@@ -1,0 +1,110 @@
+"""Provisioner API object: the user-facing capacity policy.
+
+Parity target: the v1alpha5 Provisioner CRD whose full schema is snapshotted at
+/root/reference/pkg/apis/crds/karpenter.sh_provisioners.yaml:24-305 (fields:
+requirements, limits, taints, startupTaints, ttlSecondsAfterEmpty,
+ttlSecondsUntilExpired, consolidation, weight, kubeletConfiguration, labels,
+provider/providerRef) plus the AWS defaulting/validation alias at
+/root/reference/pkg/apis/v1alpha5/provisioner.go:30-60 (defaults: linux OS,
+amd64 arch, on-demand capacity type).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..models.pod import Taint
+from ..models.requirements import Requirement, Requirements, OP_IN
+from . import wellknown as wk
+
+
+class ValidationError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class Limits:
+    """Provisioner.spec.limits.resources — cluster-wide caps per provisioner
+    (designs/limits.md; crds yaml `limits`)."""
+
+    cpu_millis: Optional[int] = None
+    memory_bytes: Optional[int] = None
+
+    def exceeded_by(self, used_cpu_millis: int, used_memory_bytes: int) -> "Optional[str]":
+        if self.cpu_millis is not None and used_cpu_millis > self.cpu_millis:
+            return f"cpu limit exceeded: {used_cpu_millis}m > {self.cpu_millis}m"
+        if self.memory_bytes is not None and used_memory_bytes > self.memory_bytes:
+            return f"memory limit exceeded: {used_memory_bytes} > {self.memory_bytes}"
+        return None
+
+
+@dataclasses.dataclass
+class KubeletConfiguration:
+    """Provisioner.spec.kubeletConfiguration subset that affects scheduling
+    (maxPods, podsPerCore, reserved resources; settings.md + instancetype.go
+    overhead math)."""
+
+    max_pods: Optional[int] = None
+    pods_per_core: Optional[int] = None
+    system_reserved_cpu_millis: int = 0
+    system_reserved_memory_bytes: int = 0
+    kube_reserved_cpu_millis: Optional[int] = None
+    kube_reserved_memory_bytes: Optional[int] = None
+    eviction_hard_memory_bytes: int = 100 * 2**20  # 100Mi default
+
+
+@dataclasses.dataclass
+class Provisioner:
+    name: str
+    requirements: Requirements = dataclasses.field(default_factory=Requirements)
+    taints: "tuple[Taint, ...]" = ()
+    startup_taints: "tuple[Taint, ...]" = ()
+    labels: "tuple[tuple[str, str], ...]" = ()
+    limits: Limits = dataclasses.field(default_factory=Limits)
+    weight: int = 0  # higher wins when multiple provisioners match (core semantics)
+    ttl_seconds_after_empty: Optional[int] = None
+    ttl_seconds_until_expired: Optional[int] = None
+    consolidation_enabled: bool = False
+    kubelet: KubeletConfiguration = dataclasses.field(default_factory=KubeletConfiguration)
+    provider_ref: Optional[str] = None  # NodeTemplate name
+
+    def set_defaults(self) -> None:
+        """Reference defaulting (v1alpha5/provisioner.go:45-60): default OS
+        linux, arch amd64, capacity-type on-demand when unconstrained."""
+        defaults = (
+            (wk.LABEL_OS, "linux"),
+            (wk.LABEL_ARCH, "amd64"),
+            (wk.LABEL_CAPACITY_TYPE, wk.CAPACITY_TYPE_ON_DEMAND),
+        )
+        for key, value in defaults:
+            if self.requirements.get(key) is None:
+                self.requirements.add(Requirement.create(key, OP_IN, [value]))
+
+    def validate(self) -> None:
+        """Reference validation (v1alpha5/provisioner.go:34-43 + core):
+        restricted labels, consolidation/ttlSecondsAfterEmpty mutual
+        exclusion, non-negative TTLs/weight."""
+        for req in self.requirements:
+            if req.key in wk.RESTRICTED_LABELS:
+                raise ValidationError(f"restricted label in requirements: {req.key}")
+        for key, _ in self.labels:
+            if key in wk.RESTRICTED_LABELS:
+                raise ValidationError(f"restricted label: {key}")
+        if self.consolidation_enabled and self.ttl_seconds_after_empty is not None:
+            raise ValidationError(
+                "consolidation and ttlSecondsAfterEmpty are mutually exclusive"
+            )
+        for ttl in (self.ttl_seconds_after_empty, self.ttl_seconds_until_expired):
+            if ttl is not None and ttl < 0:
+                raise ValidationError("TTLs must be non-negative")
+        if self.weight < 0 or self.weight > 100:
+            raise ValidationError("weight must be in [0, 100]")
+
+    def scheduling_requirements(self) -> Requirements:
+        """requirements ∪ static labels, the constraint set a node of this
+        provisioner will carry."""
+        reqs = self.requirements.copy()
+        for k, v in self.labels:
+            reqs.add(Requirement.create(k, OP_IN, [v]))
+        return reqs

@@ -1,0 +1,158 @@
+"""Well-known scheduling labels and resource names.
+
+Parity target: /root/reference/pkg/apis/v1alpha1/register.go:30-115 (AWS label
+set: instance-category/family/generation/size/cpu/memory/gpu-*/local-nvme/
+ami-id/instance-pods + extended resources nvidia.com/gpu, amd.com/gpu,
+aws.amazon.com/neuron, habana.ai/gaudi, vpc.amazonaws.com/pod-eni) and the
+karpenter-core well-known set consumed at
+/root/reference/pkg/cloudprovider/instancetype.go:67-117 (arch, os, zone,
+capacity-type, instance-type).
+
+This build is cloud-agnostic with a TPU-cloud flavor: the well-known label
+vocabulary keeps the reference's keys (so reference workloads schedule
+unchanged) and adds TPU accelerator labels/resources.
+"""
+
+from __future__ import annotations
+
+# -- core k8s / karpenter labels -------------------------------------------------
+LABEL_ARCH = "kubernetes.io/arch"
+LABEL_OS = "kubernetes.io/os"
+LABEL_ZONE = "topology.kubernetes.io/zone"
+LABEL_REGION = "topology.kubernetes.io/region"
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+LABEL_INSTANCE_TYPE = "node.kubernetes.io/instance-type"
+LABEL_CAPACITY_TYPE = "karpenter.sh/capacity-type"
+LABEL_PROVISIONER = "karpenter.sh/provisioner-name"
+
+# -- provider instance-shape labels (reference: register.go:30-115) --------------
+LABEL_INSTANCE_CATEGORY = "karpenter.k8s.tpu/instance-category"
+LABEL_INSTANCE_FAMILY = "karpenter.k8s.tpu/instance-family"
+LABEL_INSTANCE_GENERATION = "karpenter.k8s.tpu/instance-generation"
+LABEL_INSTANCE_SIZE = "karpenter.k8s.tpu/instance-size"
+LABEL_INSTANCE_CPU = "karpenter.k8s.tpu/instance-cpu"
+LABEL_INSTANCE_MEMORY = "karpenter.k8s.tpu/instance-memory"
+LABEL_INSTANCE_PODS = "karpenter.k8s.tpu/instance-pods"
+LABEL_INSTANCE_GPU_NAME = "karpenter.k8s.tpu/instance-gpu-name"
+LABEL_INSTANCE_GPU_COUNT = "karpenter.k8s.tpu/instance-gpu-count"
+LABEL_INSTANCE_GPU_MEMORY = "karpenter.k8s.tpu/instance-gpu-memory"
+LABEL_INSTANCE_ACCEL_NAME = "karpenter.k8s.tpu/instance-accelerator-name"
+LABEL_INSTANCE_ACCEL_COUNT = "karpenter.k8s.tpu/instance-accelerator-count"
+LABEL_INSTANCE_LOCAL_NVME = "karpenter.k8s.tpu/instance-local-nvme"
+LABEL_INSTANCE_HYPERVISOR = "karpenter.k8s.tpu/instance-hypervisor"
+LABEL_AMI_ID = "karpenter.k8s.tpu/instance-ami-id"
+
+# Numeric labels support Gt/Lt operators (reference: core scheduling algebra,
+# consumed at instancetype.go:67-117 for instance-cpu/-memory/-gpu-count).
+NUMERIC_LABELS = frozenset({
+    LABEL_INSTANCE_CPU,
+    LABEL_INSTANCE_MEMORY,
+    LABEL_INSTANCE_PODS,
+    LABEL_INSTANCE_GPU_COUNT,
+    LABEL_INSTANCE_GPU_MEMORY,
+    LABEL_INSTANCE_ACCEL_COUNT,
+    LABEL_INSTANCE_GENERATION,
+    LABEL_INSTANCE_LOCAL_NVME,
+})
+
+# Restricted labels: users may not set these on Provisioners directly
+# (reference: core v1alpha5 restricted set + tags.go:29+ restricted tags).
+RESTRICTED_LABELS = frozenset({
+    LABEL_PROVISIONER,
+    "kubernetes.io/cluster",
+})
+
+WELL_KNOWN_LABELS = frozenset({
+    LABEL_ARCH, LABEL_OS, LABEL_ZONE, LABEL_REGION, LABEL_HOSTNAME,
+    LABEL_INSTANCE_TYPE, LABEL_CAPACITY_TYPE, LABEL_PROVISIONER,
+    LABEL_INSTANCE_CATEGORY, LABEL_INSTANCE_FAMILY, LABEL_INSTANCE_GENERATION,
+    LABEL_INSTANCE_SIZE, LABEL_INSTANCE_CPU, LABEL_INSTANCE_MEMORY,
+    LABEL_INSTANCE_PODS, LABEL_INSTANCE_GPU_NAME, LABEL_INSTANCE_GPU_COUNT,
+    LABEL_INSTANCE_GPU_MEMORY, LABEL_INSTANCE_ACCEL_NAME,
+    LABEL_INSTANCE_ACCEL_COUNT, LABEL_INSTANCE_LOCAL_NVME,
+    LABEL_INSTANCE_HYPERVISOR, LABEL_AMI_ID,
+})
+
+# -- capacity types (reference: v1alpha5 CapacityTypeSpot/OnDemand) --------------
+CAPACITY_TYPE_SPOT = "spot"
+CAPACITY_TYPE_ON_DEMAND = "on-demand"
+CAPACITY_TYPES = (CAPACITY_TYPE_ON_DEMAND, CAPACITY_TYPE_SPOT)
+
+# -- resource names ---------------------------------------------------------------
+RESOURCE_CPU = "cpu"
+RESOURCE_MEMORY = "memory"
+RESOURCE_PODS = "pods"
+RESOURCE_EPHEMERAL = "ephemeral-storage"
+RESOURCE_NVIDIA_GPU = "nvidia.com/gpu"
+RESOURCE_AMD_GPU = "amd.com/gpu"
+RESOURCE_TPU = "google.com/tpu"
+RESOURCE_NEURON = "aws.amazon.com/neuron"
+RESOURCE_GAUDI = "habana.ai/gaudi"
+RESOURCE_POD_ENI = "vpc.amazonaws.com/pod-eni"
+
+# Requests for resource names outside the axis land on this sentinel slot; no
+# instance type ever advertises capacity for it, so such pods are correctly
+# unschedulable rather than silently zero-demand.
+RESOURCE_UNKNOWN = "__unknown__"
+
+# Canonical resource axis for array encodings. Order is load-bearing: it is the
+# R axis of every capacity/request tensor. (Reference analogue: the resource
+# union built at instancetype.go:128-163.)
+RESOURCE_AXIS = (
+    RESOURCE_CPU,          # millicores
+    RESOURCE_MEMORY,       # MiB
+    RESOURCE_PODS,         # count
+    RESOURCE_EPHEMERAL,    # GiB
+    RESOURCE_NVIDIA_GPU,   # count
+    RESOURCE_AMD_GPU,      # count
+    RESOURCE_TPU,          # count
+    RESOURCE_NEURON,       # count
+    RESOURCE_GAUDI,        # count
+    RESOURCE_POD_ENI,      # count
+    RESOURCE_UNKNOWN,      # sentinel: capacity always 0
+)
+RESOURCE_INDEX = {name: i for i, name in enumerate(RESOURCE_AXIS)}
+NUM_RESOURCES = len(RESOURCE_AXIS)
+
+EXTENDED_RESOURCES = frozenset(RESOURCE_AXIS[4:-1])
+
+# Per-resource canonical unit scale: raw-unit value / scale = axis value.
+# cpu: millicores stay exact; memory: bytes -> MiB; ephemeral: bytes -> GiB.
+# Chosen so realistic magnitudes stay < 2**24 and are exact in float32.
+_MEM_SCALE = 2**20
+_EPH_SCALE = 2**30
+
+
+def resource_vector(requests: "dict[str, int]") -> "list[int]":
+    """dict of canonical-unit ints (cpu millis, memory bytes, counts) -> R-axis list."""
+    vec = [0] * NUM_RESOURCES
+    for name, val in requests.items():
+        idx = RESOURCE_INDEX.get(name)
+        if idx is None:
+            # unknown resource: demand lands on the sentinel slot, which no
+            # capacity ever satisfies -> pod is unschedulable, as in the
+            # reference (unknown extended resources never fit).
+            if val > 0:
+                vec[RESOURCE_INDEX[RESOURCE_UNKNOWN]] += val
+            continue
+        if name == RESOURCE_MEMORY:
+            val = -(-val // _MEM_SCALE)  # ceil to MiB: request rounds up
+        elif name == RESOURCE_EPHEMERAL:
+            val = -(-val // _EPH_SCALE)
+        vec[idx] = val
+    return vec
+
+
+def capacity_vector(capacity: "dict[str, int]") -> "list[int]":
+    """Like resource_vector but rounds memory/storage DOWN (capacity is floor)."""
+    vec = [0] * NUM_RESOURCES
+    for name, val in capacity.items():
+        idx = RESOURCE_INDEX.get(name)
+        if idx is None:
+            continue
+        if name == RESOURCE_MEMORY:
+            val = val // _MEM_SCALE
+        elif name == RESOURCE_EPHEMERAL:
+            val = val // _EPH_SCALE
+        vec[idx] = val
+    return vec

@@ -1,0 +1,207 @@
+"""Instance types, offerings, and the offering algebra.
+
+Parity targets:
+- `cloudprovider.InstanceType{Name, Requirements, Offerings, Capacity,
+  Overhead}.Allocatable()` — /root/reference/pkg/cloudprovider/instancetype.go:50-65
+  and consumption at cloudprovider.go:352-363.
+- `cloudprovider.Offering{Zone, CapacityType, Price, Available}` with
+  `Offerings.Available().Requirements(reqs).Cheapest()` —
+  instancetypes.go:133-161, instance.go:445-462.
+- Capacity/overhead computation (vmMemoryOverheadPercent, kubeReserved CPU
+  curve, eviction threshold, ENI-limited pod density) —
+  instancetype.go:128-163, 229-319. Re-derived, not copied: see
+  karpenter_tpu/providers/instancetypes.py for the generator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional
+
+from ..apis import wellknown as wk
+from .requirements import Requirement, Requirements
+
+
+@dataclasses.dataclass(frozen=True)
+class Offering:
+    zone: str
+    capacity_type: str  # "spot" | "on-demand"
+    price: float
+    available: bool = True
+
+
+class Offerings(tuple):
+    """Ordered offering collection with the reference's filter/select algebra."""
+
+    def available(self) -> "Offerings":
+        return Offerings(o for o in self if o.available)
+
+    def requirements(self, reqs: Requirements) -> "Offerings":
+        """Filter by zone/capacity-type requirements (instance.go:445-462)."""
+        zone_req = reqs.get(wk.LABEL_ZONE)
+        ct_req = reqs.get(wk.LABEL_CAPACITY_TYPE)
+        out = []
+        for o in self:
+            if zone_req is not None and not zone_req.has(o.zone):
+                continue
+            if ct_req is not None and not ct_req.has(o.capacity_type):
+                continue
+            out.append(o)
+        return Offerings(out)
+
+    def cheapest(self) -> Optional[Offering]:
+        return min(self, key=lambda o: o.price, default=None)
+
+    def has(self, zone: str, capacity_type: str) -> bool:
+        return any(o.zone == zone and o.capacity_type == capacity_type for o in self)
+
+
+@dataclasses.dataclass(frozen=True)
+class InstanceType:
+    name: str
+    labels: "tuple[tuple[str, str], ...]"  # well-known labels, concrete values
+    capacity: "tuple[tuple[str, int], ...]"  # canonical units (cpu millis, mem bytes, counts)
+    overhead: "tuple[tuple[str, int], ...]" = ()
+    offerings: Offerings = Offerings()
+
+    def labels_dict(self) -> "dict[str, str]":
+        return dict(self.labels)
+
+    def requirements(self) -> Requirements:
+        """Single-valued In requirements from labels + multi-valued zone /
+        capacity-type from offerings (instancetype.go:67-117)."""
+        reqs = Requirements.from_labels(
+            {k: v for k, v in self.labels if k not in (wk.LABEL_ZONE, wk.LABEL_CAPACITY_TYPE)}
+        )
+        # zone/capacity-type sets come from AVAILABLE offerings only, matching
+        # the reference (unavailable offerings are filtered before requirements
+        # are consulted, instancetypes.go:133-161).
+        zones = sorted({o.zone for o in self.offerings.available()})
+        cts = sorted({o.capacity_type for o in self.offerings.available()})
+        if zones:
+            reqs.add(Requirement.create(wk.LABEL_ZONE, "In", zones))
+        if cts:
+            reqs.add(Requirement.create(wk.LABEL_CAPACITY_TYPE, "In", cts))
+        return reqs
+
+    def allocatable_vector(self) -> "list[int]":
+        """capacity - overhead on the canonical resource axis
+        (InstanceType.Allocatable(), cloudprovider.go:352-363)."""
+        cap = wk.capacity_vector(dict(self.capacity))
+        ovh = wk.capacity_vector(dict(self.overhead))
+        return [max(0, c - o) for c, o in zip(cap, ovh)]
+
+    def cheapest_price(self, reqs: Requirements) -> float:
+        off = self.offerings.available().requirements(reqs).cheapest()
+        return off.price if off is not None else float("inf")
+
+
+@dataclasses.dataclass
+class Catalog:
+    """The full instance-type universe for one solve (device-resident on TPU).
+
+    Versioned with a seqnum like the reference's instance-type cache
+    (instancetypes.go:62-68): any mutation bumps `seqnum`, invalidating
+    device-side encodings.
+    """
+
+    types: "list[InstanceType]"
+    seqnum: int = 0
+
+    def __post_init__(self):
+        self.by_name = {t.name: t for t in self.types}
+
+    def bump(self):
+        self.seqnum += 1
+
+    def filter_compatible(self, reqs: Requirements) -> "list[InstanceType]":
+        """requirements-compatible ∧ offerings-available filter
+        (cloudprovider.go:315-321 resolveInstanceTypes)."""
+        out = []
+        for t in self.types:
+            if not t.offerings.available().requirements(reqs):
+                continue
+            if not reqs.matches_labels(self._schedulable_labels(t, reqs)):
+                continue
+            out.append(t)
+        return out
+
+    @staticmethod
+    def _schedulable_labels(t: InstanceType, reqs: Requirements) -> "dict[str, str]":
+        """Labels view where zone/capacity-type take any offered value that the
+        requirements accept (multi-valued keys resolved against offerings)."""
+        labels = t.labels_dict()
+        zone_req = reqs.get(wk.LABEL_ZONE)
+        ct_req = reqs.get(wk.LABEL_CAPACITY_TYPE)
+        for o in t.offerings:
+            if not o.available:
+                continue
+            if zone_req is not None and not zone_req.has(o.zone):
+                continue
+            if ct_req is not None and not ct_req.has(o.capacity_type):
+                continue
+            labels[wk.LABEL_ZONE] = o.zone
+            labels[wk.LABEL_CAPACITY_TYPE] = o.capacity_type
+            return labels
+        # no offering satisfies; leave first offering's values so match fails
+        if t.offerings:
+            labels[wk.LABEL_ZONE] = t.offerings[0].zone
+            labels[wk.LABEL_CAPACITY_TYPE] = t.offerings[0].capacity_type
+        return labels
+
+
+def make_instance_type(
+    name: str,
+    cpu: "str | int",
+    memory: "str | int",
+    arch: str = "amd64",
+    os: str = "linux",
+    pods: int = 110,
+    zones: Iterable[str] = ("zone-1a", "zone-1b", "zone-1c"),
+    od_price: float = 1.0,
+    spot_price: "Optional[float]" = None,
+    extended: "Optional[dict[str, int]]" = None,
+    extra_labels: "Optional[dict[str, str]]" = None,
+    overhead_cpu: "str | int" = "0",
+    overhead_memory: "str | int" = "0",
+) -> InstanceType:
+    """Test/fixture constructor (reference analogue: fake instance-type fixtures,
+    pkg/fake/zz_generated.describe_instance_types.go)."""
+    from ..utils.quantity import cpu_millis, mem_bytes
+
+    family, _, size = name.partition(".")
+    cap = {
+        wk.RESOURCE_CPU: cpu_millis(cpu),
+        wk.RESOURCE_MEMORY: mem_bytes(memory),
+        wk.RESOURCE_PODS: pods,
+        wk.RESOURCE_EPHEMERAL: mem_bytes("20Gi"),
+    }
+    for k, v in (extended or {}).items():
+        cap[k] = v
+    labels = {
+        wk.LABEL_INSTANCE_TYPE: name,
+        wk.LABEL_ARCH: arch,
+        wk.LABEL_OS: os,
+        wk.LABEL_INSTANCE_FAMILY: family,
+        wk.LABEL_INSTANCE_SIZE: size or "std",
+        wk.LABEL_INSTANCE_CPU: str(cpu_millis(cpu) // 1000),
+        wk.LABEL_INSTANCE_MEMORY: str(mem_bytes(memory) // (2**20)),
+        wk.LABEL_INSTANCE_PODS: str(pods),
+    }
+    labels.update(extra_labels or {})
+    offerings = []
+    for z in zones:
+        offerings.append(Offering(zone=z, capacity_type=wk.CAPACITY_TYPE_ON_DEMAND, price=od_price))
+        if spot_price is not None:
+            offerings.append(Offering(zone=z, capacity_type=wk.CAPACITY_TYPE_SPOT, price=spot_price))
+    overhead = {
+        wk.RESOURCE_CPU: cpu_millis(overhead_cpu),
+        wk.RESOURCE_MEMORY: mem_bytes(overhead_memory),
+    }
+    return InstanceType(
+        name=name,
+        labels=tuple(sorted(labels.items())),
+        capacity=tuple(sorted(cap.items())),
+        overhead=tuple(sorted(overhead.items())),
+        offerings=Offerings(offerings),
+    )

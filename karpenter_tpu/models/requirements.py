@@ -1,0 +1,277 @@
+"""Scheduling requirements algebra.
+
+Parity target: karpenter-core's `scheduling.Requirements` /
+`NewRequirement(key, op, values...)` / `.Compatible()` / `.Intersects()` — the
+constraint algebra the reference consumes at
+/root/reference/pkg/cloudprovider/instancetype.go:67-117 (instance-type
+requirements construction), cloudprovider.go:315-321 (compatibility filter) and
+amifamily/ami.go:112-119 (AMI requirement matching).
+
+A requirement is a constraint on one label key with an operator:
+In / NotIn / Exists / DoesNotExist / Gt / Lt. A `Requirements` object is a
+per-key conjunction. Sets with the NotIn operator are modeled as complement
+("everything except values"), like the reference's complement sets; Gt/Lt keep
+integer bounds alongside.
+
+This host-side algebra is the exact-semantics spec. The TPU path folds each
+Requirements object into a dense boolean mask over the instance-type axis (see
+karpenter_tpu/ops/masks.py) — the fold is checked against this module
+property-test-style in tests/test_masks.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional
+
+OP_IN = "In"
+OP_NOT_IN = "NotIn"
+OP_EXISTS = "Exists"
+OP_DOES_NOT_EXIST = "DoesNotExist"
+OP_GT = "Gt"
+OP_LT = "Lt"
+OPERATORS = (OP_IN, OP_NOT_IN, OP_EXISTS, OP_DOES_NOT_EXIST, OP_GT, OP_LT)
+
+
+class IncompatibleError(ValueError):
+    """Raised when two Requirements cannot be satisfied simultaneously."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Requirement:
+    """A single (key, operator, values) constraint, normalized to set form.
+
+    complement=False: allowed iff value in `values` (plus bounds).
+    complement=True:  allowed iff value not in `values` (plus bounds).
+    forbid_key=True:  the key must be ABSENT (DoesNotExist).
+    """
+
+    key: str
+    complement: bool = False
+    values: frozenset = frozenset()
+    gt: Optional[int] = None  # exclusive lower bound
+    lt: Optional[int] = None  # exclusive upper bound
+    forbid_key: bool = False
+
+    @staticmethod
+    def create(key: str, op: str, values: Iterable[str] = ()) -> "Requirement":
+        values = tuple(str(v) for v in values)
+        if op == OP_IN:
+            return Requirement(key, complement=False, values=frozenset(values))
+        if op == OP_NOT_IN:
+            return Requirement(key, complement=True, values=frozenset(values))
+        if op == OP_EXISTS:
+            return Requirement(key, complement=True, values=frozenset())
+        if op == OP_DOES_NOT_EXIST:
+            return Requirement(key, forbid_key=True)
+        if op == OP_GT:
+            (v,) = values
+            return Requirement(key, complement=True, values=frozenset(), gt=int(v))
+        if op == OP_LT:
+            (v,) = values
+            return Requirement(key, complement=True, values=frozenset(), lt=int(v))
+        raise ValueError(f"unknown operator {op!r}")
+
+    # -- value membership ---------------------------------------------------------
+
+    def has(self, value: str) -> bool:
+        """Does a concrete label value satisfy this requirement?"""
+        if self.forbid_key:
+            return False
+        if self.complement:
+            if value in self.values:
+                return False
+        else:
+            if value not in self.values:
+                return False
+        if self.gt is not None or self.lt is not None:
+            try:
+                num = int(value)
+            except ValueError:
+                return False
+            if self.gt is not None and not num > self.gt:
+                return False
+            if self.lt is not None and not num < self.lt:
+                return False
+        return True
+
+    def allows_absent(self) -> bool:
+        """Is an object WITHOUT this key acceptable?
+
+        k8s nodeSelectorTerm semantics: In/Exists/Gt/Lt fail on a missing
+        label; NotIn and DoesNotExist succeed.
+        """
+        if self.forbid_key:
+            return True
+        # Pure NotIn (complement, no bounds) tolerates absence; Exists
+        # (complement of empty set) is encoded identically, so we track
+        # "absence-tolerant" by whether this originated from NotIn. We encode
+        # Exists as complement-of-empty WITH gt/lt None; distinguish via
+        # `_requires_presence`.
+        return self.complement and bool(self.values) and self.gt is None and self.lt is None
+
+    # -- set algebra --------------------------------------------------------------
+
+    def intersect(self, other: "Requirement") -> "Requirement":
+        assert self.key == other.key
+        if self.forbid_key or other.forbid_key:
+            # DoesNotExist ∩ anything-presence-requiring = empty; with
+            # absence-tolerant sets, result is still "key must be absent".
+            if (self.forbid_key or self.allows_absent()) and (
+                other.forbid_key or other.allows_absent()
+            ):
+                return Requirement(self.key, forbid_key=True)
+            raise IncompatibleError(f"key {self.key}: DoesNotExist vs presence-requiring")
+        gt = self.gt if other.gt is None else (other.gt if self.gt is None else max(self.gt, other.gt))
+        lt = self.lt if other.lt is None else (other.lt if self.lt is None else min(self.lt, other.lt))
+        if not self.complement and not other.complement:
+            values = self.values & other.values
+            complement = False
+        elif self.complement and other.complement:
+            values = self.values | other.values
+            complement = True
+        else:
+            allow = self.values if not self.complement else other.values
+            deny = other.values if not self.complement else self.values
+            values = allow - deny
+            complement = False
+        req = Requirement(self.key, complement=complement, values=values, gt=gt, lt=lt)
+        if req.definitely_empty():
+            raise IncompatibleError(f"key {self.key}: empty intersection")
+        return req
+
+    def definitely_empty(self) -> bool:
+        if self.forbid_key:
+            return False
+        if not self.complement:
+            return not any(self.has(v) for v in self.values)
+        if self.gt is not None and self.lt is not None and self.lt - self.gt <= 1:
+            return True
+        return False
+
+    def intersects(self, other: "Requirement") -> bool:
+        try:
+            self.intersect(other)
+            return True
+        except IncompatibleError:
+            return False
+
+
+class Requirements:
+    """Per-key conjunction of Requirements, with karpenter-core's algebra."""
+
+    def __init__(self, reqs: Iterable[Requirement] = ()):
+        self._by_key: dict[str, Requirement] = {}
+        for r in reqs:
+            self.add(r)
+
+    @staticmethod
+    def of(*specs: "tuple[str, str, Iterable[str]] | tuple[str, str]") -> "Requirements":
+        out = Requirements()
+        for spec in specs:
+            key, op, *rest = spec
+            out.add(Requirement.create(key, op, rest[0] if rest else ()))
+        return out
+
+    @staticmethod
+    def from_node_selector(selector: "dict[str, str]") -> "Requirements":
+        return Requirements(
+            Requirement.create(k, OP_IN, [v]) for k, v in sorted(selector.items())
+        )
+
+    @staticmethod
+    def from_labels(labels: "dict[str, str]") -> "Requirements":
+        """Instance-type labels -> single-valued In requirements.
+
+        Reference analogue: computeRequirements at instancetype.go:67-117.
+        """
+        return Requirements(
+            Requirement.create(k, OP_IN, [v]) for k, v in sorted(labels.items())
+        )
+
+    def add(self, req: Requirement) -> None:
+        existing = self._by_key.get(req.key)
+        self._by_key[req.key] = existing.intersect(req) if existing else req
+
+    def keys(self):
+        return self._by_key.keys()
+
+    def get(self, key: str) -> Optional[Requirement]:
+        return self._by_key.get(key)
+
+    def __iter__(self):
+        return iter(self._by_key.values())
+
+    def __len__(self):
+        return len(self._by_key)
+
+    def copy(self) -> "Requirements":
+        out = Requirements()
+        out._by_key = dict(self._by_key)
+        return out
+
+    def union(self, other: "Requirements") -> "Requirements":
+        """Conjunction of both (karpenter-core's Requirements.Add/Intersect)."""
+        out = self.copy()
+        for r in other:
+            out.add(r)
+        return out
+
+    def matches_labels(self, labels: "dict[str, str]") -> bool:
+        """Do concrete labels (e.g. an instance type's) satisfy every requirement?"""
+        for key, req in self._by_key.items():
+            if key in labels:
+                if not req.has(labels[key]):
+                    return False
+            else:
+                if not req.allows_absent():
+                    return False
+        return True
+
+    def compatible(self, other: "Requirements") -> bool:
+        """Non-empty intersection per key (karpenter-core Requirements.Compatible,
+        consumed at cloudprovider.go:315-321)."""
+        for key in set(self._by_key) | set(other._by_key):
+            a, b = self._by_key.get(key), other._by_key.get(key)
+            if a is None or b is None:
+                req = a or b
+                # A lone In/Exists/Gt/Lt is satisfiable by SOME labeled object;
+                # compatibility against the wildcard side always holds.
+                if req.definitely_empty():
+                    return False
+                continue
+            if not a.intersects(b):
+                return False
+        return True
+
+    def to_specs(self) -> "list[tuple[str, str, list[str]]]":
+        """Serialize to (key, op, values) triples (wire/CRD form).
+
+        Canonical: semantically-equal Requirements produce identical specs (a
+        key may emit several triples — e.g. a merged Gt+Lt emits both). Used
+        by PodSpec.group_key(), so canonicality is load-bearing for dedupe.
+        """
+        out = []
+        for key, r in sorted(self._by_key.items()):
+            if r.forbid_key:
+                out.append((key, OP_DOES_NOT_EXIST, []))
+            elif not r.complement:
+                # bounds folded into the explicit value set
+                out.append((key, OP_IN, sorted(v for v in r.values if r.has(v))))
+            else:
+                emitted = False
+                if r.values:
+                    out.append((key, OP_NOT_IN, sorted(r.values)))
+                    emitted = True
+                if r.gt is not None:
+                    out.append((key, OP_GT, [str(r.gt)]))
+                    emitted = True
+                if r.lt is not None:
+                    out.append((key, OP_LT, [str(r.lt)]))
+                    emitted = True
+                if not emitted:
+                    out.append((key, OP_EXISTS, []))
+        return out
+
+    def __repr__(self):
+        return f"Requirements({self.to_specs()!r})"

@@ -1,0 +1,336 @@
+"""Scalar reference scheduler — the exact-semantics spec (the "Go fallback").
+
+Parity target: karpenter-core's provisioning scheduler, specified by
+/root/reference/designs/bin-packing.md:17-43 (First-Fit-Decreasing: sort pods
+by non-increasing requests; pods go to the first node that fits; new nodes
+keep the full set of instance types that can satisfy the accumulated pods) and
+the selection semantics of /root/reference/pkg/cloudprovider/instance.go:430-462
+(price-ordered choice; spot taken when allowed and offered).
+
+Semantics model (shared letter-for-letter with the TPU kernel in
+karpenter_tpu/ops/packer.py):
+
+* The schedulable universe is a list of OPTIONS — one per (instanceType, zone,
+  capacityType) offering. Every label constraint a pod or provisioner can
+  express either (a) is determined by the option (type labels, zone,
+  capacity-type, provisioner labels) or (b) is a fixed per-pod-vs-provisioner
+  check. Hence a node-under-construction is fully described by its surviving
+  option set + used-resource vector — the reference's "requirements tighten as
+  pods are added" behavior falls out of option-set intersection.
+
+* FFD: pods sorted by (cpu desc, memory desc, name asc). Each pod lands on the
+  FIRST open node (creation order) whose option set intersects the pod's and
+  whose capacity still fits; otherwise a new node is opened for the
+  highest-weight provisioner that admits the pod.
+
+* Final launch decision per node: cheapest available option; ties broken by
+  (price, spot-before-on-demand, type name, zone) — mirroring CreateFleet
+  lowest-price / price-capacity-optimized selection (instance.go:240-244).
+
+This oracle is used (1) as the in-process fallback solver when the TPU sidecar
+is unreachable (BASELINE.json north star) and (2) as the golden model the
+kernel is differential-tested against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional, Sequence
+
+from ..apis import wellknown as wk
+from ..apis.provisioner import Provisioner
+from ..models.instancetype import Catalog, InstanceType
+from ..models.pod import PodGroup, PodSpec, Taint, group_pods, tolerates_all
+from ..models.requirements import Requirement, Requirements, IncompatibleError, OP_IN
+
+
+@dataclasses.dataclass(frozen=True)
+class Option:
+    """One schedulable (type, zone, capacityType) offering."""
+
+    index: int
+    itype: InstanceType
+    zone: str
+    capacity_type: str
+    price: float
+    alloc: "tuple[int, ...]" = ()  # precomputed allocatable_vector (hot-loop cache)
+
+    def sort_key(self):
+        # price asc; spot preferred at equal price (instance.go:430-443 takes
+        # spot whenever allowed+offered; spot is cheaper in practice, this tie
+        # break makes that deterministic at equal price); then name, zone.
+        return (self.price, self.capacity_type != wk.CAPACITY_TYPE_SPOT, self.itype.name, self.zone)
+
+
+def build_options(catalog: Catalog) -> "list[Option]":
+    opts: "list[Option]" = []
+    for t in catalog.types:
+        alloc = tuple(t.allocatable_vector())
+        for o in t.offerings:
+            if not o.available:
+                continue
+            opts.append(Option(len(opts), t, o.zone, o.capacity_type, o.price, alloc))
+    return opts
+
+
+def option_labels(opt: Option, prov: Provisioner) -> "dict[str, str]":
+    labels = opt.itype.labels_dict()
+    labels[wk.LABEL_ZONE] = opt.zone
+    labels[wk.LABEL_CAPACITY_TYPE] = opt.capacity_type
+    labels[wk.LABEL_PROVISIONER] = prov.name
+    for k, v in prov.labels:
+        labels.setdefault(k, v)
+    return labels
+
+
+def feasible_options(
+    group: PodSpec,
+    prov: Provisioner,
+    options: Sequence[Option],
+    daemon_overhead: Sequence[int],
+) -> "set[int]":
+    """Options admitting ONE pod of this spec on a fresh node of `prov`.
+
+    Mirrors resolveInstanceTypes' compatible ∧ available ∧ fits filter
+    (cloudprovider.go:302-321)."""
+    if not tolerates_all(group.tolerations, prov.taints):
+        return set()
+    try:
+        reqs = prov.scheduling_requirements().union(group.requirements)
+    except IncompatibleError:
+        return set()
+    vec = group.resource_vector()
+    out: "set[int]" = set()
+    for opt in options:
+        if not reqs.matches_labels(option_labels(opt, prov)):
+            continue
+        if all(d + v <= a for d, v, a in zip(daemon_overhead, vec, opt.alloc)):
+            out.add(opt.index)
+    return out
+
+
+@dataclasses.dataclass
+class NodeClaim:
+    """A node under construction (karpenter-core "Machine"/node claim)."""
+
+    provisioner: Provisioner
+    options: "set[int]"
+    used: "list[int]"
+    pods: "list[PodSpec]" = dataclasses.field(default_factory=list)
+    group_counts: "dict[object, int]" = dataclasses.field(default_factory=dict)
+    decided: Optional[Option] = None
+
+    def decide(self, options: Sequence[Option]) -> Option:
+        if self.decided is None:
+            self.decided = min(
+                (options[i] for i in self.options), key=Option.sort_key
+            )
+        return self.decided
+
+
+@dataclasses.dataclass
+class ExistingNode:
+    """An already-launched node considered during scheduling/consolidation
+    (cluster state; state.NewCluster at main.go:54)."""
+
+    name: str
+    labels: "dict[str, str]"
+    allocatable: "list[int]"
+    used: "list[int]"
+    taints: "tuple[Taint, ...]" = ()
+    group_counts: "dict[object, int]" = dataclasses.field(default_factory=dict)
+
+    def fits(self, group: PodSpec, vec: Sequence[int]) -> bool:
+        if not tolerates_all(group.tolerations, self.taints):
+            return False
+        if not group.requirements.matches_labels(self.labels):
+            return False
+        return all(u + v <= a for u, v, a in zip(self.used, vec, self.allocatable))
+
+
+@dataclasses.dataclass
+class SchedulingResult:
+    new_nodes: "list[NodeClaim]"
+    existing_assignments: "dict[str, list[PodSpec]]"
+    unschedulable: "list[PodSpec]"
+
+    def node_decisions(self, options: Sequence[Option]) -> "list[tuple[str, str, str, int]]":
+        """[(instance type, zone, capacityType, pod count)] sorted — the
+        decision fingerprint used for kernel/oracle parity checks."""
+        out = []
+        for n in self.new_nodes:
+            opt = n.decide(options)
+            out.append((opt.itype.name, opt.zone, opt.capacity_type, len(n.pods)))
+        return sorted(out)
+
+
+def _group_cap_per_node(spec: PodSpec) -> Optional[int]:
+    """Max pods of one group on one node, from hostname topology/anti-affinity.
+
+    Hostname anti-affinity => 1. Hostname spread with maxSkew s => s (each new
+    node is a fresh domain with zero pods; skew bound caps the run). Zone
+    spread is handled by the zone pre-pass, not here.
+    """
+    cap: Optional[int] = None
+    if spec.anti_affinity_hostname:
+        cap = 1
+    for c in spec.topology:
+        if c.topology_key == wk.LABEL_HOSTNAME and c.when_unsatisfiable == "DoNotSchedule":
+            cap = c.max_skew if cap is None else min(cap, c.max_skew)
+    return cap
+
+
+def split_zone_spread(groups: "list[PodGroup]", zones: Sequence[str]) -> "list[PodGroup]":
+    """Pre-pass: groups with a zone topology-spread constraint are split into
+    per-zone subgroups with an explicit zone requirement, counts balanced
+    round-robin (maxSkew-respecting since shares differ by <=1).
+
+    Reference analogue: the scheduler's topology domain narrowing; E2E
+    spread-zone.yaml expects even distribution across AZs.
+    """
+    out: "list[PodGroup]" = []
+    for g in groups:
+        zc = [c for c in g.spec.topology if c.topology_key == wk.LABEL_ZONE
+              and c.when_unsatisfiable == "DoNotSchedule"]
+        if not zc and not g.spec.anti_affinity_zone:
+            out.append(g)
+            continue
+        zreq = g.spec.requirements.get(wk.LABEL_ZONE)
+        allowed = [z for z in sorted(zones) if zreq is None or zreq.has(z)]
+        if not allowed:
+            out.append(g)
+            continue
+        if g.spec.anti_affinity_zone:
+            # one pod per zone; surplus pods are unschedulable (pinned to the
+            # sentinel zone no offering carries)
+            shares = [1 if i < g.count else 0 for i in range(len(allowed))]
+            surplus = g.count - sum(shares)
+        else:
+            base, extra = divmod(g.count, len(allowed))
+            shares = [base + (1 if i < extra else 0) for i in range(len(allowed))]
+            surplus = 0
+        pos = 0
+        for z, share in zip(allowed, shares):
+            if share == 0:
+                continue
+            try:
+                reqs = g.spec.requirements.copy()
+                reqs.add(Requirement.create(wk.LABEL_ZONE, OP_IN, [z]))
+            except IncompatibleError:
+                continue
+            spec = dataclasses.replace(g.spec, requirements=reqs)
+            out.append(PodGroup(spec=spec, count=share, pod_names=g.pod_names[pos:pos + share]))
+            pos += share
+        if surplus > 0:
+            spec = dataclasses.replace(g.spec, requirements=Requirements.of(
+                (wk.LABEL_ZONE, OP_IN, ["__no-zone__"])))
+            out.append(PodGroup(spec=spec, count=surplus, pod_names=g.pod_names[pos:pos + surplus]))
+    return out
+
+
+class Scheduler:
+    """FFD bin-packing over pod groups (the provisioning hot loop,
+    designs/bin-packing.md:17-43)."""
+
+    def __init__(
+        self,
+        catalog: Catalog,
+        provisioners: Sequence[Provisioner],
+        daemon_overhead: Optional[Sequence[int]] = None,
+    ):
+        self.catalog = catalog
+        self.options = build_options(catalog)
+        self.zones = sorted({o.zone for o in self.options})
+        # weight desc, then name asc (core: higher weight preferred)
+        self.provisioners = sorted(provisioners, key=lambda p: (-p.weight, p.name))
+        self.daemon_overhead = list(daemon_overhead or [0] * wk.NUM_RESOURCES)
+
+    def schedule(
+        self,
+        pods: "list[PodSpec]",
+        existing: "Iterable[ExistingNode]" = (),
+    ) -> SchedulingResult:
+        groups = group_pods([p for p in pods if not p.is_daemon()])
+        groups = split_zone_spread(groups, self.zones)
+        # FFD order: cpu desc, memory desc, name asc (bin-packing.md step 1)
+        groups.sort(key=lambda g: (
+            -g.vector[wk.RESOURCE_INDEX[wk.RESOURCE_CPU]],
+            -g.vector[wk.RESOURCE_INDEX[wk.RESOURCE_MEMORY]],
+            g.spec.name,
+        ))
+
+        feas_cache: "dict[tuple[int, str], set[int]]" = {}
+        nodes: "list[NodeClaim]" = []
+        existing = list(existing)
+        assignments: "dict[str, list[PodSpec]]" = {e.name: [] for e in existing}
+        unschedulable: "list[PodSpec]" = []
+
+        for gi, g in enumerate(groups):
+            vec = g.vector
+            cap = _group_cap_per_node(g.spec)
+            gkey = g.spec.group_key()
+            for _ in range(g.count):
+                placed = False
+                # 1) existing cluster nodes first (in-flight awareness,
+                #    bin-packing.md grouping + core scheduler behavior)
+                for e in existing:
+                    if cap is not None and e.group_counts.get(gkey, 0) >= cap:
+                        continue
+                    if e.fits(g.spec, vec):
+                        e.used = [u + v for u, v in zip(e.used, vec)]
+                        e.group_counts[gkey] = e.group_counts.get(gkey, 0) + 1
+                        assignments[e.name].append(g.spec)
+                        placed = True
+                        break
+                if placed:
+                    continue
+                # 2) first open node claim whose option set still admits the pod
+                for n in nodes:
+                    if cap is not None and n.group_counts.get(gkey, 0) >= cap:
+                        continue
+                    pk = (gi, n.provisioner.name)
+                    if pk not in feas_cache:
+                        feas_cache[pk] = feasible_options(
+                            g.spec, n.provisioner, self.options, self.daemon_overhead
+                        )
+                    shared = n.options & feas_cache[pk]
+                    if not shared:
+                        continue
+                    new_used = [u + v for u, v in zip(n.used, vec)]
+                    fitting = {
+                        i for i in shared
+                        if all(u <= a for u, a in zip(new_used, self.options[i].alloc))
+                    }
+                    if not fitting:
+                        continue
+                    n.options = fitting
+                    n.used = new_used
+                    n.pods.append(g.spec)
+                    n.group_counts[gkey] = n.group_counts.get(gkey, 0) + 1
+                    placed = True
+                    break
+                if placed:
+                    continue
+                # 3) open a new node: first provisioner (weight order) that admits
+                for prov in self.provisioners:
+                    pk2 = (gi, prov.name)
+                    if pk2 not in feas_cache:
+                        feas_cache[pk2] = feasible_options(
+                            g.spec, prov, self.options, self.daemon_overhead
+                        )
+                    if feas_cache[pk2]:
+                        nodes.append(NodeClaim(
+                            provisioner=prov,
+                            options=set(feas_cache[pk2]),
+                            used=[d + v for d, v in zip(self.daemon_overhead, vec)],
+                            pods=[g.spec],
+                            group_counts={gkey: 1},
+                        ))
+                        placed = True
+                        break
+                if not placed:
+                    unschedulable.append(g.spec)
+
+        for n in nodes:
+            n.decide(self.options)
+        return SchedulingResult(nodes, assignments, unschedulable)

@@ -1,0 +1,84 @@
+"""Kubernetes resource-quantity parsing.
+
+Parity target: the reference consumes k8s `resource.Quantity` values everywhere a
+pod requests resources or an instance type advertises capacity (e.g.
+/root/reference/pkg/cloudprovider/instancetype.go:128-163 builds capacity from
+vCPU counts / MiB memory; examples/workloads/inflate.yaml uses "1" cpu / "256M").
+
+We normalize every quantity to an integer in a canonical per-resource unit so
+that downstream array math (float32 on TPU) stays exact: values are kept under
+2**24 whenever realistic, and the scalar oracle uses exact ints.
+"""
+
+from __future__ import annotations
+
+import re
+from fractions import Fraction
+
+_SUFFIX = {
+    "": 1,
+    "k": 10**3,
+    "M": 10**6,
+    "G": 10**9,
+    "T": 10**12,
+    "P": 10**15,
+    "E": 10**18,
+    "Ki": 2**10,
+    "Mi": 2**20,
+    "Gi": 2**30,
+    "Ti": 2**40,
+    "Pi": 2**50,
+    "Ei": 2**60,
+}
+
+_QTY_RE = re.compile(r"^\s*([+-]?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)\s*([A-Za-z]*)\s*$")
+
+
+def parse_quantity(value: "str | int | float") -> Fraction:
+    """Parse a k8s quantity string to an exact Fraction of base units.
+
+    "100m" -> 1/10, "256M" -> 256_000_000, "1Gi" -> 2**30, "2" -> 2.
+    """
+    if isinstance(value, bool):
+        raise ValueError(f"invalid quantity: {value!r}")
+    if isinstance(value, int):
+        return Fraction(value)
+    if isinstance(value, float):
+        return Fraction(value).limit_denominator(10**9)
+    m = _QTY_RE.match(value)
+    if not m:
+        raise ValueError(f"invalid quantity: {value!r}")
+    number, suffix = m.groups()
+    if suffix == "m":  # milli
+        return Fraction(number) / 1000
+    if suffix not in _SUFFIX:
+        raise ValueError(f"invalid quantity suffix: {value!r}")
+    return Fraction(number) * _SUFFIX[suffix]
+
+
+def cpu_millis(value: "str | int | float") -> int:
+    """CPU quantity -> integer millicores ("1" -> 1000, "100m" -> 100)."""
+    return int(parse_quantity(value) * 1000)
+
+
+def mem_bytes(value: "str | int | float") -> int:
+    """Memory/storage quantity -> integer bytes."""
+    return int(parse_quantity(value))
+
+
+def count(value: "str | int | float") -> int:
+    """Counted resource (pods, GPUs, ENIs) -> integer."""
+    return int(parse_quantity(value))
+
+
+def format_cpu(millis: int) -> str:
+    if millis % 1000 == 0:
+        return str(millis // 1000)
+    return f"{millis}m"
+
+
+def format_mem(nbytes: int) -> str:
+    for suffix, mult in (("Gi", 2**30), ("Mi", 2**20), ("Ki", 2**10)):
+        if nbytes % mult == 0:
+            return f"{nbytes // mult}{suffix}"
+    return str(nbytes)

@@ -1,0 +1,13 @@
+"""Test harness config: force an 8-device virtual CPU platform so multi-chip
+sharding paths (mesh/pjit/shard_map) are exercised without TPU hardware —
+the analogue of the reference's envtest-backed hermetic tier (SURVEY.md §4).
+
+Must run before the first `import jax` anywhere in the test session.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,231 @@
+"""Oracle scheduler behavior tests.
+
+These encode the reference semantics the whole framework is built to
+(designs/bin-packing.md FFD; utilization E2E "100 pods => exactly 100 nodes",
+test/suites/utilization/suite_test.go:40-58; price-ordered selection,
+instance.go:445-462).
+"""
+
+import pytest
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import Taint, Toleration, TopologySpreadConstraint, make_pod
+from karpenter_tpu.models.requirements import Requirements, OP_IN, OP_NOT_IN
+from karpenter_tpu.oracle.scheduler import ExistingNode, Scheduler
+
+
+def small_catalog():
+    return Catalog(types=[
+        make_instance_type("small.2x", cpu=2, memory="8Gi", od_price=0.10, spot_price=0.03),
+        make_instance_type("medium.4x", cpu=4, memory="16Gi", od_price=0.20, spot_price=0.06),
+        make_instance_type("large.8x", cpu=8, memory="32Gi", od_price=0.40, spot_price=0.12),
+        make_instance_type("arm.4x", cpu=4, memory="16Gi", arch="arm64", od_price=0.15),
+        make_instance_type("gpu.8x", cpu=8, memory="64Gi", od_price=2.50,
+                           extended={wk.RESOURCE_NVIDIA_GPU: 4},
+                           extra_labels={wk.LABEL_INSTANCE_GPU_NAME: "a100"}),
+    ])
+
+
+def default_provisioner(**kw):
+    p = Provisioner(name="default", **kw)
+    p.set_defaults()
+    return p
+
+
+def test_single_pod_picks_cheapest_fitting_type():
+    sched = Scheduler(small_catalog(), [default_provisioner()])
+    res = sched.schedule([make_pod("p0", cpu="1", memory="1Gi")])
+    assert len(res.new_nodes) == 1
+    (name, zone, ct, npods), = res.node_decisions(sched.options)
+    assert name == "small.2x"
+    assert ct == "on-demand"  # default capacity-type requirement
+    assert npods == 1
+
+
+def test_bin_packs_multiple_pods_one_node():
+    sched = Scheduler(small_catalog(), [default_provisioner()])
+    res = sched.schedule([make_pod(f"p{i}", cpu="500m", memory="512Mi") for i in range(4)])
+    assert len(res.new_nodes) == 1
+    assert len(res.new_nodes[0].pods) == 4
+    assert res.new_nodes[0].decided.itype.name == "small.2x"
+
+
+def test_overflow_opens_second_node():
+    # 5 x 1cpu pods: biggest type has 8 cpu -> one large + one small, FFD greedy
+    sched = Scheduler(small_catalog(), [default_provisioner()])
+    res = sched.schedule([make_pod(f"p{i}", cpu="1", memory="1Gi") for i in range(10)])
+    total = sum(len(n.pods) for n in res.new_nodes)
+    assert total == 10
+    assert not res.unschedulable
+    # capacity respected on every node under its decided type
+    for n in res.new_nodes:
+        alloc = n.decided.itype.allocatable_vector()
+        assert all(u <= a for u, a in zip(n.used, alloc))
+
+
+def test_utilization_parity_100_pods_100_nodes():
+    # Reference E2E (utilization/suite_test.go:40-58): 1.5-cpu pods on a
+    # 2-cpu type => exactly one pod per node.
+    catalog = Catalog(types=[make_instance_type("t3a.small", cpu=2, memory="2Gi", od_price=0.05)])
+    sched = Scheduler(catalog, [default_provisioner()])
+    res = sched.schedule([make_pod(f"p{i}", cpu="1.5", memory="128Mi") for i in range(100)])
+    assert len(res.new_nodes) == 100
+    assert all(len(n.pods) == 1 for n in res.new_nodes)
+
+
+def test_spot_preferred_when_allowed():
+    p = Provisioner(name="spot", requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+    p.set_defaults()
+    sched = Scheduler(small_catalog(), [p])
+    res = sched.schedule([make_pod("p0", cpu="1", memory="1Gi")])
+    assert res.new_nodes[0].decided.capacity_type == "spot"  # spot is cheaper
+
+
+def test_arch_requirement_filters_types():
+    sched = Scheduler(small_catalog(), [default_provisioner()])
+    res = sched.schedule([make_pod("p0", cpu="1", memory="1Gi",
+                                   node_selector={wk.LABEL_ARCH: "arm64"})])
+    # default provisioner restricts to amd64 -> unschedulable
+    assert res.unschedulable
+
+    p = Provisioner(name="any-arch", requirements=Requirements.of(
+        (wk.LABEL_ARCH, OP_IN, ["amd64", "arm64"])))
+    p.set_defaults()
+    res2 = Scheduler(small_catalog(), [p]).schedule(
+        [make_pod("p0", cpu="1", memory="1Gi", node_selector={wk.LABEL_ARCH: "arm64"})])
+    assert len(res2.new_nodes) == 1
+    assert res2.new_nodes[0].decided.itype.name == "arm.4x"
+
+
+def test_gpu_pod_gets_gpu_node():
+    sched = Scheduler(small_catalog(), [default_provisioner()])
+    res = sched.schedule([make_pod("g0", cpu="1", memory="1Gi",
+                                   extended={wk.RESOURCE_NVIDIA_GPU: 1})])
+    assert res.new_nodes[0].decided.itype.name == "gpu.8x"
+
+
+def test_taints_require_toleration():
+    p = default_provisioner(taints=(Taint(key="dedicated", value="gpu", effect="NoSchedule"),))
+    sched = Scheduler(small_catalog(), [p])
+    res = sched.schedule([make_pod("p0", cpu="1", memory="1Gi")])
+    assert res.unschedulable
+
+    res2 = sched.schedule([make_pod(
+        "p1", cpu="1", memory="1Gi",
+        tolerations=(Toleration(key="dedicated", operator="Equal", value="gpu"),))])
+    assert len(res2.new_nodes) == 1
+
+
+def test_zone_selector_restricts_offering():
+    sched = Scheduler(small_catalog(), [default_provisioner()])
+    res = sched.schedule([make_pod("p0", cpu="1", memory="1Gi",
+                                   node_selector={wk.LABEL_ZONE: "zone-1b"})])
+    assert res.new_nodes[0].decided.zone == "zone-1b"
+
+
+def test_incompatible_zone_pods_get_separate_nodes():
+    # zone-1a pod and zone-1b pod cannot share a node even though both fit:
+    # requirement tightening via option-set intersection.
+    sched = Scheduler(small_catalog(), [default_provisioner()])
+    res = sched.schedule([
+        make_pod("a", cpu="100m", memory="128Mi", node_selector={wk.LABEL_ZONE: "zone-1a"}),
+        make_pod("b", cpu="100m", memory="128Mi", node_selector={wk.LABEL_ZONE: "zone-1b"}),
+    ])
+    assert len(res.new_nodes) == 2
+    zones = sorted(n.decided.zone for n in res.new_nodes)
+    assert zones == ["zone-1a", "zone-1b"]
+
+
+def test_zone_topology_spread_balances():
+    sched = Scheduler(small_catalog(), [default_provisioner()])
+    spread = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),)
+    res = sched.schedule([make_pod(f"p{i}", cpu="1", memory="1Gi", topology=spread)
+                          for i in range(9)])
+    per_zone = {}
+    for n in res.new_nodes:
+        per_zone[n.decided.zone] = per_zone.get(n.decided.zone, 0) + len(n.pods)
+    assert sorted(per_zone.values()) == [3, 3, 3]
+
+
+def test_hostname_anti_affinity_one_per_node():
+    sched = Scheduler(small_catalog(), [default_provisioner()])
+    res = sched.schedule([make_pod(f"p{i}", cpu="100m", memory="128Mi",
+                                   anti_affinity_hostname=True) for i in range(5)])
+    assert len(res.new_nodes) == 5
+
+
+def test_provisioner_weight_order():
+    p_low = Provisioner(name="low", weight=1)
+    p_high = Provisioner(name="high", weight=10,
+                         labels=(("team", "ml"),))
+    for p in (p_low, p_high):
+        p.set_defaults()
+    sched = Scheduler(small_catalog(), [p_low, p_high])
+    res = sched.schedule([make_pod("p0", cpu="1", memory="1Gi")])
+    assert res.new_nodes[0].provisioner.name == "high"
+
+
+def test_existing_node_used_first():
+    sched = Scheduler(small_catalog(), [default_provisioner()])
+    existing = ExistingNode(
+        name="node-1",
+        labels={wk.LABEL_ARCH: "amd64", wk.LABEL_OS: "linux",
+                wk.LABEL_ZONE: "zone-1a", wk.LABEL_CAPACITY_TYPE: "on-demand"},
+        allocatable=wk.capacity_vector({wk.RESOURCE_CPU: 4000,
+                                        wk.RESOURCE_MEMORY: 16 * 2**30,
+                                        wk.RESOURCE_PODS: 110}),
+        used=[0] * wk.NUM_RESOURCES,
+    )
+    res = sched.schedule([make_pod("p0", cpu="1", memory="1Gi")], existing=[existing])
+    assert not res.new_nodes
+    assert [p.name for p in res.existing_assignments["node-1"]] == ["p0"]
+
+
+def test_daemonset_pods_excluded_but_overhead_counted():
+    overhead = wk.resource_vector({wk.RESOURCE_CPU: 1500, wk.RESOURCE_PODS: 2})
+    sched = Scheduler(small_catalog(), [default_provisioner()], daemon_overhead=overhead)
+    res = sched.schedule([
+        make_pod("d0", cpu="200m", memory="64Mi", owner_kind="DaemonSet"),
+        make_pod("p0", cpu="1", memory="1Gi"),
+    ])
+    assert len(res.new_nodes) == 1
+    # 1.5 cpu overhead + 1 cpu pod > 2 cpu small -> must use medium.4x
+    assert res.new_nodes[0].decided.itype.name == "medium.4x"
+    assert len(res.new_nodes[0].pods) == 1  # daemon pod not packed
+
+
+def test_unschedulable_resource_too_big():
+    sched = Scheduler(small_catalog(), [default_provisioner()])
+    res = sched.schedule([make_pod("huge", cpu="64", memory="1Gi")])
+    assert res.unschedulable
+
+
+def test_zone_anti_affinity_one_per_zone():
+    sched = Scheduler(small_catalog(), [default_provisioner()])
+    res = sched.schedule([make_pod(f"p{i}", cpu="100m", memory="128Mi",
+                                   anti_affinity_zone=True) for i in range(5)])
+    # 3 zones -> 3 pods placed in distinct zones, 2 unschedulable
+    assert len(res.new_nodes) == 3
+    assert len({n.decided.zone for n in res.new_nodes}) == 3
+    assert len(res.unschedulable) == 2
+
+
+def test_unknown_extended_resource_unschedulable():
+    sched = Scheduler(small_catalog(), [default_provisioner()])
+    res = sched.schedule([make_pod("fpga", cpu="100m", memory="128Mi",
+                                   extended={"intel.com/fpga": 1})])
+    assert res.unschedulable
+
+
+def test_unavailable_offerings_not_advertised():
+    from karpenter_tpu.models.instancetype import Offering, Offerings
+    t = make_instance_type("x.1", cpu=2, memory="4Gi")
+    t = type(t)(name=t.name, labels=t.labels, capacity=t.capacity, overhead=t.overhead,
+                offerings=Offerings([Offering("zone-1a", "on-demand", 1.0, available=False),
+                                     Offering("zone-1b", "on-demand", 1.0, available=True)]))
+    reqs = t.requirements()
+    zone = reqs.get(wk.LABEL_ZONE)
+    assert zone.has("zone-1b") and not zone.has("zone-1a")

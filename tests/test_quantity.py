@@ -1,0 +1,41 @@
+from fractions import Fraction
+
+import pytest
+
+from karpenter_tpu.utils.quantity import (
+    cpu_millis, format_cpu, format_mem, mem_bytes, parse_quantity,
+)
+
+
+def test_parse_plain():
+    assert parse_quantity("2") == 2
+    assert parse_quantity(3) == 3
+    assert parse_quantity("1.5") == Fraction(3, 2)
+
+
+def test_parse_milli_cpu():
+    assert cpu_millis("100m") == 100
+    assert cpu_millis("1") == 1000
+    assert cpu_millis("1.5") == 1500
+    assert cpu_millis(2) == 2000
+
+
+def test_parse_memory_suffixes():
+    assert mem_bytes("256M") == 256_000_000
+    assert mem_bytes("1Gi") == 2**30
+    assert mem_bytes("512Ki") == 512 * 1024
+    assert mem_bytes("128974848") == 128974848
+    assert mem_bytes("1e3") == 1000
+
+
+def test_invalid():
+    with pytest.raises(ValueError):
+        parse_quantity("abc")
+    with pytest.raises(ValueError):
+        parse_quantity("1X")
+
+
+def test_format_roundtrip():
+    assert format_cpu(1500) == "1500m"
+    assert format_cpu(2000) == "2"
+    assert format_mem(2**31) == "2Gi"

@@ -1,0 +1,129 @@
+import pytest
+
+from karpenter_tpu.models.requirements import (
+    IncompatibleError, Requirement, Requirements,
+    OP_DOES_NOT_EXIST, OP_EXISTS, OP_GT, OP_IN, OP_LT, OP_NOT_IN,
+)
+
+
+def req(key, op, *values):
+    return Requirement.create(key, op, values)
+
+
+class TestRequirement:
+    def test_in(self):
+        r = req("arch", OP_IN, "amd64", "arm64")
+        assert r.has("amd64") and r.has("arm64") and not r.has("s390x")
+        assert not r.allows_absent()
+
+    def test_not_in(self):
+        r = req("zone", OP_NOT_IN, "zone-1a")
+        assert not r.has("zone-1a") and r.has("zone-1b")
+        assert r.allows_absent()
+
+    def test_exists(self):
+        r = req("gpu", OP_EXISTS)
+        assert r.has("anything")
+        assert not r.allows_absent()
+
+    def test_does_not_exist(self):
+        r = req("gpu", OP_DOES_NOT_EXIST)
+        assert not r.has("anything")
+        assert r.allows_absent()
+
+    def test_gt_lt(self):
+        r = req("cpu", OP_GT, "4")
+        assert r.has("8") and not r.has("4") and not r.has("2") and not r.has("x")
+        r2 = req("cpu", OP_LT, "16")
+        both = r.intersect(r2)
+        assert both.has("8") and not both.has("16") and not both.has("4")
+
+    def test_intersect_in_in(self):
+        a = req("k", OP_IN, "a", "b")
+        b = req("k", OP_IN, "b", "c")
+        assert a.intersect(b).values == frozenset({"b"})
+
+    def test_intersect_in_notin(self):
+        a = req("k", OP_IN, "a", "b")
+        b = req("k", OP_NOT_IN, "b")
+        assert a.intersect(b).values == frozenset({"a"})
+
+    def test_intersect_empty_raises(self):
+        with pytest.raises(IncompatibleError):
+            req("k", OP_IN, "a").intersect(req("k", OP_IN, "b"))
+
+    def test_gt_lt_empty(self):
+        with pytest.raises(IncompatibleError):
+            req("k", OP_GT, "4").intersect(req("k", OP_LT, "5"))
+
+    def test_doesnotexist_vs_in(self):
+        with pytest.raises(IncompatibleError):
+            req("k", OP_DOES_NOT_EXIST).intersect(req("k", OP_IN, "a"))
+        # NotIn tolerates absence -> compatible, result stays forbid-key
+        out = req("k", OP_DOES_NOT_EXIST).intersect(req("k", OP_NOT_IN, "a"))
+        assert out.forbid_key
+
+
+class TestRequirements:
+    def test_matches_labels(self):
+        r = Requirements.of(("arch", OP_IN, ["amd64"]), ("gpu", OP_DOES_NOT_EXIST))
+        assert r.matches_labels({"arch": "amd64"})
+        assert not r.matches_labels({"arch": "arm64"})
+        assert not r.matches_labels({"arch": "amd64", "gpu": "1"})
+
+    def test_missing_key_semantics(self):
+        assert not Requirements.of(("k", OP_IN, ["v"])).matches_labels({})
+        assert Requirements.of(("k", OP_NOT_IN, ["v"])).matches_labels({})
+        assert not Requirements.of(("k", OP_EXISTS, [])).matches_labels({})
+
+    def test_union_tightens(self):
+        a = Requirements.of(("zone", OP_IN, ["z1", "z2"]))
+        b = Requirements.of(("zone", OP_IN, ["z2", "z3"]))
+        u = a.union(b)
+        assert u.get("zone").values == frozenset({"z2"})
+
+    def test_union_incompatible(self):
+        a = Requirements.of(("zone", OP_IN, ["z1"]))
+        b = Requirements.of(("zone", OP_IN, ["z2"]))
+        with pytest.raises(IncompatibleError):
+            a.union(b)
+
+    def test_compatible(self):
+        a = Requirements.of(("zone", OP_IN, ["z1", "z2"]))
+        b = Requirements.of(("zone", OP_NOT_IN, ["z1"]))
+        assert a.compatible(b)
+        c = Requirements.of(("zone", OP_IN, ["z3"]))
+        assert not a.compatible(c)
+        assert a.compatible(Requirements())
+
+    def test_from_node_selector(self):
+        r = Requirements.from_node_selector({"a": "1", "b": "2"})
+        assert r.matches_labels({"a": "1", "b": "2", "c": "3"})
+        assert not r.matches_labels({"a": "1"})
+
+    def test_to_specs_roundtrip(self):
+        specs = [("a", OP_IN, ["x"]), ("b", OP_NOT_IN, ["y"]), ("c", OP_EXISTS, []),
+                 ("d", OP_DOES_NOT_EXIST, []), ("e", OP_GT, ["3"])]
+        r = Requirements()
+        for k, op, vals in specs:
+            r.add(Requirement.create(k, op, vals))
+        assert sorted(r.to_specs()) == sorted(specs)
+
+
+def test_to_specs_combined_bounds_canonical():
+    # merged Gt+Lt must emit BOTH bounds (group-dedupe canonicality)
+    a = Requirements()
+    a.add(Requirement.create("cpu", OP_GT, ["1"]))
+    a.add(Requirement.create("cpu", OP_LT, ["4"]))
+    b = Requirements()
+    b.add(Requirement.create("cpu", OP_GT, ["1"]))
+    b.add(Requirement.create("cpu", OP_LT, ["100"]))
+    assert a.to_specs() != b.to_specs()
+    assert ("cpu", OP_GT, ["1"]) in a.to_specs() and ("cpu", OP_LT, ["4"]) in a.to_specs()
+
+
+def test_to_specs_in_with_bounds_folds():
+    r = Requirements()
+    r.add(Requirement.create("cpu", OP_IN, ["2", "4", "8"]))
+    r.add(Requirement.create("cpu", OP_GT, ["3"]))
+    assert r.to_specs() == [("cpu", OP_IN, ["4", "8"])]
