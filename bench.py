@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Headline benchmark: scheduling-cycle latency @ 10k pending pods x ~600
+instance types (BASELINE.json metric; north-star < 100 ms on one TPU chip).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": 100/p50}
+
+vs_baseline > 1.0 means faster than the 100 ms north-star budget.
+Measures END-TO-END solve: host encode (mask folding) + device pack kernel +
+decode — the full scheduling cycle the controller would pay per batch window.
+"""
+
+import json
+import statistics
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.models.pod import TopologySpreadConstraint, make_pod
+from karpenter_tpu.models.requirements import Requirements, OP_IN
+from karpenter_tpu.providers.instancetypes import generate_fleet_catalog
+from karpenter_tpu.solver.core import TPUSolver
+
+
+def workload_10k():
+    """BASELINE.json configs[1]-style: mixed cpu/mem pods, zone selectors,
+    topology spread, across 8 deployments -> 10k pods."""
+    pods = []
+    spread = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),)
+    deployments = [
+        ("web", 3000, "500m", "1Gi", {}, spread),
+        ("api", 2000, "1", "2Gi", {}, ()),
+        ("cache", 1000, "2", "8Gi", {}, ()),
+        ("batch", 1500, "250m", "512Mi", {}, ()),
+        ("etl", 800, "4", "8Gi", {}, ()),
+        ("zone-a", 700, "1", "1Gi", {wk.LABEL_ZONE: "zone-1a"}, ()),
+        ("zone-b", 500, "1", "1Gi", {wk.LABEL_ZONE: "zone-1b"}, ()),
+        ("mem", 500, "500m", "4Gi", {}, ()),
+    ]
+    for name, count, cpu, mem, sel, topo in deployments:
+        for i in range(count):
+            pods.append(make_pod(f"{name}-{i}", cpu=cpu, memory=mem,
+                                 node_selector=dict(sel), topology=topo))
+    assert len(pods) == 10_000
+    return pods
+
+
+def main():
+    catalog = generate_fleet_catalog()
+    prov = Provisioner(name="default", requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"]),
+        (wk.LABEL_ARCH, OP_IN, ["amd64", "arm64"]),
+    ))
+    prov.set_defaults()
+    solver = TPUSolver(catalog, [prov])
+    pods = workload_10k()
+
+    # warmup: compile + grid build
+    res = solver.solve(pods)
+    placed = sum(n.pod_count for n in res.nodes)
+    assert placed + res.unschedulable_count() == len(pods), (placed, res.unschedulable_count())
+
+    times = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        res = solver.solve(pods)
+        times.append((time.perf_counter() - t0) * 1000)
+    p50 = statistics.median(times)
+
+    import jax
+    print(json.dumps({
+        "metric": "scheduling_cycle_p50_ms_10k_pods_600_types",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(100.0 / p50, 3),
+        "detail": {
+            "n_types": len(catalog.types),
+            "n_pods": len(pods),
+            "nodes_provisioned": len(res.nodes),
+            "unschedulable": res.unschedulable_count(),
+            "p_min_ms": round(min(times), 3),
+            "p_max_ms": round(max(times), 3),
+            "backend": jax.devices()[0].platform,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,327 @@
+"""Host-side encoding: scheduling problem -> dense device arrays.
+
+This is the TPU-first redesign of the reference's per-object hot loop
+(/root/reference/designs/bin-packing.md:28-43 + pkg/cloudprovider/
+cloudprovider.go:302-321 resolveInstanceTypes): every label/taint constraint is
+folded ON HOST into boolean feasibility masks over a static (instanceType x
+zone x capacityType) option grid, so the device kernel sees only dense int32
+capacity math. Pods are deduplicated into groups (identical spec => identical
+mask), so mask folding cost is O(#deployments), not O(#pods).
+
+The folding reuses the oracle's exact matching code (feasible_options), which
+guarantees the kernel and the scalar fallback agree on WHICH options are
+feasible by construction; the kernel is differential-tested on the packing
+arithmetic only.
+
+Catalog-side arrays are versioned by Catalog.seqnum (the reference's
+instance-type cache seqnum trick, instancetypes.go:62-68) so they can stay
+device-resident across solves; only the group arrays ship per solve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..apis import wellknown as wk
+from ..apis.provisioner import Provisioner
+from ..models.instancetype import Catalog
+from ..models.pod import PodGroup, PodSpec
+from ..models.requirements import IncompatibleError, Requirements
+from ..models.pod import tolerates_all
+from ..oracle.scheduler import (
+    ExistingNode, Option, feasible_options, prepare_groups, _group_cap_per_node,
+)
+
+INT_BIG = np.int32(2**30)
+
+
+@dataclasses.dataclass
+class KeyCol:
+    codes: np.ndarray             # i32 [T*S]; -1 = absent (value interned per key)
+    vocab: "dict[str, int]"       # value -> code
+    num: np.ndarray               # float64 [T*S]; nan = absent/non-numeric
+
+
+@dataclasses.dataclass
+class GridCols:
+    """Per-key integer-coded label columns over the flat option axis, for
+    vectorized requirement folding (the numpy fast path of feasible_options;
+    checked equal to the scalar path in tests/test_encode.py)."""
+
+    cols: "dict[str, KeyCol]"
+    flat_valid: np.ndarray  # bool [T*S]
+
+
+def build_cols(grid: "OptionGrid") -> GridCols:
+    n = len(grid.options)
+    raw: "dict[str, list]" = {}
+    flat_valid = np.zeros(n, dtype=bool)
+    labels_per_opt: "list[Optional[dict]]" = []
+    for i, o in enumerate(grid.options):
+        if o is None:
+            labels_per_opt.append(None)
+            continue
+        flat_valid[i] = True
+        d = dict(o.itype.labels)
+        d[wk.LABEL_ZONE] = o.zone
+        d[wk.LABEL_CAPACITY_TYPE] = o.capacity_type
+        labels_per_opt.append(d)
+        for k in d:
+            raw.setdefault(k, None)
+    cols: "dict[str, KeyCol]" = {}
+    for k in raw:
+        codes = np.full(n, -1, dtype=np.int32)
+        num = np.full(n, np.nan)
+        vocab: "dict[str, int]" = {}
+        for i, d in enumerate(labels_per_opt):
+            if d is None or k not in d:
+                continue
+            v = d[k]
+            code = vocab.get(v)
+            if code is None:
+                code = vocab[v] = len(vocab)
+            codes[i] = code
+            try:
+                num[i] = int(v)
+            except ValueError:
+                pass
+        cols[k] = KeyCol(codes, vocab, num)
+    return GridCols(cols, flat_valid)
+
+
+def fold_option_mask(reqs: Requirements, cols: GridCols, prov: Provisioner) -> np.ndarray:
+    """Requirements -> bool mask over flat options, under provisioner `prov`'s
+    label overlay. Vectorized equivalent of
+    `reqs.matches_labels(option_labels(opt, prov))` per option."""
+    n = cols.flat_valid.shape[0]
+    mask = cols.flat_valid.copy()
+    overlay = {wk.LABEL_PROVISIONER: prov.name}
+    for k, v in prov.labels:
+        overlay.setdefault(k, v)
+    for req in reqs:
+        kc = cols.cols.get(req.key)
+        if kc is None:
+            # key not on any option: provisioner overlay or absent everywhere
+            value = overlay.get(req.key)
+            ok = req.has(value) if value is not None else req.allows_absent()
+            if not ok:
+                return np.zeros(n, dtype=bool)
+            continue
+        codes, num = kc.codes, kc.num
+        present = codes >= 0
+        fill_value = overlay.get(req.key)
+        if fill_value is not None:
+            # provisioner label fills options that lack the key
+            # (option_labels setdefault semantics): absent slots behave as
+            # carrying fill_value, membership + bounds included.
+            absent_ok = req.has(fill_value)
+        else:
+            absent_ok = req.allows_absent()
+        if req.forbid_key:
+            ok = np.where(present, False, absent_ok)
+        else:
+            value_codes = [kc.vocab[v] for v in req.values if v in kc.vocab]
+            hits = np.isin(codes, value_codes) if value_codes else np.zeros(n, bool)
+            ok_present = ~hits if req.complement else hits
+            if req.gt is not None or req.lt is not None:
+                with np.errstate(invalid="ignore"):
+                    if req.gt is not None:
+                        ok_present &= num > req.gt
+                    if req.lt is not None:
+                        ok_present &= num < req.lt
+            ok = np.where(present, ok_present, absent_ok)
+        mask &= ok
+    return mask
+
+
+@dataclasses.dataclass
+class OptionGrid:
+    """Static (T x S) option lattice; S enumerates (zone, capacityType) pairs.
+
+    Flat option index = t * S + s, giving a stable bijection with the
+    oracle's Option list built from the same iteration order.
+    """
+
+    catalog: Catalog
+    zones: "list[str]"
+    capacity_types: "list[str]"
+    options: "list[Optional[Option]]"  # length T*S, None where no offering
+    valid: np.ndarray  # bool [T, S]
+    price: np.ndarray  # f32 [T, S]
+    tiebreak: np.ndarray  # i32 [T, S], rank in (price, spot-first, name, zone) order
+    alloc_t: np.ndarray  # i32 [T, R]
+    seqnum: int
+    cols: "Optional[GridCols]" = None  # lazily built label columns
+
+    def get_cols(self) -> "GridCols":
+        if self.cols is None:
+            self.cols = build_cols(self)
+        return self.cols
+
+    @property
+    def T(self):
+        return len(self.catalog.types)
+
+    @property
+    def S(self):
+        return len(self.zones) * len(self.capacity_types)
+
+    def flat_options(self) -> "list[Option]":
+        return [o for o in self.options if o is not None]
+
+
+def build_grid(catalog: Catalog) -> OptionGrid:
+    # available offerings only: must match the oracle's build_options zone
+    # universe, or zone-spread pre-passes diverge between the two paths
+    zones = sorted({o.zone for t in catalog.types for o in t.offerings if o.available})
+    cts = list(wk.CAPACITY_TYPES)  # on-demand, spot
+    T, S = len(catalog.types), len(zones) * len(cts)
+    options: "list[Optional[Option]]" = [None] * (T * S)
+    valid = np.zeros((T, S), dtype=bool)
+    price = np.full((T, S), np.inf, dtype=np.float32)
+    alloc_t = np.zeros((T, wk.NUM_RESOURCES), dtype=np.int32)
+    for ti, t in enumerate(catalog.types):
+        alloc_t[ti] = np.minimum(t.allocatable_vector(), INT_BIG)
+        avail = {(o.zone, o.capacity_type): o for o in t.offerings if o.available}
+        for zi, z in enumerate(zones):
+            for ci, ct in enumerate(cts):
+                o = avail.get((z, ct))
+                if o is None:
+                    continue
+                si = zi * len(cts) + ci
+                flat = ti * S + si
+                options[flat] = Option(flat, t, z, ct, o.price, tuple(int(a) for a in alloc_t[ti]))
+                valid[ti, si] = True
+                price[ti, si] = o.price
+    # tiebreak rank: identical key to Option.sort_key (oracle decision order)
+    tiebreak = np.full((T, S), INT_BIG, dtype=np.int32)
+    ranked = sorted((o for o in options if o is not None), key=Option.sort_key)
+    for rank, o in enumerate(ranked):
+        tiebreak[o.index // S, o.index % S] = rank
+    return OptionGrid(catalog, zones, cts, options, valid, price, tiebreak,
+                      alloc_t, catalog.seqnum)
+
+
+@dataclasses.dataclass
+class EncodedProblem:
+    """Everything the packer kernel consumes, as numpy (device-put by caller)."""
+
+    # catalog side (device-resident across solves, keyed by grid.seqnum)
+    alloc_t: np.ndarray    # i32 [T, R]
+    valid: np.ndarray      # bool [T, S]
+    tiebreak: np.ndarray   # i32 [T, S]
+    # per-solve group side
+    group_vec: np.ndarray     # i32 [G, R]
+    group_count: np.ndarray   # i32 [G]
+    group_cap: np.ndarray     # i32 [G]  (INT_BIG when uncapped)
+    group_feas: np.ndarray    # bool [G, Pv, T, S]
+    group_newprov: np.ndarray  # i32 [G]  (-1: no provisioner admits)
+    overhead: np.ndarray      # i32 [R] daemonset overhead on fresh nodes
+    # existing nodes
+    ex_alloc: np.ndarray   # i32 [Ne, R]
+    ex_used: np.ndarray    # i32 [Ne, R]
+    ex_feas: np.ndarray    # bool [G, Ne]
+    n_slots: int           # N: max new node claims (static)
+    # bookkeeping for decode
+    groups: "list[PodGroup]"
+    provisioners: "list[Provisioner]"
+    grid: OptionGrid
+
+
+def encode_problem(
+    catalog: Catalog,
+    provisioners: Sequence[Provisioner],
+    pods: "list[PodSpec]",
+    existing: Sequence[ExistingNode] = (),
+    daemon_overhead: Optional[Sequence[int]] = None,
+    n_slots: Optional[int] = None,
+    grid: Optional[OptionGrid] = None,
+) -> EncodedProblem:
+    if grid is None or grid.seqnum != catalog.seqnum:
+        grid = build_grid(catalog)
+    provs = sorted(provisioners, key=lambda p: (-p.weight, p.name))
+    overhead = list(daemon_overhead or [0] * wk.NUM_RESOURCES)
+    groups = prepare_groups(pods, grid.zones)
+    G, Pv, T, S = len(groups), len(provs), grid.T, grid.S
+    R = wk.NUM_RESOURCES
+
+    group_vec = np.zeros((max(G, 1), R), dtype=np.int32)
+    group_count = np.zeros((max(G, 1),), dtype=np.int32)
+    group_cap = np.full((max(G, 1),), INT_BIG, dtype=np.int32)
+    group_feas = np.zeros((max(G, 1), max(Pv, 1), T, S), dtype=bool)
+    group_newprov = np.full((max(G, 1),), -1, dtype=np.int32)
+    ex_alloc = np.zeros((max(len(existing), 1), R), dtype=np.int32)
+    ex_used = np.zeros((max(len(existing), 1), R), dtype=np.int32)
+    ex_feas = np.zeros((max(G, 1), max(len(existing), 1)), dtype=bool)
+
+    for ei, e in enumerate(existing):
+        ex_alloc[ei] = np.minimum(e.allocatable, INT_BIG)
+        ex_used[ei] = np.minimum(e.used, INT_BIG)
+
+    cols = grid.get_cols()
+    ovh = np.asarray(overhead, dtype=np.int64)
+    for gi, g in enumerate(groups):
+        vec = np.minimum(g.vector, INT_BIG)
+        group_vec[gi] = vec
+        group_count[gi] = g.count
+        cap = _group_cap_per_node(g.spec)
+        if cap is not None:
+            group_cap[gi] = cap
+        # capacity admission on a fresh node: overhead + vec <= alloc, per type
+        fits_t = np.all(grid.alloc_t.astype(np.int64) - ovh[None, :] - vec[None, :] >= 0, axis=1)
+        for pi, prov in enumerate(provs):
+            if not tolerates_all(g.spec.tolerations, prov.taints):
+                continue
+            try:
+                reqs = prov.scheduling_requirements().union(g.spec.requirements)
+            except IncompatibleError:
+                continue
+            mask = fold_option_mask(reqs, cols, prov).reshape(T, S) & fits_t[:, None]
+            if mask.any():
+                group_feas[gi, pi] = mask
+                if group_newprov[gi] < 0:
+                    group_newprov[gi] = pi
+        for ei, e in enumerate(existing):
+            ex_feas[gi, ei] = _ex_label_fit(e, g.spec)
+
+    if n_slots is None:
+        # Tight upper bound on claim slots: group g opens at most
+        # ceil(count_g / kstar_g) fresh nodes, kstar_g = max pods-per-fresh-node
+        # over its admitting provisioner's feasible types (kernel step 3 math).
+        bound = 0
+        alloc64 = grid.alloc_t.astype(np.int64)
+        for gi, g in enumerate(groups):
+            pi = int(group_newprov[gi])
+            if pi < 0:
+                continue
+            vec = group_vec[gi].astype(np.int64)
+            q0 = np.where(vec[None, :] > 0,
+                          (alloc64 - ovh[None, :]) // np.maximum(vec[None, :], 1),
+                          INT_BIG)
+            q0 = np.where(alloc64 - ovh[None, :] < 0, -1, q0).min(axis=1)
+            feas_t = group_feas[gi, pi].any(axis=1)
+            kstar = int(min(max(q0[feas_t].max(initial=0), 0), group_cap[gi]))
+            if kstar > 0:
+                bound += -(-int(group_count[gi]) // kstar)
+        n_slots = max(8, bound)
+
+    return EncodedProblem(
+        alloc_t=grid.alloc_t, valid=grid.valid, tiebreak=grid.tiebreak,
+        group_vec=group_vec, group_count=group_count, group_cap=group_cap,
+        group_feas=group_feas, group_newprov=group_newprov,
+        overhead=np.asarray(overhead, dtype=np.int32),
+        ex_alloc=ex_alloc, ex_used=ex_used, ex_feas=ex_feas,
+        n_slots=n_slots,
+        groups=groups, provisioners=list(provs), grid=grid,
+    )
+
+
+def _ex_label_fit(e: ExistingNode, spec: PodSpec) -> bool:
+    """Label/taint feasibility of an existing node, capacity excluded (the
+    kernel handles capacity)."""
+    from ..models.pod import tolerates_all
+
+    return tolerates_all(spec.tolerations, e.taints) and spec.requirements.matches_labels(e.labels)

@@ -162,6 +162,7 @@ class Requirements:
 
     def __init__(self, reqs: Iterable[Requirement] = ()):
         self._by_key: dict[str, Requirement] = {}
+        self._specs_cache: "Optional[list]" = None
         for r in reqs:
             self.add(r)
 
@@ -192,6 +193,7 @@ class Requirements:
     def add(self, req: Requirement) -> None:
         existing = self._by_key.get(req.key)
         self._by_key[req.key] = existing.intersect(req) if existing else req
+        self._specs_cache = None
 
     def keys(self):
         return self._by_key.keys()
@@ -208,6 +210,7 @@ class Requirements:
     def copy(self) -> "Requirements":
         out = Requirements()
         out._by_key = dict(self._by_key)
+        out._specs_cache = self._specs_cache
         return out
 
     def union(self, other: "Requirements") -> "Requirements":
@@ -250,7 +253,10 @@ class Requirements:
         Canonical: semantically-equal Requirements produce identical specs (a
         key may emit several triples — e.g. a merged Gt+Lt emits both). Used
         by PodSpec.group_key(), so canonicality is load-bearing for dedupe.
+        Memoized (hot in 10k-pod group dedupe).
         """
+        if self._specs_cache is not None:
+            return self._specs_cache
         out = []
         for key, r in sorted(self._by_key.items()):
             if r.forbid_key:
@@ -271,6 +277,7 @@ class Requirements:
                     emitted = True
                 if not emitted:
                     out.append((key, OP_EXISTS, []))
+        self._specs_cache = out
         return out
 
     def __repr__(self):

@@ -1,0 +1,199 @@
+"""TPU-native FFD bin-packing kernel.
+
+Parity target: the reference's provisioning hot loop — First-Fit-Decreasing
+pod packing with a shrinking instance-type set per node
+(/root/reference/designs/bin-packing.md:17-43) and price-ordered final
+selection (/root/reference/pkg/cloudprovider/instance.go:445-462). The scalar
+spec is karpenter_tpu/oracle/scheduler.py; this kernel is differential-tested
+against it (tests/test_packer_parity.py).
+
+TPU-first design (NOT a translation of the Go loop):
+
+* The Go reference is O(pods x nodes x types) sequential. Here the scan runs
+  over POD GROUPS (deduplicated identical pods) — O(#deployments) sequential
+  steps — and each step places the whole group with vectorized math:
+
+  - existing nodes fill via an exclusive-cumsum waterfall (first-fit order
+    preserved, no inner loop),
+  - open node-claims fill the same way, with per-(node, type) int32 capacity
+    quotients `q = (alloc - used) // vec` computed as one [N,T,R] reduction,
+  - fresh nodes open in bulk: k* = max pods/node over feasible options, the
+    group's remainder opens ceil(rem/k*) identical slots in one iota-masked
+    write.
+
+* All capacity math is int32 (canonical units are integers < 2**24), so device
+  results are bit-identical to the scalar oracle — no float drift.
+
+* Node state is (used [N,R], option-mask [N,T,S]): the reference's
+  "requirements tighten as pods are added" is option-mask intersection, and
+  the final launch decision is one masked argmin over a precomputed
+  price-order tiebreak grid.
+
+Shapes are static per (G, N, T, S, Ne) bucket; the solver service buckets pod
+counts to avoid recompilation storms (SURVEY.md §7.3 dynamic shapes).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+INT_BIG = jnp.int32(2**30)
+
+
+class PackInputs(NamedTuple):
+    # catalog (device-resident)
+    alloc_t: jax.Array    # i32 [T, R]
+    tiebreak: jax.Array   # i32 [T, S] (INT_BIG where no valid offering)
+    # groups (FFD-sorted)
+    group_vec: jax.Array      # i32 [G, R]
+    group_count: jax.Array    # i32 [G]
+    group_cap: jax.Array      # i32 [G] per-node cap (INT_BIG if none)
+    group_feas: jax.Array     # bool [G, Pv, T, S]
+    group_newprov: jax.Array  # i32 [G] (-1 => no provisioner admits)
+    overhead: jax.Array       # i32 [R]
+    # existing nodes
+    ex_alloc: jax.Array   # i32 [Ne, R]
+    ex_used: jax.Array    # i32 [Ne, R]
+    ex_feas: jax.Array    # bool [G, Ne]
+
+
+class PackState(NamedTuple):
+    used: jax.Array      # i32 [N, R]
+    optmask: jax.Array   # bool [N, T, S]
+    nprov: jax.Array     # i32 [N]
+    active: jax.Array    # bool [N]
+    n_open: jax.Array    # i32 []
+    ex_used: jax.Array   # i32 [Ne, R]
+
+
+class PackResult(NamedTuple):
+    assign: jax.Array      # i32 [G, N] pods of group g placed on claim slot n
+    ex_assign: jax.Array   # i32 [G, Ne] pods placed on existing nodes
+    unsched: jax.Array     # i32 [G] pods that could not be placed
+    used: jax.Array        # i32 [N, R]
+    active: jax.Array      # bool [N]
+    nprov: jax.Array       # i32 [N]
+    decided: jax.Array     # i32 [N] flat option id (t*S+s), -1 if inactive
+    n_open: jax.Array      # i32 []
+
+
+def _quotient(avail: jax.Array, vec: jax.Array) -> jax.Array:
+    """How many `vec`-sized pods fit into `avail`: min over resources of
+    floor(avail/vec), with zero-demand resources ignored. avail [..., R]."""
+    pos = vec > 0
+    q = jnp.where(pos, avail // jnp.maximum(vec, 1), INT_BIG)
+    q = jnp.where(avail < 0, jnp.where(pos, -1, INT_BIG), q)
+    return jnp.clip(jnp.min(q, axis=-1), -1, INT_BIG)
+
+
+def _waterfall(count: jax.Array, fill: jax.Array) -> jax.Array:
+    """First-fit distribution of `count` pods over slots with per-slot
+    capacity `fill` (in slot order): m_i = clip(count - sum_{j<i} fill_j,
+    0, fill_i). One exclusive cumsum — the vectorized form of the
+    reference's per-pod first-fit walk.
+
+    fill is clamped to `count` first: per-slot capacity can be INT_BIG (a
+    zero-request pod fits "infinitely"), and an unclamped int32 cumsum over
+    several INT_BIG slots would wrap and double-place pods."""
+    fill = jnp.minimum(fill, count)
+    before = jnp.cumsum(fill) - fill
+    return jnp.clip(count - before, 0, fill)
+
+
+def _step(inputs: PackInputs, state: PackState, g: jax.Array):
+    vec = inputs.group_vec[g]          # [R]
+    cap = inputs.group_cap[g]          # []
+    count = inputs.group_count[g]      # []
+
+    # ---- 1) existing nodes (oracle step "existing first") --------------------
+    q_ex = _quotient(inputs.ex_alloc - state.ex_used, vec)        # [Ne]
+    fill_ex = jnp.clip(jnp.minimum(q_ex, cap), 0, INT_BIG)
+    fill_ex = jnp.where(inputs.ex_feas[g], fill_ex, 0)
+    m_ex = _waterfall(count, fill_ex)                              # [Ne]
+    ex_used = state.ex_used + m_ex[:, None] * vec[None, :]
+    rem = count - jnp.sum(m_ex)
+
+    # ---- 2) open claims, first-fit in creation order -------------------------
+    feas_n = inputs.group_feas[g][jnp.clip(state.nprov, 0, None)]  # [N, T, S]
+    nodefeas = state.optmask & feas_n & state.active[:, None, None]
+    q_nt = _quotient(inputs.alloc_t[None, :, :] - state.used[:, None, :], vec)  # [N, T]
+    q_cap = jnp.where(nodefeas, q_nt[:, :, None], -1)              # [N, T, S]
+    qmax = jnp.max(q_cap.reshape(q_cap.shape[0], -1), axis=-1)     # [N]
+    fill_n = jnp.clip(jnp.minimum(qmax, cap), 0, INT_BIG)
+    m_n = _waterfall(rem, fill_n)                                  # [N]
+    new_used = state.used + m_n[:, None] * vec[None, :]
+    shrunk = nodefeas & (q_nt[:, :, None] >= m_n[:, None, None])
+    placed = m_n > 0
+    optmask = jnp.where(placed[:, None, None], shrunk, state.optmask)
+    used = jnp.where(placed[:, None], new_used, state.used)
+    rem = rem - jnp.sum(m_n)
+
+    # ---- 3) bulk-open fresh nodes -------------------------------------------
+    p = inputs.group_newprov[g]
+    freshfeas = inputs.group_feas[g][jnp.clip(p, 0, None)] & (p >= 0)  # [T, S]
+    q0 = _quotient(inputs.alloc_t - inputs.overhead[None, :], vec)     # [T]
+    kstar = jnp.max(jnp.where(freshfeas, q0[:, None], 0))
+    kstar = jnp.clip(jnp.minimum(kstar, cap), 0, INT_BIG)
+    n_new = jnp.where(kstar > 0, (rem + kstar - 1) // jnp.maximum(kstar, 1), 0)
+    n_slots = state.active.shape[0]
+    n_new = jnp.minimum(n_new, n_slots - state.n_open)  # overflow -> unschedulable
+    placed_new = jnp.where(n_new > 0, (n_new - 1) * kstar, 0)
+    last_cnt = jnp.clip(rem - placed_new, 0, kstar)
+
+    idx = jnp.arange(n_slots, dtype=jnp.int32)
+    in_range = (idx >= state.n_open) & (idx < state.n_open + n_new)
+    cnt = jnp.where(idx == state.n_open + n_new - 1, last_cnt, kstar)
+    cnt = jnp.where(in_range, cnt, 0)                              # [N]
+    fresh_used = inputs.overhead[None, :] + cnt[:, None] * vec[None, :]
+    used = jnp.where(in_range[:, None], fresh_used, used)
+    fresh_mask = freshfeas[None, :, :] & (q0[None, :, None] >= cnt[:, None, None])
+    optmask = jnp.where(in_range[:, None, None], fresh_mask, optmask)
+    active = state.active | in_range
+    nprov = jnp.where(in_range, p, state.nprov)
+    n_open = state.n_open + n_new
+    unsched = rem - jnp.sum(cnt)
+
+    new_state = PackState(used, optmask, nprov, active, n_open, ex_used)
+    return new_state, (m_n + cnt, m_ex, unsched)
+
+
+def pack_impl(inputs: PackInputs, n_slots: int) -> PackResult:
+    G = inputs.group_vec.shape[0]
+    T, S = inputs.tiebreak.shape
+    R = inputs.group_vec.shape[1]
+    Ne = inputs.ex_alloc.shape[0]
+    init = PackState(
+        used=jnp.zeros((n_slots, R), jnp.int32),
+        optmask=jnp.zeros((n_slots, T, S), bool),
+        nprov=jnp.full((n_slots,), -1, jnp.int32),
+        active=jnp.zeros((n_slots,), bool),
+        n_open=jnp.int32(0),
+        ex_used=inputs.ex_used,
+    )
+
+    def body(state, g):
+        return _step(inputs, state, g)
+
+    final, (assign, ex_assign, unsched) = jax.lax.scan(
+        body, init, jnp.arange(G, dtype=jnp.int32)
+    )
+
+    # decision: cheapest surviving option per active claim (instance.go:445-462)
+    rank = jnp.where(final.optmask, inputs.tiebreak[None, :, :], INT_BIG)
+    flatrank = rank.reshape(n_slots, -1)
+    best = jnp.argmin(flatrank, axis=-1).astype(jnp.int32)
+    has_opt = jnp.min(flatrank, axis=-1) < INT_BIG
+    decided = jnp.where(final.active & has_opt, best, -1)
+
+    return PackResult(
+        assign=assign, ex_assign=ex_assign, unsched=unsched,
+        used=final.used, active=final.active, nprov=final.nprov,
+        decided=decided, n_open=final.n_open,
+    )
+
+
+pack = functools.partial(jax.jit, static_argnames=("n_slots",))(pack_impl)

@@ -228,6 +228,22 @@ def split_zone_spread(groups: "list[PodGroup]", zones: Sequence[str]) -> "list[P
     return out
 
 
+def prepare_groups(pods: "list[PodSpec]", zones: Sequence[str]) -> "list[PodGroup]":
+    """Dedupe -> zone-spread split -> FFD sort (bin-packing.md step 1).
+
+    Shared verbatim between this oracle and the kernel encoder
+    (models/encode.py) so group ordering — which FFD results depend on —
+    is identical on both paths."""
+    groups = group_pods([p for p in pods if not p.is_daemon()])
+    groups = split_zone_spread(groups, zones)
+    groups.sort(key=lambda g: (
+        -g.vector[wk.RESOURCE_INDEX[wk.RESOURCE_CPU]],
+        -g.vector[wk.RESOURCE_INDEX[wk.RESOURCE_MEMORY]],
+        g.spec.name,
+    ))
+    return groups
+
+
 class Scheduler:
     """FFD bin-packing over pod groups (the provisioning hot loop,
     designs/bin-packing.md:17-43)."""
@@ -250,14 +266,7 @@ class Scheduler:
         pods: "list[PodSpec]",
         existing: "Iterable[ExistingNode]" = (),
     ) -> SchedulingResult:
-        groups = group_pods([p for p in pods if not p.is_daemon()])
-        groups = split_zone_spread(groups, self.zones)
-        # FFD order: cpu desc, memory desc, name asc (bin-packing.md step 1)
-        groups.sort(key=lambda g: (
-            -g.vector[wk.RESOURCE_INDEX[wk.RESOURCE_CPU]],
-            -g.vector[wk.RESOURCE_INDEX[wk.RESOURCE_MEMORY]],
-            g.spec.name,
-        ))
+        groups = prepare_groups(pods, self.zones)
 
         feas_cache: "dict[tuple[int, str], set[int]]" = {}
         nodes: "list[NodeClaim]" = []

@@ -1,0 +1,83 @@
+"""Multi-chip scale-out for the packer kernel.
+
+Parity target: the reference's scale story is single-process Go with
+request-batching (SURVEY.md §2.3); this module is the NEW capability the TPU
+build adds — `pjit`-sharded solving of the 50k-pod x 1k-offering stress config
+(BASELINE.json configs[4]) across an ICI mesh.
+
+Mesh axes and their classic-parallelism analogues for this workload:
+- "nodes": node-claim slots sharded like DATA parallelism — each device owns a
+  slice of the bin (node) population; the first-fit waterfall's exclusive
+  cumsum becomes a cross-device prefix sum XLA lowers onto ICI.
+- "types": the instance-type axis sharded like TENSOR parallelism — the
+  [N, T, S] option-mask state and the [N, T] capacity quotients are computed
+  shard-local; qmax/kstar argmax-style reductions become all-reduces.
+- the group scan is the sequential (pipeline-like) axis; groups are inherently
+  order-dependent under FFD, so they stay unsharded — the reference has the
+  same sequential dependence (designs/bin-packing.md step 4).
+
+GSPMD inserts all collectives: we only annotate input/state shardings
+(scaling-book recipe: pick a mesh, annotate, let XLA do the rest).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.packer import PackInputs, PackResult, pack_impl
+
+AXIS_NODES = "nodes"
+AXIS_TYPES = "types"
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    types_dim = 2 if n % 2 == 0 and n >= 2 else 1
+    nodes_dim = n // types_dim
+    return Mesh(np.array(devs).reshape(nodes_dim, types_dim), (AXIS_NODES, AXIS_TYPES))
+
+
+def input_shardings(mesh: Mesh) -> PackInputs:
+    """PartitionSpecs per input leaf: catalog arrays sharded over types,
+    group masks over types, small per-group vectors replicated."""
+    s = lambda *spec: NamedSharding(mesh, P(*spec))
+    return PackInputs(
+        alloc_t=s(AXIS_TYPES, None),
+        tiebreak=s(AXIS_TYPES, None),
+        group_vec=s(), group_count=s(), group_cap=s(),
+        group_feas=s(None, None, AXIS_TYPES, None),
+        group_newprov=s(), overhead=s(),
+        ex_alloc=s(), ex_used=s(), ex_feas=s(),
+    )
+
+
+def _constrained_pack(inputs: PackInputs, n_slots: int, mesh: Mesh) -> PackResult:
+    """pack_impl under the mesh: the [N, T, S] scan-carry sharding comes from
+    GSPMD propagation off the type-sharded inputs; we pin only the [N, R]
+    `used` output to the nodes axis to anchor the node dimension."""
+    result = pack_impl(inputs, n_slots)
+    used = jax.lax.with_sharding_constraint(result.used, NamedSharding(mesh, P(AXIS_NODES, None)))
+    return result._replace(used=used)
+
+
+def sharded_pack(inputs: PackInputs, n_slots: int, mesh: Mesh) -> PackResult:
+    """Run the packer SPMD over `mesh`. Bit-identical to single-device pack
+    (tests/test_sharded.py)."""
+    shardings = input_shardings(mesh)
+    inputs = jax.tree.map(
+        lambda a, sh: jax.device_put(jax.numpy.asarray(a), sh), inputs, shardings
+    )
+    fn = jax.jit(
+        _constrained_pack,
+        static_argnames=("n_slots", "mesh"),
+        in_shardings=(shardings,),
+    )
+    with mesh:
+        return fn(inputs, n_slots, mesh)

@@ -1,0 +1,148 @@
+"""Instance-type catalog provider.
+
+Parity target: InstanceTypeProvider (/root/reference/pkg/cloudprovider/
+instancetypes.go:51-121 List/createOfferings + seqnum memoization) and the
+InstanceType construction pipeline (instancetype.go:50-163: requirements from
+shape labels, capacity minus overheads).
+
+Two concrete sources:
+- `generate_fleet_catalog`: a synthetic-but-realistic ~600-type fleet (the
+  reference's EC2 catalog scale, cloudprovider.go:58-60 + 771-line price
+  table) used by benchmarks and the fake cloud backend. Generated from shape
+  grammar, NOT copied from AWS data.
+- the fake cloud backend (karpenter_tpu/fake) serves per-test fixtures.
+
+Overhead model (re-derived from instancetype.go:229-319 semantics):
+- memory: vmMemoryOverheadPercent (default 7.5%) of capacity
+- kubeReserved CPU: regressive curve on core count
+- kubeReserved memory: 11 MiB per supported pod + 255 MiB
+- eviction threshold: 100 MiB
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..apis import wellknown as wk
+from ..models.instancetype import Catalog, InstanceType, Offering, Offerings
+from ..utils.quantity import mem_bytes
+
+VM_MEMORY_OVERHEAD_PERCENT = 0.075  # settings default (settings.go:54-65)
+
+
+def kube_reserved_cpu_millis(cores: int) -> int:
+    """Regressive kubelet CPU reservation curve (instancetype.go:259-278
+    semantics: 6% of the first core, 1% of the second, 0.5% of the next two,
+    0.25% of the rest)."""
+    millis = 0
+    remaining = cores * 1000
+    tiers = [(1000, 0.06), (1000, 0.01), (2000, 0.005)]
+    for width, frac in tiers:
+        take = min(remaining, width)
+        millis += int(take * frac)
+        remaining -= take
+    millis += int(remaining * 0.0025)
+    return millis
+
+
+def node_overhead(cpu_millis: int, memory_bytes: int, pods: int) -> "dict[str, int]":
+    kube_mem = (11 * pods + 255) * 2**20
+    eviction = 100 * 2**20
+    vm_overhead = int(memory_bytes * VM_MEMORY_OVERHEAD_PERCENT)
+    return {
+        wk.RESOURCE_CPU: kube_reserved_cpu_millis(cpu_millis // 1000),
+        wk.RESOURCE_MEMORY: vm_overhead + kube_mem + eviction,
+    }
+
+
+# shape grammar: (category, family prefix, generations, mem GiB per cpu, price $/cpu-hr)
+_FAMILIES = (
+    ("c", "compute", (3, 4, 5, 6, 7, 8), 2, 0.044),
+    ("m", "general", (3, 4, 5, 6, 7, 8), 4, 0.050),
+    ("r", "memory", (3, 4, 5, 6, 7, 8), 8, 0.062),
+    ("t", "burst", (2, 3, 4), 4, 0.041),
+    ("c-arm", "compute", (6, 7, 8, 9), 2, 0.037),
+    ("m-arm", "general", (6, 7, 8, 9), 4, 0.042),
+    ("r-arm", "memory", (7, 8, 9), 8, 0.052),
+    ("d", "storage", (2, 3), 4, 0.055),
+    ("i", "io", (3, 4), 8, 0.078),
+    ("x", "xmem", (1, 2), 16, 0.10),
+    ("hpc", "hpc", (6, 7), 2, 0.09),
+    ("g", "gpu", (3, 4, 5, 6), 8, 0.35),
+    ("inf", "inference", (1, 2), 4, 0.12),
+    ("trn", "training", (1, 2), 8, 0.40),
+    ("tpu", "accel", (3, 4, 5, 6), 16, 0.30),
+)
+_SIZES = ((1, "medium"), (2, "large"), (4, "xlarge"), (8, "2xlarge"), (16, "4xlarge"),
+          (32, "8xlarge"), (48, "12xlarge"), (64, "16xlarge"), (96, "24xlarge"),
+          (128, "32xlarge"), (192, "48xlarge"))
+
+
+def generate_fleet_catalog(
+    zones: Sequence[str] = ("zone-1a", "zone-1b", "zone-1c"),
+    spot_discount: float = 0.65,
+    max_types: Optional[int] = None,
+) -> Catalog:
+    """~600-type synthetic fleet across 8 families x 9 sizes x generations."""
+    types: "list[InstanceType]" = []
+    for fam, category, gens, mem_per_cpu, price_per_cpu in _FAMILIES:
+        arch = "arm64" if fam.endswith("-arm") else "amd64"
+        for gen in gens:
+            for cpu, size in _SIZES:
+                if fam == "t" and cpu > 8:
+                    continue
+                name = f"{fam}{gen}.{size}"
+                mem_gib = cpu * mem_per_cpu
+                pods = min(110, max(8, cpu * 8))
+                cpu_m = cpu * 1000
+                mem_b = mem_gib * 2**30
+                extended: "dict[str, int]" = {}
+                extra = {
+                    wk.LABEL_INSTANCE_CATEGORY: category,
+                    wk.LABEL_INSTANCE_GENERATION: str(gen),
+                }
+                if fam == "g" and cpu >= 8:
+                    extended[wk.RESOURCE_NVIDIA_GPU] = max(1, cpu // 16)
+                    extra[wk.LABEL_INSTANCE_GPU_NAME] = "a100"
+                    extra[wk.LABEL_INSTANCE_GPU_COUNT] = str(extended[wk.RESOURCE_NVIDIA_GPU])
+                if fam == "tpu" and cpu >= 8:
+                    extended[wk.RESOURCE_TPU] = max(1, cpu // 24)
+                    extra[wk.LABEL_INSTANCE_ACCEL_NAME] = f"tpu-v{gen}"
+                    extra[wk.LABEL_INSTANCE_ACCEL_COUNT] = str(extended[wk.RESOURCE_TPU])
+                # newer generations are slightly cheaper per cpu
+                od = round(cpu * price_per_cpu * (1.0 - 0.03 * (gen - gens[0])), 4)
+                ovh = node_overhead(cpu_m, mem_b, pods)
+                cap = {
+                    wk.RESOURCE_CPU: cpu_m,
+                    wk.RESOURCE_MEMORY: mem_b,
+                    wk.RESOURCE_PODS: pods,
+                    wk.RESOURCE_EPHEMERAL: mem_bytes("100Gi"),
+                    **extended,
+                }
+                labels = {
+                    wk.LABEL_INSTANCE_TYPE: name,
+                    wk.LABEL_ARCH: arch,
+                    wk.LABEL_OS: "linux",
+                    wk.LABEL_INSTANCE_FAMILY: f"{fam}{gen}",
+                    wk.LABEL_INSTANCE_SIZE: size,
+                    wk.LABEL_INSTANCE_CPU: str(cpu),
+                    wk.LABEL_INSTANCE_MEMORY: str(mem_gib * 1024),
+                    wk.LABEL_INSTANCE_PODS: str(pods),
+                    wk.LABEL_INSTANCE_HYPERVISOR: "nitro" if gen >= 5 else "xen",
+                    **extra,
+                }
+                offerings = []
+                for z in zones:
+                    offerings.append(Offering(z, wk.CAPACITY_TYPE_ON_DEMAND, od))
+                    offerings.append(Offering(z, wk.CAPACITY_TYPE_SPOT,
+                                              round(od * (1 - spot_discount), 4)))
+                types.append(InstanceType(
+                    name=name,
+                    labels=tuple(sorted(labels.items())),
+                    capacity=tuple(sorted(cap.items())),
+                    overhead=tuple(sorted(ovh.items())),
+                    offerings=Offerings(offerings),
+                ))
+                if max_types and len(types) >= max_types:
+                    return Catalog(types=types)
+    return Catalog(types=types)

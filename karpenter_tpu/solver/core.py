@@ -1,0 +1,166 @@
+"""TPU solver: encode -> pack kernel -> decode.
+
+This is the "Solver half" of the architecture (SURVEY.md §7.1): the JAX
+service the controller calls instead of running the scalar FFD loop. The
+scalar oracle (karpenter_tpu/oracle/scheduler.py) remains the in-process
+fallback with identical semantics (BASELINE.json north star).
+
+Shape discipline (SURVEY.md §7.3 "dynamic shapes"): pod-group count, claim
+slots and existing-node count are bucketed to powers of two and padded, so a
+stream of differently-sized solves hits a handful of compiled programs, not a
+recompilation per solve. Padded groups have count=0 / feas=False and are
+no-ops in the kernel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..apis import wellknown as wk
+from ..apis.provisioner import Provisioner
+from ..models.encode import EncodedProblem, OptionGrid, build_grid, encode_problem
+from ..models.instancetype import Catalog
+from ..models.pod import PodSpec
+from ..ops.packer import PackInputs, PackResult, pack
+from ..oracle.scheduler import ExistingNode, Option
+
+
+def _bucket(n: int, lo: int = 8) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass
+class SolvedNode:
+    """One node decision (the Machine the controller would create)."""
+
+    option: Option
+    pod_counts: "dict[int, int]"  # group index -> pods
+    provisioner: Provisioner
+
+    @property
+    def pod_count(self) -> int:
+        return sum(self.pod_counts.values())
+
+
+@dataclasses.dataclass
+class SolveResult:
+    nodes: "list[SolvedNode]"
+    existing_counts: "dict[str, int]"  # existing node name -> pods placed
+    unschedulable: "dict[int, int]"  # group index -> pod count
+    groups: list
+
+    def decisions(self) -> "list[tuple[str, str, str, int]]":
+        """Fingerprint [(type, zone, capacityType, pods)] — comparable with
+        oracle SchedulingResult.node_decisions()."""
+        return sorted(
+            (n.option.itype.name, n.option.zone, n.option.capacity_type, n.pod_count)
+            for n in self.nodes
+        )
+
+    def unschedulable_count(self) -> int:
+        return sum(self.unschedulable.values())
+
+
+class TPUSolver:
+    """Catalog-resident batched solver. Keeps the encoded option grid AND its
+    device arrays resident across solves (reference analogue: the
+    seqnum-memoized instance type cache, instancetypes.go:104-120) — only the
+    per-solve group delta crosses the host-device boundary (SURVEY.md §7.3
+    "ship only the pod delta")."""
+
+    def __init__(self, catalog: Catalog, provisioners: Sequence[Provisioner]):
+        self.catalog = catalog
+        self.provisioners = list(provisioners)
+        self._grid: Optional[OptionGrid] = None
+        self._dev_alloc_t = None
+        self._dev_tiebreak = None
+
+    def grid(self) -> OptionGrid:
+        if self._grid is None or self._grid.seqnum != self.catalog.seqnum:
+            self._grid = build_grid(self.catalog)
+            self._dev_alloc_t = jax.device_put(self._grid.alloc_t)
+            self._dev_tiebreak = jax.device_put(self._grid.tiebreak)
+        return self._grid
+
+    def solve(
+        self,
+        pods: "list[PodSpec]",
+        existing: Sequence[ExistingNode] = (),
+        daemon_overhead: Optional[Sequence[int]] = None,
+        n_slots: Optional[int] = None,
+    ) -> SolveResult:
+        enc = encode_problem(
+            self.catalog, self.provisioners, pods, existing,
+            daemon_overhead, n_slots, grid=self.grid(),
+        )
+        result = run_pack(enc, self._dev_alloc_t, self._dev_tiebreak)
+        return decode(enc, result, [e.name for e in existing])
+
+
+def run_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None) -> PackResult:
+    """Pad to shape buckets and invoke the jitted kernel."""
+    G = enc.group_vec.shape[0]
+    Gb = _bucket(G)
+    Ne = enc.ex_alloc.shape[0]
+    Neb = _bucket(Ne, lo=1)
+    Nb = _bucket(enc.n_slots)
+
+    def pad(a, n, axis=0, fill=0):
+        if a.shape[axis] == n:
+            return a
+        widths = [(0, 0)] * a.ndim
+        widths[axis] = (0, n - a.shape[axis])
+        return np.pad(a, widths, constant_values=fill)
+
+    ex_feas = pad(enc.ex_feas, Gb)
+    if ex_feas.shape[1] != Neb:
+        ex_feas = pad(ex_feas, Neb, axis=1)
+    inputs = PackInputs(
+        alloc_t=dev_alloc_t if dev_alloc_t is not None else enc.alloc_t,
+        tiebreak=dev_tiebreak if dev_tiebreak is not None else enc.tiebreak,
+        group_vec=pad(enc.group_vec, Gb),
+        group_count=pad(enc.group_count, Gb),
+        group_cap=pad(enc.group_cap, Gb),
+        group_feas=pad(enc.group_feas, Gb),
+        group_newprov=pad(enc.group_newprov, Gb, fill=-1),
+        overhead=enc.overhead,
+        ex_alloc=pad(enc.ex_alloc, Neb),
+        ex_used=pad(enc.ex_used, Neb),
+        ex_feas=ex_feas,
+    )
+    inputs = jax.device_put(inputs)  # one transfer for the whole pytree
+    return pack(inputs, n_slots=Nb)
+
+
+def decode(enc: EncodedProblem, result: PackResult, existing_names: "list[str]") -> SolveResult:
+    # one bulk host transfer for the whole result pytree
+    host = jax.device_get(result._replace(used=result.used[:0]))
+    assign, ex_assign, unsched = host.assign, host.ex_assign, host.unsched
+    active, decided, nprov = host.active, host.decided, host.nprov
+    G = len(enc.groups)
+
+    nodes: "list[SolvedNode]" = []
+    for n in np.nonzero(active)[0]:
+        counts_col = assign[:G, n]
+        counts = {int(g): int(counts_col[g]) for g in np.nonzero(counts_col)[0]}
+        if decided[n] < 0:
+            # defensive: an active slot must always retain >=1 option
+            raise AssertionError(f"active claim slot {n} has no surviving option")
+        nodes.append(SolvedNode(
+            option=enc.grid.options[int(decided[n])], pod_counts=counts,
+            provisioner=enc.provisioners[int(nprov[n])],
+        ))
+    ex_totals = ex_assign[:G].sum(axis=0)
+    existing_counts = {
+        name: int(ex_totals[e]) for e, name in enumerate(existing_names)
+        if ex_totals[e] > 0
+    }
+    unschedulable = {int(g): int(unsched[g]) for g in np.nonzero(unsched[:G] > 0)[0]}
+    return SolveResult(nodes, existing_counts, unschedulable, enc.groups)

@@ -1,0 +1,95 @@
+"""Vectorized mask folding (encode fast path) vs scalar oracle matching."""
+
+import random
+
+import numpy as np
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.models.encode import build_grid, encode_problem, fold_option_mask
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import Toleration, make_pod
+from karpenter_tpu.models.requirements import (
+    IncompatibleError, Requirement, Requirements,
+    OP_DOES_NOT_EXIST, OP_EXISTS, OP_GT, OP_IN, OP_LT, OP_NOT_IN,
+)
+from karpenter_tpu.oracle.scheduler import build_options, feasible_options, option_labels
+
+
+def random_catalog(rng):
+    types = []
+    for i in range(rng.randint(3, 10)):
+        cpu = rng.choice([1, 2, 4, 8, 16])
+        types.append(make_instance_type(
+            f"f{i % 3}.{i}x", cpu=cpu, memory=f"{cpu * 4}Gi",
+            arch=rng.choice(["amd64", "arm64"]),
+            zones=rng.sample(["zone-1a", "zone-1b", "zone-1c"], rng.randint(1, 3)),
+            od_price=0.1 * cpu,
+            spot_price=0.03 * cpu if rng.random() < 0.6 else None,
+        ))
+    return Catalog(types=types)
+
+
+def random_requirements(rng):
+    reqs = Requirements()
+    pool = [
+        (wk.LABEL_ARCH, OP_IN, [rng.choice(["amd64", "arm64"])]),
+        (wk.LABEL_ZONE, OP_IN, rng.sample(["zone-1a", "zone-1b", "zone-1c"], rng.randint(1, 2))),
+        (wk.LABEL_ZONE, OP_NOT_IN, [rng.choice(["zone-1a", "zone-1b"])]),
+        (wk.LABEL_INSTANCE_CPU, OP_GT, [str(rng.choice([1, 2, 4]))]),
+        (wk.LABEL_INSTANCE_CPU, OP_LT, [str(rng.choice([8, 16, 32]))]),
+        (wk.LABEL_INSTANCE_FAMILY, OP_IN, [f"f{rng.randint(0, 3)}"]),
+        (wk.LABEL_INSTANCE_GPU_NAME, OP_DOES_NOT_EXIST, []),
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, [rng.choice(["spot", "on-demand"])]),
+        ("custom/team", OP_IN, ["ml"]),
+        ("custom/team", OP_EXISTS, []),
+    ]
+    for spec in rng.sample(pool, rng.randint(0, 4)):
+        try:
+            reqs.add(Requirement.create(*spec[:2], spec[2]))
+        except IncompatibleError:
+            pass
+    return reqs
+
+
+def test_fold_matches_scalar_oracle_randomized():
+    rng = random.Random(7)
+    for _ in range(40):
+        catalog = random_catalog(rng)
+        grid = build_grid(catalog)
+        cols = grid.get_cols()
+        prov = Provisioner(name="p",
+                           labels=(("custom/team", "ml"),) if rng.random() < 0.5 else ())
+        if rng.random() < 0.7:
+            prov.requirements = random_requirements(rng)
+        prov.set_defaults()
+        reqs = random_requirements(rng)
+        try:
+            combined = prov.scheduling_requirements().union(reqs)
+        except IncompatibleError:
+            continue
+        fast = fold_option_mask(combined, cols, prov)
+        # scalar: matches_labels per grid option
+        slow = np.zeros_like(fast)
+        for i, opt in enumerate(grid.options):
+            if opt is None:
+                continue
+            slow[i] = combined.matches_labels(option_labels(opt, prov))
+        assert (fast == slow).all(), (
+            f"fold mismatch at {np.nonzero(fast != slow)};\nreqs={combined!r}")
+
+
+def test_encode_feas_matches_oracle_feasible_options():
+    rng = random.Random(11)
+    for _ in range(10):
+        catalog = random_catalog(rng)
+        prov = Provisioner(name="default")
+        prov.set_defaults()
+        pod = make_pod("p", cpu=str(rng.choice([1, 2, 4])), memory="1Gi",
+                       requirements=random_requirements(rng))
+        enc = encode_problem(catalog, [prov], [pod])
+        # oracle path over the SAME grid-ordered option list
+        flat = [o for o in enc.grid.options if o is not None]
+        want = feasible_options(pod, prov, flat, [0] * wk.NUM_RESOURCES)
+        got = set(np.nonzero(enc.group_feas[0, 0].reshape(-1))[0].tolist())
+        assert got == want

@@ -1,0 +1,234 @@
+"""Differential tests: TPU packer kernel vs scalar oracle.
+
+The reference's semantics live in the oracle (designs/bin-packing.md FFD +
+instance.go:445-462 selection); the kernel must produce bit-identical node
+decisions (SURVEY.md §7.3 "bit-parity with sequential greedy semantics").
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import Toleration, TopologySpreadConstraint, make_pod
+from karpenter_tpu.models.requirements import Requirements, OP_IN
+from karpenter_tpu.oracle.scheduler import ExistingNode, Scheduler
+from karpenter_tpu.solver.core import TPUSolver
+
+
+def assert_parity(catalog, provisioners, pods, existing=None, daemon_overhead=None):
+    existing = existing or []
+    # oracle mutates ExistingNode.used — give each side its own copies
+    def mk_existing():
+        return [ExistingNode(name=e.name, labels=dict(e.labels),
+                             allocatable=list(e.allocatable), used=list(e.used),
+                             taints=e.taints) for e in existing]
+
+    sched = Scheduler(catalog, provisioners, daemon_overhead)
+    oracle_res = sched.schedule(list(pods), existing=mk_existing())
+    kernel_res = TPUSolver(catalog, provisioners).solve(
+        list(pods), existing=mk_existing(), daemon_overhead=daemon_overhead)
+
+    o_decisions = oracle_res.node_decisions(sched.options)
+    k_decisions = kernel_res.decisions()
+    assert k_decisions == o_decisions, (
+        f"decision mismatch:\n oracle: {o_decisions}\n kernel: {k_decisions}")
+    o_ex = {k: len(v) for k, v in oracle_res.existing_assignments.items() if v}
+    assert kernel_res.existing_counts == o_ex
+    assert kernel_res.unschedulable_count() == len(oracle_res.unschedulable)
+    return kernel_res
+
+
+def catalog5():
+    return Catalog(types=[
+        make_instance_type("small.2x", cpu=2, memory="8Gi", od_price=0.10, spot_price=0.03),
+        make_instance_type("medium.4x", cpu=4, memory="16Gi", od_price=0.20, spot_price=0.06),
+        make_instance_type("large.8x", cpu=8, memory="32Gi", od_price=0.40, spot_price=0.12),
+        make_instance_type("arm.4x", cpu=4, memory="16Gi", arch="arm64", od_price=0.15),
+        make_instance_type("gpu.8x", cpu=8, memory="64Gi", od_price=2.50,
+                           extended={wk.RESOURCE_NVIDIA_GPU: 4}),
+    ])
+
+
+def prov(name="default", **kw):
+    p = Provisioner(name=name, **kw)
+    p.set_defaults()
+    return p
+
+
+def test_parity_single_pod():
+    assert_parity(catalog5(), [prov()], [make_pod("p0", cpu="1", memory="1Gi")])
+
+
+def test_parity_inflate_100():
+    pods = [make_pod(f"inflate-{i}", cpu="1", memory="256M") for i in range(100)]
+    res = assert_parity(catalog5(), [prov()], pods)
+    assert sum(n.pod_count for n in res.nodes) == 100
+
+
+def test_parity_mixed_sizes():
+    pods = (
+        [make_pod(f"big-{i}", cpu="3", memory="12Gi") for i in range(7)]
+        + [make_pod(f"mid-{i}", cpu="1", memory="2Gi") for i in range(23)]
+        + [make_pod(f"tiny-{i}", cpu="100m", memory="128Mi") for i in range(50)]
+    )
+    assert_parity(catalog5(), [prov()], pods)
+
+
+def test_parity_zone_selectors():
+    pods = (
+        [make_pod(f"a-{i}", cpu="1", memory="1Gi",
+                  node_selector={wk.LABEL_ZONE: "zone-1a"}) for i in range(5)]
+        + [make_pod(f"b-{i}", cpu="1", memory="1Gi",
+                    node_selector={wk.LABEL_ZONE: "zone-1b"}) for i in range(3)]
+        + [make_pod(f"free-{i}", cpu="500m", memory="512Mi") for i in range(4)]
+    )
+    assert_parity(catalog5(), [prov()], pods)
+
+
+def test_parity_topology_spread():
+    spread = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),)
+    pods = [make_pod(f"s-{i}", cpu="1", memory="1Gi", topology=spread) for i in range(10)]
+    assert_parity(catalog5(), [prov()], pods)
+
+
+def test_parity_hostname_anti_affinity():
+    pods = [make_pod(f"h-{i}", cpu="100m", memory="128Mi", anti_affinity_hostname=True)
+            for i in range(7)]
+    assert_parity(catalog5(), [prov()], pods)
+
+
+def test_parity_multi_provisioner_weights():
+    p1 = prov("low")
+    p2 = Provisioner(name="high", weight=10, labels=(("team", "ml"),))
+    p2.set_defaults()
+    pods = [make_pod(f"p{i}", cpu="1", memory="1Gi") for i in range(6)]
+    assert_parity(catalog5(), [p1, p2], pods)
+
+
+def test_parity_taints_and_gpu():
+    p_gpu = Provisioner(
+        name="gpu",
+        taints=(__import__("karpenter_tpu.models.pod", fromlist=["Taint"]).Taint(
+            key="nvidia.com/gpu", value="true", effect="NoSchedule"),),
+        weight=5,
+    )
+    p_gpu.set_defaults()
+    p_def = prov()
+    pods = [make_pod(f"c{i}", cpu="1", memory="1Gi") for i in range(4)] + [
+        make_pod(
+            f"g{i}", cpu="1", memory="2Gi",
+            extended={wk.RESOURCE_NVIDIA_GPU: 1},
+            tolerations=(Toleration(key="nvidia.com/gpu", operator="Exists"),),
+        )
+        for i in range(3)
+    ]
+    assert_parity(catalog5(), [p_def, p_gpu], pods)
+
+
+def test_parity_existing_nodes():
+    existing = [
+        ExistingNode(
+            name=f"node-{i}",
+            labels={wk.LABEL_ARCH: "amd64", wk.LABEL_OS: "linux",
+                    wk.LABEL_ZONE: "zone-1a", wk.LABEL_CAPACITY_TYPE: "on-demand"},
+            allocatable=wk.capacity_vector({wk.RESOURCE_CPU: 4000,
+                                            wk.RESOURCE_MEMORY: 16 * 2**30,
+                                            wk.RESOURCE_PODS: 110}),
+            used=[0] * wk.NUM_RESOURCES,
+        )
+        for i in range(2)
+    ]
+    pods = [make_pod(f"p{i}", cpu="1", memory="1Gi") for i in range(12)]
+    assert_parity(catalog5(), [prov()], pods, existing=existing)
+
+
+def test_parity_daemon_overhead():
+    overhead = wk.resource_vector({wk.RESOURCE_CPU: 1500, wk.RESOURCE_PODS: 2})
+    pods = [make_pod(f"p{i}", cpu="1", memory="1Gi") for i in range(5)]
+    assert_parity(catalog5(), [prov()], pods, daemon_overhead=overhead)
+
+
+def test_parity_unschedulable():
+    pods = [make_pod("huge", cpu="64", memory="1Gi"),
+            make_pod("ok", cpu="1", memory="1Gi")]
+    res = assert_parity(catalog5(), [prov()], pods)
+    assert res.unschedulable_count() == 1
+
+
+def test_parity_randomized_sweep():
+    rng = random.Random(42)
+    zones = ("zone-1a", "zone-1b", "zone-1c")
+    for trial in range(12):
+        n_types = rng.randint(3, 12)
+        types = []
+        for i in range(n_types):
+            cpu = rng.choice([1, 2, 4, 8, 16, 32])
+            mem_gi = cpu * rng.choice([2, 4, 8])
+            types.append(make_instance_type(
+                f"t{trial}.{i}x", cpu=cpu, memory=f"{mem_gi}Gi",
+                zones=rng.sample(zones, rng.randint(1, 3)),
+                od_price=round(0.02 * cpu + rng.random() * 0.05, 4),
+                spot_price=round(0.006 * cpu + rng.random() * 0.02, 4) if rng.random() < 0.7 else None,
+                pods=rng.choice([16, 32, 110]),
+            ))
+        catalog = Catalog(types=types)
+        provs = [prov("default")]
+        if rng.random() < 0.5:
+            p2 = Provisioner(name="spot", weight=rng.randint(1, 20),
+                             requirements=Requirements.of(
+                                 (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+            p2.set_defaults()
+            provs.append(p2)
+        pods = []
+        for d in range(rng.randint(1, 6)):
+            cnt = rng.randint(1, 40)
+            cpu_m = rng.choice(["100m", "250m", "500m", "1", "2", "3"])
+            mem = rng.choice(["128Mi", "512Mi", "1Gi", "2Gi", "4Gi"])
+            sel = {}
+            if rng.random() < 0.3:
+                sel[wk.LABEL_ZONE] = rng.choice(zones)
+            topo = ()
+            if rng.random() < 0.25:
+                topo = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),)
+            for i in range(cnt):
+                pods.append(make_pod(f"d{d}-p{i}", cpu=cpu_m, memory=mem,
+                                     node_selector=dict(sel), topology=topo))
+        assert_parity(catalog, provs, pods)
+
+
+def test_parity_zero_request_pods_on_existing_nodes():
+    # regression: INT_BIG per-slot fill must not overflow the waterfall cumsum
+    existing = [
+        ExistingNode(
+            name=f"e{i}",
+            labels={wk.LABEL_ARCH: "amd64", wk.LABEL_OS: "linux",
+                    wk.LABEL_ZONE: "zone-1a", wk.LABEL_CAPACITY_TYPE: "on-demand"},
+            allocatable=wk.capacity_vector({wk.RESOURCE_CPU: 4000,
+                                            wk.RESOURCE_MEMORY: 16 * 2**30,
+                                            wk.RESOURCE_PODS: 110}),
+            used=[0] * wk.NUM_RESOURCES,
+        )
+        for i in range(5)
+    ]
+    pods = [make_pod(f"z{i}", cpu=0, memory=0) for i in range(7)]
+    res = assert_parity(catalog5(), [prov()], pods, existing=existing)
+    assert sum(res.existing_counts.values()) == 7
+
+
+def test_parity_zone_only_unavailable_offerings():
+    # regression: grid zone universe must exclude unavailable-only zones,
+    # matching the oracle (zone-spread would otherwise pin pods to dead zones)
+    from karpenter_tpu.models.instancetype import InstanceType, Offering, Offerings
+    base = make_instance_type("m.4x", cpu=4, memory="16Gi", zones=("zone-1a", "zone-1b"),
+                              od_price=0.2)
+    dead = InstanceType(
+        name="dead.4x", labels=base.labels, capacity=base.capacity, overhead=base.overhead,
+        offerings=Offerings([Offering("zone-1c", "on-demand", 0.1, available=False)]))
+    catalog = Catalog(types=[base, dead])
+    spread = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),)
+    pods = [make_pod(f"s{i}", cpu="1", memory="1Gi", topology=spread) for i in range(9)]
+    res = assert_parity(catalog, [prov()], pods)
+    assert res.unschedulable_count() == 0

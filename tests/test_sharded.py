@@ -1,0 +1,71 @@
+"""Sharded (multi-device) packer vs single-device packer: bit parity.
+
+Runs on the 8-device virtual CPU platform (conftest.py)."""
+
+import jax
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.models.encode import encode_problem
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.parallel.sharded import make_mesh, sharded_pack
+from karpenter_tpu.solver.core import _bucket, run_pack
+from karpenter_tpu.ops.packer import PackInputs
+
+
+def build_inputs():
+    catalog = Catalog(types=[
+        make_instance_type(f"t.{i}x", cpu=2 * (i + 1), memory=f"{8 * (i + 1)}Gi",
+                           od_price=0.1 * (i + 1), spot_price=0.03 * (i + 1))
+        for i in range(8)
+    ])
+    prov = Provisioner(name="default")
+    prov.set_defaults()
+    pods = [make_pod(f"a{i}", cpu="1", memory="2Gi") for i in range(40)] + [
+        make_pod(f"b{i}", cpu="500m", memory="1Gi") for i in range(30)]
+    enc = encode_problem(catalog, [prov], pods)
+    return enc
+
+
+def pad_inputs(enc):
+    Gb = _bucket(enc.group_vec.shape[0])
+
+    def pad(a, n, axis=0, fill=0):
+        if a.shape[axis] == n:
+            return a
+        w = [(0, 0)] * a.ndim
+        w[axis] = (0, n - a.shape[axis])
+        return np.pad(a, w, constant_values=fill)
+
+    return PackInputs(
+        alloc_t=enc.alloc_t, tiebreak=enc.tiebreak,
+        group_vec=pad(enc.group_vec, Gb), group_count=pad(enc.group_count, Gb),
+        group_cap=pad(enc.group_cap, Gb), group_feas=pad(enc.group_feas, Gb),
+        group_newprov=pad(enc.group_newprov, Gb, fill=-1), overhead=enc.overhead,
+        ex_alloc=enc.ex_alloc, ex_used=enc.ex_used, ex_feas=pad(enc.ex_feas, Gb),
+    ), _bucket(enc.n_slots)
+
+
+def test_mesh_uses_all_devices():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    mesh = make_mesh(8)
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ("nodes", "types")
+
+
+@pytest.mark.parametrize("n_devices", [2, 4, 8])
+def test_sharded_pack_parity(n_devices):
+    enc = build_inputs()
+    inputs, n_slots = pad_inputs(enc)
+    base = run_pack(enc)
+    mesh = make_mesh(n_devices)
+    sh = sharded_pack(inputs, n_slots, mesh)
+    for name in ("assign", "ex_assign", "unsched", "decided", "nprov"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(base, name)), np.asarray(getattr(sh, name)),
+            err_msg=f"sharded mismatch on {name} @ {n_devices} devices")
+    np.testing.assert_array_equal(np.asarray(base.active), np.asarray(sh.active))
+    np.testing.assert_array_equal(np.asarray(base.used), np.asarray(sh.used))
