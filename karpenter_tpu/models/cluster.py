@@ -1,0 +1,133 @@
+"""In-memory cluster state.
+
+Parity target: karpenter-core's `state.Cluster` (consumed at
+/root/reference/cmd/controller/main.go:54) — the node/pod/machine snapshot the
+scheduler and deprovisioner read. State is rebuildable from the cluster+cloud
+(reference checkpoint story, SURVEY.md §5.4): nothing here is persisted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional
+
+from ..apis import wellknown as wk
+from .pod import PodSpec, Taint
+from .requirements import Requirements
+
+
+@dataclasses.dataclass
+class StateNode:
+    """One launched node plus its resident pods."""
+
+    name: str
+    labels: "dict[str, str]"
+    allocatable: "list[int]"  # canonical resource axis
+    provider_id: str = ""
+    provisioner_name: str = ""
+    instance_type: str = ""
+    zone: str = ""
+    capacity_type: str = ""
+    price: float = 0.0
+    taints: "tuple[Taint, ...]" = ()
+    pods: "list[PodSpec]" = dataclasses.field(default_factory=list)
+    created_ts: float = 0.0
+    initialized: bool = True
+    machine_name: str = ""
+    marked_for_deletion: bool = False
+    drifted: bool = False
+
+    def used_vector(self) -> "list[int]":
+        vec = [0] * wk.NUM_RESOURCES
+        for p in self.pods:
+            for i, v in enumerate(p.resource_vector()):
+                vec[i] += v
+        return vec
+
+    def non_daemon_pods(self) -> "list[PodSpec]":
+        return [p for p in self.pods if not p.is_daemon()]
+
+    def is_empty(self) -> bool:
+        return not self.non_daemon_pods()
+
+    def to_existing(self):
+        """ExistingNode view for the scheduler (used capacity included)."""
+        from ..oracle.scheduler import ExistingNode
+
+        return ExistingNode(
+            name=self.name,
+            labels=dict(self.labels),
+            allocatable=list(self.allocatable),
+            used=self.used_vector(),
+            taints=self.taints,
+        )
+
+
+@dataclasses.dataclass
+class PodDisruptionBudget:
+    """Minimal PDB model: blocks eviction when disruptionsAllowed == 0
+    (designs/consolidation.md 'Pods that Prevent Consolidation')."""
+
+    name: str
+    selector: "dict[str, str]"
+    min_available: Optional[int] = None
+    max_unavailable: Optional[int] = None
+
+    def matches(self, pod: PodSpec) -> bool:
+        labels = dict(pod.labels)
+        return all(labels.get(k) == v for k, v in self.selector.items())
+
+    def disruptions_allowed(self, matching_healthy: int) -> int:
+        if self.min_available is not None:
+            return max(0, matching_healthy - self.min_available)
+        if self.max_unavailable is not None:
+            return max(0, self.max_unavailable)
+        return matching_healthy
+
+
+class ClusterState:
+    """Mutable cluster snapshot; the deprovisioner and scheduler read this."""
+
+    def __init__(self):
+        self.nodes: "dict[str, StateNode]" = {}
+        self.pdbs: "list[PodDisruptionBudget]" = []
+
+    def add_node(self, node: StateNode) -> None:
+        self.nodes[node.name] = node
+
+    def delete_node(self, name: str) -> Optional[StateNode]:
+        return self.nodes.pop(name, None)
+
+    def bind_pod(self, node_name: str, pod: PodSpec) -> None:
+        self.nodes[node_name].pods.append(
+            dataclasses.replace(pod, node_name=node_name))
+
+    def existing_views(self, exclude: "set[str]" = frozenset()):
+        return [n.to_existing() for name, n in sorted(self.nodes.items())
+                if name not in exclude and not n.marked_for_deletion]
+
+    def total_usage(self, provisioner_name: str) -> "tuple[int, int]":
+        """(cpu_millis, memory_bytes) of allocatable committed to a
+        provisioner's nodes (limits enforcement, designs/limits.md)."""
+        cpu = mem = 0
+        for n in self.nodes.values():
+            if n.provisioner_name != provisioner_name:
+                continue
+            cpu += n.allocatable[wk.RESOURCE_INDEX[wk.RESOURCE_CPU]]
+            mem += n.allocatable[wk.RESOURCE_INDEX[wk.RESOURCE_MEMORY]] * 2**20
+        return cpu, mem
+
+
+def pod_evictable(pod: PodSpec, pdbs: "Iterable[PodDisruptionBudget]",
+                  peers_healthy: "dict[str, int]") -> bool:
+    """Consolidation eligibility per pod (consolidation.md 'Pods that Prevent
+    Consolidation'): controller-owned, not do-not-evict, PDB headroom > 0."""
+    if pod.do_not_evict:
+        return False
+    if not pod.owner_kind:  # bare pod without controller
+        return False
+    for pdb in pdbs:
+        if pdb.matches(pod) and pdb.disruptions_allowed(
+                peers_healthy.get(pdb.name, 0)) < 1:
+            return False
+    return True

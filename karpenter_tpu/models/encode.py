@@ -262,28 +262,13 @@ def encode_problem(
         ex_used[ei] = np.minimum(e.used, INT_BIG)
 
     cols = grid.get_cols()
-    ovh = np.asarray(overhead, dtype=np.int64)
     for gi, g in enumerate(groups):
-        vec = np.minimum(g.vector, INT_BIG)
+        vec, cap, feas, newprov = encode_group(g, provs, grid, cols, overhead)
         group_vec[gi] = vec
         group_count[gi] = g.count
-        cap = _group_cap_per_node(g.spec)
-        if cap is not None:
-            group_cap[gi] = cap
-        # capacity admission on a fresh node: overhead + vec <= alloc, per type
-        fits_t = np.all(grid.alloc_t.astype(np.int64) - ovh[None, :] - vec[None, :] >= 0, axis=1)
-        for pi, prov in enumerate(provs):
-            if not tolerates_all(g.spec.tolerations, prov.taints):
-                continue
-            try:
-                reqs = prov.scheduling_requirements().union(g.spec.requirements)
-            except IncompatibleError:
-                continue
-            mask = fold_option_mask(reqs, cols, prov).reshape(T, S) & fits_t[:, None]
-            if mask.any():
-                group_feas[gi, pi] = mask
-                if group_newprov[gi] < 0:
-                    group_newprov[gi] = pi
+        group_cap[gi] = cap
+        group_feas[gi] = feas
+        group_newprov[gi] = newprov
         for ei, e in enumerate(existing):
             ex_feas[gi, ei] = _ex_label_fit(e, g.spec)
 
@@ -293,6 +278,7 @@ def encode_problem(
         # over its admitting provisioner's feasible types (kernel step 3 math).
         bound = 0
         alloc64 = grid.alloc_t.astype(np.int64)
+        ovh = np.asarray(overhead, dtype=np.int64)
         for gi, g in enumerate(groups):
             pi = int(group_newprov[gi])
             if pi < 0:
@@ -317,6 +303,47 @@ def encode_problem(
         n_slots=n_slots,
         groups=groups, provisioners=list(provs), grid=grid,
     )
+
+
+def encode_group(
+    group: PodGroup,
+    provs: "list[Provisioner]",
+    grid: OptionGrid,
+    cols: GridCols,
+    overhead: Sequence[int],
+    extra_mask: Optional[np.ndarray] = None,
+) -> "tuple[np.ndarray, int, np.ndarray, int]":
+    """One pod group -> (vec [R], cap, feas [Pv,T,S], newprov).
+
+    The single source of the admission rule (tolerations ∧ requirements ∧
+    fresh-node capacity ∧ optional extra option mask) shared by provisioning
+    (encode_problem) and consolidation (ops/consolidate.py) — the two must
+    stay bit-identical for kernel/oracle parity."""
+    T, S = grid.T, grid.S
+    vec = np.minimum(group.vector, INT_BIG).astype(np.int32)
+    cap = _group_cap_per_node(group.spec)
+    cap = INT_BIG if cap is None else cap
+    feas = np.zeros((len(provs), T, S), dtype=bool)
+    newprov = -1
+    ovh = np.asarray(overhead, dtype=np.int64)
+    fits_t = np.all(
+        grid.alloc_t.astype(np.int64) - ovh[None, :] - vec[None, :].astype(np.int64) >= 0,
+        axis=1)
+    for pi, prov in enumerate(provs):
+        if not tolerates_all(group.spec.tolerations, prov.taints):
+            continue
+        try:
+            reqs = prov.scheduling_requirements().union(group.spec.requirements)
+        except IncompatibleError:
+            continue
+        mask = fold_option_mask(reqs, cols, prov).reshape(T, S) & fits_t[:, None]
+        if extra_mask is not None:
+            mask = mask & extra_mask
+        if mask.any():
+            feas[pi] = mask
+            if newprov < 0:
+                newprov = pi
+    return vec, cap, feas, newprov
 
 
 def _ex_label_fit(e: ExistingNode, spec: PodSpec) -> bool:

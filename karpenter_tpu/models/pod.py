@@ -75,6 +75,7 @@ class TopologySpreadConstraint:
 class PodSpec:
     name: str
     namespace: str = "default"
+    labels: "tuple[tuple[str, str], ...]" = ()  # pod labels (PDB/service selectors)
     requests: "tuple[tuple[str, int], ...]" = ()  # canonical units (cpu millis, mem bytes, counts)
     requirements: Requirements = dataclasses.field(default_factory=Requirements)
     tolerations: "tuple[Toleration, ...]" = ()
@@ -102,6 +103,10 @@ class PodSpec:
             self.topology,
             self.anti_affinity_hostname,
             self.anti_affinity_zone,
+            # labels separate otherwise-identical deployments: topology spread
+            # is approximated as "pods of my own group", so merging across
+            # selectors would balance the union instead of each deployment
+            self.labels,
         )
 
 

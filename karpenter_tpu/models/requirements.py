@@ -52,6 +52,9 @@ class Requirement:
     gt: Optional[int] = None  # exclusive lower bound
     lt: Optional[int] = None  # exclusive upper bound
     forbid_key: bool = False
+    # True when the key MUST be present (In/Exists/Gt/Lt); survives
+    # intersection so Exists ∩ NotIn still requires presence.
+    requires_presence: bool = True
 
     @staticmethod
     def create(key: str, op: str, values: Iterable[str] = ()) -> "Requirement":
@@ -59,11 +62,12 @@ class Requirement:
         if op == OP_IN:
             return Requirement(key, complement=False, values=frozenset(values))
         if op == OP_NOT_IN:
-            return Requirement(key, complement=True, values=frozenset(values))
+            return Requirement(key, complement=True, values=frozenset(values),
+                               requires_presence=False)
         if op == OP_EXISTS:
             return Requirement(key, complement=True, values=frozenset())
         if op == OP_DOES_NOT_EXIST:
-            return Requirement(key, forbid_key=True)
+            return Requirement(key, forbid_key=True, requires_presence=False)
         if op == OP_GT:
             (v,) = values
             return Requirement(key, complement=True, values=frozenset(), gt=int(v))
@@ -99,16 +103,12 @@ class Requirement:
         """Is an object WITHOUT this key acceptable?
 
         k8s nodeSelectorTerm semantics: In/Exists/Gt/Lt fail on a missing
-        label; NotIn and DoesNotExist succeed.
+        label; NotIn and DoesNotExist succeed. The requires_presence bit makes
+        this survive intersections (Exists ∩ NotIn still requires presence).
         """
         if self.forbid_key:
             return True
-        # Pure NotIn (complement, no bounds) tolerates absence; Exists
-        # (complement of empty set) is encoded identically, so we track
-        # "absence-tolerant" by whether this originated from NotIn. We encode
-        # Exists as complement-of-empty WITH gt/lt None; distinguish via
-        # `_requires_presence`.
-        return self.complement and bool(self.values) and self.gt is None and self.lt is None
+        return not self.requires_presence
 
     # -- set algebra --------------------------------------------------------------
 
@@ -120,7 +120,7 @@ class Requirement:
             if (self.forbid_key or self.allows_absent()) and (
                 other.forbid_key or other.allows_absent()
             ):
-                return Requirement(self.key, forbid_key=True)
+                return Requirement(self.key, forbid_key=True, requires_presence=False)
             raise IncompatibleError(f"key {self.key}: DoesNotExist vs presence-requiring")
         gt = self.gt if other.gt is None else (other.gt if self.gt is None else max(self.gt, other.gt))
         lt = self.lt if other.lt is None else (other.lt if self.lt is None else min(self.lt, other.lt))
@@ -135,7 +135,8 @@ class Requirement:
             deny = other.values if not self.complement else self.values
             values = allow - deny
             complement = False
-        req = Requirement(self.key, complement=complement, values=values, gt=gt, lt=lt)
+        req = Requirement(self.key, complement=complement, values=values, gt=gt, lt=lt,
+                          requires_presence=self.requires_presence or other.requires_presence)
         if req.definitely_empty():
             raise IncompatibleError(f"key {self.key}: empty intersection")
         return req
@@ -265,17 +266,18 @@ class Requirements:
                 # bounds folded into the explicit value set
                 out.append((key, OP_IN, sorted(v for v in r.values if r.has(v))))
             else:
-                emitted = False
+                implies_presence = False
                 if r.values:
                     out.append((key, OP_NOT_IN, sorted(r.values)))
-                    emitted = True
                 if r.gt is not None:
                     out.append((key, OP_GT, [str(r.gt)]))
-                    emitted = True
+                    implies_presence = True
                 if r.lt is not None:
                     out.append((key, OP_LT, [str(r.lt)]))
-                    emitted = True
-                if not emitted:
+                    implies_presence = True
+                # NotIn alone doesn't imply presence; emit Exists when the
+                # requirement demands it (e.g. merged Exists ∩ NotIn)
+                if r.requires_presence and not implies_presence:
                     out.append((key, OP_EXISTS, []))
         self._specs_cache = out
         return out

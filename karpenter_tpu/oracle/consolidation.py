@@ -1,0 +1,146 @@
+"""Scalar reference consolidation search — the exact-semantics spec.
+
+Parity target: /root/reference/designs/consolidation.md:
+- Node Deletion: all of a node's evictable pods re-schedule onto the rest of
+  the cluster -> delete; savings = node price.
+- Node Replacement: pods fit on (cluster - node) plus ONE strictly-cheaper new
+  node -> replace; savings = price delta.
+- Single-node changes only; candidates scored by disruption cost =
+  f(#pods, pod-deletion-cost, priority) weighted by lifetime remaining
+  (1.0 at creation -> 0.0 at ttlSecondsUntilExpired).
+- Pods that prevent consolidation: do-not-evict, bare pods, PDB exhausted.
+
+The TPU kernel (karpenter_tpu/ops/consolidate.py) evaluates ALL candidates in
+one batched solve and is differential-tested against this module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from ..apis import wellknown as wk
+from ..apis.provisioner import Provisioner
+from ..models.cluster import ClusterState, StateNode, pod_evictable
+from ..models.instancetype import Catalog
+from ..oracle.scheduler import Scheduler
+
+# price must improve by a margin to bother replacing (avoids churn on noise)
+REPLACE_PRICE_EPS = 1e-9
+
+
+@dataclasses.dataclass
+class ConsolidationAction:
+    kind: str  # "delete" | "replace"
+    node: str
+    disruption_cost: float
+    savings: float
+    replacement: Optional[tuple] = None  # (instance type, zone, capacityType, price)
+
+    def sort_key(self):
+        return (self.disruption_cost, -self.savings, self.node)
+
+
+def lifetime_factor(node: StateNode, prov: Optional[Provisioner], now: float) -> float:
+    """1.0 at creation, linear to 0.0 at expiry (consolidation.md 'Node Age')."""
+    if prov is None or prov.ttl_seconds_until_expired is None:
+        return 1.0
+    ttl = prov.ttl_seconds_until_expired
+    if ttl <= 0:
+        return 0.0
+    age = max(0.0, now - node.created_ts)
+    return max(0.0, min(1.0, 1.0 - age / ttl))
+
+
+def disruption_cost(node: StateNode, prov: Optional[Provisioner], now: float) -> float:
+    """Blend of pod count, deletion cost and priority, scaled by lifetime
+    remaining (consolidation.md scoring)."""
+    cost = 0.0
+    for p in node.non_daemon_pods():
+        cost += 1.0 + max(p.deletion_cost, 0) / 1000.0 + max(p.priority, 0) / 1e6
+    return cost * lifetime_factor(node, prov, now)
+
+
+def eligible(node: StateNode, cluster: ClusterState) -> bool:
+    if node.marked_for_deletion or not node.initialized:
+        return False
+    if node.is_empty():
+        return False  # emptiness path handles these (cheaper than simulation)
+    healthy = {
+        pdb.name: sum(1 for n in cluster.nodes.values() for p in n.pods if pdb.matches(p))
+        for pdb in cluster.pdbs
+    }
+    pods = node.non_daemon_pods()
+    if not all(pod_evictable(p, cluster.pdbs, healthy) for p in pods):
+        return False
+    # aggregate check: deleting the node evicts ALL its matching pods at once,
+    # so the per-PDB headroom must cover the node's whole matching set
+    for pdb in cluster.pdbs:
+        on_node = sum(1 for p in pods if pdb.matches(p))
+        if on_node and pdb.disruptions_allowed(healthy.get(pdb.name, 0)) < on_node:
+            return False
+    return True
+
+
+def evaluate_candidate(
+    node: StateNode,
+    cluster: ClusterState,
+    catalog: Catalog,
+    provisioners: Sequence[Provisioner],
+    daemon_overhead: Optional[Sequence[int]] = None,
+    now: float = 0.0,
+) -> Optional[ConsolidationAction]:
+    """Simulated scheduling of `node`'s pods against the rest of the cluster,
+    with at most one strictly-cheaper replacement node."""
+    others = cluster.existing_views(exclude={node.name})
+    pods = node.non_daemon_pods()
+    # restrict the replacement universe to OPTIONS strictly cheaper than the
+    # node (option-level filter — the kernel applies the identical per-option
+    # cheaper mask over the full grid, so both paths share one universe)
+    cheaper_types = []
+    for t in catalog.types:
+        offs = type(t.offerings)(
+            o for o in t.offerings
+            if o.available and o.price < node.price - REPLACE_PRICE_EPS)
+        if offs:
+            cheaper_types.append(dataclasses.replace(t, offerings=offs))
+    cheaper = Catalog(types=cheaper_types, seqnum=catalog.seqnum)
+    sched = Scheduler(cheaper, provisioners, daemon_overhead)
+    res = sched.schedule(list(pods), existing=others)
+    if res.unschedulable or len(res.new_nodes) > 1:
+        return None
+    prov = next((p for p in provisioners if p.name == node.provisioner_name), None)
+    cost = disruption_cost(node, prov, now)
+    if not res.new_nodes:
+        return ConsolidationAction("delete", node.name, cost, savings=node.price)
+    claim = res.new_nodes[0]
+    opt = claim.decided
+    if opt.price >= node.price - REPLACE_PRICE_EPS:
+        return None
+    return ConsolidationAction(
+        "replace", node.name, cost, savings=node.price - opt.price,
+        replacement=(opt.itype.name, opt.zone, opt.capacity_type, opt.price),
+    )
+
+
+def find_consolidation(
+    cluster: ClusterState,
+    catalog: Catalog,
+    provisioners: Sequence[Provisioner],
+    daemon_overhead: Optional[Sequence[int]] = None,
+    now: float = 0.0,
+) -> Optional[ConsolidationAction]:
+    """Best single-node action, min disruption cost first (consolidation.md
+    'Selecting Nodes for Consolidation')."""
+    actions = []
+    for name in sorted(cluster.nodes):
+        node = cluster.nodes[name]
+        if not eligible(node, cluster):
+            continue
+        act = evaluate_candidate(node, cluster, catalog, provisioners,
+                                 daemon_overhead, now)
+        if act is not None:
+            actions.append(act)
+    if not actions:
+        return None
+    return min(actions, key=ConsolidationAction.sort_key)

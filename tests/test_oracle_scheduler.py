@@ -229,3 +229,23 @@ def test_unavailable_offerings_not_advertised():
     reqs = t.requirements()
     zone = reqs.get(wk.LABEL_ZONE)
     assert zone.has("zone-1b") and not zone.has("zone-1a")
+
+
+def test_label_distinct_deployments_spread_independently():
+    # two deployments with identical shapes but different labels must each
+    # satisfy their own zone spread (group dedupe must not merge them)
+    spread = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),)
+    pods = [make_pod(f"web-{i}", cpu="1", memory="1Gi", topology=spread,
+                     labels=(("app", "web"),)) for i in range(3)] + \
+           [make_pod(f"api-{i}", cpu="1", memory="1Gi", topology=spread,
+                     labels=(("app", "api"),)) for i in range(3)]
+    sched = Scheduler(small_catalog(), [default_provisioner()])
+    res = sched.schedule(pods)
+    per = {}
+    for n in res.new_nodes:
+        for p in n.pods:
+            app = dict(p.labels)["app"]
+            per.setdefault(app, {}).setdefault(n.decided.zone, 0)
+            per[app][n.decided.zone] += 1
+    for app, zones in per.items():
+        assert sorted(zones.values()) == [1, 1, 1], (app, zones)

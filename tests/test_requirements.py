@@ -127,3 +127,14 @@ def test_to_specs_in_with_bounds_folds():
     r.add(Requirement.create("cpu", OP_IN, ["2", "4", "8"]))
     r.add(Requirement.create("cpu", OP_GT, ["3"]))
     assert r.to_specs() == [("cpu", OP_IN, ["4", "8"])]
+
+
+def test_exists_intersect_notin_keeps_presence():
+    r = Requirements()
+    r.add(Requirement.create("k", OP_EXISTS, []))
+    r.add(Requirement.create("k", OP_NOT_IN, ["x"]))
+    assert not r.matches_labels({})            # presence still required
+    assert r.matches_labels({"k": "y"})
+    assert not r.matches_labels({"k": "x"})
+    specs = r.to_specs()
+    assert ("k", OP_EXISTS, []) in specs and ("k", OP_NOT_IN, ["x"]) in specs
