@@ -1,0 +1,97 @@
+"""NodeTemplate: provider-specific node configuration CRD-equivalent.
+
+Parity target: the `AWSNodeTemplate` v1alpha1 API —
+/root/reference/pkg/apis/v1alpha1/awsnodetemplate.go:21-79 (spec: provider
+fields + userData/imageSelector/detailedMonitoring; status: resolved
+subnets/security groups) and provider.go:24-186 (imageFamily,
+instanceProfile, subnetSelector, securityGroupSelector, tags, launchTemplate
+name, metadataOptions, blockDeviceMappings), with validation per
+awsnodetemplate_validation.go / provider_validation.go:46+ and restricted
+tags per tags.go:29+.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .provisioner import ValidationError
+
+IMAGE_FAMILIES = ("ubuntu-k8s", "flatboat", "custom")
+RESTRICTED_TAG_PREFIXES = ("karpenter.sh/", "kubernetes.io/cluster")
+
+
+@dataclasses.dataclass
+class MetadataOptions:
+    http_endpoint: str = "enabled"
+    http_tokens: str = "required"
+    http_put_response_hop_limit: int = 2
+
+    def validate(self):
+        if self.http_endpoint not in ("enabled", "disabled"):
+            raise ValidationError("metadataOptions.httpEndpoint must be enabled|disabled")
+        if self.http_tokens not in ("required", "optional"):
+            raise ValidationError("metadataOptions.httpTokens must be required|optional")
+
+
+@dataclasses.dataclass
+class BlockDeviceMapping:
+    device_name: str = "/dev/sda1"
+    volume_size_gib: int = 20
+    volume_type: str = "ssd"
+    encrypted: bool = True
+    iops: Optional[int] = None
+
+    def validate(self):
+        if self.volume_size_gib < 1:
+            raise ValidationError("blockDeviceMapping.volumeSize must be >= 1GiB")
+        if self.volume_type not in ("ssd", "balanced", "throughput"):
+            raise ValidationError(f"unknown volume type {self.volume_type}")
+
+
+@dataclasses.dataclass
+class NodeTemplateStatus:
+    subnets: "list[dict]" = dataclasses.field(default_factory=list)
+    security_groups: "list[str]" = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class NodeTemplate:
+    name: str
+    image_family: str = "ubuntu-k8s"
+    instance_profile: str = ""
+    subnet_selector: "dict[str, str]" = dataclasses.field(default_factory=dict)
+    security_group_selector: "dict[str, str]" = dataclasses.field(default_factory=dict)
+    image_selector: "dict[str, str]" = dataclasses.field(default_factory=dict)
+    userdata: str = ""
+    tags: "dict[str, str]" = dataclasses.field(default_factory=dict)
+    launch_template_name: str = ""  # static LT passthrough (launchtemplate.go:93-96)
+    metadata_options: MetadataOptions = dataclasses.field(default_factory=MetadataOptions)
+    block_device_mappings: "tuple[BlockDeviceMapping, ...]" = ()
+    detailed_monitoring: bool = False
+    generation: int = 1
+    status: NodeTemplateStatus = dataclasses.field(default_factory=NodeTemplateStatus)
+
+    def validate(self) -> None:
+        if self.image_family not in IMAGE_FAMILIES:
+            raise ValidationError(
+                f"imageFamily must be one of {IMAGE_FAMILIES}, got {self.image_family!r}")
+        if self.image_family == "custom" and not self.image_selector:
+            raise ValidationError("imageFamily=custom requires imageSelector")
+        if self.launch_template_name and (
+                self.userdata or self.image_selector or self.block_device_mappings):
+            raise ValidationError(
+                "launchTemplateName is mutually exclusive with userData/"
+                "imageSelector/blockDeviceMappings")
+        if not self.launch_template_name and not self.subnet_selector:
+            raise ValidationError("subnetSelector is required")
+        for key in self.tags:
+            if any(key.startswith(p) for p in RESTRICTED_TAG_PREFIXES):
+                raise ValidationError(f"restricted tag key: {key}")
+        self.metadata_options.validate()
+        for bdm in self.block_device_mappings:
+            bdm.validate()
+
+    def set_defaults(self) -> None:
+        if not self.image_family:
+            self.image_family = "ubuntu-k8s"

@@ -1,0 +1,94 @@
+"""Global settings.
+
+Parity target: /root/reference/pkg/apis/settings/settings.go:40-93 — the
+`karpenter-global-settings` ConfigMap schema: required clusterName /
+clusterEndpoint (URL-validated), defaultInstanceProfile, vmMemoryOverheadPercent
+(default 0.075, min 0), enablePodENI, enableENILimitedPodDensity, isolatedVPC,
+interruptionQueueName, tags; plus core batching windows
+(batchIdleDuration=1s / batchMaxDuration=10s, website settings.md:43-47) and
+feature gates (driftEnabled, settings.md:73-78).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+
+class SettingsError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class FeatureGates:
+    drift_enabled: bool = False
+
+
+@dataclasses.dataclass
+class Settings:
+    cluster_name: str = ""
+    cluster_endpoint: str = ""
+    default_instance_profile: str = ""
+    vm_memory_overhead_percent: float = 0.075
+    enable_pod_eni: bool = False
+    enable_eni_limited_pod_density: bool = True
+    isolated_vpc: bool = False
+    interruption_queue_name: str = ""
+    tags: "dict[str, str]" = dataclasses.field(default_factory=dict)
+    # core provisioning batch windows (settings.md:43-47,81-99)
+    batch_idle_duration: float = 1.0
+    batch_max_duration: float = 10.0
+    feature_gates: FeatureGates = dataclasses.field(default_factory=FeatureGates)
+    # solver service endpoint; empty => in-process oracle fallback only
+    solver_endpoint: str = ""
+
+    def validate(self) -> None:
+        if not self.cluster_name:
+            raise SettingsError("clusterName is required")
+        if self.cluster_endpoint and not re.match(r"^https://", self.cluster_endpoint):
+            raise SettingsError("clusterEndpoint must be a https:// URL")
+        if self.vm_memory_overhead_percent < 0:
+            raise SettingsError("vmMemoryOverheadPercent must be >= 0")
+        if self.batch_idle_duration < 0 or self.batch_max_duration < self.batch_idle_duration:
+            raise SettingsError("batchMaxDuration must be >= batchIdleDuration >= 0")
+        for key in self.tags:
+            if key.startswith("karpenter.sh/") or key == "kubernetes.io/cluster":
+                raise SettingsError(f"restricted tag key: {key}")
+
+    @staticmethod
+    def from_dict(data: "dict[str, str]") -> "Settings":
+        """Parse the ConfigMap-style flat key space (settings.go Inject)."""
+
+        def flag(key, default=False):
+            v = data.get(key)
+            return default if v is None else str(v).lower() in ("1", "true", "yes")
+
+        def dur(key, default):
+            v = data.get(key)
+            if v is None:
+                return default
+            m = re.match(r"^([0-9.]+)(ms|s|m)?$", str(v))
+            if not m:
+                raise SettingsError(f"invalid duration for {key}: {v!r}")
+            mult = {"ms": 0.001, "s": 1.0, "m": 60.0, None: 1.0}[m.group(2)]
+            return float(m.group(1)) * mult
+
+        tags = {k[len("tags."):]: v for k, v in data.items() if k.startswith("tags.")}
+        s = Settings(
+            cluster_name=data.get("clusterName", ""),
+            cluster_endpoint=data.get("clusterEndpoint", ""),
+            default_instance_profile=data.get("defaultInstanceProfile", ""),
+            vm_memory_overhead_percent=float(data.get("vmMemoryOverheadPercent", 0.075)),
+            enable_pod_eni=flag("enablePodENI"),
+            enable_eni_limited_pod_density=flag("enableENILimitedPodDensity", True),
+            isolated_vpc=flag("isolatedVPC"),
+            interruption_queue_name=data.get("interruptionQueueName", ""),
+            tags=tags,
+            batch_idle_duration=dur("batchIdleDuration", 1.0),
+            batch_max_duration=dur("batchMaxDuration", 10.0),
+            feature_gates=FeatureGates(drift_enabled=flag("featureGates.driftEnabled")),
+            solver_endpoint=data.get("solverEndpoint", ""),
+        )
+        s.validate()
+        return s

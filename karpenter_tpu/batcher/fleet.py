@@ -1,0 +1,136 @@
+"""Concrete batchers over the fake/real cloud API.
+
+Parity targets:
+- CreateFleet batcher — /root/reference/pkg/batcher/createfleet.go:29-110:
+  merges N identical 1-capacity CreateFleet calls into one N-capacity call
+  (35ms idle / 1s max / 1000 items), splits returned instance IDs back to
+  callers, fans partial-fulfillment errors out to the unfilled tail.
+- DescribeInstances batcher — describeinstances.go:35-120: coalesces by
+  filter hash (100ms / 1s / 500), splits results per caller, per-ID retry
+  fallback when an ID is missing from the batched response.
+- TerminateInstances batcher — terminateinstances.go:34-128: one bucket
+  (100ms / 1s / 500), splits state-changes, per-ID retry for failures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..utils import errors as cloud_errors
+from ..utils.clock import Clock
+from . import Batcher, one_bucket_hasher
+from ..fake.cloud import CloudInstance, CreateFleetRequest, CreateFleetResponse
+
+
+def _fleet_hasher(req: CreateFleetRequest):
+    """Identical fleet shapes (everything except capacity) share a bucket."""
+    return (req.launch_template, tuple(req.overrides), req.capacity_type,
+            tuple(sorted(req.tags.items())), req.image_id)
+
+
+class CreateFleetBatcher:
+    def __init__(self, cloud, clock: Optional[Clock] = None,
+                 idle=0.035, max_wait=1.0, max_items=1000):
+        self.cloud = cloud
+        self._batcher: Batcher = Batcher(
+            self._exec, idle, max_wait, max_items,
+            hasher=_fleet_hasher, clock=clock, name="create-fleet")
+
+    def create_fleet(self, request: CreateFleetRequest) -> CreateFleetResponse:
+        """Callers send capacity=1 requests; one merged N-capacity call runs."""
+        return self._batcher.add(request)
+
+    def _exec(self, requests):
+        total = sum(r.capacity for r in requests)
+        merged = dataclasses.replace(requests[0], capacity=total)
+        try:
+            resp = self.cloud.create_fleet(merged)
+        except Exception as e:
+            return [e] * len(requests)
+        results = []
+        ids = list(resp.instance_ids)
+        for r in requests:
+            take, ids = ids[:r.capacity], ids[r.capacity:]
+            if len(take) == r.capacity:
+                results.append(CreateFleetResponse(instance_ids=take, errors=list(resp.errors)))
+            else:
+                # partial fulfillment: unfilled callers get the pool errors
+                # as an exception (createfleet.go error fan-out)
+                pools = [(e.instance_type, e.zone) for e in resp.errors]
+                code = resp.errors[0].code if resp.errors else "UnfulfillableCapacity"
+                results.append(cloud_errors.FleetError(code, pools, "fleet under-fulfilled"))
+        return results
+
+    def stop(self):
+        self._batcher.stop()
+
+
+class DescribeInstancesBatcher:
+    def __init__(self, cloud, clock: Optional[Clock] = None,
+                 idle=0.1, max_wait=1.0, max_items=500):
+        self.cloud = cloud
+        self._batcher: Batcher = Batcher(
+            self._exec, idle, max_wait, max_items,
+            hasher=one_bucket_hasher, clock=clock, name="describe-instances")
+
+    def describe(self, instance_id: str) -> CloudInstance:
+        return self._batcher.add(instance_id)
+
+    def _exec(self, ids):
+        try:
+            found = {i.id: i for i in self.cloud.describe_instances(list(dict.fromkeys(ids)))}
+        except Exception:
+            found = {}
+        results = []
+        for i in ids:
+            inst = found.get(i)
+            if inst is None:
+                # per-ID retry fallback (describeinstances.go:97-120)
+                try:
+                    single = self.cloud.describe_instances([i])
+                    inst = single[0] if single else None
+                except Exception as e:
+                    results.append(e)
+                    continue
+            if inst is None:
+                results.append(cloud_errors.CloudError(
+                    "InvalidInstanceID.NotFound", f"instance {i} not found"))
+            else:
+                results.append(inst)
+        return results
+
+    def stop(self):
+        self._batcher.stop()
+
+
+class TerminateInstancesBatcher:
+    def __init__(self, cloud, clock: Optional[Clock] = None,
+                 idle=0.1, max_wait=1.0, max_items=500):
+        self.cloud = cloud
+        self._batcher: Batcher = Batcher(
+            self._exec, idle, max_wait, max_items,
+            hasher=one_bucket_hasher, clock=clock, name="terminate-instances")
+
+    def terminate(self, instance_id: str) -> "tuple[str, str]":
+        return self._batcher.add(instance_id)
+
+    def _exec(self, ids):
+        unique = list(dict.fromkeys(ids))
+        changes = {}
+        try:
+            for iid, state in self.cloud.terminate_instances(unique):
+                changes[iid] = (iid, state)
+        except Exception:
+            # batch failed: per-ID retry (terminateinstances.go:53-128)
+            for i in unique:
+                try:
+                    for iid, state in self.cloud.terminate_instances([i]):
+                        changes[iid] = (iid, state)
+                except Exception as e:
+                    changes[i] = e
+        return [changes.get(i, cloud_errors.CloudError(
+            "InvalidInstanceID.NotFound", i)) for i in ids]
+
+    def stop(self):
+        self._batcher.stop()

@@ -1,0 +1,141 @@
+"""TTL caches and the unavailable-offerings (ICE) cache.
+
+Parity targets:
+- TTL constants — /root/reference/pkg/cache/cache.go:19-37 (DefaultTTL=1m,
+  UnavailableOfferingsTTL=3m, InstanceTypesAndZonesTTL=5m).
+- `UnavailableOfferings` keyed `capacityType:instanceType:zone` with an atomic
+  SeqNum bumped on writes so downstream memoization keys invalidate instantly
+  ("retry in milliseconds instead of minutes") —
+  /root/reference/pkg/cache/unavailableofferings.go:31-80.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterable, Optional
+
+from ..utils.clock import Clock
+
+DEFAULT_TTL = 60.0
+UNAVAILABLE_OFFERINGS_TTL = 180.0
+INSTANCE_TYPES_AND_ZONES_TTL = 300.0
+PRICING_REFRESH_PERIOD = 12 * 3600.0
+
+
+_MISSING = object()
+
+
+class TTLCache:
+    """Thread-safe TTL cache with injectable clock (go-cache analogue)."""
+
+    def __init__(self, ttl: float = DEFAULT_TTL, clock: Optional[Clock] = None):
+        self.ttl = ttl
+        self.clock = clock or Clock()
+        self._data: "dict[Any, tuple[float, Any]]" = {}
+        self._lock = threading.Lock()
+
+    def lookup(self, key) -> "tuple[bool, Any]":
+        """(found, value) — distinguishes a cached None from a miss."""
+        with self._lock:
+            hit = self._data.get(key, _MISSING)
+            if hit is _MISSING:
+                return False, None
+            expiry, value = hit
+            if self.clock.now() >= expiry:
+                del self._data[key]
+                return False, None
+            return True, value
+
+    def get(self, key) -> Optional[Any]:
+        return self.lookup(key)[1]
+
+    def set(self, key, value, ttl: Optional[float] = None) -> None:
+        with self._lock:
+            self._data[key] = (self.clock.now() + (ttl if ttl is not None else self.ttl), value)
+
+    def delete(self, key) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def get_or_load(self, key, loader: Callable[[], Any], ttl: Optional[float] = None):
+        found, hit = self.lookup(key)
+        if found:
+            return hit
+        value = loader()
+        self.set(key, value, ttl)
+        return value
+
+    def flush(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+    def keys(self) -> "list":
+        now = self.clock.now()
+        with self._lock:
+            return [k for k, (exp, _) in self._data.items() if now < exp]
+
+
+class UnavailableOfferings:
+    """ICE-aware offering blocklist with seqnum invalidation
+    (unavailableofferings.go:31-80)."""
+
+    def __init__(self, clock: Optional[Clock] = None, ttl: float = UNAVAILABLE_OFFERINGS_TTL):
+        self._cache = TTLCache(ttl=ttl, clock=clock)
+        self._seqnum = 0
+        self._lock = threading.Lock()
+
+    @property
+    def seqnum(self) -> int:
+        with self._lock:
+            return self._seqnum
+
+    @staticmethod
+    def _key(capacity_type: str, instance_type: str, zone: str) -> str:
+        return f"{capacity_type}:{instance_type}:{zone}"
+
+    def is_unavailable(self, capacity_type: str, instance_type: str, zone: str) -> bool:
+        return self._cache.get(self._key(capacity_type, instance_type, zone)) is not None
+
+    def mark_unavailable(self, reason: str, instance_type: str, zone: str,
+                         capacity_type: str) -> None:
+        self._cache.set(self._key(capacity_type, instance_type, zone), reason)
+        with self._lock:
+            self._seqnum += 1
+
+    def mark_unavailable_for_fleet_err(self, err, capacity_type: str) -> None:
+        """Fleet launch error -> poison every (type, zone) it names
+        (instance.go:419-425 MarkUnavailableForFleetErr)."""
+        for instance_type, zone in getattr(err, "failed_pools", []):
+            self.mark_unavailable(getattr(err, "code", "FleetError"),
+                                  instance_type, zone, capacity_type)
+
+    def delete(self, capacity_type: str, instance_type: str, zone: str) -> None:
+        self._cache.delete(self._key(capacity_type, instance_type, zone))
+        with self._lock:
+            self._seqnum += 1
+
+    def flush(self) -> None:
+        self._cache.flush()
+        with self._lock:
+            self._seqnum += 1
+
+    def apply(self, catalog_types: Iterable) -> "list":
+        """Project availability onto instance types: offerings present in this
+        cache flip available=False (createOfferings parity,
+        instancetypes.go:133-161)."""
+        import dataclasses
+
+        from ..models.instancetype import Offerings
+
+        out = []
+        for t in catalog_types:
+            offs = []
+            dirty = False
+            for o in t.offerings:
+                if o.available and self.is_unavailable(o.capacity_type, t.name, o.zone):
+                    offs.append(dataclasses.replace(o, available=False))
+                    dirty = True
+                else:
+                    offs.append(o)
+            out.append(dataclasses.replace(t, offerings=Offerings(offs)) if dirty else t)
+        return out

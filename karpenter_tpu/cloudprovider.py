@@ -1,0 +1,220 @@
+"""CloudProvider facade: the contract between the core engine and the cloud.
+
+Parity target: /root/reference/pkg/cloudprovider/cloudprovider.go — the core
+`cloudprovider.CloudProvider` interface implementation: Create (:112),
+Get (:139), GetInstanceTypes (:171), Delete (:189), IsMachineDrifted (:199),
+Hydrate (:221), LivenessProbe, machine<->instance translation
+(instanceToMachine :324-365, providerID `tpu:///<zone>/<id>`),
+resolveInstanceTypes compatibility filter (:302-321), CA-bundle / kube-DNS
+plumbed into bootstrap (:367-396).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+from .apis import wellknown as wk
+from .apis.nodetemplate import NodeTemplate
+from .apis.provisioner import Provisioner
+from .apis.settings import Settings
+from .cache import UnavailableOfferings
+from .fake.cloud import CloudInstance
+from .models.instancetype import Catalog, InstanceType
+from .models.machine import (
+    LAUNCHED, Machine, MachineStatus, make_provider_id, parse_provider_id,
+)
+from .models.requirements import Requirements
+from .providers.images import ImageProvider
+from .providers.instance import InstanceProvider
+from .providers.instancetypes import InstanceTypeProvider
+from .providers.launchtemplate import LaunchTemplateProvider
+from .providers.pricing import PricingProvider
+from .providers.securitygroup import SecurityGroupProvider
+from .providers.subnet import SubnetProvider
+from .utils import errors as cloud_errors
+
+log = logging.getLogger("karpenter.cloudprovider")
+
+
+class CloudProvider:
+    """Object tree mirrors cloudprovider.New (cloudprovider.go:76-109)."""
+
+    def __init__(self, cloud, settings: Settings, source_catalog: Catalog,
+                 clock=None):
+        self.cloud = cloud
+        self.settings = settings
+        self.ice = UnavailableOfferings(clock=clock)
+        self.subnets = SubnetProvider(cloud, clock=clock)
+        self.security_groups = SecurityGroupProvider(cloud, clock=clock)
+        static_prices = {
+            (t.name, o.capacity_type, o.zone): o.price
+            for t in source_catalog.types for o in t.offerings
+        }
+        self.pricing = PricingProvider(cloud, clock=clock,
+                                       isolated=settings.isolated_vpc,
+                                       static_prices=static_prices)
+        self.images = ImageProvider(cloud, clock=clock)
+        self.launch_templates = LaunchTemplateProvider(
+            cloud, self.images, settings, clock=clock)
+        self.instance_types = InstanceTypeProvider(
+            source_catalog, self.ice, self.subnets)
+        self.instances = InstanceProvider(
+            cloud, settings, self.launch_templates, self.subnets, self.ice)
+        self.nodetemplates: "dict[str, NodeTemplate]" = {}
+
+    # -- template resolution ---------------------------------------------------
+
+    def register_nodetemplate(self, template: NodeTemplate) -> None:
+        template.validate()
+        self.nodetemplates[template.name] = template
+
+    def resolve_nodetemplate(self, provisioner_or_machine) -> NodeTemplate:
+        """providerRef -> NodeTemplate (cloudprovider.go:113-118, 286-300)."""
+        ref = getattr(provisioner_or_machine, "provider_ref", None) or getattr(
+            getattr(provisioner_or_machine, "spec", None), "machine_template_ref", "")
+        if not ref:
+            raise cloud_errors.CloudError("NodeTemplateNotFound",
+                                          "no nodeTemplate reference")
+        template = self.nodetemplates.get(ref)
+        if template is None:
+            raise cloud_errors.CloudError("NodeTemplateNotFound", ref)
+        return template
+
+    # -- interface methods -----------------------------------------------------
+
+    def get_instance_types(self, provisioner: Optional[Provisioner]) -> "list[InstanceType]":
+        """GetInstanceTypes (cloudprovider.go:171-186)."""
+        template = None
+        if provisioner is not None and provisioner.provider_ref:
+            template = self.nodetemplates.get(provisioner.provider_ref)
+        return self.instance_types.list(template).types
+
+    def catalog_for(self, provisioner: Optional[Provisioner] = None) -> Catalog:
+        template = None
+        if provisioner is not None and provisioner.provider_ref:
+            template = self.nodetemplates.get(provisioner.provider_ref)
+        return self.instance_types.list(template)
+
+    def create(self, machine: Machine) -> Machine:
+        """Create (cloudprovider.go:112-136): resolve template + compatible
+        types, launch, translate instance -> machine status."""
+        template = self.resolve_nodetemplate(machine)
+        types = self.resolve_instance_types(machine)
+        if not types:
+            raise cloud_errors.CloudError(
+                "UnfulfillableCapacity",
+                "all requested instance types were unavailable during launch")
+        instance = self.instances.create(template, machine, types)
+        return self._instance_to_machine(machine, instance, types)
+
+    def resolve_instance_types(self, machine: Machine) -> "list[InstanceType]":
+        """reqs.Compatible ∧ offerings.Available ∧ resources.Fits filter
+        (cloudprovider.go:302-321)."""
+        catalog = self.instance_types.list(
+            self.nodetemplates.get(machine.spec.machine_template_ref))
+        reqs = machine.spec.requirements
+        vec = wk.resource_vector(machine.spec.resource_requests)
+        out = []
+        for t in catalog.filter_compatible(reqs):
+            alloc = t.allocatable_vector()
+            if all(v <= a for v, a in zip(vec, alloc)):
+                out.append(t)
+        return out
+
+    def get(self, provider_id: str) -> Machine:
+        """Get (cloudprovider.go:139-160)."""
+        _, instance_id = parse_provider_id(provider_id)
+        instance = self.instances.get_by_id(instance_id)
+        return self._bare_instance_machine(instance)
+
+    def list_machines(self) -> "list[Machine]":
+        return [self._bare_instance_machine(i)
+                for i in self.instances.list_cluster_instances()]
+
+    def delete(self, machine: Machine) -> None:
+        """Delete (cloudprovider.go:189-197)."""
+        if not machine.status.provider_id:
+            return
+        _, instance_id = parse_provider_id(machine.status.provider_id)
+        self.instances.delete(instance_id)
+
+    def is_machine_drifted(self, machine: Machine) -> bool:
+        """Drift = machine's image no longer in the template's resolved set
+        (cloudprovider.go:199-217, 255-284)."""
+        if not self.settings.feature_gates.drift_enabled:
+            return False
+        try:
+            template = self.resolve_nodetemplate(machine)
+        except cloud_errors.CloudError:
+            return False
+        if not machine.status.image_id:
+            return False
+        images = self.images.get(template, archs=("amd64", "arm64"))
+        return machine.status.image_id not in {i.image_id for i in images}
+
+    def hydrate(self, instance: CloudInstance) -> Machine:
+        """Machine backfill from a pre-existing instance
+        (cloudprovider.go:221-251 Hydrate)."""
+        m = self._bare_instance_machine(instance)
+        self.cloud.instances[instance.id].tags.setdefault(
+            "karpenter.sh/managed-by", self.settings.cluster_name)
+        return m
+
+    def livez(self) -> bool:
+        """LivenessProbe chain (cloudprovider.go:163-168)."""
+        return self.instance_types.livez() and self.pricing.livez()
+
+    def name(self) -> str:
+        return "tpu"
+
+    # -- translation -----------------------------------------------------------
+
+    def _instance_to_machine(self, machine: Machine, instance: CloudInstance,
+                             types: "list[InstanceType]") -> Machine:
+        """instanceToMachine (cloudprovider.go:324-365)."""
+        itype = next((t for t in types if t.name == instance.instance_type), None)
+        labels = dict(machine.labels)
+        if itype is not None:
+            labels.update(itype.labels_dict())
+        labels[wk.LABEL_ZONE] = instance.zone
+        labels[wk.LABEL_CAPACITY_TYPE] = instance.capacity_type
+        if machine.spec.provisioner_name:
+            labels[wk.LABEL_PROVISIONER] = machine.spec.provisioner_name
+        machine.labels = labels
+        price = self.pricing.spot_price(instance.instance_type, instance.zone) \
+            if instance.capacity_type == wk.CAPACITY_TYPE_SPOT \
+            else self.pricing.on_demand_price(instance.instance_type, instance.zone)
+        machine.status = MachineStatus(
+            provider_id=make_provider_id(instance.zone, instance.id),
+            instance_type=instance.instance_type,
+            zone=instance.zone,
+            capacity_type=instance.capacity_type,
+            image_id=instance.image_id,
+            capacity=dict(itype.capacity) if itype else {},
+            allocatable={
+                name: val for name, val in zip(
+                    wk.RESOURCE_AXIS, itype.allocatable_vector())
+                if val > 0
+            } if itype else {},
+            state=LAUNCHED,
+            price=price or 0.0,
+        )
+        return machine
+
+    def _bare_instance_machine(self, instance: CloudInstance) -> Machine:
+        from .models.machine import MachineSpec
+
+        m = Machine(
+            name=instance.tags.get("karpenter.sh/machine", instance.id),
+            spec=MachineSpec(
+                provisioner_name=instance.tags.get("karpenter.sh/provisioner-name", ""),
+            ),
+        )
+        types = {t.name: t for t in self.instance_types.list().types}
+        return self._instance_to_machine(
+            m, instance, [types[instance.instance_type]]
+            if instance.instance_type in types else [])
+
+    def stop(self):
+        self.instances.stop()

@@ -1,0 +1,66 @@
+"""Event recording with dedupe.
+
+Parity target: karpenter-core's event recorder (consumed at
+/root/reference/pkg/controllers/interruption/controller.go:141,157,183 and
+main.go wiring) — events are emitted for user-visible actions and deduplicated
+so hot loops don't spam.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+from ..utils.clock import Clock
+
+DEDUPE_TTL = 120.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    kind: str          # Normal | Warning
+    reason: str        # CamelCase machine-readable reason
+    object_ref: str    # "pod/default/inflate-0", "node/xyz", "machine/m-1"
+    message: str
+
+
+MAX_EVENTS = 10_000
+
+
+class EventRecorder:
+    def __init__(self, clock: Optional[Clock] = None, dedupe_ttl: float = DEDUPE_TTL,
+                 max_events: int = MAX_EVENTS):
+        from collections import deque
+
+        self.clock = clock or Clock()
+        self.dedupe_ttl = dedupe_ttl
+        self.events: "deque[tuple[float, Event]]" = deque(maxlen=max_events)
+        self._seen: "dict[tuple, float]" = {}
+        self._lock = threading.Lock()
+
+    def publish(self, event: Event) -> bool:
+        """Record unless an identical event fired within the dedupe window.
+        Returns True when actually recorded."""
+        key = (event.kind, event.reason, event.object_ref, event.message)
+        now = self.clock.now()
+        with self._lock:
+            last = self._seen.get(key)
+            if last is not None and now - last < self.dedupe_ttl:
+                return False
+            if len(self._seen) > 4 * MAX_EVENTS:  # bound the dedupe index too
+                cutoff = now - self.dedupe_ttl
+                self._seen = {k: t for k, t in self._seen.items() if t >= cutoff}
+            self._seen[key] = now
+            self.events.append((now, event))
+            return True
+
+    def normal(self, object_ref: str, reason: str, message: str) -> bool:
+        return self.publish(Event("Normal", reason, object_ref, message))
+
+    def warning(self, object_ref: str, reason: str, message: str) -> bool:
+        return self.publish(Event("Warning", reason, object_ref, message))
+
+    def by_reason(self, reason: str) -> "list[Event]":
+        with self._lock:
+            return [e for _, e in self.events if e.reason == reason]

@@ -1,0 +1,301 @@
+"""Stateful fake cloud backend — the hermetic test substrate AND the simulated
+provisioning API for local runs.
+
+Parity target: /root/reference/pkg/fake/ec2api.go — stateful CreateFleet
+honoring InsufficientCapacityPools (:37-41,106-120), instance store (:62-64
+sync.Maps), launch-template store, subnet/SG fixtures, plus SSM/Pricing fakes.
+API shapes are our own TPU-cloud flavor (SURVEY.md §2.3: "GCP/TPU provisioning
+APIs or simulated backend"), not EC2's wire format.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+from typing import Optional, Sequence
+
+from ..models.instancetype import Catalog
+from ..utils import errors as cloud_errors
+from ..utils.clock import Clock
+from .mocks import MockedFunction
+
+
+@dataclasses.dataclass
+class CloudInstance:
+    id: str
+    instance_type: str
+    zone: str
+    capacity_type: str
+    state: str = "running"  # pending|running|stopping|stopped|shutting-down|terminated
+    tags: "dict[str, str]" = dataclasses.field(default_factory=dict)
+    launch_time: float = 0.0
+    image_id: str = ""
+    subnet_id: str = ""
+    launch_template: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetOverride:
+    instance_type: str
+    zone: str
+    subnet_id: str = ""
+    price: float = 0.0
+
+
+@dataclasses.dataclass
+class CreateFleetRequest:
+    launch_template: str
+    overrides: "list[FleetOverride]"
+    capacity: int
+    capacity_type: str
+    tags: "dict[str, str]" = dataclasses.field(default_factory=dict)
+    image_id: str = ""
+
+
+@dataclasses.dataclass
+class FleetPoolError:
+    code: str
+    instance_type: str
+    zone: str
+
+
+@dataclasses.dataclass
+class CreateFleetResponse:
+    instance_ids: "list[str]"
+    errors: "list[FleetPoolError]" = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Subnet:
+    id: str
+    zone: str
+    free_ips: int = 1000
+    tags: "dict[str, str]" = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class SecurityGroup:
+    id: str
+    name: str
+    tags: "dict[str, str]" = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Image:
+    id: str
+    name: str
+    arch: str = "amd64"
+    created: float = 0.0
+    tags: "dict[str, str]" = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class LaunchTemplate:
+    name: str
+    image_id: str
+    userdata: str = ""
+    tags: "dict[str, str]" = dataclasses.field(default_factory=dict)
+
+
+class FakeCloud:
+    """In-memory cloud. `Reset()` between tests (ec2api.go:76-104 discipline)."""
+
+    def __init__(self, catalog: Optional[Catalog] = None, clock: Optional[Clock] = None):
+        self.clock = clock or Clock()
+        self.catalog = catalog
+        self.lock = threading.RLock()
+        self.instances: "dict[str, CloudInstance]" = {}
+        self.launch_templates: "dict[str, LaunchTemplate]" = {}
+        self.subnets: "list[Subnet]" = [
+            Subnet(id=f"subnet-{z}", zone=z, free_ips=1000 - 10 * i)
+            for i, z in enumerate(("zone-1a", "zone-1b", "zone-1c"))
+        ]
+        self.security_groups: "list[SecurityGroup]" = [
+            SecurityGroup(id="sg-default", name="default",
+                          tags={"kubernetes.io/cluster/test-cluster": "owned"}),
+        ]
+        self.images: "list[Image]" = [
+            Image(id="img-amd64-1", name="node-image-amd64-v1", arch="amd64", created=1.0),
+            Image(id="img-amd64-2", name="node-image-amd64-v2", arch="amd64", created=2.0),
+            Image(id="img-arm64-1", name="node-image-arm64-v1", arch="arm64", created=1.0),
+        ]
+        self.ssm_parameters: "dict[str, str]" = {
+            "/karpenter-tpu/images/default/amd64/latest": "img-amd64-2",
+            "/karpenter-tpu/images/default/arm64/latest": "img-arm64-1",
+        }
+        # (capacity_type, instance_type, zone) triples that synthesize ICE
+        self.insufficient_capacity_pools: "set[tuple[str, str, str]]" = set()
+        self._id_counter = itertools.count(1)
+
+        self.create_fleet_api: MockedFunction = MockedFunction(
+            "CreateFleet", self._create_fleet)
+        self.describe_instances_api: MockedFunction = MockedFunction(
+            "DescribeInstances", self._describe_instances)
+        self.terminate_instances_api: MockedFunction = MockedFunction(
+            "TerminateInstances", self._terminate_instances)
+
+    # -- fleet ---------------------------------------------------------------
+
+    def create_fleet(self, request: CreateFleetRequest) -> CreateFleetResponse:
+        return self.create_fleet_api.invoke(request)
+
+    def _create_fleet(self, request: CreateFleetRequest) -> CreateFleetResponse:
+        with self.lock:
+            if request.launch_template and request.launch_template not in self.launch_templates:
+                raise cloud_errors.CloudError(
+                    cloud_errors.LAUNCH_TEMPLATE_NOT_FOUND,
+                    f"launch template {request.launch_template} not found")
+            # lowest-price allocation across overrides, skipping ICE pools
+            # (EC2 CreateFleet lowest-price / fake ec2api.go:106-120)
+            errors: "list[FleetPoolError]" = []
+            usable: "list[FleetOverride]" = []
+            for o in sorted(request.overrides, key=lambda o: (o.price, o.instance_type, o.zone)):
+                if (request.capacity_type, o.instance_type, o.zone) in self.insufficient_capacity_pools:
+                    errors.append(FleetPoolError(
+                        "InsufficientInstanceCapacity", o.instance_type, o.zone))
+                    continue
+                usable.append(o)
+            ids = []
+            if usable:
+                choice = usable[0]
+                for _ in range(request.capacity):
+                    iid = f"i-{next(self._id_counter):08d}"
+                    lt = self.launch_templates.get(request.launch_template)
+                    self.instances[iid] = CloudInstance(
+                        id=iid,
+                        instance_type=choice.instance_type,
+                        zone=choice.zone,
+                        capacity_type=request.capacity_type,
+                        state="pending",
+                        tags=dict(request.tags),
+                        launch_time=self.clock.now(),
+                        image_id=request.image_id or (lt.image_id if lt else ""),
+                        subnet_id=choice.subnet_id,
+                        launch_template=request.launch_template,
+                    )
+                    ids.append(iid)
+            return CreateFleetResponse(instance_ids=ids, errors=errors)
+
+    # -- instances -----------------------------------------------------------
+
+    def describe_instances(self, ids: Sequence[str]) -> "list[CloudInstance]":
+        return self.describe_instances_api.invoke(tuple(ids))
+
+    def _describe_instances(self, ids) -> "list[CloudInstance]":
+        with self.lock:
+            out = []
+            for i in ids:
+                inst = self.instances.get(i)
+                if inst is not None and inst.state != "terminated":
+                    # instances become visible-running on the 2nd describe
+                    # (eventual consistency analogue, instance.go:98-107)
+                    if inst.state == "pending":
+                        inst.state = "running"
+                    out.append(dataclasses.replace(inst, tags=dict(inst.tags)))
+            return out
+
+    def create_tags(self, instance_id: str, tags: "dict[str, str]") -> None:
+        with self.lock:
+            inst = self.instances.get(instance_id)
+            if inst is None:
+                raise cloud_errors.CloudError(
+                    "InvalidInstanceID.NotFound", instance_id)
+            inst.tags.update(tags)
+
+    def describe_instances_by_tag(self, key: str, value: str) -> "list[CloudInstance]":
+        with self.lock:
+            return [dataclasses.replace(i, tags=dict(i.tags))
+                    for i in self.instances.values()
+                    if i.tags.get(key) == value and i.state != "terminated"]
+
+    def terminate_instances(self, ids: Sequence[str]) -> "list[tuple[str, str]]":
+        return self.terminate_instances_api.invoke(tuple(ids))
+
+    def _terminate_instances(self, ids) -> "list[tuple[str, str]]":
+        with self.lock:
+            out = []
+            for i in ids:
+                inst = self.instances.get(i)
+                if inst is None:
+                    raise cloud_errors.CloudError(
+                        "InvalidInstanceID.NotFound", f"instance {i} not found")
+                inst.state = "terminated"
+                out.append((i, "terminated"))
+            return out
+
+    # -- launch templates ----------------------------------------------------
+
+    def create_launch_template(self, lt: LaunchTemplate) -> None:
+        with self.lock:
+            self.launch_templates[lt.name] = lt
+
+    def describe_launch_templates(self, tag_key: str = "", tag_value: str = "") -> "list[LaunchTemplate]":
+        with self.lock:
+            return [lt for lt in self.launch_templates.values()
+                    if not tag_key or lt.tags.get(tag_key) == tag_value]
+
+    def delete_launch_template(self, name: str) -> None:
+        with self.lock:
+            if name not in self.launch_templates:
+                raise cloud_errors.CloudError(
+                    cloud_errors.LAUNCH_TEMPLATE_NOT_FOUND, name)
+            del self.launch_templates[name]
+
+    # -- discovery -----------------------------------------------------------
+
+    def describe_subnets(self, selector: "dict[str, str]") -> "list[Subnet]":
+        with self.lock:
+            return [s for s in self.subnets if _match_selector(s.tags, s.id, selector)]
+
+    def describe_security_groups(self, selector: "dict[str, str]") -> "list[SecurityGroup]":
+        with self.lock:
+            return [g for g in self.security_groups
+                    if _match_selector(g.tags, g.id, selector)]
+
+    def describe_images(self, selector: "dict[str, str]") -> "list[Image]":
+        with self.lock:
+            return [im for im in self.images if _match_selector(im.tags, im.id, selector)]
+
+    def get_ssm_parameter(self, name: str) -> str:
+        with self.lock:
+            if name not in self.ssm_parameters:
+                raise cloud_errors.CloudError("ResourceNotFound", name)
+            return self.ssm_parameters[name]
+
+    def get_prices(self) -> "dict[tuple[str, str, str], float]":
+        """(instance_type, capacity_type, zone) -> $/h from the catalog."""
+        out = {}
+        if self.catalog is None:
+            return out
+        for t in self.catalog.types:
+            for o in t.offerings:
+                out[(t.name, o.capacity_type, o.zone)] = o.price
+        return out
+
+    def reset(self) -> None:
+        with self.lock:
+            self.instances.clear()
+            self.launch_templates.clear()
+            self.insufficient_capacity_pools.clear()
+            for api in (self.create_fleet_api, self.describe_instances_api,
+                        self.terminate_instances_api):
+                api.reset()
+
+
+def _match_selector(tags: "dict[str, str]", obj_id: str, selector: "dict[str, str]") -> bool:
+    """Tag/id selector semantics (subnet.go:87 getFilters): key 'id' matches
+    the object id (comma-separated list ok), '*' values are wildcards."""
+    if not selector:
+        return False
+    for k, v in selector.items():
+        if k == "id":
+            if obj_id not in [x.strip() for x in v.split(",")]:
+                return False
+        elif v == "*":
+            if k not in tags:
+                return False
+        else:
+            if tags.get(k) not in [x.strip() for x in v.split(",")]:
+                return False
+    return True

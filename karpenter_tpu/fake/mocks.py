@@ -1,0 +1,66 @@
+"""Behavior-injection primitives for the fake cloud.
+
+Parity target: /root/reference/pkg/fake/types.go:21-76 — `MockedFunction[I,O]`
+(override output, default output, call counting) and `AtomicError` (one-shot
+or N-times error injection) used by every fake API.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, Optional, TypeVar
+
+I = TypeVar("I")
+O = TypeVar("O")
+
+
+class AtomicError:
+    """Error served up to `times` calls (fake/atomic.go:80-106)."""
+
+    def __init__(self, err: Exception, times: int = 1):
+        self.err = err
+        self.times = times
+        self._calls = 0
+        self._lock = threading.Lock()
+
+    def get(self) -> Optional[Exception]:
+        with self._lock:
+            if self._calls >= self.times:
+                return None
+            self._calls += 1
+            return self.err
+
+
+class MockedFunction(Generic[I, O]):
+    def __init__(self, name: str, default_fn: Callable[[I], O]):
+        self.name = name
+        self.default_fn = default_fn
+        self.output: Optional[O] = None
+        self.error: Optional[AtomicError] = None
+        self.calls: "list[I]" = []
+        self._lock = threading.Lock()
+
+    @property
+    def called_with_count(self) -> int:
+        with self._lock:
+            return len(self.calls)
+
+    def set_error(self, err: Exception, times: int = 1) -> None:
+        self.error = AtomicError(err, times)
+
+    def invoke(self, request: I) -> O:
+        with self._lock:
+            self.calls.append(request)
+        if self.error is not None:
+            err = self.error.get()
+            if err is not None:
+                raise err
+        if self.output is not None:
+            return self.output
+        return self.default_fn(request)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.calls.clear()
+        self.output = None
+        self.error = None

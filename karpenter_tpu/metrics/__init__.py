@@ -1,0 +1,198 @@
+"""Prometheus-style metrics registry (no external deps).
+
+Parity target: the reference's metric families (SURVEY.md §5.5 /
+website metrics.md:13-92): karpenter_cloudprovider_duration_seconds,
+karpenter_provisioner_*, karpenter_nodes_*, karpenter_pods_*,
+karpenter_interruption_*, scheduling/deprovisioning duration histograms —
+plus the cloudprovider duration decorator (`metrics.Decorate`, main.go:46).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+NAMESPACE = "karpenter"
+
+DURATION_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: "tuple[str, ...]"):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._lock = threading.Lock()
+
+    def _label_key(self, labels: "dict[str, str]") -> tuple:
+        return tuple(labels.get(k, "") for k in self.label_names)
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_="", label_names=()):
+        super().__init__(name, help_, tuple(label_names))
+        self._values: "dict[tuple, float]" = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._label_key(labels), 0.0)
+
+    def collect(self):
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                yield dict(zip(self.label_names, key)), v
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._label_key(labels)] = value
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help_="", label_names=(), buckets=DURATION_BUCKETS):
+        super().__init__(name, help_, tuple(label_names))
+        self.buckets = tuple(buckets)
+        self._counts: "dict[tuple, list[int]]" = {}
+        self._sums: "dict[tuple, float]" = {}
+        self._totals: "dict[tuple, int]" = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._label_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            return self._totals.get(self._label_key(labels), 0)
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            return self._sums.get(self._label_key(labels), 0.0)
+
+    def percentile(self, q: float, **labels) -> Optional[float]:
+        key = self._label_key(labels)
+        with self._lock:
+            counts = self._counts.get(key)
+            total = self._totals.get(key, 0)
+        if not counts or not total:
+            return None
+        target = q * total
+        for i, c in enumerate(counts):
+            if c >= target:
+                return self.buckets[i]
+        return float("inf")
+
+    def time(self, **labels):
+        return _Timer(self, labels)
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0, **self.labels)
+        return False
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: "dict[str, _Metric]" = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name, help_="", label_names=()) -> Counter:
+        return self._register(name, lambda: Counter(name, help_, label_names))
+
+    def gauge(self, name, help_="", label_names=()) -> Gauge:
+        return self._register(name, lambda: Gauge(name, help_, label_names))
+
+    def histogram(self, name, help_="", label_names=(), buckets=DURATION_BUCKETS) -> Histogram:
+        return self._register(name, lambda: Histogram(name, help_, label_names, buckets))
+
+    def _register(self, name, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = factory()
+            return m
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        lines = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            if isinstance(m, Histogram):
+                lines.append(f"# TYPE {m.name} histogram")
+                with m._lock:
+                    for key, counts in sorted(m._counts.items()):
+                        labels = dict(zip(m.label_names, key))
+                        for b, c in zip(m.buckets, counts):
+                            lab = ",".join(f'{k}="{v}"' for k, v in {**labels, "le": b}.items())
+                            lines.append(f"{m.name}_bucket{{{lab}}} {c}")
+                        # mandatory +Inf bucket == total observation count
+                        lab = ",".join(f'{k}="{v}"' for k, v in {**labels, "le": "+Inf"}.items())
+                        lines.append(f"{m.name}_bucket{{{lab}}} {m._totals[key]}")
+                        lab = ",".join(f'{k}="{v}"' for k, v in labels.items())
+                        sep = f"{{{lab}}}" if lab else ""
+                        lines.append(f"{m.name}_sum{sep} {m._sums[key]}")
+                        lines.append(f"{m.name}_count{sep} {m._totals[key]}")
+            else:
+                kind = "gauge" if isinstance(m, Gauge) else "counter"
+                lines.append(f"# TYPE {m.name} {kind}")
+                for labels, v in m.collect():
+                    lab = ",".join(f'{k}="{v2}"' for k, v2 in labels.items())
+                    sep = f"{{{lab}}}" if lab else ""
+                    lines.append(f"{m.name}{sep} {v}")
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+
+def decorate_cloudprovider(cp, registry: Optional[Registry] = None):
+    """Wrap every public CloudProvider method with a duration histogram
+    (core `metrics.Decorate`, main.go:46 ->
+    karpenter_cloudprovider_duration_seconds)."""
+    reg = registry or REGISTRY
+    hist = reg.histogram(
+        f"{NAMESPACE}_cloudprovider_duration_seconds",
+        "Duration of cloud provider method calls.",
+        ("controller", "method"),
+    )
+
+    class _Decorated:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            attr = getattr(self._inner, name)
+            if not callable(attr) or name.startswith("_"):
+                return attr
+
+            def wrapped(*args, **kwargs):
+                with hist.time(controller="cloudprovider", method=name):
+                    return attr(*args, **kwargs)
+
+            return wrapped
+
+    return _Decorated(cp)

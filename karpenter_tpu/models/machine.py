@@ -1,0 +1,79 @@
+"""Machine: the capacity-request object.
+
+Parity target: `v1alpha5.Machine` — Spec{Requirements, Resources, Kubelet,
+Taints, StartupTaints, MachineTemplateRef} / Status{ProviderID, Capacity,
+Allocatable} consumed at /root/reference/pkg/cloudprovider/cloudprovider.go:
+112-136 (Create) and 324-365 (instanceToMachine), plus the core machine
+lifecycle (create -> launch -> registration -> initialization).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..apis import wellknown as wk
+from .pod import Taint
+from .requirements import Requirements
+
+# lifecycle states (core machine lifecycle, SURVEY.md §2.2)
+PENDING = "Pending"
+LAUNCHED = "Launched"
+REGISTERED = "Registered"
+INITIALIZED = "Initialized"
+TERMINATING = "Terminating"
+
+
+@dataclasses.dataclass
+class MachineSpec:
+    requirements: Requirements = dataclasses.field(default_factory=Requirements)
+    resource_requests: "dict[str, int]" = dataclasses.field(default_factory=dict)
+    taints: "tuple[Taint, ...]" = ()
+    startup_taints: "tuple[Taint, ...]" = ()
+    machine_template_ref: str = ""  # NodeTemplate name
+    provisioner_name: str = ""
+    kubelet_max_pods: Optional[int] = None
+
+
+@dataclasses.dataclass
+class MachineStatus:
+    provider_id: str = ""
+    instance_type: str = ""
+    zone: str = ""
+    capacity_type: str = ""
+    image_id: str = ""
+    capacity: "dict[str, int]" = dataclasses.field(default_factory=dict)
+    allocatable: "dict[str, int]" = dataclasses.field(default_factory=dict)
+    state: str = PENDING
+    node_name: str = ""
+    price: float = 0.0
+
+
+@dataclasses.dataclass
+class Machine:
+    name: str
+    spec: MachineSpec = dataclasses.field(default_factory=MachineSpec)
+    status: MachineStatus = dataclasses.field(default_factory=MachineStatus)
+    labels: "dict[str, str]" = dataclasses.field(default_factory=dict)
+    annotations: "dict[str, str]" = dataclasses.field(default_factory=dict)
+    deleted: bool = False
+
+    def launched(self) -> bool:
+        return self.status.state in (LAUNCHED, REGISTERED, INITIALIZED)
+
+
+def parse_provider_id(provider_id: str) -> "tuple[str, str]":
+    """'tpu:///<zone>/<instance-id>' -> (zone, id)
+    (reference: `aws:///<az>/<id>` regex parse, pkg/utils/utils.go:21-39)."""
+    prefix = "tpu:///"
+    if not provider_id.startswith(prefix):
+        raise ValueError(f"invalid provider id {provider_id!r}")
+    rest = provider_id[len(prefix):]
+    zone, _, iid = rest.partition("/")
+    if not zone or not iid:
+        raise ValueError(f"invalid provider id {provider_id!r}")
+    return zone, iid
+
+
+def make_provider_id(zone: str, instance_id: str) -> str:
+    return f"tpu:///{zone}/{instance_id}"

@@ -1,0 +1,177 @@
+"""Node image discovery + per-family bootstrap generation.
+
+Parity targets:
+- AMIProvider — /root/reference/pkg/cloudprovider/amifamily/ami.go: selector
+  tag/id filters -> DescribeImages (:158-213), newest-first arch-compatible
+  selection (:109-122), default image via SSM parameter per family (:135-149).
+- AMIFamily strategy interface — amifamily/resolver.go:72-87 (per-OS-family
+  userdata, block devices, feature flags) with concrete families al2 /
+  bottlerocket / custom -> here: ubuntu-k8s (shell bootstrap), flatboat
+  (TOML settings, the Bottlerocket analogue), custom (raw passthrough).
+- Bootstrap generators — amifamily/bootstrap/: kubelet flags, taint
+  registration, MIME-multipart merge with user-supplied userdata
+  (eksbootstrap.go:52-117,160-224).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from ..apis.nodetemplate import NodeTemplate
+from ..cache import TTLCache
+from ..models.pod import Taint
+from ..utils.clock import Clock
+
+IMAGE_CACHE_TTL = 300.0
+
+
+@dataclasses.dataclass
+class ResolvedImage:
+    image_id: str
+    arch: str
+
+
+@dataclasses.dataclass
+class BootstrapConfig:
+    cluster_name: str
+    cluster_endpoint: str
+    ca_bundle: str = ""
+    dns_ip: str = ""
+    labels: "dict[str, str]" = dataclasses.field(default_factory=dict)
+    taints: "tuple[Taint, ...]" = ()
+    max_pods: Optional[int] = None
+    custom_userdata: str = ""
+
+
+class ImageFamily:
+    """Strategy per image family (AMIFamily iface, resolver.go:72-79)."""
+
+    name = "base"
+
+    def default_image_parameter(self, arch: str) -> str:
+        return f"/karpenter-tpu/images/default/{arch}/latest"
+
+    def userdata(self, cfg: BootstrapConfig) -> str:
+        raise NotImplementedError
+
+
+class UbuntuK8s(ImageFamily):
+    """Shell bootstrap family (EKS AL2 bootstrap.sh analogue)."""
+
+    name = "ubuntu-k8s"
+
+    def userdata(self, cfg: BootstrapConfig) -> str:
+        flags = [f"--node-labels={','.join(f'{k}={v}' for k, v in sorted(cfg.labels.items()))}"]
+        if cfg.taints:
+            taints = ",".join(f"{t.key}={t.value}:{t.effect}" for t in cfg.taints)
+            flags.append(f"--register-with-taints={taints}")
+        if cfg.max_pods is not None:
+            flags.append(f"--max-pods={cfg.max_pods}")
+        script = "\n".join([
+            "#!/bin/bash -xe",
+            f"/etc/node/bootstrap.sh '{cfg.cluster_name}' \\",
+            f"  --apiserver-endpoint '{cfg.cluster_endpoint}' \\",
+            f"  --b64-cluster-ca '{cfg.ca_bundle}' \\",
+            f"  --dns-cluster-ip '{cfg.dns_ip}' \\",
+            f"  --kubelet-extra-args '{' '.join(flags)}'",
+        ])
+        if cfg.custom_userdata:
+            # MIME multipart merge: custom part first, bootstrap last
+            # (eksbootstrap.go:160-224 merge semantics)
+            boundary = "//KARPENTER-TPU-BOUNDARY//"
+            return "\n".join([
+                'MIME-Version: 1.0',
+                f'Content-Type: multipart/mixed; boundary="{boundary}"',
+                "",
+                f"--{boundary}",
+                'Content-Type: text/x-shellscript; charset="us-ascii"',
+                "",
+                cfg.custom_userdata,
+                f"--{boundary}",
+                'Content-Type: text/x-shellscript; charset="us-ascii"',
+                "",
+                script,
+                f"--{boundary}--",
+            ])
+        return script
+
+
+class Flatboat(ImageFamily):
+    """TOML-settings family (Bottlerocket analogue, bottlerocketsettings.go)."""
+
+    name = "flatboat"
+
+    def userdata(self, cfg: BootstrapConfig) -> str:
+        lines = [
+            "[settings.kubernetes]",
+            f'cluster-name = "{cfg.cluster_name}"',
+            f'api-server = "{cfg.cluster_endpoint}"',
+        ]
+        if cfg.ca_bundle:
+            lines.append(f'cluster-certificate = "{cfg.ca_bundle}"')
+        if cfg.dns_ip:
+            lines.append(f'cluster-dns-ip = "{cfg.dns_ip}"')
+        if cfg.max_pods is not None:
+            lines.append(f"max-pods = {cfg.max_pods}")
+        if cfg.labels:
+            lines.append("[settings.kubernetes.node-labels]")
+            lines += [f'"{k}" = "{v}"' for k, v in sorted(cfg.labels.items())]
+        if cfg.taints:
+            lines.append("[settings.kubernetes.node-taints]")
+            lines += [f'"{t.key}" = "{t.value}:{t.effect}"' for t in cfg.taints]
+        base = "\n".join(lines)
+        if cfg.custom_userdata:
+            # custom TOML is merged after ours (later keys win)
+            return base + "\n" + cfg.custom_userdata
+        return base
+
+
+class Custom(ImageFamily):
+    """Raw userdata passthrough (amifamily/custom.go)."""
+
+    name = "custom"
+
+    def userdata(self, cfg: BootstrapConfig) -> str:
+        return cfg.custom_userdata
+
+
+FAMILIES = {f.name: f for f in (UbuntuK8s(), Flatboat(), Custom())}
+
+
+def get_family(name: str) -> ImageFamily:
+    """GetAMIFamily with default fallback (resolver.go:143-154)."""
+    return FAMILIES.get(name, FAMILIES["ubuntu-k8s"])
+
+
+class ImageProvider:
+    def __init__(self, cloud, clock: Optional[Clock] = None):
+        self.cloud = cloud
+        self.cache = TTLCache(ttl=IMAGE_CACHE_TTL, clock=clock)
+
+    def get(self, template: NodeTemplate, archs: Sequence[str]) -> "list[ResolvedImage]":
+        """Resolve images for a NodeTemplate: selector-based discovery
+        (newest first per arch) or the family's default SSM alias."""
+        key = (template.name, template.generation, tuple(archs))
+        hit = self.cache.get(key)
+        if hit is not None:
+            return hit
+        out: "list[ResolvedImage]" = []
+        if template.image_selector:
+            images = self.cloud.describe_images(template.image_selector)
+            for arch in archs:
+                compat = sorted((i for i in images if i.arch == arch),
+                                key=lambda i: -i.created)  # newest first (:109-122)
+                if compat:
+                    out.append(ResolvedImage(image_id=compat[0].id, arch=arch))
+        else:
+            family = get_family(template.image_family)
+            for arch in archs:
+                try:
+                    image_id = self.cloud.get_ssm_parameter(
+                        family.default_image_parameter(arch))
+                except Exception:
+                    continue
+                out.append(ResolvedImage(image_id=image_id, arch=arch))
+        self.cache.set(key, out)
+        return out

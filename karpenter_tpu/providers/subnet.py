@@ -1,0 +1,50 @@
+"""Subnet provider.
+
+Parity target: /root/reference/pkg/providers/subnet/subnet.go — List by
+tag/id selectors with wildcard support (:57, getFilters :87), 1-minute cache,
+change-monitor logging suppression.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..cache import DEFAULT_TTL, TTLCache
+from ..utils.clock import Clock
+
+log = logging.getLogger("karpenter.subnet")
+
+
+class SubnetProvider:
+    def __init__(self, cloud, clock: Optional[Clock] = None):
+        self.cloud = cloud
+        self.cache = TTLCache(ttl=DEFAULT_TTL, clock=clock)
+        self._last_logged: "dict[str, tuple]" = {}
+
+    def list(self, selector: "dict[str, str]") -> list:
+        key = tuple(sorted(selector.items()))
+        hit = self.cache.get(key)
+        if hit is not None:
+            return hit
+        subnets = self.cloud.describe_subnets(selector)
+        self.cache.set(key, subnets)
+        sig = tuple(sorted(s.id for s in subnets))
+        if self._last_logged.get("subnets") != sig:  # ChangeMonitor dedupe (§5.1)
+            self._last_logged["subnets"] = sig
+            log.info("discovered subnets: %s", [f"{s.id}/{s.zone}" for s in subnets])
+        return subnets
+
+    def zones(self, selector: "dict[str, str]") -> "list[str]":
+        return sorted({s.zone for s in self.list(selector)})
+
+    def zonal_subnet_with_most_ips(self, selector: "dict[str, str]", zone: str):
+        """Pick the zone's subnet with the most free IPs
+        (instance.go:326-333 getOverrides)."""
+        best = None
+        for s in self.list(selector):
+            if s.zone != zone:
+                continue
+            if best is None or s.free_ips > best.free_ips:
+                best = s
+        return best

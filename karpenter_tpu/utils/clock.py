@@ -1,0 +1,38 @@
+"""Injectable clock (reference analogue: k8s clock + clock/testing fakeClock,
+used for TTL/cache time travel at pkg/cloudprovider/suite_test.go:94)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Clock:
+    def now(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+
+class FakeClock(Clock):
+    """Manually stepped clock; wakes sleepers when stepped past their deadline."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = start
+        self._cond = threading.Condition()
+
+    def now(self) -> float:
+        with self._cond:
+            return self._now
+
+    def sleep(self, seconds: float) -> None:
+        deadline = self.now() + seconds
+        with self._cond:
+            while self._now < deadline:
+                self._cond.wait(timeout=0.05)
+
+    def step(self, seconds: float) -> None:
+        with self._cond:
+            self._now += seconds
+            self._cond.notify_all()

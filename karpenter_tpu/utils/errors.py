@@ -1,0 +1,63 @@
+"""Cloud error taxonomy.
+
+Parity target: /root/reference/pkg/errors/errors.go — notFound code set
+(:29-37), unfulfillable-capacity (ICE) code set (:38-46:
+InsufficientInstanceCapacity, MaxSpotInstanceCountExceeded, VcpuLimitExceeded,
+UnfulfillableCapacity, Unsupported), IsNotFound:52, IsUnfulfillableCapacity:66,
+IsLaunchTemplateNotFound:70.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+NOT_FOUND_CODES = frozenset({
+    "InstanceNotFound", "InvalidInstanceID.NotFound", "QueueDoesNotExist",
+    "NodeTemplateNotFound", "ResourceNotFound",
+})
+
+UNFULFILLABLE_CAPACITY_CODES = frozenset({
+    "InsufficientInstanceCapacity", "MaxSpotInstanceCountExceeded",
+    "VcpuLimitExceeded", "UnfulfillableCapacity", "Unsupported",
+    "InsufficientAcceleratorCapacity",
+})
+
+LAUNCH_TEMPLATE_NOT_FOUND = "InvalidLaunchTemplateName.NotFoundException"
+
+
+class CloudError(Exception):
+    def __init__(self, code: str, message: str = ""):
+        super().__init__(f"{code}: {message}" if message else code)
+        self.code = code
+        self.message = message
+
+
+class FleetError(CloudError):
+    """CreateFleet per-pool failure: carries the (instanceType, zone) pools
+    that failed so the ICE cache can poison them (instance.go:419-425)."""
+
+    def __init__(self, code: str, failed_pools: "list[tuple[str, str]]", message: str = ""):
+        super().__init__(code, message)
+        self.failed_pools = failed_pools
+
+
+def code_of(err: Exception) -> Optional[str]:
+    return getattr(err, "code", None)
+
+
+def is_not_found(err: Exception) -> bool:
+    return code_of(err) in NOT_FOUND_CODES
+
+
+def is_unfulfillable_capacity(err: Exception) -> bool:
+    return code_of(err) in UNFULFILLABLE_CAPACITY_CODES
+
+
+def is_launch_template_not_found(err: Exception) -> bool:
+    return code_of(err) == LAUNCH_TEMPLATE_NOT_FOUND
+
+
+def ignore_not_found(err: Optional[Exception]) -> Optional[Exception]:
+    if err is not None and is_not_found(err):
+        return None
+    return err

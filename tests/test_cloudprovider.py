@@ -1,0 +1,237 @@
+"""Provider layer + CloudProvider facade (reference cloudprovider suite
+analogue, pkg/cloudprovider/suite_test.go pattern: fake backends, full
+create path)."""
+
+import pytest
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.nodetemplate import NodeTemplate
+from karpenter_tpu.apis.provisioner import Provisioner, ValidationError
+from karpenter_tpu.apis.settings import Settings
+from karpenter_tpu.cloudprovider import CloudProvider
+from karpenter_tpu.fake.cloud import FakeCloud
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.machine import Machine, MachineSpec, parse_provider_id
+from karpenter_tpu.models.requirements import Requirements, OP_IN
+from karpenter_tpu.providers.images import BootstrapConfig, get_family
+from karpenter_tpu.utils import errors as cloud_errors
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def catalog():
+    return Catalog(types=[
+        make_instance_type("small.2x", cpu=2, memory="8Gi", od_price=0.10, spot_price=0.03),
+        make_instance_type("medium.4x", cpu=4, memory="16Gi", od_price=0.20, spot_price=0.06),
+        make_instance_type("gpu.8x", cpu=8, memory="64Gi", od_price=2.50,
+                           extended={wk.RESOURCE_NVIDIA_GPU: 4}),
+        make_instance_type("badspot.4x", cpu=4, memory="16Gi", od_price=0.50,
+                           spot_price=0.45),  # spot above cheapest OD
+    ])
+
+
+@pytest.fixture
+def cp():
+    clock = FakeClock()
+    cloud = FakeCloud(catalog=catalog(), clock=clock)
+    settings = Settings(cluster_name="test-cluster",
+                        cluster_endpoint="https://example.test")
+    provider = CloudProvider(cloud, settings, catalog(), clock=clock)
+    provider.register_nodetemplate(NodeTemplate(
+        name="default", subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"}))
+    yield provider
+    provider.stop()
+
+
+def machine(name="m-1", cpu=1000, reqs=None, template="default", extended=None):
+    r = Requirements.of((wk.LABEL_CAPACITY_TYPE, OP_IN, ["on-demand"]),
+                        (wk.LABEL_ARCH, OP_IN, ["amd64"]))
+    if reqs:
+        r = r.union(reqs)
+    requests = {wk.RESOURCE_CPU: cpu, wk.RESOURCE_PODS: 1}
+    requests.update(extended or {})
+    return Machine(name=name, spec=MachineSpec(
+        requirements=r, resource_requests=requests,
+        machine_template_ref=template, provisioner_name="default"))
+
+
+class TestCreate:
+    def test_launches_cheapest_compatible(self, cp):
+        m = cp.create(machine())
+        assert m.status.instance_type == "small.2x"
+        assert m.status.state == "Launched"
+        zone, iid = parse_provider_id(m.status.provider_id)
+        assert zone.startswith("zone-1")
+        assert cp.cloud.instances[iid].tags["karpenter.sh/machine"] == "m-1"
+        assert m.status.price == pytest.approx(0.10)
+        assert m.labels[wk.LABEL_INSTANCE_TYPE] == "small.2x"
+
+    def test_gpu_requires_request(self, cp):
+        # exotic filter: GPU type dropped without a GPU request
+        m = cp.create(machine(cpu=3000))
+        assert m.status.instance_type != "gpu.8x"
+        g = cp.create(machine(name="m-g", cpu=1000,
+                              extended={wk.RESOURCE_NVIDIA_GPU: 1}))
+        assert g.status.instance_type == "gpu.8x"
+
+    def test_ice_feedback_and_seqnum_retry(self, cp):
+        cp.cloud.insufficient_capacity_pools = {
+            ("on-demand", "small.2x", z) for z in ("zone-1a", "zone-1b", "zone-1c")}
+        s0 = cp.ice.seqnum
+        m = cp.create(machine())
+        # fleet fell back to another pool, but small.2x pools are ICE-marked
+        # only when the fleet reports them; lowest-price pick lands on a
+        # usable pool without error here -> just assert it launched
+        assert m.status.provider_id
+        assert cp.ice.seqnum >= s0
+
+    def test_unschedulable_when_nothing_fits(self, cp):
+        with pytest.raises(cloud_errors.CloudError):
+            cp.create(machine(cpu=64_000))
+
+    def test_launch_creates_launch_template(self, cp):
+        cp.create(machine())
+        lts = cp.cloud.describe_launch_templates(
+            "karpenter.k8s.tpu/cluster", "test-cluster")
+        assert len(lts) == 1
+        assert lts[0].name.startswith("Karpenter-test-cluster-")
+        assert "bootstrap.sh" in lts[0].userdata
+
+    def test_missing_template_raises(self, cp):
+        with pytest.raises(cloud_errors.CloudError) as ei:
+            cp.create(machine(template="nope"))
+        assert cloud_errors.is_not_found(ei.value)
+
+
+class TestGetDelete:
+    def test_get_roundtrip(self, cp):
+        m = cp.create(machine())
+        got = cp.get(m.status.provider_id)
+        assert got.status.instance_type == m.status.instance_type
+        assert got.name == "m-1"
+
+    def test_delete_idempotent(self, cp):
+        m = cp.create(machine())
+        cp.delete(m)
+        _, iid = parse_provider_id(m.status.provider_id)
+        assert cp.cloud.instances[iid].state == "terminated"
+        cp.delete(m)  # second delete: not-found swallowed
+
+    def test_list_cluster_machines(self, cp):
+        cp.create(machine(name="m-a"))
+        cp.create(machine(name="m-b"))
+        names = sorted(m.name for m in cp.list_machines())
+        assert names == ["m-a", "m-b"]
+
+
+class TestDrift:
+    def test_drift_detection(self, cp):
+        cp.settings.feature_gates.drift_enabled = True
+        m = cp.create(machine())
+        assert not cp.is_machine_drifted(m)
+        # new default image published -> old machines drift
+        cp.cloud.ssm_parameters["/karpenter-tpu/images/default/amd64/latest"] = "img-amd64-3"
+        cp.images.cache.flush()
+        assert cp.is_machine_drifted(m)
+
+    def test_drift_gated(self, cp):
+        m = cp.create(machine())
+        cp.cloud.ssm_parameters["/karpenter-tpu/images/default/amd64/latest"] = "img-x"
+        cp.images.cache.flush()
+        assert not cp.is_machine_drifted(m)  # feature gate off
+
+
+class TestInstanceTypeProvider:
+    def test_ice_invalidates_list(self, cp):
+        before = cp.catalog_for()
+        cp.ice.mark_unavailable("ICE", "small.2x", "zone-1a", "on-demand")
+        after = cp.catalog_for()
+        assert after.seqnum != before.seqnum
+        t = after.by_name["small.2x"]
+        dead = [o for o in t.offerings if not o.available]
+        assert ("zone-1a", "on-demand") in [(o.zone, o.capacity_type) for o in dead]
+
+    def test_memoized_until_seqnum_changes(self, cp):
+        a = cp.catalog_for()
+        b = cp.catalog_for()
+        assert a is b
+
+
+class TestSpotFilter:
+    def test_spot_above_cheapest_od_dropped(self, cp):
+        reqs = Requirements.of(
+            (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"]),
+            (wk.LABEL_ARCH, OP_IN, ["amd64"]))
+        types = cp.instance_types.list().types
+        filtered = cp.instances.filter_instance_types(types, reqs)
+        names = {t.name for t in filtered}
+        # badspot.4x spot ($0.45) > cheapest OD ($0.10) but it still has its
+        # own OD offering -> kept; a spot-only overpriced type would drop
+        assert "badspot.4x" in names
+        assert "gpu.8x" not in names  # exotic w/o request
+
+
+class TestBootstrapFamilies:
+    def test_shell_family(self):
+        cfg = BootstrapConfig(cluster_name="c", cluster_endpoint="https://e",
+                              labels={"a": "1"}, max_pods=58)
+        out = get_family("ubuntu-k8s").userdata(cfg)
+        assert "--max-pods=58" in out and "--node-labels=a=1" in out
+
+    def test_toml_family(self):
+        cfg = BootstrapConfig(cluster_name="c", cluster_endpoint="https://e",
+                              labels={"a": "1"})
+        out = get_family("flatboat").userdata(cfg)
+        assert '[settings.kubernetes]' in out and 'cluster-name = "c"' in out
+
+    def test_mime_merge_with_custom(self):
+        cfg = BootstrapConfig(cluster_name="c", cluster_endpoint="https://e",
+                              custom_userdata="#!/bin/bash\necho hi")
+        out = get_family("ubuntu-k8s").userdata(cfg)
+        assert "multipart/mixed" in out and "echo hi" in out
+        assert out.index("echo hi") < out.index("bootstrap.sh")
+
+    def test_custom_family_passthrough(self):
+        cfg = BootstrapConfig(cluster_name="c", cluster_endpoint="https://e",
+                              custom_userdata="raw")
+        assert get_family("custom").userdata(cfg) == "raw"
+
+    def test_unknown_family_falls_back(self):
+        assert get_family("whatever").name == "ubuntu-k8s"
+
+
+class TestNodeTemplateValidation:
+    def test_static_lt_exclusive(self):
+        t = NodeTemplate(name="x", launch_template_name="my-lt", userdata="u")
+        with pytest.raises(ValidationError):
+            t.validate()
+
+    def test_custom_requires_selector(self):
+        t = NodeTemplate(name="x", image_family="custom",
+                         subnet_selector={"id": "s"})
+        with pytest.raises(ValidationError):
+            t.validate()
+
+    def test_restricted_tags(self):
+        t = NodeTemplate(name="x", subnet_selector={"id": "s"},
+                         tags={"karpenter.sh/foo": "bar"})
+        with pytest.raises(ValidationError):
+            t.validate()
+
+
+def test_concurrent_creates_merge_into_one_fleet_call(cp):
+    # regression: per-machine tags must not defeat the CreateFleet batcher
+    import threading
+    results = []
+    ths = [threading.Thread(target=lambda i=i: results.append(cp.create(machine(name=f"mc-{i}"))))
+           for i in range(8)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=20)
+    assert cp.cloud.create_fleet_api.called_with_count == 1
+    ids = {m.status.provider_id for m in results}
+    assert len(ids) == 8
+    # machine tags applied post-launch
+    names = {cp.cloud.instances[parse_provider_id(m.status.provider_id)[1]]
+             .tags["karpenter.sh/machine"] for m in results}
+    assert names == {f"mc-{i}" for i in range(8)}

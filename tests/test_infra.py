@@ -1,0 +1,331 @@
+"""Runtime infrastructure: caches, ICE cache, events, metrics, settings,
+batcher engine, concrete batchers, fake cloud."""
+
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.apis.settings import Settings, SettingsError
+from karpenter_tpu.batcher import Batcher, one_bucket_hasher
+from karpenter_tpu.batcher.fleet import (
+    CreateFleetBatcher, DescribeInstancesBatcher, TerminateInstancesBatcher,
+)
+from karpenter_tpu.cache import TTLCache, UnavailableOfferings
+from karpenter_tpu.events import EventRecorder
+from karpenter_tpu.fake.cloud import (
+    CreateFleetRequest, FakeCloud, FleetOverride, LaunchTemplate,
+)
+from karpenter_tpu.metrics import Registry, decorate_cloudprovider
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.utils import errors as cloud_errors
+from karpenter_tpu.utils.clock import FakeClock
+
+
+class TestTTLCache:
+    def test_expiry_with_fake_clock(self):
+        clock = FakeClock()
+        c = TTLCache(ttl=60, clock=clock)
+        c.set("k", "v")
+        assert c.get("k") == "v"
+        clock.step(61)
+        assert c.get("k") is None
+
+    def test_get_or_load(self):
+        c = TTLCache(ttl=60, clock=FakeClock())
+        calls = []
+        loader = lambda: calls.append(1) or "x"
+        assert c.get_or_load("k", loader) == "x"
+        assert c.get_or_load("k", loader) == "x"
+        assert len(calls) == 1
+
+
+class TestUnavailableOfferings:
+    def test_mark_and_expire(self):
+        clock = FakeClock()
+        ice = UnavailableOfferings(clock=clock)
+        s0 = ice.seqnum
+        ice.mark_unavailable("ICE", "m.large", "zone-1a", "spot")
+        assert ice.is_unavailable("spot", "m.large", "zone-1a")
+        assert not ice.is_unavailable("on-demand", "m.large", "zone-1a")
+        assert ice.seqnum == s0 + 1
+        clock.step(181)
+        assert not ice.is_unavailable("spot", "m.large", "zone-1a")
+
+    def test_fleet_err_marks_pools(self):
+        ice = UnavailableOfferings(clock=FakeClock())
+        err = cloud_errors.FleetError(
+            "InsufficientInstanceCapacity",
+            [("m.large", "zone-1a"), ("m.xlarge", "zone-1b")])
+        ice.mark_unavailable_for_fleet_err(err, "spot")
+        assert ice.is_unavailable("spot", "m.large", "zone-1a")
+        assert ice.is_unavailable("spot", "m.xlarge", "zone-1b")
+
+    def test_apply_flips_offerings(self):
+        ice = UnavailableOfferings(clock=FakeClock())
+        t = make_instance_type("m.large", cpu=2, memory="8Gi", spot_price=0.03)
+        ice.mark_unavailable("ICE", "m.large", "zone-1a", "spot")
+        (out,) = ice.apply([t])
+        flipped = [o for o in out.offerings if not o.available]
+        assert len(flipped) == 1
+        assert (flipped[0].zone, flipped[0].capacity_type) == ("zone-1a", "spot")
+
+
+class TestEvents:
+    def test_dedupe(self):
+        clock = FakeClock()
+        rec = EventRecorder(clock=clock)
+        assert rec.normal("node/n1", "Launched", "launched")
+        assert not rec.normal("node/n1", "Launched", "launched")
+        clock.step(121)
+        assert rec.normal("node/n1", "Launched", "launched")
+        assert len(rec.events) == 2
+
+
+class TestMetrics:
+    def test_counter_histogram_expose(self):
+        reg = Registry()
+        c = reg.counter("karpenter_test_total", "help", ("kind",))
+        c.inc(kind="a")
+        c.inc(2, kind="a")
+        assert c.value(kind="a") == 3
+        h = reg.histogram("karpenter_dur_seconds", "", ("m",))
+        h.observe(0.003, m="x")
+        with h.time(m="x"):
+            pass
+        assert h.count(m="x") == 2
+        text = reg.expose()
+        assert 'karpenter_test_total{kind="a"} 3' in text
+        assert "karpenter_dur_seconds_count" in text
+
+    def test_decorator(self):
+        reg = Registry()
+
+        class CP:
+            def create(self):
+                return "ok"
+
+        cp = decorate_cloudprovider(CP(), reg)
+        assert cp.create() == "ok"
+        hist = reg.histogram("karpenter_cloudprovider_duration_seconds", "", ("controller", "method"))
+        assert hist.count(controller="cloudprovider", method="create") == 1
+
+
+class TestSettings:
+    def test_defaults_and_parse(self):
+        s = Settings.from_dict({"clusterName": "c1", "batchIdleDuration": "1s",
+                                "batchMaxDuration": "10s", "tags.team": "ml"})
+        assert s.cluster_name == "c1"
+        assert s.batch_idle_duration == 1.0
+        assert s.tags == {"team": "ml"}
+        assert s.vm_memory_overhead_percent == 0.075
+
+    def test_validation(self):
+        with pytest.raises(SettingsError):
+            Settings.from_dict({})  # no cluster name
+        with pytest.raises(SettingsError):
+            Settings.from_dict({"clusterName": "c", "clusterEndpoint": "http://x"})
+        with pytest.raises(SettingsError):
+            Settings.from_dict({"clusterName": "c", "tags.karpenter.sh/x": "y"})
+        with pytest.raises(SettingsError):
+            Settings.from_dict({"clusterName": "c", "batchIdleDuration": "bogus"})
+
+
+class TestBatcherEngine:
+    def test_coalesces_within_idle_window(self):
+        batches = []
+
+        def execf(reqs):
+            batches.append(list(reqs))
+            return [r * 10 for r in reqs]
+
+        b = Batcher(execf, idle_seconds=0.05, max_seconds=1.0, max_items=100,
+                    hasher=one_bucket_hasher)
+        try:
+            results = []
+            threads = [threading.Thread(target=lambda i=i: results.append(b.add(i)))
+                       for i in range(5)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=5)
+            assert sorted(results) == [0, 10, 20, 30, 40]
+            assert len(batches) == 1  # one merged call
+        finally:
+            b.stop()
+
+    def test_max_items_flushes_immediately(self):
+        batches = []
+
+        def execf(reqs):
+            batches.append(list(reqs))
+            return list(reqs)
+
+        b = Batcher(execf, idle_seconds=10, max_seconds=60, max_items=2,
+                    hasher=one_bucket_hasher)
+        try:
+            results = []
+            ts = [threading.Thread(target=lambda i=i: results.append(b.add(i)))
+                  for i in range(2)]
+            t0 = time.monotonic()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=5)
+            assert time.monotonic() - t0 < 5  # didn't wait for the 10s idle
+            assert len(results) == 2
+        finally:
+            b.stop()
+
+    def test_error_fans_out(self):
+        def execf(reqs):
+            raise RuntimeError("boom")
+
+        b = Batcher(execf, idle_seconds=0.01, max_seconds=0.1, max_items=10,
+                    hasher=one_bucket_hasher)
+        try:
+            with pytest.raises(RuntimeError):
+                b.add(1)
+        finally:
+            b.stop()
+
+
+def fleet_request(capacity=1):
+    return CreateFleetRequest(
+        launch_template="lt-1",
+        overrides=[FleetOverride("m.large", "zone-1a", "subnet-zone-1a", 0.1),
+                   FleetOverride("m.large", "zone-1b", "subnet-zone-1b", 0.1)],
+        capacity=capacity, capacity_type="on-demand",
+        tags={"karpenter.sh/cluster": "test"})
+
+
+class TestFleetBatchers:
+    def setup_method(self):
+        self.cloud = FakeCloud(catalog=Catalog(types=[
+            make_instance_type("m.large", cpu=2, memory="8Gi")]))
+        self.cloud.create_launch_template(LaunchTemplate(name="lt-1", image_id="img-amd64-2"))
+
+    def test_create_fleet_merges_identical_requests(self):
+        b = CreateFleetBatcher(self.cloud, idle=0.03, max_wait=0.5)
+        try:
+            results = []
+            ts = [threading.Thread(
+                target=lambda: results.append(b.create_fleet(fleet_request())))
+                for _ in range(5)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=5)
+            assert self.cloud.create_fleet_api.called_with_count == 1
+            assert self.cloud.create_fleet_api.calls[0].capacity == 5
+            ids = [i for r in results for i in r.instance_ids]
+            assert len(ids) == len(set(ids)) == 5
+        finally:
+            b.stop()
+
+    def test_ice_pool_fans_error(self):
+        self.cloud.insufficient_capacity_pools = {
+            ("on-demand", "m.large", "zone-1a"), ("on-demand", "m.large", "zone-1b")}
+        b = CreateFleetBatcher(self.cloud, idle=0.02, max_wait=0.2)
+        try:
+            with pytest.raises(cloud_errors.FleetError) as ei:
+                b.create_fleet(fleet_request())
+            assert cloud_errors.is_unfulfillable_capacity(ei.value)
+            assert ("m.large", "zone-1a") in ei.value.failed_pools
+        finally:
+            b.stop()
+
+    def test_describe_and_terminate_roundtrip(self):
+        resp = self.cloud.create_fleet(fleet_request(capacity=2))
+        d = DescribeInstancesBatcher(self.cloud, idle=0.02, max_wait=0.2)
+        t = TerminateInstancesBatcher(self.cloud, idle=0.02, max_wait=0.2)
+        try:
+            inst = d.describe(resp.instance_ids[0])
+            assert inst.instance_type == "m.large"
+            iid, state = t.terminate(resp.instance_ids[0])
+            assert state == "terminated"
+            with pytest.raises(cloud_errors.CloudError):
+                d.describe(resp.instance_ids[0])  # terminated -> not found
+        finally:
+            d.stop()
+            t.stop()
+
+
+class TestFakeCloud:
+    def test_selector_matching(self):
+        cloud = FakeCloud()
+        subs = cloud.describe_subnets({"id": "subnet-zone-1a"})
+        assert [s.zone for s in subs] == ["zone-1a"]
+        assert cloud.describe_subnets({}) == []
+        sgs = cloud.describe_security_groups({"kubernetes.io/cluster/test-cluster": "*"})
+        assert [g.id for g in sgs] == ["sg-default"]
+
+    def test_error_injection(self):
+        cloud = FakeCloud()
+        cloud.describe_instances_api.set_error(
+            cloud_errors.CloudError("InternalError"), times=1)
+        with pytest.raises(cloud_errors.CloudError):
+            cloud.describe_instances(["i-1"])
+        assert cloud.describe_instances(["i-1"]) == []  # error consumed
+
+
+def test_batcher_stop_resolves_pending():
+    import threading as th
+
+    done = []
+
+    def execf(reqs):
+        return list(reqs)
+
+    b = Batcher(execf, idle_seconds=30, max_seconds=60, max_items=100,
+                hasher=one_bucket_hasher)
+    t = th.Thread(target=lambda: done.append(b.add(1)))
+    t.start()
+    time.sleep(0.05)
+    b.stop()  # must flush, not abandon
+    t.join(timeout=2)
+    assert done == [1]
+
+
+def test_ttl_cache_caches_none():
+    from karpenter_tpu.utils.clock import FakeClock as FC
+    c = TTLCache(ttl=60, clock=FC())
+    calls = []
+
+    def loader():
+        calls.append(1)
+        return None
+
+    assert c.get_or_load("k", loader) is None
+    assert c.get_or_load("k", loader) is None
+    assert len(calls) == 1
+
+
+def test_histogram_exposes_inf_bucket():
+    from karpenter_tpu.metrics import Registry as R
+    reg = R()
+    h = reg.histogram("karpenter_x_seconds", "", ("m",))
+    h.observe(90.0, m="slow")  # above the largest bucket
+    text = reg.expose()
+    assert 'le="+Inf"' in text
+    assert 'karpenter_x_seconds_count{m="slow"} 1' in text
+
+
+def test_instancetype_provider_multi_template_memo():
+    from karpenter_tpu.cache import UnavailableOfferings as UO
+    from karpenter_tpu.providers.instancetypes import InstanceTypeProvider
+    from karpenter_tpu.providers.subnet import SubnetProvider
+    from karpenter_tpu.fake.cloud import FakeCloud
+    from karpenter_tpu.apis.nodetemplate import NodeTemplate
+    from karpenter_tpu.utils.clock import FakeClock as FC
+
+    clock = FC()
+    cloud = FakeCloud(clock=clock)
+    cat = Catalog(types=[make_instance_type("m.2x", cpu=2, memory="8Gi")])
+    p = InstanceTypeProvider(cat, UO(clock=clock), SubnetProvider(cloud, clock=clock))
+    ta = NodeTemplate(name="a", subnet_selector={"id": "subnet-zone-1a"})
+    tb = NodeTemplate(name="b", subnet_selector={"id": "subnet-zone-1b"})
+    ca1, cb1 = p.list(ta), p.list(tb)
+    ca2, cb2 = p.list(ta), p.list(tb)
+    assert ca1 is ca2 and cb1 is cb2  # both variants stay memoized
+    assert {o.zone for t in ca1.types for o in t.offerings} == {"zone-1a"}
