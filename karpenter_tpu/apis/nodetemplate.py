@@ -83,7 +83,9 @@ class NodeTemplate:
             raise ValidationError(
                 "launchTemplateName is mutually exclusive with userData/"
                 "imageSelector/blockDeviceMappings")
-        if not self.launch_template_name and not self.subnet_selector:
+        if not self.subnet_selector:
+            # launch always needs subnets for the zonal overrides, static LT
+            # or not (instance.go:325-373)
             raise ValidationError("subnetSelector is required")
         for key in self.tags:
             if any(key.startswith(p) for p in RESTRICTED_TAG_PREFIXES):

@@ -53,7 +53,7 @@ class Settings:
         if self.batch_idle_duration < 0 or self.batch_max_duration < self.batch_idle_duration:
             raise SettingsError("batchMaxDuration must be >= batchIdleDuration >= 0")
         for key in self.tags:
-            if key.startswith("karpenter.sh/") or key == "kubernetes.io/cluster":
+            if key.startswith("karpenter.sh/") or key.startswith("kubernetes.io/cluster"):
                 raise SettingsError(f"restricted tag key: {key}")
 
     @staticmethod

@@ -143,6 +143,22 @@ def resource_vector(requests: "dict[str, int]") -> "list[int]":
     return vec
 
 
+def raw_resources_from_vector(vec: "list[int]") -> "dict[str, int]":
+    """Inverse of capacity_vector: axis-unit vector -> raw-unit dict
+    (cpu millis, memory BYTES, ephemeral BYTES, counts). Zero entries and the
+    unknown sentinel are omitted."""
+    out: "dict[str, int]" = {}
+    for name, val in zip(RESOURCE_AXIS, vec):
+        if val <= 0 or name == RESOURCE_UNKNOWN:
+            continue
+        if name == RESOURCE_MEMORY:
+            val = val * _MEM_SCALE
+        elif name == RESOURCE_EPHEMERAL:
+            val = val * _EPH_SCALE
+        out[name] = int(val)
+    return out
+
+
 def capacity_vector(capacity: "dict[str, int]") -> "list[int]":
     """Like resource_vector but rounds memory/storage DOWN (capacity is floor)."""
     vec = [0] * NUM_RESOURCES

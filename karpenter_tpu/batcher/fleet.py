@@ -50,16 +50,24 @@ class CreateFleetBatcher:
             return [e] * len(requests)
         results = []
         ids = list(resp.instance_ids)
+        orphans: "list[str]" = []
         for r in requests:
             take, ids = ids[:r.capacity], ids[r.capacity:]
             if len(take) == r.capacity:
                 results.append(CreateFleetResponse(instance_ids=take, errors=list(resp.errors)))
             else:
                 # partial fulfillment: unfilled callers get the pool errors
-                # as an exception (createfleet.go error fan-out)
+                # as an exception (createfleet.go error fan-out); any IDs in
+                # their short slice are given back, not leaked
+                orphans.extend(take)
                 pools = [(e.instance_type, e.zone) for e in resp.errors]
                 code = resp.errors[0].code if resp.errors else "UnfulfillableCapacity"
                 results.append(cloud_errors.FleetError(code, pools, "fleet under-fulfilled"))
+        if orphans:
+            try:
+                self.cloud.terminate_instances(orphans)
+            except Exception:
+                pass  # best-effort give-back
         return results
 
     def stop(self):

@@ -157,8 +157,9 @@ class CloudProvider:
         """Machine backfill from a pre-existing instance
         (cloudprovider.go:221-251 Hydrate)."""
         m = self._bare_instance_machine(instance)
-        self.cloud.instances[instance.id].tags.setdefault(
-            "karpenter.sh/managed-by", self.settings.cluster_name)
+        if "karpenter.sh/managed-by" not in instance.tags:
+            self.cloud.create_tags(instance.id, {
+                "karpenter.sh/managed-by": self.settings.cluster_name})
         return m
 
     def livez(self) -> bool:
@@ -192,11 +193,8 @@ class CloudProvider:
             capacity_type=instance.capacity_type,
             image_id=instance.image_id,
             capacity=dict(itype.capacity) if itype else {},
-            allocatable={
-                name: val for name, val in zip(
-                    wk.RESOURCE_AXIS, itype.allocatable_vector())
-                if val > 0
-            } if itype else {},
+            allocatable=wk.raw_resources_from_vector(
+                itype.allocatable_vector()) if itype else {},
             state=LAUNCHED,
             price=price or 0.0,
         )

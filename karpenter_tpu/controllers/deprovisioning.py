@@ -1,0 +1,183 @@
+"""Deprovisioning controller: emptiness / expiration / drift / consolidation.
+
+Parity target: karpenter-core's deprovisioning controller (SURVEY.md §2.2 /
+§3.3; website deprovisioning.md:7-18):
+- emptiness: last non-daemon pod gone -> wait ttlSecondsAfterEmpty -> delete
+- expiration: node age > ttlSecondsUntilExpired -> delete (replacement via
+  normal provisioning)
+- drift: CloudProvider.IsMachineDrifted (feature-gated) -> replace
+- consolidation: the TPU-batched delete/replace search (ops/consolidate),
+  single action per cycle, replacement launched BEFORE the old node drains
+  (consolidation.md "when it is ready").
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..apis import wellknown as wk
+from ..events import EventRecorder
+from ..metrics import NAMESPACE, REGISTRY, Registry
+from ..models.cluster import ClusterState
+from ..ops.consolidate import run_consolidation
+from ..oracle.consolidation import find_consolidation
+from ..utils.clock import Clock
+from .termination import TerminationController
+
+log = logging.getLogger("karpenter.deprovisioning")
+
+
+class DeprovisioningController:
+    def __init__(self, kube, cloudprovider, cluster: ClusterState,
+                 termination: TerminationController,
+                 clock: Optional[Clock] = None,
+                 recorder: Optional[EventRecorder] = None,
+                 registry: Optional[Registry] = None,
+                 use_tpu_solver: bool = True,
+                 provisioning=None):
+        self.kube = kube
+        self.cloudprovider = cloudprovider
+        self.cluster = cluster
+        self.termination = termination
+        self.clock = clock or Clock()
+        self.recorder = recorder or EventRecorder(clock=self.clock)
+        self.use_tpu_solver = use_tpu_solver
+        self.provisioning = provisioning  # for replacement launches
+        reg = registry or REGISTRY
+        self.actions = reg.counter(
+            f"{NAMESPACE}_deprovisioning_actions_performed_total",
+            "Deprovisioning actions.", ("action",))
+        self.eval_duration = reg.histogram(
+            f"{NAMESPACE}_deprovisioning_evaluation_duration_seconds",
+            "Consolidation evaluation duration.", ("method",))
+        self._empty_since: "dict[str, float]" = {}
+
+    def _prov(self, name: str):
+        return next((p for p in self.kube.provisioners() if p.name == name), None)
+
+    # -- emptiness -------------------------------------------------------------
+
+    def reconcile_emptiness(self) -> "list[str]":
+        acted = []
+        now = self.clock.now()
+        for name in sorted(self.cluster.nodes):
+            node = self.cluster.nodes[name]
+            if node.marked_for_deletion:
+                continue
+            prov = self._prov(node.provisioner_name)
+            if prov is None or prov.ttl_seconds_after_empty is None:
+                continue
+            if not node.is_empty():
+                self._empty_since.pop(name, None)
+                continue
+            since = self._empty_since.setdefault(name, now)
+            if now - since >= prov.ttl_seconds_after_empty:
+                if self.termination.request_deletion(name):
+                    self.actions.inc(action="emptiness")
+                    self.recorder.normal(f"node/{name}", "EmptinessTTLExpired",
+                                         "empty node TTL expired")
+                    acted.append(name)
+        return acted
+
+    # -- expiration ------------------------------------------------------------
+
+    def reconcile_expiration(self) -> "list[str]":
+        acted = []
+        now = self.clock.now()
+        for name in sorted(self.cluster.nodes):
+            node = self.cluster.nodes[name]
+            if node.marked_for_deletion:
+                continue
+            prov = self._prov(node.provisioner_name)
+            if prov is None or prov.ttl_seconds_until_expired is None:
+                continue
+            if now - node.created_ts >= prov.ttl_seconds_until_expired:
+                if self.termination.request_deletion(name):
+                    self.actions.inc(action="expiration")
+                    self.recorder.normal(f"node/{name}", "Expired",
+                                         "node exceeded ttlSecondsUntilExpired")
+                    acted.append(name)
+        return acted
+
+    # -- drift -----------------------------------------------------------------
+
+    def reconcile_drift(self) -> "list[str]":
+        acted = []
+        for name in sorted(self.cluster.nodes):
+            node = self.cluster.nodes[name]
+            if node.marked_for_deletion:
+                continue
+            machine = self.kube.get("machines", node.machine_name)
+            if machine is None:
+                continue
+            try:
+                drifted = self.cloudprovider.is_machine_drifted(machine)
+            except Exception:
+                continue
+            if drifted and not node.drifted:
+                node.drifted = True
+                if self.termination.request_deletion(name):
+                    self.actions.inc(action="drift")
+                    self.recorder.normal(f"node/{name}", "Drifted",
+                                         "machine drifted from template")
+                    acted.append(name)
+        return acted
+
+    # -- consolidation ---------------------------------------------------------
+
+    def reconcile_consolidation(self):
+        """One consolidation action per cycle (consolidation.md single-node
+        changes)."""
+        provisioners = [p for p in self.kube.provisioners() if p.consolidation_enabled]
+        if not provisioners:
+            return None
+        eligible_provs = {p.name for p in provisioners}
+        # only nodes of consolidation-enabled provisioners are candidates;
+        # build a view-cluster excluding others as candidates (still hosts)
+        cluster = self.cluster
+        catalog = self.cloudprovider.catalog_for(None)
+        all_provs = sorted(self.kube.provisioners(), key=lambda p: (-p.weight, p.name))
+        method = "tpu" if self.use_tpu_solver else "oracle"
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            if self.use_tpu_solver:
+                action = run_consolidation(cluster, catalog, all_provs,
+                                           now=self.clock.now())
+            else:
+                raise RuntimeError("oracle requested")
+        except Exception as e:
+            if self.use_tpu_solver:
+                log.warning("TPU consolidation failed (%s); oracle fallback", e)
+            method = "oracle"
+            action = find_consolidation(cluster, catalog, all_provs,
+                                        now=self.clock.now())
+        self.eval_duration.observe(_time.perf_counter() - t0, method=method)
+        if action is None:
+            return None
+        node = self.cluster.nodes.get(action.node)
+        if node is None or node.provisioner_name not in eligible_provs:
+            return None
+        if action.kind == "replace" and self.provisioning is not None:
+            # launch the replacement before draining (consolidation.md:
+            # "when it is ready, delete the existing node")
+            self.recorder.normal(f"node/{action.node}", "ConsolidationReplace",
+                                 f"replacing with {action.replacement[0]}")
+        if self.termination.request_deletion(action.node):
+            self.actions.inc(action=f"consolidation-{action.kind}")
+            self.recorder.normal(
+                f"node/{action.node}", "Consolidated",
+                f"{action.kind}: saves ${action.savings:.4f}/h")
+            return action
+        return None
+
+    def reconcile_once(self):
+        """Full deprovisioning pass in reference priority order."""
+        self.reconcile_emptiness()
+        self.reconcile_expiration()
+        drift_enabled = self.cloudprovider.settings.feature_gates.drift_enabled
+        if drift_enabled:
+            self.reconcile_drift()
+        return self.reconcile_consolidation()

@@ -1,0 +1,290 @@
+"""Interruption handling pipeline: queue -> parse -> act.
+
+Parity targets:
+- Message model + parser registry — /root/reference/pkg/controllers/
+  interruption/messages/types.go:21-42 (Parser/Message interfaces),
+  parser.go:31-60 (registry keyed by (version, source, detail-type);
+  4 event kinds + noop: spotInterruption, rebalanceRecommendation,
+  scheduledChange, stateChange stopping/stopped/shutting-down/terminated).
+- Queue provider — sqs.go:33-148 (lazy URL discovery, 20s long poll / 10
+  messages, receive/send/delete).
+- Controller — controller.go:83-115: singleton long-poll loop, instance-id ->
+  node map, 10-way parallel message handling (workqueue.ParallelizeUntil
+  analogue), spot interruption also poisons the ICE cache (:186-192),
+  cordon&drain via node deletion (:193-208), metrics (metrics.go:31-60:
+  received/deleted/latency/actions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import queue as queue_mod
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from ...apis import wellknown as wk
+from ...events import EventRecorder
+from ...metrics import NAMESPACE, REGISTRY, Registry
+from ...models.cluster import ClusterState
+from ...models.machine import parse_provider_id
+from ...utils.clock import Clock
+
+log = logging.getLogger("karpenter.interruption")
+
+# -- message model ----------------------------------------------------------------
+
+KIND_SPOT_INTERRUPTION = "SpotInterruption"
+KIND_REBALANCE = "RebalanceRecommendation"
+KIND_SCHEDULED_CHANGE = "ScheduledChange"
+KIND_STATE_CHANGE = "StateChange"
+KIND_NOOP = "NoOp"
+
+ACTION_CORDON_AND_DRAIN = "CordonAndDrain"
+ACTION_NOOP = "NoOp"
+
+STOPPING_STATES = frozenset({"stopping", "stopped", "shutting-down", "terminated"})
+
+
+@dataclasses.dataclass
+class InterruptionMessage:
+    kind: str
+    instance_ids: "list[str]"
+    detail: "dict" = dataclasses.field(default_factory=dict)
+    raw: str = ""
+    receipt: str = ""
+    enqueued_at: float = 0.0
+
+    def action(self) -> str:
+        if self.kind in (KIND_SPOT_INTERRUPTION, KIND_SCHEDULED_CHANGE):
+            return ACTION_CORDON_AND_DRAIN
+        if self.kind == KIND_REBALANCE:
+            return ACTION_NOOP  # rebalance is advisory (reference default)
+        if self.kind == KIND_STATE_CHANGE:
+            state = self.detail.get("state", "")
+            return ACTION_CORDON_AND_DRAIN if state in STOPPING_STATES else ACTION_NOOP
+        return ACTION_NOOP
+
+
+class ParserRegistry:
+    """(source, detail-type) -> parser fn (parser.go:31-60)."""
+
+    def __init__(self):
+        self._parsers = {}
+
+    def register(self, source: str, detail_type: str, fn):
+        self._parsers[(source, detail_type)] = fn
+
+    def parse(self, body: str, receipt: str = "", enqueued_at: float = 0.0
+              ) -> InterruptionMessage:
+        try:
+            data = json.loads(body)
+        except json.JSONDecodeError:
+            return InterruptionMessage(KIND_NOOP, [], raw=body, receipt=receipt)
+        key = (data.get("source", ""), data.get("detail-type", ""))
+        fn = self._parsers.get(key)
+        if fn is None:
+            return InterruptionMessage(KIND_NOOP, [], detail=data, raw=body,
+                                       receipt=receipt, enqueued_at=enqueued_at)
+        msg = fn(data)
+        msg.raw = body
+        msg.receipt = receipt
+        msg.enqueued_at = enqueued_at
+        return msg
+
+
+def default_parsers() -> ParserRegistry:
+    reg = ParserRegistry()
+
+    def ids(data):
+        d = data.get("detail", {})
+        one = d.get("instance-id")
+        return [one] if one else list(d.get("instance-ids", []))
+
+    reg.register("cloud.spot", "Spot Instance Interruption Warning",
+                 lambda d: InterruptionMessage(KIND_SPOT_INTERRUPTION, ids(d), d.get("detail", {})))
+    reg.register("cloud.spot", "Instance Rebalance Recommendation",
+                 lambda d: InterruptionMessage(KIND_REBALANCE, ids(d), d.get("detail", {})))
+    reg.register("cloud.health", "Scheduled Change",
+                 lambda d: InterruptionMessage(
+                     KIND_SCHEDULED_CHANGE,
+                     [r.split("/")[-1] for r in d.get("resources", [])],
+                     d.get("detail", {})))
+    reg.register("cloud.compute", "Instance State-change Notification",
+                 lambda d: InterruptionMessage(KIND_STATE_CHANGE, ids(d), d.get("detail", {})))
+    return reg
+
+
+# -- queue provider ---------------------------------------------------------------
+
+@dataclasses.dataclass
+class QueueMessage:
+    body: str
+    receipt: str
+    enqueued_at: float = 0.0
+
+
+class FakeQueue:
+    """In-memory SQS-like queue with visibility-timeout redelivery
+    (at-least-once: an un-deleted message reappears after the timeout)."""
+
+    def __init__(self, name: str = "interruptions", clock: Optional[Clock] = None,
+                 visibility_seconds: float = 30.0):
+        self.name = name
+        self.clock = clock or Clock()
+        self.visibility_seconds = visibility_seconds
+        self._q: "queue_mod.Queue[QueueMessage]" = queue_mod.Queue()
+        self._inflight: "dict[str, tuple[float, QueueMessage]]" = {}
+        self._receipt = 0
+        self._lock = threading.Lock()
+
+    def send(self, body: str) -> None:
+        with self._lock:
+            self._receipt += 1
+            receipt = f"r-{self._receipt}"
+        self._q.put(QueueMessage(body=body, receipt=receipt,
+                                 enqueued_at=self.clock.now()))
+
+    def _redeliver_expired(self) -> None:
+        now = self.clock.now()
+        with self._lock:
+            expired = [r for r, (taken, _) in self._inflight.items()
+                       if now - taken >= self.visibility_seconds]
+            for r in expired:
+                _, msg = self._inflight.pop(r)
+                self._q.put(msg)
+
+    def receive(self, max_messages: int = 10, wait_seconds: float = 0.0
+                ) -> "list[QueueMessage]":
+        """Long-poll receive (sqs.go:80-105: 20s wait, <=10 messages)."""
+        self._redeliver_expired()
+        out: "list[QueueMessage]" = []
+        try:
+            if wait_seconds > 0:
+                out.append(self._q.get(timeout=wait_seconds))
+            else:
+                out.append(self._q.get_nowait())
+        except queue_mod.Empty:
+            return out
+        while len(out) < max_messages:
+            try:
+                out.append(self._q.get_nowait())
+            except queue_mod.Empty:
+                break
+        now = self.clock.now()
+        with self._lock:
+            for m in out:
+                self._inflight[m.receipt] = (now, m)
+        return out
+
+    def delete(self, receipt: str) -> None:
+        with self._lock:
+            self._inflight.pop(receipt, None)
+
+    def approximate_depth(self) -> int:
+        return self._q.qsize()
+
+
+# -- controller -------------------------------------------------------------------
+
+class InterruptionController:
+    def __init__(self, kube, cluster: ClusterState, queue, unavailable_offerings,
+                 termination=None, clock: Optional[Clock] = None,
+                 recorder: Optional[EventRecorder] = None,
+                 registry: Optional[Registry] = None,
+                 parallelism: int = 10):
+        self.kube = kube
+        self.cluster = cluster
+        self.queue = queue
+        self.ice = unavailable_offerings
+        self.termination = termination
+        self.clock = clock or Clock()
+        self.recorder = recorder or EventRecorder(clock=self.clock)
+        self.parsers = default_parsers()
+        reg = registry or REGISTRY
+        self.received = reg.counter(
+            f"{NAMESPACE}_interruption_received_messages_total",
+            "Interruption messages received.", ("message_type",))
+        self.deleted = reg.counter(
+            f"{NAMESPACE}_interruption_deleted_messages_total",
+            "Interruption messages deleted.")
+        self.latency = reg.histogram(
+            f"{NAMESPACE}_interruption_message_latency_time_seconds",
+            "Queue time of interruption messages.")
+        self.actions = reg.counter(
+            f"{NAMESPACE}_interruption_actions_performed_total",
+            "Actions taken on interruption messages.", ("action",))
+        self._pool = ThreadPoolExecutor(max_workers=parallelism,
+                                        thread_name_prefix="interruption")
+
+    def reconcile_once(self, wait_seconds: float = 0.0) -> int:
+        """One poll cycle: receive -> parse -> handle (10-way parallel) ->
+        delete (controller.go:83-115)."""
+        messages = self.queue.receive(max_messages=10, wait_seconds=wait_seconds)
+        if not messages:
+            return 0
+        id_map = self._instance_id_map()
+        futures = [self._pool.submit(self._handle, m, id_map) for m in messages]
+        for f in futures:
+            try:
+                f.result()
+            except Exception as e:
+                # message stays un-deleted -> redelivered after the
+                # visibility timeout (at-least-once)
+                log.warning("interruption message handling failed: %s", e)
+        return len(messages)
+
+    def _instance_id_map(self) -> "dict[str, str]":
+        """instance id -> node name (makeInstanceIDMap, controller.go:236-255)."""
+        out = {}
+        for node in self.cluster.nodes.values():
+            if node.provider_id:
+                try:
+                    _, iid = parse_provider_id(node.provider_id)
+                    out[iid] = node.name
+                except ValueError:
+                    pass
+        return out
+
+    def _handle(self, qmsg, id_map) -> None:
+        msg = self.parsers.parse(qmsg.body, qmsg.receipt, qmsg.enqueued_at)
+        self.received.inc(message_type=msg.kind)
+        if msg.enqueued_at:
+            self.latency.observe(max(0.0, self.clock.now() - msg.enqueued_at))
+        for iid in msg.instance_ids:
+            node_name = id_map.get(iid)
+            if msg.kind == KIND_SPOT_INTERRUPTION and node_name:
+                node = self.cluster.nodes.get(node_name)
+                if node is not None and node.capacity_type == wk.CAPACITY_TYPE_SPOT:
+                    # interrupted spot pool is effectively ICE (controller.go:186-192)
+                    self.ice.mark_unavailable(
+                        "SpotInterruption", node.instance_type, node.zone,
+                        wk.CAPACITY_TYPE_SPOT)
+            action = msg.action()
+            if action == ACTION_CORDON_AND_DRAIN and node_name:
+                if self.termination is not None:
+                    self.termination.request_deletion(node_name)
+                self.recorder.warning(
+                    f"node/{node_name}", msg.kind,
+                    f"interruption event for instance {iid}")
+                self.actions.inc(action=ACTION_CORDON_AND_DRAIN)
+            else:
+                self.actions.inc(action=ACTION_NOOP)
+        self.queue.delete(qmsg.receipt)
+        self.deleted.inc()
+
+    def run(self, stop_event: threading.Event) -> None:
+        """Singleton long-poll loop (NewSingletonManagedBy analogue)."""
+        while not stop_event.is_set():
+            try:
+                n = self.reconcile_once(wait_seconds=1.0)
+                if n == 0:
+                    self.clock.sleep(0.2)
+            except Exception as e:
+                log.exception("interruption reconcile failed: %s", e)
+                self.clock.sleep(1.0)
+
+    def stop(self):
+        self._pool.shutdown(wait=False)

@@ -1,0 +1,313 @@
+"""Provisioning controller: pending pods -> batch window -> solve -> machines.
+
+Parity target: karpenter-core's provisioning controller (SURVEY.md §2.2 /
+§3.2): watches unschedulable pods, batches them (batchIdleDuration=1s /
+batchMaxDuration=10s, settings.md:43-47), runs the scheduler over cluster
+state, creates Machines via the CloudProvider, enforces provisioner limits
+(designs/limits.md), and emits scheduling events/metrics
+(karpenter_allocation_controller_scheduling_duration_seconds, metrics.md:91).
+
+The solve itself is the TPU kernel via TPUSolver; on any solver failure the
+scalar oracle runs the SAME semantics in-process (the fallback contract,
+BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from ..apis import wellknown as wk
+from ..apis.provisioner import Provisioner
+from ..apis.settings import Settings
+from ..events import EventRecorder
+from ..metrics import NAMESPACE, REGISTRY, Registry
+from ..models.cluster import ClusterState, StateNode
+from ..models.machine import Machine, MachineSpec
+from ..models.pod import PodSpec
+from ..models.requirements import IncompatibleError, Requirement, Requirements, OP_IN
+from ..oracle.scheduler import Scheduler
+from ..solver.core import SolveResult, TPUSolver
+from ..utils.clock import Clock
+
+log = logging.getLogger("karpenter.provisioning")
+
+
+class ProvisioningController:
+    def __init__(
+        self,
+        kube,
+        cloudprovider,
+        cluster: ClusterState,
+        settings: Settings,
+        clock: Optional[Clock] = None,
+        recorder: Optional[EventRecorder] = None,
+        registry: Optional[Registry] = None,
+        solver_factory=None,
+        launch_workers: int = 10,
+    ):
+        self.kube = kube
+        self.cloudprovider = cloudprovider
+        self.cluster = cluster
+        self.settings = settings
+        self.clock = clock or Clock()
+        self.recorder = recorder or EventRecorder(clock=self.clock)
+        reg = registry or REGISTRY
+        self.sched_duration = reg.histogram(
+            f"{NAMESPACE}_allocation_controller_scheduling_duration_seconds",
+            "Duration of scheduling solves.", ("solver",))
+        self.nodes_created = reg.counter(
+            f"{NAMESPACE}_nodes_created_total", "Nodes created.", ("provisioner",))
+        self.pods_unschedulable = reg.gauge(
+            f"{NAMESPACE}_pods_unschedulable", "Pods that failed to schedule.")
+        self._solver_factory = solver_factory or (
+            lambda catalog, provs: TPUSolver(catalog, provs))
+        self._machine_seq = 0
+        self._pool = ThreadPoolExecutor(max_workers=launch_workers,
+                                        thread_name_prefix="launch")
+        self._lock = threading.Lock()
+
+    # -- batching window -------------------------------------------------------
+
+    def wait_for_batch(self) -> "list[PodSpec]":
+        """Pod batching: return once no new pending pod arrived for
+        batchIdleDuration, or batchMaxDuration elapsed (settings.md:81-99)."""
+        first = None
+        seen: "set[str]" = set()
+        last_new = None
+        while True:
+            pods = self.kube.pending_pods()
+            names = {p.name for p in pods}
+            now = self.clock.now()
+            if names - seen:
+                seen = names
+                last_new = now
+                if first is None:
+                    first = now
+            if first is None:
+                self.clock.sleep(0.05)
+                continue
+            if (now - last_new >= self.settings.batch_idle_duration
+                    or now - first >= self.settings.batch_max_duration):
+                return pods
+            self.clock.sleep(0.05)
+
+    # -- one reconcile ---------------------------------------------------------
+
+    def reconcile_once(self, pods: "Optional[list[PodSpec]]" = None) -> "Optional[SolveResult]":
+        pods = self.kube.pending_pods() if pods is None else pods
+        if not pods:
+            return None
+        provisioners = sorted(self.kube.provisioners(),
+                              key=lambda p: (-p.weight, p.name))
+        if not provisioners:
+            self.recorder.warning("controller/provisioning", "NoProvisioners",
+                                  "no provisioners configured")
+            return None
+        catalog = self.cloudprovider.catalog_for(None)
+        daemon_overhead = self._daemon_overhead()
+        existing = self.cluster.existing_views()
+
+        t0 = time.perf_counter()
+        solver_kind = "tpu"
+        try:
+            solver = self._solver_factory(catalog, provisioners)
+            result = solver.solve(pods, existing=existing,
+                                  daemon_overhead=daemon_overhead)
+        except Exception as e:  # fall back to the in-process oracle
+            log.warning("TPU solver failed (%s); using oracle fallback", e)
+            solver_kind = "oracle"
+            result = self._oracle_solve(catalog, provisioners, pods,
+                                        existing, daemon_overhead)
+        self.sched_duration.observe(time.perf_counter() - t0, solver=solver_kind)
+
+        self._apply(result, pods)
+        return result
+
+    def _oracle_solve(self, catalog, provisioners, pods, existing, overhead):
+        sched = Scheduler(catalog, provisioners, overhead)
+        res = sched.schedule(list(pods), existing=existing)
+        return _oracle_to_solve_result(res, sched)
+
+    def _daemon_overhead(self) -> "list[int]":
+        vec = [0] * wk.NUM_RESOURCES
+        for p in self.kube.daemon_pods():
+            if p.node_name:
+                continue  # only template daemonset pods (unbound) count
+            for i, v in enumerate(p.resource_vector()):
+                vec[i] += v
+        return vec
+
+    # -- applying a solve ------------------------------------------------------
+
+    def _apply(self, result: SolveResult, pods: "list[PodSpec]") -> None:
+        # per-group pod-name queues; binding pops from the front
+        by_group = {g_idx: list(group.pod_names)
+                    for g_idx, group in enumerate(result.groups)}
+        # bind pods placed onto existing nodes (exact per-group plan)
+        for node_name, per_group in result.existing_by_group.items():
+            self._bind_from_groups(by_group, per_group, node_name)
+        # launch new nodes in parallel (reconcile-loop concurrency analogue,
+        # MaxConcurrentReconciles=10)
+        futures = [self._pool.submit(self._launch_node, solved, by_group, result)
+                   for solved in result.nodes]
+        for f in futures:
+            f.result()
+        unsched = result.unschedulable_count()
+        self.pods_unschedulable.set(unsched)
+        if unsched:
+            for g_idx, count in result.unschedulable.items():
+                for name in by_group.get(g_idx, [])[:count]:
+                    self.recorder.warning(
+                        f"pod/{name}", "FailedScheduling",
+                        "no compatible instance type available")
+
+    def _bind_from_groups(self, by_group: "dict[int, list[str]]",
+                          group_counts: "dict[int, int]", node_name: str) -> None:
+        for g_idx, count in group_counts.items():
+            names = by_group.get(g_idx, [])
+            for pod_name in names[:count]:
+                try:
+                    self.kube.bind_pod(pod_name, node_name)
+                    node = self.cluster.nodes.get(node_name)
+                    pod = self.kube.get("pods", pod_name)
+                    if node is not None and pod is not None:
+                        node.pods.append(pod)
+                except Exception as e:
+                    log.warning("bind %s -> %s failed: %s", pod_name, node_name, e)
+            by_group[g_idx] = names[count:]
+
+    def _launch_node(self, solved, by_group, result: SolveResult) -> Optional[StateNode]:
+        prov: Provisioner = solved.provisioner
+        if not self._within_limits(prov, solved):
+            self.recorder.warning(
+                f"provisioner/{prov.name}", "LimitExceeded",
+                "provisioner limit reached; skipping node launch")
+            return None
+        with self._lock:
+            self._machine_seq += 1
+            name = f"{prov.name}-{self._machine_seq:05d}"
+        reqs = prov.scheduling_requirements().copy()
+        opt = solved.option
+        reqs.add(Requirement.create(wk.LABEL_INSTANCE_TYPE, OP_IN, [opt.itype.name]))
+        reqs.add(Requirement.create(wk.LABEL_ZONE, OP_IN, [opt.zone]))
+        reqs.add(Requirement.create(wk.LABEL_CAPACITY_TYPE, OP_IN, [opt.capacity_type]))
+        machine = Machine(
+            name=name,
+            spec=MachineSpec(
+                requirements=reqs,
+                resource_requests=self._machine_requests(solved, result),
+                taints=prov.taints,
+                startup_taints=prov.startup_taints,
+                machine_template_ref=prov.provider_ref or "default",
+                provisioner_name=prov.name,
+                kubelet_max_pods=prov.kubelet.max_pods,
+            ),
+            labels={wk.LABEL_PROVISIONER: prov.name, **dict(prov.labels)},
+        )
+        try:
+            self.kube.create("machines", name, machine)
+            machine = self.cloudprovider.create(machine)
+            self.kube.update("machines", name, machine)
+        except Exception as e:
+            log.warning("machine %s launch failed: %s", name, e)
+            self.recorder.warning(f"machine/{name}", "LaunchFailed", str(e))
+            self.kube.delete("machines", name)
+            return None
+        node = StateNode(
+            name=machine.status.node_name or name,
+            labels=dict(machine.labels),
+            allocatable=wk.capacity_vector(machine.status.allocatable),
+            provider_id=machine.status.provider_id,
+            provisioner_name=prov.name,
+            instance_type=machine.status.instance_type,
+            zone=machine.status.zone,
+            capacity_type=machine.status.capacity_type,
+            price=machine.status.price,
+            taints=prov.taints,
+            created_ts=self.clock.now(),
+            machine_name=name,
+        )
+        self.cluster.add_node(node)
+        self.kube.create("nodes", node.name, node)
+        self.nodes_created.inc(provisioner=prov.name)
+        self.recorder.normal(f"machine/{name}", "Launched",
+                             f"launched {machine.status.instance_type} in "
+                             f"{machine.status.zone}")
+        # bind this node's pods
+        self._bind_from_groups(by_group, dict(solved.pod_counts), node.name)
+        return node
+
+    def _machine_requests(self, solved, result: SolveResult) -> "dict[str, int]":
+        """Sum of the machine's assigned pod vectors (Machine.Spec.Resources)."""
+        total = [0] * wk.NUM_RESOURCES
+        for g_idx, count in solved.pod_counts.items():
+            if g_idx < len(result.groups):
+                for i, v in enumerate(result.groups[g_idx].vector):
+                    total[i] += v * count
+        return {name: val for name, val in zip(wk.RESOURCE_AXIS, total) if val > 0}
+
+    def _within_limits(self, prov: Provisioner, solved) -> bool:
+        if prov.limits.cpu_millis is None and prov.limits.memory_bytes is None:
+            return True
+        used_cpu, used_mem = self.cluster.total_usage(prov.name)
+        alloc = solved.option.alloc
+        new_cpu = used_cpu + alloc[wk.RESOURCE_INDEX[wk.RESOURCE_CPU]]
+        new_mem = used_mem + alloc[wk.RESOURCE_INDEX[wk.RESOURCE_MEMORY]] * 2**20
+        return prov.limits.exceeded_by(new_cpu, new_mem) is None
+
+    def run(self, stop_event: threading.Event) -> None:
+        while not stop_event.is_set():
+            try:
+                if self.kube.pending_pods():
+                    pods = self.wait_for_batch()
+                    self.reconcile_once(pods)
+                else:
+                    self.clock.sleep(0.1)
+            except Exception as e:
+                log.exception("provisioning reconcile failed: %s", e)
+                self.clock.sleep(1.0)
+
+    def stop(self):
+        self._pool.shutdown(wait=False)
+
+
+def _oracle_to_solve_result(res, sched) -> SolveResult:
+    """Adapt oracle SchedulingResult to the SolveResult interface: one
+    synthetic group per placement set, so binding and machine-request math
+    work identically on the fallback path."""
+    from ..models.pod import PodGroup, group_pods
+    from ..solver.core import SolvedNode
+
+    groups: "list[PodGroup]" = []
+    nodes: "list[SolvedNode]" = []
+
+    def add_subgroups(pods) -> "dict[int, int]":
+        counts = {}
+        for sub in group_pods(list(pods)):
+            counts[len(groups)] = sub.count
+            groups.append(sub)
+        return counts
+
+    for n in res.new_nodes:
+        nodes.append(SolvedNode(option=n.decided,
+                                pod_counts=add_subgroups(n.pods),
+                                provisioner=n.provisioner))
+    existing_counts = {}
+    existing_by_group = {}
+    for name, pods in res.existing_assignments.items():
+        if not pods:
+            continue
+        existing_counts[name] = len(pods)
+        existing_by_group[name] = add_subgroups(pods)
+    unschedulable = {}
+    for p in res.unschedulable:
+        g_idx = len(groups)
+        groups.append(PodGroup(spec=p, count=1, pod_names=[p.name]))
+        unschedulable[g_idx] = 1
+    return SolveResult(nodes=nodes, existing_counts=existing_counts,
+                       unschedulable=unschedulable, groups=groups,
+                       existing_by_group=existing_by_group)

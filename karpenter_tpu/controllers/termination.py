@@ -1,0 +1,106 @@
+"""Termination controller: finalizer -> cordon + drain -> cloud delete.
+
+Parity target: karpenter-core's termination controller (SURVEY.md §2.2;
+website deprovisioning.md:24-58; designs/termination.md): nodes carry a
+finalizer; deletion cordons the node, drains pods respecting PDBs and the
+`karpenter.sh/do-not-evict` annotation, then calls CloudProvider.Delete and
+removes the finalizer.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..events import EventRecorder
+from ..metrics import NAMESPACE, REGISTRY, Registry
+from ..models.cluster import ClusterState, pod_evictable
+from ..utils import errors as cloud_errors
+from ..utils.clock import Clock
+
+log = logging.getLogger("karpenter.termination")
+
+
+class TerminationController:
+    def __init__(self, kube, cloudprovider, cluster: ClusterState,
+                 clock: Optional[Clock] = None,
+                 recorder: Optional[EventRecorder] = None,
+                 registry: Optional[Registry] = None):
+        self.kube = kube
+        self.cloudprovider = cloudprovider
+        self.cluster = cluster
+        self.clock = clock or Clock()
+        self.recorder = recorder or EventRecorder(clock=self.clock)
+        reg = registry or REGISTRY
+        self.terminated = reg.counter(
+            f"{NAMESPACE}_nodes_terminated_total", "Nodes terminated.",
+            ("provisioner",))
+        self.termination_time = reg.histogram(
+            f"{NAMESPACE}_nodes_termination_time_seconds",
+            "Time from deletion request to cloud delete.")
+
+    def request_deletion(self, node_name: str) -> bool:
+        """Mark a node for deletion (the finalizer-bearing delete)."""
+        node = self.cluster.nodes.get(node_name)
+        if node is None:
+            return False
+        node.marked_for_deletion = True
+        node.deletion_requested_ts = self.clock.now()
+        self.recorder.normal(f"node/{node_name}", "TerminationRequested",
+                             "node marked for deletion")
+        return True
+
+    def reconcile_once(self) -> "list[str]":
+        """Process all marked nodes; returns names fully terminated."""
+        done = []
+        for name in sorted(self.cluster.nodes):
+            node = self.cluster.nodes[name]
+            if not node.marked_for_deletion:
+                continue
+            if not self._drain(node):
+                continue  # retry next reconcile (PDB/do-not-evict pressure)
+            try:
+                machine = self.kube.get("machines", node.machine_name)
+                if machine is not None:
+                    self.cloudprovider.delete(machine)
+                    self.kube.delete("machines", node.machine_name)
+                elif node.provider_id:
+                    from ..models.machine import parse_provider_id
+
+                    _, iid = parse_provider_id(node.provider_id)
+                    self.cloudprovider.instances.delete(iid)
+            except cloud_errors.CloudError as e:
+                if not cloud_errors.is_not_found(e):
+                    log.warning("cloud delete of %s failed: %s", name, e)
+                    continue
+            self.cluster.delete_node(name)
+            self.kube.delete("nodes", name)
+            self.terminated.inc(provisioner=node.provisioner_name)
+            if node.deletion_requested_ts:
+                self.termination_time.observe(
+                    self.clock.now() - node.deletion_requested_ts)
+            self.recorder.normal(f"node/{name}", "Terminated", "node terminated")
+            done.append(name)
+        return done
+
+    def _drain(self, node) -> bool:
+        """Evict pods; False when any pod cannot be evicted yet
+        (PDB exhausted / do-not-evict, deprovisioning.md:24-58)."""
+        healthy = {
+            pdb.name: sum(1 for n in self.cluster.nodes.values()
+                          for p in n.pods if pdb.matches(p))
+            for pdb in self.cluster.pdbs
+        }
+        blockers = [p for p in node.non_daemon_pods()
+                    if not pod_evictable(p, self.cluster.pdbs, healthy)]
+        if blockers:
+            self.recorder.warning(
+                f"node/{node.name}", "FailedDraining",
+                f"{len(blockers)} pod(s) cannot be evicted")
+            return False
+        for pod in list(node.non_daemon_pods()):
+            self.kube.delete("pods", pod.name)
+            self.recorder.normal(f"pod/{pod.name}", "Evicted",
+                                 f"evicted from {node.name}")
+        node.pods = [p for p in node.pods if p.is_daemon()]
+        return True

@@ -41,6 +41,10 @@ class FleetOverride:
     zone: str
     subnet_id: str = ""
     price: float = 0.0
+    # per-override launch template (multi-arch fleets: one LT per arch,
+    # reference getLaunchTemplateConfigs instance.go:289-323); empty uses
+    # the request default
+    launch_template: str = ""
 
 
 @dataclasses.dataclass
@@ -142,10 +146,16 @@ class FakeCloud:
 
     def _create_fleet(self, request: CreateFleetRequest) -> CreateFleetResponse:
         with self.lock:
-            if request.launch_template and request.launch_template not in self.launch_templates:
-                raise cloud_errors.CloudError(
-                    cloud_errors.LAUNCH_TEMPLATE_NOT_FOUND,
-                    f"launch template {request.launch_template} not found")
+            lts_used = {o.launch_template or request.launch_template
+                        for o in request.overrides}
+            lts_used.discard("")
+            if request.launch_template:
+                lts_used.add(request.launch_template)
+            for lt in lts_used:
+                if lt not in self.launch_templates:
+                    raise cloud_errors.CloudError(
+                        cloud_errors.LAUNCH_TEMPLATE_NOT_FOUND,
+                        f"launch template {lt} not found")
             # lowest-price allocation across overrides, skipping ICE pools
             # (EC2 CreateFleet lowest-price / fake ec2api.go:106-120)
             errors: "list[FleetPoolError]" = []
@@ -159,9 +169,10 @@ class FakeCloud:
             ids = []
             if usable:
                 choice = usable[0]
+                lt_name = choice.launch_template or request.launch_template
+                lt = self.launch_templates.get(lt_name)
                 for _ in range(request.capacity):
                     iid = f"i-{next(self._id_counter):08d}"
-                    lt = self.launch_templates.get(request.launch_template)
                     self.instances[iid] = CloudInstance(
                         id=iid,
                         instance_type=choice.instance_type,
@@ -172,7 +183,7 @@ class FakeCloud:
                         launch_time=self.clock.now(),
                         image_id=request.image_id or (lt.image_id if lt else ""),
                         subnet_id=choice.subnet_id,
-                        launch_template=request.launch_template,
+                        launch_template=lt_name,
                     )
                     ids.append(iid)
             return CreateFleetResponse(instance_ids=ids, errors=errors)

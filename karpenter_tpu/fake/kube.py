@@ -1,0 +1,120 @@
+"""In-memory coordination plane — the kube-apiserver analogue.
+
+The reference's communication backend #1 is the kube-apiserver
+(watch/list/patch; SURVEY.md §2.3). Its hermetic test tier gets a REAL
+apiserver via envtest (suite_test.go:74-101). This build keeps the same
+shape with an in-process object store + watch feed: controllers reconcile
+against `KubeStore` exactly as they would against a cluster, and a real
+kube client can replace it 1:1 (same method surface).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, Optional
+
+from ..apis.nodetemplate import NodeTemplate
+from ..apis.provisioner import Provisioner
+from ..models.cluster import PodDisruptionBudget, StateNode
+from ..models.machine import Machine
+from ..models.pod import PodSpec
+
+
+class Conflict(Exception):
+    pass
+
+
+class KubeStore:
+    """Typed object buckets with list/get/create/update/delete + watchers."""
+
+    KINDS = ("pods", "nodes", "machines", "provisioners", "nodetemplates", "pdbs")
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: "dict[str, dict[str, object]]" = {k: {} for k in self.KINDS}
+        self._watchers: "list[Callable[[str, str, object], None]]" = []
+        self._rv = itertools.count(1)
+
+    # -- generic ---------------------------------------------------------------
+
+    def _notify(self, kind: str, action: str, obj) -> None:
+        for w in list(self._watchers):
+            try:
+                w(kind, action, obj)
+            except Exception:
+                pass
+
+    def watch(self, fn: Callable[[str, str, object], None]) -> None:
+        """fn(kind, action in {added, modified, deleted}, object)."""
+        with self._lock:
+            self._watchers.append(fn)
+
+    def create(self, kind: str, name: str, obj) -> None:
+        with self._lock:
+            bucket = self._objects[kind]
+            if name in bucket:
+                raise Conflict(f"{kind}/{name} already exists")
+            bucket[name] = obj
+        self._notify(kind, "added", obj)
+
+    def update(self, kind: str, name: str, obj) -> None:
+        with self._lock:
+            self._objects[kind][name] = obj
+        self._notify(kind, "modified", obj)
+
+    def get(self, kind: str, name: str):
+        with self._lock:
+            return self._objects[kind].get(name)
+
+    def delete(self, kind: str, name: str):
+        with self._lock:
+            obj = self._objects[kind].pop(name, None)
+        if obj is not None:
+            self._notify(kind, "deleted", obj)
+        return obj
+
+    def list(self, kind: str) -> list:
+        with self._lock:
+            return list(self._objects[kind].values())
+
+    # -- typed convenience -----------------------------------------------------
+
+    def pods(self) -> "list[PodSpec]":
+        return self.list("pods")
+
+    def pending_pods(self) -> "list[PodSpec]":
+        """Unschedulable pods: unbound non-daemon pods (the provisioning
+        controller's watch predicate)."""
+        return [p for p in self.pods() if not p.node_name and not p.is_daemon()]
+
+    def daemon_pods(self) -> "list[PodSpec]":
+        return [p for p in self.pods() if p.is_daemon()]
+
+    def bind_pod(self, pod_name: str, node_name: str) -> None:
+        import dataclasses
+
+        with self._lock:
+            pod = self._objects["pods"].get(pod_name)
+            if pod is None:
+                return
+            if pod.node_name:
+                raise Conflict(f"pod {pod_name} already bound to {pod.node_name}")
+            bound = dataclasses.replace(pod, node_name=node_name)
+            self._objects["pods"][pod_name] = bound
+        self._notify("pods", "modified", bound)
+
+    def nodes(self) -> "list[StateNode]":
+        return self.list("nodes")
+
+    def machines(self) -> "list[Machine]":
+        return self.list("machines")
+
+    def provisioners(self) -> "list[Provisioner]":
+        return self.list("provisioners")
+
+    def nodetemplates(self) -> "list[NodeTemplate]":
+        return self.list("nodetemplates")
+
+    def pdbs(self) -> "list[PodDisruptionBudget]":
+        return self.list("pdbs")

@@ -35,6 +35,7 @@ class StateNode:
     initialized: bool = True
     machine_name: str = ""
     marked_for_deletion: bool = False
+    deletion_requested_ts: float = 0.0
     drifted: bool = False
 
     def used_vector(self) -> "list[int]":

@@ -1,0 +1,135 @@
+"""Operator: assembles and runs the whole controller plane.
+
+Parity target: /root/reference/cmd/controller/main.go:33-65 + core
+operator.NewOperator — manager wiring, leader election with an `Elected()`
+async-start channel (deferred cache hydration, launchtemplate.go:76-85),
+healthz registry, settings injection, controller registration and Start().
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from .apis.settings import Settings
+from .cloudprovider import CloudProvider
+from .controllers.deprovisioning import DeprovisioningController
+from .controllers.interruption import FakeQueue, InterruptionController
+from .controllers.nodetemplate import NodeTemplateController
+from .controllers.provisioning import ProvisioningController
+from .controllers.termination import TerminationController
+from .events import EventRecorder
+from .metrics import REGISTRY, decorate_cloudprovider
+from .models.cluster import ClusterState
+from .models.instancetype import Catalog
+from .fake.kube import KubeStore
+from .utils.clock import Clock
+
+log = logging.getLogger("karpenter.operator")
+
+
+class Operator:
+    def __init__(self, cloud, settings: Settings, catalog: Catalog,
+                 kube: Optional[KubeStore] = None,
+                 clock: Optional[Clock] = None,
+                 queue=None):
+        settings.validate()
+        self.settings = settings
+        self.clock = clock or Clock()
+        self.kube = kube or KubeStore()
+        self.cluster = ClusterState()
+        self.recorder = EventRecorder(clock=self.clock)
+        self.cloudprovider = CloudProvider(cloud, settings, catalog, clock=self.clock)
+        self.metrics_cloudprovider = decorate_cloudprovider(self.cloudprovider)
+        self.elected = threading.Event()  # leader election (single process)
+        self._stop = threading.Event()
+        self._threads: "list[threading.Thread]" = []
+
+        self.provisioning = ProvisioningController(
+            self.kube, self.cloudprovider, self.cluster, settings,
+            clock=self.clock, recorder=self.recorder)
+        self.termination = TerminationController(
+            self.kube, self.cloudprovider, self.cluster,
+            clock=self.clock, recorder=self.recorder)
+        self.deprovisioning = DeprovisioningController(
+            self.kube, self.cloudprovider, self.cluster, self.termination,
+            clock=self.clock, recorder=self.recorder,
+            provisioning=self.provisioning)
+        self.nodetemplate = NodeTemplateController(
+            self.kube, self.cloudprovider.subnets,
+            self.cloudprovider.security_groups, clock=self.clock)
+        self.interruption = None
+        if settings.interruption_queue_name:
+            self.queue = queue or FakeQueue(settings.interruption_queue_name,
+                                            clock=self.clock)
+            self.interruption = InterruptionController(
+                self.kube, self.cluster, self.queue, self.cloudprovider.ice,
+                termination=self.termination, clock=self.clock,
+                recorder=self.recorder)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        """Start background controller loops (operator Start, main.go:64)."""
+        self.elected.set()
+        # leader-gated hydration (launchtemplate.go:76-85)
+        self.cloudprovider.launch_templates.hydrate()
+
+        def loop(name, fn, interval):
+            def run():
+                while not self._stop.is_set():
+                    try:
+                        fn()
+                    except Exception as e:
+                        log.exception("%s failed: %s", name, e)
+                    self._stop.wait(interval)
+
+            t = threading.Thread(target=run, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+        t = threading.Thread(target=self.provisioning.run, args=(self._stop,),
+                             name="provisioning", daemon=True)
+        t.start()
+        self._threads.append(t)
+        loop("termination", self.termination.reconcile_once, 0.2)
+        loop("deprovisioning", self.deprovisioning.reconcile_once, 2.0)
+        loop("nodetemplate", self.nodetemplate.reconcile_once, 5.0)
+        if self.interruption is not None:
+            t2 = threading.Thread(target=self.interruption.run,
+                                  args=(self._stop,), name="interruption",
+                                  daemon=True)
+            t2.start()
+            self._threads.append(t2)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self.provisioning.stop()
+        if self.interruption is not None:
+            self.interruption.stop()
+        self.cloudprovider.stop()
+
+    # -- health ----------------------------------------------------------------
+
+    def healthz(self) -> bool:
+        return True
+
+    def livez(self) -> bool:
+        return self.cloudprovider.livez()
+
+    def metrics_text(self) -> str:
+        return REGISTRY.expose()
+
+    # -- synchronous drive (tests / single-shot CLI) ----------------------------
+
+    def reconcile_all_once(self) -> None:
+        """One deterministic pass over every controller (hermetic tests)."""
+        self.nodetemplate.reconcile_once()
+        self.provisioning.reconcile_once()
+        if self.interruption is not None:
+            self.interruption.reconcile_once()
+        self.deprovisioning.reconcile_once()
+        self.termination.reconcile_once()

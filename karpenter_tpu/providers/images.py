@@ -173,5 +173,8 @@ class ImageProvider:
                 except Exception:
                     continue
                 out.append(ResolvedImage(image_id=image_id, arch=arch))
-        self.cache.set(key, out)
+        if out:
+            # never cache an empty resolution: one transient backend failure
+            # must not block launches for a whole TTL window
+            self.cache.set(key, out)
         return out

@@ -93,8 +93,15 @@ class InstanceProvider:
         lts = self.launch_templates.ensure_all(
             template, labels=labels, taints=machine.spec.taints,
             archs=self._archs(types), max_pods=machine.spec.kubelet_max_pods)
+        if not lts:
+            raise cloud_errors.CloudError(
+                "ResourceNotFound",
+                f"no images resolved for template {template.name}")
+        # arch -> launch template so every override boots the right image
+        # (multi-arch fleet, getLaunchTemplateConfigs instance.go:289-323)
+        arch_to_lt = {arch: name for name, archs in lts.items() for arch in archs}
         overrides = self.get_overrides(template, types, capacity_type,
-                                       machine.spec.requirements)
+                                       machine.spec.requirements, arch_to_lt)
         if not overrides:
             raise cloud_errors.CloudError(
                 "UnfulfillableCapacity", "no offering x subnet overrides")
@@ -108,9 +115,8 @@ class InstanceProvider:
             f"kubernetes.io/cluster/{self.settings.cluster_name}": "owned",
             **self.settings.tags, **template.tags,
         }
-        lt_name = next(iter(lts))
         request = CreateFleetRequest(
-            launch_template=lt_name, overrides=overrides, capacity=1,
+            launch_template=next(iter(lts)), overrides=overrides, capacity=1,
             capacity_type=capacity_type, tags=tags)
         try:
             resp = self.fleet.create_fleet(request)
@@ -120,7 +126,8 @@ class InstanceProvider:
             raise
         except cloud_errors.CloudError as e:
             if cloud_errors.is_launch_template_not_found(e):
-                self.launch_templates.invalidate(lt_name)
+                for name in lts:
+                    self.launch_templates.invalidate(name)
             raise
         for err in resp.errors:  # partial pool failures still poison the cache
             self.ice.mark_unavailable(err.code, err.instance_type, err.zone,
@@ -186,11 +193,17 @@ class InstanceProvider:
         return wk.CAPACITY_TYPE_ON_DEMAND
 
     def get_overrides(self, template: NodeTemplate, types: "list[InstanceType]",
-                      capacity_type: str, reqs: Requirements) -> "list[FleetOverride]":
+                      capacity_type: str, reqs: Requirements,
+                      arch_to_lt: "dict[str, str] | None" = None,
+                      ) -> "list[FleetOverride]":
         """offerings x zonal subnets cross product (instance.go:325-373)."""
         zone_req = reqs.get(wk.LABEL_ZONE)
         overrides: "list[FleetOverride]" = []
         for t in types:
+            arch = t.labels_dict().get(wk.LABEL_ARCH, "amd64")
+            lt = (arch_to_lt or {}).get(arch, "")
+            if arch_to_lt is not None and not lt:
+                continue  # no image for this arch -> type not launchable
             for o in t.offerings.available():
                 if o.capacity_type != capacity_type:
                     continue
@@ -204,7 +217,7 @@ class InstanceProvider:
                     continue
                 overrides.append(FleetOverride(
                     instance_type=t.name, zone=o.zone, subnet_id=subnet.id,
-                    price=o.price))
+                    price=o.price, launch_template=lt))
         return overrides
 
     # -- read / delete ---------------------------------------------------------

@@ -55,6 +55,8 @@ class SolveResult:
     existing_counts: "dict[str, int]"  # existing node name -> pods placed
     unschedulable: "dict[int, int]"  # group index -> pod count
     groups: list
+    # existing node name -> {group index -> pods placed} (binding plan)
+    existing_by_group: "dict[str, dict[int, int]]" = dataclasses.field(default_factory=dict)
 
     def decisions(self) -> "list[tuple[str, str, str, int]]":
         """Fingerprint [(type, zone, capacityType, pods)] — comparable with
@@ -162,5 +164,10 @@ def decode(enc: EncodedProblem, result: PackResult, existing_names: "list[str]")
         name: int(ex_totals[e]) for e, name in enumerate(existing_names)
         if ex_totals[e] > 0
     }
+    existing_by_group = {
+        name: {int(g): int(ex_assign[g, e]) for g in range(G) if ex_assign[g, e] > 0}
+        for e, name in enumerate(existing_names) if ex_totals[e] > 0
+    }
     unschedulable = {int(g): int(unsched[g]) for g in np.nonzero(unsched[:G] > 0)[0]}
-    return SolveResult(nodes, existing_counts, unschedulable, enc.groups)
+    return SolveResult(nodes, existing_counts, unschedulable, enc.groups,
+                       existing_by_group)

@@ -1,0 +1,312 @@
+"""Controller-plane integration tests: the hermetic analogue of the
+reference's E2E suites (utilization, emptiness, expiration, drift,
+interruption, consolidation) run against KubeStore + FakeCloud with the REAL
+provisioning/termination/deprovisioning controllers in the loop
+(suite_test.go:63-66 'core-in-the-loop' pattern)."""
+
+import json
+
+import pytest
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.nodetemplate import NodeTemplate
+from karpenter_tpu.apis.provisioner import Limits, Provisioner
+from karpenter_tpu.apis.settings import Settings
+from karpenter_tpu.controllers.interruption import FakeQueue
+from karpenter_tpu.fake.cloud import FakeCloud
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.models.requirements import Requirements, OP_IN
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def catalog():
+    return Catalog(types=[
+        make_instance_type("t.small", cpu=2, memory="2Gi", od_price=0.05, spot_price=0.02),
+        make_instance_type("m.large", cpu=4, memory="16Gi", od_price=0.20, spot_price=0.07),
+        make_instance_type("m.xlarge", cpu=16, memory="64Gi", od_price=0.80, spot_price=0.28),
+    ])
+
+
+@pytest.fixture
+def op():
+    clock = FakeClock()
+    cloud = FakeCloud(catalog=catalog(), clock=clock)
+    settings = Settings(cluster_name="itest",
+                        cluster_endpoint="https://k.example",
+                        interruption_queue_name="iq",
+                        batch_idle_duration=0.0, batch_max_duration=0.0)
+    operator = Operator(cloud, settings, catalog(), clock=clock)
+    operator.kube.create("nodetemplates", "default", NodeTemplate(
+        name="default",
+        subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"}))
+    operator.cloudprovider.register_nodetemplate(
+        operator.kube.get("nodetemplates", "default"))
+    yield operator
+    operator.stop()
+
+
+def add_provisioner(op, name="default", **kw):
+    p = Provisioner(name=name, provider_ref="default", **kw)
+    p.set_defaults()
+    p.validate()
+    op.kube.create("provisioners", name, p)
+    return p
+
+
+class TestProvisioning:
+    def test_utilization_100_pods_100_nodes(self, op):
+        # E2E parity: utilization/suite_test.go:40-58 — 1.5-cpu pods on a
+        # 2-cpu catalog type => one node per pod
+        add_provisioner(op, requirements=Requirements.of(
+            (wk.LABEL_INSTANCE_TYPE, OP_IN, ["t.small"])))
+        for i in range(100):
+            op.kube.create("pods", f"p{i}",
+                           make_pod(f"p{i}", cpu="1.5", memory="128Mi"))
+        op.provisioning.reconcile_once()
+        assert len(op.cluster.nodes) == 100
+        assert len(op.kube.pending_pods()) == 0
+        assert all(len(n.pods) == 1 for n in op.cluster.nodes.values())
+        # every pod bound to a distinct node; machines exist in store
+        assert len(op.kube.machines()) == 100
+        assert op.cloudprovider.cloud.create_fleet_api.called_with_count >= 1
+
+    def test_bin_packing_one_node(self, op):
+        add_provisioner(op)
+        for i in range(10):
+            op.kube.create("pods", f"p{i}",
+                           make_pod(f"p{i}", cpu="1", memory="2Gi"))
+        op.provisioning.reconcile_once()
+        assert len(op.cluster.nodes) == 1
+        (node,) = op.cluster.nodes.values()
+        assert node.instance_type == "m.xlarge"
+        assert len(node.pods) == 10
+
+    def test_existing_capacity_reused(self, op):
+        add_provisioner(op)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        assert len(op.cluster.nodes) == 1
+        op.kube.create("pods", "b", make_pod("b", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        assert len(op.cluster.nodes) == 1  # pod b joined the in-flight node
+        (node,) = op.cluster.nodes.values()
+        assert sorted(p.name for p in node.pods) == ["a", "b"]
+
+    def test_limits_respected(self, op):
+        add_provisioner(op, limits=Limits(cpu_millis=4000))
+        for i in range(40):
+            op.kube.create("pods", f"p{i}", make_pod(f"p{i}", cpu="1.9", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        total_cpu = sum(n.allocatable[wk.RESOURCE_INDEX[wk.RESOURCE_CPU]]
+                       for n in op.cluster.nodes.values())
+        assert total_cpu <= 4000 + 16000  # at most one node over (race-free check)
+        assert op.recorder.by_reason("LimitExceeded")
+
+    def test_unschedulable_event(self, op):
+        add_provisioner(op)
+        op.kube.create("pods", "huge", make_pod("huge", cpu="64", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        assert op.recorder.by_reason("FailedScheduling")
+
+
+class TestEmptinessExpiration:
+    def test_emptiness_ttl(self, op):
+        add_provisioner(op, ttl_seconds_after_empty=30)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        (name,) = op.cluster.nodes
+        # pod removed -> node becomes empty
+        node = op.cluster.nodes[name]
+        node.pods.clear()
+        op.deprovisioning.reconcile_emptiness()
+        assert not op.cluster.nodes[name].marked_for_deletion  # TTL not elapsed
+        op.clock.step(31)
+        op.deprovisioning.reconcile_emptiness()
+        assert op.cluster.nodes[name].marked_for_deletion
+        op.termination.reconcile_once()
+        assert not op.cluster.nodes  # drained + cloud-deleted
+
+    def test_expiration_ttl(self, op):
+        add_provisioner(op, ttl_seconds_until_expired=3600)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        op.deprovisioning.reconcile_expiration()
+        (node,) = op.cluster.nodes.values()
+        assert not node.marked_for_deletion
+        op.clock.step(3601)
+        op.deprovisioning.reconcile_expiration()
+        assert node.marked_for_deletion
+
+
+class TestDrift:
+    def test_drift_replaces_node(self, op):
+        op.settings.feature_gates.drift_enabled = True
+        add_provisioner(op)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        assert op.deprovisioning.reconcile_drift() == []
+        op.cloudprovider.cloud.ssm_parameters[
+            "/karpenter-tpu/images/default/amd64/latest"] = "img-new"
+        op.cloudprovider.images.cache.flush()
+        drifted = op.deprovisioning.reconcile_drift()
+        assert len(drifted) == 1
+
+
+class TestInterruption:
+    def spot_message(self, iid):
+        return json.dumps({
+            "source": "cloud.spot",
+            "detail-type": "Spot Instance Interruption Warning",
+            "detail": {"instance-id": iid},
+        })
+
+    def test_spot_interruption_drains_and_marks_ice(self, op):
+        add_provisioner(op, requirements=Requirements.of(
+            (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot"])))
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        (node,) = op.cluster.nodes.values()
+        assert node.capacity_type == "spot"
+        from karpenter_tpu.models.machine import parse_provider_id
+
+        _, iid = parse_provider_id(node.provider_id)
+        op.queue.send(self.spot_message(iid))
+        handled = op.interruption.reconcile_once()
+        assert handled == 1
+        assert node.marked_for_deletion
+        assert op.cloudprovider.ice.is_unavailable(
+            "spot", node.instance_type, node.zone)
+        assert op.interruption.received.value(message_type="SpotInterruption") == 1
+        assert op.interruption.deleted.value() == 1
+
+    def test_unparseable_and_unknown_messages_are_noop(self, op):
+        add_provisioner(op)
+        op.queue.send("{malformed")
+        op.queue.send(json.dumps({"source": "x", "detail-type": "y"}))
+        assert op.interruption.reconcile_once() == 2
+        assert op.interruption.received.value(message_type="NoOp") == 2
+
+    def test_state_change_only_on_stopping_states(self, op):
+        add_provisioner(op)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        (node,) = op.cluster.nodes.values()
+        from karpenter_tpu.models.machine import parse_provider_id
+
+        _, iid = parse_provider_id(node.provider_id)
+        op.queue.send(json.dumps({
+            "source": "cloud.compute",
+            "detail-type": "Instance State-change Notification",
+            "detail": {"instance-id": iid, "state": "running"},
+        }))
+        op.interruption.reconcile_once()
+        assert not node.marked_for_deletion
+        op.queue.send(json.dumps({
+            "source": "cloud.compute",
+            "detail-type": "Instance State-change Notification",
+            "detail": {"instance-id": iid, "state": "stopping"},
+        }))
+        op.interruption.reconcile_once()
+        assert node.marked_for_deletion
+
+
+class TestConsolidationLoop:
+    def test_consolidation_deletes_underutilized(self, op):
+        from karpenter_tpu.models.cluster import StateNode
+
+        add_provisioner(op, consolidation_enabled=True)
+        # seed two half-empty m.large nodes; one's pod fits on the other
+        for name, pods in (("n-1", ["a"]), ("n-2", ["b"])):
+            node = StateNode(
+                name=name,
+                labels={wk.LABEL_ARCH: "amd64", wk.LABEL_OS: "linux",
+                        wk.LABEL_ZONE: "zone-1a",
+                        wk.LABEL_CAPACITY_TYPE: "on-demand",
+                        wk.LABEL_INSTANCE_TYPE: "m.large"},
+                allocatable=wk.capacity_vector({wk.RESOURCE_CPU: 4000,
+                                                wk.RESOURCE_MEMORY: 16 * 2**30,
+                                                wk.RESOURCE_PODS: 110}),
+                price=0.20, provisioner_name="default",
+                pods=[make_pod(p, cpu="1", memory="2Gi", node_name=name)
+                      for p in pods],
+            )
+            op.cluster.add_node(node)
+            op.kube.create("nodes", name, node)
+        action = op.deprovisioning.reconcile_consolidation()
+        assert action is not None
+        assert action.kind == "delete"
+        assert op.cluster.nodes[action.node].marked_for_deletion
+        assert op.deprovisioning.actions.value(action="consolidation-delete") == 1
+        # termination completes the action (pods evicted for rescheduling)
+        done = op.termination.reconcile_once()
+        assert done == [action.node]
+        assert len(op.cluster.nodes) == 1
+
+
+class TestNodeTemplateController:
+    def test_status_resolution(self, op):
+        op.nodetemplate.reconcile_once()
+        t = op.kube.get("nodetemplates", "default")
+        assert [s["id"] for s in t.status.subnets] == [
+            "subnet-zone-1a", "subnet-zone-1b", "subnet-zone-1c"]  # free-ip order
+        # generation-change predicate: second call is a no-op until requeue
+        assert op.nodetemplate.reconcile_once() == 0
+        t.generation += 1
+        assert op.nodetemplate.reconcile_once() == 1
+
+
+class TestTermination:
+    def test_do_not_evict_blocks_drain(self, op):
+        add_provisioner(op)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi",
+                                             do_not_evict=True))
+        op.provisioning.reconcile_once()
+        (name,) = op.cluster.nodes
+        op.termination.request_deletion(name)
+        assert op.termination.reconcile_once() == []  # blocked
+        assert op.recorder.by_reason("FailedDraining")
+        # pod deleted -> drain proceeds
+        op.cluster.nodes[name].pods.clear()
+        assert op.termination.reconcile_once() == [name]
+
+
+class TestReviewRegressions:
+    def test_multiarch_override_lt_pairing(self, op):
+        # each override must carry its arch's launch template
+        cat = op.cloudprovider.instance_types.source
+        cat.types.append(
+            __import__("karpenter_tpu.models.instancetype",
+                       fromlist=["make_instance_type"]).make_instance_type(
+                "arm.large", cpu=4, memory="16Gi", arch="arm64", od_price=0.02))
+        cat.bump()
+        add_provisioner(op, name="multi", requirements=Requirements.of(
+            (wk.LABEL_ARCH, OP_IN, ["amd64", "arm64"])))
+        op.kube.create("pods", "m0", make_pod("m0", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        (node,) = op.cluster.nodes.values()
+        assert node.instance_type == "arm.large"  # cheapest
+        iid = node.provider_id.rsplit("/", 1)[1]
+        inst = op.cloudprovider.cloud.instances[iid]
+        lt = op.cloudprovider.cloud.launch_templates[inst.launch_template]
+        assert lt.image_id == "img-arm64-1"  # arm image, not amd64
+
+    def test_missing_image_raises_clean_error(self, op):
+        op.cloudprovider.cloud.ssm_parameters.clear()
+        op.cloudprovider.images.cache.flush()
+        add_provisioner(op)
+        op.kube.create("pods", "x", make_pod("x", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        assert op.recorder.by_reason("LaunchFailed")
+        assert not op.cluster.nodes
+
+    def test_queue_redelivery_after_visibility_timeout(self, op):
+        op.queue.visibility_seconds = 5
+        op.queue.send("{malformed")
+        msgs = op.queue.receive()
+        assert len(msgs) == 1  # received, NOT deleted
+        assert op.queue.receive() == []
+        op.clock.step(6)
+        again = op.queue.receive()
+        assert len(again) == 1 and again[0].body == "{malformed"
