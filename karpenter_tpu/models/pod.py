@@ -95,8 +95,13 @@ class PodSpec:
         return self.owner_kind == "DaemonSet"
 
     def group_key(self):
-        """Pods with equal group keys are interchangeable for scheduling."""
-        return (
+        """Pods with equal group keys are interchangeable for scheduling.
+        Memoized per instance (frozen dataclass) — grouping a 10k-pod batch
+        is on the host-side critical path of every scheduling cycle."""
+        k = self.__dict__.get("_group_key")
+        if k is not None:
+            return k
+        k = (
             self.requests,
             tuple((k, op, tuple(vals)) for k, op, vals in self.requirements.to_specs()),
             self.tolerations,
@@ -108,6 +113,8 @@ class PodSpec:
             # selectors would balance the union instead of each deployment
             self.labels,
         )
+        object.__setattr__(self, "_group_key", k)
+        return k
 
 
 def make_pod(

@@ -197,3 +197,44 @@ def pack_impl(inputs: PackInputs, n_slots: int) -> PackResult:
 
 
 pack = functools.partial(jax.jit, static_argnames=("n_slots",))(pack_impl)
+
+
+def pack_flat_impl(inputs: PackInputs, n_slots: int) -> jax.Array:
+    """pack_impl with everything the decoder needs flattened into ONE i32
+    vector, so the host pays exactly one device->host transfer per solve.
+    On a tunneled/remote device each sync is a full network round trip
+    (~tens of ms), which would otherwise dominate the <100ms cycle budget
+    (SURVEY.md §7.3 "host-device round-trip budget").
+
+    Layout: [assign (G*N) | ex_assign (G*Ne) | unsched (G) | active (N) |
+             nprov (N) | decided (N) | n_open (1)]
+    """
+    r = pack_impl(inputs, n_slots)
+    return jnp.concatenate([
+        r.assign.ravel(), r.ex_assign.ravel(), r.unsched.ravel(),
+        r.active.astype(jnp.int32), r.nprov, r.decided,
+        r.n_open.reshape(1),
+    ])
+
+
+pack_flat = functools.partial(jax.jit, static_argnames=("n_slots",))(pack_flat_impl)
+
+
+def unflatten_result(flat, G: int, N: int, Ne: int) -> PackResult:
+    """Host-side parse of pack_flat's single buffer back into PackResult
+    (used is omitted — the decoder never reads it)."""
+    import numpy as np
+
+    o = 0
+    assign = flat[o:o + G * N].reshape(G, N); o += G * N
+    ex_assign = flat[o:o + G * Ne].reshape(G, Ne); o += G * Ne
+    unsched = flat[o:o + G]; o += G
+    active = flat[o:o + N].astype(bool); o += N
+    nprov = flat[o:o + N]; o += N
+    decided = flat[o:o + N]; o += N
+    n_open = flat[o]
+    return PackResult(
+        assign=assign, ex_assign=ex_assign, unsched=unsched,
+        used=np.zeros((0,), np.int32), active=active, nprov=nprov,
+        decided=decided, n_open=n_open,
+    )

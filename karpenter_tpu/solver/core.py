@@ -25,7 +25,7 @@ from ..apis.provisioner import Provisioner
 from ..models.encode import EncodedProblem, OptionGrid, build_grid, encode_problem
 from ..models.instancetype import Catalog
 from ..models.pod import PodSpec
-from ..ops.packer import PackInputs, PackResult, pack
+from ..ops.packer import PackInputs, PackResult, pack_flat, unflatten_result
 from ..oracle.scheduler import ExistingNode, Option
 
 
@@ -137,13 +137,15 @@ def run_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None) -> PackRe
         ex_used=pad(enc.ex_used, Neb),
         ex_feas=ex_feas,
     )
-    inputs = jax.device_put(inputs)  # one transfer for the whole pytree
-    return pack(inputs, n_slots=Nb)
+    inputs = jax.device_put(inputs)  # async enqueue; no sync round trip
+    # One jitted dispatch returning ONE flat buffer: decode pays exactly one
+    # device->host round trip (the tunnel RTT floor; SURVEY.md §7.3).
+    flat = pack_flat(inputs, n_slots=Nb)
+    return unflatten_result(np.asarray(jax.device_get(flat)), Gb, Nb, Neb)
 
 
 def decode(enc: EncodedProblem, result: PackResult, existing_names: "list[str]") -> SolveResult:
-    # one bulk host transfer for the whole result pytree
-    host = jax.device_get(result._replace(used=result.used[:0]))
+    host = result  # already host-side numpy (see run_pack)
     assign, ex_assign, unsched = host.assign, host.ex_assign, host.unsched
     active, decided, nprov = host.active, host.decided, host.nprov
     G = len(enc.groups)

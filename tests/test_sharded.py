@@ -12,8 +12,8 @@ from karpenter_tpu.models.encode import encode_problem
 from karpenter_tpu.models.instancetype import Catalog, make_instance_type
 from karpenter_tpu.models.pod import make_pod
 from karpenter_tpu.parallel.sharded import make_mesh, sharded_pack
-from karpenter_tpu.solver.core import _bucket, run_pack
-from karpenter_tpu.ops.packer import PackInputs
+from karpenter_tpu.solver.core import _bucket
+from karpenter_tpu.ops.packer import PackInputs, pack
 
 
 def build_inputs():
@@ -60,7 +60,7 @@ def test_mesh_uses_all_devices():
 def test_sharded_pack_parity(n_devices):
     enc = build_inputs()
     inputs, n_slots = pad_inputs(enc)
-    base = run_pack(enc)
+    base = jax.device_get(pack(jax.device_put(inputs), n_slots=n_slots))
     mesh = make_mesh(n_devices)
     sh = sharded_pack(inputs, n_slots, mesh)
     for name in ("assign", "ex_assign", "unsched", "decided", "nprov"):
