@@ -1,0 +1,7 @@
+#!/bin/sh
+# Regenerate karpenter_tpu/solver/solver_pb2.py from solver.proto.
+# (Reference analogue: hack/code generators, Makefile codegen targets.)
+set -e
+cd "$(dirname "$0")/.."
+protoc -I karpenter_tpu/solver --python_out=karpenter_tpu/solver karpenter_tpu/solver/solver.proto
+echo "generated karpenter_tpu/solver/solver_pb2.py"

@@ -103,7 +103,7 @@ class PodSpec:
             return k
         k = (
             self.requests,
-            tuple((k, op, tuple(vals)) for k, op, vals in self.requirements.to_specs()),
+            self.requirements.canonical(),  # freezes: later in-place mutation raises
             self.tolerations,
             self.topology,
             self.anti_affinity_hostname,

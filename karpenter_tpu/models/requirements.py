@@ -164,6 +164,7 @@ class Requirements:
     def __init__(self, reqs: Iterable[Requirement] = ()):
         self._by_key: dict[str, Requirement] = {}
         self._specs_cache: "Optional[list]" = None
+        self._frozen = False
         for r in reqs:
             self.add(r)
 
@@ -192,6 +193,10 @@ class Requirements:
         )
 
     def add(self, req: Requirement) -> None:
+        if self._frozen:
+            raise RuntimeError(
+                "Requirements mutated after being hashed/canonicalized; "
+                "mutate a .copy() instead (copy-on-write contract)")
         existing = self._by_key.get(req.key)
         self._by_key[req.key] = existing.intersect(req) if existing else req
         self._specs_cache = None
@@ -207,6 +212,25 @@ class Requirements:
 
     def __len__(self):
         return len(self._by_key)
+
+    def canonical(self) -> "tuple[tuple[str, str, tuple[str, ...]], ...]":
+        """THE canonical hashable form (single owner — group_key dedupe,
+        __eq__/__hash__, and wire round-trip identity all route through
+        here). Freezes the object: publication into a hash/memo key makes
+        later in-place mutation a bug, so add() refuses it afterwards."""
+        self._frozen = True
+        return tuple((k, op, tuple(v)) for k, op, v in self.to_specs())
+
+    def __eq__(self, other) -> bool:
+        """Canonical (spec-level) equality: two Requirements are equal iff
+        they emit identical to_specs(), the same canonical form group_key
+        dedupe relies on — so wire round trips compare equal."""
+        if not isinstance(other, Requirements):
+            return NotImplemented
+        return self.to_specs() == other.to_specs()
+
+    def __hash__(self) -> int:
+        return hash(self.canonical())
 
     def copy(self) -> "Requirements":
         out = Requirements()

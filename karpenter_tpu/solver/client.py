@@ -1,0 +1,138 @@
+"""RemoteSolver: controller-side client for the solver gRPC service.
+
+Drop-in replacement for TPUSolver (same .solve signature), pluggable into
+ProvisioningController via solver_factory. Sync-on-demand: a Solve rejected
+with FAILED_PRECONDITION (stale catalog seqnum / provisioner hash) triggers
+one catalog Sync + retry — the wire analogue of the reference's
+seqnum-invalidated instance-type cache re-resolution
+(/root/reference/pkg/cloudprovider/instancetypes.go:104-120).
+
+Failure contract: any transport error raises SolverUnavailable; the
+provisioning controller catches it and runs the in-process oracle with
+identical semantics (the fallback contract, BASELINE.json north star —
+reference analogue: static pricing fallback, pricing.go:100-116).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import grpc
+
+from ..apis.provisioner import Provisioner
+from ..models.instancetype import Catalog
+from ..models.pod import PodGroup, PodSpec
+from ..oracle.scheduler import ExistingNode, Option
+from .core import SolvedNode, SolveResult
+from . import solver_pb2 as pb
+from . import wire
+from .service import SERVICE_NAME
+
+log = logging.getLogger("karpenter.solver.client")
+
+
+class SolverUnavailable(RuntimeError):
+    pass
+
+
+class StaleSync(RuntimeError):
+    """Server demanded a re-Sync (FAILED_PRECONDITION)."""
+
+
+class RemoteSolver:
+    def __init__(self, catalog: Catalog, provisioners: Sequence[Provisioner],
+                 target: str = "127.0.0.1:50151",
+                 channel: Optional[grpc.Channel] = None,
+                 timeout: float = 10.0):
+        self.catalog = catalog
+        self.provisioners = list(provisioners)
+        self.timeout = timeout
+        self._channel = channel or grpc.insecure_channel(target)
+        self._synced_seqnum = -1
+        self._prov_hash = wire.provisioners_hash(self.provisioners)
+        self._stubs = {
+            name: self._channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=resp_cls.FromString,
+            )
+            for name, resp_cls in (
+                ("Sync", pb.SyncResponse),
+                ("Solve", pb.SolveResponse),
+                ("Health", pb.HealthResponse),
+            )
+        }
+
+    # -- RPC plumbing --------------------------------------------------------------
+
+    def _call(self, name: str, request):
+        try:
+            return self._stubs[name](request, timeout=self.timeout)
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
+                raise StaleSync(e.details())
+            raise SolverUnavailable(f"{name}: {e.code().name}: {e.details()}")
+
+    def sync(self) -> int:
+        resp = self._call("Sync", pb.SyncRequest(
+            catalog=wire.catalog_to_wire(self.catalog),
+            provisioners=[wire.provisioner_to_wire(p) for p in self.provisioners],
+        ))
+        self._synced_seqnum = resp.seqnum
+        return resp.seqnum
+
+    def health(self) -> pb.HealthResponse:
+        return self._call("Health", pb.HealthRequest())
+
+    # -- solve ---------------------------------------------------------------------
+
+    def solve(self, pods: "list[PodSpec]",
+              existing: Sequence[ExistingNode] = (),
+              daemon_overhead: Optional[Sequence[int]] = None) -> SolveResult:
+        req = pb.SolveRequest(
+            catalog_seqnum=self.catalog.seqnum,
+            provisioner_hash=self._prov_hash,
+            pods=[wire.pod_to_wire(p) for p in pods],
+            existing=[wire.existing_to_wire(e) for e in existing],
+            daemon_overhead=list(daemon_overhead or ()),
+        )
+        if self._synced_seqnum != self.catalog.seqnum:
+            self.sync()
+        try:
+            resp = self._call("Solve", req)
+        except StaleSync:
+            # one re-sync + retry (server restarted or drifted)
+            self.sync()
+            resp = self._call("Solve", req)
+        return self._decode(resp, pods)
+
+    def _decode(self, resp: pb.SolveResponse, pods: "list[PodSpec]") -> SolveResult:
+        # Groups come back from the server (the encoder's partition is richer
+        # than raw group_pods: topology-spread groups split per domain);
+        # rebuild PodGroup views against our own PodSpec objects.
+        by_name = {p.name: p for p in pods}
+        groups = [
+            PodGroup(spec=by_name[g.pod_names[0]], count=len(g.pod_names),
+                     pod_names=list(g.pod_names))
+            for g in resp.groups
+        ]
+        provs = {p.name: p for p in self.provisioners}
+        nodes = []
+        for n in resp.nodes:
+            itype = self.catalog.by_name[n.instance_type]
+            nodes.append(SolvedNode(
+                option=Option(index=-1, itype=itype, zone=n.zone,
+                              capacity_type=n.capacity_type, price=n.price,
+                              alloc=tuple(itype.allocatable_vector())),
+                pod_counts={gc.group: gc.count for gc in n.pods},
+                provisioner=provs[n.provisioner],
+            ))
+        existing_by_group = {
+            e.node: {gc.group: gc.count for gc in e.pods} for e in resp.existing
+        }
+        existing_counts = {name: sum(d.values())
+                           for name, d in existing_by_group.items()}
+        unschedulable = {gc.group: gc.count for gc in resp.unschedulable}
+        return SolveResult(nodes, existing_counts, unschedulable, groups,
+                           existing_by_group)

@@ -1,0 +1,139 @@
+"""Solver gRPC service: the TPU-resident half of the architecture.
+
+Parity/architecture target: SURVEY.md §7.1 — controller half <-> solver half
+over gRPC (SolveRequest/SolveResponse), catalog arrays device-resident and
+versioned by seqnum so only the pod delta crosses the boundary per solve.
+The liveness Health RPC mirrors the reference's chained LivenessProbe
+(/root/reference/pkg/cloudprovider/cloudprovider.go:163-168).
+
+Service stubs are registered with grpc generic handlers (the image has
+grpcio but not grpcio-tools, so messages come from protoc --python_out and
+the method table is wired by hand).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent import futures
+from typing import Optional, Sequence
+
+import grpc
+
+from ..apis.provisioner import Provisioner
+from ..models.instancetype import Catalog
+from .core import SolveResult, TPUSolver
+from . import solver_pb2 as pb
+from . import wire
+
+log = logging.getLogger("karpenter.solver.service")
+
+SERVICE_NAME = "karpenter.solver.Solver"
+
+METHODS = {
+    "Sync": (pb.SyncRequest, pb.SyncResponse),
+    "Solve": (pb.SolveRequest, pb.SolveResponse),
+    "Health": (pb.HealthRequest, pb.HealthResponse),
+}
+
+
+def result_to_response(result: SolveResult, solve_ms: float,
+                       seqnum: int) -> pb.SolveResponse:
+    def counts(d: "dict[int, int]"):
+        return [pb.GroupCount(group=g, count=c) for g, c in sorted(d.items())]
+
+    return pb.SolveResponse(
+        nodes=[pb.NodeDecisionMsg(
+            instance_type=n.option.itype.name,
+            zone=n.option.zone,
+            capacity_type=n.option.capacity_type,
+            price=n.option.price,
+            provisioner=n.provisioner.name,
+            pods=counts(n.pod_counts),
+        ) for n in result.nodes],
+        existing=[pb.ExistingAssignmentMsg(node=name, pods=counts(per_group))
+                  for name, per_group in sorted(result.existing_by_group.items())],
+        unschedulable=counts(result.unschedulable),
+        groups=[pb.GroupMsg(pod_names=list(g.pod_names)) for g in result.groups],
+        solve_ms=solve_ms,
+        catalog_seqnum=seqnum,
+    )
+
+
+class SolverService:
+    """Stateful solver host: one synced (catalog, provisioners) pair, one
+    TPUSolver whose device-resident grid persists across Solve calls."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._solver: Optional[TPUSolver] = None
+        self._seqnum: int = -1
+        self._prov_hash: int = 0
+
+    # -- RPC methods (called by the generic handler) -------------------------------
+
+    def Sync(self, request: pb.SyncRequest, context) -> pb.SyncResponse:
+        catalog = wire.catalog_from_wire(request.catalog)
+        provisioners = [wire.provisioner_from_wire(m) for m in request.provisioners]
+        with self._lock:
+            self._solver = TPUSolver(catalog, provisioners)
+            self._seqnum = catalog.seqnum
+            self._prov_hash = wire.provisioners_hash(provisioners)
+            # build + device-put the option grid eagerly so the first Solve
+            # doesn't pay grid construction inside its latency budget
+            self._solver.grid()
+        log.info("synced catalog seqnum=%d (%d types, %d provisioners)",
+                 self._seqnum, len(catalog.types), len(provisioners))
+        return pb.SyncResponse(seqnum=self._seqnum)
+
+    def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
+        with self._lock:
+            solver, seqnum, phash = self._solver, self._seqnum, self._prov_hash
+        if solver is None or request.catalog_seqnum != seqnum \
+                or request.provisioner_hash != phash:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"catalog out of sync: server seqnum={seqnum}, "
+                f"request seqnum={request.catalog_seqnum}; re-Sync required")
+        pods = [wire.pod_from_wire(m) for m in request.pods]
+        existing = [wire.existing_from_wire(m) for m in request.existing]
+        overhead = list(request.daemon_overhead) or None
+        t0 = time.perf_counter()
+        result = solver.solve(pods, existing=existing, daemon_overhead=overhead)
+        solve_ms = (time.perf_counter() - t0) * 1000
+        return result_to_response(result, solve_ms, seqnum)
+
+    def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
+        import jax
+
+        with self._lock:
+            seqnum = self._seqnum
+            n_types = len(self._solver.catalog.types) if self._solver else 0
+        return pb.HealthResponse(ok=True, backend=jax.devices()[0].platform,
+                                 catalog_seqnum=seqnum, n_types=n_types)
+
+
+def _generic_handler(service: SolverService) -> grpc.GenericRpcHandler:
+    table = {}
+    for name, (req_cls, _resp_cls) in METHODS.items():
+        table[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(service, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda msg: msg.SerializeToString(),
+        )
+    return grpc.method_handlers_generic_handler(SERVICE_NAME, table)
+
+
+def serve(address: str = "127.0.0.1:0", max_workers: int = 4,
+          service: Optional[SolverService] = None) -> "tuple[grpc.Server, int, SolverService]":
+    """Start the solver service; returns (server, bound_port, service).
+    Solves are serialized per-solver by the GIL+device anyway; max_workers>1
+    keeps Health responsive during long solves."""
+    service = service or SolverService()
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((_generic_handler(service),))
+    port = server.add_insecure_port(address)
+    server.start()
+    log.info("solver service listening on port %d", port)
+    return server, port, service

@@ -1,0 +1,234 @@
+"""proto <-> model converters for the solver gRPC boundary.
+
+The conversions must be EXACT round trips: pod grouping (PodSpec.group_key)
+runs independently on both sides of the wire, and group indices in
+SolveResponse are only meaningful if client and server derive the identical
+deterministic grouping from the identical pod list (order-preserving
+first-occurrence order of group_pods, models/pod.py).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+from ..apis.provisioner import KubeletConfiguration, Limits, Provisioner
+from ..models.instancetype import Catalog, InstanceType, Offering, Offerings
+from ..models.pod import PodSpec, Taint, Toleration, TopologySpreadConstraint
+from ..models.requirements import Requirement, Requirements
+from ..oracle.scheduler import ExistingNode
+from . import solver_pb2 as pb
+
+# -- requirements -----------------------------------------------------------------
+
+
+def reqs_to_wire(reqs: Requirements) -> "list[pb.RequirementSpec]":
+    return [pb.RequirementSpec(key=k, op=op, values=list(vals))
+            for k, op, vals in reqs.to_specs()]
+
+
+def reqs_from_wire(specs) -> Requirements:
+    r = Requirements()
+    for s in specs:
+        r.add(Requirement.create(s.key, s.op, list(s.values)))
+    return r
+
+
+def _kvs(pairs) -> "list[pb.KV]":
+    return [pb.KV(key=k, value=v) for k, v in pairs]
+
+
+def _qtys(pairs) -> "list[pb.ResourceQty]":
+    return [pb.ResourceQty(key=k, value=v) for k, v in pairs]
+
+
+def _taints_to_wire(taints) -> "list[pb.TaintSpec]":
+    return [pb.TaintSpec(key=t.key, value=t.value, effect=t.effect) for t in taints]
+
+
+def _taints_from_wire(msgs) -> "tuple[Taint, ...]":
+    return tuple(Taint(key=m.key, value=m.value, effect=m.effect) for m in msgs)
+
+
+# -- pods -------------------------------------------------------------------------
+
+
+def pod_to_wire(p: PodSpec) -> pb.PodSpecMsg:
+    return pb.PodSpecMsg(
+        name=p.name,
+        namespace=p.namespace,
+        labels=_kvs(p.labels),
+        requests=_qtys(p.requests),
+        requirements=reqs_to_wire(p.requirements),
+        tolerations=[pb.TolerationSpec(key=t.key, operator=t.operator,
+                                       value=t.value, effect=t.effect)
+                     for t in p.tolerations],
+        topology=[pb.TopologySpreadSpec(max_skew=t.max_skew,
+                                        topology_key=t.topology_key,
+                                        when_unsatisfiable=t.when_unsatisfiable)
+                  for t in p.topology],
+        anti_affinity_hostname=p.anti_affinity_hostname,
+        anti_affinity_zone=p.anti_affinity_zone,
+        priority=p.priority,
+        deletion_cost=p.deletion_cost,
+        owner_kind=p.owner_kind,
+        do_not_evict=p.do_not_evict,
+        node_name=p.node_name,
+    )
+
+
+def pod_from_wire(m: pb.PodSpecMsg) -> PodSpec:
+    return PodSpec(
+        name=m.name,
+        namespace=m.namespace,
+        labels=tuple((kv.key, kv.value) for kv in m.labels),
+        requests=tuple((q.key, q.value) for q in m.requests),
+        requirements=reqs_from_wire(m.requirements),
+        tolerations=tuple(Toleration(key=t.key, operator=t.operator,
+                                     value=t.value, effect=t.effect)
+                          for t in m.tolerations),
+        topology=tuple(TopologySpreadConstraint(
+            max_skew=t.max_skew, topology_key=t.topology_key,
+            when_unsatisfiable=t.when_unsatisfiable) for t in m.topology),
+        anti_affinity_hostname=m.anti_affinity_hostname,
+        anti_affinity_zone=m.anti_affinity_zone,
+        priority=m.priority,
+        deletion_cost=m.deletion_cost,
+        owner_kind=m.owner_kind,
+        do_not_evict=m.do_not_evict,
+        node_name=m.node_name,
+    )
+
+
+# -- catalog ----------------------------------------------------------------------
+
+
+def itype_to_wire(t: InstanceType) -> pb.InstanceTypeMsg:
+    return pb.InstanceTypeMsg(
+        name=t.name,
+        labels=_kvs(t.labels),
+        capacity=_qtys(t.capacity),
+        overhead=_qtys(t.overhead),
+        offerings=[pb.OfferingMsg(zone=o.zone, capacity_type=o.capacity_type,
+                                  price=o.price, available=o.available)
+                   for o in t.offerings],
+    )
+
+
+def itype_from_wire(m: pb.InstanceTypeMsg) -> InstanceType:
+    return InstanceType(
+        name=m.name,
+        labels=tuple((kv.key, kv.value) for kv in m.labels),
+        capacity=tuple((q.key, q.value) for q in m.capacity),
+        overhead=tuple((q.key, q.value) for q in m.overhead),
+        offerings=Offerings(Offering(zone=o.zone, capacity_type=o.capacity_type,
+                                     price=o.price, available=o.available)
+                            for o in m.offerings),
+    )
+
+
+def catalog_to_wire(c: Catalog) -> pb.CatalogMsg:
+    return pb.CatalogMsg(types=[itype_to_wire(t) for t in c.types], seqnum=c.seqnum)
+
+
+def catalog_from_wire(m: pb.CatalogMsg) -> Catalog:
+    return Catalog(types=[itype_from_wire(t) for t in m.types], seqnum=m.seqnum)
+
+
+# -- provisioners -----------------------------------------------------------------
+
+
+def provisioner_to_wire(p: Provisioner) -> pb.ProvisionerMsg:
+    k = p.kubelet
+    return pb.ProvisionerMsg(
+        name=p.name,
+        requirements=reqs_to_wire(p.requirements),
+        taints=_taints_to_wire(p.taints),
+        startup_taints=_taints_to_wire(p.startup_taints),
+        labels=_kvs(p.labels),
+        limit_cpu_millis=-1 if p.limits.cpu_millis is None else p.limits.cpu_millis,
+        limit_memory_bytes=-1 if p.limits.memory_bytes is None else p.limits.memory_bytes,
+        weight=p.weight,
+        ttl_seconds_after_empty=(-1 if p.ttl_seconds_after_empty is None
+                                 else p.ttl_seconds_after_empty),
+        ttl_seconds_until_expired=(-1 if p.ttl_seconds_until_expired is None
+                                   else p.ttl_seconds_until_expired),
+        consolidation_enabled=p.consolidation_enabled,
+        kubelet=pb.KubeletConfigMsg(
+            max_pods=k.max_pods or 0,
+            pods_per_core=k.pods_per_core or 0,
+            system_reserved_cpu_millis=k.system_reserved_cpu_millis,
+            system_reserved_memory_bytes=k.system_reserved_memory_bytes,
+            kube_reserved_cpu_millis=(-1 if k.kube_reserved_cpu_millis is None
+                                      else k.kube_reserved_cpu_millis),
+            kube_reserved_memory_bytes=(-1 if k.kube_reserved_memory_bytes is None
+                                        else k.kube_reserved_memory_bytes),
+            eviction_hard_memory_bytes=k.eviction_hard_memory_bytes,
+        ),
+        provider_ref=p.provider_ref or "",
+    )
+
+
+def provisioner_from_wire(m: pb.ProvisionerMsg) -> Provisioner:
+    k = m.kubelet
+    return Provisioner(
+        name=m.name,
+        requirements=reqs_from_wire(m.requirements),
+        taints=_taints_from_wire(m.taints),
+        startup_taints=_taints_from_wire(m.startup_taints),
+        labels=tuple((kv.key, kv.value) for kv in m.labels),
+        limits=Limits(
+            cpu_millis=None if m.limit_cpu_millis < 0 else m.limit_cpu_millis,
+            memory_bytes=None if m.limit_memory_bytes < 0 else m.limit_memory_bytes,
+        ),
+        weight=m.weight,
+        ttl_seconds_after_empty=(None if m.ttl_seconds_after_empty < 0
+                                 else m.ttl_seconds_after_empty),
+        ttl_seconds_until_expired=(None if m.ttl_seconds_until_expired < 0
+                                   else m.ttl_seconds_until_expired),
+        consolidation_enabled=m.consolidation_enabled,
+        kubelet=KubeletConfiguration(
+            max_pods=k.max_pods or None,
+            pods_per_core=k.pods_per_core or None,
+            system_reserved_cpu_millis=k.system_reserved_cpu_millis,
+            system_reserved_memory_bytes=k.system_reserved_memory_bytes,
+            kube_reserved_cpu_millis=(None if k.kube_reserved_cpu_millis < 0
+                                      else k.kube_reserved_cpu_millis),
+            kube_reserved_memory_bytes=(None if k.kube_reserved_memory_bytes < 0
+                                        else k.kube_reserved_memory_bytes),
+            eviction_hard_memory_bytes=k.eviction_hard_memory_bytes,
+        ),
+        provider_ref=m.provider_ref or None,
+    )
+
+
+def provisioners_hash(provisioners) -> int:
+    """Stable fingerprint of the synced provisioner specs; lets the server
+    reject a Solve whose provisioner set drifted since the last Sync (the
+    seqnum trick applied to the other half of the problem definition)."""
+    h = 0
+    for p in provisioners:
+        h = zlib.crc32(provisioner_to_wire(p).SerializeToString(), h)
+    return h
+
+
+# -- existing nodes ---------------------------------------------------------------
+
+
+def existing_to_wire(e: ExistingNode) -> pb.ExistingNodeMsg:
+    return pb.ExistingNodeMsg(
+        name=e.name,
+        labels=_kvs(sorted(e.labels.items())),
+        allocatable=list(e.allocatable),
+        used=list(e.used),
+        taints=_taints_to_wire(e.taints),
+    )
+
+
+def existing_from_wire(m: pb.ExistingNodeMsg) -> ExistingNode:
+    return ExistingNode(
+        name=m.name,
+        labels={kv.key: kv.value for kv in m.labels},
+        allocatable=list(m.allocatable),
+        used=list(m.used),
+        taints=_taints_from_wire(m.taints),
+    )

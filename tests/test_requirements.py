@@ -138,3 +138,32 @@ def test_exists_intersect_notin_keeps_presence():
     assert not r.matches_labels({"k": "x"})
     specs = r.to_specs()
     assert ("k", OP_EXISTS, []) in specs and ("k", OP_NOT_IN, ["x"]) in specs
+
+
+class TestCanonicalFreeze:
+    """Copy-on-write contract: once a Requirements is published into a hash /
+    group key, in-place mutation is refused (stale-memo guard)."""
+
+    def test_eq_hash_spec_level(self):
+        import karpenter_tpu.apis.wellknown as wk
+        a = Requirements.of((wk.LABEL_ZONE, OP_IN, ["z2", "z1"]))
+        b = Requirements.of((wk.LABEL_ZONE, OP_IN, ["z1", "z2"]))
+        assert a == b and hash(a) == hash(b)
+
+    def test_mutation_after_hash_raises(self):
+        import pytest
+        r = Requirements.of(("k", OP_IN, ["v"]))
+        hash(r)
+        with pytest.raises(RuntimeError):
+            r.add(Requirement.create("k2", OP_IN, ["w"]))
+        assert r.copy() is not r
+        r.copy().add(Requirement.create("k2", OP_IN, ["w"]))  # copy unfrozen
+
+    def test_group_key_freezes_pod_requirements(self):
+        import pytest
+        from karpenter_tpu.models.pod import make_pod
+        p = make_pod("p", cpu="1", memory="1Gi", node_selector={"a": "b"})
+        k1 = p.group_key()
+        with pytest.raises(RuntimeError):
+            p.requirements.add(Requirement.create("c", OP_IN, ["d"]))
+        assert p.group_key() == k1

@@ -1,0 +1,181 @@
+"""gRPC solver boundary tests: wire round-trips, over-the-wire decision
+parity with the in-process TPUSolver, seqnum re-sync, and the unreachable ->
+oracle fallback contract inside the provisioning controller.
+
+Reference analogues: the seqnum-memoized instance-type cache
+(pkg/cloudprovider/instancetypes.go:104-120) and the fallback-on-failure
+pattern (pricing.go:100-116)."""
+
+import pytest
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.provisioner import Limits, Provisioner
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import (
+    Taint, Toleration, TopologySpreadConstraint, make_pod,
+)
+from karpenter_tpu.models.requirements import OP_IN, Requirements
+from karpenter_tpu.oracle.scheduler import ExistingNode
+from karpenter_tpu.solver import wire
+from karpenter_tpu.solver.client import RemoteSolver, SolverUnavailable
+from karpenter_tpu.solver.core import TPUSolver
+from karpenter_tpu.solver.service import serve
+
+
+def small_catalog():
+    return Catalog(types=[
+        make_instance_type("m.large", cpu=2, memory="8Gi", od_price=0.10, spot_price=0.03),
+        make_instance_type("m.xlarge", cpu=4, memory="16Gi", od_price=0.20, spot_price=0.06),
+        make_instance_type("c.xlarge", cpu=4, memory="8Gi", od_price=0.17, spot_price=0.05),
+    ])
+
+
+def default_provisioner(**kw):
+    p = Provisioner(name="default", requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])), **kw)
+    p.set_defaults()
+    return p
+
+
+def mixed_pods(n=40):
+    pods = [make_pod(f"web-{i}", cpu="500m", memory="1Gi",
+                     topology=(TopologySpreadConstraint(1, wk.LABEL_ZONE),))
+            for i in range(n // 2)]
+    pods += [make_pod(f"db-{i}", cpu="1", memory="4Gi",
+                      node_selector={wk.LABEL_ZONE: "zone-1a"})
+             for i in range(n - n // 2)]
+    return pods
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv, port, svc = serve("127.0.0.1:0")
+    yield port
+    srv.stop(grace=None)
+
+
+class TestWireRoundTrip:
+    def test_pod_round_trip_preserves_group_key(self):
+        p = make_pod(
+            "p1", cpu="1500m", memory="3Gi",
+            node_selector={wk.LABEL_ZONE: "zone-1b"},
+            tolerations=(Toleration(key="gpu", operator="Exists", effect="NoSchedule"),),
+            topology=(TopologySpreadConstraint(2, wk.LABEL_ZONE, "ScheduleAnyway"),),
+            labels=(("app", "p"),), priority=7, deletion_cost=3,
+            do_not_evict=True, anti_affinity_hostname=True,
+        )
+        q = wire.pod_from_wire(wire.pod_to_wire(p))
+        assert q == p
+        assert q.group_key() == p.group_key()
+
+    def test_catalog_round_trip(self):
+        c = small_catalog()
+        c2 = wire.catalog_from_wire(wire.catalog_to_wire(c))
+        assert [t.name for t in c2.types] == [t.name for t in c.types]
+        assert c2.types[0] == c.types[0]
+        assert c2.seqnum == c.seqnum
+
+    def test_provisioner_round_trip(self):
+        p = default_provisioner(
+            taints=(Taint(key="dedicated", value="x", effect="NoSchedule"),),
+            labels=(("team", "infra"),), weight=10,
+            limits=Limits(cpu_millis=100_000),
+            ttl_seconds_after_empty=30, provider_ref="tmpl")
+        q = wire.provisioner_from_wire(wire.provisioner_to_wire(p))
+        assert q.name == p.name
+        assert q.requirements.to_specs() == p.requirements.to_specs()
+        assert q.taints == p.taints
+        assert q.limits == p.limits
+        assert q.ttl_seconds_after_empty == 30
+        assert q.ttl_seconds_until_expired is None
+        assert q.provider_ref == "tmpl"
+        assert wire.provisioners_hash([q]) == wire.provisioners_hash([p])
+
+    def test_existing_node_round_trip(self):
+        e = ExistingNode(name="n1", labels={wk.LABEL_ZONE: "zone-1a"},
+                         allocatable=[4000, 8192, 110, 0, 0, 0, 0, 0][:wk.NUM_RESOURCES],
+                         used=[0] * wk.NUM_RESOURCES,
+                         taints=(Taint(key="k", effect="NoExecute"),))
+        e2 = wire.existing_from_wire(wire.existing_to_wire(e))
+        assert e2.name == e.name and e2.labels == e.labels
+        assert e2.allocatable == e.allocatable and e2.taints == e.taints
+
+
+class TestRemoteParity:
+    def test_remote_matches_inprocess(self, server):
+        catalog = small_catalog()
+        provs = [default_provisioner()]
+        pods = mixed_pods()
+        local = TPUSolver(catalog, provs).solve(pods)
+        remote = RemoteSolver(catalog, provs, target=f"127.0.0.1:{server}").solve(pods)
+        assert remote.decisions() == local.decisions()
+        assert remote.unschedulable_count() == local.unschedulable_count()
+
+    def test_remote_with_existing_nodes(self, server):
+        catalog = small_catalog()
+        provs = [default_provisioner()]
+        existing = [ExistingNode(
+            name="existing-1",
+            labels={wk.LABEL_ZONE: "zone-1a", wk.LABEL_ARCH: "amd64",
+                    wk.LABEL_OS: "linux", wk.LABEL_INSTANCE_TYPE: "m.xlarge",
+                    wk.LABEL_CAPACITY_TYPE: "on-demand"},
+            allocatable=catalog.by_name["m.xlarge"].allocatable_vector(),
+            used=[0] * wk.NUM_RESOURCES)]
+        pods = [make_pod(f"p{i}", cpu="500m", memory="1Gi") for i in range(8)]
+        solver = RemoteSolver(catalog, provs, target=f"127.0.0.1:{server}")
+        local = TPUSolver(catalog, provs).solve(pods, existing=existing)
+        remote = solver.solve(pods, existing=existing)
+        assert remote.decisions() == local.decisions()
+        assert remote.existing_counts == local.existing_counts
+
+    def test_seqnum_bump_triggers_resync(self, server):
+        catalog = small_catalog()
+        provs = [default_provisioner()]
+        solver = RemoteSolver(catalog, provs, target=f"127.0.0.1:{server}")
+        r1 = solver.solve([make_pod("a", cpu="1", memory="1Gi")])
+        assert len(r1.nodes) == 1
+        # mutate the catalog: mark m.large unavailable everywhere, bump seqnum
+        big = catalog.by_name["m.large"]
+        from karpenter_tpu.models.instancetype import Offering, Offerings
+        object.__setattr__(big, "offerings", Offerings(
+            Offering(o.zone, o.capacity_type, o.price, available=False)
+            for o in big.offerings))
+        catalog.bump()
+        r2 = solver.solve([make_pod("b", cpu="1", memory="1Gi")])
+        assert r2.nodes[0].option.itype.name != "m.large"
+
+    def test_health(self, server):
+        solver = RemoteSolver(small_catalog(), [default_provisioner()],
+                              target=f"127.0.0.1:{server}")
+        h = solver.health()
+        assert h.ok
+
+    def test_unreachable_raises(self):
+        solver = RemoteSolver(small_catalog(), [default_provisioner()],
+                              target="127.0.0.1:1", timeout=0.5)
+        with pytest.raises(SolverUnavailable):
+            solver.solve([make_pod("a", cpu="1", memory="1Gi")])
+
+
+class TestControllerFallback:
+    def test_provisioning_falls_back_to_oracle_when_solver_unreachable(self):
+        """ProvisioningController + RemoteSolver at a dead address still
+        provisions (oracle fallback contract)."""
+        from karpenter_tpu.apis.settings import Settings
+        from karpenter_tpu.fake.cloud import FakeCloud
+        from karpenter_tpu.operator import Operator
+
+        catalog = small_catalog()
+        cloud = FakeCloud(catalog)
+        settings = Settings(cluster_name="t", cluster_endpoint="https://t")
+        op = Operator(cloud, settings, catalog)
+        op.provisioning._solver_factory = lambda cat, provs: RemoteSolver(
+            cat, provs, target="127.0.0.1:1", timeout=0.2)
+        op.kube.create("provisioners", "default", default_provisioner())
+        for i in range(4):
+            p = make_pod(f"p{i}", cpu="1", memory="1Gi")
+            op.kube.create("pods", p.name, p)
+        result = op.provisioning.reconcile_once()
+        assert result is not None
+        assert len(result.nodes) >= 1
+        assert result.unschedulable_count() == 0
