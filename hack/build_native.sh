@@ -1,0 +1,6 @@
+#!/bin/sh
+# Build the native packer shared library (controller-half fallback solver).
+set -e
+cd "$(dirname "$0")/.."
+g++ -O2 -Wall -shared -fPIC -o karpenter_tpu/native/libktpack.so karpenter_tpu/native/ktpack.cc
+echo "built karpenter_tpu/native/libktpack.so"

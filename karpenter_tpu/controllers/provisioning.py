@@ -30,7 +30,7 @@ from ..models.machine import Machine, MachineSpec
 from ..models.pod import PodSpec
 from ..models.requirements import IncompatibleError, Requirement, Requirements, OP_IN
 from ..oracle.scheduler import Scheduler
-from ..solver.core import SolveResult, TPUSolver
+from ..solver.core import NativeSolver, SolveResult, TPUSolver
 from ..utils.clock import Clock
 
 log = logging.getLogger("karpenter.provisioning")
@@ -117,11 +117,17 @@ class ProvisioningController:
             solver = self._solver_factory(catalog, provisioners)
             result = solver.solve(pods, existing=existing,
                                   daemon_overhead=daemon_overhead)
-        except Exception as e:  # fall back to the in-process oracle
-            log.warning("TPU solver failed (%s); using oracle fallback", e)
-            solver_kind = "oracle"
-            result = self._oracle_solve(catalog, provisioners, pods,
-                                        existing, daemon_overhead)
+        except Exception as e:  # fallback chain: native C++ scan, then oracle
+            log.warning("TPU solver failed (%s); using native fallback", e)
+            try:
+                solver_kind = "native"
+                result = NativeSolver(catalog, provisioners).solve(
+                    pods, existing=existing, daemon_overhead=daemon_overhead)
+            except Exception as e2:
+                log.warning("native solver failed (%s); using oracle fallback", e2)
+                solver_kind = "oracle"
+                result = self._oracle_solve(catalog, provisioners, pods,
+                                            existing, daemon_overhead)
         self.sched_duration.observe(time.perf_counter() - t0, solver=solver_kind)
 
         self._apply(result, pods)

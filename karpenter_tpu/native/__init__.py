@@ -1,0 +1,127 @@
+"""ctypes binding for the native (C++) FFD packer fallback.
+
+Loads libktpack.so (hack/build_native.sh), rebuilding it on demand with g++
+when the shared object is missing or older than its source. The binding
+exposes native_pack() with the exact PackInputs/PackResult contract of the
+JAX kernel (ops/packer.py) — bit-parity is enforced by
+tests/test_native_pack.py.
+
+Why native and not just the Python oracle: the fallback runs inside the
+controller's scheduling-cycle budget when the TPU sidecar is down; the C++
+scan is ~100-1000x the Python oracle's throughput and needs no JAX runtime.
+(Reference analogue for graceful degradation: embedded static pricing
+fallback, /root/reference/pkg/cloudprovider/pricing.go:100-116.)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "ktpack.cc")
+_LIB = os.path.join(_HERE, "libktpack.so")
+
+_lock = threading.Lock()
+_lib = None
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _build() -> None:
+    subprocess.run(
+        ["g++", "-O2", "-Wall", "-shared", "-fPIC", "-o", _LIB, _SRC],
+        check=True, capture_output=True, text=True,
+    )
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        try:
+            if (not os.path.exists(_LIB)
+                    or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_LIB)
+        except (OSError, subprocess.CalledProcessError) as e:
+            raise NativeUnavailable(f"native packer unavailable: {e}")
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.kt_pack.restype = ctypes.c_int
+        lib.kt_pack.argtypes = (
+            [i32p, i32p, i32p, i32p, i32p, u8p, i32p, i32p, i32p, i32p, u8p]
+            + [ctypes.c_int] * 7
+            + [i32p, i32p, i32p, u8p, i32p, i32p, i32p]
+        )
+        _lib = lib
+        return lib
+
+
+def _i32(a) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a), dtype=np.int32)
+
+
+def _u8(a) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a), dtype=np.uint8)
+
+
+def _ptr(a: np.ndarray):
+    if a.dtype == np.int32:
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def native_pack(inputs, n_slots: int):
+    """PackInputs -> PackResult via the C++ scan. Accepts the same (possibly
+    jax-array) fields as pack_impl; everything is materialized to host numpy."""
+    from ..ops.packer import PackResult
+
+    lib = _load()
+    alloc_t = _i32(inputs.alloc_t)
+    tiebreak = _i32(inputs.tiebreak)
+    group_vec = _i32(inputs.group_vec)
+    group_count = _i32(inputs.group_count)
+    group_cap = _i32(inputs.group_cap)
+    group_feas = _u8(inputs.group_feas)
+    group_newprov = _i32(inputs.group_newprov)
+    overhead = _i32(inputs.overhead)
+    ex_alloc = _i32(inputs.ex_alloc)
+    ex_used = _i32(inputs.ex_used)
+    ex_feas = _u8(inputs.ex_feas)
+
+    G, Pv, T, S = group_feas.shape
+    R = group_vec.shape[1]
+    Ne = ex_alloc.shape[0]
+    N = int(n_slots)
+
+    assign = np.zeros((G, N), np.int32)
+    ex_assign = np.zeros((G, Ne), np.int32)
+    unsched = np.zeros((G,), np.int32)
+    active = np.zeros((N,), np.uint8)
+    nprov = np.zeros((N,), np.int32)
+    decided = np.zeros((N,), np.int32)
+    n_open = np.zeros((1,), np.int32)
+
+    rc = lib.kt_pack(
+        _ptr(alloc_t), _ptr(tiebreak), _ptr(group_vec), _ptr(group_count),
+        _ptr(group_cap), _ptr(group_feas), _ptr(group_newprov), _ptr(overhead),
+        _ptr(ex_alloc), _ptr(ex_used), _ptr(ex_feas),
+        G, Pv, T, S, R, Ne, N,
+        _ptr(assign), _ptr(ex_assign), _ptr(unsched), _ptr(active),
+        _ptr(nprov), _ptr(decided), _ptr(n_open),
+    )
+    if rc != 0:
+        raise NativeUnavailable(f"kt_pack returned {rc}")
+    return PackResult(
+        assign=assign, ex_assign=ex_assign, unsched=unsched,
+        used=np.zeros((0,), np.int32), active=active.astype(bool),
+        nprov=nprov, decided=decided, n_open=np.int32(n_open[0]),
+    )

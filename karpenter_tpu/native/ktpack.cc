@@ -1,0 +1,234 @@
+// Native (host CPU) implementation of the FFD group-scan packer.
+//
+// Same semantics spec as the JAX kernel (karpenter_tpu/ops/packer.py) and the
+// scalar oracle (karpenter_tpu/oracle/scheduler.py): this is the controller
+// half's in-process fallback when the TPU solver sidecar is unreachable, and
+// the fast path for small solves where a device round trip (~tens of ms over
+// a tunneled chip) would dominate. Differential-tested for bit-parity against
+// pack_impl in tests/test_native_pack.py.
+//
+// Reference analogue: the FFD spec at /root/reference/designs/bin-packing.md
+// (sort pods desc; greedy fill; cheapest-offering tie-break per
+// /root/reference/pkg/cloudprovider/instance.go:445-462). This is NOT a port
+// of the Go loop: it consumes the same dense encoded problem (masks already
+// folded by models/encode.py) as the device kernel, so all three backends
+// share one semantics boundary.
+//
+// Build: hack/build_native.sh  ->  karpenter_tpu/native/libktpack.so
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int32_t INT_BIG = 1 << 30;
+
+inline int32_t clip(int64_t v, int64_t lo, int64_t hi) {
+  if (v < lo) return static_cast<int32_t>(lo);
+  if (v > hi) return static_cast<int32_t>(hi);
+  return static_cast<int32_t>(v);
+}
+
+// How many vec-sized pods fit into avail (length R): min over resources of
+// floor(avail/vec); zero-demand resources ignored; negative avail with
+// demand => -1 (mirrors _quotient in ops/packer.py).
+int32_t quotient(const int32_t* avail, const int32_t* vec, int R) {
+  int64_t q = INT_BIG;
+  for (int r = 0; r < R; ++r) {
+    int64_t qr;
+    bool pos = vec[r] > 0;
+    if (avail[r] < 0) {
+      qr = pos ? -1 : INT_BIG;
+    } else {
+      qr = pos ? avail[r] / vec[r] : INT_BIG;
+    }
+    if (qr < q) q = qr;
+  }
+  return clip(q, -1, INT_BIG);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success. All arrays are row-major int32/uint8 as documented in
+// PackInputs (ops/packer.py); outputs must be pre-allocated by the caller.
+int kt_pack(const int32_t* alloc_t,      // [T,R]
+            const int32_t* tiebreak,     // [T,S]
+            const int32_t* group_vec,    // [G,R]
+            const int32_t* group_count,  // [G]
+            const int32_t* group_cap,    // [G]
+            const uint8_t* group_feas,   // [G,Pv,T,S]
+            const int32_t* group_newprov,// [G]
+            const int32_t* overhead,     // [R]
+            const int32_t* ex_alloc,     // [Ne,R]
+            const int32_t* ex_used_in,   // [Ne,R]
+            const uint8_t* ex_feas,      // [G,Ne]
+            int G, int Pv, int T, int S, int R, int Ne, int N,
+            int32_t* assign,             // out [G,N]
+            int32_t* ex_assign,          // out [G,Ne]
+            int32_t* unsched,            // out [G]
+            uint8_t* active,             // out [N]
+            int32_t* nprov,              // out [N]
+            int32_t* decided,            // out [N]
+            int32_t* n_open_out) {       // out [1]
+  const int TS = T * S;
+  std::vector<int32_t> used(static_cast<size_t>(N) * R, 0);
+  std::vector<uint8_t> optmask(static_cast<size_t>(N) * TS, 0);
+  std::vector<int32_t> ex_used(ex_used_in, ex_used_in + static_cast<size_t>(Ne) * R);
+  std::vector<int32_t> q_nt(static_cast<size_t>(N));   // per-node best quotient
+  std::vector<int32_t> qt(static_cast<size_t>(T));     // per-type quotient scratch
+  std::vector<int32_t> m_n(static_cast<size_t>(N));
+  int32_t n_open = 0;
+
+  std::memset(assign, 0, sizeof(int32_t) * G * N);
+  std::memset(ex_assign, 0, sizeof(int32_t) * G * Ne);
+  std::memset(unsched, 0, sizeof(int32_t) * G);
+  std::memset(active, 0, sizeof(uint8_t) * N);
+  for (int n = 0; n < N; ++n) nprov[n] = -1;
+
+  for (int g = 0; g < G; ++g) {
+    const int32_t* vec = group_vec + static_cast<size_t>(g) * R;
+    const int32_t cap = group_cap[g];
+    int64_t rem = group_count[g];
+
+    // ---- 1) existing nodes, first-fit in index order ------------------------
+    for (int e = 0; e < Ne && rem > 0; ++e) {
+      if (!ex_feas[static_cast<size_t>(g) * Ne + e]) continue;
+      std::vector<int32_t> avail(R);
+      for (int r = 0; r < R; ++r)
+        avail[r] = ex_alloc[static_cast<size_t>(e) * R + r] -
+                   ex_used[static_cast<size_t>(e) * R + r];
+      int64_t fill = quotient(avail.data(), vec, R);
+      if (fill > cap) fill = cap;
+      if (fill <= 0) continue;
+      if (fill > rem) fill = rem;
+      ex_assign[static_cast<size_t>(g) * Ne + e] = static_cast<int32_t>(fill);
+      for (int r = 0; r < R; ++r)
+        ex_used[static_cast<size_t>(e) * R + r] += static_cast<int32_t>(fill) * vec[r];
+      rem -= fill;
+    }
+
+    // ---- 2) open claims, first-fit in creation order ------------------------
+    // per-node max quotient over surviving feasible (t,s) options
+    for (int n = 0; n < n_open; ++n) {
+      m_n[n] = 0;
+      if (!active[n] || rem <= 0) { q_nt[n] = -1; continue; }
+      int pidx = nprov[n] < 0 ? 0 : nprov[n];
+      const uint8_t* feas =
+          group_feas + ((static_cast<size_t>(g) * Pv + pidx) * TS);
+      const uint8_t* om = optmask.data() + static_cast<size_t>(n) * TS;
+      int32_t qmax = -1;
+      for (int t = 0; t < T; ++t) {
+        bool any = false;
+        for (int s = 0; s < S; ++s) {
+          if (om[t * S + s] && feas[t * S + s]) { any = true; break; }
+        }
+        if (!any) { qt[t] = -1; continue; }
+        std::vector<int32_t> avail(R);
+        for (int r = 0; r < R; ++r)
+          avail[r] = alloc_t[static_cast<size_t>(t) * R + r] -
+                     used[static_cast<size_t>(n) * R + r];
+        qt[t] = quotient(avail.data(), vec, R);
+        if (qt[t] > qmax) qmax = qt[t];
+      }
+      q_nt[n] = qmax;
+      int64_t fill = qmax > cap ? cap : qmax;
+      if (fill <= 0) continue;
+      if (fill > rem) fill = rem;
+      m_n[n] = static_cast<int32_t>(fill);
+      rem -= fill;
+      // place + shrink option mask: survive iff feasible for this group AND
+      // the type still fits the node's new load (q_nt >= m_n)
+      for (int r = 0; r < R; ++r)
+        used[static_cast<size_t>(n) * R + r] += m_n[n] * vec[r];
+      int pidx2 = nprov[n] < 0 ? 0 : nprov[n];
+      const uint8_t* feas2 =
+          group_feas + ((static_cast<size_t>(g) * Pv + pidx2) * TS);
+      uint8_t* om2 = optmask.data() + static_cast<size_t>(n) * TS;
+      for (int t = 0; t < T; ++t) {
+        // recompute per-type quotient against the PRE-placement load (qt[t]
+        // was computed above for all types of this node)
+        bool fits = qt[t] >= m_n[n];
+        for (int s = 0; s < S; ++s) {
+          om2[t * S + s] =
+              (om2[t * S + s] && feas2[t * S + s] && fits) ? 1 : 0;
+        }
+      }
+      assign[static_cast<size_t>(g) * N + n] += m_n[n];
+    }
+
+    // ---- 3) bulk-open fresh nodes ------------------------------------------
+    int32_t p = group_newprov[g];
+    int64_t kstar = 0;
+    if (p >= 0) {
+      const uint8_t* feas =
+          group_feas + ((static_cast<size_t>(g) * Pv + p) * TS);
+      for (int t = 0; t < T; ++t) {
+        bool any = false;
+        for (int s = 0; s < S; ++s)
+          if (feas[t * S + s]) { any = true; break; }
+        std::vector<int32_t> avail(R);
+        for (int r = 0; r < R; ++r)
+          avail[r] = alloc_t[static_cast<size_t>(t) * R + r] - overhead[r];
+        qt[t] = quotient(avail.data(), vec, R);  // q0 (also reused below)
+        if (any && qt[t] > kstar) kstar = qt[t];
+      }
+    } else {
+      for (int t = 0; t < T; ++t) qt[t] = -1;
+    }
+    if (kstar > cap) kstar = cap;
+    if (kstar < 0) kstar = 0;
+    int64_t n_new = kstar > 0 ? (rem + kstar - 1) / kstar : 0;
+    if (n_new > N - n_open) n_new = N - n_open;
+    int64_t placed_new = n_new > 0 ? (n_new - 1) * kstar : 0;
+    int64_t last_cnt = rem - placed_new;
+    if (last_cnt < 0) last_cnt = 0;
+    if (last_cnt > kstar) last_cnt = kstar;
+    for (int64_t i = 0; i < n_new; ++i) {
+      int n = static_cast<int>(n_open + i);
+      int64_t cnt = (i == n_new - 1) ? last_cnt : kstar;
+      for (int r = 0; r < R; ++r)
+        used[static_cast<size_t>(n) * R + r] =
+            overhead[r] + static_cast<int32_t>(cnt) * vec[r];
+      const uint8_t* feas =
+          group_feas + ((static_cast<size_t>(g) * Pv + p) * TS);
+      uint8_t* om = optmask.data() + static_cast<size_t>(n) * TS;
+      for (int t = 0; t < T; ++t) {
+        bool fits = qt[t] >= cnt;
+        for (int s = 0; s < S; ++s)
+          om[t * S + s] = (feas[t * S + s] && fits) ? 1 : 0;
+      }
+      active[n] = 1;
+      nprov[n] = p;
+      assign[static_cast<size_t>(g) * N + n] += static_cast<int32_t>(cnt);
+      rem -= cnt;
+    }
+    n_open += static_cast<int32_t>(n_new);
+    unsched[g] = static_cast<int32_t>(rem);
+  }
+
+  // ---- decision: cheapest surviving option per active claim -----------------
+  for (int n = 0; n < N; ++n) {
+    int32_t best_rank = INT_BIG;
+    int32_t best = -1;
+    if (active[n]) {
+      const uint8_t* om = optmask.data() + static_cast<size_t>(n) * TS;
+      for (int t = 0; t < T; ++t) {
+        for (int s = 0; s < S; ++s) {
+          int32_t rank = om[t * S + s] ? tiebreak[t * S + s] : INT_BIG;
+          if (rank < best_rank) {  // strict: first min wins (argmin parity)
+            best_rank = rank;
+            best = t * S + s;
+          }
+        }
+      }
+    }
+    decided[n] = (active[n] && best_rank < INT_BIG) ? best : -1;
+  }
+  *n_open_out = n_open;
+  return 0;
+}
+
+}  // extern "C"

@@ -16,6 +16,7 @@ reference analogue: static pricing fallback, pricing.go:100-116).
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Optional, Sequence
 
 import grpc
@@ -27,9 +28,24 @@ from ..oracle.scheduler import ExistingNode, Option
 from .core import SolvedNode, SolveResult
 from . import solver_pb2 as pb
 from . import wire
-from .service import SERVICE_NAME
+from .service import METHODS, SERVICE_NAME
 
 log = logging.getLogger("karpenter.solver.client")
+
+# One channel per target, shared across RemoteSolver instances: the
+# per-reconcile solver_factory pattern constructs a fresh RemoteSolver each
+# cycle, and per-instance channels would leak sockets.
+_channels: "dict[str, grpc.Channel]" = {}
+_channels_lock = threading.Lock()
+
+
+def _shared_channel(target: str) -> grpc.Channel:
+    with _channels_lock:
+        ch = _channels.get(target)
+        if ch is None:
+            ch = grpc.insecure_channel(target)
+            _channels[target] = ch
+        return ch
 
 
 class SolverUnavailable(RuntimeError):
@@ -48,20 +64,18 @@ class RemoteSolver:
         self.catalog = catalog
         self.provisioners = list(provisioners)
         self.timeout = timeout
-        self._channel = channel or grpc.insecure_channel(target)
+        self._channel = channel or _shared_channel(target)
         self._synced_seqnum = -1
         self._prov_hash = wire.provisioners_hash(self.provisioners)
+        # stub table derived from the server's METHODS so client and service
+        # can't drift (single owner of the RPC name -> message mapping)
         self._stubs = {
             name: self._channel.unary_unary(
                 f"/{SERVICE_NAME}/{name}",
                 request_serializer=lambda m: m.SerializeToString(),
                 response_deserializer=resp_cls.FromString,
             )
-            for name, resp_cls in (
-                ("Sync", pb.SyncResponse),
-                ("Solve", pb.SolveResponse),
-                ("Health", pb.HealthResponse),
-            )
+            for name, (_req_cls, resp_cls) in METHODS.items()
         }
 
     # -- RPC plumbing --------------------------------------------------------------

@@ -106,6 +106,42 @@ class TPUSolver:
         return decode(enc, result, [e.name for e in existing])
 
 
+class NativeSolver(TPUSolver):
+    """Same encode/decode pipeline, C++ scan instead of the device kernel
+    (karpenter_tpu/native/). The controller's fallback backend when the TPU
+    sidecar is unreachable — and the preferred path for small solves, where
+    a tunneled-device round trip would dominate the latency budget. No
+    padding/bucketing: dynamic shapes are free on the host."""
+
+    def grid(self) -> OptionGrid:
+        if self._grid is None or self._grid.seqnum != self.catalog.seqnum:
+            self._grid = build_grid(self.catalog)  # host-only: no device_put
+        return self._grid
+
+    def solve(
+        self,
+        pods: "list[PodSpec]",
+        existing: Sequence[ExistingNode] = (),
+        daemon_overhead: Optional[Sequence[int]] = None,
+        n_slots: Optional[int] = None,
+    ) -> SolveResult:
+        from ..native import native_pack
+
+        enc = encode_problem(
+            self.catalog, self.provisioners, pods, existing,
+            daemon_overhead, n_slots, grid=self.grid(),
+        )
+        inputs = PackInputs(
+            alloc_t=enc.alloc_t, tiebreak=enc.tiebreak,
+            group_vec=enc.group_vec, group_count=enc.group_count,
+            group_cap=enc.group_cap, group_feas=enc.group_feas,
+            group_newprov=enc.group_newprov, overhead=enc.overhead,
+            ex_alloc=enc.ex_alloc, ex_used=enc.ex_used, ex_feas=enc.ex_feas,
+        )
+        result = native_pack(inputs, n_slots=enc.n_slots)
+        return decode(enc, result, [e.name for e in existing])
+
+
 def run_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None) -> PackResult:
     """Pad to shape buckets and invoke the jitted kernel."""
     G = enc.group_vec.shape[0]

@@ -74,15 +74,25 @@ class SolverService:
     # -- RPC methods (called by the generic handler) -------------------------------
 
     def Sync(self, request: pb.SyncRequest, context) -> pb.SyncResponse:
-        catalog = wire.catalog_from_wire(request.catalog)
         provisioners = [wire.provisioner_from_wire(m) for m in request.provisioners]
+        prov_hash = wire.provisioners_hash(provisioners)
         with self._lock:
-            self._solver = TPUSolver(catalog, provisioners)
+            unchanged = (self._solver is not None
+                         and self._seqnum == request.catalog.seqnum
+                         and self._prov_hash == prov_hash)
+        if unchanged:
+            # idempotent re-Sync: keep the device-resident grid (per-reconcile
+            # clients re-Sync freely; only a real seqnum/spec change pays)
+            return pb.SyncResponse(seqnum=request.catalog.seqnum)
+        catalog = wire.catalog_from_wire(request.catalog)
+        solver = TPUSolver(catalog, provisioners)
+        # build + device-put the option grid OUTSIDE the lock so Health stays
+        # responsive during catalog churn, then swap atomically
+        solver.grid()
+        with self._lock:
+            self._solver = solver
             self._seqnum = catalog.seqnum
-            self._prov_hash = wire.provisioners_hash(provisioners)
-            # build + device-put the option grid eagerly so the first Solve
-            # doesn't pay grid construction inside its latency budget
-            self._solver.grid()
+            self._prov_hash = prov_hash
         log.info("synced catalog seqnum=%d (%d types, %d provisioners)",
                  self._seqnum, len(catalog.types), len(provisioners))
         return pb.SyncResponse(seqnum=self._seqnum)

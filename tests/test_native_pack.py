@@ -1,0 +1,163 @@
+"""Differential tests: native C++ packer vs JAX kernel vs oracle.
+
+The native scan (karpenter_tpu/native/ktpack.cc) is the controller's
+in-process fallback; it consumes the same encoded problem as the device
+kernel, so parity here means all three backends share one semantics spec
+(SURVEY.md §7.3 "fallback equivalence")."""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.models.encode import encode_problem
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import TopologySpreadConstraint, make_pod
+from karpenter_tpu.models.requirements import Requirements, OP_IN
+from karpenter_tpu.native import native_pack
+from karpenter_tpu.ops.packer import PackInputs, pack
+from karpenter_tpu.oracle.scheduler import ExistingNode
+from karpenter_tpu.solver.core import NativeSolver, TPUSolver
+
+
+def catalog5():
+    return Catalog(types=[
+        make_instance_type("small.2x", cpu=2, memory="8Gi", od_price=0.10, spot_price=0.03),
+        make_instance_type("medium.4x", cpu=4, memory="16Gi", od_price=0.20, spot_price=0.06),
+        make_instance_type("large.8x", cpu=8, memory="32Gi", od_price=0.40, spot_price=0.12),
+        make_instance_type("arm.4x", cpu=4, memory="16Gi", arch="arm64", od_price=0.15),
+        make_instance_type("gpu.8x", cpu=8, memory="64Gi", od_price=2.50,
+                           extended={wk.RESOURCE_NVIDIA_GPU: 4}),
+    ])
+
+
+def prov(name="default", **kw):
+    p = Provisioner(name=name, **kw)
+    p.set_defaults()
+    return p
+
+
+def kernel_inputs(catalog, provisioners, pods, existing=(), overhead=None):
+    enc = encode_problem(catalog, provisioners, pods, existing, overhead)
+    inputs = PackInputs(
+        alloc_t=enc.alloc_t, tiebreak=enc.tiebreak, group_vec=enc.group_vec,
+        group_count=enc.group_count, group_cap=enc.group_cap,
+        group_feas=enc.group_feas, group_newprov=enc.group_newprov,
+        overhead=enc.overhead, ex_alloc=enc.ex_alloc, ex_used=enc.ex_used,
+        ex_feas=enc.ex_feas,
+    )
+    return inputs, enc.n_slots
+
+
+def assert_bit_parity(catalog, provisioners, pods, existing=(), overhead=None):
+    inputs, n_slots = kernel_inputs(catalog, provisioners, pods, existing, overhead)
+    kr = pack(inputs, n_slots=n_slots)
+    nr = native_pack(inputs, n_slots)
+    np.testing.assert_array_equal(np.asarray(kr.assign), nr.assign)
+    np.testing.assert_array_equal(np.asarray(kr.ex_assign), nr.ex_assign)
+    np.testing.assert_array_equal(np.asarray(kr.unsched), nr.unsched)
+    np.testing.assert_array_equal(np.asarray(kr.active), nr.active)
+    np.testing.assert_array_equal(np.asarray(kr.nprov), nr.nprov)
+    np.testing.assert_array_equal(np.asarray(kr.decided), nr.decided)
+    assert int(kr.n_open) == int(nr.n_open)
+
+
+class TestNativeBitParity:
+    def test_inflate(self):
+        pods = [make_pod(f"p{i}", cpu="1", memory="256M") for i in range(100)]
+        assert_bit_parity(catalog5(), [prov()], pods)
+
+    def test_mixed_sizes_and_zones(self):
+        pods = (
+            [make_pod(f"big-{i}", cpu="3", memory="12Gi") for i in range(7)]
+            + [make_pod(f"z-{i}", cpu="1", memory="1Gi",
+                        node_selector={wk.LABEL_ZONE: "zone-1a"}) for i in range(5)]
+            + [make_pod(f"tiny-{i}", cpu="100m", memory="128Mi") for i in range(50)]
+        )
+        assert_bit_parity(catalog5(), [prov()], pods)
+
+    def test_topology_spread(self):
+        spread = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),)
+        pods = [make_pod(f"s-{i}", cpu="1", memory="1Gi", topology=spread)
+                for i in range(10)]
+        assert_bit_parity(catalog5(), [prov()], pods)
+
+    def test_existing_nodes(self):
+        catalog = catalog5()
+        existing = [ExistingNode(
+            name="ex-1",
+            labels={wk.LABEL_ZONE: "zone-1a", wk.LABEL_ARCH: "amd64",
+                    wk.LABEL_OS: "linux", wk.LABEL_INSTANCE_TYPE: "medium.4x",
+                    wk.LABEL_CAPACITY_TYPE: "on-demand"},
+            allocatable=catalog.by_name["medium.4x"].allocatable_vector(),
+            used=[0] * wk.NUM_RESOURCES)]
+        pods = [make_pod(f"p{i}", cpu="500m", memory="1Gi") for i in range(12)]
+        assert_bit_parity(catalog, [prov()], pods, existing=existing)
+
+    def test_unschedulable_overflow(self):
+        # gpu pods with no gpu-admitting provisioner requirement mismatch:
+        # arm-only provisioner vs amd64-only pods
+        p = Provisioner(name="arm", requirements=Requirements.of(
+            (wk.LABEL_ARCH, OP_IN, ["arm64"])))
+        p.set_defaults()
+        pods = [make_pod(f"p{i}", cpu="1", memory="1Gi",
+                         node_selector={wk.LABEL_ARCH: "amd64"}) for i in range(3)]
+        assert_bit_parity(catalog5(), [p], pods)
+
+    def test_randomized_sweep(self):
+        rng = random.Random(7)
+        for trial in range(15):
+            n = rng.randint(1, 60)
+            pods = []
+            for i in range(n):
+                kw = {}
+                if rng.random() < 0.3:
+                    kw["node_selector"] = {wk.LABEL_ZONE: rng.choice(
+                        ["zone-1a", "zone-1b", "zone-1c"])}
+                if rng.random() < 0.2:
+                    kw["topology"] = (TopologySpreadConstraint(
+                        1, wk.LABEL_ZONE),)
+                pods.append(make_pod(
+                    f"t{trial}-p{i}",
+                    cpu=rng.choice(["100m", "250m", "500m", "1", "2", "3"]),
+                    memory=rng.choice(["128Mi", "512Mi", "1Gi", "4Gi", "12Gi"]),
+                    **kw))
+            assert_bit_parity(catalog5(), [prov()], pods)
+
+
+class TestNativeSolverEndToEnd:
+    def test_decisions_match_tpu_solver(self):
+        catalog = catalog5()
+        provs = [prov()]
+        pods = ([make_pod(f"a{i}", cpu="1", memory="2Gi") for i in range(20)]
+                + [make_pod(f"b{i}", cpu="250m", memory="512Mi") for i in range(30)])
+        tpu = TPUSolver(catalog, provs).solve(pods)
+        native = NativeSolver(catalog, provs).solve(pods)
+        assert native.decisions() == tpu.decisions()
+        assert native.unschedulable_count() == tpu.unschedulable_count()
+
+    def test_provisioning_fallback_chain_uses_native(self):
+        """Solver factory raising -> controller falls back to native, not
+        straight to the Python oracle."""
+        from karpenter_tpu.apis.settings import Settings
+        from karpenter_tpu.fake.cloud import FakeCloud
+        from karpenter_tpu.operator import Operator
+
+        catalog = catalog5()
+        op = Operator(FakeCloud(catalog),
+                      Settings(cluster_name="t", cluster_endpoint="https://t"),
+                      catalog)
+
+        def boom(cat, provs):
+            raise RuntimeError("sidecar down")
+
+        op.provisioning._solver_factory = boom
+        op.kube.create("provisioners", "default", prov())
+        for i in range(4):
+            p = make_pod(f"p{i}", cpu="1", memory="1Gi")
+            op.kube.create("pods", p.name, p)
+        result = op.provisioning.reconcile_once()
+        assert result is not None and len(result.nodes) >= 1
+        assert result.unschedulable_count() == 0
