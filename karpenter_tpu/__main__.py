@@ -1,0 +1,122 @@
+"""CLI entry point.
+
+Parity target: /root/reference/cmd/controller/main.go:33-65 (operator boot)
+plus the new solver sidecar from SURVEY.md §7.1.
+
+  python -m karpenter_tpu solver-serve --port 50151
+      Host the TPU solver gRPC service (the solver half).
+
+  python -m karpenter_tpu controller --simulate [--solver ADDR]
+      Run the full controller plane against the simulated cloud backend
+      (SURVEY.md §2.3: "GCP/TPU provisioning APIs or simulated backend").
+      With --solver, scheduling solves go to the gRPC sidecar with the
+      native/oracle fallback chain; without, the in-process TPU solver runs.
+
+  python -m karpenter_tpu version
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+
+VERSION = "0.1.0"
+
+
+def _wait_for_signal() -> None:
+    """Block until SIGTERM/SIGINT. Explicit handlers: the environment's
+    sitecustomize can leave default SIGINT delivery unreliable, and
+    orchestrators terminate with SIGTERM."""
+    import threading
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda signum, frame: stop.set())
+    while not stop.is_set():
+        # poll rather than a bare wait(): signal handlers only run between
+        # interpreter bytecodes, and Event.wait() without timeout parks in C
+        stop.wait(0.2)
+
+
+def cmd_solver_serve(args) -> int:
+    from .solver.service import serve
+
+    server, port, _service = serve(f"{args.host}:{args.port}",
+                                   max_workers=args.workers)
+    print(f"solver service listening on {args.host}:{port}", flush=True)
+    try:
+        _wait_for_signal()
+    finally:
+        server.stop(grace=1.0)
+    return 0
+
+
+def cmd_controller(args) -> int:
+    from .apis.provisioner import Provisioner
+    from .apis.settings import Settings
+    from .fake.cloud import FakeCloud
+    from .operator import Operator
+    from .providers.instancetypes import generate_fleet_catalog
+
+    if not args.simulate:
+        print("only --simulate mode is available in this build "
+              "(real TPU-fleet API wiring is environment-specific)",
+              file=sys.stderr)
+        return 2
+
+    catalog = generate_fleet_catalog()
+    settings = Settings(cluster_name=args.cluster_name,
+                        cluster_endpoint="https://simulated")
+    solver_factory = None
+    if args.solver:
+        from .solver.client import RemoteSolver
+
+        solver_factory = (
+            lambda cat, provs: RemoteSolver(cat, provs, target=args.solver))
+    op = Operator(FakeCloud(catalog), settings, catalog,
+                  solver_factory=solver_factory)
+    default_prov = Provisioner(name="default")
+    op.kube.create("provisioners", "default", default_prov)
+    op.start()
+    print(f"controller running (cluster={args.cluster_name}, "
+          f"solver={'grpc:' + args.solver if args.solver else 'in-process'}); "
+          f"Ctrl-C to stop", flush=True)
+    try:
+        _wait_for_signal()
+    finally:
+        op.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s %(message)s")
+    parser = argparse.ArgumentParser(prog="karpenter_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_serve = sub.add_parser("solver-serve", help="host the solver gRPC service")
+    p_serve.add_argument("--host", default="127.0.0.1")
+    p_serve.add_argument("--port", type=int, default=50151)
+    p_serve.add_argument("--workers", type=int, default=4)
+    p_serve.set_defaults(fn=cmd_solver_serve)
+
+    p_ctrl = sub.add_parser("controller", help="run the controller plane")
+    p_ctrl.add_argument("--simulate", action="store_true",
+                        help="use the simulated cloud backend")
+    p_ctrl.add_argument("--solver", default="",
+                        help="gRPC solver sidecar address (host:port)")
+    p_ctrl.add_argument("--cluster-name", default="simulated")
+    p_ctrl.set_defaults(fn=cmd_controller)
+
+    p_ver = sub.add_parser("version")
+    p_ver.set_defaults(fn=lambda a: print(VERSION) or 0)
+
+    args = parser.parse_args(argv)
+    return args.fn(args) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+"""MachineHydration controller: backfill Machine objects for pre-existing
+nodes (migration shim).
+
+Parity target: /root/reference/pkg/controllers/machinehydration/controller.go
+— for every node owned by a provisioner that has no Machine, create a Machine
+from the node + provisioner (:55-98, machineutil.New analogue) and tag the
+backing instance via CloudProvider.Hydrate (:82-98, cloudprovider.go:221-251).
+The reference defines this controller but leaves it unregistered
+(controllers.go:31-39); here it is always wired into the Operator — this
+build has no migration-era compatibility concern, so hydration simply runs.
+
+Checkpoint/resume role (SURVEY.md §5.4): state lives in the cluster and the
+cloud — after a controller restart, hydration + list_machines rebuild the
+Machine inventory from instance tags, no checkpoint files.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..apis import wellknown as wk
+from ..models.machine import Machine, MachineSpec, parse_provider_id
+from ..models.requirements import OP_IN, Requirement, Requirements
+from ..utils.clock import Clock
+from ..utils.errors import CloudError
+
+log = logging.getLogger("karpenter.machinehydration")
+
+
+class MachineHydrationController:
+    def __init__(self, kube, cloudprovider, cluster=None,
+                 clock: Optional[Clock] = None):
+        self.kube = kube
+        self.cloudprovider = cloudprovider
+        self.cluster = cluster
+        self.clock = clock or Clock()
+
+    def reconcile_once(self) -> int:
+        """Sweep all nodes; hydrate each provisioner-owned node without a
+        Machine. Returns the number hydrated."""
+        all_machines = self.kube.list("machines")
+        machines = {m.name for m in all_machines}
+        by_provider_id = {
+            m.status.provider_id: m.name
+            for m in all_machines if m.status.provider_id
+        }
+        count = 0
+        for node in self.kube.list("nodes"):
+            if self._hydrate_node(node, machines, by_provider_id):
+                count += 1
+        return count
+
+    def _hydrate_node(self, node, machines: "set[str]",
+                      by_provider_id: "dict[str, str]") -> bool:
+        provisioner_name = node.labels.get(wk.LABEL_PROVISIONER, "")
+        if not provisioner_name:
+            return False  # not karpenter-owned (controller.go: provisioner label gate)
+        if node.machine_name and node.machine_name in machines:
+            return False
+        if node.provider_id and node.provider_id in by_provider_id:
+            # machine exists but the node lost the back-reference; relink
+            node.machine_name = by_provider_id[node.provider_id]
+            return False
+        if not node.provider_id:
+            return False
+        try:
+            _, instance_id = parse_provider_id(node.provider_id)
+            instance = self.cloudprovider.instances.get_by_id(instance_id)
+            machine = self.cloudprovider.hydrate(instance)
+        except (CloudError, ValueError) as e:
+            log.warning("hydrate %s failed: %s", node.name, e)
+            return False
+        machine.name = f"{node.name}-hydrated"
+        machine.labels = dict(node.labels)
+        machine.spec = MachineSpec(
+            requirements=self._node_requirements(node),
+            provisioner_name=provisioner_name,
+            machine_template_ref=self._template_ref(provisioner_name),
+        )
+        try:
+            self.kube.create("machines", machine.name, machine)
+        except Exception as e:
+            log.warning("machine create for %s failed: %s", node.name, e)
+            return False
+        node.machine_name = machine.name
+        machines.add(machine.name)
+        if machine.status.provider_id:
+            by_provider_id[machine.status.provider_id] = machine.name
+        # bring the node under management: cluster state drives existing-
+        # capacity scheduling, limits accounting, and termination eligibility
+        if self.cluster is not None and node.name not in self.cluster.nodes:
+            self.cluster.add_node(node)
+        log.info("hydrated machine %s from node %s", machine.name, node.name)
+        return True
+
+    def _node_requirements(self, node) -> Requirements:
+        """Machine requirements from the node's concrete labels
+        (machineutil.New: node labels become single-valued requirements)."""
+        reqs = Requirements()
+        for key, value in sorted(node.labels.items()):
+            if key in wk.RESTRICTED_LABELS:
+                continue
+            reqs.add(Requirement.create(key, OP_IN, [value]))
+        return reqs
+
+    def _template_ref(self, provisioner_name: str) -> str:
+        prov = self.kube.get("provisioners", provisioner_name)
+        if prov is not None and prov.provider_ref:
+            return prov.provider_ref
+        return "default"

@@ -35,6 +35,14 @@ class KubeStore:
         self._objects: "dict[str, dict[str, object]]" = {k: {} for k in self.KINDS}
         self._watchers: "list[Callable[[str, str, object], None]]" = []
         self._rv = itertools.count(1)
+        # admission interception point (set by Operator with the Webhooks
+        # pipeline): fn(kind, obj, operation) -> obj, raising to reject —
+        # the apiserver's admission-webhook call site analogue
+        self._admission: "Optional[Callable[[str, object, str], object]]" = None
+
+    def set_admission(self, fn: "Optional[Callable[[str, object, str], object]]") -> None:
+        with self._lock:
+            self._admission = fn
 
     # -- generic ---------------------------------------------------------------
 
@@ -51,6 +59,8 @@ class KubeStore:
             self._watchers.append(fn)
 
     def create(self, kind: str, name: str, obj) -> None:
+        if self._admission is not None:
+            obj = self._admission(kind, obj, "CREATE")
         with self._lock:
             bucket = self._objects[kind]
             if name in bucket:
@@ -59,6 +69,8 @@ class KubeStore:
         self._notify(kind, "added", obj)
 
     def update(self, kind: str, name: str, obj) -> None:
+        if self._admission is not None:
+            obj = self._admission(kind, obj, "UPDATE")
         with self._lock:
             self._objects[kind][name] = obj
         self._notify(kind, "modified", obj)

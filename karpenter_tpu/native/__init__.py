@@ -28,6 +28,7 @@ _LIB = os.path.join(_HERE, "libktpack.so")
 
 _lock = threading.Lock()
 _lib = None
+_load_error: "Optional[NativeUnavailable]" = None
 
 
 class NativeUnavailable(RuntimeError):
@@ -42,17 +43,21 @@ def _build() -> None:
 
 
 def _load():
-    global _lib
+    global _lib, _load_error
     with _lock:
         if _lib is not None:
             return _lib
+        if _load_error is not None:
+            # negative cache: don't re-spawn g++ on every fallback solve
+            raise _load_error
         try:
             if (not os.path.exists(_LIB)
                     or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
                 _build()
             lib = ctypes.CDLL(_LIB)
         except (OSError, subprocess.CalledProcessError) as e:
-            raise NativeUnavailable(f"native packer unavailable: {e}")
+            _load_error = NativeUnavailable(f"native packer unavailable: {e}")
+            raise _load_error
         i32p = ctypes.POINTER(ctypes.c_int32)
         u8p = ctypes.POINTER(ctypes.c_uint8)
         lib.kt_pack.restype = ctypes.c_int

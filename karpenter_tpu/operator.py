@@ -16,6 +16,7 @@ from .apis.settings import Settings
 from .cloudprovider import CloudProvider
 from .controllers.deprovisioning import DeprovisioningController
 from .controllers.interruption import FakeQueue, InterruptionController
+from .controllers.machinehydration import MachineHydrationController
 from .controllers.nodetemplate import NodeTemplateController
 from .controllers.provisioning import ProvisioningController
 from .controllers.termination import TerminationController
@@ -25,6 +26,7 @@ from .models.cluster import ClusterState
 from .models.instancetype import Catalog
 from .fake.kube import KubeStore
 from .utils.clock import Clock
+from .webhooks import Webhooks
 
 log = logging.getLogger("karpenter.operator")
 
@@ -33,7 +35,7 @@ class Operator:
     def __init__(self, cloud, settings: Settings, catalog: Catalog,
                  kube: Optional[KubeStore] = None,
                  clock: Optional[Clock] = None,
-                 queue=None):
+                 queue=None, solver_factory=None):
         settings.validate()
         self.settings = settings
         self.clock = clock or Clock()
@@ -48,7 +50,8 @@ class Operator:
 
         self.provisioning = ProvisioningController(
             self.kube, self.cloudprovider, self.cluster, settings,
-            clock=self.clock, recorder=self.recorder)
+            clock=self.clock, recorder=self.recorder,
+            solver_factory=solver_factory)
         self.termination = TerminationController(
             self.kube, self.cloudprovider, self.cluster,
             clock=self.clock, recorder=self.recorder)
@@ -59,6 +62,13 @@ class Operator:
         self.nodetemplate = NodeTemplateController(
             self.kube, self.cloudprovider.subnets,
             self.cloudprovider.security_groups, clock=self.clock)
+        # admission webhooks at the coordination-plane boundary
+        # (operator.WithWebhooks analogue, cmd/controller/main.go:58-63)
+        self.webhooks = Webhooks()
+        self.kube.set_admission(self.webhooks.admit)
+        self.machinehydration = MachineHydrationController(
+            self.kube, self.cloudprovider, cluster=self.cluster,
+            clock=self.clock)
         self.interruption = None
         if settings.interruption_queue_name:
             self.queue = queue or FakeQueue(settings.interruption_queue_name,
@@ -96,6 +106,7 @@ class Operator:
         loop("termination", self.termination.reconcile_once, 0.2)
         loop("deprovisioning", self.deprovisioning.reconcile_once, 2.0)
         loop("nodetemplate", self.nodetemplate.reconcile_once, 5.0)
+        loop("machinehydration", self.machinehydration.reconcile_once, 5.0)
         if self.interruption is not None:
             t2 = threading.Thread(target=self.interruption.run,
                                   args=(self._stop,), name="interruption",
@@ -128,6 +139,7 @@ class Operator:
     def reconcile_all_once(self) -> None:
         """One deterministic pass over every controller (hermetic tests)."""
         self.nodetemplate.reconcile_once()
+        self.machinehydration.reconcile_once()
         self.provisioning.reconcile_once()
         if self.interruption is not None:
             self.interruption.reconcile_once()

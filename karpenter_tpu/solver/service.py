@@ -90,6 +90,9 @@ class SolverService:
         # responsive during catalog churn, then swap atomically
         solver.grid()
         with self._lock:
+            if self._solver is not None and self._seqnum > catalog.seqnum:
+                # a newer catalog won the race while we built; keep it
+                return pb.SyncResponse(seqnum=self._seqnum)
             self._solver = solver
             self._seqnum = catalog.seqnum
             self._prov_hash = prov_hash

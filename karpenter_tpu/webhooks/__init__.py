@@ -1,0 +1,74 @@
+"""Admission webhooks: defaulting + validation at the API boundary.
+
+Parity target: /root/reference/pkg/webhooks/webhooks.go:33-63 — knative
+defaulting and validation admission controllers registered for the
+AWSNodeTemplate and Provisioner kinds (the `Resources` map :60-63), plus the
+core webhook half that defaults/validates the Provisioner CRD
+(/root/reference/pkg/apis/v1alpha5/provisioner.go:34-60).
+
+Shape here: the coordination plane (KubeStore, the kube-apiserver analogue)
+calls Webhooks.admit() on every create/update of a registered kind — the same
+interception point a real apiserver gives admission webhooks. Rejection
+raises AdmissionError and the write never lands.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from ..apis.nodetemplate import NodeTemplate
+from ..apis.provisioner import Provisioner, ValidationError
+
+log = logging.getLogger("karpenter.webhooks")
+
+
+class AdmissionError(Exception):
+    """Write rejected by a validation webhook."""
+
+
+class Webhooks:
+    """Defaulting-then-validation pipeline per registered kind
+    (webhooks.go Resources map analogue)."""
+
+    def __init__(self):
+        # kind -> (defaulter, validator); mirrors the reference's
+        # {AWSNodeTemplate, Provisioner} registration
+        self.resources: "dict[str, tuple[Optional[Callable], Optional[Callable]]]" = {
+            "provisioners": (self._default_provisioner, self._validate_provisioner),
+            "nodetemplates": (self._default_nodetemplate, self._validate_nodetemplate),
+        }
+
+    def admit(self, kind: str, obj, operation: str = "CREATE"):
+        """Run defaulting then validation; returns the (mutated) object.
+        Raises AdmissionError on rejection."""
+        entry = self.resources.get(kind)
+        if entry is None:
+            return obj
+        defaulter, validator = entry
+        if defaulter is not None:
+            defaulter(obj)
+        if validator is not None:
+            try:
+                validator(obj)
+            except (ValidationError, ValueError) as e:
+                raise AdmissionError(f"{kind} admission denied ({operation}): {e}")
+        return obj
+
+    # -- per-kind hooks (delegating to the API types' own spec logic) --------------
+
+    @staticmethod
+    def _default_provisioner(p: Provisioner) -> None:
+        p.set_defaults()
+
+    @staticmethod
+    def _validate_provisioner(p: Provisioner) -> None:
+        p.validate()
+
+    @staticmethod
+    def _default_nodetemplate(t: NodeTemplate) -> None:
+        t.set_defaults()
+
+    @staticmethod
+    def _validate_nodetemplate(t: NodeTemplate) -> None:
+        t.validate()

@@ -1,0 +1,105 @@
+"""MachineHydration controller tests (pkg/controllers/machinehydration
+analogue): Machine backfill from pre-existing provisioner-owned nodes, with
+instance tagging via CloudProvider.hydrate."""
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.apis.settings import Settings
+from karpenter_tpu.fake.cloud import CloudInstance, FakeCloud
+from karpenter_tpu.models.cluster import StateNode
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.machine import make_provider_id
+from karpenter_tpu.operator import Operator
+
+
+def make_operator():
+    catalog = Catalog(types=[make_instance_type("m.l", cpu=4, memory="16Gi")])
+    cloud = FakeCloud(catalog)
+    op = Operator(cloud, Settings(cluster_name="test-cluster",
+                                  cluster_endpoint="https://t"), catalog)
+    return op, cloud
+
+
+def preexisting_node(cloud, name="legacy-1", provisioner="default"):
+    """A node + instance that predate the controller (no Machine)."""
+    inst = CloudInstance(id=f"i-{name}", instance_type="m.l", zone="zone-1a",
+                        capacity_type="on-demand",
+                        tags={"kubernetes.io/cluster/test-cluster": "owned"})
+    cloud.instances[inst.id] = inst
+    node = StateNode(
+        name=name,
+        labels={wk.LABEL_PROVISIONER: provisioner,
+                wk.LABEL_INSTANCE_TYPE: "m.l",
+                wk.LABEL_ZONE: "zone-1a"},
+        allocatable=[4000, 16384, 110] + [0] * (wk.NUM_RESOURCES - 3),
+        provider_id=make_provider_id("zone-1a", inst.id),
+        provisioner_name=provisioner,
+        machine_name="",  # the gap hydration fills
+    )
+    return node, inst
+
+
+class TestMachineHydration:
+    def test_hydrates_machine_for_orphan_node(self):
+        op, cloud = make_operator()
+        op.kube.create("provisioners", "default", Provisioner(name="default"))
+        node, inst = preexisting_node(cloud)
+        op.kube.create("nodes", node.name, node)
+
+        assert op.machinehydration.reconcile_once() == 1
+        machine = op.kube.get("machines", "legacy-1-hydrated")
+        assert machine is not None
+        assert node.machine_name == "legacy-1-hydrated"
+        assert machine.spec.provisioner_name == "default"
+        # node labels became machine requirements
+        assert machine.spec.requirements.get(wk.LABEL_INSTANCE_TYPE).has("m.l")
+        # instance got the managed-by tag (hydrate -> create_tags)
+        assert cloud.instances[inst.id].tags.get(
+            "karpenter.sh/managed-by") == "test-cluster"
+
+    def test_idempotent(self):
+        op, cloud = make_operator()
+        op.kube.create("provisioners", "default", Provisioner(name="default"))
+        node, _ = preexisting_node(cloud)
+        op.kube.create("nodes", node.name, node)
+        assert op.machinehydration.reconcile_once() == 1
+        assert op.machinehydration.reconcile_once() == 0
+        assert len(op.kube.list("machines")) == 1
+
+    def test_skips_unowned_node(self):
+        op, cloud = make_operator()
+        node, _ = preexisting_node(cloud)
+        node.labels.pop(wk.LABEL_PROVISIONER)
+        op.kube.create("nodes", node.name, node)
+        assert op.machinehydration.reconcile_once() == 0
+        assert not op.kube.list("machines")
+
+    def test_relinks_when_machine_exists_by_provider_id(self):
+        op, cloud = make_operator()
+        op.kube.create("provisioners", "default", Provisioner(name="default"))
+        node, _ = preexisting_node(cloud)
+        op.kube.create("nodes", node.name, node)
+        op.machinehydration.reconcile_once()
+        node.machine_name = ""  # lose the back-reference
+        assert op.machinehydration.reconcile_once() == 0  # relink, no new machine
+        assert node.machine_name == "legacy-1-hydrated"
+        assert len(op.kube.list("machines")) == 1
+
+    def test_skips_node_without_provider_id(self):
+        op, cloud = make_operator()
+        op.kube.create("provisioners", "default", Provisioner(name="default"))
+        node, _ = preexisting_node(cloud)
+        node.provider_id = ""
+        op.kube.create("nodes", node.name, node)
+        assert op.machinehydration.reconcile_once() == 0
+
+    def test_hydrated_node_joins_cluster_state(self):
+        """Hydration brings the node under management: existing-capacity
+        scheduling and termination must see it."""
+        op, cloud = make_operator()
+        op.kube.create("provisioners", "default", Provisioner(name="default"))
+        node, _ = preexisting_node(cloud)
+        op.kube.create("nodes", node.name, node)
+        op.machinehydration.reconcile_once()
+        assert "legacy-1" in op.cluster.nodes
+        assert any(e.name == "legacy-1" for e in op.cluster.existing_views())

@@ -1,0 +1,86 @@
+"""Admission webhook tests (pkg/webhooks/webhooks.go analogue): defaulting
+mutates on the way in, validation rejects bad specs, unregistered kinds pass
+through untouched."""
+
+import pytest
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.nodetemplate import NodeTemplate
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.apis.settings import Settings
+from karpenter_tpu.fake.cloud import FakeCloud
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.models.requirements import Requirements, OP_IN
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.webhooks import AdmissionError, Webhooks
+
+
+def make_operator():
+    catalog = Catalog(types=[make_instance_type("m.l", cpu=2, memory="8Gi")])
+    return Operator(FakeCloud(catalog),
+                    Settings(cluster_name="t", cluster_endpoint="https://t"),
+                    catalog)
+
+
+class TestWebhooks:
+    def test_provisioner_defaulted_on_create(self):
+        op = make_operator()
+        op.kube.create("provisioners", "p", Provisioner(name="p"))
+        p = op.kube.get("provisioners", "p")
+        # defaulting webhook applied linux/amd64/on-demand
+        # (v1alpha5/provisioner.go:45-60)
+        assert p.requirements.get(wk.LABEL_OS).has("linux")
+        assert p.requirements.get(wk.LABEL_ARCH).has("amd64")
+        assert p.requirements.get(wk.LABEL_CAPACITY_TYPE).has("on-demand")
+
+    def test_invalid_provisioner_rejected(self):
+        op = make_operator()
+        bad = Provisioner(name="bad", requirements=Requirements.of(
+            (wk.LABEL_PROVISIONER, OP_IN, ["nope"])))  # restricted label
+        with pytest.raises(AdmissionError):
+            op.kube.create("provisioners", "bad", bad)
+        assert op.kube.get("provisioners", "bad") is None
+
+    def test_mutually_exclusive_consolidation_ttl_rejected(self):
+        op = make_operator()
+        bad = Provisioner(name="bad", consolidation_enabled=True,
+                          ttl_seconds_after_empty=30)
+        with pytest.raises(AdmissionError):
+            op.kube.create("provisioners", "bad", bad)
+
+    def test_update_also_validated(self):
+        op = make_operator()
+        op.kube.create("provisioners", "p", Provisioner(name="p"))
+        with pytest.raises(AdmissionError):
+            op.kube.update("provisioners", "p", Provisioner(name="p", weight=101))
+
+    def test_nodetemplate_validated(self):
+        op = make_operator()
+        t = NodeTemplate(name="tmpl", subnet_selector={"cluster": "t"})
+        op.kube.create("nodetemplates", "tmpl", t)
+        assert op.kube.get("nodetemplates", "tmpl") is t
+
+    def test_nodetemplate_missing_subnets_rejected(self):
+        op = make_operator()
+        with pytest.raises(AdmissionError):
+            op.kube.create("nodetemplates", "bad", NodeTemplate(name="bad"))
+
+    def test_nodetemplate_static_lt_exclusive(self):
+        op = make_operator()
+        bad = NodeTemplate(name="bad", subnet_selector={"c": "t"},
+                           launch_template_name="lt-1", userdata="#!/bin/sh")
+        with pytest.raises(AdmissionError):
+            op.kube.create("nodetemplates", "bad", bad)
+
+    def test_unregistered_kind_passthrough(self):
+        op = make_operator()
+        pod = make_pod("p", cpu="1", memory="1Gi")
+        op.kube.create("pods", "p", pod)
+        assert op.kube.get("pods", "p") is pod
+
+    def test_admit_direct(self):
+        w = Webhooks()
+        p = Provisioner(name="x")
+        w.admit("provisioners", p)
+        assert p.requirements.get(wk.LABEL_OS) is not None
