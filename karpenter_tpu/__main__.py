@@ -54,6 +54,7 @@ def cmd_solver_serve(args) -> int:
 
 
 def cmd_controller(args) -> int:
+    from .apis.nodetemplate import NodeTemplate
     from .apis.provisioner import Provisioner
     from .apis.settings import Settings
     from .fake.cloud import FakeCloud
@@ -77,8 +78,12 @@ def cmd_controller(args) -> int:
             lambda cat, provs: RemoteSolver(cat, provs, target=args.solver))
     op = Operator(FakeCloud(catalog), settings, catalog,
                   solver_factory=solver_factory)
-    default_prov = Provisioner(name="default")
-    op.kube.create("provisioners", "default", default_prov)
+    # kube.create runs the admission webhooks (defaulting + validation)
+    op.kube.create("nodetemplates", "default", NodeTemplate(
+        name="default",
+        subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"}))
+    op.kube.create("provisioners", "default",
+                   Provisioner(name="default", provider_ref="default"))
     op.start()
     print(f"controller running (cluster={args.cluster_name}, "
           f"solver={'grpc:' + args.solver if args.solver else 'in-process'}); "

@@ -62,12 +62,24 @@ class CloudProvider:
         self.instances = InstanceProvider(
             cloud, settings, self.launch_templates, self.subnets, self.ice)
         self.nodetemplates: "dict[str, NodeTemplate]" = {}
+        # authoritative template lookup (the operator wires the kube store
+        # here so deletes are honored; the reference gets this for free via
+        # the shared kube client, cloudprovider.go:286-300). When unset, the
+        # register_nodetemplate registry is the source (standalone use).
+        self.template_source = None
 
     # -- template resolution ---------------------------------------------------
 
     def register_nodetemplate(self, template: NodeTemplate) -> None:
         template.validate()
         self.nodetemplates[template.name] = template
+
+    def _get_template(self, name: str) -> "Optional[NodeTemplate]":
+        if not name:
+            return None
+        if self.template_source is not None:
+            return self.template_source(name)
+        return self.nodetemplates.get(name)
 
     def resolve_nodetemplate(self, provisioner_or_machine) -> NodeTemplate:
         """providerRef -> NodeTemplate (cloudprovider.go:113-118, 286-300)."""
@@ -76,7 +88,7 @@ class CloudProvider:
         if not ref:
             raise cloud_errors.CloudError("NodeTemplateNotFound",
                                           "no nodeTemplate reference")
-        template = self.nodetemplates.get(ref)
+        template = self._get_template(ref)
         if template is None:
             raise cloud_errors.CloudError("NodeTemplateNotFound", ref)
         return template
@@ -87,13 +99,13 @@ class CloudProvider:
         """GetInstanceTypes (cloudprovider.go:171-186)."""
         template = None
         if provisioner is not None and provisioner.provider_ref:
-            template = self.nodetemplates.get(provisioner.provider_ref)
+            template = self._get_template(provisioner.provider_ref)
         return self.instance_types.list(template).types
 
     def catalog_for(self, provisioner: Optional[Provisioner] = None) -> Catalog:
         template = None
         if provisioner is not None and provisioner.provider_ref:
-            template = self.nodetemplates.get(provisioner.provider_ref)
+            template = self._get_template(provisioner.provider_ref)
         return self.instance_types.list(template)
 
     def create(self, machine: Machine) -> Machine:
@@ -112,7 +124,7 @@ class CloudProvider:
         """reqs.Compatible ∧ offerings.Available ∧ resources.Fits filter
         (cloudprovider.go:302-321)."""
         catalog = self.instance_types.list(
-            self.nodetemplates.get(machine.spec.machine_template_ref))
+            self._get_template(machine.spec.machine_template_ref))
         reqs = machine.spec.requirements
         vec = wk.resource_vector(machine.spec.resource_requests)
         out = []

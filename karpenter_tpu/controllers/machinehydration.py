@@ -56,6 +56,16 @@ class MachineHydrationController:
         provisioner_name = node.labels.get(wk.LABEL_PROVISIONER, "")
         if not provisioner_name:
             return False  # not karpenter-owned (controller.go: provisioner label gate)
+        # every owned node joins cluster state, whether or not a Machine needs
+        # backfilling — restart recovery (SURVEY.md §5.4) must make restored
+        # nodes visible to existing-capacity scheduling and consolidation.
+        # Guards: never resurrect a node the termination controller is tearing
+        # down (marked_for_deletion), and re-check store membership at join
+        # time — the sweep list may be stale against a concurrent delete.
+        if (self.cluster is not None and node.name not in self.cluster.nodes
+                and not node.marked_for_deletion
+                and self.kube.get("nodes", node.name) is not None):
+            self.cluster.add_node(node)
         if node.machine_name and node.machine_name in machines:
             return False
         if node.provider_id and node.provider_id in by_provider_id:
@@ -87,10 +97,6 @@ class MachineHydrationController:
         machines.add(machine.name)
         if machine.status.provider_id:
             by_provider_id[machine.status.provider_id] = machine.name
-        # bring the node under management: cluster state drives existing-
-        # capacity scheduling, limits accounting, and termination eligibility
-        if self.cluster is not None and node.name not in self.cluster.nodes:
-            self.cluster.add_node(node)
         log.info("hydrated machine %s from node %s", machine.name, node.name)
         return True
 

@@ -100,6 +100,13 @@ class LaunchTemplate:
     image_id: str
     userdata: str = ""
     tags: "dict[str, str]" = dataclasses.field(default_factory=dict)
+    # resolved node-template options (reference carries these in the EC2 LT
+    # data: metadataOptions, blockDeviceMappings, monitoring, instance profile
+    # — launchtemplate.go:195-235 createLaunchTemplate)
+    metadata_options: "dict" = dataclasses.field(default_factory=dict)
+    block_devices: "list[dict]" = dataclasses.field(default_factory=list)
+    monitoring: bool = False
+    instance_profile: str = ""
 
 
 class FakeCloud:

@@ -62,6 +62,10 @@ class Operator:
         self.nodetemplate = NodeTemplateController(
             self.kube, self.cloudprovider.subnets,
             self.cloudprovider.security_groups, clock=self.clock)
+        # the kube store is the single source of truth for templates: deletes
+        # take effect immediately and no side-registry can drift
+        self.cloudprovider.template_source = (
+            lambda name: self.kube.get("nodetemplates", name))
         # admission webhooks at the coordination-plane boundary
         # (operator.WithWebhooks analogue, cmd/controller/main.go:58-63)
         self.webhooks = Webhooks()

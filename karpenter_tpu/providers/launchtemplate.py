@@ -21,7 +21,7 @@ from ..apis.settings import Settings
 from ..fake.cloud import LaunchTemplate
 from ..models.pod import Taint
 from ..utils.clock import Clock
-from .images import BootstrapConfig, ImageProvider, ResolvedImage, get_family
+from .images import BootstrapConfig, ImageProvider, get_family
 
 log = logging.getLogger("karpenter.launchtemplate")
 
@@ -74,15 +74,19 @@ class LaunchTemplateProvider:
                 "bdm": [dataclass_dict(b) for b in template.block_device_mappings],
                 "monitoring": template.detailed_monitoring,
                 "profile": template.instance_profile or self.settings.default_instance_profile,
+                # tags are carried on the created LT, so they must be hashed:
+                # templates differing only in tags may not share an LT
+                "tags": dict(sorted(template.tags.items())),
             }
             spec_hash = hashlib.sha256(
                 json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
-            name = self._ensure(spec_hash, image, userdata, template)
+            name = self._ensure(spec_hash, spec, template)
             out.setdefault(name, []).append(image.arch)
         return out
 
-    def _ensure(self, spec_hash: str, image: ResolvedImage, userdata: str,
-                template: NodeTemplate) -> str:
+    def _ensure(self, spec_hash: str, spec: dict, template: NodeTemplate) -> str:
+        """`spec` is the same resolved dict the hash was computed from — the
+        created LT must carry exactly what was hashed."""
         name = self._name(spec_hash)
         with self._lock:
             if name in self._known:
@@ -91,8 +95,12 @@ class LaunchTemplateProvider:
             CLUSTER_TAG_KEY, self.settings.cluster_name)}
         if name not in existing:
             self.cloud.create_launch_template(LaunchTemplate(
-                name=name, image_id=image.image_id, userdata=userdata,
+                name=name, image_id=spec["image"], userdata=spec["userdata"],
                 tags={CLUSTER_TAG_KEY: self.settings.cluster_name, **template.tags},
+                metadata_options=spec["metadata"],
+                block_devices=spec["bdm"],
+                monitoring=spec["monitoring"],
+                instance_profile=spec["profile"],
             ))
             log.info("created launch template %s", name)
         with self._lock:

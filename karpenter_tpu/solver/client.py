@@ -93,6 +93,15 @@ class RemoteSolver:
             catalog=wire.catalog_to_wire(self.catalog),
             provisioners=[wire.provisioner_to_wire(p) for p in self.provisioners],
         ))
+        if resp.seqnum != self.catalog.seqnum:
+            # the server already holds a NEWER catalog (another replica won):
+            # recording resp.seqnum as synced would make every later solve
+            # fail FAILED_PRECONDITION after a wasted server build. We are the
+            # stale side — surface it so the caller falls back this cycle and
+            # re-syncs after refreshing its catalog.
+            raise StaleSync(
+                f"server catalog seqnum={resp.seqnum} is newer than ours "
+                f"({self.catalog.seqnum}); refresh the catalog before syncing")
         self._synced_seqnum = resp.seqnum
         return resp.seqnum
 

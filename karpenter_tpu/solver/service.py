@@ -80,10 +80,17 @@ class SolverService:
             unchanged = (self._solver is not None
                          and self._seqnum == request.catalog.seqnum
                          and self._prov_hash == prov_hash)
+            outdated = self._solver is not None and self._seqnum > request.catalog.seqnum
+            newest = self._seqnum
         if unchanged:
             # idempotent re-Sync: keep the device-resident grid (per-reconcile
             # clients re-Sync freely; only a real seqnum/spec change pays)
             return pb.SyncResponse(seqnum=request.catalog.seqnum)
+        if outdated:
+            # the caller's catalog is older than what's installed: don't pay a
+            # solver build that would only be discarded; the returned seqnum
+            # tells the client it is the stale side
+            return pb.SyncResponse(seqnum=newest)
         catalog = wire.catalog_from_wire(request.catalog)
         solver = TPUSolver(catalog, provisioners)
         # build + device-put the option grid OUTSIDE the lock so Health stays

@@ -1,0 +1,336 @@
+"""E2E-analogue scenario suites over the fake cloud + real controller plane.
+
+Mirrors the reference's test/suites/ tier (SURVEY.md §4 tier 4) hermetically:
+- chaos: runaway scale-up guards while consolidation/emptiness churn
+  (/root/reference/test/suites/chaos/suite_test.go:65-112)
+- integration/extended-resources: GPU pods w/ taints+tolerations
+  (test/suites/integration/extended_resources_test.go)
+- integration/scheduling: zone restriction, topology spread, anti-affinity
+- integration/tags: tag propagation to instances + launch templates
+- integration/block-device-mappings + metadata options
+- the threaded operator plane end-to-end (async batching windows)
+"""
+
+import time
+
+import pytest
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.nodetemplate import (BlockDeviceMapping, MetadataOptions,
+                                             NodeTemplate)
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.apis.settings import Settings
+from karpenter_tpu.fake.cloud import FakeCloud
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import (PodSpec, Taint, Toleration,
+                                      TopologySpreadConstraint, make_pod)
+from karpenter_tpu.models.requirements import OP_IN, Requirements
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def catalog():
+    return Catalog(types=[
+        make_instance_type("t.small", cpu=2, memory="2Gi", od_price=0.05, spot_price=0.02),
+        make_instance_type("m.large", cpu=4, memory="16Gi", od_price=0.20, spot_price=0.07),
+        make_instance_type("m.xlarge", cpu=16, memory="64Gi", od_price=0.80, spot_price=0.28),
+        make_instance_type("gpu.large", cpu=8, memory="32Gi", od_price=2.50,
+                           spot_price=0.90, extended={wk.RESOURCE_NVIDIA_GPU: 4},
+                           extra_labels={wk.LABEL_INSTANCE_GPU_NAME: "a100",
+                                         wk.LABEL_INSTANCE_GPU_COUNT: "4"}),
+    ])
+
+
+def make_operator(clock=None, **settings_kw):
+    clock = clock or FakeClock()
+    cloud = FakeCloud(catalog=catalog(), clock=clock)
+    settings = Settings(cluster_name="e2e",
+                        cluster_endpoint="https://k.example",
+                        batch_idle_duration=0.0, batch_max_duration=0.0,
+                        **settings_kw)
+    op = Operator(cloud, settings, catalog(), clock=clock)
+    op.kube.create("nodetemplates", "default", NodeTemplate(
+        name="default",
+        subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"}))
+    op.cloudprovider.register_nodetemplate(op.kube.get("nodetemplates", "default"))
+    return op
+
+
+def add_provisioner(op, name="default", **kw):
+    p = Provisioner(name=name, provider_ref=kw.pop("provider_ref", "default"), **kw)
+    p.set_defaults()
+    p.validate()
+    op.kube.create("provisioners", name, p)
+    return p
+
+
+@pytest.fixture
+def op():
+    operator = make_operator()
+    yield operator
+    operator.stop()
+
+
+class TestChaos:
+    """Runaway scale-up guards (chaos/suite_test.go:65-112): node count must
+    stay bounded while deprovisioning churns against a steady workload."""
+
+    def test_no_runaway_under_consolidation_churn(self, op):
+        add_provisioner(op, consolidation_enabled=True)
+        for i in range(20):
+            op.kube.create("pods", f"p{i}", make_pod(f"p{i}", cpu="1", memory="2Gi"))
+        op.provisioning.reconcile_once()
+        peak = len(op.cluster.nodes)
+        assert peak >= 1
+        # churn: repeated consolidation + provisioning cycles with the same
+        # workload must never create nodes beyond the initial peak + 1
+        # (one in-flight replacement is legal during a replace action)
+        for _ in range(10):
+            op.deprovisioning.reconcile_once()
+            op.termination.reconcile_once()
+            op.provisioning.reconcile_once()
+            op.clock.step(5)
+            assert len(op.cluster.nodes) <= peak + 1, "runaway scale-up"
+        # workload still fully scheduled at the end
+        assert len(op.kube.pending_pods()) == 0
+
+    def test_no_runaway_under_emptiness_churn(self, op):
+        add_provisioner(op, ttl_seconds_after_empty=10)
+        for i in range(10):
+            op.kube.create("pods", f"p{i}", make_pod(f"p{i}", cpu="1", memory="2Gi"))
+        op.provisioning.reconcile_once()
+        peak = len(op.cluster.nodes)
+        for cycle in range(6):
+            # delete and recreate the workload: nodes empty, TTL elapses,
+            # nodes are reclaimed, new pods must reuse/replace without runaway
+            for pod in list(op.kube.pods()):
+                op.kube.delete("pods", pod.name)
+            for node in op.cluster.nodes.values():
+                node.pods.clear()
+            op.clock.step(11)
+            op.deprovisioning.reconcile_emptiness()
+            op.termination.reconcile_once()
+            for i in range(10):
+                op.kube.create("pods", f"c{cycle}-p{i}",
+                               make_pod(f"c{cycle}-p{i}", cpu="1", memory="2Gi"))
+            op.provisioning.reconcile_once()
+            assert len(op.cluster.nodes) <= peak + 1, "runaway scale-up"
+
+
+class TestExtendedResources:
+    """GPU pods with taints/tolerations + extended-resource requests
+    (BASELINE configs[2]; integration/extended_resources_test.go analogue)."""
+
+    def gpu_provisioner(self, op):
+        return add_provisioner(
+            op, name="gpu",
+            taints=(Taint(key="nvidia.com/gpu", value="true", effect="NoSchedule"),),
+            requirements=Requirements.of(
+                (wk.LABEL_INSTANCE_TYPE, OP_IN, ["gpu.large"])))
+
+    def test_gpu_pods_land_on_gpu_nodes(self, op):
+        self.gpu_provisioner(op)
+        # cpu provisioner excludes the accelerator family, as in the reference
+        # E2E setup (a dedicated tainted provisioner owns GPU capacity)
+        add_provisioner(op, name="default", requirements=Requirements.of(
+            (wk.LABEL_INSTANCE_TYPE, OP_IN, ["t.small", "m.large", "m.xlarge"])))
+        for i in range(8):
+            op.kube.create("pods", f"g{i}", make_pod(
+                f"g{i}", cpu="1", memory="1Gi",
+                extended={wk.RESOURCE_NVIDIA_GPU: 1},
+                tolerations=(Toleration(key="nvidia.com/gpu", operator="Exists"),)))
+        for i in range(4):
+            op.kube.create("pods", f"c{i}", make_pod(f"c{i}", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        assert len(op.kube.pending_pods()) == 0
+        gpu_nodes = [n for n in op.cluster.nodes.values()
+                     if n.instance_type == "gpu.large"]
+        other = [n for n in op.cluster.nodes.values()
+                 if n.instance_type != "gpu.large"]
+        # 8 pods x 1 gpu on 4-gpu machines => exactly 2 gpu nodes
+        assert len(gpu_nodes) == 2
+        assert {p.name for n in gpu_nodes for p in n.pods} == {f"g{i}" for i in range(8)}
+        # untolerated cpu pods never land on tainted gpu nodes
+        assert all(not p.name.startswith("c") for n in gpu_nodes for p in n.pods)
+        assert other and all(p.name.startswith("c") for n in other for p in n.pods)
+
+    def test_gpu_node_carries_accelerator_labels(self, op):
+        self.gpu_provisioner(op)
+        op.kube.create("pods", "g0", make_pod(
+            "g0", cpu="1", memory="1Gi", extended={wk.RESOURCE_NVIDIA_GPU: 1},
+            tolerations=(Toleration(key="nvidia.com/gpu", operator="Exists"),)))
+        op.provisioning.reconcile_once()
+        (node,) = op.cluster.nodes.values()
+        assert node.labels[wk.LABEL_INSTANCE_GPU_NAME] == "a100"
+        assert node.allocatable[wk.RESOURCE_INDEX[wk.RESOURCE_NVIDIA_GPU]] == 4
+
+    def test_unknown_extended_resource_unschedulable(self, op):
+        add_provisioner(op)
+        op.kube.create("pods", "x", make_pod(
+            "x", cpu="1", memory="1Gi", extended={"vendor.example/fpga": 1}))
+        op.provisioning.reconcile_once()
+        assert not op.cluster.nodes
+        assert op.recorder.by_reason("FailedScheduling")
+
+
+class TestSchedulingConstraints:
+    def test_zone_restriction(self, op):
+        add_provisioner(op, requirements=Requirements.of(
+            (wk.LABEL_ZONE, OP_IN, ["zone-1b"])))
+        for i in range(5):
+            op.kube.create("pods", f"p{i}", make_pod(f"p{i}", cpu="1.5", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        assert op.cluster.nodes
+        assert all(n.zone == "zone-1b" for n in op.cluster.nodes.values())
+
+    def test_topology_spread_across_three_zones(self, op):
+        add_provisioner(op, requirements=Requirements.of(
+            (wk.LABEL_INSTANCE_TYPE, OP_IN, ["t.small"])))
+        for i in range(9):
+            op.kube.create("pods", f"p{i}", make_pod(
+                f"p{i}", cpu="1.5", memory="1Gi",
+                topology=(TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.LABEL_ZONE),)))
+        op.provisioning.reconcile_once()
+        assert len(op.kube.pending_pods()) == 0
+        per_zone = {}
+        for n in op.cluster.nodes.values():
+            per_zone[n.zone] = per_zone.get(n.zone, 0) + len(n.pods)
+        assert len(per_zone) == 3
+        assert max(per_zone.values()) - min(per_zone.values()) <= 1
+
+    def test_hostname_anti_affinity_one_pod_per_node(self, op):
+        add_provisioner(op)
+        for i in range(6):
+            op.kube.create("pods", f"p{i}", make_pod(
+                f"p{i}", cpu="100m", memory="128Mi", anti_affinity_hostname=True))
+        op.provisioning.reconcile_once()
+        assert len(op.cluster.nodes) == 6
+        assert all(len(n.pods) == 1 for n in op.cluster.nodes.values())
+
+    def test_spot_preferred_when_allowed(self, op):
+        # spot+OD allowed => cheapest (spot) offering chosen
+        # (getCapacityType, instance.go:430-443)
+        add_provisioner(op, requirements=Requirements.of(
+            (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        (node,) = op.cluster.nodes.values()
+        assert node.capacity_type == "spot"
+
+
+class TestTagsAndLaunchTemplateOptions:
+    def test_tags_propagate_to_instances(self, op):
+        t = op.kube.get("nodetemplates", "default")
+        t.tags = {"team": "ml", "env": "prod"}
+        add_provisioner(op)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        (inst,) = [i for i in op.cloudprovider.cloud.instances.values()]
+        assert inst.tags["team"] == "ml" and inst.tags["env"] == "prod"
+        # cluster ownership tags always present (launchInstance tag spec,
+        # instance.go:223-239)
+        assert any("cluster" in k for k in inst.tags)
+
+    def test_block_devices_and_metadata_options_propagate(self, op):
+        t = op.kube.get("nodetemplates", "default")
+        t.metadata_options = MetadataOptions(http_tokens="optional",
+                                             http_put_response_hop_limit=3)
+        t.block_device_mappings = (
+            BlockDeviceMapping(device_name="/dev/sda1", volume_size_gib=100,
+                               volume_type="balanced"),)
+        t.detailed_monitoring = True
+        t.validate()
+        add_provisioner(op)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        (inst,) = op.cloudprovider.cloud.instances.values()
+        lt = op.cloudprovider.cloud.launch_templates[inst.launch_template]
+        assert lt.metadata_options["http_tokens"] == "optional"
+        assert lt.metadata_options["http_put_response_hop_limit"] == 3
+        assert lt.block_devices[0]["volume_size_gib"] == 100
+        assert lt.block_devices[0]["volume_type"] == "balanced"
+        assert lt.monitoring is True
+
+    def test_distinct_options_yield_distinct_launch_templates(self, op):
+        add_provisioner(op)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        n_before = len(op.cloudprovider.cloud.launch_templates)
+        t = op.kube.get("nodetemplates", "default")
+        t.detailed_monitoring = True
+        t.generation += 1
+        # pod too large for the remaining capacity of the existing node
+        op.kube.create("pods", "b", make_pod("b", cpu="15.5", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        assert len(op.cloudprovider.cloud.launch_templates) == n_before + 1
+
+
+class TestNodeTemplateLifecycle:
+    def test_deleted_template_stops_resolving(self, op):
+        add_provisioner(op)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        assert len(op.cluster.nodes) == 1
+        # template deleted from the store -> machine creation must fail with
+        # NodeTemplateNotFound, not keep launching from a stale registry
+        op.kube.delete("nodetemplates", "default")
+        op.kube.create("pods", "b", make_pod("b", cpu="15.5", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        assert len(op.cluster.nodes) == 1  # no new capacity
+        assert op.recorder.by_reason("LaunchFailed")
+
+    def test_templates_differing_only_in_tags_get_distinct_lts(self, op):
+        op.kube.create("nodetemplates", "tagged", NodeTemplate(
+            name="tagged",
+            subnet_selector={"id": "subnet-zone-1a"},
+            tags={"team": "web"}))
+        add_provisioner(op, name="default")
+        add_provisioner(op, name="tagged-prov", provider_ref="tagged")
+        op.kube.create("pods", "a", make_pod(
+            "a", cpu="1", memory="1Gi",
+            node_selector={wk.LABEL_PROVISIONER: "default"}))
+        op.kube.create("pods", "b", make_pod(
+            "b", cpu="1", memory="1Gi",
+            node_selector={wk.LABEL_PROVISIONER: "tagged-prov"}))
+        op.provisioning.reconcile_once()
+        lts = op.cloudprovider.cloud.launch_templates
+        assert len(lts) == 2
+        assert {lt.tags.get("team") for lt in lts.values()} == {None, "web"}
+
+
+class TestThreadedOperator:
+    """The async controller plane end-to-end with real threads + real clock
+    (the reference's operator Start() path, cmd/controller/main.go:64)."""
+
+    def test_pods_flow_to_nodes_through_background_loops(self):
+        from karpenter_tpu.utils.clock import Clock
+
+        clock = Clock()
+        cloud = FakeCloud(catalog=catalog(), clock=clock)
+        settings = Settings(cluster_name="e2e-threaded",
+                            cluster_endpoint="https://k.example",
+                            batch_idle_duration=0.02, batch_max_duration=0.1)
+        op = Operator(cloud, settings, catalog(), clock=clock)
+        op.kube.create("nodetemplates", "default", NodeTemplate(
+            name="default",
+            subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"}))
+        op.cloudprovider.register_nodetemplate(
+            op.kube.get("nodetemplates", "default"))
+        add_provisioner(op)
+        try:
+            op.start()
+            for i in range(10):
+                op.kube.create("pods", f"p{i}", make_pod(f"p{i}", cpu="1", memory="2Gi"))
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if not op.kube.pending_pods() and op.cluster.nodes:
+                    break
+                time.sleep(0.05)
+            assert not op.kube.pending_pods()
+            # batching may split under scheduler jitter; bound, don't pin
+            assert 1 <= len(op.cluster.nodes) <= 2
+            assert op.livez() and op.healthz()
+            assert "karpenter" in op.metrics_text()
+        finally:
+            op.stop()

@@ -144,6 +144,33 @@ class TestRemoteParity:
         r2 = solver.solve([make_pod("b", cpu="1", memory="1Gi")])
         assert r2.nodes[0].option.itype.name != "m.large"
 
+    def test_stale_replica_sync_raises_not_loops(self):
+        # two replicas, one shared sidecar: the replica holding the OLDER
+        # catalog seqnum must get StaleSync from sync() (not a recorded
+        # "success" with the winner's seqnum that would send every later
+        # Solve into a rebuild/FAILED_PRECONDITION cycle)
+        from karpenter_tpu.solver.client import StaleSync
+        from karpenter_tpu.solver.service import serve as serve_fresh
+
+        srv, port, svc = serve_fresh("127.0.0.1:0")
+        try:
+            new_catalog = small_catalog()
+            new_catalog.seqnum = 7
+            winner = RemoteSolver(new_catalog, [default_provisioner()],
+                                  target=f"127.0.0.1:{port}")
+            assert winner.sync() == 7
+            old_catalog = small_catalog()
+            old_catalog.seqnum = 5
+            stale = RemoteSolver(old_catalog, [default_provisioner()],
+                                 target=f"127.0.0.1:{port}")
+            with pytest.raises(StaleSync):
+                stale.sync()
+            assert stale._synced_seqnum == -1  # never recorded a false sync
+            # the winner keeps solving fine
+            assert winner.solve([make_pod("a", cpu="1", memory="1Gi")]).nodes
+        finally:
+            srv.stop(grace=None)
+
     def test_health(self, server):
         solver = RemoteSolver(small_catalog(), [default_provisioner()],
                               target=f"127.0.0.1:{server}")
