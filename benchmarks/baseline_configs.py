@@ -1,0 +1,265 @@
+"""BASELINE.json configs[0..4] benchmark scenarios.
+
+One JSON line per config:
+  {"bench": "baseline_config", "config": i, "name": ..., "ms": ...,
+   "nodes": ..., "detail": {...}}
+
+Configs (BASELINE.json):
+  0  inflate: 100 homogeneous CPU pods, 1 provisioner, ~20 types
+     (+ decision parity with the scalar oracle — the north-star check)
+  1  5k mixed cpu/mem pods, anti-affinity + topology spread across 3 AZs,
+     full catalog
+  2  GPU pods with taints/tolerations + extended resources, spot+OD weighting
+  3  consolidation: 500 under-utilized nodes, replacement search over the
+     full catalog
+  4  stress: 50k pods, 8 provisioners with overlapping requirements, full
+     offering set — sharded over every visible device via parallel/sharded
+
+Usage: python -m benchmarks.baseline_configs [--configs 0,1,2,3,4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.models.instancetype import Catalog
+from karpenter_tpu.models.pod import (Taint, Toleration,
+                                      TopologySpreadConstraint, make_pod)
+from karpenter_tpu.models.requirements import OP_IN, Requirements
+from karpenter_tpu.providers.instancetypes import generate_fleet_catalog
+from karpenter_tpu.solver.core import TPUSolver
+
+REPEATS = 5
+
+
+def _provisioner(name="default", **kw):
+    p = Provisioner(name=name, **kw)
+    p.set_defaults()
+    return p
+
+
+def _timed_solve(solver, pods, repeats=REPEATS):
+    result = solver.solve(pods)  # warmup: compile + grid build
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        result = solver.solve(pods)
+        times.append((time.perf_counter() - t0) * 1000)
+    return result, statistics.median(times)
+
+
+def config_0_inflate() -> dict:
+    catalog = generate_fleet_catalog(max_types=20)
+    prov = _provisioner()
+    pods = [make_pod(f"inflate-{i}", cpu="1", memory="1536Mi")
+            for i in range(100)]
+    solver = TPUSolver(catalog, [prov])
+    result, ms = _timed_solve(solver, pods)
+
+    # north star: identical node decisions to the sequential oracle
+    from karpenter_tpu.oracle.scheduler import Scheduler
+    oracle = Scheduler(catalog, [prov])
+    oracle_result = oracle.schedule(pods)
+    oracle_decisions = oracle_result.node_decisions(oracle.options)
+    assert result.decisions() == oracle_decisions, "decision parity violated"
+
+    return {"bench": "baseline_config", "config": 0, "name": "inflate-100",
+            "ms": round(ms, 3), "nodes": len(result.nodes),
+            "detail": {"n_types": len(catalog.types),
+                       "oracle_parity": True,
+                       "unschedulable": result.unschedulable_count()}}
+
+
+def _mixed_5k_pods():
+    spread = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),)
+    pods = []
+    for name, count, cpu, mem, topo, anti in (
+            ("web", 1500, "500m", "1Gi", spread, False),
+            ("api", 1200, "1", "2Gi", spread, False),
+            ("singleton", 100, "250m", "512Mi", (), True),
+            ("cache", 700, "2", "8Gi", (), False),
+            ("batch", 1000, "250m", "512Mi", (), False),
+            ("mem", 500, "500m", "4Gi", (), False)):
+        for i in range(count):
+            pods.append(make_pod(f"{name}-{i}", cpu=cpu, memory=mem,
+                                 topology=topo, anti_affinity_hostname=anti))
+    assert len(pods) == 5000
+    return pods
+
+
+def config_1_mixed_5k() -> dict:
+    catalog = generate_fleet_catalog()
+    prov = _provisioner(requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+    solver = TPUSolver(catalog, [prov])
+    result, ms = _timed_solve(solver, _mixed_5k_pods())
+    assert result.unschedulable_count() == 0
+    return {"bench": "baseline_config", "config": 1, "name": "mixed-5k-3az",
+            "ms": round(ms, 3), "nodes": len(result.nodes),
+            "detail": {"n_types": len(catalog.types)}}
+
+
+def config_2_gpu() -> dict:
+    catalog = generate_fleet_catalog()
+    gpu_prov = _provisioner(
+        name="gpu", weight=10,  # preferred for pods that tolerate its taint
+        taints=(Taint(key="nvidia.com/gpu", value="true", effect="NoSchedule"),),
+        requirements=Requirements.of(
+            (wk.LABEL_INSTANCE_GPU_NAME, OP_IN, ["a100"]),
+            (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+    cpu_prov = _provisioner(name="default")
+    tol = (Toleration(key="nvidia.com/gpu", operator="Exists"),)
+    pods = [make_pod(f"train-{i}", cpu="4", memory="16Gi",
+                     extended={wk.RESOURCE_NVIDIA_GPU: 1}, tolerations=tol)
+            for i in range(200)]
+    pods += [make_pod(f"cpu-{i}", cpu="1", memory="2Gi") for i in range(300)]
+    solver = TPUSolver(catalog, [gpu_prov, cpu_prov])
+    result, ms = _timed_solve(solver, pods)
+    assert result.unschedulable_count() == 0
+    gpu_nodes = [n for n in result.nodes if n.provisioner.name == "gpu"]
+    assert gpu_nodes and all(
+        dict(n.option.itype.labels).get(wk.LABEL_INSTANCE_GPU_NAME) == "a100"
+        for n in gpu_nodes)
+    # spot+OD weighting: every gpu node decision picked the cheaper offering
+    assert all(n.option.capacity_type == "spot" for n in gpu_nodes)
+    return {"bench": "baseline_config", "config": 2, "name": "gpu-taints-spot",
+            "ms": round(ms, 3), "nodes": len(result.nodes),
+            "detail": {"gpu_nodes": len(gpu_nodes)}}
+
+
+def config_3_consolidation() -> dict:
+    from karpenter_tpu.models.cluster import ClusterState, StateNode
+    from karpenter_tpu.ops.consolidate import run_consolidation
+
+    catalog = generate_fleet_catalog()
+    prov = _provisioner(consolidation_enabled=True)
+    cluster = ClusterState()
+    # 500 m5.2xlarge-ish nodes each holding one small pod: all candidates
+    big = catalog.by_name["m5.2xlarge"]
+    for i in range(500):
+        node = StateNode(
+            name=f"n-{i}",
+            labels={**big.labels_dict(), wk.LABEL_ZONE: "zone-1a",
+                    wk.LABEL_CAPACITY_TYPE: "on-demand",
+                    wk.LABEL_PROVISIONER: "default"},
+            allocatable=big.allocatable_vector(),
+            instance_type=big.name, zone="zone-1a", capacity_type="on-demand",
+            price=big.offerings[0].price, provisioner_name="default",
+            pods=[make_pod(f"p-{i}", cpu="500m", memory="1Gi",
+                           node_name=f"n-{i}")],
+        )
+        cluster.add_node(node)
+    run_consolidation(cluster, catalog, [prov])  # warmup
+    times = []
+    action = None
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        action = run_consolidation(cluster, catalog, [prov])
+        times.append((time.perf_counter() - t0) * 1000)
+    assert action is not None
+    return {"bench": "baseline_config", "config": 3, "name": "consolidation-500",
+            "ms": round(statistics.median(times), 3), "nodes": 500,
+            "detail": {"action": action.kind, "node": action.node,
+                       "savings_per_hour": round(action.savings, 4)}}
+
+
+def config_4_stress_50k() -> dict:
+    import jax
+    import numpy as np
+
+    from karpenter_tpu.models.encode import encode_problem
+    from karpenter_tpu.ops.packer import PackInputs
+    from karpenter_tpu.parallel.sharded import make_mesh, sharded_pack
+    from karpenter_tpu.solver.core import _bucket
+
+    catalog = generate_fleet_catalog()
+    # 8 provisioners with overlapping requirements (BASELINE configs[4])
+    provisioners = []
+    for i, (ct, archs) in enumerate((
+            (["on-demand"], ["amd64"]),
+            (["spot", "on-demand"], ["amd64"]),
+            (["spot"], ["amd64"]),
+            (["on-demand"], ["arm64"]),
+            (["spot", "on-demand"], ["arm64"]),
+            (["spot", "on-demand"], ["amd64", "arm64"]),
+            (["on-demand"], ["amd64", "arm64"]),
+            (["spot"], ["amd64", "arm64"]))):
+        p = Provisioner(name=f"prov-{i}", weight=len(provisioners),
+                        requirements=Requirements.of(
+                            (wk.LABEL_CAPACITY_TYPE, OP_IN, ct),
+                            (wk.LABEL_ARCH, OP_IN, archs)))
+        p.set_defaults()
+        provisioners.append(p)
+    pods = []
+    for d in range(25):
+        for i in range(2000):
+            pods.append(make_pod(f"d{d}-p{i}", cpu=f"{250 * (d % 4 + 1)}m",
+                                 memory=f"{512 * (d % 8 + 1)}Mi"))
+    assert len(pods) == 50_000
+
+    t_enc = time.perf_counter()
+    enc = encode_problem(catalog, provisioners, pods)
+    encode_ms = (time.perf_counter() - t_enc) * 1000
+
+    Gb = _bucket(enc.group_vec.shape[0])
+
+    def pad(a, n, axis=0, fill=0):
+        if a.shape[axis] == n:
+            return a
+        w = [(0, 0)] * a.ndim
+        w[axis] = (0, n - a.shape[axis])
+        return np.pad(a, w, constant_values=fill)
+
+    inputs = PackInputs(
+        alloc_t=enc.alloc_t, tiebreak=enc.tiebreak,
+        group_vec=pad(enc.group_vec, Gb), group_count=pad(enc.group_count, Gb),
+        group_cap=pad(enc.group_cap, Gb), group_feas=pad(enc.group_feas, Gb),
+        group_newprov=pad(enc.group_newprov, Gb, fill=-1), overhead=enc.overhead,
+        ex_alloc=enc.ex_alloc, ex_used=enc.ex_used, ex_feas=pad(enc.ex_feas, Gb),
+    )
+    n_slots = _bucket(enc.n_slots)
+    mesh = make_mesh(len(jax.devices()))
+    result = sharded_pack(inputs, n_slots, mesh)  # warmup (compile)
+    jax.block_until_ready(result.assign)
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        result = sharded_pack(inputs, n_slots, mesh)
+        jax.block_until_ready(result.assign)
+        times.append((time.perf_counter() - t0) * 1000)
+    n_open = int(np.asarray(result.active).sum())
+    n_unsched = int(np.asarray(result.unsched).sum())
+    assert n_unsched == 0, f"{n_unsched} pods unschedulable"
+    return {"bench": "baseline_config", "config": 4, "name": "stress-50k-sharded",
+            "ms": round(statistics.median(times), 3), "nodes": n_open,
+            "detail": {"n_pods": len(pods), "n_types": len(catalog.types),
+                       "n_devices": mesh.devices.size,
+                       "encode_ms": round(encode_ms, 3),
+                       "mesh": dict(zip(mesh.axis_names, mesh.devices.shape))}}
+
+
+CONFIGS = {
+    0: config_0_inflate,
+    1: config_1_mixed_5k,
+    2: config_2_gpu,
+    3: config_3_consolidation,
+    4: config_4_stress_50k,
+}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--configs", default="0,1,2,3,4")
+    args = parser.parse_args(argv)
+    for idx in (int(c) for c in args.configs.split(",")):
+        print(json.dumps(CONFIGS[idx]()), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,107 @@
+"""Interruption-controller throughput benchmark.
+
+Parity target: /root/reference/pkg/controllers/interruption/
+interruption_benchmark_test.go:61-120 — queue 100 / 1,000 / 5,000 / 15,000
+interruption messages against provisioned (fake) nodes and measure drain
+throughput of the receive -> parse -> act -> delete pipeline.
+
+Usage: python -m benchmarks.interruption_bench [--scales 100,1000,5000,15000]
+Prints one JSON line per scale:
+  {"bench": "interruption", "messages": N, "seconds": S, "msgs_per_sec": R}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.nodetemplate import NodeTemplate
+from karpenter_tpu.apis.settings import Settings
+from karpenter_tpu.fake.cloud import FakeCloud
+from karpenter_tpu.models.cluster import StateNode
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.machine import make_provider_id
+from karpenter_tpu.operator import Operator
+
+
+def _catalog() -> Catalog:
+    return Catalog(types=[
+        make_instance_type("m.large", cpu=4, memory="16Gi",
+                           od_price=0.20, spot_price=0.07),
+    ])
+
+
+def build_operator(n_nodes: int) -> Operator:
+    settings = Settings(cluster_name="bench",
+                        cluster_endpoint="https://bench.example",
+                        interruption_queue_name="bench-queue",
+                        batch_idle_duration=0.0, batch_max_duration=0.0)
+    op = Operator(FakeCloud(catalog=_catalog()), settings, _catalog())
+    op.kube.create("nodetemplates", "default", NodeTemplate(
+        name="default", subnet_selector={"id": "subnet-zone-1a"}))
+    # seed nodes directly, as the reference benchmark provisions fake nodes
+    # (interruption_benchmark_test.go:87-120) — provisioning isn't under test
+    for i in range(n_nodes):
+        node = StateNode(
+            name=f"node-{i}",
+            provider_id=make_provider_id("zone-1a", f"i-{i:08d}"),
+            labels={wk.LABEL_INSTANCE_TYPE: "m.large",
+                    wk.LABEL_ZONE: "zone-1a",
+                    wk.LABEL_CAPACITY_TYPE: wk.CAPACITY_TYPE_SPOT,
+                    wk.LABEL_PROVISIONER: "default"},
+            instance_type="m.large", zone="zone-1a",
+            capacity_type=wk.CAPACITY_TYPE_SPOT,
+            allocatable=wk.capacity_vector({wk.RESOURCE_CPU: 4000,
+                                            wk.RESOURCE_MEMORY: 16 * 2**30,
+                                            wk.RESOURCE_PODS: 110}),
+            provisioner_name="default",
+        )
+        op.cluster.add_node(node)
+        op.kube.create("nodes", node.name, node)
+    return op
+
+
+def spot_message(instance_id: str) -> str:
+    return json.dumps({
+        "source": "cloud.spot",
+        "detail-type": "Spot Instance Interruption Warning",
+        "detail": {"instance-id": instance_id},
+    })
+
+
+def run_scale(n: int) -> dict:
+    op = build_operator(n)
+    try:
+        for i in range(n):
+            op.queue.send(spot_message(f"i-{i:08d}"))
+        t0 = time.perf_counter()
+        drained = 0
+        while drained < n:
+            got = op.interruption.reconcile_once()
+            if got == 0:
+                break
+            drained += got
+        seconds = time.perf_counter() - t0
+        assert drained == n, f"drained {drained}/{n}"
+        acted = op.interruption.actions.value(action="CordonAndDrain")
+        assert acted >= n, f"only {acted}/{n} cordon actions"
+        return {"bench": "interruption", "messages": n,
+                "seconds": round(seconds, 4),
+                "msgs_per_sec": round(n / seconds, 1)}
+    finally:
+        op.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--scales", default="100,1000,5000,15000")
+    args = parser.parse_args(argv)
+    for scale in (int(s) for s in args.scales.split(",")):
+        print(json.dumps(run_scale(scale)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

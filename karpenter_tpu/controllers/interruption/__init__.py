@@ -29,7 +29,6 @@ from ...apis import wellknown as wk
 from ...events import EventRecorder
 from ...metrics import NAMESPACE, REGISTRY, Registry
 from ...models.cluster import ClusterState
-from ...models.machine import parse_provider_id
 from ...utils.clock import Clock
 
 log = logging.getLogger("karpenter.interruption")
@@ -225,8 +224,7 @@ class InterruptionController:
         messages = self.queue.receive(max_messages=10, wait_seconds=wait_seconds)
         if not messages:
             return 0
-        id_map = self._instance_id_map()
-        futures = [self._pool.submit(self._handle, m, id_map) for m in messages]
+        futures = [self._pool.submit(self._handle, m) for m in messages]
         for f in futures:
             try:
                 f.result()
@@ -236,28 +234,19 @@ class InterruptionController:
                 log.warning("interruption message handling failed: %s", e)
         return len(messages)
 
-    def _instance_id_map(self) -> "dict[str, str]":
-        """instance id -> node name (makeInstanceIDMap, controller.go:236-255)."""
-        out = {}
-        for node in self.cluster.nodes.values():
-            if node.provider_id:
-                try:
-                    _, iid = parse_provider_id(node.provider_id)
-                    out[iid] = node.name
-                except ValueError:
-                    pass
-        return out
-
-    def _handle(self, qmsg, id_map) -> None:
+    def _handle(self, qmsg) -> None:
+        """instance-id -> node resolution uses the cluster's incrementally
+        maintained index (vs makeInstanceIDMap's per-poll rebuild,
+        controller.go:236-255 — O(1) per message at any cluster size)."""
         msg = self.parsers.parse(qmsg.body, qmsg.receipt, qmsg.enqueued_at)
         self.received.inc(message_type=msg.kind)
         if msg.enqueued_at:
             self.latency.observe(max(0.0, self.clock.now() - msg.enqueued_at))
         for iid in msg.instance_ids:
-            node_name = id_map.get(iid)
-            if msg.kind == KIND_SPOT_INTERRUPTION and node_name:
-                node = self.cluster.nodes.get(node_name)
-                if node is not None and node.capacity_type == wk.CAPACITY_TYPE_SPOT:
+            node = self.cluster.node_by_instance_id(iid)
+            node_name = node.name if node is not None else None
+            if msg.kind == KIND_SPOT_INTERRUPTION and node is not None:
+                if node.capacity_type == wk.CAPACITY_TYPE_SPOT:
                     # interrupted spot pool is effectively ICE (controller.go:186-192)
                     self.ice.mark_unavailable(
                         "SpotInterruption", node.instance_type, node.zone,

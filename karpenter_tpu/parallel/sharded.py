@@ -28,10 +28,34 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.packer import PackInputs, PackResult, pack_impl
+from ..ops.packer import INT_BIG, PackInputs, PackResult, pack_impl
 
 AXIS_NODES = "nodes"
 AXIS_TYPES = "types"
+
+
+def pad_types(inputs: PackInputs, multiple: int) -> PackInputs:
+    """Pad the instance-type axis to a multiple of the mesh's type dimension
+    with never-selectable entries: zero capacity, INT_BIG tiebreak, infeasible
+    everywhere. Transparent to consumers — `decided` flat ids are t*S+s with S
+    unchanged, so real types keep their ids."""
+    T = inputs.alloc_t.shape[0]
+    Tp = -(-T // multiple) * multiple
+    if Tp == T:
+        return inputs
+    pad_n = Tp - T
+
+    def pad(a, axis, value):
+        a = np.asarray(a)
+        w = [(0, 0)] * a.ndim
+        w[axis] = (0, pad_n)
+        return np.pad(a, w, constant_values=value)
+
+    return inputs._replace(
+        alloc_t=pad(inputs.alloc_t, 0, 0),
+        tiebreak=pad(inputs.tiebreak, 0, int(INT_BIG)),
+        group_feas=pad(inputs.group_feas, 2, False),
+    )
 
 
 def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
@@ -70,6 +94,7 @@ def _constrained_pack(inputs: PackInputs, n_slots: int, mesh: Mesh) -> PackResul
 def sharded_pack(inputs: PackInputs, n_slots: int, mesh: Mesh) -> PackResult:
     """Run the packer SPMD over `mesh`. Bit-identical to single-device pack
     (tests/test_sharded.py)."""
+    inputs = pad_types(inputs, mesh.shape[AXIS_TYPES])
     shardings = input_shardings(mesh)
     inputs = jax.tree.map(
         lambda a, sh: jax.device_put(jax.numpy.asarray(a), sh), inputs, shardings

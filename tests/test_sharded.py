@@ -59,6 +59,25 @@ def test_mesh_uses_all_devices():
 @pytest.mark.parametrize("n_devices", [2, 4, 8])
 def test_sharded_pack_parity(n_devices):
     enc = build_inputs()
+    _assert_parity(enc, n_devices)
+
+
+def test_sharded_pack_parity_odd_type_count():
+    # 5 types on a (2, 2) mesh: the type axis is NOT divisible by the mesh
+    # dim, exercising pad_types (never-selectable padded entries)
+    catalog = Catalog(types=[
+        make_instance_type(f"o.{i}x", cpu=2 * (i + 1), memory=f"{8 * (i + 1)}Gi",
+                           od_price=0.1 * (i + 1), spot_price=0.03 * (i + 1))
+        for i in range(5)
+    ])
+    prov = Provisioner(name="default")
+    prov.set_defaults()
+    pods = [make_pod(f"a{i}", cpu="1", memory="2Gi") for i in range(25)]
+    enc = encode_problem(catalog, [prov], pods)
+    _assert_parity(enc, 4)
+
+
+def _assert_parity(enc, n_devices):
     inputs, n_slots = pad_inputs(enc)
     base = jax.device_get(pack(jax.device_put(inputs), n_slots=n_slots))
     mesh = make_mesh(n_devices)
