@@ -1,0 +1,305 @@
+"""Randomized / race tier — the `make battletest` analogue (SURVEY.md §4
+tier 2: -race -cover --ginkgo.randomize-all -tags random_test_delay).
+
+Three layers:
+- hypothesis property tests: kernel/oracle decision parity over a generated
+  pod space, quantity parsing laws
+- threaded race stress with random delays: batcher fan-out, queue
+  at-least-once delivery, TTL/ICE cache coherence under concurrency
+- seeded random controller-op churn with global invariants
+"""
+
+import random
+import threading
+import time
+
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.batcher import Batcher
+from karpenter_tpu.cache import TTLCache, UnavailableOfferings
+from karpenter_tpu.controllers.interruption import FakeQueue
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import TopologySpreadConstraint, make_pod
+from karpenter_tpu.models.requirements import OP_IN, Requirements
+from karpenter_tpu.oracle.scheduler import Scheduler
+from karpenter_tpu.solver.core import TPUSolver
+from karpenter_tpu.utils.quantity import cpu_millis, mem_bytes
+
+
+def battletest_catalog():
+    return Catalog(types=[
+        make_instance_type("small.2x", cpu=2, memory="8Gi", od_price=0.10, spot_price=0.03),
+        make_instance_type("medium.4x", cpu=4, memory="16Gi", od_price=0.20, spot_price=0.06),
+        make_instance_type("large.8x", cpu=8, memory="32Gi", od_price=0.40, spot_price=0.12),
+        make_instance_type("mem.4x", cpu=4, memory="64Gi", od_price=0.55, spot_price=0.17),
+    ])
+
+
+# -- hypothesis: parity over a generated pod space ---------------------------------
+
+pod_strategy = st.builds(
+    dict,
+    cpu=st.sampled_from(["100m", "250m", "500m", "1", "1500m", "2", "3", "7"]),
+    memory=st.sampled_from(["128Mi", "512Mi", "1Gi", "2Gi", "4Gi", "30Gi"]),
+    zone=st.sampled_from(["", "zone-1a", "zone-1b"]),
+    spread=st.booleans(),
+    capacity=st.sampled_from(["", "spot", "on-demand"]),
+    count=st.integers(min_value=1, max_value=12),
+)
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.lists(pod_strategy, min_size=1, max_size=6))
+def test_fuzz_parity_kernel_vs_oracle(specs):
+    """Kernel decisions must be bit-identical to the scalar oracle on any
+    workload the generator produces (FIXED catalog so compiled shapes are
+    reused across examples)."""
+    catalog = battletest_catalog()
+    prov = Provisioner(name="default", requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+    prov.set_defaults()
+    pods = []
+    for si, spec in enumerate(specs):
+        sel = {wk.LABEL_ZONE: spec["zone"]} if spec["zone"] else {}
+        if spec["capacity"]:
+            sel[wk.LABEL_CAPACITY_TYPE] = spec["capacity"]
+        topo = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),) \
+            if spec["spread"] else ()
+        for i in range(spec["count"]):
+            pods.append(make_pod(f"g{si}-p{i}", cpu=spec["cpu"],
+                                 memory=spec["memory"], node_selector=dict(sel),
+                                 topology=topo))
+    sched = Scheduler(catalog, [prov])
+    oracle = sched.schedule(list(pods))
+    kernel = TPUSolver(catalog, [prov]).solve(list(pods))
+    assert kernel.decisions() == oracle.node_decisions(sched.options)
+    assert kernel.unschedulable_count() == len(oracle.unschedulable)
+
+
+@settings(max_examples=50, deadline=None)
+@given(st.integers(min_value=0, max_value=10**15))
+def test_fuzz_quantity_cpu_millis_roundtrip(n):
+    assert cpu_millis(f"{n}m") == n
+    assert cpu_millis(str(n)) == n * 1000
+
+
+@settings(max_examples=50, deadline=None)
+@given(st.integers(min_value=0, max_value=2**50),
+       st.sampled_from(["", "Ki", "Mi", "Gi", "k", "M", "G"]))
+def test_fuzz_quantity_mem_bytes_monotone(n, suffix):
+    a = mem_bytes(f"{n}{suffix}")
+    b = mem_bytes(f"{n + 1}{suffix}")
+    assert 0 <= a < b
+
+
+# -- threaded race stress ----------------------------------------------------------
+
+class TestBatcherRaces:
+    def test_concurrent_adds_each_caller_gets_own_result(self):
+        delays = random.Random(7)
+
+        def exec_fn(requests):
+            time.sleep(delays.random() * 0.01)  # random_test_delay analogue
+            return [r * 10 for r in requests]
+
+        b = Batcher(exec_fn, idle_seconds=0.005, max_seconds=0.05, max_items=64)
+        results = {}
+        errors = []
+
+        def worker(i):
+            try:
+                results[i] = b.add(i)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(100)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        b.stop()
+        assert not errors
+        assert results == {i: i * 10 for i in range(100)}
+
+    def test_stop_resolves_inflight_callers(self):
+        release = threading.Event()
+
+        def exec_fn(requests):
+            release.wait(2)
+            return list(requests)
+
+        b = Batcher(exec_fn, idle_seconds=5.0, max_seconds=10.0, max_items=1000)
+        out = {}
+        t = threading.Thread(target=lambda: out.setdefault("r", b.add(1)))
+        t.start()
+        time.sleep(0.05)
+        release.set()
+        b.stop()
+        t.join(timeout=5)
+        assert out.get("r") == 1
+
+
+class TestQueueRaces:
+    def test_concurrent_producers_consumers_at_least_once(self):
+        q = FakeQueue(visibility_seconds=60)
+        N = 500
+        seen = set()
+        seen_lock = threading.Lock()
+
+        def produce(base):
+            for i in range(N // 5):
+                q.send(f"msg-{base + i}")
+
+        def consume():
+            idle = 0
+            while idle < 20:
+                msgs = q.receive(max_messages=10)
+                if not msgs:
+                    idle += 1
+                    time.sleep(0.002)
+                    continue
+                idle = 0
+                for m in msgs:
+                    with seen_lock:
+                        seen.add(m.body)
+                    q.delete(m.receipt)
+
+        producers = [threading.Thread(target=produce, args=(i * (N // 5),))
+                     for i in range(5)]
+        consumers = [threading.Thread(target=consume) for _ in range(4)]
+        for t in producers + consumers:
+            t.start()
+        for t in producers:
+            t.join(timeout=10)
+        for t in consumers:
+            t.join(timeout=10)
+        assert seen == {f"msg-{i}" for i in range(N)}
+        assert q.approximate_depth() == 0
+
+
+class TestCacheRaces:
+    def test_ttl_cache_concurrent_mixed_ops(self):
+        cache = TTLCache(ttl=0.05)
+        stop = threading.Event()
+        errors = []
+
+        def hammer(seed):
+            rng = random.Random(seed)
+            while not stop.is_set():
+                try:
+                    k = rng.randrange(20)
+                    op = rng.random()
+                    if op < 0.4:
+                        cache.set(k, k * 2)
+                    elif op < 0.8:
+                        v = cache.get(k)
+                        assert v is None or v == k * 2
+                    elif op < 0.9:
+                        cache.delete(k)
+                    else:
+                        cache.get_or_load(k, lambda k=k: k * 2)
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=hammer, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errors
+
+    def test_ice_cache_seqnum_monotone_under_concurrency(self):
+        ice = UnavailableOfferings()
+        seqs = []
+
+        def mark(i):
+            ice.mark_unavailable("test", f"t{i % 5}.x", "zone-1a", "spot")
+            seqs.append(ice.seqnum)
+
+        threads = [threading.Thread(target=mark, args=(i,)) for i in range(50)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        assert ice.seqnum >= max(seqs)
+        assert ice.is_unavailable("spot", "t0.x", "zone-1a")
+
+
+# -- seeded random controller churn ------------------------------------------------
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_random_controller_op_churn_invariants(seed):
+    """Random op sequence over the full controller plane; after every step the
+    global invariants must hold (the randomize-all battletest analogue)."""
+    from karpenter_tpu.apis.nodetemplate import NodeTemplate
+    from karpenter_tpu.apis.settings import Settings
+    from karpenter_tpu.fake.cloud import FakeCloud
+    from karpenter_tpu.operator import Operator
+    from karpenter_tpu.utils.clock import FakeClock
+
+    rng = random.Random(seed)
+    clock = FakeClock()
+    catalog = battletest_catalog()
+    cloud = FakeCloud(catalog=catalog, clock=clock)
+    settings = Settings(cluster_name="battle", cluster_endpoint="https://k",
+                        interruption_queue_name="bq",
+                        batch_idle_duration=0.0, batch_max_duration=0.0)
+    op = Operator(cloud, settings, catalog, clock=clock)
+    op.kube.create("nodetemplates", "default", NodeTemplate(
+        name="default",
+        subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"}))
+    p = Provisioner(name="default", provider_ref="default",
+                    ttl_seconds_after_empty=30)
+    op.kube.create("provisioners", "default", p)
+
+    pod_i = 0
+    controllers = [
+        op.provisioning.reconcile_once,
+        op.termination.reconcile_once,
+        op.deprovisioning.reconcile_once,
+        op.nodetemplate.reconcile_once,
+        op.machinehydration.reconcile_once,
+        lambda: op.interruption.reconcile_once(),
+    ]
+    try:
+        for step in range(60):
+            roll = rng.random()
+            if roll < 0.35:
+                for _ in range(rng.randrange(1, 6)):
+                    op.kube.create("pods", f"p{pod_i}", make_pod(
+                        f"p{pod_i}", cpu=rng.choice(["250m", "1", "2"]),
+                        memory=rng.choice(["256Mi", "1Gi", "4Gi"])))
+                    pod_i += 1
+            elif roll < 0.5 and op.kube.pods():
+                victim = rng.choice(op.kube.pods())
+                op.kube.delete("pods", victim.name)
+                if victim.node_name and victim.node_name in op.cluster.nodes:
+                    node = op.cluster.nodes[victim.node_name]
+                    node.pods = [q for q in node.pods if q.name != victim.name]
+            elif roll < 0.6:
+                clock.step(rng.randrange(1, 60))
+            # run a random subset of controllers in random order
+            order = rng.sample(controllers, k=rng.randrange(1, len(controllers)))
+            for fn in order:
+                fn()
+
+            # -- invariants -----------------------------------------------------
+            for node in op.cluster.nodes.values():
+                used = node.used_vector()
+                assert all(u <= a for u, a in zip(used, node.allocatable)), \
+                    f"seed={seed} step={step}: node {node.name} overpacked"
+            for pod in op.kube.pods():
+                if pod.node_name:
+                    assert pod.node_name in op.cluster.nodes, \
+                        f"seed={seed} step={step}: pod {pod.name} bound to ghost"
+        # drain: everything pending must eventually schedule
+        op.provisioning.reconcile_once()
+        assert not op.kube.pending_pods()
+    finally:
+        op.stop()
