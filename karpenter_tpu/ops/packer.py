@@ -41,6 +41,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from . import pallas_kernels
+
 INT_BIG = jnp.int32(2**30)
 
 
@@ -120,7 +122,12 @@ def _step(inputs: PackInputs, state: PackState, g: jax.Array):
     # ---- 2) open claims, first-fit in creation order -------------------------
     feas_n = inputs.group_feas[g][jnp.clip(state.nprov, 0, None)]  # [N, T, S]
     nodefeas = state.optmask & feas_n & state.active[:, None, None]
-    q_nt = _quotient(inputs.alloc_t[None, :, :] - state.used[:, None, :], vec)  # [N, T]
+    if pallas_kernels.enabled():
+        # fused Pallas path (flag read at trace time; set the env var before
+        # the first solve — see ops/pallas_kernels.py)
+        q_nt = pallas_kernels.quotient_nt_auto(inputs.alloc_t, state.used, vec)
+    else:
+        q_nt = _quotient(inputs.alloc_t[None, :, :] - state.used[:, None, :], vec)  # [N, T]
     q_cap = jnp.where(nodefeas, q_nt[:, :, None], -1)              # [N, T, S]
     qmax = jnp.max(q_cap.reshape(q_cap.shape[0], -1), axis=-1)     # [N]
     fill_n = jnp.clip(jnp.minimum(qmax, cap), 0, INT_BIG)

@@ -1,0 +1,145 @@
+"""Pallas TPU kernels for the packer's inner hot op.
+
+The packer scan step's dominant compute is the per-(node, type) capacity
+quotient (ops/packer.py `_step` step 2):
+
+    q[n, t] = min over r of floor((alloc[t, r] - used[n, r]) / vec[r])
+
+Stock XLA evaluates this as a fused elementwise+reduce over a virtual
+[N, T, R] iteration space. This kernel restructures it VPU-first: one grid
+program per node tile, the R axis statically unrolled (R = 11), each r step a
+[TILE_N, T] broadcast-subtract + divide + min — no [N, T, R] intermediate and
+lane-aligned [*, T] tiles throughout.
+
+Numerics: canonical units keep every value < 2**24 (apis/wellknown.py), so
+f32 division is used with one exact correction step (products stay < 2**24,
+so `q*vec` comparisons are exact) — results are bit-identical to the int32
+reference (tests/test_pallas_kernels.py).
+
+Selection: enabled on TPU backends when KARPENTER_TPU_PALLAS=1 (or
+force_enable()); everywhere else the stock-XLA `_quotient` path runs. On CPU
+the kernel runs in interpreter mode for semantics tests only.
+
+Measured (TPU v5e via tunnel, N=128 T=551 R=11, 100-iter on-device loop to
+amortize the ~66 ms host<->device RTT): pallas ~735-745 us/iter vs XLA
+~760-770 us/iter end-to-end — i.e. parity to ~3% total; XLA's own fusion of
+the subtract/div/min reduction is already near-optimal for this shape, and
+the solve cycle is RTT-bound, not compute-bound. Kept flag-gated (default
+off) as the hook for larger option grids where the [N, T, S] masks stop
+fitting in cache-friendly tiles.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+INT_BIG = 2**30  # plain int: jnp scalars would be captured as tracer consts
+_LANE = 128
+_SUBLANE = 8
+_TILE_N = 64
+
+_force = {"on": False}
+
+
+def force_enable(on: bool = True) -> None:
+    _force["on"] = on
+
+
+def enabled() -> bool:
+    if _force["on"]:
+        return True
+    return os.environ.get("KARPENTER_TPU_PALLAS", "") == "1"
+
+
+def _pad_to(x, axis, multiple, value):
+    n = x.shape[axis]
+    target = -(-n // multiple) * multiple
+    if target == n:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, target - n)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def _quotient_kernel(alloc_ref, used_ref, vec_ref, out_ref, *, n_res: int):
+    """One program = TILE_N node slots x all types.
+
+    alloc_ref: [R16, Tp] i32 (type-major: row r = resource r across types)
+    used_ref:  [TILE_N, R16] i32
+    vec_ref:   [1, R16] i32 (SMEM)
+    out_ref:   [TILE_N, Tp] i32
+    """
+    for r in range(n_res):  # static unroll over the resource axis
+        vec_r = vec_ref[0, r]
+
+        @pl.when(vec_r > 0)  # vec_r == 0: resource not demanded, no-op
+        def _():
+            avail = alloc_ref[r:r + 1, :] - used_ref[:, r:r + 1]  # [TILE_N, Tp]
+            af = avail.astype(jnp.float32)
+            vf = vec_r.astype(jnp.float32)
+            qr = jnp.floor(af / vf).astype(jnp.int32)
+            # one exact correction step: qr*vec and avail are < 2**24, so the
+            # comparisons below are exact even though the division was f32
+            over = qr * vec_r > avail
+            under = (qr + 1) * vec_r <= avail
+            qr = jnp.where(over, qr - 1, jnp.where(under, qr + 1, qr))
+            qr = jnp.where(avail < 0, -1, qr)
+            out_ref[:] = jnp.minimum(out_ref[:], qr)
+
+
+def _quotient_init_kernel(out_ref):
+    out_ref[:] = jnp.full(out_ref.shape, INT_BIG, jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quotient_nt(alloc_t: jax.Array, used: jax.Array, vec: jax.Array,
+                interpret: bool = False) -> jax.Array:
+    """q[n, t] = min_r floor((alloc_t[t, r] - used[n, r]) / vec[r]) with the
+    packer's conventions: zero-demand resources ignored (INT_BIG), negative
+    availability -> -1, result clipped to [-1, INT_BIG].
+
+    Drop-in for ops/packer._quotient(alloc_t[None] - used[:, None], vec).
+    """
+    N, R = used.shape
+    T = alloc_t.shape[0]
+    Rp = -(-R // 16) * 16
+    Tp = -(-T // _LANE) * _LANE
+    Np = -(-N // _TILE_N) * _TILE_N
+
+    alloc_rt = _pad_to(_pad_to(alloc_t.T, 0, 16, 0), 1, _LANE, 0)   # [Rp, Tp]
+    used_p = _pad_to(_pad_to(used, 0, _TILE_N, 0), 1, 16, 0)        # [Np, Rp]
+    vec_p = _pad_to(vec.reshape(1, R), 1, 16, 0)                     # [1, Rp]
+
+    grid = (Np // _TILE_N,)
+    out = pl.pallas_call(
+        functools.partial(_seeded_kernel, n_res=R),
+        out_shape=jax.ShapeDtypeStruct((Np, Tp), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((Rp, Tp), lambda n: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TILE_N, Rp), lambda n: (n, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Rp), lambda n: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((_TILE_N, Tp), lambda n: (n, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(alloc_rt, used_p, vec_p)
+    return jnp.clip(out[:N, :T], -1, INT_BIG)
+
+
+def _seeded_kernel(alloc_ref, used_ref, vec_ref, out_ref, *, n_res: int):
+    _quotient_init_kernel(out_ref)
+    _quotient_kernel(alloc_ref, used_ref, vec_ref, out_ref, n_res=n_res)
+
+
+def quotient_nt_auto(alloc_t: jax.Array, used: jax.Array, vec: jax.Array) -> jax.Array:
+    """Backend-appropriate invocation: compiled on TPU, interpreter elsewhere
+    (parity tests on the CPU platform)."""
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    return quotient_nt(alloc_t, used, vec, interpret=interpret)

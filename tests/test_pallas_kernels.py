@@ -1,0 +1,76 @@
+"""Pallas quotient kernel vs the stock-XLA int32 reference: bit parity.
+
+Runs in interpreter mode on the CPU test platform; the compiled path is
+exercised on real TPU by bench.py --pallas.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.ops import pallas_kernels as pk
+from karpenter_tpu.ops.packer import _quotient
+
+import jax.numpy as jnp
+
+
+def reference_quotient_nt(alloc_t, used, vec):
+    return np.asarray(_quotient(
+        jnp.asarray(alloc_t)[None, :, :] - jnp.asarray(used)[:, None, :],
+        jnp.asarray(vec)))
+
+
+def rand_problem(rng, n, t, r=wk.NUM_RESOURCES):
+    alloc_t = rng.integers(0, 2**20, size=(t, r), dtype=np.int32)
+    used = rng.integers(0, 2**20, size=(n, r), dtype=np.int32)
+    vec = rng.integers(0, 64, size=(r,), dtype=np.int32)
+    vec[rng.random(r) < 0.4] = 0  # zero-demand resources are common
+    return alloc_t, used, vec
+
+
+@pytest.mark.parametrize("seed,n,t", [(0, 8, 16), (1, 64, 551), (2, 100, 37),
+                                      (3, 1, 1), (4, 65, 129)])
+def test_quotient_parity_random(seed, n, t):
+    rng = np.random.default_rng(seed)
+    alloc_t, used, vec = rand_problem(rng, n, t)
+    got = np.asarray(pk.quotient_nt_auto(jnp.asarray(alloc_t),
+                                         jnp.asarray(used), jnp.asarray(vec)))
+    want = reference_quotient_nt(alloc_t, used, vec)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_quotient_negative_availability():
+    alloc_t = np.array([[4, 8]], dtype=np.int32)       # one type, R=2
+    used = np.array([[6, 0], [0, 0], [4, 8]], dtype=np.int32)
+    vec = np.array([2, 1], dtype=np.int32)
+    got = np.asarray(pk.quotient_nt_auto(jnp.asarray(alloc_t),
+                                         jnp.asarray(used), jnp.asarray(vec)))
+    want = reference_quotient_nt(alloc_t, used, vec)
+    np.testing.assert_array_equal(got, want)
+    assert got[0, 0] == -1   # over-committed -> -1
+    assert got[2, 0] == 0    # exactly full -> 0
+
+
+def test_quotient_zero_vec_everywhere_is_big():
+    alloc_t = np.zeros((3, 4), dtype=np.int32)
+    used = np.zeros((2, 4), dtype=np.int32)
+    vec = np.zeros(4, dtype=np.int32)
+    got = np.asarray(pk.quotient_nt_auto(jnp.asarray(alloc_t),
+                                         jnp.asarray(used), jnp.asarray(vec)))
+    want = reference_quotient_nt(alloc_t, used, vec)
+    np.testing.assert_array_equal(got, want)
+    assert (got == int(pk.INT_BIG)).all()
+
+
+def test_exact_boundary_divisions():
+    # quotients at exact multiples and one-below, large magnitudes (< 2**24)
+    vals = np.array([2**24 - 1, 2**24 - 2, 3 * 5461 * 1023], dtype=np.int32)
+    alloc_t = np.stack([vals, vals], axis=0)           # [2, 3]
+    used = np.zeros((4, 3), dtype=np.int32)
+    used[1] = 1
+    used[2] = [v % 7 for v in vals]
+    vec = np.array([7, 5461, 1023], dtype=np.int32)
+    got = np.asarray(pk.quotient_nt_auto(jnp.asarray(alloc_t),
+                                         jnp.asarray(used), jnp.asarray(vec)))
+    want = reference_quotient_nt(alloc_t, used, vec)
+    np.testing.assert_array_equal(got, want)
