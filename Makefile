@@ -1,0 +1,46 @@
+# Developer entry points (reference analogue: Makefile:47-105 presubmit /
+# test / battletest / benchmark / e2etests targets).
+
+PY ?= python
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: presubmit test battletest deflake benchmark bench e2e docs native run solver-serve verify-entry
+
+presubmit: test verify-entry  ## what CI runs
+
+test:  ## hermetic suite (8-device virtual CPU mesh)
+	$(PY) -m pytest tests/ -q
+
+battletest:  ## randomized/race tier, shuffled ordering, 3x
+	for i in 1 2 3; do \
+		$(PY) -m pytest tests/test_battletest.py tests/test_packer_parity.py -q -p no:randomly || exit 1; \
+	done
+
+deflake:  ## loop the race tier until it fails
+	while $(PY) -m pytest tests/test_battletest.py -q; do :; done
+
+benchmark:  ## interruption throughput + BASELINE config scenarios (CPU)
+	env $(CPU_ENV) $(PY) -m benchmarks.interruption_bench
+	env $(CPU_ENV) $(PY) -m benchmarks.baseline_configs
+
+bench:  ## the headline one-line benchmark (real TPU when present)
+	$(PY) bench.py
+
+e2e:  ## E2E-analogue scenario suites only
+	$(PY) -m pytest tests/test_e2e_scenarios.py tests/test_controllers.py -q
+
+docs:  ## regenerate generated docs (metrics/settings/instance-types)
+	env $(CPU_ENV) $(PY) hack/gen_docs.py all
+
+native:  ## build the C++ fallback packer
+	bash hack/build_native.sh
+
+run:  ## run the controller plane against the simulated cloud
+	$(PY) -m karpenter_tpu controller --simulate
+
+solver-serve:  ## host the TPU solver gRPC service
+	$(PY) -m karpenter_tpu solver-serve
+
+verify-entry:  ## driver contract: graft entry compiles, multichip dryrun passes
+	env $(CPU_ENV) $(PY) -c "import __graft_entry__ as g; fn, args = g.entry(); \
+import jax; jax.jit(fn).lower(*args).compile(); g.dryrun_multichip(8)"
