@@ -165,10 +165,12 @@ class CloudProvider:
         images = self.images.get(template, archs=("amd64", "arm64"))
         return machine.status.image_id not in {i.image_id for i in images}
 
-    def hydrate(self, instance: CloudInstance) -> Machine:
+    def hydrate(self, instance: CloudInstance, kubelet=None) -> Machine:
         """Machine backfill from a pre-existing instance
-        (cloudprovider.go:221-251 Hydrate)."""
-        m = self._bare_instance_machine(instance)
+        (cloudprovider.go:221-251 Hydrate). `kubelet` is the owning
+        provisioner's config so the rebuilt Machine reports the same
+        kubelet-adjusted allocatable it launched with."""
+        m = self._bare_instance_machine(instance, kubelet=kubelet)
         if "karpenter.sh/managed-by" not in instance.tags:
             self.cloud.create_tags(instance.id, {
                 "karpenter.sh/managed-by": self.settings.cluster_name})
@@ -198,6 +200,19 @@ class CloudProvider:
         price = self.pricing.spot_price(instance.instance_type, instance.zone) \
             if instance.capacity_type == wk.CAPACITY_TYPE_SPOT \
             else self.pricing.on_demand_price(instance.instance_type, instance.zone)
+        alloc = itype.allocatable_vector() if itype else []
+        if itype is not None and machine.spec.kubelet is not None:
+            # kubelet config shapes the node's reported allocatable exactly
+            # as it shaped the scheduling decision (oracle kubelet_* helpers)
+            from .oracle.scheduler import (kubelet_overhead_vector,
+                                           kubelet_pods_cap)
+
+            kovh = kubelet_overhead_vector(machine.spec.kubelet)
+            alloc = [max(0, a - k) for a, k in zip(alloc, kovh)]
+            cap = kubelet_pods_cap(machine.spec.kubelet, itype)
+            if cap is not None:
+                pi = wk.RESOURCE_INDEX[wk.RESOURCE_PODS]
+                alloc[pi] = min(alloc[pi], cap)
         machine.status = MachineStatus(
             provider_id=make_provider_id(instance.zone, instance.id),
             instance_type=instance.instance_type,
@@ -205,20 +220,20 @@ class CloudProvider:
             capacity_type=instance.capacity_type,
             image_id=instance.image_id,
             capacity=dict(itype.capacity) if itype else {},
-            allocatable=wk.raw_resources_from_vector(
-                itype.allocatable_vector()) if itype else {},
+            allocatable=wk.raw_resources_from_vector(alloc) if itype else {},
             state=LAUNCHED,
             price=price or 0.0,
         )
         return machine
 
-    def _bare_instance_machine(self, instance: CloudInstance) -> Machine:
+    def _bare_instance_machine(self, instance: CloudInstance, kubelet=None) -> Machine:
         from .models.machine import MachineSpec
 
         m = Machine(
             name=instance.tags.get("karpenter.sh/machine", instance.id),
             spec=MachineSpec(
                 provisioner_name=instance.tags.get("karpenter.sh/provisioner-name", ""),
+                kubelet=kubelet,
             ),
         )
         types = {t.name: t for t in self.instance_types.list().types}

@@ -74,10 +74,12 @@ class MachineHydrationController:
             return False
         if not node.provider_id:
             return False
+        prov = self.kube.get("provisioners", provisioner_name)
         try:
             _, instance_id = parse_provider_id(node.provider_id)
             instance = self.cloudprovider.instances.get_by_id(instance_id)
-            machine = self.cloudprovider.hydrate(instance)
+            machine = self.cloudprovider.hydrate(
+                instance, kubelet=prov.kubelet if prov is not None else None)
         except (CloudError, ValueError) as e:
             log.warning("hydrate %s failed: %s", node.name, e)
             return False

@@ -210,7 +210,7 @@ class ProvisioningController:
                 startup_taints=prov.startup_taints,
                 machine_template_ref=prov.provider_ref or "default",
                 provisioner_name=prov.name,
-                kubelet_max_pods=prov.kubelet.max_pods,
+                kubelet=prov.kubelet,
             ),
             labels={wk.LABEL_PROVISIONER: prov.name, **dict(prov.labels)},
         )

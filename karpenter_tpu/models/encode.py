@@ -33,6 +33,7 @@ from ..models.requirements import IncompatibleError, Requirements
 from ..models.pod import tolerates_all
 from ..oracle.scheduler import (
     ExistingNode, Option, feasible_options, prepare_groups, _group_cap_per_node,
+    kubelet_is_default, kubelet_overhead_vector, kubelet_pods_cap,
 )
 
 INT_BIG = np.int32(2**30)
@@ -205,6 +206,29 @@ def build_grid(catalog: Catalog) -> OptionGrid:
                       alloc_t, catalog.seqnum)
 
 
+def kubelet_arrays(
+    provs: "list[Provisioner]", catalog: Catalog
+) -> "tuple[Optional[np.ndarray], Optional[np.ndarray]]":
+    """(prov_overhead [Pv, R], prov_pods_cap [Pv, T]) — None, None when every
+    provisioner runs kubelet defaults (keeps the compiled kernel unchanged
+    for the common case; the reference hashes kubelet config into its
+    instance-type cache key the same way, instancetypes.go:104-111)."""
+    if all(kubelet_is_default(p.kubelet) for p in provs):
+        return None, None
+    Pv, T, R = len(provs), len(catalog.types), wk.NUM_RESOURCES
+    ovh = np.zeros((max(Pv, 1), R), dtype=np.int32)
+    cap = np.full((max(Pv, 1), max(T, 1)), INT_BIG, dtype=np.int32)
+    cores = [max(1, dict(t.capacity).get(wk.RESOURCE_CPU, 1000) // 1000)
+             for t in catalog.types]
+    for pi, p in enumerate(provs):
+        ovh[pi] = np.minimum(kubelet_overhead_vector(p.kubelet), INT_BIG)
+        for ti, t in enumerate(catalog.types):
+            c = kubelet_pods_cap(p.kubelet, t, cores=cores[ti])
+            if c is not None:
+                cap[pi, ti] = min(c, int(INT_BIG))
+    return ovh, cap
+
+
 @dataclasses.dataclass
 class EncodedProblem:
     """Everything the packer kernel consumes, as numpy (device-put by caller)."""
@@ -229,6 +253,9 @@ class EncodedProblem:
     groups: "list[PodGroup]"
     provisioners: "list[Provisioner]"
     grid: OptionGrid
+    # per-provisioner kubelet effects (None when all defaults)
+    prov_overhead: "Optional[np.ndarray]" = None  # i32 [Pv, R]
+    prov_pods_cap: "Optional[np.ndarray]" = None  # i32 [Pv, T]
 
 
 def encode_problem(
@@ -261,9 +288,13 @@ def encode_problem(
         ex_alloc[ei] = np.minimum(e.allocatable, INT_BIG)
         ex_used[ei] = np.minimum(e.used, INT_BIG)
 
+    prov_overhead, prov_pods_cap = kubelet_arrays(provs, catalog)
+
     cols = grid.get_cols()
     for gi, g in enumerate(groups):
-        vec, cap, feas, newprov = encode_group(g, provs, grid, cols, overhead)
+        vec, cap, feas, newprov = encode_group(
+            g, provs, grid, cols, overhead,
+            prov_overhead=prov_overhead, prov_pods_cap=prov_pods_cap)
         group_vec[gi] = vec
         group_count[gi] = g.count
         group_cap[gi] = cap
@@ -279,15 +310,22 @@ def encode_problem(
         bound = 0
         alloc64 = grid.alloc_t.astype(np.int64)
         ovh = np.asarray(overhead, dtype=np.int64)
+        pods_i = wk.RESOURCE_INDEX[wk.RESOURCE_PODS]
         for gi, g in enumerate(groups):
             pi = int(group_newprov[gi])
             if pi < 0:
                 continue
             vec = group_vec[gi].astype(np.int64)
+            ovh_p = ovh if prov_overhead is None \
+                else ovh + prov_overhead[pi].astype(np.int64)
             q0 = np.where(vec[None, :] > 0,
-                          (alloc64 - ovh[None, :]) // np.maximum(vec[None, :], 1),
+                          (alloc64 - ovh_p[None, :]) // np.maximum(vec[None, :], 1),
                           INT_BIG)
-            q0 = np.where(alloc64 - ovh[None, :] < 0, -1, q0).min(axis=1)
+            q0 = np.where(alloc64 - ovh_p[None, :] < 0, -1, q0).min(axis=1)
+            if prov_pods_cap is not None and vec[pods_i] > 0:
+                q0 = np.minimum(q0, np.maximum(
+                    (prov_pods_cap[pi].astype(np.int64) - ovh_p[pods_i])
+                    // vec[pods_i], -1))
             feas_t = group_feas[gi, pi].any(axis=1)
             kstar = int(min(max(q0[feas_t].max(initial=0), 0), group_cap[gi]))
             if kstar > 0:
@@ -302,6 +340,7 @@ def encode_problem(
         ex_alloc=ex_alloc, ex_used=ex_used, ex_feas=ex_feas,
         n_slots=n_slots,
         groups=groups, provisioners=list(provs), grid=grid,
+        prov_overhead=prov_overhead, prov_pods_cap=prov_pods_cap,
     )
 
 
@@ -312,6 +351,8 @@ def encode_group(
     cols: GridCols,
     overhead: Sequence[int],
     extra_mask: Optional[np.ndarray] = None,
+    prov_overhead: Optional[np.ndarray] = None,
+    prov_pods_cap: Optional[np.ndarray] = None,
 ) -> "tuple[np.ndarray, int, np.ndarray, int]":
     """One pod group -> (vec [R], cap, feas [Pv,T,S], newprov).
 
@@ -326,9 +367,10 @@ def encode_group(
     feas = np.zeros((len(provs), T, S), dtype=bool)
     newprov = -1
     ovh = np.asarray(overhead, dtype=np.int64)
-    fits_t = np.all(
-        grid.alloc_t.astype(np.int64) - ovh[None, :] - vec[None, :].astype(np.int64) >= 0,
-        axis=1)
+    alloc64 = grid.alloc_t.astype(np.int64)
+    vec64 = vec.astype(np.int64)
+    fits_default = np.all(alloc64 - ovh[None, :] - vec64[None, :] >= 0, axis=1)
+    pods_i = wk.RESOURCE_INDEX[wk.RESOURCE_PODS]
     for pi, prov in enumerate(provs):
         if not tolerates_all(group.spec.tolerations, prov.taints):
             continue
@@ -336,6 +378,16 @@ def encode_group(
             reqs = prov.scheduling_requirements().union(group.spec.requirements)
         except IncompatibleError:
             continue
+        if prov_overhead is None:
+            fits_t = fits_default
+        else:
+            # kubelet-adjusted fresh-node fit: extra reserved overhead plus
+            # the pods cap must still admit one pod (oracle feasible_options)
+            ovh_p = ovh + prov_overhead[pi].astype(np.int64)
+            fits_t = np.all(alloc64 - ovh_p[None, :] - vec64[None, :] >= 0, axis=1)
+            if prov_pods_cap is not None:
+                fits_t &= (prov_pods_cap[pi].astype(np.int64)
+                           - ovh_p[pods_i] - vec64[pods_i] >= 0)
         mask = fold_option_mask(reqs, cols, prov).reshape(T, S) & fits_t[:, None]
         if extra_mask is not None:
             mask = mask & extra_mask

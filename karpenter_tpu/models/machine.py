@@ -32,7 +32,10 @@ class MachineSpec:
     startup_taints: "tuple[Taint, ...]" = ()
     machine_template_ref: str = ""  # NodeTemplate name
     provisioner_name: str = ""
-    kubelet_max_pods: Optional[int] = None
+    # full kubelet config (Machine.Spec.Kubelet): shapes the node's reported
+    # allocatable at launch (cloudprovider._instance_to_machine) and the
+    # bootstrap kubelet flags (providers/images.py BootstrapConfig)
+    kubelet: "Optional[object]" = None  # apis.provisioner.KubeletConfiguration
 
 
 @dataclasses.dataclass

@@ -63,6 +63,7 @@ def _load():
         lib.kt_pack.restype = ctypes.c_int
         lib.kt_pack.argtypes = (
             [i32p, i32p, i32p, i32p, i32p, u8p, i32p, i32p, i32p, i32p, u8p]
+            + [i32p, i32p, ctypes.c_int]   # prov_overhead, prov_pods_cap, pods_i
             + [ctypes.c_int] * 7
             + [i32p, i32p, i32p, u8p, i32p, i32p, i32p]
         )
@@ -101,6 +102,10 @@ def native_pack(inputs, n_slots: int):
     ex_alloc = _i32(inputs.ex_alloc)
     ex_used = _i32(inputs.ex_used)
     ex_feas = _u8(inputs.ex_feas)
+    prov_overhead = getattr(inputs, "prov_overhead", None)
+    prov_pods_cap = getattr(inputs, "prov_pods_cap", None)
+    prov_overhead = None if prov_overhead is None else _i32(prov_overhead)
+    prov_pods_cap = None if prov_pods_cap is None else _i32(prov_pods_cap)
 
     G, Pv, T, S = group_feas.shape
     R = group_vec.shape[1]
@@ -115,10 +120,16 @@ def native_pack(inputs, n_slots: int):
     decided = np.zeros((N,), np.int32)
     n_open = np.zeros((1,), np.int32)
 
+    from ..apis import wellknown as wk
+
+    null_i32 = ctypes.POINTER(ctypes.c_int32)()
     rc = lib.kt_pack(
         _ptr(alloc_t), _ptr(tiebreak), _ptr(group_vec), _ptr(group_count),
         _ptr(group_cap), _ptr(group_feas), _ptr(group_newprov), _ptr(overhead),
         _ptr(ex_alloc), _ptr(ex_used), _ptr(ex_feas),
+        null_i32 if prov_overhead is None else _ptr(prov_overhead),
+        null_i32 if prov_pods_cap is None else _ptr(prov_pods_cap),
+        wk.RESOURCE_INDEX[wk.RESOURCE_PODS],
         G, Pv, T, S, R, Ne, N,
         _ptr(assign), _ptr(ex_assign), _ptr(unsched), _ptr(active),
         _ptr(nprov), _ptr(decided), _ptr(n_open),

@@ -48,6 +48,15 @@ int32_t quotient(const int32_t* avail, const int32_t* vec, int R) {
   return clip(q, -1, INT_BIG);
 }
 
+// Extra pods the kubelet pods cap admits (mirrors _pods_cap_quotient in
+// ops/packer.py): floor(cap_avail/vec_pods), zero-demand => INT_BIG,
+// negative => -1.
+int32_t pods_cap_quotient(int64_t cap_avail, int32_t vec_pods) {
+  if (vec_pods <= 0) return INT_BIG;
+  if (cap_avail < 0) return -1;
+  return clip(cap_avail / vec_pods, -1, INT_BIG);
+}
+
 }  // namespace
 
 extern "C" {
@@ -65,6 +74,9 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
             const int32_t* ex_alloc,     // [Ne,R]
             const int32_t* ex_used_in,   // [Ne,R]
             const uint8_t* ex_feas,      // [G,Ne]
+            const int32_t* prov_overhead,// [Pv,R] or nullptr (kubelet reserved)
+            const int32_t* prov_pods_cap,// [Pv,T] or nullptr (kubelet pods cap)
+            int pods_i,                  // index of the pods resource on R
             int G, int Pv, int T, int S, int R, int Ne, int N,
             int32_t* assign,             // out [G,N]
             int32_t* ex_assign,          // out [G,Ne]
@@ -131,6 +143,13 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
           avail[r] = alloc_t[static_cast<size_t>(t) * R + r] -
                      used[static_cast<size_t>(n) * R + r];
         qt[t] = quotient(avail.data(), vec, R);
+        if (prov_pods_cap != nullptr) {
+          int32_t capq = pods_cap_quotient(
+              static_cast<int64_t>(prov_pods_cap[static_cast<size_t>(pidx) * T + t]) -
+                  used[static_cast<size_t>(n) * R + pods_i],
+              vec[pods_i]);
+          if (capq < qt[t]) qt[t] = capq;
+        }
         if (qt[t] > qmax) qmax = qt[t];
       }
       q_nt[n] = qmax;
@@ -162,6 +181,10 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
     // ---- 3) bulk-open fresh nodes ------------------------------------------
     int32_t p = group_newprov[g];
     int64_t kstar = 0;
+    std::vector<int32_t> ovh_p(overhead, overhead + R);
+    if (p >= 0 && prov_overhead != nullptr)
+      for (int r = 0; r < R; ++r)
+        ovh_p[r] += prov_overhead[static_cast<size_t>(p) * R + r];
     if (p >= 0) {
       const uint8_t* feas =
           group_feas + ((static_cast<size_t>(g) * Pv + p) * TS);
@@ -171,8 +194,15 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
           if (feas[t * S + s]) { any = true; break; }
         std::vector<int32_t> avail(R);
         for (int r = 0; r < R; ++r)
-          avail[r] = alloc_t[static_cast<size_t>(t) * R + r] - overhead[r];
+          avail[r] = alloc_t[static_cast<size_t>(t) * R + r] - ovh_p[r];
         qt[t] = quotient(avail.data(), vec, R);  // q0 (also reused below)
+        if (prov_pods_cap != nullptr) {
+          int32_t capq = pods_cap_quotient(
+              static_cast<int64_t>(prov_pods_cap[static_cast<size_t>(p) * T + t]) -
+                  ovh_p[pods_i],
+              vec[pods_i]);
+          if (capq < qt[t]) qt[t] = capq;
+        }
         if (any && qt[t] > kstar) kstar = qt[t];
       }
     } else {
@@ -191,7 +221,7 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
       int64_t cnt = (i == n_new - 1) ? last_cnt : kstar;
       for (int r = 0; r < R; ++r)
         used[static_cast<size_t>(n) * R + r] =
-            overhead[r] + static_cast<int32_t>(cnt) * vec[r];
+            ovh_p[r] + static_cast<int32_t>(cnt) * vec[r];
       const uint8_t* feas =
           group_feas + ((static_cast<size_t>(g) * Pv + p) * TS);
       uint8_t* om = optmask.data() + static_cast<size_t>(n) * TS;

@@ -119,6 +119,9 @@ def encode_consolidation(
             fit_cache[key] = hit
         return hit
 
+    from ..models.encode import kubelet_arrays
+
+    prov_overhead, prov_pods_cap = kubelet_arrays(provs, catalog)
     feas_cache: "dict[tuple, tuple]" = {}
     for ci, (cand, cheaper_opt, groups) in enumerate(per_cand):
         n_groups.append(len(groups))
@@ -126,7 +129,10 @@ def encode_consolidation(
             gkey = (g.spec.group_key(), cheaper_opt.tobytes())
             enc = feas_cache.get(gkey)
             if enc is None:
-                enc = encode_group(g, provs, grid, cols, overhead, extra_mask=cheaper_opt)
+                enc = encode_group(g, provs, grid, cols, overhead,
+                                   extra_mask=cheaper_opt,
+                                   prov_overhead=prov_overhead,
+                                   prov_pods_cap=prov_pods_cap)
                 feas_cache[gkey] = enc
             vec, cap, feas, newprov = enc
             group_vec[ci, gi] = vec
@@ -148,6 +154,7 @@ def encode_consolidation(
         overhead=np.asarray(overhead, dtype=np.int32),
         ex_alloc=ex_alloc, ex_used=np.broadcast_to(ex_used, (C, Ne, R)).copy(),
         ex_feas=ex_feas,
+        prov_overhead=prov_overhead, prov_pods_cap=prov_pods_cap,
     )
     return ConsolidationBatch(inputs, candidates, provs, grid, n_groups)
 
@@ -158,6 +165,7 @@ def _batched_pack(inputs: PackInputs, n_slots: int):
         alloc_t=None, tiebreak=None,
         group_vec=0, group_count=0, group_cap=0, group_feas=0, group_newprov=0,
         overhead=None, ex_alloc=None, ex_used=0, ex_feas=0,
+        prov_overhead=None, prov_pods_cap=None,  # shared across candidates
     )
     return jax.vmap(lambda inp: pack_impl(inp, n_slots), in_axes=(axes,))(inputs)
 

@@ -41,7 +41,10 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..apis import wellknown as wk
 from . import pallas_kernels
+
+_PODS_I = wk.RESOURCE_INDEX[wk.RESOURCE_PODS]
 
 INT_BIG = jnp.int32(2**30)
 
@@ -61,6 +64,11 @@ class PackInputs(NamedTuple):
     ex_alloc: jax.Array   # i32 [Ne, R]
     ex_used: jax.Array    # i32 [Ne, R]
     ex_feas: jax.Array    # bool [G, Ne]
+    # per-provisioner kubelet configuration effects (None when every
+    # provisioner uses defaults — the common case keeps the compiled
+    # program unchanged). See oracle/scheduler.py kubelet_* helpers.
+    prov_overhead: "jax.Array | None" = None  # i32 [Pv, R] extra node overhead
+    prov_pods_cap: "jax.Array | None" = None  # i32 [Pv, T] max pods per node
 
 
 class PackState(NamedTuple):
@@ -106,6 +114,14 @@ def _waterfall(count: jax.Array, fill: jax.Array) -> jax.Array:
     return jnp.clip(count - before, 0, fill)
 
 
+def _pods_cap_quotient(cap_avail: jax.Array, vec_pods: jax.Array) -> jax.Array:
+    """How many more pods the kubelet pods cap admits: floor(cap_avail/vec)
+    with the same zero-demand/negative conventions as _quotient."""
+    q = jnp.where(vec_pods > 0, cap_avail // jnp.maximum(vec_pods, 1), INT_BIG)
+    q = jnp.where(cap_avail < 0, jnp.where(vec_pods > 0, -1, INT_BIG), q)
+    return jnp.clip(q, -1, INT_BIG)
+
+
 def _step(inputs: PackInputs, state: PackState, g: jax.Array):
     vec = inputs.group_vec[g]          # [R]
     cap = inputs.group_cap[g]          # []
@@ -128,6 +144,12 @@ def _step(inputs: PackInputs, state: PackState, g: jax.Array):
         q_nt = pallas_kernels.quotient_nt_auto(inputs.alloc_t, state.used, vec)
     else:
         q_nt = _quotient(inputs.alloc_t[None, :, :] - state.used[:, None, :], vec)  # [N, T]
+    if inputs.prov_pods_cap is not None:
+        # kubelet pods cap of the node's provisioner bounds the quotient
+        cap_nt = inputs.prov_pods_cap[jnp.clip(state.nprov, 0, None)]   # [N, T]
+        q_extra = _pods_cap_quotient(
+            cap_nt - state.used[:, _PODS_I][:, None], vec[_PODS_I])
+        q_nt = jnp.minimum(q_nt, q_extra)
     q_cap = jnp.where(nodefeas, q_nt[:, :, None], -1)              # [N, T, S]
     qmax = jnp.max(q_cap.reshape(q_cap.shape[0], -1), axis=-1)     # [N]
     fill_n = jnp.clip(jnp.minimum(qmax, cap), 0, INT_BIG)
@@ -142,7 +164,14 @@ def _step(inputs: PackInputs, state: PackState, g: jax.Array):
     # ---- 3) bulk-open fresh nodes -------------------------------------------
     p = inputs.group_newprov[g]
     freshfeas = inputs.group_feas[g][jnp.clip(p, 0, None)] & (p >= 0)  # [T, S]
-    q0 = _quotient(inputs.alloc_t - inputs.overhead[None, :], vec)     # [T]
+    ovh = inputs.overhead
+    if inputs.prov_overhead is not None:
+        ovh = ovh + inputs.prov_overhead[jnp.clip(p, 0, None)]
+    q0 = _quotient(inputs.alloc_t - ovh[None, :], vec)                 # [T]
+    if inputs.prov_pods_cap is not None:
+        cap_t = inputs.prov_pods_cap[jnp.clip(p, 0, None)]             # [T]
+        q0 = jnp.minimum(q0, _pods_cap_quotient(
+            cap_t - ovh[_PODS_I], vec[_PODS_I]))
     kstar = jnp.max(jnp.where(freshfeas, q0[:, None], 0))
     kstar = jnp.clip(jnp.minimum(kstar, cap), 0, INT_BIG)
     n_new = jnp.where(kstar > 0, (rem + kstar - 1) // jnp.maximum(kstar, 1), 0)
@@ -155,7 +184,7 @@ def _step(inputs: PackInputs, state: PackState, g: jax.Array):
     in_range = (idx >= state.n_open) & (idx < state.n_open + n_new)
     cnt = jnp.where(idx == state.n_open + n_new - 1, last_cnt, kstar)
     cnt = jnp.where(in_range, cnt, 0)                              # [N]
-    fresh_used = inputs.overhead[None, :] + cnt[:, None] * vec[None, :]
+    fresh_used = ovh[None, :] + cnt[:, None] * vec[None, :]
     used = jnp.where(in_range[:, None], fresh_used, used)
     fresh_mask = freshfeas[None, :, :] & (q0[None, :, None] >= cnt[:, None, None])
     optmask = jnp.where(in_range[:, None, None], fresh_mask, optmask)

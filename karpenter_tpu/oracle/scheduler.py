@@ -73,6 +73,63 @@ def build_options(catalog: Catalog) -> "list[Option]":
     return opts
 
 
+DEFAULT_EVICTION_HARD = 100 * 2**20  # KubeletConfiguration default
+
+
+def kubelet_is_default(k) -> bool:
+    return (k.max_pods is None and k.pods_per_core is None
+            and k.system_reserved_cpu_millis == 0
+            and k.system_reserved_memory_bytes == 0
+            and k.kube_reserved_cpu_millis is None
+            and k.kube_reserved_memory_bytes is None
+            and k.eviction_hard_memory_bytes == DEFAULT_EVICTION_HARD)
+
+
+def kubelet_overhead_vector(k) -> "list[int]":
+    """Per-node overhead a provisioner's kubelet config adds ON TOP of the
+    instance type's built-in overhead (which already carries the default
+    kubeReserved curve + default eviction threshold — providers/
+    instancetypes.py node_overhead). kubeReserved/systemReserved here are
+    additional reservations; evictionHard adds only its delta over the
+    default. (Reference analogue: instancetype.go:229-319 capacity math,
+    re-derived for a catalog whose defaults are pre-baked.)"""
+    extra_cpu = k.system_reserved_cpu_millis + (k.kube_reserved_cpu_millis or 0)
+    extra_mem = (k.system_reserved_memory_bytes
+                 + (k.kube_reserved_memory_bytes or 0)
+                 + max(0, k.eviction_hard_memory_bytes - DEFAULT_EVICTION_HARD))
+    vec = [0] * wk.NUM_RESOURCES
+    vec[wk.RESOURCE_INDEX[wk.RESOURCE_CPU]] = extra_cpu
+    vec[wk.RESOURCE_INDEX[wk.RESOURCE_MEMORY]] = -(-extra_mem // 2**20)  # ceil MiB
+    return vec
+
+
+def kubelet_pods_cap(k, itype: InstanceType, cores: Optional[int] = None) -> Optional[int]:
+    """Max pods per node of this type under the kubelet config (maxPods /
+    podsPerCore, whichever is tighter; instancetype.go:321+ `pods`).
+    `cores` avoids re-deriving the type's core count in Pv*T loops
+    (models/encode.py kubelet_arrays)."""
+    cap: Optional[int] = None
+    if k.max_pods is not None:
+        cap = k.max_pods
+    if k.pods_per_core is not None:
+        if cores is None:
+            cores = max(1, dict(itype.capacity).get(wk.RESOURCE_CPU, 1000) // 1000)
+        per_core = k.pods_per_core * cores
+        cap = per_core if cap is None else min(cap, per_core)
+    return cap
+
+
+def effective_alloc(opt: Option, prov: Provisioner) -> "tuple[int, ...]":
+    """Option allocatable under the provisioner's kubelet pods cap."""
+    cap = kubelet_pods_cap(prov.kubelet, opt.itype)
+    if cap is None:
+        return opt.alloc
+    alloc = list(opt.alloc)
+    pi = wk.RESOURCE_INDEX[wk.RESOURCE_PODS]
+    alloc[pi] = min(alloc[pi], cap)
+    return tuple(alloc)
+
+
 def option_labels(opt: Option, prov: Provisioner) -> "dict[str, str]":
     labels = opt.itype.labels_dict()
     labels[wk.LABEL_ZONE] = opt.zone
@@ -100,11 +157,14 @@ def feasible_options(
     except IncompatibleError:
         return set()
     vec = group.resource_vector()
+    kovh = kubelet_overhead_vector(prov.kubelet)
     out: "set[int]" = set()
     for opt in options:
         if not reqs.matches_labels(option_labels(opt, prov)):
             continue
-        if all(d + v <= a for d, v, a in zip(daemon_overhead, vec, opt.alloc)):
+        alloc = effective_alloc(opt, prov)
+        if all(d + k + v <= a
+               for d, k, v, a in zip(daemon_overhead, kovh, vec, alloc)):
             out.add(opt.index)
     return out
 
@@ -260,6 +320,14 @@ class Scheduler:
         # weight desc, then name asc (core: higher weight preferred)
         self.provisioners = sorted(provisioners, key=lambda p: (-p.weight, p.name))
         self.daemon_overhead = list(daemon_overhead or [0] * wk.NUM_RESOURCES)
+        self._eff_cache: "dict[tuple[str, int], tuple[int, ...]]" = {}
+
+    def _eff_alloc(self, prov: Provisioner, opt_index: int) -> "tuple[int, ...]":
+        key = (prov.name, opt_index)
+        a = self._eff_cache.get(key)
+        if a is None:
+            a = self._eff_cache[key] = effective_alloc(self.options[opt_index], prov)
+        return a
 
     def schedule(
         self,
@@ -308,7 +376,8 @@ class Scheduler:
                     new_used = [u + v for u, v in zip(n.used, vec)]
                     fitting = {
                         i for i in shared
-                        if all(u <= a for u, a in zip(new_used, self.options[i].alloc))
+                        if all(u <= a for u, a in zip(
+                            new_used, self._eff_alloc(n.provisioner, i)))
                     }
                     if not fitting:
                         continue
@@ -328,10 +397,12 @@ class Scheduler:
                             g.spec, prov, self.options, self.daemon_overhead
                         )
                     if feas_cache[pk2]:
+                        kovh = kubelet_overhead_vector(prov.kubelet)
                         nodes.append(NodeClaim(
                             provisioner=prov,
                             options=set(feas_cache[pk2]),
-                            used=[d + v for d, v in zip(self.daemon_overhead, vec)],
+                            used=[d + k + v for d, k, v in zip(
+                                self.daemon_overhead, kovh, vec)],
                             pods=[g.spec],
                             group_counts={gkey: 1},
                         ))

@@ -51,11 +51,14 @@ def pad_types(inputs: PackInputs, multiple: int) -> PackInputs:
         w[axis] = (0, pad_n)
         return np.pad(a, w, constant_values=value)
 
-    return inputs._replace(
+    out = inputs._replace(
         alloc_t=pad(inputs.alloc_t, 0, 0),
         tiebreak=pad(inputs.tiebreak, 0, int(INT_BIG)),
         group_feas=pad(inputs.group_feas, 2, False),
     )
+    if inputs.prov_pods_cap is not None:
+        out = out._replace(prov_pods_cap=pad(inputs.prov_pods_cap, 1, 0))
+    return out
 
 
 def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
@@ -79,6 +82,7 @@ def input_shardings(mesh: Mesh) -> PackInputs:
         group_feas=s(None, None, AXIS_TYPES, None),
         group_newprov=s(), overhead=s(),
         ex_alloc=s(), ex_used=s(), ex_feas=s(),
+        prov_overhead=s(), prov_pods_cap=s(None, AXIS_TYPES),
     )
 
 
@@ -96,6 +100,8 @@ def sharded_pack(inputs: PackInputs, n_slots: int, mesh: Mesh) -> PackResult:
     (tests/test_sharded.py)."""
     inputs = pad_types(inputs, mesh.shape[AXIS_TYPES])
     shardings = input_shardings(mesh)
+    if inputs.prov_overhead is None:
+        shardings = shardings._replace(prov_overhead=None, prov_pods_cap=None)
     inputs = jax.tree.map(
         lambda a, sh: jax.device_put(jax.numpy.asarray(a), sh), inputs, shardings
     )

@@ -40,8 +40,39 @@ class BootstrapConfig:
     dns_ip: str = ""
     labels: "dict[str, str]" = dataclasses.field(default_factory=dict)
     taints: "tuple[Taint, ...]" = ()
-    max_pods: Optional[int] = None
+    # full kubelet config: the node's real kubelet must enforce exactly what
+    # the scheduler modeled (max-pods/pods-per-core/reserved/eviction)
+    kubelet: "Optional[object]" = None  # apis.provisioner.KubeletConfiguration
     custom_userdata: str = ""
+
+    def kubelet_flags(self) -> "list[str]":
+        """kubelet CLI flags for the shell-bootstrap family; TOML families
+        render the same fields their own way."""
+        k = self.kubelet
+        if k is None:
+            return []
+        flags = []
+        if k.max_pods is not None:
+            flags.append(f"--max-pods={k.max_pods}")
+        if k.pods_per_core is not None:
+            flags.append(f"--pods-per-core={k.pods_per_core}")
+        reserved = []
+        if k.system_reserved_cpu_millis:
+            reserved.append(f"cpu={k.system_reserved_cpu_millis}m")
+        if k.system_reserved_memory_bytes:
+            reserved.append(f"memory={k.system_reserved_memory_bytes}")
+        if reserved:
+            flags.append(f"--system-reserved={','.join(reserved)}")
+        kube_res = []
+        if k.kube_reserved_cpu_millis is not None:
+            kube_res.append(f"cpu={k.kube_reserved_cpu_millis}m")
+        if k.kube_reserved_memory_bytes is not None:
+            kube_res.append(f"memory={k.kube_reserved_memory_bytes}")
+        if kube_res:
+            flags.append(f"--kube-reserved={','.join(kube_res)}")
+        if k.eviction_hard_memory_bytes:
+            flags.append(f"--eviction-hard=memory.available<{k.eviction_hard_memory_bytes}")
+        return flags
 
 
 class ImageFamily:
@@ -66,8 +97,7 @@ class UbuntuK8s(ImageFamily):
         if cfg.taints:
             taints = ",".join(f"{t.key}={t.value}:{t.effect}" for t in cfg.taints)
             flags.append(f"--register-with-taints={taints}")
-        if cfg.max_pods is not None:
-            flags.append(f"--max-pods={cfg.max_pods}")
+        flags.extend(cfg.kubelet_flags())
         script = "\n".join([
             "#!/bin/bash -xe",
             f"/etc/node/bootstrap.sh '{cfg.cluster_name}' \\",
@@ -112,8 +142,18 @@ class Flatboat(ImageFamily):
             lines.append(f'cluster-certificate = "{cfg.ca_bundle}"')
         if cfg.dns_ip:
             lines.append(f'cluster-dns-ip = "{cfg.dns_ip}"')
-        if cfg.max_pods is not None:
-            lines.append(f"max-pods = {cfg.max_pods}")
+        k = cfg.kubelet
+        if k is not None:
+            if k.max_pods is not None:
+                lines.append(f"max-pods = {k.max_pods}")
+            if k.pods_per_core is not None:
+                lines.append(f"pods-per-core = {k.pods_per_core}")
+            if k.system_reserved_cpu_millis or k.system_reserved_memory_bytes:
+                lines.append("[settings.kubernetes.system-reserved]")
+                if k.system_reserved_cpu_millis:
+                    lines.append(f'cpu = "{k.system_reserved_cpu_millis}m"')
+                if k.system_reserved_memory_bytes:
+                    lines.append(f'memory = "{k.system_reserved_memory_bytes}"')
         if cfg.labels:
             lines.append("[settings.kubernetes.node-labels]")
             lines += [f'"{k}" = "{v}"' for k, v in sorted(cfg.labels.items())]

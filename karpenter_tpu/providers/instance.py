@@ -92,7 +92,7 @@ class InstanceProvider:
         labels = {k: v for k, v in machine.labels.items()}
         lts = self.launch_templates.ensure_all(
             template, labels=labels, taints=machine.spec.taints,
-            archs=self._archs(types), max_pods=machine.spec.kubelet_max_pods)
+            archs=self._archs(types), kubelet=machine.spec.kubelet)
         if not lts:
             raise cloud_errors.CloudError(
                 "ResourceNotFound",

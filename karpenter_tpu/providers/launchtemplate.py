@@ -47,7 +47,7 @@ class LaunchTemplateProvider:
         labels: "dict[str, str]",
         taints: "Sequence[Taint]" = (),
         archs: Sequence[str] = ("amd64",),
-        max_pods: Optional[int] = None,
+        kubelet=None,  # apis.provisioner.KubeletConfiguration
     ) -> "dict[str, list[str]]":
         """Resolve per-arch launch templates; returns {lt_name: [archs]}.
 
@@ -63,7 +63,7 @@ class LaunchTemplateProvider:
                 cluster_endpoint=self.settings.cluster_endpoint,
                 labels=labels,
                 taints=tuple(taints),
-                max_pods=max_pods,
+                kubelet=kubelet,
                 custom_userdata=template.userdata,
             )
             userdata = family.userdata(cfg)

@@ -137,6 +137,7 @@ class NativeSolver(TPUSolver):
             group_cap=enc.group_cap, group_feas=enc.group_feas,
             group_newprov=enc.group_newprov, overhead=enc.overhead,
             ex_alloc=enc.ex_alloc, ex_used=enc.ex_used, ex_feas=enc.ex_feas,
+            prov_overhead=enc.prov_overhead, prov_pods_cap=enc.prov_pods_cap,
         )
         result = native_pack(inputs, n_slots=enc.n_slots)
         return decode(enc, result, [e.name for e in existing])
@@ -172,6 +173,7 @@ def run_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None) -> PackRe
         ex_alloc=pad(enc.ex_alloc, Neb),
         ex_used=pad(enc.ex_used, Neb),
         ex_feas=ex_feas,
+        prov_overhead=enc.prov_overhead, prov_pods_cap=enc.prov_pods_cap,
     )
     inputs = jax.device_put(inputs)  # async enqueue; no sync round trip
     # One jitted dispatch returning ONE flat buffer: decode pays exactly one

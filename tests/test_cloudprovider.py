@@ -172,10 +172,18 @@ class TestSpotFilter:
 
 class TestBootstrapFamilies:
     def test_shell_family(self):
+        from karpenter_tpu.apis.provisioner import KubeletConfiguration
+
         cfg = BootstrapConfig(cluster_name="c", cluster_endpoint="https://e",
-                              labels={"a": "1"}, max_pods=58)
+                              labels={"a": "1"},
+                              kubelet=KubeletConfiguration(
+                                  max_pods=58, pods_per_core=4,
+                                  system_reserved_cpu_millis=250))
         out = get_family("ubuntu-k8s").userdata(cfg)
         assert "--max-pods=58" in out and "--node-labels=a=1" in out
+        assert "--pods-per-core=4" in out
+        assert "--system-reserved=cpu=250m" in out
+        assert "--eviction-hard=memory.available<" in out
 
     def test_toml_family(self):
         cfg = BootstrapConfig(cluster_name="c", cluster_endpoint="https://e",

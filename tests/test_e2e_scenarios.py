@@ -266,6 +266,40 @@ class TestTagsAndLaunchTemplateOptions:
         assert len(op.cloudprovider.cloud.launch_templates) == n_before + 1
 
 
+class TestKubeletConfiguration:
+    """Provisioner kubelet config shapes both the scheduling decision and the
+    launched node's reported allocatable (integration/kubelet-config E2E
+    analogue)."""
+
+    def test_max_pods_bounds_packing_and_allocatable(self, op):
+        from karpenter_tpu.apis.provisioner import KubeletConfiguration
+
+        add_provisioner(op, kubelet=KubeletConfiguration(max_pods=2))
+        for i in range(5):
+            op.kube.create("pods", f"p{i}", make_pod(f"p{i}", cpu="100m",
+                                                     memory="128Mi"))
+        op.provisioning.reconcile_once()
+        assert not op.kube.pending_pods()
+        assert len(op.cluster.nodes) >= 3  # 5 pods at <=2/node
+        pods_i = wk.RESOURCE_INDEX[wk.RESOURCE_PODS]
+        for node in op.cluster.nodes.values():
+            assert len(node.pods) <= 2
+            assert node.allocatable[pods_i] == 2
+
+    def test_reserved_memory_reduces_allocatable(self, op):
+        from karpenter_tpu.apis.provisioner import KubeletConfiguration
+
+        add_provisioner(op, kubelet=KubeletConfiguration(
+            system_reserved_memory_bytes=4 * 2**30))
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        (node,) = op.cluster.nodes.values()
+        mem_i = wk.RESOURCE_INDEX[wk.RESOURCE_MEMORY]
+        base = dict(op.cloudprovider.catalog_for().by_name[
+            node.instance_type].capacity)[wk.RESOURCE_MEMORY] // 2**20
+        assert node.allocatable[mem_i] <= base - 4096
+
+
 class TestNodeTemplateLifecycle:
     def test_deleted_template_stops_resolving(self, op):
         add_provisioner(op)

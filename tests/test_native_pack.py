@@ -47,6 +47,7 @@ def kernel_inputs(catalog, provisioners, pods, existing=(), overhead=None):
         group_feas=enc.group_feas, group_newprov=enc.group_newprov,
         overhead=enc.overhead, ex_alloc=enc.ex_alloc, ex_used=enc.ex_used,
         ex_feas=enc.ex_feas,
+        prov_overhead=enc.prov_overhead, prov_pods_cap=enc.prov_pods_cap,
     )
     return inputs, enc.n_slots
 
@@ -68,6 +69,15 @@ class TestNativeBitParity:
     def test_inflate(self):
         pods = [make_pod(f"p{i}", cpu="1", memory="256M") for i in range(100)]
         assert_bit_parity(catalog5(), [prov()], pods)
+
+    def test_kubelet_caps_and_reserved(self):
+        from karpenter_tpu.apis.provisioner import KubeletConfiguration
+
+        p = prov(kubelet=KubeletConfiguration(
+            max_pods=4, system_reserved_cpu_millis=250,
+            kube_reserved_memory_bytes=2**30))
+        pods = [make_pod(f"p{i}", cpu="200m", memory="512Mi") for i in range(15)]
+        assert_bit_parity(catalog5(), [p], pods)
 
     def test_mixed_sizes_and_zones(self):
         pods = (

@@ -151,6 +151,48 @@ def test_parity_daemon_overhead():
     assert_parity(catalog5(), [prov()], pods, daemon_overhead=overhead)
 
 
+def test_parity_kubelet_max_pods():
+    from karpenter_tpu.apis.provisioner import KubeletConfiguration
+
+    p = prov(kubelet=KubeletConfiguration(max_pods=3))
+    pods = [make_pod(f"p{i}", cpu="100m", memory="128Mi") for i in range(10)]
+    res = assert_parity(catalog5(), [p], pods)
+    # 10 tiny pods at <=3/node => at least 4 nodes
+    assert len(res.nodes) >= 4
+    assert all(n.pod_count <= 3 for n in res.nodes)
+
+
+def test_parity_kubelet_pods_per_core():
+    from karpenter_tpu.apis.provisioner import KubeletConfiguration
+
+    p = prov(kubelet=KubeletConfiguration(pods_per_core=1))
+    # small.2x (2 cores) caps at 2 pods; large.8x at 8
+    pods = [make_pod(f"p{i}", cpu="100m", memory="128Mi") for i in range(12)]
+    assert_parity(catalog5(), [p], pods)
+
+
+def test_parity_kubelet_reserved_overhead():
+    from karpenter_tpu.apis.provisioner import KubeletConfiguration
+
+    p = prov(kubelet=KubeletConfiguration(
+        system_reserved_cpu_millis=500,
+        kube_reserved_memory_bytes=2 * 2**30,
+        eviction_hard_memory_bytes=300 * 2**20))
+    pods = [make_pod(f"p{i}", cpu="1.5", memory="6Gi") for i in range(6)]
+    res = assert_parity(catalog5(), [p], pods)
+    assert res.nodes  # still schedulable, just on bigger/more nodes
+
+
+def test_parity_kubelet_mixed_provisioners():
+    from karpenter_tpu.apis.provisioner import KubeletConfiguration
+
+    capped = prov(name="capped", weight=10,
+                  kubelet=KubeletConfiguration(max_pods=2))
+    plain = prov(name="plain")
+    pods = [make_pod(f"p{i}", cpu="200m", memory="256Mi") for i in range(9)]
+    assert_parity(catalog5(), [capped, plain], pods)
+
+
 def test_parity_unschedulable():
     pods = [make_pod("huge", cpu="64", memory="1Gi"),
             make_pod("ok", cpu="1", memory="1Gi")]
