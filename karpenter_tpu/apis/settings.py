@@ -56,6 +56,19 @@ class Settings:
             if key.startswith("karpenter.sh/") or key.startswith("kubernetes.io/cluster"):
                 raise SettingsError(f"restricted tag key: {key}")
 
+    def apply(self, other: "Settings") -> "list[str]":
+        """In-place update from a freshly parsed Settings; every component
+        holding this object by reference observes the change (the reference's
+        live-watched ConfigMap injection, settings.go Inject). Returns the
+        names of changed fields."""
+        changed = []
+        for f in dataclasses.fields(Settings):
+            new = getattr(other, f.name)
+            if getattr(self, f.name) != new:
+                setattr(self, f.name, new)
+                changed.append(f.name)
+        return changed
+
     @staticmethod
     def from_dict(data: "dict[str, str]") -> "Settings":
         """Parse the ConfigMap-style flat key space (settings.go Inject)."""

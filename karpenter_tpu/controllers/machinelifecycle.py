@@ -1,0 +1,89 @@
+"""Machine lifecycle controller: Launched -> Registered -> Initialized.
+
+Parity target: karpenter-core's machine lifecycle (SURVEY.md §2.2 "Machine
+lifecycle": create -> launch -> registration -> initialization). Here:
+
+- LAUNCHED -> REGISTERED: the node object for the machine exists in the
+  cluster (the node "joined"; core watches node registration).
+- REGISTERED -> INITIALIZED: the backing instance reports `running`, the
+  node's startup taints are cleared (v1alpha5 startupTaints: "registered
+  with, expected to be removed before pods schedule"), and the node is
+  marked initialized — the gate consolidation eligibility checks
+  (oracle/consolidation.py eligible()).
+
+Emits karpenter_machines_initialized_total and the launch->initialized
+latency histogram (reference: karpenter_nodes_* metrics, metrics.md).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..metrics import NAMESPACE, REGISTRY, Registry
+from ..models.machine import INITIALIZED, LAUNCHED, REGISTERED, parse_provider_id
+from ..utils.clock import Clock
+from ..utils.errors import CloudError
+
+log = logging.getLogger("karpenter.machinelifecycle")
+
+
+class MachineLifecycleController:
+    def __init__(self, kube, cloudprovider, cluster,
+                 clock: Optional[Clock] = None,
+                 registry: Optional[Registry] = None):
+        self.kube = kube
+        self.cloudprovider = cloudprovider
+        self.cluster = cluster
+        self.clock = clock or Clock()
+        reg = registry or REGISTRY
+        self.initialized = reg.counter(
+            f"{NAMESPACE}_machines_initialized_total",
+            "Machines that reached Initialized.", ("provisioner",))
+        self.init_time = reg.histogram(
+            f"{NAMESPACE}_machines_initialization_time_seconds",
+            "Time from launch to Initialized.")
+
+    def _node_for(self, machine):
+        name = machine.status.node_name
+        if name and name in self.cluster.nodes:
+            return self.cluster.nodes[name]
+        for node in self.cluster.nodes.values():
+            if node.machine_name == machine.name:
+                return node
+        return None
+
+    def reconcile_once(self) -> int:
+        """Advance every machine one lifecycle step; returns transitions."""
+        moved = 0
+        for machine in self.kube.machines():
+            state = machine.status.state
+            if state == LAUNCHED:
+                if self._node_for(machine) is not None:
+                    machine.status.state = REGISTERED
+                    moved += 1
+            elif state == REGISTERED:
+                node = self._node_for(machine)
+                if node is None:
+                    continue
+                if not machine.status.provider_id:
+                    continue
+                try:
+                    _, iid = parse_provider_id(machine.status.provider_id)
+                    instance = self.cloudprovider.instances.get_by_id(iid)
+                except (CloudError, ValueError) as e:
+                    log.warning("lifecycle check for %s failed: %s",
+                                machine.name, e)
+                    continue
+                if instance.state != "running":
+                    continue
+                machine.status.state = INITIALIZED
+                node.startup_taints = ()
+                node.initialized = True
+                moved += 1
+                self.initialized.inc(
+                    provisioner=machine.spec.provisioner_name or "")
+                if node.created_ts:
+                    self.init_time.observe(
+                        max(0.0, self.clock.now() - node.created_ts))
+        return moved

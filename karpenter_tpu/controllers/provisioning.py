@@ -234,8 +234,10 @@ class ProvisioningController:
             capacity_type=machine.status.capacity_type,
             price=machine.status.price,
             taints=prov.taints,
+            startup_taints=prov.startup_taints,
             created_ts=self.clock.now(),
             machine_name=name,
+            initialized=False,  # the machine lifecycle controller flips this
         )
         self.cluster.add_node(node)
         self.kube.create("nodes", node.name, node)

@@ -1,0 +1,50 @@
+"""Settings live-watch controller.
+
+Parity target: the reference live-watches the `karpenter-global-settings`
+ConfigMap and injects the parsed struct into every reconcile context
+(settings.go:72-93 Inject; website settings.md). Here the Settings object is
+shared by reference across the operator, so one in-place `apply` makes the
+change visible everywhere — batching windows, feature gates, tags — without
+restarts. Invalid updates are rejected and logged, keeping the last good
+configuration (knative configmap-watcher semantics).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..apis.settings import Settings, SettingsError
+from ..utils.clock import Clock
+
+log = logging.getLogger("karpenter.settings")
+
+CONFIGMAP_NAME = "karpenter-global-settings"
+
+
+class SettingsWatchController:
+    def __init__(self, kube, settings: Settings, clock: Optional[Clock] = None):
+        self.kube = kube
+        self.settings = settings
+        self.clock = clock or Clock()
+        self._last_applied: "Optional[dict]" = None
+
+    def reconcile_once(self) -> "list[str]":
+        """Apply the ConfigMap if it changed; returns changed field names."""
+        cm = self.kube.get("configmaps", CONFIGMAP_NAME)
+        if cm is None:
+            return []
+        data = dict(cm.get("data", cm) if isinstance(cm, dict) else cm.data)
+        if data == self._last_applied:
+            return []
+        try:
+            parsed = Settings.from_dict(data)
+        except (SettingsError, ValueError) as e:
+            log.warning("rejecting settings update: %s", e)
+            self._last_applied = data  # don't re-log every cycle
+            return []
+        changed = self.settings.apply(parsed)
+        self._last_applied = data
+        if changed:
+            log.info("settings updated: %s", ", ".join(changed))
+        return changed

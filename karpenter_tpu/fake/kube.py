@@ -28,7 +28,8 @@ class Conflict(Exception):
 class KubeStore:
     """Typed object buckets with list/get/create/update/delete + watchers."""
 
-    KINDS = ("pods", "nodes", "machines", "provisioners", "nodetemplates", "pdbs")
+    KINDS = ("pods", "nodes", "machines", "provisioners", "nodetemplates",
+             "pdbs", "configmaps")
 
     def __init__(self):
         self._lock = threading.RLock()

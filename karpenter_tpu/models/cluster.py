@@ -30,6 +30,9 @@ class StateNode:
     capacity_type: str = ""
     price: float = 0.0
     taints: "tuple[Taint, ...]" = ()
+    # startup taints registered at boot, cleared at initialization
+    # (v1alpha5 startupTaints; the scheduler's in-flight model ignores them)
+    startup_taints: "tuple[Taint, ...]" = ()
     pods: "list[PodSpec]" = dataclasses.field(default_factory=list)
     created_ts: float = 0.0
     initialized: bool = True

@@ -17,6 +17,8 @@ from .cloudprovider import CloudProvider
 from .controllers.deprovisioning import DeprovisioningController
 from .controllers.interruption import FakeQueue, InterruptionController
 from .controllers.machinehydration import MachineHydrationController
+from .controllers.machinelifecycle import MachineLifecycleController
+from .controllers.settingswatch import SettingsWatchController
 from .controllers.nodetemplate import NodeTemplateController
 from .controllers.provisioning import ProvisioningController
 from .controllers.termination import TerminationController
@@ -73,6 +75,10 @@ class Operator:
         self.machinehydration = MachineHydrationController(
             self.kube, self.cloudprovider, cluster=self.cluster,
             clock=self.clock)
+        self.machinelifecycle = MachineLifecycleController(
+            self.kube, self.cloudprovider, self.cluster, clock=self.clock)
+        self.settingswatch = SettingsWatchController(
+            self.kube, settings, clock=self.clock)
         self.interruption = None
         if settings.interruption_queue_name:
             self.queue = queue or FakeQueue(settings.interruption_queue_name,
@@ -107,6 +113,8 @@ class Operator:
                              name="provisioning", daemon=True)
         t.start()
         self._threads.append(t)
+        loop("machinelifecycle", self.machinelifecycle.reconcile_once, 0.2)
+        loop("settingswatch", self.settingswatch.reconcile_once, 2.0)
         loop("termination", self.termination.reconcile_once, 0.2)
         loop("deprovisioning", self.deprovisioning.reconcile_once, 2.0)
         loop("nodetemplate", self.nodetemplate.reconcile_once, 5.0)
@@ -142,9 +150,11 @@ class Operator:
 
     def reconcile_all_once(self) -> None:
         """One deterministic pass over every controller (hermetic tests)."""
+        self.settingswatch.reconcile_once()
         self.nodetemplate.reconcile_once()
         self.machinehydration.reconcile_once()
         self.provisioning.reconcile_once()
+        self.machinelifecycle.reconcile_once()
         if self.interruption is not None:
             self.interruption.reconcile_once()
         self.deprovisioning.reconcile_once()

@@ -91,7 +91,10 @@ class InstanceProvider:
                 types: "list[InstanceType]", capacity_type: str) -> CloudInstance:
         labels = {k: v for k, v in machine.labels.items()}
         lts = self.launch_templates.ensure_all(
-            template, labels=labels, taints=machine.spec.taints,
+            template, labels=labels,
+            # the node registers with BOTH taint sets; startup taints are
+            # cleared at initialization (machinelifecycle controller)
+            taints=tuple(machine.spec.taints) + tuple(machine.spec.startup_taints),
             archs=self._archs(types), kubelet=machine.spec.kubelet)
         if not lts:
             raise cloud_errors.CloudError(

@@ -245,6 +245,83 @@ class TestConsolidationLoop:
         assert len(op.cluster.nodes) == 1
 
 
+class TestMachineLifecycle:
+    def test_launched_to_registered_to_initialized(self, op):
+        from karpenter_tpu.models.machine import INITIALIZED, LAUNCHED
+        from karpenter_tpu.models.pod import Taint
+
+        add_provisioner(op, startup_taints=(
+            Taint(key="node.example/not-ready", value="true", effect="NoSchedule"),))
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        (machine,) = op.kube.machines()
+        (node,) = op.cluster.nodes.values()
+        assert machine.status.state == LAUNCHED
+        assert not node.initialized
+        assert node.startup_taints  # registered with the startup taint
+        # the launch template registered both taint sets
+        inst = next(iter(op.cloudprovider.cloud.instances.values()))
+        lt = op.cloudprovider.cloud.launch_templates[inst.launch_template]
+        assert "node.example/not-ready" in lt.userdata
+        # one pass: LAUNCHED->REGISTERED, second: REGISTERED->INITIALIZED
+        # (instance already 'running' after the create-describe wait)
+        assert op.machinelifecycle.reconcile_once() >= 1
+        op.machinelifecycle.reconcile_once()
+        assert machine.status.state == INITIALIZED
+        assert node.initialized and node.startup_taints == ()
+        assert op.machinelifecycle.initialized.value(provisioner="default") == 1
+
+    def test_initialization_gates_consolidation(self, op):
+        add_provisioner(op, consolidation_enabled=True)
+        # two one-pod nodes (hostname anti-affinity); freeing node 2 makes
+        # node 1's pod movable
+        op.kube.create("pods", "a", make_pod("a", cpu="1.9", memory="128Mi",
+                                             anti_affinity_hostname=True))
+        op.kube.create("pods", "b", make_pod("b", cpu="1.9", memory="128Mi",
+                                             anti_affinity_hostname=True))
+        op.provisioning.reconcile_once()
+        assert len(op.cluster.nodes) == 2
+        (n1, n2) = sorted(op.cluster.nodes.values(), key=lambda n: n.name)
+        n2.pods.clear()
+        op.kube.delete("pods", "b")
+        # NOT initialized yet: no candidate
+        assert op.deprovisioning.reconcile_consolidation() is None
+        op.machinelifecycle.reconcile_once()
+        op.machinelifecycle.reconcile_once()
+        assert n1.initialized and n2.initialized
+        action = op.deprovisioning.reconcile_consolidation()
+        assert action is not None and action.kind == "delete"
+
+
+class TestSettingsWatch:
+    def test_configmap_update_applies_live(self, op):
+        assert op.settings.batch_idle_duration == 0.0
+        op.kube.create("configmaps", "karpenter-global-settings", {"data": {
+            "clusterName": "itest", "clusterEndpoint": "https://k.example",
+            "batchIdleDuration": "2s", "batchMaxDuration": "20s",
+            "featureGates.driftEnabled": "true",
+            "interruptionQueueName": "iq",
+        }})
+        changed = op.settingswatch.reconcile_once()
+        assert "batch_idle_duration" in changed
+        assert op.settings.batch_idle_duration == 2.0
+        assert op.settings.feature_gates.drift_enabled is True
+        # the provisioning controller shares the object by reference
+        assert op.provisioning.settings.batch_idle_duration == 2.0
+        # unchanged data is a no-op
+        assert op.settingswatch.reconcile_once() == []
+
+    def test_invalid_update_keeps_last_good(self, op):
+        before = op.settings.batch_max_duration
+        op.kube.create("configmaps", "karpenter-global-settings", {"data": {
+            "clusterName": "",  # required -> rejected
+            "batchMaxDuration": "99s",
+        }})
+        assert op.settingswatch.reconcile_once() == []
+        assert op.settings.batch_max_duration == before
+        assert op.settings.cluster_name == "itest"
+
+
 class TestNodeTemplateController:
     def test_status_resolution(self, op):
         op.nodetemplate.reconcile_once()
