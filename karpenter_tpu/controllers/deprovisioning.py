@@ -139,39 +139,67 @@ class DeprovisioningController:
         catalog = self.cloudprovider.catalog_for(None)
         all_provs = sorted(self.kube.provisioners(), key=lambda p: (-p.weight, p.name))
         method = "tpu" if self.use_tpu_solver else "oracle"
+        # only nodes of consolidation-enabled provisioners may be candidates
+        # (pre-search: a vetoed node must not shadow the next-best action)
+        cand_filter = lambda n: n.provisioner_name in eligible_provs
         import time as _time
 
         t0 = _time.perf_counter()
         try:
             if self.use_tpu_solver:
                 action = run_consolidation(cluster, catalog, all_provs,
-                                           now=self.clock.now())
+                                           now=self.clock.now(),
+                                           candidate_filter=cand_filter)
             else:
                 raise RuntimeError("oracle requested")
         except Exception as e:
             if self.use_tpu_solver:
                 log.warning("TPU consolidation failed (%s); oracle fallback", e)
             method = "oracle"
+            from ..oracle.consolidation import find_multi_consolidation
+
             action = find_consolidation(cluster, catalog, all_provs,
-                                        now=self.clock.now())
+                                        now=self.clock.now(),
+                                        candidate_filter=cand_filter)
+            if action is None:
+                # sequential pair simulation is O(pairs) scheduler runs:
+                # cap hard (8 candidates -> <=28) on the fallback path
+                action = find_multi_consolidation(
+                    cluster, catalog, all_provs, now=self.clock.now(),
+                    max_candidates=8, candidate_filter=cand_filter)
         self.eval_duration.observe(_time.perf_counter() - t0, method=method)
         if action is None:
             return None
-        node = self.cluster.nodes.get(action.node)
-        if node is None or node.provisioner_name not in eligible_provs:
+        nodes = [self.cluster.nodes.get(n) for n in action.nodes]
+        if any(n is None or n.provisioner_name not in eligible_provs
+               for n in nodes):
             return None
         if action.kind == "replace" and self.provisioning is not None:
             # launch the replacement before draining (consolidation.md:
             # "when it is ready, delete the existing node")
             self.recorder.normal(f"node/{action.node}", "ConsolidationReplace",
                                  f"replacing with {action.replacement[0]}")
-        if self.termination.request_deletion(action.node):
-            self.actions.inc(action=f"consolidation-{action.kind}")
-            self.recorder.normal(
-                f"node/{action.node}", "Consolidated",
-                f"{action.kind}: saves ${action.savings:.4f}/h")
-            return action
-        return None
+        # all-or-nothing: a multi-node action executed partially would drain
+        # one node while claiming the combined savings
+        requested = []
+        for n in action.nodes:
+            if self.termination.request_deletion(n):
+                requested.append(n)
+            else:
+                for done in requested:  # roll back the members already marked
+                    node = self.cluster.nodes.get(done)
+                    if node is not None:
+                        node.marked_for_deletion = False
+                        node.deletion_requested_ts = 0.0
+                log.warning("consolidation aborted: %s not deletable", n)
+                return None
+        suffix = "-multi" if len(action.nodes) > 1 else ""
+        self.actions.inc(action=f"consolidation-{action.kind}{suffix}")
+        self.recorder.normal(
+            f"node/{action.node}", "Consolidated",
+            f"{action.kind} {','.join(action.nodes)}: "
+            f"saves ${action.savings:.4f}/h")
+        return action
 
     def reconcile_once(self):
         """Full deprovisioning pass in reference priority order."""

@@ -35,7 +35,8 @@ from ..models.encode import INT_BIG, OptionGrid, build_grid, encode_group
 from ..models.instancetype import Catalog
 from ..models.pod import tolerates_all
 from ..oracle.consolidation import (
-    ConsolidationAction, REPLACE_PRICE_EPS, disruption_cost, eligible,
+    ConsolidationAction, MAX_PAIR_CANDIDATES, REPLACE_PRICE_EPS,
+    candidate_pairs, disruption_cost, eligible,
 )
 from ..oracle.scheduler import prepare_groups
 from .packer import PackInputs, pack_impl
@@ -46,7 +47,7 @@ N_SLOTS = 2  # 1 replacement allowed; a 2nd opening proves non-consolidatable
 @dataclasses.dataclass
 class ConsolidationBatch:
     inputs: PackInputs  # group/ex leaves carry a leading C axis
-    candidates: "list[StateNode]"
+    candidates: "list[tuple[StateNode, ...]]"  # one SET per lane (singles or pairs)
     provisioners: "list[Provisioner]"
     grid: OptionGrid
     n_groups: "list[int]"
@@ -58,7 +59,13 @@ def encode_consolidation(
     provisioners: Sequence[Provisioner],
     daemon_overhead: Optional[Sequence[int]] = None,
     grid: Optional[OptionGrid] = None,
+    cand_sets: "Optional[list[tuple[StateNode, ...]]]" = None,
+    candidate_filter=None,
 ) -> Optional[ConsolidationBatch]:
+    """cand_sets=None encodes the single-node sweep; pass node tuples (e.g.
+    candidate_pairs) for the multi-node search — each set is one vmap lane
+    whose group batch is the set's combined pods and whose cheaper-option
+    mask is priced against the set's combined price."""
     if grid is None or grid.seqnum != catalog.seqnum:
         grid = build_grid(catalog)
     provs = sorted(provisioners, key=lambda p: (-p.weight, p.name))
@@ -68,8 +75,12 @@ def encode_consolidation(
     T, S, R, Pv = grid.T, grid.S, wk.NUM_RESOURCES, len(provs)
     price = grid.price  # [T, S], inf where invalid
 
-    candidates = [cluster.nodes[name] for name in sorted(cluster.nodes)
-                  if eligible(cluster.nodes[name], cluster)]
+    if cand_sets is None:
+        cand_sets = [(cluster.nodes[name],) for name in sorted(cluster.nodes)
+                     if eligible(cluster.nodes[name], cluster)
+                     and (candidate_filter is None
+                          or candidate_filter(cluster.nodes[name]))]
+    candidates = cand_sets
     if not candidates:
         return None
 
@@ -87,12 +98,14 @@ def encode_consolidation(
     per_cand = []
     gmax = 1
     for cand in candidates:
-        cheaper_opt = price < (cand.price - REPLACE_PRICE_EPS)  # [T, S]
+        total_price = sum(n.price for n in cand)
+        cheaper_opt = price < (total_price - REPLACE_PRICE_EPS)  # [T, S]
         zones_c = sorted({
             grid.zones[s // len(grid.capacity_types)]
             for t in range(T) for s in range(S) if cheaper_opt[t, s]
         })
-        groups = prepare_groups(cand.non_daemon_pods(), zones_c)
+        pods = [p for n in cand for p in n.non_daemon_pods()]
+        groups = prepare_groups(pods, zones_c)
         gmax = max(gmax, len(groups))
         per_cand.append((cand, cheaper_opt, groups))
 
@@ -140,9 +153,10 @@ def encode_consolidation(
             group_cap[ci, gi] = cap
             group_feas[ci, gi] = feas
             group_newprov[ci, gi] = newprov
+            member_names = {n.name for n in cand}
             for name, i in node_index.items():
-                if name == cand.name:
-                    continue  # pods must not land back on the candidate
+                if name in member_names:
+                    continue  # pods must not land back on the candidate set
                 if cluster.nodes[name].marked_for_deletion:
                     continue
                 ex_feas[ci, gi, i] = node_fits(g.spec, name)
@@ -170,21 +184,8 @@ def _batched_pack(inputs: PackInputs, n_slots: int):
     return jax.vmap(lambda inp: pack_impl(inp, n_slots), in_axes=(axes,))(inputs)
 
 
-def run_consolidation(
-    cluster: ClusterState,
-    catalog: Catalog,
-    provisioners: Sequence[Provisioner],
-    daemon_overhead: Optional[Sequence[int]] = None,
-    now: float = 0.0,
-    grid: Optional[OptionGrid] = None,
-) -> Optional[ConsolidationAction]:
-    """Batched equivalent of oracle find_consolidation (bit-parity tested)."""
-    batch = encode_consolidation(cluster, catalog, provisioners,
-                                 daemon_overhead, grid)
-    if batch is None:
-        return None
-    result = jax.device_get(_batched_pack(jax.device_put(batch.inputs), N_SLOTS))
-
+def _decode_actions(batch: ConsolidationBatch, result, now: float
+                    ) -> "list[ConsolidationAction]":
     actions = []
     for ci, cand in enumerate(batch.candidates):
         G = batch.n_groups[ci]
@@ -193,23 +194,74 @@ def run_consolidation(
         opened = int(result.n_open[ci])
         if opened > 1:
             continue
-        prov = next((p for p in batch.provisioners
-                     if p.name == cand.provisioner_name), None)
-        cost = disruption_cost(cand, prov, now)
+        total_price = sum(n.price for n in cand)
+        cost = sum(
+            disruption_cost(
+                n, next((p for p in batch.provisioners
+                         if p.name == n.provisioner_name), None), now)
+            for n in cand)
+        names = tuple(sorted(n.name for n in cand))
         if opened == 0:
             actions.append(ConsolidationAction(
-                "delete", cand.name, cost, savings=cand.price))
+                "delete", names[0], cost, savings=total_price, nodes=names))
             continue
         flat = int(result.decided[ci, 0])
         if flat < 0:
             raise AssertionError(
-                f"candidate {cand.name}: open claim slot has no surviving option")
+                f"candidate {names}: open claim slot has no surviving option")
         opt = batch.grid.options[flat]
-        if opt.price >= cand.price - REPLACE_PRICE_EPS:
+        if opt.price >= total_price - REPLACE_PRICE_EPS:
             continue
         actions.append(ConsolidationAction(
-            "replace", cand.name, cost, savings=cand.price - opt.price,
-            replacement=(opt.itype.name, opt.zone, opt.capacity_type, opt.price)))
+            "replace", names[0], cost, savings=total_price - opt.price,
+            replacement=(opt.itype.name, opt.zone, opt.capacity_type, opt.price),
+            nodes=names))
+    return actions
+
+
+def run_consolidation(
+    cluster: ClusterState,
+    catalog: Catalog,
+    provisioners: Sequence[Provisioner],
+    daemon_overhead: Optional[Sequence[int]] = None,
+    now: float = 0.0,
+    grid: Optional[OptionGrid] = None,
+    multi_node: bool = True,
+    max_pair_candidates: int = MAX_PAIR_CANDIDATES,
+    candidate_filter=None,
+) -> Optional[ConsolidationAction]:
+    """Batched equivalent of oracle find_consolidation (bit-parity tested).
+
+    Single-node sweep first (reference semantics); when it yields nothing
+    and multi_node is set, a second vmapped dispatch evaluates node PAIRS —
+    the multi-node search designs/consolidation.md rules out as too
+    expensive to do sequentially. Both sweeps are one device dispatch each."""
+    batch = encode_consolidation(cluster, catalog, provisioners,
+                                 daemon_overhead, grid,
+                                 candidate_filter=candidate_filter)
+    if batch is None:
+        return None
+    result = jax.device_get(_batched_pack(jax.device_put(batch.inputs), N_SLOTS))
+    actions = _decode_actions(batch, result, now)
+    if actions:
+        return min(actions, key=ConsolidationAction.sort_key)
+    if not multi_node:
+        return None
+    # reuse the singles sweep's eligibility result and option grid — no
+    # second eligible()/build_grid pass
+    pairs = candidate_pairs(cluster, batch.provisioners, now,
+                            max_pair_candidates,
+                            nodes=[c[0] for c in batch.candidates])
+    if not pairs:
+        return None
+    pair_batch = encode_consolidation(cluster, catalog, provisioners,
+                                      daemon_overhead, batch.grid,
+                                      cand_sets=pairs)
+    if pair_batch is None:
+        return None
+    pair_result = jax.device_get(
+        _batched_pack(jax.device_put(pair_batch.inputs), N_SLOTS))
+    actions = _decode_actions(pair_batch, pair_result, now)
     if not actions:
         return None
     return min(actions, key=ConsolidationAction.sort_key)

@@ -32,13 +32,21 @@ REPLACE_PRICE_EPS = 1e-9
 @dataclasses.dataclass
 class ConsolidationAction:
     kind: str  # "delete" | "replace"
-    node: str
+    node: str  # primary node (nodes[0])
     disruption_cost: float
     savings: float
     replacement: Optional[tuple] = None  # (instance type, zone, capacityType, price)
+    # all nodes the action disrupts; multi-node actions (the TPU headroom
+    # feature the Go reference skips for cost, consolidation.md 'Selecting
+    # Nodes') carry >1 entry
+    nodes: "tuple[str, ...]" = ()
+
+    def __post_init__(self):
+        if not self.nodes:
+            self.nodes = (self.node,)
 
     def sort_key(self):
-        return (self.disruption_cost, -self.savings, self.node)
+        return (self.disruption_cost, -self.savings, self.nodes)
 
 
 def lifetime_factor(node: StateNode, prov: Optional[Provisioner], now: float) -> float:
@@ -82,6 +90,57 @@ def eligible(node: StateNode, cluster: ClusterState) -> bool:
     return True
 
 
+def evaluate_candidate_set(
+    nodes: "Sequence[StateNode]",
+    cluster: ClusterState,
+    catalog: Catalog,
+    provisioners: Sequence[Provisioner],
+    daemon_overhead: Optional[Sequence[int]] = None,
+    now: float = 0.0,
+) -> Optional[ConsolidationAction]:
+    """Simulated scheduling of the set's combined pods against the rest of
+    the cluster, with at most one replacement strictly cheaper than the set's
+    combined price. |nodes| == 1 is the reference's single-node search;
+    |nodes| > 1 is the multi-node search the Go reference skips for cost."""
+    names = {n.name for n in nodes}
+    total_price = sum(n.price for n in nodes)
+    others = cluster.existing_views(exclude=names)
+    pods = [p for n in nodes for p in n.non_daemon_pods()]
+    # restrict the replacement universe to OPTIONS strictly cheaper than the
+    # set (option-level filter — the kernel applies the identical per-option
+    # cheaper mask over the full grid, so both paths share one universe)
+    cheaper_types = []
+    for t in catalog.types:
+        offs = type(t.offerings)(
+            o for o in t.offerings
+            if o.available and o.price < total_price - REPLACE_PRICE_EPS)
+        if offs:
+            cheaper_types.append(dataclasses.replace(t, offerings=offs))
+    cheaper = Catalog(types=cheaper_types, seqnum=catalog.seqnum)
+    sched = Scheduler(cheaper, provisioners, daemon_overhead)
+    res = sched.schedule(list(pods), existing=others)
+    if res.unschedulable or len(res.new_nodes) > 1:
+        return None
+    cost = sum(
+        disruption_cost(
+            n, next((p for p in provisioners if p.name == n.provisioner_name),
+                    None), now)
+        for n in nodes)
+    ordered = tuple(sorted(names))
+    if not res.new_nodes:
+        return ConsolidationAction("delete", ordered[0], cost,
+                                   savings=total_price, nodes=ordered)
+    claim = res.new_nodes[0]
+    opt = claim.decided
+    if opt.price >= total_price - REPLACE_PRICE_EPS:
+        return None
+    return ConsolidationAction(
+        "replace", ordered[0], cost, savings=total_price - opt.price,
+        replacement=(opt.itype.name, opt.zone, opt.capacity_type, opt.price),
+        nodes=ordered,
+    )
+
+
 def evaluate_candidate(
     node: StateNode,
     cluster: ClusterState,
@@ -90,37 +149,8 @@ def evaluate_candidate(
     daemon_overhead: Optional[Sequence[int]] = None,
     now: float = 0.0,
 ) -> Optional[ConsolidationAction]:
-    """Simulated scheduling of `node`'s pods against the rest of the cluster,
-    with at most one strictly-cheaper replacement node."""
-    others = cluster.existing_views(exclude={node.name})
-    pods = node.non_daemon_pods()
-    # restrict the replacement universe to OPTIONS strictly cheaper than the
-    # node (option-level filter — the kernel applies the identical per-option
-    # cheaper mask over the full grid, so both paths share one universe)
-    cheaper_types = []
-    for t in catalog.types:
-        offs = type(t.offerings)(
-            o for o in t.offerings
-            if o.available and o.price < node.price - REPLACE_PRICE_EPS)
-        if offs:
-            cheaper_types.append(dataclasses.replace(t, offerings=offs))
-    cheaper = Catalog(types=cheaper_types, seqnum=catalog.seqnum)
-    sched = Scheduler(cheaper, provisioners, daemon_overhead)
-    res = sched.schedule(list(pods), existing=others)
-    if res.unschedulable or len(res.new_nodes) > 1:
-        return None
-    prov = next((p for p in provisioners if p.name == node.provisioner_name), None)
-    cost = disruption_cost(node, prov, now)
-    if not res.new_nodes:
-        return ConsolidationAction("delete", node.name, cost, savings=node.price)
-    claim = res.new_nodes[0]
-    opt = claim.decided
-    if opt.price >= node.price - REPLACE_PRICE_EPS:
-        return None
-    return ConsolidationAction(
-        "replace", node.name, cost, savings=node.price - opt.price,
-        replacement=(opt.itype.name, opt.zone, opt.capacity_type, opt.price),
-    )
+    return evaluate_candidate_set([node], cluster, catalog, provisioners,
+                                  daemon_overhead, now)
 
 
 def find_consolidation(
@@ -129,16 +159,94 @@ def find_consolidation(
     provisioners: Sequence[Provisioner],
     daemon_overhead: Optional[Sequence[int]] = None,
     now: float = 0.0,
+    candidate_filter=None,
 ) -> Optional[ConsolidationAction]:
     """Best single-node action, min disruption cost first (consolidation.md
-    'Selecting Nodes for Consolidation')."""
+    'Selecting Nodes for Consolidation'). `candidate_filter` restricts which
+    nodes may be candidates (e.g. consolidation-enabled provisioners only);
+    all nodes still host rescheduled pods."""
     actions = []
     for name in sorted(cluster.nodes):
         node = cluster.nodes[name]
         if not eligible(node, cluster):
             continue
+        if candidate_filter is not None and not candidate_filter(node):
+            continue
         act = evaluate_candidate(node, cluster, catalog, provisioners,
                                  daemon_overhead, now)
+        if act is not None:
+            actions.append(act)
+    if not actions:
+        return None
+    return min(actions, key=ConsolidationAction.sort_key)
+
+
+MAX_PAIR_CANDIDATES = 32  # pair search over the M cheapest-to-disrupt nodes
+
+
+def _pair_pdb_safe(a: StateNode, b: StateNode, cluster: ClusterState) -> bool:
+    """The aggregate PDB-headroom invariant for SIMULTANEOUS eviction of both
+    nodes: eligible() checks each node's matching set alone; a pair evicts
+    the union at once, so the combined set must fit the budget too."""
+    if not cluster.pdbs:
+        return True
+    healthy = {
+        pdb.name: sum(1 for n in cluster.nodes.values()
+                      for p in n.pods if pdb.matches(p))
+        for pdb in cluster.pdbs
+    }
+    pods = a.non_daemon_pods() + b.non_daemon_pods()
+    for pdb in cluster.pdbs:
+        on_pair = sum(1 for p in pods if pdb.matches(p))
+        if on_pair and pdb.disruptions_allowed(healthy.get(pdb.name, 0)) < on_pair:
+            return False
+    return True
+
+
+def candidate_pairs(cluster: ClusterState, provisioners, now: float,
+                    max_candidates: int = MAX_PAIR_CANDIDATES,
+                    nodes: "Optional[Sequence[StateNode]]" = None,
+                    candidate_filter=None):
+    """Eligible nodes pruned to the cheapest-to-disrupt M, paired; pairs
+    violating the combined PDB budget are dropped. Pass `nodes` to reuse an
+    eligibility sweep already done (the kernel path reuses its singles
+    batch)."""
+    if nodes is None:
+        nodes = [cluster.nodes[name] for name in sorted(cluster.nodes)
+                 if eligible(cluster.nodes[name], cluster)]
+    if candidate_filter is not None:
+        nodes = [n for n in nodes if candidate_filter(n)]
+    scored = sorted(
+        (disruption_cost(
+            n, next((p for p in provisioners
+                     if p.name == n.provisioner_name), None), now),
+         n.name, n)
+        for n in nodes)
+    pruned = [n for _, _, n in scored[:max_candidates]]
+    return [(pruned[i], pruned[j])
+            for i in range(len(pruned)) for j in range(i + 1, len(pruned))
+            if _pair_pdb_safe(pruned[i], pruned[j], cluster)]
+
+
+def find_multi_consolidation(
+    cluster: ClusterState,
+    catalog: Catalog,
+    provisioners: Sequence[Provisioner],
+    daemon_overhead: Optional[Sequence[int]] = None,
+    now: float = 0.0,
+    max_candidates: int = MAX_PAIR_CANDIDATES,
+    candidate_filter=None,
+) -> Optional[ConsolidationAction]:
+    """Best two-node action — the multi-node search designs/consolidation.md
+    rules out as too expensive sequentially. Run after the single-node search
+    returns nothing. NOTE: sequential simulation is O(pairs) scheduler runs;
+    callers without the batched kernel should cap max_candidates hard (the
+    controller's oracle fallback uses 8 -> <=28 simulations)."""
+    actions = []
+    for pair in candidate_pairs(cluster, provisioners, now, max_candidates,
+                                candidate_filter=candidate_filter):
+        act = evaluate_candidate_set(pair, cluster, catalog, provisioners,
+                                     daemon_overhead, now)
         if act is not None:
             actions.append(act)
     if not actions:
