@@ -243,18 +243,70 @@ def config_4_stress_50k() -> dict:
                        "mesh": dict(zip(mesh.axis_names, mesh.devices.shape))}}
 
 
+def config_5_pair_sweep() -> dict:
+    """Multi-node (pair) consolidation sweep — beyond-reference capability:
+    64 full nodes, no single-node action exists, the batched pair dispatch
+    evaluates 496 two-node lanes."""
+    from karpenter_tpu.models.cluster import ClusterState, StateNode
+    from karpenter_tpu.models.instancetype import make_instance_type
+    from karpenter_tpu.ops.consolidate import run_consolidation
+
+    catalog = generate_fleet_catalog()
+    # a bulk-discounted big type (sub-linear pricing): the shape where pair
+    # consolidation wins but single-node search cannot
+    catalog.types.append(make_instance_type(
+        "bulk.32xlarge", cpu=32, memory="128Gi", od_price=0.55))
+    catalog.bump()
+    catalog.__post_init__()
+    prov = _provisioner(consolidation_enabled=True)
+    cluster = ClusterState()
+    big = catalog.by_name["c8.2xlarge"]  # cheapest amd64 8-vcpu type
+    alloc = big.allocatable_vector()
+    cpu_free = alloc[wk.RESOURCE_INDEX[wk.RESOURCE_CPU]]
+    # FULL nodes: no cheaper single type fits a node's pods, but two nodes'
+    # pods collapse onto one bulk.32xlarge (0.55 < 2x c8.2xlarge)
+    for i in range(64):
+        n_pods = max(1, cpu_free // 1000)
+        node = StateNode(
+            name=f"n-{i:03d}",
+            labels={**big.labels_dict(), wk.LABEL_ZONE: "zone-1a",
+                    wk.LABEL_CAPACITY_TYPE: "on-demand",
+                    wk.LABEL_PROVISIONER: "default"},
+            allocatable=list(alloc),
+            instance_type=big.name, zone="zone-1a", capacity_type="on-demand",
+            price=big.offerings[0].price, provisioner_name="default",
+            pods=[make_pod(f"p{i}-{j}", cpu="1", memory="1Gi",
+                           node_name=f"n-{i:03d}") for j in range(n_pods)],
+        )
+        cluster.add_node(node)
+    run_consolidation(cluster, catalog, [prov])  # warmup
+    times = []
+    action = None
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        action = run_consolidation(cluster, catalog, [prov])
+        times.append((time.perf_counter() - t0) * 1000)
+    return {"bench": "baseline_config", "config": 5, "name": "pair-sweep-64",
+            "ms": round(statistics.median(times), 3), "nodes": 64,
+            "detail": {"action": None if action is None else
+                       {"kind": action.kind, "nodes": list(action.nodes),
+                        "replacement": action.replacement,
+                        "savings_per_hour": round(action.savings, 4)}}}
+
+
 CONFIGS = {
     0: config_0_inflate,
     1: config_1_mixed_5k,
     2: config_2_gpu,
     3: config_3_consolidation,
     4: config_4_stress_50k,
+    5: config_5_pair_sweep,
 }
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--configs", default="0,1,2,3,4")
+    parser.add_argument("--configs", default="0,1,2,3,4,5")
     args = parser.parse_args(argv)
     for idx in (int(c) for c in args.configs.split(",")):
         print(json.dumps(CONFIGS[idx]()), flush=True)
