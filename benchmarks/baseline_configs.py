@@ -256,8 +256,7 @@ def config_5_pair_sweep() -> dict:
     # consolidation wins but single-node search cannot
     catalog.types.append(make_instance_type(
         "bulk.32xlarge", cpu=32, memory="128Gi", od_price=0.55))
-    catalog.bump()
-    catalog.__post_init__()
+    catalog.bump()  # rebuilds by_name too
     prov = _provisioner(consolidation_enabled=True)
     cluster = ClusterState()
     big = catalog.by_name["c8.2xlarge"]  # cheapest amd64 8-vcpu type

@@ -76,14 +76,38 @@ def cmd_controller(args) -> int:
 
         solver_factory = (
             lambda cat, provs: RemoteSolver(cat, provs, target=args.solver))
-    op = Operator(FakeCloud(catalog), settings, catalog,
-                  solver_factory=solver_factory)
-    # kube.create runs the admission webhooks (defaulting + validation)
-    op.kube.create("nodetemplates", "default", NodeTemplate(
-        name="default",
-        subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"}))
-    op.kube.create("provisioners", "default",
-                   Provisioner(name="default", provider_ref="default"))
+    cloud = FakeCloud(catalog)
+    # reference templates discover infra by cluster tag; tag the simulated
+    # subnets/SGs so `karpenter.sh/discovery: <cluster>` selectors resolve
+    for s in cloud.subnets:
+        s.tags.setdefault("karpenter.sh/discovery", args.cluster_name)
+    for g in cloud.security_groups:
+        g.tags.setdefault("karpenter.sh/discovery", args.cluster_name)
+    op = Operator(cloud, settings, catalog, solver_factory=solver_factory)
+    if args.apply:
+        # reference-compatible manifests (Provisioner / AWSNodeTemplate /
+        # Deployment / Pod / PDB YAML) drive the plane as-is
+        from .apis.yaml_compat import load_files
+
+        loaded = load_files(*args.apply, env={"CLUSTER_NAME": args.cluster_name})
+        for t in loaded.templates:
+            op.kube.create("nodetemplates", t.name, t)
+        for p in loaded.provisioners:
+            op.kube.create("provisioners", p.name, p)
+        for pdb in loaded.pdbs:
+            op.kube.create("pdbs", pdb.name, pdb)  # flows to cluster via watch
+        for pod in loaded.pods:
+            op.kube.create("pods", pod.name, pod)
+        print(f"applied {len(loaded.templates)} templates, "
+              f"{len(loaded.provisioners)} provisioners, "
+              f"{len(loaded.pods)} pods, {len(loaded.pdbs)} pdbs", flush=True)
+    else:
+        # kube.create runs the admission webhooks (defaulting + validation)
+        op.kube.create("nodetemplates", "default", NodeTemplate(
+            name="default",
+            subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"}))
+        op.kube.create("provisioners", "default",
+                       Provisioner(name="default", provider_ref="default"))
     op.start()
     print(f"controller running (cluster={args.cluster_name}, "
           f"solver={'grpc:' + args.solver if args.solver else 'in-process'}); "
@@ -114,6 +138,10 @@ def main(argv=None) -> int:
     p_ctrl.add_argument("--solver", default="",
                         help="gRPC solver sidecar address (host:port)")
     p_ctrl.add_argument("--cluster-name", default="simulated")
+    p_ctrl.add_argument("--apply", action="append", default=[],
+                        metavar="FILE",
+                        help="manifest file(s) to apply at boot "
+                             "(reference-compatible Karpenter YAML)")
     p_ctrl.set_defaults(fn=cmd_controller)
 
     p_ver = sub.add_parser("version")

@@ -1,0 +1,310 @@
+"""Karpenter-manifest compatibility loader.
+
+Parses the REFERENCE's own YAML kinds — unchanged files from
+/root/reference/examples/ work directly (the switch-over contract: a user of
+the reference brings their manifests as-is):
+
+- karpenter.sh/v1alpha5 Provisioner  -> apis.provisioner.Provisioner
+- karpenter.k8s.aws/v1alpha1 AWSNodeTemplate (and the native
+  karpenter.k8s.tpu NodeTemplate) -> apis.nodetemplate.NodeTemplate
+- apps/v1 Deployment -> replicas x models.pod.PodSpec
+- v1 Pod -> PodSpec
+- policy/v1 PodDisruptionBudget -> models.cluster.PodDisruptionBudget
+
+Known deliberate gaps: `preferredDuringScheduling` node affinities are soft
+preferences the scheduler may ignore — they parse to nothing (the reference's
+scheduler treats them best-effort too); percentage PDBs resolve against the
+workload's replica count when a matching Deployment is in the same bundle.
+Replay parity with the reference's examples is tested in
+tests/test_yaml_compat.py (SURVEY.md §7.2 step 1's replay harness).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+import yaml
+
+from ..models.cluster import PodDisruptionBudget
+from ..models.pod import PodSpec, Taint, Toleration, TopologySpreadConstraint
+from ..models.requirements import OP_IN, Requirement, Requirements
+from ..utils.quantity import cpu_millis, mem_bytes, count as count_qty
+from . import wellknown as wk
+from .nodetemplate import BlockDeviceMapping, MetadataOptions, NodeTemplate
+from .provisioner import KubeletConfiguration, Limits, Provisioner
+
+# reference AMI families -> our image families (providers/images.py)
+FAMILY_MAP = {
+    "AL2": "ubuntu-k8s",
+    "Ubuntu": "ubuntu-k8s",
+    "Bottlerocket": "flatboat",
+    "Custom": "custom",
+}
+# EBS volume types -> our volume classes
+VOLUME_MAP = {"gp2": "ssd", "gp3": "ssd", "io1": "ssd", "io2": "ssd",
+              "st1": "throughput", "sc1": "throughput", "standard": "balanced"}
+
+# the reference's provider label namespace -> ours (same suffixes:
+# instance-family/-size/-cpu/..., apis/wellknown.py)
+_AWS_LABEL_PREFIX = "karpenter.k8s.aws/"
+_OUR_LABEL_PREFIX = "karpenter.k8s.tpu/"
+
+
+def _map_key(key: str) -> str:
+    if key.startswith(_AWS_LABEL_PREFIX):
+        return _OUR_LABEL_PREFIX + key[len(_AWS_LABEL_PREFIX):]
+    return key
+
+
+@dataclasses.dataclass
+class LoadedManifests:
+    provisioners: "list[Provisioner]"
+    templates: "list[NodeTemplate]"
+    pods: "list[PodSpec]"
+    pdbs: "list[PodDisruptionBudget]"
+
+
+def load_manifests(text: str, env: "Optional[dict[str, str]]" = None,
+                   replicas_override: "Optional[int]" = None) -> LoadedManifests:
+    """Parse a multi-document YAML bundle. `${VAR}` placeholders substitute
+    from `env` (the reference's examples use ${CLUSTER_NAME})."""
+    for key, value in (env or {}).items():
+        text = text.replace("${" + key + "}", value)
+    out = LoadedManifests([], [], [], [])
+    docs = [d for d in yaml.safe_load_all(text) if d]
+    for doc in docs:
+        kind = doc.get("kind", "")
+        if kind == "Provisioner":
+            out.provisioners.append(_provisioner(doc))
+        elif kind in ("AWSNodeTemplate", "NodeTemplate"):
+            out.templates.append(_nodetemplate(doc))
+        elif kind == "Deployment":
+            out.pods.extend(_deployment_pods(doc, replicas_override))
+        elif kind == "Pod":
+            out.pods.append(_pod(doc.get("metadata", {}), doc.get("spec", {})))
+        elif kind == "PodDisruptionBudget":
+            out.pdbs.append(_pdb(doc, docs))
+    return out
+
+
+def load_files(*paths, env=None, replicas_override=None) -> LoadedManifests:
+    text = "\n---\n".join(open(p).read() for p in paths)
+    return load_manifests(text, env=env, replicas_override=replicas_override)
+
+
+# -- provisioner -------------------------------------------------------------------
+
+def _requirements(items) -> Requirements:
+    reqs = Requirements()
+    for item in items or ():
+        reqs.add(Requirement.create(
+            _map_key(item["key"]), item["operator"],
+            [str(v) for v in item.get("values", [])]))
+    return reqs
+
+
+def _taints(items) -> "tuple[Taint, ...]":
+    return tuple(
+        Taint(key=t["key"], value=str(t.get("value", "")),
+              effect=t.get("effect", "NoSchedule"))
+        for t in items or ())
+
+
+def _provisioner(doc) -> Provisioner:
+    spec = doc.get("spec", {})
+    limits_spec = (spec.get("limits") or {}).get("resources", {})
+    limits = Limits(
+        cpu_millis=cpu_millis(limits_spec["cpu"]) if "cpu" in limits_spec else None,
+        memory_bytes=mem_bytes(limits_spec["memory"]) if "memory" in limits_spec else None,
+    )
+    kube = spec.get("kubeletConfiguration") or {}
+    sys_res = kube.get("systemReserved") or {}
+    kube_res = kube.get("kubeReserved") or {}
+    evict = kube.get("evictionHard") or {}
+    evict_mem = evict.get("memory.available")
+    kubelet = KubeletConfiguration(
+        max_pods=kube.get("maxPods"),
+        pods_per_core=kube.get("podsPerCore"),
+        system_reserved_cpu_millis=cpu_millis(sys_res["cpu"]) if "cpu" in sys_res else 0,
+        system_reserved_memory_bytes=mem_bytes(sys_res["memory"]) if "memory" in sys_res else 0,
+        kube_reserved_cpu_millis=cpu_millis(kube_res["cpu"]) if "cpu" in kube_res else None,
+        kube_reserved_memory_bytes=mem_bytes(kube_res["memory"]) if "memory" in kube_res else None,
+        eviction_hard_memory_bytes=mem_bytes(evict_mem) if evict_mem else 100 * 2**20,
+    )
+    p = Provisioner(
+        name=doc.get("metadata", {}).get("name", "default"),
+        requirements=_requirements(spec.get("requirements")),
+        taints=_taints(spec.get("taints")),
+        startup_taints=_taints(spec.get("startupTaints")),
+        labels=tuple(sorted((spec.get("labels") or {}).items())),
+        limits=limits,
+        weight=int(spec.get("weight", 0)),
+        ttl_seconds_after_empty=spec.get("ttlSecondsAfterEmpty"),
+        ttl_seconds_until_expired=spec.get("ttlSecondsUntilExpired"),
+        consolidation_enabled=bool((spec.get("consolidation") or {}).get("enabled", False)),
+        kubelet=kubelet,
+        provider_ref=(spec.get("providerRef") or {}).get("name"),
+    )
+    p.set_defaults()
+    p.validate()
+    return p
+
+
+# -- node template -----------------------------------------------------------------
+
+def _nodetemplate(doc) -> NodeTemplate:
+    spec = doc.get("spec", {})
+    bdms = []
+    for m in spec.get("blockDeviceMappings") or ():
+        ebs = m.get("ebs") or {}
+        size = ebs.get("volumeSize", "20Gi")
+        size_gib = max(1, mem_bytes(str(size)) // 2**30) if not isinstance(size, int) else size
+        bdms.append(BlockDeviceMapping(
+            device_name=m.get("deviceName", "/dev/sda1"),
+            volume_size_gib=int(size_gib),
+            volume_type=VOLUME_MAP.get(ebs.get("volumeType", "gp3"), "ssd"),
+            encrypted=bool(ebs.get("encrypted", True)),
+            iops=ebs.get("iops"),
+        ))
+    md = spec.get("metadataOptions") or {}
+    template = NodeTemplate(
+        name=doc.get("metadata", {}).get("name", "default"),
+        image_family=FAMILY_MAP.get(spec.get("amiFamily", "AL2"), "ubuntu-k8s"),
+        instance_profile=spec.get("instanceProfile", ""),
+        subnet_selector=dict(spec.get("subnetSelector") or {}),
+        security_group_selector=dict(spec.get("securityGroupSelector") or {}),
+        image_selector=dict(spec.get("amiSelector") or {}),
+        userdata=spec.get("userData", ""),
+        tags=dict(spec.get("tags") or {}),
+        launch_template_name=spec.get("launchTemplate", ""),
+        metadata_options=MetadataOptions(
+            http_endpoint=md.get("httpEndpoint", "enabled"),
+            http_tokens=md.get("httpTokens", "required"),
+            http_put_response_hop_limit=int(md.get("httpPutResponseHopLimit", 2)),
+        ),
+        block_device_mappings=tuple(bdms),
+        detailed_monitoring=bool(spec.get("detailedMonitoring", False)),
+    )
+    template.set_defaults()
+    return template
+
+
+# -- workloads ---------------------------------------------------------------------
+
+def _pod_requests(containers) -> "dict[str, int]":
+    """Sum container requests; extended resources follow the k8s rule that
+    requests default to limits when only limits are set."""
+    total: "dict[str, int]" = {}
+    for c in containers or ():
+        resources = c.get("resources") or {}
+        limits = resources.get("limits") or {}
+        requests = dict(limits)  # limits imply requests
+        requests.update(resources.get("requests") or {})
+        for name, qty in requests.items():
+            if name == "cpu":
+                total["cpu"] = total.get("cpu", 0) + cpu_millis(str(qty))
+            elif name in ("memory", "ephemeral-storage"):
+                total[name] = total.get(name, 0) + mem_bytes(str(qty))
+            else:
+                total[name] = total.get(name, 0) + count_qty(qty)
+    return total
+
+
+def _pod(metadata, spec, name: str = "", labels=None) -> PodSpec:
+    labels = labels if labels is not None else (metadata.get("labels") or {})
+    requests = _pod_requests(spec.get("containers"))
+    reqs = Requirements()
+    for k, v in (spec.get("nodeSelector") or {}).items():
+        reqs.add(Requirement.create(_map_key(k), OP_IN, [str(v)]))
+    affinity = (spec.get("affinity") or {}).get("nodeAffinity") or {}
+    required = affinity.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+    for term in required.get("nodeSelectorTerms") or ():
+        for expr in term.get("matchExpressions") or ():
+            reqs.add(Requirement.create(
+                _map_key(expr["key"]), expr["operator"],
+                [str(v) for v in expr.get("values", [])]))
+    # preferredDuringScheduling: soft, deliberately ignored (module docstring)
+    tolerations = tuple(
+        Toleration(key=t.get("key", ""), operator=t.get("operator", "Equal"),
+                   value=str(t.get("value", "")), effect=t.get("effect", ""))
+        for t in spec.get("tolerations") or ())
+    topology = tuple(
+        TopologySpreadConstraint(
+            max_skew=int(t.get("maxSkew", 1)),
+            topology_key=t["topologyKey"],
+            when_unsatisfiable=t.get("whenUnsatisfiable", "DoNotSchedule"))
+        for t in spec.get("topologySpreadConstraints") or ())
+    anti = (spec.get("affinity") or {}).get("podAntiAffinity") or {}
+    anti_host = anti_zone = False
+    for term in anti.get("requiredDuringSchedulingIgnoredDuringExecution") or ():
+        key = term.get("topologyKey", "")
+        anti_host |= key == wk.LABEL_HOSTNAME
+        anti_zone |= key == wk.LABEL_ZONE
+    raw = dict(requests)
+    raw.setdefault("pods", 1)
+    return PodSpec(
+        name=name or metadata.get("name", "pod"),
+        labels=tuple(sorted((str(k), str(v)) for k, v in labels.items())),
+        requests=tuple(sorted(raw.items())),
+        requirements=reqs,
+        tolerations=tolerations,
+        topology=topology,
+        anti_affinity_hostname=anti_host,
+        anti_affinity_zone=anti_zone,
+        do_not_evict=(metadata.get("annotations") or {}).get(
+            "karpenter.sh/do-not-evict", "") == "true",
+    )
+
+
+def _deployment_pods(doc, replicas_override: "Optional[int]") -> "list[PodSpec]":
+    spec = doc.get("spec", {})
+    replicas = replicas_override if replicas_override is not None \
+        else int(spec.get("replicas", 1))
+    template = spec.get("template", {})
+    metadata = template.get("metadata", {})
+    name = doc.get("metadata", {}).get("name", "workload")
+    proto = _pod(metadata, template.get("spec", {}), name=name)
+    return [dataclasses.replace(proto, name=f"{name}-{i}")
+            for i in range(replicas)]
+
+
+def _pdb(doc, all_docs) -> PodDisruptionBudget:
+    spec = doc.get("spec", {})
+    selector = {str(k): str(v) for k, v in
+                (spec.get("selector", {}).get("matchLabels") or {}).items()}
+    min_available = spec.get("minAvailable")
+    max_unavailable = spec.get("maxUnavailable")
+
+    def resolve(value):
+        if value is None:
+            return None
+        if isinstance(value, int):
+            return value
+        m = re.match(r"^(\d+)%$", str(value))
+        if not m:
+            return int(value)
+        # percentage: resolve against a matching Deployment's replicas in the
+        # same bundle (k8s resolves against the live replica count)
+        pct = int(m.group(1))
+        for d in all_docs:
+            if d.get("kind") != "Deployment":
+                continue
+            labels = (d.get("spec", {}).get("template", {})
+                      .get("metadata", {}).get("labels") or {})
+            if all(labels.get(k) == v for k, v in selector.items()):
+                replicas = int(d.get("spec", {}).get("replicas", 1))
+                return -(-pct * replicas // 100)  # ceil, k8s rounding
+        # resolving silently to 0 would fail OPEN for minAvailable (every pod
+        # evictable) or permanently CLOSED for maxUnavailable — refuse instead
+        raise ValueError(
+            f"percentage PDB {doc.get('metadata', {}).get('name')!r} needs a "
+            f"matching Deployment in the same bundle to resolve {value!r}")
+
+    return PodDisruptionBudget(
+        name=doc.get("metadata", {}).get("name", "pdb"),
+        selector=selector,
+        min_available=resolve(min_available),
+        max_unavailable=resolve(max_unavailable),
+    )

@@ -112,7 +112,10 @@ class Catalog:
         self.by_name = {t.name: t for t in self.types}
 
     def bump(self):
+        """Mutation barrier: bump the version AND rebuild derived indexes so
+        callers can't observe a stale by_name after appending types."""
         self.seqnum += 1
+        self.by_name = {t.name: t for t in self.types}
 
     def filter_compatible(self, reqs: Requirements) -> "list[InstanceType]":
         """requirements-compatible ∧ offerings-available filter

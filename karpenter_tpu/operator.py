@@ -68,6 +68,10 @@ class Operator:
         # take effect immediately and no side-registry can drift
         self.cloudprovider.template_source = (
             lambda name: self.kube.get("nodetemplates", name))
+        # PDBs flow kube -> cluster state via watch (single write path; the
+        # deprovisioner/termination read cluster.pdbs)
+        self.kube.watch(self._sync_pdbs)
+        self.cluster.pdbs = self.kube.pdbs()
         # admission webhooks at the coordination-plane boundary
         # (operator.WithWebhooks analogue, cmd/controller/main.go:58-63)
         self.webhooks = Webhooks()
@@ -87,6 +91,10 @@ class Operator:
                 self.kube, self.cluster, self.queue, self.cloudprovider.ice,
                 termination=self.termination, clock=self.clock,
                 recorder=self.recorder)
+
+    def _sync_pdbs(self, kind: str, action: str, obj) -> None:
+        if kind == "pdbs":
+            self.cluster.pdbs = self.kube.pdbs()
 
     # -- lifecycle -------------------------------------------------------------
 

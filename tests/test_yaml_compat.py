@@ -1,0 +1,220 @@
+"""Replay harness: the REFERENCE's own example manifests, loaded unchanged,
+scheduled by this framework with kernel/oracle decision parity
+(SURVEY.md §7.2 step 1; BASELINE.json configs[0] names inflate.yaml).
+
+Files under /root/reference/examples/ are read directly; nothing is copied
+or edited — the switch-over contract is that a reference user's manifests
+work as-is.
+"""
+
+import os
+
+import pytest
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.yaml_compat import load_files, load_manifests
+from karpenter_tpu.oracle.scheduler import Scheduler
+from karpenter_tpu.providers.instancetypes import generate_fleet_catalog
+from karpenter_tpu.solver.core import TPUSolver
+
+REF = "/root/reference/examples"
+ENV = {"CLUSTER_NAME": "replay"}
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference examples not mounted")
+
+
+def schedule_with_parity(loaded, catalog=None):
+    catalog = catalog or generate_fleet_catalog()
+    provs = loaded.provisioners
+    sched = Scheduler(catalog, provs)
+    oracle = sched.schedule(list(loaded.pods))
+    kernel = TPUSolver(catalog, provs).solve(list(loaded.pods))
+    assert kernel.decisions() == oracle.node_decisions(sched.options)
+    assert kernel.unschedulable_count() == len(oracle.unschedulable)
+    return kernel
+
+
+class TestProvisionerManifests:
+    def test_every_provisioner_example_parses(self):
+        files = [f for f in os.listdir(f"{REF}/provisioner") if f.endswith(".yaml")]
+        assert len(files) >= 7
+        for f in files:
+            loaded = load_files(f"{REF}/provisioner/{f}", env=ENV)
+            assert loaded.provisioners, f
+            assert loaded.templates, f
+            # providerRef wiring intact
+            assert loaded.provisioners[0].provider_ref == loaded.templates[0].name
+
+    def test_cpu_limit(self):
+        loaded = load_files(f"{REF}/provisioner/100-cpu-limit.yaml", env=ENV)
+        assert loaded.provisioners[0].limits.cpu_millis == 100_000
+
+    def test_spot(self):
+        loaded = load_files(f"{REF}/provisioner/spot.yaml", env=ENV)
+        req = loaded.provisioners[0].requirements.get(wk.LABEL_CAPACITY_TYPE)
+        assert req is not None and req.has("spot") and not req.has("on-demand")
+
+    def test_node_ttls(self):
+        loaded = load_files(f"{REF}/provisioner/node-ttls.yaml", env=ENV)
+        p = loaded.provisioners[0]
+        assert p.ttl_seconds_until_expired == 604800
+        assert p.ttl_seconds_after_empty == 60
+
+    def test_bottlerocket_family_and_block_devices(self):
+        loaded = load_files(f"{REF}/provisioner/bottlerocket.yaml", env=ENV)
+        t = loaded.templates[0]
+        assert t.image_family == "flatboat"  # Bottlerocket analogue
+        assert len(t.block_device_mappings) == 2
+        assert t.block_device_mappings[1].volume_size_gib == 20
+
+    def test_large_instances_notin(self):
+        loaded = load_files(f"{REF}/provisioner/large-instances.yaml", env=ENV)
+        req = loaded.provisioners[0].requirements.get(wk.LABEL_INSTANCE_TYPE)
+        assert req is not None and not req.has("t3.small")
+        assert req.has("m5.4xlarge")  # NotIn: anything not listed passes
+
+
+class TestWorkloadReplay:
+    def load_workload(self, name, replicas=None):
+        return load_files(
+            f"{REF}/provisioner/general-purpose.yaml",
+            f"{REF}/workloads/{name}", env=ENV, replicas_override=replicas)
+
+    def test_inflate_100(self):
+        # BASELINE configs[0]: 100 x (1 cpu, 256M), single provisioner
+        loaded = self.load_workload("inflate.yaml", replicas=100)
+        assert len(loaded.pods) == 100
+        vec = dict(loaded.pods[0].requests)
+        assert vec["cpu"] == 1000 and vec["memory"] == 256 * 10**6
+        result = schedule_with_parity(loaded)
+        assert result.unschedulable_count() == 0
+        placed = sum(n.pod_count for n in result.nodes)
+        assert placed == 100
+
+    def test_spread_zone_balanced(self):
+        loaded = self.load_workload("spread-zone.yaml", replicas=9)
+        result = schedule_with_parity(loaded)
+        per_zone = {}
+        for n in result.nodes:
+            per_zone[n.option.zone] = per_zone.get(n.option.zone, 0) + n.pod_count
+        assert result.unschedulable_count() == 0
+        assert len(per_zone) == 3
+        assert max(per_zone.values()) - min(per_zone.values()) <= 1
+
+    def test_spread_hostname_zone_caps_per_node(self):
+        loaded = self.load_workload("spread-hostname-zone.yaml", replicas=12)
+        assert loaded.pods[0].topology[0].max_skew == 2
+        result = schedule_with_parity(loaded)
+        assert result.unschedulable_count() == 0
+        assert all(n.pod_count <= 2 for n in result.nodes)  # hostname maxSkew=2
+
+    GPU_PROVISIONER = """
+apiVersion: karpenter.sh/v1alpha5
+kind: Provisioner
+metadata:
+  name: gpu
+spec:
+  requirements:
+    - key: karpenter.k8s.aws/instance-gpu-name
+      operator: Exists
+  providerRef:
+    name: default
+"""
+
+    def test_gpu_nvidia_lands_on_gpu_type(self):
+        loaded = self.load_workload("gpu-nvidia.yaml", replicas=4)
+        loaded.provisioners = load_manifests(
+            self.GPU_PROVISIONER, env=ENV).provisioners
+        vec = dict(loaded.pods[0].requests)
+        assert vec[wk.RESOURCE_NVIDIA_GPU] == 1  # limits imply requests
+        result = schedule_with_parity(loaded)
+        assert result.unschedulable_count() == 0
+        for n in result.nodes:
+            caps = dict(n.option.itype.capacity)
+            assert caps.get(wk.RESOURCE_NVIDIA_GPU, 0) >= 1
+
+    ARCH_OPEN_PROVISIONER = """
+apiVersion: karpenter.sh/v1alpha5
+kind: Provisioner
+metadata:
+  name: default
+spec:
+  requirements:
+    - key: kubernetes.io/arch
+      operator: In
+      values: [amd64, arm64]
+  providerRef:
+    name: default
+"""
+
+    def test_arm64_node_selector(self):
+        # arm64 pods need an arch-open provisioner, exactly as in the
+        # reference (v1alpha5 defaulting pins amd64 otherwise)
+        loaded = load_files(
+            f"{REF}/workloads/arm64.yaml", env=ENV, replicas_override=3)
+        loaded.provisioners = load_manifests(
+            self.ARCH_OPEN_PROVISIONER, env=ENV).provisioners
+        result = schedule_with_parity(loaded)
+        assert result.unschedulable_count() == 0
+        assert all(dict(n.option.itype.labels)[wk.LABEL_ARCH] == "arm64"
+                   for n in result.nodes)
+
+    def test_spot_workload_tolerates_spot_provisioner(self):
+        loaded = load_files(
+            f"{REF}/provisioner/spot.yaml",
+            f"{REF}/workloads/spot.yaml", env=ENV, replicas_override=5)
+        result = schedule_with_parity(loaded)
+        assert result.unschedulable_count() == 0
+        assert all(n.option.capacity_type == "spot" for n in result.nodes)
+
+    def test_disruption_budget_pdb_resolves_percentage(self):
+        loaded = load_files(f"{REF}/workloads/disruption-budget.yaml", env=ENV)
+        (pdb,) = loaded.pdbs
+        # minAvailable 80% of 10 replicas -> 8
+        assert pdb.min_available == 8
+        assert len(loaded.pods) == 10
+
+    def test_prefer_arm_soft_affinity_ignored(self):
+        loaded = self.load_workload("prefer-arm.yaml", replicas=2)
+        # preferred affinities are soft: pods parse with no hard arch req
+        assert loaded.pods[0].requirements.get(wk.LABEL_ARCH) is None
+        result = schedule_with_parity(loaded)
+        assert result.unschedulable_count() == 0
+
+
+class TestEndToEndManifestApply:
+    def test_manifests_drive_the_controller_plane(self):
+        """The loaded objects run through the real operator (apply -f flow)."""
+        from karpenter_tpu.apis.settings import Settings
+        from karpenter_tpu.fake.cloud import FakeCloud
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.utils.clock import FakeClock
+
+        loaded = load_files(
+            f"{REF}/provisioner/general-purpose.yaml",
+            f"{REF}/workloads/inflate.yaml", env=ENV, replicas_override=20)
+        catalog = generate_fleet_catalog()
+        clock = FakeClock()
+        cloud = FakeCloud(catalog=catalog, clock=clock)
+        # the reference discovers subnets by cluster tag; tag the fakes
+        for s in cloud.subnets:
+            s.tags["karpenter.sh/discovery"] = "replay"
+        for g in cloud.security_groups:
+            g.tags["karpenter.sh/discovery"] = "replay"
+        settings = Settings(cluster_name="replay",
+                            cluster_endpoint="https://replay",
+                            batch_idle_duration=0.0, batch_max_duration=0.0)
+        op = Operator(cloud, settings, catalog, clock=clock)
+        try:
+            for t in loaded.templates:
+                op.kube.create("nodetemplates", t.name, t)
+            for p in loaded.provisioners:
+                op.kube.create("provisioners", p.name, p)
+            for pod in loaded.pods:
+                op.kube.create("pods", pod.name, pod)
+            op.provisioning.reconcile_once()
+            assert not op.kube.pending_pods()
+            assert op.cluster.nodes
+        finally:
+            op.stop()
