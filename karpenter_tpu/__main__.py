@@ -43,6 +43,14 @@ def _wait_for_signal() -> None:
 def cmd_solver_serve(args) -> int:
     from .solver.service import serve
 
+    if args.distributed:
+        from .parallel.multihost import initialize_distributed, mesh_description, make_hybrid_mesh
+
+        multi = initialize_distributed(args.coordinator, args.num_processes,
+                                       args.process_id)
+        print(f"distributed: {mesh_description(make_hybrid_mesh())}"
+              if multi else "distributed requested but single-process",
+              flush=True)
     server, port, _service = serve(f"{args.host}:{args.port}",
                                    max_workers=args.workers)
     print(f"solver service listening on {args.host}:{port}", flush=True)
@@ -130,6 +138,12 @@ def main(argv=None) -> int:
     p_serve.add_argument("--host", default="127.0.0.1")
     p_serve.add_argument("--port", type=int, default=50151)
     p_serve.add_argument("--workers", type=int, default=4)
+    p_serve.add_argument("--distributed", action="store_true",
+                         help="join a multi-host mesh via jax.distributed")
+    p_serve.add_argument("--coordinator", default=None,
+                         help="coordinator address host:port (defaults from env)")
+    p_serve.add_argument("--num-processes", type=int, default=None)
+    p_serve.add_argument("--process-id", type=int, default=None)
     p_serve.set_defaults(fn=cmd_solver_serve)
 
     p_ctrl = sub.add_parser("controller", help="run the controller plane")

@@ -88,3 +88,33 @@ def _assert_parity(enc, n_devices):
             err_msg=f"sharded mismatch on {name} @ {n_devices} devices")
     np.testing.assert_array_equal(np.asarray(base.active), np.asarray(sh.active))
     np.testing.assert_array_equal(np.asarray(base.used), np.asarray(sh.used))
+
+
+class TestMultihost:
+    """Single-process coverage of the multi-host module (true multi-process
+    runs need a pod; the driver's dryrun + these keep the path compiling)."""
+
+    def test_hybrid_mesh_falls_back_single_process(self):
+        from karpenter_tpu.parallel.multihost import (initialize_distributed,
+                                                      make_hybrid_mesh,
+                                                      mesh_description)
+
+        assert initialize_distributed() is False  # one process in tests
+        mesh = make_hybrid_mesh()
+        assert mesh.axis_names == ("nodes", "types")
+        desc = mesh_description(mesh)
+        assert desc["n_devices"] == 8
+        assert desc["n_processes"] == 1
+        assert desc["types_axis_crosses_hosts"] is False
+
+    def test_sharded_pack_on_hybrid_mesh(self):
+        from karpenter_tpu.parallel.multihost import make_hybrid_mesh
+
+        enc = build_inputs()
+        inputs, n_slots = pad_inputs(enc)
+        base = jax.device_get(pack(jax.device_put(inputs), n_slots=n_slots))
+        sh = sharded_pack(inputs, n_slots, make_hybrid_mesh())
+        np.testing.assert_array_equal(np.asarray(base.assign),
+                                      np.asarray(sh.assign))
+        np.testing.assert_array_equal(np.asarray(base.decided),
+                                      np.asarray(sh.decided))
