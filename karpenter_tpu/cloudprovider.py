@@ -58,7 +58,7 @@ class CloudProvider:
         self.launch_templates = LaunchTemplateProvider(
             cloud, self.images, settings, clock=clock)
         self.instance_types = InstanceTypeProvider(
-            source_catalog, self.ice, self.subnets)
+            source_catalog, self.ice, self.subnets, settings=settings)
         self.instances = InstanceProvider(
             cloud, settings, self.launch_templates, self.subnets, self.ice)
         self.nodetemplates: "dict[str, NodeTemplate]" = {}

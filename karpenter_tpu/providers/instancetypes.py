@@ -159,21 +159,28 @@ class InstanceTypeProvider:
     """
 
     def __init__(self, source_catalog: Catalog, unavailable_offerings,
-                 subnet_provider=None):
+                 subnet_provider=None, settings=None):
         import threading
 
         self.source = source_catalog
         self.ice = unavailable_offerings
         self.subnets = subnet_provider
+        self.settings = settings
         self._memo: "dict[tuple, Catalog]" = {}
         self._version = 0  # monotone seqnum for derived catalogs
         self._lock = threading.Lock()
+
+    def _density_limited(self) -> bool:
+        """enableENILimitedPodDensity (settings.go): when disabled, every
+        type reports the default max-pods instead of its network-limited
+        density. Live-watchable, so it is part of the memo key."""
+        return self.settings is None or self.settings.enable_eni_limited_pod_density
 
     def list(self, nodetemplate=None) -> Catalog:
         zones = None
         if nodetemplate is not None and self.subnets is not None and nodetemplate.subnet_selector:
             zones = tuple(self.subnets.zones(nodetemplate.subnet_selector))
-        key = (self.source.seqnum, self.ice.seqnum, zones)
+        key = (self.source.seqnum, self.ice.seqnum, zones, self._density_limited())
         with self._lock:
             hit = self._memo.get(key)
             if hit is not None:
@@ -183,6 +190,16 @@ class InstanceTypeProvider:
             for k in [k for k in self._memo if k[:2] != key[:2]]:
                 del self._memo[k]
             types = self.ice.apply(self.source.types)
+            if not self._density_limited():
+                import dataclasses as _dc
+
+                DEFAULT_MAX_PODS = 110
+                types = [
+                    _dc.replace(t, capacity=tuple(
+                        (k, DEFAULT_MAX_PODS if k == wk.RESOURCE_PODS else v)
+                        for k, v in t.capacity))
+                    for t in types
+                ]
             if zones is not None:
                 import dataclasses as _dc
 

@@ -243,3 +243,28 @@ def test_concurrent_creates_merge_into_one_fleet_call(cp):
     names = {cp.cloud.instances[parse_provider_id(m.status.provider_id)[1]]
              .tags["karpenter.sh/machine"] for m in results}
     assert names == {f"mc-{i}" for i in range(8)}
+
+
+class TestPodDensitySetting:
+    def test_eni_limited_density_toggle(self):
+        from karpenter_tpu.apis.settings import Settings
+        from karpenter_tpu.cache import UnavailableOfferings
+        from karpenter_tpu.providers.instancetypes import (
+            InstanceTypeProvider, generate_fleet_catalog)
+
+        catalog = generate_fleet_catalog(max_types=30)
+        settings = Settings(cluster_name="t", cluster_endpoint="https://t")
+        provider = InstanceTypeProvider(catalog, UnavailableOfferings(),
+                                        settings=settings)
+        small = next(t for t in provider.list().types
+                     if dict(t.capacity)[wk.RESOURCE_CPU] <= 2000)
+        assert dict(small.capacity)[wk.RESOURCE_PODS] < 110  # network-limited
+        # live settings flip (the ConfigMap watch path) takes effect
+        settings.enable_eni_limited_pod_density = False
+        flat = provider.list()
+        assert all(dict(t.capacity)[wk.RESOURCE_PODS] == 110
+                   for t in flat.types)
+        settings.enable_eni_limited_pod_density = True
+        again = provider.list()
+        assert dict(again.by_name[small.name].capacity)[wk.RESOURCE_PODS] == \
+            dict(small.capacity)[wk.RESOURCE_PODS]
