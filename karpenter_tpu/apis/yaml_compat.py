@@ -225,7 +225,18 @@ def _pod(metadata, spec, name: str = "", labels=None) -> PodSpec:
             reqs.add(Requirement.create(
                 _map_key(expr["key"]), expr["operator"],
                 [str(v) for v in expr.get("values", [])]))
-    # preferredDuringScheduling: soft, deliberately ignored (module docstring)
+    # preferredDuringScheduling: the HIGHEST-weight term becomes the pod's
+    # soft preference set (one-round relaxation in the scheduler); k8s's
+    # full per-term weighted scoring is approximated by that single term
+    prefs = Requirements()
+    preferred = sorted(
+        affinity.get("preferredDuringSchedulingIgnoredDuringExecution") or (),
+        key=lambda t: -int(t.get("weight", 0)))
+    if preferred:
+        for expr in (preferred[0].get("preference") or {}).get("matchExpressions") or ():
+            prefs.add(Requirement.create(
+                _map_key(expr["key"]), expr["operator"],
+                [str(v) for v in expr.get("values", [])]))
     tolerations = tuple(
         Toleration(key=t.get("key", ""), operator=t.get("operator", "Equal"),
                    value=str(t.get("value", "")), effect=t.get("effect", ""))
@@ -249,6 +260,7 @@ def _pod(metadata, spec, name: str = "", labels=None) -> PodSpec:
         labels=tuple(sorted((str(k), str(v)) for k, v in labels.items())),
         requests=tuple(sorted(raw.items())),
         requirements=reqs,
+        preferences=prefs,
         tolerations=tolerations,
         topology=topology,
         anti_affinity_hostname=anti_host,

@@ -391,6 +391,20 @@ def encode_group(
         mask = fold_option_mask(reqs, cols, prov).reshape(T, S) & fits_t[:, None]
         if extra_mask is not None:
             mask = mask & extra_mask
+        if mask.any() and len(group.spec.preferences):
+            # soft preferences, one relaxation round — mirrors the oracle's
+            # feasible_options exactly (PodSpec.preferences docstring)
+            try:
+                pref_reqs = reqs.union(group.spec.preferences)
+            except IncompatibleError:
+                pref_reqs = None
+            if pref_reqs is not None:
+                pref_mask = (fold_option_mask(pref_reqs, cols, prov)
+                             .reshape(T, S) & fits_t[:, None])
+                if extra_mask is not None:
+                    pref_mask = pref_mask & extra_mask
+                if pref_mask.any():
+                    mask = pref_mask
         if mask.any():
             feas[pi] = mask
             if newprov < 0:

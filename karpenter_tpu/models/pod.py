@@ -78,6 +78,11 @@ class PodSpec:
     labels: "tuple[tuple[str, str], ...]" = ()  # pod labels (PDB/service selectors)
     requests: "tuple[tuple[str, int], ...]" = ()  # canonical units (cpu millis, mem bytes, counts)
     requirements: Requirements = dataclasses.field(default_factory=Requirements)
+    # soft preferences (preferredDuringScheduling): honored for NEW-capacity
+    # option selection when any option satisfies them, dropped otherwise —
+    # the reference core's preference-relaxation, reduced to one round.
+    # Existing-node placement ignores them (first-fit order is not rescored).
+    preferences: Requirements = dataclasses.field(default_factory=Requirements)
     tolerations: "tuple[Toleration, ...]" = ()
     topology: "tuple[TopologySpreadConstraint, ...]" = ()
     anti_affinity_hostname: bool = False  # self anti-affinity on kubernetes.io/hostname
@@ -104,6 +109,7 @@ class PodSpec:
         k = (
             self.requests,
             self.requirements.canonical(),  # freezes: later in-place mutation raises
+            self.preferences.canonical(),
             self.tolerations,
             self.topology,
             self.anti_affinity_hostname,

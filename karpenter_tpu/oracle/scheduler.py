@@ -158,15 +158,28 @@ def feasible_options(
         return set()
     vec = group.resource_vector()
     kovh = kubelet_overhead_vector(prov.kubelet)
-    out: "set[int]" = set()
-    for opt in options:
-        if not reqs.matches_labels(option_labels(opt, prov)):
-            continue
-        alloc = effective_alloc(opt, prov)
-        if all(d + k + v <= a
-               for d, k, v, a in zip(daemon_overhead, kovh, vec, alloc)):
-            out.add(opt.index)
-    return out
+
+    def feasible(r: Requirements) -> "set[int]":
+        out: "set[int]" = set()
+        for opt in options:
+            if not r.matches_labels(option_labels(opt, prov)):
+                continue
+            alloc = effective_alloc(opt, prov)
+            if all(d + k + v <= a
+                   for d, k, v, a in zip(daemon_overhead, kovh, vec, alloc)):
+                out.add(opt.index)
+        return out
+
+    base = feasible(reqs)
+    # soft preferences: one relaxation round (PodSpec.preferences docstring)
+    if base and len(group.preferences):
+        try:
+            preferred = feasible(reqs.union(group.preferences))
+        except IncompatibleError:
+            preferred = set()
+        if preferred:
+            return preferred
+    return base
 
 
 @dataclasses.dataclass
