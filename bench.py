@@ -8,26 +8,76 @@ Prints ONE JSON line:
 vs_baseline > 1.0 means faster than the 100 ms north-star budget.
 Measures END-TO-END solve: host encode (mask folding) + device pack kernel +
 decode — the full scheduling cycle the controller would pay per batch window.
+
+Robustness (round-2 hardening): the env's tunneled TPU ("axon" platform) is
+flaky — backend init can hang indefinitely, and sitecustomize pre-imports jax
+so env vars alone can't redirect it. We therefore
+  1. probe the TPU backend in a SUBPROCESS with a hard timeout (a hang in
+     PJRT init — even at interpreter startup — only costs the probe);
+  2. retry the probe with backoff, then pin this process to whichever
+     platform survived via jax.config.update *before* any device touch;
+  3. run a watchdog that emits a parseable JSON line (degraded or error)
+     and exits if a device call wedges mid-benchmark.
 """
 
 import json
+import os
 import statistics
 import sys
+import threading
 import time
 
 sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
-from karpenter_tpu.apis import wellknown as wk
-from karpenter_tpu.apis.provisioner import Provisioner
-from karpenter_tpu.models.pod import TopologySpreadConstraint, make_pod
-from karpenter_tpu.models.requirements import Requirements, OP_IN
-from karpenter_tpu.providers.instancetypes import generate_fleet_catalog
-from karpenter_tpu.solver.core import TPUSolver
+from karpenter_tpu.utils.jaxenv import pin, probe_tpu
+
+WATCHDOG_BUDGET_S = int(os.environ.get("KARPENTER_TPU_BENCH_BUDGET_S", "900"))
+
+_state = {"times": [], "detail": {}, "emitted": False, "lock": threading.Lock()}
+
+
+def _emit(value, vs, detail, exit_code=None, degraded=False):
+    with _state["lock"]:
+        if _state["emitted"]:
+            return
+        _state["emitted"] = True
+    record = {
+        "metric": "scheduling_cycle_p50_ms_10k_pods_600_types",
+        "value": value,
+        "unit": "ms",
+        "vs_baseline": vs,
+        "detail": detail,
+    }
+    if degraded:
+        record["degraded"] = True  # partial reps only — do not trust as headline
+    print(json.dumps(record), flush=True)
+    if exit_code is not None:
+        os._exit(exit_code)
+
+
+def _watchdog():
+    """If the benchmark wedges (tunnel stall mid-solve), emit what we have.
+    Started AFTER the probe so probe attempts/backoff don't eat the budget."""
+    time.sleep(WATCHDOG_BUDGET_S)
+    times = list(_state["times"])
+    detail = dict(_state["detail"])
+    detail["watchdog"] = f"budget {WATCHDOG_BUDGET_S}s exceeded"
+    if times:
+        p50 = statistics.median(times)
+        detail["reps_completed"] = len(times)
+        _emit(round(p50, 3), round(100.0 / p50, 3), detail, exit_code=0,
+              degraded=True)
+    else:
+        detail["error"] = "no completed reps before watchdog budget"
+        _emit(None, None, detail, exit_code=1)
 
 
 def workload_10k():
     """BASELINE.json configs[1]-style: mixed cpu/mem pods, zone selectors,
     topology spread, across 8 deployments -> 10k pods."""
+    from karpenter_tpu.apis import wellknown as wk
+    from karpenter_tpu.models.pod import TopologySpreadConstraint, make_pod
+
     pods = []
     spread = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),)
     deployments = [
@@ -49,6 +99,39 @@ def workload_10k():
 
 
 def main():
+    forced = os.environ.get("KARPENTER_TPU_BENCH_PLATFORM")
+    if forced:  # operator knows the tunnel state; skip the ~minutes-long probe
+        tpu_ok, note = forced == "axon", f"forced via KARPENTER_TPU_BENCH_PLATFORM={forced}"
+    else:
+        tpu_ok, note = probe_tpu()
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    platform = "axon" if tpu_ok else "cpu"
+    jax, warning = pin(platform)
+    if warning:
+        _state["detail"]["platform_pin_warning"] = warning
+
+    _state["detail"]["probe"] = note
+    _state["detail"]["requested_backend"] = platform
+    # A probe-failure CPU fallback is NOT a TPU number — flag it so the
+    # recorded artifact can't masquerade as the round's chip result.
+    fallback_degraded = not tpu_ok and forced != "cpu"
+
+    try:
+        backend = jax.devices()[0].platform
+    except Exception as e:
+        _emit(None, None,
+              {**_state["detail"], "error": f"device init failed after probe: {e}"},
+              exit_code=1)
+        return
+    _state["detail"]["backend"] = backend
+
+    from karpenter_tpu.apis import wellknown as wk
+    from karpenter_tpu.apis.provisioner import Provisioner
+    from karpenter_tpu.models.requirements import OP_IN, Requirements
+    from karpenter_tpu.providers.instancetypes import generate_fleet_catalog
+    from karpenter_tpu.solver.core import TPUSolver
+
     catalog = generate_fleet_catalog()
     prov = Provisioner(name="default", requirements=Requirements.of(
         (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"]),
@@ -64,29 +147,23 @@ def main():
     assert placed + res.unschedulable_count() == len(pods), (placed, res.unschedulable_count())
 
     solver.solve(pods)  # second warmup: settle tunnel/device caches
-    times = []
     for _ in range(20):
         t0 = time.perf_counter()
         res = solver.solve(pods)
-        times.append((time.perf_counter() - t0) * 1000)
+        _state["times"].append((time.perf_counter() - t0) * 1000)
+    times = _state["times"]
     p50 = statistics.median(times)
 
-    import jax
-    print(json.dumps({
-        "metric": "scheduling_cycle_p50_ms_10k_pods_600_types",
-        "value": round(p50, 3),
-        "unit": "ms",
-        "vs_baseline": round(100.0 / p50, 3),
-        "detail": {
-            "n_types": len(catalog.types),
-            "n_pods": len(pods),
-            "nodes_provisioned": len(res.nodes),
-            "unschedulable": res.unschedulable_count(),
-            "p_min_ms": round(min(times), 3),
-            "p_max_ms": round(max(times), 3),
-            "backend": jax.devices()[0].platform,
-        },
-    }))
+    _state["detail"].update({
+        "n_types": len(catalog.types),
+        "n_pods": len(pods),
+        "nodes_provisioned": len(res.nodes),
+        "unschedulable": res.unschedulable_count(),
+        "p_min_ms": round(min(times), 3),
+        "p_max_ms": round(max(times), 3),
+    })
+    _emit(round(p50, 3), round(100.0 / p50, 3), _state["detail"],
+          degraded=fallback_degraded)
 
 
 if __name__ == "__main__":

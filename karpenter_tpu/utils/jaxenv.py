@@ -1,0 +1,71 @@
+"""Single home for the "pin the jax platform before any backend touch" dance.
+
+The deployment env's sitecustomize registers a tunneled TPU ("axon") PJRT
+backend in every interpreter and sets JAX_PLATFORMS=axon, so a bare
+``jax.devices()`` can hang indefinitely when the loopback relay wedges.
+Three surfaces need the same defense (tests/conftest.py, __graft_entry__.py,
+bench.py); this module is the one copy they share so fallback semantics
+can't drift.
+
+Reference analogue: none — this is deployment-env hardening, the moral
+equivalent of the reference's operator env bootstrapping
+(cmd/controller/main.go:33-65 reading env/flags before client init).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+
+def pin(platform: str, n_devices=None):
+    """Pin the jax platform BEFORE any backend touch. Safe to call when
+    backends are already initialized (the config updates are then no-ops and
+    the caller relies on the driver's own env pin). Returns (jax, warning) —
+    warning is None or the swallowed-config-error text."""
+    os.environ["JAX_PLATFORMS"] = platform
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}").strip()
+    import jax
+
+    warning = None
+    updates = [("jax_platforms", platform)]
+    if n_devices is not None:
+        updates.append(("jax_num_cpu_devices", n_devices))
+    for key, val in updates:
+        try:
+            jax.config.update(key, val)
+        except (RuntimeError, ValueError) as e:
+            warning = str(e)[:160]  # backends already initialized; env pin must suffice
+    return jax, warning
+
+
+def pin_cpu(n_devices: int = 8):
+    """Force the CPU platform with >= n_devices virtual devices. Returns jax."""
+    return pin("cpu", n_devices)[0]
+
+
+def probe_tpu(attempts: int = 3, timeout_s: int = 60, backoff_s: int = 10):
+    """Init the axon backend in a throwaway subprocess with a hard timeout
+    (a PJRT-init hang — even at interpreter startup — only costs the probe).
+    Returns (ok, note); note carries the per-attempt failure trail."""
+    env = dict(os.environ, JAX_PLATFORMS="axon")
+    code = ("import jax; jax.config.update('jax_platforms','axon'); "
+            "d=jax.devices(); print('PROBE_OK', d[0].platform, len(d))")
+    notes = []
+    for attempt in range(1, attempts + 1):
+        try:
+            r = subprocess.run([sys.executable, "-c", code], env=env,
+                               capture_output=True, text=True, timeout=timeout_s)
+            if r.returncode == 0 and "PROBE_OK" in r.stdout:
+                return True, f"probe ok on attempt {attempt}"
+            notes.append(f"attempt {attempt}: rc={r.returncode} "
+                         f"{(r.stderr or r.stdout).strip()[-160:]}")
+        except subprocess.TimeoutExpired:
+            notes.append(f"attempt {attempt}: timeout {timeout_s}s")
+        if attempt < attempts:
+            time.sleep(backoff_s * attempt)
+    return False, "; ".join(notes)

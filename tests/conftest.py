@@ -3,16 +3,11 @@ sharding paths (mesh/pjit/shard_map) are exercised without TPU hardware —
 the analogue of the reference's envtest-backed hermetic tier (SURVEY.md §4).
 
 The environment's sitecustomize pre-imports jax with the axon TPU platform,
-so env vars alone are too late — we must also flip jax_platforms via config.
+so env vars alone are too late — the platform must also be pinned via
+jax.config. The pin logic is single-sourced in karpenter_tpu/utils/jaxenv.py
+(shared with bench.py and __graft_entry__.py).
 """
 
-import os
+from karpenter_tpu.utils.jaxenv import pin_cpu
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+pin_cpu(8)
