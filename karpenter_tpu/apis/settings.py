@@ -13,11 +13,17 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import threading
 from typing import Optional
 
 
 class SettingsError(ValueError):
     pass
+
+
+# module-level: Settings is a dataclass with mutable default-containing
+# instances; a per-instance lock would complicate dataclasses.replace
+_apply_lock = threading.Lock()
 
 
 @dataclasses.dataclass
@@ -60,14 +66,28 @@ class Settings:
         """In-place update from a freshly parsed Settings; every component
         holding this object by reference observes the change (the reference's
         live-watched ConfigMap injection, settings.go Inject). Returns the
-        names of changed fields."""
+        names of changed fields.
+
+        Controller threads read fields concurrently; single-field reads are
+        atomic under the GIL, and multi-field readers that need a mutually
+        consistent view take snapshot(). The lock makes apply+snapshot
+        linearize so no snapshot observes a half-applied update."""
         changed = []
-        for f in dataclasses.fields(Settings):
-            new = getattr(other, f.name)
-            if getattr(self, f.name) != new:
-                setattr(self, f.name, new)
-                changed.append(f.name)
+        with _apply_lock:
+            for f in dataclasses.fields(Settings):
+                new = getattr(other, f.name)
+                if getattr(self, f.name) != new:
+                    setattr(self, f.name, new)
+                    changed.append(f.name)
         return changed
+
+    def snapshot(self) -> "Settings":
+        """Consistent point-in-time copy for multi-field readers (e.g. the
+        batcher reading both batch windows together)."""
+        with _apply_lock:
+            return dataclasses.replace(
+                self, tags=dict(self.tags),
+                feature_gates=dataclasses.replace(self.feature_gates))
 
     @staticmethod
     def from_dict(data: "dict[str, str]") -> "Settings":

@@ -193,28 +193,41 @@ def _nodetemplate(doc) -> NodeTemplate:
 
 # -- workloads ---------------------------------------------------------------------
 
-def _pod_requests(containers) -> "dict[str, int]":
-    """Sum container requests; extended resources follow the k8s rule that
-    requests default to limits when only limits are set."""
+def _container_requests(c) -> "dict[str, int]":
+    resources = c.get("resources") or {}
+    limits = resources.get("limits") or {}
+    requests = dict(limits)  # limits imply requests (k8s defaulting rule)
+    requests.update(resources.get("requests") or {})
+    out: "dict[str, int]" = {}
+    for name, qty in requests.items():
+        if name == "cpu":
+            out["cpu"] = cpu_millis(str(qty))
+        elif name in ("memory", "ephemeral-storage"):
+            out[name] = mem_bytes(str(qty))
+        else:
+            out[name] = count_qty(qty)
+    return out
+
+
+def _pod_requests(containers, init_containers=()) -> "dict[str, int]":
+    """k8s effective pod requests: max(sum(containers), max(initContainers))
+    per resource — init containers run serially before the main set, so the
+    node must fit whichever phase is larger (the rule the reference inherits
+    from scheduler resource accounting)."""
     total: "dict[str, int]" = {}
     for c in containers or ():
-        resources = c.get("resources") or {}
-        limits = resources.get("limits") or {}
-        requests = dict(limits)  # limits imply requests
-        requests.update(resources.get("requests") or {})
-        for name, qty in requests.items():
-            if name == "cpu":
-                total["cpu"] = total.get("cpu", 0) + cpu_millis(str(qty))
-            elif name in ("memory", "ephemeral-storage"):
-                total[name] = total.get(name, 0) + mem_bytes(str(qty))
-            else:
-                total[name] = total.get(name, 0) + count_qty(qty)
+        for name, v in _container_requests(c).items():
+            total[name] = total.get(name, 0) + v
+    for c in init_containers or ():
+        for name, v in _container_requests(c).items():
+            if v > total.get(name, 0):
+                total[name] = v
     return total
 
 
 def _pod(metadata, spec, name: str = "", labels=None) -> PodSpec:
     labels = labels if labels is not None else (metadata.get("labels") or {})
-    requests = _pod_requests(spec.get("containers"))
+    requests = _pod_requests(spec.get("containers"), spec.get("initContainers"))
     reqs = Requirements()
     for k, v in (spec.get("nodeSelector") or {}).items():
         reqs.add(Requirement.create(_map_key(k), OP_IN, [str(v)]))

@@ -180,19 +180,22 @@ class DeprovisioningController:
             self.recorder.normal(f"node/{action.node}", "ConsolidationReplace",
                                  f"replacing with {action.replacement[0]}")
         # all-or-nothing: a multi-node action executed partially would drain
-        # one node while claiming the combined savings
-        requested = []
+        # one node while claiming the combined savings. Roll back only marks
+        # THIS action created — a member already marked by a concurrent path
+        # (emptiness/interruption) keeps its pending deletion.
+        newly_marked = []
         for n in action.nodes:
-            if self.termination.request_deletion(n):
-                requested.append(n)
-            else:
-                for done in requested:  # roll back the members already marked
+            status = self.termination.request_deletion(n)
+            if not status:
+                for done in newly_marked:
                     node = self.cluster.nodes.get(done)
                     if node is not None:
                         node.marked_for_deletion = False
                         node.deletion_requested_ts = 0.0
                 log.warning("consolidation aborted: %s not deletable", n)
                 return None
+            if status == self.termination.MARKED_NEW:
+                newly_marked.append(n)
         suffix = "-multi" if len(action.nodes) > 1 else ""
         self.actions.inc(action=f"consolidation-{action.kind}{suffix}")
         self.recorder.normal(

@@ -90,8 +90,9 @@ class ProvisioningController:
             if first is None:
                 self.clock.sleep(0.05)
                 continue
-            if (now - last_new >= self.settings.batch_idle_duration
-                    or now - first >= self.settings.batch_max_duration):
+            windows = self.settings.snapshot()  # idle+max read consistently
+            if (now - last_new >= windows.batch_idle_duration
+                    or now - first >= windows.batch_max_duration):
                 return pods
             self.clock.sleep(0.05)
 

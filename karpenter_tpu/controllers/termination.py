@@ -39,16 +39,27 @@ class TerminationController:
             f"{NAMESPACE}_nodes_termination_time_seconds",
             "Time from deletion request to cloud delete.")
 
-    def request_deletion(self, node_name: str) -> bool:
-        """Mark a node for deletion (the finalizer-bearing delete)."""
+    MARKED_NEW = "marked"
+    MARKED_ALREADY = "already-marked"
+
+    def request_deletion(self, node_name: str) -> str:
+        """Mark a node for deletion (the finalizer-bearing delete).
+
+        Returns MARKED_NEW if this call created the mark, MARKED_ALREADY if a
+        concurrent path (emptiness/expiration/interruption) got there first,
+        or "" (falsy) if the node doesn't exist. The distinction lets a
+        multi-node rollback undo only the marks it created instead of
+        cancelling an unrelated pending deletion."""
         node = self.cluster.nodes.get(node_name)
         if node is None:
-            return False
+            return ""
+        if node.marked_for_deletion:
+            return self.MARKED_ALREADY
         node.marked_for_deletion = True
         node.deletion_requested_ts = self.clock.now()
         self.recorder.normal(f"node/{node_name}", "TerminationRequested",
                              "node marked for deletion")
-        return True
+        return self.MARKED_NEW
 
     def reconcile_once(self) -> "list[str]":
         """Process all marked nodes; returns names fully terminated."""

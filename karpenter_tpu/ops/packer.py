@@ -48,6 +48,24 @@ _PODS_I = wk.RESOURCE_INDEX[wk.RESOURCE_PODS]
 
 INT_BIG = jnp.int32(2**30)
 
+# f32 one-correction division in the Pallas quotient kernel is bit-exact only
+# below 2**24; encode clamps values at INT_BIG (2**30), so a catalog with a
+# huge extended-resource count could breach the parity contract. Inputs are
+# checked host-side (pallas_value_safe) and oversized problems take the XLA
+# path via the use_pallas static arg.
+F24 = 2**24
+
+
+def pallas_value_safe(*arrays) -> bool:
+    """True when every host-side input magnitude stays below 2**24 (`used`
+    never exceeds alloc elementwise — the waterfall only places what fits —
+    so checking alloc/vec/overhead bounds every value the kernel sees)."""
+    import numpy as np
+
+    return all(
+        int(np.abs(np.asarray(a)).max(initial=0)) < F24
+        for a in arrays if a is not None)
+
 
 class PackInputs(NamedTuple):
     # catalog (device-resident)
@@ -122,7 +140,8 @@ def _pods_cap_quotient(cap_avail: jax.Array, vec_pods: jax.Array) -> jax.Array:
     return jnp.clip(q, -1, INT_BIG)
 
 
-def _step(inputs: PackInputs, state: PackState, g: jax.Array):
+def _step(inputs: PackInputs, state: PackState, g: jax.Array,
+          use_pallas: bool = False):
     vec = inputs.group_vec[g]          # [R]
     cap = inputs.group_cap[g]          # []
     count = inputs.group_count[g]      # []
@@ -138,9 +157,7 @@ def _step(inputs: PackInputs, state: PackState, g: jax.Array):
     # ---- 2) open claims, first-fit in creation order -------------------------
     feas_n = inputs.group_feas[g][jnp.clip(state.nprov, 0, None)]  # [N, T, S]
     nodefeas = state.optmask & feas_n & state.active[:, None, None]
-    if pallas_kernels.enabled():
-        # fused Pallas path (flag read at trace time; set the env var before
-        # the first solve — see ops/pallas_kernels.py)
+    if use_pallas:
         q_nt = pallas_kernels.quotient_nt_auto(inputs.alloc_t, state.used, vec)
     else:
         q_nt = _quotient(inputs.alloc_t[None, :, :] - state.used[:, None, :], vec)  # [N, T]
@@ -197,7 +214,13 @@ def _step(inputs: PackInputs, state: PackState, g: jax.Array):
     return new_state, (m_n + cnt, m_ex, unsched)
 
 
-def pack_impl(inputs: PackInputs, n_slots: int) -> PackResult:
+def pack_impl(inputs: PackInputs, n_slots: int,
+              use_pallas: "bool | None" = None) -> PackResult:
+    # use_pallas is a STATIC choice: None defers to the env flag (read at
+    # trace time, as before); run_pack passes an explicit bool that also
+    # folds in the pallas_value_safe() 2**24 exactness check.
+    if use_pallas is None:
+        use_pallas = pallas_kernels.enabled()
     G = inputs.group_vec.shape[0]
     T, S = inputs.tiebreak.shape
     R = inputs.group_vec.shape[1]
@@ -212,7 +235,7 @@ def pack_impl(inputs: PackInputs, n_slots: int) -> PackResult:
     )
 
     def body(state, g):
-        return _step(inputs, state, g)
+        return _step(inputs, state, g, use_pallas=use_pallas)
 
     final, (assign, ex_assign, unsched) = jax.lax.scan(
         body, init, jnp.arange(G, dtype=jnp.int32)
@@ -232,10 +255,12 @@ def pack_impl(inputs: PackInputs, n_slots: int) -> PackResult:
     )
 
 
-pack = functools.partial(jax.jit, static_argnames=("n_slots",))(pack_impl)
+pack = functools.partial(
+    jax.jit, static_argnames=("n_slots", "use_pallas"))(pack_impl)
 
 
-def pack_flat_impl(inputs: PackInputs, n_slots: int) -> jax.Array:
+def pack_flat_impl(inputs: PackInputs, n_slots: int,
+                   use_pallas: "bool | None" = None) -> jax.Array:
     """pack_impl with everything the decoder needs flattened into ONE i32
     vector, so the host pays exactly one device->host transfer per solve.
     On a tunneled/remote device each sync is a full network round trip
@@ -245,7 +270,7 @@ def pack_flat_impl(inputs: PackInputs, n_slots: int) -> jax.Array:
     Layout: [assign (G*N) | ex_assign (G*Ne) | unsched (G) | active (N) |
              nprov (N) | decided (N) | n_open (1)]
     """
-    r = pack_impl(inputs, n_slots)
+    r = pack_impl(inputs, n_slots, use_pallas=use_pallas)
     return jnp.concatenate([
         r.assign.ravel(), r.ex_assign.ravel(), r.unsched.ravel(),
         r.active.astype(jnp.int32), r.nprov, r.decided,
@@ -253,7 +278,8 @@ def pack_flat_impl(inputs: PackInputs, n_slots: int) -> jax.Array:
     ])
 
 
-pack_flat = functools.partial(jax.jit, static_argnames=("n_slots",))(pack_flat_impl)
+pack_flat = functools.partial(
+    jax.jit, static_argnames=("n_slots", "use_pallas"))(pack_flat_impl)
 
 
 def unflatten_result(flat, G: int, N: int, Ne: int) -> PackResult:

@@ -2,10 +2,13 @@
 
 Drop-in replacement for TPUSolver (same .solve signature), pluggable into
 ProvisioningController via solver_factory. Sync-on-demand: a Solve rejected
-with FAILED_PRECONDITION (stale catalog seqnum / provisioner hash) triggers
-one catalog Sync + retry — the wire analogue of the reference's
+with FAILED_PRECONDITION (stale catalog content hash / provisioner hash)
+triggers one catalog Sync + retry — the wire analogue of the reference's
 seqnum-invalidated instance-type cache re-resolution
-(/root/reference/pkg/cloudprovider/instancetypes.go:104-120).
+(/root/reference/pkg/cloudprovider/instancetypes.go:104-120). Staleness is
+keyed on catalog CONTENT (wire.catalog_hash), not the process-local seqnum,
+so a restarted controller (seqnum reset to 0) re-syncs cleanly against a
+long-lived solver service instead of being branded stale forever.
 
 Failure contract: any transport error raises SolverUnavailable; the
 provisioning controller catches it and runs the in-process oracle with
@@ -65,8 +68,11 @@ class RemoteSolver:
         self.provisioners = list(provisioners)
         self.timeout = timeout
         self._channel = channel or _shared_channel(target)
-        self._synced_seqnum = -1
+        self._synced_hash: Optional[int] = None
         self._prov_hash = wire.provisioners_hash(self.provisioners)
+        # content hash memoized per seqnum: recomputed only when the catalog
+        # object actually mutates (seqnum bump), not per solve
+        self._hash_cache: "tuple[int, int]" = (-1, 0)  # (seqnum, hash)
         # stub table derived from the server's METHODS so client and service
         # can't drift (single owner of the RPC name -> message mapping)
         self._stubs = {
@@ -88,21 +94,26 @@ class RemoteSolver:
                 raise StaleSync(e.details())
             raise SolverUnavailable(f"{name}: {e.code().name}: {e.details()}")
 
+    def catalog_content_hash(self) -> int:
+        if self._hash_cache[0] != self.catalog.seqnum:
+            self._hash_cache = (self.catalog.seqnum, wire.catalog_hash(self.catalog))
+        return self._hash_cache[1]
+
     def sync(self) -> int:
         resp = self._call("Sync", pb.SyncRequest(
             catalog=wire.catalog_to_wire(self.catalog),
             provisioners=[wire.provisioner_to_wire(p) for p in self.provisioners],
         ))
-        if resp.seqnum != self.catalog.seqnum:
-            # the server already holds a NEWER catalog (another replica won):
-            # recording resp.seqnum as synced would make every later solve
-            # fail FAILED_PRECONDITION after a wasted server build. We are the
-            # stale side — surface it so the caller falls back this cycle and
-            # re-syncs after refreshing its catalog.
+        # Staleness is content-keyed (see wire.catalog_hash): the server
+        # installs whatever content we sent, so a mismatch here means the
+        # wire round-trip itself is broken — surface it rather than record a
+        # sync that every later Solve would fail.
+        ours = self.catalog_content_hash()
+        if resp.catalog_hash != ours:
             raise StaleSync(
-                f"server catalog seqnum={resp.seqnum} is newer than ours "
-                f"({self.catalog.seqnum}); refresh the catalog before syncing")
-        self._synced_seqnum = resp.seqnum
+                f"server installed catalog hash={resp.catalog_hash:x}, "
+                f"ours is {ours:x}; wire round-trip mismatch")
+        self._synced_hash = ours
         return resp.seqnum
 
     def health(self) -> pb.HealthResponse:
@@ -115,12 +126,13 @@ class RemoteSolver:
               daemon_overhead: Optional[Sequence[int]] = None) -> SolveResult:
         req = pb.SolveRequest(
             catalog_seqnum=self.catalog.seqnum,
+            catalog_hash=self.catalog_content_hash(),
             provisioner_hash=self._prov_hash,
             pods=[wire.pod_to_wire(p) for p in pods],
             existing=[wire.existing_to_wire(e) for e in existing],
             daemon_overhead=list(daemon_overhead or ()),
         )
-        if self._synced_seqnum != self.catalog.seqnum:
+        if self._synced_hash != self.catalog_content_hash():
             self.sync()
         try:
             resp = self._call("Solve", req)

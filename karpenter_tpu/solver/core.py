@@ -25,7 +25,9 @@ from ..apis.provisioner import Provisioner
 from ..models.encode import EncodedProblem, OptionGrid, build_grid, encode_problem
 from ..models.instancetype import Catalog
 from ..models.pod import PodSpec
-from ..ops.packer import PackInputs, PackResult, pack_flat, unflatten_result
+from ..ops import pallas_kernels
+from ..ops.packer import (PackInputs, PackResult, pack_flat,
+                          pallas_value_safe, unflatten_result)
 from ..oracle.scheduler import ExistingNode, Option
 
 
@@ -175,10 +177,16 @@ def run_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None) -> PackRe
         ex_feas=ex_feas,
         prov_overhead=enc.prov_overhead, prov_pods_cap=enc.prov_pods_cap,
     )
+    # Pallas engages only when the env flag is on AND every input magnitude
+    # is below the f32-exactness bound (checked on host arrays; see
+    # packer.pallas_value_safe) — oversized problems take the XLA path.
+    use_pallas = pallas_kernels.enabled() and pallas_value_safe(
+        enc.alloc_t, enc.ex_alloc, enc.group_vec, enc.overhead,
+        enc.prov_overhead)
     inputs = jax.device_put(inputs)  # async enqueue; no sync round trip
     # One jitted dispatch returning ONE flat buffer: decode pays exactly one
     # device->host round trip (the tunnel RTT floor; SURVEY.md §7.3).
-    flat = pack_flat(inputs, n_slots=Nb)
+    flat = pack_flat(inputs, n_slots=Nb, use_pallas=use_pallas)
     return unflatten_result(np.asarray(jax.device_get(flat)), Gb, Nb, Neb)
 
 

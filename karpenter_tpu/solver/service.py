@@ -16,6 +16,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import OrderedDict
 from concurrent import futures
 from typing import Optional, Sequence
 
@@ -62,60 +63,84 @@ def result_to_response(result: SolveResult, solve_ms: float,
 
 
 class SolverService:
-    """Stateful solver host: one synced (catalog, provisioners) pair, one
-    TPUSolver whose device-resident grid persists across Solve calls."""
+    """Stateful solver host: a small LRU of synced (catalog, provisioners)
+    pairs, each with a TPUSolver whose device-resident grid persists across
+    Solve calls. The LRU (vs a single slot) keeps multiple controller
+    replicas with briefly divergent catalogs from thrashing grid rebuilds
+    against each other — each replica's grid stays resident and its Solves
+    are served directly."""
+
+    LRU_CAPACITY = 4
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._solver: Optional[TPUSolver] = None
-        self._seqnum: int = -1
-        self._prov_hash: int = 0
+        # (cat_hash, prov_hash) -> (TPUSolver, seqnum); insertion order = LRU
+        self._cache: "OrderedDict[tuple[int, int], tuple[TPUSolver, int]]" = \
+            OrderedDict()
+
+    def _mru(self) -> "tuple[Optional[TPUSolver], int, int]":
+        """(solver, seqnum, cat_hash) of the most recently used entry.
+        Callers must hold self._lock."""
+        if not self._cache:
+            return None, -1, 0
+        key = next(reversed(self._cache))
+        solver, seqnum = self._cache[key]
+        return solver, seqnum, key[0]
+
+    @property
+    def _cat_hash(self) -> int:
+        """Most-recently-used catalog hash (observability/tests)."""
+        with self._lock:
+            return self._mru()[2]
 
     # -- RPC methods (called by the generic handler) -------------------------------
 
     def Sync(self, request: pb.SyncRequest, context) -> pb.SyncResponse:
         provisioners = [wire.provisioner_from_wire(m) for m in request.provisioners]
         prov_hash = wire.provisioners_hash(provisioners)
+        # Staleness is keyed on catalog CONTENT, not seqnum: seqnums are
+        # process-local counters that reset when a controller restarts, so a
+        # fresh client with a low seqnum but identical content must be treated
+        # as synced, and a content change must rebuild even if its seqnum is
+        # lower than an installed one (content owns identity, not ordering).
+        cat_hash = wire.catalog_hash(request.catalog)
+        key = (cat_hash, prov_hash)
         with self._lock:
-            unchanged = (self._solver is not None
-                         and self._seqnum == request.catalog.seqnum
-                         and self._prov_hash == prov_hash)
-            outdated = self._solver is not None and self._seqnum > request.catalog.seqnum
-            newest = self._seqnum
-        if unchanged:
-            # idempotent re-Sync: keep the device-resident grid (per-reconcile
-            # clients re-Sync freely; only a real seqnum/spec change pays)
-            return pb.SyncResponse(seqnum=request.catalog.seqnum)
-        if outdated:
-            # the caller's catalog is older than what's installed: don't pay a
-            # solver build that would only be discarded; the returned seqnum
-            # tells the client it is the stale side
-            return pb.SyncResponse(seqnum=newest)
+            hit = self._cache.get(key)
+            if hit is not None:
+                # idempotent re-Sync: keep the device-resident grid
+                self._cache.move_to_end(key)
+                self._cache[key] = (hit[0], request.catalog.seqnum)
+        if hit is not None:
+            return pb.SyncResponse(seqnum=request.catalog.seqnum,
+                                   catalog_hash=cat_hash)
         catalog = wire.catalog_from_wire(request.catalog)
         solver = TPUSolver(catalog, provisioners)
         # build + device-put the option grid OUTSIDE the lock so Health stays
         # responsive during catalog churn, then swap atomically
         solver.grid()
         with self._lock:
-            if self._solver is not None and self._seqnum > catalog.seqnum:
-                # a newer catalog won the race while we built; keep it
-                return pb.SyncResponse(seqnum=self._seqnum)
-            self._solver = solver
-            self._seqnum = catalog.seqnum
-            self._prov_hash = prov_hash
-        log.info("synced catalog seqnum=%d (%d types, %d provisioners)",
-                 self._seqnum, len(catalog.types), len(provisioners))
-        return pb.SyncResponse(seqnum=self._seqnum)
+            self._cache[key] = (solver, catalog.seqnum)
+            self._cache.move_to_end(key)
+            while len(self._cache) > self.LRU_CAPACITY:
+                evicted_key, _ = self._cache.popitem(last=False)
+                log.info("evicted solver for catalog hash=%x", evicted_key[0])
+        log.info("synced catalog seqnum=%d hash=%x (%d types, %d provisioners)",
+                 catalog.seqnum, cat_hash, len(catalog.types), len(provisioners))
+        return pb.SyncResponse(seqnum=catalog.seqnum, catalog_hash=cat_hash)
 
     def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
+        key = (request.catalog_hash, request.provisioner_hash)
         with self._lock:
-            solver, seqnum, phash = self._solver, self._seqnum, self._prov_hash
-        if solver is None or request.catalog_seqnum != seqnum \
-                or request.provisioner_hash != phash:
+            entry = self._cache.get(key)
+            if entry is not None:
+                self._cache.move_to_end(key)
+        if entry is None:
             context.abort(
                 grpc.StatusCode.FAILED_PRECONDITION,
-                f"catalog out of sync: server seqnum={seqnum}, "
-                f"request seqnum={request.catalog_seqnum}; re-Sync required")
+                f"catalog hash={request.catalog_hash:x} not synced; "
+                f"re-Sync required")
+        solver, seqnum = entry
         pods = [wire.pod_from_wire(m) for m in request.pods]
         existing = [wire.existing_from_wire(m) for m in request.existing]
         overhead = list(request.daemon_overhead) or None
@@ -128,8 +153,8 @@ class SolverService:
         import jax
 
         with self._lock:
-            seqnum = self._seqnum
-            n_types = len(self._solver.catalog.types) if self._solver else 0
+            solver, seqnum, _ = self._mru()
+            n_types = len(solver.catalog.types) if solver else 0
         return pb.HealthResponse(ok=True, backend=jax.devices()[0].platform,
                                  catalog_seqnum=seqnum, n_types=n_types)
 

@@ -9,7 +9,7 @@ first-occurrence order of group_pods, models/pod.py).
 
 from __future__ import annotations
 
-import zlib
+import hashlib
 
 from ..apis.provisioner import KubeletConfiguration, Limits, Provisioner
 from ..models.instancetype import Catalog, InstanceType, Offering, Offerings
@@ -201,14 +201,37 @@ def provisioner_from_wire(m: pb.ProvisionerMsg) -> Provisioner:
     )
 
 
+def _digest64(chunks) -> int:
+    """64-bit blake2b over length-delimited chunks. These fingerprints are
+    the SOLE staleness gate for Solve, so a 32-bit CRC's collision odds
+    (birthday bound ~2**16 catalogs) are not acceptable — a collision would
+    silently serve placements from the wrong catalog. Length prefixes keep
+    chunk boundaries unambiguous."""
+    h = hashlib.blake2b(digest_size=8)
+    for c in chunks:
+        h.update(len(c).to_bytes(4, "little"))
+        h.update(c)
+    return int.from_bytes(h.digest(), "little")
+
+
+def catalog_hash(catalog_or_msg) -> int:
+    """Content fingerprint of a catalog, seqnum EXCLUDED. Seqnums are
+    process-local mutation counters: a restarted controller starts over at 0
+    while a long-lived solver service keeps its old value, so cross-process
+    seqnum comparison wrongly brands the fresh client stale forever. Content
+    hashing makes sync staleness restart-proof (the durable analogue of the
+    reference's seqnum-memoized cache key, instancetypes.go:104-120)."""
+    m = catalog_or_msg if isinstance(catalog_or_msg, pb.CatalogMsg) \
+        else catalog_to_wire(catalog_or_msg)
+    return _digest64(t.SerializeToString() for t in m.types)
+
+
 def provisioners_hash(provisioners) -> int:
     """Stable fingerprint of the synced provisioner specs; lets the server
     reject a Solve whose provisioner set drifted since the last Sync (the
     seqnum trick applied to the other half of the problem definition)."""
-    h = 0
-    for p in provisioners:
-        h = zlib.crc32(provisioner_to_wire(p).SerializeToString(), h)
-    return h
+    return _digest64(provisioner_to_wire(p).SerializeToString()
+                     for p in provisioners)
 
 
 # -- existing nodes ---------------------------------------------------------------

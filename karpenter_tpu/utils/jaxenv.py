@@ -53,8 +53,13 @@ def probe_tpu(attempts: int = 3, timeout_s: int = 60, backoff_s: int = 10):
     (a PJRT-init hang — even at interpreter startup — only costs the probe).
     Returns (ok, note); note carries the per-attempt failure trail."""
     env = dict(os.environ, JAX_PLATFORMS="axon")
-    code = ("import jax; jax.config.update('jax_platforms','axon'); "
-            "d=jax.devices(); print('PROBE_OK', d[0].platform, len(d))")
+    # the config.update is guarded like pin(): sitecustomize may have already
+    # initialized the backend at interpreter startup, and a healthy TPU must
+    # not be reported down just because the late pin raises
+    code = ("import jax\n"
+            "try: jax.config.update('jax_platforms','axon')\n"
+            "except (RuntimeError, ValueError): pass\n"
+            "d = jax.devices(); print('PROBE_OK', d[0].platform, len(d))")
     notes = []
     for attempt in range(1, attempts + 1):
         try:

@@ -335,6 +335,21 @@ class TestNodeTemplateController:
 
 
 class TestTermination:
+    def test_request_deletion_distinguishes_already_marked(self, op):
+        # the multi-node consolidation rollback must only undo marks IT
+        # created; the status contract here is what makes that possible
+        add_provisioner(op)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        (name,) = op.cluster.nodes
+        t = op.termination
+        assert t.request_deletion("no-such-node") == ""
+        assert t.request_deletion(name) == t.MARKED_NEW
+        ts = op.cluster.nodes[name].deletion_requested_ts
+        assert t.request_deletion(name) == t.MARKED_ALREADY
+        # re-request must not refresh the original request timestamp
+        assert op.cluster.nodes[name].deletion_requested_ts == ts
+
     def test_do_not_evict_blocks_drain(self, op):
         add_provisioner(op)
         op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi",

@@ -120,6 +120,18 @@ class TestSettings:
         assert s.tags == {"team": "ml"}
         assert s.vm_memory_overhead_percent == 0.075
 
+    def test_snapshot_is_consistent_copy(self):
+        s = Settings.from_dict({"clusterName": "c1", "tags.team": "ml"})
+        snap = s.snapshot()
+        s.apply(Settings.from_dict({"clusterName": "c2",
+                                    "batchIdleDuration": "2s",
+                                    "batchMaxDuration": "20s"}))
+        # the snapshot is immune to the later apply (incl. nested containers)
+        assert snap.cluster_name == "c1"
+        assert snap.batch_idle_duration == 1.0
+        assert snap.tags == {"team": "ml"}
+        assert s.cluster_name == "c2" and s.tags == {}
+
     def test_validation(self):
         with pytest.raises(SettingsError):
             Settings.from_dict({})  # no cluster name

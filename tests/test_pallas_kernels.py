@@ -74,3 +74,41 @@ def test_exact_boundary_divisions():
                                          jnp.asarray(used), jnp.asarray(vec)))
     want = reference_quotient_nt(alloc_t, used, vec)
     np.testing.assert_array_equal(got, want)
+
+
+def test_value_safety_gate_routes_oversized_to_xla():
+    # f32 one-correction exactness holds only below 2**24; encode clamps at
+    # INT_BIG (2**30), so run_pack must take the XLA path for huge extended
+    # resource counts and keep the bit-parity contract
+    from karpenter_tpu.ops.packer import F24, pallas_value_safe
+
+    ok = np.array([[F24 - 1, 12]], dtype=np.int32)
+    huge = np.array([[F24, 12]], dtype=np.int32)
+    assert pallas_value_safe(ok, np.zeros((2, 2), np.int32))
+    assert not pallas_value_safe(ok, huge)
+    assert pallas_value_safe(None, ok)       # optional inputs skipped
+    assert pallas_value_safe()               # vacuous
+
+
+def test_pack_with_oversized_catalog_matches_oracle_convention():
+    # end-to-end: a catalog entry with an extended-resource count above 2**24
+    # must still solve exactly (XLA path) even with the pallas flag forced on
+    from karpenter_tpu.apis.provisioner import Provisioner
+    from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+    from karpenter_tpu.models.pod import make_pod
+    from karpenter_tpu.solver.core import TPUSolver
+
+    big = make_instance_type("huge.ex", cpu=64, memory="256Gi", od_price=1.0,
+                             extended={"nvidia.com/gpu": 2**25})
+    cat = Catalog(types=[big])
+    prov = Provisioner(name="default")
+    prov.set_defaults()
+    pods = [make_pod(f"p{i}", cpu="1", memory="1Gi",
+                     extended={"nvidia.com/gpu": 3}) for i in range(5)]
+    pk.force_enable(True)
+    try:
+        res = TPUSolver(cat, [prov]).solve(pods)
+    finally:
+        pk.force_enable(False)
+    assert sum(n.pod_count for n in res.nodes) == 5
+    assert res.unschedulable_count() == 0

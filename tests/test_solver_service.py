@@ -144,30 +144,58 @@ class TestRemoteParity:
         r2 = solver.solve([make_pod("b", cpu="1", memory="1Gi")])
         assert r2.nodes[0].option.itype.name != "m.large"
 
-    def test_stale_replica_sync_raises_not_loops(self):
-        # two replicas, one shared sidecar: the replica holding the OLDER
-        # catalog seqnum must get StaleSync from sync() (not a recorded
-        # "success" with the winner's seqnum that would send every later
-        # Solve into a rebuild/FAILED_PRECONDITION cycle)
-        from karpenter_tpu.solver.client import StaleSync
+    def test_restarted_controller_resyncs_cleanly(self):
+        # restart scenario: the controller's process-local seqnum counter
+        # resets while the long-lived sidecar keeps its higher one. Staleness
+        # is content-keyed, so the fresh client with IDENTICAL content must
+        # sync + solve (previously it got StaleSync forever and every
+        # reconcile fell back to the oracle)
         from karpenter_tpu.solver.service import serve as serve_fresh
 
         srv, port, svc = serve_fresh("127.0.0.1:0")
         try:
-            new_catalog = small_catalog()
-            new_catalog.seqnum = 7
-            winner = RemoteSolver(new_catalog, [default_provisioner()],
-                                  target=f"127.0.0.1:{port}")
-            assert winner.sync() == 7
-            old_catalog = small_catalog()
-            old_catalog.seqnum = 5
-            stale = RemoteSolver(old_catalog, [default_provisioner()],
+            old = small_catalog()
+            old.seqnum = 7  # long-running controller, several catalog bumps
+            first = RemoteSolver(old, [default_provisioner()],
                                  target=f"127.0.0.1:{port}")
-            with pytest.raises(StaleSync):
-                stale.sync()
-            assert stale._synced_seqnum == -1  # never recorded a false sync
-            # the winner keeps solving fine
-            assert winner.solve([make_pod("a", cpu="1", memory="1Gi")]).nodes
+            assert first.solve([make_pod("a", cpu="1", memory="1Gi")]).nodes
+            restarted_catalog = small_catalog()  # same content, seqnum 0
+            restarted = RemoteSolver(restarted_catalog, [default_provisioner()],
+                                     target=f"127.0.0.1:{port}")
+            assert restarted.solve([make_pod("b", cpu="1", memory="1Gi")]).nodes
+            # identical content: the device-resident grid was NOT rebuilt
+            assert svc._cat_hash == restarted.catalog_content_hash()
+        finally:
+            srv.stop(grace=None)
+
+    def test_divergent_replicas_both_keep_solving(self):
+        # two replicas with DIFFERENT catalog content sharing one sidecar:
+        # the service's solver LRU keeps BOTH grids resident so neither
+        # replica pays rebuild thrash (nor FAILED_PRECONDITION loops)
+        from karpenter_tpu.models.instancetype import Offering, Offerings
+        from karpenter_tpu.solver.service import serve as serve_fresh
+
+        srv, port, svc = serve_fresh("127.0.0.1:0")
+        try:
+            cat_a = small_catalog()
+            cat_b = small_catalog()
+            big = cat_b.by_name["m.large"]
+            object.__setattr__(big, "offerings", Offerings(
+                Offering(o.zone, o.capacity_type, o.price, available=False)
+                for o in big.offerings))
+            a = RemoteSolver(cat_a, [default_provisioner()],
+                             target=f"127.0.0.1:{port}")
+            b = RemoteSolver(cat_b, [default_provisioner()],
+                             target=f"127.0.0.1:{port}")
+            assert a.solve([make_pod("a", cpu="1", memory="1Gi")]).nodes
+            rb = b.solve([make_pod("b", cpu="1", memory="1Gi")])
+            assert rb.nodes[0].option.itype.name != "m.large"
+            # both grids stay resident in the LRU; a keeps solving with no
+            # rebuild and b's view is unaffected
+            assert len(svc._cache) == 2
+            ra = a.solve([make_pod("c", cpu="1", memory="1Gi")])
+            assert ra.nodes
+            assert len(svc._cache) == 2
         finally:
             srv.stop(grace=None)
 

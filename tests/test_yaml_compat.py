@@ -218,3 +218,30 @@ class TestEndToEndManifestApply:
             assert op.cluster.nodes
         finally:
             op.stop()
+
+
+class TestPodRequests:
+    def test_init_containers_fold_in_as_max(self):
+        # k8s effective requests: max(sum(containers), max(initContainers))
+        from karpenter_tpu.apis.yaml_compat import _pod_requests
+
+        containers = [
+            {"resources": {"requests": {"cpu": "500m", "memory": "1Gi"}}},
+            {"resources": {"requests": {"cpu": "500m", "memory": "1Gi"}}},
+        ]
+        init = [
+            {"resources": {"requests": {"cpu": "4", "memory": "512Mi"}}},
+            {"resources": {"requests": {"cpu": "2", "memory": "4Gi"}}},
+        ]
+        r = _pod_requests(containers, init)
+        assert r["cpu"] == 4000          # init phase dominates cpu
+        assert r["memory"] == 4 * 1024 ** 3  # heaviest single init container
+        # without init containers the sums stand
+        r2 = _pod_requests(containers)
+        assert r2["cpu"] == 1000 and r2["memory"] == 2 * 1024 ** 3
+
+    def test_limits_imply_requests(self):
+        from karpenter_tpu.apis.yaml_compat import _pod_requests
+
+        r = _pod_requests([{"resources": {"limits": {"nvidia.com/gpu": 2}}}])
+        assert r["nvidia.com/gpu"] == 2
