@@ -11,10 +11,11 @@ the reference brings their manifests as-is):
 - v1 Pod -> PodSpec
 - policy/v1 PodDisruptionBudget -> models.cluster.PodDisruptionBudget
 
-Known deliberate gaps: `preferredDuringScheduling` node affinities are soft
-preferences the scheduler may ignore — they parse to nothing (the reference's
-scheduler treats them best-effort too); percentage PDBs resolve against the
-workload's replica count when a matching Deployment is in the same bundle.
+`preferredDuringScheduling` node affinities parse to ordered preference terms
+(weight desc) that the scheduler relaxes iteratively, dropping lowest-weight
+first — the reference core's progressive preference relaxation. Percentage
+PDBs resolve against the workload's replica count when a matching Deployment
+is in the same bundle.
 Replay parity with the reference's examples is tested in
 tests/test_yaml_compat.py (SURVEY.md §7.2 step 1's replay harness).
 """
@@ -238,18 +239,23 @@ def _pod(metadata, spec, name: str = "", labels=None) -> PodSpec:
             reqs.add(Requirement.create(
                 _map_key(expr["key"]), expr["operator"],
                 [str(v) for v in expr.get("values", [])]))
-    # preferredDuringScheduling: the HIGHEST-weight term becomes the pod's
-    # soft preference set (one-round relaxation in the scheduler); k8s's
-    # full per-term weighted scoring is approximated by that single term
-    prefs = Requirements()
+    # preferredDuringScheduling: every term becomes an ordered preference
+    # (weight desc); the scheduler relaxes them iteratively, dropping the
+    # lowest-weight term first (k8s's weighted scoring, approximated as a
+    # lexicographic prefix preference — the reference core's relaxation)
+    pref_terms: "list[Requirements]" = []
     preferred = sorted(
         affinity.get("preferredDuringSchedulingIgnoredDuringExecution") or (),
         key=lambda t: -int(t.get("weight", 0)))
-    if preferred:
-        for expr in (preferred[0].get("preference") or {}).get("matchExpressions") or ():
-            prefs.add(Requirement.create(
+    for term in preferred:
+        tr = Requirements()
+        for expr in (term.get("preference") or {}).get("matchExpressions") or ():
+            tr.add(Requirement.create(
                 _map_key(expr["key"]), expr["operator"],
                 [str(v) for v in expr.get("values", [])]))
+        if len(tr):
+            pref_terms.append(tr)
+    prefs = tuple(pref_terms)
     tolerations = tuple(
         Toleration(key=t.get("key", ""), operator=t.get("operator", "Equal"),
                    value=str(t.get("value", "")), effect=t.get("effect", ""))

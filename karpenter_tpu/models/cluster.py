@@ -64,6 +64,7 @@ class StateNode:
             allocatable=list(self.allocatable),
             used=self.used_vector(),
             taints=self.taints,
+            resident=tuple(self.non_daemon_pods()),
         )
 
 

@@ -256,6 +256,8 @@ class EncodedProblem:
     # per-provisioner kubelet effects (None when all defaults)
     prov_overhead: "Optional[np.ndarray]" = None  # i32 [Pv, R]
     prov_pods_cap: "Optional[np.ndarray]" = None  # i32 [Pv, T]
+    # remaining per-(group, existing-node) cap; None when no group is capped
+    ex_cap: "Optional[np.ndarray]" = None  # i32 [G, Ne]
 
 
 def encode_problem(
@@ -271,7 +273,7 @@ def encode_problem(
         grid = build_grid(catalog)
     provs = sorted(provisioners, key=lambda p: (-p.weight, p.name))
     overhead = list(daemon_overhead or [0] * wk.NUM_RESOURCES)
-    groups = prepare_groups(pods, grid.zones)
+    groups = prepare_groups(pods, grid.zones, existing)
     G, Pv, T, S = len(groups), len(provs), grid.T, grid.S
     R = wk.NUM_RESOURCES
 
@@ -302,6 +304,24 @@ def encode_problem(
         group_newprov[gi] = newprov
         for ei, e in enumerate(existing):
             ex_feas[gi, ei] = _ex_label_fit(e, g.spec)
+
+    # Per-existing-node REMAINING group caps: hostname spread/anti-affinity
+    # counts pods already RESIDENT on the node (the oracle does the same via
+    # ExistingNode.group_counts seeding). When present, this array REPLACES
+    # the scalar group_cap on the existing-node path, so capped groups get
+    # their cap here even on resident-free nodes.
+    ex_cap = None
+    if existing and any(int(c) < int(INT_BIG) for c in group_cap[:max(G, 1)]):
+        ex_cap = np.full((max(G, 1), max(len(existing), 1)), INT_BIG,
+                         dtype=np.int32)
+        for gi, g in enumerate(groups):
+            cap = int(group_cap[gi])
+            if cap >= int(INT_BIG):
+                continue
+            # residents carry their PRE-SPLIT spec: count via origin key
+            okey = g.spec.origin_key()
+            for ei, e in enumerate(existing):
+                ex_cap[gi, ei] = max(0, cap - e.resident_counts.get(okey, 0))
 
     if n_slots is None:
         # Tight upper bound on claim slots: group g opens at most
@@ -341,6 +361,7 @@ def encode_problem(
         n_slots=n_slots,
         groups=groups, provisioners=list(provs), grid=grid,
         prov_overhead=prov_overhead, prov_pods_cap=prov_pods_cap,
+        ex_cap=ex_cap,
     )
 
 
@@ -391,20 +412,24 @@ def encode_group(
         mask = fold_option_mask(reqs, cols, prov).reshape(T, S) & fits_t[:, None]
         if extra_mask is not None:
             mask = mask & extra_mask
-        if mask.any() and len(group.spec.preferences):
-            # soft preferences, one relaxation round — mirrors the oracle's
-            # feasible_options exactly (PodSpec.preferences docstring)
-            try:
-                pref_reqs = reqs.union(group.spec.preferences)
-            except IncompatibleError:
-                pref_reqs = None
-            if pref_reqs is not None:
+        if mask.any() and group.spec.preferences:
+            # iterative preference relaxation — mirrors the oracle's
+            # feasible_options exactly (PodSpec.preferences docstring):
+            # largest satisfiable prefix of weight-ordered terms wins
+            for k in range(len(group.spec.preferences), 0, -1):
+                try:
+                    pref_reqs = reqs
+                    for term in group.spec.preferences[:k]:
+                        pref_reqs = pref_reqs.union(term)
+                except IncompatibleError:
+                    continue
                 pref_mask = (fold_option_mask(pref_reqs, cols, prov)
                              .reshape(T, S) & fits_t[:, None])
                 if extra_mask is not None:
                     pref_mask = pref_mask & extra_mask
                 if pref_mask.any():
                     mask = pref_mask
+                    break
         if mask.any():
             feas[pi] = mask
             if newprov < 0:

@@ -78,11 +78,15 @@ class PodSpec:
     labels: "tuple[tuple[str, str], ...]" = ()  # pod labels (PDB/service selectors)
     requests: "tuple[tuple[str, int], ...]" = ()  # canonical units (cpu millis, mem bytes, counts)
     requirements: Requirements = dataclasses.field(default_factory=Requirements)
-    # soft preferences (preferredDuringScheduling): honored for NEW-capacity
-    # option selection when any option satisfies them, dropped otherwise —
-    # the reference core's preference-relaxation, reduced to one round.
-    # Existing-node placement ignores them (first-fit order is not rescored).
-    preferences: Requirements = dataclasses.field(default_factory=Requirements)
+    # soft preferences (preferredDuringScheduling): an ORDERED tuple of
+    # requirement terms, highest weight first. The scheduler relaxes them
+    # iteratively — it tries all terms, then drops the lowest-weight term,
+    # and so on down to none — taking the largest satisfiable prefix
+    # (the reference core's progressive preference relaxation,
+    # pkg/controllers/provisioning/scheduling preferences; exercised by
+    # examples/workloads/prefer-arm.yaml). Existing-node placement ignores
+    # them (first-fit order is not rescored).
+    preferences: "tuple[Requirements, ...]" = ()
     tolerations: "tuple[Toleration, ...]" = ()
     topology: "tuple[TopologySpreadConstraint, ...]" = ()
     anti_affinity_hostname: bool = False  # self anti-affinity on kubernetes.io/hostname
@@ -92,6 +96,17 @@ class PodSpec:
     owner_kind: str = "ReplicaSet"  # "" => bare pod; "DaemonSet" excluded from provisioning
     do_not_evict: bool = False
     node_name: str = ""  # bound node (for cluster-state pods)
+    # set by the zone-split pre-pass: the PRE-SPLIT group key, so resident
+    # pods (stored with their original spec) are still counted against the
+    # split subgroup's per-node caps. NOT part of group_key (it's provenance,
+    # not scheduling identity).
+    spread_origin: "object" = None
+
+    def origin_key(self):
+        """Identity for counting RESIDENT pods of this logical group: the
+        pre-split key when this spec is a zone-split subgroup."""
+        return self.spread_origin if self.spread_origin is not None \
+            else self.group_key()
 
     def resource_vector(self) -> "list[int]":
         return wk.resource_vector(dict(self.requests))
@@ -109,7 +124,7 @@ class PodSpec:
         k = (
             self.requests,
             self.requirements.canonical(),  # freezes: later in-place mutation raises
-            self.preferences.canonical(),
+            tuple(t.canonical() for t in self.preferences),
             self.tolerations,
             self.topology,
             self.anti_affinity_hostname,

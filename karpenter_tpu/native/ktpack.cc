@@ -74,6 +74,9 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
             const int32_t* ex_alloc,     // [Ne,R]
             const int32_t* ex_used_in,   // [Ne,R]
             const uint8_t* ex_feas,      // [G,Ne]
+            const int32_t* ex_cap,       // [G,Ne] or nullptr (remaining group
+                                         //   cap per existing node, resident
+                                         //   pods already subtracted)
             const int32_t* prov_overhead,// [Pv,R] or nullptr (kubelet reserved)
             const int32_t* prov_pods_cap,// [Pv,T] or nullptr (kubelet pods cap)
             int pods_i,                  // index of the pods resource on R
@@ -113,7 +116,9 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
         avail[r] = ex_alloc[static_cast<size_t>(e) * R + r] -
                    ex_used[static_cast<size_t>(e) * R + r];
       int64_t fill = quotient(avail.data(), vec, R);
-      if (fill > cap) fill = cap;
+      const int64_t cap_e =
+          ex_cap ? ex_cap[static_cast<size_t>(g) * Ne + e] : cap;
+      if (fill > cap_e) fill = cap_e;
       if (fill <= 0) continue;
       if (fill > rem) fill = rem;
       ex_assign[static_cast<size_t>(g) * Ne + e] = static_cast<int32_t>(fill);

@@ -105,9 +105,14 @@ def encode_consolidation(
             for t in range(T) for s in range(S) if cheaper_opt[t, s]
         })
         pods = [p for n in cand for p in n.non_daemon_pods()]
-        groups = prepare_groups(pods, zones_c)
+        # domain-population-aware split must see the surviving nodes (the
+        # oracle path passes cluster.existing_views(exclude=cand) the same
+        # way, oracle/consolidation.py:107)
+        cand_names = {n.name for n in cand}
+        survivors = cluster.existing_views(exclude=cand_names)
+        groups = prepare_groups(pods, zones_c, survivors)
         gmax = max(gmax, len(groups))
-        per_cand.append((cand, cheaper_opt, groups))
+        per_cand.append((cand, cheaper_opt, groups, survivors))
 
     Gb = gmax
     group_vec = np.zeros((C, Gb, R), dtype=np.int32)
@@ -136,8 +141,10 @@ def encode_consolidation(
 
     prov_overhead, prov_pods_cap = kubelet_arrays(provs, catalog)
     feas_cache: "dict[tuple, tuple]" = {}
-    for ci, (cand, cheaper_opt, groups) in enumerate(per_cand):
+    ex_cap_arr = None  # [C, Gb, Ne] remaining caps; built on first capped group
+    for ci, (cand, cheaper_opt, groups, survivors) in enumerate(per_cand):
         n_groups.append(len(groups))
+        res_by_name = {e.name: e.resident_counts for e in survivors}
         for gi, g in enumerate(groups):
             gkey = (g.spec.group_key(), cheaper_opt.tobytes())
             enc = feas_cache.get(gkey)
@@ -160,6 +167,17 @@ def encode_consolidation(
                 if cluster.nodes[name].marked_for_deletion:
                     continue
                 ex_feas[ci, gi, i] = node_fits(g.spec, name)
+            if cap < int(INT_BIG):
+                # hostname spread/anti-affinity counts pods RESIDENT on the
+                # surviving nodes (mirrors encode_problem's ex_cap)
+                if ex_cap_arr is None:
+                    ex_cap_arr = np.full((C, Gb, Ne), INT_BIG, dtype=np.int32)
+                okey = g.spec.origin_key()
+                ex_cap_arr[ci, gi, :] = cap
+                for name, i in node_index.items():
+                    rc = res_by_name.get(name)
+                    if rc:
+                        ex_cap_arr[ci, gi, i] = max(0, cap - rc.get(okey, 0))
 
     inputs = PackInputs(
         alloc_t=grid.alloc_t, tiebreak=grid.tiebreak,
@@ -169,6 +187,7 @@ def encode_consolidation(
         ex_alloc=ex_alloc, ex_used=np.broadcast_to(ex_used, (C, Ne, R)).copy(),
         ex_feas=ex_feas,
         prov_overhead=prov_overhead, prov_pods_cap=prov_pods_cap,
+        ex_cap=ex_cap_arr,
     )
     return ConsolidationBatch(inputs, candidates, provs, grid, n_groups)
 
@@ -180,6 +199,7 @@ def _batched_pack(inputs: PackInputs, n_slots: int):
         group_vec=0, group_count=0, group_cap=0, group_feas=0, group_newprov=0,
         overhead=None, ex_alloc=None, ex_used=0, ex_feas=0,
         prov_overhead=None, prov_pods_cap=None,  # shared across candidates
+        ex_cap=None if inputs.ex_cap is None else 0,
     )
     return jax.vmap(lambda inp: pack_impl(inp, n_slots), in_axes=(axes,))(inputs)
 

@@ -87,6 +87,11 @@ class PackInputs(NamedTuple):
     # program unchanged). See oracle/scheduler.py kubelet_* helpers.
     prov_overhead: "jax.Array | None" = None  # i32 [Pv, R] extra node overhead
     prov_pods_cap: "jax.Array | None" = None  # i32 [Pv, T] max pods per node
+    # per-(group, existing-node) REMAINING group cap: group_cap minus pods of
+    # the group already resident on that node (hostname spread / anti-affinity
+    # must count residents — designs/bin-packing.md domain counting). None
+    # when no group is capped (common case: compiled program unchanged).
+    ex_cap: "jax.Array | None" = None  # i32 [G, Ne]
 
 
 class PackState(NamedTuple):
@@ -148,7 +153,9 @@ def _step(inputs: PackInputs, state: PackState, g: jax.Array,
 
     # ---- 1) existing nodes (oracle step "existing first") --------------------
     q_ex = _quotient(inputs.ex_alloc - state.ex_used, vec)        # [Ne]
-    fill_ex = jnp.clip(jnp.minimum(q_ex, cap), 0, INT_BIG)
+    # per-node remaining cap counts pods already resident on the node
+    cap_ex = cap if inputs.ex_cap is None else inputs.ex_cap[g]
+    fill_ex = jnp.clip(jnp.minimum(q_ex, cap_ex), 0, INT_BIG)
     fill_ex = jnp.where(inputs.ex_feas[g], fill_ex, 0)
     m_ex = _waterfall(count, fill_ex)                              # [Ne]
     ex_used = state.ex_used + m_ex[:, None] * vec[None, :]

@@ -171,14 +171,20 @@ def feasible_options(
         return out
 
     base = feasible(reqs)
-    # soft preferences: one relaxation round (PodSpec.preferences docstring)
-    if base and len(group.preferences):
-        try:
-            preferred = feasible(reqs.union(group.preferences))
-        except IncompatibleError:
-            preferred = set()
-        if preferred:
-            return preferred
+    # Iterative preference relaxation (PodSpec.preferences docstring): take
+    # the LARGEST prefix of weight-ordered preference terms that still leaves
+    # at least one feasible option; terms drop lowest-weight first.
+    if base and group.preferences:
+        for k in range(len(group.preferences), 0, -1):
+            try:
+                r = reqs
+                for term in group.preferences[:k]:
+                    r = r.union(term)
+            except IncompatibleError:
+                continue
+            preferred = feasible(r)
+            if preferred:
+                return preferred
     return base
 
 
@@ -204,14 +210,36 @@ class NodeClaim:
 @dataclasses.dataclass
 class ExistingNode:
     """An already-launched node considered during scheduling/consolidation
-    (cluster state; state.NewCluster at main.go:54)."""
+    (cluster state; state.NewCluster at main.go:54).
+
+    `resident` carries the node's non-daemon pods so topology decisions can
+    count what is ALREADY in each domain — zone-spread shares and per-node
+    group caps (hostname spread / anti-affinity) must account for resident
+    pods, matching the reference scheduler's domain-population counting
+    (designs/bin-packing.md:28-43 grouping over existing nodes)."""
 
     name: str
     labels: "dict[str, str]"
     allocatable: "list[int]"
     used: "list[int]"
     taints: "tuple[Taint, ...]" = ()
+    resident: "tuple[PodSpec, ...]" = ()
+    # pods placed DURING the current scheduling run, keyed by subgroup key
     group_counts: "dict[object, int]" = dataclasses.field(default_factory=dict)
+    # pods already resident BEFORE the run, keyed by (pre-split) group key.
+    # Kept separate from group_counts so the kernel's static per-row ex_cap
+    # (resident base only) and this oracle enforce the identical cap rule.
+    resident_counts: "dict[object, int]" = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        # seed resident counts (same group_key space as the pending batch:
+        # identical specs hash identically; residents are never zone-split)
+        for p in self.resident:
+            k = p.group_key()
+            self.resident_counts[k] = self.resident_counts.get(k, 0) + 1
+
+    def zone(self) -> str:
+        return self.labels.get(wk.LABEL_ZONE, "")
 
     def fits(self, group: PodSpec, vec: Sequence[int]) -> bool:
         if not tolerates_all(group.tolerations, self.taints):
@@ -253,19 +281,32 @@ def _group_cap_per_node(spec: PodSpec) -> Optional[int]:
     return cap
 
 
-def split_zone_spread(groups: "list[PodGroup]", zones: Sequence[str]) -> "list[PodGroup]":
+def split_zone_spread(groups: "list[PodGroup]", zones: Sequence[str],
+                      existing: "Sequence[ExistingNode]" = ()) -> "list[PodGroup]":
     """Pre-pass: groups with a zone topology-spread constraint are split into
-    per-zone subgroups with an explicit zone requirement, counts balanced
-    round-robin (maxSkew-respecting since shares differ by <=1).
+    per-zone subgroups, shares assigned by WATER-FILLING over the pods the
+    group ALREADY has resident in each zone (each new pod goes to the domain
+    with the lowest current population — always satisfies maxSkew >= 1,
+    matching the reference scheduler's domain-population counting,
+    designs/bin-packing.md:28-43).
+
+    `DoNotSchedule` subgroups get a hard zone requirement. `ScheduleAnyway`
+    subgroups get a SOFT zone preference term (appended lowest-priority): the
+    scheduler's iterative relaxation drops it when the zone can't host the
+    pod, so spreading is best-effort exactly as k8s specifies.
 
     Reference analogue: the scheduler's topology domain narrowing; E2E
     spread-zone.yaml expects even distribution across AZs.
     """
     out: "list[PodGroup]" = []
     for g in groups:
-        zc = [c for c in g.spec.topology if c.topology_key == wk.LABEL_ZONE
-              and c.when_unsatisfiable == "DoNotSchedule"]
-        if not zc and not g.spec.anti_affinity_zone:
+        hard = any(c.topology_key == wk.LABEL_ZONE
+                   and c.when_unsatisfiable == "DoNotSchedule"
+                   for c in g.spec.topology)
+        soft = any(c.topology_key == wk.LABEL_ZONE
+                   and c.when_unsatisfiable == "ScheduleAnyway"
+                   for c in g.spec.topology)
+        if not hard and not soft and not g.spec.anti_affinity_zone:
             out.append(g)
             continue
         zreq = g.spec.requirements.get(wk.LABEL_ZONE)
@@ -273,42 +314,66 @@ def split_zone_spread(groups: "list[PodGroup]", zones: Sequence[str]) -> "list[P
         if not allowed:
             out.append(g)
             continue
+        # domain population: pods of this group already resident per zone
+        gkey = g.spec.group_key()
+        resident = {z: 0 for z in allowed}
+        for e in existing:
+            ez = e.zone()
+            if ez in resident:
+                resident[ez] += e.resident_counts.get(gkey, 0)
         if g.spec.anti_affinity_zone:
-            # one pod per zone; surplus pods are unschedulable (pinned to the
-            # sentinel zone no offering carries)
-            shares = [1 if i < g.count else 0 for i in range(len(allowed))]
+            # one pod per zone, counting residents; surplus pods are
+            # unschedulable (pinned to the sentinel zone no offering carries)
+            open_zones = [z for z in allowed if resident[z] == 0]
+            shares = [1 if i < g.count else 0 for i in range(len(open_zones))]
+            allowed = open_zones
             surplus = g.count - sum(shares)
         else:
-            base, extra = divmod(g.count, len(allowed))
-            shares = [base + (1 if i < extra else 0) for i in range(len(allowed))]
+            counts = dict(resident)
+            share_of = {z: 0 for z in allowed}
+            for _ in range(g.count):
+                z = min(allowed, key=lambda zz: (counts[zz], zz))
+                counts[z] += 1
+                share_of[z] += 1
+            shares = [share_of[z] for z in allowed]
             surplus = 0
         pos = 0
         for z, share in zip(allowed, shares):
             if share == 0:
                 continue
-            try:
-                reqs = g.spec.requirements.copy()
-                reqs.add(Requirement.create(wk.LABEL_ZONE, OP_IN, [z]))
-            except IncompatibleError:
-                continue
-            spec = dataclasses.replace(g.spec, requirements=reqs)
+            if hard or g.spec.anti_affinity_zone:
+                try:
+                    reqs = g.spec.requirements.copy()
+                    reqs.add(Requirement.create(wk.LABEL_ZONE, OP_IN, [z]))
+                except IncompatibleError:
+                    continue
+                spec = dataclasses.replace(g.spec, requirements=reqs,
+                                           spread_origin=gkey)
+            else:
+                # ScheduleAnyway: soft zone pin, dropped first by relaxation
+                spec = dataclasses.replace(
+                    g.spec, spread_origin=gkey,
+                    preferences=g.spec.preferences + (
+                        Requirements.of((wk.LABEL_ZONE, OP_IN, [z])),))
             out.append(PodGroup(spec=spec, count=share, pod_names=g.pod_names[pos:pos + share]))
             pos += share
         if surplus > 0:
             spec = dataclasses.replace(g.spec, requirements=Requirements.of(
-                (wk.LABEL_ZONE, OP_IN, ["__no-zone__"])))
+                (wk.LABEL_ZONE, OP_IN, ["__no-zone__"])), spread_origin=gkey)
             out.append(PodGroup(spec=spec, count=surplus, pod_names=g.pod_names[pos:pos + surplus]))
     return out
 
 
-def prepare_groups(pods: "list[PodSpec]", zones: Sequence[str]) -> "list[PodGroup]":
-    """Dedupe -> zone-spread split -> FFD sort (bin-packing.md step 1).
+def prepare_groups(pods: "list[PodSpec]", zones: Sequence[str],
+                   existing: "Sequence[ExistingNode]" = ()) -> "list[PodGroup]":
+    """Dedupe -> zone-spread split (domain-population aware) -> FFD sort
+    (bin-packing.md step 1).
 
     Shared verbatim between this oracle and the kernel encoder
     (models/encode.py) so group ordering — which FFD results depend on —
     is identical on both paths."""
     groups = group_pods([p for p in pods if not p.is_daemon()])
-    groups = split_zone_spread(groups, zones)
+    groups = split_zone_spread(groups, zones, existing)
     groups.sort(key=lambda g: (
         -g.vector[wk.RESOURCE_INDEX[wk.RESOURCE_CPU]],
         -g.vector[wk.RESOURCE_INDEX[wk.RESOURCE_MEMORY]],
@@ -347,11 +412,11 @@ class Scheduler:
         pods: "list[PodSpec]",
         existing: "Iterable[ExistingNode]" = (),
     ) -> SchedulingResult:
-        groups = prepare_groups(pods, self.zones)
+        existing = list(existing)
+        groups = prepare_groups(pods, self.zones, existing)
 
         feas_cache: "dict[tuple[int, str], set[int]]" = {}
         nodes: "list[NodeClaim]" = []
-        existing = list(existing)
         assignments: "dict[str, list[PodSpec]]" = {e.name: [] for e in existing}
         unschedulable: "list[PodSpec]" = []
 
@@ -359,12 +424,21 @@ class Scheduler:
             vec = g.vector
             cap = _group_cap_per_node(g.spec)
             gkey = g.spec.group_key()
+            # resident pods carry their PRE-SPLIT spec, so per-node caps on
+            # existing nodes count via the origin key; new claims use the
+            # subgroup key (zone subgroups can never share a fresh node)
+            okey = g.spec.origin_key()
             for _ in range(g.count):
                 placed = False
                 # 1) existing cluster nodes first (in-flight awareness,
                 #    bin-packing.md grouping + core scheduler behavior)
                 for e in existing:
-                    if cap is not None and e.group_counts.get(gkey, 0) >= cap:
+                    # cap = resident base (origin key) + pods this run placed
+                    # of THIS subgroup — the same static-base + per-row rule
+                    # the kernel's ex_cap waterfall applies
+                    if cap is not None and (
+                            e.resident_counts.get(okey, 0)
+                            + e.group_counts.get(gkey, 0)) >= cap:
                         continue
                     if e.fits(g.spec, vec):
                         e.used = [u + v for u, v in zip(e.used, vec)]

@@ -83,6 +83,7 @@ def input_shardings(mesh: Mesh) -> PackInputs:
         group_newprov=s(), overhead=s(),
         ex_alloc=s(), ex_used=s(), ex_feas=s(),
         prov_overhead=s(), prov_pods_cap=s(None, AXIS_TYPES),
+        ex_cap=s(),
     )
 
 
@@ -102,6 +103,8 @@ def sharded_pack(inputs: PackInputs, n_slots: int, mesh: Mesh) -> PackResult:
     shardings = input_shardings(mesh)
     if inputs.prov_overhead is None:
         shardings = shardings._replace(prov_overhead=None, prov_pods_cap=None)
+    if inputs.ex_cap is None:
+        shardings = shardings._replace(ex_cap=None)
     inputs = jax.tree.map(
         lambda a, sh: jax.device_put(jax.numpy.asarray(a), sh), inputs, shardings
     )

@@ -26,7 +26,7 @@ from ..models.encode import EncodedProblem, OptionGrid, build_grid, encode_probl
 from ..models.instancetype import Catalog
 from ..models.pod import PodSpec
 from ..ops import pallas_kernels
-from ..ops.packer import (PackInputs, PackResult, pack_flat,
+from ..ops.packer import (INT_BIG, PackInputs, PackResult, pack_flat,
                           pallas_value_safe, unflatten_result)
 from ..oracle.scheduler import ExistingNode, Option
 
@@ -140,6 +140,7 @@ class NativeSolver(TPUSolver):
             group_newprov=enc.group_newprov, overhead=enc.overhead,
             ex_alloc=enc.ex_alloc, ex_used=enc.ex_used, ex_feas=enc.ex_feas,
             prov_overhead=enc.prov_overhead, prov_pods_cap=enc.prov_pods_cap,
+            ex_cap=enc.ex_cap,
         )
         result = native_pack(inputs, n_slots=enc.n_slots)
         return decode(enc, result, [e.name for e in existing])
@@ -163,6 +164,10 @@ def run_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None) -> PackRe
     ex_feas = pad(enc.ex_feas, Gb)
     if ex_feas.shape[1] != Neb:
         ex_feas = pad(ex_feas, Neb, axis=1)
+    ex_cap = enc.ex_cap
+    if ex_cap is not None:
+        ex_cap = pad(pad(ex_cap, Gb, fill=int(INT_BIG)), Neb, axis=1,
+                     fill=int(INT_BIG))
     inputs = PackInputs(
         alloc_t=dev_alloc_t if dev_alloc_t is not None else enc.alloc_t,
         tiebreak=dev_tiebreak if dev_tiebreak is not None else enc.tiebreak,
@@ -176,6 +181,7 @@ def run_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None) -> PackRe
         ex_used=pad(enc.ex_used, Neb),
         ex_feas=ex_feas,
         prov_overhead=enc.prov_overhead, prov_pods_cap=enc.prov_pods_cap,
+        ex_cap=ex_cap,
     )
     # Pallas engages only when the env flag is on AND every input magnitude
     # is below the f32-exactness bound (checked on host arrays; see

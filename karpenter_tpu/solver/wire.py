@@ -13,7 +13,8 @@ import hashlib
 
 from ..apis.provisioner import KubeletConfiguration, Limits, Provisioner
 from ..models.instancetype import Catalog, InstanceType, Offering, Offerings
-from ..models.pod import PodSpec, Taint, Toleration, TopologySpreadConstraint
+from ..models.pod import (PodSpec, Taint, Toleration, TopologySpreadConstraint,
+                          group_pods)
 from ..models.requirements import Requirement, Requirements
 from ..oracle.scheduler import ExistingNode
 from . import solver_pb2 as pb
@@ -73,6 +74,8 @@ def pod_to_wire(p: PodSpec) -> pb.PodSpecMsg:
         owner_kind=p.owner_kind,
         do_not_evict=p.do_not_evict,
         node_name=p.node_name,
+        preferences=[pb.RequirementsTerm(requirements=reqs_to_wire(t))
+                     for t in p.preferences],
     )
 
 
@@ -96,6 +99,8 @@ def pod_from_wire(m: pb.PodSpecMsg) -> PodSpec:
         owner_kind=m.owner_kind,
         do_not_evict=m.do_not_evict,
         node_name=m.node_name,
+        preferences=tuple(reqs_from_wire(t.requirements)
+                          for t in m.preferences),
     )
 
 
@@ -244,6 +249,8 @@ def existing_to_wire(e: ExistingNode) -> pb.ExistingNodeMsg:
         allocatable=list(e.allocatable),
         used=list(e.used),
         taints=_taints_to_wire(e.taints),
+        resident=[pb.ResidentGroup(spec=pod_to_wire(g.spec), count=g.count)
+                  for g in group_pods(list(e.resident))],
     )
 
 
@@ -254,4 +261,6 @@ def existing_from_wire(m: pb.ExistingNodeMsg) -> ExistingNode:
         allocatable=list(m.allocatable),
         used=list(m.used),
         taints=_taints_from_wire(m.taints),
+        resident=tuple(p for rg in m.resident
+                       for p in [pod_from_wire(rg.spec)] * rg.count),
     )

@@ -24,7 +24,8 @@ def assert_parity(catalog, provisioners, pods, existing=None, daemon_overhead=No
     def mk_existing():
         return [ExistingNode(name=e.name, labels=dict(e.labels),
                              allocatable=list(e.allocatable), used=list(e.used),
-                             taints=e.taints) for e in existing]
+                             taints=e.taints, resident=e.resident)
+                for e in existing]
 
     sched = Scheduler(catalog, provisioners, daemon_overhead)
     oracle_res = sched.schedule(list(pods), existing=mk_existing())
@@ -274,3 +275,134 @@ def test_parity_zone_only_unavailable_offerings():
     pods = [make_pod(f"s{i}", cpu="1", memory="1Gi", topology=spread) for i in range(9)]
     res = assert_parity(catalog, [prov()], pods)
     assert res.unschedulable_count() == 0
+
+
+def _existing_in_zone(name, zone, resident=(), cpu=8000, mem=32 * 2**30):
+    return ExistingNode(
+        name=name,
+        labels={wk.LABEL_ARCH: "amd64", wk.LABEL_OS: "linux",
+                wk.LABEL_ZONE: zone, wk.LABEL_CAPACITY_TYPE: "on-demand"},
+        allocatable=wk.capacity_vector({wk.RESOURCE_CPU: cpu,
+                                        wk.RESOURCE_MEMORY: mem,
+                                        wk.RESOURCE_PODS: 110}),
+        used=[0] * wk.NUM_RESOURCES,
+        resident=tuple(resident),
+    )
+
+
+def test_parity_zone_spread_counts_existing_domains():
+    # 4 pods of the spread group already live in zone-1a; the 2 new pods must
+    # water-fill into 1b and 1c, NOT round-robin from scratch (VERDICT missing
+    # #4: domain-population counting, designs/bin-packing.md:28-43)
+    spread = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),)
+
+    def pod(name):
+        return make_pod(name, cpu="1", memory="1Gi", topology=spread)
+
+    residents = [pod(f"old{i}") for i in range(4)]
+    existing = [_existing_in_zone("node-a", "zone-1a", residents)]
+    new = [pod("new0"), pod("new1")]
+    res = assert_parity(catalog5(), [prov()], new, existing=existing)
+    zones = sorted(n.option.zone for n in res.nodes)
+    placed_new_on_existing = sum(res.existing_counts.values())
+    # neither new pod lands in the saturated zone-1a
+    assert placed_new_on_existing == 0
+    assert zones == ["zone-1b", "zone-1c"], zones
+
+
+def test_parity_zone_spread_fills_into_lagging_domain():
+    # residents [2, 1, 0]: three new pods go [0->1a? no: min zone first]
+    # water-fill: counts (2,1,0) -> picks 1c, 1b, 1c -> final (2,2,2)
+    spread = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),)
+
+    def pod(name):
+        return make_pod(name, cpu="1", memory="1Gi", topology=spread)
+
+    existing = [
+        _existing_in_zone("node-a", "zone-1a", [pod("oa0"), pod("oa1")]),
+        _existing_in_zone("node-b", "zone-1b", [pod("ob0")]),
+    ]
+    new = [pod(f"n{i}") for i in range(3)]
+    res = assert_parity(catalog5(), [prov()], new, existing=existing)
+    # one pod tops up zone-1b (fits on node-b), two go to fresh zone-1c nodes
+    per_zone = {}
+    for n in res.nodes:
+        per_zone[n.option.zone] = per_zone.get(n.option.zone, 0) + n.pod_count
+    assert per_zone.get("zone-1c", 0) == 2
+    assert res.existing_counts.get("node-b", 0) == 1
+
+
+def test_parity_schedule_anyway_relaxes_instead_of_failing():
+    # ScheduleAnyway spread with a zone whose only capacity can't host the
+    # pod: the soft zone pin is dropped and every pod still schedules
+    spread = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE,
+                                       when_unsatisfiable="ScheduleAnyway"),)
+    cat = Catalog(types=[
+        make_instance_type("small.2x", cpu=2, memory="8Gi", od_price=0.10,
+                           zones=("zone-1a", "zone-1b")),  # nothing in 1c
+    ])
+    pods = [make_pod(f"p{i}", cpu="1", memory="1Gi", topology=spread)
+            for i in range(6)]
+    res = assert_parity(cat, [prov()], pods)
+    assert res.unschedulable_count() == 0
+    placed = sum(n.pod_count for n in res.nodes)
+    assert placed == 6
+    # the 1a/1b shares stay pinned; only the 1c share relaxed
+    per_zone = {}
+    for n in res.nodes:
+        per_zone[n.option.zone] = per_zone.get(n.option.zone, 0) + n.pod_count
+    assert per_zone.get("zone-1a", 0) >= 2 and per_zone.get("zone-1b", 0) >= 2
+
+
+def test_parity_hostname_anti_affinity_counts_residents():
+    # a resident pod of the anti-affine group blocks its node for the new
+    # pod even though capacity fits (per-(group, node) remaining cap)
+    def pod(name):
+        return make_pod(name, cpu="1", memory="1Gi", anti_affinity_hostname=True)
+
+    existing = [_existing_in_zone("node-a", "zone-1a", [pod("old0")])]
+    res = assert_parity(catalog5(), [prov()], [pod("new0")], existing=existing)
+    assert sum(res.existing_counts.values()) == 0  # refused the resident node
+    assert sum(n.pod_count for n in res.nodes) == 1
+
+
+def test_parity_preference_relaxation_prefix():
+    # ordered preference terms: [arm64 (top weight), spot] — catalog offers
+    # no arm spot, so arm64 survives and the spot term is dropped
+    p = make_pod("p0", cpu="1", memory="1Gi", preferences=(
+        Requirements.of((wk.LABEL_ARCH, OP_IN, ["arm64"])),
+        Requirements.of((wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot"])),
+    ))
+    pr = prov(requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"]),
+        (wk.LABEL_ARCH, OP_IN, ["amd64", "arm64"]),
+    ))
+    res = assert_parity(catalog5(), [pr], [p])  # arm.4x has no spot offering
+    (node,) = res.nodes
+    assert node.option.itype.name == "arm.4x"
+    assert node.option.capacity_type == "on-demand"
+
+
+def test_parity_zone_split_keeps_resident_hostname_caps():
+    # the HA shape the origin-key plumbing exists for: zone spread AND
+    # hostname anti-affinity together. Residents carry the PRE-split spec;
+    # the zone-split subgroup must still count them on existing nodes.
+    spread = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),)
+
+    def pod(name):
+        return make_pod(name, cpu="1", memory="1Gi", topology=spread,
+                        anti_affinity_hostname=True)
+
+    # one resident replica per zone, each on a roomy node
+    existing = [
+        _existing_in_zone("node-a", "zone-1a", [pod("oa")]),
+        _existing_in_zone("node-b", "zone-1b", [pod("ob")]),
+        _existing_in_zone("node-c", "zone-1c", [pod("oc")]),
+    ]
+    new = [pod(f"n{i}") for i in range(3)]
+    res = assert_parity(catalog5(), [prov()], new, existing=existing)
+    # every new replica must open a FRESH node: all existing nodes already
+    # host one replica of the group (hostname anti-affinity cap = 1)
+    assert sum(res.existing_counts.values()) == 0
+    assert sum(n.pod_count for n in res.nodes) == 3
+    assert all(n.pod_count == 1 for n in res.nodes)

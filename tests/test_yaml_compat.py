@@ -175,12 +175,34 @@ spec:
         assert pdb.min_available == 8
         assert len(loaded.pods) == 10
 
-    def test_prefer_arm_soft_affinity_ignored(self):
+    def test_prefer_arm_lands_on_arm(self):
         loaded = self.load_workload("prefer-arm.yaml", replicas=2)
-        # preferred affinities are soft: pods parse with no hard arch req
-        assert loaded.pods[0].requirements.get(wk.LABEL_ARCH) is None
+        # preferred affinities parse as ordered soft terms (weight desc:
+        # arm64 at weight 50 before amd64 at weight 1), not hard reqs
+        pod = loaded.pods[0]
+        assert pod.requirements.get(wk.LABEL_ARCH) is None
+        assert len(pod.preferences) == 2
+        assert pod.preferences[0].get(wk.LABEL_ARCH).has("arm64")
+        assert pod.preferences[1].get(wk.LABEL_ARCH).has("amd64")
+        # general-purpose provisioner pins amd64 families: the arm64 term is
+        # infeasible, relaxation drops to the amd64 term, pods still schedule
         result = schedule_with_parity(loaded)
         assert result.unschedulable_count() == 0
+        # under a permissive provisioner the top-weight arm64 term is honored
+        # (reference semantics: prefer-arm lands on arm when arm is offered)
+        from karpenter_tpu.apis.provisioner import Provisioner
+        from karpenter_tpu.models.requirements import OP_IN, Requirements
+
+        prov = Provisioner(name="default", requirements=Requirements.of(
+            (wk.LABEL_ARCH, OP_IN, ["amd64", "arm64"])))
+        prov.set_defaults()
+        import dataclasses
+
+        loaded2 = dataclasses.replace(loaded, provisioners=[prov])
+        result2 = schedule_with_parity(loaded2)
+        assert result2.unschedulable_count() == 0
+        for n in result2.nodes:
+            assert "-arm" in n.option.itype.name
 
 
 class TestEndToEndManifestApply:
