@@ -29,7 +29,8 @@ from typing import Optional
 import yaml
 
 from ..models.cluster import PodDisruptionBudget
-from ..models.pod import PodSpec, Taint, Toleration, TopologySpreadConstraint
+from ..models.pod import (PodAffinityTerm, PodSpec, Taint, Toleration,
+                          TopologySpreadConstraint)
 from ..models.requirements import OP_IN, Requirement, Requirements
 from ..utils.quantity import cpu_millis, mem_bytes, count as count_qty
 from . import wellknown as wk
@@ -266,12 +267,41 @@ def _pod(metadata, spec, name: str = "", labels=None) -> PodSpec:
             topology_key=t["topologyKey"],
             when_unsatisfiable=t.get("whenUnsatisfiable", "DoNotSchedule"))
         for t in spec.get("topologySpreadConstraints") or ())
+    label_items = {str(k): str(v) for k, v in labels.items()}
+
+    def _term_selector(term) -> "tuple[tuple[str, str], ...]":
+        """labelSelector -> conjunctive matchLabels pairs (matchExpressions
+        with op In and a single value fold in; other operators are dropped —
+        documented approximation, they cannot narrow a conjunctive form)."""
+        sel = term.get("labelSelector") or {}
+        pairs = {str(k): str(v) for k, v in (sel.get("matchLabels") or {}).items()}
+        for expr in sel.get("matchExpressions") or ():
+            if expr.get("operator") == "In" and len(expr.get("values", [])) == 1:
+                pairs[str(expr["key"])] = str(expr["values"][0])
+        return tuple(sorted(pairs.items()))
+
+    def _is_self(sel_pairs) -> bool:
+        return all(label_items.get(k) == v for k, v in sel_pairs)
+
     anti = (spec.get("affinity") or {}).get("podAntiAffinity") or {}
     anti_host = anti_zone = False
+    anti_terms: "list[PodAffinityTerm]" = []
     for term in anti.get("requiredDuringSchedulingIgnoredDuringExecution") or ():
         key = term.get("topologyKey", "")
-        anti_host |= key == wk.LABEL_HOSTNAME
-        anti_zone |= key == wk.LABEL_ZONE
+        sel = _term_selector(term)
+        if _is_self(sel):
+            # selector matches this pod's own labels: self anti-affinity
+            anti_host |= key == wk.LABEL_HOSTNAME
+            anti_zone |= key == wk.LABEL_ZONE
+        elif key in (wk.LABEL_HOSTNAME, wk.LABEL_ZONE):
+            anti_terms.append(PodAffinityTerm(match_labels=sel, topology_key=key))
+    aff = (spec.get("affinity") or {}).get("podAffinity") or {}
+    aff_terms: "list[PodAffinityTerm]" = []
+    for term in aff.get("requiredDuringSchedulingIgnoredDuringExecution") or ():
+        key = term.get("topologyKey", "")
+        if key in (wk.LABEL_HOSTNAME, wk.LABEL_ZONE):
+            aff_terms.append(PodAffinityTerm(
+                match_labels=_term_selector(term), topology_key=key))
     raw = dict(requests)
     raw.setdefault("pods", 1)
     return PodSpec(
@@ -284,6 +314,8 @@ def _pod(metadata, spec, name: str = "", labels=None) -> PodSpec:
         topology=topology,
         anti_affinity_hostname=anti_host,
         anti_affinity_zone=anti_zone,
+        pod_affinity=tuple(aff_terms),
+        pod_anti_affinity=tuple(anti_terms),
         do_not_evict=(metadata.get("annotations") or {}).get(
             "karpenter.sh/do-not-evict", "") == "true",
     )

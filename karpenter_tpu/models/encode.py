@@ -442,4 +442,5 @@ def _ex_label_fit(e: ExistingNode, spec: PodSpec) -> bool:
     kernel handles capacity)."""
     from ..models.pod import tolerates_all
 
-    return tolerates_all(spec.tolerations, e.taints) and spec.requirements.matches_labels(e.labels)
+    return (tolerates_all(spec.tolerations, e.taints)
+            and spec.requirements.matches_labels(e.effective_labels()))

@@ -62,6 +62,26 @@ def tolerates_all(tolerations: "tuple[Toleration, ...]", taints: "tuple[Taint, .
 
 
 @dataclasses.dataclass(frozen=True)
+class PodAffinityTerm:
+    """One required pod-(anti-)affinity term: a label selector over PODS plus
+    the topology key whose domains are constrained.
+
+    Reference analogue: core scheduling's inter-pod affinity handling
+    (exercised by test/suites/integration/scheduling_test.go). Selector is
+    matchLabels-conjunctive (matchExpressions with op In are folded into the
+    same form by the manifest loader); the scheduler resolves terms against
+    resident and co-pending pods in a host pre-pass
+    (oracle/scheduler.py resolve_pod_affinity)."""
+
+    match_labels: "tuple[tuple[str, str], ...]" = ()
+    topology_key: str = wk.LABEL_HOSTNAME
+
+    def matches(self, labels: "tuple[tuple[str, str], ...]") -> bool:
+        d = dict(labels)
+        return all(d.get(k) == v for k, v in self.match_labels)
+
+
+@dataclasses.dataclass(frozen=True)
 class TopologySpreadConstraint:
     max_skew: int
     topology_key: str
@@ -91,6 +111,10 @@ class PodSpec:
     topology: "tuple[TopologySpreadConstraint, ...]" = ()
     anti_affinity_hostname: bool = False  # self anti-affinity on kubernetes.io/hostname
     anti_affinity_zone: bool = False
+    # required pod-(anti-)affinity with label selectors (self-selecting
+    # anti-affinity uses the booleans above; these carry cross-group terms)
+    pod_affinity: "tuple[PodAffinityTerm, ...]" = ()
+    pod_anti_affinity: "tuple[PodAffinityTerm, ...]" = ()
     priority: int = 0
     deletion_cost: int = 0
     owner_kind: str = "ReplicaSet"  # "" => bare pod; "DaemonSet" excluded from provisioning
@@ -129,6 +153,8 @@ class PodSpec:
             self.topology,
             self.anti_affinity_hostname,
             self.anti_affinity_zone,
+            self.pod_affinity,
+            self.pod_anti_affinity,
             # labels separate otherwise-identical deployments: topology spread
             # is approximated as "pods of my own group", so merging across
             # selectors would balance the union instead of each deployment

@@ -241,10 +241,20 @@ class ExistingNode:
     def zone(self) -> str:
         return self.labels.get(wk.LABEL_ZONE, "")
 
+    def effective_labels(self) -> "dict[str, str]":
+        """labels with the hostname defaulted to the node name — pod-affinity
+        pins target hostname, and kubelet always sets that label on real
+        nodes even when a fake/test node omits it."""
+        if wk.LABEL_HOSTNAME in self.labels:
+            return self.labels
+        d = dict(self.labels)
+        d[wk.LABEL_HOSTNAME] = self.name
+        return d
+
     def fits(self, group: PodSpec, vec: Sequence[int]) -> bool:
         if not tolerates_all(group.tolerations, self.taints):
             return False
-        if not group.requirements.matches_labels(self.labels):
+        if not group.requirements.matches_labels(self.effective_labels()):
             return False
         return all(u + v <= a for u, v, a in zip(self.used, vec, self.allocatable))
 
@@ -281,6 +291,121 @@ def _group_cap_per_node(spec: PodSpec) -> Optional[int]:
     return cap
 
 
+def resolve_pod_affinity(groups: "list[PodGroup]", zones: Sequence[str],
+                         existing: "Sequence[ExistingNode]" = ()) -> "list[PodGroup]":
+    """Pre-pass: required pod-(anti-)affinity terms -> node requirements.
+
+    Runs BEFORE zone splitting so affinity-derived zone constraints narrow
+    the spread domains. Semantics (approximating core inter-pod affinity,
+    test/suites/integration/scheduling_test.go):
+
+    - zone AFFINITY: the pod may only go to zones that hold a matching
+      resident pod, or zones a matching co-pending group can land in (its
+      explicit zone requirement, else any zone). No candidates at all =>
+      unschedulable (pinned to the sentinel zone), matching k8s required
+      semantics. A selector matching the group's own labels is satisfiable
+      anywhere the group itself can go (the k8s first-pod bootstrap rule).
+    - hostname AFFINITY: with matching residents, pin to those nodes
+      (hostname In [...] — fresh options carry no hostname, so only those
+      nodes fit). With matching co-pending pods, no hard pin is derivable
+      pre-solve; FFD packing co-locates best-effort (documented gap).
+    - zone/hostname ANTI-affinity with a non-self selector: exclude the
+      domains that hold matching residents (NotIn; fresh options lack the
+      hostname key, so NotIn admits them). Anti-affinity BETWEEN co-pending
+      groups is not expressible in the group-scan model (documented gap);
+      self-selecting anti-affinity uses the anti_affinity_* booleans.
+    """
+    has_terms = any(g.spec.pod_affinity or g.spec.pod_anti_affinity
+                    for g in groups)
+    if not has_terms:
+        return groups
+
+    def pending_zones(term) -> "tuple[set[str], bool]":
+        """(zones matching co-pending groups can use, any_match)."""
+        out: "set[str]" = set()
+        any_match = False
+        for og in groups:
+            if not term.matches(og.spec.labels):
+                continue
+            any_match = True
+            zreq = og.spec.requirements.get(wk.LABEL_ZONE)
+            out |= {z for z in zones if zreq is None or zreq.has(z)}
+        return out, any_match
+
+    out: "list[PodGroup]" = []
+    for g in groups:
+        spec = g.spec
+        if not spec.pod_affinity and not spec.pod_anti_affinity:
+            out.append(g)
+            continue
+        reqs = spec.requirements.copy()
+        feasible = True
+        for term in spec.pod_affinity:
+            if term.topology_key == wk.LABEL_ZONE:
+                cand = {e.zone() for e in existing
+                        if any(term.matches(p.labels) for p in e.resident)}
+                pend, any_pend = pending_zones(term)
+                cand |= pend
+                cand &= set(zones)
+                if not cand:
+                    feasible = False
+                    break
+                if cand != set(zones):
+                    try:
+                        reqs.add(Requirement.create(
+                            wk.LABEL_ZONE, OP_IN, sorted(cand)))
+                    except IncompatibleError:
+                        feasible = False
+                        break
+            elif term.topology_key == wk.LABEL_HOSTNAME:
+                hosts = sorted(
+                    e.labels.get(wk.LABEL_HOSTNAME, e.name) for e in existing
+                    if any(term.matches(p.labels) for p in e.resident))
+                if hosts:
+                    try:
+                        reqs.add(Requirement.create(
+                            wk.LABEL_HOSTNAME, OP_IN, hosts))
+                    except IncompatibleError:
+                        feasible = False
+                        break
+                elif not term.matches(spec.labels) \
+                        and not pending_zones(term)[1]:
+                    feasible = False  # nothing to co-locate with anywhere
+                    break
+        for term in spec.pod_anti_affinity:
+            if not feasible:
+                break
+            if term.topology_key == wk.LABEL_ZONE:
+                forbidden = sorted(
+                    {e.zone() for e in existing
+                     if any(term.matches(p.labels) for p in e.resident)})
+                if forbidden:
+                    try:
+                        reqs.add(Requirement.create(
+                            wk.LABEL_ZONE, "NotIn", forbidden))
+                    except IncompatibleError:
+                        feasible = False
+                        break
+            elif term.topology_key == wk.LABEL_HOSTNAME:
+                forbidden = sorted(
+                    e.labels.get(wk.LABEL_HOSTNAME, e.name) for e in existing
+                    if any(term.matches(p.labels) for p in e.resident))
+                if forbidden:
+                    try:
+                        reqs.add(Requirement.create(
+                            wk.LABEL_HOSTNAME, "NotIn", forbidden))
+                    except IncompatibleError:
+                        feasible = False
+                        break
+        if not feasible:
+            reqs = Requirements.of((wk.LABEL_ZONE, OP_IN, ["__no-zone__"]))
+        new_spec = dataclasses.replace(g.spec, requirements=reqs,
+                                       spread_origin=g.spec.origin_key())
+        out.append(PodGroup(spec=new_spec, count=g.count,
+                            pod_names=g.pod_names))
+    return out
+
+
 def split_zone_spread(groups: "list[PodGroup]", zones: Sequence[str],
                       existing: "Sequence[ExistingNode]" = ()) -> "list[PodGroup]":
     """Pre-pass: groups with a zone topology-spread constraint are split into
@@ -315,7 +440,9 @@ def split_zone_spread(groups: "list[PodGroup]", zones: Sequence[str],
             out.append(g)
             continue
         # domain population: pods of this group already resident per zone
-        gkey = g.spec.group_key()
+        # (ORIGIN key: an earlier pre-pass, e.g. pod-affinity resolution,
+        # may have rewritten the spec, while residents keep the original)
+        gkey = g.spec.origin_key()
         resident = {z: 0 for z in allowed}
         for e in existing:
             ez = e.zone()
@@ -373,6 +500,7 @@ def prepare_groups(pods: "list[PodSpec]", zones: Sequence[str],
     (models/encode.py) so group ordering — which FFD results depend on —
     is identical on both paths."""
     groups = group_pods([p for p in pods if not p.is_daemon()])
+    groups = resolve_pod_affinity(groups, zones, existing)
     groups = split_zone_spread(groups, zones, existing)
     groups.sort(key=lambda g: (
         -g.vector[wk.RESOURCE_INDEX[wk.RESOURCE_CPU]],

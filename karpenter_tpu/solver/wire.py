@@ -13,8 +13,8 @@ import hashlib
 
 from ..apis.provisioner import KubeletConfiguration, Limits, Provisioner
 from ..models.instancetype import Catalog, InstanceType, Offering, Offerings
-from ..models.pod import (PodSpec, Taint, Toleration, TopologySpreadConstraint,
-                          group_pods)
+from ..models.pod import (PodAffinityTerm, PodSpec, Taint, Toleration,
+                          TopologySpreadConstraint, group_pods)
 from ..models.requirements import Requirement, Requirements
 from ..oracle.scheduler import ExistingNode
 from . import solver_pb2 as pb
@@ -76,6 +76,12 @@ def pod_to_wire(p: PodSpec) -> pb.PodSpecMsg:
         node_name=p.node_name,
         preferences=[pb.RequirementsTerm(requirements=reqs_to_wire(t))
                      for t in p.preferences],
+        pod_affinity=[pb.PodAffinityTermSpec(match_labels=_kvs(t.match_labels),
+                                             topology_key=t.topology_key)
+                      for t in p.pod_affinity],
+        pod_anti_affinity=[pb.PodAffinityTermSpec(
+            match_labels=_kvs(t.match_labels), topology_key=t.topology_key)
+            for t in p.pod_anti_affinity],
     )
 
 
@@ -101,6 +107,16 @@ def pod_from_wire(m: pb.PodSpecMsg) -> PodSpec:
         node_name=m.node_name,
         preferences=tuple(reqs_from_wire(t.requirements)
                           for t in m.preferences),
+        pod_affinity=tuple(
+            PodAffinityTerm(
+                match_labels=tuple((kv.key, kv.value) for kv in t.match_labels),
+                topology_key=t.topology_key)
+            for t in m.pod_affinity),
+        pod_anti_affinity=tuple(
+            PodAffinityTerm(
+                match_labels=tuple((kv.key, kv.value) for kv in t.match_labels),
+                topology_key=t.topology_key)
+            for t in m.pod_anti_affinity),
     )
 
 

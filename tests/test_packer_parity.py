@@ -406,3 +406,74 @@ def test_parity_zone_split_keeps_resident_hostname_caps():
     assert sum(res.existing_counts.values()) == 0
     assert sum(n.pod_count for n in res.nodes) == 3
     assert all(n.pod_count == 1 for n in res.nodes)
+
+
+def test_parity_pod_affinity_zone_follows_existing():
+    from karpenter_tpu.models.pod import PodAffinityTerm
+
+    backend = make_pod("db0", cpu="1", memory="1Gi",
+                       labels=(("app", "db"),))
+    existing = [_existing_in_zone("node-b", "zone-1b", [backend])]
+    follower = make_pod("web0", cpu="1", memory="1Gi", pod_affinity=(
+        PodAffinityTerm(match_labels=(("app", "db"),),
+                        topology_key=wk.LABEL_ZONE),))
+    res = assert_parity(catalog5(), [prov()], [follower], existing=existing)
+    placed_existing = sum(res.existing_counts.values())
+    zones = [n.option.zone for n in res.nodes]
+    # lands in zone-1b: either on node-b itself or a fresh zone-1b node
+    assert placed_existing == 1 or zones == ["zone-1b"]
+    assert res.unschedulable_count() == 0
+
+
+def test_parity_pod_affinity_unsatisfiable_is_unschedulable():
+    from karpenter_tpu.models.pod import PodAffinityTerm
+
+    lonely = make_pod("web0", cpu="1", memory="1Gi", pod_affinity=(
+        PodAffinityTerm(match_labels=(("app", "nonexistent"),),
+                        topology_key=wk.LABEL_ZONE),))
+    res = assert_parity(catalog5(), [prov()], [lonely])
+    assert res.unschedulable_count() == 1
+
+
+def test_parity_pod_affinity_hostname_pins_to_node():
+    from karpenter_tpu.models.pod import PodAffinityTerm
+
+    backend = make_pod("db0", cpu="1", memory="1Gi", labels=(("app", "db"),))
+    existing = [
+        _existing_in_zone("node-a", "zone-1a"),
+        _existing_in_zone("node-b", "zone-1b", [backend]),
+    ]
+    follower = make_pod("web0", cpu="1", memory="1Gi", pod_affinity=(
+        PodAffinityTerm(match_labels=(("app", "db"),),
+                        topology_key=wk.LABEL_HOSTNAME),))
+    res = assert_parity(catalog5(), [prov()], [follower], existing=existing)
+    assert res.existing_counts == {"node-b": 1}
+    assert not res.nodes
+
+
+def test_parity_pod_anti_affinity_zone_avoids_matching_domain():
+    from karpenter_tpu.models.pod import PodAffinityTerm
+
+    noisy = make_pod("noisy0", cpu="1", memory="1Gi", labels=(("app", "noisy"),))
+    existing = [_existing_in_zone("node-a", "zone-1a", [noisy])]
+    quiet = make_pod("quiet0", cpu="1", memory="1Gi", pod_anti_affinity=(
+        PodAffinityTerm(match_labels=(("app", "noisy"),),
+                        topology_key=wk.LABEL_ZONE),))
+    res = assert_parity(catalog5(), [prov()], [quiet], existing=existing)
+    assert sum(res.existing_counts.values()) == 0
+    (node,) = res.nodes
+    assert node.option.zone != "zone-1a"
+
+
+def test_parity_pod_anti_affinity_hostname_avoids_node_not_zone():
+    from karpenter_tpu.models.pod import PodAffinityTerm
+
+    noisy = make_pod("noisy0", cpu="1", memory="1Gi", labels=(("app", "noisy"),))
+    existing = [_existing_in_zone("node-a", "zone-1a", [noisy])]
+    quiet = make_pod("quiet0", cpu="1", memory="1Gi", pod_anti_affinity=(
+        PodAffinityTerm(match_labels=(("app", "noisy"),),
+                        topology_key=wk.LABEL_HOSTNAME),))
+    res = assert_parity(catalog5(), [prov()], [quiet], existing=existing)
+    # refused node-a, but a fresh node (any zone, incl. 1a) is fine
+    assert sum(res.existing_counts.values()) == 0
+    assert sum(n.pod_count for n in res.nodes) == 1

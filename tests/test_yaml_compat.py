@@ -267,3 +267,46 @@ class TestPodRequests:
 
         r = _pod_requests([{"resources": {"limits": {"nvidia.com/gpu": 2}}}])
         assert r["nvidia.com/gpu"] == 2
+
+
+class TestPodAffinityParsing:
+    def test_required_pod_affinity_and_cross_group_anti(self):
+        from karpenter_tpu.apis.yaml_compat import load_manifests
+
+        loaded = load_manifests("""
+apiVersion: v1
+kind: Pod
+metadata:
+  name: web
+  labels: {app: web}
+spec:
+  affinity:
+    podAffinity:
+      requiredDuringSchedulingIgnoredDuringExecution:
+      - labelSelector:
+          matchLabels: {app: db}
+        topologyKey: topology.kubernetes.io/zone
+    podAntiAffinity:
+      requiredDuringSchedulingIgnoredDuringExecution:
+      - labelSelector:
+          matchLabels: {app: web}
+        topologyKey: kubernetes.io/hostname
+      - labelSelector:
+          matchExpressions:
+          - {key: app, operator: In, values: [noisy]}
+        topologyKey: topology.kubernetes.io/zone
+  containers:
+  - name: c
+    resources: {requests: {cpu: "1"}}
+""")
+        (pod,) = loaded.pods
+        # app=db affinity -> cross-group term
+        (aff,) = pod.pod_affinity
+        assert aff.match_labels == (("app", "db"),)
+        assert aff.topology_key == wk.LABEL_ZONE
+        # app=web (self) hostname anti-affinity -> boolean
+        assert pod.anti_affinity_hostname
+        # app=noisy (cross-group) zone anti-affinity -> term
+        (anti,) = pod.pod_anti_affinity
+        assert anti.match_labels == (("app", "noisy"),)
+        assert anti.topology_key == wk.LABEL_ZONE
