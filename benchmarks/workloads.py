@@ -1,0 +1,50 @@
+"""Shared benchmark workload generators.
+
+One definition of the headline mixed workload, used by bench.py (the driver
+entry), hack/tpu_capture.py (opportunistic on-chip capture), and the scale
+ladder in benchmarks/baseline_configs.py, so every recorded number is over
+the same pod population shape.
+
+Reference analogue: the reference's benchmark fixtures are generated once
+and shared across scales (/root/reference/pkg/controllers/interruption/
+interruption_benchmark_test.go:61-76 reuses one message factory).
+"""
+
+from __future__ import annotations
+
+# (name, share out of 10_000, cpu, memory, zone-pin, zone-spread?)
+_DEPLOYMENTS = [
+    ("web", 3000, "500m", "1Gi", None, True),
+    ("api", 2000, "1", "2Gi", None, False),
+    ("cache", 1000, "2", "8Gi", None, False),
+    ("batch", 1500, "250m", "512Mi", None, False),
+    ("etl", 800, "4", "8Gi", None, False),
+    ("zone-a", 700, "1", "1Gi", "zone-1a", False),
+    ("zone-b", 500, "1", "1Gi", "zone-1b", False),
+    ("mem", 500, "500m", "4Gi", None, False),
+]
+
+
+def mixed_workload(n: int) -> list:
+    """`n` pods in the headline 8-deployment mix (zone selectors + one
+    zone-spread deployment), scaled proportionally from the 10k shape.
+    mixed_workload(10_000) reproduces bench.py's original workload exactly."""
+    from karpenter_tpu.apis import wellknown as wk
+    from karpenter_tpu.models.pod import TopologySpreadConstraint, make_pod
+
+    spread = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),)
+    counts = [max(0, round(n * share / 10_000)) for _, share, *_ in _DEPLOYMENTS]
+    counts[0] += n - sum(counts)  # rounding remainder lands on the largest
+
+    pods = []
+    for (name, _, cpu, mem, zone, has_spread), count in zip(_DEPLOYMENTS, counts):
+        sel = {"topology.kubernetes.io/zone": zone} if zone else {}
+        # re-key via wellknown to survive label constant changes
+        if zone:
+            sel = {wk.LABEL_ZONE: zone}
+        topo = spread if has_spread else ()
+        for i in range(count):
+            pods.append(make_pod(f"{name}-{i}", cpu=cpu, memory=mem,
+                                 node_selector=dict(sel), topology=topo))
+    assert len(pods) == n, (len(pods), n)
+    return pods

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Persistent opportunistic TPU capture (VERDICT r2 ask #1).
+
+The deployment tunnel to the real chip is flaky: one-shot probing at a fixed
+instant (bench.py rounds 1-2) missed it two rounds running. This tool makes
+capture a *process*, not an event:
+
+  --once   probe; if the tunnel answers, run the capture suite and record it.
+  --loop   run forever: probe on a backoff schedule across the whole round,
+           capture whenever a probe succeeds, re-capture every
+           --recapture-s to keep the freshest number, survive wedges (the
+           capture itself runs in a subprocess with a hard timeout).
+
+Each successful capture writes benchmarks/results/tpu_<utc>.json:
+
+  {"captured_at": ..., "headline": {p50_ms @ 10k pods x ~600 types, ...},
+   "sweep": [{"n_pods": N, "tpu_p50_ms": ..., "native_p50_ms": ...}, ...],
+   "crossover_pods": N}   # smallest size where the device beats the C++ host
+                          # scan — the routing threshold for
+                          # controllers/provisioning.py size-based routing
+
+bench.py reports the most recent of these files alongside its live number,
+so the driver's BENCH_r{N}.json always carries the best chip evidence the
+round produced even if the tunnel is down at collection time.
+
+Reference analogue: the scale ladder of
+/root/reference/pkg/controllers/interruption/interruption_benchmark_test.go:61-76
+(recorded numbers, not one-off prints).
+"""
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS_DIR = os.path.join(REPO, "benchmarks", "results")
+SWEEP_SIZES = (100, 300, 1000, 3000, 10000)
+
+
+def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
+    """Run inside the pinned-to-axon subprocess: headline + crossover sweep."""
+    sys.path.insert(0, REPO)
+    from karpenter_tpu.utils.jaxenv import pin
+
+    jax, _ = pin("axon")
+    backend = jax.devices()[0].platform
+
+    from benchmarks.workloads import mixed_workload
+    from karpenter_tpu.apis import wellknown as wk
+    from karpenter_tpu.apis.provisioner import Provisioner
+    from karpenter_tpu.models.requirements import OP_IN, Requirements
+    from karpenter_tpu.providers.instancetypes import generate_fleet_catalog
+    from karpenter_tpu.solver.core import NativeSolver, TPUSolver
+
+    catalog = generate_fleet_catalog()
+    prov = Provisioner(name="default", requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"]),
+        (wk.LABEL_ARCH, OP_IN, ["amd64", "arm64"]),
+    ))
+    prov.set_defaults()
+    tpu = TPUSolver(catalog, [prov])
+    native = NativeSolver(catalog, [prov])
+
+    def p50(solver, pods, reps):
+        solver.solve(pods)  # warmup: compile/grid-build outside the clock
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            solver.solve(pods)
+            times.append((time.perf_counter() - t0) * 1000)
+        return round(statistics.median(times), 3), times
+
+    sweep = []
+    for n in SWEEP_SIZES:
+        pods = mixed_workload(n)
+        t_tpu, _ = p50(tpu, pods, reps_sweep)
+        t_nat, _ = p50(native, pods, reps_sweep)
+        sweep.append({"n_pods": n, "tpu_p50_ms": t_tpu, "native_p50_ms": t_nat})
+
+    pods = mixed_workload(10_000)
+    head_p50, times = p50(tpu, pods, reps_headline)
+    res = tpu.solve(pods)
+
+    crossover = None
+    for row in sweep:  # smallest size where the device wins
+        if row["tpu_p50_ms"] < row["native_p50_ms"]:
+            crossover = row["n_pods"]
+            break
+
+    return {
+        "backend": backend,
+        "headline": {
+            "metric": "scheduling_cycle_p50_ms_10k_pods_600_types",
+            "p50_ms": head_p50,
+            "p_min_ms": round(min(times), 3),
+            "p_max_ms": round(max(times), 3),
+            "reps": len(times),
+            "n_types": len(catalog.types),
+            "n_pods": len(pods),
+            "nodes_provisioned": len(res.nodes),
+            "unschedulable": res.unschedulable_count(),
+        },
+        "sweep": sweep,
+        "crossover_pods": crossover,
+    }
+
+
+def latest_capture() -> "dict | None":
+    """Most recent recorded capture, or None. Shared with bench.py."""
+    try:
+        names = sorted(n for n in os.listdir(RESULTS_DIR)
+                       if n.startswith("tpu_") and n.endswith(".json"))
+    except FileNotFoundError:
+        return None
+    for name in reversed(names):
+        try:
+            with open(os.path.join(RESULTS_DIR, name)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if rec.get("degraded"):
+            continue
+        return rec
+    return None
+
+
+def capture_once(timeout_s: int, reps_headline: int, reps_sweep: int) -> "dict | None":
+    """Probe + capture in a killable subprocess. Returns the record or None."""
+    from karpenter_tpu.utils.jaxenv import probe_tpu
+
+    ok, note = probe_tpu(attempts=1, timeout_s=90)
+    if not ok:
+        print(f"probe failed: {note}", file=sys.stderr)
+        return None
+    code = (f"import sys, json; sys.path.insert(0, {REPO!r})\n"
+            "from hack.tpu_capture import _capture_payload\n"
+            f"print('CAPTURE::' + json.dumps(_capture_payload({reps_headline}, {reps_sweep})))")
+    env = dict(os.environ, JAX_PLATFORMS="axon")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                           capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"capture wedged; killed after {timeout_s}s", file=sys.stderr)
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("CAPTURE::"):
+            rec = json.loads(line[len("CAPTURE::"):])
+            ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            rec["captured_at"] = ts
+            rec["device"] = "tunneled TPU (platform=axon)"
+            os.makedirs(RESULTS_DIR, exist_ok=True)
+            path = os.path.join(RESULTS_DIR, f"tpu_{ts}.json")
+            with open(path, "w") as f:
+                json.dump(rec, f, indent=1)
+            print(f"captured -> {path}")
+            return rec
+    print(f"capture failed rc={r.returncode}: {(r.stderr or r.stdout)[-300:]}",
+          file=sys.stderr)
+    return None
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--loop", action="store_true",
+                    help="probe/capture forever on a backoff schedule")
+    ap.add_argument("--probe-interval-s", type=int, default=300,
+                    help="base wait between failed probes (doubles to max 30m)")
+    ap.add_argument("--recapture-s", type=int, default=7200,
+                    help="refresh a successful capture this often")
+    ap.add_argument("--capture-timeout-s", type=int, default=1800)
+    ap.add_argument("--reps-headline", type=int, default=20)
+    ap.add_argument("--reps-sweep", type=int, default=5)
+    args = ap.parse_args()
+
+    sys.path.insert(0, REPO)
+    if not args.loop:
+        rec = capture_once(args.capture_timeout_s, args.reps_headline,
+                           args.reps_sweep)
+        sys.exit(0 if rec else 1)
+
+    wait = args.probe_interval_s
+    while True:
+        rec = capture_once(args.capture_timeout_s, args.reps_headline,
+                           args.reps_sweep)
+        if rec:
+            wait = args.probe_interval_s
+            time.sleep(args.recapture_s)
+        else:
+            time.sleep(wait)
+            wait = min(wait * 2, 1800)
+
+
+if __name__ == "__main__":
+    main()

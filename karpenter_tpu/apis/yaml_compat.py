@@ -288,13 +288,23 @@ def _pod(metadata, spec, name: str = "", labels=None) -> PodSpec:
     anti_terms: "list[PodAffinityTerm]" = []
     for term in anti.get("requiredDuringSchedulingIgnoredDuringExecution") or ():
         key = term.get("topologyKey", "")
+        if key not in (wk.LABEL_HOSTNAME, wk.LABEL_ZONE):
+            continue
         sel = _term_selector(term)
         if _is_self(sel):
-            # selector matches this pod's own labels: self anti-affinity
+            # selector matches this pod's own labels: self anti-affinity.
+            # An empty/absent labelSelector lands here too (k8s: matches ALL
+            # pods) — the cross-group term below then carries the
+            # exclude-every-occupied-domain half of that semantics.
             anti_host |= key == wk.LABEL_HOSTNAME
             anti_zone |= key == wk.LABEL_ZONE
-        elif key in (wk.LABEL_HOSTNAME, wk.LABEL_ZONE):
-            anti_terms.append(PodAffinityTerm(match_labels=sel, topology_key=key))
+        # self-spread and cross-group exclusion are NOT mutually exclusive:
+        # the same selector can also match other deployments' pods (e.g.
+        # {app: x} with foreign app=x residents), so the term always joins
+        # the cross-group exclusion list (resolve_pod_affinity); for the
+        # matches-self case the resident-count caps make it redundant but
+        # never conflicting.
+        anti_terms.append(PodAffinityTerm(match_labels=sel, topology_key=key))
     aff = (spec.get("affinity") or {}).get("podAffinity") or {}
     aff_terms: "list[PodAffinityTerm]" = []
     for term in aff.get("requiredDuringSchedulingIgnoredDuringExecution") or ():

@@ -258,6 +258,9 @@ class EncodedProblem:
     prov_pods_cap: "Optional[np.ndarray]" = None  # i32 [Pv, T]
     # remaining per-(group, existing-node) cap; None when no group is capped
     ex_cap: "Optional[np.ndarray]" = None  # i32 [G, Ne]
+    # origin-representative row per group (first row sharing origin_key):
+    # zone-split subgroups of one deployment share one per-node cap budget
+    group_origin: "Optional[np.ndarray]" = None  # i32 [G]
 
 
 def encode_problem(
@@ -291,6 +294,15 @@ def encode_problem(
         ex_used[ei] = np.minimum(e.used, INT_BIG)
 
     prov_overhead, prov_pods_cap = kubelet_arrays(provs, catalog)
+
+    # Subgroups sharing an origin (ScheduleAnyway zone splits differ only in
+    # soft preferences) consume ONE per-node cap budget — the kernel's carried
+    # ex_placed/claim_placed counters are keyed by this representative row,
+    # mirroring the oracle's origin-keyed group_counts.
+    group_origin = np.arange(max(G, 1), dtype=np.int32)
+    first_by_origin: "dict[object, int]" = {}
+    for gi, g in enumerate(groups):
+        group_origin[gi] = first_by_origin.setdefault(g.spec.origin_key(), gi)
 
     cols = grid.get_cols()
     for gi, g in enumerate(groups):
@@ -361,7 +373,7 @@ def encode_problem(
         n_slots=n_slots,
         groups=groups, provisioners=list(provs), grid=grid,
         prov_overhead=prov_overhead, prov_pods_cap=prov_pods_cap,
-        ex_cap=ex_cap,
+        ex_cap=ex_cap, group_origin=group_origin,
     )
 
 

@@ -63,7 +63,7 @@ def _load():
         lib.kt_pack.restype = ctypes.c_int
         lib.kt_pack.argtypes = (
             [i32p, i32p, i32p, i32p, i32p, u8p, i32p, i32p, i32p, i32p, u8p]
-            + [i32p]                       # ex_cap (nullable)
+            + [i32p, i32p]                 # ex_cap, group_origin (nullable)
             + [i32p, i32p, ctypes.c_int]   # prov_overhead, prov_pods_cap, pods_i
             + [ctypes.c_int] * 7
             + [i32p, i32p, i32p, u8p, i32p, i32p, i32p]
@@ -105,6 +105,8 @@ def native_pack(inputs, n_slots: int):
     ex_feas = _u8(inputs.ex_feas)
     ex_cap = getattr(inputs, "ex_cap", None)
     ex_cap = None if ex_cap is None else _i32(ex_cap)
+    group_origin = getattr(inputs, "group_origin", None)
+    group_origin = None if group_origin is None else _i32(group_origin)
     prov_overhead = getattr(inputs, "prov_overhead", None)
     prov_pods_cap = getattr(inputs, "prov_pods_cap", None)
     prov_overhead = None if prov_overhead is None else _i32(prov_overhead)
@@ -131,6 +133,7 @@ def native_pack(inputs, n_slots: int):
         _ptr(group_cap), _ptr(group_feas), _ptr(group_newprov), _ptr(overhead),
         _ptr(ex_alloc), _ptr(ex_used), _ptr(ex_feas),
         null_i32 if ex_cap is None else _ptr(ex_cap),
+        null_i32 if group_origin is None else _ptr(group_origin),
         null_i32 if prov_overhead is None else _ptr(prov_overhead),
         null_i32 if prov_pods_cap is None else _ptr(prov_pods_cap),
         wk.RESOURCE_INDEX[wk.RESOURCE_PODS],

@@ -77,6 +77,10 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
             const int32_t* ex_cap,       // [G,Ne] or nullptr (remaining group
                                          //   cap per existing node, resident
                                          //   pods already subtracted)
+            const int32_t* group_origin, // [G] or nullptr (origin row whose
+                                         //   per-node cap budget this row
+                                         //   shares; zone-split subgroups of
+                                         //   one deployment share one budget)
             const int32_t* prov_overhead,// [Pv,R] or nullptr (kubelet reserved)
             const int32_t* prov_pods_cap,// [Pv,T] or nullptr (kubelet pods cap)
             int pods_i,                  // index of the pods resource on R
@@ -95,6 +99,10 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
   std::vector<int32_t> q_nt(static_cast<size_t>(N));   // per-node best quotient
   std::vector<int32_t> qt(static_cast<size_t>(T));     // per-type quotient scratch
   std::vector<int32_t> m_n(static_cast<size_t>(N));
+  // in-run pods placed per (origin row, node): the shared cap budget consumed
+  // so far by all subgroups of an origin (oracle group_counts under okey)
+  std::vector<int32_t> ex_placed(static_cast<size_t>(G) * Ne, 0);
+  std::vector<int32_t> claim_placed(static_cast<size_t>(G) * N, 0);
   int32_t n_open = 0;
 
   std::memset(assign, 0, sizeof(int32_t) * G * N);
@@ -106,6 +114,7 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
   for (int g = 0; g < G; ++g) {
     const int32_t* vec = group_vec + static_cast<size_t>(g) * R;
     const int32_t cap = group_cap[g];
+    const int og = group_origin ? group_origin[g] : g;
     int64_t rem = group_count[g];
 
     // ---- 1) existing nodes, first-fit in index order ------------------------
@@ -116,12 +125,16 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
         avail[r] = ex_alloc[static_cast<size_t>(e) * R + r] -
                    ex_used[static_cast<size_t>(e) * R + r];
       int64_t fill = quotient(avail.data(), vec, R);
+      // remaining cap: static residual minus pods placed in-run by any
+      // subgroup sharing the origin (oracle: resident + group_counts[okey])
       const int64_t cap_e =
-          ex_cap ? ex_cap[static_cast<size_t>(g) * Ne + e] : cap;
+          (ex_cap ? ex_cap[static_cast<size_t>(g) * Ne + e] : cap) -
+          ex_placed[static_cast<size_t>(og) * Ne + e];
       if (fill > cap_e) fill = cap_e;
       if (fill <= 0) continue;
       if (fill > rem) fill = rem;
       ex_assign[static_cast<size_t>(g) * Ne + e] = static_cast<int32_t>(fill);
+      ex_placed[static_cast<size_t>(og) * Ne + e] += static_cast<int32_t>(fill);
       for (int r = 0; r < R; ++r)
         ex_used[static_cast<size_t>(e) * R + r] += static_cast<int32_t>(fill) * vec[r];
       rem -= fill;
@@ -158,10 +171,14 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
         if (qt[t] > qmax) qmax = qt[t];
       }
       q_nt[n] = qmax;
-      int64_t fill = qmax > cap ? cap : qmax;
+      // per-claim budget shared across subgroups of the origin
+      const int64_t cap_n =
+          static_cast<int64_t>(cap) - claim_placed[static_cast<size_t>(og) * N + n];
+      int64_t fill = qmax > cap_n ? cap_n : qmax;
       if (fill <= 0) continue;
       if (fill > rem) fill = rem;
       m_n[n] = static_cast<int32_t>(fill);
+      claim_placed[static_cast<size_t>(og) * N + n] += m_n[n];
       rem -= fill;
       // place + shrink option mask: survive iff feasible for this group AND
       // the type still fits the node's new load (q_nt >= m_n)
@@ -238,6 +255,7 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
       active[n] = 1;
       nprov[n] = p;
       assign[static_cast<size_t>(g) * N + n] += static_cast<int32_t>(cnt);
+      claim_placed[static_cast<size_t>(og) * N + n] += static_cast<int32_t>(cnt);
       rem -= cnt;
     }
     n_open += static_cast<int32_t>(n_new);

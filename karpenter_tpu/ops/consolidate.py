@@ -121,6 +121,10 @@ def encode_consolidation(
     group_feas = np.zeros((C, Gb, Pv, T, S), dtype=bool)
     group_newprov = np.full((C, Gb), -1, dtype=np.int32)
     ex_feas = np.zeros((C, Gb, Ne), dtype=bool)
+    # origin-representative rows: zone-split subgroups share one per-node cap
+    # budget (identity for padded/unsplit rows — see encode_problem)
+    group_origin = np.broadcast_to(
+        np.arange(Gb, dtype=np.int32), (C, Gb)).copy()
     n_groups = []
 
     # label/taint fit of a pod-group against an existing node, memoized: the
@@ -145,6 +149,10 @@ def encode_consolidation(
     for ci, (cand, cheaper_opt, groups, survivors) in enumerate(per_cand):
         n_groups.append(len(groups))
         res_by_name = {e.name: e.resident_counts for e in survivors}
+        first_by_origin: "dict[object, int]" = {}
+        for gi, g in enumerate(groups):
+            group_origin[ci, gi] = first_by_origin.setdefault(
+                g.spec.origin_key(), gi)
         for gi, g in enumerate(groups):
             gkey = (g.spec.group_key(), cheaper_opt.tobytes())
             enc = feas_cache.get(gkey)
@@ -187,7 +195,7 @@ def encode_consolidation(
         ex_alloc=ex_alloc, ex_used=np.broadcast_to(ex_used, (C, Ne, R)).copy(),
         ex_feas=ex_feas,
         prov_overhead=prov_overhead, prov_pods_cap=prov_pods_cap,
-        ex_cap=ex_cap_arr,
+        ex_cap=ex_cap_arr, group_origin=group_origin,
     )
     return ConsolidationBatch(inputs, candidates, provs, grid, n_groups)
 
@@ -200,6 +208,7 @@ def _batched_pack(inputs: PackInputs, n_slots: int):
         overhead=None, ex_alloc=None, ex_used=0, ex_feas=0,
         prov_overhead=None, prov_pods_cap=None,  # shared across candidates
         ex_cap=None if inputs.ex_cap is None else 0,
+        group_origin=None if inputs.group_origin is None else 0,
     )
     return jax.vmap(lambda inp: pack_impl(inp, n_slots), in_axes=(axes,))(inputs)
 

@@ -92,6 +92,13 @@ class PackInputs(NamedTuple):
     # must count residents — designs/bin-packing.md domain counting). None
     # when no group is capped (common case: compiled program unchanged).
     ex_cap: "jax.Array | None" = None  # i32 [G, Ne]
+    # Origin-representative row per group: subgroups produced by zone-split
+    # pre-passes (notably ScheduleAnyway soft splits, whose hard requirements
+    # are identical) must SHARE one per-node cap budget, matching the oracle's
+    # origin-keyed group_counts. group_origin[g] is the first row index with
+    # the same origin_key; None => every row is its own origin (identity),
+    # which is exact whenever no group is capped or no origins are shared.
+    group_origin: "jax.Array | None" = None  # i32 [G]
 
 
 class PackState(NamedTuple):
@@ -101,6 +108,10 @@ class PackState(NamedTuple):
     active: jax.Array    # bool [N]
     n_open: jax.Array    # i32 []
     ex_used: jax.Array   # i32 [Ne, R]
+    # in-run pods placed per (origin row, node): the shared cap budget
+    # consumed so far by ALL subgroups of an origin (oracle group_counts)
+    ex_placed: jax.Array     # i32 [G, Ne]
+    claim_placed: jax.Array  # i32 [G, N]
 
 
 class PackResult(NamedTuple):
@@ -150,15 +161,21 @@ def _step(inputs: PackInputs, state: PackState, g: jax.Array,
     vec = inputs.group_vec[g]          # [R]
     cap = inputs.group_cap[g]          # []
     count = inputs.group_count[g]      # []
+    # origin row whose cap budget this row consumes (identity when absent)
+    og = g if inputs.group_origin is None else inputs.group_origin[g]
 
     # ---- 1) existing nodes (oracle step "existing first") --------------------
     q_ex = _quotient(inputs.ex_alloc - state.ex_used, vec)        # [Ne]
-    # per-node remaining cap counts pods already resident on the node
+    # per-node remaining cap: resident pods (static ex_cap) plus pods placed
+    # in-run by any subgroup sharing the origin (oracle: resident_counts[okey]
+    # + group_counts[okey])
     cap_ex = cap if inputs.ex_cap is None else inputs.ex_cap[g]
+    cap_ex = cap_ex - state.ex_placed[og]
     fill_ex = jnp.clip(jnp.minimum(q_ex, cap_ex), 0, INT_BIG)
     fill_ex = jnp.where(inputs.ex_feas[g], fill_ex, 0)
     m_ex = _waterfall(count, fill_ex)                              # [Ne]
     ex_used = state.ex_used + m_ex[:, None] * vec[None, :]
+    ex_placed = state.ex_placed.at[og].add(m_ex)
     rem = count - jnp.sum(m_ex)
 
     # ---- 2) open claims, first-fit in creation order -------------------------
@@ -176,7 +193,9 @@ def _step(inputs: PackInputs, state: PackState, g: jax.Array,
         q_nt = jnp.minimum(q_nt, q_extra)
     q_cap = jnp.where(nodefeas, q_nt[:, :, None], -1)              # [N, T, S]
     qmax = jnp.max(q_cap.reshape(q_cap.shape[0], -1), axis=-1)     # [N]
-    fill_n = jnp.clip(jnp.minimum(qmax, cap), 0, INT_BIG)
+    # per-claim remaining budget shared across subgroups of the origin
+    cap_n = cap - state.claim_placed[og]                           # [N]
+    fill_n = jnp.clip(jnp.minimum(qmax, cap_n), 0, INT_BIG)
     m_n = _waterfall(rem, fill_n)                                  # [N]
     new_used = state.used + m_n[:, None] * vec[None, :]
     shrunk = nodefeas & (q_nt[:, :, None] >= m_n[:, None, None])
@@ -217,7 +236,9 @@ def _step(inputs: PackInputs, state: PackState, g: jax.Array,
     n_open = state.n_open + n_new
     unsched = rem - jnp.sum(cnt)
 
-    new_state = PackState(used, optmask, nprov, active, n_open, ex_used)
+    claim_placed = state.claim_placed.at[og].add(m_n + cnt)
+    new_state = PackState(used, optmask, nprov, active, n_open, ex_used,
+                          ex_placed, claim_placed)
     return new_state, (m_n + cnt, m_ex, unsched)
 
 
@@ -239,6 +260,8 @@ def pack_impl(inputs: PackInputs, n_slots: int,
         active=jnp.zeros((n_slots,), bool),
         n_open=jnp.int32(0),
         ex_used=inputs.ex_used,
+        ex_placed=jnp.zeros((G, Ne), jnp.int32),
+        claim_placed=jnp.zeros((G, n_slots), jnp.int32),
     )
 
     def body(state, g):

@@ -224,7 +224,8 @@ class ExistingNode:
     used: "list[int]"
     taints: "tuple[Taint, ...]" = ()
     resident: "tuple[PodSpec, ...]" = ()
-    # pods placed DURING the current scheduling run, keyed by subgroup key
+    # pods placed DURING the current scheduling run, keyed by ORIGIN key so
+    # zone-split subgroups of one deployment share one per-node cap budget
     group_counts: "dict[object, int]" = dataclasses.field(default_factory=dict)
     # pods already resident BEFORE the run, keyed by (pre-split) group key.
     # Kept separate from group_counts so the kernel's static per-row ex_cap
@@ -551,26 +552,29 @@ class Scheduler:
         for gi, g in enumerate(groups):
             vec = g.vector
             cap = _group_cap_per_node(g.spec)
-            gkey = g.spec.group_key()
-            # resident pods carry their PRE-SPLIT spec, so per-node caps on
-            # existing nodes count via the origin key; new claims use the
-            # subgroup key (zone subgroups can never share a fresh node)
+            # All in-run per-node counting is keyed by the ORIGIN key: resident
+            # pods carry their pre-split spec, and ScheduleAnyway zone-split
+            # subgroups share hard requirements (they differ only in soft
+            # preferences), so two soft subgroups of one capped deployment
+            # must share one per-node budget. Hard zone subgroups can never
+            # share a node anyway (disjoint zone pins), so origin-keyed
+            # counting is strictly safe on both existing nodes and claims.
             okey = g.spec.origin_key()
             for _ in range(g.count):
                 placed = False
                 # 1) existing cluster nodes first (in-flight awareness,
                 #    bin-packing.md grouping + core scheduler behavior)
                 for e in existing:
-                    # cap = resident base (origin key) + pods this run placed
-                    # of THIS subgroup — the same static-base + per-row rule
-                    # the kernel's ex_cap waterfall applies
+                    # cap = resident base + pods this run placed of any
+                    # subgroup sharing the origin — the same static-base +
+                    # shared-budget rule the kernel's ex_cap waterfall applies
                     if cap is not None and (
                             e.resident_counts.get(okey, 0)
-                            + e.group_counts.get(gkey, 0)) >= cap:
+                            + e.group_counts.get(okey, 0)) >= cap:
                         continue
                     if e.fits(g.spec, vec):
                         e.used = [u + v for u, v in zip(e.used, vec)]
-                        e.group_counts[gkey] = e.group_counts.get(gkey, 0) + 1
+                        e.group_counts[okey] = e.group_counts.get(okey, 0) + 1
                         assignments[e.name].append(g.spec)
                         placed = True
                         break
@@ -578,7 +582,7 @@ class Scheduler:
                     continue
                 # 2) first open node claim whose option set still admits the pod
                 for n in nodes:
-                    if cap is not None and n.group_counts.get(gkey, 0) >= cap:
+                    if cap is not None and n.group_counts.get(okey, 0) >= cap:
                         continue
                     pk = (gi, n.provisioner.name)
                     if pk not in feas_cache:
@@ -599,7 +603,7 @@ class Scheduler:
                     n.options = fitting
                     n.used = new_used
                     n.pods.append(g.spec)
-                    n.group_counts[gkey] = n.group_counts.get(gkey, 0) + 1
+                    n.group_counts[okey] = n.group_counts.get(okey, 0) + 1
                     placed = True
                     break
                 if placed:
@@ -619,7 +623,7 @@ class Scheduler:
                             used=[d + k + v for d, k, v in zip(
                                 self.daemon_overhead, kovh, vec)],
                             pods=[g.spec],
-                            group_counts={gkey: 1},
+                            group_counts={okey: 1},
                         ))
                         placed = True
                         break

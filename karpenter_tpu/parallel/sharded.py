@@ -83,7 +83,7 @@ def input_shardings(mesh: Mesh) -> PackInputs:
         group_newprov=s(), overhead=s(),
         ex_alloc=s(), ex_used=s(), ex_feas=s(),
         prov_overhead=s(), prov_pods_cap=s(None, AXIS_TYPES),
-        ex_cap=s(),
+        ex_cap=s(), group_origin=s(),
     )
 
 
@@ -105,6 +105,8 @@ def sharded_pack(inputs: PackInputs, n_slots: int, mesh: Mesh) -> PackResult:
         shardings = shardings._replace(prov_overhead=None, prov_pods_cap=None)
     if inputs.ex_cap is None:
         shardings = shardings._replace(ex_cap=None)
+    if inputs.group_origin is None:
+        shardings = shardings._replace(group_origin=None)
     inputs = jax.tree.map(
         lambda a, sh: jax.device_put(jax.numpy.asarray(a), sh), inputs, shardings
     )

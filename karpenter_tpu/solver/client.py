@@ -25,6 +25,7 @@ from typing import Optional, Sequence
 import grpc
 
 from ..apis.provisioner import Provisioner
+from ..metrics import Counter
 from ..models.instancetype import Catalog
 from ..models.pod import PodGroup, PodSpec
 from ..oracle.scheduler import ExistingNode, Option
@@ -34,6 +35,14 @@ from . import wire
 from .service import METHODS, SERVICE_NAME
 
 log = logging.getLogger("karpenter.solver.client")
+
+# rolling-upgrade observability: an old server that predates content-hash
+# Sync answers catalog_hash=0; without a signal that skew silently costs a
+# full re-sync + oracle fallback every cycle (ADVICE r2)
+VERSION_SKEW = Counter(
+    "karpenter_solver_client_version_skew_total",
+    "Sync responses missing the content hash (old server speaking the "
+    "legacy seqnum protocol)")
 
 # One channel per target, shared across RemoteSolver instances: the
 # per-reconcile solver_factory pattern constructs a fresh RemoteSolver each
@@ -110,6 +119,19 @@ class RemoteSolver:
         # sync that every later Solve would fail.
         ours = self.catalog_content_hash()
         if resp.catalog_hash != ours:
+            if resp.catalog_hash == 0 and ours != 0:
+                # Old server (pre-content-hash protocol): it synced fine but
+                # can't echo the hash. Accept via the legacy seqnum handshake
+                # instead of branding every future Sync stale — but make the
+                # degraded mode visible so a rolling upgrade doesn't silently
+                # fall back to the oracle each cycle.
+                VERSION_SKEW.inc()
+                log.warning(
+                    "solver server answered Sync without a catalog content "
+                    "hash (version skew: old server); proceeding on the "
+                    "legacy seqnum protocol — upgrade the solver service")
+                self._synced_hash = ours
+                return resp.seqnum
             raise StaleSync(
                 f"server installed catalog hash={resp.catalog_hash:x}, "
                 f"ours is {ours:x}; wire round-trip mismatch")

@@ -140,7 +140,7 @@ class NativeSolver(TPUSolver):
             group_newprov=enc.group_newprov, overhead=enc.overhead,
             ex_alloc=enc.ex_alloc, ex_used=enc.ex_used, ex_feas=enc.ex_feas,
             prov_overhead=enc.prov_overhead, prov_pods_cap=enc.prov_pods_cap,
-            ex_cap=enc.ex_cap,
+            ex_cap=enc.ex_cap, group_origin=enc.group_origin,
         )
         result = native_pack(inputs, n_slots=enc.n_slots)
         return decode(enc, result, [e.name for e in existing])
@@ -168,6 +168,12 @@ def run_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None) -> PackRe
     if ex_cap is not None:
         ex_cap = pad(pad(ex_cap, Gb, fill=int(INT_BIG)), Neb, axis=1,
                      fill=int(INT_BIG))
+    group_origin = enc.group_origin
+    if group_origin is not None:
+        # padded rows are their own origin (identity) so they stay no-ops
+        ident = np.arange(Gb, dtype=np.int32)
+        ident[:group_origin.shape[0]] = group_origin
+        group_origin = ident
     inputs = PackInputs(
         alloc_t=dev_alloc_t if dev_alloc_t is not None else enc.alloc_t,
         tiebreak=dev_tiebreak if dev_tiebreak is not None else enc.tiebreak,
@@ -181,7 +187,7 @@ def run_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None) -> PackRe
         ex_used=pad(enc.ex_used, Neb),
         ex_feas=ex_feas,
         prov_overhead=enc.prov_overhead, prov_pods_cap=enc.prov_pods_cap,
-        ex_cap=ex_cap,
+        ex_cap=ex_cap, group_origin=group_origin,
     )
     # Pallas engages only when the env flag is on AND every input magnitude
     # is below the f32-exactness bound (checked on host arrays; see

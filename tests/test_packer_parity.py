@@ -477,3 +477,34 @@ def test_parity_pod_anti_affinity_hostname_avoids_node_not_zone():
     # refused node-a, but a fresh node (any zone, incl. 1a) is fine
     assert sum(res.existing_counts.values()) == 0
     assert sum(n.pod_count for n in res.nodes) == 1
+
+
+def test_parity_soft_zone_split_shares_per_node_cap():
+    # ADVICE r2 (medium): ScheduleAnyway zone-split subgroups have identical
+    # hard requirements but distinct group keys; with hostname anti-affinity
+    # (cap=1) each soft subgroup must NOT get its own per-node budget — the
+    # cap budget is shared via the origin key on existing nodes and claims.
+    spread = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE,
+                                       when_unsatisfiable="ScheduleAnyway"),)
+
+    def pod(name):
+        return make_pod(name, cpu="100m", memory="128Mi", topology=spread,
+                        anti_affinity_hostname=True)
+
+    # one roomy existing node: both soft subgroups could land here by
+    # capacity, but required anti-affinity allows at most ONE pod total
+    existing = [_existing_in_zone("node-a", "zone-1a")]
+    pods = [pod(f"p{i}") for i in range(6)]
+    res = assert_parity(catalog5(), [prov()], pods, existing=existing)
+    assert sum(res.existing_counts.values()) <= 1
+    # every node claim also carries at most one pod of the deployment
+    assert all(n.pod_count == 1 for n in res.nodes)
+    assert sum(n.pod_count for n in res.nodes) + sum(
+        res.existing_counts.values()) == 6
+
+    # native backend enforces the same shared budget
+    from karpenter_tpu.solver.core import NativeSolver
+    nres = NativeSolver(catalog5(), [prov()]).solve(
+        pods, existing=[_existing_in_zone("node-a", "zone-1a")])
+    assert sum(nres.existing_counts.values()) <= 1
+    assert all(n.pod_count == 1 for n in nres.nodes)

@@ -234,3 +234,32 @@ class TestControllerFallback:
         assert result is not None
         assert len(result.nodes) >= 1
         assert result.unschedulable_count() == 0
+
+
+def test_version_skew_sync_without_content_hash_degrades_loudly():
+    # ADVICE r2: an old server that predates content-hash Sync answers
+    # catalog_hash=0. The client must accept via the legacy seqnum handshake
+    # (not StaleSync every cycle) AND surface the skew via metric + warning.
+    from karpenter_tpu.solver import solver_pb2 as pb
+    from karpenter_tpu.solver.client import VERSION_SKEW
+    from karpenter_tpu.solver.service import SolverService
+
+    class LegacyService(SolverService):
+        def Sync(self, request, context):
+            resp = super().Sync(request, context)
+            return pb.SyncResponse(seqnum=resp.seqnum, catalog_hash=0)
+
+    srv, port, _svc = serve("127.0.0.1:0", service=LegacyService())
+    try:
+        before = VERSION_SKEW.value()
+        solver = RemoteSolver(small_catalog(), [default_provisioner()],
+                              target=f"127.0.0.1:{port}")
+        res = solver.solve([make_pod("a", cpu="1", memory="1Gi")])
+        assert res.nodes  # solve went through despite the skewed handshake
+        assert VERSION_SKEW.value() == before + 1
+        # synced state recorded: the next solve does NOT re-sync every cycle
+        res2 = solver.solve([make_pod("b", cpu="1", memory="1Gi")])
+        assert res2.nodes
+        assert VERSION_SKEW.value() == before + 1
+    finally:
+        srv.stop(grace=None)

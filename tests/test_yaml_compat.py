@@ -304,9 +304,64 @@ spec:
         (aff,) = pod.pod_affinity
         assert aff.match_labels == (("app", "db"),)
         assert aff.topology_key == wk.LABEL_ZONE
-        # app=web (self) hostname anti-affinity -> boolean
+        # app=web (self) hostname anti-affinity -> boolean AND a cross-group
+        # term (the same selector can match other deployments' app=web pods;
+        # ADVICE r2: self-spread and cross-group exclusion are not exclusive)
         assert pod.anti_affinity_hostname
+        by_sel = {t.match_labels: t for t in pod.pod_anti_affinity}
+        assert set(by_sel) == {(("app", "web"),), (("app", "noisy"),)}
+        assert by_sel[(("app", "web"),)].topology_key == wk.LABEL_HOSTNAME
         # app=noisy (cross-group) zone anti-affinity -> term
-        (anti,) = pod.pod_anti_affinity
-        assert anti.match_labels == (("app", "noisy"),)
-        assert anti.topology_key == wk.LABEL_ZONE
+        assert by_sel[(("app", "noisy"),)].topology_key == wk.LABEL_ZONE
+
+    def test_self_selector_still_excludes_foreign_residents(self):
+        # selector {app: x} matches the pod itself AND a resident pod of a
+        # DIFFERENT deployment carrying app=x: the domain exclusion must
+        # survive the self-fold (previously silently dropped)
+        from karpenter_tpu.apis.yaml_compat import load_manifests
+        from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+        from karpenter_tpu.models.pod import make_pod
+        from karpenter_tpu.oracle.scheduler import ExistingNode, Scheduler
+        from karpenter_tpu.apis.provisioner import Provisioner
+
+        loaded = load_manifests("""
+apiVersion: v1
+kind: Pod
+metadata:
+  name: x-new
+  labels: {app: x}
+spec:
+  affinity:
+    podAntiAffinity:
+      requiredDuringSchedulingIgnoredDuringExecution:
+      - labelSelector:
+          matchLabels: {app: x}
+        topologyKey: topology.kubernetes.io/zone
+  containers:
+  - name: c
+    resources: {requests: {cpu: "1", memory: 1Gi}}
+""")
+        (pod,) = loaded.pods
+        assert pod.anti_affinity_zone
+        assert any(t.match_labels == (("app", "x"),)
+                   for t in pod.pod_anti_affinity)
+        # a FOREIGN resident (different deployment, same app=x label) in
+        # zone-1a forbids that zone for the new pod
+        foreign = make_pod("other-deploy-0", cpu="100m", memory="128Mi",
+                           labels=(("app", "x"), ("tier", "other")))
+        catalog = Catalog(types=[make_instance_type(
+            "m.xl", cpu=8, memory="32Gi", od_price=0.2)])
+        prov = Provisioner(name="default")
+        prov.set_defaults()
+        existing = [ExistingNode(
+            name="node-a",
+            labels={wk.LABEL_ARCH: "amd64", wk.LABEL_OS: "linux",
+                    wk.LABEL_ZONE: "zone-1a",
+                    wk.LABEL_CAPACITY_TYPE: "on-demand"},
+            allocatable=catalog.types[0].allocatable_vector(),
+            used=[0] * wk.NUM_RESOURCES, resident=(foreign,))]
+        sched = Scheduler(catalog, [prov])
+        res = sched.schedule([pod], existing=existing)
+        zones = {z for _, z, _, _ in res.node_decisions(sched.options)}
+        assert zones and "zone-1a" not in zones
+        assert not any(res.existing_assignments.values())
