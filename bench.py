@@ -100,7 +100,7 @@ def main():
     # evidence survives even when the tunnel is down at driver-collection
     # time (VERDICT r2 ask #1: capture is a process, not an event).
     try:
-        from hack.tpu_capture import latest_capture
+        from karpenter_tpu.utils.capture import latest_capture
         cap = latest_capture()
         if cap:
             _state["detail"]["latest_tpu_capture"] = {

@@ -110,22 +110,12 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
 
 
 def latest_capture() -> "dict | None":
-    """Most recent recorded capture, or None. Shared with bench.py."""
-    try:
-        names = sorted(n for n in os.listdir(RESULTS_DIR)
-                       if n.startswith("tpu_") and n.endswith(".json"))
-    except FileNotFoundError:
-        return None
-    for name in reversed(names):
-        try:
-            with open(os.path.join(RESULTS_DIR, name)) as f:
-                rec = json.load(f)
-        except (OSError, ValueError):
-            continue
-        if rec.get("degraded"):
-            continue
-        return rec
-    return None
+    """Most recent recorded capture, or None (read side lives in the
+    package: karpenter_tpu.utils.capture)."""
+    sys.path.insert(0, REPO)
+    from karpenter_tpu.utils.capture import latest_capture as _lc
+
+    return _lc(RESULTS_DIR)
 
 
 def capture_once(timeout_s: int, reps_headline: int, reps_sweep: int) -> "dict | None":

@@ -65,6 +65,23 @@ class ProvisioningController:
             f"{NAMESPACE}_pods_unschedulable", "Pods that failed to schedule.")
         self._solver_factory = solver_factory or (
             lambda catalog, provs: TPUSolver(catalog, provs))
+        # Solver instances are cached across reconciles, invalidated by
+        # catalog CONTENT hash + provisioner hash (the same trick the gRPC
+        # service uses, solver/service.py LRU) — steady-state reconciles pay
+        # ZERO option-grid rebuilds (reference analogue: seqnum-memoized
+        # instance types, instancetypes.go:104-120).
+        self._solver_cache: "dict[tuple, object]" = {}
+        self._native_cache: "dict[tuple, NativeSolver]" = {}
+        self._hash_memo: "tuple[int, int, int]" = (-1, -1, 0)  # (id, seqnum, hash)
+        self.solver_rebuilds = 0  # observability + rebuild-free assertion in tests
+        # Size-based routing (docs/designs/solver-boundary.md): below the
+        # measured device-vs-native crossover the in-process C++ scan wins
+        # (on a tunneled chip it wins at EVERY measured size — threshold None
+        # means "native first always"). Operators override via
+        # KARPENTER_TPU_ROUTE_CROSSOVER.
+        from ..utils.capture import route_crossover
+        self.route_threshold = route_crossover()
+        self.last_solver_kind: "Optional[str]" = None
         self._machine_seq = 0
         self._pool = ThreadPoolExecutor(max_workers=launch_workers,
                                         thread_name_prefix="launch")
@@ -113,26 +130,64 @@ class ProvisioningController:
         existing = self.cluster.existing_views()
 
         t0 = time.perf_counter()
-        solver_kind = "tpu"
-        try:
-            solver = self._solver_factory(catalog, provisioners)
-            result = solver.solve(pods, existing=existing,
-                                  daemon_overhead=daemon_overhead)
-        except Exception as e:  # fallback chain: native C++ scan, then oracle
-            log.warning("TPU solver failed (%s); using native fallback", e)
-            try:
-                solver_kind = "native"
-                result = NativeSolver(catalog, provisioners).solve(
-                    pods, existing=existing, daemon_overhead=daemon_overhead)
-            except Exception as e2:
-                log.warning("native solver failed (%s); using oracle fallback", e2)
-                solver_kind = "oracle"
-                result = self._oracle_solve(catalog, provisioners, pods,
-                                            existing, daemon_overhead)
+        result, solver_kind = self._routed_solve(
+            catalog, provisioners, pods, existing, daemon_overhead)
+        self.last_solver_kind = solver_kind
         self.sched_duration.observe(time.perf_counter() - t0, solver=solver_kind)
 
         self._apply(result, pods)
         return result
+
+    # -- solver cache + routing ------------------------------------------------
+
+    def _content_key(self, catalog, provisioners) -> tuple:
+        from ..solver import wire
+
+        memo_id, memo_seq, memo_hash = self._hash_memo
+        if memo_id != id(catalog) or memo_seq != catalog.seqnum:
+            memo_hash = wire.catalog_hash(catalog)
+            self._hash_memo = (id(catalog), catalog.seqnum, memo_hash)
+        return (memo_hash, wire.provisioners_hash(provisioners))
+
+    def _cached(self, cache: dict, key: tuple, build):
+        solver = cache.get(key)
+        if solver is None:
+            solver = build()
+            cache.clear()  # one resident grid per backend is enough in-process
+            cache[key] = solver
+        return solver
+
+    def _routed_solve(self, catalog, provisioners, pods, existing, overhead):
+        """Route by batch size (measured crossover), degrade down the chain.
+        Order: preferred backend -> other backend -> scalar oracle; every
+        backend enforces identical semantics (parity-tested), so routing is
+        purely a latency decision."""
+        key = self._content_key(catalog, provisioners)
+
+        def run_primary():
+            def build():
+                self.solver_rebuilds += 1
+                return self._solver_factory(catalog, provisioners)
+            solver = self._cached(self._solver_cache, key, build)
+            return solver.solve(pods, existing=existing,
+                                daemon_overhead=overhead)
+
+        def run_native():
+            solver = self._cached(self._native_cache, key,
+                                  lambda: NativeSolver(catalog, provisioners))
+            return solver.solve(pods, existing=existing,
+                                daemon_overhead=overhead)
+
+        small = self.route_threshold is None or len(pods) < self.route_threshold
+        order = [("native", run_native), ("tpu", run_primary)] if small \
+            else [("tpu", run_primary), ("native", run_native)]
+        for kind, fn in order:
+            try:
+                return fn(), kind
+            except Exception as e:
+                log.warning("%s solver failed (%s); degrading", kind, e)
+        return self._oracle_solve(catalog, provisioners, pods,
+                                  existing, overhead), "oracle"
 
     def _oracle_solve(self, catalog, provisioners, pods, existing, overhead):
         sched = Scheduler(catalog, provisioners, overhead)

@@ -1,0 +1,72 @@
+"""Recorded on-chip capture lookups (routing + bench reporting).
+
+hack/tpu_capture.py records benchmark captures into benchmarks/results/;
+this module is the read side shared by bench.py (report the freshest chip
+evidence) and the provisioning controller (data-driven device-vs-native
+routing threshold). Kept inside the package so the controller does not
+import repo-root script modules.
+
+Reference analogue: the reference sizes its behavior from measured constants
+(batching windows, cache TTLs — pkg/batcher/createfleet.go:33-36); here the
+measured constant is the solve-latency crossover between the host C++ scan
+and the device kernel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+RESULTS_DIR = os.path.join(_REPO_ROOT, "benchmarks", "results")
+
+
+def latest_capture(results_dir: Optional[str] = None) -> "Optional[dict]":
+    """Most recent non-degraded recorded capture, or None."""
+    d = results_dir or RESULTS_DIR
+    try:
+        names = sorted(n for n in os.listdir(d)
+                       if n.startswith("tpu_") and n.endswith(".json"))
+    except FileNotFoundError:
+        return None
+    for name in reversed(names):
+        try:
+            with open(os.path.join(d, name)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if rec.get("degraded"):
+            continue
+        return rec
+    return None
+
+
+def route_crossover(default: "Optional[int]" = None) -> "Optional[int]":
+    """Pod-count threshold below which the in-process native scan beats the
+    device kernel. Resolution order:
+
+      1. KARPENTER_TPU_ROUTE_CROSSOVER env (operator override; "inf" or
+         "none" disables the device path preference entirely),
+      2. the freshest recorded capture's measured crossover_pods
+         (null there = the device never won the sweep -> None),
+      3. `default`.
+
+    Returns None when no threshold is known — callers treat None as "prefer
+    the native path at every size the sweep covered" (measured reality on a
+    tunneled chip, where the ~66 ms RTT dominates every solve; see
+    docs/designs/solver-boundary.md routing table).
+    """
+    env = os.environ.get("KARPENTER_TPU_ROUTE_CROSSOVER", "").strip().lower()
+    if env:
+        if env in ("inf", "none", "native"):
+            return None
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    cap = latest_capture()
+    if cap is not None and "crossover_pods" in cap:
+        return cap["crossover_pods"]  # may legitimately be None
+    return default

@@ -402,3 +402,72 @@ class TestReviewRegressions:
         op.clock.step(6)
         again = op.queue.receive()
         assert len(again) == 1 and again[0].body == "{malformed"
+
+
+class TestSolverCacheAndRouting:
+    def test_steady_state_zero_solver_rebuilds(self, op):
+        # VERDICT r2 ask #4: the in-process solver (and its option grid) is
+        # held across reconciles, invalidated by catalog CONTENT hash
+        add_provisioner(op)
+        pc = op.provisioning
+        for i in range(3):
+            p = make_pod(f"w{i}", cpu="1", memory="1Gi")
+            op.kube.create("pods", p.name, p)
+            pc.reconcile_once()
+        # routing may satisfy every solve on the native path; force one
+        # primary build to compare against, then reconcile again
+        pc.route_threshold = 0  # always prefer the primary (device) solver
+        p = make_pod("wx", cpu="1", memory="1Gi")
+        op.kube.create("pods", p.name, p)
+        pc.reconcile_once()
+        builds = pc.solver_rebuilds
+        assert builds == 1
+        for i in range(3):
+            q = make_pod(f"y{i}", cpu="1", memory="1Gi")
+            op.kube.create("pods", q.name, q)
+            pc.reconcile_once()
+        assert pc.solver_rebuilds == builds  # zero rebuilds steady-state
+
+    def test_catalog_content_change_rebuilds_once(self, op):
+        add_provisioner(op)
+        pc = op.provisioning
+        pc.route_threshold = 0
+        p = make_pod("a", cpu="1", memory="1Gi")
+        op.kube.create("pods", p.name, p)
+        pc.reconcile_once()
+        assert pc.solver_rebuilds == 1
+        # content mutation + seqnum bump -> exactly one rebuild
+        cat = op.cloudprovider.catalog_for(None)
+        from karpenter_tpu.models.instancetype import Offering, Offerings
+        big = cat.by_name["m.xlarge"]
+        object.__setattr__(big, "offerings", Offerings(
+            Offering(o.zone, o.capacity_type, o.price, available=False)
+            for o in big.offerings))
+        cat.bump()
+        for i in range(2):
+            q = make_pod(f"b{i}", cpu="1", memory="1Gi")
+            op.kube.create("pods", q.name, q)
+            pc.reconcile_once()
+        assert pc.solver_rebuilds == 2
+
+    def test_small_batches_route_native(self, op):
+        # measured crossover on the tunneled chip is null -> native first
+        add_provisioner(op)
+        pc = op.provisioning
+        pc.route_threshold = None
+        p = make_pod("r0", cpu="1", memory="1Gi")
+        op.kube.create("pods", p.name, p)
+        pc.reconcile_once()
+        assert pc.last_solver_kind == "native"
+        assert pc.solver_rebuilds == 0  # device path never engaged
+
+    def test_large_batches_route_primary(self, op):
+        add_provisioner(op)
+        pc = op.provisioning
+        pc.route_threshold = 2  # batches of >=2 pods go to the device path
+        for i in range(3):
+            p = make_pod(f"s{i}", cpu="1", memory="1Gi")
+            op.kube.create("pods", p.name, p)
+        pc.reconcile_once()
+        assert pc.last_solver_kind == "tpu"
+        assert pc.solver_rebuilds == 1
