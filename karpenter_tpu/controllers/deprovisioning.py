@@ -29,6 +29,15 @@ log = logging.getLogger("karpenter.deprovisioning")
 
 
 class DeprovisioningController:
+    # Replacement-launch state machine (consolidation.md:15 "launch the new
+    # cheaper node and when it is ready delete the existing node"):
+    REPLACE_INIT_TIMEOUT_S = 300.0  # roll the replacement back after this
+    # Post-action stabilization (consolidation.md:65): don't chain actions
+    # against a cluster still in flux — 5 min while replaced pods are
+    # pending, a short settle window otherwise.
+    STABILIZATION_PENDING_S = 300.0
+    STABILIZATION_S = 30.0
+
     def __init__(self, kube, cloudprovider, cluster: ClusterState,
                  termination: TerminationController,
                  clock: Optional[Clock] = None,
@@ -52,6 +61,9 @@ class DeprovisioningController:
             f"{NAMESPACE}_deprovisioning_evaluation_duration_seconds",
             "Consolidation evaluation duration.", ("method",))
         self._empty_since: "dict[str, float]" = {}
+        # in-flight replace action: {"action", "replacement", "started_ts"}
+        self._pending_replace: "Optional[dict]" = None
+        self._last_action_ts: "Optional[float]" = None
 
     def _prov(self, name: str):
         return next((p for p in self.kube.provisioners() if p.name == name), None)
@@ -128,7 +140,17 @@ class DeprovisioningController:
 
     def reconcile_consolidation(self):
         """One consolidation action per cycle (consolidation.md single-node
-        changes)."""
+        changes). Replace actions run as a two-phase state machine: launch
+        the replacement first, finish (drain the old nodes) only once the
+        machine-lifecycle controller marks it initialized."""
+        now = self.clock.now()
+        if self._pending_replace is not None:
+            return self._finish_pending_replace(now)
+        if self._last_action_ts is not None:
+            window = self.STABILIZATION_PENDING_S if self.kube.pending_pods() \
+                else self.STABILIZATION_S
+            if now - self._last_action_ts < window:
+                return None
         provisioners = [p for p in self.kube.provisioners() if p.consolidation_enabled]
         if not provisioners:
             return None
@@ -175,14 +197,31 @@ class DeprovisioningController:
                for n in nodes):
             return None
         if action.kind == "replace" and self.provisioning is not None:
-            # launch the replacement before draining (consolidation.md:
-            # "when it is ready, delete the existing node")
-            self.recorder.normal(f"node/{action.node}", "ConsolidationReplace",
-                                 f"replacing with {action.replacement[0]}")
-        # all-or-nothing: a multi-node action executed partially would drain
-        # one node while claiming the combined savings. Roll back only marks
-        # THIS action created — a member already marked by a concurrent path
-        # (emptiness/interruption) keeps its pending deletion.
+            # two-phase replace: launch now, drain once the replacement is
+            # initialized (consolidation.md: "when it is ready, delete the
+            # existing node") — pods never pass through a pending window
+            replacement = self._launch_replacement(action)
+            if replacement is None:
+                return None
+            self.recorder.normal(
+                f"node/{action.node}", "ConsolidationReplace",
+                f"launched replacement {replacement.name} "
+                f"({action.replacement[0]}); draining once initialized")
+            self._pending_replace = {"action": action,
+                                     "replacement": replacement.name,
+                                     "started_ts": now}
+            return action
+        if not self._mark_all_or_nothing(action):
+            return None
+        self._record_action(action, now)
+        return action
+
+    def _mark_all_or_nothing(self, action) -> bool:
+        """Mark every node of the action for deletion, or none: a multi-node
+        action executed partially would drain one node while claiming the
+        combined savings. Roll back only marks THIS action created — a member
+        already marked by a concurrent path (emptiness/interruption) keeps
+        its pending deletion."""
         newly_marked = []
         for n in action.nodes:
             status = self.termination.request_deletion(n)
@@ -193,22 +232,131 @@ class DeprovisioningController:
                         node.marked_for_deletion = False
                         node.deletion_requested_ts = 0.0
                 log.warning("consolidation aborted: %s not deletable", n)
-                return None
+                return False
             if status == self.termination.MARKED_NEW:
                 newly_marked.append(n)
+        return True
+
+    def _record_action(self, action, now: float) -> None:
         suffix = "-multi" if len(action.nodes) > 1 else ""
         self.actions.inc(action=f"consolidation-{action.kind}{suffix}")
         self.recorder.normal(
             f"node/{action.node}", "Consolidated",
             f"{action.kind} {','.join(action.nodes)}: "
             f"saves ${action.savings:.4f}/h")
-        return action
+        self._last_action_ts = now
+
+    def _launch_replacement(self, action):
+        """Launch the replacement machine (no pod bindings — the drained
+        pods rebind onto it via normal provisioning once the old nodes
+        evict). Returns the StateNode or None."""
+        from ..oracle.scheduler import Option
+        from ..solver.core import SolvedNode, SolveResult
+
+        prov = self._prov(self.cluster.nodes[action.node].provisioner_name)
+        if prov is None:
+            return None
+        itype_name, zone, capacity_type, price = action.replacement
+        catalog = self.cloudprovider.catalog_for(None)
+        itype = catalog.by_name.get(itype_name)
+        if itype is None:
+            return None
+        solved = SolvedNode(
+            option=Option(index=-1, itype=itype, zone=zone,
+                          capacity_type=capacity_type, price=price,
+                          alloc=tuple(itype.allocatable_vector())),
+            pod_counts={}, provisioner=prov)
+        empty = SolveResult(nodes=[], existing_counts={}, unschedulable={},
+                            groups=[])
+        try:
+            return self.provisioning._launch_node(solved, {}, empty)
+        except Exception as e:
+            log.warning("replacement launch failed: %s", e)
+            return None
+
+    def _finish_pending_replace(self, now: float):
+        """Phase 2: the old nodes drain only after the replacement is
+        initialized AND the action still holds against current cluster state
+        (the reference revalidates its command after the wait). A replacement
+        that never initializes within the timeout is rolled back (deleted)
+        and the action abandoned; every abandonment restarts the settle
+        window so a persistent failure can't relaunch-loop."""
+        pr = self._pending_replace
+        action, rep_name = pr["action"], pr["replacement"]
+        rep = self.cluster.nodes.get(rep_name)
+        if rep is None or rep.marked_for_deletion:
+            # replacement vanished or is itself terminating (interruption /
+            # manual delete): draining into it would strand the pods
+            log.warning("replacement %s gone or terminating; abandoning "
+                        "replace", rep_name)
+            self._pending_replace = None
+            self._last_action_ts = now
+            return None
+        if rep.initialized:
+            self._pending_replace = None
+            if not self._revalidate_replace(action, rep_name) \
+                    or not self._mark_all_or_nothing(action):
+                # cluster moved under us (new pods bound to the old nodes /
+                # members no longer drainable): roll the replacement back
+                self.termination.request_deletion(rep_name)
+                self._last_action_ts = now
+                return None
+            self._record_action(action, now)
+            return action
+        if now - pr["started_ts"] >= self.REPLACE_INIT_TIMEOUT_S:
+            log.warning("replacement %s not initialized within %.0fs; "
+                        "rolling back", rep_name, self.REPLACE_INIT_TIMEOUT_S)
+            self.recorder.warning(f"node/{rep_name}", "ReplacementTimeout",
+                                  "replacement failed to initialize; rolled back")
+            self.termination.request_deletion(rep_name)
+            self._pending_replace = None
+            self._last_action_ts = now
+        return None
+
+    def _revalidate_replace(self, action, rep_name: str) -> bool:
+        """The action was computed before the init wait; during that window
+        provisioning may have bound NEW pods onto the old nodes (they were
+        unmarked capacity). Re-simulate: the old nodes' CURRENT pods must fit
+        on the surviving cluster (which now includes the replacement) with
+        zero fresh launches and zero unschedulable pods."""
+        pods = []
+        for n in action.nodes:
+            node = self.cluster.nodes.get(n)
+            if node is None:
+                return False
+            pods.extend(node.non_daemon_pods())
+        if not pods:
+            return True
+        survivors = self.cluster.existing_views(exclude=set(action.nodes))
+        provs = sorted(self.kube.provisioners(),
+                       key=lambda p: (-p.weight, p.name))
+        catalog = self.cloudprovider.catalog_for(None)
+        try:
+            from ..solver.core import NativeSolver
+
+            res = NativeSolver(catalog, provs).solve(pods, existing=survivors)
+            ok = res.unschedulable_count() == 0 and not res.nodes
+        except Exception:
+            from ..oracle.scheduler import Scheduler
+
+            r = Scheduler(catalog, provs).schedule(list(pods),
+                                                   existing=survivors)
+            ok = not r.unschedulable and not r.new_nodes
+        if not ok:
+            log.warning("replace %s revalidation failed: pods no longer fit "
+                        "the surviving cluster; abandoning",
+                        ",".join(action.nodes))
+        return ok
 
     def reconcile_once(self):
         """Full deprovisioning pass in reference priority order."""
-        self.reconcile_emptiness()
-        self.reconcile_expiration()
+        acted = list(self.reconcile_emptiness())
+        acted += self.reconcile_expiration()
         drift_enabled = self.cloudprovider.settings.feature_gates.drift_enabled
         if drift_enabled:
-            self.reconcile_drift()
+            acted += self.reconcile_drift()
+        if acted:
+            # other deprovisioners disrupted the cluster this pass: restart
+            # the consolidation settle window (consolidation.md:65)
+            self._last_action_ts = self.clock.now()
         return self.reconcile_consolidation()

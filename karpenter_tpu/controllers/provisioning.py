@@ -72,7 +72,12 @@ class ProvisioningController:
         # instance types, instancetypes.go:104-120).
         self._solver_cache: "dict[tuple, object]" = {}
         self._native_cache: "dict[tuple, NativeSolver]" = {}
-        self._hash_memo: "tuple[int, int, int]" = (-1, -1, 0)  # (id, seqnum, hash)
+        # memoized content hashes. The memo holds STRONG references to the
+        # hashed objects: comparing `is` against a live object is sound,
+        # while an id() of a freed one could be recycled by the allocator
+        # and alias a different catalog.
+        self._cat_memo: "Optional[tuple]" = None   # (catalog, seqnum, hash)
+        self._prov_memo: "Optional[tuple]" = None  # (prov tuple, hash)
         self.solver_rebuilds = 0  # observability + rebuild-free assertion in tests
         # Size-based routing (docs/designs/solver-boundary.md): below the
         # measured device-vs-native crossover the in-process C++ scan wins
@@ -143,11 +148,17 @@ class ProvisioningController:
     def _content_key(self, catalog, provisioners) -> tuple:
         from ..solver import wire
 
-        memo_id, memo_seq, memo_hash = self._hash_memo
-        if memo_id != id(catalog) or memo_seq != catalog.seqnum:
-            memo_hash = wire.catalog_hash(catalog)
-            self._hash_memo = (id(catalog), catalog.seqnum, memo_hash)
-        return (memo_hash, wire.provisioners_hash(provisioners))
+        memo = self._cat_memo
+        if memo is None or memo[0] is not catalog or memo[1] != catalog.seqnum:
+            memo = (catalog, catalog.seqnum, wire.catalog_hash(catalog))
+            self._cat_memo = memo
+        provs = tuple(provisioners)
+        pmemo = self._prov_memo
+        if pmemo is None or len(pmemo[0]) != len(provs) or any(
+                a is not b for a, b in zip(pmemo[0], provs)):
+            pmemo = (provs, wire.provisioners_hash(provs))
+            self._prov_memo = pmemo
+        return (memo[2], pmemo[1])
 
     def _cached(self, cache: dict, key: tuple, build):
         solver = cache.get(key)

@@ -471,3 +471,169 @@ class TestSolverCacheAndRouting:
         pc.reconcile_once()
         assert pc.last_solver_kind == "tpu"
         assert pc.solver_rebuilds == 1
+
+
+class TestReplaceBeforeDrain:
+    def _seed_replaceable(self, op):
+        # lone expensive node with one small pod and nowhere else to go:
+        # the search proposes "replace with a cheaper type"
+        from karpenter_tpu.models.cluster import StateNode
+
+        add_provisioner(op, consolidation_enabled=True)
+        node = StateNode(
+            name="n-big",
+            labels={wk.LABEL_ARCH: "amd64", wk.LABEL_OS: "linux",
+                    wk.LABEL_ZONE: "zone-1a",
+                    wk.LABEL_CAPACITY_TYPE: "on-demand",
+                    wk.LABEL_INSTANCE_TYPE: "m.xlarge"},
+            allocatable=wk.capacity_vector({wk.RESOURCE_CPU: 16000,
+                                            wk.RESOURCE_MEMORY: 64 * 2**30,
+                                            wk.RESOURCE_PODS: 110}),
+            price=0.80, provisioner_name="default", initialized=True,
+            pods=[make_pod("lone", cpu="1", memory="1Gi", node_name="n-big")],
+        )
+        op.cluster.add_node(node)
+        op.kube.create("nodes", "n-big", node)
+        op.kube.create("pods", "lone",
+                       make_pod("lone", cpu="1", memory="1Gi", node_name="n-big"))
+        return node
+
+    def test_replacement_launches_before_drain(self, op):
+        # consolidation.md:15: launch the cheaper node; drain only when ready
+        self._seed_replaceable(op)
+        replace_count = op.deprovisioning.actions.value(
+            action="consolidation-replace")
+        action = op.deprovisioning.reconcile_consolidation()
+        assert action is not None and action.kind == "replace"
+        # phase 1: replacement launched, old node NOT yet marked
+        assert not op.cluster.nodes["n-big"].marked_for_deletion
+        new_names = [n for n in op.cluster.nodes if n != "n-big"]
+        assert len(new_names) == 1
+        replacement = op.cluster.nodes[new_names[0]]
+        assert not replacement.initialized
+        # zero pending-pod window so far
+        assert len(op.kube.pending_pods()) == 0
+        # not initialized yet -> still no drain on the next cycle
+        assert op.deprovisioning.reconcile_consolidation() is None
+        assert not op.cluster.nodes["n-big"].marked_for_deletion
+        # machine lifecycle initializes the replacement -> drain proceeds
+        op.machinelifecycle.reconcile_once()
+        op.machinelifecycle.reconcile_once()
+        assert op.cluster.nodes[new_names[0]].initialized
+        done = op.deprovisioning.reconcile_consolidation()
+        assert done is not None and done.kind == "replace"
+        assert op.cluster.nodes["n-big"].marked_for_deletion
+        assert op.deprovisioning.actions.value(
+            action="consolidation-replace") == replace_count + 1
+        # termination evicts (the ReplicaSet analogue recreates the pod);
+        # the pod rebinds onto the ALREADY-READY node — no new launch
+        op.termination.reconcile_once()
+        assert set(op.cluster.nodes) == {new_names[0]}
+        op.kube.create("pods", "lone", make_pod("lone", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        assert len(op.kube.pending_pods()) == 0
+        assert set(op.cluster.nodes) == {new_names[0]}  # zero extra nodes
+        assert len(op.cluster.nodes[new_names[0]].pods) == 1
+
+    def test_replacement_timeout_rolls_back(self, op):
+        self._seed_replaceable(op)
+        replace_count = op.deprovisioning.actions.value(
+            action="consolidation-replace")
+        action = op.deprovisioning.reconcile_consolidation()
+        assert action is not None and action.kind == "replace"
+        (rep_name,) = [n for n in op.cluster.nodes if n != "n-big"]
+        # never initialized; past the timeout the replacement is rolled back
+        op.clock.step(op.deprovisioning.REPLACE_INIT_TIMEOUT_S + 1)
+        assert op.deprovisioning.reconcile_consolidation() is None
+        assert op.cluster.nodes[rep_name].marked_for_deletion
+        assert not op.cluster.nodes["n-big"].marked_for_deletion
+        assert op.deprovisioning.actions.value(
+            action="consolidation-replace") == replace_count
+
+    def _seed_delete_pairs(self, op):
+        # two independent delete-consolidatable pairs (each pair's pod fits
+        # on the other member)
+        from karpenter_tpu.models.cluster import StateNode
+
+        add_provisioner(op, consolidation_enabled=True)
+        for name, pods in (("n-1", ["a"]), ("n-2", ["b"]),
+                           ("n-3", ["c"]), ("n-4", ["d"])):
+            node = StateNode(
+                name=name,
+                labels={wk.LABEL_ARCH: "amd64", wk.LABEL_OS: "linux",
+                        wk.LABEL_ZONE: "zone-1a",
+                        wk.LABEL_CAPACITY_TYPE: "on-demand",
+                        wk.LABEL_INSTANCE_TYPE: "m.large"},
+                allocatable=wk.capacity_vector({wk.RESOURCE_CPU: 4000,
+                                                wk.RESOURCE_MEMORY: 16 * 2**30,
+                                                wk.RESOURCE_PODS: 110}),
+                price=0.20, provisioner_name="default", initialized=True,
+                pods=[make_pod(p, cpu="1", memory="2Gi", node_name=name)
+                      for p in pods],
+            )
+            op.cluster.add_node(node)
+            op.kube.create("nodes", name, node)
+
+    def test_stabilization_window_defers_next_action(self, op):
+        self._seed_delete_pairs(op)
+        first = op.deprovisioning.reconcile_consolidation()
+        assert first is not None and first.kind == "delete"
+        # immediately after the action: deferred (cluster in flux)
+        assert op.deprovisioning.reconcile_consolidation() is None
+        # quiet cluster: settles after the short window
+        op.clock.step(op.deprovisioning.STABILIZATION_S + 1)
+        second = op.deprovisioning.reconcile_consolidation()
+        assert second is not None
+
+    def test_stabilization_uses_long_window_while_pods_pending(self, op):
+        self._seed_delete_pairs(op)
+        assert op.deprovisioning.reconcile_consolidation() is not None
+        # a pod goes pending (e.g. evicted by the action's drain)
+        op.kube.create("pods", "pend", make_pod("pend", cpu="1", memory="2Gi"))
+        op.clock.step(op.deprovisioning.STABILIZATION_S + 1)
+        # short window elapsed but pods are pending -> still deferred
+        assert op.deprovisioning.reconcile_consolidation() is None
+        op.clock.step(op.deprovisioning.STABILIZATION_PENDING_S)
+        # long window elapsed -> next action may proceed (pod still pending
+        # is fine; the window bounds flux, not cluster fullness)
+        assert op.deprovisioning.reconcile_consolidation() is not None
+
+
+class TestReplaceRevalidation:
+    def test_terminating_replacement_abandons_drain(self, op):
+        # the replacement gets interrupted/marked during the init window:
+        # draining the old node into it would strand the pods
+        tb = TestReplaceBeforeDrain()
+        tb._seed_replaceable(op)
+        action = op.deprovisioning.reconcile_consolidation()
+        assert action is not None and action.kind == "replace"
+        (rep_name,) = [n for n in op.cluster.nodes if n != "n-big"]
+        op.machinelifecycle.reconcile_once()
+        op.machinelifecycle.reconcile_once()
+        assert op.cluster.nodes[rep_name].initialized
+        op.termination.request_deletion(rep_name)  # e.g. spot interruption
+        assert op.deprovisioning.reconcile_consolidation() is None
+        assert not op.cluster.nodes["n-big"].marked_for_deletion
+        assert op.deprovisioning._pending_replace is None
+
+    def test_revalidation_aborts_when_old_node_gained_pods(self, op):
+        # during the init wait, provisioning binds MORE pods onto the old
+        # node (it was unmarked capacity); the original replacement can no
+        # longer host them all -> abandon + roll the replacement back
+        tb = TestReplaceBeforeDrain()
+        tb._seed_replaceable(op)
+        action = op.deprovisioning.reconcile_consolidation()
+        assert action is not None and action.kind == "replace"
+        (rep_name,) = [n for n in op.cluster.nodes if n != "n-big"]
+        # 8 new 1-cpu pods land on n-big while the replacement initializes
+        big = op.cluster.nodes["n-big"]
+        for i in range(8):
+            p = make_pod(f"late{i}", cpu="1", memory="1Gi", node_name="n-big")
+            op.kube.create("pods", f"late{i}", p)
+            big.pods.append(p)
+        op.machinelifecycle.reconcile_once()
+        op.machinelifecycle.reconcile_once()
+        assert op.cluster.nodes[rep_name].initialized
+        assert op.deprovisioning.reconcile_consolidation() is None
+        assert not op.cluster.nodes["n-big"].marked_for_deletion
+        assert op.cluster.nodes[rep_name].marked_for_deletion  # rolled back
