@@ -264,9 +264,13 @@ class InterruptionController:
         self.queue.delete(qmsg.receipt)
         self.deleted.inc()
 
-    def run(self, stop_event: threading.Event) -> None:
-        """Singleton long-poll loop (NewSingletonManagedBy analogue)."""
+    def run(self, stop_event: threading.Event, gate=None) -> None:
+        """Singleton long-poll loop (NewSingletonManagedBy analogue); with
+        `gate` (leader election) the poller idles until elected."""
         while not stop_event.is_set():
+            if gate is not None and not gate.is_set():
+                stop_event.wait(0.2)
+                continue
             try:
                 n = self.reconcile_once(wait_seconds=1.0)
                 if n == 0:

@@ -88,6 +88,11 @@ class ProvisioningController:
         self.route_threshold = route_crossover()
         self.last_solver_kind: "Optional[str]" = None
         self._machine_seq = 0
+        # per-process machine-name suffix: two HA replicas sharing one store
+        # must never collide on create (the reference uses generateName)
+        import uuid
+
+        self._name_suffix = uuid.uuid4().hex[:5]
         self._pool = ThreadPoolExecutor(max_workers=launch_workers,
                                         thread_name_prefix="launch")
         self._lock = threading.Lock()
@@ -262,7 +267,7 @@ class ProvisioningController:
             return None
         with self._lock:
             self._machine_seq += 1
-            name = f"{prov.name}-{self._machine_seq:05d}"
+            name = f"{prov.name}-{self._name_suffix}-{self._machine_seq:05d}"
         reqs = prov.scheduling_requirements().copy()
         opt = solved.option
         reqs.add(Requirement.create(wk.LABEL_INSTANCE_TYPE, OP_IN, [opt.itype.name]))
@@ -334,8 +339,14 @@ class ProvisioningController:
         new_mem = used_mem + alloc[wk.RESOURCE_INDEX[wk.RESOURCE_MEMORY]] * 2**20
         return prov.limits.exceeded_by(new_cpu, new_mem) is None
 
-    def run(self, stop_event: threading.Event) -> None:
+    def run(self, stop_event: threading.Event,
+            gate: "Optional[threading.Event]" = None) -> None:
+        """Reconcile loop; with `gate` (leader election) the controller
+        idles until this replica is elected."""
         while not stop_event.is_set():
+            if gate is not None and not gate.is_set():
+                stop_event.wait(0.2)
+                continue
             try:
                 if self.kube.pending_pods():
                     pods = self.wait_for_batch()

@@ -29,7 +29,7 @@ class KubeStore:
     """Typed object buckets with list/get/create/update/delete + watchers."""
 
     KINDS = ("pods", "nodes", "machines", "provisioners", "nodetemplates",
-             "pdbs", "configmaps")
+             "pdbs", "configmaps", "leases")
 
     def __init__(self):
         self._lock = threading.RLock()
@@ -59,6 +59,12 @@ class KubeStore:
         with self._lock:
             self._watchers.append(fn)
 
+    def unwatch(self, fn: Callable[[str, str, object], None]) -> None:
+        """Deregister a watcher (a stopped HA replica sharing this store
+        must not keep receiving events — and being kept alive — forever)."""
+        with self._lock:
+            self._watchers = [w for w in self._watchers if w is not fn]
+
     def create(self, kind: str, name: str, obj) -> None:
         if self._admission is not None:
             obj = self._admission(kind, obj, "CREATE")
@@ -79,6 +85,33 @@ class KubeStore:
     def get(self, kind: str, name: str):
         with self._lock:
             return self._objects[kind].get(name)
+
+    def compare_and_swap(self, kind: str, name: str, expect, obj) -> None:
+        """Atomic update iff the stored object is still `expect` (identity —
+        the apiserver's resourceVersion-precondition analogue). Raises
+        Conflict when another writer won the race. Leader-election leases
+        depend on this being one critical section. Admission runs exactly as
+        it does for update(): a real apiserver applies webhooks to
+        precondition-guarded writes too."""
+        if self._admission is not None:
+            obj = self._admission(kind, obj, "UPDATE")
+        with self._lock:
+            cur = self._objects[kind].get(name)
+            if cur is not expect:
+                raise Conflict(f"{kind}/{name} changed since read")
+            self._objects[kind][name] = obj
+        self._notify(kind, "modified", obj)
+
+    def delete_if(self, kind: str, name: str, expect) -> bool:
+        """Atomic delete iff the stored object is still `expect` (graceful
+        lease release must not clobber a successor's lease)."""
+        with self._lock:
+            cur = self._objects[kind].get(name)
+            if cur is not expect:
+                return False
+            self._objects[kind].pop(name)
+        self._notify(kind, "deleted", expect)
+        return True
 
     def delete(self, kind: str, name: str):
         with self._lock:

@@ -1,0 +1,153 @@
+"""Store-backed lease leader election (active/passive HA).
+
+Parity target: the reference runs 2 replicas + PDB with real lease-based
+leader election through the operator manager
+(/root/reference/cmd/controller/main.go:34,42 `operator.NewOperator` with
+LEADER_ELECT, charts/karpenter 2-replica deployment). Controllers act only
+on the elected replica; a standby takes over within the lease TTL when the
+leader dies, and immediately when it releases gracefully.
+
+The lease lives in the coordination plane (KubeStore kind "leases" — the
+coordination.k8s.io/Lease analogue) and every transition is a single
+compare-and-swap, so two candidates racing a renewal or a takeover cannot
+both win (kube.compare_and_swap raises Conflict for the loser).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Callable, Optional
+
+from .fake.kube import Conflict
+from .utils.clock import Clock
+
+log = logging.getLogger("karpenter.leaderelection")
+
+LEASE_NAME = "karpenter-leader"
+
+
+@dataclasses.dataclass(frozen=True)
+class Lease:
+    """coordination.k8s.io/v1 Lease spec subset."""
+
+    holder: str
+    acquired_ts: float   # when the current holder first became leader
+    renew_ts: float      # last successful renewal
+    duration_s: float    # holder is presumed dead duration_s after renew_ts
+
+    def expired(self, now: float) -> bool:
+        return now - self.renew_ts >= self.duration_s
+
+
+class LeaderElector:
+    """Acquire/renew loop with standby takeover.
+
+    - the holder renews every `renew_period_s` (< duration/2 by default);
+    - a standby polls and takes over once the lease expires;
+    - `release()` (graceful shutdown) deletes the lease iff still ours, so
+      the standby flips without waiting out the TTL;
+    - losing a renewal race or failing to renew within the TTL demotes the
+      local process immediately (elected cleared before callbacks fire).
+    """
+
+    def __init__(self, kube, identity: str, clock: Optional[Clock] = None,
+                 lease_duration_s: float = 15.0, renew_period_s: float = 4.0,
+                 retry_period_s: float = 2.0, name: str = LEASE_NAME,
+                 on_started_leading: "Optional[Callable[[], None]]" = None,
+                 on_stopped_leading: "Optional[Callable[[], None]]" = None):
+        self.kube = kube
+        self.identity = identity
+        self.clock = clock or Clock()
+        self.lease_duration_s = lease_duration_s
+        self.renew_period_s = renew_period_s
+        self.retry_period_s = retry_period_s
+        self.name = name
+        self.elected = threading.Event()
+        self._on_started = on_started_leading
+        self._on_stopped = on_stopped_leading
+        self._held: "Optional[Lease]" = None  # our last written lease object
+        # serializes tick vs release: a release racing an in-flight renewal
+        # could otherwise leave the fresh lease dangling (or resurrect it)
+        self._mutex = threading.Lock()
+
+    def is_leader(self) -> bool:
+        return self.elected.is_set()
+
+    # -- one election tick -----------------------------------------------------
+
+    def try_acquire_or_renew(self) -> bool:
+        """One CAS-guarded tick; returns leadership after the tick."""
+        with self._mutex:
+            return self._tick()
+
+    def _tick(self) -> bool:
+        now = self.clock.now()
+        cur = self.kube.get("leases", self.name)
+        try:
+            if cur is None:
+                fresh = Lease(self.identity, now, now, self.lease_duration_s)
+                self.kube.create("leases", self.name, fresh)
+                self._became_leader(fresh, takeover_from=None)
+            elif cur.holder == self.identity:
+                renewed = dataclasses.replace(cur, renew_ts=now)
+                self.kube.compare_and_swap("leases", self.name, cur, renewed)
+                self._held = renewed
+                if not self.elected.is_set():  # e.g. restart with stale lease
+                    self._became_leader(renewed, takeover_from=None)
+            elif cur.expired(now):
+                taken = Lease(self.identity, now, now, self.lease_duration_s)
+                self.kube.compare_and_swap("leases", self.name, cur, taken)
+                self._became_leader(taken, takeover_from=cur.holder)
+            else:
+                self._demote_if_leading("lease held by %s" % cur.holder)
+        except Conflict:
+            # another candidate won this write; if we thought we were the
+            # leader our lease was stolen (we must have been expired)
+            self._demote_if_leading("lost lease race")
+        return self.elected.is_set()
+
+    def release(self) -> None:
+        """Graceful handoff: delete the lease iff it is still ours."""
+        with self._mutex:
+            if self._held is None:
+                return
+            cur = self.kube.get("leases", self.name)
+            if cur is not None and cur.holder == self.identity:
+                self.kube.delete_if("leases", self.name, cur)
+            self._demote_if_leading("released")
+
+    def _became_leader(self, lease: Lease, takeover_from: "Optional[str]") -> None:
+        self._held = lease
+        if not self.elected.is_set():
+            if takeover_from:
+                log.info("%s took leadership over from expired %s",
+                         self.identity, takeover_from)
+            else:
+                log.info("%s became leader", self.identity)
+            self.elected.set()
+            if self._on_started is not None:
+                self._on_started()
+
+    def _demote_if_leading(self, why: str) -> None:
+        self._held = None
+        if self.elected.is_set():
+            log.warning("%s lost leadership (%s)", self.identity, why)
+            self.elected.clear()
+            if self._on_stopped is not None:
+                self._on_stopped()
+
+    # -- loop ------------------------------------------------------------------
+
+    def run(self, stop_event: threading.Event) -> None:
+        while not stop_event.is_set():
+            try:
+                leading = self.try_acquire_or_renew()
+            except Exception as e:  # store hiccup: drop leadership, retry
+                log.exception("election tick failed: %s", e)
+                self._demote_if_leading(f"election error: {e}")
+                leading = False
+            stop_event.wait(self.renew_period_s if leading
+                            else self.retry_period_s)
+        self.release()

@@ -23,6 +23,7 @@ from .controllers.nodetemplate import NodeTemplateController
 from .controllers.provisioning import ProvisioningController
 from .controllers.termination import TerminationController
 from .events import EventRecorder
+from .leaderelection import LeaderElector
 from .metrics import REGISTRY, decorate_cloudprovider
 from .models.cluster import ClusterState
 from .models.instancetype import Catalog
@@ -37,7 +38,9 @@ class Operator:
     def __init__(self, cloud, settings: Settings, catalog: Catalog,
                  kube: Optional[KubeStore] = None,
                  clock: Optional[Clock] = None,
-                 queue=None, solver_factory=None):
+                 queue=None, solver_factory=None,
+                 leader_elect: bool = False,
+                 identity: Optional[str] = None):
         settings.validate()
         self.settings = settings
         self.clock = clock or Clock()
@@ -46,7 +49,22 @@ class Operator:
         self.recorder = EventRecorder(clock=self.clock)
         self.cloudprovider = CloudProvider(cloud, settings, catalog, clock=self.clock)
         self.metrics_cloudprovider = decorate_cloudprovider(self.cloudprovider)
-        self.elected = threading.Event()  # leader election (single process)
+        # Leader election (main.go:42 LEADER_ELECT, charts 2-replica/PDB):
+        # when enabled, a store-backed lease elects exactly one active
+        # replica; controllers idle on standbys and take over within the
+        # lease TTL. Single-process mode keeps the bare always-set event.
+        self.leader_elect = leader_elect
+        if leader_elect:
+            import uuid
+
+            self.leader = LeaderElector(
+                self.kube, identity or f"karpenter-{uuid.uuid4().hex[:8]}",
+                clock=self.clock,
+                on_started_leading=self._on_started_leading)
+            self.elected = self.leader.elected
+        else:
+            self.leader = None
+            self.elected = threading.Event()
         self._stop = threading.Event()
         self._threads: "list[threading.Thread]" = []
 
@@ -98,26 +116,45 @@ class Operator:
 
     # -- lifecycle -------------------------------------------------------------
 
+    def _on_started_leading(self) -> None:
+        # leader-gated hydration (launchtemplate.go:76-85): standbys must not
+        # prefetch against the leader's cache-eviction discipline
+        try:
+            self.cloudprovider.launch_templates.hydrate()
+        except Exception as e:
+            log.warning("leader hydration failed: %s", e)
+
     def start(self) -> None:
-        """Start background controller loops (operator Start, main.go:64)."""
-        self.elected.set()
-        # leader-gated hydration (launchtemplate.go:76-85)
-        self.cloudprovider.launch_templates.hydrate()
+        """Start background controller loops (operator Start, main.go:64).
+        With leader_elect, reconcile loops spin but act only while this
+        replica holds the lease (manager-gated controllers analogue)."""
+        if self.leader is not None:
+            t0 = threading.Thread(target=self.leader.run, args=(self._stop,),
+                                  name="leaderelection", daemon=True)
+            t0.start()
+            self._threads.append(t0)
+        else:
+            self.elected.set()
+            # single-process mode hydrates inline and FAILS FAST: a broken
+            # cloud API at boot should abort start, not surface per-launch
+            self.cloudprovider.launch_templates.hydrate()
 
         def loop(name, fn, interval):
             def run():
                 while not self._stop.is_set():
-                    try:
-                        fn()
-                    except Exception as e:
-                        log.exception("%s failed: %s", name, e)
+                    if self.elected.is_set():
+                        try:
+                            fn()
+                        except Exception as e:
+                            log.exception("%s failed: %s", name, e)
                     self._stop.wait(interval)
 
             t = threading.Thread(target=run, name=name, daemon=True)
             t.start()
             self._threads.append(t)
 
-        t = threading.Thread(target=self.provisioning.run, args=(self._stop,),
+        t = threading.Thread(target=self.provisioning.run,
+                             args=(self._stop, self.elected),
                              name="provisioning", daemon=True)
         t.start()
         self._threads.append(t)
@@ -129,15 +166,22 @@ class Operator:
         loop("machinehydration", self.machinehydration.reconcile_once, 5.0)
         if self.interruption is not None:
             t2 = threading.Thread(target=self.interruption.run,
-                                  args=(self._stop,), name="interruption",
-                                  daemon=True)
+                                  args=(self._stop, self.elected),
+                                  name="interruption", daemon=True)
             t2.start()
             self._threads.append(t2)
 
     def stop(self) -> None:
+        # The graceful lease release happens inside the election thread's
+        # run() exit path — releasing from THIS thread would race an
+        # in-flight renewal tick and could leave the lease dangling (or
+        # resurrect it mid-shutdown). stop_event wakes the elector's wait
+        # immediately, so the handoff is still prompt.
         self._stop.set()
         for t in self._threads:
             t.join(timeout=2)
+        self.kube.unwatch(self._sync_pdbs)  # shared-store replicas must not
+        # leak dead watchers across restarts (multi-replica HA mode)
         self.provisioning.stop()
         if self.interruption is not None:
             self.interruption.stop()

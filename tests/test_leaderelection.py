@@ -1,0 +1,170 @@
+"""Leader election: lease CAS semantics, standby takeover, and the
+kill-the-leader HA scenario through the threaded operator.
+
+Parity target: /root/reference/cmd/controller/main.go:34,42 (operator-managed
+lease election, LEADER_ELECT) and the charts' 2-replica + PDB deployment.
+"""
+
+import threading
+import time
+
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.apis.settings import Settings
+from karpenter_tpu.apis.nodetemplate import NodeTemplate
+from karpenter_tpu.fake.cloud import FakeCloud
+from karpenter_tpu.fake.kube import KubeStore
+from karpenter_tpu.leaderelection import LeaderElector
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.utils.clock import Clock, FakeClock
+
+
+def catalog():
+    return Catalog(types=[
+        make_instance_type("m.large", cpu=4, memory="16Gi", od_price=0.20,
+                           spot_price=0.07),
+    ])
+
+
+class TestLeaderElector:
+    def test_acquire_then_renew(self):
+        kube, clock = KubeStore(), FakeClock()
+        a = LeaderElector(kube, "a", clock=clock)
+        assert a.try_acquire_or_renew()
+        assert a.is_leader()
+        lease1 = kube.get("leases", a.name)
+        clock.step(3)
+        assert a.try_acquire_or_renew()
+        lease2 = kube.get("leases", a.name)
+        assert lease2.renew_ts > lease1.renew_ts
+        assert lease2.acquired_ts == lease1.acquired_ts
+
+    def test_standby_waits_then_takes_over_on_expiry(self):
+        kube, clock = KubeStore(), FakeClock()
+        a = LeaderElector(kube, "a", clock=clock, lease_duration_s=15)
+        b = LeaderElector(kube, "b", clock=clock, lease_duration_s=15)
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()  # lease held and fresh
+        assert not b.is_leader()
+        # leader dies (stops renewing); standby must take over once the TTL
+        # elapses, not before
+        clock.step(14)
+        assert not b.try_acquire_or_renew()
+        clock.step(2)  # now expired
+        assert b.try_acquire_or_renew()
+        assert b.is_leader()
+        # the late old leader notices the steal and demotes
+        assert not a.try_acquire_or_renew()
+        assert not a.is_leader()
+
+    def test_graceful_release_hands_over_immediately(self):
+        kube, clock = KubeStore(), FakeClock()
+        a = LeaderElector(kube, "a", clock=clock)
+        b = LeaderElector(kube, "b", clock=clock)
+        assert a.try_acquire_or_renew()
+        a.release()
+        assert not a.is_leader()
+        assert b.try_acquire_or_renew()  # no TTL wait
+        assert b.is_leader()
+
+    def test_concurrent_candidates_elect_exactly_one(self):
+        kube, clock = KubeStore(), FakeClock()
+        electors = [LeaderElector(kube, f"c{i}", clock=clock) for i in range(8)]
+        barrier = threading.Barrier(len(electors))
+        results = [None] * len(electors)
+
+        def tick(i):
+            barrier.wait()
+            results[i] = electors[i].try_acquire_or_renew()
+
+        threads = [threading.Thread(target=tick, args=(i,))
+                   for i in range(len(electors))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(results) == 1
+        assert sum(e.is_leader() for e in electors) == 1
+
+    def test_release_does_not_clobber_successor(self):
+        kube, clock = KubeStore(), FakeClock()
+        a = LeaderElector(kube, "a", clock=clock, lease_duration_s=5)
+        b = LeaderElector(kube, "b", clock=clock, lease_duration_s=5)
+        assert a.try_acquire_or_renew()
+        clock.step(6)  # a expired; b steals
+        assert b.try_acquire_or_renew()
+        a.release()  # late release must not delete b's lease
+        lease = kube.get("leases", a.name)
+        assert lease is not None and lease.holder == "b"
+
+
+class TestOperatorHA:
+    def _mk_op(self, kube, identity):
+        clock = Clock()
+        cloud = FakeCloud(catalog=catalog(), clock=clock)
+        settings = Settings(cluster_name="ha", cluster_endpoint="https://k",
+                            batch_idle_duration=0.02, batch_max_duration=0.1)
+        op = Operator(cloud, settings, catalog(), kube=kube, clock=clock,
+                      leader_elect=True, identity=identity)
+        # fast lease for the test
+        op.leader.lease_duration_s = 1.2
+        op.leader.renew_period_s = 0.15
+        op.leader.retry_period_s = 0.1
+        prov = Provisioner(name="default", provider_ref="default")
+        prov.set_defaults()
+        return op
+
+    def test_kill_the_leader_standby_takes_over(self):
+        kube = KubeStore()
+        kube.create("nodetemplates", "default", NodeTemplate(
+            name="default", subnet_selector={"id": "subnet-zone-1a"}))
+        a = self._mk_op(kube, "op-a")
+        b = self._mk_op(kube, "op-b")
+        for op in (a, b):
+            op.cloudprovider.register_nodetemplate(
+                kube.get("nodetemplates", "default"))
+        prov = Provisioner(name="default", provider_ref="default")
+        prov.set_defaults()
+        kube.create("provisioners", "default", prov)
+        try:
+            a.start()
+            b.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not (
+                    a.elected.is_set() or b.elected.is_set()):
+                time.sleep(0.02)
+            leaders = [op for op in (a, b) if op.elected.is_set()]
+            assert len(leaders) == 1, "exactly one replica must lead"
+            leader, standby = leaders[0], (b if leaders[0] is a else a)
+
+            # the leader (and only the leader) schedules the first pod
+            kube.create("pods", "p1", make_pod("p1", cpu="1", memory="1Gi"))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and kube.pending_pods():
+                time.sleep(0.05)
+            assert not kube.pending_pods()
+            machines_after_p1 = len(kube.machines())
+            assert machines_after_p1 == 1  # exactly one actor provisioned
+            assert len(standby.cluster.nodes) == 0  # standby stayed idle
+
+            # HARD-kill the leader: no graceful release, lease left dangling
+            leader.leader.release = lambda: None
+            leader.stop()
+
+            # standby must take over within the lease TTL (+renew slack)
+            deadline = time.monotonic() + leader.leader.lease_duration_s + 3
+            while time.monotonic() < deadline and not standby.elected.is_set():
+                time.sleep(0.02)
+            assert standby.elected.is_set(), "standby failed to take over"
+
+            # the new leader schedules the next pod; still exactly one actor
+            kube.create("pods", "p2", make_pod("p2", cpu="1", memory="1Gi"))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and kube.pending_pods():
+                time.sleep(0.05)
+            assert not kube.pending_pods()
+            assert len(kube.machines()) == machines_after_p1 + 1
+        finally:
+            a.stop()
+            b.stop()
