@@ -20,7 +20,6 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
-import queue as queue_mod
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
@@ -117,79 +116,17 @@ def default_parsers() -> ParserRegistry:
 
 
 # -- queue provider ---------------------------------------------------------------
+# The client boundary lives in queues.py (QueueProvider interface + FakeQueue
+# + RemoteQueueProvider real-client stub); re-exported here for compatibility.
 
-@dataclasses.dataclass
-class QueueMessage:
-    body: str
-    receipt: str
-    enqueued_at: float = 0.0
-
-
-class FakeQueue:
-    """In-memory SQS-like queue with visibility-timeout redelivery
-    (at-least-once: an un-deleted message reappears after the timeout)."""
-
-    def __init__(self, name: str = "interruptions", clock: Optional[Clock] = None,
-                 visibility_seconds: float = 30.0):
-        self.name = name
-        self.clock = clock or Clock()
-        self.visibility_seconds = visibility_seconds
-        self._q: "queue_mod.Queue[QueueMessage]" = queue_mod.Queue()
-        self._inflight: "dict[str, tuple[float, QueueMessage]]" = {}
-        self._receipt = 0
-        self._lock = threading.Lock()
-
-    def send(self, body: str) -> None:
-        with self._lock:
-            self._receipt += 1
-            receipt = f"r-{self._receipt}"
-        self._q.put(QueueMessage(body=body, receipt=receipt,
-                                 enqueued_at=self.clock.now()))
-
-    def _redeliver_expired(self) -> None:
-        now = self.clock.now()
-        with self._lock:
-            expired = [r for r, (taken, _) in self._inflight.items()
-                       if now - taken >= self.visibility_seconds]
-            for r in expired:
-                _, msg = self._inflight.pop(r)
-                self._q.put(msg)
-
-    def receive(self, max_messages: int = 10, wait_seconds: float = 0.0
-                ) -> "list[QueueMessage]":
-        """Long-poll receive (sqs.go:80-105: 20s wait, <=10 messages)."""
-        self._redeliver_expired()
-        out: "list[QueueMessage]" = []
-        try:
-            if wait_seconds > 0:
-                out.append(self._q.get(timeout=wait_seconds))
-            else:
-                out.append(self._q.get_nowait())
-        except queue_mod.Empty:
-            return out
-        while len(out) < max_messages:
-            try:
-                out.append(self._q.get_nowait())
-            except queue_mod.Empty:
-                break
-        now = self.clock.now()
-        with self._lock:
-            for m in out:
-                self._inflight[m.receipt] = (now, m)
-        return out
-
-    def delete(self, receipt: str) -> None:
-        with self._lock:
-            self._inflight.pop(receipt, None)
-
-    def approximate_depth(self) -> int:
-        return self._q.qsize()
-
+from .queues import (FakeQueue, QueueAPI, QueueMessage, QueueNotFound,  # noqa: F401,E402
+                     QueueProvider, RemoteQueueProvider)
 
 # -- controller -------------------------------------------------------------------
 
 class InterruptionController:
-    def __init__(self, kube, cluster: ClusterState, queue, unavailable_offerings,
+    def __init__(self, kube, cluster: ClusterState, queue: QueueProvider,
+                 unavailable_offerings,
                  termination=None, clock: Optional[Clock] = None,
                  recorder: Optional[EventRecorder] = None,
                  registry: Optional[Registry] = None,

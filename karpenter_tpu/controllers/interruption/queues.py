@@ -1,0 +1,194 @@
+"""Interruption queue client boundary.
+
+Parity target: /root/reference/pkg/controllers/interruption/sqs.go:33-148 —
+the SQSProvider wraps the low-level SQS API with LAZY queue-URL discovery
+(resolved on first use, cached), invalidation when the configured queue name
+changes, and receive/send/delete against the resolved URL.
+
+The boundary here is `QueueProvider`: the controller depends only on this
+interface, with two implementations —
+
+- `FakeQueue`: in-memory at-least-once queue with visibility-timeout
+  redelivery (the hermetic test backend, reference pkg/fake/sqsapi.go);
+- `RemoteQueueProvider`: the real-client stub over a minimal `QueueAPI`
+  (get_queue_url / send_message / receive_message / delete_message), with
+  the reference's lazy discovery + name-change invalidation + stale-URL
+  recovery semantics. Wire it to a real broker by implementing QueueAPI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue as queue_mod
+import threading
+from typing import Callable, Optional, Protocol, runtime_checkable
+
+from ...utils.clock import Clock
+
+log = logging.getLogger("karpenter.interruption.queue")
+
+
+@dataclasses.dataclass
+class QueueMessage:
+    body: str
+    receipt: str
+    enqueued_at: float = 0.0
+
+
+@runtime_checkable
+class QueueProvider(Protocol):
+    """What the interruption controller needs from a queue."""
+
+    name: str
+
+    def send(self, body: str) -> None: ...
+
+    def receive(self, max_messages: int = 10, wait_seconds: float = 0.0
+                ) -> "list[QueueMessage]": ...
+
+    def delete(self, receipt: str) -> None: ...
+
+    def approximate_depth(self) -> int: ...
+
+
+class FakeQueue:
+    """In-memory SQS-like queue with visibility-timeout redelivery
+    (at-least-once: an un-deleted message reappears after the timeout)."""
+
+    def __init__(self, name: str = "interruptions", clock: Optional[Clock] = None,
+                 visibility_seconds: float = 30.0):
+        self.name = name
+        self.clock = clock or Clock()
+        self.visibility_seconds = visibility_seconds
+        self._q: "queue_mod.Queue[QueueMessage]" = queue_mod.Queue()
+        self._inflight: "dict[str, tuple[float, QueueMessage]]" = {}
+        self._receipt = 0
+        self._lock = threading.Lock()
+
+    def send(self, body: str) -> None:
+        with self._lock:
+            self._receipt += 1
+            receipt = f"r-{self._receipt}"
+        self._q.put(QueueMessage(body=body, receipt=receipt,
+                                 enqueued_at=self.clock.now()))
+
+    def _redeliver_expired(self) -> None:
+        now = self.clock.now()
+        with self._lock:
+            expired = [r for r, (taken, _) in self._inflight.items()
+                       if now - taken >= self.visibility_seconds]
+            for r in expired:
+                _, msg = self._inflight.pop(r)
+                self._q.put(msg)
+
+    def receive(self, max_messages: int = 10, wait_seconds: float = 0.0
+                ) -> "list[QueueMessage]":
+        """Long-poll receive (sqs.go:80-105: 20s wait, <=10 messages)."""
+        self._redeliver_expired()
+        out: "list[QueueMessage]" = []
+        try:
+            if wait_seconds > 0:
+                out.append(self._q.get(timeout=wait_seconds))
+            else:
+                out.append(self._q.get_nowait())
+        except queue_mod.Empty:
+            return out
+        while len(out) < max_messages:
+            try:
+                out.append(self._q.get_nowait())
+            except queue_mod.Empty:
+                break
+        now = self.clock.now()
+        with self._lock:
+            for m in out:
+                self._inflight[m.receipt] = (now, m)
+        return out
+
+    def delete(self, receipt: str) -> None:
+        with self._lock:
+            self._inflight.pop(receipt, None)
+
+    def approximate_depth(self) -> int:
+        return self._q.qsize()
+
+
+class QueueNotFound(Exception):
+    """The broker does not know the queue (URL stale or queue recreated)."""
+
+
+class QueueAPI(Protocol):
+    """Minimal low-level broker API the real provider is generic over
+    (aws-sdk sqsiface analogue). Implementations raise QueueNotFound for
+    unknown queue names/URLs."""
+
+    def get_queue_url(self, name: str) -> str: ...
+
+    def send_message(self, queue_url: str, body: str) -> None: ...
+
+    def receive_message(self, queue_url: str, max_messages: int,
+                        wait_seconds: float) -> "list[QueueMessage]": ...
+
+    def delete_message(self, queue_url: str, receipt: str) -> None: ...
+
+
+class RemoteQueueProvider:
+    """QueueProvider over a QueueAPI with the reference's URL lifecycle:
+
+    - the queue URL is discovered LAZILY on first use and cached
+      (sqs.go queueURL sync once-per-name);
+    - a change of the configured queue name (live settings watch)
+      invalidates the cached URL so the next call re-discovers;
+    - a QueueNotFound from the broker (queue deleted/recreated under us)
+      also invalidates, and the operation is retried once against the
+      freshly discovered URL.
+    """
+
+    def __init__(self, api: QueueAPI,
+                 name_source: "Callable[[], str] | str"):
+        self.api = api
+        self._name_source = (name_source if callable(name_source)
+                             else (lambda: name_source))
+        self._lock = threading.Lock()
+        self._url: "Optional[str]" = None
+        self._url_for_name: "Optional[str]" = None
+
+    @property
+    def name(self) -> str:
+        return self._name_source()
+
+    def _queue_url(self) -> str:
+        name = self.name
+        with self._lock:
+            if self._url is None or self._url_for_name != name:
+                self._url = self.api.get_queue_url(name)
+                self._url_for_name = name
+                log.info("resolved queue %s -> %s", name, self._url)
+            return self._url
+
+    def _invalidate(self) -> None:
+        with self._lock:
+            self._url = None
+            self._url_for_name = None
+
+    def _with_url(self, op):
+        try:
+            return op(self._queue_url())
+        except QueueNotFound:
+            # stale URL (queue recreated): re-discover once and retry
+            self._invalidate()
+            return op(self._queue_url())
+
+    def send(self, body: str) -> None:
+        self._with_url(lambda url: self.api.send_message(url, body))
+
+    def receive(self, max_messages: int = 10, wait_seconds: float = 0.0
+                ) -> "list[QueueMessage]":
+        return self._with_url(lambda url: self.api.receive_message(
+            url, max_messages, wait_seconds))
+
+    def delete(self, receipt: str) -> None:
+        self._with_url(lambda url: self.api.delete_message(url, receipt))
+
+    def approximate_depth(self) -> int:
+        return -1  # brokers expose this asynchronously; not part of QueueAPI
