@@ -69,11 +69,22 @@ def cmd_controller(args) -> int:
     from .operator import Operator
     from .providers.instancetypes import generate_fleet_catalog
 
-    if not args.simulate:
-        print("only --simulate mode is available in this build "
-              "(real TPU-fleet API wiring is environment-specific)",
+    if not args.simulate and not args.kubeconfig:
+        print("need --simulate (in-process store) or --kubeconfig SERVER "
+              "(real coordination plane; the cloud backend stays simulated — "
+              "real TPU-fleet API wiring is environment-specific)",
               file=sys.stderr)
         return 2
+
+    kube = None
+    if args.kubeconfig:
+        from .coordination.httpkube import HttpKubeStore
+
+        kube = HttpKubeStore.from_kubeconfig(args.kubeconfig)
+        kube.start()
+        print(f"coordination plane: {kube.server} "
+              f"({sum(len(kube.list(k)) for k in kube.KINDS)} objects synced)",
+              flush=True)
 
     catalog = generate_fleet_catalog()
     settings = Settings(cluster_name=args.cluster_name,
@@ -91,7 +102,9 @@ def cmd_controller(args) -> int:
         s.tags.setdefault("karpenter.sh/discovery", args.cluster_name)
     for g in cloud.security_groups:
         g.tags.setdefault("karpenter.sh/discovery", args.cluster_name)
-    op = Operator(cloud, settings, catalog, solver_factory=solver_factory)
+    op = Operator(cloud, settings, catalog, kube=kube,
+                  solver_factory=solver_factory,
+                  leader_elect=bool(args.leader_elect))
     if args.apply:
         # reference-compatible manifests (Provisioner / AWSNodeTemplate /
         # Deployment / Pod / PDB YAML) drive the plane as-is
@@ -109,8 +122,10 @@ def cmd_controller(args) -> int:
         print(f"applied {len(loaded.templates)} templates, "
               f"{len(loaded.provisioners)} provisioners, "
               f"{len(loaded.pods)} pods, {len(loaded.pdbs)} pdbs", flush=True)
-    else:
-        # kube.create runs the admission webhooks (defaulting + validation)
+    elif not args.kubeconfig:
+        # simulate-only default seeding; against a real coordination plane
+        # the cluster's own objects are authoritative
+        # (kube.create runs the admission webhooks: defaulting + validation)
         op.kube.create("nodetemplates", "default", NodeTemplate(
             name="default",
             subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"}))
@@ -156,6 +171,12 @@ def main(argv=None) -> int:
                         metavar="FILE",
                         help="manifest file(s) to apply at boot "
                              "(reference-compatible Karpenter YAML)")
+    p_ctrl.add_argument("--kubeconfig", default="",
+                        help="run against a real apiserver (kubeconfig path); "
+                             "see karpenter_tpu/fake/apiserver.py for the "
+                             "in-repo mini apiserver")
+    p_ctrl.add_argument("--leader-elect", action="store_true",
+                        help="lease-based leader election (HA replicas)")
     p_ctrl.set_defaults(fn=cmd_controller)
 
     p_ver = sub.add_parser("version")

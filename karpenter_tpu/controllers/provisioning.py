@@ -96,24 +96,45 @@ class ProvisioningController:
         self._pool = ThreadPoolExecutor(max_workers=launch_workers,
                                         thread_name_prefix="launch")
         self._lock = threading.Lock()
+        # Watch-driven batching: the store notifies on pod events and the
+        # batcher rescans pending pods only when something actually changed —
+        # no fixed-rate full-store polling (the reference batches off a watch
+        # stream, settings.md:43-47). Starts dirty so pre-existing pending
+        # pods are picked up on boot.
+        self._pods_dirty = threading.Event()
+        self._pods_dirty.set()
+        kube.watch(self._on_store_event)
 
     # -- batching window -------------------------------------------------------
 
+    def _on_store_event(self, kind: str, action: str, obj) -> None:
+        # provisioner/nodetemplate changes can unblock previously
+        # unschedulable pods — they re-arm the batcher too
+        if kind in ("pods", "provisioners", "nodetemplates"):
+            self._pods_dirty.set()
+
     def wait_for_batch(self) -> "list[PodSpec]":
         """Pod batching: return once no new pending pod arrived for
-        batchIdleDuration, or batchMaxDuration elapsed (settings.md:81-99)."""
+        batchIdleDuration, or batchMaxDuration elapsed (settings.md:81-99).
+
+        The pending set is rescanned only when the watch flagged a pod
+        change; between events the loop just ticks the clock for window
+        deadlines (cheap — no store scan at 20 Hz)."""
         first = None
         seen: "set[str]" = set()
         last_new = None
+        pods: "list[PodSpec]" = []
         while True:
-            pods = self.kube.pending_pods()
-            names = {p.name for p in pods}
+            if self._pods_dirty.is_set():
+                self._pods_dirty.clear()
+                pods = self.kube.pending_pods()
+                names = {p.name for p in pods}
+                if names - seen:
+                    seen = names
+                    last_new = self.clock.now()
+                    if first is None:
+                        first = last_new
             now = self.clock.now()
-            if names - seen:
-                seen = names
-                last_new = now
-                if first is None:
-                    first = now
             if first is None:
                 self.clock.sleep(0.05)
                 continue
@@ -343,22 +364,36 @@ class ProvisioningController:
             gate: "Optional[threading.Event]" = None) -> None:
         """Reconcile loop; with `gate` (leader election) the controller
         idles until this replica is elected."""
+        last_retry_scan = 0.0
         while not stop_event.is_set():
             if gate is not None and not gate.is_set():
                 stop_event.wait(0.2)
                 continue
             try:
-                if self.kube.pending_pods():
-                    pods = self.wait_for_batch()
-                    self.reconcile_once(pods)
-                else:
-                    self.clock.sleep(0.1)
+                # idle until the watch reports churn; a slow retry scan
+                # (1 Hz) re-arms for pods left pending by a failed solve —
+                # e.g. an ICE TTL expiring produces no store event at all
+                if not self._pods_dirty.wait(timeout=0.1):
+                    now = self.clock.now()
+                    if now - last_retry_scan >= 1.0:
+                        last_retry_scan = now
+                        if self.kube.pending_pods():
+                            self._pods_dirty.set()
+                    continue
+                self._pods_dirty.clear()
+                if not self.kube.pending_pods():
+                    continue
+                self._pods_dirty.set()  # re-arm wait_for_batch's scan gate
+                pods = self.wait_for_batch()
+                self.reconcile_once(pods)
             except Exception as e:
                 log.exception("provisioning reconcile failed: %s", e)
+                self._pods_dirty.set()  # the failed batch must retry
                 self.clock.sleep(1.0)
 
     def stop(self):
         self._pool.shutdown(wait=False)
+        self.kube.unwatch(self._on_store_event)  # no dead-replica watcher leak
 
 
 def _oracle_to_solve_result(res, sched) -> SolveResult:

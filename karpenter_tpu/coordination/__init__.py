@@ -1,0 +1,12 @@
+"""Coordination plane: the protocol every store speaks, plus backends.
+
+- protocol.py — the formal CoordinationPlane surface (implicit since round 1
+  in fake/kube.KubeStore, now a checked contract);
+- serde.py — model <-> manifest JSON round-trips for every stored kind;
+- httpkube.py — HttpKubeStore, a kubernetes-REST client (stdlib HTTP,
+  list+watch informer cache) implementing the protocol against a real
+  apiserver or the in-repo mini apiserver (fake/apiserver.py, the
+  kwok-analogue test infrastructure).
+"""
+
+from .protocol import CoordinationPlane  # noqa: F401

@@ -1,0 +1,79 @@
+"""The coordination-plane contract (KubeStore protocol, formalized).
+
+Every controller in this framework talks to the cluster through exactly this
+surface. Two implementations exist:
+
+- `fake.kube.KubeStore` — in-process store (hermetic tests; the reference's
+  envtest analogue);
+- `coordination.httpkube.HttpKubeStore` — kubernetes REST client over a real
+  apiserver (or the in-repo mini apiserver).
+
+Parity target: the reference boots controller-runtime against a live
+apiserver (/root/reference/cmd/controller/main.go:33-65); its unit tier
+swaps in envtest. The split here is identical, with this Protocol as the
+seam.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class CoordinationPlane(Protocol):
+    """get/create/update/delete/list + watch + typed reads + subresources.
+
+    Semantics every implementation must honor:
+    - `create` raises fake.kube.Conflict when the name exists;
+    - `compare_and_swap` is atomic on object identity (in-process) or
+      resourceVersion (HTTP) and raises Conflict for the loser;
+    - `watch` callbacks fire as fn(kind, action in {added, modified,
+      deleted}, obj) after the store mutates; `unwatch` deregisters;
+    - admission (set_admission) runs before create/update/compare_and_swap
+      writes are applied;
+    - typed reads (pending_pods, provisioners, ...) reflect every write this
+      process has successfully completed (read-your-writes).
+    """
+
+    # generic CRUD
+    def get(self, kind: str, name: str): ...
+
+    def create(self, kind: str, name: str, obj) -> None: ...
+
+    def update(self, kind: str, name: str, obj) -> None: ...
+
+    def delete(self, kind: str, name: str): ...
+
+    def list(self, kind: str) -> list: ...
+
+    def compare_and_swap(self, kind: str, name: str, expect, obj) -> None: ...
+
+    def delete_if(self, kind: str, name: str, expect) -> bool: ...
+
+    # watch plumbing
+    def watch(self, fn: Callable[[str, str, object], None]) -> None: ...
+
+    def unwatch(self, fn: Callable[[str, str, object], None]) -> None: ...
+
+    # admission boundary
+    def set_admission(self, fn) -> None: ...
+
+    # typed reads
+    def pods(self) -> list: ...
+
+    def pending_pods(self) -> list: ...
+
+    def daemon_pods(self) -> list: ...
+
+    def nodes(self) -> list: ...
+
+    def machines(self) -> list: ...
+
+    def provisioners(self) -> list: ...
+
+    def nodetemplates(self) -> list: ...
+
+    def pdbs(self) -> list: ...
+
+    # subresources
+    def bind_pod(self, pod_name: str, node_name: str) -> None: ...

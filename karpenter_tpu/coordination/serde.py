@@ -1,0 +1,242 @@
+"""Model <-> manifest JSON round-trips for every stored kind.
+
+Two regimes, one per object ownership:
+
+- **User-authored kinds** (pods, provisioners, nodetemplates, pdbs): read
+  real Kubernetes manifests via apis.yaml_compat (the same parser the
+  examples/replay harness uses), so objects applied by kubectl work
+  unchanged. Objects written by THIS framework additionally embed their
+  exact model (`x-karpenter-model`) so round-trips are lossless — k8s
+  schema can't express every internal field bit-for-bit.
+- **Controller-owned kinds** (machines, nodes, leases, configmaps): these
+  are our CRDs; the manifest schema is the embedded model itself.
+
+Reference analogue: the reference's CRD types ARE its Go structs with
+k8s codegen (/root/reference/pkg/apis/v1alpha1); here the generic tagged
+encoder plays the codegen role.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..apis.nodetemplate import (BlockDeviceMapping, MetadataOptions,
+                                 NodeTemplate, NodeTemplateStatus)
+from ..apis.provisioner import KubeletConfiguration, Limits, Provisioner
+from ..models.cluster import PodDisruptionBudget, StateNode
+from ..models.machine import Machine, MachineSpec, MachineStatus
+from ..models.pod import (PodAffinityTerm, PodSpec, Taint, Toleration,
+                          TopologySpreadConstraint)  # noqa: F401 (Taint used in node parse)
+from ..models.requirements import Requirement, Requirements
+
+MODEL_KEY = "x-karpenter-model"
+
+# kind -> (apiVersion, Kind, namespaced)
+ROUTES = {
+    "pods": ("v1", "Pod", True),
+    "nodes": ("v1", "Node", False),
+    "configmaps": ("v1", "ConfigMap", True),
+    "pdbs": ("policy/v1", "PodDisruptionBudget", True),
+    "leases": ("coordination.k8s.io/v1", "Lease", True),
+    "provisioners": ("karpenter.sh/v1alpha5", "Provisioner", False),
+    "machines": ("karpenter.sh/v1alpha5", "Machine", False),
+    "nodetemplates": ("karpenter.k8s.tpu/v1alpha1", "NodeTemplate", False),
+}
+
+# registered dataclasses for the tagged generic encoder
+_TYPES = {}
+for _cls in (PodSpec, Taint, Toleration, TopologySpreadConstraint,
+             PodAffinityTerm, Machine, MachineSpec, MachineStatus, StateNode,
+             Provisioner, Limits, KubeletConfiguration, NodeTemplate,
+             NodeTemplateStatus, MetadataOptions, BlockDeviceMapping,
+             PodDisruptionBudget):
+    _TYPES[_cls.__name__] = _cls
+
+# runtime-only fields never serialized (decode restores the default)
+_SKIP_FIELDS = {("StateNode", "pods")}
+
+
+def _register_lease():
+    from ..leaderelection import Lease
+
+    _TYPES.setdefault("Lease", Lease)
+    return Lease
+
+
+def encode(obj):
+    """Model object -> JSON-able value (tagged for exact decode)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        cls_name = type(obj).__name__
+        if cls_name == "Lease":
+            _register_lease()
+        out = {"__dc__": cls_name}
+        for f in dataclasses.fields(obj):
+            if (cls_name, f.name) in _SKIP_FIELDS:
+                continue
+            out[f.name] = encode(getattr(obj, f.name))
+        return out
+    if isinstance(obj, Requirements):
+        return {"__requirements__": [
+            {"key": k, "op": op, "values": list(v)}
+            for k, op, v in obj.to_specs()]}
+    if isinstance(obj, tuple):
+        return {"__tuple__": [encode(v) for v in obj]}
+    if isinstance(obj, dict):
+        return {str(k): encode(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [encode(v) for v in obj]
+    return obj  # str/int/float/bool/None
+
+
+def decode(val):
+    if isinstance(val, dict):
+        if "__dc__" in val:
+            name = val["__dc__"]
+            if name == "Lease":
+                _register_lease()
+            cls = _TYPES[name]
+            kwargs = {k: decode(v) for k, v in val.items() if k != "__dc__"}
+            return cls(**kwargs)
+        if "__requirements__" in val:
+            r = Requirements()
+            for spec in val["__requirements__"]:
+                r.add(Requirement.create(spec["key"], spec["op"],
+                                         spec["values"]))
+            return r
+        if "__tuple__" in val:
+            return tuple(decode(v) for v in val["__tuple__"])
+        return {k: decode(v) for k, v in val.items()}
+    if isinstance(val, list):
+        return [decode(v) for v in val]
+    return val
+
+
+def to_manifest(kind: str, name: str, obj) -> dict:
+    """Model -> k8s-shaped manifest (with the exact model embedded)."""
+    api_version, k8s_kind, _ = ROUTES[kind]
+    doc = {
+        "apiVersion": api_version,
+        "kind": k8s_kind,
+        "metadata": {"name": name},
+    }
+    if kind == "configmaps":
+        doc["data"] = dict(obj.get("data", obj)) if isinstance(obj, dict) \
+            else dict(obj)
+        return doc
+    if kind == "pods" and isinstance(obj, PodSpec):
+        # surface the schedulable basics in real schema; exact model embedded
+        doc["metadata"]["labels"] = dict(obj.labels)
+        doc["spec"] = {"nodeName": obj.node_name} if obj.node_name else {}
+    if kind == "nodes" and isinstance(obj, StateNode):
+        doc["metadata"]["labels"] = dict(obj.labels)
+        doc["spec"] = {"providerID": obj.provider_id}
+    doc[MODEL_KEY] = encode(obj)
+    return doc
+
+
+def from_manifest(kind: str, doc: dict):
+    """Manifest -> model. Embedded model wins (lossless); otherwise parse
+    the real k8s schema via yaml_compat (kubectl-authored objects)."""
+    if kind == "configmaps":
+        return {"data": dict(doc.get("data", {}))}
+    embedded = doc.get(MODEL_KEY)
+    if embedded is not None:
+        obj = decode(embedded)
+        if kind == "pods":
+            # the binding subresource mutates spec.nodeName server-side;
+            # the manifest is authoritative over the embedded copy
+            node_name = (doc.get("spec") or {}).get("nodeName", "")
+            if node_name != obj.node_name:
+                obj = dataclasses.replace(obj, node_name=node_name)
+        return obj
+    return _parse_k8s(kind, doc)
+
+
+def _parse_k8s(kind: str, doc: dict):
+    from ..apis import yaml_compat as yc
+
+    if kind == "pods":
+        pod = yc._pod(doc.get("metadata", {}), doc.get("spec", {}))
+        node_name = (doc.get("spec") or {}).get("nodeName", "")
+        if node_name:
+            pod = dataclasses.replace(pod, node_name=node_name)
+        return pod
+    if kind == "provisioners":
+        return yc._provisioner(doc)
+    if kind == "nodetemplates":
+        return yc._nodetemplate(doc)
+    if kind == "pdbs":
+        return yc._pdb(doc, [doc])
+    if kind == "nodes":
+        return _parse_k8s_node(doc)
+    if kind == "leases":
+        return _parse_k8s_lease(doc)
+    # foreign object of a controller-owned kind (e.g. a Machine authored by
+    # another tool): not ours to interpret — callers skip None
+    return None
+
+
+def _parse_k8s_node(doc: dict) -> StateNode:
+    """Kubelet-authored Node manifest -> StateNode (a real cluster has
+    pre-existing nodes the informer must not choke on). Best-effort: the
+    machine-hydration controller fills in karpenter ownership later."""
+    from ..apis import wellknown as wk
+    from ..utils.quantity import cpu_millis, mem_bytes
+
+    meta = doc.get("metadata") or {}
+    spec = doc.get("spec") or {}
+    status = doc.get("status") or {}
+    alloc_q = status.get("allocatable") or status.get("capacity") or {}
+    caps: "dict[str, int]" = {}
+    for key, val in alloc_q.items():
+        try:
+            if key == "cpu":
+                caps[wk.RESOURCE_CPU] = cpu_millis(str(val))
+            elif key == "memory":
+                caps[wk.RESOURCE_MEMORY] = mem_bytes(str(val))
+            elif key == "pods":
+                caps[wk.RESOURCE_PODS] = int(val)
+        except (ValueError, TypeError):
+            continue
+    labels = dict(meta.get("labels") or {})
+    taints = tuple(
+        Taint(key=t.get("key", ""), value=str(t.get("value", "")),
+              effect=t.get("effect", ""))
+        for t in spec.get("taints") or ())
+    return StateNode(
+        name=meta.get("name", ""), labels=labels,
+        allocatable=wk.capacity_vector(caps),
+        provider_id=spec.get("providerID", ""),
+        instance_type=labels.get(wk.LABEL_INSTANCE_TYPE, ""),
+        zone=labels.get(wk.LABEL_ZONE, ""),
+        capacity_type=labels.get(wk.LABEL_CAPACITY_TYPE, ""),
+        provisioner_name=labels.get(wk.LABEL_PROVISIONER, ""),
+        taints=taints)
+
+
+def _parse_k8s_lease(doc: dict):
+    """coordination.k8s.io/v1 Lease manifest -> Lease model (RFC3339
+    renewTime -> epoch seconds)."""
+    import datetime
+
+    Lease = _register_lease()
+    spec = doc.get("spec") or {}
+
+    def ts(key: str) -> float:
+        raw = spec.get(key)
+        if not raw:
+            return 0.0
+        try:
+            return datetime.datetime.fromisoformat(
+                str(raw).replace("Z", "+00:00")).timestamp()
+        except ValueError:
+            return 0.0
+
+    return Lease(holder=spec.get("holderIdentity", ""),
+                 acquired_ts=ts("acquireTime"), renew_ts=ts("renewTime"),
+                 duration_s=float(spec.get("leaseDurationSeconds", 15)))
+
+
+def manifest_name(doc: dict) -> "Optional[str]":
+    return (doc.get("metadata") or {}).get("name")

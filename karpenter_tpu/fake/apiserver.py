@@ -1,0 +1,243 @@
+"""Mini kube-apiserver: a real HTTP server speaking enough of the
+Kubernetes REST API to exercise the coordination plane over the wire.
+
+This is the in-repo kwok/envtest analogue (reference test infrastructure,
+/root/reference/test/pkg/environment/ + envtest in unit suites): the
+HttpKubeStore client, the deploy/ manifests, and the controller CLI can all
+run against it without a cluster.
+
+Supported surface (JSON only):
+
+- CRUD + LIST on core (`/api/v1/...`) and group (`/apis/{g}/{v}/...`)
+  paths, namespaced and cluster-scoped;
+- `?watch=true` chunked watch streams (initial ADDED replay + live events),
+  one JSON object per line, with resourceVersion bookkeeping;
+- optimistic concurrency: PUT with metadata.resourceVersion must match or
+  409 (the CAS substrate for leader-election leases);
+- the pod `binding` subresource (POST .../pods/{name}/binding) setting
+  spec.nodeName, 409 when already bound.
+
+State is plural-keyed documents; the server neither validates schemas nor
+runs admission — that stays client/controller-side, exactly where the
+framework's webhook pipeline sits.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+# path -> (plural); both /api/v1 (core) and /apis/{group}/{version} forms.
+_PATH_RE = re.compile(
+    r"^/(?:api/v1|apis/[^/]+/[^/]+)"
+    r"(?:/namespaces/(?P<ns>[^/]+))?"
+    r"/(?P<plural>[^/?]+)"
+    r"(?:/(?P<name>[^/?]+))?"
+    r"(?:/(?P<sub>binding|status))?$")
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.objects: "dict[str, dict[str, dict]]" = {}
+        self.rv = 0
+        self.watchers: "dict[str, list[queue.Queue]]" = {}
+
+    def bucket(self, plural: str) -> "dict[str, dict]":
+        return self.objects.setdefault(plural, {})
+
+    def next_rv(self) -> str:
+        self.rv += 1
+        return str(self.rv)
+
+    def notify(self, plural: str, type_: str, doc: dict) -> None:
+        for q in self.watchers.get(plural, []):
+            q.put({"type": type_, "object": doc})
+
+    def add_watcher(self, plural: str) -> "queue.Queue":
+        q: "queue.Queue" = queue.Queue()
+        self.watchers.setdefault(plural, []).append(q)
+        return q
+
+    def drop_watcher(self, plural: str, q) -> None:
+        ws = self.watchers.get(plural, [])
+        if q in ws:
+            ws.remove(q)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: _State  # injected by serve()
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    # -- helpers ---------------------------------------------------------------
+
+    def _json(self, code: int, doc) -> None:
+        body = json.dumps(doc).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, reason: str, message: str) -> None:
+        self._json(code, {"kind": "Status", "status": "Failure",
+                          "reason": reason, "message": message, "code": code})
+
+    def _read_body(self) -> dict:
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n) or b"{}")
+
+    def _route(self):
+        path, _, query = self.path.partition("?")
+        m = _PATH_RE.match(path)
+        if m is None:
+            return None
+        return m.group("plural"), m.group("name"), m.group("sub"), query
+
+    # -- verbs -----------------------------------------------------------------
+
+    def do_GET(self):
+        r = self._route()
+        if r is None:
+            return self._error(404, "NotFound", self.path)
+        plural, name, _sub, query = r
+        st = self.state
+        if name is None and "watch=true" in query:
+            return self._watch(plural)
+        with st.lock:
+            bucket = st.bucket(plural)
+            if name is None:
+                items = list(bucket.values())
+                return self._json(200, {"kind": "List", "items": items,
+                                        "metadata": {"resourceVersion": str(st.rv)}})
+            doc = bucket.get(name)
+        if doc is None:
+            return self._error(404, "NotFound", f"{plural}/{name}")
+        return self._json(200, doc)
+
+    def _watch(self, plural: str) -> None:
+        st = self.state
+        with st.lock:
+            q = st.add_watcher(plural)
+            initial = list(st.bucket(plural).values())
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def emit(event: dict) -> None:
+                line = (json.dumps(event) + "\n").encode()
+                self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                self.wfile.flush()
+
+            for doc in initial:
+                emit({"type": "ADDED", "object": doc})
+            while True:
+                try:
+                    emit(q.get(timeout=1.0))
+                except queue.Empty:
+                    emit({"type": "BOOKMARK", "object": {}})  # liveness tick
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            with st.lock:
+                st.drop_watcher(plural, q)
+
+    def do_POST(self):
+        r = self._route()
+        if r is None:
+            return self._error(404, "NotFound", self.path)
+        plural, name, sub, _ = r
+        st = self.state
+        body = self._read_body()
+        if sub == "binding":
+            target = ((body.get("target") or {}).get("name")
+                      or body.get("nodeName", ""))
+            with st.lock:
+                doc = st.bucket(plural).get(name)
+                if doc is None:
+                    return self._error(404, "NotFound", f"{plural}/{name}")
+                spec = doc.setdefault("spec", {})
+                if spec.get("nodeName"):
+                    return self._error(
+                        409, "Conflict",
+                        f"pod {name} already bound to {spec['nodeName']}")
+                spec["nodeName"] = target
+                doc["metadata"]["resourceVersion"] = st.next_rv()
+                st.notify(plural, "MODIFIED", doc)
+            return self._json(201, {"kind": "Status", "status": "Success"})
+        obj_name = (body.get("metadata") or {}).get("name") or name
+        if not obj_name:
+            return self._error(422, "Invalid", "metadata.name required")
+        with st.lock:
+            bucket = st.bucket(plural)
+            if obj_name in bucket:
+                return self._error(409, "AlreadyExists",
+                                   f"{plural}/{obj_name} already exists")
+            body.setdefault("metadata", {})["name"] = obj_name
+            body["metadata"]["resourceVersion"] = st.next_rv()
+            bucket[obj_name] = body
+            st.notify(plural, "ADDED", body)
+        return self._json(201, body)
+
+    def do_PUT(self):
+        r = self._route()
+        if r is None or r[1] is None:
+            return self._error(404, "NotFound", self.path)
+        plural, name, _sub, _ = r
+        st = self.state
+        body = self._read_body()
+        want_rv = (body.get("metadata") or {}).get("resourceVersion")
+        with st.lock:
+            bucket = st.bucket(plural)
+            cur = bucket.get(name)
+            if cur is not None and want_rv is not None \
+                    and cur["metadata"].get("resourceVersion") != want_rv:
+                return self._error(409, "Conflict",
+                                   f"{plural}/{name} resourceVersion mismatch")
+            body.setdefault("metadata", {})["name"] = name
+            body["metadata"]["resourceVersion"] = st.next_rv()
+            bucket[name] = body
+            st.notify(plural, "MODIFIED" if cur is not None else "ADDED", body)
+        return self._json(200, body)
+
+    def do_DELETE(self):
+        r = self._route()
+        if r is None or r[1] is None:
+            return self._error(404, "NotFound", self.path)
+        plural, name, _sub, _ = r
+        st = self.state
+        body = self._read_body()
+        want_rv = (body.get("preconditions") or {}).get("resourceVersion")
+        with st.lock:
+            cur = st.bucket(plural).get(name)
+            if cur is not None and want_rv is not None \
+                    and cur["metadata"].get("resourceVersion") != want_rv:
+                return self._error(409, "Conflict",
+                                   f"{plural}/{name} resourceVersion mismatch")
+            doc = st.bucket(plural).pop(name, None)
+            if doc is not None:
+                st.notify(plural, "DELETED", doc)
+        if doc is None:
+            return self._error(404, "NotFound", f"{plural}/{name}")
+        return self._json(200, doc)
+
+
+def serve(address: str = "127.0.0.1", port: int = 0
+          ) -> "tuple[ThreadingHTTPServer, int, _State]":
+    """Start the mini apiserver; returns (server, bound_port, state)."""
+    state = _State()
+    handler = type("Handler", (_Handler,), {"state": state})
+    srv = ThreadingHTTPServer((address, port), handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="mini-apiserver")
+    t.start()
+    return srv, srv.server_address[1], state

@@ -1,0 +1,295 @@
+"""Coordination plane over the wire: mini apiserver + HttpKubeStore +
+the full controller plane scheduling a kubectl-authored pod.
+
+Parity target: the reference boots against a real apiserver
+(/root/reference/cmd/controller/main.go:33-65) and its unit tier runs
+envtest; here the in-repo mini apiserver (fake/apiserver.py) plays the
+kwok/envtest role and HttpKubeStore is the client-go analogue.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+from karpenter_tpu.apis.settings import Settings
+from karpenter_tpu.coordination.httpkube import HttpKubeStore
+from karpenter_tpu.coordination.protocol import CoordinationPlane
+from karpenter_tpu.coordination import serde
+from karpenter_tpu.fake.apiserver import serve
+from karpenter_tpu.fake.cloud import FakeCloud
+from karpenter_tpu.fake.kube import Conflict, KubeStore
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.operator import Operator
+
+
+@pytest.fixture
+def api():
+    srv, port, state = serve()
+    yield f"http://127.0.0.1:{port}", state
+    srv.shutdown()
+
+
+def _post_raw(base: str, path: str, doc: dict) -> None:
+    req = urllib.request.Request(base + path, json.dumps(doc).encode(),
+                                 {"Content-Type": "application/json"},
+                                 method="POST")
+    urllib.request.urlopen(req).read()
+
+
+def catalog():
+    return Catalog(types=[
+        make_instance_type("m.large", cpu=4, memory="16Gi", od_price=0.20,
+                           spot_price=0.07),
+    ])
+
+
+class TestProtocolConformance:
+    def test_both_stores_implement_the_protocol(self, api):
+        base, _ = api
+        http_store = HttpKubeStore(base)
+        assert isinstance(http_store, CoordinationPlane)
+        assert isinstance(KubeStore(), CoordinationPlane)
+
+
+class TestHttpStore:
+    def test_crud_watch_and_read_your_writes(self, api):
+        base, _ = api
+        a = HttpKubeStore(base)
+        a.start()
+        b = HttpKubeStore(base)
+        b.start()
+        a.create("pods", "p1", make_pod("p1", cpu="1", memory="1Gi"))
+        assert [p.name for p in a.pending_pods()] == ["p1"]  # no wait
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not b.pending_pods():
+            time.sleep(0.02)
+        assert [p.name for p in b.pending_pods()] == ["p1"]
+        # duplicate create conflicts through the wire
+        with pytest.raises(Conflict):
+            b.create("pods", "p1", make_pod("p1", cpu="1", memory="1Gi"))
+        a.stop(), b.stop()
+
+    def test_binding_subresource(self, api):
+        base, _ = api
+        a = HttpKubeStore(base)
+        a.start()
+        a.create("pods", "p1", make_pod("p1", cpu="1", memory="1Gi"))
+        a.bind_pod("p1", "node-1")
+        assert a.get("pods", "p1").node_name == "node-1"
+        assert a.pending_pods() == []
+        with pytest.raises(Conflict):
+            a.bind_pod("p1", "node-2")
+        a.stop()
+
+    def test_cas_leases_over_the_wire(self, api):
+        base, _ = api
+        a = HttpKubeStore(base)
+        a.start()
+        from karpenter_tpu.leaderelection import Lease
+
+        a.create("leases", "karpenter-leader", Lease("x", 1, 1, 15))
+        cached = a.get("leases", "karpenter-leader")
+        a.compare_and_swap("leases", "karpenter-leader", cached,
+                           Lease("x", 1, 2, 15))
+        with pytest.raises(Conflict):  # stale expectation loses
+            a.compare_and_swap("leases", "karpenter-leader", cached,
+                               Lease("y", 9, 9, 15))
+        a.stop()
+
+    def test_leader_election_over_http(self, api):
+        base, _ = api
+        from karpenter_tpu.leaderelection import LeaderElector
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        a_store, b_store = HttpKubeStore(base), HttpKubeStore(base)
+        a_store.start(), b_store.start()
+        a = LeaderElector(a_store, "a", clock=clock, lease_duration_s=15)
+        b = LeaderElector(b_store, "b", clock=clock, lease_duration_s=15)
+        assert a.try_acquire_or_renew()
+        deadline = time.monotonic() + 5  # b's cache must see a's lease
+        while time.monotonic() < deadline and \
+                b_store.get("leases", a.name) is None:
+            time.sleep(0.02)
+        assert not b.try_acquire_or_renew()
+        clock.step(16)  # a stops renewing; TTL expires
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not b.try_acquire_or_renew():
+            time.sleep(0.05)
+        assert b.is_leader()
+        a_store.stop(), b_store.stop()
+
+
+class TestControllerOverTheWire:
+    def test_kubectl_authored_pod_schedules_end_to_end(self, api, tmp_path):
+        """The done-criterion for VERDICT r2 ask #3: the controller, against
+        a real (HTTP) apiserver, schedules a pending pod created in plain
+        Kubernetes schema using the deploy/ + examples/ manifests."""
+        base, state = api
+
+        # 1) kubectl-style applies: CRDs (stored as-is), then the quickstart
+        # provisioner + nodetemplate (k8s schema, parsed by yaml_compat)
+        for crd_path in ("deploy/crds/karpenter.sh_provisioners.yaml",
+                         "deploy/crds/karpenter.k8s.tpu_nodetemplates.yaml"):
+            doc = yaml.safe_load(open(crd_path))
+            _post_raw(base, "/apis/apiextensions.k8s.io/v1/"
+                      "customresourcedefinitions", doc)
+        bundle = open("examples/quickstart.yaml").read().replace(
+            "${CLUSTER_NAME}", "wire-test")
+        for doc in yaml.safe_load_all(bundle):
+            if not doc:
+                continue
+            kind = doc["kind"]
+            if kind == "Provisioner":
+                _post_raw(base, "/apis/karpenter.sh/v1alpha5/provisioners", doc)
+            elif kind == "NodeTemplate":
+                _post_raw(base, "/apis/karpenter.k8s.tpu/v1alpha1/"
+                          "nodetemplates", doc)
+        # one plain-schema pending pod (what kube-scheduler would leave)
+        _post_raw(base, "/api/v1/namespaces/default/pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "web-0", "labels": {"app": "web"}},
+            "spec": {"containers": [{
+                "name": "c",
+                "resources": {"requests": {"cpu": "1", "memory": "1Gi"}},
+            }]},
+        })
+
+        # 2) the controller plane against the wire store
+        kube = HttpKubeStore(base)
+        kube.start()
+        assert [p.name for p in kube.provisioners()] == ["default"]
+        assert [t.name for t in kube.nodetemplates()] == ["default"]
+        assert [p.name for p in kube.pending_pods()] == ["web-0"]
+
+        cat = catalog()
+        cloud = FakeCloud(cat)
+        for s in cloud.subnets:
+            s.tags.setdefault("karpenter.sh/discovery", "wire-test")
+        for g in cloud.security_groups:
+            g.tags.setdefault("karpenter.sh/discovery", "wire-test")
+        settings = Settings(cluster_name="wire-test",
+                            cluster_endpoint="https://wire",
+                            batch_idle_duration=0.0, batch_max_duration=0.0)
+        op = Operator(cloud, settings, cat, kube=kube)
+        try:
+            op.reconcile_all_once()
+            # 3) server-side truth: the pod is BOUND and capacity objects
+            # exist on the apiserver, not just in process memory
+            pod_doc = state.bucket("pods")["web-0"]
+            assert pod_doc["spec"].get("nodeName"), "pod not bound server-side"
+            assert state.bucket("machines"), "no machine object on the server"
+            assert state.bucket("nodes"), "no node object on the server"
+            assert kube.pending_pods() == []
+            # the bound node is the machine's node (names line up)
+            node_name = pod_doc["spec"]["nodeName"]
+            assert node_name in state.bucket("nodes")
+        finally:
+            op.stop()
+            kube.stop()
+
+
+class TestSerde:
+    def test_k8s_pod_without_embedded_model_parses(self):
+        doc = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "kp", "labels": {"app": "y"}},
+               "spec": {"nodeName": "n9", "containers": [{
+                   "name": "c", "resources": {
+                       "requests": {"cpu": "500m", "memory": "1Gi"}}}]}}
+        pod = serde.from_manifest("pods", doc)
+        assert pod.node_name == "n9"
+        assert dict(pod.labels) == {"app": "y"}
+
+    def test_machine_round_trip_is_lossless(self):
+        from karpenter_tpu.models.machine import (Machine, MachineSpec,
+                                                  MachineStatus)
+        from karpenter_tpu.models.requirements import (OP_IN, Requirements)
+        from karpenter_tpu.apis import wellknown as wk
+
+        m = Machine(name="m1", spec=MachineSpec(
+            requirements=Requirements.of((wk.LABEL_ARCH, OP_IN, ["amd64"])),
+            resource_requests={"cpu": 1500}),
+            status=MachineStatus(provider_id="tpu:///z-1a/i-123",
+                                 state="Launched"))
+        doc = serde.to_manifest("machines", "m1", m)
+        json.dumps(doc)  # JSON-able
+        m2 = serde.from_manifest("machines", doc)
+        assert m2 == m
+
+    def test_statenode_pods_are_runtime_only(self):
+        from karpenter_tpu.models.cluster import StateNode
+        from karpenter_tpu.apis import wellknown as wk
+
+        sn = StateNode(name="n", labels={}, allocatable=[0] * wk.NUM_RESOURCES,
+                       pods=[make_pod("x", cpu="1", memory="1Gi")])
+        back = serde.from_manifest(
+            "nodes", serde.to_manifest("nodes", "n", sn))
+        assert back.pods == []
+
+
+class TestReviewHardening:
+    def test_foreign_node_manifests_parse(self):
+        # a real cluster has kubelet-authored Nodes with no embedded model
+        doc = {"apiVersion": "v1", "kind": "Node",
+               "metadata": {"name": "ip-10-0-0-1",
+                            "labels": {"topology.kubernetes.io/zone": "z1"}},
+               "spec": {"providerID": "tpu:///z1/i-9",
+                        "taints": [{"key": "k", "value": "v",
+                                    "effect": "NoSchedule"}]},
+               "status": {"allocatable": {"cpu": "4", "memory": "16Gi",
+                                          "pods": "110"}}}
+        node = serde.from_manifest("nodes", doc)
+        from karpenter_tpu.apis import wellknown as wk
+
+        assert node.name == "ip-10-0-0-1"
+        assert node.provider_id == "tpu:///z1/i-9"
+        assert node.allocatable[wk.RESOURCE_INDEX[wk.RESOURCE_CPU]] == 4000
+        assert node.taints[0].key == "k"
+
+    def test_foreign_lease_manifests_parse(self):
+        doc = {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+               "metadata": {"name": "other-leader"},
+               "spec": {"holderIdentity": "someone",
+                        "renewTime": "2026-07-29T00:00:00Z",
+                        "leaseDurationSeconds": 30}}
+        lease = serde.from_manifest("leases", doc)
+        assert lease.holder == "someone" and lease.duration_s == 30.0
+        assert lease.renew_ts > 0
+
+    def test_foreign_machine_is_skipped_not_fatal(self, api):
+        base, _ = api
+        _post_raw(base, "/apis/karpenter.sh/v1alpha5/machines", {
+            "apiVersion": "karpenter.sh/v1alpha5", "kind": "Machine",
+            "metadata": {"name": "foreign-1"}, "spec": {}})
+        store = HttpKubeStore(base)
+        store.start()  # must not raise on the uninterpretable machine
+        assert store.machines() == []  # visible server-side, not cached
+        store.stop()
+
+    def test_delete_if_respects_server_side_precondition(self, api):
+        base, state = api
+        from karpenter_tpu.leaderelection import Lease
+
+        a = HttpKubeStore(base)
+        a.start()
+        a.create("leases", "l", Lease("a", 1, 1, 15))
+        ours = a.get("leases", "l")
+        # a successor CAS-writes behind our back (raw PUT bumps the rv)
+        doc = dict(state.bucket("leases")["l"])
+        doc.pop("x-karpenter-model", None)
+        doc["spec"] = {"holderIdentity": "b", "renewTime": "2026-07-29T00:00:00Z",
+                       "leaseDurationSeconds": 15}
+        del doc["metadata"]["resourceVersion"]
+        req = urllib.request.Request(
+            base + "/apis/coordination.k8s.io/v1/namespaces/default/leases/l",
+            json.dumps(doc).encode(), {"Content-Type": "application/json"},
+            method="PUT")
+        urllib.request.urlopen(req).read()
+        # our stale-precondition delete must NOT remove the successor's lease
+        assert a.delete_if("leases", "l", ours) is False
+        assert "l" in state.bucket("leases")
+        a.stop()
