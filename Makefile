@@ -19,9 +19,8 @@ battletest:  ## randomized/race tier, shuffled ordering, 3x
 deflake:  ## loop the race tier until it fails
 	while $(PY) -m pytest tests/test_battletest.py -q; do :; done
 
-benchmark:  ## interruption throughput + BASELINE config scenarios (CPU)
-	env $(CPU_ENV) $(PY) -m benchmarks.interruption_bench
-	env $(CPU_ENV) $(PY) -m benchmarks.baseline_configs
+benchmark:  ## interruption ladder + BASELINE configs, RECORDED + diffed
+	env $(CPU_ENV) $(PY) -m benchmarks.record
 
 bench:  ## the headline one-line benchmark (real TPU when present)
 	$(PY) bench.py

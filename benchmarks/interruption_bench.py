@@ -40,7 +40,8 @@ def build_operator(n_nodes: int) -> Operator:
                         batch_idle_duration=0.0, batch_max_duration=0.0)
     op = Operator(FakeCloud(catalog=_catalog()), settings, _catalog())
     op.kube.create("nodetemplates", "default", NodeTemplate(
-        name="default", subnet_selector={"id": "subnet-zone-1a"}))
+        name="default", subnet_selector={"id": "subnet-zone-1a"},
+        security_group_selector={"id": "sg-default"}))
     # seed nodes directly, as the reference benchmark provisions fake nodes
     # (interruption_benchmark_test.go:87-120) — provisioning isn't under test
     for i in range(n_nodes):

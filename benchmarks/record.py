@@ -1,0 +1,110 @@
+"""Run the benchmark ladder and RECORD the results (VERDICT r2 ask #8).
+
+The reference's benchmark tier prints numbers that CI then archives per run
+(interruption_benchmark_test.go:61-76 scale ladder); rounds 1-2 here ran
+`make benchmark` and discarded the output. This wrapper:
+
+  1. runs benchmarks.interruption_bench (scale ladder incl. 15k) and
+     benchmarks.baseline_configs (all configs incl. 3: consolidation-500
+     and 4: stress-50k-sharded),
+  2. writes one dated record into benchmarks/results/bench_<utc>.json,
+  3. diffs against the previous record and prints per-metric deltas, so
+     round-over-round regressions are visible in CI, not folklore.
+
+Usage: python -m benchmarks.record [--skip-stress]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS_DIR = os.path.join(REPO, "benchmarks", "results")
+
+
+def _run_json_lines(argv: "list[str]") -> "list[dict]":
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never grab the real chip here
+    proc = subprocess.run([sys.executable, "-m", *argv], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=3600)
+    out = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                pass
+    if proc.returncode != 0:
+        print(proc.stderr[-500:], file=sys.stderr)
+    return out
+
+
+def _key(rec: dict) -> str:
+    if rec.get("bench") == "baseline_config":
+        return f"config{rec['config']}:{rec.get('name', '')}"
+    if "scale" in rec:
+        return f"interruption:{rec['scale']}"
+    return rec.get("bench", rec.get("metric", "?"))
+
+
+def _metric_ms(rec: dict):
+    for field in ("ms", "p50_ms", "wall_ms", "value"):
+        if field in rec:
+            return rec[field]
+    return None
+
+
+def previous_record() -> "dict | None":
+    try:
+        names = sorted(n for n in os.listdir(RESULTS_DIR)
+                       if n.startswith("bench_") and n.endswith(".json"))
+    except FileNotFoundError:
+        return None
+    if not names:
+        return None
+    with open(os.path.join(RESULTS_DIR, names[-1])) as f:
+        return json.load(f)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-stress", action="store_true",
+                    help="skip config 4 (50k sharded; minutes on CPU)")
+    args = ap.parse_args(argv)
+
+    prev = previous_record()
+    results = _run_json_lines(["benchmarks.interruption_bench"])
+    configs = "0,1,2,3,5" if args.skip_stress else "0,1,2,3,4,5"
+    results += _run_json_lines(["benchmarks.baseline_configs",
+                                "--configs", configs])
+
+    ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    record = {"recorded_at": ts, "backend": "cpu", "entries": results}
+    os.makedirs(RESULTS_DIR, exist_ok=True)
+    path = os.path.join(RESULTS_DIR, f"bench_{ts}.json")
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1)
+    print(f"recorded {len(results)} entries -> {path}")
+
+    if prev:
+        prev_by_key = {_key(r): r for r in prev.get("entries", [])}
+        print(f"vs {prev.get('recorded_at', 'previous')}:")
+        for rec in results:
+            k = _key(rec)
+            cur = _metric_ms(rec)
+            old = _metric_ms(prev_by_key.get(k, {}))
+            if cur is None or old in (None, 0):
+                continue
+            print(f"  {k}: {old:.1f} -> {cur:.1f} ms "
+                  f"({(cur / old - 1) * 100:+.0f}%)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

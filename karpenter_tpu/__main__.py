@@ -128,7 +128,8 @@ def cmd_controller(args) -> int:
         # (kube.create runs the admission webhooks: defaulting + validation)
         op.kube.create("nodetemplates", "default", NodeTemplate(
             name="default",
-            subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"}))
+            subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"},
+            security_group_selector={"id": "sg-default"}))
         op.kube.create("provisioners", "default",
                        Provisioner(name="default", provider_ref="default"))
     op.start()

@@ -13,6 +13,7 @@ tags per tags.go:29+.
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Optional
 
 from .provisioner import ValidationError
@@ -20,18 +21,56 @@ from .provisioner import ValidationError
 IMAGE_FAMILIES = ("ubuntu-k8s", "flatboat", "custom")
 RESTRICTED_TAG_PREFIXES = ("karpenter.sh/", "kubernetes.io/cluster")
 
+# resource-id shapes for "id"/"ids" selector values (reference:
+# provider_validation.go:40-44 subnetRegex/securityGroupRegex, and
+# awsnodetemplate_validation.go amiRegex)
+_ID_RES = {
+    "subnet": re.compile(r"^subnet-[0-9a-z-]+$"),
+    "sg": re.compile(r"^sg-[0-9a-z-]+$"),
+    "img": re.compile(r"^img-[0-9a-z-]+$"),
+}
+MAX_VOLUME_GIB = 64 * 1024  # 64 TiB (provider_validation.go maxVolumeSize)
+
+
+def _validate_selector(field: str, selector: "dict[str, str]",
+                       id_kind: Optional[str] = None) -> None:
+    """Selector hygiene (provider_validation.go:86-100): no empty keys or
+    values; explicit "id"/"ids" values must be well-formed resource ids."""
+    for key, value in selector.items():
+        if key == "" or value == "":
+            raise ValidationError(
+                f"{field}[{key!r}] must have a non-empty key and value")
+        if id_kind is not None and key in ("id", "ids"):
+            regex = _ID_RES[id_kind]
+            for item in value.split(","):
+                if not regex.match(item.strip()):
+                    raise ValidationError(
+                        f"{field}[{key!r}]: {item.strip()!r} is not a valid "
+                        f"{id_kind} id ({regex.pattern})")
+
 
 @dataclasses.dataclass
 class MetadataOptions:
     http_endpoint: str = "enabled"
     http_tokens: str = "required"
     http_put_response_hop_limit: int = 2
+    http_protocol_ipv6: str = "disabled"  # dual-stack metadata endpoint
 
     def validate(self):
         if self.http_endpoint not in ("enabled", "disabled"):
             raise ValidationError("metadataOptions.httpEndpoint must be enabled|disabled")
         if self.http_tokens not in ("required", "optional"):
             raise ValidationError("metadataOptions.httpTokens must be required|optional")
+        if self.http_protocol_ipv6 not in ("enabled", "disabled"):
+            raise ValidationError(
+                "metadataOptions.httpProtocolIPv6 must be enabled|disabled")
+        if not 1 <= self.http_put_response_hop_limit <= 64:
+            # provider_validation.go:169-177 bounds
+            raise ValidationError(
+                "metadataOptions.httpPutResponseHopLimit must be in [1, 64]")
+
+    def is_default(self) -> bool:
+        return self == MetadataOptions()
 
 
 @dataclasses.dataclass
@@ -43,10 +82,16 @@ class BlockDeviceMapping:
     iops: Optional[int] = None
 
     def validate(self):
-        if self.volume_size_gib < 1:
-            raise ValidationError("blockDeviceMapping.volumeSize must be >= 1GiB")
+        if not self.device_name:
+            raise ValidationError("blockDeviceMapping.deviceName is required")
+        if not 1 <= self.volume_size_gib <= MAX_VOLUME_GIB:
+            raise ValidationError(
+                f"blockDeviceMapping.volumeSize must be in [1GiB, 64TiB], "
+                f"got {self.volume_size_gib}GiB")
         if self.volume_type not in ("ssd", "balanced", "throughput"):
             raise ValidationError(f"unknown volume type {self.volume_type}")
+        if self.iops is not None and self.volume_type != "ssd":
+            raise ValidationError("iops is only configurable for ssd volumes")
 
 
 @dataclasses.dataclass
@@ -72,24 +117,53 @@ class NodeTemplate:
     generation: int = 1
     status: NodeTemplateStatus = dataclasses.field(default_factory=NodeTemplateStatus)
 
-    def validate(self) -> None:
+    def validate(self, cluster_name: Optional[str] = None) -> None:
+        """Full v1alpha1 validation (awsnodetemplate_validation.go +
+        provider_validation.go:46+ + restricted tags per tags.go:29+;
+        per-cluster ownership tag restriction when `cluster_name` given)."""
         if self.image_family not in IMAGE_FAMILIES:
             raise ValidationError(
                 f"imageFamily must be one of {IMAGE_FAMILIES}, got {self.image_family!r}")
         if self.image_family == "custom" and not self.image_selector:
             raise ValidationError("imageFamily=custom requires imageSelector")
-        if self.launch_template_name and (
-                self.userdata or self.image_selector or self.block_device_mappings):
-            raise ValidationError(
-                "launchTemplateName is mutually exclusive with userData/"
-                "imageSelector/blockDeviceMappings")
+        if self.launch_template_name:
+            # static LT owns bootstrap, networking, devices AND identity:
+            # every field it subsumes is mutually exclusive with it
+            # (provider_validation.go:64-84 + validateUserData/validateAMISelector)
+            conflicts = [
+                ("userData", self.userdata),
+                ("imageSelector", self.image_selector),
+                ("blockDeviceMappings", self.block_device_mappings),
+                ("securityGroupSelector", self.security_group_selector),
+                ("instanceProfile", self.instance_profile),
+                ("metadataOptions", not self.metadata_options.is_default()),
+            ]
+            for field, present in conflicts:
+                if present:
+                    raise ValidationError(
+                        f"launchTemplateName is mutually exclusive with {field}")
         if not self.subnet_selector:
             # launch always needs subnets for the zonal overrides, static LT
             # or not (instance.go:325-373)
             raise ValidationError("subnetSelector is required")
-        for key in self.tags:
+        if not self.launch_template_name and not self.security_group_selector:
+            # matches validateSecurityGroups: SGs required unless the static
+            # LT carries them
+            raise ValidationError(
+                "securityGroupSelector is required without launchTemplateName")
+        _validate_selector("subnetSelector", self.subnet_selector, "subnet")
+        _validate_selector("securityGroupSelector",
+                           self.security_group_selector, "sg")
+        _validate_selector("imageSelector", self.image_selector, "img")
+        for key, value in self.tags.items():
+            if key == "":
+                raise ValidationError(
+                    f"empty tag keys are not supported (value {value!r})")
             if any(key.startswith(p) for p in RESTRICTED_TAG_PREFIXES):
                 raise ValidationError(f"restricted tag key: {key}")
+            if cluster_name and key == f"kubernetes.io/cluster/{cluster_name}":
+                raise ValidationError(
+                    f"tag {key} is reserved for cluster ownership")
         self.metadata_options.validate()
         for bdm in self.block_device_mappings:
             bdm.validate()

@@ -92,7 +92,7 @@ class Operator:
         self.cluster.pdbs = self.kube.pdbs()
         # admission webhooks at the coordination-plane boundary
         # (operator.WithWebhooks analogue, cmd/controller/main.go:58-63)
-        self.webhooks = Webhooks()
+        self.webhooks = Webhooks(cluster_name=settings.cluster_name)
         self.kube.set_admission(self.webhooks.admit)
         self.machinehydration = MachineHydrationController(
             self.kube, self.cloudprovider, cluster=self.cluster,

@@ -308,13 +308,18 @@ def resolve_pod_affinity(groups: "list[PodGroup]", zones: Sequence[str],
       anywhere the group itself can go (the k8s first-pod bootstrap rule).
     - hostname AFFINITY: with matching residents, pin to those nodes
       (hostname In [...] — fresh options carry no hostname, so only those
-      nodes fit). With matching co-pending pods, no hard pin is derivable
-      pre-solve; FFD packing co-locates best-effort (documented gap).
+      nodes fit). Matching CO-PENDING pods are handled by the two-round
+      solve (split_deferred_pods): the dependent group is deferred, the
+      target's round-1 claims join `existing` as pseudo nodes, and this
+      same resident pin applies — hard co-location.
     - zone/hostname ANTI-affinity with a non-self selector: exclude the
       domains that hold matching residents (NotIn; fresh options lack the
       hostname key, so NotIn admits them). Anti-affinity BETWEEN co-pending
-      groups is not expressible in the group-scan model (documented gap);
-      self-selecting anti-affinity uses the anti_affinity_* booleans.
+      groups also resolves through the two-round solve (the target's
+      claims/zones become resident domains to exclude); self-selecting
+      anti-affinity uses the anti_affinity_* booleans. Greedy first-wins:
+      dependency chains deeper than one round stay best-effort (the
+      sequential kube-scheduler has the same horizon).
     """
     has_terms = any(g.spec.pod_affinity or g.spec.pod_anti_affinity
                     for g in groups)
@@ -492,6 +497,47 @@ def split_zone_spread(groups: "list[PodGroup]", zones: Sequence[str],
     return out
 
 
+def split_deferred_pods(pods: "list[PodSpec]") -> "tuple[list[PodSpec], list[PodSpec]]":
+    """(primary, deferred) for the two-round co-pending affinity solve.
+
+    A group whose required pod-(anti-)affinity terms match another CO-PENDING
+    group kept in the primary round is deferred: round 1 places the targets,
+    their claims are then presented to round 2 as existing nodes (with the
+    target pods as residents), and the resident-based affinity machinery —
+    hostname In pins, domain NotIn exclusions, per-node resident caps —
+    resolves the co-pending terms exactly as it does for real residents.
+
+    Greedy first-wins ordering (matching the sequential kube-scheduler):
+    mutual/cyclic dependencies keep the first group in round 1 and defer the
+    rest; chains deeper than one round stay best-effort.
+    """
+    groups = group_pods([p for p in pods if not p.is_daemon()])
+    primary_specs: "list[PodSpec]" = []
+    deferred_keys: "set" = set()
+    for g in groups:
+        spec = g.spec
+        defer = False
+        for term in tuple(spec.pod_affinity) + tuple(spec.pod_anti_affinity):
+            if any(og is not spec and term.matches(og.labels)
+                   for og in primary_specs):
+                defer = True
+                break
+        if defer:
+            deferred_keys.add(spec.group_key())
+        else:
+            primary_specs.append(spec)
+    if not deferred_keys:
+        return list(pods), []
+    primary: "list[PodSpec]" = []
+    deferred: "list[PodSpec]" = []
+    for p in pods:
+        if not p.is_daemon() and p.group_key() in deferred_keys:
+            deferred.append(p)
+        else:
+            primary.append(p)
+    return primary, deferred
+
+
 def prepare_groups(pods: "list[PodSpec]", zones: Sequence[str],
                    existing: "Sequence[ExistingNode]" = ()) -> "list[PodGroup]":
     """Dedupe -> zone-spread split (domain-population aware) -> FFD sort
@@ -541,7 +587,50 @@ class Scheduler:
         pods: "list[PodSpec]",
         existing: "Iterable[ExistingNode]" = (),
     ) -> SchedulingResult:
+        """Two-round driver: groups with co-pending affinity targets are
+        deferred; round 1's claims join `existing` for round 2 so the
+        resident-based affinity logic resolves them (split_deferred_pods)."""
         existing = list(existing)
+        primary, deferred = split_deferred_pods(pods)
+        if not deferred:
+            return self._schedule_once(pods, existing)
+        res = self._schedule_once(primary, existing)
+        pseudo = self._claims_as_existing(res.new_nodes)
+        res2 = self._schedule_once(deferred, existing + pseudo)
+        # merge: dependents placed on round-1 claims fold back into them
+        by_name = {p.name: (p, claim) for p, claim in
+                   zip(pseudo, res.new_nodes)}
+        for name, placed in list(res2.existing_assignments.items()):
+            hit = by_name.get(name)
+            if hit is None:
+                res.existing_assignments.setdefault(name, []).extend(placed)
+                continue
+            hit[1].pods.extend(placed)
+        res.new_nodes.extend(res2.new_nodes)
+        res.unschedulable.extend(res2.unschedulable)
+        return res
+
+    def _claims_as_existing(self, claims: "list[NodeClaim]") -> "list[ExistingNode]":
+        """Round-1 claims as existing nodes: labels of the decided option,
+        remaining capacity under that option, the claim's pods as residents."""
+        out = []
+        for i, n in enumerate(claims):
+            opt = n.decide(self.options)
+            out.append(ExistingNode(
+                name=f"__round1-claim-{i}",
+                labels=option_labels(opt, n.provisioner),
+                allocatable=list(effective_alloc(opt, n.provisioner)),
+                used=list(n.used),
+                taints=n.provisioner.taints,
+                resident=tuple(n.pods),
+            ))
+        return out
+
+    def _schedule_once(
+        self,
+        pods: "list[PodSpec]",
+        existing: "list[ExistingNode]",
+    ) -> SchedulingResult:
         groups = prepare_groups(pods, self.zones, existing)
 
         feas_cache: "dict[tuple[int, str], set[int]]" = {}

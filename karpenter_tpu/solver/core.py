@@ -100,12 +100,100 @@ class TPUSolver:
         daemon_overhead: Optional[Sequence[int]] = None,
         n_slots: Optional[int] = None,
     ) -> SolveResult:
+        """Two-round driver (shared semantics with the oracle's schedule):
+        groups whose required pod-(anti-)affinity terms target CO-PENDING
+        groups are deferred; round 1's solved claims join `existing` as
+        pseudo nodes carrying their pods as residents, so round 2 resolves
+        the terms through the resident-based affinity machinery."""
+        from ..oracle.scheduler import split_deferred_pods
+
+        primary, deferred = split_deferred_pods(pods)
+        if not deferred:
+            return self._solve_once(pods, existing, daemon_overhead, n_slots)
+        res = self._solve_once(primary, existing, daemon_overhead, n_slots)
+        pseudo = self._nodes_as_existing(res, daemon_overhead)
+        res2 = self._solve_once(deferred, list(existing) + pseudo,
+                                daemon_overhead, n_slots)
+        return _merge_rounds(res, res2, {p.name: i for i, p in
+                                         enumerate(pseudo)})
+
+    def _nodes_as_existing(self, res: SolveResult,
+                           daemon_overhead) -> "list[ExistingNode]":
+        """Round-1 claims as existing nodes (mirror of the oracle's
+        _claims_as_existing: decided-option labels/alloc, pods resident)."""
+        from ..oracle.scheduler import (effective_alloc,
+                                        kubelet_overhead_vector, option_labels)
+
+        out = []
+        for i, n in enumerate(res.nodes):
+            used = [d + k for d, k in zip(
+                list(daemon_overhead or [0] * wk.NUM_RESOURCES),
+                kubelet_overhead_vector(n.provisioner.kubelet))]
+            resident: "list[PodSpec]" = []
+            for g_idx, count in n.pod_counts.items():
+                spec = res.groups[g_idx].spec
+                vec = res.groups[g_idx].vector
+                for r in range(wk.NUM_RESOURCES):
+                    used[r] += vec[r] * count
+                resident.extend([spec] * count)
+            out.append(ExistingNode(
+                name=f"__round1-claim-{i}",
+                labels=option_labels(n.option, n.provisioner),
+                allocatable=list(effective_alloc(n.option, n.provisioner)),
+                used=used,
+                taints=n.provisioner.taints,
+                resident=tuple(resident),
+            ))
+        return out
+
+    def _solve_once(
+        self,
+        pods: "list[PodSpec]",
+        existing: Sequence[ExistingNode] = (),
+        daemon_overhead: Optional[Sequence[int]] = None,
+        n_slots: Optional[int] = None,
+    ) -> SolveResult:
         enc = encode_problem(
             self.catalog, self.provisioners, pods, existing,
             daemon_overhead, n_slots, grid=self.grid(),
         )
         result = run_pack(enc, self._dev_alloc_t, self._dev_tiebreak)
         return decode(enc, result, [e.name for e in existing])
+
+
+def _merge_rounds(res: SolveResult, res2: SolveResult,
+                  pseudo_index: "dict[str, int]") -> SolveResult:
+    """Fold the deferred round back: group indices offset by round-1's
+    group count; dependents placed on pseudo nodes join the claim's
+    pod_counts; real-node assignments and unschedulables merge."""
+    offset = len(res.groups)
+    groups = list(res.groups) + list(res2.groups)
+    nodes = list(res.nodes)
+    for name, per_group in res2.existing_by_group.items():
+        claim_i = pseudo_index.get(name)
+        if claim_i is None:
+            continue
+        counts = nodes[claim_i].pod_counts
+        for g_idx, count in per_group.items():
+            counts[g_idx + offset] = counts.get(g_idx + offset, 0) + count
+    nodes.extend(dataclasses.replace(
+        n, pod_counts={g + offset: c for g, c in n.pod_counts.items()})
+        for n in res2.nodes)
+    existing_by_group = {name: dict(d)
+                         for name, d in res.existing_by_group.items()}
+    for name, per_group in res2.existing_by_group.items():
+        if name in pseudo_index:
+            continue
+        tgt = existing_by_group.setdefault(name, {})
+        for g_idx, count in per_group.items():
+            tgt[g_idx + offset] = tgt.get(g_idx + offset, 0) + count
+    existing_counts = {name: sum(d.values())
+                       for name, d in existing_by_group.items() if d}
+    unschedulable = dict(res.unschedulable)
+    for g_idx, count in res2.unschedulable.items():
+        unschedulable[g_idx + offset] = count
+    return SolveResult(nodes, existing_counts, unschedulable, groups,
+                       existing_by_group)
 
 
 class NativeSolver(TPUSolver):
@@ -120,7 +208,7 @@ class NativeSolver(TPUSolver):
             self._grid = build_grid(self.catalog)  # host-only: no device_put
         return self._grid
 
-    def solve(
+    def _solve_once(
         self,
         pods: "list[PodSpec]",
         existing: Sequence[ExistingNode] = (),

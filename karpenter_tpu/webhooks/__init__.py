@@ -31,9 +31,12 @@ class Webhooks:
     """Defaulting-then-validation pipeline per registered kind
     (webhooks.go Resources map analogue)."""
 
-    def __init__(self):
+    def __init__(self, cluster_name: str = ""):
         # kind -> (defaulter, validator); mirrors the reference's
-        # {AWSNodeTemplate, Provisioner} registration
+        # {AWSNodeTemplate, Provisioner} registration. cluster_name feeds
+        # the per-cluster restricted ownership tag check (tags.go:29+,
+        # kubernetes.io/cluster/<name> is karpenter-owned).
+        self.cluster_name = cluster_name
         self.resources: "dict[str, tuple[Optional[Callable], Optional[Callable]]]" = {
             "provisioners": (self._default_provisioner, self._validate_provisioner),
             "nodetemplates": (self._default_nodetemplate, self._validate_nodetemplate),
@@ -69,6 +72,5 @@ class Webhooks:
     def _default_nodetemplate(t: NodeTemplate) -> None:
         t.set_defaults()
 
-    @staticmethod
-    def _validate_nodetemplate(t: NodeTemplate) -> None:
-        t.validate()
+    def _validate_nodetemplate(self, t: NodeTemplate) -> None:
+        t.validate(cluster_name=self.cluster_name)

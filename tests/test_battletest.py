@@ -253,7 +253,8 @@ def test_random_controller_op_churn_invariants(seed):
     op = Operator(cloud, settings, catalog, clock=clock)
     op.kube.create("nodetemplates", "default", NodeTemplate(
         name="default",
-        subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"}))
+        subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"},
+        security_group_selector={"id": "sg-default"}))
     p = Provisioner(name="default", provider_ref="default",
                     ttl_seconds_after_empty=30)
     op.kube.create("provisioners", "default", p)

@@ -37,7 +37,9 @@ def cp():
                         cluster_endpoint="https://example.test")
     provider = CloudProvider(cloud, settings, catalog(), clock=clock)
     provider.register_nodetemplate(NodeTemplate(
-        name="default", subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"}))
+        name="default",
+        subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"},
+        security_group_selector={"id": "sg-default"}))
     yield provider
     provider.stop()
 

@@ -40,7 +40,8 @@ def op():
     operator = Operator(cloud, settings, catalog(), clock=clock)
     operator.kube.create("nodetemplates", "default", NodeTemplate(
         name="default",
-        subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"}))
+        subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"},
+        security_group_selector={"id": "sg-default"}))
     operator.cloudprovider.register_nodetemplate(
         operator.kube.get("nodetemplates", "default"))
     yield operator

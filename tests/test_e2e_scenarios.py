@@ -11,6 +11,8 @@ Mirrors the reference's test/suites/ tier (SURVEY.md §4 tier 4) hermetically:
 - the threaded operator plane end-to-end (async batching windows)
 """
 
+import dataclasses
+import json
 import time
 
 import pytest
@@ -51,7 +53,8 @@ def make_operator(clock=None, **settings_kw):
     op = Operator(cloud, settings, catalog(), clock=clock)
     op.kube.create("nodetemplates", "default", NodeTemplate(
         name="default",
-        subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"}))
+        subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"},
+        security_group_selector={"id": "sg-default"}))
     op.cloudprovider.register_nodetemplate(op.kube.get("nodetemplates", "default"))
     return op
 
@@ -318,6 +321,7 @@ class TestNodeTemplateLifecycle:
         op.kube.create("nodetemplates", "tagged", NodeTemplate(
             name="tagged",
             subnet_selector={"id": "subnet-zone-1a"},
+            security_group_selector={"id": "sg-default"},
             tags={"team": "web"}))
         add_provisioner(op, name="default")
         add_provisioner(op, name="tagged-prov", provider_ref="tagged")
@@ -348,7 +352,8 @@ class TestThreadedOperator:
         op = Operator(cloud, settings, catalog(), clock=clock)
         op.kube.create("nodetemplates", "default", NodeTemplate(
             name="default",
-            subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"}))
+            subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"},
+            security_group_selector={"id": "sg-default"}))
         op.cloudprovider.register_nodetemplate(
             op.kube.get("nodetemplates", "default"))
         add_provisioner(op)
@@ -366,5 +371,226 @@ class TestThreadedOperator:
             assert 1 <= len(op.cluster.nodes) <= 2
             assert op.livez() and op.healthz()
             assert "karpenter" in op.metrics_text()
+        finally:
+            op.stop()
+
+
+class TestStorageAndDensity:
+    """Storage + pod-density E2E analogues (reference
+    test/suites/integration/storage_test.go and the enableENILimitedPodDensity
+    flag, settings.md; VERDICT r2 ask #10)."""
+
+    def test_ephemeral_storage_capacity_respected(self, op):
+        # make_instance_type fixtures carry 20Gi ephemeral: a 15Gi request
+        # monopolizes a node, so two such pods need two nodes
+        add_provisioner(op)
+        for i in range(2):
+            p = make_pod(f"disk-{i}", cpu="100m", memory="128Mi")
+            p = dataclasses.replace(p, requests=tuple(sorted(
+                dict(p.requests, **{wk.RESOURCE_EPHEMERAL: 15 * 2**30}).items())))
+            op.kube.create("pods", p.name, p)
+        op.provisioning.reconcile_once()
+        assert len(op.kube.pending_pods()) == 0
+        assert len(op.cluster.nodes) == 2
+
+    def test_oversized_ephemeral_request_unschedulable(self, op):
+        add_provisioner(op)
+        p = make_pod("bigdisk", cpu="100m", memory="128Mi")
+        p = dataclasses.replace(p, requests=tuple(sorted(
+            dict(p.requests, **{wk.RESOURCE_EPHEMERAL: 50 * 2**30}).items())))
+        op.kube.create("pods", p.name, p)
+        op.provisioning.reconcile_once()
+        assert len(op.kube.pending_pods()) == 1
+        assert op.recorder.by_reason("FailedScheduling")
+
+    def _density_operator(self, enable_density: bool):
+        clock = FakeClock()
+        cat = Catalog(types=[
+            make_instance_type("net.limited", cpu=16, memory="64Gi",
+                               pods=4, od_price=0.10),  # network-limited
+        ])
+        cloud = FakeCloud(catalog=cat, clock=clock)
+        settings = Settings(
+            cluster_name="density", cluster_endpoint="https://k",
+            batch_idle_duration=0.0, batch_max_duration=0.0,
+            enable_eni_limited_pod_density=enable_density)
+        o = Operator(cloud, settings, cat, clock=clock)
+        o.kube.create("nodetemplates", "default", NodeTemplate(
+            name="default",
+            subnet_selector={"id": "subnet-zone-1a"},
+            security_group_selector={"id": "sg-default"}))
+        o.cloudprovider.register_nodetemplate(
+            o.kube.get("nodetemplates", "default"))
+        return o
+
+    def test_network_limited_density_caps_pods_per_node(self):
+        # flag ON (default): the type's network-limited 4-pod density holds
+        o = self._density_operator(enable_density=True)
+        try:
+            add_provisioner(o)
+            for i in range(8):
+                o.kube.create("pods", f"p{i}",
+                              make_pod(f"p{i}", cpu="100m", memory="128Mi"))
+            o.provisioning.reconcile_once()
+            assert len(o.kube.pending_pods()) == 0
+            assert len(o.cluster.nodes) == 2  # 4 pods per node
+        finally:
+            o.stop()
+
+    def test_density_flag_disabled_uses_default_max_pods(self):
+        # flag OFF: every type reports the 110 default instead (settings.md
+        # enableENILimitedPodDensity=false)
+        o = self._density_operator(enable_density=False)
+        try:
+            add_provisioner(o)
+            for i in range(8):
+                o.kube.create("pods", f"p{i}",
+                              make_pod(f"p{i}", cpu="100m", memory="128Mi"))
+            o.provisioning.reconcile_once()
+            assert len(o.kube.pending_pods()) == 0
+            assert len(o.cluster.nodes) == 1  # all 8 share one node
+        finally:
+            o.stop()
+
+
+class TestDualStack:
+    """ipv6/dual-stack analogues (reference test/suites/ipv6)."""
+
+    def test_ip_family_label_restricts_types(self):
+        clock = FakeClock()
+        cat = Catalog(types=[
+            make_instance_type("v4.large", cpu=4, memory="16Gi", od_price=0.1),
+            make_instance_type("ds.large", cpu=4, memory="16Gi", od_price=0.3,
+                               extra_labels={"karpenter.k8s.tpu/ip-family":
+                                             "dual-stack"}),
+        ])
+        cloud = FakeCloud(catalog=cat, clock=clock)
+        o = Operator(cloud, Settings(cluster_name="ds",
+                                     cluster_endpoint="https://k",
+                                     batch_idle_duration=0.0,
+                                     batch_max_duration=0.0), cat, clock=clock)
+        o.kube.create("nodetemplates", "default", NodeTemplate(
+            name="default",
+            subnet_selector={"id": "subnet-zone-1a"},
+            security_group_selector={"id": "sg-default"}))
+        o.cloudprovider.register_nodetemplate(
+            o.kube.get("nodetemplates", "default"))
+        try:
+            add_provisioner(o)
+            o.kube.create("pods", "v6pod", make_pod(
+                "v6pod", cpu="1", memory="1Gi",
+                node_selector={"karpenter.k8s.tpu/ip-family": "dual-stack"}))
+            o.kube.create("pods", "anypod",
+                          make_pod("anypod", cpu="1", memory="1Gi"))
+            o.provisioning.reconcile_once()
+            assert len(o.kube.pending_pods()) == 0
+            types = sorted(n.instance_type for n in o.cluster.nodes.values())
+            # the pinned pod forced the dual-stack type; the free pod packs
+            # wherever cheapest (may share the dual-stack node)
+            assert "ds.large" in types
+            v6_nodes = [n for n in o.cluster.nodes.values()
+                        if n.instance_type == "ds.large"]
+            assert any(p.name == "v6pod" for n in v6_nodes for p in n.pods)
+        finally:
+            o.stop()
+
+    def test_ipv6_metadata_protocol_propagates_to_launch_template(self, op):
+        t = op.kube.get("nodetemplates", "default")
+        t.metadata_options = MetadataOptions(http_protocol_ipv6="enabled")
+        t.validate()
+        add_provisioner(op)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        (inst,) = op.cloudprovider.cloud.instances.values()
+        lt = op.cloudprovider.cloud.launch_templates[inst.launch_template]
+        assert lt.metadata_options["http_protocol_ipv6"] == "enabled"
+
+
+class TestChaosRound3:
+    """Two more runaway guards (chaos/suite_test.go:65-112; VERDICT r2
+    ask #10): scale-up during drift churn, and an interruption storm landing
+    mid-consolidation."""
+
+    def test_no_runaway_scaleup_during_drift_churn(self, op):
+        op.settings.feature_gates.drift_enabled = True
+        add_provisioner(op)
+        for i in range(12):
+            op.kube.create("pods", f"p{i}",
+                           make_pod(f"p{i}", cpu="1", memory="2Gi"))
+        op.provisioning.reconcile_once()
+        peak = len(op.cluster.nodes)
+        assert peak >= 1
+        # the image moves: every node is drifted at once
+        op.cloudprovider.cloud.ssm_parameters[
+            "/karpenter-tpu/images/default/amd64/latest"] = "img-new"
+        op.cloudprovider.images.cache.flush()
+        for _ in range(8):
+            op.deprovisioning.reconcile_once()
+            op.termination.reconcile_once()
+            # ReplicaSet analogue: re-create evicted pods
+            alive = {p.name for p in op.kube.pods()}
+            for i in range(12):
+                if f"p{i}" not in alive:
+                    op.kube.create("pods", f"p{i}",
+                                   make_pod(f"p{i}", cpu="1", memory="2Gi"))
+            op.provisioning.reconcile_once()
+            op.machinelifecycle.reconcile_once()
+            op.clock.step(5)
+            assert len(op.cluster.nodes) <= peak + 1, "runaway during drift"
+        assert len(op.kube.pending_pods()) == 0
+
+    def test_interruption_storm_during_consolidation(self):
+        clock = FakeClock()
+        cloud = FakeCloud(catalog=catalog(), clock=clock)
+        settings = Settings(cluster_name="storm",
+                            cluster_endpoint="https://k.example",
+                            interruption_queue_name="iq",
+                            batch_idle_duration=0.0, batch_max_duration=0.0)
+        op = Operator(cloud, settings, catalog(), clock=clock)
+        op.kube.create("nodetemplates", "default", NodeTemplate(
+            name="default",
+            subnet_selector={"id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"},
+            security_group_selector={"id": "sg-default"}))
+        op.cloudprovider.register_nodetemplate(
+            op.kube.get("nodetemplates", "default"))
+        try:
+            add_provisioner(op, consolidation_enabled=True,
+                            requirements=Requirements.of(
+                                (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot"])))
+            for i in range(12):
+                op.kube.create("pods", f"p{i}",
+                               make_pod(f"p{i}", cpu="1", memory="2Gi"))
+            op.provisioning.reconcile_once()
+            op.machinelifecycle.reconcile_once()
+            op.machinelifecycle.reconcile_once()
+            peak = len(op.cluster.nodes)
+            assert peak >= 1
+            from karpenter_tpu.models.machine import parse_provider_id
+
+            for cycle in range(6):
+                # storm: interrupt half the live spot nodes mid-churn
+                names = sorted(op.cluster.nodes)
+                for name in names[: max(1, len(names) // 2)]:
+                    node = op.cluster.nodes[name]
+                    if node.provider_id:
+                        _, iid = parse_provider_id(node.provider_id)
+                        op.queue.send(json.dumps({
+                            "source": "cloud.spot",
+                            "detail-type": "Spot Instance Interruption Warning",
+                            "detail": {"instance-id": iid}}))
+                op.interruption.reconcile_once()
+                op.deprovisioning.reconcile_once()
+                op.termination.reconcile_once()
+                alive = {p.name for p in op.kube.pods()}
+                for i in range(12):
+                    if f"p{i}" not in alive:
+                        op.kube.create("pods", f"p{i}",
+                                       make_pod(f"p{i}", cpu="1", memory="2Gi"))
+                op.provisioning.reconcile_once()
+                op.machinelifecycle.reconcile_once()
+                op.clock.step(60)
+                assert len(op.cluster.nodes) <= peak + 2, \
+                    "runaway during interruption storm"
+            assert len(op.kube.pending_pods()) == 0
         finally:
             op.stop()

@@ -335,8 +335,10 @@ def test_instancetype_provider_multi_template_memo():
     cloud = FakeCloud(clock=clock)
     cat = Catalog(types=[make_instance_type("m.2x", cpu=2, memory="8Gi")])
     p = InstanceTypeProvider(cat, UO(clock=clock), SubnetProvider(cloud, clock=clock))
-    ta = NodeTemplate(name="a", subnet_selector={"id": "subnet-zone-1a"})
-    tb = NodeTemplate(name="b", subnet_selector={"id": "subnet-zone-1b"})
+    ta = NodeTemplate(name="a", subnet_selector={"id": "subnet-zone-1a"},
+                      security_group_selector={"id": "sg-default"})
+    tb = NodeTemplate(name="b", subnet_selector={"id": "subnet-zone-1b"},
+                      security_group_selector={"id": "sg-default"})
     ca1, cb1 = p.list(ta), p.list(tb)
     ca2, cb2 = p.list(ta), p.list(tb)
     assert ca1 is ca2 and cb1 is cb2  # both variants stay memoized

@@ -118,7 +118,8 @@ class TestOperatorHA:
     def test_kill_the_leader_standby_takes_over(self):
         kube = KubeStore()
         kube.create("nodetemplates", "default", NodeTemplate(
-            name="default", subnet_selector={"id": "subnet-zone-1a"}))
+            name="default", subnet_selector={"id": "subnet-zone-1a"},
+            security_group_selector={"id": "sg-default"}))
         a = self._mk_op(kube, "op-a")
         b = self._mk_op(kube, "op-b")
         for op in (a, b):

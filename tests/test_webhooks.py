@@ -57,7 +57,8 @@ class TestWebhooks:
 
     def test_nodetemplate_validated(self):
         op = make_operator()
-        t = NodeTemplate(name="tmpl", subnet_selector={"cluster": "t"})
+        t = NodeTemplate(name="tmpl", subnet_selector={"cluster": "t"},
+                         security_group_selector={"cluster": "t"})
         op.kube.create("nodetemplates", "tmpl", t)
         assert op.kube.get("nodetemplates", "tmpl") is t
 
@@ -84,3 +85,110 @@ class TestWebhooks:
         p = Provisioner(name="x")
         w.admit("provisioners", p)
         assert p.requirements.get(wk.LABEL_OS) is not None
+
+
+class TestNodeTemplateValidationDepth:
+    """Round-3 v1alpha1 depth: the same invalid manifests the reference's
+    validation rejects (provider_validation.go:46+, tags.go:29+,
+    awsnodetemplate_validation.go)."""
+
+    def _base(self, **kw):
+        from karpenter_tpu.apis.nodetemplate import NodeTemplate
+
+        kw.setdefault("subnet_selector", {"id": "subnet-zone-1a"})
+        kw.setdefault("security_group_selector", {"id": "sg-default"})
+        return NodeTemplate(name="t", **kw)
+
+    def test_empty_selector_key_or_value_rejected(self):
+        from karpenter_tpu.apis.provisioner import ValidationError
+
+        with pytest.raises(ValidationError):
+            self._base(subnet_selector={"": "x"}).validate()
+        with pytest.raises(ValidationError):
+            self._base(security_group_selector={"tag": ""}).validate()
+
+    def test_malformed_resource_ids_rejected(self):
+        from karpenter_tpu.apis.provisioner import ValidationError
+
+        with pytest.raises(ValidationError):
+            self._base(subnet_selector={"id": "not-a-subnet"}).validate()
+        with pytest.raises(ValidationError):
+            self._base(security_group_selector={"id": "subnet-1"}).validate()
+        with pytest.raises(ValidationError):
+            self._base(image_selector={"id": "vol-123"}).validate()
+        # well-formed comma lists pass
+        self._base(subnet_selector={
+            "id": "subnet-zone-1a, subnet-zone-1b"}).validate()
+
+    def test_security_group_selector_required_without_static_lt(self):
+        from karpenter_tpu.apis.nodetemplate import NodeTemplate
+        from karpenter_tpu.apis.provisioner import ValidationError
+
+        with pytest.raises(ValidationError):
+            NodeTemplate(name="t",
+                         subnet_selector={"id": "subnet-zone-1a"}).validate()
+        # a static LT carries its own SGs
+        NodeTemplate(name="t", subnet_selector={"id": "subnet-zone-1a"},
+                     launch_template_name="lt-1").validate()
+
+    def test_static_lt_excludes_identity_and_network_fields(self):
+        from karpenter_tpu.apis.nodetemplate import NodeTemplate
+        from karpenter_tpu.apis.provisioner import ValidationError
+
+        for kw in ({"security_group_selector": {"id": "sg-1"}},
+                   {"instance_profile": "profile-x"}):
+            with pytest.raises(ValidationError):
+                NodeTemplate(name="t", launch_template_name="lt-1",
+                             subnet_selector={"id": "subnet-zone-1a"},
+                             **kw).validate()
+
+    def test_per_cluster_ownership_tag_rejected(self):
+        from karpenter_tpu.apis.provisioner import ValidationError
+
+        t = self._base(tags={"kubernetes.io/cluster/prod-1": "owned"})
+        with pytest.raises(ValidationError):
+            t.validate(cluster_name="prod-1")
+
+    def test_empty_tag_key_rejected(self):
+        from karpenter_tpu.apis.provisioner import ValidationError
+
+        with pytest.raises(ValidationError):
+            self._base(tags={"": "v"}).validate()
+
+    def test_metadata_options_bounds(self):
+        from karpenter_tpu.apis.nodetemplate import MetadataOptions
+        from karpenter_tpu.apis.provisioner import ValidationError
+
+        with pytest.raises(ValidationError):
+            self._base(metadata_options=MetadataOptions(
+                http_put_response_hop_limit=0)).validate()
+        with pytest.raises(ValidationError):
+            self._base(metadata_options=MetadataOptions(
+                http_put_response_hop_limit=65)).validate()
+        with pytest.raises(ValidationError):
+            self._base(metadata_options=MetadataOptions(
+                http_protocol_ipv6="on")).validate()
+        self._base(metadata_options=MetadataOptions(
+            http_protocol_ipv6="enabled")).validate()  # dual-stack ok
+
+    def test_block_device_bounds_and_iops(self):
+        from karpenter_tpu.apis.nodetemplate import BlockDeviceMapping
+        from karpenter_tpu.apis.provisioner import ValidationError
+
+        with pytest.raises(ValidationError):
+            self._base(block_device_mappings=(
+                BlockDeviceMapping(volume_size_gib=65 * 1024),)).validate()
+        with pytest.raises(ValidationError):
+            self._base(block_device_mappings=(
+                BlockDeviceMapping(device_name=""),)).validate()
+        with pytest.raises(ValidationError):
+            self._base(block_device_mappings=(
+                BlockDeviceMapping(volume_type="balanced", iops=3000),)).validate()
+
+    def test_webhook_pipeline_carries_cluster_name(self):
+        from karpenter_tpu.webhooks import AdmissionError, Webhooks
+
+        hooks = Webhooks(cluster_name="prod-1")
+        bad = self._base(tags={"kubernetes.io/cluster/prod-1": "owned"})
+        with pytest.raises(AdmissionError):
+            hooks.admit("nodetemplates", bad, "CREATE")
