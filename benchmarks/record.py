@@ -27,7 +27,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RESULTS_DIR = os.path.join(REPO, "benchmarks", "results")
 
 
-def _run_json_lines(argv: "list[str]") -> "list[dict]":
+def _run_json_lines(argv: "list[str]") -> "tuple[list[dict], int]":
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("PALLAS_AXON_POOL_IPS", None)  # never grab the real chip here
     proc = subprocess.run([sys.executable, "-m", *argv], cwd=REPO, env=env,
@@ -41,8 +41,9 @@ def _run_json_lines(argv: "list[str]") -> "list[dict]":
             except ValueError:
                 pass
     if proc.returncode != 0:
-        print(proc.stderr[-500:], file=sys.stderr)
-    return out
+        print(f"{argv[0]} FAILED rc={proc.returncode}:\n"
+              f"{proc.stderr[-800:]}", file=sys.stderr)
+    return out, proc.returncode
 
 
 def _key(rec: dict) -> str:
@@ -79,10 +80,16 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     prev = previous_record()
-    results = _run_json_lines(["benchmarks.interruption_bench"])
+    results, rc1 = _run_json_lines(["benchmarks.interruption_bench"])
     configs = "0,1,2,3,5" if args.skip_stress else "0,1,2,3,4,5"
-    results += _run_json_lines(["benchmarks.baseline_configs",
-                                "--configs", configs])
+    more, rc2 = _run_json_lines(["benchmarks.baseline_configs",
+                                 "--configs", configs])
+    results += more
+    if rc1 != 0 or rc2 != 0:
+        # a broken harness must FAIL the run (and never become the baseline
+        # the next run diffs against)
+        print("benchmark harness failed; no record written", file=sys.stderr)
+        return 1
 
     ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
     record = {"recorded_at": ts, "backend": "cpu", "entries": results}

@@ -159,11 +159,17 @@ class NodeTemplate:
             if key == "":
                 raise ValidationError(
                     f"empty tag keys are not supported (value {value!r})")
-            if any(key.startswith(p) for p in RESTRICTED_TAG_PREFIXES):
+            if key.startswith("karpenter.sh/"):
                 raise ValidationError(f"restricted tag key: {key}")
-            if cluster_name and key == f"kubernetes.io/cluster/{cluster_name}":
-                raise ValidationError(
-                    f"tag {key} is reserved for cluster ownership")
+            if key.startswith("kubernetes.io/cluster"):
+                # With the cluster context, only THIS cluster's ownership tag
+                # is karpenter-owned (instance.go:224 stamps it); tagging for
+                # other clusters is legitimate shared-infra practice. Without
+                # context (direct validate() calls) stay conservative.
+                if not cluster_name \
+                        or key == f"kubernetes.io/cluster/{cluster_name}":
+                    raise ValidationError(
+                        f"tag {key} is reserved for cluster ownership")
         self.metadata_options.validate()
         for bdm in self.block_device_mappings:
             bdm.validate()

@@ -271,7 +271,14 @@ def encode_problem(
     daemon_overhead: Optional[Sequence[int]] = None,
     n_slots: Optional[int] = None,
     grid: Optional[OptionGrid] = None,
+    group_cache: "Optional[dict]" = None,
 ) -> EncodedProblem:
+    """`group_cache` (owned by a solver instance whose provisioner set is
+    fixed) memoizes encode_group results across solves keyed by (group key,
+    grid seqnum, daemon overhead): steady-state controllers re-solve the
+    same deployments against an unchanged grid, and the mask folding is the
+    dominant per-group cost (the reference memoizes the analogous
+    instance-type construction, instancetypes.go:104-120)."""
     if grid is None or grid.seqnum != catalog.seqnum:
         grid = build_grid(catalog)
     provs = sorted(provisioners, key=lambda p: (-p.weight, p.name))
@@ -305,10 +312,27 @@ def encode_problem(
         group_origin[gi] = first_by_origin.setdefault(g.spec.origin_key(), gi)
 
     cols = grid.get_cols()
+    if group_cache is not None and group_cache.get("seqnum") != grid.seqnum:
+        group_cache.clear()
+        group_cache["seqnum"] = grid.seqnum
+        group_cache["entries"] = {}
+    ovh_key = tuple(overhead)
     for gi, g in enumerate(groups):
-        vec, cap, feas, newprov = encode_group(
-            g, provs, grid, cols, overhead,
-            prov_overhead=prov_overhead, prov_pods_cap=prov_pods_cap)
+        entry = None
+        ck = None
+        if group_cache is not None:
+            ck = (g.spec.group_key(), ovh_key)
+            entry = group_cache["entries"].get(ck)
+        if entry is None:
+            entry = encode_group(
+                g, provs, grid, cols, overhead,
+                prov_overhead=prov_overhead, prov_pods_cap=prov_pods_cap)
+            if ck is not None:
+                entries = group_cache["entries"]
+                if len(entries) > 2048:  # bound churny-workload growth
+                    entries.clear()
+                entries[ck] = entry
+        vec, cap, feas, newprov = entry
         group_vec[gi] = vec
         group_count[gi] = g.count
         group_cap[gi] = cap

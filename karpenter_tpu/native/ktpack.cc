@@ -99,6 +99,9 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
   std::vector<int32_t> q_nt(static_cast<size_t>(N));   // per-node best quotient
   std::vector<int32_t> qt(static_cast<size_t>(T));     // per-type quotient scratch
   std::vector<int32_t> m_n(static_cast<size_t>(N));
+  std::vector<int32_t> avail(static_cast<size_t>(R));  // hoisted: the inner
+  // loops below run G x N x T times; a per-iteration vector would cost
+  // millions of allocations per solve
   // in-run pods placed per (origin row, node): the shared cap budget consumed
   // so far by all subgroups of an origin (oracle group_counts under okey)
   std::vector<int32_t> ex_placed(static_cast<size_t>(G) * Ne, 0);
@@ -120,7 +123,6 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
     // ---- 1) existing nodes, first-fit in index order ------------------------
     for (int e = 0; e < Ne && rem > 0; ++e) {
       if (!ex_feas[static_cast<size_t>(g) * Ne + e]) continue;
-      std::vector<int32_t> avail(R);
       for (int r = 0; r < R; ++r)
         avail[r] = ex_alloc[static_cast<size_t>(e) * R + r] -
                    ex_used[static_cast<size_t>(e) * R + r];
@@ -156,7 +158,6 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
           if (om[t * S + s] && feas[t * S + s]) { any = true; break; }
         }
         if (!any) { qt[t] = -1; continue; }
-        std::vector<int32_t> avail(R);
         for (int r = 0; r < R; ++r)
           avail[r] = alloc_t[static_cast<size_t>(t) * R + r] -
                      used[static_cast<size_t>(n) * R + r];
@@ -214,7 +215,6 @@ int kt_pack(const int32_t* alloc_t,      // [T,R]
         bool any = false;
         for (int s = 0; s < S; ++s)
           if (feas[t * S + s]) { any = true; break; }
-        std::vector<int32_t> avail(R);
         for (int r = 0; r < R; ++r)
           avail[r] = alloc_t[static_cast<size_t>(t) * R + r] - ovh_p[r];
         qt[t] = quotient(avail.data(), vec, R);  // q0 (also reused below)

@@ -412,6 +412,39 @@ def resolve_pod_affinity(groups: "list[PodGroup]", zones: Sequence[str],
     return out
 
 
+def water_fill_shares(resident: "dict[str, int]", allowed: "list[str]",
+                      count: int) -> "dict[str, int]":
+    """Closed-form water filling: the exact distribution the sequential
+    "each pod goes to the (lowest-population, lexicographically-first)
+    domain" loop produces, in O(Z log Z) instead of O(pods x Z).
+
+    Level L fills every domain below it; the remainder lands one pod each on
+    the name-ordered prefix of the domains sitting at or below L (matching
+    the sequential tie-break). Differential-tested against the scalar loop
+    in tests/test_oracle_scheduler.py."""
+    levels = sorted(resident[z] for z in allowed)
+    n_z = len(allowed)
+    # find the highest fully-reachable level L: cost(L) = sum(max(0, L-c_z))
+    lo = levels[0]
+    hi = levels[-1] + (count // n_z) + 1
+    while lo < hi:  # binary search the largest L with cost(L) <= count
+        mid = (lo + hi + 1) // 2
+        cost = sum(mid - c for c in levels if c < mid)
+        if cost <= count:
+            lo = mid
+        else:
+            hi = mid - 1
+    L = lo
+    shares = {z: max(0, L - resident[z]) for z in allowed}
+    leftover = count - sum(shares.values())
+    if leftover:
+        # one pod each to the name-ordered prefix of domains at level <= L
+        at_level = sorted(z for z in allowed if resident[z] <= L)
+        for z in at_level[:leftover]:
+            shares[z] += 1
+    return shares
+
+
 def split_zone_spread(groups: "list[PodGroup]", zones: Sequence[str],
                       existing: "Sequence[ExistingNode]" = ()) -> "list[PodGroup]":
     """Pre-pass: groups with a zone topology-spread constraint are split into
@@ -462,12 +495,7 @@ def split_zone_spread(groups: "list[PodGroup]", zones: Sequence[str],
             allowed = open_zones
             surplus = g.count - sum(shares)
         else:
-            counts = dict(resident)
-            share_of = {z: 0 for z in allowed}
-            for _ in range(g.count):
-                z = min(allowed, key=lambda zz: (counts[zz], zz))
-                counts[z] += 1
-                share_of[z] += 1
+            share_of = water_fill_shares(resident, allowed, g.count)
             shares = [share_of[z] for z in allowed]
             surplus = 0
         pos = 0
@@ -511,21 +539,28 @@ def split_deferred_pods(pods: "list[PodSpec]") -> "tuple[list[PodSpec], list[Pod
     mutual/cyclic dependencies keep the first group in round 1 and defer the
     rest; chains deeper than one round stay best-effort.
     """
+    # fast path: no affinity terms anywhere -> no second round. An attribute
+    # scan is ~10x cheaper than the full dedup grouping at 10k pods, and the
+    # headline workloads carry no terms (profiled round 3).
+    if not any(p.pod_affinity or p.pod_anti_affinity for p in pods):
+        return list(pods), []
     groups = group_pods([p for p in pods if not p.is_daemon()])
-    primary_specs: "list[PodSpec]" = []
+    # a group defers when any of its terms matches ANOTHER co-pending group
+    # regardless of input order (forward references included); cycle
+    # breaking is first-wins: a candidate whose every target already
+    # deferred stays primary so the deferred targets can see ITS placements
+    def targets_of(spec: PodSpec) -> "list[PodSpec]":
+        out = []
+        for term in tuple(spec.pod_affinity) + tuple(spec.pod_anti_affinity):
+            out.extend(og.spec for og in groups
+                       if og.spec is not spec and term.matches(og.spec.labels))
+        return out
+
     deferred_keys: "set" = set()
     for g in groups:
-        spec = g.spec
-        defer = False
-        for term in tuple(spec.pod_affinity) + tuple(spec.pod_anti_affinity):
-            if any(og is not spec and term.matches(og.labels)
-                   for og in primary_specs):
-                defer = True
-                break
-        if defer:
-            deferred_keys.add(spec.group_key())
-        else:
-            primary_specs.append(spec)
+        tgts = targets_of(g.spec)
+        if tgts and any(t.group_key() not in deferred_keys for t in tgts):
+            deferred_keys.add(g.spec.group_key())
     if not deferred_keys:
         return list(pods), []
     primary: "list[PodSpec]" = []

@@ -85,6 +85,9 @@ class TPUSolver:
         self._grid: Optional[OptionGrid] = None
         self._dev_alloc_t = None
         self._dev_tiebreak = None
+        # encode_group memo across solves (this instance's provisioner set is
+        # fixed; the grid seqnum keys invalidation — see encode_problem)
+        self._group_cache: dict = {}
 
     def grid(self) -> OptionGrid:
         if self._grid is None or self._grid.seqnum != self.catalog.seqnum:
@@ -156,6 +159,7 @@ class TPUSolver:
         enc = encode_problem(
             self.catalog, self.provisioners, pods, existing,
             daemon_overhead, n_slots, grid=self.grid(),
+            group_cache=self._group_cache,
         )
         result = run_pack(enc, self._dev_alloc_t, self._dev_tiebreak)
         return decode(enc, result, [e.name for e in existing])
@@ -220,6 +224,7 @@ class NativeSolver(TPUSolver):
         enc = encode_problem(
             self.catalog, self.provisioners, pods, existing,
             daemon_overhead, n_slots, grid=self.grid(),
+            group_cache=self._group_cache,
         )
         inputs = PackInputs(
             alloc_t=enc.alloc_t, tiebreak=enc.tiebreak,

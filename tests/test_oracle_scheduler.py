@@ -249,3 +249,28 @@ def test_label_distinct_deployments_spread_independently():
             per[app][n.decided.zone] += 1
     for app, zones in per.items():
         assert sorted(zones.values()) == [1, 1, 1], (app, zones)
+
+
+def test_water_fill_closed_form_matches_sequential_loop():
+    # the closed form must reproduce the sequential "lowest population,
+    # name tie-break" loop bit-for-bit (it replaced an O(pods x zones) loop
+    # on the encode hot path)
+    import random
+
+    from karpenter_tpu.oracle.scheduler import water_fill_shares
+
+    rng = random.Random(7)
+    for trial in range(300):
+        n_zones = rng.randint(1, 6)
+        allowed = sorted(f"z{i}" for i in range(n_zones))
+        resident = {z: rng.randint(0, 12) for z in allowed}
+        count = rng.randint(0, 40)
+        # sequential reference
+        counts = dict(resident)
+        seq = {z: 0 for z in allowed}
+        for _ in range(count):
+            z = min(allowed, key=lambda zz: (counts[zz], zz))
+            counts[z] += 1
+            seq[z] += 1
+        assert water_fill_shares(resident, allowed, count) == seq, (
+            trial, resident, count)

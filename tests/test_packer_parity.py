@@ -586,3 +586,26 @@ def test_parity_copending_zone_affinity_coalesces_zone():
     res = assert_parity(catalog5(), [prov()], a + b)
     assert res.unschedulable_count() == 0
     assert {n.option.zone for n in res.nodes} == {"zone-1b"}
+
+
+def test_parity_copending_anti_affinity_forward_reference():
+    # review r3: deferral must be input-order independent — the group WITH
+    # the terms arrives BEFORE its target in the pod list and must still
+    # defer (forward reference)
+    from karpenter_tpu.models.pod import PodAffinityTerm
+
+    quiet = [make_pod(f"quiet-{i}", cpu="100m", memory="128Mi",
+                      labels=(("app", "quiet"),),
+                      pod_anti_affinity=(PodAffinityTerm(
+                          match_labels=(("app", "noisy"),),
+                          topology_key=wk.LABEL_HOSTNAME),))
+             for i in range(2)]
+    noisy = [make_pod(f"noisy-{i}", cpu="100m", memory="128Mi",
+                      labels=(("app", "noisy"),)) for i in range(2)]
+    # terms-first ordering (the previously-broken direction)
+    res = assert_parity(catalog5(), [prov()], quiet + noisy)
+    assert res.unschedulable_count() == 0
+    for n in res.nodes:
+        kinds = {res.groups[g].spec.labels for g in n.pod_counts}
+        assert not ((("app", "noisy"),) in kinds
+                    and (("app", "quiet"),) in kinds), n.pod_counts

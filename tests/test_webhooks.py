@@ -148,6 +148,13 @@ class TestNodeTemplateValidationDepth:
         t = self._base(tags={"kubernetes.io/cluster/prod-1": "owned"})
         with pytest.raises(ValidationError):
             t.validate(cluster_name="prod-1")
+        # ANOTHER cluster's tag is legitimate shared-infra tagging when the
+        # cluster context is known...
+        self._base(tags={"kubernetes.io/cluster/other": "shared"}).validate(
+            cluster_name="prod-1")
+        # ...but without context every cluster-ownership tag is conservative
+        with pytest.raises(ValidationError):
+            self._base(tags={"kubernetes.io/cluster/other": "shared"}).validate()
 
     def test_empty_tag_key_rejected(self):
         from karpenter_tpu.apis.provisioner import ValidationError
