@@ -1,17 +1,26 @@
-"""Pricing provider.
+"""Pricing provider + pricing-source client boundary.
 
 Parity target: /root/reference/pkg/cloudprovider/pricing.go — on-demand +
 per-zone spot prices (:175-187 OnDemandPrice/SpotPrice), 12h background
 refresh (:83, 139-147), embedded static fallback prices served until the
 first successful update (:100-116), isolated-VPC mode disabling updates
 (:119-121), liveness check that the refresh loop isn't wedged (:437-443).
+
+The client boundary is `PricingSource` (get_prices): `fake.cloud.FakeCloud`
+is the hermetic impl; `RestPricingSource` is the real-client stub — paged
+JSON endpoints for on-demand and per-zone spot, with the reference's
+INDEPENDENT update semantics (pricing.go:202-243: an OD fetch that succeeds
+applies even when the spot fetch fails, and vice versa).
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
-from typing import Optional
+import urllib.error
+import urllib.request
+from typing import Optional, Protocol, runtime_checkable
 
 from ..cache import PRICING_REFRESH_PERIOD
 from ..utils.clock import Clock
@@ -19,8 +28,73 @@ from ..utils.clock import Clock
 log = logging.getLogger("karpenter.pricing")
 
 
+@runtime_checkable
+class PricingSource(Protocol):
+    """What the provider needs from a price feed: the full
+    (instance type, capacity type, zone) -> $/h map for one refresh."""
+
+    def get_prices(self) -> "dict[tuple[str, str, str], float]": ...
+
+
+class RestPricingSource:
+    """PricingSource over paged JSON endpoints (the Pricing-API +
+    DescribeSpotPriceHistory analogue, pricing.go:283-316, 379-435).
+
+    GET {base}/on-demand?page=N -> {"prices": [{"instanceType", "price"}...],
+                                    "next": true|false}
+    GET {base}/spot?page=N      -> {"prices": [{"instanceType", "zone",
+                                    "price"}...], "next": true|false}
+
+    On-demand prices fan out across `zones`; the two feeds update
+    independently — a partial outage degrades, never blanks, the map.
+    """
+
+    def __init__(self, base_url: str, zones: "list[str]",
+                 timeout: float = 10.0, max_pages: int = 100):
+        self.base_url = base_url.rstrip("/")
+        self.zones = list(zones)
+        self.timeout = timeout
+        self.max_pages = max_pages
+
+    def _fetch_pages(self, path: str) -> "list[dict]":
+        out: "list[dict]" = []
+        for page in range(self.max_pages):
+            with urllib.request.urlopen(
+                    f"{self.base_url}/{path}?page={page}",
+                    timeout=self.timeout) as resp:
+                doc = json.loads(resp.read())
+            out.extend(doc.get("prices", []))
+            if not doc.get("next"):
+                break
+        return out
+
+    def get_prices(self) -> "dict[tuple[str, str, str], float]":
+        prices: "dict[tuple[str, str, str], float]" = {}
+        errors = []
+        try:
+            for row in self._fetch_pages("on-demand"):
+                for z in self.zones:
+                    prices[(row["instanceType"], "on-demand", z)] = \
+                        float(row["price"])
+        except (urllib.error.URLError, OSError, ValueError, KeyError) as e:
+            errors.append(f"on-demand: {e}")
+        try:
+            for row in self._fetch_pages("spot"):
+                prices[(row["instanceType"], "spot", row["zone"])] = \
+                    float(row["price"])
+        except (urllib.error.URLError, OSError, ValueError, KeyError) as e:
+            errors.append(f"spot: {e}")
+        if errors:
+            # independent updates (pricing.go:202-243): whatever side
+            # succeeded still applies; both failing yields {} and the
+            # provider keeps its previous/static map
+            log.warning("pricing fetch partial failure: %s", "; ".join(errors))
+        return prices
+
+
 class PricingProvider:
-    def __init__(self, cloud, clock: Optional[Clock] = None, isolated: bool = False,
+    def __init__(self, cloud: PricingSource, clock: Optional[Clock] = None,
+                 isolated: bool = False,
                  static_prices: "Optional[dict[tuple[str, str, str], float]]" = None):
         self.cloud = cloud
         self.clock = clock or Clock()

@@ -594,3 +594,94 @@ class TestChaosRound3:
             assert len(op.kube.pending_pods()) == 0
         finally:
             op.stop()
+
+
+class TestBackwardsCompat:
+    """Reference-manifest backwards compatibility (the analogue of
+    test/suites/integration/backwards_compat): manifests written for
+    upstream AWS Karpenter — AWSNodeTemplate kind, karpenter.k8s.aws/*
+    label keys, ${CLUSTER_NAME} discovery tags — drive this controller
+    unchanged through the full provision path."""
+
+    AWS_BUNDLE = """
+apiVersion: karpenter.sh/v1alpha5
+kind: Provisioner
+metadata:
+  name: legacy
+spec:
+  requirements:
+    - key: karpenter.sh/capacity-type
+      operator: In
+      values: [spot, on-demand]
+    - key: karpenter.k8s.aws/instance-generation
+      operator: Exists
+  providerRef:
+    name: legacy
+---
+apiVersion: karpenter.k8s.aws/v1alpha1
+kind: AWSNodeTemplate
+metadata:
+  name: legacy
+spec:
+  amiFamily: AL2
+  subnetSelector:
+    karpenter.sh/discovery: "${CLUSTER_NAME}"
+  securityGroupSelector:
+    karpenter.sh/discovery: "${CLUSTER_NAME}"
+---
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: legacy-inflate
+spec:
+  replicas: 5
+  selector:
+    matchLabels: {app: legacy}
+  template:
+    metadata:
+      labels: {app: legacy}
+    spec:
+      containers:
+        - name: c
+          resources:
+            requests: {cpu: "1", memory: 1Gi}
+"""
+
+    def test_aws_flavored_bundle_schedules(self):
+        from karpenter_tpu.apis.yaml_compat import load_manifests
+
+        clock = FakeClock()
+        cat = Catalog(types=[
+            make_instance_type(
+                "m.large", cpu=4, memory="16Gi", od_price=0.2,
+                spot_price=0.07,
+                extra_labels={"karpenter.k8s.tpu/instance-generation": "5"}),
+        ])
+        cloud = FakeCloud(catalog=cat, clock=clock)
+        for s in cloud.subnets:
+            s.tags.setdefault("karpenter.sh/discovery", "legacy-cluster")
+        for g in cloud.security_groups:
+            g.tags.setdefault("karpenter.sh/discovery", "legacy-cluster")
+        op = Operator(cloud, Settings(cluster_name="legacy-cluster",
+                                      cluster_endpoint="https://k",
+                                      batch_idle_duration=0.0,
+                                      batch_max_duration=0.0), cat, clock=clock)
+        try:
+            loaded = load_manifests(self.AWS_BUNDLE,
+                                    env={"CLUSTER_NAME": "legacy-cluster"})
+            (tmpl,) = loaded.templates
+            (prov,) = loaded.provisioners
+            assert len(loaded.pods) == 5
+            # the aws label key mapped onto this provider's namespace
+            assert prov.requirements.get(
+                "karpenter.k8s.tpu/instance-generation") is not None
+            op.kube.create("nodetemplates", tmpl.name, tmpl)
+            op.kube.create("provisioners", prov.name, prov)
+            for pod in loaded.pods:
+                op.kube.create("pods", pod.name, pod)
+            op.provisioning.reconcile_once()
+            assert len(op.kube.pending_pods()) == 0
+            assert all(n.provisioner_name == "legacy"
+                       for n in op.cluster.nodes.values())
+        finally:
+            op.stop()

@@ -343,3 +343,100 @@ def test_instancetype_provider_multi_template_memo():
     ca2, cb2 = p.list(ta), p.list(tb)
     assert ca1 is ca2 and cb1 is cb2  # both variants stay memoized
     assert {o.zone for t in ca1.types for o in t.offerings} == {"zone-1a"}
+
+
+class TestRestPricingSource:
+    """The real pricing client stub: paged feeds, independent OD/spot
+    updates (pricing.go:202-243, 283-316, 379-435)."""
+
+    def _serve(self, handler):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        import json as _json
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                code, doc = handler(self.path)
+                body = _json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, srv.server_address[1]
+
+    def test_paged_fetch_and_zone_fanout(self):
+        from karpenter_tpu.providers.pricing import (PricingSource,
+                                                     RestPricingSource)
+
+        def handler(path):
+            if path == "/on-demand?page=0":
+                return 200, {"prices": [
+                    {"instanceType": "m.large", "price": 0.2}], "next": True}
+            if path == "/on-demand?page=1":
+                return 200, {"prices": [
+                    {"instanceType": "m.xl", "price": 0.4}], "next": False}
+            if path.startswith("/spot"):
+                return 200, {"prices": [
+                    {"instanceType": "m.large", "zone": "z1", "price": 0.06}],
+                    "next": False}
+            return 404, {}
+
+        srv, port = self._serve(handler)
+        try:
+            src = RestPricingSource(f"http://127.0.0.1:{port}",
+                                    zones=["z1", "z2"])
+            assert isinstance(src, PricingSource)
+            prices = src.get_prices()
+            assert prices[("m.large", "on-demand", "z1")] == 0.2
+            assert prices[("m.large", "on-demand", "z2")] == 0.2
+            assert prices[("m.xl", "on-demand", "z2")] == 0.4
+            assert prices[("m.large", "spot", "z1")] == 0.06
+            assert ("m.large", "spot", "z2") not in prices
+        finally:
+            srv.shutdown()
+
+    def test_independent_updates_on_partial_outage(self):
+        from karpenter_tpu.providers.pricing import (PricingProvider,
+                                                     RestPricingSource)
+
+        def handler(path):
+            if path.startswith("/on-demand"):
+                return 200, {"prices": [
+                    {"instanceType": "m.large", "price": 0.25}], "next": False}
+            return 500, {"error": "spot feed down"}
+
+        srv, port = self._serve(handler)
+        try:
+            src = RestPricingSource(f"http://127.0.0.1:{port}", zones=["z1"])
+            prov = PricingProvider(src, static_prices={
+                ("m.large", "on-demand", "z1"): 0.2,
+                ("m.large", "spot", "z1"): 0.05,
+            })
+            assert prov.update()  # OD side landed despite the spot outage
+            assert prov.on_demand_price("m.large", "z1") == 0.25
+            assert prov.spot_price("m.large", "z1") == 0.05  # static kept
+        finally:
+            srv.shutdown()
+
+    def test_total_outage_keeps_previous_map(self):
+        from karpenter_tpu.providers.pricing import (PricingProvider,
+                                                     RestPricingSource)
+
+        def handler(path):
+            return 500, {}
+
+        srv, port = self._serve(handler)
+        try:
+            src = RestPricingSource(f"http://127.0.0.1:{port}", zones=["z1"])
+            prov = PricingProvider(src, static_prices={
+                ("m.large", "on-demand", "z1"): 0.2})
+            assert not prov.update()  # nothing fresh
+            assert prov.on_demand_price("m.large", "z1") == 0.2
+        finally:
+            srv.shutdown()
