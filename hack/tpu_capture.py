@@ -91,8 +91,50 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
             crossover = row["n_pods"]
             break
 
+    # Consolidation sweep on-chip: 500 candidate lanes in ONE vmapped
+    # dispatch — the shape where a single device round trip amortizes over
+    # the whole search (vs per-candidate host scans). Comparable with the
+    # recorded CPU number in benchmarks/results/bench_*.json (config 3).
+    consolidation = None
+    try:
+        from karpenter_tpu.apis import wellknown as wkk
+        from karpenter_tpu.models.cluster import ClusterState, StateNode
+        from karpenter_tpu.models.pod import make_pod
+        from karpenter_tpu.ops.consolidate import run_consolidation
+
+        cluster = ClusterState()
+        big = catalog.by_name["m5.2xlarge"]
+        for i in range(500):
+            cluster.add_node(StateNode(
+                name=f"n-{i}",
+                labels={**big.labels_dict(), wkk.LABEL_ZONE: "zone-1a",
+                        wkk.LABEL_CAPACITY_TYPE: "on-demand",
+                        wkk.LABEL_PROVISIONER: "default"},
+                allocatable=big.allocatable_vector(),
+                instance_type=big.name, zone="zone-1a",
+                capacity_type="on-demand", price=big.offerings[0].price,
+                provisioner_name="default",
+                pods=[make_pod(f"p-{i}", cpu="500m", memory="1Gi",
+                               node_name=f"n-{i}")]))
+        cprov = Provisioner(name="default", consolidation_enabled=True)
+        cprov.set_defaults()
+        run_consolidation(cluster, catalog, [cprov])  # compile + warm
+        ctimes = []
+        for _ in range(max(3, reps_sweep)):
+            t0 = time.perf_counter()
+            action = run_consolidation(cluster, catalog, [cprov])
+            ctimes.append((time.perf_counter() - t0) * 1000)
+        consolidation = {
+            "candidates": 500,
+            "p50_ms": round(statistics.median(ctimes), 3),
+            "action": action.kind if action else None,
+        }
+    except Exception as e:
+        consolidation = {"error": str(e)[:200]}
+
     return {
         "backend": backend,
+        "consolidation_500": consolidation,
         "headline": {
             "metric": "scheduling_cycle_p50_ms_10k_pods_600_types",
             "p50_ms": head_p50,

@@ -304,3 +304,105 @@ def test_random_controller_op_churn_invariants(seed):
         assert not op.kube.pending_pods()
     finally:
         op.stop()
+
+
+class TestCoordinationRaces:
+    """Race tier for the round-3 surfaces: the HTTP store under concurrent
+    writers + watchers, and leader election under tick storms."""
+
+    def test_http_store_concurrent_writers_and_watchers(self):
+        import threading
+        import time as _time
+
+        from karpenter_tpu.coordination.httpkube import HttpKubeStore
+        from karpenter_tpu.fake.apiserver import serve
+        from karpenter_tpu.fake.kube import Conflict
+        from karpenter_tpu.models.pod import make_pod
+
+        srv, port, state = serve()
+        stores = [HttpKubeStore(f"http://127.0.0.1:{port}") for _ in range(3)]
+        try:
+            for s in stores:
+                s.start()
+            seen = []
+            stores[2].watch(lambda k, a, o: seen.append((k, a)))
+            errors = []
+
+            def writer(i):
+                try:
+                    for j in range(20):
+                        stores[i].create(
+                            "pods", f"w{i}-p{j}",
+                            make_pod(f"w{i}-p{j}", cpu="1", memory="1Gi"))
+                except Exception as e:
+                    errors.append(e)
+
+            def conflict_writer():
+                # every writer races the same name: exactly one must win
+                wins = 0
+                for s in stores[:2]:
+                    try:
+                        s.create("pods", "contested",
+                                 make_pod("contested", cpu="1", memory="1Gi"))
+                        wins += 1
+                    except Conflict:
+                        pass
+                if wins != 1:
+                    errors.append(AssertionError(f"wins={wins}"))
+
+            threads = [threading.Thread(target=writer, args=(i,))
+                       for i in range(2)]
+            threads.append(threading.Thread(target=conflict_writer))
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors, errors
+            # server-side truth: every pod landed exactly once
+            assert len(state.bucket("pods")) == 41
+            # all caches converge; the watcher saw the churn
+            deadline = _time.monotonic() + 10
+            while _time.monotonic() < deadline and any(
+                    len(s.pods()) < 41 for s in stores):
+                _time.sleep(0.05)
+            assert all(len(s.pods()) == 41 for s in stores)
+            assert sum(1 for k, a in seen if k == "pods" and a == "added") >= 40
+        finally:
+            for s in stores:
+                s.stop()
+            srv.shutdown()
+
+    def test_election_tick_storm_exactly_one_leader(self):
+        import threading
+
+        from karpenter_tpu.fake.kube import KubeStore
+        from karpenter_tpu.leaderelection import LeaderElector
+        from karpenter_tpu.utils.clock import FakeClock
+
+        kube, clock = KubeStore(), FakeClock()
+        electors = [LeaderElector(kube, f"e{i}", clock=clock,
+                                  lease_duration_s=10)
+                    for i in range(6)]
+        stop = threading.Event()
+        errors = []
+
+        def storm(e):
+            try:
+                for _ in range(50):
+                    e.try_acquire_or_renew()
+                    if stop.is_set():
+                        return
+            except Exception as ex:
+                errors.append(ex)
+
+        threads = [threading.Thread(target=storm, args=(e,)) for e in electors]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        assert not errors, errors
+        leaders = [e for e in electors if e.is_leader()]
+        assert len(leaders) == 1
+        lease = kube.get("leases", electors[0].name)
+        assert lease is not None and lease.holder == leaders[0].identity
