@@ -102,9 +102,18 @@ def cmd_controller(args) -> int:
         s.tags.setdefault("karpenter.sh/discovery", args.cluster_name)
     for g in cloud.security_groups:
         g.tags.setdefault("karpenter.sh/discovery", args.cluster_name)
+    # each listener disables independently with -1; the plane exists if ANY
+    # port is enabled
+    serve_http = any(p >= 0 for p in (args.metrics_port, args.health_port,
+                                      args.webhook_port))
     op = Operator(cloud, settings, catalog, kube=kube,
                   solver_factory=solver_factory,
-                  leader_elect=bool(args.leader_elect))
+                  leader_elect=bool(args.leader_elect),
+                  serve_http=serve_http,
+                  metrics_port=args.metrics_port,
+                  health_port=args.health_port,
+                  webhook_port=args.webhook_port,
+                  webhook_tls=(args.webhook_tls_cert, args.webhook_tls_key))
     if args.apply:
         # reference-compatible manifests (Provisioner / AWSNodeTemplate /
         # Deployment / Pod / PDB YAML) drive the plane as-is
@@ -178,6 +187,17 @@ def main(argv=None) -> int:
                              "in-repo mini apiserver")
     p_ctrl.add_argument("--leader-elect", action="store_true",
                         help="lease-based leader election (HA replicas)")
+    p_ctrl.add_argument("--metrics-port", type=int, default=8080,
+                        help="prometheus metrics port (-1 disables serving)")
+    p_ctrl.add_argument("--health-port", type=int, default=8081,
+                        help="healthz/livez/readyz port (-1 disables)")
+    p_ctrl.add_argument("--webhook-port", type=int, default=8443,
+                        help="AdmissionReview validating-webhook port "
+                             "(-1 disables)")
+    p_ctrl.add_argument("--webhook-tls-cert", default="",
+                        help="TLS cert for the webhook listener (apiserver "
+                             "dials webhooks over TLS; cert-manager mounts it)")
+    p_ctrl.add_argument("--webhook-tls-key", default="")
     p_ctrl.set_defaults(fn=cmd_controller)
 
     p_ver = sub.add_parser("version")

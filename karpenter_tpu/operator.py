@@ -15,6 +15,7 @@ from typing import Optional
 from .apis.settings import Settings
 from .cloudprovider import CloudProvider
 from .controllers.deprovisioning import DeprovisioningController
+from .controllers.garbagecollection import GarbageCollectionController
 from .controllers.interruption import FakeQueue, InterruptionController
 from .controllers.machinehydration import MachineHydrationController
 from .controllers.machinelifecycle import MachineLifecycleController
@@ -40,7 +41,11 @@ class Operator:
                  clock: Optional[Clock] = None,
                  queue=None, solver_factory=None,
                  leader_elect: bool = False,
-                 identity: Optional[str] = None):
+                 identity: Optional[str] = None,
+                 serve_http: bool = False,
+                 metrics_port: int = 0, health_port: int = 0,
+                 webhook_port: int = 0,
+                 webhook_tls: "tuple[str, str]" = ("", "")):
         settings.validate()
         self.settings = settings
         self.clock = clock or Clock()
@@ -67,6 +72,17 @@ class Operator:
             self.elected = threading.Event()
         self._stop = threading.Event()
         self._threads: "list[threading.Thread]" = []
+        # HTTP serving plane (metrics/health/webhook — values.yaml:134-142
+        # port wiring); port 0 binds ephemerally (tests), opt-in via the CLI
+        self.serving = None
+        if serve_http:
+            from .serving import ServingPlane
+
+            self.serving = ServingPlane(self, metrics_port=metrics_port,
+                                        health_port=health_port,
+                                        webhook_port=webhook_port,
+                                        tls_cert=webhook_tls[0] or None,
+                                        tls_key=webhook_tls[1] or None)
 
         self.provisioning = ProvisioningController(
             self.kube, self.cloudprovider, self.cluster, settings,
@@ -101,6 +117,8 @@ class Operator:
             self.kube, self.cloudprovider, self.cluster, clock=self.clock)
         self.settingswatch = SettingsWatchController(
             self.kube, settings, clock=self.clock)
+        self.garbagecollection = GarbageCollectionController(
+            self.kube, self.cloudprovider, clock=self.clock)
         self.interruption = None
         if settings.interruption_queue_name:
             self.queue = queue or FakeQueue(settings.interruption_queue_name,
@@ -128,6 +146,9 @@ class Operator:
         """Start background controller loops (operator Start, main.go:64).
         With leader_elect, reconcile loops spin but act only while this
         replica holds the lease (manager-gated controllers analogue)."""
+        if self.serving is not None:
+            ports = self.serving.start()
+            log.info("serving plane up: %s", ports)
         if self.leader is not None:
             t0 = threading.Thread(target=self.leader.run, args=(self._stop,),
                                   name="leaderelection", daemon=True)
@@ -164,6 +185,7 @@ class Operator:
         loop("deprovisioning", self.deprovisioning.reconcile_once, 2.0)
         loop("nodetemplate", self.nodetemplate.reconcile_once, 5.0)
         loop("machinehydration", self.machinehydration.reconcile_once, 5.0)
+        loop("garbagecollection", self.garbagecollection.reconcile_once, 60.0)
         if self.interruption is not None:
             t2 = threading.Thread(target=self.interruption.run,
                                   args=(self._stop, self.elected),
@@ -178,6 +200,8 @@ class Operator:
         # resurrect it mid-shutdown). stop_event wakes the elector's wait
         # immediately, so the handoff is still prompt.
         self._stop.set()
+        if self.serving is not None:
+            self.serving.stop()
         for t in self._threads:
             t.join(timeout=2)
         self.kube.unwatch(self._sync_pdbs)  # shared-store replicas must not

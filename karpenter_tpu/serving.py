@@ -1,0 +1,179 @@
+"""Operator HTTP serving plane: metrics, health, admission webhook.
+
+Parity target: the reference serves Prometheus metrics on :8080, health
+probes on :8081 and webhooks on :8443 (charts/karpenter/values.yaml:134-142,
+probed by the deployment's liveness/readiness checks); the knative webhook
+half answers AdmissionReview requests (pkg/webhooks/webhooks.go:33-63).
+
+Three tiny stdlib servers (one per port so the chart's port wiring maps
+1:1). The webhook endpoint implements the VALIDATING half of
+admission.k8s.io/v1 AdmissionReview: objects parse through the same serde
+the coordination plane uses, then run the in-process Webhooks pipeline —
+deny returns allowed=false with the message; requests without a readable
+body FAIL CLOSED. The apiserver always dials webhooks over TLS, so the
+webhook listener wraps its socket when a cert/key pair is provided
+(cert-manager mounts them in the deployment; plaintext only suits the mini
+apiserver / local drives). Defaulting stays at the store boundary
+(HttpKubeStore/KubeStore apply it before writes); mutating webhooks would
+additionally need JSONPatch plumbing.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+log = logging.getLogger("karpenter.serving")
+
+# AdmissionReview resource plural -> store kind
+_PLURALS = {
+    "provisioners": "provisioners",
+    "nodetemplates": "nodetemplates",
+    "awsnodetemplates": "nodetemplates",  # backwards-compat manifests
+}
+
+
+class ServingPlane:
+    """Owns the three listeners; start() returns the bound ports."""
+
+    def __init__(self, operator, metrics_port: int = 8080,
+                 health_port: int = 8081, webhook_port: int = 8443,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None):
+        self.operator = operator
+        self.ports = {"metrics": metrics_port, "health": health_port,
+                      "webhook": webhook_port}
+        self.tls_cert, self.tls_key = tls_cert, tls_key
+        self._servers: "list[ThreadingHTTPServer]" = []
+
+    def start(self) -> "dict[str, int]":
+        bound = {}
+        for name, handler in (("metrics", self._metrics_handler()),
+                              ("health", self._health_handler()),
+                              ("webhook", self._webhook_handler())):
+            port = self.ports[name]
+            if port < 0:  # negative disables the listener
+                continue
+            srv = ThreadingHTTPServer(("0.0.0.0", port), handler)
+            if name == "webhook" and self.tls_cert and self.tls_key:
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                ctx.load_cert_chain(self.tls_cert, self.tls_key)
+                srv.socket = ctx.wrap_socket(srv.socket, server_side=True)
+            threading.Thread(target=srv.serve_forever, daemon=True,
+                             name=f"serve-{name}").start()
+            self._servers.append(srv)
+            bound[name] = srv.server_address[1]
+        return bound
+
+    def stop(self) -> None:
+        for srv in self._servers:
+            srv.shutdown()
+            srv.server_close()  # release the listening socket now, not at GC
+        self._servers.clear()
+
+    # -- handlers --------------------------------------------------------------
+
+    def _metrics_handler(self):
+        op = self.operator
+
+        class Metrics(_Base):
+            def do_GET(self):
+                if self.path.rstrip("/") in ("", "/metrics"):
+                    return self._text(200, op.metrics_text(),
+                                      content_type="text/plain; version=0.0.4")
+                return self._text(404, "not found")
+
+        return Metrics
+
+    def _health_handler(self):
+        op = self.operator
+
+        class Health(_Base):
+            def do_GET(self):
+                if self.path.startswith("/healthz") or \
+                        self.path.startswith("/readyz"):
+                    ok = op.healthz()
+                elif self.path.startswith("/livez"):
+                    ok = op.livez()
+                else:
+                    return self._text(404, "not found")
+                return self._text(200 if ok else 503, "ok" if ok else "unhealthy")
+
+        return Health
+
+    def _webhook_handler(self):
+        op = self.operator
+
+        class Webhook(_Base):
+            def do_POST(self):
+                if not self.path.startswith("/validate"):
+                    return self._text(404, "not found")
+                length = self.headers.get("Content-Length")
+                try:
+                    # fail CLOSED on an unreadable body (absent/zero
+                    # Content-Length, e.g. a proxy stripping it): an
+                    # unverifiable object must not be admitted
+                    if length is None or int(length) <= 0:
+                        raise ValueError("missing or empty request body")
+                    review = json.loads(self.rfile.read(int(length)))
+                    resp = _admit_review(op, review)
+                except Exception as e:  # malformed review: explicit denial
+                    resp = _review_response("", False, f"bad request: {e}")
+                body = json.dumps(resp).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        return Webhook
+
+
+class _Base(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _text(self, code: int, body: str,
+              content_type: str = "text/plain") -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+def _review_response(uid: str, allowed: bool, message: str = "") -> dict:
+    resp = {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "response": {"uid": uid, "allowed": allowed}}
+    if message:
+        resp["response"]["status"] = {"message": message, "code": 403}
+    return resp
+
+
+def _admit_review(operator, review: dict) -> dict:
+    """AdmissionReview request -> response via the Webhooks pipeline."""
+    from .coordination import serde
+    from .webhooks import AdmissionError
+
+    req = review.get("request") or {}
+    uid = req.get("uid", "")
+    plural = ((req.get("resource") or {}).get("resource") or "").lower()
+    kind = _PLURALS.get(plural)
+    if kind is None:
+        return _review_response(uid, True)  # not a guarded kind: admit
+    doc = req.get("object") or {}
+    try:
+        obj = serde.from_manifest(kind, doc)
+        operator.webhooks.admit(kind, obj, req.get("operation", "CREATE"))
+    except AdmissionError as e:
+        return _review_response(uid, False, str(e))
+    except Exception as e:  # unparseable object
+        return _review_response(uid, False, f"invalid {kind} manifest: {e}")
+    return _review_response(uid, True)

@@ -638,3 +638,29 @@ class TestReplaceRevalidation:
         assert op.deprovisioning.reconcile_consolidation() is None
         assert not op.cluster.nodes["n-big"].marked_for_deletion
         assert op.cluster.nodes[rep_name].marked_for_deletion  # rolled back
+
+
+class TestGarbageCollection:
+    def test_orphan_instance_reaped_after_grace(self, op):
+        # a machine launched, then its store object lost (crashed controller
+        # between cloud create and machine write): the cloud instance leaks
+        add_provisioner(op)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        (name,) = [m.name for m in op.kube.machines()]
+        op.kube.delete("machines", name)  # simulate the lost write
+        # within the grace window: too early to judge (eventual consistency)
+        assert op.garbagecollection.reconcile_once() == []
+        op.clock.step(op.garbagecollection.grace_seconds + 1)
+        reaped = op.garbagecollection.reconcile_once()
+        assert len(reaped) == 1
+        assert op.cloudprovider.list_machines() == []
+        assert op.garbagecollection.collected.value() >= 1
+
+    def test_owned_instances_never_reaped(self, op):
+        add_provisioner(op)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        op.clock.step(op.garbagecollection.grace_seconds + 1)
+        assert op.garbagecollection.reconcile_once() == []
+        assert len(op.cloudprovider.list_machines()) == 1

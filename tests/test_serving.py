@@ -1,0 +1,207 @@
+"""Operator serving plane: metrics, health probes, AdmissionReview webhook
+(reference values.yaml:134-142 port wiring + pkg/webhooks AdmissionReview).
+"""
+
+import json
+import urllib.request
+import urllib.error
+
+import pytest
+
+from karpenter_tpu.apis.nodetemplate import NodeTemplate
+from karpenter_tpu.apis.settings import Settings
+from karpenter_tpu.fake.cloud import FakeCloud
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture
+def served_op():
+    clock = FakeClock()
+    cat = Catalog(types=[make_instance_type("m.large", cpu=4, memory="16Gi",
+                                            od_price=0.2)])
+    op = Operator(FakeCloud(catalog=cat, clock=clock),
+                  Settings(cluster_name="srv", cluster_endpoint="https://k"),
+                  cat, clock=clock, serve_http=True,
+                  metrics_port=0, health_port=0, webhook_port=0)
+    ports = op.serving.start()
+    yield op, ports
+    op.serving.stop()
+    op.stop()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                    timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _review(port, plural, obj, operation="CREATE"):
+    body = json.dumps({
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {"uid": "u-1", "operation": operation,
+                    "resource": {"resource": plural}, "object": obj},
+    }).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/validate", body,
+        {"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return json.loads(r.read())
+
+
+class TestServingPlane:
+    def test_metrics_endpoint(self, served_op):
+        op, ports = served_op
+        code, body = _get(ports["metrics"], "/metrics")
+        assert code == 200
+        assert "karpenter" in body
+
+    def test_health_endpoints(self, served_op):
+        op, ports = served_op
+        for path in ("/healthz", "/livez", "/readyz"):
+            code, body = _get(ports["health"], path)
+            assert code == 200, path
+            assert body == "ok"
+
+    def test_webhook_allows_valid_nodetemplate(self, served_op):
+        op, ports = served_op
+        resp = _review(ports["webhook"], "nodetemplates", {
+            "apiVersion": "karpenter.k8s.tpu/v1alpha1", "kind": "NodeTemplate",
+            "metadata": {"name": "ok"},
+            "spec": {"subnetSelector": {"id": "subnet-zone-1a"},
+                     "securityGroupSelector": {"id": "sg-default"}},
+        })
+        assert resp["response"]["allowed"] is True
+        assert resp["response"]["uid"] == "u-1"
+
+    def test_webhook_denies_invalid_nodetemplate(self, served_op):
+        op, ports = served_op
+        resp = _review(ports["webhook"], "nodetemplates", {
+            "apiVersion": "karpenter.k8s.tpu/v1alpha1", "kind": "NodeTemplate",
+            "metadata": {"name": "bad"},
+            "spec": {"subnetSelector": {"id": "not-a-subnet-id!"},
+                     "securityGroupSelector": {"id": "sg-default"}},
+        })
+        assert resp["response"]["allowed"] is False
+        assert "subnet" in resp["response"]["status"]["message"]
+
+    def test_webhook_denies_restricted_cluster_tag(self, served_op):
+        op, ports = served_op
+        resp = _review(ports["webhook"], "awsnodetemplates", {
+            "apiVersion": "karpenter.k8s.aws/v1alpha1",
+            "kind": "AWSNodeTemplate",
+            "metadata": {"name": "bad"},
+            "spec": {"subnetSelector": {"id": "subnet-zone-1a"},
+                     "securityGroupSelector": {"id": "sg-default"},
+                     "tags": {"kubernetes.io/cluster/srv": "owned"}},
+        })
+        assert resp["response"]["allowed"] is False
+
+    def test_webhook_admits_unguarded_kinds(self, served_op):
+        op, ports = served_op
+        resp = _review(ports["webhook"], "pods", {"metadata": {"name": "p"}})
+        assert resp["response"]["allowed"] is True
+
+    def test_webhook_denies_garbage(self, served_op):
+        op, ports = served_op
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ports['webhook']}/validate", b"not json",
+            {"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            resp = json.loads(r.read())
+        assert resp["response"]["allowed"] is False
+
+
+class TestServingHardening:
+    def test_webhook_fails_closed_without_content_length(self, served_op):
+        import http.client
+
+        op, ports = served_op
+        conn = http.client.HTTPConnection("127.0.0.1", ports["webhook"],
+                                          timeout=5)
+        # POST with no body and no Content-Length: must be denied, not
+        # admitted as an empty review
+        conn.putrequest("POST", "/validate")
+        conn.endheaders()
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert body["response"]["allowed"] is False
+        conn.close()
+
+    def test_stop_releases_listening_sockets(self):
+        import socket
+
+        from karpenter_tpu.serving import ServingPlane
+
+        class NullOp:
+            def metrics_text(self):
+                return "x"
+
+            def healthz(self):
+                return True
+
+            def livez(self):
+                return True
+
+        plane = ServingPlane(NullOp(), metrics_port=0, health_port=0,
+                             webhook_port=0)
+        ports = plane.start()
+        plane.stop()
+        # the port must be immediately rebindable (server_close ran)
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", ports["metrics"]))
+        s.close()
+
+    def test_webhook_serves_tls_when_cert_provided(self, tmp_path):
+        import ssl as _ssl
+        import subprocess
+
+        from karpenter_tpu.serving import ServingPlane
+
+        cert, key = tmp_path / "tls.crt", tmp_path / "tls.key"
+        gen = subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=karpenter-tpu.karpenter-tpu.svc"],
+            capture_output=True)
+        if gen.returncode != 0:
+            pytest.skip("openssl unavailable")
+
+        class NullOp:
+            def metrics_text(self):
+                return "x"
+
+            def healthz(self):
+                return True
+
+            def livez(self):
+                return True
+
+            class webhooks:  # noqa: N801 - minimal admit surface
+                @staticmethod
+                def admit(kind, obj, op):
+                    return obj
+
+        plane = ServingPlane(NullOp(), metrics_port=-1, health_port=-1,
+                             webhook_port=0, tls_cert=str(cert),
+                             tls_key=str(key))
+        ports = plane.start()
+        try:
+            ctx = _ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = _ssl.CERT_NONE
+            req = urllib.request.Request(
+                f"https://127.0.0.1:{ports['webhook']}/validate",
+                json.dumps({"request": {"uid": "u", "resource":
+                            {"resource": "pods"}, "object": {}}}).encode(),
+                {"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req, timeout=5, context=ctx) as r:
+                resp = json.loads(r.read())
+            assert resp["response"]["allowed"] is True  # unguarded kind
+        finally:
+            plane.stop()
