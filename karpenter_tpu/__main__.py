@@ -41,9 +41,9 @@ def _wait_for_signal() -> None:
 
 
 def cmd_solver_serve(args) -> int:
-    from .solver.service import serve
-
     if args.distributed:
+        # MUST run before any import that touches the XLA backend (the
+        # kernels are imported lazily below for exactly this reason)
         from .parallel.multihost import initialize_distributed, mesh_description, make_hybrid_mesh
 
         multi = initialize_distributed(args.coordinator, args.num_processes,
@@ -51,6 +51,8 @@ def cmd_solver_serve(args) -> int:
         print(f"distributed: {mesh_description(make_hybrid_mesh())}"
               if multi else "distributed requested but single-process",
               flush=True)
+    from .solver.service import serve
+
     server, port, _service = serve(f"{args.host}:{args.port}",
                                    max_workers=args.workers)
     print(f"solver service listening on {args.host}:{port}", flush=True)

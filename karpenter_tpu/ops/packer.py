@@ -46,7 +46,12 @@ from . import pallas_kernels
 
 _PODS_I = wk.RESOURCE_INDEX[wk.RESOURCE_PODS]
 
-INT_BIG = jnp.int32(2**30)
+# plain int, NOT jnp.int32(...): a module-level jnp scalar initializes the
+# XLA backend at import time, which breaks jax.distributed.initialize for
+# any process that imports the kernels before joining the mesh (the
+# multi-host bootstrap order). Arithmetic against int32 arrays stays int32
+# under weak typing; 2**30 fits comfortably.
+INT_BIG = 2**30
 
 # f32 one-correction division in the Pallas quotient kernel is bit-exact only
 # below 2**24; encode clamps values at INT_BIG (2**30), so a catalog with a

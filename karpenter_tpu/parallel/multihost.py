@@ -66,16 +66,32 @@ def make_hybrid_mesh(types_dim: Optional[int] = None) -> Mesh:
     n_proc = jax.process_count()
     if n_proc <= 1:
         return make_mesh()
-    from jax.experimental import mesh_utils
-
     local = jax.local_device_count()
     if types_dim is None:
         types_dim = 2 if local % 2 == 0 and local >= 2 else 1
     nodes_local = local // types_dim
-    devices = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=(nodes_local, types_dim),
-        dcn_mesh_shape=(n_proc, 1),
-    )
+    all_devices = jax.devices()
+    slices = {getattr(d, "slice_index", None) for d in all_devices}
+    if None not in slices and len(slices) == n_proc:
+        # TPU pods (one real DCN slice per process): let jax order by the
+        # actual slice topology
+        from jax.experimental import mesh_utils
+
+        devices = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(nodes_local, types_dim),
+            dcn_mesh_shape=(n_proc, 1),
+        )
+    else:
+        # no slice metadata (CPU multi-process, some GPU setups): build the
+        # DCN-outermost order by process — each host's block is contiguous
+        # on the nodes axis, so inter-host hops ride the latency-tolerant
+        # axis exactly as on a pod
+        by_proc: "dict[int, list]" = {}
+        for d in all_devices:
+            by_proc.setdefault(d.process_index, []).append(d)
+        rows = [np.array(by_proc[pi]).reshape(nodes_local, types_dim)
+                for pi in sorted(by_proc)]
+        devices = np.concatenate(rows, axis=0)
     assert devices.shape == (nodes_local * n_proc, types_dim)
     return Mesh(devices, (AXIS_NODES, AXIS_TYPES))
 
@@ -87,9 +103,13 @@ def mesh_description(mesh: Mesh) -> dict:
         len({d.process_index for d in dev[i].flat if hasattr(d, "process_index")})
         for i in range(dev.shape[0])
     ] if dev.ndim == 2 else []
+    nodes_procs = len({d.process_index for d in dev.flat
+                       if hasattr(d, "process_index")})
     return {
         "axes": dict(zip(mesh.axis_names, mesh.devices.shape)),
         "n_devices": int(dev.size),
         "n_processes": jax.process_count(),
         "types_axis_crosses_hosts": any(p > 1 for p in procs_by_row),
+        # the nodes axis SHOULD span every process (DCN-outermost layout)
+        "nodes_axis_spans_processes": nodes_procs == jax.process_count(),
     }
