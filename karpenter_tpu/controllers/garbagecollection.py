@@ -30,15 +30,21 @@ GRACE_SECONDS = 5 * 60.0  # eventual-consistency window before reaping
 class GarbageCollectionController:
     def __init__(self, kube, cloudprovider, clock: Optional[Clock] = None,
                  registry: Optional[Registry] = None,
-                 grace_seconds: float = GRACE_SECONDS):
+                 grace_seconds: float = GRACE_SECONDS,
+                 cluster=None, termination=None):
         self.kube = kube
         self.cloudprovider = cloudprovider
+        self.cluster = cluster
+        self.termination = termination
         self.clock = clock or Clock()
         self.grace_seconds = grace_seconds
         reg = registry or REGISTRY
         self.collected = reg.counter(
             f"{NAMESPACE}_garbage_collected_instances_total",
             "Leaked cloud instances terminated by GC.")
+        self.retired = reg.counter(
+            f"{NAMESPACE}_garbage_collected_machines_total",
+            "Machines retired because their cloud instance vanished.")
 
     def reconcile_once(self) -> "list[str]":
         """One sweep; returns the terminated instance ids. One cluster-tag
@@ -74,4 +80,38 @@ class GarbageCollectionController:
             log.info("garbage-collected leaked instance %s (no machine)",
                      inst.id)
             reaped.append(inst.id)
+        self._retire_vanished_machines({i.id for i in instances})
         return reaped
+
+    def _retire_vanished_machines(self, present: "set[str]") -> None:
+        """Inverse direction: a store machine whose cloud instance is GONE
+        (out-of-band termination the interruption pipeline missed) is
+        retired through the normal drain path — its pods are dead anyway
+        and reschedule onto live capacity (reference analogue: the
+        cloud-node-lifecycle deletion of NotReady nodes whose instance
+        disappeared)."""
+        for m in self.kube.machines():
+            pid = m.status.provider_id
+            if not pid:
+                continue  # not launched yet
+            try:
+                _, iid = parse_provider_id(pid)
+            except ValueError:
+                continue
+            if iid in present:
+                continue
+            node = None
+            if self.cluster is not None:
+                node = next((n for n in self.cluster.nodes.values()
+                             if n.machine_name == m.name), None)
+            if node is not None and self.termination is not None:
+                if self.termination.request_deletion(node.name):
+                    self.retired.inc()
+                    log.info("retiring machine %s: instance %s vanished",
+                             m.name, iid)
+            else:
+                # no node joined (died between launch and registration)
+                self.kube.delete("machines", m.name)
+                self.retired.inc()
+                log.info("deleted machine %s: instance %s vanished before "
+                         "registration", m.name, iid)

@@ -118,7 +118,8 @@ class Operator:
         self.settingswatch = SettingsWatchController(
             self.kube, settings, clock=self.clock)
         self.garbagecollection = GarbageCollectionController(
-            self.kube, self.cloudprovider, clock=self.clock)
+            self.kube, self.cloudprovider, clock=self.clock,
+            cluster=self.cluster, termination=self.termination)
         self.interruption = None
         if settings.interruption_queue_name:
             self.queue = queue or FakeQueue(settings.interruption_queue_name,

@@ -664,3 +664,33 @@ class TestGarbageCollection:
         op.clock.step(op.garbagecollection.grace_seconds + 1)
         assert op.garbagecollection.reconcile_once() == []
         assert len(op.cloudprovider.list_machines()) == 1
+
+    def test_vanished_instance_retires_machine_and_node(self, op):
+        # out-of-band termination (instance gone, no interruption message):
+        # GC retires the machine through the normal drain path
+        add_provisioner(op)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        (node_name,) = list(op.cluster.nodes)
+        node = op.cluster.nodes[node_name]
+        from karpenter_tpu.models.machine import parse_provider_id
+
+        _, iid = parse_provider_id(node.provider_id)
+        op.cloudprovider.instances.delete(iid)  # vanishes out-of-band
+        assert op.garbagecollection.reconcile_once() == []
+        assert op.cluster.nodes[node_name].marked_for_deletion
+        op.termination.reconcile_once()
+        assert node_name not in op.cluster.nodes
+        assert op.kube.machines() == []
+
+    def test_vanished_preregistration_machine_deleted(self, op):
+        # machine launched, instance died before any node joined: the
+        # machine object itself is GC'd (no node to drain)
+        from karpenter_tpu.models.machine import Machine, MachineSpec, MachineStatus
+
+        add_provisioner(op)
+        m = Machine(name="ghost", spec=MachineSpec(provisioner_name="default"),
+                    status=MachineStatus(provider_id="tpu:///zone-1a/i-gone"))
+        op.kube.create("machines", "ghost", m)
+        op.garbagecollection.reconcile_once()
+        assert op.kube.get("machines", "ghost") is None
