@@ -42,6 +42,7 @@ _API = {
     "provisioners": ("/apis/karpenter.sh/v1alpha5", "provisioners", False),
     "machines": ("/apis/karpenter.sh/v1alpha5", "machines", False),
     "nodetemplates": ("/apis/karpenter.k8s.tpu/v1alpha1", "nodetemplates", False),
+    "events": ("/api/v1", "events", True),
 }
 
 
@@ -171,12 +172,19 @@ class HttpKubeStore:
 
     # -- informer lifecycle ----------------------------------------------------
 
+    # kinds the informer LISTs + watches. "events" is deliberately excluded:
+    # a busy cluster's event firehose (kubelet, scheduler, every component)
+    # would flood the cache and fire every watcher with objects no
+    # controller reads — our own writes still land in the cache via the
+    # read-your-writes apply, and listings of foreign events go direct.
+    WATCHED_KINDS = tuple(k for k in KubeStore.KINDS if k != "events")
+
     def start(self) -> None:
         """Seed the cache with LIST, then keep it current with one watch
         stream per kind (reconnect-with-relist on drop)."""
-        for kind in self.KINDS:
+        for kind in self.WATCHED_KINDS:
             self._relist(kind)
-        for kind in self.KINDS:
+        for kind in self.WATCHED_KINDS:
             t = threading.Thread(target=self._watch_loop, args=(kind,),
                                  name=f"watch-{kind}", daemon=True)
             t.start()

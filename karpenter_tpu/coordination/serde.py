@@ -42,6 +42,7 @@ ROUTES = {
     "provisioners": ("karpenter.sh/v1alpha5", "Provisioner", False),
     "machines": ("karpenter.sh/v1alpha5", "Machine", False),
     "nodetemplates": ("karpenter.k8s.tpu/v1alpha1", "NodeTemplate", False),
+    "events": ("v1", "Event", True),
 }
 
 # registered dataclasses for the tagged generic encoder
@@ -124,6 +125,27 @@ def to_manifest(kind: str, name: str, obj) -> dict:
         doc["data"] = dict(obj.get("data", obj)) if isinstance(obj, dict) \
             else dict(obj)
         return doc
+    if kind == "events" and isinstance(obj, dict):
+        # native v1 Event fields: a real apiserver prunes unknown fields on
+        # built-in types, so kubectl-get-events parity needs the real schema
+        # (the embedded model below keeps exact round-trips on our side)
+        import datetime
+
+        ref_kind, _, ref_name = str(obj.get("object_ref", "")).partition("/")
+        ts = obj.get("ts") or 0.0
+        stamp = datetime.datetime.fromtimestamp(
+            ts, tz=datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ") \
+            if ts else None
+        doc.update({
+            "type": obj.get("kind", "Normal"),
+            "reason": obj.get("reason", ""),
+            "message": obj.get("message", ""),
+            "involvedObject": {"kind": ref_kind.capitalize(),
+                               "name": ref_name},
+            "source": {"component": "karpenter-tpu"},
+        })
+        if stamp:
+            doc["lastTimestamp"] = stamp
     if kind == "pods" and isinstance(obj, PodSpec):
         # surface the schedulable basics in real schema; exact model embedded
         doc["metadata"]["labels"] = dict(obj.labels)
@@ -172,6 +194,27 @@ def _parse_k8s(kind: str, doc: dict):
         return _parse_k8s_node(doc)
     if kind == "leases":
         return _parse_k8s_lease(doc)
+    if kind == "events":
+        # other components' events (kubelet, scheduler): normalize to the
+        # recorder's stored-dict shape so event listings stay uniform
+        import datetime
+
+        ref = doc.get("involvedObject") or {}
+        ts = 0.0
+        for field in ("lastTimestamp", "eventTime", "firstTimestamp"):
+            raw = doc.get(field)
+            if raw:
+                try:
+                    ts = datetime.datetime.fromisoformat(
+                        str(raw).replace("Z", "+00:00")).timestamp()
+                    break
+                except ValueError:
+                    continue
+        return {"ts": ts, "kind": doc.get("type", "Normal"),
+                "reason": doc.get("reason", ""),
+                "object_ref": f"{ref.get('kind', '').lower()}/"
+                              f"{ref.get('name', '')}",
+                "message": doc.get("message", "")}
     # foreign object of a controller-owned kind (e.g. a Machine authored by
     # another tool): not ours to interpret — callers skip None
     return None

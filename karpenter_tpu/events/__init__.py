@@ -30,7 +30,7 @@ MAX_EVENTS = 10_000
 
 class EventRecorder:
     def __init__(self, clock: Optional[Clock] = None, dedupe_ttl: float = DEDUPE_TTL,
-                 max_events: int = MAX_EVENTS):
+                 max_events: int = MAX_EVENTS, sink=None):
         from collections import deque
 
         self.clock = clock or Clock()
@@ -38,6 +38,14 @@ class EventRecorder:
         self.events: "deque[tuple[float, Event]]" = deque(maxlen=max_events)
         self._seen: "dict[tuple, float]" = {}
         self._lock = threading.Lock()
+        # optional sink(ts, event) invoked for every RECORDED (post-dedupe)
+        # event — the operator wires it to persist Events into the
+        # coordination plane so `kubectl get events` works (reference:
+        # events go through the k8s event recorder to the apiserver)
+        self._sink = sink
+
+    def set_sink(self, sink) -> None:
+        self._sink = sink
 
     def publish(self, event: Event) -> bool:
         """Record unless an identical event fired within the dedupe window.
@@ -53,7 +61,19 @@ class EventRecorder:
                 self._seen = {k: t for k, t in self._seen.items() if t >= cutoff}
             self._seen[key] = now
             self.events.append((now, event))
-            return True
+        if self._sink is not None:
+            try:  # persistence must never break the emitting controller
+                self._sink(now, event)
+            except Exception as e:
+                err = f"{type(e).__name__}: {e}"
+                if err != getattr(self, "_last_sink_error", None):
+                    self._last_sink_error = err  # don't spam per event
+                    import logging
+
+                    logging.getLogger("karpenter.events").warning(
+                        "event persistence failing (%s); events remain "
+                        "in-memory only", err)
+        return True
 
     def normal(self, object_ref: str, reason: str, message: str) -> bool:
         return self.publish(Event("Normal", reason, object_ref, message))

@@ -29,7 +29,7 @@ class KubeStore:
     """Typed object buckets with list/get/create/update/delete + watchers."""
 
     KINDS = ("pods", "nodes", "machines", "provisioners", "nodetemplates",
-             "pdbs", "configmaps", "leases")
+             "pdbs", "configmaps", "leases", "events")
 
     def __init__(self):
         self._lock = threading.RLock()

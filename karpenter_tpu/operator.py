@@ -51,7 +51,16 @@ class Operator:
         self.clock = clock or Clock()
         self.kube = kube or KubeStore()
         self.cluster = ClusterState()
-        self.recorder = EventRecorder(clock=self.clock)
+        self.recorder = EventRecorder(clock=self.clock,
+                                      sink=self._persist_event)
+        # bounded Event-object retention in the coordination plane
+        import collections
+        import uuid as _uuid
+
+        self._event_names = collections.deque()
+        self._event_seq = 0
+        self._event_suffix = _uuid.uuid4().hex[:5]  # HA replicas can't collide
+        self._event_lock = threading.Lock()  # recorder is shared by 7 threads
         self.cloudprovider = CloudProvider(cloud, settings, catalog, clock=self.clock)
         self.metrics_cloudprovider = decorate_cloudprovider(self.cloudprovider)
         # Leader election (main.go:42 LEADER_ELECT, charts 2-replica/PDB):
@@ -133,6 +142,41 @@ class Operator:
         if kind == "pdbs":
             self.cluster.pdbs = self.kube.pdbs()
 
+    MAX_STORED_EVENTS = 2000
+
+    def _persist_event(self, ts: float, event) -> None:
+        """Recorded events become Event objects in the coordination plane
+        (`kubectl get events` parity); retention is bounded by deleting the
+        oldest beyond MAX_STORED_EVENTS. Serialized: the recorder is shared
+        across every controller thread, and a torn seq would mint colliding
+        names (the losing create's Conflict silently dropping the event)."""
+        with self._event_lock:
+            self._event_seq += 1
+            name = f"evt-{self._event_suffix}-{self._event_seq:07d}"
+            self.kube.create("events", name, {
+                "name": name, "ts": ts, "kind": event.kind,
+                "reason": event.reason, "object_ref": event.object_ref,
+                "message": event.message})
+            self._event_names.append(name)
+            while len(self._event_names) > self.MAX_STORED_EVENTS:
+                self.kube.delete("events", self._event_names.popleft())
+
+    def _prune_stored_events(self) -> None:
+        """Crash-restart hygiene: a replica that died left its evt-* objects
+        behind with no process-local retention state. On start, cap the
+        store-wide population at MAX_STORED_EVENTS, oldest first (stored
+        events carry their own name for exactly this sweep)."""
+        try:
+            stored = sorted(
+                (o.get("ts", 0.0), o["name"])
+                for o in self.kube.list("events")
+                if isinstance(o, dict) and o.get("name"))
+        except Exception as e:
+            log.warning("event prune skipped: %s", e)
+            return
+        for _, name in stored[:max(0, len(stored) - self.MAX_STORED_EVENTS)]:
+            self.kube.delete("events", name)
+
     # -- lifecycle -------------------------------------------------------------
 
     def _on_started_leading(self) -> None:
@@ -150,6 +194,7 @@ class Operator:
         if self.serving is not None:
             ports = self.serving.start()
             log.info("serving plane up: %s", ports)
+        self._prune_stored_events()  # orphans from crashed replicas
         if self.leader is not None:
             t0 = threading.Thread(target=self.leader.run, args=(self._stop,),
                                   name="leaderelection", daemon=True)

@@ -694,3 +694,37 @@ class TestGarbageCollection:
         op.kube.create("machines", "ghost", m)
         op.garbagecollection.reconcile_once()
         assert op.kube.get("machines", "ghost") is None
+
+
+class TestEventObjects:
+    def test_events_persist_to_the_coordination_plane(self, op):
+        # kubectl-get-events parity: recorded events become store objects
+        add_provisioner(op)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        stored = op.kube.list("events")
+        assert stored, "no Event objects landed in the store"
+        reasons = {e["reason"] for e in stored}
+        assert "Launched" in reasons
+        assert all({"ts", "kind", "reason", "object_ref", "message"}
+                   <= set(e) for e in stored)
+
+    def test_event_retention_is_bounded(self, op):
+        op.MAX_STORED_EVENTS = 10
+        for i in range(25):
+            op.recorder.normal(f"node/n{i}", "Test", f"msg {i}")
+        assert len(op.kube.list("events")) == 10
+
+    def test_restart_prunes_orphaned_events(self, op):
+        # a crashed replica's events have no process-local retention state;
+        # start() caps the store-wide population oldest-first
+        for i in range(30):
+            op.kube.create("events", f"evt-dead-{i:07d}",
+                           {"name": f"evt-dead-{i:07d}", "ts": float(i),
+                            "kind": "Normal", "reason": "Old",
+                            "object_ref": "node/x", "message": "stale"})
+        op.MAX_STORED_EVENTS = 12
+        op._prune_stored_events()
+        left = op.kube.list("events")
+        assert len(left) == 12
+        assert min(e["ts"] for e in left) == 18.0  # oldest went first
