@@ -110,7 +110,8 @@ class ServingPlane:
 
         class Webhook(_Base):
             def do_POST(self):
-                if not self.path.startswith("/validate"):
+                mutate = self.path.startswith("/mutate")
+                if not mutate and not self.path.startswith("/validate"):
                     return self._text(404, "not found")
                 length = self.headers.get("Content-Length")
                 try:
@@ -120,7 +121,7 @@ class ServingPlane:
                     if length is None or int(length) <= 0:
                         raise ValueError("missing or empty request body")
                     review = json.loads(self.rfile.read(int(length)))
-                    resp = _admit_review(op, review)
+                    resp = _admit_review(op, review, mutate=mutate)
                 except Exception as e:  # malformed review: explicit denial
                     resp = _review_response("", False, f"bad request: {e}")
                 body = json.dumps(resp).encode()
@@ -157,8 +158,16 @@ def _review_response(uid: str, allowed: bool, message: str = "") -> dict:
     return resp
 
 
-def _admit_review(operator, review: dict) -> dict:
-    """AdmissionReview request -> response via the Webhooks pipeline."""
+def _admit_review(operator, review: dict, mutate: bool = False) -> dict:
+    """AdmissionReview request -> response via the Webhooks pipeline.
+
+    /validate runs defaulting+validation and answers allowed/denied only;
+    /mutate additionally returns the defaulted object as a whole-document
+    JSONPatch (RFC 6902 `replace` at path "") so the knative-style
+    defaulting half works through a real apiserver too."""
+    import base64
+    import copy
+
     from .coordination import serde
     from .webhooks import AdmissionError
 
@@ -171,9 +180,23 @@ def _admit_review(operator, review: dict) -> dict:
     doc = req.get("object") or {}
     try:
         obj = serde.from_manifest(kind, doc)
-        operator.webhooks.admit(kind, obj, req.get("operation", "CREATE"))
+        admitted = operator.webhooks.admit(kind, obj,
+                                           req.get("operation", "CREATE"))
     except AdmissionError as e:
         return _review_response(uid, False, str(e))
     except Exception as e:  # unparseable object
         return _review_response(uid, False, f"invalid {kind} manifest: {e}")
-    return _review_response(uid, True)
+    resp = _review_response(uid, True)
+    if mutate:
+        name = serde.manifest_name(doc) or getattr(admitted, "name", "")
+        defaulted = serde.to_manifest(kind, name, admitted)
+        # preserve the caller's metadata (labels/annotations/namespace the
+        # serde round trip doesn't carry)
+        merged_meta = copy.deepcopy(doc.get("metadata") or {})
+        merged_meta.update(defaulted.get("metadata") or {})
+        defaulted["metadata"] = merged_meta
+        patch = [{"op": "replace", "path": "", "value": defaulted}]
+        resp["response"]["patchType"] = "JSONPatch"
+        resp["response"]["patch"] = base64.b64encode(
+            json.dumps(patch).encode()).decode()
+    return resp

@@ -205,3 +205,51 @@ class TestServingHardening:
             assert resp["response"]["allowed"] is True  # unguarded kind
         finally:
             plane.stop()
+
+
+class TestMutatingWebhook:
+    def _mutate(self, port, plural, obj):
+        body = json.dumps({
+            "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": "m-1", "operation": "CREATE",
+                        "resource": {"resource": plural}, "object": obj},
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/mutate", body,
+            {"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return json.loads(r.read())
+
+    def test_mutate_returns_defaulting_patch(self, served_op):
+        import base64
+
+        op, ports = served_op
+        # a provisioner with no requirements: defaulting adds linux/amd64/
+        # on-demand (v1alpha5/provisioner.go:45-60 analogue)
+        resp = self._mutate(ports["webhook"], "provisioners", {
+            "apiVersion": "karpenter.sh/v1alpha5", "kind": "Provisioner",
+            "metadata": {"name": "min", "labels": {"team": "a"}},
+            "spec": {},
+        })
+        assert resp["response"]["allowed"] is True
+        assert resp["response"]["patchType"] == "JSONPatch"
+        patch = json.loads(base64.b64decode(resp["response"]["patch"]))
+        (op_item,) = patch
+        assert op_item["op"] == "replace" and op_item["path"] == ""
+        defaulted = op_item["value"]
+        assert defaulted["metadata"]["name"] == "min"
+        assert defaulted["metadata"]["labels"] == {"team": "a"}  # preserved
+        from karpenter_tpu.coordination import serde
+
+        prov = serde.from_manifest("provisioners", defaulted)
+        assert prov.requirements.get("kubernetes.io/os") is not None
+
+    def test_mutate_still_denies_invalid(self, served_op):
+        op, ports = served_op
+        resp = self._mutate(ports["webhook"], "nodetemplates", {
+            "apiVersion": "karpenter.k8s.tpu/v1alpha1", "kind": "NodeTemplate",
+            "metadata": {"name": "bad"},
+            "spec": {"subnetSelector": {"id": "bogus!"}},
+        })
+        assert resp["response"]["allowed"] is False
+        assert "patch" not in resp["response"]
