@@ -1,0 +1,88 @@
+"""E2E scenario harness: the reference's polling Monitor + expectation
+helpers (test/pkg/environment/common/monitor.go:36-145 and
+expectations.go), adapted to the hermetic and threaded operators.
+
+Scenario tests drive the operator, then assert through these helpers
+instead of raw store reads — the same vocabulary the reference suites use
+(ExpectCreatedNodeCount, EventuallyExpectHealthyPodCount, ...).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Monitor:
+    """Tracks node/pod population deltas from a reset point."""
+
+    def __init__(self, op):
+        self.op = op
+        self.reset()
+
+    def reset(self) -> None:
+        self._nodes_at_reset = set(self.op.cluster.nodes)
+        self._nodes_ever_seen = set(self.op.cluster.nodes)
+        self._pods_at_reset = {p.name for p in self.op.kube.pods()}
+
+    def _observe(self) -> "set[str]":
+        current = set(self.op.cluster.nodes)
+        self._nodes_ever_seen |= current
+        return current
+
+    # -- counts ----------------------------------------------------------------
+
+    def created_node_count(self) -> int:
+        return len(self._observe() - self._nodes_at_reset)
+
+    def deleted_node_count(self) -> int:
+        # every node observed since reset that is gone now (the reference
+        # Monitor counts deletions off the watch stream; polling keeps a
+        # running ever-seen set instead)
+        return len(self._nodes_ever_seen - self._observe())
+
+    def node_count(self) -> int:
+        return len(self.op.cluster.nodes)
+
+    def pending_pod_count(self) -> int:
+        return len(self.op.kube.pending_pods())
+
+    def bound_pod_count(self) -> int:
+        return sum(1 for p in self.op.kube.pods()
+                   if p.node_name and not p.is_daemon())
+
+    def restarted_pod_count(self) -> int:
+        """Pods recreated since reset (same name, delete+create churn)."""
+        current = {p.name for p in self.op.kube.pods()}
+        return len(current & self._pods_at_reset)
+
+    # -- expectations ----------------------------------------------------------
+
+    def expect_created_node_count(self, op: str, n: int) -> None:
+        """ExpectCreatedNodeCount analogue: '==', '<=', '>=' against the
+        nodes created since reset."""
+        got = self.created_node_count()
+        ok = {"==": got == n, "<=": got <= n, ">=": got >= n}[op]
+        assert ok, f"created nodes: expected {op} {n}, got {got}"
+
+    def expect_healthy_pod_count(self, n: int) -> None:
+        got = self.bound_pod_count()
+        assert got == n, f"bound pods: expected {n}, got {got}"
+
+    def eventually(self, predicate, timeout_s: float = 15.0,
+                   interval_s: float = 0.05, message: str = "") -> None:
+        """EventuallyExpect* analogue for the threaded operator (real
+        clock); hermetic tests drive reconciles directly and use the
+        synchronous expectations instead."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if predicate():
+                return
+            time.sleep(interval_s)
+        raise AssertionError(message or "condition never became true")
+
+    def eventually_expect_healthy_pod_count(self, n: int,
+                                            timeout_s: float = 15.0) -> None:
+        self.eventually(lambda: self.bound_pod_count() == n,
+                        timeout_s=timeout_s,
+                        message=f"never reached {n} bound pods "
+                                f"(at {self.bound_pod_count()})")

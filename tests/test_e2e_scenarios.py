@@ -685,3 +685,55 @@ spec:
                        for n in op.cluster.nodes.values())
         finally:
             op.stop()
+
+
+class TestMonitorHarness:
+    """The reference's Monitor/expectations vocabulary
+    (common/monitor.go:36-145, expectations.go) over both operator modes."""
+
+    def test_monitor_tracks_utilization_run(self, op):
+        from harness import Monitor
+
+        add_provisioner(op, requirements=Requirements.of(
+            (wk.LABEL_INSTANCE_TYPE, OP_IN, ["t.small"])))
+        mon = Monitor(op)
+        for i in range(10):
+            op.kube.create("pods", f"p{i}",
+                           make_pod(f"p{i}", cpu="1.5", memory="128Mi"))
+        op.provisioning.reconcile_once()
+        mon.expect_created_node_count("==", 10)  # utilization parity shape
+        mon.expect_healthy_pod_count(10)
+        assert mon.pending_pod_count() == 0
+        # consolidation-free teardown shows deletions too
+        for node in list(op.cluster.nodes.values()):
+            node.pods.clear()
+            op.termination.request_deletion(node.name)
+        op.termination.reconcile_once()
+        assert mon.deleted_node_count() == 10
+
+    def test_monitor_eventually_with_threaded_operator(self):
+        from harness import Monitor
+        from karpenter_tpu.utils.clock import Clock
+
+        clock = Clock()
+        cloud = FakeCloud(catalog=catalog(), clock=clock)
+        settings = Settings(cluster_name="mon", cluster_endpoint="https://k",
+                            batch_idle_duration=0.02, batch_max_duration=0.1)
+        o = Operator(cloud, settings, catalog(), clock=clock)
+        o.kube.create("nodetemplates", "default", NodeTemplate(
+            name="default", subnet_selector={"id": "subnet-zone-1a"},
+            security_group_selector={"id": "sg-default"}))
+        o.cloudprovider.register_nodetemplate(
+            o.kube.get("nodetemplates", "default"))
+        add_provisioner(o)
+        try:
+            o.start()
+            mon = Monitor(o)
+            for i in range(6):
+                o.kube.create("pods", f"w{i}",
+                              make_pod(f"w{i}", cpu="1", memory="2Gi"))
+            mon.eventually_expect_healthy_pod_count(6, timeout_s=20)
+            mon.expect_created_node_count(">=", 1)
+            mon.expect_created_node_count("<=", 2)
+        finally:
+            o.stop()
