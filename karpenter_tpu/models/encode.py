@@ -354,10 +354,14 @@ def encode_problem(
             cap = int(group_cap[gi])
             if cap >= int(INT_BIG):
                 continue
-            # residents carry their PRE-SPLIT spec: count via origin key
+            # residents carry their PRE-SPLIT spec: count via origin key;
+            # group_counts carries IN-RUN placements from an earlier solve
+            # round (the two-round co-pending affinity driver) — the oracle's
+            # cap check is resident_counts[okey] + group_counts[okey]
             okey = g.spec.origin_key()
             for ei, e in enumerate(existing):
-                ex_cap[gi, ei] = max(0, cap - e.resident_counts.get(okey, 0))
+                ex_cap[gi, ei] = max(0, cap - e.resident_counts.get(okey, 0)
+                                     - e.group_counts.get(okey, 0))
 
     if n_slots is None:
         # Tight upper bound on claim slots: group g opens at most

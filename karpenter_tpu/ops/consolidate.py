@@ -182,7 +182,9 @@ def encode_consolidation(
                 ex_feas[ci, gi, i] = node_fits(g.spec, name)
             if cap < int(INT_BIG):
                 # hostname spread/anti-affinity counts pods RESIDENT on the
-                # surviving nodes (mirrors encode_problem's ex_cap)
+                # surviving nodes (mirrors encode_problem's ex_cap; the
+                # in-run group_counts term is zero here — survivor views are
+                # built fresh from cluster state each sweep)
                 if ex_cap_arr is None:
                     ex_cap_arr = np.full((C, Gb, Ne), INT_BIG, dtype=np.int32)
                 okey = g.spec.origin_key()

@@ -228,8 +228,10 @@ class ExistingNode:
     # zone-split subgroups of one deployment share one per-node cap budget
     group_counts: "dict[object, int]" = dataclasses.field(default_factory=dict)
     # pods already resident BEFORE the run, keyed by (pre-split) group key.
-    # Kept separate from group_counts so the kernel's static per-row ex_cap
-    # (resident base only) and this oracle enforce the identical cap rule.
+    # Kept separate from group_counts so spreading counts residents only;
+    # the kernel's static per-row ex_cap subtracts BOTH (resident base +
+    # carried in-run counts, models/encode.py) — the same
+    # resident_counts[okey] + group_counts[okey] rule this oracle checks.
     resident_counts: "dict[object, int]" = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):

@@ -114,8 +114,12 @@ class TPUSolver:
         if not deferred:
             return self._solve_once(pods, existing, daemon_overhead, n_slots)
         res = self._solve_once(primary, existing, daemon_overhead, n_slots)
+        # Round 2 must see round 1's consumption of the REAL existing nodes
+        # (the oracle mutates its views in place; this path re-encodes, so
+        # carry used + origin-keyed in-run counts on fresh copies).
+        carried = _carry_round1_existing(existing, res)
         pseudo = self._nodes_as_existing(res, daemon_overhead)
-        res2 = self._solve_once(deferred, list(existing) + pseudo,
+        res2 = self._solve_once(deferred, carried + pseudo,
                                 daemon_overhead, n_slots)
         return _merge_rounds(res, res2, {p.name: i for i, p in
                                          enumerate(pseudo)})
@@ -163,6 +167,35 @@ class TPUSolver:
         )
         result = run_pack(enc, self._dev_alloc_t, self._dev_tiebreak)
         return decode(enc, result, [e.name for e in existing])
+
+
+def _carry_round1_existing(existing: "Sequence[ExistingNode]",
+                           res: SolveResult) -> "list[ExistingNode]":
+    """Fresh ExistingNode copies reflecting round-1 placements: used grows
+    by the placed vectors, and group_counts carries the origin-keyed in-run
+    counts (the oracle's cap rule is resident_counts[okey] +
+    group_counts[okey]; encode_problem consumes both). `resident` stays
+    untouched — round-1 placements are NOT affinity anchors in the oracle's
+    round 2 either (they live in assignments, not resident)."""
+    out: "list[ExistingNode]" = []
+    for e in existing:
+        per_group = res.existing_by_group.get(e.name, {})
+        used = list(e.used)
+        # pre-seeded counts are part of the contract now (encode subtracts
+        # them from ex_cap); chained solves must not reset them
+        counts: "dict[object, int]" = dict(e.group_counts)
+        for g_idx, count in per_group.items():
+            vec = res.groups[g_idx].vector
+            for r in range(wk.NUM_RESOURCES):
+                used[r] += vec[r] * count
+            okey = res.groups[g_idx].spec.origin_key()
+            counts[okey] = counts.get(okey, 0) + count
+        ne = ExistingNode(name=e.name, labels=e.labels,
+                          allocatable=list(e.allocatable), used=used,
+                          taints=e.taints, resident=e.resident)
+        ne.group_counts = counts
+        out.append(ne)
+    return out
 
 
 def _merge_rounds(res: SolveResult, res2: SolveResult,

@@ -609,3 +609,41 @@ def test_parity_copending_anti_affinity_forward_reference():
         kinds = {res.groups[g].spec.labels for g in n.pod_counts}
         assert not ((("app", "noisy"),) in kinds
                     and (("app", "quiet"),) in kinds), n.pod_counts
+
+
+def test_parity_round2_sees_round1_existing_consumption():
+    # fuzz-found (round 3): the two-round solve's second round re-encodes
+    # existing nodes, so round-1 placements on REAL existing nodes must be
+    # carried (used + origin-keyed counts) or round 2 overcommits them.
+    # Here round 1 fills the only affinity-anchored node to the brim; the
+    # deferred pod (hostname affinity to app=a) no longer fits and must be
+    # unschedulable on BOTH paths - not placed into phantom capacity.
+    from karpenter_tpu.models.pod import PodAffinityTerm
+
+    filler = [make_pod(f"fill-{i}", cpu="1500m", memory="1Gi",
+                       labels=(("app", "a"),)) for i in range(4)]
+    dependent = make_pod("dep", cpu="2", memory="1Gi", labels=(("app", "b"),),
+                         pod_affinity=(PodAffinityTerm(
+                             match_labels=(("app", "a"),),
+                             topology_key=wk.LABEL_HOSTNAME),))
+    anchor = make_pod("res-a", cpu="500m", memory="512Mi",
+                      labels=(("app", "a"),))
+    # 8-cpu node: resident 0.5 + fillers 6.0 = 6.5 used; dep needs 2 > 1.5
+    existing = [ExistingNode(
+        name="node-a",
+        labels={wk.LABEL_ARCH: "amd64", wk.LABEL_OS: "linux",
+                wk.LABEL_ZONE: "zone-1a", wk.LABEL_CAPACITY_TYPE: "on-demand"},
+        allocatable=wk.capacity_vector({wk.RESOURCE_CPU: 8000,
+                                        wk.RESOURCE_MEMORY: 32 * 2**30,
+                                        wk.RESOURCE_PODS: 110}),
+        used=wk.resource_vector({wk.RESOURCE_CPU: 500,
+                                 wk.RESOURCE_MEMORY: 512 * 2**20,
+                                 wk.RESOURCE_PODS: 1}),
+        resident=(anchor,),
+    )]
+    # the catalog's only zone-1a-capable small types can't host dep either
+    # way; the point is parity on the existing-node accounting
+    res = assert_parity(catalog5(), [prov()], filler + [dependent],
+                        existing=existing)
+    assert res.existing_counts.get("node-a", 0) == 4  # fillers only
+    assert res.unschedulable_count() == 1  # dep: anchor node is full
