@@ -406,3 +406,92 @@ class TestCoordinationRaces:
         assert len(leaders) == 1
         lease = kube.get("leases", electors[0].name)
         assert lease is not None and lease.holder == leaders[0].identity
+
+
+# -- extended parity fuzz: the round-3 semantics space -----------------------------
+# (affinity terms x residents x soft/hard spread x existing nodes; the deep
+# offline session that found the round-2 overcommit ran this generator at
+# 3900 cases — this keeps the space covered in-tree)
+
+rich_group_strategy = st.builds(
+    dict,
+    app=st.sampled_from(["a", "b", "c"]),
+    cpu=st.sampled_from(["100m", "500m", "1", "2"]),
+    memory=st.sampled_from(["128Mi", "1Gi", "4Gi"]),
+    count=st.integers(min_value=1, max_value=5),
+    aa_host=st.booleans(),
+    spread=st.sampled_from(["", "DoNotSchedule", "ScheduleAnyway"]),
+    zone=st.sampled_from(["", "zone-1a", "zone-1b"]),
+    term=st.sampled_from(["", "aff-zone", "aff-host", "anti-zone", "anti-host"]),
+    term_app=st.sampled_from(["a", "b", "c"]),
+)
+
+resident_strategy = st.builds(
+    dict,
+    zone=st.sampled_from(["zone-1a", "zone-1b", "zone-1c"]),
+    apps=st.lists(st.sampled_from(["a", "b", "c"]), max_size=3),
+)
+
+
+@settings(max_examples=15, deadline=None)
+@given(st.lists(rich_group_strategy, min_size=1, max_size=3),
+       st.lists(resident_strategy, max_size=2))
+def test_fuzz_parity_affinity_residents_space(groups, nodes):
+    from karpenter_tpu.models.pod import PodAffinityTerm
+    from karpenter_tpu.oracle.scheduler import ExistingNode
+    from karpenter_tpu.solver.core import NativeSolver
+
+    pods = []
+    for gi, g in enumerate(groups):
+        kw = {}
+        if g["aa_host"]:
+            kw["anti_affinity_hostname"] = True
+        if g["spread"]:
+            kw["topology"] = (TopologySpreadConstraint(
+                max_skew=1, topology_key=wk.LABEL_ZONE,
+                when_unsatisfiable=g["spread"]),)
+        if g["term"]:
+            mode, key = g["term"].split("-")
+            term = PodAffinityTerm(
+                match_labels=(("app", g["term_app"]),),
+                topology_key=wk.LABEL_ZONE if key == "zone" else wk.LABEL_HOSTNAME)
+            kw["pod_affinity" if mode == "aff" else "pod_anti_affinity"] = (term,)
+        sel = {wk.LABEL_ZONE: g["zone"]} if g["zone"] else {}
+        for i in range(g["count"]):
+            pods.append(make_pod(f"g{gi}-{i}", cpu=g["cpu"], memory=g["memory"],
+                                 labels=(("app", g["app"]),),
+                                 node_selector=dict(sel), **kw))
+
+    def mk_existing():
+        out = []
+        for ei, n in enumerate(nodes):
+            res = tuple(make_pod(f"res{ei}-{ri}", cpu="500m", memory="1Gi",
+                                 labels=(("app", app),), node_name=f"ex-{ei}")
+                        for ri, app in enumerate(n["apps"]))
+            used = [0] * wk.NUM_RESOURCES
+            for p in res:
+                for i, v in enumerate(p.resource_vector()):
+                    used[i] += v
+            out.append(ExistingNode(
+                name=f"ex-{ei}",
+                labels={wk.LABEL_ARCH: "amd64", wk.LABEL_OS: "linux",
+                        wk.LABEL_ZONE: n["zone"],
+                        wk.LABEL_CAPACITY_TYPE: "on-demand"},
+                allocatable=wk.capacity_vector({wk.RESOURCE_CPU: 8000,
+                                                wk.RESOURCE_MEMORY: 32 * 2**30,
+                                                wk.RESOURCE_PODS: 110}),
+                used=list(used), resident=res))
+        return out
+
+    cat = battletest_catalog()
+    prov = Provisioner(name="default", requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+    prov.set_defaults()
+    sched = Scheduler(cat, [prov])
+    o = sched.schedule(list(pods), existing=mk_existing())
+    k = TPUSolver(cat, [prov]).solve(list(pods), existing=mk_existing())
+    n = NativeSolver(cat, [prov]).solve(list(pods), existing=mk_existing())
+    o_ex = {kk: len(v) for kk, v in o.existing_assignments.items() if v}
+    assert o.node_decisions(sched.options) == k.decisions() == n.decisions()
+    assert o_ex == k.existing_counts == n.existing_counts
+    assert len(o.unschedulable) == k.unschedulable_count() == n.unschedulable_count()
