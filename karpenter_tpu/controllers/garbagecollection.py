@@ -45,6 +45,9 @@ class GarbageCollectionController:
         self.retired = reg.counter(
             f"{NAMESPACE}_garbage_collected_machines_total",
             "Machines retired because their cloud instance vanished.")
+        # machine name -> first sweep timestamp at which its instance was
+        # absent from the cluster listing (inverse-direction grace window)
+        self._missing_since: "dict[str, float]" = {}
 
     def reconcile_once(self) -> "list[str]":
         """One sweep; returns the terminated instance ids. One cluster-tag
@@ -89,7 +92,16 @@ class GarbageCollectionController:
         retired through the normal drain path — its pods are dead anyway
         and reschedule onto live capacity (reference analogue: the
         cloud-node-lifecycle deletion of NotReady nodes whose instance
-        disappeared)."""
+        disappeared).
+
+        Absence must be *confirmed*: the instance listing is eventually
+        consistent and snapshotted at sweep start, so a machine whose
+        instance launched mid-sweep would look vanished for one pass. A
+        machine is only retired once its instance has been absent from the
+        listing continuously for grace_seconds (missing-since window — the
+        inverse analogue of the forward direction's launch_time grace)."""
+        now = self.clock.now()
+        seen_missing = set()
         for m in self.kube.machines():
             pid = m.status.provider_id
             if not pid:
@@ -99,7 +111,12 @@ class GarbageCollectionController:
             except ValueError:
                 continue
             if iid in present:
+                self._missing_since.pop(m.name, None)
                 continue
+            seen_missing.add(m.name)
+            first = self._missing_since.setdefault(m.name, now)
+            if now - first < self.grace_seconds:
+                continue  # not yet confirmed absent; listing may be stale
             node = None
             if self.cluster is not None:
                 node = next((n for n in self.cluster.nodes.values()
@@ -107,11 +124,17 @@ class GarbageCollectionController:
             if node is not None and self.termination is not None:
                 if self.termination.request_deletion(node.name):
                     self.retired.inc()
+                    self._missing_since.pop(m.name, None)
                     log.info("retiring machine %s: instance %s vanished",
                              m.name, iid)
             else:
                 # no node joined (died between launch and registration)
                 self.kube.delete("machines", m.name)
                 self.retired.inc()
+                self._missing_since.pop(m.name, None)
                 log.info("deleted machine %s: instance %s vanished before "
                          "registration", m.name, iid)
+        # forget machines that disappeared from the store on their own
+        for name in list(self._missing_since):
+            if name not in seen_missing:
+                del self._missing_since[name]

@@ -60,6 +60,7 @@ def load_kubeconfig(path: str) -> "tuple[str, Optional[str], object]":
     client-certificate(-data)/client-key(-data), and
     insecure-skip-tls-verify."""
     import base64
+    import os
     import tempfile
 
     import yaml
@@ -88,14 +89,19 @@ def load_kubeconfig(path: str) -> "tuple[str, Optional[str], object]":
         cert_data = user.get("client-certificate-data")
         key_data = user.get("client-key-data")
         if cert_data and key_data:
-            # ssl wants file paths; decode the inline pair to a temp bundle
+            # ssl wants file paths; decode the inline pair to a temp bundle,
+            # and unlink it as soon as the context has loaded it — private
+            # key material must not outlive this call on disk (ADVICE r3)
             bundle = tempfile.NamedTemporaryFile(
                 mode="w", suffix=".pem", delete=False)
-            bundle.write(base64.b64decode(cert_data).decode())
-            bundle.write("\n")
-            bundle.write(base64.b64decode(key_data).decode())
-            bundle.close()
-            ssl_ctx.load_cert_chain(bundle.name)
+            try:
+                bundle.write(base64.b64decode(cert_data).decode())
+                bundle.write("\n")
+                bundle.write(base64.b64decode(key_data).decode())
+                bundle.close()
+                ssl_ctx.load_cert_chain(bundle.name)
+            finally:
+                os.unlink(bundle.name)
         elif user.get("client-certificate") and user.get("client-key"):
             ssl_ctx.load_cert_chain(user["client-certificate"],
                                     user["client-key"])
@@ -285,6 +291,18 @@ class HttpKubeStore:
         return self._cache.get(kind, name)
 
     def list(self, kind: str) -> list:
+        if kind not in self.WATCHED_KINDS:
+            # unwatched kinds (events) never enter the informer cache, so a
+            # cache read would always be empty — and Operator's event prune
+            # would never see orphaned evt-* objects from crashed replicas.
+            # Serve these with a direct LIST instead (ADVICE r3).
+            doc = self._request_json("GET", self._url(kind))
+            out = []
+            for item in doc.get("items", []):
+                obj = serde.from_manifest(kind, item)
+                if obj is not None:
+                    out.append(obj)
+            return out
         return self._cache.list(kind)
 
     def create(self, kind: str, name: str, obj) -> None:

@@ -146,6 +146,29 @@ def to_manifest(kind: str, name: str, obj) -> dict:
         })
         if stamp:
             doc["lastTimestamp"] = stamp
+    if kind == "leases" and type(obj).__name__ == "Lease":
+        # native coordination.k8s.io/v1 spec: a real apiserver prunes the
+        # embedded-model field on built-in types, and a lease that reads back
+        # empty looks permanently expired — two controllers would both elect
+        # themselves (HA safety). RFC3339 MicroTime like client-go writes.
+        import datetime
+
+        def _stamp(ts: float) -> "Optional[str]":
+            if not ts:
+                return None
+            return datetime.datetime.fromtimestamp(
+                ts, tz=datetime.timezone.utc).strftime(
+                    "%Y-%m-%dT%H:%M:%S.%fZ")
+
+        spec = {
+            "holderIdentity": obj.holder,
+            "leaseDurationSeconds": int(obj.duration_s),
+        }
+        if _stamp(obj.acquired_ts):
+            spec["acquireTime"] = _stamp(obj.acquired_ts)
+        if _stamp(obj.renew_ts):
+            spec["renewTime"] = _stamp(obj.renew_ts)
+        doc["spec"] = spec
     if kind == "pods" and isinstance(obj, PodSpec):
         # surface the schedulable basics in real schema; exact model embedded
         doc["metadata"]["labels"] = dict(obj.labels)
@@ -210,11 +233,18 @@ def _parse_k8s(kind: str, doc: dict):
                     break
                 except ValueError:
                     continue
-        return {"ts": ts, "kind": doc.get("type", "Normal"),
-                "reason": doc.get("reason", ""),
-                "object_ref": f"{ref.get('kind', '').lower()}/"
-                              f"{ref.get('name', '')}",
-                "message": doc.get("message", "")}
+        out = {"ts": ts, "kind": doc.get("type", "Normal"),
+               "reason": doc.get("reason", ""),
+               "object_ref": f"{ref.get('kind', '').lower()}/"
+                             f"{ref.get('name', '')}",
+               "message": doc.get("message", "")}
+        # keep the store name: a pruning apiserver strips the embedded model
+        # from our own evt-* events, and the restart prune sweep
+        # (Operator._prune_stored_events) can only delete what it can name
+        name = (doc.get("metadata") or {}).get("name")
+        if name:
+            out["name"] = name
+        return out
     # foreign object of a controller-owned kind (e.g. a Machine authored by
     # another tool): not ours to interpret — callers skip None
     return None

@@ -147,19 +147,33 @@ class Operator:
     def _persist_event(self, ts: float, event) -> None:
         """Recorded events become Event objects in the coordination plane
         (`kubectl get events` parity); retention is bounded by deleting the
-        oldest beyond MAX_STORED_EVENTS. Serialized: the recorder is shared
-        across every controller thread, and a torn seq would mint colliding
-        names (the losing create's Conflict silently dropping the event)."""
+        oldest beyond MAX_STORED_EVENTS. Only the name mint and retention
+        bookkeeping are serialized — the recorder is shared across every
+        controller thread, and a torn seq would mint colliding names — but
+        the store I/O happens OUTSIDE the lock: over HttpKubeStore each
+        create is a synchronous apiserver round-trip, and holding the lock
+        across it would serialize every event-emitting controller thread
+        behind a slow apiserver (ADVICE r3)."""
         with self._event_lock:
             self._event_seq += 1
             name = f"evt-{self._event_suffix}-{self._event_seq:07d}"
+            self._event_names.append(name)
+            evict = []
+            while len(self._event_names) > self.MAX_STORED_EVENTS:
+                evict.append(self._event_names.popleft())
+        try:
             self.kube.create("events", name, {
                 "name": name, "ts": ts, "kind": event.kind,
                 "reason": event.reason, "object_ref": event.object_ref,
                 "message": event.message})
-            self._event_names.append(name)
-            while len(self._event_names) > self.MAX_STORED_EVENTS:
-                self.kube.delete("events", self._event_names.popleft())
+        finally:
+            # evicted names left the deque above; delete them even when the
+            # create blips, else they leak until a restart's prune sweep
+            for old in evict:
+                try:
+                    self.kube.delete("events", old)
+                except Exception as e:
+                    log.warning("event retention delete %s failed: %s", old, e)
 
     def _prune_stored_events(self) -> None:
         """Crash-restart hygiene: a replica that died left its evt-* objects

@@ -677,6 +677,11 @@ class TestGarbageCollection:
 
         _, iid = parse_provider_id(node.provider_id)
         op.cloudprovider.instances.delete(iid)  # vanishes out-of-band
+        # first sweep only *observes* the absence — the listing is eventually
+        # consistent, so retirement needs the missing-since window to elapse
+        assert op.garbagecollection.reconcile_once() == []
+        assert not op.cluster.nodes[node_name].marked_for_deletion
+        op.clock.step(op.garbagecollection.grace_seconds + 1)
         assert op.garbagecollection.reconcile_once() == []
         assert op.cluster.nodes[node_name].marked_for_deletion
         op.termination.reconcile_once()
@@ -685,7 +690,8 @@ class TestGarbageCollection:
 
     def test_vanished_preregistration_machine_deleted(self, op):
         # machine launched, instance died before any node joined: the
-        # machine object itself is GC'd (no node to drain)
+        # machine object itself is GC'd (no node to drain) — but only after
+        # absence is confirmed across the grace window
         from karpenter_tpu.models.machine import Machine, MachineSpec, MachineStatus
 
         add_provisioner(op)
@@ -693,7 +699,35 @@ class TestGarbageCollection:
                     status=MachineStatus(provider_id="tpu:///zone-1a/i-gone"))
         op.kube.create("machines", "ghost", m)
         op.garbagecollection.reconcile_once()
+        assert op.kube.get("machines", "ghost") is not None  # window open
+        op.clock.step(op.garbagecollection.grace_seconds + 1)
+        op.garbagecollection.reconcile_once()
         assert op.kube.get("machines", "ghost") is None
+
+    def test_just_launched_machine_survives_stale_listing(self, op):
+        # ADVICE r3 (high): a machine whose instance launched AFTER the
+        # sweep's instance listing must not be torn down. Simulated by a
+        # listing race: the instance is absent at sweep N, present again by
+        # sweep N+1 — the missing-since entry resets and nothing is retired.
+        from karpenter_tpu.models.machine import Machine, MachineSpec, MachineStatus
+
+        add_provisioner(op)
+        m = Machine(name="young", spec=MachineSpec(provisioner_name="default"),
+                    status=MachineStatus(provider_id="tpu:///zone-1a/i-late"))
+        op.kube.create("machines", "young", m)
+        op.garbagecollection.reconcile_once()  # observes absence, starts window
+        # the launch write lands (eventual consistency catches up)
+        from karpenter_tpu.fake.cloud import CloudInstance
+        from karpenter_tpu.providers.instance import TAG_CLUSTER
+        op.cloudprovider.cloud.instances["i-late"] = CloudInstance(
+            id="i-late", instance_type="t.small", zone="zone-1a",
+            capacity_type="on-demand", tags={TAG_CLUSTER: "itest"},
+            launch_time=op.clock.now())
+        op.clock.step(op.garbagecollection.grace_seconds + 1)
+        op.garbagecollection.reconcile_once()
+        assert op.kube.get("machines", "young") is not None
+        # and the window restarts from scratch if it vanishes again later
+        assert "young" not in op.garbagecollection._missing_since
 
 
 class TestEventObjects:

@@ -220,6 +220,25 @@ class TestSerde:
         m2 = serde.from_manifest("machines", doc)
         assert m2 == m
 
+    def test_lease_survives_model_field_pruning(self):
+        # ADVICE r3 (medium): a real apiserver prunes unknown fields on
+        # built-in types, stripping x-karpenter-model from Leases. The
+        # manifest must carry the real coordination.k8s.io/v1 spec so the
+        # round-trip doesn't read back holder=""/renew_ts=0 (= always
+        # expired => two concurrent leaders).
+        from karpenter_tpu.leaderelection import Lease
+
+        doc = serde.to_manifest("leases", "karpenter-leader",
+                                Lease("replica-a", 100.0, 250.0, 15))
+        json.dumps(doc)
+        doc.pop(serde.MODEL_KEY)  # what a pruning apiserver does
+        back = serde.from_manifest("leases", doc)
+        assert back.holder == "replica-a"
+        assert back.duration_s == 15.0
+        assert abs(back.acquired_ts - 100.0) < 1e-3
+        assert abs(back.renew_ts - 250.0) < 1e-3
+        assert not back.expired(now=260.0)  # held, not falsely expired
+
     def test_statenode_pods_are_runtime_only(self):
         from karpenter_tpu.models.cluster import StateNode
         from karpenter_tpu.apis import wellknown as wk
@@ -269,6 +288,28 @@ class TestReviewHardening:
         store.start()  # must not raise on the uninterpretable machine
         assert store.machines() == []  # visible server-side, not cached
         store.stop()
+
+    def test_events_list_goes_direct_not_cache(self, api):
+        # ADVICE r3 (medium): events are unwatched, so list("events") must
+        # LIST the server directly — otherwise orphaned evt-* objects from
+        # crashed replicas are invisible to Operator._prune_stored_events
+        # and accumulate forever.
+        base, _ = api
+        a = HttpKubeStore(base)
+        a.start()
+        a.create("events", "evt-dead-0000001", {
+            "name": "evt-dead-0000001", "ts": 1.0, "kind": "Normal",
+            "reason": "Launched", "object_ref": "machine/m1",
+            "message": "from a replica that crashed"})
+        a.stop()
+        b = HttpKubeStore(base)  # fresh replica, no watch needed
+        b.start()
+        try:
+            listed = b.list("events")
+            assert [e["name"] for e in listed if isinstance(e, dict)
+                    and e.get("name")] == ["evt-dead-0000001"]
+        finally:
+            b.stop()
 
     def test_delete_if_respects_server_side_precondition(self, api):
         base, state = api
