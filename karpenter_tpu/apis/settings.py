@@ -41,6 +41,10 @@ class Settings:
     enable_eni_limited_pod_density: bool = True
     isolated_vpc: bool = False
     interruption_queue_name: str = ""
+    # how nodes are named at registration (settings.go:29-47): "ip-name"
+    # (default) = the instance's private DNS name; "resource-name" = the
+    # cloud instance id
+    node_name_convention: str = "ip-name"
     tags: "dict[str, str]" = dataclasses.field(default_factory=dict)
     # core provisioning batch windows (settings.md:43-47,81-99)
     batch_idle_duration: float = 1.0
@@ -58,6 +62,9 @@ class Settings:
             raise SettingsError("vmMemoryOverheadPercent must be >= 0")
         if self.batch_idle_duration < 0 or self.batch_max_duration < self.batch_idle_duration:
             raise SettingsError("batchMaxDuration must be >= batchIdleDuration >= 0")
+        if self.node_name_convention not in ("ip-name", "resource-name"):
+            raise SettingsError(
+                "nodeNameConvention must be ip-name or resource-name")
         for key in self.tags:
             if key.startswith("karpenter.sh/") or key.startswith("kubernetes.io/cluster"):
                 raise SettingsError(f"restricted tag key: {key}")
@@ -117,6 +124,7 @@ class Settings:
             enable_eni_limited_pod_density=flag("enableENILimitedPodDensity", True),
             isolated_vpc=flag("isolatedVPC"),
             interruption_queue_name=data.get("interruptionQueueName", ""),
+            node_name_convention=data.get("nodeNameConvention", "ip-name"),
             tags=tags,
             batch_idle_duration=dur("batchIdleDuration", 1.0),
             batch_max_duration=dur("batchMaxDuration", 10.0),

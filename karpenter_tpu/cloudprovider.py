@@ -213,6 +213,15 @@ class CloudProvider:
             if cap is not None:
                 pi = wk.RESOURCE_INDEX[wk.RESOURCE_PODS]
                 alloc[pi] = min(alloc[pi], cap)
+        # nodeNameConvention (settings.go:29-47; instanceToMachine
+        # cloudprovider.go:344-348): the name the node registers with —
+        # resource-name = the instance id, ip-name (default) = the
+        # lowercased private DNS name (falling back to the instance id for
+        # backends that don't surface one)
+        if self.settings.node_name_convention == "resource-name":
+            node_name = instance.id
+        else:
+            node_name = (getattr(instance, "private_dns", "") or instance.id).lower()
         machine.status = MachineStatus(
             provider_id=make_provider_id(instance.zone, instance.id),
             instance_type=instance.instance_type,
@@ -222,6 +231,7 @@ class CloudProvider:
             capacity=dict(itype.capacity) if itype else {},
             allocatable=wk.raw_resources_from_vector(alloc) if itype else {},
             state=LAUNCHED,
+            node_name=node_name,
             price=price or 0.0,
         )
         return machine

@@ -122,11 +122,17 @@ class GarbageCollectionController:
                 node = next((n for n in self.cluster.nodes.values()
                              if n.machine_name == m.name), None)
             if node is not None and self.termination is not None:
-                if self.termination.request_deletion(node.name):
+                # only a mark WE created counts as a GC retirement: a node
+                # already marked (by us last sweep while it drains, or by an
+                # unrelated emptiness/expiration path) must not re-increment
+                # the counter every grace window
+                verdict = self.termination.request_deletion(node.name)
+                if verdict == self.termination.MARKED_NEW:
                     self.retired.inc()
-                    self._missing_since.pop(m.name, None)
                     log.info("retiring machine %s: instance %s vanished",
                              m.name, iid)
+                if verdict:
+                    self._missing_since.pop(m.name, None)
             else:
                 # no node joined (died between launch and registration)
                 self.kube.delete("machines", m.name)

@@ -33,6 +33,10 @@ class CloudInstance:
     image_id: str = ""
     subnet_id: str = ""
     launch_template: str = ""
+    # private DNS name the node registers with under the default ip-name
+    # convention (settings nodeNameConvention; reference instanceToMachine
+    # lowercases PrivateDnsName, cloudprovider.go:344-348)
+    private_dns: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -179,9 +183,12 @@ class FakeCloud:
                 lt_name = choice.launch_template or request.launch_template
                 lt = self.launch_templates.get(lt_name)
                 for _ in range(request.capacity):
-                    iid = f"i-{next(self._id_counter):08d}"
+                    n = next(self._id_counter)
+                    iid = f"i-{n:08d}"
                     self.instances[iid] = CloudInstance(
                         id=iid,
+                        private_dns=f"ip-10-{(n >> 16) & 255}-{(n >> 8) & 255}"
+                                    f"-{n & 255}.internal",
                         instance_type=choice.instance_type,
                         zone=choice.zone,
                         capacity_type=request.capacity_type,

@@ -24,6 +24,34 @@ from .requirements import Requirement, Requirements, OP_IN
 ANNOTATION_DO_NOT_EVICT = "karpenter.sh/do-not-evict"
 ANNOTATION_POD_DELETION_COST = "controller.kubernetes.io/pod-deletion-cost"
 
+# group-key interning (see PodSpec.group_token). Tokens come from a monotonic
+# counter and are never reused; clearing the table (pathological spec churn)
+# bumps an EPOCH, and stamped tokens from older epochs are re-interned on next
+# read. Invariant: at any instant, token equality <=> group-key equality
+# across all live specs — so group_pods stays a pure function of the pod list
+# (the solver wire protocol depends on client and server deriving identical
+# group partitions from identical pods).
+import itertools as _itertools
+import threading as _threading
+
+_group_key_tokens: "dict[object, int]" = {}
+_group_key_counter = _itertools.count()
+_group_key_epoch = 0
+_group_key_lock = _threading.Lock()
+_GROUP_KEY_TABLE_MAX = 1 << 20
+
+
+def _intern_group_key(key) -> "tuple[int, int]":
+    global _group_key_epoch
+    with _group_key_lock:
+        t = _group_key_tokens.get(key)
+        if t is None:
+            if len(_group_key_tokens) >= _GROUP_KEY_TABLE_MAX:
+                _group_key_tokens.clear()
+                _group_key_epoch += 1
+            t = _group_key_tokens[key] = next(_group_key_counter)
+        return t, _group_key_epoch
+
 
 @dataclasses.dataclass(frozen=True)
 class Toleration:
@@ -163,6 +191,23 @@ class PodSpec:
         object.__setattr__(self, "_group_key", k)
         return k
 
+    def group_token(self) -> int:
+        """Small interned token equivalent to group_key() for dict/set use.
+
+        The group-key tuple nests requirements/tolerations/topology and
+        Python re-hashes it on EVERY dict operation — at 50k pods that
+        hashing alone dominates host encode (bench config 4). The token is
+        interned once per distinct key and memoized per instance, so
+        steady-state grouping costs one attribute read + int-dict op per
+        pod. Token equality is equivalent to key equality — stamps from a
+        cleared table epoch are re-interned (see _intern_group_key)."""
+        cached = self.__dict__.get("_group_token")
+        if cached is not None and cached[1] == _group_key_epoch:
+            return cached[0]
+        t, epoch = _intern_group_key(self.group_key())
+        object.__setattr__(self, "_group_token", (t, epoch))
+        return t
+
 
 def make_pod(
     name: str,
@@ -211,12 +256,15 @@ class PodGroup:
 
 
 def group_pods(pods: "list[PodSpec]") -> "list[PodGroup]":
-    groups: "dict[object, PodGroup]" = {}
+    # int-token keys, not the key tuples: re-hashing the nested tuples per
+    # lookup dominated 50k-pod host encode (see PodSpec.group_token)
+    groups: "dict[int, PodGroup]" = {}
+    get = groups.get
     for p in pods:
-        key = p.group_key()
-        g = groups.get(key)
+        tok = p.group_token()
+        g = get(tok)
         if g is None:
-            groups[key] = PodGroup(spec=p, count=1, pod_names=[p.name])
+            groups[tok] = PodGroup(spec=p, count=1, pod_names=[p.name])
         else:
             g.count += 1
             g.pod_names.append(p.name)

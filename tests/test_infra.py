@@ -141,6 +141,42 @@ class TestSettings:
             Settings.from_dict({"clusterName": "c", "tags.karpenter.sh/x": "y"})
         with pytest.raises(SettingsError):
             Settings.from_dict({"clusterName": "c", "batchIdleDuration": "bogus"})
+        with pytest.raises(SettingsError):
+            Settings.from_dict({"clusterName": "c",
+                                "nodeNameConvention": "hostname"})
+
+    def test_node_name_convention(self):
+        # settings.go:29-47: ip-name (default) names nodes after the
+        # instance's private DNS; resource-name after the instance id
+        from karpenter_tpu.fake.cloud import FakeCloud
+        from karpenter_tpu.models.instancetype import (Catalog,
+                                                       make_instance_type)
+        from karpenter_tpu.models.machine import Machine, MachineSpec
+        from karpenter_tpu.cloudprovider import CloudProvider
+        from karpenter_tpu.apis.nodetemplate import NodeTemplate
+
+        catalog = Catalog(types=[make_instance_type(
+            "t.small", cpu=2, memory="2Gi", od_price=0.05, spot_price=0.02)])
+
+        def launch(convention):
+            s = Settings.from_dict({"clusterName": "c",
+                                    "nodeNameConvention": convention}
+                                   if convention else {"clusterName": "c"})
+            cp = CloudProvider(FakeCloud(catalog=catalog), s, catalog)
+            cp.register_nodetemplate(NodeTemplate(
+                name="default",
+                subnet_selector={"id": "subnet-zone-1a"},
+                security_group_selector={"id": "sg-default"}))
+            m = Machine(name="m1", spec=MachineSpec(
+                provisioner_name="default", machine_template_ref="default"))
+            return cp.create(m).status
+
+        st = launch(None)
+        assert st.node_name.startswith("ip-10-") and st.node_name.endswith(".internal")
+        st = launch("resource-name")
+        assert st.node_name.startswith("i-")
+        _, iid = st.provider_id[len("tpu:///"):].split("/")
+        assert st.node_name == iid
 
 
 class TestBatcherEngine:

@@ -167,3 +167,28 @@ class TestCanonicalFreeze:
         with pytest.raises(RuntimeError):
             p.requirements.add(Requirement.create("c", OP_IN, ["d"]))
         assert p.group_key() == k1
+
+    def test_group_token_matches_group_key_equality(self):
+        from karpenter_tpu.models import pod as pod_mod
+        from karpenter_tpu.models.pod import group_pods, make_pod
+
+        a1 = make_pod("a1", cpu="1", memory="1Gi")
+        a2 = make_pod("a2", cpu="1", memory="1Gi")
+        b = make_pod("b", cpu="2", memory="1Gi")
+        assert a1.group_token() == a2.group_token()  # equal keys, one token
+        assert a1.group_token() != b.group_token()
+        groups = group_pods([a1, a2, b])
+        assert sorted((g.count for g in groups)) == [1, 2]
+        # a table clear bumps the epoch: stamped tokens are re-interned, so
+        # equal-key specs from before and after the clear still land in ONE
+        # group (group_pods stays a pure function of the pod list — the
+        # solver wire protocol's client/server grouping must agree)
+        with pod_mod._group_key_lock:
+            pod_mod._group_key_tokens.clear()
+            pod_mod._group_key_epoch += 1
+        a3 = make_pod("a3", cpu="1", memory="1Gi")
+        regrouped = group_pods([a1, a2, a3, b])
+        assert sorted(g.count for g in regrouped) == [1, 3]
+        assert a1.group_token() == a3.group_token()
+        # and tokens are never numerically reused across epochs
+        assert a1.group_token() != b.group_token()
