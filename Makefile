@@ -11,13 +11,15 @@ presubmit: test verify-entry  ## what CI runs
 test:  ## hermetic suite (8-device virtual CPU mesh)
 	$(PY) -m pytest tests/ -q
 
-battletest:  ## randomized/race tier, shuffled ordering, 3x
+battletest:  ## randomized/race tier: shuffled order (seed logged) + random per-test delay, 3x
 	for i in 1 2 3; do \
-		$(PY) -m pytest tests/test_battletest.py tests/test_packer_parity.py -q -p no:randomly || exit 1; \
+		env KARPENTER_TPU_RANDOMIZE=1 KARPENTER_TPU_TEST_DELAY_MS=10 \
+			$(PY) -m pytest tests/test_battletest.py tests/test_packer_parity.py -q || exit 1; \
 	done
 
-deflake:  ## loop the race tier until it fails
-	while $(PY) -m pytest tests/test_battletest.py -q; do :; done
+deflake:  ## loop the randomized race tier until it fails (fresh seed each round)
+	while env KARPENTER_TPU_RANDOMIZE=1 KARPENTER_TPU_TEST_DELAY_MS=10 \
+		$(PY) -m pytest tests/test_battletest.py -q; do :; done
 
 benchmark:  ## interruption ladder + BASELINE configs, RECORDED + diffed
 	env $(CPU_ENV) $(PY) -m benchmarks.record

@@ -168,17 +168,14 @@ def config_3_consolidation() -> dict:
                        "savings_per_hour": round(action.savings, 4)}}
 
 
-def config_4_stress_50k() -> dict:
-    import jax
-    import numpy as np
-
-    from karpenter_tpu.models.encode import encode_problem
-    from karpenter_tpu.ops.packer import PackInputs
-    from karpenter_tpu.parallel.sharded import make_mesh, sharded_pack
-    from karpenter_tpu.solver.core import _bucket
-
+def stress_problem_50k(n_pods: int = 50_000):
+    """BASELINE.json configs[4] shape, the ONE definition shared by the
+    recorded benchmark (config_4_stress_50k) and the driver's multichip
+    dryrun (__graft_entry__.dryrun_multichip) so the CI parity check can
+    never desynchronize from the benchmarked shape: full 551-type fleet
+    catalog, 8 provisioners with overlapping requirements, 25 deployments.
+    Returns (catalog, provisioners, pods)."""
     catalog = generate_fleet_catalog()
-    # 8 provisioners with overlapping requirements (BASELINE configs[4])
     provisioners = []
     for i, (ct, archs) in enumerate((
             (["on-demand"], ["amd64"]),
@@ -189,17 +186,30 @@ def config_4_stress_50k() -> dict:
             (["spot", "on-demand"], ["amd64", "arm64"]),
             (["on-demand"], ["amd64", "arm64"]),
             (["spot"], ["amd64", "arm64"]))):
-        p = Provisioner(name=f"prov-{i}", weight=len(provisioners),
+        p = Provisioner(name=f"prov-{i}", weight=i,
                         requirements=Requirements.of(
                             (wk.LABEL_CAPACITY_TYPE, OP_IN, ct),
                             (wk.LABEL_ARCH, OP_IN, archs)))
         p.set_defaults()
         provisioners.append(p)
-    pods = []
-    for d in range(25):
-        for i in range(2000):
-            pods.append(make_pod(f"d{d}-p{i}", cpu=f"{250 * (d % 4 + 1)}m",
-                                 memory=f"{512 * (d % 8 + 1)}Mi"))
+    n_dep = 25
+    per = n_pods // n_dep
+    pods = [make_pod(f"d{d}-p{i}", cpu=f"{250 * (d % 4 + 1)}m",
+                     memory=f"{512 * (d % 8 + 1)}Mi")
+            for d in range(n_dep) for i in range(per)]
+    return catalog, provisioners, pods
+
+
+def config_4_stress_50k() -> dict:
+    import jax
+    import numpy as np
+
+    from karpenter_tpu.models.encode import encode_problem
+    from karpenter_tpu.ops.packer import PackInputs
+    from karpenter_tpu.parallel.sharded import make_mesh, sharded_pack
+    from karpenter_tpu.solver.core import _bucket
+
+    catalog, provisioners, pods = stress_problem_50k()
     assert len(pods) == 50_000
 
     from karpenter_tpu.models.encode import build_grid

@@ -1,0 +1,9 @@
+{{/* Resource name — reference analogue: karpenter.fullname */}}
+{{- define "karpenter-tpu.fullname" -}}
+{{ .Values.fullnameOverride | default .Release.Name }}
+{{- end }}
+
+{{/* Solver gRPC endpoint the controller dials (localhost sidecar) */}}
+{{- define "karpenter-tpu.solverEndpoint" -}}
+{{ .Values.solver.host }}:{{ .Values.solver.port }}
+{{- end }}

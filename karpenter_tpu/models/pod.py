@@ -257,15 +257,36 @@ class PodGroup:
 
 def group_pods(pods: "list[PodSpec]") -> "list[PodGroup]":
     # int-token keys, not the key tuples: re-hashing the nested tuples per
-    # lookup dominated 50k-pod host encode (see PodSpec.group_token)
-    groups: "dict[int, PodGroup]" = {}
-    get = groups.get
+    # lookup dominated 50k-pod host encode (see PodSpec.group_token).
+    # Token equality == key equality only WITHIN one table epoch: if the
+    # intern table clears mid-pass (2^20 distinct keys, or a concurrent
+    # thread's clear), a token already used as a dict key here could split
+    # from an equal-key pod interned after the clear. Snapshot the epoch
+    # around the pass and redo it on the (rare) mismatch so the result is
+    # always a single-epoch partition — a pure function of the pod list.
+    # Bounded retries: under epoch churn faster than a pass (many threads
+    # interning disjoint key floods), fall back to grouping by the raw key
+    # tuples — slower, but correct without any epoch assumption.
+    for _ in range(3):
+        epoch_before = _group_key_epoch
+        groups: "dict[int, PodGroup]" = {}
+        get = groups.get
+        for p in pods:
+            tok = p.group_token()
+            g = get(tok)
+            if g is None:
+                groups[tok] = PodGroup(spec=p, count=1, pod_names=[p.name])
+            else:
+                g.count += 1
+                g.pod_names.append(p.name)
+        if _group_key_epoch == epoch_before:
+            return list(groups.values())
+    bykey: "dict[object, PodGroup]" = {}
     for p in pods:
-        tok = p.group_token()
-        g = get(tok)
+        g = bykey.get(p.group_key())
         if g is None:
-            groups[tok] = PodGroup(spec=p, count=1, pod_names=[p.name])
+            bykey[p.group_key()] = PodGroup(spec=p, count=1, pod_names=[p.name])
         else:
             g.count += 1
             g.pod_names.append(p.name)
-    return list(groups.values())
+    return list(bykey.values())

@@ -8,6 +8,53 @@ jax.config. The pin logic is single-sourced in karpenter_tpu/utils/jaxenv.py
 (shared with bench.py and __graft_entry__.py).
 """
 
+import os
+import random
+import time
+
+import pytest
+
 from karpenter_tpu.utils.jaxenv import pin_cpu
 
 pin_cpu(8)
+
+# Randomized tier (reference analogue: Makefile:65-72 battletest =
+# --ginkgo.randomize-all + -tags random_test_delay). pytest-randomly is not
+# in the image, so the shuffle lives here: KARPENTER_TPU_RANDOMIZE=1
+# shuffles the collected test order with a logged, reproducible seed
+# (KARPENTER_TPU_TEST_SEED pins it for replay), and
+# KARPENTER_TPU_TEST_DELAY_MS=N sleeps a random 0..N ms before every test —
+# the random_test_delay build-tag analogue that perturbs thread interleaving
+# in the race tier.
+
+def _randomize_enabled() -> bool:
+    return os.environ.get("KARPENTER_TPU_RANDOMIZE") == "1"
+
+
+def pytest_configure(config):
+    if _randomize_enabled():
+        config._karpenter_seed = int(
+            os.environ.get("KARPENTER_TPU_TEST_SEED", 0)) or \
+            random.SystemRandom().randrange(1, 2**31)
+
+
+def pytest_collection_modifyitems(config, items):
+    seed = getattr(config, "_karpenter_seed", None)
+    if seed is not None:
+        random.Random(seed).shuffle(items)
+
+
+def pytest_report_header(config):
+    seed = getattr(config, "_karpenter_seed", None)
+    if seed is not None:
+        return (f"randomized order: seed={seed} "
+                f"(replay: KARPENTER_TPU_TEST_SEED={seed})")
+    return None
+
+
+@pytest.fixture(autouse=True)
+def random_test_delay():
+    delay_ms = int(os.environ.get("KARPENTER_TPU_TEST_DELAY_MS", "0"))
+    if delay_ms:
+        time.sleep(random.random() * delay_ms / 1000.0)
+    yield

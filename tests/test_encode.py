@@ -93,3 +93,31 @@ def test_encode_feas_matches_oracle_feasible_options():
         want = feasible_options(pod, prov, flat, [0] * wk.NUM_RESOURCES)
         got = set(np.nonzero(enc.group_feas[0, 0].reshape(-1))[0].tolist())
         assert got == want
+
+
+def test_group_pods_survives_intern_table_epoch_churn():
+    """A mid-pass intern-table clear must not split equal-key pods into two
+    groups (token==key only holds within one epoch), and pathological churn
+    (table too small for the pass's keys) must terminate via the raw-key
+    fallback with the identical partition."""
+    import karpenter_tpu.models.pod as podmod
+    from karpenter_tpu.models.pod import group_pods
+
+    pods = [make_pod(f"q{i}", cpu="500m", memory="128Mi") for i in range(20)] \
+        + [make_pod(f"r{i}", cpu="250m", memory="64Mi") for i in range(20)]
+    want = sorted(g.count for g in group_pods(pods))
+    assert want == [20, 20]
+
+    saved = podmod._GROUP_KEY_TABLE_MAX
+    try:
+        podmod._GROUP_KEY_TABLE_MAX = 1  # every new intern clears + re-epochs
+        with podmod._group_key_lock:
+            podmod._group_key_tokens.clear()
+            podmod._group_key_epoch += 1
+        for p in pods:
+            p.__dict__.pop("_group_token", None)
+        got = group_pods(pods)
+        assert sorted(g.count for g in got) == want
+        assert len(got) == 2
+    finally:
+        podmod._GROUP_KEY_TABLE_MAX = saved

@@ -1,0 +1,90 @@
+"""Chart parity: `helmless render` (the in-repo `helm template` subset —
+the image has no helm binary) at DEFAULT values must reproduce the static
+manifests in deploy/ byte-for-byte, and overrides must actually steer the
+render (VERDICT r3 ask #7; reference analogue: charts/karpenter with
+values.yaml:134-142, plus the split charts/karpenter-crd)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "hack"))
+
+from helmless import Renderer, _parse_set  # noqa: E402
+
+CHART = os.path.join(REPO, "charts", "karpenter-tpu")
+CRD_CHART = os.path.join(REPO, "charts", "karpenter-tpu-crd")
+DEPLOY = os.path.join(REPO, "deploy", "karpenter-tpu")
+
+
+def test_default_render_matches_static_manifests_byte_for_byte():
+    docs = Renderer(CHART).render()
+    static = sorted(f for f in os.listdir(DEPLOY) if f.endswith(".yaml"))
+    assert sorted(docs) == static
+    for name in static:
+        with open(os.path.join(DEPLOY, name)) as f:
+            want = f.read()
+        assert docs[name] == want, f"{name} render drifted from deploy/"
+
+
+def test_crd_chart_matches_deploy_crds():
+    docs = Renderer(CRD_CHART).render()
+    crd_dir = os.path.join(REPO, "deploy", "crds")
+    static = sorted(os.listdir(crd_dir))
+    assert sorted(docs) == static
+    for name in static:
+        with open(os.path.join(crd_dir, name)) as f:
+            assert docs[name] == f.read()
+
+
+def test_every_render_is_valid_yaml_with_expected_kinds():
+    docs = Renderer(CHART).render()
+    kinds = set()
+    for body in docs.values():
+        for doc in yaml.safe_load_all(body):
+            assert doc and doc.get("kind")
+            kinds.add(doc["kind"])
+    assert {"Deployment", "Service", "ConfigMap", "PodDisruptionBudget",
+            "ServiceAccount", "ClusterRole", "ClusterRoleBinding",
+            "ValidatingWebhookConfiguration",
+            "MutatingWebhookConfiguration", "ServiceMonitor"} <= kinds
+
+
+def test_overrides_steer_the_render():
+    docs = Renderer(CHART, _parse_set([
+        "replicas=3", "leaderElect=false", "controller.metricsPort=9090",
+        "solver.port=6000", "serviceMonitor.enabled=false",
+    ])).render()
+    dep = yaml.safe_load(docs["deployment.yaml"])
+    assert dep["spec"]["replicas"] == 3
+    ctrl = dep["spec"]["template"]["spec"]["containers"][0]
+    assert "--leader-elect" not in ctrl["args"]
+    assert "127.0.0.1:6000" in ctrl["args"]
+    svc = yaml.safe_load(docs["service.yaml"])
+    assert svc["spec"]["ports"][0]["port"] == 9090
+    assert "servicemonitor.yaml" not in docs  # empty renders are dropped
+    cm = yaml.safe_load(docs["settings.yaml"])
+    assert cm["data"]["solverEndpoint"] == "127.0.0.1:6000"
+
+
+def test_namespace_and_fullname_flow_through():
+    docs = Renderer(CHART, {"fullnameOverride": "kp"},
+                    namespace="kube-system").render()
+    dep = yaml.safe_load(docs["deployment.yaml"])
+    assert dep["metadata"]["name"] == "kp"
+    assert dep["metadata"]["namespace"] == "kube-system"
+    wh = list(yaml.safe_load_all(docs["webhooks.yaml"]))
+    assert wh[0]["webhooks"][0]["clientConfig"]["service"]["namespace"] == \
+        "kube-system"
+
+
+def test_cli_render_runs():
+    r = subprocess.run([sys.executable, os.path.join(REPO, "hack", "helmless.py"),
+                        "render", CHART, "--set", "replicas=1"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "kind: Deployment" in r.stdout
