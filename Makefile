@@ -30,6 +30,10 @@ bench:  ## the headline one-line benchmark (real TPU when present)
 e2e:  ## E2E-analogue scenario suites only
 	$(PY) -m pytest tests/test_e2e_scenarios.py tests/test_controllers.py -q
 
+foreigntest:  ## wire-compat tier against a real kube-apiserver (fetches envtest)
+	bash hack/fetch_envtest.sh || true  # offline: the tier skips on absent binaries
+	$(PY) -m pytest tests/test_foreign_apiserver.py -q
+
 docs:  ## regenerate generated docs (metrics/settings/instance-types)
 	env $(CPU_ENV) $(PY) hack/gen_docs.py all
 

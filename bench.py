@@ -46,6 +46,15 @@ def _emit(value, vs, detail, exit_code=None, degraded=False):
         "value": value,
         "unit": "ms",
         "vs_baseline": vs,
+        # round-over-round comparability (VERDICT r3 ask #8): the measured
+        # backend plus BOTH curves at top level, so BENCH_r{N}.json diffs
+        # against r{N-1} without digging through detail history. onchip_ms
+        # is this run's device p50 when the backend is the TPU, else the
+        # freshest recorded capture's.
+        "backend": detail.get("backend"),
+        "native_routed_ms": detail.get("routed_native_p50_ms"),
+        "onchip_ms": (value if detail.get("backend") == "tpu" else
+                      (detail.get("latest_tpu_capture") or {}).get("p50_ms")),
         "detail": detail,
     }
     if degraded:

@@ -50,7 +50,6 @@ class ConsolidationBatch:
     candidates: "list[tuple[StateNode, ...]]"  # one SET per lane (singles or pairs)
     provisioners: "list[Provisioner]"
     grid: OptionGrid
-    n_groups: "list[int]"
 
 
 def encode_consolidation(
@@ -130,7 +129,6 @@ def encode_consolidation(
     # budget (identity for padded/unsplit rows — see encode_problem)
     group_origin = np.broadcast_to(
         np.arange(Gb, dtype=np.int32), (C, Gb)).copy()
-    n_groups = []
 
     # label/taint fit of a pod-group against an existing node, memoized: the
     # same group spec recurs across many candidates in a homogeneous cluster
@@ -152,7 +150,6 @@ def encode_consolidation(
     feas_cache: "dict[tuple, tuple]" = {}
     ex_cap_arr = None  # [C, Gb, Ne] remaining caps; built on first capped group
     for ci, (cand, cheaper_opt, groups, survivors) in enumerate(per_cand):
-        n_groups.append(len(groups))
         res_by_name = {e.name: e.resident_counts for e in survivors}
         first_by_origin: "dict[object, int]" = {}
         for gi, g in enumerate(groups):
@@ -204,7 +201,7 @@ def encode_consolidation(
         prov_overhead=prov_overhead, prov_pods_cap=prov_pods_cap,
         ex_cap=ex_cap_arr, group_origin=group_origin,
     )
-    return ConsolidationBatch(inputs, candidates, provs, grid, n_groups)
+    return ConsolidationBatch(inputs, candidates, provs, grid)
 
 
 @functools.partial(jax.jit, static_argnames=("n_slots",))
@@ -220,14 +217,28 @@ def _batched_pack(inputs: PackInputs, n_slots: int):
     return jax.vmap(lambda inp: pack_impl(inp, n_slots), in_axes=(axes,))(inputs)
 
 
-def _decode_actions(batch: ConsolidationBatch, result, now: float
+@functools.partial(jax.jit, static_argnames=("n_slots",))
+def _batched_pack_verdicts(inputs: PackInputs, n_slots: int):
+    """The batched pack reduced ON DEVICE to the [C, 3] verdict table the
+    action decoder actually reads: (total unschedulable, nodes opened,
+    decided option of slot 0). The full PackResult for C=500 lanes is
+    megabytes (assign [C,G,N], ex_assign [C,G,Ne]); over a tunneled device
+    every d2h transfer is the latency budget, so the sweep ships ~6KB
+    instead (same discipline as packer.pack_flat — one read per dispatch)."""
+    r = _batched_pack(inputs, n_slots)
+    return jax.numpy.stack(
+        [r.unsched.sum(axis=1), r.n_open, r.decided[:, 0]], axis=1)
+
+
+def _decode_actions(batch: ConsolidationBatch, verdicts, now: float
                     ) -> "list[ConsolidationAction]":
+    """verdicts: [C, 3] host array — (unsched_total, n_open, decided0) per
+    candidate lane (see _batched_pack_verdicts)."""
     actions = []
     for ci, cand in enumerate(batch.candidates):
-        G = batch.n_groups[ci]
-        if result.unsched[ci, :G].sum() > 0:
+        if int(verdicts[ci, 0]) > 0:  # any pod unschedulable in this lane
             continue
-        opened = int(result.n_open[ci])
+        opened = int(verdicts[ci, 1])
         if opened > 1:
             continue
         total_price = sum(n.price for n in cand)
@@ -241,7 +252,7 @@ def _decode_actions(batch: ConsolidationBatch, result, now: float
             actions.append(ConsolidationAction(
                 "delete", names[0], cost, savings=total_price, nodes=names))
             continue
-        flat = int(result.decided[ci, 0])
+        flat = int(verdicts[ci, 2])
         if flat < 0:
             raise AssertionError(
                 f"candidate {names}: open claim slot has no surviving option")
@@ -277,8 +288,9 @@ def run_consolidation(
                                  candidate_filter=candidate_filter)
     if batch is None:
         return None
-    result = jax.device_get(_batched_pack(jax.device_put(batch.inputs), N_SLOTS))
-    actions = _decode_actions(batch, result, now)
+    verdicts = jax.device_get(
+        _batched_pack_verdicts(jax.device_put(batch.inputs), N_SLOTS))
+    actions = _decode_actions(batch, verdicts, now)
     if actions:
         return min(actions, key=ConsolidationAction.sort_key)
     if not multi_node:
@@ -295,9 +307,9 @@ def run_consolidation(
                                       cand_sets=pairs)
     if pair_batch is None:
         return None
-    pair_result = jax.device_get(
-        _batched_pack(jax.device_put(pair_batch.inputs), N_SLOTS))
-    actions = _decode_actions(pair_batch, pair_result, now)
+    pair_verdicts = jax.device_get(
+        _batched_pack_verdicts(jax.device_put(pair_batch.inputs), N_SLOTS))
+    actions = _decode_actions(pair_batch, pair_verdicts, now)
     if not actions:
         return None
     return min(actions, key=ConsolidationAction.sort_key)

@@ -1,0 +1,227 @@
+"""Wire-compat tier against a kube-apiserver THIS REPO DID NOT WRITE.
+
+Self-authored client <-> self-authored server (fake/apiserver.py) can share
+a bug invisibly — field casing, watch semantics, CAS on status. This tier
+boots a real `kube-apiserver` + `etcd` (the envtest control plane,
+fetched by hack/fetch_envtest.sh), applies the deploy/ CRDs and the
+quickstart manifests through plain HTTP, and drives the full controller
+plane through HttpKubeStore until a kubectl-authored pod is BOUND — the
+same done-criterion as the mini-apiserver e2e (test_httpkube.py), now with
+a foreign server on the other side of the socket.
+
+Reference analogue: the envtest tier of
+/root/reference/pkg/cloudprovider/suite_test.go:74-101 (a *real*
+kube-apiserver binary under the unit suite).
+
+Skips cleanly when the binaries are absent (zero-egress environments):
+run `hack/fetch_envtest.sh` or point KUBEBUILDER_ASSETS at them.
+"""
+
+import json
+import os
+import shutil
+import socket
+import ssl
+import subprocess
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOKEN = "envtest-token"
+
+
+def _assets_dir():
+    for cand in (os.environ.get("KUBEBUILDER_ASSETS"),
+                 os.path.join(REPO, "hack", "bin", "envtest")):
+        if cand and os.path.isfile(os.path.join(cand, "kube-apiserver")) \
+                and os.path.isfile(os.path.join(cand, "etcd")):
+            return cand
+    return None
+
+
+ASSETS = _assets_dir()
+pytestmark = pytest.mark.skipif(
+    ASSETS is None,
+    reason="envtest binaries not present (hack/fetch_envtest.sh; offline "
+           "environments skip the foreign-apiserver tier)")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _request(base, path, method="GET", doc=None, timeout=10):
+    req = urllib.request.Request(
+        base + path,
+        None if doc is None else json.dumps(doc).encode(),
+        {"Content-Type": "application/json",
+         "Authorization": f"Bearer {TOKEN}"},
+        method=method)
+    ctx = ssl._create_unverified_context()
+    with urllib.request.urlopen(req, timeout=timeout, context=ctx) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+@pytest.fixture(scope="module")
+def apiserver(tmp_path_factory):
+    """etcd + kube-apiserver on loopback, torn down at module end."""
+    tmp = tmp_path_factory.mktemp("envtest")
+    etcd_port, peer_port, api_port = _free_port(), _free_port(), _free_port()
+
+    etcd = subprocess.Popen(
+        [os.path.join(ASSETS, "etcd"),
+         "--data-dir", str(tmp / "etcd"),
+         "--listen-client-urls", f"http://127.0.0.1:{etcd_port}",
+         "--advertise-client-urls", f"http://127.0.0.1:{etcd_port}",
+         "--listen-peer-urls", f"http://127.0.0.1:{peer_port}"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    # service-account keypair + static token the test authenticates with
+    sa_key, sa_pub = str(tmp / "sa.key"), str(tmp / "sa.pub")
+    subprocess.run(["openssl", "genrsa", "-out", sa_key, "2048"],
+                   check=True, capture_output=True)
+    subprocess.run(["openssl", "rsa", "-in", sa_key, "-pubout", "-out",
+                    sa_pub], check=True, capture_output=True)
+    tokens = tmp / "tokens.csv"
+    tokens.write_text(f"{TOKEN},envtest,envtest-uid,system:masters\n")
+
+    apiserver = subprocess.Popen(
+        [os.path.join(ASSETS, "kube-apiserver"),
+         "--etcd-servers", f"http://127.0.0.1:{etcd_port}",
+         "--secure-port", str(api_port),
+         "--bind-address", "127.0.0.1",
+         "--cert-dir", str(tmp / "certs"),
+         "--service-account-issuer", "https://karpenter-tpu.envtest",
+         "--service-account-key-file", sa_pub,
+         "--service-account-signing-key-file", sa_key,
+         "--token-auth-file", str(tokens),
+         "--authorization-mode", "AlwaysAllow",
+         "--disable-admission-plugins", "ServiceAccount",
+         "--allow-privileged=true"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    base = f"https://127.0.0.1:{api_port}"
+    try:
+        deadline = time.time() + 120
+        last = None
+        while time.time() < deadline:
+            if etcd.poll() is not None or apiserver.poll() is not None:
+                raise RuntimeError("control plane process exited early")
+            try:
+                _request(base, "/readyz", timeout=3)
+                break
+            except (urllib.error.URLError, OSError) as e:
+                last = e
+                time.sleep(1)
+        else:
+            raise RuntimeError(f"kube-apiserver never became ready: {last}")
+        yield base
+    finally:
+        apiserver.terminate()
+        etcd.terminate()
+        apiserver.wait(timeout=30)
+        etcd.wait(timeout=30)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _apply_crds(base):
+    applied = set()
+    for name in sorted(os.listdir(os.path.join(REPO, "deploy", "crds"))):
+        doc = yaml.safe_load(open(os.path.join(REPO, "deploy", "crds", name)))
+        applied.add(doc["metadata"]["name"])
+        try:
+            _request(base, "/apis/apiextensions.k8s.io/v1/"
+                     "customresourcedefinitions", method="POST", doc=doc)
+        except urllib.error.HTTPError as e:
+            if e.code != 409:  # already applied by a previous test
+                raise
+    # wait until every CRD we applied reports Established — the real
+    # apiserver takes a beat to serve new groups
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        ok = set()
+        listing = _request(base, "/apis/apiextensions.k8s.io/v1/"
+                           "customresourcedefinitions")
+        for item in listing.get("items", []):
+            conds = {c["type"]: c["status"]
+                     for c in item.get("status", {}).get("conditions", [])}
+            if conds.get("Established") == "True":
+                ok.add(item["metadata"]["name"])
+        if applied <= ok:
+            return
+        time.sleep(1)
+    raise RuntimeError("CRDs never became Established")
+
+
+def test_kubectl_authored_pod_schedules_against_foreign_apiserver(apiserver):
+    from karpenter_tpu.apis.settings import Settings
+    from karpenter_tpu.coordination.httpkube import HttpKubeStore
+    from karpenter_tpu.fake.cloud import FakeCloud
+    from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+    from karpenter_tpu.operator import Operator
+
+    base = apiserver
+    _apply_crds(base)
+
+    bundle = open(os.path.join(REPO, "examples", "quickstart.yaml")).read() \
+        .replace("${CLUSTER_NAME}", "foreign-test")
+    for doc in yaml.safe_load_all(bundle):
+        if not doc:
+            continue
+        if doc["kind"] == "Provisioner":
+            _request(base, "/apis/karpenter.sh/v1alpha5/provisioners",
+                     method="POST", doc=doc)
+        elif doc["kind"] == "NodeTemplate":
+            _request(base, "/apis/karpenter.k8s.tpu/v1alpha1/nodetemplates",
+                     method="POST", doc=doc)
+    _request(base, "/api/v1/namespaces/default/pods", method="POST", doc={
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "web-0", "labels": {"app": "web"}},
+        "spec": {"containers": [{
+            "name": "c", "image": "registry.example/pause:3.2",
+            "resources": {"requests": {"cpu": "1", "memory": "1Gi"}},
+        }]},
+    })
+
+    cat = Catalog(types=[make_instance_type(
+        "m.large", cpu=4, memory="16Gi", od_price=0.20, spot_price=0.07)])
+    cloud = FakeCloud(cat)
+    for s in cloud.subnets:
+        s.tags.setdefault("karpenter.sh/discovery", "foreign-test")
+    for g in cloud.security_groups:
+        g.tags.setdefault("karpenter.sh/discovery", "foreign-test")
+
+    kube = HttpKubeStore(base, token=TOKEN, verify_tls=False)
+    kube.start()
+    op = None
+    try:
+        assert [p.name for p in kube.provisioners()] == ["default"]
+        assert [p.name for p in kube.pending_pods()] == ["web-0"]
+        settings = Settings(cluster_name="foreign-test",
+                            cluster_endpoint="https://foreign",
+                            batch_idle_duration=0.0, batch_max_duration=0.0)
+        op = Operator(cloud, settings, cat, kube=kube)
+        op.reconcile_all_once()
+
+        # server-side truth from the FOREIGN apiserver, not our cache
+        pod_doc = _request(base, "/api/v1/namespaces/default/pods/web-0")
+        assert pod_doc["spec"].get("nodeName"), "pod not bound server-side"
+        machines = _request(base, "/apis/karpenter.sh/v1alpha5/machines")
+        assert machines.get("items"), "no machine object on the server"
+        # the exact-model embedding must survive real-apiserver pruning
+        # (machines CRD preserves unknown fields at the root)
+        assert any("x-karpenter-model" in m for m in machines["items"]), \
+            "embedded model pruned — machine round-trip is lossy"
+        nodes = _request(base, "/api/v1/nodes")
+        node_names = {n["metadata"]["name"] for n in nodes.get("items", [])}
+        assert pod_doc["spec"]["nodeName"] in node_names
+    finally:
+        if op is not None:
+            op.stop()
+        kube.stop()

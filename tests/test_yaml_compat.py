@@ -365,3 +365,54 @@ spec:
         zones = {z for _, z, _, _ in res.node_decisions(sched.options)}
         assert zones and "zone-1a" not in zones
         assert not any(res.existing_assignments.values())
+
+
+class TestExamplesDirectory:
+    """The in-repo examples/ set (reference analogue: examples/provisioner +
+    examples/workloads) must parse, validate, and — combined — schedule
+    against the fleet catalog."""
+
+    EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+    def _load(self, *rel):
+        import glob
+
+        paths = []
+        for r in rel:
+            paths.extend(sorted(glob.glob(os.path.join(self.EXAMPLES, r))))
+        assert paths
+        return paths
+
+    def test_every_example_parses_and_validates(self):
+        for path in self._load("*.yaml", "provisioner/*.yaml",
+                               "workloads/*.yaml"):
+            loaded = load_manifests(open(path).read(),
+                                    env={"CLUSTER_NAME": "demo"})
+            for prov in loaded.provisioners:
+                prov.validate()
+            assert (loaded.provisioners or loaded.templates or loaded.pods
+                    or loaded.pdbs), f"{path} loaded nothing"
+
+    def test_example_breadth_matches_reference_shape(self):
+        assert len(self._load("provisioner/*.yaml")) >= 8
+        assert len(self._load("workloads/*.yaml")) >= 8
+
+    def test_combined_examples_schedule_end_to_end(self):
+        provisioners, pods = [], []
+        for path in self._load("provisioner/*.yaml"):
+            provisioners.extend(load_manifests(
+                open(path).read(), env={"CLUSTER_NAME": "demo"}).provisioners)
+        for path in self._load("workloads/*.yaml"):
+            pods.extend(load_manifests(
+                open(path).read(), env={"CLUSTER_NAME": "demo"}).pods)
+        for p in provisioners:
+            p.set_defaults()
+        catalog = generate_fleet_catalog()
+        sched = Scheduler(catalog, provisioners)
+        res = sched.schedule(pods)
+        placed = sum(len(n.pods) for n in res.new_nodes)
+        assert placed + len(res.unschedulable) == len(pods)
+        # the accelerator workload is the only one the generated fleet may
+        # not satisfy; everything else must schedule
+        unsched_apps = {p.name.split("-")[0] for p in res.unschedulable}
+        assert unsched_apps <= {"accel"}, unsched_apps
