@@ -124,6 +124,78 @@ class TPUSolver:
         return _merge_rounds(res, res2, {p.name: i for i, p in
                                          enumerate(pseudo)})
 
+    def solve_many(
+        self,
+        problems: "Sequence[dict]",
+    ) -> "list[SolveResult]":
+        """Wave-pipelined batch of independent solves: every problem's pack
+        kernel is ENQUEUED before any result is read, then the whole wave's
+        flat outputs are concatenated device-side and fetched with ONE
+        device->host read. Each problem is a dict of solve() kwargs
+        (pods, existing, daemon_overhead, n_slots).
+
+        Rationale (docs/designs/solver-boundary.md): on a tunneled device
+        the d2h read is both the latency floor (one RTT) and — measured on
+        this deployment's relay — a *state degrader*: the first read drops
+        the session out of streaming mode. A controller cycle that needs
+        provisioning + consolidation + N drift simulations pays one read
+        instead of N+2. Problems whose pods carry co-pending affinity terms
+        need the two-round driver and fall back to solve() (still correct,
+        one extra read each — rare in practice).
+        """
+        import jax.numpy as jnp
+
+        from ..oracle.scheduler import split_deferred_pods
+
+        slots: "list[tuple]" = []  # (mode, payload)
+        for prob in problems:
+            pods = prob.get("pods", [])
+            existing = prob.get("existing", ())
+            overhead = prob.get("daemon_overhead")
+            n_slots = prob.get("n_slots")
+            # cheap pre-check (attribute scan) before the real split: only
+            # affinity-bearing pod sets can need the two-round driver, and
+            # solve() will redo the split for those anyway
+            if any(p.pod_affinity or p.pod_anti_affinity for p in pods) \
+                    and split_deferred_pods(pods)[1]:
+                slots.append(("solo", prob))
+                continue
+            enc = encode_problem(
+                self.catalog, self.provisioners, pods, existing,
+                overhead, n_slots, grid=self.grid(),
+                group_cache=self._group_cache,
+            )
+            flat, dims = dispatch_pack(enc, self._dev_alloc_t,
+                                       self._dev_tiebreak)
+            slots.append(("wave", (enc, flat, dims, list(existing))))
+
+        wave = [payload for mode, payload in slots if mode == "wave"]
+        fetched: "list[PackResult]" = []
+        if wave:
+            sizes = [int(flat.shape[0]) for _, flat, _, _ in wave]
+            cat = np.asarray(jax.device_get(
+                jnp.concatenate([flat for _, flat, _, _ in wave])))
+            off = 0
+            for (enc, _, dims, _), size in zip(wave, sizes):
+                Gb, Nb, Neb = dims
+                fetched.append(unflatten_result(cat[off:off + size],
+                                                Gb, Nb, Neb))
+                off += size
+
+        out: "list[SolveResult]" = []
+        wi = 0
+        for mode, payload in slots:
+            if mode == "solo":
+                out.append(self.solve(
+                    payload.get("pods", []), payload.get("existing", ()),
+                    payload.get("daemon_overhead"), payload.get("n_slots")))
+            else:
+                enc, _, _, existing = payload
+                out.append(decode(enc, fetched[wi],
+                                  [e.name for e in existing]))
+                wi += 1
+        return out
+
     def _nodes_as_existing(self, res: SolveResult,
                            daemon_overhead) -> "list[ExistingNode]":
         """Round-1 claims as existing nodes (mirror of the oracle's
@@ -240,6 +312,13 @@ class NativeSolver(TPUSolver):
     a tunneled-device round trip would dominate the latency budget. No
     padding/bucketing: dynamic shapes are free on the host."""
 
+    def solve_many(self, problems: "Sequence[dict]") -> "list[SolveResult]":
+        """In-process host scans have no read budget to amortize — a plain
+        loop keeps the host-only contract (no jax dispatch ever)."""
+        return [self.solve(p.get("pods", []), p.get("existing", ()),
+                           p.get("daemon_overhead"), p.get("n_slots"))
+                for p in problems]
+
     def grid(self) -> OptionGrid:
         if self._grid is None or self._grid.seqnum != self.catalog.seqnum:
             self._grid = build_grid(self.catalog)  # host-only: no device_put
@@ -272,8 +351,15 @@ class NativeSolver(TPUSolver):
         return decode(enc, result, [e.name for e in existing])
 
 
-def run_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None) -> PackResult:
-    """Pad to shape buckets and invoke the jitted kernel."""
+def dispatch_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None):
+    """Pad to shape buckets and ENQUEUE the jitted kernel — no device read.
+    Returns (flat device array, (Gb, Nb, Neb)); fetch_pack turns it into a
+    PackResult. Split from run_pack so wave callers (solve_many) can overlap
+    K dispatches and pay a single device->host read for the whole wave —
+    on a tunneled device each read is a full round trip, and (measured on
+    the deployment tunnel, docs/designs/solver-boundary.md) the FIRST read
+    also degrades the link's sync latency for the session, so reads are the
+    scarcest resource the solver spends."""
     G = enc.group_vec.shape[0]
     Gb = _bucket(G)
     Ne = enc.ex_alloc.shape[0]
@@ -325,7 +411,19 @@ def run_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None) -> PackRe
     # One jitted dispatch returning ONE flat buffer: decode pays exactly one
     # device->host round trip (the tunnel RTT floor; SURVEY.md §7.3).
     flat = pack_flat(inputs, n_slots=Nb, use_pallas=use_pallas)
+    return flat, (Gb, Nb, Neb)
+
+
+def fetch_pack(flat, dims) -> PackResult:
+    """The single device->host read for a dispatched pack."""
+    Gb, Nb, Neb = dims
     return unflatten_result(np.asarray(jax.device_get(flat)), Gb, Nb, Neb)
+
+
+def run_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None) -> PackResult:
+    """dispatch + fetch: the single-solve path."""
+    flat, dims = dispatch_pack(enc, dev_alloc_t, dev_tiebreak)
+    return fetch_pack(flat, dims)
 
 
 def decode(enc: EncodedProblem, result: PackResult, existing_names: "list[str]") -> SolveResult:

@@ -263,3 +263,67 @@ def test_version_skew_sync_without_content_hash_degrades_loudly():
         assert VERSION_SKEW.value() == before + 1
     finally:
         srv.stop(grace=None)
+
+
+class TestSolveMany:
+    """Wave-pipelined batch API: K solves, one concatenated device read
+    (docs/designs/solver-boundary.md read-budget discipline)."""
+
+    def test_wave_results_match_individual_solves(self):
+        cat = small_catalog()
+        solver = TPUSolver(cat, [default_provisioner()])
+        problems = [
+            {"pods": mixed_pods(16)},
+            {"pods": [make_pod(f"big-{i}", cpu="2", memory="8Gi")
+                      for i in range(10)]},
+            {"pods": [make_pod(f"tiny-{i}", cpu="100m", memory="128Mi")
+                      for i in range(30)]},
+        ]
+        wave = solver.solve_many(problems)
+        solo = [solver.solve(**p) for p in problems]
+        assert len(wave) == len(solo) == 3
+        for w, s in zip(wave, solo):
+            assert w.decisions() == s.decisions()
+            assert w.unschedulable_count() == s.unschedulable_count()
+
+    def test_deferred_affinity_problems_fall_back_to_two_round(self):
+        from karpenter_tpu.models.pod import PodAffinityTerm
+
+        cat = small_catalog()
+        solver = TPUSolver(cat, [default_provisioner()])
+        anchor = [make_pod(f"a-{i}", cpu="250m", memory="256Mi",
+                           labels=(("app", "anchor"),)) for i in range(4)]
+        follower = [make_pod(
+            f"f-{i}", cpu="250m", memory="256Mi",
+            pod_affinity=(PodAffinityTerm(
+                match_labels=(("app", "anchor"),),
+                topology_key=wk.LABEL_HOSTNAME),))
+            for i in range(2)]
+        problems = [{"pods": anchor + follower}, {"pods": mixed_pods(8)}]
+        wave = solver.solve_many(problems)
+        solo = [solver.solve(**p) for p in problems]
+        for w, s in zip(wave, solo):
+            assert w.decisions() == s.decisions()
+        placed = sum(n.pod_count for n in wave[0].nodes)
+        assert placed + wave[0].unschedulable_count() == 6
+
+    def test_empty_wave(self):
+        solver = TPUSolver(small_catalog(), [default_provisioner()])
+        assert solver.solve_many([]) == []
+
+    def test_native_solve_many_stays_on_host(self, monkeypatch):
+        """NativeSolver is the device-unreachable fallback: its wave API
+        must never touch the jax dispatch path."""
+        import karpenter_tpu.solver.core as score
+        from karpenter_tpu.solver.core import NativeSolver
+
+        def boom(*a, **k):
+            raise AssertionError("NativeSolver.solve_many dispatched to jax")
+
+        monkeypatch.setattr(score, "dispatch_pack", boom)
+        solver = NativeSolver(small_catalog(), [default_provisioner()])
+        problems = [{"pods": mixed_pods(12)}, {"pods": mixed_pods(6)}]
+        wave = solver.solve_many(problems)
+        solo = [solver.solve(**p) for p in problems]
+        for w, s in zip(wave, solo):
+            assert w.decisions() == s.decisions()
