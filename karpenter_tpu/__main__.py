@@ -154,6 +154,52 @@ def cmd_controller(args) -> int:
     return 0
 
 
+def cmd_cleanup(args) -> int:
+    """Sweep leaked capacity: cloud instances with no coordination-plane
+    owner and stale hash-named launch templates. The operational analogue of
+    the reference's test-account cleanup tooling (reference test/ 'cleanup'
+    + sweeper scripts) pointed at the framework's own GC logic — one
+    explicit pass, printed, exit 0 (reconcile-once semantics; the running
+    controller does this continuously)."""
+    from .apis.settings import Settings
+    from .cloudprovider import CloudProvider
+    from .controllers.garbagecollection import GarbageCollectionController
+    from .fake.cloud import FakeCloud
+    from .fake.kube import KubeStore
+    from .providers.instancetypes import generate_fleet_catalog
+
+    if not args.simulate:
+        # the cloud backend in this build is process-local (simulated); a
+        # cleanup pointed at a real apiserver would compare its machines
+        # against an EMPTY fresh cloud and retire healthy capacity. The
+        # running controller's own GC loop is the live-cluster sweeper;
+        # this command is for the simulated account only.
+        print("cleanup runs against the simulated cloud only (--simulate); "
+              "for a live cluster the controller's GC loop is the sweeper",
+              file=sys.stderr)
+        return 2
+    kube = KubeStore()
+
+    catalog = generate_fleet_catalog()
+    settings = Settings(cluster_name=args.cluster_name,
+                        cluster_endpoint="https://simulated")
+    cloud = FakeCloud(catalog)
+    provider = CloudProvider(cloud, settings, catalog)
+    gc = GarbageCollectionController(kube, provider)
+    # force-expire the grace windows when asked: a cleanup sweep of a dead
+    # test account wants everything, not just old leaks
+    if args.all:
+        gc.grace_seconds = 0
+    reaped = gc.reconcile_once()
+    stale_lts = provider.launch_templates.delete_all() \
+        if args.launch_templates else 0
+    print(f"reaped {len(reaped)} leaked instance(s), "
+          f"{stale_lts} launch template(s)")
+    for r in reaped:
+        print(f"  {r}")
+    return 0
+
+
 def main(argv=None) -> int:
     logging.basicConfig(
         level=logging.INFO,
@@ -201,6 +247,17 @@ def main(argv=None) -> int:
                              "dials webhooks over TLS; cert-manager mounts it)")
     p_ctrl.add_argument("--webhook-tls-key", default="")
     p_ctrl.set_defaults(fn=cmd_controller)
+
+    p_clean = sub.add_parser(
+        "cleanup", help="one-shot sweep of leaked instances/launch templates "
+                        "(simulated account)")
+    p_clean.add_argument("--simulate", action="store_true")
+    p_clean.add_argument("--cluster-name", default="simulated")
+    p_clean.add_argument("--all", action="store_true",
+                         help="ignore grace windows (dead-account sweep)")
+    p_clean.add_argument("--launch-templates", action="store_true",
+                         help="also delete all cluster-owned launch templates")
+    p_clean.set_defaults(fn=cmd_cleanup)
 
     p_ver = sub.add_parser("version")
     p_ver.set_defaults(fn=lambda a: print(VERSION) or 0)

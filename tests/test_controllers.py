@@ -762,3 +762,14 @@ class TestEventObjects:
         left = op.kube.list("events")
         assert len(left) == 12
         assert min(e["ts"] for e in left) == 18.0  # oldest went first
+
+
+def test_cleanup_cli_sweeps_and_exits_zero(capsys):
+    """Operational cleanup tooling (reference test-account sweeper analogue):
+    one-shot GC pass over the simulated account, grace windows ignored."""
+    from karpenter_tpu.__main__ import main
+
+    rc = main(["cleanup", "--simulate", "--all", "--launch-templates"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "reaped" in out and "launch template" in out
