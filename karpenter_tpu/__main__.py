@@ -98,6 +98,10 @@ def cmd_controller(args) -> int:
         solver_factory = (
             lambda cat, provs: RemoteSolver(cat, provs, target=args.solver))
     cloud = FakeCloud(catalog)
+    if args.state and __import__("os").path.exists(args.state):
+        cloud.load_state(args.state)
+        print(f"loaded simulated account from {args.state} "
+              f"({len(cloud.instances)} instances)", flush=True)
     # reference templates discover infra by cluster tag; tag the simulated
     # subnets/SGs so `karpenter.sh/discovery: <cluster>` selectors resolve
     for s in cloud.subnets:
@@ -151,6 +155,9 @@ def cmd_controller(args) -> int:
         _wait_for_signal()
     finally:
         op.stop()
+        if args.state:
+            cloud.save_state(args.state)
+            print(f"saved simulated account to {args.state}", flush=True)
     return 0
 
 
@@ -168,14 +175,18 @@ def cmd_cleanup(args) -> int:
     from .fake.kube import KubeStore
     from .providers.instancetypes import generate_fleet_catalog
 
-    if not args.simulate:
+    import os
+
+    if not args.state:
         # the cloud backend in this build is process-local (simulated); a
         # cleanup pointed at a real apiserver would compare its machines
         # against an EMPTY fresh cloud and retire healthy capacity. The
         # running controller's own GC loop is the live-cluster sweeper;
-        # this command is for the simulated account only.
-        print("cleanup runs against the simulated cloud only (--simulate); "
-              "for a live cluster the controller's GC loop is the sweeper",
+        # this command sweeps a PERSISTED simulated account (--state FILE,
+        # the file `controller --simulate --state FILE` maintains).
+        print("cleanup needs --state FILE (the persisted simulated account "
+              "written by `controller --simulate --state FILE`); for a live "
+              "cluster the controller's GC loop is the sweeper",
               file=sys.stderr)
         return 2
     kube = KubeStore()
@@ -184,6 +195,10 @@ def cmd_cleanup(args) -> int:
     settings = Settings(cluster_name=args.cluster_name,
                         cluster_endpoint="https://simulated")
     cloud = FakeCloud(catalog)
+    if os.path.exists(args.state):
+        cloud.load_state(args.state)
+    n_before = len([i for i in cloud.instances.values()
+                    if i.state == "running"])
     provider = CloudProvider(cloud, settings, catalog)
     gc = GarbageCollectionController(kube, provider)
     # force-expire the grace windows when asked: a cleanup sweep of a dead
@@ -193,8 +208,9 @@ def cmd_cleanup(args) -> int:
     reaped = gc.reconcile_once()
     stale_lts = provider.launch_templates.delete_all() \
         if args.launch_templates else 0
-    print(f"reaped {len(reaped)} leaked instance(s), "
-          f"{stale_lts} launch template(s)")
+    cloud.save_state(args.state)
+    print(f"account {args.state}: {n_before} running instance(s); "
+          f"reaped {len(reaped)} leaked, {stale_lts} launch template(s)")
     for r in reaped:
         print(f"  {r}")
     return 0
@@ -225,6 +241,11 @@ def main(argv=None) -> int:
     p_ctrl.add_argument("--solver", default="",
                         help="gRPC solver sidecar address (host:port)")
     p_ctrl.add_argument("--cluster-name", default="simulated")
+    p_ctrl.add_argument("--state", default="",
+                        help="persist the simulated account (instances, "
+                             "launch templates) to this JSON file: loaded at "
+                             "boot, saved on shutdown — lets `cleanup "
+                             "--state` sweep the same account")
     p_ctrl.add_argument("--apply", action="append", default=[],
                         metavar="FILE",
                         help="manifest file(s) to apply at boot "
@@ -250,8 +271,9 @@ def main(argv=None) -> int:
 
     p_clean = sub.add_parser(
         "cleanup", help="one-shot sweep of leaked instances/launch templates "
-                        "(simulated account)")
-    p_clean.add_argument("--simulate", action="store_true")
+                        "in a persisted simulated account")
+    p_clean.add_argument("--state", default="",
+                         help="account state file (see controller --state)")
     p_clean.add_argument("--cluster-name", default="simulated")
     p_clean.add_argument("--all", action="store_true",
                          help="ignore grace windows (dead-account sweep)")

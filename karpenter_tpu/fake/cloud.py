@@ -307,6 +307,41 @@ class FakeCloud:
                         self.terminate_instances_api):
                 api.reset()
 
+    # -- account persistence ---------------------------------------------------
+    # The simulated ACCOUNT (instances + launch templates + id watermark)
+    # can round-trip through a JSON file so separate processes share one
+    # account — `controller --simulate --state F` then `cleanup --state F`
+    # behaves like the reference's test-account sweeper against real cloud
+    # state. Static infra (subnets/SGs/images/prices) is derived config,
+    # not account state, and is not persisted.
+
+    def save_state(self, path: str) -> None:
+        import json
+
+        with self.lock:
+            doc = {
+                "instances": [dataclasses.asdict(i)
+                              for i in self.instances.values()],
+                "launch_templates": [dataclasses.asdict(lt)
+                                     for lt in self.launch_templates.values()],
+                "next_id": next(self._id_counter),
+            }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+
+    def load_state(self, path: str) -> None:
+        import json
+
+        with open(path) as f:
+            doc = json.load(f)
+        with self.lock:
+            self.instances = {
+                d["id"]: CloudInstance(**d) for d in doc["instances"]}
+            self.launch_templates = {
+                d["name"]: LaunchTemplate(**d)
+                for d in doc["launch_templates"]}
+            self._id_counter = itertools.count(int(doc["next_id"]))
+
 
 def _match_selector(tags: "dict[str, str]", obj_id: str, selector: "dict[str, str]") -> bool:
     """Tag/id selector semantics (subnet.go:87 getFilters): key 'id' matches

@@ -764,12 +764,36 @@ class TestEventObjects:
         assert min(e["ts"] for e in left) == 18.0  # oldest went first
 
 
-def test_cleanup_cli_sweeps_and_exits_zero(capsys):
+def test_cleanup_cli_reaps_persisted_leaks(capsys, tmp_path):
     """Operational cleanup tooling (reference test-account sweeper analogue):
-    one-shot GC pass over the simulated account, grace windows ignored."""
-    from karpenter_tpu.__main__ import main
+    a LEAKED instance persisted in a simulated-account state file is reaped
+    by a separate cleanup process sharing the account through that file."""
+    import json
 
-    rc = main(["cleanup", "--simulate", "--all", "--launch-templates"])
+    from karpenter_tpu.__main__ import main
+    from karpenter_tpu.fake.cloud import (CloudInstance, FakeCloud,
+                                          LaunchTemplate)
+
+    state = tmp_path / "account.json"
+    cloud = FakeCloud()
+    cloud.instances["i-leak-1"] = CloudInstance(
+        id="i-leak-1", instance_type="m.large", zone="zone-1a",
+        capacity_type="on-demand", launch_time=0.0,
+        tags={"karpenter.sh/provisioner-name": "default",
+              "karpenter.sh/cluster": "simulated"})
+    cloud.launch_templates["Karpenter-simulated-abc"] = LaunchTemplate(
+        name="Karpenter-simulated-abc", image_id="img-amd64-2",
+        tags={"karpenter.k8s.tpu/cluster": "simulated"})
+    cloud.save_state(str(state))
+
+    rc = main(["cleanup", "--state", str(state), "--all",
+               "--launch-templates"])
     assert rc == 0
     out = capsys.readouterr().out
-    assert "reaped" in out and "launch template" in out
+    assert "reaped 1 leaked" in out, out
+    doc = json.loads(state.read_text())
+    states = {i["id"]: i["state"] for i in doc["instances"]}
+    assert states["i-leak-1"] != "running"
+
+    # without --state the tool refuses rather than sweeping a fresh account
+    assert main(["cleanup"]) == 2
