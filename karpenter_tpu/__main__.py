@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import sys
 
@@ -98,7 +99,7 @@ def cmd_controller(args) -> int:
         solver_factory = (
             lambda cat, provs: RemoteSolver(cat, provs, target=args.solver))
     cloud = FakeCloud(catalog)
-    if args.state and __import__("os").path.exists(args.state):
+    if args.state and os.path.exists(args.state):
         cloud.load_state(args.state)
         print(f"loaded simulated account from {args.state} "
               f"({len(cloud.instances)} instances)", flush=True)
@@ -175,8 +176,6 @@ def cmd_cleanup(args) -> int:
     from .fake.kube import KubeStore
     from .providers.instancetypes import generate_fleet_catalog
 
-    import os
-
     if not args.state:
         # the cloud backend in this build is process-local (simulated); a
         # cleanup pointed at a real apiserver would compare its machines
@@ -189,14 +188,18 @@ def cmd_cleanup(args) -> int:
               "cluster the controller's GC loop is the sweeper",
               file=sys.stderr)
         return 2
+    if not os.path.exists(args.state):
+        # a typo'd path must not silently sweep (and then persist) a fresh
+        # empty account — the account file is the contract
+        print(f"state file not found: {args.state}", file=sys.stderr)
+        return 2
     kube = KubeStore()
 
     catalog = generate_fleet_catalog()
     settings = Settings(cluster_name=args.cluster_name,
                         cluster_endpoint="https://simulated")
     cloud = FakeCloud(catalog)
-    if os.path.exists(args.state):
-        cloud.load_state(args.state)
+    cloud.load_state(args.state)
     n_before = len([i for i in cloud.instances.values()
                     if i.state == "running"])
     provider = CloudProvider(cloud, settings, catalog)

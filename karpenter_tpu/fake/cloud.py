@@ -11,7 +11,7 @@ APIs or simulated backend"), not EC2's wire format.
 from __future__ import annotations
 
 import dataclasses
-import itertools
+import os
 import threading
 from typing import Optional, Sequence
 
@@ -141,7 +141,7 @@ class FakeCloud:
         }
         # (capacity_type, instance_type, zone) triples that synthesize ICE
         self.insufficient_capacity_pools: "set[tuple[str, str, str]]" = set()
-        self._id_counter = itertools.count(1)
+        self._next_id = 1
 
         self.create_fleet_api: MockedFunction = MockedFunction(
             "CreateFleet", self._create_fleet)
@@ -183,7 +183,8 @@ class FakeCloud:
                 lt_name = choice.launch_template or request.launch_template
                 lt = self.launch_templates.get(lt_name)
                 for _ in range(request.capacity):
-                    n = next(self._id_counter)
+                    n = self._next_id
+                    self._next_id += 1
                     iid = f"i-{n:08d}"
                     self.instances[iid] = CloudInstance(
                         id=iid,
@@ -324,10 +325,13 @@ class FakeCloud:
                               for i in self.instances.values()],
                 "launch_templates": [dataclasses.asdict(lt)
                                      for lt in self.launch_templates.values()],
-                "next_id": next(self._id_counter),
+                "next_id": self._next_id,
             }
-        with open(path, "w") as f:
+        # atomic replace: a crash mid-write must not corrupt the account
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
 
     def load_state(self, path: str) -> None:
         import json
@@ -340,7 +344,7 @@ class FakeCloud:
             self.launch_templates = {
                 d["name"]: LaunchTemplate(**d)
                 for d in doc["launch_templates"]}
-            self._id_counter = itertools.count(int(doc["next_id"]))
+            self._next_id = int(doc["next_id"])
 
 
 def _match_selector(tags: "dict[str, str]", obj_id: str, selector: "dict[str, str]") -> bool:

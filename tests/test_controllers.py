@@ -795,5 +795,8 @@ def test_cleanup_cli_reaps_persisted_leaks(capsys, tmp_path):
     states = {i["id"]: i["state"] for i in doc["instances"]}
     assert states["i-leak-1"] != "running"
 
-    # without --state the tool refuses rather than sweeping a fresh account
+    # without --state (or with a typo'd path) the tool refuses rather than
+    # sweeping — and then persisting — a fresh empty account
     assert main(["cleanup"]) == 2
+    assert main(["cleanup", "--state", str(tmp_path / "typo.json")]) == 2
+    assert not (tmp_path / "typo.json").exists()
