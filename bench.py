@@ -121,19 +121,23 @@ def main():
             }
     except Exception as e:  # capture history must never break the bench
         _state["detail"]["latest_tpu_capture_error"] = str(e)[:120]
-    try:  # profiler-trace evidence for the on-chip kernel time (round 4)
-        trace_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                  "benchmarks", "results",
-                                  "trace_summary_20260730.json")
-        with open(trace_path) as _f:
-            ts = json.load(_f)
-        _state["detail"]["device_trace"] = {
-            "device_exec_per_run_ms": ts.get("device_exec_per_run_ms"),
-            "workload": ts.get("workload"),
-            "trace": ts.get("trace"),
-        }
+    try:  # newest RECORDED profiler-trace evidence (clearly dated — this is
+        # archive evidence for the on-chip kernel time, not this run's data)
+        import glob as _glob
+        summaries = sorted(_glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "benchmarks", "results", "trace_summary_*.json")))
+        if summaries:
+            with open(summaries[-1]) as _f:
+                ts = json.load(_f)
+            _state["detail"]["recorded_device_trace"] = {
+                "captured_at": ts.get("captured_at"),
+                "device_exec_per_run_ms": ts.get("device_exec_per_run_ms"),
+                "workload": ts.get("workload"),
+                "trace": ts.get("trace"),
+            }
     except Exception as e:
-        _state["detail"]["device_trace_error"] = str(e)[:120]
+        _state["detail"]["recorded_device_trace_error"] = str(e)[:120]
     # A probe-failure CPU fallback is NOT a TPU number — flag it so the
     # recorded artifact can't masquerade as the round's chip result.
     fallback_degraded = not tpu_ok and forced != "cpu"

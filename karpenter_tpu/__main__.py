@@ -52,10 +52,12 @@ def cmd_solver_serve(args) -> int:
         print(f"distributed: {mesh_description(make_hybrid_mesh())}"
               if multi else "distributed requested but single-process",
               flush=True)
-    from .solver.service import serve
+    from .solver.service import SolverService, serve
 
+    service = SolverService(trace_dir=args.trace_dir or None,
+                            trace_every=args.trace_every)
     server, port, _service = serve(f"{args.host}:{args.port}",
-                                   max_workers=args.workers)
+                                   max_workers=args.workers, service=service)
     print(f"solver service listening on {args.host}:{port}", flush=True)
     try:
         _wait_for_signal()
@@ -236,6 +238,10 @@ def main(argv=None) -> int:
                          help="coordinator address host:port (defaults from env)")
     p_serve.add_argument("--num-processes", type=int, default=None)
     p_serve.add_argument("--process-id", type=int, default=None)
+    p_serve.add_argument("--trace-dir", default="",
+                         help="capture a jax.profiler trace of every "
+                              "--trace-every'th solve into this directory")
+    p_serve.add_argument("--trace-every", type=int, default=100)
     p_serve.set_defaults(fn=cmd_solver_serve)
 
     p_ctrl = sub.add_parser("controller", help="run the controller plane")

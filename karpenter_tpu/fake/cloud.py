@@ -327,11 +327,24 @@ class FakeCloud:
                                      for lt in self.launch_templates.values()],
                 "next_id": self._next_id,
             }
-        # atomic replace: a crash mid-write must not corrupt the account
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1)
-        os.replace(tmp, path)
+        # atomic replace with a per-writer temp name: a crash mid-write
+        # must not corrupt the account, and two processes saving the shared
+        # file concurrently must not interleave into one temp file
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(os.path.abspath(path)) or ".",
+            prefix=os.path.basename(path) + ".")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     def load_state(self, path: str) -> None:
         import json

@@ -72,11 +72,20 @@ class SolverService:
 
     LRU_CAPACITY = 4
 
-    def __init__(self):
+    def __init__(self, trace_dir: "Optional[str]" = None,
+                 trace_every: int = 100):
         self._lock = threading.Lock()
         # (cat_hash, prov_hash) -> (TPUSolver, seqnum); insertion order = LRU
         self._cache: "OrderedDict[tuple[int, int], tuple[TPUSolver, int]]" = \
             OrderedDict()
+        # device-path profiling (SURVEY §5.1): when trace_dir is set, every
+        # trace_every-th Solve runs under jax.profiler.trace so production
+        # captures the on-chip timeline continuously (the evidence class of
+        # benchmarks/results/traces/ — see docs/designs/solver-boundary.md)
+        self._trace_dir = trace_dir
+        self._trace_every = max(1, trace_every)
+        self._solve_count = 0
+        self._trace_active = False  # single-flight: jax has ONE global profiler
 
     def _mru(self) -> "tuple[Optional[TPUSolver], int, int]":
         """(solver, seqnum, cat_hash) of the most recently used entry.
@@ -144,8 +153,42 @@ class SolverService:
         pods = [wire.pod_from_wire(m) for m in request.pods]
         existing = [wire.existing_from_wire(m) for m in request.existing]
         overhead = list(request.daemon_overhead) or None
+        with self._lock:
+            self._solve_count += 1
+            trace_now = (self._trace_dir is not None
+                         and (self._solve_count - 1) % self._trace_every == 0
+                         and not self._trace_active)  # jax: ONE global profiler
+            if trace_now:
+                self._trace_active = True
         t0 = time.perf_counter()
-        result = solver.solve(pods, existing=existing, daemon_overhead=overhead)
+        if trace_now:
+            # profiling must never fail a production Solve: start/stop are
+            # individually guarded so an unwritable dir or a wedged profiler
+            # degrades to an untraced solve, never an aborted RPC
+            started = False
+            try:
+                import jax
+
+                jax.profiler.start_trace(self._trace_dir)
+                started = True
+            except Exception as e:
+                log.warning("profiler start failed: %s", e)
+            try:
+                result = solver.solve(pods, existing=existing,
+                                      daemon_overhead=overhead)
+            finally:
+                if started:
+                    try:
+                        jax.profiler.stop_trace()
+                        log.info("profiler trace for solve #%d -> %s",
+                                 self._solve_count, self._trace_dir)
+                    except Exception as e:
+                        log.warning("profiler stop failed: %s", e)
+                with self._lock:
+                    self._trace_active = False
+        else:
+            result = solver.solve(pods, existing=existing,
+                                  daemon_overhead=overhead)
         solve_ms = (time.perf_counter() - t0) * 1000
         return result_to_response(result, solve_ms, seqnum)
 

@@ -327,3 +327,26 @@ class TestSolveMany:
         solo = [solver.solve(**p) for p in problems]
         for w, s in zip(wave, solo):
             assert w.decisions() == s.decisions()
+
+
+def test_solver_service_profiling_hook(tmp_path):
+    """--trace-dir captures a jax.profiler trace of the Nth solve
+    (SURVEY §5.1 device-path profiling as a first-class service feature)."""
+    import os
+
+    from karpenter_tpu.solver.client import RemoteSolver
+    from karpenter_tpu.solver.service import SolverService, serve
+
+    svc = SolverService(trace_dir=str(tmp_path), trace_every=1)
+    srv, port, _ = serve("127.0.0.1:0", service=svc)
+    try:
+        solver = RemoteSolver(small_catalog(), [default_provisioner()],
+                              target=f"127.0.0.1:{port}")
+        res = solver.solve(mixed_pods(8))
+        assert sum(n.pod_count for n in res.nodes) == 8
+        produced = []
+        for root, _dirs, files in os.walk(tmp_path):
+            produced += [f for f in files if "trace" in f or f.endswith(".pb")]
+        assert produced, "no profiler trace written"
+    finally:
+        srv.stop(grace=None)
