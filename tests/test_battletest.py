@@ -495,3 +495,90 @@ def test_fuzz_parity_affinity_residents_space(groups, nodes):
     assert o.node_decisions(sched.options) == k.decisions() == n.decisions()
     assert o_ex == k.existing_counts == n.existing_counts
     assert len(o.unschedulable) == k.unschedulable_count() == n.unschedulable_count()
+
+
+class TestRound4Races:
+    """Race tier for the round-4 surfaces: wave solves sharing one solver,
+    and concurrent account-file persistence."""
+
+    def test_concurrent_waves_and_solos_on_one_solver(self):
+        cat = battletest_catalog()
+        prov = Provisioner(name="default", requirements=Requirements.of(
+            (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+        prov.set_defaults()
+        solver = TPUSolver(cat, [prov])
+        pods = [make_pod(f"w-{i}", cpu="500m", memory="1Gi")
+                for i in range(24)]
+        want = solver.solve(list(pods)).decisions()
+        errors: "list[BaseException]" = []
+
+        def wave():
+            try:
+                for r in solver.solve_many([{"pods": list(pods)}] * 3):
+                    assert r.decisions() == want
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        def solo():
+            try:
+                for _ in range(3):
+                    assert solver.solve(list(pods)).decisions() == want
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=f)
+                   for f in (wave, solo, wave, solo)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
+    def test_concurrent_account_saves_never_corrupt_the_file(self, tmp_path):
+        import json
+
+        from karpenter_tpu.fake.cloud import (CloudInstance, FakeCloud)
+
+        path = str(tmp_path / "account.json")
+        clouds = []
+        for k in range(3):
+            c = FakeCloud()
+            for i in range(20):
+                iid = f"i-{k}-{i}"
+                c.instances[iid] = CloudInstance(
+                    id=iid, instance_type="m.large", zone="zone-1a",
+                    capacity_type="on-demand")
+            clouds.append(c)
+
+        stop = threading.Event()
+        errors: "list[BaseException]" = []
+
+        def saver(c):
+            while not stop.is_set():
+                try:
+                    c.save_state(path)
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=saver, args=(c,)) for c in clouds]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 1.5
+        reads = 0
+        while time.time() < deadline:
+            try:
+                doc = json.loads(open(path).read())
+            except FileNotFoundError:
+                continue
+            # every observable state is a COMPLETE snapshot from one writer
+            assert len(doc["instances"]) == 20
+            reads += 1
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert reads > 10
+        fresh = FakeCloud()
+        fresh.load_state(path)
+        assert len(fresh.instances) == 20
