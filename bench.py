@@ -118,6 +118,17 @@ def main():
                                                           cap.get("value")),
                 "crossover_pods": cap.get("crossover_pods"),
                 "backend": cap.get("backend", "tpu"),
+                # attribution fields (round 4): consolidation number, the
+                # link-state sentinels, and streaming-mode kernel time, so a
+                # capture taken in a degraded relay phase can't masquerade
+                # as a kernel regression (docs/designs/solver-boundary.md)
+                "consolidation_500_ms": (cap.get("consolidation_500")
+                                         or {}).get("p50_ms"),
+                "link_state": cap.get("link_state"),
+                "exec_only_10k_ms": (cap.get("exec_only_10k")
+                                     or {}).get("p50_ms"),
+                "wave_per_solve_ms": (cap.get("wave_pipelined")
+                                      or {}).get("per_solve_ms"),
             }
     except Exception as e:  # capture history must never break the bench
         _state["detail"]["latest_tpu_capture_error"] = str(e)[:120]

@@ -564,19 +564,21 @@ class TestRound4Races:
         threads = [threading.Thread(target=saver, args=(c,)) for c in clouds]
         for t in threads:
             t.start()
-        deadline = time.time() + 1.5
-        reads = 0
-        while time.time() < deadline:
-            try:
-                doc = json.loads(open(path).read())
-            except FileNotFoundError:
-                continue
-            # every observable state is a COMPLETE snapshot from one writer
-            assert len(doc["instances"]) == 20
-            reads += 1
-        stop.set()
-        for t in threads:
-            t.join()
+        try:
+            deadline = time.time() + 1.5
+            reads = 0
+            while time.time() < deadline:
+                try:
+                    doc = json.loads(open(path).read())
+                except FileNotFoundError:
+                    continue
+                # every observable state is a COMPLETE snapshot of one writer
+                assert len(doc["instances"]) == 20
+                reads += 1
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
         assert not errors, errors
         assert reads > 10
         fresh = FakeCloud()
