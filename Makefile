@@ -2,40 +2,44 @@
 # test / battletest / benchmark / e2etests targets).
 
 PY ?= python
-CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+# CPU-only targets bypass the axon TPU plugin entirely (-u PALLAS_AXON_POOL_IPS):
+# when the deployment relay wedges, sitecustomize's register() blocks EVERY
+# plain python start at interpreter boot — see the verify skill's "Wedged TPU
+# tunnel" note and karpenter_tpu/utils/jaxenv.py.
+CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit test battletest deflake benchmark bench e2e docs native run solver-serve verify-entry
+.PHONY: presubmit test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry
 
 presubmit: test verify-entry  ## what CI runs
 
 test:  ## hermetic suite (8-device virtual CPU mesh)
-	$(PY) -m pytest tests/ -q
+	$(CPU_ENV) $(PY) -m pytest tests/ -q
 
 battletest:  ## randomized/race tier: shuffled order (seed logged) + random per-test delay, 3x
 	for i in 1 2 3; do \
-		env KARPENTER_TPU_RANDOMIZE=1 KARPENTER_TPU_TEST_DELAY_MS=10 \
+		$(CPU_ENV) KARPENTER_TPU_RANDOMIZE=1 KARPENTER_TPU_TEST_DELAY_MS=10 \
 			$(PY) -m pytest tests/test_battletest.py tests/test_packer_parity.py -q || exit 1; \
 	done
 
 deflake:  ## loop the randomized race tier until it fails (fresh seed each round)
-	while env KARPENTER_TPU_RANDOMIZE=1 KARPENTER_TPU_TEST_DELAY_MS=10 \
+	while $(CPU_ENV) KARPENTER_TPU_RANDOMIZE=1 KARPENTER_TPU_TEST_DELAY_MS=10 \
 		$(PY) -m pytest tests/test_battletest.py -q; do :; done
 
 benchmark:  ## interruption ladder + BASELINE configs, RECORDED + diffed
-	env $(CPU_ENV) $(PY) -m benchmarks.record
+	$(CPU_ENV) $(PY) -m benchmarks.record
 
 bench:  ## the headline one-line benchmark (real TPU when present)
 	$(PY) bench.py
 
 e2e:  ## E2E-analogue scenario suites only
-	$(PY) -m pytest tests/test_e2e_scenarios.py tests/test_controllers.py -q
+	$(CPU_ENV) $(PY) -m pytest tests/test_e2e_scenarios.py tests/test_controllers.py -q
 
 foreigntest:  ## wire-compat tier against a real kube-apiserver (fetches envtest)
 	bash hack/fetch_envtest.sh || true  # offline: the tier skips on absent binaries
-	$(PY) -m pytest tests/test_foreign_apiserver.py -q
+	$(CPU_ENV) $(PY) -m pytest tests/test_foreign_apiserver.py -q
 
 docs:  ## regenerate generated docs (metrics/settings/instance-types)
-	env $(CPU_ENV) $(PY) hack/gen_docs.py all
+	$(CPU_ENV) $(PY) hack/gen_docs.py all
 
 native:  ## build the C++ fallback packer
 	bash hack/build_native.sh
@@ -47,5 +51,5 @@ solver-serve:  ## host the TPU solver gRPC service
 	$(PY) -m karpenter_tpu solver-serve
 
 verify-entry:  ## driver contract: graft entry compiles, multichip dryrun passes
-	env $(CPU_ENV) $(PY) -c "import __graft_entry__ as g; fn, args = g.entry(); \
+	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; fn, args = g.entry(); \
 import jax; jax.jit(fn).lower(*args).compile(); g.dryrun_multichip(8)"
