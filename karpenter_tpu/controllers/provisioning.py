@@ -61,6 +61,9 @@ class ProvisioningController:
             "Duration of scheduling solves.", ("solver",))
         self.nodes_created = reg.counter(
             f"{NAMESPACE}_nodes_created_total", "Nodes created.", ("provisioner",))
+        self.pods_bound = reg.counter(
+            f"{NAMESPACE}_pods_bound_total",
+            "Pods bound to nodes by the provisioner.", ("provisioner",))
         self.pods_unschedulable = reg.gauge(
             f"{NAMESPACE}_pods_unschedulable", "Pods that failed to schedule.")
         self._solver_factory = solver_factory or (
@@ -249,10 +252,21 @@ class ProvisioningController:
         # bind pods placed onto existing nodes (exact per-group plan)
         for node_name, per_group in result.existing_by_group.items():
             self._bind_from_groups(by_group, per_group, node_name)
+        # Pre-partition each new node's pod names HERE, in the reconcile
+        # thread: concurrent launch workers must not pop from the shared
+        # per-group queues (double-bind/skip race under the thread pool).
+        assignments = []
+        for solved in result.nodes:
+            take: "dict[int, list[str]]" = {}
+            for g_idx, count in solved.pod_counts.items():
+                names = by_group.get(g_idx, [])
+                take[g_idx] = names[:count]
+                by_group[g_idx] = names[count:]
+            assignments.append(take)
         # launch new nodes in parallel (reconcile-loop concurrency analogue,
         # MaxConcurrentReconciles=10)
-        futures = [self._pool.submit(self._launch_node, solved, by_group, result)
-                   for solved in result.nodes]
+        futures = [self._pool.submit(self._launch_node, solved, take, result)
+                   for solved, take in zip(result.nodes, assignments)]
         for f in futures:
             f.result()
         unsched = result.unschedulable_count()
@@ -266,20 +280,31 @@ class ProvisioningController:
 
     def _bind_from_groups(self, by_group: "dict[int, list[str]]",
                           group_counts: "dict[int, int]", node_name: str) -> None:
+        """Single-threaded path (existing nodes): pops from the shared
+        queues, then binds."""
+        take = {}
         for g_idx, count in group_counts.items():
             names = by_group.get(g_idx, [])
-            for pod_name in names[:count]:
+            take[g_idx] = names[:count]
+            by_group[g_idx] = names[count:]
+        self._bind_assigned(take, node_name)
+
+    def _bind_assigned(self, assigned: "dict[int, list[str]]",
+                       node_name: str) -> None:
+        for pod_names in assigned.values():
+            for pod_name in pod_names:
                 try:
                     self.kube.bind_pod(pod_name, node_name)
                     node = self.cluster.nodes.get(node_name)
                     pod = self.kube.get("pods", pod_name)
                     if node is not None and pod is not None:
                         node.pods.append(pod)
+                    self.pods_bound.inc(provisioner=(
+                        node.provisioner_name if node else ""))
                 except Exception as e:
                     log.warning("bind %s -> %s failed: %s", pod_name, node_name, e)
-            by_group[g_idx] = names[count:]
 
-    def _launch_node(self, solved, by_group, result: SolveResult) -> Optional[StateNode]:
+    def _launch_node(self, solved, assigned, result: SolveResult) -> Optional[StateNode]:
         prov: Provisioner = solved.provisioner
         if not self._within_limits(prov, solved):
             self.recorder.warning(
@@ -339,7 +364,7 @@ class ProvisioningController:
                              f"launched {machine.status.instance_type} in "
                              f"{machine.status.zone}")
         # bind this node's pods
-        self._bind_from_groups(by_group, dict(solved.pod_counts), node.name)
+        self._bind_assigned(assigned, node.name)
         return node
 
     def _machine_requests(self, solved, result: SolveResult) -> "dict[str, int]":

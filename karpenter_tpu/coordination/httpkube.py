@@ -28,6 +28,7 @@ import urllib.request
 from typing import Callable, Optional
 
 from ..fake.kube import Conflict, KubeStore
+from ..metrics import NAMESPACE, REGISTRY
 from . import serde
 
 log = logging.getLogger("karpenter.httpkube")
@@ -116,7 +117,7 @@ class HttpKubeStore:
 
     def __init__(self, server: str, token: Optional[str] = None,
                  verify_tls: bool = True, timeout: float = 10.0,
-                 ssl_context=None):
+                 ssl_context=None, registry=None):
         self.server = server.rstrip("/")
         self.token = token
         self.timeout = timeout
@@ -124,6 +125,18 @@ class HttpKubeStore:
         if self._ssl is None and server.startswith("https") and not verify_tls:
             self._ssl = ssl._create_unverified_context()
         self._cache = KubeStore()  # informer cache + watcher fan-out
+        # wire-client observability (designs/metrics.md): request outcomes
+        # at the HTTP boundary and watch reconnects — the dashboards' first
+        # question when a controller goes quiet is "is the watch alive".
+        # Injectable registry like every controller (tests isolate counts).
+        reg = registry if registry is not None else REGISTRY
+        self.requests_total = reg.counter(
+            f"{NAMESPACE}_coordination_requests_total",
+            "Coordination-plane HTTP requests.", ("method", "outcome"))
+        self.watch_restarts = reg.counter(
+            f"{NAMESPACE}_coordination_watch_restarts_total",
+            "Watch streams re-established (any cause incl. clean "
+            "server-side timeouts).", ("kind",))
         self._admission = None
         self._docs: "dict[tuple[str, str], dict]" = {}  # last manifest seen
         self._rv: "dict[tuple[str, str], int]" = {}     # last rv applied
@@ -166,10 +179,14 @@ class HttpKubeStore:
         except urllib.error.HTTPError as e:
             msg = e.read().decode(errors="replace")[:300]
             if e.code == 409:
+                self.requests_total.inc(method=method, outcome="conflict")
                 raise Conflict(msg)
+            self.requests_total.inc(method=method, outcome=f"http_{e.code}")
             raise ApiError(e.code, msg)
         except urllib.error.URLError as e:
+            self.requests_total.inc(method=method, outcome="unreachable")
             raise ApiError(0, f"apiserver unreachable: {e.reason}")
+        self.requests_total.inc(method=method, outcome="ok")
         return resp
 
     def _request_json(self, method, url, body=None):
@@ -219,7 +236,13 @@ class HttpKubeStore:
                 self._docs.pop((kind, name), None)
 
     def _watch_loop(self, kind: str) -> None:
+        attached_before = False
         while not self._stop.is_set():
+            if attached_before:
+                # ANY re-entry is a restart — kube-apiserver ends long
+                # watches with a clean close, which must count too
+                self.watch_restarts.inc(kind=kind)
+            attached_before = True
             try:
                 resp = self._request("GET", self._url(kind, query="watch=true"),
                                      timeout=86400)
