@@ -68,6 +68,10 @@ class Provisioner:
     consolidation_enabled: bool = False
     kubelet: KubeletConfiguration = dataclasses.field(default_factory=KubeletConfiguration)
     provider_ref: Optional[str] = None  # NodeTemplate name
+    # status.resources maintained by the counters controller
+    # (controllers/counters.py) — NOT part of the spec: excluded from the
+    # solver wire mapping, so status churn never invalidates solver caches
+    status_resources: "dict[str, str]" = dataclasses.field(default_factory=dict)
 
     def set_defaults(self) -> None:
         """Reference defaulting (v1alpha5/provisioner.go:45-60): default OS

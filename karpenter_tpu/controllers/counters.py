@@ -1,0 +1,64 @@
+"""Counters controller: live per-provisioner consumption in status.resources.
+
+Parity target: karpenter-core's counters controller (SURVEY.md §2.2; the
+reference's Provisioner carries status.resources maintained by a dedicated
+reconcile so `kubectl get provisioner -o yaml` shows what the pool
+consumes). The sums come from the SAME cluster-state source the limits
+gate reads (`ClusterState.total_usage`, designs/limits.md), so the
+displayed numbers and the enforcement numbers cannot disagree.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..models.cluster import ClusterState
+
+log = logging.getLogger("karpenter.counters")
+
+
+def _fmt_resources(cpu_millis: int, mem_bytes: int, nodes: int) -> "dict[str, str]":
+    return {
+        "cpu": f"{cpu_millis}m",
+        "memory": f"{mem_bytes // 2**20}Mi",
+        "nodes": str(nodes),
+    }
+
+
+class CountersController:
+    def __init__(self, kube, cluster: ClusterState):
+        self.kube = kube
+        self.cluster = cluster
+
+    def reconcile_once(self) -> "list[str]":
+        """Write status.resources for every provisioner whose consumption
+        changed; returns the names updated."""
+        import dataclasses
+
+        node_counts: "dict[str, int]" = {}
+        for node in self.cluster.nodes.values():
+            if node.provisioner_name:
+                node_counts[node.provisioner_name] = \
+                    node_counts.get(node.provisioner_name, 0) + 1
+        updated = []
+        for prov in self.kube.provisioners():
+            cpu, mem = self.cluster.total_usage(prov.name)
+            want = _fmt_resources(cpu, mem, node_counts.get(prov.name, 0))
+            if prov.status_resources == want:
+                continue
+            # Write a COPY via CAS against the object we read:
+            # - never mutate the shared informer-cache object (a failed
+            #   write would leave the cache claiming the new status and the
+            #   equality early-out would skip the retry forever);
+            # - CAS so a concurrent user edit to the spec raises Conflict
+            #   instead of being clobbered by our stale read (the
+            #   read-modify-write rule every status writer here follows).
+            fresh = dataclasses.replace(prov, status_resources=want)
+            try:
+                self.kube.compare_and_swap("provisioners", prov.name,
+                                           prov, fresh)
+                updated.append(prov.name)
+            except Exception as e:  # conflict/transient: next sweep converges
+                log.debug("counters update %s failed: %s", prov.name, e)
+        return updated

@@ -29,16 +29,23 @@ class NodeTemplateController:
         self._last_seen: "dict[str, tuple[int, float]]" = {}
 
     def reconcile(self, template: NodeTemplate) -> NodeTemplate:
+        import dataclasses
+
         subnets = self.subnets.list(template.subnet_selector)
         subnets = sorted(subnets, key=lambda s: -s.free_ips)  # most-free first
         sg_ids = self.security_groups.ids(template.security_group_selector) \
             if template.security_group_selector else []
-        template.status = NodeTemplateStatus(
+        # CAS on a COPY (the read-modify-write rule for status writers,
+        # controllers/counters.py): never mutate the shared informer-cache
+        # object, and never clobber a concurrent user edit with our stale
+        # read — a Conflict just retries on the next sweep.
+        fresh = dataclasses.replace(template, status=NodeTemplateStatus(
             subnets=[{"id": s.id, "zone": s.zone} for s in subnets],
             security_groups=sg_ids,
-        )
-        self.kube.update("nodetemplates", template.name, template)
-        return template
+        ))
+        self.kube.compare_and_swap("nodetemplates", template.name,
+                                   template, fresh)
+        return fresh
 
     def reconcile_once(self) -> int:
         """Generation-change predicate + periodic requeue."""

@@ -176,8 +176,102 @@ def to_manifest(kind: str, name: str, obj) -> dict:
     if kind == "nodes" and isinstance(obj, StateNode):
         doc["metadata"]["labels"] = dict(obj.labels)
         doc["spec"] = {"providerID": obj.provider_id}
+    if kind == "provisioners" and isinstance(obj, Provisioner):
+        # REAL-schema spec, not just the embedded model: the counters
+        # controller PUTs whole provisioner objects, and against an
+        # apiserver that prunes unknown fields a spec-less write would
+        # destroy the user's configuration (the CRD also preserves unknown
+        # fields at the root for the embedding, but real-schema fidelity is
+        # what kubectl users read back)
+        doc["spec"] = _provisioner_spec(obj)
+        if obj.status_resources:
+            # counters-controller consumption (kubectl-visible)
+            doc["status"] = {"resources": dict(obj.status_resources)}
     doc[MODEL_KEY] = encode(obj)
     return doc
+
+
+def _fmt_bytes(n: int) -> str:
+    """Exact k8s quantity: Mi only when lossless, else plain bytes — a
+    floor-divided Mi would silently shrink non-Mi-multiple user values on
+    the pruning-apiserver round trip."""
+    if n % 2**20 == 0:
+        return f"{n // 2**20}Mi"
+    return str(n)
+
+
+def _provisioner_spec(p: Provisioner) -> dict:
+    """Inverse of yaml_compat._provisioner: the REAL v1alpha5 spec schema.
+    Round-trip property: _provisioner(to_manifest(p)) == p up to
+    set_defaults (tested in test_httpkube serde suite)."""
+    def req_items(reqs: Requirements) -> "list[dict]":
+        # to_specs() is THE canonical serializer (merged Exists∩NotIn emits
+        # the NotIn+Exists pair, In [] stays match-nothing, bounds fold) —
+        # re-implementing it here is how presence/emptiness semantics get
+        # silently dropped on the pruning-apiserver path
+        items = []
+        for key, op, values in reqs.to_specs():
+            item = {"key": key, "operator": op}
+            if values or op in ("In", "NotIn"):
+                item["values"] = list(values)
+            items.append(item)
+        return items
+
+    def taint_items(taints) -> "list[dict]":
+        return [{"key": t.key, **({"value": t.value} if t.value else {}),
+                 "effect": t.effect} for t in taints]
+
+    spec: dict = {"requirements": req_items(p.requirements)}
+    if p.taints:
+        spec["taints"] = taint_items(p.taints)
+    if p.startup_taints:
+        spec["startupTaints"] = taint_items(p.startup_taints)
+    if p.labels:
+        spec["labels"] = dict(p.labels)
+    limits = {}
+    if p.limits.cpu_millis is not None:
+        limits["cpu"] = f"{p.limits.cpu_millis}m"
+    if p.limits.memory_bytes is not None:
+        limits["memory"] = _fmt_bytes(p.limits.memory_bytes)
+    if limits:
+        spec["limits"] = {"resources": limits}
+    if p.weight:
+        spec["weight"] = p.weight
+    if p.ttl_seconds_after_empty is not None:
+        spec["ttlSecondsAfterEmpty"] = p.ttl_seconds_after_empty
+    if p.ttl_seconds_until_expired is not None:
+        spec["ttlSecondsUntilExpired"] = p.ttl_seconds_until_expired
+    if p.consolidation_enabled:
+        spec["consolidation"] = {"enabled": True}
+    k = p.kubelet
+    kube: dict = {}
+    if k.max_pods is not None:
+        kube["maxPods"] = k.max_pods
+    if k.pods_per_core is not None:
+        kube["podsPerCore"] = k.pods_per_core
+    if k.system_reserved_cpu_millis or k.system_reserved_memory_bytes:
+        kube["systemReserved"] = {
+            **({"cpu": f"{k.system_reserved_cpu_millis}m"}
+               if k.system_reserved_cpu_millis else {}),
+            **({"memory": _fmt_bytes(k.system_reserved_memory_bytes)}
+               if k.system_reserved_memory_bytes else {}),
+        }
+    if k.kube_reserved_cpu_millis is not None or \
+            k.kube_reserved_memory_bytes is not None:
+        kube["kubeReserved"] = {
+            **({"cpu": f"{k.kube_reserved_cpu_millis}m"}
+               if k.kube_reserved_cpu_millis is not None else {}),
+            **({"memory": _fmt_bytes(k.kube_reserved_memory_bytes)}
+               if k.kube_reserved_memory_bytes is not None else {}),
+        }
+    if k.eviction_hard_memory_bytes != 100 * 2**20:
+        kube["evictionHard"] = {
+            "memory.available": _fmt_bytes(k.eviction_hard_memory_bytes)}
+    if kube:
+        spec["kubeletConfiguration"] = kube
+    if p.provider_ref:
+        spec["providerRef"] = {"name": p.provider_ref}
+    return spec
 
 
 def from_manifest(kind: str, doc: dict):
@@ -208,7 +302,11 @@ def _parse_k8s(kind: str, doc: dict):
             pod = dataclasses.replace(pod, node_name=node_name)
         return pod
     if kind == "provisioners":
-        return yc._provisioner(doc)
+        p = yc._provisioner(doc)
+        res = (doc.get("status") or {}).get("resources")
+        if res:
+            p.status_resources = {k: str(v) for k, v in res.items()}
+        return p
     if kind == "nodetemplates":
         return yc._nodetemplate(doc)
     if kind == "pdbs":

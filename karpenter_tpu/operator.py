@@ -15,6 +15,7 @@ from typing import Optional
 from .apis.settings import Settings
 from .cloudprovider import CloudProvider
 from .controllers.deprovisioning import DeprovisioningController
+from .controllers.counters import CountersController
 from .controllers.garbagecollection import GarbageCollectionController
 from .controllers.interruption import FakeQueue, InterruptionController
 from .controllers.machinehydration import MachineHydrationController
@@ -129,6 +130,7 @@ class Operator:
         self.garbagecollection = GarbageCollectionController(
             self.kube, self.cloudprovider, clock=self.clock,
             cluster=self.cluster, termination=self.termination)
+        self.counters = CountersController(self.kube, self.cluster)
         self.interruption = None
         if settings.interruption_queue_name:
             self.queue = queue or FakeQueue(settings.interruption_queue_name,
@@ -246,6 +248,7 @@ class Operator:
         loop("nodetemplate", self.nodetemplate.reconcile_once, 5.0)
         loop("machinehydration", self.machinehydration.reconcile_once, 5.0)
         loop("garbagecollection", self.garbagecollection.reconcile_once, 60.0)
+        loop("counters", self.counters.reconcile_once, 5.0)
         if self.interruption is not None:
             t2 = threading.Thread(target=self.interruption.run,
                                   args=(self._stop, self.elected),
@@ -295,3 +298,4 @@ class Operator:
             self.interruption.reconcile_once()
         self.deprovisioning.reconcile_once()
         self.termination.reconcile_once()
+        self.counters.reconcile_once()

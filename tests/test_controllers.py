@@ -800,3 +800,32 @@ def test_cleanup_cli_reaps_persisted_leaks(capsys, tmp_path):
     assert main(["cleanup"]) == 2
     assert main(["cleanup", "--state", str(tmp_path / "typo.json")]) == 2
     assert not (tmp_path / "typo.json").exists()
+
+
+def test_counters_controller_maintains_provisioner_status_resources(op):
+    """kubectl-visible consumption (core counters-controller parity): after
+    provisioning, each provisioner's status.resources carries the same sums
+    the limits gate reads; consumption changes update it."""
+    from karpenter_tpu.coordination import serde
+
+    add_provisioner(op)
+    for i in range(4):
+        op.kube.create("pods", f"cnt-{i}",
+                       make_pod(f"cnt-{i}", cpu="1", memory="1Gi"))
+    op.reconcile_all_once()
+    prov = op.kube.get("provisioners", "default")
+    res = prov.status_resources
+    assert res and res["nodes"] != "0"
+    cpu, mem = op.cluster.total_usage("default")
+    assert res["cpu"] == f"{cpu}m"
+    assert res["memory"] == f"{mem // 2**20}Mi"
+    # kubectl sees it in real schema, not just the embedded model
+    doc = serde.to_manifest("provisioners", "default", prov)
+    assert doc["status"]["resources"] == res
+    # consumption changes flow through on the next sweep
+    for name in list(op.cluster.nodes):
+        op.termination.request_deletion(name)
+    op.reconcile_all_once()
+    op.reconcile_all_once()
+    prov2 = op.kube.get("provisioners", "default")
+    assert prov2.status_resources["nodes"] == "0"

@@ -334,3 +334,74 @@ class TestReviewHardening:
         assert a.delete_if("leases", "l", ours) is False
         assert "l" in state.bucket("leases")
         a.stop()
+
+
+class TestProvisionerWireFidelity:
+    def test_spec_survives_a_pruning_apiserver_round_trip(self):
+        """A provisioner written by the counters controller must read back
+        with the user's spec intact even when the server PRUNES the
+        embedded model (the foreign-apiserver failure mode: a spec-less
+        PUT would destroy the user's configuration)."""
+        from karpenter_tpu.apis import wellknown as wk
+        from karpenter_tpu.apis.provisioner import Limits, Provisioner
+        from karpenter_tpu.models.pod import Taint
+        from karpenter_tpu.models.requirements import (OP_GT, OP_IN,
+                                                       OP_NOT_IN,
+                                                       Requirements)
+
+        p = Provisioner(
+            name="full", weight=30,
+            requirements=Requirements.of(
+                (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot"]),
+                (wk.LABEL_ZONE, OP_NOT_IN, ["zone-1c"]),
+                ("karpenter.k8s.tpu/instance-cpu", OP_GT, ["15"]),
+            ),
+            taints=(Taint(key="team", value="ml", effect="NoSchedule"),),
+            labels=(("tier", "batch"),),
+            limits=Limits(cpu_millis=100_000, memory_bytes=400 * 2**30),
+            ttl_seconds_until_expired=2_592_000,
+            consolidation_enabled=True,
+            provider_ref="default",
+        )
+        p.set_defaults()
+        p.status_resources = {"cpu": "4000m", "memory": "8192Mi",
+                              "nodes": "2"}
+        doc = serde.to_manifest("provisioners", "full", p)
+        doc.pop(serde.MODEL_KEY)  # the pruning apiserver drops it
+        back = serde.from_manifest("provisioners", doc)
+        assert back.weight == 30
+        assert back.limits.cpu_millis == 100_000
+        assert back.limits.memory_bytes == 400 * 2**30
+        assert back.ttl_seconds_after_empty is None
+        assert back.ttl_seconds_until_expired == 2_592_000
+        assert back.consolidation_enabled
+        assert back.provider_ref == "default"
+        assert back.taints == p.taints
+        assert dict(back.labels)["tier"] == "batch"
+        assert back.status_resources == p.status_resources
+        # requirement semantics identical (set-form comparison)
+        for key in (wk.LABEL_CAPACITY_TYPE, wk.LABEL_ZONE,
+                    "karpenter.k8s.tpu/instance-cpu"):
+            assert back.requirements.get(key) == p.requirements.get(key), key
+
+    def test_merged_and_exact_quantities_survive_pruning(self):
+        """The adversarial corners: a merged Exists∩NotIn requirement must
+        keep its presence demand, and non-Mi-multiple memory quantities
+        must not shrink, across a model-pruning round trip."""
+        from karpenter_tpu.apis.provisioner import Limits, Provisioner
+        from karpenter_tpu.models.requirements import (OP_EXISTS, OP_NOT_IN,
+                                                       Requirement,
+                                                       Requirements)
+
+        reqs = Requirements()
+        reqs.add(Requirement.create("team", OP_EXISTS, []))
+        reqs.add(Requirement.create("team", OP_NOT_IN, ["a"]))
+        p = Provisioner(name="corner", requirements=reqs,
+                        limits=Limits(memory_bytes=100_000_000))
+        doc = serde.to_manifest("provisioners", "corner", p)
+        doc.pop(serde.MODEL_KEY)
+        back = serde.from_manifest("provisioners", doc)
+        got = back.requirements.get("team")
+        assert got == p.requirements.get("team")
+        assert got.requires_presence
+        assert back.limits.memory_bytes == 100_000_000
