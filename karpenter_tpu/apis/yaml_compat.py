@@ -43,10 +43,18 @@ FAMILY_MAP = {
     "Ubuntu": "ubuntu-k8s",
     "Bottlerocket": "flatboat",
     "Custom": "custom",
+    # native names round-trip as themselves (serde emits these; users may
+    # also write them directly)
+    "ubuntu-k8s": "ubuntu-k8s",
+    "Flatboat": "flatboat",
+    "flatboat": "flatboat",
+    "custom": "custom",
 }
 # EBS volume types -> our volume classes
 VOLUME_MAP = {"gp2": "ssd", "gp3": "ssd", "io1": "ssd", "io2": "ssd",
-              "st1": "throughput", "sc1": "throughput", "standard": "balanced"}
+              "st1": "throughput", "sc1": "throughput", "standard": "balanced",
+              # native classes round-trip as themselves
+              "ssd": "ssd", "throughput": "throughput", "balanced": "balanced"}
 
 # the reference's provider label namespace -> ours (same suffixes:
 # instance-family/-size/-cpu/..., apis/wellknown.py)
@@ -185,6 +193,7 @@ def _nodetemplate(doc) -> NodeTemplate:
             http_endpoint=md.get("httpEndpoint", "enabled"),
             http_tokens=md.get("httpTokens", "required"),
             http_put_response_hop_limit=int(md.get("httpPutResponseHopLimit", 2)),
+            http_protocol_ipv6=md.get("httpProtocolIPv6", "disabled"),
         ),
         block_device_mappings=tuple(bdms),
         detailed_monitoring=bool(spec.get("detailedMonitoring", False)),

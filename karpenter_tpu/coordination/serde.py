@@ -176,6 +176,16 @@ def to_manifest(kind: str, name: str, obj) -> dict:
     if kind == "nodes" and isinstance(obj, StateNode):
         doc["metadata"]["labels"] = dict(obj.labels)
         doc["spec"] = {"providerID": obj.provider_id}
+    if kind == "nodetemplates" and isinstance(obj, NodeTemplate):
+        # real-schema spec+status: the nodetemplate controller PUTs whole
+        # objects for status; a spec-less write against a pruning apiserver
+        # must not blank the user's kubectl-visible configuration
+        doc["spec"] = _nodetemplate_spec(obj)
+        if obj.status.subnets or obj.status.security_groups:
+            doc["status"] = {
+                "subnets": [dict(s) for s in obj.status.subnets],
+                "securityGroups": list(obj.status.security_groups),
+            }
     if kind == "provisioners" and isinstance(obj, Provisioner):
         # REAL-schema spec, not just the embedded model: the counters
         # controller PUTs whole provisioner objects, and against an
@@ -274,6 +284,45 @@ def _provisioner_spec(p: Provisioner) -> dict:
     return spec
 
 
+def _nodetemplate_spec(t: NodeTemplate) -> dict:
+    """Inverse of yaml_compat._nodetemplate (native family/volume names —
+    the parser maps both the reference's flavor and ours)."""
+    spec: dict = {"amiFamily": t.image_family}
+    if t.instance_profile:
+        spec["instanceProfile"] = t.instance_profile
+    if t.subnet_selector:
+        spec["subnetSelector"] = dict(t.subnet_selector)
+    if t.security_group_selector:
+        spec["securityGroupSelector"] = dict(t.security_group_selector)
+    if t.image_selector:
+        spec["amiSelector"] = dict(t.image_selector)
+    if t.userdata:
+        spec["userData"] = t.userdata
+    if t.tags:
+        spec["tags"] = dict(t.tags)
+    if t.launch_template_name:
+        spec["launchTemplate"] = t.launch_template_name
+    md = t.metadata_options
+    if not md.is_default():  # ALL fields, not a hand-picked subset
+        spec["metadataOptions"] = {
+            "httpEndpoint": md.http_endpoint,
+            "httpTokens": md.http_tokens,
+            "httpPutResponseHopLimit": md.http_put_response_hop_limit,
+            "httpProtocolIPv6": md.http_protocol_ipv6,
+        }
+    if t.block_device_mappings:
+        spec["blockDeviceMappings"] = [
+            {"deviceName": b.device_name,
+             "ebs": {"volumeSize": f"{b.volume_size_gib}Gi",
+                     "volumeType": b.volume_type,
+                     "encrypted": b.encrypted,
+                     **({"iops": b.iops} if b.iops else {})}}
+            for b in t.block_device_mappings]
+    if t.detailed_monitoring:
+        spec["detailedMonitoring"] = True
+    return spec
+
+
 def from_manifest(kind: str, doc: dict):
     """Manifest -> model. Embedded model wins (lossless); otherwise parse
     the real k8s schema via yaml_compat (kubectl-authored objects)."""
@@ -301,14 +350,21 @@ def _parse_k8s(kind: str, doc: dict):
         if node_name:
             pod = dataclasses.replace(pod, node_name=node_name)
         return pod
+    if kind == "nodetemplates":
+        t = yc._nodetemplate(doc)
+        st = doc.get("status") or {}
+        if st.get("subnets") or st.get("securityGroups"):
+            t.status = NodeTemplateStatus(
+                subnets=[dict(s) for s in st.get("subnets") or []],
+                security_groups=list(st.get("securityGroups") or []),
+            )
+        return t
     if kind == "provisioners":
         p = yc._provisioner(doc)
         res = (doc.get("status") or {}).get("resources")
         if res:
             p.status_resources = {k: str(v) for k, v in res.items()}
         return p
-    if kind == "nodetemplates":
-        return yc._nodetemplate(doc)
     if kind == "pdbs":
         return yc._pdb(doc, [doc])
     if kind == "nodes":

@@ -405,3 +405,48 @@ class TestProvisionerWireFidelity:
         assert got == p.requirements.get("team")
         assert got.requires_presence
         assert back.limits.memory_bytes == 100_000_000
+
+    def test_nodetemplate_spec_survives_a_pruning_apiserver_round_trip(self):
+        """The nodetemplate controller PUTs whole objects for status; the
+        user's spec must survive model pruning, including native
+        family/volume names."""
+        from karpenter_tpu.apis.nodetemplate import (BlockDeviceMapping,
+                                                     NodeTemplate,
+                                                     NodeTemplateStatus)
+
+        from karpenter_tpu.apis.nodetemplate import MetadataOptions
+
+        t = NodeTemplate(
+            name="rt", image_family="flatboat",
+            subnet_selector={"karpenter.sh/discovery": "demo"},
+            security_group_selector={"karpenter.sh/discovery": "demo"},
+            image_selector={"name": "node-image-*"},
+            userdata="[settings.kubernetes]\ncluster-name = 'demo'\n",
+            instance_profile="KarpenterNodeRole",
+            tags={"team": "ml"},
+            metadata_options=MetadataOptions(http_protocol_ipv6="enabled"),
+            block_device_mappings=(BlockDeviceMapping(
+                device_name="/dev/xvdb", volume_size_gib=500,
+                volume_type="throughput", encrypted=True),),
+            detailed_monitoring=True,
+        )
+        t.status = NodeTemplateStatus(
+            subnets=[{"id": "subnet-zone-1a", "zone": "zone-1a"}],
+            security_groups=["sg-default"],
+        )
+        doc = serde.to_manifest("nodetemplates", "rt", t)
+        doc.pop(serde.MODEL_KEY)
+        back = serde.from_manifest("nodetemplates", doc)
+        assert back.image_family == "flatboat"
+        assert back.subnet_selector == t.subnet_selector
+        assert back.image_selector == t.image_selector
+        assert back.tags == t.tags
+        assert back.detailed_monitoring
+        b = back.block_device_mappings[0]
+        assert (b.device_name, b.volume_size_gib, b.volume_type) == \
+            ("/dev/xvdb", 500, "throughput")
+        assert back.status.subnets == t.status.subnets
+        assert back.status.security_groups == t.status.security_groups
+        assert back.metadata_options == t.metadata_options  # incl. ipv6
+        assert back.userdata == t.userdata
+        assert back.instance_profile == t.instance_profile
