@@ -176,6 +176,23 @@ def to_manifest(kind: str, name: str, obj) -> dict:
     if kind == "nodes" and isinstance(obj, StateNode):
         doc["metadata"]["labels"] = dict(obj.labels)
         doc["spec"] = {"providerID": obj.provider_id}
+    if kind == "machines" and isinstance(obj, Machine):
+        # real-schema status for kubectl UX: the machines CRD's printer
+        # columns read .status.providerID/.status.phase (deploy/crds);
+        # the exact model stays embedded (CRD root preserves unknowns)
+        doc["metadata"]["labels"] = dict(obj.labels)
+        doc["spec"] = {
+            "provisionerName": obj.spec.provisioner_name,
+            "machineTemplateRef": obj.spec.machine_template_ref,
+        }
+        doc["status"] = {
+            "providerID": obj.status.provider_id,
+            "phase": obj.status.state,
+            "instanceType": obj.status.instance_type,
+            "zone": obj.status.zone,
+            "capacityType": obj.status.capacity_type,
+            "nodeName": obj.status.node_name,
+        }
     if kind == "nodetemplates" and isinstance(obj, NodeTemplate):
         # real-schema spec+status: the nodetemplate controller PUTs whole
         # objects for status; a spec-less write against a pruning apiserver

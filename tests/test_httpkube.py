@@ -411,10 +411,9 @@ class TestProvisionerWireFidelity:
         user's spec must survive model pruning, including native
         family/volume names."""
         from karpenter_tpu.apis.nodetemplate import (BlockDeviceMapping,
+                                                     MetadataOptions,
                                                      NodeTemplate,
                                                      NodeTemplateStatus)
-
-        from karpenter_tpu.apis.nodetemplate import MetadataOptions
 
         t = NodeTemplate(
             name="rt", image_family="flatboat",
@@ -439,6 +438,7 @@ class TestProvisionerWireFidelity:
         back = serde.from_manifest("nodetemplates", doc)
         assert back.image_family == "flatboat"
         assert back.subnet_selector == t.subnet_selector
+        assert back.security_group_selector == t.security_group_selector
         assert back.image_selector == t.image_selector
         assert back.tags == t.tags
         assert back.detailed_monitoring
@@ -450,3 +450,26 @@ class TestProvisionerWireFidelity:
         assert back.metadata_options == t.metadata_options  # incl. ipv6
         assert back.userdata == t.userdata
         assert back.instance_profile == t.instance_profile
+
+    def test_machine_status_printer_columns_in_real_schema(self):
+        """kubectl get machines reads .status.providerID/.status.phase via
+        the CRD printer columns — the wire manifest must carry them in
+        real schema, not only inside the embedded model."""
+        from karpenter_tpu.models.machine import (LAUNCHED, Machine,
+                                                  MachineSpec, MachineStatus)
+
+        m = Machine(name="m-1", spec=MachineSpec(provisioner_name="default"),
+                    status=MachineStatus(provider_id="tpu://i-001",
+                                         state=LAUNCHED,
+                                         instance_type="m.large",
+                                         zone="zone-1a",
+                                         capacity_type="spot",
+                                         node_name="ip-10-0-0-1.internal"))
+        doc = serde.to_manifest("machines", "m-1", m)
+        assert doc["status"]["providerID"] == "tpu://i-001"
+        assert doc["status"]["phase"] == LAUNCHED
+        assert doc["status"]["nodeName"] == "ip-10-0-0-1.internal"
+        assert doc["spec"]["provisionerName"] == "default"
+        # embedded model still round-trips exactly
+        back = serde.from_manifest("machines", doc)
+        assert back.status == m.status and back.spec == m.spec
