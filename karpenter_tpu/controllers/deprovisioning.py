@@ -231,6 +231,12 @@ class DeprovisioningController:
                     if node is not None:
                         node.marked_for_deletion = False
                         node.deletion_requested_ts = 0.0
+                    try:
+                        # clear the server-side cordon too, or a real
+                        # scheduler shuns this healthy node forever
+                        self.kube.uncordon_node(done)
+                    except Exception as e:
+                        log.warning("uncordon %s failed: %s", done, e)
                 log.warning("consolidation aborted: %s not deletable", n)
                 return False
             if status == self.termination.MARKED_NEW:

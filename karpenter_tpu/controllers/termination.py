@@ -57,6 +57,13 @@ class TerminationController:
             return self.MARKED_ALREADY
         node.marked_for_deletion = True
         node.deletion_requested_ts = self.clock.now()
+        try:
+            # server-side cordon: on a real cluster kube-scheduler must
+            # stop targeting the draining node (spec.unschedulable);
+            # best-effort — our own solver already excludes marked nodes
+            self.kube.cordon_node(node_name)
+        except Exception as e:
+            log.warning("cordon %s failed: %s", node_name, e)
         self.recorder.normal(f"node/{node_name}", "TerminationRequested",
                              "node marked for deletion")
         return self.MARKED_NEW

@@ -167,10 +167,11 @@ class HttpKubeStore:
         return self.server + path
 
     def _request(self, method: str, url: str, body: "Optional[dict]" = None,
-                 timeout: "Optional[float]" = None):
+                 timeout: "Optional[float]" = None,
+                 content_type: str = "application/json"):
         data = None if body is None else json.dumps(body).encode()
         req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Content-Type", "application/json")
+        req.add_header("Content-Type", content_type)
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         try:
@@ -189,8 +190,10 @@ class HttpKubeStore:
         self.requests_total.inc(method=method, outcome="ok")
         return resp
 
-    def _request_json(self, method, url, body=None):
-        with self._request(method, url, body) as resp:
+    def _request_json(self, method, url, body=None,
+                      content_type: str = "application/json"):
+        with self._request(method, url, body,
+                           content_type=content_type) as resp:
             return json.loads(resp.read() or b"{}")
 
     # -- informer lifecycle ----------------------------------------------------
@@ -427,6 +430,30 @@ class HttpKubeStore:
         return self._cache.pdbs()
 
     # -- subresources ----------------------------------------------------------
+
+    def cordon_node(self, name: str) -> None:
+        """Server-side cordon: a merge-PATCH (RFC 7386) of ONLY
+        spec.unschedulable, so the kubelet-owned Node object is never
+        replaced wholesale — the real kube-scheduler must stop targeting
+        a draining node. NB merge-patch replaces list fields wholesale;
+        never extend this to taints without strategic-merge."""
+        self._patch_unschedulable(name, True)
+
+    def uncordon_node(self, name: str) -> None:
+        """Roll back a cordon (consolidation revalidation failure): the
+        node stays in service, so spec.unschedulable must clear or the
+        real scheduler would shun healthy capacity forever."""
+        self._patch_unschedulable(name, None)  # merge-patch null deletes
+
+    def _patch_unschedulable(self, name: str, value) -> None:
+        doc = self._request_json(
+            "PATCH", self._url("nodes", name),
+            {"spec": {"unschedulable": value}},
+            content_type="application/merge-patch+json")
+        # same read-your-writes path as every other write: record rv + doc
+        # and refresh the cache object (fires cache watchers); the watch
+        # echo then dedupes by resourceVersion
+        self._apply_manifest("nodes", "MODIFIED", doc, notify=True)
 
     def bind_pod(self, pod_name: str, node_name: str) -> None:
         self._request_json(

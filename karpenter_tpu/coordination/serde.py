@@ -176,6 +176,10 @@ def to_manifest(kind: str, name: str, obj) -> dict:
     if kind == "nodes" and isinstance(obj, StateNode):
         doc["metadata"]["labels"] = dict(obj.labels)
         doc["spec"] = {"providerID": obj.provider_id}
+        if obj.marked_for_deletion:
+            # server-side cordon: a real kube-scheduler must stop
+            # targeting a draining node (designs/termination.md step 1)
+            doc["spec"]["unschedulable"] = True
     if kind == "machines" and isinstance(obj, Machine):
         # real-schema status for kubectl UX: the machines CRD's printer
         # columns read .status.providerID/.status.phase (deploy/crds);
@@ -354,6 +358,12 @@ def from_manifest(kind: str, doc: dict):
             node_name = (doc.get("spec") or {}).get("nodeName", "")
             if node_name != obj.node_name:
                 obj = dataclasses.replace(obj, node_name=node_name)
+        if kind == "nodes":
+            # cordon/uncordon PATCH spec.unschedulable without rewriting
+            # the embedded model — the server spec is authoritative, else
+            # the watch echo would revert the cordon in every peer's cache
+            obj.marked_for_deletion = bool(
+                (doc.get("spec") or {}).get("unschedulable", False))
         return obj
     return _parse_k8s(kind, doc)
 
@@ -450,6 +460,7 @@ def _parse_k8s_node(doc: dict) -> StateNode:
         for t in spec.get("taints") or ())
     return StateNode(
         name=meta.get("name", ""), labels=labels,
+        marked_for_deletion=bool(spec.get("unschedulable", False)),
         allocatable=wk.capacity_vector(caps),
         provider_id=spec.get("providerID", ""),
         instance_type=labels.get(wk.LABEL_INSTANCE_TYPE, ""),

@@ -209,6 +209,53 @@ class _Handler(BaseHTTPRequestHandler):
             st.notify(plural, "MODIFIED" if cur is not None else "ADDED", body)
         return self._json(200, body)
 
+    def do_PATCH(self):
+        """application/merge-patch+json (RFC 7386): recursive merge, null
+        deletes a key — the subset real clients (and HttpKubeStore's
+        cordon) use. A /status PATCH scopes to the status portion like the
+        real subresource; other content types get 415."""
+        r = self._route()
+        if r is None or r[1] is None:
+            return self._error(404, "NotFound", self.path)
+        plural, name, sub, _ = r
+        if self.headers.get("Content-Type") != "application/merge-patch+json":
+            return self._error(
+                415, "UnsupportedMediaType",
+                "only application/merge-patch+json is implemented")
+        if sub not in (None, "", "status"):
+            return self._error(405, "MethodNotAllowed",
+                               f"PATCH on subresource {sub!r} not supported")
+        st = self.state
+        patch = self._read_body()
+        if not isinstance(patch, dict):
+            return self._error(415, "UnsupportedMediaType",
+                               "merge-patch body must be a JSON object")
+        if sub == "status":
+            patch = {"status": patch.get("status", {})}
+
+        def merge(base, over):
+            out = dict(base)
+            for k, v in over.items():
+                if v is None:
+                    out.pop(k, None)
+                elif isinstance(v, dict) and isinstance(out.get(k), dict):
+                    out[k] = merge(out[k], v)
+                else:
+                    out[k] = v
+            return out
+
+        with st.lock:
+            bucket = st.bucket(plural)
+            cur = bucket.get(name)
+            if cur is None:
+                return self._error(404, "NotFound", f"{plural}/{name}")
+            body = merge(cur, patch)
+            body.setdefault("metadata", {})["name"] = name
+            body["metadata"]["resourceVersion"] = st.next_rv()
+            bucket[name] = body
+            st.notify(plural, "MODIFIED", body)
+        return self._json(200, body)
+
     def do_DELETE(self):
         r = self._route()
         if r is None or r[1] is None:

@@ -137,6 +137,23 @@ class KubeStore:
     def daemon_pods(self) -> "list[PodSpec]":
         return [p for p in self.pods() if p.is_daemon()]
 
+    def cordon_node(self, name: str) -> None:
+        """Server-side cordon analogue: flips the stored node's deletion
+        mark (our model's unschedulable bit) and notifies watchers. Over
+        HttpKubeStore this is a spec.unschedulable merge-PATCH."""
+        self._set_unschedulable(name, True)
+
+    def uncordon_node(self, name: str) -> None:
+        self._set_unschedulable(name, False)
+
+    def _set_unschedulable(self, name: str, value: bool) -> None:
+        with self._lock:
+            node = self._objects["nodes"].get(name)
+            if node is not None:
+                node.marked_for_deletion = value
+        if node is not None:
+            self._notify("nodes", "modified", node)
+
     def bind_pod(self, pod_name: str, node_name: str) -> None:
         import dataclasses
 

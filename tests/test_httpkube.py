@@ -473,3 +473,36 @@ class TestProvisionerWireFidelity:
         # embedded model still round-trips exactly
         back = serde.from_manifest("machines", doc)
         assert back.status == m.status and back.spec == m.spec
+
+    def test_cordon_reaches_the_apiserver_as_spec_unschedulable(self, api):
+        """Marking a node for deletion must cordon it SERVER-SIDE
+        (spec.unschedulable merge-PATCH): on a real cluster kube-scheduler
+        keeps scheduling onto a node our solver merely stopped using."""
+        base, state = api
+        kube = HttpKubeStore(base)
+        kube.start()
+        try:
+            from karpenter_tpu.models.cluster import StateNode
+
+            node = StateNode(name="n-cordon", labels={}, allocatable=[0] * 8,
+                             provider_id="tpu://i-1")
+            kube.create("nodes", "n-cordon", node)
+            kube.cordon_node("n-cordon")
+            doc = state.bucket("nodes")["n-cordon"]
+            assert doc["spec"].get("unschedulable") is True, doc["spec"]
+            # the informer cache reflects it without waiting for the echo
+            cached = kube.get("nodes", "n-cordon")
+            assert cached.marked_for_deletion
+            # the embedded model in the PATCHed doc is STALE (it predates
+            # the cordon); the spec override must survive a full relist
+            # (the self-undoing-echo regression)
+            kube._relist("nodes")
+            assert kube.get("nodes", "n-cordon").marked_for_deletion
+            # rollback: uncordon clears server spec AND cache
+            kube.uncordon_node("n-cordon")
+            doc = state.bucket("nodes")["n-cordon"]
+            assert "unschedulable" not in doc["spec"], doc["spec"]
+            kube._relist("nodes")
+            assert not kube.get("nodes", "n-cordon").marked_for_deletion
+        finally:
+            kube.stop()
