@@ -584,3 +584,68 @@ class TestRound4Races:
         fresh = FakeCloud()
         fresh.load_state(path)
         assert len(fresh.instances) == 20
+
+
+class TestSerdeFuzz:
+    """Differential fuzz for the wire-fidelity layer: ANY valid provisioner
+    must survive to_manifest -> model-pruning -> from_manifest with
+    identical scheduling semantics (the real-apiserver path the counters
+    controller writes through)."""
+
+    @settings(max_examples=120, deadline=None)
+    @given(st.data())
+    def test_provisioner_pruning_round_trip(self, data):
+        from karpenter_tpu.apis.provisioner import Limits, Provisioner
+        from karpenter_tpu.coordination import serde
+        from karpenter_tpu.models.pod import Taint
+        from karpenter_tpu.models.requirements import (
+            IncompatibleError, Requirement)
+
+        keys = ["team", "tier", wk.LABEL_ZONE, wk.LABEL_CAPACITY_TYPE,
+                "karpenter.k8s.tpu/instance-cpu"]
+        reqs = Requirements()
+        for key in data.draw(st.lists(st.sampled_from(keys), unique=True,
+                                      max_size=4)):
+            numeric = key.endswith("instance-cpu")
+            op = data.draw(st.sampled_from(
+                ["In", "NotIn", "Exists", "Gt", "Lt"] if numeric
+                else ["In", "NotIn", "Exists", "DoesNotExist"]))
+            values: "list[str]" = []
+            if op in ("In", "NotIn"):
+                values = [str(v) for v in data.draw(st.lists(
+                    st.integers(0, 99) if numeric
+                    else st.sampled_from(["a", "b", "zone-1a", "spot",
+                                          "on-demand"]),
+                    min_size=0 if op == "In" else 1, max_size=3,
+                    unique=True))]
+            elif op in ("Gt", "Lt"):
+                values = [str(data.draw(st.integers(1, 500)))]
+            try:
+                reqs.add(Requirement.create(key, op, values))
+            except IncompatibleError:
+                return  # self-conflicting draw; nothing to round-trip
+        p = Provisioner(
+            name="fuzz",
+            requirements=reqs,
+            taints=tuple(Taint(key=f"t{i}", value=data.draw(
+                st.sampled_from(["", "v"])), effect="NoSchedule")
+                for i in range(data.draw(st.integers(0, 2)))),
+            weight=data.draw(st.integers(0, 100)),
+            limits=Limits(
+                cpu_millis=data.draw(st.one_of(
+                    st.none(), st.integers(1, 10**7))),
+                memory_bytes=data.draw(st.one_of(
+                    st.none(), st.integers(1, 2**40)))),
+            consolidation_enabled=data.draw(st.booleans()),
+            provider_ref="default",
+        )
+        doc = serde.to_manifest("provisioners", "fuzz", p)
+        doc.pop(serde.MODEL_KEY)
+        back = serde.from_manifest("provisioners", doc)
+        # set_defaults runs on parse; compare against the defaulted original
+        p.set_defaults()
+        assert back.requirements.to_specs() == p.requirements.to_specs()
+        assert back.taints == p.taints
+        assert back.weight == p.weight
+        assert back.limits == p.limits
+        assert back.consolidation_enabled == p.consolidation_enabled
