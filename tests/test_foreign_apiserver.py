@@ -221,6 +221,14 @@ def test_kubectl_authored_pod_schedules_against_foreign_apiserver(apiserver):
         nodes = _request(base, "/api/v1/nodes")
         node_names = {n["metadata"]["name"] for n in nodes.get("items", [])}
         assert pod_doc["spec"]["nodeName"] in node_names
+        # counters + spec fidelity on the foreign server: status.resources
+        # present AND the user-authored spec survived our status writes
+        prov_doc = _request(base,
+                            "/apis/karpenter.sh/v1alpha5/provisioners/default")
+        res = (prov_doc.get("status") or {}).get("resources") or {}
+        assert res.get("nodes") not in (None, "0"), prov_doc.get("status")
+        assert prov_doc.get("spec", {}).get("requirements"), \
+            "user spec blanked by a status write"
     finally:
         if op is not None:
             op.stop()

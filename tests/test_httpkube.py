@@ -188,6 +188,12 @@ class TestControllerOverTheWire:
             # the bound node is the machine's node (names line up)
             node_name = pod_doc["spec"]["nodeName"]
             assert node_name in state.bucket("nodes")
+            # counters controller: consumption is SERVER-side visible in
+            # real schema (kubectl get provisioner shows it)
+            prov_doc = state.bucket("provisioners")["default"]
+            res = (prov_doc.get("status") or {}).get("resources") or {}
+            assert res.get("nodes") not in (None, "0"), prov_doc.get("status")
+            assert res.get("cpu", "").endswith("m")
         finally:
             op.stop()
             kube.stop()
