@@ -14,8 +14,10 @@ Configs (BASELINE.json):
      full catalog
   4  stress: 50k pods, 8 provisioners with overlapping requirements, full
      offering set — sharded over every visible device via parallel/sharded
+  5  pair sweep: multi-node consolidation over 64-node pair grids
+  6  config 1's workload on the PRODUCTION routed backend (C++ scan)
 
-Usage: python -m benchmarks.baseline_configs [--configs 0,1,2,3,4]
+Usage: python -m benchmarks.baseline_configs [--configs 0,1,...,6]
 """
 
 from __future__ import annotations
@@ -32,7 +34,7 @@ from karpenter_tpu.models.pod import (Taint, Toleration,
                                       TopologySpreadConstraint, make_pod)
 from karpenter_tpu.models.requirements import OP_IN, Requirements
 from karpenter_tpu.providers.instancetypes import generate_fleet_catalog
-from karpenter_tpu.solver.core import TPUSolver
+from karpenter_tpu.solver.core import NativeSolver, TPUSolver
 
 REPEATS = 5
 
@@ -102,6 +104,23 @@ def config_1_mixed_5k() -> dict:
     return {"bench": "baseline_config", "config": 1, "name": "mixed-5k-3az",
             "ms": round(ms, 3), "nodes": len(result.nodes),
             "detail": {"n_types": len(catalog.types)}}
+
+
+def config_6_mixed_5k_routed() -> dict:
+    """config 1's workload on the PRODUCTION routed backend (the C++ scan
+    the controller prefers behind a high-RTT tunnel) — records the number
+    a real cycle pays next to the device-kernel-on-virtual-CPU number so
+    the two are never conflated round-over-round."""
+    catalog = generate_fleet_catalog()
+    prov = _provisioner(requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+    solver = NativeSolver(catalog, [prov])
+    result, ms = _timed_solve(solver, _mixed_5k_pods())
+    assert result.unschedulable_count() == 0
+    return {"bench": "baseline_config", "config": 6,
+            "name": "mixed-5k-3az-routed",
+            "ms": round(ms, 3), "nodes": len(result.nodes),
+            "detail": {"n_types": len(catalog.types), "backend": "native"}}
 
 
 def config_2_gpu() -> dict:
@@ -327,6 +346,7 @@ CONFIGS = {
     3: config_3_consolidation,
     4: config_4_stress_50k,
     5: config_5_pair_sweep,
+    6: config_6_mixed_5k_routed,
 }
 
 
