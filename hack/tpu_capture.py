@@ -187,6 +187,43 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
     except Exception as e:
         consolidation = {"error": str(e)[:200]}
 
+    # Pair sweep on-chip (weak #6, round 3): 64 nodes whose singles can't
+    # consolidate -> the multi-node grid (2016 pair lanes) runs as one
+    # vmapped dispatch + one [C,3] verdict read.
+    pair_sweep = None
+    try:
+        from karpenter_tpu.apis import wellknown as wkk
+        from karpenter_tpu.models.cluster import ClusterState, StateNode
+        from karpenter_tpu.models.pod import make_pod
+        from karpenter_tpu.ops.consolidate import run_consolidation
+
+        cluster = ClusterState()
+        big = catalog.by_name["m5.2xlarge"]
+        for i in range(64):
+            cluster.add_node(StateNode(
+                name=f"pn-{i}",
+                labels={**big.labels_dict(), wkk.LABEL_ZONE: "zone-1a",
+                        wkk.LABEL_CAPACITY_TYPE: "on-demand",
+                        wkk.LABEL_PROVISIONER: "default"},
+                allocatable=big.allocatable_vector(),
+                instance_type=big.name, zone="zone-1a",
+                capacity_type="on-demand", price=big.offerings[0].price,
+                provisioner_name="default",
+                pods=[make_pod(f"pp-{i}-{j}", cpu="2", memory="12Gi",
+                               node_name=f"pn-{i}") for j in range(3)]))
+        pprov = Provisioner(name="default", consolidation_enabled=True)
+        pprov.set_defaults()
+        run_consolidation(cluster, catalog, [pprov])  # compile + warm
+        ptimes = []
+        for _ in range(max(3, reps_sweep)):
+            t0 = time.perf_counter()
+            run_consolidation(cluster, catalog, [pprov])
+            ptimes.append((time.perf_counter() - t0) * 1000)
+        pair_sweep = {"nodes": 64,
+                      "p50_ms": round(st.median(ptimes), 3)}
+    except Exception as e:
+        pair_sweep = {"error": str(e)[:200]}
+
     return {
         "backend": backend,
         # link-state decomposition (VERDICT r3 ask #1): sync latency fresh /
@@ -197,6 +234,7 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
         "exec_only_10k": exec_only,
         "wave_pipelined": wave,
         "consolidation_500": consolidation,
+        "pair_sweep_64": pair_sweep,
         "headline": {
             "metric": "scheduling_cycle_p50_ms_10k_pods_600_types",
             "p50_ms": head_p50,
