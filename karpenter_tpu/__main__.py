@@ -117,6 +117,7 @@ def cmd_controller(args) -> int:
                                       args.webhook_port))
     op = Operator(cloud, settings, catalog, kube=kube,
                   solver_factory=solver_factory,
+                  solver_target=args.solver,
                   leader_elect=bool(args.leader_elect),
                   serve_http=serve_http,
                   metrics_port=args.metrics_port,

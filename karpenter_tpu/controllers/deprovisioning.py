@@ -44,7 +44,8 @@ class DeprovisioningController:
                  recorder: Optional[EventRecorder] = None,
                  registry: Optional[Registry] = None,
                  use_tpu_solver: bool = True,
-                 provisioning=None):
+                 provisioning=None,
+                 remote_consolidator=None):
         self.kube = kube
         self.cloudprovider = cloudprovider
         self.cluster = cluster
@@ -53,6 +54,11 @@ class DeprovisioningController:
         self.recorder = recorder or EventRecorder(clock=self.clock)
         self.use_tpu_solver = use_tpu_solver
         self.provisioning = provisioning  # for replacement launches
+        # callable(cluster, catalog, provisioners, eligible_names, now)
+        # -> action | None: runs the batched search on the solver SIDECAR's
+        # device (solver/client.py consolidate). The controller container
+        # has no chip in the deployed split; in-process stays the fallback.
+        self.remote_consolidator = remote_consolidator
         reg = registry or REGISTRY
         self.actions = reg.counter(
             f"{NAMESPACE}_deprovisioning_actions_performed_total",
@@ -167,8 +173,27 @@ class DeprovisioningController:
         import time as _time
 
         t0 = _time.perf_counter()
+        action = None
+        remote_done = False
+        if self.remote_consolidator is not None:
+            from ..oracle.consolidation import eligible
+
+            eligible_names = {
+                name for name, n in cluster.nodes.items()
+                if cand_filter(n) and eligible(n, cluster)}
+            try:
+                action = self.remote_consolidator(
+                    cluster, catalog, all_provs, eligible_names,
+                    self.clock.now())
+                method = "remote"
+                remote_done = True
+            except Exception as e:
+                log.warning("remote consolidation failed (%s); "
+                            "in-process fallback", e)
         try:
-            if self.use_tpu_solver:
+            if remote_done:
+                pass
+            elif self.use_tpu_solver:
                 action = run_consolidation(cluster, catalog, all_provs,
                                            now=self.clock.now(),
                                            candidate_filter=cand_filter)

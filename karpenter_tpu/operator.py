@@ -40,7 +40,7 @@ class Operator:
     def __init__(self, cloud, settings: Settings, catalog: Catalog,
                  kube: Optional[KubeStore] = None,
                  clock: Optional[Clock] = None,
-                 queue=None, solver_factory=None,
+                 queue=None, solver_factory=None, solver_target: str = "",
                  leader_elect: bool = False,
                  identity: Optional[str] = None,
                  serve_http: bool = False,
@@ -101,10 +101,36 @@ class Operator:
         self.termination = TerminationController(
             self.kube, self.cloudprovider, self.cluster,
             clock=self.clock, recorder=self.recorder)
+        remote_consolidator = None
+        if solver_target:
+            # deployed split (SURVEY 7.1): the sidecar owns the chip, so
+            # the batched consolidation search runs THERE; the in-process
+            # kernel stays the fallback chain's next link. The client is
+            # cached per (catalog object+seqnum, provisioner hash) so
+            # steady-state cycles reuse the synced session instead of
+            # re-shipping the catalog every reconcile (the provisioning
+            # path's content-keyed cache discipline).
+            _rc_cache: "dict[tuple, object]" = {}
+
+            def remote_consolidator(cluster, catalog, provisioners,
+                                    eligible_names, now,
+                                    _target=solver_target):
+                from .solver import wire
+                from .solver.client import RemoteSolver
+
+                key = (id(catalog), catalog.seqnum,
+                       wire.provisioners_hash(provisioners))
+                rs = _rc_cache.get(key)
+                if rs is None:
+                    _rc_cache.clear()  # one live entry; catalogs don't coexist
+                    rs = _rc_cache[key] = RemoteSolver(
+                        catalog, provisioners, target=_target)
+                return rs.consolidate(cluster, eligible_names, now=now)
         self.deprovisioning = DeprovisioningController(
             self.kube, self.cloudprovider, self.cluster, self.termination,
             clock=self.clock, recorder=self.recorder,
-            provisioning=self.provisioning)
+            provisioning=self.provisioning,
+            remote_consolidator=remote_consolidator)
         self.nodetemplate = NodeTemplateController(
             self.kube, self.cloudprovider.subnets,
             self.cloudprovider.security_groups, clock=self.clock)

@@ -141,6 +141,41 @@ class RemoteSolver:
     def health(self) -> pb.HealthResponse:
         return self._call("Health", pb.HealthRequest())
 
+    # -- consolidation -------------------------------------------------------------
+
+    def consolidate(self, cluster, eligible_names: "set[str]",
+                    daemon_overhead: Optional[Sequence[int]] = None,
+                    now: float = 0.0, multi_node: bool = True,
+                    max_pair_candidates: "Optional[int]" = None):
+        """Run the consolidation search on the service's device. The
+        controller ships its cluster-state views with PRE-COMPUTED
+        eligibility verdicts (the service has no PDB store); the synced
+        catalog/provisioners key the device-resident state like Solve."""
+        from ..oracle.consolidation import MAX_PAIR_CANDIDATES
+
+        if max_pair_candidates is None:
+            max_pair_candidates = MAX_PAIR_CANDIDATES  # parity with fallback
+        nodes = [wire.consolidation_node_to_wire(
+                     cluster.nodes[name], eligible=name in eligible_names)
+                 for name in sorted(cluster.nodes)]
+        req = pb.ConsolidateRequest(
+            catalog_hash=self.catalog_content_hash(),
+            provisioner_hash=self._prov_hash,
+            nodes=nodes,
+            daemon_overhead=list(daemon_overhead or ()),
+            multi_node=multi_node,
+            max_pair_candidates=max_pair_candidates,
+            now=now,
+        )
+        if self._synced_hash != self.catalog_content_hash():
+            self.sync()
+        try:
+            resp = self._call("Consolidate", req)
+        except StaleSync:
+            self.sync()
+            resp = self._call("Consolidate", req)
+        return wire.action_from_response(resp)
+
     # -- solve ---------------------------------------------------------------------
 
     def solve(self, pods: "list[PodSpec]",

@@ -35,6 +35,7 @@ SERVICE_NAME = "karpenter.solver.Solver"
 METHODS = {
     "Sync": (pb.SyncRequest, pb.SyncResponse),
     "Solve": (pb.SolveRequest, pb.SolveResponse),
+    "Consolidate": (pb.ConsolidateRequest, pb.ConsolidateResponse),
     "Health": (pb.HealthRequest, pb.HealthResponse),
 }
 
@@ -191,6 +192,49 @@ class SolverService:
                                   daemon_overhead=overhead)
         solve_ms = (time.perf_counter() - t0) * 1000
         return result_to_response(result, solve_ms, seqnum)
+
+    def Consolidate(self, request: pb.ConsolidateRequest,
+                    context) -> pb.ConsolidateResponse:
+        """The consolidation search on the service's device: the controller
+        ships cluster-state views (with its PDB/do-not-evict eligibility
+        verdicts pre-computed), the service runs the batched candidate/pair
+        kernels against the SYNCED catalog and returns the chosen action —
+        the deployment's chip never has to live in the controller container
+        (SURVEY.md 7.1 split)."""
+        from ..models.cluster import ClusterState
+        from ..oracle.consolidation import MAX_PAIR_CANDIDATES
+        from ..ops.consolidate import run_consolidation
+
+        key = (request.catalog_hash, request.provisioner_hash)
+        with self._lock:
+            entry = self._cache.get(key)
+            if entry is not None:
+                self._cache.move_to_end(key)
+        if entry is None:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"catalog hash={request.catalog_hash:x} not synced; "
+                f"re-Sync required")
+        solver, _seqnum = entry
+        cluster = ClusterState()
+        eligible_names: "set[str]" = set()
+        for msg in request.nodes:
+            node, node_eligible = wire.consolidation_node_from_wire(msg)
+            cluster.add_node(node)
+            if node_eligible:
+                eligible_names.add(node.name)
+        overhead = list(request.daemon_overhead) or None
+        t0 = time.perf_counter()
+        action = run_consolidation(
+            cluster, solver.catalog, solver.provisioners,
+            daemon_overhead=overhead, now=request.now,
+            grid=solver.grid(),  # the Sync'd device-resident grid — no rebuild
+            multi_node=request.multi_node,
+            max_pair_candidates=(request.max_pair_candidates
+                                 or MAX_PAIR_CANDIDATES),
+            candidate_filter=lambda n: n.name in eligible_names)
+        ms = (time.perf_counter() - t0) * 1000
+        return wire.action_to_response(action, ms)
 
     def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
         import jax

@@ -280,3 +280,82 @@ def existing_from_wire(m: pb.ExistingNodeMsg) -> ExistingNode:
         resident=tuple(p for rg in m.resident
                        for p in [pod_from_wire(rg.spec)] * rg.count),
     )
+
+
+# -- consolidation ------------------------------------------------------------------
+
+
+def consolidation_node_to_wire(n, eligible: bool) -> pb.ConsolidationNodeMsg:
+    """StateNode + the controller's eligibility verdict -> wire (an explicit
+    parameter — never smuggled through attributes on shared live state).
+    Full pod specs travel: priority/deletion-cost feed the disruption
+    scoring on the service side, labels feed survivor topology counting."""
+    return pb.ConsolidationNodeMsg(
+        name=n.name,
+        labels=_kvs(sorted(n.labels.items())),
+        allocatable=list(n.allocatable),
+        taints=_taints_to_wire(n.taints),
+        instance_type=n.instance_type,
+        zone=n.zone,
+        capacity_type=n.capacity_type,
+        price=n.price,
+        provisioner_name=n.provisioner_name,
+        created_ts=n.created_ts,
+        initialized=n.initialized,
+        eligible=eligible,
+        marked_for_deletion=n.marked_for_deletion,
+        pods=[pod_to_wire(p) for p in n.pods],
+    )
+
+
+def consolidation_node_from_wire(m: pb.ConsolidationNodeMsg):
+    """-> (StateNode, eligible)."""
+    from ..models.cluster import StateNode
+
+    node = StateNode(
+        name=m.name,
+        labels={kv.key: kv.value for kv in m.labels},
+        allocatable=list(m.allocatable),
+        taints=_taints_from_wire(m.taints),
+        instance_type=m.instance_type,
+        zone=m.zone,
+        capacity_type=m.capacity_type,
+        price=m.price,
+        provisioner_name=m.provisioner_name,
+        created_ts=m.created_ts,
+        initialized=m.initialized,
+        marked_for_deletion=m.marked_for_deletion,
+        pods=[pod_from_wire(p) for p in m.pods],
+    )
+    return node, m.eligible
+
+
+def action_to_response(action, consolidate_ms: float) -> pb.ConsolidateResponse:
+    if action is None:
+        return pb.ConsolidateResponse(found=False,
+                                      consolidate_ms=consolidate_ms)
+    resp = pb.ConsolidateResponse(
+        found=True, kind=action.kind, nodes=list(action.nodes),
+        savings=action.savings, cost=action.disruption_cost,
+        consolidate_ms=consolidate_ms)
+    if action.replacement is not None:
+        itype, zone, ct, price = action.replacement
+        resp.replacement_instance_type = itype
+        resp.replacement_zone = zone
+        resp.replacement_capacity_type = ct
+        resp.replacement_price = price
+    return resp
+
+
+def action_from_response(m: pb.ConsolidateResponse):
+    from ..oracle.consolidation import ConsolidationAction
+
+    if not m.found:
+        return None
+    replacement = None
+    if m.replacement_instance_type:
+        replacement = (m.replacement_instance_type, m.replacement_zone,
+                       m.replacement_capacity_type, m.replacement_price)
+    return ConsolidationAction(
+        m.kind, m.nodes[0] if m.nodes else "", m.cost, savings=m.savings,
+        replacement=replacement, nodes=tuple(m.nodes))

@@ -350,3 +350,135 @@ def test_solver_service_profiling_hook(tmp_path):
         assert produced, "no profiler trace written"
     finally:
         srv.stop(grace=None)
+
+
+class TestRemoteConsolidation:
+    """The Consolidate RPC: the batched search runs on the SERVICE's device
+    (the deployed split gives the chip to the sidecar) and must return the
+    identical action the in-process kernel picks."""
+
+    def _cluster(self, cat):
+        from karpenter_tpu.models.cluster import ClusterState, StateNode
+
+        big = cat.by_name["m.xlarge"]
+        cluster = ClusterState()
+        for i in range(8):
+            cluster.add_node(StateNode(
+                name=f"n-{i}",
+                labels={**big.labels_dict(), wk.LABEL_ZONE: "zone-1a",
+                        wk.LABEL_CAPACITY_TYPE: "on-demand",
+                        wk.LABEL_PROVISIONER: "default"},
+                allocatable=big.allocatable_vector(),
+                instance_type=big.name, zone="zone-1a",
+                capacity_type="on-demand", price=big.offerings[0].price,
+                provisioner_name="default",
+                pods=[make_pod(f"p-{i}", cpu="500m", memory="1Gi",
+                               node_name=f"n-{i}")]))
+        return cluster
+
+    def test_remote_action_matches_in_process(self, server):
+        from karpenter_tpu.oracle.consolidation import eligible
+        from karpenter_tpu.ops.consolidate import run_consolidation
+        from karpenter_tpu.solver.client import RemoteSolver
+
+        cat = small_catalog()
+        prov = default_provisioner(consolidation_enabled=True)
+        cluster = self._cluster(cat)
+        eligible_names = {n for n, node in cluster.nodes.items()
+                          if eligible(node, cluster)}
+        rs = RemoteSolver(cat, [prov], target=f"127.0.0.1:{server}")
+        remote = rs.consolidate(cluster, eligible_names, now=0.0)
+        local = run_consolidation(cluster, cat, [prov], now=0.0)
+        assert (remote is None) == (local is None)
+        assert remote.kind == local.kind
+        assert remote.nodes == local.nodes
+        assert abs(remote.savings - local.savings) < 1e-9
+        assert abs(remote.disruption_cost - local.disruption_cost) < 1e-9
+        assert remote.replacement == local.replacement
+
+    def test_remote_respects_controller_eligibility_verdicts(self, server):
+        from karpenter_tpu.solver.client import RemoteSolver
+
+        cat = small_catalog()
+        prov = default_provisioner(consolidation_enabled=True)
+        cluster = self._cluster(cat)
+        rs = RemoteSolver(cat, [prov], target=f"127.0.0.1:{server}")
+        # the controller says NOTHING is eligible (e.g. every node's pods
+        # are PDB-blocked): the service must find no action
+        assert rs.consolidate(cluster, set(), now=0.0) is None
+
+    def test_unsynced_consolidate_resyncs_transparently(self, server):
+        from karpenter_tpu.oracle.consolidation import eligible
+        from karpenter_tpu.solver.client import RemoteSolver
+
+        cat = small_catalog()
+        prov = default_provisioner(consolidation_enabled=True)
+        cluster = self._cluster(cat)
+        eligible_names = {n for n, node in cluster.nodes.items()
+                          if eligible(node, cluster)}
+        rs = RemoteSolver(cat, [prov], target=f"127.0.0.1:{server}")
+        # no explicit sync() call: consolidate must sync on demand
+        action = rs.consolidate(cluster, eligible_names, now=0.0)
+        assert action is not None
+
+    def test_operator_routes_consolidation_to_the_sidecar(self, server):
+        """Operator(solver_target=...) wires the deprovisioner's remote
+        chain: the action comes from the service (method=remote), and a
+        dead sidecar degrades to the in-process kernel."""
+        from karpenter_tpu.apis.settings import Settings
+        from karpenter_tpu.fake.cloud import FakeCloud
+        from karpenter_tpu.metrics import Registry
+        from karpenter_tpu.models.cluster import StateNode
+        from karpenter_tpu.operator import Operator
+
+        catalog = small_catalog()
+        cloud = FakeCloud(catalog)
+        settings = Settings(cluster_name="t", cluster_endpoint="https://t")
+        op = Operator(cloud, settings, catalog,
+                      solver_target=f"127.0.0.1:{server}")
+        assert op.deprovisioning.remote_consolidator is not None
+        prov = default_provisioner(consolidation_enabled=True)
+        op.kube.create("provisioners", "default", prov)
+        big = catalog.by_name["m.xlarge"]
+        for i in range(6):
+            node = StateNode(
+                name=f"n-{i}",
+                labels={**big.labels_dict(), wk.LABEL_ZONE: "zone-1a",
+                        wk.LABEL_CAPACITY_TYPE: "on-demand",
+                        wk.LABEL_PROVISIONER: "default"},
+                allocatable=big.allocatable_vector(),
+                instance_type=big.name, zone="zone-1a",
+                capacity_type="on-demand", price=big.offerings[0].price,
+                provisioner_name="default",
+                pods=[make_pod(f"p-{i}", cpu="250m", memory="512Mi",
+                               node_name=f"n-{i}")])
+            op.cluster.add_node(node)
+            op.kube.create("nodes", node.name, node)
+        action = op.deprovisioning.reconcile_consolidation()
+        assert action is not None and action.kind in ("delete", "replace")
+
+    def test_draining_nodes_never_absorb_evicted_pods_remotely(self, server):
+        """A node concurrently marked for deletion (emptiness/interruption)
+        must not be a landing spot in the remote simulation — the wire
+        carries marked_for_deletion so the service's survivor mask matches
+        the in-process kernel's."""
+        from karpenter_tpu.ops.consolidate import run_consolidation
+        from karpenter_tpu.solver.client import RemoteSolver
+
+        cat = small_catalog()
+        prov = default_provisioner(consolidation_enabled=True)
+        cluster = self._cluster(cat)
+        # every node except n-0 is draining: nothing may absorb n-0's pods.
+        # The only legal action left is REPLACE onto a cheaper fresh node —
+        # a service that ignored the draining mask would pick the
+        # higher-savings DELETE (pods "fit" on a draining peer) instead.
+        for name, node in cluster.nodes.items():
+            if name != "n-0":
+                node.marked_for_deletion = True
+        rs = RemoteSolver(cat, [prov], target=f"127.0.0.1:{server}")
+        remote = rs.consolidate(cluster, {"n-0"}, now=0.0)
+        local = run_consolidation(cluster, cat, [prov], now=0.0)
+        assert local is not None and local.kind == "replace"
+        assert remote is not None and remote.kind == "replace"
+        assert remote.nodes == local.nodes
+        assert remote.replacement == local.replacement
