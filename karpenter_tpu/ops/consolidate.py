@@ -281,6 +281,17 @@ def _decode_actions(batch: ConsolidationBatch, verdicts, now: float
     return actions
 
 
+def _verdicts(inputs: PackInputs, mesh):
+    """Single-device dispatch, or candidate lanes sharded over a mesh
+    (pure data parallelism — see parallel/sharded.py make_lane_mesh)."""
+    if mesh is not None:
+        from ..parallel.sharded import sharded_consolidation_verdicts
+
+        return sharded_consolidation_verdicts(inputs, N_SLOTS, mesh)
+    return jax.device_get(
+        _batched_pack_verdicts(jax.device_put(inputs), N_SLOTS))
+
+
 def run_consolidation(
     cluster: ClusterState,
     catalog: Catalog,
@@ -291,6 +302,7 @@ def run_consolidation(
     multi_node: bool = True,
     max_pair_candidates: int = MAX_PAIR_CANDIDATES,
     candidate_filter=None,
+    mesh=None,
 ) -> Optional[ConsolidationAction]:
     """Batched equivalent of oracle find_consolidation (bit-parity tested).
 
@@ -303,8 +315,7 @@ def run_consolidation(
                                  candidate_filter=candidate_filter)
     if batch is None:
         return None
-    verdicts = jax.device_get(
-        _batched_pack_verdicts(jax.device_put(batch.inputs), N_SLOTS))
+    verdicts = _verdicts(batch.inputs, mesh)
     actions = _decode_actions(batch, verdicts, now)
     if actions:
         return min(actions, key=ConsolidationAction.sort_key)
@@ -322,8 +333,7 @@ def run_consolidation(
                                       cand_sets=pairs)
     if pair_batch is None:
         return None
-    pair_verdicts = jax.device_get(
-        _batched_pack_verdicts(jax.device_put(pair_batch.inputs), N_SLOTS))
+    pair_verdicts = _verdicts(pair_batch.inputs, mesh)
     actions = _decode_actions(pair_batch, pair_verdicts, now)
     if not actions:
         return None

@@ -117,3 +117,81 @@ def sharded_pack(inputs: PackInputs, n_slots: int, mesh: Mesh) -> PackResult:
     )
     with mesh:
         return fn(inputs, n_slots, mesh)
+
+
+# -- consolidation lanes ------------------------------------------------------------
+
+AXIS_LANES = "lanes"
+
+
+def make_lane_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D mesh for the consolidation sweep: candidate lanes are mutually
+    independent simulations, so the batch shards like DATA parallelism —
+    every device owns C/n lanes and no collective crosses lanes at all
+    (the cheapest possible scale-out; contrast the pack mesh above where
+    the type axis all-reduces)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (AXIS_LANES,))
+
+
+def _pad_lanes(inputs: PackInputs, multiple: int) -> "tuple[PackInputs, int]":
+    """Pad the leading candidate axis to a device multiple with NO-OP lanes
+    (zero pod counts, infeasible everywhere): they place nothing, open
+    nothing, and the caller slices verdicts back to the true lane count."""
+    C = inputs.group_vec.shape[0]
+    Cp = -(-C // multiple) * multiple
+    if Cp == C:
+        return inputs, C
+    pad_n = Cp - C
+
+    def pad(a, value=0):
+        a = np.asarray(a)
+        w = [(0, 0)] * a.ndim
+        w[0] = (0, pad_n)
+        return np.pad(a, w, constant_values=value)
+
+    out = inputs._replace(
+        group_vec=pad(inputs.group_vec), group_count=pad(inputs.group_count),
+        group_cap=pad(inputs.group_cap, int(INT_BIG)),
+        group_feas=pad(inputs.group_feas, False),
+        group_newprov=pad(inputs.group_newprov, -1),
+        ex_used=pad(inputs.ex_used), ex_feas=pad(inputs.ex_feas, False),
+    )
+    if inputs.ex_cap is not None:
+        out = out._replace(ex_cap=pad(inputs.ex_cap, int(INT_BIG)))
+    if inputs.group_origin is not None:
+        out = out._replace(group_origin=pad(inputs.group_origin))
+    return out, C
+
+
+def sharded_consolidation_verdicts(inputs: PackInputs, n_slots: int,
+                                   mesh: Mesh) -> np.ndarray:
+    """The [C, 3] verdict table of ops.consolidate._batched_pack_verdicts,
+    with candidate lanes sharded across `mesh`. Bit-identical to the
+    single-device sweep (tests/test_sharded.py)."""
+    from ..ops.consolidate import _batched_pack_verdicts
+
+    n = mesh.devices.size
+    inputs, C = _pad_lanes(inputs, n)
+    lane = lambda *rest: NamedSharding(mesh, P(AXIS_LANES, *rest))
+    rep = NamedSharding(mesh, P())
+    shardings = PackInputs(
+        alloc_t=rep, tiebreak=rep,
+        group_vec=lane(), group_count=lane(), group_cap=lane(),
+        group_feas=lane(), group_newprov=lane(), overhead=rep,
+        ex_alloc=rep, ex_used=lane(), ex_feas=lane(),
+        prov_overhead=None if inputs.prov_overhead is None else rep,
+        prov_pods_cap=None if inputs.prov_pods_cap is None else rep,
+        ex_cap=None if inputs.ex_cap is None else lane(),
+        group_origin=None if inputs.group_origin is None else lane(),
+    )
+    dev_inputs = jax.tree.map(
+        lambda a, sh: jax.device_put(jax.numpy.asarray(a), sh),
+        inputs, shardings)
+    fn = jax.jit(_batched_pack_verdicts, static_argnames=("n_slots",),
+                 in_shardings=(shardings,))
+    with mesh:
+        verdicts = fn(dev_inputs, n_slots)
+    return np.asarray(jax.device_get(verdicts))[:C]

@@ -151,10 +151,8 @@ class RemoteSolver:
         controller ships its cluster-state views with PRE-COMPUTED
         eligibility verdicts (the service has no PDB store); the synced
         catalog/provisioners key the device-resident state like Solve."""
-        from ..oracle.consolidation import MAX_PAIR_CANDIDATES
-
         if max_pair_candidates is None:
-            max_pair_candidates = MAX_PAIR_CANDIDATES  # parity with fallback
+            max_pair_candidates = -1  # wire sentinel: server-side default
         nodes = [wire.consolidation_node_to_wire(
                      cluster.nodes[name], eligible=name in eligible_names)
                  for name in sorted(cluster.nodes)]

@@ -230,8 +230,11 @@ class SolverService:
             daemon_overhead=overhead, now=request.now,
             grid=solver.grid(),  # the Sync'd device-resident grid — no rebuild
             multi_node=request.multi_node,
-            max_pair_candidates=(request.max_pair_candidates
-                                 or MAX_PAIR_CANDIDATES),
+            # -1 = unset sentinel -> server default; 0 legitimately
+            # DISABLES the pair search (proto3 zero-value trap)
+            max_pair_candidates=(MAX_PAIR_CANDIDATES
+                                 if request.max_pair_candidates < 0
+                                 else request.max_pair_candidates),
             candidate_filter=lambda n: n.name in eligible_names)
         ms = (time.perf_counter() - t0) * 1000
         return wire.action_to_response(action, ms)

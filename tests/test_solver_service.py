@@ -482,3 +482,53 @@ class TestRemoteConsolidation:
         assert remote is not None and remote.kind == "replace"
         assert remote.nodes == local.nodes
         assert remote.replacement == local.replacement
+
+    def test_dead_sidecar_degrades_to_in_process_kernel(self):
+        """The remote-failure branch: a dead target must fall through to
+        the in-process kernel and still produce the same action."""
+        from karpenter_tpu.apis.settings import Settings
+        from karpenter_tpu.fake.cloud import FakeCloud
+        from karpenter_tpu.models.cluster import StateNode
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.ops.consolidate import run_consolidation
+
+        catalog = small_catalog()
+        cloud = FakeCloud(catalog)
+        settings = Settings(cluster_name="t", cluster_endpoint="https://t")
+        op = Operator(cloud, settings, catalog,
+                      solver_target="127.0.0.1:1")  # nothing listens here
+        prov = default_provisioner(consolidation_enabled=True)
+        op.kube.create("provisioners", "default", prov)
+        big = catalog.by_name["m.xlarge"]
+        for i in range(6):
+            node = StateNode(
+                name=f"n-{i}",
+                labels={**big.labels_dict(), wk.LABEL_ZONE: "zone-1a",
+                        wk.LABEL_CAPACITY_TYPE: "on-demand",
+                        wk.LABEL_PROVISIONER: "default"},
+                allocatable=big.allocatable_vector(),
+                instance_type=big.name, zone="zone-1a",
+                capacity_type="on-demand", price=big.offerings[0].price,
+                provisioner_name="default",
+                pods=[make_pod(f"p-{i}", cpu="250m", memory="512Mi",
+                               node_name=f"n-{i}")])
+            op.cluster.add_node(node)
+            op.kube.create("nodes", node.name, node)
+        # shrink the grpc timeout so the dead dial fails fast
+        import karpenter_tpu.solver.client as client_mod
+        orig = client_mod.RemoteSolver.__init__
+
+        def fast_init(self, *a, **kw):
+            kw.setdefault("timeout", 0.2)
+            orig(self, *a, **kw)
+
+        # the expectation comes from the UNMUTATED cluster (reconcile marks
+        # the chosen nodes as it executes the action)
+        want = run_consolidation(op.cluster, catalog, [prov], now=0.0)
+        client_mod.RemoteSolver.__init__ = fast_init
+        try:
+            action = op.deprovisioning.reconcile_consolidation()
+        finally:
+            client_mod.RemoteSolver.__init__ = orig
+        assert action is not None and want is not None
+        assert action.kind == want.kind and action.nodes == want.nodes
