@@ -43,6 +43,28 @@ from .packer import PackInputs, pack_impl
 
 N_SLOTS = 2  # 1 replacement allowed; a 2nd opening proves non-consolidatable
 
+# grid memo for grid-less callers (the deprovisioner's in-process path, the
+# benchmark harness): build_grid costs ~120ms at 551 types and dominated
+# every sweep (profiled round 4). weakref to the catalog: identity
+# comparison against a LIVE object stays sound (a dead ref is just a miss,
+# never an id()-recycling alias) without pinning a retired catalog + grid
+# in memory for the process lifetime.
+import weakref as _weakref
+
+_grid_memo: "tuple | None" = None  # (weakref(catalog), seqnum, grid)
+
+
+def _grid_for(catalog: Catalog, grid: "Optional[OptionGrid]") -> OptionGrid:
+    global _grid_memo
+    if grid is not None and grid.seqnum == catalog.seqnum:
+        return grid
+    m = _grid_memo
+    if m is not None and m[0]() is catalog and m[1] == catalog.seqnum:
+        return m[2]
+    g = build_grid(catalog)
+    _grid_memo = (_weakref.ref(catalog), catalog.seqnum, g)
+    return g
+
 
 @dataclasses.dataclass
 class ConsolidationBatch:
@@ -65,8 +87,7 @@ def encode_consolidation(
     candidate_pairs) for the multi-node search — each set is one vmap lane
     whose group batch is the set's combined pods and whose cheaper-option
     mask is priced against the set's combined price."""
-    if grid is None or grid.seqnum != catalog.seqnum:
-        grid = build_grid(catalog)
+    grid = _grid_for(catalog, grid)
     provs = sorted(provisioners, key=lambda p: (-p.weight, p.name))
     overhead = np.asarray(daemon_overhead if daemon_overhead is not None
                           else [0] * wk.NUM_RESOURCES, dtype=np.int32)
