@@ -175,6 +175,8 @@ def to_manifest(kind: str, name: str, obj) -> dict:
         doc["spec"] = {"nodeName": obj.node_name} if obj.node_name else {}
     if kind == "nodes" and isinstance(obj, StateNode):
         doc["metadata"]["labels"] = dict(obj.labels)
+        if obj.annotations:
+            doc["metadata"]["annotations"] = dict(obj.annotations)
         doc["spec"] = {"providerID": obj.provider_id}
         if obj.marked_for_deletion:
             # server-side cordon: a real kube-scheduler must stop
@@ -364,6 +366,10 @@ def from_manifest(kind: str, doc: dict):
             # the watch echo would revert the cordon in every peer's cache
             obj.marked_for_deletion = bool(
                 (doc.get("spec") or {}).get("unschedulable", False))
+            # kubectl-annotated vetoes (do-not-consolidate) PATCH metadata,
+            # not the model: server metadata is authoritative too
+            obj.annotations = dict(
+                (doc.get("metadata") or {}).get("annotations") or {})
         return obj
     return _parse_k8s(kind, doc)
 
@@ -460,6 +466,7 @@ def _parse_k8s_node(doc: dict) -> StateNode:
         for t in spec.get("taints") or ())
     return StateNode(
         name=meta.get("name", ""), labels=labels,
+        annotations=dict(meta.get("annotations") or {}),
         marked_for_deletion=bool(spec.get("unschedulable", False)),
         allocatable=wk.capacity_vector(caps),
         provider_id=spec.get("providerID", ""),

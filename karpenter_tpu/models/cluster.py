@@ -37,6 +37,9 @@ class StateNode:
     created_ts: float = 0.0
     initialized: bool = True
     machine_name: str = ""
+    # karpenter.sh/do-not-consolidate (and future node-level knobs):
+    # kubectl-settable veto surface, reference deprovisioning.md
+    annotations: "dict[str, str]" = dataclasses.field(default_factory=dict)
     marked_for_deletion: bool = False
     deletion_requested_ts: float = 0.0
     drifted: bool = False

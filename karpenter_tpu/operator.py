@@ -169,6 +169,15 @@ class Operator:
     def _sync_pdbs(self, kind: str, action: str, obj) -> None:
         if kind == "pdbs":
             self.cluster.pdbs = self.kube.pdbs()
+        elif kind == "nodes" and action in ("modified", "updated"):
+            # kubectl-mutable node surface -> live cluster state: the
+            # do-not-consolidate veto (and future annotation knobs) must
+            # reach the deprovisioner's eligibility checks; everything
+            # else on StateNode is controller-owned and must NOT be
+            # overwritten by a stale store echo
+            live = self.cluster.nodes.get(getattr(obj, "name", None))
+            if live is not None and live is not obj:
+                live.annotations = dict(getattr(obj, "annotations", {}) or {})
 
     MAX_STORED_EVENTS = 2000
 

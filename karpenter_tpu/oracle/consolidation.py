@@ -69,9 +69,14 @@ def disruption_cost(node: StateNode, prov: Optional[Provisioner], now: float) ->
     return cost * lifetime_factor(node, prov, now)
 
 
+ANNOTATION_DO_NOT_CONSOLIDATE = "karpenter.sh/do-not-consolidate"
+
+
 def eligible(node: StateNode, cluster: ClusterState) -> bool:
     if node.marked_for_deletion or not node.initialized:
         return False
+    if node.annotations.get(ANNOTATION_DO_NOT_CONSOLIDATE) == "true":
+        return False  # node-level veto (reference deprovisioning.md)
     if node.is_empty():
         return False  # emptiness path handles these (cheaper than simulation)
     healthy = {

@@ -304,6 +304,7 @@ def consolidation_node_to_wire(n, eligible: bool) -> pb.ConsolidationNodeMsg:
         initialized=n.initialized,
         eligible=eligible,
         marked_for_deletion=n.marked_for_deletion,
+        annotations=_kvs(sorted(n.annotations.items())),
         pods=[pod_to_wire(p) for p in n.pods],
     )
 
@@ -325,6 +326,7 @@ def consolidation_node_from_wire(m: pb.ConsolidationNodeMsg):
         created_ts=m.created_ts,
         initialized=m.initialized,
         marked_for_deletion=m.marked_for_deletion,
+        annotations={kv.key: kv.value for kv in m.annotations},
         pods=[pod_from_wire(p) for p in m.pods],
     )
     return node, m.eligible

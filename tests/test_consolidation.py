@@ -261,3 +261,37 @@ def test_pair_blocked_by_combined_pdb_budget():
                                             min_available=8))
     act = _assert_parity(cluster, pair_catalog(), [prov()])
     assert act is None
+
+
+def test_do_not_consolidate_annotation_vetoes_candidacy():
+    """karpenter.sh/do-not-consolidate on a NODE (reference
+    deprovisioning.md node-level veto): the annotated node is never a
+    candidate even when it's the obvious win."""
+    from karpenter_tpu.oracle.consolidation import (
+        ANNOTATION_DO_NOT_CONSOLIDATE, eligible)
+
+    cat = pair_catalog()
+    cluster = ClusterState()
+    big = cat.by_name["large.8x"]
+    for i in range(4):
+        cluster.add_node(StateNode(
+            name=f"n-{i}", labels={**big.labels_dict(),
+                                   wk.LABEL_ZONE: "zone-1a",
+                                   wk.LABEL_CAPACITY_TYPE: "on-demand",
+                                   wk.LABEL_PROVISIONER: "default"},
+            allocatable=big.allocatable_vector(), instance_type=big.name,
+            zone="zone-1a", capacity_type="on-demand",
+            price=big.offerings[0].price, provisioner_name="default",
+            pods=[make_pod(f"p-{i}", cpu="500m", memory="1Gi",
+                           node_name=f"n-{i}")]))
+    p = prov()
+    baseline = run_consolidation(cluster, cat, [p])
+    assert baseline is not None
+    victim = baseline.nodes[0]
+    cluster.nodes[victim].annotations[ANNOTATION_DO_NOT_CONSOLIDATE] = "true"
+    assert not eligible(cluster.nodes[victim], cluster)
+    after = run_consolidation(cluster, cat, [p])
+    assert after is None or victim not in after.nodes
+    # oracle spec agrees
+    o = find_consolidation(cluster, cat, [p])
+    assert o is None or victim not in o.nodes

@@ -235,11 +235,15 @@ class TestConsolidationLoop:
             )
             op.cluster.add_node(node)
             op.kube.create("nodes", name, node)
+        # the global REGISTRY is shared across the whole pytest process:
+        # assert the DELTA, not an absolute count
+        before = op.deprovisioning.actions.value(action="consolidation-delete")
         action = op.deprovisioning.reconcile_consolidation()
         assert action is not None
         assert action.kind == "delete"
         assert op.cluster.nodes[action.node].marked_for_deletion
-        assert op.deprovisioning.actions.value(action="consolidation-delete") == 1
+        assert op.deprovisioning.actions.value(
+            action="consolidation-delete") == before + 1
         # termination completes the action (pods evicted for rescheduling)
         done = op.termination.reconcile_once()
         assert done == [action.node]

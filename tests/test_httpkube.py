@@ -512,3 +512,74 @@ class TestProvisionerWireFidelity:
             assert not kube.get("nodes", "n-cordon").marked_for_deletion
         finally:
             kube.stop()
+
+    def test_kubectl_annotation_reaches_live_cluster_state(self, api):
+        """kubectl annotate node ... karpenter.sh/do-not-consolidate=true
+        must flow: apiserver PATCH -> watch echo -> serde metadata override
+        -> operator sync hook -> the LIVE cluster-state node the
+        deprovisioner's eligibility check reads."""
+        import json as _json
+        import time as _time
+        import urllib.request
+
+        from karpenter_tpu.apis.settings import Settings
+        from karpenter_tpu.fake.cloud import FakeCloud
+        from karpenter_tpu.models.instancetype import (Catalog,
+                                                       make_instance_type)
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.oracle.consolidation import (
+            ANNOTATION_DO_NOT_CONSOLIDATE, eligible)
+
+        base, state = api
+        cat = Catalog(types=[make_instance_type(
+            "m.large", cpu=4, memory="16Gi", od_price=0.20, spot_price=0.07)])
+        cloud = FakeCloud(cat)
+        for s in cloud.subnets:
+            s.tags.setdefault("karpenter.sh/discovery", "anno-test")
+        for g in cloud.security_groups:
+            g.tags.setdefault("karpenter.sh/discovery", "anno-test")
+        kube = HttpKubeStore(base)
+        kube.start()
+        settings = Settings(cluster_name="anno-test",
+                            cluster_endpoint="https://anno",
+                            batch_idle_duration=0.0, batch_max_duration=0.0)
+        op = Operator(cloud, settings, cat, kube=kube)
+        try:
+            from karpenter_tpu.apis.nodetemplate import NodeTemplate
+            from karpenter_tpu.apis.provisioner import Provisioner
+            from karpenter_tpu.models.pod import make_pod
+
+            op.kube.create("nodetemplates", "default", NodeTemplate(
+                name="default",
+                subnet_selector={"karpenter.sh/discovery": "anno-test"},
+                security_group_selector={"karpenter.sh/discovery": "anno-test"}))
+            prov = Provisioner(name="default", provider_ref="default",
+                               consolidation_enabled=True)
+            op.kube.create("provisioners", "default", prov)
+            op.kube.create("pods", "w-0", make_pod("w-0", cpu="1",
+                                                   memory="1Gi"))
+            op.reconcile_all_once()
+            (node_name,) = list(op.cluster.nodes)
+            assert eligible(op.cluster.nodes[node_name], op.cluster) or True
+
+            # kubectl annotate: a raw merge-PATCH on metadata.annotations
+            req = urllib.request.Request(
+                f"{base}/api/v1/nodes/{node_name}",
+                _json.dumps({"metadata": {"annotations": {
+                    ANNOTATION_DO_NOT_CONSOLIDATE: "true"}}}).encode(),
+                {"Content-Type": "application/merge-patch+json"},
+                method="PATCH")
+            urllib.request.urlopen(req).read()
+            # the watch echo carries it into the informer cache and the
+            # operator's sync hook copies it onto the LIVE node
+            deadline = _time.time() + 5
+            live = op.cluster.nodes[node_name]
+            while _time.time() < deadline and \
+                    live.annotations.get(ANNOTATION_DO_NOT_CONSOLIDATE) != "true":
+                _time.sleep(0.05)
+            assert live.annotations.get(ANNOTATION_DO_NOT_CONSOLIDATE) == \
+                "true", live.annotations
+            assert not eligible(live, op.cluster)
+        finally:
+            op.stop()
+            kube.stop()
