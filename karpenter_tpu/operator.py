@@ -169,7 +169,7 @@ class Operator:
     def _sync_pdbs(self, kind: str, action: str, obj) -> None:
         if kind == "pdbs":
             self.cluster.pdbs = self.kube.pdbs()
-        elif kind == "nodes" and action in ("modified", "updated"):
+        elif kind == "nodes" and action == "modified":
             # kubectl-mutable node surface -> live cluster state: the
             # do-not-consolidate veto (and future annotation knobs) must
             # reach the deprovisioner's eligibility checks; everything
@@ -178,6 +178,27 @@ class Operator:
             live = self.cluster.nodes.get(getattr(obj, "name", None))
             if live is not None and live is not obj:
                 live.annotations = dict(getattr(obj, "annotations", {}) or {})
+        elif kind == "pods" and action in ("modified", "deleted"):
+            # bound-pod updates (kubectl annotate do-not-evict, priority
+            # edits) and deletions must refresh the OWNING node's resident
+            # list — eligibility and drain read node.pods, and the object
+            # appended at bind time goes stale the moment the store's copy
+            # is replaced (PodSpec is immutable-by-replace).
+            # Snapshot-rebuild + ONE attribute reassign: in-process notifies
+            # run on the writer's thread, but foreign writes arrive on the
+            # watch thread, and index mutation against a concurrently
+            # reassigned list (termination's daemons-only rebuild) could
+            # delete the wrong element; attribute assignment is atomic.
+            node_name = getattr(obj, "node_name", "")
+            live = self.cluster.nodes.get(node_name) if node_name else None
+            if live is not None:
+                pods = live.pods
+                if action == "deleted":
+                    rebuilt = [p for p in pods if p.name != obj.name]
+                else:
+                    rebuilt = [obj if p.name == obj.name else p for p in pods]
+                if rebuilt != pods:
+                    live.pods = rebuilt
 
     MAX_STORED_EVENTS = 2000
 

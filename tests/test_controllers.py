@@ -833,3 +833,27 @@ def test_counters_controller_maintains_provisioner_status_resources(op):
     op.reconcile_all_once()
     prov2 = op.kube.get("provisioners", "default")
     assert prov2.status_resources["nodes"] == "0"
+
+
+def test_pod_annotation_update_reaches_live_node_pods(op):
+    """kubectl-annotating a BOUND pod (do-not-evict) must refresh the
+    owning node's resident list — eligibility reads node.pods, and the
+    bind-time object goes stale when the store copy is replaced."""
+    import dataclasses
+
+    add_provisioner(op, consolidation_enabled=True)
+    op.kube.create("pods", "w-0", make_pod("w-0", cpu="1", memory="1Gi"))
+    op.reconcile_all_once()
+    (node_name,) = list(op.cluster.nodes)
+    live = op.cluster.nodes[node_name]
+    (pod,) = [p for p in live.pods if p.name == "w-0"]
+    assert not pod.do_not_evict
+    protected = dataclasses.replace(pod, do_not_evict=True)
+    op.kube.update("pods", "w-0", protected)
+    (pod2,) = [p for p in live.pods if p.name == "w-0"]
+    assert pod2.do_not_evict, "live resident list not refreshed"
+    from karpenter_tpu.oracle.consolidation import eligible
+    assert not eligible(live, op.cluster)
+    # deletion drops it from the resident list too
+    op.kube.delete("pods", "w-0")
+    assert not [p for p in live.pods if p.name == "w-0"]

@@ -559,8 +559,9 @@ class TestProvisionerWireFidelity:
             op.kube.create("pods", "w-0", make_pod("w-0", cpu="1",
                                                    memory="1Gi"))
             op.reconcile_all_once()
-            (node_name,) = list(op.cluster.nodes)
-            assert eligible(op.cluster.nodes[node_name], op.cluster) or True
+            op.reconcile_all_once()  # second pass: machine lifecycle flips
+            (node_name,) = list(op.cluster.nodes)  # Initialized on pass 2
+            assert eligible(op.cluster.nodes[node_name], op.cluster)
 
             # kubectl annotate: a raw merge-PATCH on metadata.annotations
             req = urllib.request.Request(
