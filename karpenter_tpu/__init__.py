@@ -1,0 +1,11 @@
+"""karpenter-tpu: a TPU-native Kubernetes node-provisioning autoscaler.
+
+Public surface (see docs/getting-started.md):
+
+- ``karpenter_tpu.operator.Operator`` — the controller plane.
+- ``karpenter_tpu.solver.core.TPUSolver`` / ``NativeSolver`` — the batched
+  scheduling backends (bit-parity with ``oracle.scheduler``).
+- ``python -m karpenter_tpu`` — controller / solver-serve / cleanup CLIs.
+"""
+
+__version__ = "0.1.0"

@@ -23,7 +23,7 @@ import os
 import signal
 import sys
 
-VERSION = "0.1.0"
+from . import __version__ as VERSION
 
 
 def _wait_for_signal() -> None:
