@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Raw tunnel-link characterization: d2h/h2d latency + bandwidth curve.
+
+Completes the floor decomposition of docs/designs/solver-boundary.md with
+transfer-size data: the link sentinels established WHEN the relay degrades
+(the session's first device->host read); this tool establishes the COST
+MODEL afterwards — per-op latency and sustained bandwidth in both
+directions — so multi-MB readbacks (e.g. the 10k-pod wave's concatenated
+PackResult) are attributable to latency x ops + bytes / bandwidth.
+
+Writes benchmarks/results/linkprobe_<utc>.json. Run while the tunnel is
+answering (hack/tpu_capture.py records link_state; this goes deeper).
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SIZES = (8, 1 << 10, 1 << 17, 1 << 20, 1 << 22, 1 << 24)  # 8B .. 16MB
+REPS = 5
+
+
+def _sync_sentinel(jax, jnp, reps=5):
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.int32)
+    f(x).block_until_ready()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        ts.append((time.perf_counter() - t0) * 1000)
+    return round(statistics.median(ts), 3)
+
+
+def main():
+    from karpenter_tpu.utils.jaxenv import pin, probe_tpu
+
+    ok, note = probe_tpu(attempts=1)
+    if not ok:
+        print(json.dumps({"error": "tunnel not answering", "probe": note}))
+        return 1
+    jax, _ = pin("axon")
+    import jax.numpy as jnp
+    import numpy as np
+
+    rec = {"device": str(jax.devices()[0]),
+           "sync_fresh_ms": _sync_sentinel(jax, jnp)}
+
+    # h2d while still streaming (puts don't flip the link state)
+    h2d = []
+    for size in SIZES:
+        host = np.zeros(size // 4, np.int32)
+        jax.device_put(host).block_until_ready()  # first-touch alloc
+        ts = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            jax.device_put(host).block_until_ready()
+            ts.append((time.perf_counter() - t0) * 1000)
+        ms = statistics.median(ts)
+        h2d.append({"bytes": size, "p50_ms": round(ms, 3),
+                    "mb_per_s": round(size / 2**20 / (ms / 1000), 1) if ms else None})
+    rec["h2d_streaming"] = h2d
+    rec["sync_after_h2d_ms"] = _sync_sentinel(jax, jnp)
+
+    # d2h: the FIRST read flips the relay out of streaming mode — record it
+    # separately, then sweep sizes in the degraded state the production
+    # readback actually experiences.
+    dev8 = jax.device_put(np.zeros(2, np.int32))
+    t0 = time.perf_counter()
+    np.asarray(jax.device_get(dev8))
+    rec["first_read_ms"] = round((time.perf_counter() - t0) * 1000, 3)
+
+    # Each rep reads a FRESH device-computed buffer (a re-get of the same
+    # buffer is served from PJRT's host-side copy and measures nothing);
+    # the producing op is blocked on *before* the clock so the timed span
+    # is the transfer alone, not the degraded-mode dispatch sync.
+    d2h = []
+    for size in SIZES:
+        dev = jax.device_put(np.zeros(size // 4, np.int32))
+        bump = jax.jit(lambda x, s: x + s)
+        bump(dev, 0).block_until_ready()
+        ts = []
+        for rep in range(REPS):
+            y = bump(dev, rep + 1)
+            y.block_until_ready()
+            t0 = time.perf_counter()
+            np.asarray(jax.device_get(y))
+            ts.append((time.perf_counter() - t0) * 1000)
+        ms = statistics.median(ts)
+        d2h.append({"bytes": size, "p50_ms": round(ms, 3),
+                    "mb_per_s": round(size / 2**20 / (ms / 1000), 1) if ms else None})
+    rec["d2h_degraded"] = d2h
+
+    # What a solve actually pays: get() of a just-enqueued (unsynced)
+    # result — dispatch sync + transfer in one span.
+    unsynced = []
+    for size in (8, 1 << 17, 1 << 22):
+        dev = jax.device_put(np.zeros(size // 4, np.int32))
+        bump = jax.jit(lambda x, s: x * 1 + s)
+        bump(dev, 0).block_until_ready()
+        ts = []
+        for rep in range(REPS):
+            y = bump(dev, rep + 1)
+            t0 = time.perf_counter()
+            np.asarray(jax.device_get(y))
+            ts.append((time.perf_counter() - t0) * 1000)
+        unsynced.append({"bytes": size,
+                         "p50_ms": round(statistics.median(ts), 3)})
+    rec["d2h_unsynced"] = unsynced
+    rec["sync_after_d2h_ms"] = _sync_sentinel(jax, jnp)
+
+    # latency/bandwidth fit: ms ~= a + bytes/bw  (least squares over sweep)
+    xs = np.array([e["bytes"] for e in d2h], float)
+    ys = np.array([e["p50_ms"] for e in d2h], float)
+    A = np.stack([np.ones_like(xs), xs], axis=1)
+    (a, b), *_ = np.linalg.lstsq(A, ys, rcond=None)
+    rec["d2h_fit"] = {"latency_ms": round(float(a), 3),
+                      "bandwidth_mb_s": round(1.0 / b / 1048.576, 1) if b > 0 else None}
+
+    rec["captured_at"] = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    out = os.path.join(REPO, "benchmarks", "results",
+                       f"linkprobe_{rec['captured_at']}.json")
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec, indent=1))
+    print(f"-> {out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,7 +35,8 @@ from ..models.requirements import OP_IN, Requirement, Requirements
 from ..utils.quantity import cpu_millis, mem_bytes, count as count_qty
 from . import wellknown as wk
 from .nodetemplate import BlockDeviceMapping, MetadataOptions, NodeTemplate
-from .provisioner import KubeletConfiguration, Limits, Provisioner
+from .provisioner import (KubeletConfiguration, Limits, Provisioner,
+                          ValidationError)
 
 # reference AMI families -> our image families (providers/images.py)
 FAMILY_MAP = {
@@ -83,11 +84,28 @@ def load_manifests(text: str, env: "Optional[dict[str, str]]" = None,
     for key, value in (env or {}).items():
         text = text.replace("${" + key + "}", value)
     out = LoadedManifests([], [], [], [])
+    synthesized: "set[str]" = set()  # templates minted from inline provider
     docs = [d for d in yaml.safe_load_all(text) if d]
     for doc in docs:
         kind = doc.get("kind", "")
         if kind == "Provisioner":
-            out.provisioners.append(_provisioner(doc))
+            prov = _provisioner(doc)
+            inline = (doc.get("spec") or {}).get("provider")
+            if inline:
+                # v1alpha5 still accepts the inline vendor block that
+                # v1alpha4 introduced (provisioner.go:38 DeserializeProvider)
+                # — mutually exclusive with providerRef, loaded as an
+                # anonymous NodeTemplate owned by this provisioner
+                # (docs/designs/api-evolution.md).
+                if prov.provider_ref:
+                    raise ValidationError(
+                        f"provisioner {prov.name}: spec.provider and "
+                        f"spec.providerRef are mutually exclusive")
+                out.templates.append(_nodetemplate(
+                    {"metadata": {"name": prov.name}, "spec": inline}))
+                synthesized.add(prov.name)
+                prov.provider_ref = prov.name
+            out.provisioners.append(prov)
         elif kind in ("AWSNodeTemplate", "NodeTemplate"):
             out.templates.append(_nodetemplate(doc))
         elif kind == "Deployment":
@@ -96,6 +114,15 @@ def load_manifests(text: str, env: "Optional[dict[str, str]]" = None,
             out.pods.append(_pod(doc.get("metadata", {}), doc.get("spec", {})))
         elif kind == "PodDisruptionBudget":
             out.pdbs.append(_pdb(doc, docs))
+    counts: "dict[str, int]" = {}
+    for t in out.templates:
+        counts[t.name] = counts.get(t.name, 0) + 1
+    clash = {n for n in synthesized if counts[n] > 1}
+    if clash:
+        raise ValidationError(
+            f"inline spec.provider synthesizes a NodeTemplate named after "
+            f"its provisioner, which collides with an explicit template: "
+            f"{sorted(clash)} — rename the provisioner or use providerRef")
     return out
 
 
@@ -123,7 +150,17 @@ def _taints(items) -> "tuple[Taint, ...]":
 
 
 def _provisioner(doc) -> Provisioner:
-    spec = doc.get("spec", {})
+    spec_keys = doc.get("spec") or {}
+    for removed, instead in (
+            ("architecture", "a kubernetes.io/arch requirement"),
+            ("operatingSystem", "a kubernetes.io/os requirement"),
+            ("cluster", "settings (apis/settings.py)")):
+        # scalars the reference removed in v1alpha4 (designs/v1alpha4-api.md)
+        # fail loudly instead of silently narrowing the pool
+        if removed in spec_keys:
+            raise ValidationError(
+                f"spec.{removed} was removed in v1alpha4; use {instead}")
+    spec = spec_keys  # same fetch, None-safe (explicit `spec:` null)
     limits_spec = (spec.get("limits") or {}).get("resources", {})
     limits = Limits(
         cpu_millis=cpu_millis(limits_spec["cpu"]) if "cpu" in limits_spec else None,

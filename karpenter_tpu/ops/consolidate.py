@@ -232,7 +232,12 @@ def encode_consolidation(
         group_vec=group_vec, group_count=group_count, group_cap=group_cap,
         group_feas=group_feas, group_newprov=group_newprov,
         overhead=np.asarray(overhead, dtype=np.int32),
-        ex_alloc=ex_alloc, ex_used=np.broadcast_to(ex_used, (C, Ne, R)).copy(),
+        # ex_used is IDENTICAL across lanes (a candidate's own nodes are
+        # excluded via ex_feas, never via usage), so it rides the shared
+        # in_axes=None lane like ex_alloc: at 500 lanes x 500 nodes the old
+        # per-lane broadcast shipped ~6MB h2d per sweep — the dominant cost
+        # on a ~15MB/s degraded tunnel link (linkprobe_20260730T154547Z)
+        ex_alloc=ex_alloc, ex_used=ex_used,
         ex_feas=ex_feas,
         prov_overhead=prov_overhead, prov_pods_cap=prov_pods_cap,
         ex_cap=ex_cap_arr, group_origin=group_origin,
@@ -245,7 +250,7 @@ def _batched_pack(inputs: PackInputs, n_slots: int):
     axes = PackInputs(
         alloc_t=None, tiebreak=None,
         group_vec=0, group_count=0, group_cap=0, group_feas=0, group_newprov=0,
-        overhead=None, ex_alloc=None, ex_used=0, ex_feas=0,
+        overhead=None, ex_alloc=None, ex_used=None, ex_feas=0,
         prov_overhead=None, prov_pods_cap=None,  # shared across candidates
         ex_cap=None if inputs.ex_cap is None else 0,
         group_origin=None if inputs.group_origin is None else 0,

@@ -157,7 +157,7 @@ def _pad_lanes(inputs: PackInputs, multiple: int) -> "tuple[PackInputs, int]":
         group_cap=pad(inputs.group_cap, int(INT_BIG)),
         group_feas=pad(inputs.group_feas, False),
         group_newprov=pad(inputs.group_newprov, -1),
-        ex_used=pad(inputs.ex_used), ex_feas=pad(inputs.ex_feas, False),
+        ex_feas=pad(inputs.ex_feas, False),
     )
     if inputs.ex_cap is not None:
         out = out._replace(ex_cap=pad(inputs.ex_cap, int(INT_BIG)))
@@ -181,7 +181,7 @@ def sharded_consolidation_verdicts(inputs: PackInputs, n_slots: int,
         alloc_t=rep, tiebreak=rep,
         group_vec=lane(), group_count=lane(), group_cap=lane(),
         group_feas=lane(), group_newprov=lane(), overhead=rep,
-        ex_alloc=rep, ex_used=lane(), ex_feas=lane(),
+        ex_alloc=rep, ex_used=rep, ex_feas=lane(),  # ex_used: shared, no lane axis
         prov_overhead=None if inputs.prov_overhead is None else rep,
         prov_pods_cap=None if inputs.prov_pods_cap is None else rep,
         ex_cap=None if inputs.ex_cap is None else lane(),

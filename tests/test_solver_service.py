@@ -340,8 +340,11 @@ def test_solver_service_profiling_hook(tmp_path):
     svc = SolverService(trace_dir=str(tmp_path), trace_every=1)
     srv, port, _ = serve("127.0.0.1:0", service=svc)
     try:
+        # generous deadline: the traced solve pays jax.profiler start/stop,
+        # which grows with accumulated session state — late in a full-suite
+        # run it can exceed the 10s production default (observed flake)
         solver = RemoteSolver(small_catalog(), [default_provisioner()],
-                              target=f"127.0.0.1:{port}")
+                              target=f"127.0.0.1:{port}", timeout=120.0)
         res = solver.solve(mixed_pods(8))
         assert sum(n.pod_count for n in res.nodes) == 8
         produced = []

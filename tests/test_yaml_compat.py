@@ -74,6 +74,99 @@ class TestProvisionerManifests:
         assert req is not None and not req.has("t3.small")
         assert req.has("m5.4xlarge")  # NotIn: anything not listed passes
 
+    def test_inline_provider_becomes_anonymous_nodetemplate(self):
+        # the v1alpha4 inline vendor block (designs/v1alpha4-api.md;
+        # provisioner.go:38 DeserializeProvider) still loads
+        loaded = load_manifests("""
+apiVersion: karpenter.sh/v1alpha5
+kind: Provisioner
+metadata:
+  name: legacy
+spec:
+  provider:
+    amiFamily: Bottlerocket
+    instanceProfile: legacyProfile
+    subnetSelector:
+      karpenter.sh/discovery: demo
+    securityGroupSelector:
+      karpenter.sh/discovery: demo
+""")
+        p = loaded.provisioners[0]
+        assert p.provider_ref == "legacy"
+        t = loaded.templates[0]
+        assert (t.name, t.image_family, t.instance_profile) == \
+            ("legacy", "flatboat", "legacyProfile")
+
+    def test_inline_provider_and_providerref_are_exclusive(self):
+        import pytest
+
+        from karpenter_tpu.apis.provisioner import ValidationError
+
+        with pytest.raises(ValidationError, match="mutually exclusive"):
+            load_manifests("""
+apiVersion: karpenter.sh/v1alpha5
+kind: Provisioner
+metadata:
+  name: both
+spec:
+  providerRef:
+    name: other
+  provider:
+    subnetSelector:
+      karpenter.sh/discovery: demo
+""")
+
+    def test_inline_provider_collision_with_explicit_template_rejected(self):
+        import pytest
+
+        from karpenter_tpu.apis.provisioner import ValidationError
+
+        with pytest.raises(ValidationError, match="collides"):
+            load_manifests("""
+apiVersion: karpenter.sh/v1alpha5
+kind: Provisioner
+metadata:
+  name: foo
+spec:
+  provider:
+    subnetSelector:
+      karpenter.sh/discovery: demo
+---
+apiVersion: karpenter.k8s.tpu/v1alpha1
+kind: NodeTemplate
+metadata:
+  name: foo
+spec:
+  subnetSelector:
+    karpenter.sh/discovery: demo
+""")
+
+    def test_explicit_null_spec_parses(self):
+        loaded = load_manifests("""
+apiVersion: karpenter.sh/v1alpha5
+kind: Provisioner
+metadata:
+  name: empty
+spec:
+""")
+        assert loaded.provisioners[0].name == "empty"
+
+    def test_removed_v1alpha3_scalars_fail_loudly(self):
+        import pytest
+
+        from karpenter_tpu.apis.provisioner import ValidationError
+
+        for field in ("architecture", "operatingSystem", "cluster"):
+            with pytest.raises(ValidationError, match="removed in v1alpha4"):
+                load_manifests(f"""
+apiVersion: karpenter.sh/v1alpha5
+kind: Provisioner
+metadata:
+  name: old
+spec:
+  {field}: whatever
+""")
+
 
 class TestWorkloadReplay:
     def load_workload(self, name, replicas=None):
@@ -385,6 +478,7 @@ class TestExamplesDirectory:
 
     def test_every_example_parses_and_validates(self):
         for path in self._load("*.yaml", "provisioner/*.yaml",
+                               "provisioner/launchtemplates/*.yaml",
                                "workloads/*.yaml"):
             loaded = load_manifests(open(path).read(),
                                     env={"CLUSTER_NAME": "demo"})
@@ -394,8 +488,10 @@ class TestExamplesDirectory:
                     or loaded.pdbs), f"{path} loaded nothing"
 
     def test_example_breadth_matches_reference_shape(self):
+        # reference: 7 provisioner + 4 launchtemplates + 11 workloads
         assert len(self._load("provisioner/*.yaml")) >= 8
-        assert len(self._load("workloads/*.yaml")) >= 8
+        assert len(self._load("provisioner/launchtemplates/*.yaml")) >= 4
+        assert len(self._load("workloads/*.yaml")) >= 11
 
     def test_combined_examples_schedule_end_to_end(self):
         provisioners, pods = [], []
