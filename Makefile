@@ -8,9 +8,17 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry
+.PHONY: presubmit lint test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry
 
-presubmit: test verify-entry  ## what CI runs
+presubmit: lint test verify-entry  ## what CI runs
+
+lint:  ## static analysis: bytecode-compile everything; ruff when installed
+	$(PY) -m compileall -q karpenter_tpu tests hack benchmarks bench.py __graft_entry__.py
+	@if $(PY) -c "import ruff" 2>/dev/null; then \
+		$(PY) -m ruff check karpenter_tpu tests hack benchmarks; \
+	else \
+		echo "ruff not installed; compileall-only lint (CI runs ruff)"; \
+	fi
 
 test:  ## hermetic suite (8-device virtual CPU mesh)
 	$(CPU_ENV) $(PY) -m pytest tests/ -q

@@ -129,6 +129,8 @@ def main():
                                      or {}).get("p50_ms"),
                 "wave_per_solve_ms": (cap.get("wave_pipelined")
                                       or {}).get("per_solve_ms"),
+                "wave_steady_per_solve_ms": (cap.get("wave_steady")
+                                             or {}).get("per_solve_ms"),
             }
     except Exception as e:  # capture history must never break the bench
         _state["detail"]["latest_tpu_capture_error"] = str(e)[:120]

@@ -26,15 +26,28 @@ REPS = 5
 
 
 def _sync_sentinel(jax, jnp, reps=5):
-    f = jax.jit(lambda x: x + 1)
-    x = jnp.zeros((8,), jnp.int32)
-    f(x).block_until_ready()
-    ts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        f(x).block_until_ready()
-        ts.append((time.perf_counter() - t0) * 1000)
-    return round(statistics.median(ts), 3)
+    # one sentinel implementation for all tools (shape: {p50_ms, min_ms})
+    from hack.tpu_capture import _link_sentinel
+
+    return _link_sentinel(jax, jnp, reps=reps)["p50_ms"]
+
+
+def _h2d_sweep(jax, np):
+    """device_put latency/bandwidth across SIZES (puts never flip the
+    relay's link state, so this measures whichever state is current)."""
+    rows = []
+    for size in SIZES:
+        host = np.zeros(size // 4, np.int32)
+        jax.device_put(host).block_until_ready()  # first-touch alloc
+        ts = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            jax.device_put(host).block_until_ready()
+            ts.append((time.perf_counter() - t0) * 1000)
+        ms = statistics.median(ts)
+        rows.append({"bytes": size, "p50_ms": round(ms, 3),
+                     "mb_per_s": round(size / 2**20 / (ms / 1000), 1) if ms else None})
+    return rows
 
 
 def main():
@@ -51,20 +64,7 @@ def main():
     rec = {"device": str(jax.devices()[0]),
            "sync_fresh_ms": _sync_sentinel(jax, jnp)}
 
-    # h2d while still streaming (puts don't flip the link state)
-    h2d = []
-    for size in SIZES:
-        host = np.zeros(size // 4, np.int32)
-        jax.device_put(host).block_until_ready()  # first-touch alloc
-        ts = []
-        for _ in range(REPS):
-            t0 = time.perf_counter()
-            jax.device_put(host).block_until_ready()
-            ts.append((time.perf_counter() - t0) * 1000)
-        ms = statistics.median(ts)
-        h2d.append({"bytes": size, "p50_ms": round(ms, 3),
-                    "mb_per_s": round(size / 2**20 / (ms / 1000), 1) if ms else None})
-    rec["h2d_streaming"] = h2d
+    rec["h2d_streaming"] = _h2d_sweep(jax, np)
     rec["sync_after_h2d_ms"] = _sync_sentinel(jax, jnp)
 
     # d2h: the FIRST read flips the relay out of streaming mode — record it
@@ -113,6 +113,12 @@ def main():
                          "p50_ms": round(statistics.median(ts), 3)})
     rec["d2h_unsynced"] = unsynced
     rec["sync_after_d2h_ms"] = _sync_sentinel(jax, jnp)
+
+    # h2d in the DEGRADED state (the streaming sweep above ran before the
+    # first read): what consolidation/solve input shipping actually pays
+    # in a long-lived session. NOTE each rep blocks, so small sizes read
+    # as the degraded sync floor; bandwidth shows at the large sizes.
+    rec["h2d_degraded"] = _h2d_sweep(jax, np)
 
     # latency/bandwidth fit: ms ~= a + bytes/bw  (least squares over sweep)
     xs = np.array([e["bytes"] for e in d2h], float)

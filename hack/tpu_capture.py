@@ -152,6 +152,16 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
     pods = mixed_workload(10_000)
     head_p50, times = p50(tpu, pods, reps_headline)
     res = tpu.solve(pods)
+    # phase attribution of the degraded-mode solve (needs the
+    # KARPENTER_TPU_SOLVE_TIMING=1 env capture_once sets): which of
+    # encode / dispatch(h2d+enqueue) / fetch(the one sync) / decode owns
+    # the wall clock above the ~66ms sync floor
+    phases = []
+    for _ in range(3):
+        tpu.solve(pods)
+        t = getattr(tpu, "last_timings", None)
+        if t:
+            phases.append(t)
 
     crossover = None
     for row in sweep:  # smallest size where the device wins
@@ -259,6 +269,7 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
             "n_pods": len(pods),
             "nodes_provisioned": len(res.nodes),
             "unschedulable": res.unschedulable_count(),
+            "phase_split": phases,
         },
         "sweep": sweep,
         "crossover_pods": crossover,
@@ -285,7 +296,8 @@ def capture_once(timeout_s: int, reps_headline: int, reps_sweep: int) -> "dict |
     code = (f"import sys, json; sys.path.insert(0, {REPO!r})\n"
             "from hack.tpu_capture import _capture_payload\n"
             f"print('CAPTURE::' + json.dumps(_capture_payload({reps_headline}, {reps_sweep})))")
-    env = dict(os.environ, JAX_PLATFORMS="axon")
+    env = dict(os.environ, JAX_PLATFORMS="axon",
+               KARPENTER_TPU_SOLVE_TIMING="1")  # phase-attributed headline
     try:
         r = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
                            capture_output=True, text=True, timeout=timeout_s)

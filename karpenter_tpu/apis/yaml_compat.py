@@ -202,7 +202,7 @@ def _provisioner(doc) -> Provisioner:
 # -- node template -----------------------------------------------------------------
 
 def _nodetemplate(doc) -> NodeTemplate:
-    spec = doc.get("spec", {})
+    spec = doc.get("spec") or {}  # None-safe (explicit `spec:` null)
     bdms = []
     for m in spec.get("blockDeviceMappings") or ():
         ebs = m.get("ebs") or {}

@@ -30,6 +30,12 @@ from ..ops.packer import (INT_BIG, PackInputs, PackResult, pack_flat,
                           pallas_value_safe, unflatten_result)
 from ..oracle.scheduler import ExistingNode, Option
 
+import os as _os
+
+# phase-attributed solves (encode/dispatch/fetch/decode wall-clock split,
+# read from TPUSolver.last_timings) — capture-tool diagnostics only
+_SOLVE_TIMING = _os.environ.get("KARPENTER_TPU_SOLVE_TIMING") == "1"
+
 
 def _bucket(n: int, lo: int = 8) -> int:
     b = lo
@@ -232,13 +238,34 @@ class TPUSolver:
         daemon_overhead: Optional[Sequence[int]] = None,
         n_slots: Optional[int] = None,
     ) -> SolveResult:
+        # one code path, timed always (perf_counter is ns against a multi-ms
+        # solve); .last_timings is only published under the capture tool's
+        # KARPENTER_TPU_SOLVE_TIMING=1 flag. Phases: encode/dispatch are
+        # host work + async enqueue, fetch is the one device sync, decode
+        # is host-side result shaping (docs/designs/solver-boundary.md).
+        import time as _time
+
+        t0 = _time.perf_counter()
         enc = encode_problem(
             self.catalog, self.provisioners, pods, existing,
             daemon_overhead, n_slots, grid=self.grid(),
             group_cache=self._group_cache,
         )
-        result = run_pack(enc, self._dev_alloc_t, self._dev_tiebreak)
-        return decode(enc, result, [e.name for e in existing])
+        t1 = _time.perf_counter()
+        flat, dims = dispatch_pack(enc, self._dev_alloc_t, self._dev_tiebreak)
+        t2 = _time.perf_counter()
+        result = fetch_pack(flat, dims)
+        t3 = _time.perf_counter()
+        out = decode(enc, result, [e.name for e in existing])
+        if _SOLVE_TIMING:
+            t4 = _time.perf_counter()
+            self.last_timings = {
+                "encode_ms": round((t1 - t0) * 1000, 3),
+                "dispatch_ms": round((t2 - t1) * 1000, 3),
+                "fetch_ms": round((t3 - t2) * 1000, 3),
+                "decode_ms": round((t4 - t3) * 1000, 3),
+            }
+        return out
 
 
 def _carry_round1_existing(existing: "Sequence[ExistingNode]",

@@ -148,8 +148,15 @@ kind: Provisioner
 metadata:
   name: empty
 spec:
+---
+apiVersion: karpenter.k8s.tpu/v1alpha1
+kind: NodeTemplate
+metadata:
+  name: empty
+spec:
 """)
         assert loaded.provisioners[0].name == "empty"
+        assert loaded.templates[0].name == "empty"
 
     def test_removed_v1alpha3_scalars_fail_loudly(self):
         import pytest
