@@ -15,7 +15,7 @@ presubmit: lint test verify-entry  ## what CI runs
 lint:  ## static analysis: bytecode-compile everything; ruff when installed
 	$(PY) -m compileall -q karpenter_tpu tests hack benchmarks bench.py __graft_entry__.py
 	@if $(PY) -c "import ruff" 2>/dev/null; then \
-		$(PY) -m ruff check karpenter_tpu tests hack benchmarks; \
+		$(PY) -m ruff check karpenter_tpu tests hack benchmarks bench.py __graft_entry__.py; \
 	else \
 		echo "ruff not installed; compileall-only lint (CI runs ruff)"; \
 	fi

@@ -111,7 +111,8 @@ def load_manifests(text: str, env: "Optional[dict[str, str]]" = None,
         elif kind == "Deployment":
             out.pods.extend(_deployment_pods(doc, replicas_override))
         elif kind == "Pod":
-            out.pods.append(_pod(doc.get("metadata", {}), doc.get("spec", {})))
+            out.pods.append(_pod(doc.get("metadata", {}),
+                                 doc.get("spec") or {}))
         elif kind == "PodDisruptionBudget":
             out.pdbs.append(_pdb(doc, docs))
     counts: "dict[str, int]" = {}
@@ -378,21 +379,21 @@ def _pod(metadata, spec, name: str = "", labels=None) -> PodSpec:
 
 
 def _deployment_pods(doc, replicas_override: "Optional[int]") -> "list[PodSpec]":
-    spec = doc.get("spec", {})
+    spec = doc.get("spec") or {}  # None-safe (explicit `spec:` null)
     replicas = replicas_override if replicas_override is not None \
         else int(spec.get("replicas", 1))
-    template = spec.get("template", {})
+    template = spec.get("template") or {}
     metadata = template.get("metadata", {})
     name = doc.get("metadata", {}).get("name", "workload")
-    proto = _pod(metadata, template.get("spec", {}), name=name)
+    proto = _pod(metadata, template.get("spec") or {}, name=name)
     return [dataclasses.replace(proto, name=f"{name}-{i}")
             for i in range(replicas)]
 
 
 def _pdb(doc, all_docs) -> PodDisruptionBudget:
-    spec = doc.get("spec", {})
+    spec = doc.get("spec") or {}  # None-safe (explicit `spec:` null)
     selector = {str(k): str(v) for k, v in
-                (spec.get("selector", {}).get("matchLabels") or {}).items()}
+                ((spec.get("selector") or {}).get("matchLabels") or {}).items()}
     min_available = spec.get("minAvailable")
     max_unavailable = spec.get("maxUnavailable")
 

@@ -72,6 +72,16 @@ class ConsolidationBatch:
     candidates: "list[tuple[StateNode, ...]]"  # one SET per lane (singles or pairs)
     provisioners: "list[Provisioner]"
     grid: OptionGrid
+    # group feasibility ships as a unique-row table + per-lane indices and
+    # is expanded to the full [C,Gb,Pv,T,S] ON DEVICE (inputs.group_feas is
+    # None): candidate lanes in a real cluster repeat a handful of distinct
+    # (group spec, price band) rows, so the dense array is ~97% duplicate
+    # bytes — 1.6MB at 500 singles, ~13MB at the 2016-lane pair sweep —
+    # and h2d bandwidth on a degraded tunnel link is ~15MB/s
+    # (docs/designs/solver-boundary.md cost model). Row 0 is all-False
+    # (padded/unused groups).
+    feas_table: "np.ndarray" = None  # [U, Pv, T, S] bool
+    feas_idx: "np.ndarray" = None  # [C, Gb] int32
 
 
 def encode_consolidation(
@@ -151,7 +161,9 @@ def encode_consolidation(
     group_vec = np.zeros((C, Gb, R), dtype=np.int32)
     group_count = np.zeros((C, Gb), dtype=np.int32)
     group_cap = np.full((C, Gb), INT_BIG, dtype=np.int32)
-    group_feas = np.zeros((C, Gb, Pv, T, S), dtype=bool)
+    feas_idx = np.zeros((C, Gb), dtype=np.int32)  # 0 = all-False row
+    feas_rows: "list[np.ndarray]" = []  # unique [Pv,T,S] rows, 1-based
+    feas_row_index: "dict[tuple, int]" = {}
     group_newprov = np.full((C, Gb), -1, dtype=np.int32)
     ex_feas = np.zeros((C, Gb, Ne), dtype=bool)
     # origin-representative rows: zone-split subgroups share one per-node cap
@@ -208,7 +220,11 @@ def encode_consolidation(
             group_vec[ci, gi] = vec
             group_count[ci, gi] = g.count
             group_cap[ci, gi] = cap
-            group_feas[ci, gi] = feas
+            ridx = feas_row_index.get(gkey)
+            if ridx is None:
+                feas_rows.append(feas)
+                ridx = feas_row_index[gkey] = len(feas_rows)  # 1-based
+            feas_idx[ci, gi] = ridx
             group_newprov[ci, gi] = newprov
             row = ex_feas[ci, gi]
             row[:] = fit_vector(g.spec)
@@ -227,10 +243,14 @@ def encode_consolidation(
                     if rc:
                         ex_cap_arr[ci, gi, i] = max(0, cap - rc.get(okey, 0))
 
+    feas_table = np.zeros((1 + len(feas_rows), Pv, T, S), dtype=bool)
+    for i, feas in enumerate(feas_rows):
+        feas_table[1 + i] = feas
     inputs = PackInputs(
         alloc_t=grid.alloc_t, tiebreak=grid.tiebreak,
         group_vec=group_vec, group_count=group_count, group_cap=group_cap,
-        group_feas=group_feas, group_newprov=group_newprov,
+        group_feas=None,  # expanded on device from (feas_table, feas_idx)
+        group_newprov=group_newprov,
         overhead=np.asarray(overhead, dtype=np.int32),
         # ex_used is IDENTICAL across lanes (a candidate's own nodes are
         # excluded via ex_feas, never via usage), so it rides the shared
@@ -242,7 +262,8 @@ def encode_consolidation(
         prov_overhead=prov_overhead, prov_pods_cap=prov_pods_cap,
         ex_cap=ex_cap_arr, group_origin=group_origin,
     )
-    return ConsolidationBatch(inputs, candidates, provs, grid)
+    return ConsolidationBatch(inputs, candidates, provs, grid,
+                              feas_table=feas_table, feas_idx=feas_idx)
 
 
 @functools.partial(jax.jit, static_argnames=("n_slots",))
@@ -259,13 +280,20 @@ def _batched_pack(inputs: PackInputs, n_slots: int):
 
 
 @functools.partial(jax.jit, static_argnames=("n_slots",))
-def _batched_pack_verdicts(inputs: PackInputs, n_slots: int):
+def _batched_pack_verdicts(inputs: PackInputs, n_slots: int,
+                           feas_table=None, feas_idx=None):
     """The batched pack reduced ON DEVICE to the [C, 3] verdict table the
     action decoder actually reads: (total unschedulable, nodes opened,
     decided option of slot 0). The full PackResult for C=500 lanes is
     megabytes (assign [C,G,N], ex_assign [C,G,Ne]); over a tunneled device
     every d2h transfer is the latency budget, so the sweep ships ~6KB
-    instead (same discipline as packer.pack_flat — one read per dispatch)."""
+    instead (same discipline as packer.pack_flat — one read per dispatch).
+    When (feas_table, feas_idx) are given, inputs.group_feas is None and
+    the dense [C,Gb,Pv,T,S] feasibility is gathered here on device — the
+    h2d direction ships the unique rows only (ConsolidationBatch)."""
+    if feas_table is not None:
+        inputs = inputs._replace(
+            group_feas=jax.numpy.take(feas_table, feas_idx, axis=0))
     r = _batched_pack(inputs, n_slots)
     return jax.numpy.stack(
         [r.unsched.sum(axis=1), r.n_open, r.decided[:, 0]], axis=1)
@@ -307,15 +335,19 @@ def _decode_actions(batch: ConsolidationBatch, verdicts, now: float
     return actions
 
 
-def _verdicts(inputs: PackInputs, mesh):
+def _verdicts(batch: ConsolidationBatch, mesh):
     """Single-device dispatch, or candidate lanes sharded over a mesh
     (pure data parallelism — see parallel/sharded.py make_lane_mesh)."""
     if mesh is not None:
         from ..parallel.sharded import sharded_consolidation_verdicts
 
-        return sharded_consolidation_verdicts(inputs, N_SLOTS, mesh)
-    return jax.device_get(
-        _batched_pack_verdicts(jax.device_put(inputs), N_SLOTS))
+        return sharded_consolidation_verdicts(
+            batch.inputs, N_SLOTS, mesh,
+            feas_table=batch.feas_table, feas_idx=batch.feas_idx)
+    return jax.device_get(_batched_pack_verdicts(
+        jax.device_put(batch.inputs), N_SLOTS,
+        feas_table=jax.device_put(batch.feas_table),
+        feas_idx=jax.device_put(batch.feas_idx)))
 
 
 def run_consolidation(
@@ -341,7 +373,7 @@ def run_consolidation(
                                  candidate_filter=candidate_filter)
     if batch is None:
         return None
-    verdicts = _verdicts(batch.inputs, mesh)
+    verdicts = _verdicts(batch, mesh)
     actions = _decode_actions(batch, verdicts, now)
     if actions:
         return min(actions, key=ConsolidationAction.sort_key)
@@ -359,7 +391,7 @@ def run_consolidation(
                                       cand_sets=pairs)
     if pair_batch is None:
         return None
-    pair_verdicts = _verdicts(pair_batch.inputs, mesh)
+    pair_verdicts = _verdicts(pair_batch, mesh)
     actions = _decode_actions(pair_batch, pair_verdicts, now)
     if not actions:
         return None

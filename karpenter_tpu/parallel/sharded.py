@@ -155,10 +155,11 @@ def _pad_lanes(inputs: PackInputs, multiple: int) -> "tuple[PackInputs, int]":
     out = inputs._replace(
         group_vec=pad(inputs.group_vec), group_count=pad(inputs.group_count),
         group_cap=pad(inputs.group_cap, int(INT_BIG)),
-        group_feas=pad(inputs.group_feas, False),
         group_newprov=pad(inputs.group_newprov, -1),
         ex_feas=pad(inputs.ex_feas, False),
     )
+    if inputs.group_feas is not None:  # None when a feas table+idx rides along
+        out = out._replace(group_feas=pad(inputs.group_feas, False))
     if inputs.ex_cap is not None:
         out = out._replace(ex_cap=pad(inputs.ex_cap, int(INT_BIG)))
     if inputs.group_origin is not None:
@@ -167,20 +168,30 @@ def _pad_lanes(inputs: PackInputs, multiple: int) -> "tuple[PackInputs, int]":
 
 
 def sharded_consolidation_verdicts(inputs: PackInputs, n_slots: int,
-                                   mesh: Mesh) -> np.ndarray:
+                                   mesh: Mesh, feas_table=None,
+                                   feas_idx=None) -> np.ndarray:
     """The [C, 3] verdict table of ops.consolidate._batched_pack_verdicts,
     with candidate lanes sharded across `mesh`. Bit-identical to the
-    single-device sweep (tests/test_sharded.py)."""
+    single-device sweep (tests/test_sharded.py). When a unique-row
+    feasibility table rides along (inputs.group_feas is None), the table
+    replicates and only the per-lane indices shard — the dense expansion
+    happens device-side inside the jitted verdicts fn."""
     from ..ops.consolidate import _batched_pack_verdicts
 
     n = mesh.devices.size
     inputs, C = _pad_lanes(inputs, n)
     lane = lambda *rest: NamedSharding(mesh, P(AXIS_LANES, *rest))
     rep = NamedSharding(mesh, P())
+    if feas_idx is not None:
+        Cp = inputs.group_vec.shape[0]
+        if feas_idx.shape[0] != Cp:  # pad lanes -> all-False row 0
+            feas_idx = np.pad(feas_idx,
+                              [(0, Cp - feas_idx.shape[0]), (0, 0)])
     shardings = PackInputs(
         alloc_t=rep, tiebreak=rep,
         group_vec=lane(), group_count=lane(), group_cap=lane(),
-        group_feas=lane(), group_newprov=lane(), overhead=rep,
+        group_feas=None if inputs.group_feas is None else lane(),
+        group_newprov=lane(), overhead=rep,
         ex_alloc=rep, ex_used=rep, ex_feas=lane(),  # ex_used: shared, no lane axis
         prov_overhead=None if inputs.prov_overhead is None else rep,
         prov_pods_cap=None if inputs.prov_pods_cap is None else rep,
@@ -190,8 +201,11 @@ def sharded_consolidation_verdicts(inputs: PackInputs, n_slots: int,
     dev_inputs = jax.tree.map(
         lambda a, sh: jax.device_put(jax.numpy.asarray(a), sh),
         inputs, shardings)
-    fn = jax.jit(_batched_pack_verdicts, static_argnames=("n_slots",),
-                 in_shardings=(shardings,))
-    with mesh:
-        verdicts = fn(dev_inputs, n_slots)
+    if feas_table is not None:
+        feas_table = jax.device_put(jax.numpy.asarray(feas_table), rep)
+        feas_idx = jax.device_put(jax.numpy.asarray(feas_idx), lane())
+    with mesh:  # _batched_pack_verdicts is already jitted at definition
+        verdicts = _batched_pack_verdicts(dev_inputs, n_slots,
+                                          feas_table=feas_table,
+                                          feas_idx=feas_idx)
     return np.asarray(jax.device_get(verdicts))[:C]

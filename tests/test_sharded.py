@@ -163,10 +163,16 @@ class TestShardedConsolidation:
         prov.set_defaults()
         batch = encode_consolidation(cluster, cat, [prov])
         assert batch is not None
-        single = np.asarray(jax.device_get(
-            _batched_pack_verdicts(jax.device_put(batch.inputs), N_SLOTS)))
+        assert batch.inputs.group_feas is None  # rides as table+idx
+        assert batch.feas_table.shape[0] >= 2  # all-False row + real rows
+        single = np.asarray(jax.device_get(_batched_pack_verdicts(
+            jax.device_put(batch.inputs), N_SLOTS,
+            feas_table=jax.device_put(batch.feas_table),
+            feas_idx=jax.device_put(batch.feas_idx))))
         mesh = make_lane_mesh(8)
-        sharded = sharded_consolidation_verdicts(batch.inputs, N_SLOTS, mesh)
+        sharded = sharded_consolidation_verdicts(
+            batch.inputs, N_SLOTS, mesh,
+            feas_table=batch.feas_table, feas_idx=batch.feas_idx)
         assert sharded.shape == single.shape
         assert (sharded == single).all()
 
