@@ -79,6 +79,60 @@ def test_fuzz_parity_kernel_vs_oracle(specs):
     assert kernel.unschedulable_count() == len(oracle.unschedulable)
 
 
+# -- hypothesis: consolidation parity over generated clusters ----------------------
+
+cnode_strategy = st.builds(
+    dict,
+    type_idx=st.integers(min_value=0, max_value=3),
+    zone=st.sampled_from(["zone-1a", "zone-1b"]),
+    pods=st.lists(
+        st.builds(dict,
+                  cpu=st.sampled_from(["100m", "500m", "1", "2", "3"]),
+                  memory=st.sampled_from(["128Mi", "1Gi", "4Gi", "16Gi"]),
+                  pinned=st.booleans()),
+        min_size=0, max_size=3),
+    marked=st.booleans(),
+)
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.lists(cnode_strategy, min_size=1, max_size=7))
+def test_fuzz_consolidation_parity_kernel_vs_oracle(nodespecs):
+    """The batched consolidation sweep (unique-row feas table, shared
+    ex_used, price-memoized cheaper-option mask) must pick the same
+    single-node action as the scalar oracle on any generated cluster —
+    including no-action, do-not-evict pods, and draining nodes."""
+    from karpenter_tpu.models.cluster import ClusterState, StateNode
+    from karpenter_tpu.ops.consolidate import run_consolidation
+    from karpenter_tpu.oracle.consolidation import find_consolidation
+
+    catalog = battletest_catalog()
+    cluster = ClusterState()
+    for ni, nspec in enumerate(nodespecs):
+        itype = catalog.types[nspec["type_idx"]]
+        pods = [make_pod(f"c{ni}-p{pi}", cpu=p["cpu"], memory=p["memory"],
+                         node_name=f"cn-{ni:02d}", do_not_evict=p["pinned"])
+                for pi, p in enumerate(nspec["pods"])]
+        cluster.add_node(StateNode(
+            name=f"cn-{ni:02d}",
+            labels={**itype.labels_dict(), wk.LABEL_ZONE: nspec["zone"],
+                    wk.LABEL_CAPACITY_TYPE: "on-demand",
+                    wk.LABEL_PROVISIONER: "default"},
+            allocatable=itype.allocatable_vector(),
+            instance_type=itype.name, zone=nspec["zone"],
+            capacity_type="on-demand", price=itype.offerings[0].price,
+            provisioner_name="default", pods=pods,
+            marked_for_deletion=nspec["marked"]))
+    prov = Provisioner(name="default", consolidation_enabled=True)
+    prov.set_defaults()
+    kernel = run_consolidation(cluster, catalog, [prov], multi_node=False)
+    oracle = find_consolidation(cluster, catalog, [prov])
+    assert (kernel is None) == (oracle is None), (kernel, oracle)
+    if kernel is not None:
+        assert (kernel.kind, kernel.nodes, kernel.replacement) == \
+            (oracle.kind, oracle.nodes, oracle.replacement), (kernel, oracle)
+
+
 @settings(max_examples=50, deadline=None)
 @given(st.integers(min_value=0, max_value=10**15))
 def test_fuzz_quantity_cpu_millis_roundtrip(n):
