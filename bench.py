@@ -117,6 +117,7 @@ def main():
                 "p50_ms": (cap.get("headline") or {}).get("p50_ms",
                                                           cap.get("value")),
                 "crossover_pods": cap.get("crossover_pods"),
+                "exec_crossover_pods": cap.get("exec_crossover_pods"),
                 "backend": cap.get("backend", "tpu"),
                 # attribution fields (round 4): consolidation number, the
                 # link-state sentinels, and streaming-mode kernel time, so a
