@@ -16,8 +16,9 @@ Configs (BASELINE.json):
      offering set — sharded over every visible device via parallel/sharded
   5  pair sweep: multi-node consolidation over 64-node pair grids
   6  config 1's workload on the PRODUCTION routed backend (C++ scan)
+  7  4x stress: 200k pods, same shape as 4 — beyond-reference scale point
 
-Usage: python -m benchmarks.baseline_configs [--configs 0,1,...,6]
+Usage: python -m benchmarks.baseline_configs [--configs 0,1,...,7]
 """
 
 from __future__ import annotations
@@ -220,6 +221,17 @@ def stress_problem_50k(n_pods: int = 50_000):
 
 
 def config_4_stress_50k() -> dict:
+    return _stress_config(4, "stress-50k-sharded", 50_000, REPEATS)
+
+
+def config_7_stress_200k() -> dict:
+    """4x the reference-scale stress shape — beyond-reference scale point:
+    200k pending pods solved in one sharded dispatch (the reference
+    schedules incrementally and has no single-cycle analogue)."""
+    return _stress_config(7, "stress-200k-sharded", 200_000, max(2, REPEATS // 2))
+
+
+def _stress_config(idx: int, name: str, n_pods: int, repeats: int) -> dict:
     import jax
     import numpy as np
 
@@ -228,8 +240,8 @@ def config_4_stress_50k() -> dict:
     from karpenter_tpu.parallel.sharded import make_mesh, sharded_pack
     from karpenter_tpu.solver.core import _bucket
 
-    catalog, provisioners, pods = stress_problem_50k()
-    assert len(pods) == 50_000
+    catalog, provisioners, pods = stress_problem_50k(n_pods)
+    assert len(pods) == n_pods
 
     from karpenter_tpu.models.encode import build_grid
 
@@ -244,7 +256,7 @@ def config_4_stress_50k() -> dict:
                          group_cache=group_cache)
     encode_cold_ms = (time.perf_counter() - t_enc) * 1000
     enc_times = []
-    for _ in range(REPEATS):
+    for _ in range(repeats):
         t_enc = time.perf_counter()
         enc = encode_problem(catalog, provisioners, pods, grid=grid,
                              group_cache=group_cache)
@@ -272,7 +284,7 @@ def config_4_stress_50k() -> dict:
     result = sharded_pack(inputs, n_slots, mesh)  # warmup (compile)
     jax.block_until_ready(result.assign)
     times = []
-    for _ in range(REPEATS):
+    for _ in range(repeats):
         t0 = time.perf_counter()
         result = sharded_pack(inputs, n_slots, mesh)
         jax.block_until_ready(result.assign)
@@ -280,7 +292,7 @@ def config_4_stress_50k() -> dict:
     n_open = int(np.asarray(result.active).sum())
     n_unsched = int(np.asarray(result.unsched).sum())
     assert n_unsched == 0, f"{n_unsched} pods unschedulable"
-    return {"bench": "baseline_config", "config": 4, "name": "stress-50k-sharded",
+    return {"bench": "baseline_config", "config": idx, "name": name,
             "ms": round(statistics.median(times), 3), "nodes": n_open,
             "detail": {"n_pods": len(pods), "n_types": len(catalog.types),
                        "n_devices": mesh.devices.size,
@@ -347,6 +359,7 @@ CONFIGS = {
     4: config_4_stress_50k,
     5: config_5_pair_sweep,
     6: config_6_mixed_5k_routed,
+    7: config_7_stress_200k,
 }
 
 

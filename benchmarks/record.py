@@ -76,12 +76,13 @@ def previous_record() -> "dict | None":
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip-stress", action="store_true",
-                    help="skip config 4 (50k sharded; minutes on CPU)")
+                    help="skip the stress configs 4 and 7 (50k/200k "
+                         "sharded; minutes on CPU)")
     args = ap.parse_args(argv)
 
     prev = previous_record()
     results, rc1 = _run_json_lines(["benchmarks.interruption_bench"])
-    configs = "0,1,2,3,5,6" if args.skip_stress else "0,1,2,3,4,5,6"
+    configs = "0,1,2,3,5,6" if args.skip_stress else "0,1,2,3,4,5,6,7"
     more, rc2 = _run_json_lines(["benchmarks.baseline_configs",
                                  "--configs", configs])
     results += more
