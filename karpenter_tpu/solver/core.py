@@ -15,6 +15,7 @@ no-ops in the kernel.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Sequence
 
 import jax
@@ -171,35 +172,57 @@ class TPUSolver:
                 overhead, n_slots, grid=self.grid(),
                 group_cache=self._group_cache,
             )
-            flat, dims = dispatch_pack(enc, self._dev_alloc_t,
-                                       self._dev_tiebreak)
-            slots.append(("wave", (enc, flat, dims, list(existing))))
+            inputs, dims, up = build_pack_inputs(enc, self._dev_alloc_t,
+                                                 self._dev_tiebreak)
+            slots.append(("wave", (enc, inputs, dims, up, list(existing))))
 
-        wave = [payload for mode, payload in slots if mode == "wave"]
-        fetched: "list[PackResult]" = []
-        if wave:
-            sizes = [int(flat.shape[0]) for _, flat, _, _ in wave]
-            cat = np.asarray(jax.device_get(
-                jnp.concatenate([flat for _, flat, _, _ in wave])))
+        # Same-shape problems fold into ONE vmapped dispatch per bucket
+        # (degraded-link cost is per device OPERATION, not per byte —
+        # solver-boundary.md), then all buckets concatenate into one read.
+        buckets: "dict[tuple, list[int]]" = {}
+        for i, (mode, payload) in enumerate(slots):
+            if mode != "wave":
+                continue
+            _enc, inputs, dims, up, _ex = payload
+            key = (dims, up, inputs.ex_cap is not None,
+                   inputs.group_origin is not None,
+                   inputs.prov_overhead is not None,
+                   inputs.prov_pods_cap is not None)
+            buckets.setdefault(key, []).append(i)
+        flats: "list[tuple[list[int], object]]" = []  # (slot idxs, [K,L] dev)
+        for key, idxs in buckets.items():
+            (_gb, Nb, _neb), up = key[0], key[1]
+            members = [slots[i][1][1] for i in idxs]
+            if len(members) == 1:
+                dev = jax.device_put(members[0])
+                flat2d = pack_flat(dev, n_slots=Nb, use_pallas=up)[None, :]
+            else:
+                dev = jax.device_put(_stack_pack_inputs(members))
+                flat2d = _wave_pack_flat(dev, Nb, up)
+            flats.append((idxs, flat2d))
+        fetched: "dict[int, PackResult]" = {}
+        if flats:
+            cat = np.asarray(jax.device_get(jnp.concatenate(
+                [f.reshape(-1) for _, f in flats])))
             off = 0
-            for (enc, _, dims, _), size in zip(wave, sizes):
-                Gb, Nb, Neb = dims
-                fetched.append(unflatten_result(cat[off:off + size],
-                                                Gb, Nb, Neb))
-                off += size
+            for idxs, f in flats:
+                K, L = f.shape
+                for j, slot_i in enumerate(idxs):
+                    dims = slots[slot_i][1][2]
+                    fetched[slot_i] = unflatten_result(
+                        cat[off + j * L: off + (j + 1) * L], *dims)
+                off += K * L
 
         out: "list[SolveResult]" = []
-        wi = 0
-        for mode, payload in slots:
+        for i, (mode, payload) in enumerate(slots):
             if mode == "solo":
                 out.append(self.solve(
                     payload.get("pods", []), payload.get("existing", ()),
                     payload.get("daemon_overhead"), payload.get("n_slots")))
             else:
-                enc, _, _, existing = payload
-                out.append(decode(enc, fetched[wi],
+                enc, _, _, _, existing = payload
+                out.append(decode(enc, fetched[i],
                                   [e.name for e in existing]))
-                wi += 1
         return out
 
     def _nodes_as_existing(self, res: SolveResult,
@@ -378,15 +401,12 @@ class NativeSolver(TPUSolver):
         return decode(enc, result, [e.name for e in existing])
 
 
-def dispatch_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None):
-    """Pad to shape buckets and ENQUEUE the jitted kernel — no device read.
-    Returns (flat device array, (Gb, Nb, Neb)); fetch_pack turns it into a
-    PackResult. Split from run_pack so wave callers (solve_many) can overlap
-    K dispatches and pay a single device->host read for the whole wave —
-    on a tunneled device each read is a full round trip, and (measured on
-    the deployment tunnel, docs/designs/solver-boundary.md) the FIRST read
-    also degrades the link's sync latency for the session, so reads are the
-    scarcest resource the solver spends."""
+def build_pack_inputs(enc: EncodedProblem, dev_alloc_t=None,
+                      dev_tiebreak=None):
+    """Pad to shape buckets and assemble host-side PackInputs — no device
+    work. Returns (inputs, (Gb, Nb, Neb), use_pallas). dispatch_pack ships
+    and enqueues one problem; solve_many stacks same-shape inputs from
+    several problems into ONE vmapped dispatch (_wave_pack_flat)."""
     G = enc.group_vec.shape[0]
     Gb = _bucket(G)
     Ne = enc.ex_alloc.shape[0]
@@ -434,11 +454,59 @@ def dispatch_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None):
     use_pallas = pallas_kernels.enabled() and pallas_value_safe(
         enc.alloc_t, enc.ex_alloc, enc.group_vec, enc.overhead,
         enc.prov_overhead)
+    return inputs, (Gb, Nb, Neb), use_pallas
+
+
+def dispatch_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None):
+    """build_pack_inputs + ENQUEUE the jitted kernel — no device read.
+    Returns (flat device array, (Gb, Nb, Neb)); fetch_pack turns it into a
+    PackResult. Split from run_pack so wave callers (solve_many) can overlap
+    dispatches and pay a single device->host read for the whole wave —
+    on a tunneled device each read is a full round trip, and (measured on
+    the deployment tunnel, docs/designs/solver-boundary.md) the FIRST read
+    also degrades the link's sync latency for the session, so reads are the
+    scarcest resource the solver spends."""
+    inputs, dims, use_pallas = build_pack_inputs(enc, dev_alloc_t,
+                                                 dev_tiebreak)
     inputs = jax.device_put(inputs)  # async enqueue; no sync round trip
     # One jitted dispatch returning ONE flat buffer: decode pays exactly one
     # device->host round trip (the tunnel RTT floor; SURVEY.md §7.3).
-    flat = pack_flat(inputs, n_slots=Nb, use_pallas=use_pallas)
-    return flat, (Gb, Nb, Neb)
+    flat = pack_flat(inputs, n_slots=dims[1], use_pallas=use_pallas)
+    return flat, dims
+
+
+def _stack_pack_inputs(members: "list[PackInputs]") -> PackInputs:
+    """Stack same-shape per-problem leaves along a new leading K axis,
+    padding K to a power-of-two bucket (lo=2) by repeating the first
+    member so wave size never mints a fresh compiled shape — the same
+    bucketing doctrine as _bucket for G/N/Ne (duplicate rows are simply
+    never read back). alloc_t/tiebreak (catalog arrays, possibly already
+    device-resident) stay shared from the first member; None leaves stay
+    None (tree.map skips empty subtrees)."""
+    first = members[0]
+    Kb = _bucket(len(members), lo=2)
+    members = list(members) + [first] * (Kb - len(members))
+    stripped = [m._replace(alloc_t=None, tiebreak=None) for m in members]
+    stacked = jax.tree.map(lambda *xs: np.stack(xs), *stripped)
+    return stacked._replace(alloc_t=first.alloc_t, tiebreak=first.tiebreak)
+
+
+@functools.partial(jax.jit, static_argnames=("n_slots", "use_pallas"))
+def _wave_pack_flat(stacked: PackInputs, n_slots: int,
+                    use_pallas: "bool | None"):
+    """K same-shape problems as ONE vmapped kernel dispatch returning
+    [K, L] flat results. In the tunnel's degraded link state every device
+    operation costs a flat ~66ms sync slot (solver-boundary.md cost
+    model), so a wave of K separate dispatches pays K slots — this folds
+    them into one. alloc_t/tiebreak are shared (catalog arrays); every
+    per-problem leaf carries a leading K axis."""
+    from ..ops.packer import pack_flat_impl
+
+    axes = jax.tree.map(lambda _: 0, stacked)._replace(
+        alloc_t=None, tiebreak=None)
+    return jax.vmap(
+        lambda inp: pack_flat_impl(inp, n_slots, use_pallas=use_pallas),
+        in_axes=(axes,))(stacked)
 
 
 def fetch_pack(flat, dims) -> PackResult:

@@ -286,6 +286,51 @@ class TestSolveMany:
             assert w.decisions() == s.decisions()
             assert w.unschedulable_count() == s.unschedulable_count()
 
+    def test_same_shape_wave_folds_into_one_vmapped_dispatch(self, monkeypatch):
+        """K same-shape problems must cost ONE device dispatch (the
+        degraded tunnel link charges per operation, not per byte —
+        docs/designs/solver-boundary.md cost model)."""
+        import karpenter_tpu.solver.core as score
+
+        calls = {"wave": 0, "single": 0}
+        orig_wave, orig_flat = score._wave_pack_flat, score.pack_flat
+
+        def count_wave(*a, **k):
+            calls["wave"] += 1
+            return orig_wave(*a, **k)
+
+        def count_single(*a, **k):
+            calls["single"] += 1
+            return orig_flat(*a, **k)
+
+        monkeypatch.setattr(score, "_wave_pack_flat", count_wave)
+        monkeypatch.setattr(score, "pack_flat", count_single)
+        solver = TPUSolver(small_catalog(), [default_provisioner()])
+        problems = [{"pods": mixed_pods(16)} for _ in range(4)]
+        wave = solver.solve_many(problems)
+        assert calls == {"wave": 1, "single": 0}, calls
+        solo = [solver.solve(**p) for p in problems]
+        for w, s in zip(wave, solo):
+            assert w.decisions() == s.decisions()
+            assert w.unschedulable_count() == s.unschedulable_count() == 0
+
+    def test_mixed_shape_wave_buckets_and_matches(self):
+        """Problems of different padded shapes land in different vmap
+        buckets (or the single-dispatch path) and still match solve()."""
+        cat = small_catalog()
+        solver = TPUSolver(cat, [default_provisioner()])
+        problems = (
+            [{"pods": mixed_pods(16)} for _ in range(2)]       # bucket A x2
+            + [{"pods": [make_pod(f"w-{i}", cpu="250m", memory="512Mi")
+                         for i in range(150)]}]                # bigger Gb/Nb
+            + [{"pods": mixed_pods(5)}]                        # small
+        )
+        wave = solver.solve_many(problems)
+        solo = [solver.solve(**p) for p in problems]
+        for w, s in zip(wave, solo):
+            assert w.decisions() == s.decisions()
+            assert w.unschedulable_count() == s.unschedulable_count()
+
     def test_deferred_affinity_problems_fall_back_to_two_round(self):
         from karpenter_tpu.models.pod import PodAffinityTerm
 
