@@ -154,6 +154,14 @@ class TPUSolver:
 
         from ..oracle.scheduler import split_deferred_pods
 
+        # ONE catalog snapshot for the whole wave: grid() refreshes the
+        # device-resident catalog arrays on seqnum change, and a refresh
+        # landing mid-loop would otherwise encode later problems against a
+        # NEW grid while their lanes pack against the first member's stale
+        # alloc_t/tiebreak (the bucket key has no grid identity on purpose
+        # — this snapshot is what makes that impossible).
+        wave_grid = self.grid()
+        dev_alloc_t, dev_tiebreak = self._dev_alloc_t, self._dev_tiebreak
         slots: "list[tuple]" = []  # (mode, payload)
         for prob in problems:
             pods = prob.get("pods", [])
@@ -169,11 +177,11 @@ class TPUSolver:
                 continue
             enc = encode_problem(
                 self.catalog, self.provisioners, pods, existing,
-                overhead, n_slots, grid=self.grid(),
+                overhead, n_slots, grid=wave_grid,
                 group_cache=self._group_cache,
             )
-            inputs, dims, up = build_pack_inputs(enc, self._dev_alloc_t,
-                                                 self._dev_tiebreak)
+            inputs, dims, up = build_pack_inputs(enc, dev_alloc_t,
+                                                 dev_tiebreak)
             slots.append(("wave", (enc, inputs, dims, up, list(existing))))
 
         # Same-shape problems fold into ONE vmapped dispatch per bucket

@@ -79,6 +79,39 @@ def test_fuzz_parity_kernel_vs_oracle(specs):
     assert kernel.unschedulable_count() == len(oracle.unschedulable)
 
 
+# -- hypothesis: wave batching parity over generated problem mixes -----------------
+
+wave_problem_strategy = st.builds(
+    dict,
+    cpu=st.sampled_from(["250m", "500m", "1", "2"]),
+    memory=st.sampled_from(["512Mi", "1Gi", "4Gi"]),
+    count=st.integers(min_value=1, max_value=40),
+)
+
+
+@settings(max_examples=15, deadline=None)
+@given(st.lists(wave_problem_strategy, min_size=1, max_size=6))
+def test_fuzz_wave_solve_many_matches_solo(mixes):
+    """solve_many's shape-bucketed vmapped dispatch (+ K padding, offset
+    math into the concatenated read) must match per-problem solve() for
+    any mix of problem sizes — same-bucket, cross-bucket, and padded-lane
+    cases all arise from the generator."""
+    catalog = battletest_catalog()
+    prov = Provisioner(name="default")
+    prov.set_defaults()
+    solver = TPUSolver(catalog, [prov])
+    problems = [{"pods": [make_pod(f"m{mi}-p{i}", cpu=m["cpu"],
+                                   memory=m["memory"])
+                          for i in range(m["count"])]}
+                for mi, m in enumerate(mixes)]
+    wave = solver.solve_many(problems)
+    for w, pr in zip(wave, problems):
+        s = solver.solve(**pr)
+        assert w.decisions() == s.decisions()
+        placed = sum(n.pod_count for n in w.nodes)
+        assert placed + w.unschedulable_count() == len(pr["pods"])
+
+
 # -- hypothesis: consolidation parity over generated clusters ----------------------
 
 cnode_strategy = st.builds(
