@@ -250,8 +250,8 @@ def _step(inputs: PackInputs, state: PackState, g: jax.Array,
 def pack_impl(inputs: PackInputs, n_slots: int,
               use_pallas: "bool | None" = None) -> PackResult:
     # use_pallas is a STATIC choice: None defers to the env flag (read at
-    # trace time, as before); run_pack passes an explicit bool that also
-    # folds in the pallas_value_safe() 2**24 exactness check.
+    # trace time, as before); build_pack_inputs passes an explicit bool
+    # that also folds in the pallas_value_safe() 2**24 exactness check.
     if use_pallas is None:
         use_pallas = pallas_kernels.enabled()
     G = inputs.group_vec.shape[0]

@@ -135,11 +135,12 @@ class TPUSolver:
         self,
         problems: "Sequence[dict]",
     ) -> "list[SolveResult]":
-        """Wave-pipelined batch of independent solves: every problem's pack
-        kernel is ENQUEUED before any result is read, then the whole wave's
-        flat outputs are concatenated device-side and fetched with ONE
-        device->host read. Each problem is a dict of solve() kwargs
-        (pods, existing, daemon_overhead, n_slots).
+        """Wave-pipelined batch of independent solves: problems bucket by
+        padded shape and each bucket runs as ONE vmapped kernel dispatch
+        (wave size padded to a power-of-two so K never mints a new
+        compile); all buckets' flat outputs are concatenated device-side
+        and fetched with ONE device->host read. Each problem is a dict of
+        solve() kwargs (pods, existing, daemon_overhead, n_slots).
 
         Rationale (docs/designs/solver-boundary.md): on a tunneled device
         the d2h read is both the latency floor (one RTT) and — measured on
@@ -154,12 +155,14 @@ class TPUSolver:
 
         from ..oracle.scheduler import split_deferred_pods
 
-        # ONE catalog snapshot for the whole wave: grid() refreshes the
-        # device-resident catalog arrays on seqnum change, and a refresh
-        # landing mid-loop would otherwise encode later problems against a
-        # NEW grid while their lanes pack against the first member's stale
-        # alloc_t/tiebreak (the bucket key has no grid identity on purpose
-        # — this snapshot is what makes that impossible).
+        # ONE catalog snapshot for the whole wave — but encode_problem
+        # rebuilds a grid whose seqnum went stale (a concurrent catalog
+        # bump mid-loop), so coherence is enforced the other way around:
+        # each problem ships the catalog arrays of the grid its encode
+        # ACTUALLY used (enc.alloc_t IS grid.alloc_t), the device-resident
+        # copies are substituted only while that is still the snapshot,
+        # and the bucket key carries the array identity so lanes from
+        # different grids can never stack.
         wave_grid = self.grid()
         dev_alloc_t, dev_tiebreak = self._dev_alloc_t, self._dev_tiebreak
         slots: "list[tuple]" = []  # (mode, payload)
@@ -180,8 +183,11 @@ class TPUSolver:
                 overhead, n_slots, grid=wave_grid,
                 group_cache=self._group_cache,
             )
-            inputs, dims, up = build_pack_inputs(enc, dev_alloc_t,
-                                                 dev_tiebreak)
+            if enc.alloc_t is wave_grid.alloc_t:
+                inputs, dims, up = build_pack_inputs(enc, dev_alloc_t,
+                                                     dev_tiebreak)
+            else:  # encode rebuilt a fresh grid (catalog bumped mid-wave)
+                inputs, dims, up = build_pack_inputs(enc)
             slots.append(("wave", (enc, inputs, dims, up, list(existing))))
 
         # Same-shape problems fold into ONE vmapped dispatch per bucket
@@ -192,7 +198,8 @@ class TPUSolver:
             if mode != "wave":
                 continue
             _enc, inputs, dims, up, _ex = payload
-            key = (dims, up, inputs.ex_cap is not None,
+            key = (dims, up, id(inputs.alloc_t),  # grid identity
+                   inputs.ex_cap is not None,
                    inputs.group_origin is not None,
                    inputs.prov_overhead is not None,
                    inputs.prov_pods_cap is not None)
@@ -468,7 +475,8 @@ def build_pack_inputs(enc: EncodedProblem, dev_alloc_t=None,
 def dispatch_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None):
     """build_pack_inputs + ENQUEUE the jitted kernel — no device read.
     Returns (flat device array, (Gb, Nb, Neb)); fetch_pack turns it into a
-    PackResult. Split from run_pack so wave callers (solve_many) can overlap
+    PackResult. Dispatch and fetch are separate so wave callers
+    (solve_many) can overlap
     dispatches and pay a single device->host read for the whole wave —
     on a tunneled device each read is a full round trip, and (measured on
     the deployment tunnel, docs/designs/solver-boundary.md) the FIRST read
@@ -523,14 +531,8 @@ def fetch_pack(flat, dims) -> PackResult:
     return unflatten_result(np.asarray(jax.device_get(flat)), Gb, Nb, Neb)
 
 
-def run_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None) -> PackResult:
-    """dispatch + fetch: the single-solve path."""
-    flat, dims = dispatch_pack(enc, dev_alloc_t, dev_tiebreak)
-    return fetch_pack(flat, dims)
-
-
 def decode(enc: EncodedProblem, result: PackResult, existing_names: "list[str]") -> SolveResult:
-    host = result  # already host-side numpy (see run_pack)
+    host = result  # already host-side numpy (see fetch_pack)
     assign, ex_assign, unsched = host.assign, host.ex_assign, host.unsched
     active, decided, nprov = host.active, host.decided, host.nprov
     G = len(enc.groups)

@@ -78,8 +78,8 @@ def test_exact_boundary_divisions():
 
 def test_value_safety_gate_routes_oversized_to_xla():
     # f32 one-correction exactness holds only below 2**24; encode clamps at
-    # INT_BIG (2**30), so run_pack must take the XLA path for huge extended
-    # resource counts and keep the bit-parity contract
+    # INT_BIG (2**30), so build_pack_inputs must route huge extended
+    # resource counts to the XLA path and keep the bit-parity contract
     from karpenter_tpu.ops.packer import F24, pallas_value_safe
 
     ok = np.array([[F24 - 1, 12]], dtype=np.int32)

@@ -331,6 +331,45 @@ class TestSolveMany:
             assert w.decisions() == s.decisions()
             assert w.unschedulable_count() == s.unschedulable_count()
 
+    def test_mid_wave_catalog_bump_stays_coherent(self, monkeypatch):
+        """A catalog bump landing between two encodes of one wave must not
+        pair a new-grid encode with stale device catalog arrays: problems
+        encoded after the bump ship their own grid's arrays and bucket
+        separately (grid identity is part of the bucket key)."""
+        import karpenter_tpu.solver.core as score
+        from karpenter_tpu.models.instancetype import make_instance_type
+
+        cat = small_catalog()
+        solver = TPUSolver(cat, [default_provisioner()])
+        pods = mixed_pods(16)
+        solo_old = solver.solve(pods)  # pre-bump decisions (old grid)
+
+        real_encode = score.encode_problem
+        calls = {"n": 0}
+
+        def bumping_encode(*a, **k):
+            calls["n"] += 1
+            if calls["n"] == 2:  # between problem 1 and problem 2
+                cat.types.append(make_instance_type(
+                    "late.8xl", cpu=16, memory="64Gi", od_price=0.01))
+                cat.bump()
+            return real_encode(*a, **k)
+
+        monkeypatch.setattr(score, "encode_problem", bumping_encode)
+        wave = solver.solve_many([{"pods": pods} for _ in range(3)])
+        monkeypatch.setattr(score, "encode_problem", real_encode)
+
+        # problem 1 solved on the pre-bump snapshot
+        assert wave[0].decisions() == solo_old.decisions()
+        # problems 2-3 solved coherently on the bumped catalog (the dirt-
+        # cheap late.8xl must win) and match a fresh post-bump solve
+        solo_new = solver.solve(pods)
+        assert wave[1].decisions() == wave[2].decisions() == solo_new.decisions()
+        assert wave[1].decisions() != solo_old.decisions()
+        assert {d[0] for d in wave[1].decisions()} == {"late.8xl"}
+        for w in wave:
+            assert w.unschedulable_count() == 0
+
     def test_deferred_affinity_problems_fall_back_to_two_round(self):
         from karpenter_tpu.models.pod import PodAffinityTerm
 
