@@ -67,13 +67,32 @@ def main():
     rec["h2d_streaming"] = _h2d_sweep(jax, np)
     rec["sync_after_h2d_ms"] = _sync_sentinel(jax, jnp)
 
+    # ESCAPE-HATCH EXPERIMENT (before the first literal read, while still
+    # streaming): does an io_callback-based readback — results pushed
+    # host-ward from inside the jitted computation — avoid the
+    # streaming->degraded transition that jax.device_get triggers? One
+    # shared implementation with the capture tool (incl. effects_barrier
+    # inside the timed span — block_until_ready alone does not wait for
+    # host callback delivery).
+    from hack.tpu_capture import _io_callback_probe
+
+    rec["io_callback_escape"] = _io_callback_probe(jax, jnp, reps=REPS)
+    io_degraded = (rec["io_callback_escape"].get("sync_after") or
+                   {}).get("p50_ms", 0.0) >= 5.0
+
     # d2h: the FIRST read flips the relay out of streaming mode — record it
     # separately, then sweep sizes in the degraded state the production
-    # readback actually experiences.
+    # readback actually experiences. (If the io probe above already
+    # consumed the transition, first_read_ms is just a degraded-state
+    # read — flagged so the recorded evidence can't contradict itself.)
     dev8 = jax.device_put(np.zeros(2, np.int32))
     t0 = time.perf_counter()
     np.asarray(jax.device_get(dev8))
     rec["first_read_ms"] = round((time.perf_counter() - t0) * 1000, 3)
+    if io_degraded:
+        rec["first_read_note"] = ("transition consumed by the io_callback "
+                                  "probe; this is a degraded-state read, "
+                                  "not the streaming->degraded flip")
 
     # Each rep reads a FRESH device-computed buffer (a re-get of the same
     # buffer is served from PJRT's host-side copy and measures nothing);

@@ -60,6 +60,55 @@ def _link_sentinel(jax, jnp, reps: int = 5) -> dict:
     return {"p50_ms": round(st.median(ts), 3), "min_ms": round(min(ts), 3)}
 
 
+def _io_callback_probe(jax, jnp, reps: int = 5) -> dict:
+    """Escape-hatch experiment: does an io_callback-based readback (results
+    pushed host-ward from inside the jitted computation) avoid the
+    streaming->degraded transition that jax.device_get triggers? Returns
+    timing + a sync sentinel taken AFTER the probe so the caller can tell
+    whether the link survived (sync_after.p50_ms sub-ms) or the probe
+    consumed the transition itself. effects_barrier is inside the timed
+    span: block_until_ready alone does not wait for host callbacks, and a
+    sub-ms number that excluded delivery would read as 'streaming readback
+    is free' when nothing reached the host."""
+    import statistics as st
+
+    import numpy as np
+
+    try:
+        from jax.experimental import io_callback
+
+        inbox = []
+
+        def _sink(x):
+            inbox.append(np.asarray(x))
+            return np.int32(0)
+
+        @jax.jit
+        def _f(x):
+            y = x + 1
+            io_callback(_sink, jax.ShapeDtypeStruct((), jnp.int32),
+                        y.sum(), ordered=True)
+            return y
+
+        x = jnp.arange(1024, dtype=jnp.int32)
+        t0 = time.perf_counter()
+        _f(x).block_until_ready()
+        jax.effects_barrier()
+        first_ms = (time.perf_counter() - t0) * 1000
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _f(x).block_until_ready()
+            jax.effects_barrier()
+            ts.append((time.perf_counter() - t0) * 1000)
+        return {"first_ms": round(first_ms, 3),
+                "p50_ms": round(st.median(ts), 3),
+                "values_received": len(inbox),
+                "sync_after": _link_sentinel(jax, jnp)}
+    except Exception as e:  # experimental API: record, never fail a capture
+        return {"error": str(e)[:200]}
+
+
 def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
     """Run inside the pinned-to-axon subprocess: headline + crossover sweep."""
     sys.path.insert(0, REPO)
@@ -121,6 +170,18 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
     pods10k = workloads[10_000]
     link_after_exec = _link_sentinel(jax, jnp)
 
+    # escape-hatch probe, run LAST in the streaming section: if its
+    # sync_after sentinel stays sub-ms, io_callback readback avoids the
+    # first-read degradation and the wall-clock crossover vs the native
+    # scan flips. If instead the probe itself consumed the transition,
+    # the wave/link_state notes below are made conditional so the
+    # recorded attribution stays truthful either way.
+    io_escape = _io_callback_probe(jax, jnp, reps=max(5, reps_sweep))
+    streaming_after_io = (io_escape.get("sync_after") or
+                          {}).get("p50_ms", 999.0) < 5.0
+    if "error" in io_escape:
+        streaming_after_io = True  # probe never ran device work
+
     # wave: K pipelined solves, ONE concatenated read (solver.solve_many)
     K = 8
     t0 = time.perf_counter()
@@ -129,10 +190,13 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
     assert all(r.unschedulable_count() == 0 for r in wave_res)
     wave = {"k": K, "n_pods": 10_000, "total_ms": round(wave_ms, 3),
             "per_solve_ms": round(wave_ms / K, 3),
-            "note": "includes the session's first d2h read (the relay's "
-                    "multi-second streaming->degraded transition, "
-                    "linkprobe first_read_ms) — see wave_steady for the "
-                    "amortized cost"}
+            "note": ("includes the session's first d2h read (the relay's "
+                     "multi-second streaming->degraded transition, "
+                     "linkprobe first_read_ms) — see wave_steady for the "
+                     "amortized cost" if streaming_after_io else
+                     "link already degraded by the io_callback probe — "
+                     "the transition cost is in io_callback_escape, not "
+                     "this number")}
     link_after_read = _link_sentinel(jax, jnp)  # first d2h happened above
 
     # steady-state wave: same K solves AFTER the link already degraded —
@@ -272,10 +336,13 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
         # after exec-only work / after the first d2h read, plus the
         # streaming-mode kernel time and wave-amortized throughput
         "link_state": {"fresh": link_fresh, "after_exec_only": link_after_exec,
-                       "after_first_read": link_after_read},
+                       "after_first_read": link_after_read,
+                       "transition_in": ("wave" if streaming_after_io
+                                         else "io_callback_probe")},
         "exec_only_10k": exec_only,
         "exec_sweep": exec_sweep,
         "exec_crossover_pods": exec_crossover,
+        "io_callback_escape": io_escape,
         "wave_pipelined": wave,
         "wave_steady": wave_steady,
         "consolidation_500": consolidation,
