@@ -132,6 +132,13 @@ def main():
                                       or {}).get("per_solve_ms"),
                 "wave_steady_per_solve_ms": (cap.get("wave_steady")
                                              or {}).get("per_solve_ms"),
+                # escape-hatch outcome: sub-ms sync_after means io_callback
+                # readback kept the link streaming (solver-boundary.md)
+                "io_escape_sync_after_ms": ((cap.get("io_callback_escape")
+                                             or {}).get("sync_after")
+                                            or {}).get("p50_ms"),
+                "callback_headline_ms": (cap.get("callback_headline")
+                                         or {}).get("p50_ms"),
             }
     except Exception as e:  # capture history must never break the bench
         _state["detail"]["latest_tpu_capture_error"] = str(e)[:120]

@@ -37,6 +37,16 @@ import os as _os
 # read from TPUSolver.last_timings) — capture-tool diagnostics only
 _SOLVE_TIMING = _os.environ.get("KARPENTER_TPU_SOLVE_TIMING") == "1"
 
+# Readback mechanism for EVERY solver device->host read (host_fetch —
+# single solves and solve_many waves alike): "get" (default) is a literal
+# jax.device_get; "callback" emits results host-ward through io_callback —
+# the escape hatch for relays whose link degrades permanently after the
+# session's first literal read (hack/tpu_capture.py _io_callback_probe
+# measures whether the deployment's relay lets callbacks through in
+# streaming mode; flip this on only where that probe's sync_after stays
+# sub-ms).
+_READBACK = _os.environ.get("KARPENTER_TPU_READBACK", "get")
+
 
 def _bucket(n: int, lo: int = 8) -> int:
     b = lo
@@ -217,8 +227,8 @@ class TPUSolver:
             flats.append((idxs, flat2d))
         fetched: "dict[int, PackResult]" = {}
         if flats:
-            cat = np.asarray(jax.device_get(jnp.concatenate(
-                [f.reshape(-1) for _, f in flats])))
+            cat = host_fetch(jnp.concatenate(
+                [f.reshape(-1) for _, f in flats]))
             off = 0
             for idxs, f in flats:
                 K, L = f.shape
@@ -526,9 +536,64 @@ def _wave_pack_flat(stacked: PackInputs, n_slots: int,
 
 
 def fetch_pack(flat, dims) -> PackResult:
-    """The single device->host read for a dispatched pack."""
+    """The single device->host read for a dispatched pack (routed through
+    host_fetch, so KARPENTER_TPU_READBACK=callback covers it too)."""
     Gb, Nb, Neb = dims
-    return unflatten_result(np.asarray(jax.device_get(flat)), Gb, Nb, Neb)
+    return unflatten_result(host_fetch(flat), Gb, Nb, Neb)
+
+
+# -- callback readback (KARPENTER_TPU_READBACK=callback) ---------------------------
+#
+# host_fetch is the ONE device->host read primitive for the solver: the
+# default is a literal jax.device_get; the callback mode emits the array
+# host-ward from inside a tiny jitted program via io_callback instead, so
+# no literal fetch ever runs and (on relays where the io probe confirms
+# callbacks stream) the link never leaves streaming mode. One global
+# ordered inbox: io_callback bodies are baked into the traced graph, so
+# the sink must be a module-level function; the lock serializes
+# dispatch->barrier->pop so concurrent solvers cannot interleave, and the
+# inbox is cleared on entry AND exit so a failed fetch can never leak a
+# stale buffer into the next one.
+
+import collections as _collections
+import threading as _threading
+
+_CB_INBOX: "_collections.deque" = _collections.deque()
+_CB_LOCK = _threading.Lock()
+
+
+def _cb_sink(arr):
+    _CB_INBOX.append(np.asarray(arr))
+    return np.int32(0)
+
+
+@jax.jit
+def _emit_via_cb(x):
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+
+    return io_callback(_cb_sink, jax.ShapeDtypeStruct((), jnp.int32),
+                       x, ordered=True)
+
+
+def host_fetch(dev_arr) -> "np.ndarray":
+    """Bring a device array to host through the configured readback
+    transport. effects_barrier is the wait on the callback path —
+    block_until_ready does not cover host callback delivery."""
+    if _READBACK != "callback":
+        return np.asarray(jax.device_get(dev_arr))
+    with _CB_LOCK:
+        _CB_INBOX.clear()
+        try:
+            _emit_via_cb(dev_arr).block_until_ready()
+            jax.effects_barrier()
+            if len(_CB_INBOX) != 1:
+                raise RuntimeError(
+                    f"callback readback delivered {len(_CB_INBOX)} buffers "
+                    f"(expected 1)")
+            return _CB_INBOX.popleft()
+        finally:
+            _CB_INBOX.clear()
 
 
 def decode(enc: EncodedProblem, result: PackResult, existing_names: "list[str]") -> SolveResult:

@@ -331,6 +331,26 @@ class TestSolveMany:
             assert w.decisions() == s.decisions()
             assert w.unschedulable_count() == s.unschedulable_count()
 
+    def test_callback_readback_matches_device_get(self, monkeypatch):
+        """KARPENTER_TPU_READBACK=callback routes results host-ward via
+        io_callback (the relay escape hatch) — bit-identical decisions to
+        the default device_get path."""
+        import karpenter_tpu.solver.core as score
+
+        cat = small_catalog()
+        solver = TPUSolver(cat, [default_provisioner()])
+        pods = mixed_pods(24)
+        baseline = solver.solve(pods)
+        monkeypatch.setattr(score, "_READBACK", "callback")
+        cb_solver = TPUSolver(cat, [default_provisioner()])
+        via_cb = cb_solver.solve(pods)
+        assert via_cb.decisions() == baseline.decisions()
+        assert via_cb.unschedulable_count() == baseline.unschedulable_count()
+        # the wave's concatenated read routes through the same transport
+        wave = cb_solver.solve_many([{"pods": pods}] * 2)
+        assert all(w.decisions() == baseline.decisions() for w in wave)
+        assert not score._CB_INBOX  # nothing leaked in the inbox
+
     def test_mid_wave_catalog_bump_stays_coherent(self, monkeypatch):
         """A catalog bump landing between two encodes of one wave must not
         pair a new-grid encode with stale device catalog arrays: problems
