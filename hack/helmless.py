@@ -154,6 +154,10 @@ class Renderer:
         stages = [s.strip() for s in expr.split("|")]
         # leading function-application form: {{ toYaml .Values.x | ... }}
         head = stages[0].split(None, 1)
+        if len(head) == 2 and head[0] in ("eq", "ne"):
+            toks = re.findall(r'"[^"]*"|\S+', head[1])
+            a, b = self._atom(toks[0]), self._atom(toks[1])
+            return (a == b) if head[0] == "eq" else (a != b)
         if len(head) == 2 and head[0] in ("toYaml", "quote", "int"):
             val = self._atom(head[1])
             stages[0] = head[0]  # re-run the function as a stage

@@ -214,7 +214,7 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
         if "error" not in callback_headline:
             still = ((callback_headline.get("sync_after") or
                       {}).get("p50_ms", 999.0) < 5.0)
-            if streaming_after_io and not still:
+            if not still:  # this block only runs while still streaming
                 transition_in = "callback_headline"
             streaming_after_io = still
 

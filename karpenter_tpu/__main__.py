@@ -54,6 +54,12 @@ def cmd_solver_serve(args) -> int:
               flush=True)
     from .solver.service import SolverService, serve
 
+    # one switch for every device->host read the solvers perform
+    # (solver/core.py host_fetch); unconditional so an explicit
+    # `--readback get` overrides a KARPENTER_TPU_READBACK=callback env
+    from .solver import core as solver_core
+
+    solver_core._READBACK = args.readback
     service = SolverService(trace_dir=args.trace_dir or None,
                             trace_every=args.trace_every)
     server, port, _service = serve(f"{args.host}:{args.port}",
@@ -243,6 +249,12 @@ def main(argv=None) -> int:
                          help="capture a jax.profiler trace of every "
                               "--trace-every'th solve into this directory")
     p_serve.add_argument("--trace-every", type=int, default=100)
+    p_serve.add_argument(
+        "--readback", choices=("get", "callback"),
+        default=os.environ.get("KARPENTER_TPU_READBACK", "get"),
+        help="device->host readback transport: literal fetch (get) or "
+             "io_callback streaming (callback) — for relays whose link "
+             "degrades after the first literal read")
     p_serve.set_defaults(fn=cmd_solver_serve)
 
     p_ctrl = sub.add_parser("controller", help="run the controller plane")

@@ -344,7 +344,9 @@ def _verdicts(batch: ConsolidationBatch, mesh):
         return sharded_consolidation_verdicts(
             batch.inputs, N_SLOTS, mesh,
             feas_table=batch.feas_table, feas_idx=batch.feas_idx)
-    return jax.device_get(_batched_pack_verdicts(
+    from ..solver.core import host_fetch  # honors --readback callback
+
+    return host_fetch(_batched_pack_verdicts(
         jax.device_put(batch.inputs), N_SLOTS,
         feas_table=jax.device_put(batch.feas_table),
         feas_idx=jax.device_put(batch.feas_idx)))

@@ -208,4 +208,6 @@ def sharded_consolidation_verdicts(inputs: PackInputs, n_slots: int,
         verdicts = _batched_pack_verdicts(dev_inputs, n_slots,
                                           feas_table=feas_table,
                                           feas_idx=feas_idx)
-    return np.asarray(jax.device_get(verdicts))[:C]
+    from ..solver.core import host_fetch  # honors --readback callback
+
+    return host_fetch(verdicts)[:C]

@@ -563,7 +563,9 @@ _CB_LOCK = _threading.Lock()
 
 
 def _cb_sink(arr):
-    _CB_INBOX.append(np.asarray(arr))
+    # copy: callback arguments may alias runtime-owned transfer buffers
+    # that are only valid for the duration of the callback
+    _CB_INBOX.append(np.array(arr, copy=True))
     return np.int32(0)
 
 

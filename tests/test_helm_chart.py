@@ -71,6 +71,18 @@ def test_overrides_steer_the_render():
     assert cm["data"]["solverEndpoint"] == "127.0.0.1:6000"
 
 
+def test_solver_readback_value_renders_flag_only_when_non_default():
+    # default ("get"): no --readback arg, keeping the deploy/ byte parity
+    default = yaml.safe_load(Renderer(CHART).render()["deployment.yaml"])
+    solver_args = default["spec"]["template"]["spec"]["containers"][1]["args"]
+    assert "--readback" not in solver_args
+    # callback transport (relay escape hatch, docs/designs/solver-boundary.md)
+    docs = Renderer(CHART, _parse_set(["solver.readback=callback"])).render()
+    dep = yaml.safe_load(docs["deployment.yaml"])
+    solver_args = dep["spec"]["template"]["spec"]["containers"][1]["args"]
+    assert solver_args[-2:] == ["--readback", "callback"]
+
+
 def test_namespace_and_fullname_flow_through():
     docs = Renderer(CHART, {"fullnameOverride": "kp"},
                     namespace="kube-system").render()
