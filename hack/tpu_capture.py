@@ -109,6 +109,26 @@ def _io_callback_probe(jax, jnp, reps: int = 5) -> dict:
         return {"error": str(e)[:200]}
 
 
+def io_probe_gate(jax, jnp, reps: int = 5) -> "tuple[dict, bool, bool]":
+    """Run the io_callback probe and judge it. Returns
+    (probe, still_streaming, transport_ok):
+
+    - still_streaming: the link's sync sentinel stayed sub-ms (or the
+      probe never ran device work) — the attribution question.
+    - transport_ok: additionally EVERY callback value actually reached
+      the host (warmup + reps deliveries) and nothing errored — the
+      "safe to route production reads through callbacks" question. A
+      sub-ms sentinel with zero deliveries is exactly the false positive
+      the delivery count guards against."""
+    probe = _io_callback_probe(jax, jnp, reps=reps)
+    errored = "error" in probe
+    still_streaming = errored or (
+        (probe.get("sync_after") or {}).get("p50_ms", 999.0) < 5.0)
+    transport_ok = (not errored and still_streaming
+                    and probe.get("values_received") == reps + 1)
+    return probe, still_streaming, transport_ok
+
+
 def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
     """Run inside the pinned-to-axon subprocess: headline + crossover sweep."""
     sys.path.insert(0, REPO)
@@ -176,13 +196,10 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
     # scan flips. If instead the probe itself consumed the transition,
     # the wave/link_state notes below are made conditional so the
     # recorded attribution stays truthful either way.
-    io_escape = _io_callback_probe(jax, jnp, reps=max(5, reps_sweep))
+    io_escape, streaming_after_io, io_ok = io_probe_gate(
+        jax, jnp, reps=max(5, reps_sweep))
     transition_in = "wave"  # who consumed the streaming->degraded flip
-    streaming_after_io = (io_escape.get("sync_after") or
-                          {}).get("p50_ms", 999.0) < 5.0
-    if "error" in io_escape:
-        streaming_after_io = True  # probe never ran device work
-    elif not streaming_after_io:
+    if "error" not in io_escape and not streaming_after_io:
         transition_in = "io_callback_probe"
 
     # If the escape works, MEASURE it at the headline shape immediately
@@ -190,7 +207,7 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
     # (KARPENTER_TPU_READBACK=callback path, solver/core.py) — the
     # crossover-flipping number if sync_after stays sub-ms afterwards.
     callback_headline = None
-    if streaming_after_io and "error" not in io_escape:
+    if io_ok:  # transport verified: streaming survived AND all delivered
         import karpenter_tpu.solver.core as score
 
         prev_rb = score._READBACK
