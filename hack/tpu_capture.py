@@ -121,12 +121,18 @@ def io_probe_gate(jax, jnp, reps: int = 5) -> "tuple[dict, bool, bool]":
       sub-ms sentinel with zero deliveries is exactly the false positive
       the delivery count guards against."""
     probe = _io_callback_probe(jax, jnp, reps=reps)
+    still_streaming, transport_ok = judge_io_probe(probe, reps)
+    return probe, still_streaming, transport_ok
+
+
+def judge_io_probe(probe: dict, reps: int) -> "tuple[bool, bool]":
+    """Pure judgment half of io_probe_gate (unit-tested separately)."""
     errored = "error" in probe
     still_streaming = errored or (
         (probe.get("sync_after") or {}).get("p50_ms", 999.0) < 5.0)
     transport_ok = (not errored and still_streaming
                     and probe.get("values_received") == reps + 1)
-    return probe, still_streaming, transport_ok
+    return still_streaming, transport_ok
 
 
 def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:

@@ -1,0 +1,48 @@
+"""The io-probe gate's judgment (hack/tpu_capture.judge_io_probe) decides
+whether bench.py and the capture tool route production reads through the
+callback transport — driver-critical, so the truth table is pinned here.
+(The probe itself needs a device; the judgment is pure.)"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from hack.tpu_capture import judge_io_probe
+
+
+def _probe(sync_p50=0.05, received=6, error=None):
+    p = {"sync_after": {"p50_ms": sync_p50, "min_ms": sync_p50},
+         "values_received": received, "p50_ms": 0.5, "first_ms": 50.0}
+    if error is not None:
+        p = {"error": error}
+    return p
+
+
+def test_healthy_probe_enables_transport():
+    assert judge_io_probe(_probe(), reps=5) == (True, True)
+
+
+def test_degraded_sentinel_disables_both():
+    streaming, ok = judge_io_probe(_probe(sync_p50=66.0), reps=5)
+    assert (streaming, ok) == (False, False)
+
+
+def test_streaming_but_undelivered_is_the_false_positive():
+    # sub-ms sentinel with missing deliveries: link fine, transport NOT
+    streaming, ok = judge_io_probe(_probe(received=0), reps=5)
+    assert (streaming, ok) == (True, False)
+    streaming, ok = judge_io_probe(_probe(received=5), reps=5)  # warmup lost
+    assert (streaming, ok) == (True, False)
+
+
+def test_errored_probe_means_transition_still_ahead_but_no_transport():
+    # probe never ran device work: attribution says streaming, gate says no
+    streaming, ok = judge_io_probe(_probe(error="io_callback unavailable"),
+                                   reps=5)
+    assert (streaming, ok) == (True, False)
+
+
+def test_missing_sentinel_defaults_to_degraded():
+    p = {"values_received": 6}
+    assert judge_io_probe(p, reps=5) == (False, False)
