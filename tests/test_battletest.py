@@ -128,18 +128,10 @@ cnode_strategy = st.builds(
 )
 
 
-@settings(max_examples=25, deadline=None)
-@given(st.lists(cnode_strategy, min_size=1, max_size=7))
-def test_fuzz_consolidation_parity_kernel_vs_oracle(nodespecs):
-    """The batched consolidation sweep (unique-row feas table, shared
-    ex_used, price-memoized cheaper-option mask) must pick the same
-    single-node action as the scalar oracle on any generated cluster —
-    including no-action, do-not-evict pods, and draining nodes."""
+def build_consolidation_cluster(catalog, nodespecs):
+    """Shared cluster builder for the consolidation fuzz tests."""
     from karpenter_tpu.models.cluster import ClusterState, StateNode
-    from karpenter_tpu.ops.consolidate import run_consolidation
-    from karpenter_tpu.oracle.consolidation import find_consolidation
 
-    catalog = battletest_catalog()
     cluster = ClusterState()
     for ni, nspec in enumerate(nodespecs):
         itype = catalog.types[nspec["type_idx"]]
@@ -156,6 +148,45 @@ def test_fuzz_consolidation_parity_kernel_vs_oracle(nodespecs):
             capacity_type="on-demand", price=itype.offerings[0].price,
             provisioner_name="default", pods=pods,
             marked_for_deletion=nspec["marked"]))
+    return cluster
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.lists(cnode_strategy, min_size=2, max_size=6))
+def test_fuzz_multi_node_consolidation_parity(nodespecs):
+    """Full-chain parity incl. the PAIR sweep: when singles find nothing,
+    the batched pair grid must pick the same action as the oracle's
+    sequential find_multi_consolidation (or the same no-action)."""
+    from karpenter_tpu.ops.consolidate import run_consolidation
+    from karpenter_tpu.oracle.consolidation import (find_consolidation,
+                                                    find_multi_consolidation)
+
+    catalog = battletest_catalog()
+    cluster = build_consolidation_cluster(catalog, nodespecs)
+    prov = Provisioner(name="default", consolidation_enabled=True)
+    prov.set_defaults()
+    kernel = run_consolidation(cluster, catalog, [prov], multi_node=True)
+    oracle = find_consolidation(cluster, catalog, [prov])
+    if oracle is None:
+        oracle = find_multi_consolidation(cluster, catalog, [prov])
+    assert (kernel is None) == (oracle is None), (kernel, oracle)
+    if kernel is not None:
+        assert (kernel.kind, kernel.nodes, kernel.replacement) == \
+            (oracle.kind, oracle.nodes, oracle.replacement), (kernel, oracle)
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.lists(cnode_strategy, min_size=1, max_size=7))
+def test_fuzz_consolidation_parity_kernel_vs_oracle(nodespecs):
+    """The batched consolidation sweep (unique-row feas table, shared
+    ex_used, price-memoized cheaper-option mask) must pick the same
+    single-node action as the scalar oracle on any generated cluster —
+    including no-action, do-not-evict pods, and draining nodes."""
+    from karpenter_tpu.ops.consolidate import run_consolidation
+    from karpenter_tpu.oracle.consolidation import find_consolidation
+
+    catalog = battletest_catalog()
+    cluster = build_consolidation_cluster(catalog, nodespecs)
     prov = Provisioner(name="default", consolidation_enabled=True)
     prov.set_defaults()
     kernel = run_consolidation(cluster, catalog, [prov], multi_node=False)
