@@ -56,12 +56,17 @@ class CloudProvider:
                                        static_prices=static_prices)
         self.images = ImageProvider(cloud, clock=clock)
         self.launch_templates = LaunchTemplateProvider(
-            cloud, self.images, settings, clock=clock)
+            cloud, self.images, settings, clock=clock,
+            securitygroup_provider=self.security_groups)
         self.instance_types = InstanceTypeProvider(
             source_catalog, self.ice, self.subnets, settings=settings)
         self.instances = InstanceProvider(
             cloud, settings, self.launch_templates, self.subnets, self.ice)
         self.nodetemplates: "dict[str, NodeTemplate]" = {}
+        # zone-fold memos (constrain_to_template_zones): strong refs so
+        # identity checks can't alias recycled objects
+        self._all_zones_memo: "Optional[tuple]" = None
+        self._zone_fold_memo: "dict[str, tuple]" = {}
         # authoritative template lookup (the operator wires the kube store
         # here so deletes are honored; the reference gets this for free via
         # the shared kube client, cloudprovider.go:286-300). When unset, the
@@ -80,6 +85,58 @@ class CloudProvider:
         if self.template_source is not None:
             return self.template_source(name)
         return self.nodetemplates.get(name)
+
+    def constrain_to_template_zones(
+            self, provisioners: "Sequence[Provisioner]",
+            catalog: Catalog) -> "list[Provisioner]":
+        """Fold each provisioner's template subnet zones into its zone
+        domain, so EVERY solve entry point (provisioning, consolidation
+        search, replace revalidation) decides only zones the template can
+        launch into. The reference gets this for free by building offerings
+        from the template's subnets
+        (/root/reference/pkg/cloudprovider/instancetypes.go:86-102); here
+        scheduling shares one catalog, so the restriction rides the
+        provisioner requirements. Constrained copies are memoized per
+        provisioner object + zone set so steady-state callers keep object
+        identity (solver caches key on it)."""
+        memo = self._all_zones_memo
+        if memo is None or memo[0] is not catalog or memo[1] != catalog.seqnum:
+            memo = (catalog, catalog.seqnum,
+                    {o.zone for t in catalog.types for o in t.offerings})
+            self._all_zones_memo = memo
+        all_zones = memo[2]
+        # prune memo entries for provisioners that no longer exist, so
+        # deleted provisioners don't pin their objects forever
+        live = {p.name for p in provisioners}
+        for stale in [n for n in self._zone_fold_memo if n not in live]:
+            del self._zone_fold_memo[stale]
+        return [self._zone_constrained(p, all_zones) for p in provisioners]
+
+    def _zone_constrained(self, prov: Provisioner,
+                          all_zones: "set[str]") -> Provisioner:
+        if not prov.provider_ref:
+            return prov
+        try:
+            template = self._get_template(prov.provider_ref)
+        except Exception:
+            return prov
+        if template is None or not template.subnet_selector:
+            return prov
+        zones = tuple(sorted(self.subnets.zones(template.subnet_selector)))
+        if not zones or set(zones) >= all_zones:
+            return prov  # unrestricted (or unresolvable: launch surfaces it)
+        memo = self._zone_fold_memo.get(prov.name)
+        if memo is not None and memo[0] is prov and memo[1] == zones:
+            return memo[2]
+        import dataclasses
+
+        from .models.requirements import OP_IN
+
+        constrained = dataclasses.replace(
+            prov, requirements=prov.requirements.union(
+                Requirements.of((wk.LABEL_ZONE, OP_IN, list(zones)))))
+        self._zone_fold_memo[prov.name] = (prov, zones, constrained)
+        return constrained
 
     def resolve_nodetemplate(self, provisioner_or_machine) -> NodeTemplate:
         """providerRef -> NodeTemplate (cloudprovider.go:113-118, 286-300)."""

@@ -165,7 +165,12 @@ class DeprovisioningController:
         # build a view-cluster excluding others as candidates (still hosts)
         cluster = self.cluster
         catalog = self.cloudprovider.catalog_for(None)
-        all_provs = sorted(self.kube.provisioners(), key=lambda p: (-p.weight, p.name))
+        # replacement solves must respect template subnet zones too
+        # (same fold as provisioning — a replacement decided in a zone the
+        # template can't launch into would fail-loop forever)
+        all_provs = self.cloudprovider.constrain_to_template_zones(
+            sorted(self.kube.provisioners(), key=lambda p: (-p.weight, p.name)),
+            catalog)
         method = "tpu" if self.use_tpu_solver else "oracle"
         # only nodes of consolidation-enabled provisioners may be candidates
         # (pre-search: a vetoed node must not shadow the next-best action)
@@ -359,9 +364,10 @@ class DeprovisioningController:
         if not pods:
             return True
         survivors = self.cluster.existing_views(exclude=set(action.nodes))
-        provs = sorted(self.kube.provisioners(),
-                       key=lambda p: (-p.weight, p.name))
         catalog = self.cloudprovider.catalog_for(None)
+        provs = self.cloudprovider.constrain_to_template_zones(
+            sorted(self.kube.provisioners(), key=lambda p: (-p.weight, p.name)),
+            catalog)
         try:
             from ..solver.core import NativeSolver
 

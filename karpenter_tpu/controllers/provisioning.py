@@ -160,6 +160,8 @@ class ProvisioningController:
                                   "no provisioners configured")
             return None
         catalog = self.cloudprovider.catalog_for(None)
+        provisioners = self.cloudprovider.constrain_to_template_zones(
+            provisioners, catalog)
         daemon_overhead = self._daemon_overhead()
         existing = self.cluster.existing_views()
 
@@ -297,7 +299,12 @@ class ProvisioningController:
                     self.kube.bind_pod(pod_name, node_name)
                     node = self.cluster.nodes.get(node_name)
                     pod = self.kube.get("pods", pod_name)
-                    if node is not None and pod is not None:
+                    # the operator's watch hook may have already added the
+                    # bound pod to the resident list (notify runs on this
+                    # thread); the direct append covers standalone use
+                    # where no watch is attached
+                    if (node is not None and pod is not None
+                            and all(p.name != pod.name for p in node.pods)):
                         node.pods.append(pod)
                     self.pods_bound.inc(provisioner=(
                         node.provisioner_name if node else ""))

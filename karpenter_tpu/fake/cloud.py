@@ -111,6 +111,7 @@ class LaunchTemplate:
     block_devices: "list[dict]" = dataclasses.field(default_factory=list)
     monitoring: bool = False
     instance_profile: str = ""
+    security_group_ids: "list[str]" = dataclasses.field(default_factory=list)
 
 
 class FakeCloud:

@@ -178,7 +178,7 @@ class Operator:
             live = self.cluster.nodes.get(getattr(obj, "name", None))
             if live is not None and live is not obj:
                 live.annotations = dict(getattr(obj, "annotations", {}) or {})
-        elif kind == "pods" and action in ("modified", "deleted"):
+        elif kind == "pods" and action in ("added", "modified", "deleted"):
             # bound-pod updates (kubectl annotate do-not-evict, priority
             # edits) and deletions must refresh the OWNING node's resident
             # list — eligibility and drain read node.pods, and the object
@@ -195,8 +195,15 @@ class Operator:
                 pods = live.pods
                 if action == "deleted":
                     rebuilt = [p for p in pods if p.name != obj.name]
-                else:
+                elif any(p.name == obj.name for p in pods):
                     rebuilt = [obj if p.name == obj.name else p for p in pods]
+                else:
+                    # newly BOUND pod (kube-scheduler placing onto an
+                    # existing node, or a daemon arriving late): it must
+                    # join the resident list or emptiness/eligibility will
+                    # judge the node by a stale view (karpenter-core
+                    # cluster.updatePod tracks these binds the same way)
+                    rebuilt = pods + [obj]
                 if rebuilt != pods:
                     live.pods = rebuilt
 

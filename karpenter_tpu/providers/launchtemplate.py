@@ -19,6 +19,7 @@ from typing import Optional, Sequence
 from ..apis.nodetemplate import NodeTemplate
 from ..apis.settings import Settings
 from ..fake.cloud import LaunchTemplate
+from ..utils import errors as cloud_errors
 from ..models.pod import Taint
 from ..utils.clock import Clock
 from .images import BootstrapConfig, ImageProvider, get_family
@@ -30,9 +31,10 @@ CLUSTER_TAG_KEY = "karpenter.k8s.tpu/cluster"
 
 class LaunchTemplateProvider:
     def __init__(self, cloud, image_provider: ImageProvider, settings: Settings,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None, securitygroup_provider=None):
         self.cloud = cloud
         self.images = image_provider
+        self.security_groups = securitygroup_provider
         self.settings = settings
         self._known: "dict[str, str]" = {}  # hash-name -> name (presence cache)
         self._lock = threading.Lock()
@@ -55,6 +57,18 @@ class LaunchTemplateProvider:
         (launchtemplate.go:93-96)."""
         if template.launch_template_name:
             return {template.launch_template_name: list(archs)}
+        # Constrained security groups resolve into the LT; an empty match is a
+        # launch failure, not a silently ungrouped node
+        # (launchtemplate.go:141-154 "no security groups exist given
+        # constraints", SecurityGroupIds:210). A selector with no provider to
+        # resolve it is a wiring bug and fails just as loudly.
+        sg_ids: "list[str]" = []
+        if self.security_groups is not None:
+            sg_ids = self.security_groups.ids(template.security_group_selector)
+        if not sg_ids and template.security_group_selector:
+            raise cloud_errors.CloudError(
+                "InvalidParameterValue",
+                "no security groups exist given constraints")
         out: "dict[str, list[str]]" = {}
         family = get_family(template.image_family)
         for image in self.images.get(template, archs):
@@ -77,6 +91,7 @@ class LaunchTemplateProvider:
                 # tags are carried on the created LT, so they must be hashed:
                 # templates differing only in tags may not share an LT
                 "tags": dict(sorted(template.tags.items())),
+                "sgs": sorted(sg_ids),
             }
             spec_hash = hashlib.sha256(
                 json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
@@ -101,6 +116,7 @@ class LaunchTemplateProvider:
                 block_devices=spec["bdm"],
                 monitoring=spec["monitoring"],
                 instance_profile=spec["profile"],
+                security_group_ids=spec["sgs"],
             ))
             log.info("created launch template %s", name)
         with self._lock:

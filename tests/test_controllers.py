@@ -173,21 +173,27 @@ class TestInterruption:
         from karpenter_tpu.models.machine import parse_provider_id
 
         _, iid = parse_provider_id(node.provider_id)
+        # global REGISTRY: assert deltas, not absolutes (see consolidation note)
+        recv_before = op.interruption.received.value(message_type="SpotInterruption")
+        del_before = op.interruption.deleted.value()
         op.queue.send(self.spot_message(iid))
         handled = op.interruption.reconcile_once()
         assert handled == 1
         assert node.marked_for_deletion
         assert op.cloudprovider.ice.is_unavailable(
             "spot", node.instance_type, node.zone)
-        assert op.interruption.received.value(message_type="SpotInterruption") == 1
-        assert op.interruption.deleted.value() == 1
+        assert op.interruption.received.value(
+            message_type="SpotInterruption") == recv_before + 1
+        assert op.interruption.deleted.value() == del_before + 1
 
     def test_unparseable_and_unknown_messages_are_noop(self, op):
         add_provisioner(op)
+        noop_before = op.interruption.received.value(message_type="NoOp")
         op.queue.send("{malformed")
         op.queue.send(json.dumps({"source": "x", "detail-type": "y"}))
         assert op.interruption.reconcile_once() == 2
-        assert op.interruption.received.value(message_type="NoOp") == 2
+        assert op.interruption.received.value(
+            message_type="NoOp") == noop_before + 2
 
     def test_state_change_only_on_stopping_states(self, op):
         add_provisioner(op)
@@ -270,11 +276,13 @@ class TestMachineLifecycle:
         assert "node.example/not-ready" in lt.userdata
         # one pass: LAUNCHED->REGISTERED, second: REGISTERED->INITIALIZED
         # (instance already 'running' after the create-describe wait)
+        init_before = op.machinelifecycle.initialized.value(provisioner="default")
         assert op.machinelifecycle.reconcile_once() >= 1
         op.machinelifecycle.reconcile_once()
         assert machine.status.state == INITIALIZED
         assert node.initialized and node.startup_taints == ()
-        assert op.machinelifecycle.initialized.value(provisioner="default") == 1
+        assert op.machinelifecycle.initialized.value(
+            provisioner="default") == init_before + 1
 
     def test_initialization_gates_consolidation(self, op):
         add_provisioner(op, consolidation_enabled=True)
