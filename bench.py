@@ -114,6 +114,10 @@ def main():
         if cap:
             _state["detail"]["latest_tpu_capture"] = {
                 "captured_at": cap.get("captured_at"),
+                # a salvaged partial (relay wedged mid-capture) reports
+                # only the sections that completed — flagged so a missing
+                # section reads as "not measured", never "regressed"
+                "partial": cap.get("partial", False),
                 "p50_ms": (cap.get("headline") or {}).get("p50_ms",
                                                           cap.get("value")),
                 "crossover_pods": cap.get("crossover_pods"),

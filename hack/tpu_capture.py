@@ -135,16 +135,34 @@ def judge_io_probe(probe: dict, reps: int) -> "tuple[bool, bool]":
     return still_streaming, transport_ok
 
 
-def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
-    """Run inside the pinned-to-axon subprocess: headline + crossover sweep."""
+def _capture_payload(reps_headline: int, reps_sweep: int,
+                     partial_path: "str | None" = None) -> dict:
+    """Run inside the pinned-to-axon subprocess: headline + crossover sweep.
+
+    When partial_path is given, every completed section is checkpointed
+    there (atomic rename) — a relay wedge mid-capture then still banks the
+    sections that finished instead of losing the whole attempt (the
+    40-minute all-or-nothing failure mode this replaces)."""
     sys.path.insert(0, REPO)
     from karpenter_tpu.utils.jaxenv import pin
 
     jax, _ = pin("axon")
     import jax.numpy as jnp
 
+    rec: dict = {}
+
+    def bank(**sections) -> None:
+        rec.update(sections)
+        if partial_path:
+            tmp = partial_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(rec, f)
+            os.replace(tmp, partial_path)
+
     backend = jax.devices()[0].platform
+    bank(backend=backend)
     link_fresh = _link_sentinel(jax, jnp)  # BEFORE any d2h read
+    bank(link_state={"fresh": link_fresh})
 
     from benchmarks.workloads import mixed_workload
     from karpenter_tpu.apis import wellknown as wk
@@ -191,10 +209,13 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
             ts.append((time.perf_counter() - t0) * 1000)
         exec_sweep.append({"n_pods": n, "p50_ms": round(st.median(ts), 3),
                            "min_ms": round(min(ts), 3)})
+        bank(exec_sweep=exec_sweep)
     exec_only = {**next(r for r in exec_sweep if r["n_pods"] == 10_000),
                  "note": "host encode excluded; put+exec+block, no d2h read"}
     pods10k = workloads[10_000]
     link_after_exec = _link_sentinel(jax, jnp)
+    bank(exec_only_10k=exec_only,
+         link_state={"fresh": link_fresh, "after_exec_only": link_after_exec})
 
     # escape-hatch probe, run LAST in the streaming section: if its
     # sync_after sentinel stays sub-ms, io_callback readback avoids the
@@ -207,6 +228,7 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
     transition_in = "wave"  # who consumed the streaming->degraded flip
     if "error" not in io_escape and not streaming_after_io:
         transition_in = "io_callback_probe"
+    bank(io_callback_escape=io_escape)
 
     # If the escape works, MEASURE it at the headline shape immediately
     # (still streaming): full solves routed through the callback readback
@@ -240,6 +262,7 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
             if not still:  # this block only runs while still streaming
                 transition_in = "callback_headline"
             streaming_after_io = still
+        bank(callback_headline=callback_headline)
 
     # wave: K pipelined solves, ONE concatenated read (solver.solve_many)
     K = 8
@@ -256,6 +279,10 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
                      f"link already degraded during {transition_in} — "
                      "the transition cost is not in this number")}
     link_after_read = _link_sentinel(jax, jnp)  # first d2h happened above
+    bank(wave_pipelined=wave,
+         link_state={"fresh": link_fresh, "after_exec_only": link_after_exec,
+                     "after_first_read": link_after_read,
+                     "transition_in": transition_in})
 
     # steady-state wave: same K solves AFTER the link already degraded —
     # what a long-lived controller session actually pays per wave
@@ -265,6 +292,7 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
     assert all(r.unschedulable_count() == 0 for r in wave_res2)
     wave_steady = {"k": K, "n_pods": 10_000, "total_ms": round(wave2_ms, 3),
                    "per_solve_ms": round(wave2_ms / K, 3)}
+    bank(wave_steady=wave_steady)
 
     def p50(solver, pods, reps):
         solver.solve(pods)  # warmup: compile/grid-build outside the clock
@@ -281,10 +309,18 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
         t_tpu, _ = p50(tpu, pods, reps_sweep)
         t_nat, _ = p50(native, pods, reps_sweep)
         sweep.append({"n_pods": n, "tpu_p50_ms": t_tpu, "native_p50_ms": t_nat})
+        bank(sweep=sweep)
 
     pods = workloads[10_000]
     head_p50, times = p50(tpu, pods, reps_headline)
     res = tpu.solve(pods)
+    bank(headline={
+        "metric": "scheduling_cycle_p50_ms_10k_pods_600_types",
+        "p50_ms": head_p50, "p_min_ms": round(min(times), 3),
+        "p_max_ms": round(max(times), 3), "reps": len(times),
+        "n_types": len(catalog.types), "n_pods": len(pods),
+        "nodes_provisioned": len(res.nodes),
+        "unschedulable": res.unschedulable_count()})
     # phase attribution of the degraded-mode solve (needs the
     # KARPENTER_TPU_SOLVE_TIMING=1 env capture_once sets): which of
     # encode / dispatch(h2d+enqueue) / fetch(the one sync) / decode owns
@@ -295,6 +331,8 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
         t = getattr(tpu, "last_timings", None)
         if t:
             phases.append(t)
+    rec["headline"]["phase_split"] = phases
+    bank()  # checkpoint the phase attribution before the device-heavy tail
 
     crossover = None
     for row in sweep:  # smallest size where the device wins
@@ -309,6 +347,7 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
         if row["p50_ms"] < nat_by_n[row["n_pods"]]:
             exec_crossover = row["n_pods"]
             break
+    bank(crossover_pods=crossover, exec_crossover_pods=exec_crossover)
 
     # Consolidation sweep on-chip: 500 candidate lanes in ONE vmapped
     # dispatch — the shape where a single device round trip amortizes over
@@ -350,6 +389,7 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
         }
     except Exception as e:
         consolidation = {"error": str(e)[:200]}
+    bank(consolidation_500=consolidation)
 
     # Pair sweep on-chip (weak #6, round 3): 64 nodes whose singles can't
     # consolidate -> the multi-node grid (2016 pair lanes) runs as one
@@ -387,39 +427,45 @@ def _capture_payload(reps_headline: int, reps_sweep: int) -> dict:
                       "p50_ms": round(st.median(ptimes), 3)}
     except Exception as e:
         pair_sweep = {"error": str(e)[:200]}
+    # every key was checkpointed as its section completed
+    bank(pair_sweep_64=pair_sweep)
+    return rec
 
-    return {
-        "backend": backend,
-        # link-state decomposition (VERDICT r3 ask #1): sync latency fresh /
-        # after exec-only work / after the first d2h read, plus the
-        # streaming-mode kernel time and wave-amortized throughput
-        "link_state": {"fresh": link_fresh, "after_exec_only": link_after_exec,
-                       "after_first_read": link_after_read,
-                       "transition_in": transition_in},
-        "exec_only_10k": exec_only,
-        "exec_sweep": exec_sweep,
-        "exec_crossover_pods": exec_crossover,
-        "io_callback_escape": io_escape,
-        "callback_headline": callback_headline,
-        "wave_pipelined": wave,
-        "wave_steady": wave_steady,
-        "consolidation_500": consolidation,
-        "pair_sweep_64": pair_sweep,
-        "headline": {
-            "metric": "scheduling_cycle_p50_ms_10k_pods_600_types",
-            "p50_ms": head_p50,
-            "p_min_ms": round(min(times), 3),
-            "p_max_ms": round(max(times), 3),
-            "reps": len(times),
-            "n_types": len(catalog.types),
-            "n_pods": len(pods),
-            "nodes_provisioned": len(res.nodes),
-            "unschedulable": res.unschedulable_count(),
-            "phase_split": phases,
-        },
-        "sweep": sweep,
-        "crossover_pods": crossover,
-    }
+
+def _finalize(rec: dict, partial_file: "str | None" = None) -> dict:
+    """Stamp + write a capture record to RESULTS_DIR (shared by the full
+    and salvaged paths so the on-disk format cannot fork)."""
+    ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    rec["captured_at"] = ts
+    rec["device"] = "tunneled TPU (platform=axon)"
+    path = os.path.join(RESULTS_DIR, f"tpu_{ts}.json")
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1)
+    if partial_file:
+        try:
+            os.unlink(partial_file)
+        except FileNotFoundError:
+            pass
+    print(f"captured -> {path}" if not rec.get("partial") else
+          f"salvaged partial capture ({len(rec)} sections) -> {path}")
+    return rec
+
+
+def _salvage_partial(partial: str, **how) -> "dict | None":
+    """Bank the checkpointed sections of a dead capture as a partial
+    record: the relay wedge (or a crash) loses the attempt, not the
+    evidence. `how` records the death mode verbatim (wedged_after_s=N for
+    a timeout kill, crashed_rc=N for a subprocess exit)."""
+    try:
+        with open(partial) as f:
+            rec = json.load(f)
+    except (FileNotFoundError, ValueError):
+        return None
+    if not rec or list(rec) == ["backend"]:
+        return None  # nothing measured before the death
+    rec["partial"] = True
+    rec.update(how)
+    return _finalize(rec, partial_file=partial)
 
 
 def latest_capture() -> "dict | None":
@@ -439,9 +485,16 @@ def capture_once(timeout_s: int, reps_headline: int, reps_sweep: int) -> "dict |
     if not ok:
         print(f"probe failed: {note}", file=sys.stderr)
         return None
+    os.makedirs(RESULTS_DIR, exist_ok=True)
+    partial = os.path.join(RESULTS_DIR, ".capture_partial.json")
+    try:
+        os.unlink(partial)
+    except FileNotFoundError:
+        pass
     code = (f"import sys, json; sys.path.insert(0, {REPO!r})\n"
             "from hack.tpu_capture import _capture_payload\n"
-            f"print('CAPTURE::' + json.dumps(_capture_payload({reps_headline}, {reps_sweep})))")
+            f"print('CAPTURE::' + json.dumps(_capture_payload("
+            f"{reps_headline}, {reps_sweep}, partial_path={partial!r})))")
     env = dict(os.environ, JAX_PLATFORMS="axon",
                KARPENTER_TPU_SOLVE_TIMING="1")  # phase-attributed headline
     try:
@@ -449,22 +502,15 @@ def capture_once(timeout_s: int, reps_headline: int, reps_sweep: int) -> "dict |
                            capture_output=True, text=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
         print(f"capture wedged; killed after {timeout_s}s", file=sys.stderr)
-        return None
+        return _salvage_partial(partial, wedged_after_s=timeout_s)
     for line in r.stdout.splitlines():
         if line.startswith("CAPTURE::"):
             rec = json.loads(line[len("CAPTURE::"):])
-            ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
-            rec["captured_at"] = ts
-            rec["device"] = "tunneled TPU (platform=axon)"
-            os.makedirs(RESULTS_DIR, exist_ok=True)
-            path = os.path.join(RESULTS_DIR, f"tpu_{ts}.json")
-            with open(path, "w") as f:
-                json.dump(rec, f, indent=1)
-            print(f"captured -> {path}")
-            return rec
+            return _finalize(rec, partial_file=partial)
     print(f"capture failed rc={r.returncode}: {(r.stderr or r.stdout)[-300:]}",
           file=sys.stderr)
-    return None
+    # a crash (not a timeout) may still have checkpointed sections
+    return _salvage_partial(partial, crashed_rc=r.returncode)
 
 
 def main():
@@ -484,16 +530,20 @@ def main():
     if not args.loop:
         rec = capture_once(args.capture_timeout_s, args.reps_headline,
                            args.reps_sweep)
-        sys.exit(0 if rec else 1)
+        # a salvaged partial banks evidence but is NOT a successful capture:
+        # exit 1 so automation retries for a complete record
+        sys.exit(0 if rec and not rec.get("partial") else 1)
 
     wait = args.probe_interval_s
     while True:
         rec = capture_once(args.capture_timeout_s, args.reps_headline,
                            args.reps_sweep)
-        if rec:
+        if rec and not rec.get("partial"):
             wait = args.probe_interval_s
             time.sleep(args.recapture_s)
         else:
+            # failed OR partial: keep retrying on the probe backoff — a
+            # partial must not suppress the retry that completes it
             time.sleep(wait)
             wait = min(wait * 2, 1800)
 

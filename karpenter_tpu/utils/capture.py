@@ -23,14 +23,14 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 RESULTS_DIR = os.path.join(_REPO_ROOT, "benchmarks", "results")
 
 
-def latest_capture(results_dir: Optional[str] = None) -> "Optional[dict]":
-    """Most recent non-degraded recorded capture, or None."""
+def _iter_captures(results_dir: Optional[str] = None):
+    """Recorded captures, newest first, skipping degraded/unreadable ones."""
     d = results_dir or RESULTS_DIR
     try:
         names = sorted(n for n in os.listdir(d)
                        if n.startswith("tpu_") and n.endswith(".json"))
     except FileNotFoundError:
-        return None
+        return
     for name in reversed(names):
         try:
             with open(os.path.join(d, name)) as f:
@@ -39,8 +39,15 @@ def latest_capture(results_dir: Optional[str] = None) -> "Optional[dict]":
             continue
         if rec.get("degraded"):
             continue
-        return rec
-    return None
+        yield rec
+
+
+def latest_capture(results_dir: Optional[str] = None) -> "Optional[dict]":
+    """Most recent non-degraded recorded capture, or None. May be a partial
+    record (rec["partial"] set) when a relay wedge cut a capture short —
+    callers that need a specific section should fall back through
+    _iter_captures."""
+    return next(_iter_captures(results_dir), None)
 
 
 def route_crossover(default: "Optional[int]" = None) -> "Optional[int]":
@@ -66,7 +73,9 @@ def route_crossover(default: "Optional[int]" = None) -> "Optional[int]":
             return int(env)
         except ValueError:
             pass
-    cap = latest_capture()
-    if cap is not None and "crossover_pods" in cap:
-        return cap["crossover_pods"]  # may legitimately be None
+    # newest capture that actually measured the crossover (a salvaged
+    # partial may have wedged before the sweep finished)
+    for cap in _iter_captures():
+        if "crossover_pods" in cap:
+            return cap["crossover_pods"]  # may legitimately be None
     return default

@@ -46,3 +46,69 @@ def test_errored_probe_means_transition_still_ahead_but_no_transport():
 def test_missing_sentinel_defaults_to_degraded():
     p = {"values_received": 6}
     assert judge_io_probe(p, reps=5) == (False, False)
+
+
+# -- partial-capture salvage (a relay wedge banks completed sections) --------
+
+def test_salvage_banks_checkpointed_sections(tmp_path, monkeypatch):
+    import json
+
+    import hack.tpu_capture as tc
+
+    monkeypatch.setattr(tc, "RESULTS_DIR", str(tmp_path))
+    partial = tmp_path / "partial.json"
+    partial.write_text(json.dumps({
+        "backend": "tpu",
+        "exec_sweep": [{"n_pods": 100, "p50_ms": 1.0}],
+        "exec_only_10k": {"n_pods": 10000, "p50_ms": 2.3}}))
+    rec = tc._salvage_partial(str(partial), wedged_after_s=2400)
+    assert rec is not None and rec["partial"] is True
+    assert rec["wedged_after_s"] == 2400
+    assert rec["exec_only_10k"]["p50_ms"] == 2.3
+    assert not partial.exists()  # consumed
+    (saved,) = list(tmp_path.glob("tpu_*.json"))
+    assert json.loads(saved.read_text())["partial"] is True
+
+
+def test_salvage_ignores_empty_or_missing_partial(tmp_path, monkeypatch):
+    import json
+
+    import hack.tpu_capture as tc
+
+    monkeypatch.setattr(tc, "RESULTS_DIR", str(tmp_path))
+    assert tc._salvage_partial(str(tmp_path / "absent.json"),
+                               crashed_rc=1) is None
+    p = tmp_path / "backend_only.json"
+    p.write_text(json.dumps({"backend": "tpu"}))
+    assert tc._salvage_partial(str(p), crashed_rc=1) is None  # nothing measured
+    assert not list(tmp_path.glob("tpu_*.json"))
+
+
+def test_salvage_records_crash_mode_distinctly(tmp_path, monkeypatch):
+    import json
+
+    import hack.tpu_capture as tc
+
+    monkeypatch.setattr(tc, "RESULTS_DIR", str(tmp_path))
+    p = tmp_path / "p.json"
+    p.write_text(json.dumps({"backend": "tpu", "exec_sweep": []}))
+    rec = tc._salvage_partial(str(p), crashed_rc=1)
+    assert rec["crashed_rc"] == 1 and "wedged_after_s" not in rec
+
+
+def test_route_crossover_skips_partial_without_sweep(tmp_path, monkeypatch):
+    """A newer partial capture missing crossover_pods must not shadow the
+    older complete capture's measured crossover."""
+    import json
+
+    from karpenter_tpu.utils import capture as capmod
+
+    old = tmp_path / "tpu_20260101T000000Z.json"
+    old.write_text(json.dumps({"crossover_pods": 3000}))
+    new = tmp_path / "tpu_20260102T000000Z.json"
+    new.write_text(json.dumps({"partial": True, "exec_sweep": []}))
+    monkeypatch.setattr(capmod, "RESULTS_DIR", str(tmp_path))
+    monkeypatch.delenv("KARPENTER_TPU_ROUTE_CROSSOVER", raising=False)
+    assert capmod.route_crossover() == 3000
+    # the newest record overall is still the partial (bench reporting)
+    assert capmod.latest_capture(str(tmp_path))["partial"] is True
