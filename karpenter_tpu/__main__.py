@@ -228,6 +228,96 @@ def cmd_cleanup(args) -> int:
     return 0
 
 
+def _tail_delta(lines: "list[str]", last_printed: "str | None"
+                ) -> "tuple[list[str], str | None]":
+    """New lines since `last_printed` in a SLIDING log window.
+
+    The cursor is the last printed line's content, matched from the end:
+    an index cursor goes permanently silent once the window fills (every
+    poll returns exactly N lines), and if the marker rotated out entirely
+    the whole window is new."""
+    start = 0
+    if last_printed is not None:
+        for i in range(len(lines) - 1, -1, -1):
+            if lines[i] == last_printed:
+                start = i + 1
+                break
+    new = lines[start:]
+    return new, (lines[-1] if lines else last_printed)
+
+
+def cmd_logs(args) -> int:
+    """Fetch recent controller logs from a live controller's /logz endpoint
+    (utils/logring ring buffer) — the hermetic analogue of the reference's
+    log-fetch tool (test/cmd/logs/main.go: controller logs for a test run
+    without shelling into the pod). --follow polls for new lines."""
+    import time as _time
+    import urllib.request
+
+    base = args.endpoint.rstrip("/")
+    last_printed = None  # content cursor: /logz serves a SLIDING window,
+    # so an index into it would go silent once the window fills
+    while True:
+        try:
+            with urllib.request.urlopen(f"{base}/logz?n={args.lines}",
+                                        timeout=10) as r:
+                lines = [ln for ln in r.read().decode().splitlines() if ln]
+        except OSError as e:
+            if not args.follow:
+                print(f"cannot reach {base}/logz: {e}", file=sys.stderr)
+                return 1
+            # tail -f survives controller restarts: retry, don't abort
+            print(f"# retrying ({e})", file=sys.stderr)
+            _time.sleep(args.interval)
+            continue
+        if not args.follow:
+            for ln in lines:
+                print(ln)
+            return 0
+        new, last_printed = _tail_delta(lines, last_printed)
+        for ln in new:
+            print(ln, flush=True)
+        _time.sleep(args.interval)
+
+
+def cmd_sync(args) -> int:
+    """Make a coordination plane match a manifest fixture set (apply +
+    optional prune) — the hermetic analogue of the reference's GitOps
+    test-cluster sync (test/cmd/sync-cluster; the synced path is
+    test/infrastructure/clusters/test-infra)."""
+    from .apis.yaml_compat import load_files
+    from .coordination.sync import sync_manifests
+
+    paths = []
+    for p in args.manifests:
+        if os.path.isdir(p):
+            # recursive: fixture trees nest by kind (provisioners/,
+            # workloads/ — pruning against a partial load would DELETE the
+            # nested objects as "absent")
+            for root, _dirs, files in sorted(os.walk(p)):
+                paths.extend(sorted(
+                    os.path.join(root, f) for f in files
+                    if f.endswith((".yaml", ".yml"))))
+        else:
+            paths.append(p)
+    if not paths:
+        print("no manifests found", file=sys.stderr)
+        return 2
+    loaded = load_files(*paths, env={"CLUSTER_NAME": args.cluster_name})
+    from .coordination.httpkube import HttpKubeStore
+
+    kube = HttpKubeStore.from_kubeconfig(args.kubeconfig)
+    kube.start()
+    try:
+        counts = sync_manifests(kube, loaded, prune=args.prune)
+    finally:
+        kube.stop()
+    print(f"synced {len(paths)} file(s): {counts['created']} created, "
+          f"{counts['updated']} updated, {counts['pruned']} pruned, "
+          f"{counts['unchanged']} unchanged")
+    return 0
+
+
 def main(argv=None) -> int:
     logging.basicConfig(
         level=logging.INFO,
@@ -302,6 +392,29 @@ def main(argv=None) -> int:
     p_clean.add_argument("--launch-templates", action="store_true",
                          help="also delete all cluster-owned launch templates")
     p_clean.set_defaults(fn=cmd_cleanup)
+
+    p_logs = sub.add_parser(
+        "logs", help="fetch recent logs from a live controller (/logz)")
+    p_logs.add_argument("--endpoint", default="http://127.0.0.1:8081",
+                        help="controller health listener base URL")
+    p_logs.add_argument("-n", "--lines", type=int, default=500)
+    p_logs.add_argument("-f", "--follow", action="store_true",
+                        help="poll for new lines")
+    p_logs.add_argument("--interval", type=float, default=2.0)
+    p_logs.set_defaults(fn=cmd_logs)
+
+    p_sync = sub.add_parser(
+        "sync", help="apply (and optionally prune to) a manifest fixture "
+                     "set against a coordination plane")
+    p_sync.add_argument("manifests", nargs="+",
+                        help="YAML files or directories")
+    p_sync.add_argument("--kubeconfig", required=True,
+                        help="target apiserver kubeconfig")
+    p_sync.add_argument("--cluster-name", default="simulated")
+    p_sync.add_argument("--prune", action="store_true",
+                        help="delete managed-kind objects absent from the "
+                             "fixture (pods are never pruned)")
+    p_sync.set_defaults(fn=cmd_sync)
 
     p_ver = sub.add_parser("version")
     p_ver.set_defaults(fn=lambda a: print(VERSION) or 0)

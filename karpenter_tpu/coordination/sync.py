@@ -1,0 +1,85 @@
+"""Fixture sync: make a coordination plane match a manifest set.
+
+The reference keeps its test clusters in sync with the repo's fixture
+directory via a GitOps loop (test/cmd/sync-cluster bootstraps it;
+test/infrastructure/clusters/test-infra is the synced path). The hermetic
+analogue is one idempotent pass: apply every object from the manifests
+(create or update), and with prune=True delete managed-kind objects the
+fixture no longer names.
+
+Works against any store with the shared create/update/delete/list API —
+the in-process KubeStore, the mini apiserver, or a real cluster through
+HttpKubeStore.
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger("karpenter.sync")
+
+
+def _is_conflict(e: Exception) -> bool:
+    """Already-exists, from either store flavor (a lost create race)."""
+    from ..fake.kube import Conflict
+
+    if isinstance(e, Conflict):
+        return True
+    return getattr(e, "code", None) == 409  # httpkube ApiError
+
+# kinds a fixture set manages, in apply order (templates before the
+# provisioners that reference them; pods last so admission sees their
+# provisioner); prune runs in reverse
+_KIND_ORDER = ("nodetemplates", "provisioners", "pdbs", "pods")
+
+
+def sync_manifests(kube, loaded, prune: bool = False) -> "dict[str, int]":
+    """One sync pass; returns {created, updated, pruned, unchanged} counts.
+
+    `loaded` is an apis.yaml_compat.LoadedManifests. Conflicted creates
+    fall back to update (last-writer-wins, like a kubectl apply); prune
+    only touches the managed kinds so foreign objects (machines, nodes,
+    leases, events) are never swept.
+    """
+    desired: "dict[str, dict[str, object]]" = {
+        "nodetemplates": {t.name: t for t in loaded.templates},
+        "provisioners": {p.name: p for p in loaded.provisioners},
+        "pdbs": {p.name: p for p in loaded.pdbs},
+        "pods": {p.name: p for p in loaded.pods},
+    }
+    counts = {"created": 0, "updated": 0, "pruned": 0, "unchanged": 0}
+    for kind in _KIND_ORDER:
+        for name, obj in desired[kind].items():
+            current = kube.get(kind, name)
+            if current is None:
+                try:
+                    kube.create(kind, name, obj)
+                    counts["created"] += 1
+                    continue
+                except Exception as e:
+                    if not _is_conflict(e):
+                        raise  # admission denial / server error: surface it
+                    current = kube.get(kind, name)  # lost a create race
+            if kind == "pods":
+                # an existing pod may be BOUND: stomping it with the
+                # fixture's pending copy would silently unbind workload
+                counts["unchanged"] += 1
+                continue
+            if current == obj:
+                counts["unchanged"] += 1
+                continue
+            kube.update(kind, name, obj)
+            counts["updated"] += 1
+    if prune:
+        for kind in reversed(_KIND_ORDER):
+            if kind == "pods":
+                # never prune pods: bound workload pods are cluster state,
+                # not fixture state (the fixture only seeds pending ones)
+                continue
+            for obj in list(kube.list(kind)):
+                name = getattr(obj, "name", None)
+                if name is not None and name not in desired[kind]:
+                    kube.delete(kind, name)
+                    counts["pruned"] += 1
+    log.info("sync: %s", counts)
+    return counts

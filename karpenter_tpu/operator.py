@@ -48,6 +48,10 @@ class Operator:
                  webhook_port: int = 0,
                  webhook_tls: "tuple[str, str]" = ("", "")):
         settings.validate()
+        # recent-log ring from boot (served at /logz for the `logs` CLI)
+        from .utils import logring
+
+        logring.install()
         self.settings = settings
         self.clock = clock or Clock()
         self.kube = kube or KubeStore()

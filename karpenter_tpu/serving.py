@@ -94,6 +94,19 @@ class ServingPlane:
 
         class Health(_Base):
             def do_GET(self):
+                if self.path.startswith("/logz"):
+                    # recent controller logs (utils/logring) — the `logs`
+                    # CLI's kubectl-logs-shaped triage endpoint
+                    from urllib.parse import parse_qs, urlsplit
+
+                    from .utils import logring
+
+                    qs = parse_qs(urlsplit(self.path).query)
+                    try:
+                        n = int(qs.get("n", ["500"])[0])
+                    except ValueError:
+                        n = 500
+                    return self._text(200, "\n".join(logring.dump(n)) + "\n")
                 if self.path.startswith("/healthz") or \
                         self.path.startswith("/readyz"):
                     ok = op.healthz()

@@ -1,0 +1,63 @@
+"""Bounded in-process log ring served at /logz (`logs` CLI).
+
+The reference's test tooling fetches controller logs for a run without
+shelling into the pod (/root/reference/test/cmd/logs/main.go pulls them
+from the log archive by test id). The hermetic analogue keeps the last N
+records in memory and serves them over the health listener — `python -m
+karpenter_tpu logs` is then kubectl-logs-shaped triage against a live
+controller.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+
+_LOCK = threading.Lock()
+_HANDLER: "RingHandler | None" = None
+
+
+class RingHandler(logging.Handler):
+    """Keep the last `capacity` formatted records, thread-safe."""
+
+    def __init__(self, capacity: int = 2000):
+        super().__init__()
+        self.ring: "collections.deque[str]" = collections.deque(maxlen=capacity)
+        self.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s %(message)s"))
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            line = self.format(record)
+        except Exception:
+            return
+        with _LOCK:
+            self.ring.append(line)
+
+    def dump(self, n: "int | None" = None) -> "list[str]":
+        with _LOCK:
+            lines = list(self.ring)
+        return lines if n is None else lines[-n:]
+
+
+def install(capacity: int = 2000) -> RingHandler:
+    """Attach the process-wide ring to the package logger tree (idempotent)."""
+    global _HANDLER
+    with _LOCK:
+        if _HANDLER is not None:
+            return _HANDLER
+        _HANDLER = RingHandler(capacity)
+    pkg = logging.getLogger("karpenter")
+    pkg.addHandler(_HANDLER)
+    if pkg.level == logging.NOTSET:
+        # without an explicit level the tree inherits root (WARNING unless
+        # basicConfig ran), and INFO records never reach the ring
+        pkg.setLevel(logging.INFO)
+    return _HANDLER
+
+
+def dump(n: "int | None" = None) -> "list[str]":
+    """Recent records, oldest first (empty when no ring is installed)."""
+    h = _HANDLER
+    return h.dump(n) if h is not None else []

@@ -1,0 +1,177 @@
+"""Operational tooling: the log ring (/logz + `logs` CLI) and fixture sync
+(`sync` CLI) — the analogues of the reference's test/cmd fleet tools
+(logs/main.go log fetch; sync-cluster GitOps fixture sync)."""
+
+import logging
+import urllib.request
+
+from karpenter_tpu.apis.yaml_compat import load_manifests
+from karpenter_tpu.coordination.httpkube import HttpKubeStore
+from karpenter_tpu.coordination.sync import sync_manifests
+from karpenter_tpu.fake.kube import KubeStore
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.utils import logring
+
+from tests.test_e2e_scenarios import make_operator  # noqa: F401
+
+FIXTURE = """
+apiVersion: karpenter.sh/v1alpha5
+kind: Provisioner
+metadata:
+  name: default
+spec:
+  providerRef:
+    name: default
+---
+apiVersion: karpenter.k8s.tpu/v1alpha1
+kind: AWSNodeTemplate
+metadata:
+  name: default
+spec:
+  subnetSelector:
+    id: subnet-zone-1a
+  securityGroupSelector:
+    id: sg-default
+"""
+
+
+class TestLogRing:
+    def test_ring_captures_package_logs_bounded(self):
+        h = logring.install(capacity=2000)
+        log = logging.getLogger("karpenter.test.ring")
+        marker = "ring-marker-xyz"
+        log.info(marker)
+        assert any(marker in ln for ln in logring.dump())
+        # bounded: capacity caps retention
+        for i in range(h.ring.maxlen + 50):
+            log.info("flood %d", i)
+        assert len(logring.dump()) == h.ring.maxlen
+        # tail query
+        assert len(logring.dump(10)) == 10
+
+    def test_logz_endpoint_serves_ring(self):
+        from karpenter_tpu.serving import ServingPlane
+
+        op = make_operator()
+        try:
+            plane = ServingPlane(op, metrics_port=-1, health_port=0,
+                                 webhook_port=-1)
+            ports = plane.start()
+            logging.getLogger("karpenter.test.logz").info("logz-marker-abc")
+            try:
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{ports['health']}/logz?n=50",
+                    timeout=5).read().decode()
+                assert "logz-marker-abc" in body
+            finally:
+                plane.stop()
+        finally:
+            op.stop()
+
+
+class TestTailDelta:
+    """logs --follow cursor over /logz's sliding window: content-matched
+    from the end, never an index (a full window makes an index cursor
+    permanently silent)."""
+
+    def test_saturated_window_keeps_advancing(self):
+        from karpenter_tpu.__main__ import _tail_delta
+
+        w1 = [f"l{i}" for i in range(500)]
+        new, last = _tail_delta(w1, None)
+        assert new == w1 and last == "l499"
+        # window slides by 3: only the 3 new lines print
+        w2 = w1[3:] + ["l500", "l501", "l502"]
+        new, last = _tail_delta(w2, last)
+        assert new == ["l500", "l501", "l502"] and last == "l502"
+
+    def test_marker_rotated_out_prints_whole_window(self):
+        from karpenter_tpu.__main__ import _tail_delta
+
+        new, last = _tail_delta(["b1", "b2"], "gone")
+        assert new == ["b1", "b2"] and last == "b2"
+
+    def test_empty_poll_keeps_cursor(self):
+        from karpenter_tpu.__main__ import _tail_delta
+
+        new, last = _tail_delta([], "l9")
+        assert new == [] and last == "l9"
+
+
+class TestSyncManifests:
+    def test_apply_then_idempotent(self):
+        kube = KubeStore()
+        loaded = load_manifests(FIXTURE)
+        c1 = sync_manifests(kube, loaded)
+        assert c1["created"] == 2 and c1["updated"] == 0
+        assert kube.get("provisioners", "default") is not None
+        assert kube.get("nodetemplates", "default") is not None
+        c2 = sync_manifests(kube, loaded)
+        assert c2["created"] == 0 and c2["pruned"] == 0
+
+    def test_update_on_drifted_object(self):
+        kube = KubeStore()
+        loaded = load_manifests(FIXTURE)
+        sync_manifests(kube, loaded)
+        # drift the stored template, re-sync restores the fixture's version
+        # (fresh load: the first sync stored the same objects `loaded` holds)
+        t = kube.get("nodetemplates", "default")
+        t.tags = {"drift": "yes"}
+        kube.update("nodetemplates", "default", t)
+        c = sync_manifests(kube, load_manifests(FIXTURE))
+        assert c["updated"] >= 1
+        assert kube.get("nodetemplates", "default").tags == {}
+
+    def test_prune_removes_unmanaged_fixture_extras_only(self):
+        kube = KubeStore()
+        loaded = load_manifests(FIXTURE)
+        sync_manifests(kube, loaded)
+        from karpenter_tpu.apis.provisioner import Provisioner
+
+        extra = Provisioner(name="stale")
+        extra.set_defaults()
+        kube.create("provisioners", "stale", extra)
+        # a foreign kind must survive the prune
+        kube.create("pods", "workload", make_pod("workload", cpu="1",
+                                                 memory="1Gi"))
+        c = sync_manifests(kube, loaded, prune=True)
+        assert c["pruned"] == 1
+        assert kube.get("provisioners", "stale") is None
+        assert kube.get("pods", "workload") is not None
+
+    def test_existing_pod_never_stomped(self):
+        kube = KubeStore()
+        bound = make_pod("w", cpu="1", memory="1Gi", node_name="node-1")
+        kube.create("pods", "w", bound)
+        fixture_pod = make_pod("w", cpu="1", memory="1Gi")
+        loaded = load_manifests(FIXTURE)
+        loaded.pods.append(fixture_pod)
+        sync_manifests(kube, loaded)
+        assert kube.get("pods", "w").node_name == "node-1"
+
+    def test_create_denial_surfaces_not_swallowed(self):
+        import pytest
+
+        kube = KubeStore()
+        kube.set_admission(lambda kind, obj, op_: (_ for _ in ()).throw(
+            ValueError("denied by policy")))
+        with pytest.raises(ValueError, match="denied"):
+            sync_manifests(kube, load_manifests(FIXTURE))
+
+    def test_sync_against_mini_apiserver(self):
+        from karpenter_tpu.fake.apiserver import serve
+
+        srv, port, _state = serve()
+        try:
+            kube = HttpKubeStore(f"http://127.0.0.1:{port}")
+            kube.start()
+            try:
+                c = sync_manifests(kube, load_manifests(FIXTURE), prune=True)
+                assert c["created"] == 2
+                assert kube.get("provisioners", "default") is not None
+                c2 = sync_manifests(kube, load_manifests(FIXTURE), prune=True)
+                assert c2["created"] == 0 and c2["pruned"] == 0
+            finally:
+                kube.stop()
+        finally:
+            srv.shutdown()
