@@ -194,7 +194,11 @@ class ProvisioningController:
     def _cached(self, cache: dict, key: tuple, build):
         solver = cache.get(key)
         if solver is None:
-            solver = build()
+            # the evicted predecessor donates its static state (grid layout
+            # + group-encode folds) to the replacement — an ICE-only catalog
+            # change then skips the grid/encode rebuild entirely
+            old = next(iter(cache.values()), None)
+            solver = build(old)
             cache.clear()  # one resident grid per backend is enough in-process
             cache[key] = solver
         return solver
@@ -207,16 +211,23 @@ class ProvisioningController:
         key = self._content_key(catalog, provisioners)
 
         def run_primary():
-            def build():
+            def build(old):
                 self.solver_rebuilds += 1
-                return self._solver_factory(catalog, provisioners)
+                s = self._solver_factory(catalog, provisioners)
+                if old is not None and hasattr(s, "adopt_static"):
+                    s.adopt_static(old)
+                return s
             solver = self._cached(self._solver_cache, key, build)
             return solver.solve(pods, existing=existing,
                                 daemon_overhead=overhead)
 
         def run_native():
-            solver = self._cached(self._native_cache, key,
-                                  lambda: NativeSolver(catalog, provisioners))
+            def build(old):
+                s = NativeSolver(catalog, provisioners)
+                if old is not None:
+                    s.adopt_static(old)  # ICE-only change: reuse static grid
+                return s
+            solver = self._cached(self._native_cache, key, build)
             return solver.solve(pods, existing=existing,
                                 daemon_overhead=overhead)
 

@@ -149,18 +149,28 @@ class OptionGrid:
     catalog: Catalog
     zones: "list[str]"
     capacity_types: "list[str]"
-    options: "list[Optional[Option]]"  # length T*S, None where no offering
-    valid: np.ndarray  # bool [T, S]
+    options: "list[Optional[Option]]"  # length T*S, None where no offering DEFINED
+    valid: np.ndarray  # bool [T, S] — offering defined AND currently available
     price: np.ndarray  # f32 [T, S]
     tiebreak: np.ndarray  # i32 [T, S], rank in (price, spot-first, name, zone) order
     alloc_t: np.ndarray  # i32 [T, R]
     seqnum: int
     cols: "Optional[GridCols]" = None  # lazily built label columns
+    layout_key: int = 0  # availability-independent content fingerprint
 
     def get_cols(self) -> "GridCols":
         if self.cols is None:
             self.cols = build_cols(self)
         return self.cols
+
+    def active_zones(self) -> "list[str]":
+        """Zones with at least one AVAILABLE option — the zone-spread
+        universe, which must match the oracle's (it builds options from
+        available offerings only; build_options, oracle/scheduler.py)."""
+        C = len(self.capacity_types)
+        v = self.valid.reshape(self.T, len(self.zones), C)
+        act = v.any(axis=(0, 2))
+        return [z for zi, z in enumerate(self.zones) if act[zi]]
 
     @property
     def T(self):
@@ -174,10 +184,46 @@ class OptionGrid:
         return [o for o in self.options if o is not None]
 
 
-def build_grid(catalog: Catalog) -> OptionGrid:
-    # available offerings only: must match the oracle's build_options zone
-    # universe, or zone-spread pre-passes diverge between the two paths
-    zones = sorted({o.zone for t in catalog.types for o in t.offerings if o.available})
+def grid_layout_key(catalog: Catalog) -> int:
+    """Fingerprint of everything a grid depends on EXCEPT offering
+    availability: type names/labels/allocatables and the defined offering
+    lattice with prices. ICE marks (and expiries) flip only availability,
+    so two catalogs with equal layout keys share every static grid array —
+    the spot-storm fast path (an ICE seqnum bump then costs a [T,S] mask
+    refresh instead of a full grid + group-encode rebuild)."""
+    return hash(tuple(
+        (t.name, tuple(sorted(t.labels_dict().items())),
+         tuple(int(a) for a in t.allocatable_vector()),
+         tuple(sorted((o.zone, o.capacity_type, float(o.price))
+                      for o in t.offerings)))
+        for t in catalog.types))
+
+
+def build_grid(catalog: Catalog,
+               reuse: "Optional[OptionGrid]" = None) -> OptionGrid:
+    """Build the option lattice over every DEFINED offering; `valid` carries
+    current availability separately. The zone-spread universe the oracle
+    must agree on comes from active_zones() (available only), not from the
+    static `zones` axis. When `reuse` has the same layout_key, its static
+    arrays (options, price, tiebreak, alloc_t, label cols) are shared and
+    only `valid` is recomputed."""
+    key = grid_layout_key(catalog)
+    if reuse is not None and reuse.layout_key == key:
+        S = reuse.S
+        valid = np.zeros_like(reuse.valid)
+        zi_of = {z: i for i, z in enumerate(reuse.zones)}
+        ci_of = {c: i for i, c in enumerate(reuse.capacity_types)}
+        for ti, t in enumerate(catalog.types):
+            for o in t.offerings:
+                if o.available:
+                    si = zi_of[o.zone] * len(reuse.capacity_types) \
+                        + ci_of[o.capacity_type]
+                    valid[ti, si] = True
+        return OptionGrid(catalog, reuse.zones, reuse.capacity_types,
+                          reuse.options, valid, reuse.price, reuse.tiebreak,
+                          reuse.alloc_t, catalog.seqnum, cols=reuse.cols,
+                          layout_key=key)
+    zones = sorted({o.zone for t in catalog.types for o in t.offerings})
     cts = list(wk.CAPACITY_TYPES)  # on-demand, spot
     T, S = len(catalog.types), len(zones) * len(cts)
     options: "list[Optional[Option]]" = [None] * (T * S)
@@ -186,24 +232,27 @@ def build_grid(catalog: Catalog) -> OptionGrid:
     alloc_t = np.zeros((T, wk.NUM_RESOURCES), dtype=np.int32)
     for ti, t in enumerate(catalog.types):
         alloc_t[ti] = np.minimum(t.allocatable_vector(), INT_BIG)
-        avail = {(o.zone, o.capacity_type): o for o in t.offerings if o.available}
+        defined = {(o.zone, o.capacity_type): o for o in t.offerings}
         for zi, z in enumerate(zones):
             for ci, ct in enumerate(cts):
-                o = avail.get((z, ct))
+                o = defined.get((z, ct))
                 if o is None:
                     continue
                 si = zi * len(cts) + ci
                 flat = ti * S + si
                 options[flat] = Option(flat, t, z, ct, o.price, tuple(int(a) for a in alloc_t[ti]))
-                valid[ti, si] = True
+                valid[ti, si] = o.available
                 price[ti, si] = o.price
-    # tiebreak rank: identical key to Option.sort_key (oracle decision order)
+    # tiebreak rank: identical key to Option.sort_key (oracle decision
+    # order). Ranking over the DEFINED universe preserves the relative
+    # order of the available subset the oracle ranks; the kernel compares
+    # ranks only within availability-masked feasible sets.
     tiebreak = np.full((T, S), INT_BIG, dtype=np.int32)
     ranked = sorted((o for o in options if o is not None), key=Option.sort_key)
     for rank, o in enumerate(ranked):
         tiebreak[o.index // S, o.index % S] = rank
     return OptionGrid(catalog, zones, cts, options, valid, price, tiebreak,
-                      alloc_t, catalog.seqnum)
+                      alloc_t, catalog.seqnum, layout_key=key)
 
 
 def kubelet_arrays(
@@ -280,10 +329,13 @@ def encode_problem(
     dominant per-group cost (the reference memoizes the analogous
     instance-type construction, instancetypes.go:104-120)."""
     if grid is None or grid.seqnum != catalog.seqnum:
-        grid = build_grid(catalog)
+        grid = build_grid(catalog, reuse=grid)
     provs = sorted(provisioners, key=lambda p: (-p.weight, p.name))
     overhead = list(daemon_overhead or [0] * wk.NUM_RESOURCES)
-    groups = prepare_groups(pods, grid.zones, existing)
+    # zone-spread universe = zones with AVAILABLE options (parity with the
+    # oracle's available-offering universe; the grid's static zone axis
+    # spans all DEFINED offerings)
+    groups = prepare_groups(pods, grid.active_zones(), existing)
     G, Pv, T, S = len(groups), len(provs), grid.T, grid.S
     R = wk.NUM_RESOURCES
 
@@ -312,10 +364,21 @@ def encode_problem(
         group_origin[gi] = first_by_origin.setdefault(g.spec.origin_key(), gi)
 
     cols = grid.get_cols()
-    if group_cache is not None and group_cache.get("seqnum") != grid.seqnum:
-        group_cache.clear()
-        group_cache["seqnum"] = grid.seqnum
-        group_cache["entries"] = {}
+    if group_cache is not None:
+        # two-level invalidation: the STATIC level (requirement folds over
+        # the defined universe) survives ICE seqnum churn and clears only
+        # on layout changes; the FINAL level (availability folded in) is
+        # per-seqnum. A spot storm then costs cheap mask ANDs per group,
+        # not a re-fold (the reference's analogous split is the seqnum-
+        # keyed ICE cache atop the long-lived instance-type cache,
+        # instancetypes.go:104-120 + unavailableofferings.go:31-80).
+        if group_cache.get("layout") != grid.layout_key:
+            group_cache.clear()
+            group_cache["layout"] = grid.layout_key
+            group_cache["static"] = {}
+        if group_cache.get("seqnum") != grid.seqnum:
+            group_cache["seqnum"] = grid.seqnum
+            group_cache["entries"] = {}
     ovh_key = tuple(overhead)
     for gi, g in enumerate(groups):
         entry = None
@@ -324,12 +387,20 @@ def encode_problem(
             ck = (g.spec.group_key(), ovh_key)
             entry = group_cache["entries"].get(ck)
         if entry is None:
-            entry = encode_group(
-                g, provs, grid, cols, overhead,
-                prov_overhead=prov_overhead, prov_pods_cap=prov_pods_cap)
+            static = group_cache["static"].get(ck) if ck is not None else None
+            if static is None:
+                static = encode_group_static(
+                    g, provs, grid, cols, overhead,
+                    prov_overhead=prov_overhead, prov_pods_cap=prov_pods_cap)
+                if ck is not None:
+                    statics = group_cache["static"]
+                    if len(statics) > 2048:  # bound churny-workload growth
+                        statics.clear()
+                    statics[ck] = static
+            entry = combine_group(static, grid.valid)
             if ck is not None:
                 entries = group_cache["entries"]
-                if len(entries) > 2048:  # bound churny-workload growth
+                if len(entries) > 2048:
                     entries.clear()
                 entries[ck] = entry
         vec, cap, feas, newprov = entry
@@ -405,28 +476,38 @@ def encode_problem(
     )
 
 
-def encode_group(
+@dataclasses.dataclass
+class GroupStatic:
+    """Availability-independent encode of one pod group: valid as long as
+    the grid LAYOUT (types/labels/allocs/defined offerings) is unchanged,
+    i.e. across ICE seqnum bumps. combine_group folds current availability
+    in — together they are bit-identical to the one-shot encode_group."""
+
+    vec: np.ndarray  # i32 [R]
+    cap: int
+    n_provs: int
+    # (pi, base mask [T,S] pre-availability, pref masks in k-descending
+    # relaxation order, each pre-availability)
+    per_prov: "list[tuple[int, np.ndarray, list[np.ndarray]]]"
+
+
+def encode_group_static(
     group: PodGroup,
     provs: "list[Provisioner]",
     grid: OptionGrid,
     cols: GridCols,
     overhead: Sequence[int],
-    extra_mask: Optional[np.ndarray] = None,
     prov_overhead: Optional[np.ndarray] = None,
     prov_pods_cap: Optional[np.ndarray] = None,
-) -> "tuple[np.ndarray, int, np.ndarray, int]":
-    """One pod group -> (vec [R], cap, feas [Pv,T,S], newprov).
-
-    The single source of the admission rule (tolerations ∧ requirements ∧
-    fresh-node capacity ∧ optional extra option mask) shared by provisioning
-    (encode_problem) and consolidation (ops/consolidate.py) — the two must
-    stay bit-identical for kernel/oracle parity."""
+) -> GroupStatic:
+    """The fold half of the admission rule (tolerations ∧ requirements ∧
+    fresh-node capacity) over the DEFINED option universe — everything
+    except current offering availability."""
     T, S = grid.T, grid.S
     vec = np.minimum(group.vector, INT_BIG).astype(np.int32)
     cap = _group_cap_per_node(group.spec)
     cap = INT_BIG if cap is None else cap
-    feas = np.zeros((len(provs), T, S), dtype=bool)
-    newprov = -1
+    per_prov: "list[tuple[int, np.ndarray, list[np.ndarray]]]" = []
     ovh = np.asarray(overhead, dtype=np.int64)
     alloc64 = grid.alloc_t.astype(np.int64)
     vec64 = vec.astype(np.int64)
@@ -449,13 +530,17 @@ def encode_group(
             if prov_pods_cap is not None:
                 fits_t &= (prov_pods_cap[pi].astype(np.int64)
                            - ovh_p[pods_i] - vec64[pods_i] >= 0)
-        mask = fold_option_mask(reqs, cols, prov).reshape(T, S) & fits_t[:, None]
-        if extra_mask is not None:
-            mask = mask & extra_mask
-        if mask.any() and group.spec.preferences:
+        base = fold_option_mask(reqs, cols, prov).reshape(T, S) & fits_t[:, None]
+        prefs: "list[np.ndarray]" = []
+        if base.any() and group.spec.preferences:
+            # empty base can only stay empty under availability ANDs, so
+            # prefix folds would never be consulted — skip them (the old
+            # one-shot encode gated the relaxation the same way)
             # iterative preference relaxation — mirrors the oracle's
             # feasible_options exactly (PodSpec.preferences docstring):
-            # largest satisfiable prefix of weight-ordered terms wins
+            # largest satisfiable prefix of weight-ordered terms wins;
+            # satisfiability depends on availability, so the prefix masks
+            # are stored and the CHOICE happens in combine_group
             for k in range(len(group.spec.preferences), 0, -1):
                 try:
                     pref_reqs = reqs
@@ -463,18 +548,56 @@ def encode_group(
                         pref_reqs = pref_reqs.union(term)
                 except IncompatibleError:
                     continue
-                pref_mask = (fold_option_mask(pref_reqs, cols, prov)
+                prefs.append(fold_option_mask(pref_reqs, cols, prov)
                              .reshape(T, S) & fits_t[:, None])
-                if extra_mask is not None:
-                    pref_mask = pref_mask & extra_mask
-                if pref_mask.any():
-                    mask = pref_mask
+        per_prov.append((pi, base, prefs))
+    return GroupStatic(vec, cap, len(provs), per_prov)
+
+
+def combine_group(
+    static: GroupStatic, avail: np.ndarray,
+) -> "tuple[np.ndarray, int, np.ndarray, int]":
+    """Fold current availability (grid.valid, optionally ∧ an extra option
+    mask) into a static group encode -> (vec, cap, feas [Pv,T,S], newprov)."""
+    T, S = avail.shape
+    feas = np.zeros((static.n_provs, T, S), dtype=bool)
+    newprov = -1
+    for pi, base, prefs in static.per_prov:
+        mask = base & avail
+        if mask.any() and prefs:
+            for pm in prefs:  # k-descending; largest satisfiable prefix wins
+                m2 = pm & avail
+                if m2.any():
+                    mask = m2
                     break
         if mask.any():
             feas[pi] = mask
             if newprov < 0:
                 newprov = pi
-    return vec, cap, feas, newprov
+    return static.vec, static.cap, feas, newprov
+
+
+def encode_group(
+    group: PodGroup,
+    provs: "list[Provisioner]",
+    grid: OptionGrid,
+    cols: GridCols,
+    overhead: Sequence[int],
+    extra_mask: Optional[np.ndarray] = None,
+    prov_overhead: Optional[np.ndarray] = None,
+    prov_pods_cap: Optional[np.ndarray] = None,
+) -> "tuple[np.ndarray, int, np.ndarray, int]":
+    """One pod group -> (vec [R], cap, feas [Pv,T,S], newprov).
+
+    The single source of the admission rule (tolerations ∧ requirements ∧
+    fresh-node capacity ∧ availability ∧ optional extra option mask) shared
+    by provisioning (encode_problem) and consolidation (ops/consolidate.py)
+    — the two must stay bit-identical for kernel/oracle parity."""
+    static = encode_group_static(group, provs, grid, cols, overhead,
+                                 prov_overhead=prov_overhead,
+                                 prov_pods_cap=prov_pods_cap)
+    avail = grid.valid if extra_mask is None else (grid.valid & extra_mask)
+    return combine_group(static, avail)
 
 
 def _ex_label_fit(e: ExistingNode, spec: PodSpec) -> bool:

@@ -61,7 +61,10 @@ def _grid_for(catalog: Catalog, grid: "Optional[OptionGrid]") -> OptionGrid:
     m = _grid_memo
     if m is not None and m[0]() is catalog and m[1] == catalog.seqnum:
         return m[2]
-    g = build_grid(catalog)
+    # a caller-held or memoized stale grid can still donate its static
+    # arrays when only availability changed (build_grid layout check)
+    g = build_grid(catalog, reuse=grid if grid is not None
+                   else (m[2] if m is not None else None))
     _grid_memo = (_weakref.ref(catalog), catalog.seqnum, g)
     return g
 
@@ -103,7 +106,10 @@ def encode_consolidation(
                           else [0] * wk.NUM_RESOURCES, dtype=np.int32)
     cols = grid.get_cols()
     T, S, R, Pv = grid.T, grid.S, wk.NUM_RESOURCES, len(provs)
-    price = grid.price  # [T, S], inf where invalid
+    # [T, S]; inf only where NO offering is defined — unavailable offerings
+    # carry real prices on the static grid, so every price test must mask
+    # with grid.valid
+    price = grid.price
 
     if cand_sets is None:
         cand_sets = [(cluster.nodes[name],) for name in sorted(cluster.nodes)
@@ -142,7 +148,10 @@ def encode_consolidation(
         total_price = sum(n.price for n in cand)
         hit = by_price.get(total_price)
         if hit is None:
-            cheaper_opt = price < (total_price - REPLACE_PRICE_EPS)  # [T, S]
+            # AND with availability: the static grid carries real prices on
+            # unavailable options (old grids encoded them as inf)
+            cheaper_opt = (price < (total_price - REPLACE_PRICE_EPS)) \
+                & grid.valid  # [T, S]
             zs = {grid.zones[s // len(grid.capacity_types)]
                   for t, s in zip(*np.nonzero(cheaper_opt))}
             hit = by_price[total_price] = (cheaper_opt, sorted(zs))

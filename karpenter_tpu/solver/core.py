@@ -96,21 +96,47 @@ class TPUSolver:
     per-solve group delta crosses the host-device boundary (SURVEY.md §7.3
     "ship only the pod delta")."""
 
-    def __init__(self, catalog: Catalog, provisioners: Sequence[Provisioner]):
+    def __init__(self, catalog: Catalog, provisioners: Sequence[Provisioner],
+                 reuse_from: "Optional[TPUSolver]" = None):
         self.catalog = catalog
         self.provisioners = list(provisioners)
         self._grid: Optional[OptionGrid] = None
+        self._donor_grid: Optional[OptionGrid] = None
         self._dev_alloc_t = None
         self._dev_tiebreak = None
         # encode_group memo across solves (this instance's provisioner set is
-        # fixed; the grid seqnum keys invalidation — see encode_problem)
+        # fixed; layout/seqnum two-level invalidation — see encode_problem)
         self._group_cache: dict = {}
+        if reuse_from is not None:
+            self.adopt_static(reuse_from)
+
+    def adopt_static(self, other: "TPUSolver") -> None:
+        """An evicted predecessor (solver caches rebuild on catalog content
+        changes) donates its grid + group cache: when only availability
+        changed (ICE churn), build_grid shares every static array and the
+        cache's static level stays warm. The donation is a build_grid REUSE
+        DONOR only, never installed as the live grid — seqnums are
+        per-catalog counters (two distinct catalogs can share a seqnum), so
+        only build_grid's layout_key check may decide what is reusable. The
+        donated cache is layout-keyed internally, so adoption is safe even
+        when the layout DID change (it just clears)."""
+        if not isinstance(other, TPUSolver):
+            return
+        self._donor_grid = other._grid or other._donor_grid
+        self._dev_alloc_t = other._dev_alloc_t
+        self._dev_tiebreak = other._dev_tiebreak
+        if list(other.provisioners) == self.provisioners:
+            self._group_cache = other._group_cache
 
     def grid(self) -> OptionGrid:
         if self._grid is None or self._grid.seqnum != self.catalog.seqnum:
-            self._grid = build_grid(self.catalog)
-            self._dev_alloc_t = jax.device_put(self._grid.alloc_t)
-            self._dev_tiebreak = jax.device_put(self._grid.tiebreak)
+            old = self._grid or self._donor_grid
+            self._donor_grid = None
+            self._grid = build_grid(self.catalog, reuse=old)
+            if old is None or self._grid.alloc_t is not old.alloc_t \
+                    or self._dev_alloc_t is None:
+                self._dev_alloc_t = jax.device_put(self._grid.alloc_t)
+                self._dev_tiebreak = jax.device_put(self._grid.tiebreak)
         return self._grid
 
     def solve(
@@ -396,7 +422,11 @@ class NativeSolver(TPUSolver):
 
     def grid(self) -> OptionGrid:
         if self._grid is None or self._grid.seqnum != self.catalog.seqnum:
-            self._grid = build_grid(self.catalog)  # host-only: no device_put
+            # host-only: no device_put; a stale or donated grid is only a
+            # build_grid reuse donor (layout_key decides, never seqnum)
+            old = self._grid or self._donor_grid
+            self._donor_grid = None
+            self._grid = build_grid(self.catalog, reuse=old)
         return self._grid
 
     def _solve_once(

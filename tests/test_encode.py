@@ -121,3 +121,148 @@ def test_group_pods_survives_intern_table_epoch_churn():
         assert len(got) == 2
     finally:
         podmod._GROUP_KEY_TABLE_MAX = saved
+
+
+# -- static grid + dynamic availability (ICE-churn fast path) ----------------
+
+def _ice_flip(catalog, type_name, zone, ct, available=False):
+    """Clone-free availability flip + seqnum bump (what InstanceTypeProvider
+    does on an ICE mark, minus the object rebuild)."""
+    import dataclasses
+
+    for ti, t in enumerate(catalog.types):
+        if t.name != type_name:
+            continue
+        offs = tuple(
+            dataclasses.replace(o, available=available)
+            if (o.zone == zone and o.capacity_type == ct) else o
+            for o in t.offerings)
+        catalog.types[ti] = dataclasses.replace(t, offerings=type(t.offerings)(offs))
+    catalog.seqnum += 1
+
+
+def test_grid_reuse_shares_static_arrays_on_ice_flip():
+    from karpenter_tpu.models.instancetype import Catalog
+
+    cat = Catalog(types=[
+        make_instance_type("a.large", cpu=4, memory="16Gi", od_price=0.2,
+                           spot_price=0.07),
+        make_instance_type("b.small", cpu=2, memory="4Gi", od_price=0.05,
+                           spot_price=0.02)])
+    g1 = build_grid(cat)
+    g1.get_cols()
+    _ice_flip(cat, "b.small", "zone-1a", "spot")
+    g2 = build_grid(cat, reuse=g1)
+    assert g2.layout_key == g1.layout_key
+    assert g2.tiebreak is g1.tiebreak and g2.price is g1.price
+    assert g2.alloc_t is g1.alloc_t and g2.cols is g1.cols
+    assert g2.seqnum == cat.seqnum != g1.seqnum
+    # exactly one option flipped off
+    assert g1.valid.sum() - g2.valid.sum() == 1
+    # a LAYOUT change (price move) must NOT reuse
+    cat.types[0] = __import__("dataclasses").replace(
+        cat.types[0], offerings=type(cat.types[0].offerings)(
+            tuple(__import__("dataclasses").replace(o, price=o.price * 2)
+                  for o in cat.types[0].offerings)))
+    cat.seqnum += 1
+    g3 = build_grid(cat, reuse=g2)
+    assert g3.layout_key != g2.layout_key
+    assert g3.tiebreak is not g2.tiebreak
+
+
+def test_group_cache_static_level_survives_ice_churn():
+    from karpenter_tpu.models.instancetype import Catalog
+
+    cat = Catalog(types=[
+        make_instance_type("a.large", cpu=4, memory="16Gi", od_price=0.2,
+                           spot_price=0.07)])
+    prov = Provisioner(name="default")
+    prov.set_defaults()
+    pods = [make_pod(f"p{i}", cpu="1", memory="1Gi") for i in range(8)]
+    cache = {}
+    grid = build_grid(cat)
+    encode_problem(cat, [prov], pods, grid=grid, group_cache=cache)
+    statics_before = dict(cache["static"])
+    assert statics_before, "static level should be populated"
+    _ice_flip(cat, "a.large", "zone-1a", "spot")
+    grid2 = build_grid(cat, reuse=grid)
+    enc = encode_problem(cat, [prov], pods, grid=grid2, group_cache=cache)
+    # the static folds were reused object-identically; final level refreshed
+    for k, v in statics_before.items():
+        assert cache["static"][k] is v
+    assert cache["seqnum"] == cat.seqnum
+    # and the ICE'd option is truly infeasible in the fresh final encode
+    zi = grid2.zones.index("zone-1a")
+    ci = grid2.capacity_types.index("spot")
+    si = zi * len(grid2.capacity_types) + ci
+    assert not enc.group_feas[:, :, 0, si].any()
+
+
+def test_fully_iced_zone_matches_oracle_zone_spread():
+    """A zone losing ALL availability must shrink the zone-spread universe
+    exactly like the oracle's (available-offering) universe — the static
+    grid keeps the zone on its axis, so the spread pre-pass must consult
+    active_zones, not the axis."""
+    from karpenter_tpu.models.instancetype import Catalog
+    from karpenter_tpu.models.pod import TopologySpreadConstraint
+    from karpenter_tpu.oracle.scheduler import Scheduler
+    from karpenter_tpu.solver.core import TPUSolver
+
+    cat = Catalog(types=[
+        make_instance_type("a.large", cpu=4, memory="16Gi", od_price=0.2,
+                           spot_price=0.07),
+        make_instance_type("b.large", cpu=8, memory="32Gi", od_price=0.4,
+                           spot_price=0.14)])
+    prov = Provisioner(name="default")
+    prov.set_defaults()
+    # ICE out zone-1c entirely (every type, both capacity types)
+    for t in list(cat.types):
+        for ct in ("spot", "on-demand"):
+            _ice_flip(cat, t.name, "zone-1c", ct)
+    grid = build_grid(cat)
+    assert "zone-1c" in grid.zones  # static axis keeps it
+    assert grid.active_zones() == ["zone-1a", "zone-1b"]
+    pods = [make_pod(f"s{i}", cpu="1", memory="2Gi",
+                     topology=(TopologySpreadConstraint(
+                         max_skew=1, topology_key=wk.LABEL_ZONE),))
+            for i in range(9)]
+    sched = Scheduler(cat, [prov])
+    oracle = sched.schedule(list(pods)).node_decisions(sched.options)
+    kernel = TPUSolver(cat, [prov]).solve(pods).decisions()
+    assert kernel == oracle
+    zones_used = {d[1] for d in kernel}
+    assert "zone-1c" not in zones_used
+
+
+def test_donated_grid_never_bypasses_content_check():
+    """Two distinct catalogs can share a seqnum (per-instance counters), so
+    an adopted predecessor grid must only ever be a build_grid reuse donor
+    — installing it as the live grid would serve the OLD catalog's prices
+    (reviewer repro, round 4)."""
+    from karpenter_tpu.models.instancetype import Catalog
+    from karpenter_tpu.solver.core import NativeSolver
+
+    cat_a = Catalog(types=[make_instance_type(
+        "a.large", cpu=4, memory="16Gi", od_price=0.2, spot_price=0.07)])
+    cat_b = Catalog(types=[make_instance_type(
+        "a.large", cpu=4, memory="16Gi", od_price=9.9, spot_price=3.3)])
+    assert cat_a.seqnum == cat_b.seqnum  # the hazard: equal counters
+    s_a = NativeSolver(cat_a, [])
+    g_a = s_a.grid()
+    s_b = NativeSolver(cat_b, [])
+    s_b.adopt_static(s_a)
+    g_b = s_b.grid()
+    assert g_b is not g_a
+    assert abs(float(g_b.price.max()) - 9.9) < 1e-4  # B's prices, not A's
+    # and an ICE-only successor still shares statics through donation
+    import dataclasses
+    cat_b2 = Catalog(types=[dataclasses.replace(
+        cat_b.types[0],
+        offerings=type(cat_b.types[0].offerings)(tuple(
+            dataclasses.replace(o, available=(o.capacity_type != "spot"))
+            for o in cat_b.types[0].offerings)))], seqnum=cat_b.seqnum + 1)
+    s_b2 = NativeSolver(cat_b2, [])
+    s_b2.adopt_static(s_b)
+    g_b2 = s_b2.grid()
+    assert g_b2.tiebreak is g_b.tiebreak  # layout match -> shared statics
+    assert g_b2.valid.sum() < g_b.valid.sum()
