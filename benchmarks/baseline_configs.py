@@ -17,8 +17,10 @@ Configs (BASELINE.json):
   5  pair sweep: multi-node consolidation over 64-node pair grids
   6  config 1's workload on the PRODUCTION routed backend (C++ scan)
   7  4x stress: 200k pods, same shape as 4 — beyond-reference scale point
+  8  ICE storm: p50 first-solve-after-an-ICE-mark at config-1 shape — the
+     static-grid fast path (docs/designs/bin-packing-kernel.md)
 
-Usage: python -m benchmarks.baseline_configs [--configs 0,1,...,7]
+Usage: python -m benchmarks.baseline_configs [--configs 0,1,...,8]
 """
 
 from __future__ import annotations
@@ -351,6 +353,52 @@ def config_5_pair_sweep() -> dict:
                         "savings_per_hour": round(action.savings, 4)}}}
 
 
+def config_8_ice_storm() -> dict:
+    """Spot-interruption storm: every message marks a pool unavailable,
+    bumping catalog content — the next cycle used to pay a full grid +
+    group-encode rebuild. Measures the p50 FIRST solve after each of a
+    series of ICE marks (fresh catalog object + donated solver per mark,
+    exactly the controller's solver-cache path), beside the same solver's
+    warm number. Reference analogue: the ICE cache is designed for
+    millisecond retries (website concepts _index.md:143,
+    unavailableofferings.go:31-80)."""
+    from karpenter_tpu.cache import UnavailableOfferings
+    from karpenter_tpu.providers.instancetypes import InstanceTypeProvider
+
+    src = generate_fleet_catalog()
+    ice = UnavailableOfferings()
+    provider = InstanceTypeProvider(src, ice, None)
+    prov = _provisioner(requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+    pods = _mixed_5k_pods()
+    catalog = provider.list(None)
+    solver = TPUSolver(catalog, [prov])
+    solver.solve(pods)
+    _, warm_ms = _timed_solve(solver, pods, repeats=3)
+    # storm: distinct spot pools marked one per cycle
+    spot_pools = [(t.name, o.zone) for t in catalog.types[:8]
+                  for o in t.offerings
+                  if o.capacity_type == "spot" and o.available][:6]
+    post_ice = []
+    for itype, zone in spot_pools:
+        ice.mark_unavailable("ICE", itype, zone, "spot")
+        cat2 = provider.list(None)
+        nxt = TPUSolver(cat2, [prov])
+        nxt.adopt_static(solver)
+        t0 = time.perf_counter()
+        result = nxt.solve(pods)
+        post_ice.append((time.perf_counter() - t0) * 1000)
+        assert result.unschedulable_count() == 0
+        solver = nxt
+    ms = statistics.median(post_ice)
+    return {"bench": "baseline_config", "config": 8, "name": "ice-storm-5k",
+            "ms": round(ms, 3), "nodes": len(result.nodes),
+            "detail": {"n_types": len(catalog.types),
+                       "marks": len(spot_pools),
+                       "warm_ms": round(warm_ms, 3),
+                       "post_ice_ms": [round(x, 2) for x in post_ice]}}
+
+
 CONFIGS = {
     0: config_0_inflate,
     1: config_1_mixed_5k,
@@ -360,6 +408,7 @@ CONFIGS = {
     5: config_5_pair_sweep,
     6: config_6_mixed_5k_routed,
     7: config_7_stress_200k,
+    8: config_8_ice_storm,
 }
 
 

@@ -82,7 +82,7 @@ def main(argv=None) -> int:
 
     prev = previous_record()
     results, rc1 = _run_json_lines(["benchmarks.interruption_bench"])
-    configs = "0,1,2,3,5,6" if args.skip_stress else "0,1,2,3,4,5,6,7"
+    configs = "0,1,2,3,5,6,8" if args.skip_stress else "0,1,2,3,4,5,6,7,8"
     more, rc2 = _run_json_lines(["benchmarks.baseline_configs",
                                  "--configs", configs])
     results += more

@@ -110,7 +110,8 @@ class TPUSolver:
         if reuse_from is not None:
             self.adopt_static(reuse_from)
 
-    def adopt_static(self, other: "TPUSolver") -> None:
+    def adopt_static(self, other: "TPUSolver",
+                     share_group_cache: bool = True) -> None:
         """An evicted predecessor (solver caches rebuild on catalog content
         changes) donates its grid + group cache: when only availability
         changed (ICE churn), build_grid shares every static array and the
@@ -119,14 +120,30 @@ class TPUSolver:
         per-catalog counters (two distinct catalogs can share a seqnum), so
         only build_grid's layout_key check may decide what is reusable. The
         donated cache is layout-keyed internally, so adoption is safe even
-        when the layout DID change (it just clears)."""
+        when the layout DID change (it just clears).
+
+        share_group_cache=False copies the static level into a fresh dict
+        instead of sharing the donor's — required when the donor STAYS LIVE
+        (the solver service LRU keeps it serving other clients; two solvers
+        mutating one cache dict would race and seqnum-thrash)."""
         if not isinstance(other, TPUSolver):
             return
         self._donor_grid = other._grid or other._donor_grid
         self._dev_alloc_t = other._dev_alloc_t
         self._dev_tiebreak = other._dev_tiebreak
-        if list(other.provisioners) == self.provisioners:
+        if list(other.provisioners) != self.provisioners:
+            return
+        if share_group_cache:
             self._group_cache = other._group_cache
+            return
+        try:
+            src = other._group_cache
+            layout = src.get("layout")
+            statics = dict(src.get("static") or {})
+        except RuntimeError:  # donor inserted concurrently mid-copy
+            return
+        if layout is not None:
+            self._group_cache = {"layout": layout, "static": statics}
 
     def grid(self) -> OptionGrid:
         if self._grid is None or self._grid.seqnum != self.catalog.seqnum:

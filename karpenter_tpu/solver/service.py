@@ -126,6 +126,17 @@ class SolverService:
                                    catalog_hash=cat_hash)
         catalog = wire.catalog_from_wire(request.catalog)
         solver = TPUSolver(catalog, provisioners)
+        # the most recent resident solver donates its static grid arrays +
+        # group-encode folds: an ICE-only catalog change (spot storms bump
+        # content per message) then skips the grid rebuild AND the device
+        # re-put of alloc/tiebreak — the layout check inside build_grid
+        # decides, so a real layout change still rebuilds from scratch
+        with self._lock:
+            donor, _, _ = self._mru()
+        if donor is not None:
+            # the donor keeps serving its own clients from the LRU: copy the
+            # static fold level rather than sharing the live cache dict
+            solver.adopt_static(donor, share_group_cache=False)
         # build + device-put the option grid OUTSIDE the lock so Health stays
         # responsive during catalog churn, then swap atomically
         solver.grid()

@@ -639,3 +639,52 @@ class TestRemoteConsolidation:
             client_mod.RemoteSolver.__init__ = orig
         assert action is not None and want is not None
         assert action.kind == want.kind and action.nodes == want.nodes
+
+
+def test_ice_resync_donates_static_grid():
+    """An ICE-only catalog change re-synced to the service must reuse the
+    resident solver's static grid arrays (the spot-storm fast path) while
+    a layout change must not."""
+    import dataclasses
+
+    from karpenter_tpu.solver import wire
+    from karpenter_tpu.solver.service import SolverService, pb
+
+    svc = SolverService()
+    cat = small_catalog()
+    provs = [default_provisioner()]
+    req = pb.SyncRequest(catalog=wire.catalog_to_wire(cat),
+                         provisioners=[wire.provisioner_to_wire(p)
+                                       for p in provs])
+    svc.Sync(req, None)
+    (s1, _), = list(svc._cache.values())
+    g1 = s1.grid()
+
+    iced = dataclasses.replace(cat, types=[
+        dataclasses.replace(t, offerings=type(t.offerings)(tuple(
+            dataclasses.replace(o, available=(o.capacity_type != "spot"))
+            for o in t.offerings)))
+        for t in cat.types], seqnum=cat.seqnum + 1)
+    req2 = pb.SyncRequest(catalog=wire.catalog_to_wire(iced),
+                          provisioners=[wire.provisioner_to_wire(p)
+                                        for p in provs])
+    svc.Sync(req2, None)
+    s2 = [s for s, _ in svc._cache.values() if s is not s1][0]
+    g2 = s2.grid()
+    assert g2.tiebreak is g1.tiebreak and g2.alloc_t is g1.alloc_t
+    assert g2.valid.sum() < g1.valid.sum()
+    # the donor's cache dict is NOT shared (it keeps serving its clients)
+    assert s2._group_cache is not s1._group_cache
+
+    # layout change (price move): no static sharing
+    moved = dataclasses.replace(cat, types=[
+        dataclasses.replace(t, offerings=type(t.offerings)(tuple(
+            dataclasses.replace(o, price=o.price * 2) for o in t.offerings)))
+        for t in cat.types], seqnum=cat.seqnum + 2)
+    req3 = pb.SyncRequest(catalog=wire.catalog_to_wire(moved),
+                          provisioners=[wire.provisioner_to_wire(p)
+                                        for p in provs])
+    svc.Sync(req3, None)
+    s3 = [s for s, _ in svc._cache.values()
+          if s is not s1 and s is not s2][0]
+    assert s3.grid().tiebreak is not g2.tiebreak
