@@ -51,6 +51,23 @@ pod_strategy = st.builds(
 )
 
 
+def pods_from_specs(specs, prefix=""):
+    """Expand pod_strategy spec dicts into pods (shared by the parity and
+    ICE-churn fuzz tests so their generators cannot diverge)."""
+    pods = []
+    for si, spec in enumerate(specs):
+        sel = {wk.LABEL_ZONE: spec["zone"]} if spec["zone"] else {}
+        if spec["capacity"]:
+            sel[wk.LABEL_CAPACITY_TYPE] = spec["capacity"]
+        topo = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),) \
+            if spec["spread"] else ()
+        for i in range(spec["count"]):
+            pods.append(make_pod(f"{prefix}g{si}-p{i}", cpu=spec["cpu"],
+                                 memory=spec["memory"], node_selector=dict(sel),
+                                 topology=topo))
+    return pods
+
+
 @settings(max_examples=25, deadline=None)
 @given(st.lists(pod_strategy, min_size=1, max_size=6))
 def test_fuzz_parity_kernel_vs_oracle(specs):
@@ -61,17 +78,7 @@ def test_fuzz_parity_kernel_vs_oracle(specs):
     prov = Provisioner(name="default", requirements=Requirements.of(
         (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
     prov.set_defaults()
-    pods = []
-    for si, spec in enumerate(specs):
-        sel = {wk.LABEL_ZONE: spec["zone"]} if spec["zone"] else {}
-        if spec["capacity"]:
-            sel[wk.LABEL_CAPACITY_TYPE] = spec["capacity"]
-        topo = (TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_ZONE),) \
-            if spec["spread"] else ()
-        for i in range(spec["count"]):
-            pods.append(make_pod(f"g{si}-p{i}", cpu=spec["cpu"],
-                                 memory=spec["memory"], node_selector=dict(sel),
-                                 topology=topo))
+    pods = pods_from_specs(specs)
     sched = Scheduler(catalog, [prov])
     oracle = sched.schedule(list(pods))
     kernel = TPUSolver(catalog, [prov]).solve(list(pods))
@@ -767,3 +774,63 @@ class TestSerdeFuzz:
         assert back.weight == p.weight
         assert back.limits == p.limits
         assert back.consolidation_enabled == p.consolidation_enabled
+
+
+# -- hypothesis: ICE-churn parity with a persistent solver + cache -----------------
+
+ice_step_strategy = st.builds(
+    dict,
+    # which pool flips this step (type x zone x ct), and to which state —
+    # expiry (re-available) is as load-bearing as marking: the static-grid
+    # fast path must track BOTH directions through the two-level cache
+    flip_type=st.integers(min_value=0, max_value=3),
+    flip_zone=st.sampled_from(["zone-1a", "zone-1b", "zone-1c"]),
+    flip_ct=st.sampled_from(["spot", "on-demand"]),
+    available=st.booleans(),
+    pods=st.lists(pod_strategy, min_size=1, max_size=3),
+)
+
+
+@settings(max_examples=15, deadline=None)
+@given(st.lists(ice_step_strategy, min_size=2, max_size=5))
+def test_fuzz_ice_churn_persistent_solver_matches_fresh_oracle(steps):
+    """A LONG-LIVED solver chain (each step's solver adopts the last, the
+    group cache's static level persisting across availability flips) must
+    decide identically to a FRESH oracle built from scratch every step —
+    the staleness trap the static-grid/dynamic-availability split could
+    introduce if any availability-dependent state leaked into the reused
+    layer."""
+    import dataclasses
+
+    catalog = battletest_catalog()
+    prov = Provisioner(name="default", requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+    prov.set_defaults()
+    solver = TPUSolver(catalog, [prov])
+    for si, step in enumerate(steps):
+        # flip one pool's availability on a FRESH catalog object (the
+        # provider rebuilds per seqnum the same way)
+        tname = catalog.types[step["flip_type"] % len(catalog.types)].name
+        new_types = []
+        for t in catalog.types:
+            if t.name != tname:
+                new_types.append(t)
+                continue
+            new_types.append(dataclasses.replace(t, offerings=type(t.offerings)(
+                tuple(dataclasses.replace(o, available=step["available"])
+                      if (o.zone == step["flip_zone"]
+                          and o.capacity_type == step["flip_ct"]) else o
+                      for o in t.offerings))))
+        catalog = Catalog(types=new_types, seqnum=catalog.seqnum + 1)
+        nxt = TPUSolver(catalog, [prov])
+        nxt.adopt_static(solver)
+        solver = nxt
+
+        pods = pods_from_specs(step["pods"], prefix=f"s{si}-")
+        sched = Scheduler(catalog, [prov])  # fresh spec, no reused state
+        oracle = sched.schedule(list(pods))
+        kernel = solver.solve(list(pods))
+        assert kernel.decisions() == oracle.node_decisions(sched.options), \
+            f"divergence at step {si} after flipping {tname}/" \
+            f"{step['flip_zone']}/{step['flip_ct']}->{step['available']}"
+        assert kernel.unschedulable_count() == len(oracle.unschedulable)
