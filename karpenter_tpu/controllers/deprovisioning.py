@@ -161,6 +161,13 @@ class DeprovisioningController:
         if not provisioners:
             return None
         eligible_provs = {p.name for p in provisioners}
+        # Mechanism 1 — Empty Node Consolidation (deprovisioning.md:74-77):
+        # entirely empty nodes delete in PARALLEL before any search. With
+        # consolidation enabled, ttlSecondsAfterEmpty is excluded by the
+        # API, so this is the ONLY reclaim path for empty nodes here.
+        empty_act = self._consolidate_empty_nodes(eligible_provs, now)
+        if empty_act is not None:
+            return empty_act
         # only nodes of consolidation-enabled provisioners are candidates;
         # build a view-cluster excluding others as candidates (still hosts)
         cluster = self.cluster
@@ -246,6 +253,47 @@ class DeprovisioningController:
         self._record_action(action, now)
         return action
 
+    # a just-launched node may be empty only because its workload has not
+    # bound yet (two-phase replace: pods rebind AFTER the old nodes evict);
+    # nodes younger than this are never mechanism-1 candidates — the
+    # analogue of the reference's node nomination protection
+    EMPTY_NODE_PROTECT_S = 180.0
+
+    def _consolidate_empty_nodes(self, eligible_provs: "set[str]",
+                                 now: float):
+        """Delete every entirely-empty consolidation-eligible node in one
+        parallel pass (mechanism 1, deprovisioning.md:75). PDB/eviction
+        checks are moot (no resident pods); the do-not-consolidate veto and
+        initialization gate still apply. Skipped entirely while pods are
+        PENDING: in-flight (re)scheduling may be about to claim exactly
+        this capacity, and deleting it forces a relaunch loop."""
+        from ..oracle.consolidation import ANNOTATION_DO_NOT_CONSOLIDATE
+        from ..oracle.consolidation import ConsolidationAction
+
+        if self.kube.pending_pods():
+            return None
+        empties = []
+        for name in sorted(self.cluster.nodes):
+            node = self.cluster.nodes[name]
+            if (node.marked_for_deletion or not node.initialized
+                    or not node.is_empty()
+                    or node.provisioner_name not in eligible_provs
+                    or now - node.created_ts < self.EMPTY_NODE_PROTECT_S
+                    or node.annotations.get(
+                        ANNOTATION_DO_NOT_CONSOLIDATE) == "true"):
+                continue
+            empties.append(node)
+        if not empties:
+            return None
+        action = ConsolidationAction(
+            "delete", empties[0].name, 0.0,
+            savings=sum(n.price for n in empties),
+            nodes=tuple(n.name for n in empties))
+        if not self._mark_all_or_nothing(action):
+            return None
+        self._record_action(action, now, label="consolidation-delete-empty")
+        return action
+
     def _mark_all_or_nothing(self, action) -> bool:
         """Mark every node of the action for deletion, or none: a multi-node
         action executed partially would drain one node while claiming the
@@ -273,9 +321,9 @@ class DeprovisioningController:
                 newly_marked.append(n)
         return True
 
-    def _record_action(self, action, now: float) -> None:
+    def _record_action(self, action, now: float, label: str = "") -> None:
         suffix = "-multi" if len(action.nodes) > 1 else ""
-        self.actions.inc(action=f"consolidation-{action.kind}{suffix}")
+        self.actions.inc(action=label or f"consolidation-{action.kind}{suffix}")
         self.recorder.normal(
             f"node/{action.node}", "Consolidated",
             f"{action.kind} {','.join(action.nodes)}: "

@@ -330,6 +330,13 @@ def _decode_actions(batch: ConsolidationBatch, verdicts, now: float
             actions.append(ConsolidationAction(
                 "delete", names[0], cost, savings=total_price, nodes=names))
             continue
+        if any(n.capacity_type == wk.CAPACITY_TYPE_SPOT for n in cand):
+            # spot nodes consolidate by DELETION only — replacing with the
+            # now-cheapest offering would defeat capacity-optimized spot
+            # selection and raise interruption rates (reference
+            # website deprovisioning.md:88; mirrored in the oracle's
+            # evaluate_candidate_set)
+            continue
         flat = int(verdicts[ci, 2])
         if flat < 0:
             raise AssertionError(

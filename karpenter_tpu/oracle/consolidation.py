@@ -135,6 +135,15 @@ def evaluate_candidate_set(
     if not res.new_nodes:
         return ConsolidationAction("delete", ordered[0], cost,
                                    savings=total_price, nodes=ordered)
+    if any(n.capacity_type == wk.CAPACITY_TYPE_SPOT for n in nodes):
+        # spot nodes consolidate by DELETION only: replacing with the
+        # now-cheapest offering would defeat capacity-optimized spot
+        # selection and raise interruption rates (reference website
+        # deprovisioning.md:88 "It will not replace a spot node with a
+        # cheaper spot node"). Gating the outcome (not the universe)
+        # keeps the simulation identical to the non-spot path, so a
+        # delete verdict means the same thing either way.
+        return None
     claim = res.new_nodes[0]
     opt = claim.decided
     if opt.price >= total_price - REPLACE_PRICE_EPS:

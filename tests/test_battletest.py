@@ -125,6 +125,9 @@ cnode_strategy = st.builds(
     dict,
     type_idx=st.integers(min_value=0, max_value=3),
     zone=st.sampled_from(["zone-1a", "zone-1b"]),
+    # spot nodes take the delete-only consolidation path (reference
+    # deprovisioning.md:88) — the fuzz must cover both gates
+    capacity=st.sampled_from(["on-demand", "spot"]),
     pods=st.lists(
         st.builds(dict,
                   cpu=st.sampled_from(["100m", "500m", "1", "2", "3"]),
@@ -142,17 +145,21 @@ def build_consolidation_cluster(catalog, nodespecs):
     cluster = ClusterState()
     for ni, nspec in enumerate(nodespecs):
         itype = catalog.types[nspec["type_idx"]]
+        ct = nspec.get("capacity", "on-demand")
+        price = next((o.price for o in itype.offerings
+                      if o.capacity_type == ct and o.zone == nspec["zone"]),
+                     itype.offerings[0].price)
         pods = [make_pod(f"c{ni}-p{pi}", cpu=p["cpu"], memory=p["memory"],
                          node_name=f"cn-{ni:02d}", do_not_evict=p["pinned"])
                 for pi, p in enumerate(nspec["pods"])]
         cluster.add_node(StateNode(
             name=f"cn-{ni:02d}",
             labels={**itype.labels_dict(), wk.LABEL_ZONE: nspec["zone"],
-                    wk.LABEL_CAPACITY_TYPE: "on-demand",
+                    wk.LABEL_CAPACITY_TYPE: ct,
                     wk.LABEL_PROVISIONER: "default"},
             allocatable=itype.allocatable_vector(),
             instance_type=itype.name, zone=nspec["zone"],
-            capacity_type="on-demand", price=itype.offerings[0].price,
+            capacity_type=ct, price=price,
             provisioner_name="default", pods=pods,
             marked_for_deletion=nspec["marked"]))
     return cluster

@@ -295,3 +295,61 @@ def test_do_not_consolidate_annotation_vetoes_candidacy():
     # oracle spec agrees
     o = find_consolidation(cluster, cat, [p])
     assert o is None or victim not in o.nodes
+
+
+def _spot_node(name, cpu_alloc, price, pods, itype="large.8x", **kw):
+    n = node(name, cpu_alloc, price, pods, itype=itype, **kw)
+    n.capacity_type = "spot"
+    n.labels[wk.LABEL_CAPACITY_TYPE] = "spot"
+    return n
+
+
+def test_spot_node_never_replaced_only_deleted():
+    """Reference deprovisioning.md:88: spot nodes consolidate by deletion
+    only — a cheaper replacement must NOT be launched for them."""
+    cluster = ClusterState()
+    # lone big spot node: the on-demand twin of this shape yields `replace`
+    # (test_replace_with_cheaper_node); spot must yield nothing
+    cluster.add_node(_spot_node("big", 8, 0.40,
+                                [make_pod("a", cpu="1", memory="1Gi")]))
+    act = _assert_parity(cluster, catalog(), [prov()])
+    assert act is None
+
+
+def test_spot_node_delete_path_still_works():
+    cluster = ClusterState()
+    cluster.add_node(_spot_node("spot-a", 8, 0.40,
+                                [make_pod("a", cpu="1", memory="1Gi")]))
+    cluster.add_node(node("host", 8, 0.40, []))
+    act = _assert_parity(cluster, catalog(), [prov()])
+    assert act is not None and act.kind == "delete"
+    assert act.nodes == ("spot-a",) or act.nodes == ("host",)
+
+
+def test_pair_with_spot_member_cannot_replace():
+    """The multi-node extension inherits the delete-only rule when ANY set
+    member is spot (consistent extrapolation of the reference rule)."""
+    cluster = ClusterState()
+    # the on-demand version of this cluster produces a pair replace
+    # (test_pair_replace_when_singles_fail idiom): two half-full nodes whose
+    # combined pods fit one cheaper node
+    def build(spot_first):
+        # the test_pair_replace_when_singles_fail shape: two FULL large.8x
+        # nodes whose combined pods fit one xlarge.16x
+        c = ClusterState()
+        for ni in range(2):
+            pods = [make_pod(f"p{ni}-{i}", cpu="1", memory="1Gi",
+                             node_name=f"n-{ni}") for i in range(8)]
+            mk = _spot_node if (spot_first and ni == 0) else node
+            c.add_node(mk(f"n-{ni}", 8, 0.40, pods))
+        return c
+
+    # the all-on-demand twin DOES pair-replace — proving the gate is what
+    # suppresses the action below
+    twin = find_multi_consolidation(build(False), pair_catalog(), [prov()])
+    assert twin is not None and twin.kind == "replace"
+    cluster = build(True)
+    o = find_multi_consolidation(cluster, pair_catalog(), [prov()])
+    k = run_consolidation(cluster, pair_catalog(), [prov()])
+    assert o is None or o.kind != "replace"
+    assert k is None or k.kind != "replace"

@@ -865,3 +865,87 @@ def test_pod_annotation_update_reaches_live_node_pods(op):
     # deletion drops it from the resident list too
     op.kube.delete("pods", "w-0")
     assert not [p for p in live.pods if p.name == "w-0"]
+
+
+class TestEmptyNodeConsolidation:
+    """Mechanism 1 of consolidation (deprovisioning.md:74-77): entirely
+    empty nodes delete in parallel BEFORE any search. With consolidation
+    enabled, ttlSecondsAfterEmpty is API-excluded, so this is the only
+    reclaim path for empty nodes of such provisioners."""
+
+    def _empty_nodes(self, op, count):
+        """Launch `count` initialized nodes (anti-affinity forces one per
+        node), then remove their pods so all become empty."""
+        for i in range(count):
+            op.kube.create("pods", f"tmp-{i}", make_pod(
+                f"tmp-{i}", cpu="3", memory="3Gi",
+                anti_affinity_hostname=True))
+        op.provisioning.reconcile_once()
+        op.machinelifecycle.reconcile_once()
+        op.machinelifecycle.reconcile_once()
+        for i in range(count):
+            op.kube.delete("pods", f"tmp-{i}")
+        for n in op.cluster.nodes.values():
+            n.pods = [p for p in n.pods if not p.name.startswith("tmp-")]
+
+    def test_empty_nodes_deleted_in_parallel(self, op):
+        add_provisioner(op, consolidation_enabled=True)
+        self._empty_nodes(op, 2)
+        emptied = {n for n, v in op.cluster.nodes.items() if v.is_empty()}
+        assert len(emptied) >= 2
+        op.clock.step(600)
+        act = op.deprovisioning.reconcile_consolidation()
+        assert act is not None and act.kind == "delete"
+        assert set(act.nodes) == emptied, "ALL empties delete in one pass"
+        for _ in range(3):
+            op.termination.reconcile_once()
+            op.clock.step(5)
+        assert not (set(op.cluster.nodes) & emptied)
+
+    def test_do_not_consolidate_spares_empty_node(self, op):
+        add_provisioner(op, consolidation_enabled=True)
+        self._empty_nodes(op, 1)
+        (name,) = [n for n, v in op.cluster.nodes.items() if v.is_empty()]
+        op.cluster.nodes[name].annotations[
+            "karpenter.sh/do-not-consolidate"] = "true"
+        op.clock.step(600)
+        assert op.deprovisioning.reconcile_consolidation() is None
+        assert name in op.cluster.nodes
+
+    def test_young_empty_node_protected(self, op):
+        """A just-initialized empty node (e.g. the replacement of a
+        two-phase replace whose pods have not rebound yet) must survive
+        mechanism 1 until the launch-protection window passes."""
+        add_provisioner(op, consolidation_enabled=True)
+        self._empty_nodes(op, 1)
+        op.clock.step(60)  # < EMPTY_NODE_PROTECT_S
+        assert op.deprovisioning.reconcile_consolidation() is None
+        assert op.cluster.nodes
+        op.clock.step(600)  # window passed -> reclaimed
+        act = op.deprovisioning.reconcile_consolidation()
+        assert act is not None and act.kind == "delete"
+
+    def test_pending_pods_block_empty_delete(self, op):
+        """In-flight (re)scheduling may be about to claim the empty
+        capacity: mechanism 1 must not race it."""
+        add_provisioner(op, consolidation_enabled=True)
+        self._empty_nodes(op, 1)
+        op.clock.step(600)
+        op.kube.create("pods", "incoming", make_pod(
+            "incoming", cpu="64", memory="1Gi"))  # pending (fits nowhere)
+        assert op.deprovisioning.reconcile_consolidation() is None
+        assert op.cluster.nodes
+        op.kube.delete("pods", "incoming")
+        act = op.deprovisioning.reconcile_consolidation()
+        assert act is not None and act.kind == "delete"
+
+    def test_uninitialized_empty_node_spared(self, op):
+        add_provisioner(op, consolidation_enabled=True)
+        op.kube.create("pods", "tmp", make_pod("tmp", cpu="3", memory="3Gi"))
+        op.provisioning.reconcile_once()  # launched, NOT initialized
+        op.kube.delete("pods", "tmp")
+        for n in op.cluster.nodes.values():
+            n.pods.clear()
+        op.clock.step(600)
+        assert op.deprovisioning.reconcile_consolidation() is None
+        assert op.cluster.nodes
