@@ -217,15 +217,17 @@ class DeprovisioningController:
             method = "oracle"
             from ..oracle.consolidation import find_multi_consolidation
 
-            action = find_consolidation(cluster, catalog, all_provs,
-                                        now=self.clock.now(),
-                                        candidate_filter=cand_filter)
+            # mechanism order matches the reference (multi before single,
+            # deprovisioning.md:74-77); sequential pair simulation is
+            # O(pairs) scheduler runs, so cap hard (8 candidates -> <=28)
+            # on this fallback path
+            action = find_multi_consolidation(
+                cluster, catalog, all_provs, now=self.clock.now(),
+                max_candidates=8, candidate_filter=cand_filter)
             if action is None:
-                # sequential pair simulation is O(pairs) scheduler runs:
-                # cap hard (8 candidates -> <=28) on the fallback path
-                action = find_multi_consolidation(
-                    cluster, catalog, all_provs, now=self.clock.now(),
-                    max_candidates=8, candidate_filter=cand_filter)
+                action = find_consolidation(cluster, catalog, all_provs,
+                                            now=self.clock.now(),
+                                            candidate_filter=cand_filter)
         self.eval_duration.observe(_time.perf_counter() - t0, method=method)
         if action is None:
             return None

@@ -380,37 +380,31 @@ def run_consolidation(
     candidate_filter=None,
     mesh=None,
 ) -> Optional[ConsolidationAction]:
-    """Batched equivalent of oracle find_consolidation (bit-parity tested).
+    """Batched equivalent of the oracle search (bit-parity tested).
 
-    Single-node sweep first (reference semantics); when it yields nothing
-    and multi_node is set, a second vmapped dispatch evaluates node PAIRS —
-    the multi-node search designs/consolidation.md rules out as too
-    expensive to do sequentially. Both sweeps are one device dispatch each."""
+    Mechanism order matches the reference (deprovisioning.md:74-77,
+    v0.24.0): MULTI-NODE pairs decide before single-node — a bigger win
+    shadows a smaller one. Pair lanes and single lanes ride ONE combined
+    dispatch (one device round trip — the unit a tunneled link charges);
+    mechanism precedence is applied to the decoded verdicts instead of
+    sequencing two dispatches."""
+    provs_sorted = sorted(provisioners, key=lambda p: (-p.weight, p.name))
+    cand_nodes = [cluster.nodes[name] for name in sorted(cluster.nodes)
+                  if eligible(cluster.nodes[name], cluster)
+                  and (candidate_filter is None
+                       or candidate_filter(cluster.nodes[name]))]
+    if not cand_nodes:
+        return None
+    sets: "list[tuple]" = [(n,) for n in cand_nodes]
+    if multi_node:
+        sets = candidate_pairs(cluster, provs_sorted, now,
+                               max_pair_candidates, nodes=cand_nodes) + sets
     batch = encode_consolidation(cluster, catalog, provisioners,
-                                 daemon_overhead, grid,
-                                 candidate_filter=candidate_filter)
+                                 daemon_overhead, grid, cand_sets=sets)
     if batch is None:
         return None
-    verdicts = _verdicts(batch, mesh)
-    actions = _decode_actions(batch, verdicts, now)
-    if actions:
-        return min(actions, key=ConsolidationAction.sort_key)
-    if not multi_node:
-        return None
-    # reuse the singles sweep's eligibility result and option grid — no
-    # second eligible()/build_grid pass
-    pairs = candidate_pairs(cluster, batch.provisioners, now,
-                            max_pair_candidates,
-                            nodes=[c[0] for c in batch.candidates])
-    if not pairs:
-        return None
-    pair_batch = encode_consolidation(cluster, catalog, provisioners,
-                                      daemon_overhead, batch.grid,
-                                      cand_sets=pairs)
-    if pair_batch is None:
-        return None
-    pair_verdicts = _verdicts(pair_batch, mesh)
-    actions = _decode_actions(pair_batch, pair_verdicts, now)
+    actions = _decode_actions(batch, _verdicts(batch, mesh), now)
     if not actions:
         return None
-    return min(actions, key=ConsolidationAction.sort_key)
+    multi_actions = [a for a in actions if len(a.nodes) > 1]
+    return min(multi_actions or actions, key=ConsolidationAction.sort_key)

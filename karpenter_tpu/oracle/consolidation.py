@@ -251,9 +251,10 @@ def find_multi_consolidation(
     max_candidates: int = MAX_PAIR_CANDIDATES,
     candidate_filter=None,
 ) -> Optional[ConsolidationAction]:
-    """Best two-node action — the multi-node search designs/consolidation.md
-    rules out as too expensive sequentially. Run after the single-node search
-    returns nothing. NOTE: sequential simulation is O(pairs) scheduler runs;
+    """Best two-node action — mechanism 2 of consolidation, which the
+    reference runs BEFORE the single-node search (deprovisioning.md:74-77
+    at v0.24.0): a multi-node win shadows a single-node one.
+    NOTE: sequential simulation is O(pairs) scheduler runs;
     callers without the batched kernel should cap max_candidates hard (the
     controller's oracle fallback uses 8 -> <=28 simulations)."""
     actions = []

@@ -168,9 +168,10 @@ def build_consolidation_cluster(catalog, nodespecs):
 @settings(max_examples=10, deadline=None)
 @given(st.lists(cnode_strategy, min_size=2, max_size=6))
 def test_fuzz_multi_node_consolidation_parity(nodespecs):
-    """Full-chain parity incl. the PAIR sweep: when singles find nothing,
-    the batched pair grid must pick the same action as the oracle's
-    sequential find_multi_consolidation (or the same no-action)."""
+    """Full-chain parity incl. the PAIR sweep: the batched pair grid runs
+    FIRST (reference mechanism order) and must pick the same action as the
+    oracle's sequential find_multi_consolidation, falling back to the
+    single sweep identically (or the same no-action)."""
     from karpenter_tpu.ops.consolidate import run_consolidation
     from karpenter_tpu.oracle.consolidation import (find_consolidation,
                                                     find_multi_consolidation)
@@ -180,9 +181,9 @@ def test_fuzz_multi_node_consolidation_parity(nodespecs):
     prov = Provisioner(name="default", consolidation_enabled=True)
     prov.set_defaults()
     kernel = run_consolidation(cluster, catalog, [prov], multi_node=True)
-    oracle = find_consolidation(cluster, catalog, [prov])
+    oracle = find_multi_consolidation(cluster, catalog, [prov])
     if oracle is None:
-        oracle = find_multi_consolidation(cluster, catalog, [prov])
+        oracle = find_consolidation(cluster, catalog, [prov])
     assert (kernel is None) == (oracle is None), (kernel, oracle)
     if kernel is not None:
         assert (kernel.kind, kernel.nodes, kernel.replacement) == \

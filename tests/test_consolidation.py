@@ -48,10 +48,11 @@ def node(name, cpu_alloc, price, pods, itype="large.8x", **kw):
 
 
 def _assert_parity(cluster, cat, provs, now=0.0):
-    # oracle mirrors run_consolidation's policy: singles, then pairs
-    o = find_consolidation(cluster, cat, provs, now=now)
+    # oracle mirrors run_consolidation's policy: multi-node first, then
+    # singles (reference mechanism order, deprovisioning.md:74-77)
+    o = find_multi_consolidation(cluster, cat, provs, now=now)
     if o is None:
-        o = find_multi_consolidation(cluster, cat, provs, now=now)
+        o = find_consolidation(cluster, cat, provs, now=now)
     k = run_consolidation(cluster, cat, provs, now=now)
     if o is None:
         assert k is None, f"kernel found {k}, oracle none"
@@ -65,10 +66,30 @@ def _assert_parity(cluster, cat, provs, now=0.0):
 def test_delete_when_pods_fit_elsewhere():
     cluster = ClusterState()
     cluster.add_node(node("n1", 8, 0.40, [make_pod("a", cpu="1", memory="1Gi", node_name="n1")]))
-    cluster.add_node(node("n2", 8, 0.40, [make_pod("b", cpu="1", memory="1Gi", node_name="n2")]))
+    # n2 hosts a do-not-evict pod: it can HOST rescheduled pods but is not
+    # itself a candidate — so the multi-node mechanism (which runs FIRST,
+    # reference order) has <2 candidates and the single delete decides
+    cluster.add_node(node("n2", 8, 0.40, [make_pod("b", cpu="1", memory="1Gi",
+                                                   node_name="n2",
+                                                   do_not_evict=True)]))
     act = _assert_parity(cluster, catalog(), [prov()])
     assert act.kind == "delete"
     assert act.savings == 0.40
+
+
+def test_pair_action_shadows_single_delete():
+    """Reference mechanism order (deprovisioning.md:74-77): multi-node runs
+    BEFORE single-node, so two half-empty nodes consolidate into one
+    cheaper replacement even though a plain single delete also exists."""
+    cluster = ClusterState()
+    cluster.add_node(node("n1", 8, 0.40, [make_pod("a", cpu="1", memory="1Gi",
+                                                   node_name="n1")]))
+    cluster.add_node(node("n2", 8, 0.40, [make_pod("b", cpu="1", memory="1Gi",
+                                                   node_name="n2")]))
+    act = _assert_parity(cluster, catalog(), [prov()])
+    assert act.kind == "replace" and set(act.nodes) == {"n1", "n2"}
+    assert act.replacement[0] == "small.2x"
+    assert abs(act.savings - 0.70) < 1e-9
 
 
 def test_replace_with_cheaper_node():
@@ -91,12 +112,19 @@ def test_no_action_when_cluster_tight():
 
 def test_min_disruption_candidate_wins():
     cluster = ClusterState()
-    # both deletable; n-few has fewer pods -> lower disruption cost
-    big_pods = [make_pod(f"b{i}", cpu="100m", memory="128Mi") for i in range(10)]
-    few_pods = [make_pod("f0", cpu="100m", memory="128Mi")]
+    # both deletable; n-few has fewer pods -> lower disruption cost.
+    # A PDB allowing 10 evictions blocks the PAIR (11 pods at once) so the
+    # single-node mechanism decides — as in the reference, min-disruption
+    # ordering applies within a mechanism.
+    big_pods = [make_pod(f"b{i}", cpu="100m", memory="128Mi",
+                         labels=(("app", "d"),)) for i in range(10)]
+    few_pods = [make_pod("f0", cpu="100m", memory="128Mi",
+                         labels=(("app", "d"),))]
     cluster.add_node(node("n-big", 8, 0.40, big_pods))
     cluster.add_node(node("n-few", 8, 0.40, few_pods))
     cluster.add_node(node("n-host", 8, 0.40, []))
+    cluster.pdbs.append(PodDisruptionBudget("d-pdb", {"app": "d"},
+                                            max_unavailable=10))
     # host node empty => skipped as candidate (emptiness path), but hosts pods
     act = _assert_parity(cluster, catalog(), [prov()])
     assert act.node == "n-few"

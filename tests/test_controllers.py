@@ -224,8 +224,10 @@ class TestConsolidationLoop:
         from karpenter_tpu.models.cluster import StateNode
 
         add_provisioner(op, consolidation_enabled=True)
-        # seed two half-empty m.large nodes; one's pod fits on the other
-        for name, pods in (("n-1", ["a"]), ("n-2", ["b"])):
+        # two half-empty m.large nodes; n-2's pod is do-not-evict so it can
+        # only HOST (multi-node mechanism, which runs first, has <2
+        # candidates) and the single delete of n-1 decides
+        for name, pods, sticky in (("n-1", ["a"], False), ("n-2", ["b"], True)):
             node = StateNode(
                 name=name,
                 labels={wk.LABEL_ARCH: "amd64", wk.LABEL_OS: "linux",
@@ -236,7 +238,8 @@ class TestConsolidationLoop:
                                                 wk.RESOURCE_MEMORY: 16 * 2**30,
                                                 wk.RESOURCE_PODS: 110}),
                 price=0.20, provisioner_name="default",
-                pods=[make_pod(p, cpu="1", memory="2Gi", node_name=name)
+                pods=[make_pod(p, cpu="1", memory="2Gi", node_name=name,
+                               do_not_evict=sticky)
                       for p in pods],
             )
             op.cluster.add_node(node)
