@@ -70,6 +70,9 @@ class DeprovisioningController:
         # in-flight replace action: {"action", "replacement", "started_ts"}
         self._pending_replace: "Optional[dict]" = None
         self._last_action_ts: "Optional[float]" = None
+        # pods already awareness-logged for consolidation-blocking
+        # preferences (deprovisioning.md:40) — log once per pod
+        self._pref_logged: "set[str]" = set()
 
     def _prov(self, name: str):
         return next((p for p in self.kube.provisioners() if p.name == name), None)
@@ -161,6 +164,20 @@ class DeprovisioningController:
         if not provisioners:
             return None
         eligible_provs = {p.name for p in provisioners}
+        # awareness logging (deprovisioning.md:40): pods with soft scheduling
+        # preferences can prevent consolidation — surface each once so a
+        # "nothing consolidates" cluster is explicable without a debugger.
+        # The seen-set is rebuilt from the LIVE preference pods each pass,
+        # so deleted pods don't pin memory for the controller's lifetime
+        current_pref_pods = set()
+        for name in sorted(self.cluster.nodes):
+            for pod in self.cluster.nodes[name].non_daemon_pods():
+                if pod.preferences:
+                    current_pref_pods.add(pod.name)
+                    if pod.name not in self._pref_logged:
+                        log.info("pod %s has scheduling preferences which "
+                                 "can prevent consolidation", pod.name)
+        self._pref_logged = current_pref_pods
         # Mechanism 1 — Empty Node Consolidation (deprovisioning.md:74-77):
         # entirely empty nodes delete in PARALLEL before any search. With
         # consolidation enabled, ttlSecondsAfterEmpty is excluded by the

@@ -197,6 +197,16 @@ class InterruptionController:
                     f"interruption event for instance {iid}")
                 self.actions.inc(action=ACTION_CORDON_AND_DRAIN)
             else:
+                if node_name and msg.kind == KIND_REBALANCE:
+                    # rebalance recommendations surface on the node without
+                    # any action (deprovisioning.md:113). Benign state
+                    # changes stay silent — the reference's parser downgrades
+                    # non-stopping states to NoOp before events are emitted
+                    # (statechange/parser.go:27-38), and an event per
+                    # 'running' notification would spam every scale-up.
+                    self.recorder.normal(
+                        f"node/{node_name}", msg.kind,
+                        f"advisory interruption event for instance {iid}")
                 self.actions.inc(action=ACTION_NOOP)
         self.queue.delete(qmsg.receipt)
         self.deleted.inc()

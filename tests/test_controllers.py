@@ -195,6 +195,25 @@ class TestInterruption:
         assert op.interruption.received.value(
             message_type="NoOp") == noop_before + 2
 
+    def test_rebalance_recommendation_event_without_action(self, op):
+        """Advisory rebalance recommendations surface as node events but
+        never cordon/drain (reference deprovisioning.md:113)."""
+        add_provisioner(op)
+        op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+        op.provisioning.reconcile_once()
+        (node,) = op.cluster.nodes.values()
+        from karpenter_tpu.models.machine import parse_provider_id
+
+        _, iid = parse_provider_id(node.provider_id)
+        op.queue.send(json.dumps({
+            "source": "cloud.spot",
+            "detail-type": "Instance Rebalance Recommendation",
+            "detail": {"instance-id": iid},
+        }))
+        assert op.interruption.reconcile_once() == 1
+        assert not node.marked_for_deletion
+        assert op.recorder.by_reason("RebalanceRecommendation")
+
     def test_state_change_only_on_stopping_states(self, op):
         add_provisioner(op)
         op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
@@ -210,6 +229,9 @@ class TestInterruption:
         }))
         op.interruption.reconcile_once()
         assert not node.marked_for_deletion
+        # benign state changes are SILENT: no advisory node event (the
+        # reference's parser NoOps non-stopping states before events)
+        assert not op.recorder.by_reason("StateChange")
         op.queue.send(json.dumps({
             "source": "cloud.compute",
             "detail-type": "Instance State-change Notification",
