@@ -19,8 +19,9 @@ Configs (BASELINE.json):
   7  4x stress: 200k pods, same shape as 4 — beyond-reference scale point
   8  ICE storm: p50 first-solve-after-an-ICE-mark at config-1 shape — the
      static-grid fast path (docs/designs/bin-packing-kernel.md)
+  9  20x stress: 1M pods x 551 types in one sharded dispatch
 
-Usage: python -m benchmarks.baseline_configs [--configs 0,1,...,8]
+Usage: python -m benchmarks.baseline_configs [--configs 0,1,...,9]
 """
 
 from __future__ import annotations
@@ -226,6 +227,15 @@ def config_4_stress_50k() -> dict:
     return _stress_config(4, "stress-50k-sharded", 50_000, REPEATS)
 
 
+def config_9_stress_1m() -> dict:
+    """20x the 50k stress shape: one MILLION pending pods x 551 types in a
+    single sharded dispatch — far beyond any scale the sequential
+    reference's per-pod loop entertains (its own E2E ceiling is ~100-pod
+    utilization suites). Repeats kept low: the point is that the shape
+    fits and solves, the ladder's per-cycle numbers live in configs 1-7."""
+    return _stress_config(9, "stress-1m-sharded", 1_000_000, 2)
+
+
 def config_7_stress_200k() -> dict:
     """4x the reference-scale stress shape — beyond-reference scale point:
     200k pending pods solved in one sharded dispatch (the reference
@@ -409,6 +419,7 @@ CONFIGS = {
     6: config_6_mixed_5k_routed,
     7: config_7_stress_200k,
     8: config_8_ice_storm,
+    9: config_9_stress_1m,
 }
 
 

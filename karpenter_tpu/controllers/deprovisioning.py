@@ -171,6 +171,8 @@ class DeprovisioningController:
         # so deleted pods don't pin memory for the controller's lifetime
         current_pref_pods = set()
         for name in sorted(self.cluster.nodes):
+            if self.cluster.nodes[name].provisioner_name not in eligible_provs:
+                continue  # never a candidate: its pods can't block anything
             for pod in self.cluster.nodes[name].non_daemon_pods():
                 if pod.preferences:
                     current_pref_pods.add(pod.name)
