@@ -84,7 +84,33 @@ class GarbageCollectionController:
                      inst.id)
             reaped.append(inst.id)
         self._retire_vanished_machines({i.id for i in instances})
+        self._retire_orphaned_nodes(now)
         return reaped
+
+    def _retire_orphaned_nodes(self, now: float) -> None:
+        """Level-triggered backstop for the ownership cascade: a node whose
+        provisioner no longer EXISTS is terminated (reference
+        deprovisioning.md:22 — upstream gets this from node ownerReferences
+        + the apiserver's GC, which also catches deletions that raced a
+        node's registration or happened while the controller was down).
+        The launch grace window guards a node registering while its
+        provisioner create is still being admitted."""
+        if self.cluster is None or self.termination is None:
+            return
+        provs = {p.name for p in self.kube.provisioners()}
+        for name in sorted(self.cluster.nodes):
+            node = self.cluster.nodes.get(name)
+            if (node is None or node.marked_for_deletion
+                    or not node.provisioner_name
+                    or node.provisioner_name in provs):
+                continue
+            if now - node.created_ts < self.grace_seconds:
+                continue
+            verdict = self.termination.request_deletion(name)
+            if verdict == self.termination.MARKED_NEW:
+                self.retired.inc()
+                log.info("terminating orphaned node %s: provisioner %s "
+                         "no longer exists", name, node.provisioner_name)
 
     def _retire_vanished_machines(self, present: "set[str]") -> None:
         """Inverse direction: a store machine whose cloud instance is GONE

@@ -144,7 +144,7 @@ class Operator:
             lambda name: self.kube.get("nodetemplates", name))
         # PDBs flow kube -> cluster state via watch (single write path; the
         # deprovisioner/termination read cluster.pdbs)
-        self.kube.watch(self._sync_pdbs)
+        self.kube.watch(self._on_watch_event)
         self.cluster.pdbs = self.kube.pdbs()
         # admission webhooks at the coordination-plane boundary
         # (operator.WithWebhooks analogue, cmd/controller/main.go:58-63)
@@ -170,9 +170,32 @@ class Operator:
                 termination=self.termination, clock=self.clock,
                 recorder=self.recorder)
 
-    def _sync_pdbs(self, kind: str, action: str, obj) -> None:
+    def _on_watch_event(self, kind: str, action: str, obj) -> None:
         if kind == "pdbs":
             self.cluster.pdbs = self.kube.pdbs()
+        elif kind == "provisioners" and action == "deleted":
+            # nodes are OWNED by the provisioner that launched them: its
+            # deletion gracefully terminates them (reference
+            # deprovisioning.md:22 — the reference gets the cascade from
+            # node ownerReferences + kube GC; here the observed deletion
+            # drives it, and the GC controller's orphan sweep is the
+            # level-triggered backstop for nodes that register after this
+            # event or while the controller is down). Standbys receive the
+            # same watch event but only the LEADER may write.
+            pname = getattr(obj, "name", None)
+            term = getattr(self, "termination", None)
+            if pname and term is not None and (
+                    not self.leader_elect or self.elected.is_set()):
+                for nname in sorted(self.cluster.nodes):
+                    node = self.cluster.nodes.get(nname)
+                    if (node is not None
+                            and node.provisioner_name == pname
+                            and not node.marked_for_deletion
+                            and term.request_deletion(nname)):
+                        self.recorder.normal(
+                            f"node/{nname}", "OwnerDeleted",
+                            f"provisioner {pname} deleted; terminating "
+                            "owned node")
         elif kind == "nodes" and action == "modified":
             # kubectl-mutable node surface -> live cluster state: the
             # do-not-consolidate veto (and future annotation knobs) must
@@ -334,7 +357,7 @@ class Operator:
             self.serving.stop()
         for t in self._threads:
             t.join(timeout=2)
-        self.kube.unwatch(self._sync_pdbs)  # shared-store replicas must not
+        self.kube.unwatch(self._on_watch_event)  # shared-store replicas must not
         # leak dead watchers across restarts (multi-replica HA mode)
         self.provisioning.stop()
         if self.interruption is not None:

@@ -141,6 +141,61 @@ class TestTermination:
         assert name not in op.cluster.nodes
 
 
+class TestProvisionerDeletion:
+    """deprovisioning.md:22: nodes are owned by their provisioner — deleting
+    it gracefully terminates them (ownership cascade)."""
+
+    def test_deleting_provisioner_terminates_owned_nodes(self, op):
+        add_provisioner(op, name="blue")
+        add_provisioner(op, name="green")
+        op.kube.create("pods", "a", make_pod(
+            "a", cpu="1", memory="1Gi",
+            node_selector={wk.LABEL_PROVISIONER: "blue"}))
+        op.kube.create("pods", "b", make_pod(
+            "b", cpu="1", memory="1Gi",
+            node_selector={wk.LABEL_PROVISIONER: "green"}))
+        op.provisioning.reconcile_once()
+        owned = {n: v.provisioner_name for n, v in op.cluster.nodes.items()}
+        assert set(owned.values()) == {"blue", "green"}
+        op.kube.delete("provisioners", "blue")
+        blue_nodes = {n for n, p in owned.items() if p == "blue"}
+        for n in blue_nodes:
+            assert op.cluster.nodes[n].marked_for_deletion
+        green_nodes = {n for n, p in owned.items() if p == "green"}
+        for n in green_nodes:
+            assert not op.cluster.nodes[n].marked_for_deletion
+        assert op.recorder.by_reason("OwnerDeleted")
+        # drain completes through termination (pods evicted)
+        for _ in range(4):
+            op.termination.reconcile_once()
+            op.clock.step(5)
+        assert not (set(op.cluster.nodes) & blue_nodes)
+        assert green_nodes <= set(op.cluster.nodes)
+
+
+    def test_gc_backstop_reaps_orphaned_node(self, op):
+        """A node that registers AFTER the deletion event (or while the
+        controller was down) is caught by the GC sweep's level-triggered
+        orphan check once the launch grace passes."""
+        add_provisioner(op, name="blue")
+        op.kube.create("pods", "a", make_pod(
+            "a", cpu="1", memory="1Gi",
+            node_selector={wk.LABEL_PROVISIONER: "blue"}))
+        op.provisioning.reconcile_once()
+        (name,) = op.cluster.nodes
+        # simulate the missed edge: clear the mark the watch cascade set
+        op.kube.delete("provisioners", "blue")
+        node = op.cluster.nodes[name]
+        node.marked_for_deletion = False
+        node.deletion_requested_ts = 0.0
+        # young node: grace spares it
+        op.garbagecollection.reconcile_once()
+        assert not node.marked_for_deletion
+        op.clock.step(op.garbagecollection.grace_seconds + 1)
+        op.garbagecollection.reconcile_once()
+        assert node.marked_for_deletion
+
+
 class TestImageSelection:
     """integration/ami_test.go: selector-matched newest image wins; without a
     selector the family's SSM default alias resolves."""
