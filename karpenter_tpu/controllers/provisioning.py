@@ -171,7 +171,8 @@ class ProvisioningController:
         self.last_solver_kind = solver_kind
         self.sched_duration.observe(time.perf_counter() - t0, solver=solver_kind)
 
-        self._apply(result, pods)
+        self._apply(result, pods, catalog=catalog, provisioners=provisioners,
+                    daemon_overhead=daemon_overhead)
         return result
 
     # -- solver cache + routing ------------------------------------------------
@@ -258,7 +259,9 @@ class ProvisioningController:
 
     # -- applying a solve ------------------------------------------------------
 
-    def _apply(self, result: SolveResult, pods: "list[PodSpec]") -> None:
+    def _apply(self, result: SolveResult, pods: "list[PodSpec]",
+               catalog=None, provisioners=None,
+               daemon_overhead=None) -> None:
         # per-group pod-name queues; binding pops from the front
         by_group = {g_idx: list(group.pod_names)
                     for g_idx, group in enumerate(result.groups)}
@@ -285,11 +288,43 @@ class ProvisioningController:
         unsched = result.unschedulable_count()
         self.pods_unschedulable.set(unsched)
         if unsched:
+            # name the failing constraint (the reference's scheduler errors
+            # say WHY: "incompatible with provisioner …"). Diagnosed against
+            # the SAME catalog/provisioners/overhead the failed solve used
+            # (a refresh between solve and apply must not contradict it);
+            # one diagnosis per GROUP — identical pods fail identically —
+            # and a hard cap bounds the fold cost in pathological storms.
+            from ..models.encode import build_grid, diagnose_unschedulable
+
+            if catalog is None:
+                catalog = self.cloudprovider.catalog_for(None)
+            if provisioners is None:
+                provisioners = self.cloudprovider.constrain_to_template_zones(
+                    sorted(self.kube.provisioners(),
+                           key=lambda p: (-p.weight, p.name)), catalog)
+            diag_grid = None
+            diagnosed = 0
             for g_idx, count in result.unschedulable.items():
-                for name in by_group.get(g_idx, [])[:count]:
+                names = by_group.get(g_idx, [])[:count]
+                if not names:
+                    continue
+                why = "no compatible instance type available"
+                if diagnosed < 32:
+                    diagnosed += 1
+                    try:
+                        pod = self.kube.get("pods", names[0])
+                        if pod is not None:
+                            if diag_grid is None:  # once per cycle
+                                diag_grid = build_grid(catalog)
+                            why = diagnose_unschedulable(
+                                pod, provisioners, catalog,
+                                daemon_overhead=daemon_overhead,
+                                grid=diag_grid)
+                    except Exception:
+                        pass  # diagnosis must never break the event
+                for name in names:
                     self.recorder.warning(
-                        f"pod/{name}", "FailedScheduling",
-                        "no compatible instance type available")
+                        f"pod/{name}", "FailedScheduling", why)
 
     def _bind_from_groups(self, by_group: "dict[int, list[str]]",
                           group_counts: "dict[int, int]", node_name: str) -> None:

@@ -577,6 +577,71 @@ def combine_group(
     return static.vec, static.cap, feas, newprov
 
 
+def diagnose_unschedulable(
+    pod: PodSpec,
+    provisioners: "Sequence[Provisioner]",
+    catalog: Catalog,
+    daemon_overhead: Optional[Sequence[int]] = None,
+    grid: Optional[OptionGrid] = None,
+) -> str:
+    """WHY a pod cannot schedule, as a human-readable clause for the
+    FailedScheduling event — the reference's scheduler errors name the
+    failing constraint ("incompatible with provisioner …", "no instance
+    type satisfied resources …") rather than a generic message. Walks the
+    admission rule's stages in order and reports the first one no
+    provisioner survives."""
+    if grid is None or grid.seqnum != catalog.seqnum:
+        grid = build_grid(catalog, reuse=grid)
+    provs = list(provisioners)  # flags are ORed: order is irrelevant
+    cols = grid.get_cols()
+    overhead = list(daemon_overhead or [0] * wk.NUM_RESOURCES)
+    group = PodGroup(spec=pod, count=1, pod_names=[pod.name])
+    vec64 = np.minimum(group.vector, INT_BIG).astype(np.int64)
+    ovh = np.asarray(overhead, dtype=np.int64)
+    alloc64 = grid.alloc_t.astype(np.int64)
+    prov_overhead, prov_pods_cap = kubelet_arrays(provs, catalog)
+    any_tol = any_req = any_fit = any_avail = False
+    for pi, prov in enumerate(provs):
+        if not tolerates_all(pod.tolerations, prov.taints):
+            continue
+        any_tol = True
+        try:
+            reqs = prov.scheduling_requirements().union(pod.requirements)
+        except IncompatibleError:
+            continue
+        req_mask = fold_option_mask(reqs, cols, prov).reshape(grid.T, grid.S)
+        if not req_mask.any():
+            continue
+        any_req = True
+        ovh_p = ovh if prov_overhead is None \
+            else ovh + prov_overhead[pi].astype(np.int64)
+        fits_t = np.all(alloc64 - ovh_p[None, :] - vec64[None, :] >= 0, axis=1)
+        if prov_pods_cap is not None:
+            pods_i = wk.RESOURCE_INDEX[wk.RESOURCE_PODS]
+            fits_t &= (prov_pods_cap[pi].astype(np.int64)
+                       - ovh_p[pods_i] - vec64[pods_i] >= 0)
+        m = req_mask & fits_t[:, None]
+        if not m.any():
+            continue
+        any_fit = True
+        if (m & grid.valid).any():
+            any_avail = True
+    if not any_tol:
+        return "pod does not tolerate the taints of any provisioner"
+    if not any_req:
+        return ("pod requirements are incompatible with every "
+                "provisioner and instance type")
+    if not any_fit:
+        return "resource requests do not fit any compatible instance type"
+    if not any_avail:
+        return ("every compatible offering is currently unavailable "
+                "(insufficient capacity)")
+    # option-level admission passes; the failure is cross-pod (affinity /
+    # topology caps / provisioner limits interplay) this cycle
+    return ("compatible capacity exists but scheduling constraints "
+            "(affinity/topology/limits) were unsatisfiable this cycle")
+
+
 def encode_group(
     group: PodGroup,
     provs: "list[Provisioner]",

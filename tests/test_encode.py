@@ -266,3 +266,46 @@ def test_donated_grid_never_bypasses_content_check():
     g_b2 = s_b2.grid()
     assert g_b2.tiebreak is g_b.tiebreak  # layout match -> shared statics
     assert g_b2.valid.sum() < g_b.valid.sum()
+
+
+def test_diagnose_unschedulable_stages():
+    """The FailedScheduling diagnosis names the first admission stage no
+    provisioner survives (toleration -> requirements -> fit -> availability)."""
+    from karpenter_tpu.models.encode import diagnose_unschedulable
+    from karpenter_tpu.models.instancetype import Catalog
+    from karpenter_tpu.models.pod import Taint
+
+    cat = Catalog(types=[make_instance_type(
+        "m.large", cpu=4, memory="16Gi", od_price=0.2, spot_price=0.07)])
+    tainted = Provisioner(name="t", taints=(
+        Taint(key="team", value="x", effect="NoSchedule"),))
+    tainted.set_defaults()
+    plain = Provisioner(name="p")
+    plain.set_defaults()
+
+    # 1) toleration: only a tainted provisioner exists
+    why = diagnose_unschedulable(
+        make_pod("a", cpu="1", memory="1Gi"), [tainted], cat)
+    assert "tolerate" in why
+
+    # 2) requirements: zone nothing offers
+    why = diagnose_unschedulable(
+        make_pod("b", cpu="1", memory="1Gi",
+                 node_selector={wk.LABEL_ZONE: "zone-9z"}), [plain], cat)
+    assert "incompatible" in why
+
+    # 3) fit: larger than every type
+    why = diagnose_unschedulable(
+        make_pod("c", cpu="64", memory="1Gi"), [plain], cat)
+    assert "do not fit" in why
+
+    # 4) availability: everything compatible is ICE'd
+    _ice_flip(cat, "m.large", "zone-1a", "spot")
+    _ice_flip(cat, "m.large", "zone-1a", "on-demand")
+    _ice_flip(cat, "m.large", "zone-1b", "spot")
+    _ice_flip(cat, "m.large", "zone-1b", "on-demand")
+    _ice_flip(cat, "m.large", "zone-1c", "spot")
+    _ice_flip(cat, "m.large", "zone-1c", "on-demand")
+    why = diagnose_unschedulable(
+        make_pod("d", cpu="1", memory="1Gi"), [plain], cat)
+    assert "unavailable" in why
