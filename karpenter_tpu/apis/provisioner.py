@@ -52,6 +52,17 @@ class KubeletConfiguration:
     kube_reserved_cpu_millis: Optional[int] = None
     kube_reserved_memory_bytes: Optional[int] = None
     eviction_hard_memory_bytes: int = 100 * 2**20  # 100Mi default
+    # bootstrap passthrough (no scheduling impact — rendered into the
+    # node's kubelet flags by the image family; reference CRD
+    # karpenter.sh_provisioners.yaml kubeletConfiguration properties)
+    cluster_dns: "tuple[str, ...]" = ()
+    container_runtime: Optional[str] = None
+    cpu_cfs_quota: Optional[bool] = None
+    eviction_soft: "tuple[tuple[str, str], ...]" = ()
+    eviction_soft_grace_period: "tuple[tuple[str, str], ...]" = ()
+    eviction_max_pod_grace_period: Optional[int] = None
+    image_gc_high_threshold_percent: Optional[int] = None
+    image_gc_low_threshold_percent: Optional[int] = None
 
 
 @dataclasses.dataclass

@@ -180,6 +180,16 @@ def _provisioner(doc) -> Provisioner:
         kube_reserved_cpu_millis=cpu_millis(kube_res["cpu"]) if "cpu" in kube_res else None,
         kube_reserved_memory_bytes=mem_bytes(kube_res["memory"]) if "memory" in kube_res else None,
         eviction_hard_memory_bytes=mem_bytes(evict_mem) if evict_mem else 100 * 2**20,
+        # bootstrap passthrough (reference CRD kubeletConfiguration)
+        cluster_dns=tuple(kube.get("clusterDNS") or ()),
+        container_runtime=kube.get("containerRuntime"),
+        cpu_cfs_quota=kube.get("cpuCFSQuota"),
+        eviction_soft=tuple(sorted((kube.get("evictionSoft") or {}).items())),
+        eviction_soft_grace_period=tuple(sorted(
+            (kube.get("evictionSoftGracePeriod") or {}).items())),
+        eviction_max_pod_grace_period=kube.get("evictionMaxPodGracePeriod"),
+        image_gc_high_threshold_percent=kube.get("imageGCHighThresholdPercent"),
+        image_gc_low_threshold_percent=kube.get("imageGCLowThresholdPercent"),
     )
     p = Provisioner(
         name=doc.get("metadata", {}).get("name", "default"),

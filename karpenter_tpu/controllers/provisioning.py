@@ -260,8 +260,7 @@ class ProvisioningController:
     # -- applying a solve ------------------------------------------------------
 
     def _apply(self, result: SolveResult, pods: "list[PodSpec]",
-               catalog=None, provisioners=None,
-               daemon_overhead=None) -> None:
+               catalog, provisioners, daemon_overhead) -> None:
         # per-group pod-name queues; binding pops from the front
         by_group = {g_idx: list(group.pod_names)
                     for g_idx, group in enumerate(result.groups)}
@@ -294,15 +293,10 @@ class ProvisioningController:
             # (a refresh between solve and apply must not contradict it);
             # one diagnosis per GROUP — identical pods fail identically —
             # and a hard cap bounds the fold cost in pathological storms.
-            from ..models.encode import build_grid, diagnose_unschedulable
+            from ..models.encode import (build_grid, diagnose_unschedulable,
+                                         kubelet_arrays)
 
-            if catalog is None:
-                catalog = self.cloudprovider.catalog_for(None)
-            if provisioners is None:
-                provisioners = self.cloudprovider.constrain_to_template_zones(
-                    sorted(self.kube.provisioners(),
-                           key=lambda p: (-p.weight, p.name)), catalog)
-            diag_grid = None
+            diag_grid = diag_kub = None
             diagnosed = 0
             for g_idx, count in result.unschedulable.items():
                 names = by_group.get(g_idx, [])[:count]
@@ -312,14 +306,17 @@ class ProvisioningController:
                 if diagnosed < 32:
                     diagnosed += 1
                     try:
-                        pod = self.kube.get("pods", names[0])
-                        if pod is not None:
-                            if diag_grid is None:  # once per cycle
-                                diag_grid = build_grid(catalog)
-                            why = diagnose_unschedulable(
-                                pod, provisioners, catalog,
-                                daemon_overhead=daemon_overhead,
-                                grid=diag_grid)
+                        # the group's OWN spec — the exact pod the solve
+                        # failed on (a store fetch could race an edit/delete
+                        # and explain a different pod)
+                        pod = result.groups[g_idx].spec
+                        if diag_grid is None:  # once per cycle
+                            diag_grid = build_grid(catalog)
+                            diag_kub = kubelet_arrays(provisioners, catalog)
+                        why = diagnose_unschedulable(
+                            pod, provisioners, catalog,
+                            daemon_overhead=daemon_overhead,
+                            grid=diag_grid, kubelet=diag_kub)
                     except Exception:
                         pass  # diagnosis must never break the event
                 for name in names:

@@ -300,6 +300,23 @@ def _provisioner_spec(p: Provisioner) -> dict:
     if k.eviction_hard_memory_bytes != 100 * 2**20:
         kube["evictionHard"] = {
             "memory.available": _fmt_bytes(k.eviction_hard_memory_bytes)}
+    # bootstrap passthrough keys survive the store round trip verbatim
+    if k.cluster_dns:
+        kube["clusterDNS"] = list(k.cluster_dns)
+    if k.container_runtime is not None:
+        kube["containerRuntime"] = k.container_runtime
+    if k.cpu_cfs_quota is not None:
+        kube["cpuCFSQuota"] = k.cpu_cfs_quota
+    if k.eviction_soft:
+        kube["evictionSoft"] = dict(k.eviction_soft)
+    if k.eviction_soft_grace_period:
+        kube["evictionSoftGracePeriod"] = dict(k.eviction_soft_grace_period)
+    if k.eviction_max_pod_grace_period is not None:
+        kube["evictionMaxPodGracePeriod"] = k.eviction_max_pod_grace_period
+    if k.image_gc_high_threshold_percent is not None:
+        kube["imageGCHighThresholdPercent"] = k.image_gc_high_threshold_percent
+    if k.image_gc_low_threshold_percent is not None:
+        kube["imageGCLowThresholdPercent"] = k.image_gc_low_threshold_percent
     if kube:
         spec["kubeletConfiguration"] = kube
     if p.provider_ref:

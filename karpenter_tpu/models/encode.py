@@ -583,6 +583,7 @@ def diagnose_unschedulable(
     catalog: Catalog,
     daemon_overhead: Optional[Sequence[int]] = None,
     grid: Optional[OptionGrid] = None,
+    kubelet: "Optional[tuple]" = None,
 ) -> str:
     """WHY a pod cannot schedule, as a human-readable clause for the
     FailedScheduling event — the reference's scheduler errors name the
@@ -599,7 +600,10 @@ def diagnose_unschedulable(
     vec64 = np.minimum(group.vector, INT_BIG).astype(np.int64)
     ovh = np.asarray(overhead, dtype=np.int64)
     alloc64 = grid.alloc_t.astype(np.int64)
-    prov_overhead, prov_pods_cap = kubelet_arrays(provs, catalog)
+    # kubelet arrays are O(Pv*T) Python to build: callers diagnosing many
+    # groups per cycle pass them in once (indexed by position in `provs`)
+    prov_overhead, prov_pods_cap = (
+        kubelet if kubelet is not None else kubelet_arrays(provs, catalog))
     any_tol = any_req = any_fit = any_avail = False
     for pi, prov in enumerate(provs):
         if not tolerates_all(pod.tolerations, prov.taints):

@@ -72,6 +72,29 @@ class BootstrapConfig:
             flags.append(f"--kube-reserved={','.join(kube_res)}")
         if k.eviction_hard_memory_bytes:
             flags.append(f"--eviction-hard=memory.available<{k.eviction_hard_memory_bytes}")
+        # bootstrap passthrough (reference CRD kubeletConfiguration keys
+        # with no scheduling impact — they only shape the node's kubelet)
+        if k.cluster_dns:
+            flags.append(f"--cluster-dns={','.join(k.cluster_dns)}")
+        if k.container_runtime is not None:
+            flags.append(f"--container-runtime={k.container_runtime}")
+        if k.cpu_cfs_quota is not None:
+            flags.append(f"--cpu-cfs-quota={str(k.cpu_cfs_quota).lower()}")
+        if k.eviction_soft:
+            flags.append("--eviction-soft=" + ",".join(
+                f"{sig}<{val}" for sig, val in k.eviction_soft))
+        if k.eviction_soft_grace_period:
+            flags.append("--eviction-soft-grace-period=" + ",".join(
+                f"{sig}={val}" for sig, val in k.eviction_soft_grace_period))
+        if k.eviction_max_pod_grace_period is not None:
+            flags.append("--eviction-max-pod-grace-period="
+                         f"{k.eviction_max_pod_grace_period}")
+        if k.image_gc_high_threshold_percent is not None:
+            flags.append("--image-gc-high-threshold="
+                         f"{k.image_gc_high_threshold_percent}")
+        if k.image_gc_low_threshold_percent is not None:
+            flags.append("--image-gc-low-threshold="
+                         f"{k.image_gc_low_threshold_percent}")
         return flags
 
 
@@ -148,12 +171,35 @@ class Flatboat(ImageFamily):
                 lines.append(f"max-pods = {k.max_pods}")
             if k.pods_per_core is not None:
                 lines.append(f"pods-per-core = {k.pods_per_core}")
+            # passthrough keys render TOML-style too (the kubelet_flags
+            # docstring's contract: TOML families carry the same fields)
+            if k.cluster_dns:
+                lines.append(f'cluster-dns-ip = "{k.cluster_dns[0]}"')
+            if k.cpu_cfs_quota is not None:
+                lines.append(
+                    f"cpu-cfs-quota-enforced = {str(k.cpu_cfs_quota).lower()}")
+            if k.eviction_max_pod_grace_period is not None:
+                lines.append("eviction-max-pod-grace-period = "
+                             f"{k.eviction_max_pod_grace_period}")
+            if k.image_gc_high_threshold_percent is not None:
+                lines.append("image-gc-high-threshold-percent = "
+                             f'"{k.image_gc_high_threshold_percent}"')
+            if k.image_gc_low_threshold_percent is not None:
+                lines.append("image-gc-low-threshold-percent = "
+                             f'"{k.image_gc_low_threshold_percent}"')
             if k.system_reserved_cpu_millis or k.system_reserved_memory_bytes:
                 lines.append("[settings.kubernetes.system-reserved]")
                 if k.system_reserved_cpu_millis:
                     lines.append(f'cpu = "{k.system_reserved_cpu_millis}m"')
                 if k.system_reserved_memory_bytes:
                     lines.append(f'memory = "{k.system_reserved_memory_bytes}"')
+            if k.eviction_soft:
+                lines.append("[settings.kubernetes.eviction-soft]")
+                lines += [f'"{sig}" = "{val}"' for sig, val in k.eviction_soft]
+            if k.eviction_soft_grace_period:
+                lines.append("[settings.kubernetes.eviction-soft-grace-period]")
+                lines += [f'"{sig}" = "{val}"'
+                          for sig, val in k.eviction_soft_grace_period]
         if cfg.labels:
             lines.append("[settings.kubernetes.node-labels]")
             lines += [f'"{k}" = "{v}"' for k, v in sorted(cfg.labels.items())]

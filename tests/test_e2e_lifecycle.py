@@ -319,3 +319,88 @@ class TestSubnetSelection:
         op.provisioning.reconcile_once()
         (inst,) = op.cloudprovider.cloud.instances.values()
         assert inst.subnet_id == "subnet-zone-1a"
+
+
+class TestKubeletPassthrough:
+    """Reference CRD kubeletConfiguration keys with no scheduling impact
+    still load from manifests, survive the store round trip, and reach the
+    node's kubelet flags via the generated user data."""
+
+    FULL = """
+apiVersion: karpenter.sh/v1alpha5
+kind: Provisioner
+metadata: {name: kc}
+spec:
+  providerRef: {name: default}
+  kubeletConfiguration:
+    clusterDNS: ["10.0.0.10", "10.0.0.11"]
+    containerRuntime: containerd
+    cpuCFSQuota: false
+    maxPods: 60
+    evictionSoft:
+      memory.available: "500Mi"
+    evictionSoftGracePeriod:
+      memory.available: "1m"
+    evictionMaxPodGracePeriod: 120
+    imageGCHighThresholdPercent: 85
+    imageGCLowThresholdPercent: 70
+"""
+
+    def test_manifest_to_userdata_flags(self, op):
+        from karpenter_tpu.apis.yaml_compat import load_manifests
+
+        loaded = load_manifests(self.FULL)
+        (p,) = loaded.provisioners
+        k = p.kubelet
+        assert k.cluster_dns == ("10.0.0.10", "10.0.0.11")
+        assert k.container_runtime == "containerd"
+        assert k.cpu_cfs_quota is False
+        assert k.eviction_soft == (("memory.available", "500Mi"),)
+        op.kube.create("provisioners", "kc", p)
+        op.kube.create("pods", "a", make_pod(
+            "a", cpu="1", memory="1Gi",
+            node_selector={wk.LABEL_PROVISIONER: "kc"}))
+        op.provisioning.reconcile_once()
+        (inst,) = op.cloudprovider.cloud.instances.values()
+        ud = op.cloudprovider.cloud.launch_templates[inst.launch_template].userdata
+        for needle in ("--cluster-dns=10.0.0.10,10.0.0.11",
+                       "--container-runtime=containerd",
+                       "--cpu-cfs-quota=false",
+                       "--eviction-soft=memory.available<500Mi",
+                       "--eviction-soft-grace-period=memory.available=1m",
+                       "--eviction-max-pod-grace-period=120",
+                       "--image-gc-high-threshold=85",
+                       "--image-gc-low-threshold=70"):
+            assert needle in ud, f"{needle} missing from userdata"
+
+    def test_flatboat_family_renders_passthrough_toml(self):
+        from karpenter_tpu.apis.yaml_compat import load_manifests
+        from karpenter_tpu.providers.images import BootstrapConfig, get_family
+
+        (p,) = load_manifests(self.FULL).provisioners
+        toml = get_family("flatboat").userdata(BootstrapConfig(
+            cluster_name="c", cluster_endpoint="https://k",
+            labels={}, taints=(), kubelet=p.kubelet))
+        for needle in ('cluster-dns-ip = "10.0.0.10"',
+                       "cpu-cfs-quota-enforced = false",
+                       "eviction-max-pod-grace-period = 120",
+                       "[settings.kubernetes.eviction-soft]",
+                       '"memory.available" = "500Mi"',
+                       "[settings.kubernetes.eviction-soft-grace-period]"):
+            assert needle in toml, f"{needle} missing from TOML userdata"
+
+    def test_store_round_trip_preserves_passthrough(self):
+        from karpenter_tpu.apis.yaml_compat import load_manifests
+        from karpenter_tpu.coordination import serde
+
+        (p,) = load_manifests(self.FULL).provisioners
+        doc = serde.to_manifest("provisioners", "kc", p)
+        kube = doc["spec"]["kubeletConfiguration"]
+        assert kube["clusterDNS"] == ["10.0.0.10", "10.0.0.11"]
+        assert kube["cpuCFSQuota"] is False
+        assert kube["evictionSoft"] == {"memory.available": "500Mi"}
+        # the real-schema spec reloads to an EQUAL model (pruning apiserver)
+        import yaml
+
+        (p2,) = load_manifests(yaml.safe_dump(doc)).provisioners
+        assert p2.kubelet == p.kubelet
