@@ -111,6 +111,9 @@ class NodeTemplate:
     userdata: str = ""
     tags: "dict[str, str]" = dataclasses.field(default_factory=dict)
     launch_template_name: str = ""  # static LT passthrough (launchtemplate.go:93-96)
+    # fleet "context" (reserved-capacity targeting) passed verbatim to the
+    # launch API (reference instance.go:228 Context: nodeTemplate.Spec.Context)
+    fleet_context: str = ""
     metadata_options: MetadataOptions = dataclasses.field(default_factory=MetadataOptions)
     block_device_mappings: "tuple[BlockDeviceMapping, ...]" = ()
     detailed_monitoring: bool = False

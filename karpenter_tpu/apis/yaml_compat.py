@@ -237,6 +237,7 @@ def _nodetemplate(doc) -> NodeTemplate:
         userdata=spec.get("userData", ""),
         tags=dict(spec.get("tags") or {}),
         launch_template_name=spec.get("launchTemplate", ""),
+        fleet_context=spec.get("context", ""),
         metadata_options=MetadataOptions(
             http_endpoint=md.get("httpEndpoint", "enabled"),
             http_tokens=md.get("httpTokens", "required"),

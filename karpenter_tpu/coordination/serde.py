@@ -342,6 +342,8 @@ def _nodetemplate_spec(t: NodeTemplate) -> dict:
         spec["tags"] = dict(t.tags)
     if t.launch_template_name:
         spec["launchTemplate"] = t.launch_template_name
+    if t.fleet_context:
+        spec["context"] = t.fleet_context
     md = t.metadata_options
     if not md.is_default():  # ALL fields, not a hand-picked subset
         spec["metadataOptions"] = {

@@ -59,6 +59,9 @@ class CreateFleetRequest:
     capacity_type: str
     tags: "dict[str, str]" = dataclasses.field(default_factory=dict)
     image_id: str = ""
+    # EC2 Fleet "context" (reserved-capacity targeting; the reference passes
+    # nodeTemplate.Spec.Context verbatim, instance.go:228)
+    fleet_context: str = ""
 
 
 @dataclasses.dataclass

@@ -120,7 +120,8 @@ class InstanceProvider:
         }
         request = CreateFleetRequest(
             launch_template=next(iter(lts)), overrides=overrides, capacity=1,
-            capacity_type=capacity_type, tags=tags)
+            capacity_type=capacity_type, tags=tags,
+            fleet_context=template.fleet_context)
         try:
             resp = self.fleet.create_fleet(request)
         except cloud_errors.FleetError as e:

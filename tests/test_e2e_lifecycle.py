@@ -404,3 +404,25 @@ spec:
 
         (p2,) = load_manifests(yaml.safe_dump(doc)).provisioners
         assert p2.kubelet == p.kubelet
+
+
+def test_fleet_context_reaches_launch_api(op):
+    """spec.context (reserved-capacity targeting) passes verbatim to the
+    launch API (reference instance.go:228)."""
+    import yaml as _yaml
+
+    from karpenter_tpu.apis.yaml_compat import load_manifests
+    from karpenter_tpu.coordination import serde
+
+    t = op.kube.get("nodetemplates", "default")
+    t.fleet_context = "cr-0123456789abcdef"
+    add_provisioner(op)
+    op.kube.create("pods", "a", make_pod("a", cpu="1", memory="1Gi"))
+    op.provisioning.reconcile_once()
+    (req,) = op.cloudprovider.cloud.create_fleet_api.calls
+    assert req.fleet_context == "cr-0123456789abcdef"
+    # manifest + store round trips carry the key
+    doc = serde.to_manifest("nodetemplates", "default", t)
+    assert doc["spec"]["context"] == "cr-0123456789abcdef"
+    loaded = load_manifests(_yaml.safe_dump(doc))
+    assert loaded.templates[0].fleet_context == "cr-0123456789abcdef"
