@@ -72,6 +72,8 @@ class Provisioner:
     taints: "tuple[Taint, ...]" = ()
     startup_taints: "tuple[Taint, ...]" = ()
     labels: "tuple[tuple[str, str], ...]" = ()
+    # applied to every node this provisioner launches (CRD spec.annotations)
+    annotations: "tuple[tuple[str, str], ...]" = ()
     limits: Limits = dataclasses.field(default_factory=Limits)
     weight: int = 0  # higher wins when multiple provisioners match (core semantics)
     ttl_seconds_after_empty: Optional[int] = None

@@ -197,6 +197,7 @@ def _provisioner(doc) -> Provisioner:
         taints=_taints(spec.get("taints")),
         startup_taints=_taints(spec.get("startupTaints")),
         labels=tuple(sorted((spec.get("labels") or {}).items())),
+        annotations=tuple(sorted((spec.get("annotations") or {}).items())),
         limits=limits,
         weight=int(spec.get("weight", 0)),
         ttl_seconds_after_empty=spec.get("ttlSecondsAfterEmpty"),

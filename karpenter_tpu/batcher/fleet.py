@@ -26,7 +26,7 @@ from ..fake.cloud import CloudInstance, CreateFleetRequest, CreateFleetResponse
 def _fleet_hasher(req: CreateFleetRequest):
     """Identical fleet shapes (everything except capacity) share a bucket."""
     return (req.launch_template, tuple(req.overrides), req.capacity_type,
-            tuple(sorted(req.tags.items())), req.image_id)
+            tuple(sorted(req.tags.items())), req.image_id, req.fleet_context)
 
 
 class CreateFleetBatcher:

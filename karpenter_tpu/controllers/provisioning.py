@@ -406,6 +406,9 @@ class ProvisioningController:
             created_ts=self.clock.now(),
             machine_name=name,
             initialized=False,  # the machine lifecycle controller flips this
+            # provisioner annotations are applied to every node it launches
+            # (reference CRD spec.annotations)
+            annotations=dict(prov.annotations),
         )
         self.cluster.add_node(node)
         self.kube.create("nodes", node.name, node)

@@ -261,6 +261,8 @@ def _provisioner_spec(p: Provisioner) -> dict:
         spec["startupTaints"] = taint_items(p.startup_taints)
     if p.labels:
         spec["labels"] = dict(p.labels)
+    if p.annotations:
+        spec["annotations"] = dict(p.annotations)
     limits = {}
     if p.limits.cpu_millis is not None:
         limits["cpu"] = f"{p.limits.cpu_millis}m"

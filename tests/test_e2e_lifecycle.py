@@ -426,3 +426,44 @@ def test_fleet_context_reaches_launch_api(op):
     assert doc["spec"]["context"] == "cr-0123456789abcdef"
     loaded = load_manifests(_yaml.safe_dump(doc))
     assert loaded.templates[0].fleet_context == "cr-0123456789abcdef"
+
+
+def test_provisioner_annotations_applied_to_nodes(op):
+    """CRD spec.annotations: applied to every node the provisioner launches
+    — including veto knobs like do-not-consolidate, which must then reach
+    the deprovisioner's eligibility checks."""
+    import yaml as _yaml
+
+    from karpenter_tpu.apis.yaml_compat import load_manifests
+    from karpenter_tpu.coordination import serde
+
+    M = """
+apiVersion: karpenter.sh/v1alpha5
+kind: Provisioner
+metadata: {name: anno}
+spec:
+  providerRef: {name: default}
+  consolidation: {enabled: true}
+  annotations:
+    team.example/cost-center: "42"
+    karpenter.sh/do-not-consolidate: "true"
+"""
+    (p,) = load_manifests(M).provisioners
+    op.kube.create("provisioners", "anno", p)
+    op.kube.create("pods", "a", make_pod(
+        "a", cpu="1", memory="1Gi",
+        node_selector={wk.LABEL_PROVISIONER: "anno"}))
+    op.provisioning.reconcile_once()
+    (node,) = op.cluster.nodes.values()
+    assert node.annotations["team.example/cost-center"] == "42"
+    # the annotation-driven veto is live: empty node, yet never consolidated
+    op.machinelifecycle.reconcile_once()
+    op.machinelifecycle.reconcile_once()
+    op.kube.delete("pods", "a")
+    node.pods.clear()
+    op.clock.step(600)
+    assert op.deprovisioning.reconcile_consolidation() is None
+    # store round trip keeps the annotations
+    doc = serde.to_manifest("provisioners", "anno", p)
+    (p2,) = load_manifests(_yaml.safe_dump(doc)).provisioners
+    assert p2.annotations == p.annotations

@@ -476,3 +476,21 @@ class TestRestPricingSource:
             assert prov.on_demand_price("m.large", "z1") == 0.2
         finally:
             srv.shutdown()
+
+
+def test_fleet_batcher_never_merges_distinct_contexts():
+    """Requests differing only in fleet_context must not share a batch
+    bucket — merged, the second template's reserved-capacity targeting
+    would silently apply the first's context (reference createfleet.go
+    hashes the full request shape)."""
+    from karpenter_tpu.batcher.fleet import _fleet_hasher
+    from karpenter_tpu.fake.cloud import CreateFleetRequest, FleetOverride
+
+    base = dict(launch_template="lt-1",
+                overrides=[FleetOverride("m.large", "zone-1a")],
+                capacity=1, capacity_type="on-demand")
+    a = CreateFleetRequest(**base, fleet_context="cr-a")
+    b = CreateFleetRequest(**base, fleet_context="cr-b")
+    c = CreateFleetRequest(**base, fleet_context="cr-a")
+    assert _fleet_hasher(a) != _fleet_hasher(b)
+    assert _fleet_hasher(a) == _fleet_hasher(c)
