@@ -45,10 +45,12 @@ def kube_reserved_cpu_millis(cores: int) -> int:
     return millis
 
 
-def node_overhead(cpu_millis: int, memory_bytes: int, pods: int) -> "dict[str, int]":
+def node_overhead(cpu_millis: int, memory_bytes: int, pods: int,
+                  vm_overhead_percent: float = VM_MEMORY_OVERHEAD_PERCENT,
+                  ) -> "dict[str, int]":
     kube_mem = (11 * pods + 255) * 2**20
     eviction = 100 * 2**20
-    vm_overhead = int(memory_bytes * VM_MEMORY_OVERHEAD_PERCENT)
+    vm_overhead = int(memory_bytes * vm_overhead_percent)
     return {
         wk.RESOURCE_CPU: kube_reserved_cpu_millis(cpu_millis // 1000),
         wk.RESOURCE_MEMORY: vm_overhead + kube_mem + eviction,
@@ -176,21 +178,55 @@ class InstanceTypeProvider:
         density. Live-watchable, so it is part of the memo key."""
         return self.settings is None or self.settings.enable_eni_limited_pod_density
 
+    def _vm_overhead_percent(self) -> float:
+        """vmMemoryOverheadPercent (settings.go:48,62,83): live-watchable
+        memory-overhead fraction. The source catalog bakes the default; a
+        changed setting re-derives every type's memory overhead."""
+        if self.settings is None:
+            return VM_MEMORY_OVERHEAD_PERCENT
+        return self.settings.vm_memory_overhead_percent
+
     def list(self, nodetemplate=None) -> Catalog:
         zones = None
         if nodetemplate is not None and self.subnets is not None and nodetemplate.subnet_selector:
             zones = tuple(self.subnets.zones(nodetemplate.subnet_selector))
-        key = (self.source.seqnum, self.ice.seqnum, zones, self._density_limited())
+        # settings are mutated live by the settings-watch thread: read each
+        # knob ONCE so the memo key always matches the catalog built for it
+        density = self._density_limited()
+        pct = self._vm_overhead_percent()
+        key = (self.source.seqnum, self.ice.seqnum, zones, density, pct)
         with self._lock:
             hit = self._memo.get(key)
             if hit is not None:
                 return hit
-            # prune entries for dead seqnums; keep live per-template variants
-            # (one entry per zones-tuple under the current seqnum pair)
-            for k in [k for k in self._memo if k[:2] != key[:2]]:
+            # prune dead seqnums AND stale settings variants (pct is an
+            # unbounded float dimension); keep only the current settings'
+            # per-zones-tuple entries
+            for k in [k for k in self._memo
+                      if (k[0], k[1], k[3], k[4])
+                      != (key[0], key[1], key[3], key[4])]:
                 del self._memo[k]
             types = self.ice.apply(self.source.types)
-            if not self._density_limited():
+            if pct != VM_MEMORY_OVERHEAD_PERCENT:
+                # the SOURCE catalog's baked memory overhead includes the vm
+                # share at the DEFAULT percent; a live setting change adjusts
+                # by the DELTA only — rebuilding the whole formula would
+                # fabricate kube/eviction overhead on fixture catalogs whose
+                # baked overhead is not formula-derived
+                import dataclasses as _dc
+
+                delta = pct - VM_MEMORY_OVERHEAD_PERCENT
+                retuned = []
+                for t in types:
+                    cap = dict(t.capacity)
+                    ovh = dict(t.overhead)
+                    ovh[wk.RESOURCE_MEMORY] = max(0, ovh.get(
+                        wk.RESOURCE_MEMORY, 0) + int(
+                        cap.get(wk.RESOURCE_MEMORY, 0) * delta))
+                    retuned.append(_dc.replace(t, overhead=tuple(
+                        sorted(ovh.items()))))
+                types = retuned
+            if not density:
                 import dataclasses as _dc
 
                 DEFAULT_MAX_PODS = 110

@@ -494,3 +494,30 @@ def test_fleet_batcher_never_merges_distinct_contexts():
     c = CreateFleetRequest(**base, fleet_context="cr-a")
     assert _fleet_hasher(a) != _fleet_hasher(b)
     assert _fleet_hasher(a) == _fleet_hasher(c)
+
+
+def test_vm_memory_overhead_percent_is_live():
+    """settings.vmMemoryOverheadPercent re-derives every type's memory
+    overhead (the source catalog bakes the default); the memo key carries
+    the live value so a settings change invalidates derived catalogs."""
+    from karpenter_tpu.apis import wellknown as wk
+    from karpenter_tpu.apis.settings import Settings
+    from karpenter_tpu.cache import UnavailableOfferings
+    from karpenter_tpu.providers.instancetypes import (
+        InstanceTypeProvider, generate_fleet_catalog)
+
+    src = generate_fleet_catalog(max_types=5)
+    settings = Settings(cluster_name="t", cluster_endpoint="https://k")
+    provider = InstanceTypeProvider(src, UnavailableOfferings(),
+                                    settings=settings)
+    base_alloc = provider.list(None).types[0].allocatable_vector()
+    settings.vm_memory_overhead_percent = 0.2
+    fat_alloc = provider.list(None).types[0].allocatable_vector()
+    mem_i = wk.RESOURCE_INDEX[wk.RESOURCE_MEMORY]
+    assert fat_alloc[mem_i] < base_alloc[mem_i], (base_alloc, fat_alloc)
+    # cpu overhead curve unchanged
+    cpu_i = wk.RESOURCE_INDEX[wk.RESOURCE_CPU]
+    assert fat_alloc[cpu_i] == base_alloc[cpu_i]
+    # back to default: identical to the source-baked numbers
+    settings.vm_memory_overhead_percent = 0.075
+    assert provider.list(None).types[0].allocatable_vector() == base_alloc
