@@ -21,6 +21,7 @@ Overhead model (re-derived from instancetype.go:229-319 semantics):
 
 from __future__ import annotations
 
+import dataclasses as _dc
 from typing import Optional, Sequence
 
 from ..apis import wellknown as wk
@@ -194,7 +195,9 @@ class InstanceTypeProvider:
         # knob ONCE so the memo key always matches the catalog built for it
         density = self._density_limited()
         pct = self._vm_overhead_percent()
-        key = (self.source.seqnum, self.ice.seqnum, zones, density, pct)
+        pod_eni = self.settings is not None and self.settings.enable_pod_eni
+        key = (self.source.seqnum, self.ice.seqnum, zones, density, pct,
+               pod_eni)
         with self._lock:
             hit = self._memo.get(key)
             if hit is not None:
@@ -203,8 +206,7 @@ class InstanceTypeProvider:
             # unbounded float dimension); keep only the current settings'
             # per-zones-tuple entries
             for k in [k for k in self._memo
-                      if (k[0], k[1], k[3], k[4])
-                      != (key[0], key[1], key[3], key[4])]:
+                      if (k[0], k[1], *k[3:]) != (key[0], key[1], *key[3:])]:
                 del self._memo[k]
             types = self.ice.apply(self.source.types)
             if pct != VM_MEMORY_OVERHEAD_PERCENT:
@@ -213,8 +215,6 @@ class InstanceTypeProvider:
                 # by the DELTA only — rebuilding the whole formula would
                 # fabricate kube/eviction overhead on fixture catalogs whose
                 # baked overhead is not formula-derived
-                import dataclasses as _dc
-
                 delta = pct - VM_MEMORY_OVERHEAD_PERCENT
                 retuned = []
                 for t in types:
@@ -226,9 +226,29 @@ class InstanceTypeProvider:
                     retuned.append(_dc.replace(t, overhead=tuple(
                         sorted(ovh.items()))))
                 types = retuned
+            # enablePodENI (settings.go:79; awsPodENI instancetype.go:
+            # 174-181): trunking-compatible (nitro) types advertise vpc
+            # pod-eni branch-interface capacity WHEN enabled; disabled
+            # STRIPS any baked pod-eni capacity so the gate is symmetric
+            # (the reference's disabled path reports quantity 0). The
+            # synthetic fleet's rule: nitro types carry min(107, 3*cpu)
+            # branches (the reference reads a static per-type limits table).
+            gated = []
+            for t in types:
+                cap = dict(t.capacity)
+                if pod_eni:
+                    labels = dict(t.labels)
+                    if labels.get(wk.LABEL_INSTANCE_HYPERVISOR) == "nitro" \
+                            and wk.RESOURCE_POD_ENI not in cap:
+                        cpu = int(labels.get(wk.LABEL_INSTANCE_CPU, "0") or 0)
+                        cap[wk.RESOURCE_POD_ENI] = min(107, max(1, 3 * cpu))
+                        t = _dc.replace(t, capacity=tuple(sorted(cap.items())))
+                elif wk.RESOURCE_POD_ENI in cap:
+                    del cap[wk.RESOURCE_POD_ENI]
+                    t = _dc.replace(t, capacity=tuple(sorted(cap.items())))
+                gated.append(t)
+            types = gated
             if not density:
-                import dataclasses as _dc
-
                 DEFAULT_MAX_PODS = 110
                 types = [
                     _dc.replace(t, capacity=tuple(
@@ -237,9 +257,6 @@ class InstanceTypeProvider:
                     for t in types
                 ]
             if zones is not None:
-                import dataclasses as _dc
-
-                from ..models.instancetype import Offerings
 
                 restricted = []
                 for t in types:

@@ -521,3 +521,66 @@ def test_vm_memory_overhead_percent_is_live():
     # back to default: identical to the source-baked numbers
     settings.vm_memory_overhead_percent = 0.075
     assert provider.list(None).types[0].allocatable_vector() == base_alloc
+
+
+def test_enable_pod_eni_advertises_branch_interfaces():
+    """enablePodENI: trunking-compatible (nitro) types advertise pod-eni
+    capacity; disabled (default) leaves it unadvertised so pod-eni pods are
+    unschedulable (reference awsPodENI gating)."""
+    from karpenter_tpu.apis import wellknown as wk
+    from karpenter_tpu.apis.settings import Settings
+    from karpenter_tpu.cache import UnavailableOfferings
+    from karpenter_tpu.providers.instancetypes import (
+        InstanceTypeProvider, generate_fleet_catalog)
+
+    src = generate_fleet_catalog()
+    settings = Settings(cluster_name="t", cluster_endpoint="https://k")
+    provider = InstanceTypeProvider(src, UnavailableOfferings(),
+                                    settings=settings)
+    assert all(wk.RESOURCE_POD_ENI not in dict(t.capacity)
+               for t in provider.list(None).types)
+    settings.enable_pod_eni = True
+    cat = provider.list(None)
+    nitro = [t for t in cat.types
+             if dict(t.labels).get(wk.LABEL_INSTANCE_HYPERVISOR) == "nitro"]
+    xen = [t for t in cat.types
+           if dict(t.labels).get(wk.LABEL_INSTANCE_HYPERVISOR) == "xen"]
+    assert nitro and all(
+        dict(t.capacity).get(wk.RESOURCE_POD_ENI, 0) > 0 for t in nitro)
+    assert xen and all(
+        wk.RESOURCE_POD_ENI not in dict(t.capacity) for t in xen)
+    # a pod requesting pod-eni schedules end-to-end only when enabled
+    from karpenter_tpu.apis.provisioner import Provisioner
+    from karpenter_tpu.models.pod import make_pod
+    from karpenter_tpu.solver.core import NativeSolver
+
+    prov = Provisioner(name="default")
+    prov.set_defaults()
+    pod = make_pod("eni", cpu="1", memory="1Gi",
+                   extended={wk.RESOURCE_POD_ENI: 2})
+    res = NativeSolver(cat, [prov]).solve([pod])
+    assert res.unschedulable_count() == 0
+    settings.enable_pod_eni = False
+    res2 = NativeSolver(provider.list(None), [prov]).solve([pod])
+    assert res2.unschedulable_count() == 1
+
+
+def test_pod_eni_disabled_strips_baked_capacity():
+    """The gate is symmetric: disabled STRIPS pod-eni capacity baked into a
+    source catalog (reference awsPodENI reports 0 when disabled)."""
+    from karpenter_tpu.apis import wellknown as wk
+    from karpenter_tpu.apis.settings import Settings
+    from karpenter_tpu.cache import UnavailableOfferings
+    from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+    from karpenter_tpu.providers.instancetypes import InstanceTypeProvider
+
+    src = Catalog(types=[make_instance_type(
+        "n.large", cpu=4, memory="16Gi", od_price=0.2,
+        extended={wk.RESOURCE_POD_ENI: 5})])
+    settings = Settings(cluster_name="t", cluster_endpoint="https://k")
+    provider = InstanceTypeProvider(src, UnavailableOfferings(),
+                                    settings=settings)
+    assert wk.RESOURCE_POD_ENI not in dict(provider.list(None).types[0].capacity)
+    settings.enable_pod_eni = True
+    assert dict(provider.list(None).types[0].capacity).get(
+        wk.RESOURCE_POD_ENI) == 5  # baked value preserved when enabled
