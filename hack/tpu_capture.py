@@ -376,16 +376,24 @@ def _capture_payload(reps_headline: int, reps_sweep: int,
                                node_name=f"n-{i}")]))
         cprov = Provisioner(name="default", consolidation_enabled=True)
         cprov.set_defaults()
+        import karpenter_tpu.ops.consolidate as _cmod
+
         run_consolidation(cluster, catalog, [cprov])  # compile + warm
-        ctimes = []
+        ctimes, phases = [], []
         for _ in range(max(3, reps_sweep)):
             t0 = time.perf_counter()
             action = run_consolidation(cluster, catalog, [cprov])
             ctimes.append((time.perf_counter() - t0) * 1000)
+            if _cmod.last_timings:  # per-rep, like the headline phase_split
+                phases.append(_cmod.last_timings)
         consolidation = {
             "candidates": 500,
             "p50_ms": round(statistics.median(ctimes), 3),
             "action": action.kind if action else None,
+            # which phase owns the wall clock (encode/flatten/put/
+            # dispatch/fetch/decode — needs KARPENTER_TPU_SOLVE_TIMING=1,
+            # which capture_once sets); one entry per rep
+            "phase_split": phases,
         }
     except Exception as e:
         consolidation = {"error": str(e)[:200]}

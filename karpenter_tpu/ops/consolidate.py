@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os as _os
+import time as _time
 from typing import Optional, Sequence
 
 import jax
@@ -42,6 +44,12 @@ from ..oracle.scheduler import prepare_groups
 from .packer import PackInputs, pack_impl
 
 N_SLOTS = 2  # 1 replacement allowed; a 2nd opening proves non-consolidatable
+
+# phase-attributed sweeps (encode/flatten/put/dispatch/fetch/decode split,
+# read from consolidate.last_timings) — capture-tool diagnostics, same flag
+# as solver/core.py so one env var attributes the whole controller cycle
+_SOLVE_TIMING = _os.environ.get("KARPENTER_TPU_SOLVE_TIMING") == "1"
+last_timings: "dict | None" = None
 
 # grid memo for grid-less callers (the deprovisioner's in-process path, the
 # benchmark harness): build_grid costs ~120ms at 551 types and dominated
@@ -288,6 +296,15 @@ def _batched_pack(inputs: PackInputs, n_slots: int):
     return jax.vmap(lambda inp: pack_impl(inp, n_slots), in_axes=(axes,))(inputs)
 
 
+def _reduce_verdicts(r):
+    """PackResult -> [C, 3] verdict table: (total unschedulable, nodes
+    opened, decided option of slot 0). The ONE definition of the column
+    contract _decode_actions indexes by position — shared by the dense,
+    flat, and sharded dispatch paths."""
+    return jax.numpy.stack(
+        [r.unsched.sum(axis=1), r.n_open, r.decided[:, 0]], axis=1)
+
+
 @functools.partial(jax.jit, static_argnames=("n_slots",))
 def _batched_pack_verdicts(inputs: PackInputs, n_slots: int,
                            feas_table=None, feas_idx=None):
@@ -303,9 +320,7 @@ def _batched_pack_verdicts(inputs: PackInputs, n_slots: int,
     if feas_table is not None:
         inputs = inputs._replace(
             group_feas=jax.numpy.take(feas_table, feas_idx, axis=0))
-    r = _batched_pack(inputs, n_slots)
-    return jax.numpy.stack(
-        [r.unsched.sum(axis=1), r.n_open, r.decided[:, 0]], axis=1)
+    return _reduce_verdicts(_batched_pack(inputs, n_slots))
 
 
 def _decode_actions(batch: ConsolidationBatch, verdicts, now: float
@@ -351,7 +366,109 @@ def _decode_actions(batch: ConsolidationBatch, verdicts, now: float
     return actions
 
 
-def _verdicts(batch: ConsolidationBatch, mesh):
+# device-resident catalog arrays for grid-less callers (the deprovisioner,
+# the capture harness): without this every sweep re-shipped alloc_t/tiebreak
+# host->device. Keyed on the grid OBJECT (weakref — numpy arrays are not
+# weakref-able) + seqnum; a dead ref is a miss, never an aliasing hazard.
+_dev_grid_memo: "tuple | None" = None  # (weakref(grid), seqnum, dev_alloc, dev_tb)
+
+
+def _dev_grid_arrays(grid: OptionGrid):
+    global _dev_grid_memo
+    m = _dev_grid_memo
+    if m is not None and m[0]() is grid and m[1] == grid.seqnum:
+        return m[2], m[3]
+    dev_alloc = jax.device_put(grid.alloc_t)
+    dev_tb = jax.device_put(grid.tiebreak)
+    _dev_grid_memo = (_weakref.ref(grid), grid.seqnum, dev_alloc, dev_tb)
+    return dev_alloc, dev_tb
+
+
+def _flatten_batch(batch: ConsolidationBatch):
+    """Host-side pack of every DYNAMIC leaf into two contiguous buffers
+    (one i32, one u8): on the tunneled device each host->device transfer is
+    a per-OPERATION cost (solver-boundary.md cost model — the round-4
+    on-chip sweep paid ~16 per-leaf puts), so the sweep ships exactly two
+    arrays however many leaves the problem has. The static catalog arrays
+    (alloc_t/tiebreak) stay device-resident via _dev_grid_arrays.
+
+    Returns (i32_buf, u8_buf, dims) where dims is the static shape tuple
+    _verdicts_flat uses to slice the buffers back apart at trace time."""
+    inp = batch.inputs
+    C, Gb, R = inp.group_vec.shape
+    Ne = inp.ex_alloc.shape[0]
+    U = batch.feas_table.shape[0]
+    Pv, T, S = batch.feas_table.shape[1:]
+    i32_parts = [inp.group_vec, inp.group_count, inp.group_cap,
+                 inp.group_newprov, inp.group_origin, inp.overhead,
+                 inp.ex_alloc, inp.ex_used, batch.feas_idx]
+    if inp.ex_cap is not None:
+        i32_parts.append(inp.ex_cap)
+    if inp.prov_overhead is not None:
+        i32_parts.append(inp.prov_overhead)
+    if inp.prov_pods_cap is not None:
+        i32_parts.append(inp.prov_pods_cap)
+    i32 = np.concatenate(
+        [np.ascontiguousarray(a, dtype=np.int32).ravel() for a in i32_parts])
+    u8 = np.concatenate(
+        [np.ascontiguousarray(inp.ex_feas, dtype=np.uint8).ravel(),
+         np.ascontiguousarray(batch.feas_table, dtype=np.uint8).ravel()])
+    dims = (C, Gb, R, Ne, U, Pv, T, S,
+            inp.ex_cap is not None, inp.prov_overhead is not None,
+            inp.prov_pods_cap is not None)
+    return i32, u8, dims
+
+
+@functools.partial(jax.jit, static_argnames=("dims", "n_slots"))
+def _verdicts_flat(i32, u8, alloc_t, tiebreak, dims, n_slots):
+    """Device-side unpack of _flatten_batch's two buffers + the batched
+    pack reduced to the [C, 3] verdict table. Slicing/reshaping is trace
+    time bookkeeping (XLA sees static offsets); the whole sweep is ONE
+    h2d-light dispatch and one 12-byte-per-lane read."""
+    import jax.numpy as jnp
+
+    (C, Gb, R, Ne, U, Pv, T, S, has_excap, has_povh, has_pcap) = dims
+    o = [0]
+
+    def take(n, shape):
+        part = i32[o[0]:o[0] + n]  # static offsets: resolved at trace time
+        o[0] += n
+        return part.reshape(shape)
+
+    group_vec = take(C * Gb * R, (C, Gb, R))
+    group_count = take(C * Gb, (C, Gb))
+    group_cap = take(C * Gb, (C, Gb))
+    group_newprov = take(C * Gb, (C, Gb))
+    group_origin = take(C * Gb, (C, Gb))
+    overhead = take(R, (R,))
+    ex_alloc = take(Ne * R, (Ne, R))
+    ex_used = take(Ne * R, (Ne, R))
+    feas_idx = take(C * Gb, (C, Gb))
+    ex_cap = take(C * Gb * Ne, (C, Gb, Ne)) if has_excap else None
+    prov_overhead = take(Pv * R, (Pv, R)) if has_povh else None
+    prov_pods_cap = take(Pv * T, (Pv, T)) if has_pcap else None
+    # trace-time drift guard: a new array added to _flatten_batch without
+    # the matching take() here would otherwise read shifted garbage that
+    # still reshapes cleanly — fail loudly instead
+    assert o[0] == i32.shape[0], (
+        f"i32 layout drift: consumed {o[0]} of {i32.shape[0]}")
+    assert u8.shape[0] == C * Gb * Ne + U * Pv * T * S, (
+        f"u8 layout drift: {u8.shape[0]} != {C * Gb * Ne + U * Pv * T * S}")
+    ex_feas = u8[:C * Gb * Ne].reshape(C, Gb, Ne).astype(bool)
+    feas_table = u8[C * Gb * Ne:].reshape(U, Pv, T, S).astype(bool)
+    inputs = PackInputs(
+        alloc_t=alloc_t, tiebreak=tiebreak,
+        group_vec=group_vec, group_count=group_count, group_cap=group_cap,
+        group_feas=jnp.take(feas_table, feas_idx, axis=0),
+        group_newprov=group_newprov, overhead=overhead,
+        ex_alloc=ex_alloc, ex_used=ex_used, ex_feas=ex_feas,
+        prov_overhead=prov_overhead, prov_pods_cap=prov_pods_cap,
+        ex_cap=ex_cap, group_origin=group_origin,
+    )
+    return _reduce_verdicts(_batched_pack(inputs, n_slots))
+
+
+def _verdicts(batch: ConsolidationBatch, mesh, timings: "dict | None" = None):
     """Single-device dispatch, or candidate lanes sharded over a mesh
     (pure data parallelism — see parallel/sharded.py make_lane_mesh)."""
     if mesh is not None:
@@ -362,10 +479,25 @@ def _verdicts(batch: ConsolidationBatch, mesh):
             feas_table=batch.feas_table, feas_idx=batch.feas_idx)
     from ..solver.core import host_fetch  # honors --readback callback
 
-    return host_fetch(_batched_pack_verdicts(
-        jax.device_put(batch.inputs), N_SLOTS,
-        feas_table=jax.device_put(batch.feas_table),
-        feas_idx=jax.device_put(batch.feas_idx)))
+    t0 = _time.perf_counter()
+    i32, u8, dims = _flatten_batch(batch)
+    dev_alloc, dev_tb = _dev_grid_arrays(batch.grid)
+    t1 = _time.perf_counter()
+    dev_i32 = jax.device_put(i32)
+    dev_u8 = jax.device_put(u8)
+    t2 = _time.perf_counter()
+    flat = _verdicts_flat(dev_i32, dev_u8, dev_alloc, dev_tb, dims, N_SLOTS)
+    t3 = _time.perf_counter()
+    out = host_fetch(flat)
+    if timings is not None:
+        t4 = _time.perf_counter()
+        timings.update({
+            "flatten_ms": round((t1 - t0) * 1000, 3),
+            "put_ms": round((t2 - t1) * 1000, 3),
+            "dispatch_ms": round((t3 - t2) * 1000, 3),
+            "fetch_ms": round((t4 - t3) * 1000, 3),
+        })
+    return out
 
 
 def run_consolidation(
@@ -388,6 +520,8 @@ def run_consolidation(
     dispatch (one device round trip — the unit a tunneled link charges);
     mechanism precedence is applied to the decoded verdicts instead of
     sequencing two dispatches."""
+    global last_timings
+    t0 = _time.perf_counter()
     provs_sorted = sorted(provisioners, key=lambda p: (-p.weight, p.name))
     cand_nodes = [cluster.nodes[name] for name in sorted(cluster.nodes)
                   if eligible(cluster.nodes[name], cluster)
@@ -403,7 +537,17 @@ def run_consolidation(
                                  daemon_overhead, grid, cand_sets=sets)
     if batch is None:
         return None
-    actions = _decode_actions(batch, _verdicts(batch, mesh), now)
+    timings: "dict | None" = {} if _SOLVE_TIMING else None
+    t1 = _time.perf_counter()
+    verdicts = _verdicts(batch, mesh, timings=timings)
+    t2 = _time.perf_counter()
+    actions = _decode_actions(batch, verdicts, now)
+    if timings is not None:
+        timings["encode_ms"] = round((t1 - t0) * 1000, 3)
+        timings["verdicts_ms"] = round((t2 - t1) * 1000, 3)
+        timings["decode_ms"] = round((_time.perf_counter() - t2) * 1000, 3)
+        timings["lanes"] = len(batch.candidates)
+        last_timings = timings
     if not actions:
         return None
     multi_actions = [a for a in actions if len(a.nodes) > 1]
