@@ -8,7 +8,7 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit lint test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry
+.PHONY: presubmit lint test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog
 
 presubmit: lint test verify-entry  ## what CI runs
 
@@ -38,6 +38,9 @@ benchmark:  ## interruption ladder + BASELINE configs, RECORDED + diffed
 
 bench:  ## the headline one-line benchmark (real TPU when present)
 	$(PY) bench.py
+
+catalog:  ## regenerate the real-data fleet catalog (provenance in the output)
+	$(PY) hack/gen_catalog.py
 
 e2e:  ## E2E-analogue scenario suites only
 	$(CPU_ENV) $(PY) -m pytest tests/test_e2e_scenarios.py tests/test_controllers.py -q

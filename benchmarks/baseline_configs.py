@@ -19,7 +19,7 @@ Configs (BASELINE.json):
   7  4x stress: 200k pods, same shape as 4 — beyond-reference scale point
   8  ICE storm: p50 first-solve-after-an-ICE-mark at config-1 shape — the
      static-grid fast path (docs/designs/bin-packing-kernel.md)
-  9  20x stress: 1M pods x 551 types in one sharded dispatch
+  9  20x stress: 1M pods x the full real fleet in one sharded dispatch
 
 Usage: python -m benchmarks.baseline_configs [--configs 0,1,...,9]
 """
@@ -195,7 +195,7 @@ def stress_problem_50k(n_pods: int = 50_000):
     """BASELINE.json configs[4] shape, the ONE definition shared by the
     recorded benchmark (config_4_stress_50k) and the driver's multichip
     dryrun (__graft_entry__.dryrun_multichip) so the CI parity check can
-    never desynchronize from the benchmarked shape: full 551-type fleet
+    never desynchronize from the benchmarked shape: full 603-type real fleet
     catalog, 8 provisioners with overlapping requirements, 25 deployments.
     Returns (catalog, provisioners, pods)."""
     catalog = generate_fleet_catalog()
@@ -228,7 +228,7 @@ def config_4_stress_50k() -> dict:
 
 
 def config_9_stress_1m() -> dict:
-    """20x the 50k stress shape: one MILLION pending pods x 551 types in a
+    """20x the 50k stress shape: one MILLION pending pods x the full 603-type real fleet in a
     single sharded dispatch — far beyond any scale the sequential
     reference's per-pod loop entertains (its own E2E ceiling is ~100-pod
     utilization suites). Repeats kept low: the point is that the shape
@@ -322,18 +322,24 @@ def config_5_pair_sweep() -> dict:
     from karpenter_tpu.ops.consolidate import run_consolidation
 
     catalog = generate_fleet_catalog()
+    # the globally cheapest >=8-vCPU type: nothing cheaper can host a full
+    # node's pods, so no single-node action exists
+    big = min((t for t in catalog.types
+               if dict(t.capacity)[wk.RESOURCE_CPU] >= 8000),
+              key=lambda t: t.offerings[0].price)
     # a bulk-discounted big type (sub-linear pricing): the shape where pair
-    # consolidation wins but single-node search cannot
+    # consolidation wins but single-node search cannot — priced so one bulk
+    # node undercuts TWO `big` nodes but not one
+    bulk_price = round(big.offerings[0].price * 1.7, 4)
     catalog.types.append(make_instance_type(
-        "bulk.32xlarge", cpu=32, memory="128Gi", od_price=0.55))
+        "bulk.32xlarge", cpu=32, memory="128Gi", od_price=bulk_price))
     catalog.bump()  # rebuilds by_name too
     prov = _provisioner(consolidation_enabled=True)
     cluster = ClusterState()
-    big = catalog.by_name["c8.2xlarge"]  # cheapest amd64 8-vcpu type
     alloc = big.allocatable_vector()
     cpu_free = alloc[wk.RESOURCE_INDEX[wk.RESOURCE_CPU]]
     # FULL nodes: no cheaper single type fits a node's pods, but two nodes'
-    # pods collapse onto one bulk.32xlarge (0.55 < 2x c8.2xlarge)
+    # pods collapse onto one bulk.32xlarge (1.7x < 2x big's price)
     for i in range(64):
         n_pods = max(1, cpu_free // 1000)
         node = StateNode(

@@ -1,0 +1,229 @@
+"""HTTP cloud-backend driver (VERDICT r4 ask #4).
+
+The production half of the L7 cloud-session boundary: until now every
+deployment path terminated in the in-memory simulated backend
+(fake/cloud.py). This package adds a real wire driver — session bootstrap,
+region discovery, connectivity dry-run, retrying JSON-over-HTTP transport,
+and error-taxonomy mapping — so the framework serializes real launch
+requests over a socket. The server half (cloudbackend/server.py) is the
+recorded/stub backend the driver is tested against; a real deployment
+points the session at whatever endpoint speaks the same protocol.
+
+Parity targets:
+- session bootstrap + region discovery + EC2 connectivity dry-run:
+  /root/reference/pkg/context/context.go:53-99 (NewOrDie: session with
+  retryer, IMDS region fallback, checkEC2Connectivity DryRun probe,
+  user-agent handler :84-89)
+- error taxonomy mapping: /root/reference/pkg/errors/errors.go:52-79
+  (IsNotFound / IsUnfulfillableCapacity / IsLaunchTemplateNotFound) —
+  wire errors rehydrate into the SAME CloudError/FleetError types the
+  providers and batchers already branch on (utils/errors.py), so every
+  layer above the boundary is transport-agnostic.
+
+The client implements the exact duck-typed surface of fake/cloud.py
+FakeCloud — one shared contract suite (tests/test_cloudbackend.py) runs
+against both, which is the proof the boundary holds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Optional, Sequence
+
+from ..fake.cloud import (CloudInstance, CreateFleetRequest,
+                          CreateFleetResponse, FleetPoolError, Image,
+                          LaunchTemplate, SecurityGroup, Subnet)
+from ..utils import errors as cloud_errors
+
+USER_AGENT = "karpenter-tpu/0.1"
+DEFAULT_RETRIES = 3  # client.DefaultRetryer parity (context.go:58-60)
+RETRY_BACKOFF_S = 0.05
+
+
+class ConnectivityError(Exception):
+    """Session bootstrap failed: endpoint unreachable or dry-run rejected
+    (the reference treats this as fatal at boot, context.go:67-69)."""
+
+
+class CloudSession:
+    """Bootstrapped connection context for the HTTP backend.
+
+    Construction performs the reference's NewOrDie sequence:
+    1. resolve the region — explicit arg, else KARPENTER_TPU_REGION env,
+       else the endpoint's metadata service (GET /imds/region — the IMDS
+       analogue, context.go:61-65);
+    2. dry-run connectivity probe (DescribeInstanceTypes with dry_run:
+       the expected outcome is the DryRunOperation error code — an actual
+       listing means the flag was ignored; anything else is a failed boot,
+       context.go:91-99).
+    """
+
+    def __init__(self, endpoint: str, region: str = "",
+                 retries: int = DEFAULT_RETRIES, timeout_s: float = 10.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.retries = retries
+        self.timeout_s = timeout_s
+        self.region = (region or os.environ.get("KARPENTER_TPU_REGION")
+                       or self._discover_region())
+        self.check_connectivity()
+
+    # -- transport ----------------------------------------------------------------
+
+    def call(self, action: str, payload: dict) -> dict:
+        """POST /api/<action>; retry transient failures (connection errors
+        and 5xx) with linear backoff; rehydrate structured cloud errors."""
+        body = json.dumps(payload).encode()
+        last: "Exception | None" = None
+        for attempt in range(self.retries + 1):
+            req = urllib.request.Request(
+                f"{self.endpoint}/api/{action}", data=body,
+                headers={"Content-Type": "application/json",
+                         "User-Agent": USER_AGENT,
+                         "X-Region": self.region or ""})
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                    return json.loads(r.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                data = e.read()
+                if e.code >= 500:  # transient server side: retry
+                    last = e
+                else:
+                    raise _rehydrate_error(data) from None
+            except (urllib.error.URLError, TimeoutError, OSError) as e:
+                last = e
+            if attempt < self.retries:
+                time.sleep(RETRY_BACKOFF_S * (attempt + 1))
+        raise ConnectivityError(
+            f"{action} failed after {self.retries + 1} attempts: {last}")
+
+    def _discover_region(self) -> str:
+        """Metadata-service region discovery (IMDS analogue)."""
+        req = urllib.request.Request(
+            f"{self.endpoint}/imds/region",
+            headers={"User-Agent": USER_AGENT})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return json.loads(r.read()).get("region", "")
+        except (urllib.error.URLError, TimeoutError, OSError, ValueError) as e:
+            raise ConnectivityError(
+                f"region discovery against {self.endpoint} failed: {e}") from e
+
+    def check_connectivity(self) -> None:
+        """Dry-run DescribeInstanceTypes; success IS the DryRunOperation
+        error (checkEC2Connectivity, context.go:91-99)."""
+        try:
+            self.call("DescribeInstanceTypes", {"dry_run": True})
+        except cloud_errors.CloudError as e:
+            if e.code == "DryRunOperation":
+                return
+            raise ConnectivityError(f"dry-run probe rejected: {e}") from e
+        raise ConnectivityError(
+            "dry-run probe returned data instead of DryRunOperation — "
+            "endpoint ignored the dry_run flag")
+
+
+def _rehydrate_error(data: bytes) -> Exception:
+    """Wire error -> the taxonomy type the stack already branches on."""
+    try:
+        doc = json.loads(data)
+    except ValueError:
+        doc = {}
+    code = doc.get("code", "InternalError")
+    message = doc.get("message", "")
+    pools = doc.get("failed_pools")
+    if pools is not None:
+        return cloud_errors.FleetError(
+            code, [tuple(p) for p in pools], message)
+    return cloud_errors.CloudError(code, message)
+
+
+class HttpCloud:
+    """FakeCloud-surface client over a CloudSession: the drop-in `cloud`
+    object for providers, batchers, and the operator."""
+
+    def __init__(self, session: CloudSession):
+        self.session = session
+
+    # -- fleet ---------------------------------------------------------------
+
+    def create_fleet(self, request: CreateFleetRequest) -> CreateFleetResponse:
+        # client token (EC2 ClientToken semantics): the transport retries
+        # timeouts/5xx, and a retry of a CreateFleet whose RESPONSE was
+        # lost must replay the first launch, not run a second one — the
+        # server dedupes on the token (cloudbackend/server.py)
+        payload = dataclasses.asdict(request)
+        payload["client_token"] = uuid.uuid4().hex
+        doc = self.session.call("CreateFleet", payload)
+        return CreateFleetResponse(
+            instance_ids=list(doc.get("instance_ids", ())),
+            errors=[FleetPoolError(**e) for e in doc.get("errors", ())])
+
+    def describe_instances(self, ids: Sequence[str]) -> "list[CloudInstance]":
+        doc = self.session.call("DescribeInstances", {"ids": list(ids)})
+        return [CloudInstance(**d) for d in doc.get("instances", ())]
+
+    def create_tags(self, instance_id: str, tags: "dict[str, str]") -> None:
+        self.session.call("CreateTags",
+                          {"instance_id": instance_id, "tags": dict(tags)})
+
+    def describe_instances_by_tag(self, key: str, value: str
+                                  ) -> "list[CloudInstance]":
+        doc = self.session.call("DescribeInstancesByTag",
+                                {"key": key, "value": value})
+        return [CloudInstance(**d) for d in doc.get("instances", ())]
+
+    def terminate_instances(self, ids: Sequence[str]
+                            ) -> "list[tuple[str, str]]":
+        doc = self.session.call("TerminateInstances", {"ids": list(ids)})
+        return [tuple(x) for x in doc.get("states", ())]
+
+    # -- launch templates ----------------------------------------------------
+
+    def create_launch_template(self, lt: LaunchTemplate) -> None:
+        self.session.call("CreateLaunchTemplate", dataclasses.asdict(lt))
+
+    def describe_launch_templates(self, tag_key: str = "",
+                                  tag_value: str = "") -> "list[LaunchTemplate]":
+        doc = self.session.call("DescribeLaunchTemplates",
+                                {"tag_key": tag_key, "tag_value": tag_value})
+        return [LaunchTemplate(**d) for d in doc.get("launch_templates", ())]
+
+    def delete_launch_template(self, name: str) -> None:
+        self.session.call("DeleteLaunchTemplate", {"name": name})
+
+    # -- discovery -----------------------------------------------------------
+
+    def describe_subnets(self, selector: "dict[str, str]") -> "list[Subnet]":
+        doc = self.session.call("DescribeSubnets", {"selector": dict(selector)})
+        return [Subnet(**d) for d in doc.get("subnets", ())]
+
+    def describe_security_groups(self, selector: "dict[str, str]"
+                                 ) -> "list[SecurityGroup]":
+        doc = self.session.call("DescribeSecurityGroups",
+                                {"selector": dict(selector)})
+        return [SecurityGroup(**d) for d in doc.get("security_groups", ())]
+
+    def describe_images(self, selector: "dict[str, str]") -> "list[Image]":
+        doc = self.session.call("DescribeImages", {"selector": dict(selector)})
+        return [Image(**d) for d in doc.get("images", ())]
+
+    def get_ssm_parameter(self, name: str) -> str:
+        return self.session.call("GetSSMParameter", {"name": name})["value"]
+
+    def get_prices(self) -> "dict[tuple[str, str, str], float]":
+        doc = self.session.call("GetPrices", {})
+        return {(t, ct, z): p for t, ct, z, p in doc.get("prices", ())}
+
+
+def connect(endpoint: str, region: str = "",
+            retries: int = DEFAULT_RETRIES) -> HttpCloud:
+    """Bootstrap a session (region discovery + connectivity dry-run) and
+    return the drop-in cloud client. Raises ConnectivityError at boot the
+    way the reference's NewOrDie is fatal (context.go:53)."""
+    return HttpCloud(CloudSession(endpoint, region=region, retries=retries))

@@ -1,0 +1,189 @@
+"""Stub cloud-API server: the wire-protocol reference implementation.
+
+Serves the JSON-over-HTTP protocol that cloudbackend.HttpCloud speaks,
+backed by any FakeCloud-surface object (normally fake/cloud.py's stateful
+simulator — ICE pools, eventual consistency, MockedFunction fault
+injection all work THROUGH the wire). Tests boot it on 127.0.0.1:0; a
+deployment could equally run it as a sidecar adapter in front of a real
+provisioning API.
+
+Protocol:
+  GET  /imds/region          -> {"region": ...}           (IMDS analogue)
+  POST /api/<Action>  JSON   -> 200 JSON result
+                              | 400 {"code", "message"[, "failed_pools"]}
+                              | 500 {"code": "InternalError", ...}
+  DescribeInstanceTypes with {"dry_run": true} -> 400 DryRunOperation
+  (the connectivity probe contract, reference context.go:91-99).
+
+Faults for retry testing: fail_next_with(status) makes the next N
+requests return that HTTP status before the handler runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..fake.cloud import CreateFleetRequest, FleetOverride, LaunchTemplate
+from ..utils import errors as cloud_errors
+
+
+def _asdicts(items) -> "list[dict]":
+    return [dataclasses.asdict(i) for i in items]
+
+
+class CloudAPIServer:
+    """ThreadingHTTPServer wrapper with a real port and clean shutdown."""
+
+    def __init__(self, cloud, region: str = "us-test-1",
+                 host: str = "127.0.0.1", port: int = 0):
+        self.cloud = cloud
+        self.region = region
+        self._fail_next: "list[int]" = []  # pending injected HTTP statuses
+        self._fleet_replies: "dict[str, dict]" = {}  # client-token dedupe
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/imds/region":
+                    self._reply(200, {"region": outer.region})
+                else:
+                    self._reply(404, {"code": "ResourceNotFound",
+                                      "message": self.path})
+
+            def do_POST(self):
+                with outer._lock:
+                    injected = (outer._fail_next.pop(0)
+                                if outer._fail_next else None)
+                if injected is not None:
+                    self._reply(injected, {"code": "InternalError",
+                                           "message": "injected fault"})
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    self._reply(400, {"code": "MalformedRequest",
+                                      "message": "bad json"})
+                    return
+                action = self.path.rsplit("/", 1)[-1]
+                try:
+                    self._reply(200, outer.dispatch(action, payload))
+                except cloud_errors.FleetError as e:
+                    self._reply(400, {"code": e.code, "message": e.message,
+                                      "failed_pools": [list(p) for p in
+                                                       e.failed_pools]})
+                except cloud_errors.CloudError as e:
+                    self._reply(400, {"code": e.code, "message": e.message})
+                except Exception as e:  # noqa: BLE001 — wire boundary
+                    self._reply(500, {"code": "InternalError",
+                                      "message": str(e)[:200]})
+
+            def _reply(self, status: int, doc: dict):
+                body = json.dumps(doc).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "CloudAPIServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def fail_next_with(self, status: int, times: int = 1) -> None:
+        with self._lock:
+            self._fail_next.extend([status] * times)
+
+    # -- dispatch ------------------------------------------------------------
+
+    def dispatch(self, action: str, p: dict) -> dict:
+        cloud = self.cloud
+        if action == "DescribeInstanceTypes":
+            if p.get("dry_run"):
+                # success-by-error: the connectivity probe contract
+                raise cloud_errors.CloudError(
+                    "DryRunOperation", "dry run succeeded")
+            return {"instance_types": [t.name for t in
+                                       getattr(cloud, "catalog", None).types]
+                    if getattr(cloud, "catalog", None) else []}
+        if action == "CreateFleet":
+            # client-token dedupe (EC2 ClientToken semantics): a transport
+            # retry whose first attempt launched but lost the response
+            # replays the recorded result instead of double-launching
+            token = p.get("client_token", "")
+            if token:
+                with self._lock:
+                    hit = self._fleet_replies.get(token)
+                if hit is not None:
+                    return hit
+            req = CreateFleetRequest(
+                launch_template=p["launch_template"],
+                overrides=[FleetOverride(**o) for o in p["overrides"]],
+                capacity=p["capacity"], capacity_type=p["capacity_type"],
+                tags=p.get("tags") or {}, image_id=p.get("image_id", ""),
+                fleet_context=p.get("fleet_context", ""))
+            resp = cloud.create_fleet(req)
+            out = {"instance_ids": resp.instance_ids,
+                   "errors": _asdicts(resp.errors)}
+            if token:
+                with self._lock:
+                    self._fleet_replies[token] = out
+                    while len(self._fleet_replies) > 1024:  # bounded memory
+                        self._fleet_replies.pop(
+                            next(iter(self._fleet_replies)))
+            return out
+        if action == "DescribeInstances":
+            return {"instances": _asdicts(cloud.describe_instances(p["ids"]))}
+        if action == "CreateTags":
+            cloud.create_tags(p["instance_id"], p["tags"])
+            return {}
+        if action == "DescribeInstancesByTag":
+            return {"instances": _asdicts(
+                cloud.describe_instances_by_tag(p["key"], p["value"]))}
+        if action == "TerminateInstances":
+            return {"states": [list(s) for s in
+                               cloud.terminate_instances(p["ids"])]}
+        if action == "CreateLaunchTemplate":
+            cloud.create_launch_template(LaunchTemplate(**p))
+            return {}
+        if action == "DescribeLaunchTemplates":
+            return {"launch_templates": _asdicts(cloud.describe_launch_templates(
+                p.get("tag_key", ""), p.get("tag_value", "")))}
+        if action == "DeleteLaunchTemplate":
+            cloud.delete_launch_template(p["name"])
+            return {}
+        if action == "DescribeSubnets":
+            return {"subnets": _asdicts(cloud.describe_subnets(p["selector"]))}
+        if action == "DescribeSecurityGroups":
+            return {"security_groups": _asdicts(
+                cloud.describe_security_groups(p["selector"]))}
+        if action == "DescribeImages":
+            return {"images": _asdicts(cloud.describe_images(p["selector"]))}
+        if action == "GetSSMParameter":
+            return {"value": cloud.get_ssm_parameter(p["name"])}
+        if action == "GetPrices":
+            return {"prices": [[t, ct, z, price] for (t, ct, z), price
+                               in cloud.get_prices().items()]}
+        raise cloud_errors.CloudError("UnknownAction", action)

@@ -1,0 +1,237 @@
+"""Shared cloud-backend contract suite (VERDICT r4 ask #4).
+
+One suite, two drivers: the in-memory FakeCloud and the HTTP driver
+(cloudbackend.HttpCloud -> CloudAPIServer -> FakeCloud). Green against
+both is the proof that the L7 boundary is transport-agnostic — every
+provider/batcher behavior above it exercises identical semantics whether
+the backend is in-process or across a socket.
+
+Reference parity: session bootstrap contract context.go:53-99 (region
+discovery, connectivity dry-run, retryer); error taxonomy round-trip
+errors.go:52-79.
+"""
+
+import pytest
+
+from karpenter_tpu.cloudbackend import (CloudSession, ConnectivityError,
+                                        HttpCloud, connect)
+from karpenter_tpu.cloudbackend.server import CloudAPIServer
+from karpenter_tpu.fake.cloud import (CreateFleetRequest, FakeCloud,
+                                      FleetOverride, LaunchTemplate)
+from karpenter_tpu.providers.instancetypes import generate_fleet_catalog
+from karpenter_tpu.utils import errors as cloud_errors
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return generate_fleet_catalog(max_types=40)
+
+
+@pytest.fixture(params=["fake", "http"])
+def cloud(request, catalog):
+    backing = FakeCloud(catalog=catalog)
+    if request.param == "fake":
+        backing.backing = backing  # uniform access to the simulator state
+        yield backing
+        return
+    server = CloudAPIServer(backing, region="us-test-1").start()
+    try:
+        client = connect(server.endpoint)
+        client.backing = backing  # state seeding stays out-of-band (tests
+        # poke ICE pools the way the reference pokes its fake EC2 directly)
+        yield client
+    finally:
+        server.stop()
+
+
+def _fleet_request(lt="lt-1", pools=(("a1.large", "zone-1a", 0.05),),
+                   capacity=2, capacity_type="on-demand"):
+    return CreateFleetRequest(
+        launch_template=lt,
+        overrides=[FleetOverride(instance_type=t, zone=z, price=p,
+                                 subnet_id=f"subnet-{z}")
+                   for t, z, p in pools],
+        capacity=capacity, capacity_type=capacity_type,
+        tags={"karpenter.sh/provisioner-name": "default"})
+
+
+class TestContract:
+    def test_fleet_launch_describe_terminate(self, cloud):
+        cloud.create_launch_template(LaunchTemplate(name="lt-1",
+                                                    image_id="img-amd64-2"))
+        resp = cloud.create_fleet(_fleet_request())
+        assert len(resp.instance_ids) == 2 and not resp.errors
+        got = cloud.describe_instances(resp.instance_ids)
+        assert {i.id for i in got} == set(resp.instance_ids)
+        # the fake flips pending->running on describe (eventual-consistency
+        # analogue); both states are live
+        assert all(i.instance_type == "a1.large" and i.zone == "zone-1a"
+                   and i.state in ("pending", "running") for i in got)
+        assert all(i.tags["karpenter.sh/provisioner-name"] == "default"
+                   for i in got)
+        states = cloud.terminate_instances(resp.instance_ids)
+        assert all(s == "terminated" for _, s in states)
+
+    def test_fleet_ice_pool_skips_to_next_cheapest(self, cloud):
+        # ICE seeding pokes the simulator state directly (the way the
+        # reference seeds its fake EC2); the fleet call runs THROUGH the
+        # driver under test
+        cloud.backing.insufficient_capacity_pools.add(
+            ("on-demand", "a1.large", "zone-1a"))
+        cloud.create_launch_template(LaunchTemplate(name="lt-1",
+                                                    image_id="img-amd64-2"))
+        resp = cloud.create_fleet(_fleet_request(
+            pools=(("a1.large", "zone-1a", 0.05),
+                   ("a1.xlarge", "zone-1b", 0.10))))
+        assert [e.code for e in resp.errors] == ["InsufficientInstanceCapacity"]
+        assert all(i.startswith("i-") for i in resp.instance_ids)
+
+    def test_launch_template_lifecycle_and_not_found(self, cloud):
+        cloud.create_launch_template(LaunchTemplate(
+            name="lt-x", image_id="img-amd64-1", tags={"owner": "karpenter"}))
+        lts = cloud.describe_launch_templates("owner", "karpenter")
+        assert [lt.name for lt in lts] == ["lt-x"]
+        cloud.delete_launch_template("lt-x")
+        with pytest.raises(cloud_errors.CloudError) as ei:
+            cloud.delete_launch_template("lt-x")
+        assert cloud_errors.is_launch_template_not_found(ei.value)
+
+    def test_fleet_missing_launch_template_maps_to_taxonomy(self, cloud):
+        with pytest.raises(cloud_errors.CloudError) as ei:
+            cloud.create_fleet(_fleet_request(lt="lt-missing"))
+        assert cloud_errors.is_launch_template_not_found(ei.value)
+
+    def test_describe_instances_not_found(self, cloud):
+        with pytest.raises(cloud_errors.CloudError) as ei:
+            cloud.terminate_instances(["i-doesnotexist"])
+        assert cloud_errors.is_not_found(ei.value)
+
+    def test_discovery_and_prices(self, cloud):
+        subnets = cloud.describe_subnets({"id": "subnet-zone-1a"})
+        assert [s.zone for s in subnets] == ["zone-1a"]
+        sgs = cloud.describe_security_groups(
+            {"kubernetes.io/cluster/test-cluster": "owned"})
+        assert [g.id for g in sgs] == ["sg-default"]
+        images = cloud.describe_images({"id": "img-arm64-1"})
+        assert [i.arch for i in images] == ["arm64"]
+        assert cloud.get_ssm_parameter(
+            "/karpenter-tpu/images/default/amd64/latest") == "img-amd64-2"
+        with pytest.raises(cloud_errors.CloudError) as ei:
+            cloud.get_ssm_parameter("/missing")
+        assert cloud_errors.is_not_found(ei.value)
+        prices = cloud.get_prices()
+        assert prices[("a1.large", "on-demand", "zone-1a")] == pytest.approx(
+            0.051)
+
+    def test_tagging_round_trip(self, cloud):
+        cloud.create_launch_template(LaunchTemplate(name="lt-1",
+                                                    image_id="img-amd64-2"))
+        resp = cloud.create_fleet(_fleet_request(capacity=1))
+        iid = resp.instance_ids[0]
+        cloud.create_tags(iid, {"Name": "karpenter-node"})
+        got = cloud.describe_instances_by_tag("Name", "karpenter-node")
+        assert [i.id for i in got] == [iid]
+
+
+class TestHttpDriverSpecifics:
+    """Wire-only behaviors: bootstrap, retries, fault mapping."""
+
+    def test_ice_errors_cross_the_wire(self, catalog):
+        backing = FakeCloud(catalog=catalog)
+        backing.insufficient_capacity_pools.add(
+            ("spot", "a1.large", "zone-1a"))
+        backing.create_launch_template(LaunchTemplate(name="lt-1",
+                                                      image_id="img-amd64-2"))
+        server = CloudAPIServer(backing).start()
+        try:
+            cloud = connect(server.endpoint)
+            resp = cloud.create_fleet(_fleet_request(
+                pools=(("a1.large", "zone-1a", 0.02),), capacity_type="spot"))
+            assert not resp.instance_ids
+            assert [(e.code, e.instance_type, e.zone) for e in resp.errors] \
+                == [("InsufficientInstanceCapacity", "a1.large", "zone-1a")]
+            assert cloud_errors.is_unfulfillable_capacity(
+                cloud_errors.CloudError(resp.errors[0].code))
+        finally:
+            server.stop()
+
+    def test_session_discovers_region_from_metadata(self, catalog):
+        server = CloudAPIServer(FakeCloud(catalog=catalog),
+                                region="eu-test-9").start()
+        try:
+            sess = CloudSession(server.endpoint)
+            assert sess.region == "eu-test-9"
+        finally:
+            server.stop()
+
+    def test_session_explicit_region_skips_discovery(self, catalog):
+        server = CloudAPIServer(FakeCloud(catalog=catalog)).start()
+        try:
+            assert CloudSession(server.endpoint,
+                                region="us-explicit-1").region == "us-explicit-1"
+        finally:
+            server.stop()
+
+    def test_connectivity_dry_run_fails_fast_when_unreachable(self):
+        with pytest.raises(ConnectivityError):
+            CloudSession("http://127.0.0.1:1", retries=0, timeout_s=0.5)
+
+    def test_transient_500_retries_then_succeeds(self, catalog):
+        backing = FakeCloud(catalog=catalog)
+        backing.create_launch_template(LaunchTemplate(name="lt-1",
+                                                      image_id="img-amd64-2"))
+        server = CloudAPIServer(backing).start()
+        try:
+            cloud = connect(server.endpoint)
+            server.fail_next_with(500, times=2)
+            resp = cloud.create_fleet(_fleet_request(capacity=1))
+            assert len(resp.instance_ids) == 1  # 2 injected faults < 3 retries
+        finally:
+            server.stop()
+
+    def test_create_fleet_client_token_dedupes_replay(self, catalog):
+        """A retried CreateFleet whose first attempt launched but lost the
+        response must replay the recorded result, not double-launch."""
+        import dataclasses
+
+        backing = FakeCloud(catalog=catalog)
+        backing.create_launch_template(LaunchTemplate(name="lt-1",
+                                                      image_id="img-amd64-2"))
+        server = CloudAPIServer(backing).start()
+        try:
+            cloud = connect(server.endpoint)
+            payload = dataclasses.asdict(_fleet_request(capacity=2))
+            payload["client_token"] = "tok-1"
+            first = cloud.session.call("CreateFleet", payload)
+            replay = cloud.session.call("CreateFleet", payload)  # same token
+            assert replay["instance_ids"] == first["instance_ids"]
+            assert len(backing.instances) == 2  # no second launch
+        finally:
+            server.stop()
+
+    def test_retries_exhausted_raises_connectivity(self, catalog):
+        server = CloudAPIServer(FakeCloud(catalog=catalog)).start()
+        try:
+            cloud = connect(server.endpoint)
+            server.fail_next_with(500, times=10)
+            with pytest.raises(ConnectivityError):
+                cloud.describe_instances(["i-1"])
+        finally:
+            server.stop()
+
+    def test_providers_run_over_the_wire(self, catalog):
+        """Drop-in proof: the resource providers run unmodified against
+        HttpCloud."""
+        from karpenter_tpu.providers.securitygroup import SecurityGroupProvider
+        from karpenter_tpu.providers.subnet import SubnetProvider
+
+        server = CloudAPIServer(FakeCloud(catalog=catalog)).start()
+        try:
+            cloud = connect(server.endpoint)
+            subnets = SubnetProvider(cloud).list({"id": "subnet-zone-1b"})
+            assert [s.zone for s in subnets] == ["zone-1b"]
+            sgs = SecurityGroupProvider(cloud).list(
+                {"kubernetes.io/cluster/test-cluster": "owned"})
+            assert [g.id for g in sgs] == ["sg-default"]
+        finally:
+            server.stop()

@@ -541,12 +541,19 @@ def test_enable_pod_eni_advertises_branch_interfaces():
                for t in provider.list(None).types)
     settings.enable_pod_eni = True
     cat = provider.list(None)
-    nitro = [t for t in cat.types
-             if dict(t.labels).get(wk.LABEL_INSTANCE_HYPERVISOR) == "nitro"]
+    trunking = [t for t in cat.types
+                if wk.RESOURCE_POD_ENI in dict(t.capacity)]
     xen = [t for t in cat.types
            if dict(t.labels).get(wk.LABEL_INSTANCE_HYPERVISOR) == "xen"]
-    assert nitro and all(
-        dict(t.capacity).get(wk.RESOURCE_POD_ENI, 0) > 0 for t in nitro)
+    # real-data semantics: only trunking-compatible types advertise their
+    # BAKED branch counts (limits table via hack/gen_catalog.py); a nitro
+    # type without trunking support (t4g) must NOT have capacity fabricated
+    assert trunking and all(
+        dict(t.capacity)[wk.RESOURCE_POD_ENI] > 0 for t in trunking)
+    assert dict(cat.by_name["m5.2xlarge"].capacity).get(
+        wk.RESOURCE_POD_ENI, 0) == 38  # the limits-table value, not 3*cpu
+    assert wk.RESOURCE_POD_ENI not in dict(
+        cat.by_name["t4g.2xlarge"].capacity)  # nitro but non-trunking
     assert xen and all(
         wk.RESOURCE_POD_ENI not in dict(t.capacity) for t in xen)
     # a pod requesting pod-eni schedules end-to-end only when enabled

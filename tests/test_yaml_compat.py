@@ -302,7 +302,7 @@ spec:
         result2 = schedule_with_parity(loaded2)
         assert result2.unschedulable_count() == 0
         for n in result2.nodes:
-            assert "-arm" in n.option.itype.name
+            assert dict(n.option.itype.labels)[wk.LABEL_ARCH] == "arm64"
 
 
 class TestEndToEndManifestApply:
