@@ -47,6 +47,9 @@ class CloudAPIServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
             def log_message(self, *a):  # quiet
                 pass
 
@@ -58,6 +61,11 @@ class CloudAPIServer:
                                       "message": self.path})
 
             def do_POST(self):
+                # drain the body FIRST: on an HTTP/1.1 keep-alive socket an
+                # early reply that leaves body bytes unread corrupts the
+                # framing of the next request on the same connection
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n)
                 with outer._lock:
                     injected = (outer._fail_next.pop(0)
                                 if outer._fail_next else None)
@@ -65,9 +73,8 @@ class CloudAPIServer:
                     self._reply(injected, {"code": "InternalError",
                                            "message": "injected fault"})
                     return
-                n = int(self.headers.get("Content-Length", 0))
                 try:
-                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    payload = json.loads(raw or b"{}")
                 except ValueError:
                     self._reply(400, {"code": "MalformedRequest",
                                       "message": "bad json"})

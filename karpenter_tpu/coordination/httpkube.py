@@ -19,11 +19,14 @@ same pipeline as real admission webhooks — deploy/karpenter-tpu/).
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
+import socket
 import ssl
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Callable, Optional
 
@@ -143,6 +146,16 @@ class HttpKubeStore:
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._threads: "list[threading.Thread]" = []
+        # keep-alive connection pool for full-body requests (_request_json):
+        # one reusable connection per thread. A fresh TCP (+TLS) handshake
+        # per write capped the wire drain at ~10 ops/s in the deployed-
+        # topology benchmark (benchmarks/wire_bench.py); keep-alive is what
+        # a real client library does. Watch streams stay on urllib — they
+        # hold a connection open indefinitely and never return it usable.
+        split = urllib.parse.urlsplit(self.server)
+        self._netloc = split.netloc
+        self._https = split.scheme == "https"
+        self._pool_local = threading.local()
 
     @classmethod
     def from_kubeconfig(cls, path: str, **kw) -> "HttpKubeStore":
@@ -190,11 +203,91 @@ class HttpKubeStore:
         self.requests_total.inc(method=method, outcome="ok")
         return resp
 
+    def _pooled_conn(self) -> "tuple[http.client.HTTPConnection, bool]":
+        """(connection, fresh): fresh=True means it was just connected —
+        nothing has ever been sent on it. Raises OSError family on
+        connect failure (caller maps to ApiError(0))."""
+        c = getattr(self._pool_local, "conn", None)
+        if c is not None:
+            return c, False
+        if self._https:
+            c = http.client.HTTPSConnection(
+                self._netloc, timeout=self.timeout, context=self._ssl)
+        else:
+            c = http.client.HTTPConnection(
+                self._netloc, timeout=self.timeout)
+        c.connect()
+        # TCP_NODELAY: http.client writes headers and body as separate
+        # small segments; with Nagle on, the second segment waits out
+        # the peer's delayed ACK (~40ms) — at controller write rates
+        # that stall IS the wire benchmark's whole budget
+        c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._pool_local.conn = c
+        return c, True
+
+    def _drop_pooled_conn(self) -> None:
+        c = getattr(self._pool_local, "conn", None)
+        if c is not None:
+            self._pool_local.conn = None
+            try:
+                c.close()
+            except OSError:
+                pass
+
     def _request_json(self, method, url, body=None,
                       content_type: str = "application/json"):
-        with self._request(method, url, body,
-                           content_type=content_type) as resp:
-            return json.loads(resp.read() or b"{}")
+        """Full-body request over the per-thread keep-alive connection.
+        The response is always consumed completely, so the socket stays
+        reusable; a stale pooled socket (server closed it between calls)
+        gets ONE transparent reconnect."""
+        data = None if body is None else json.dumps(body).encode()
+        headers = {"Content-Type": content_type}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        split = urllib.parse.urlsplit(url)
+        path = split.path + (f"?{split.query}" if split.query else "")
+        for attempt in (0, 1):
+            try:
+                conn, fresh = self._pooled_conn()
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
+                # connect-phase failure: nothing was sent, retrying any
+                # method is safe; exhausted -> the documented contract
+                if attempt == 0:
+                    continue
+                self.requests_total.inc(method=method, outcome="unreachable")
+                raise ApiError(0, f"apiserver unreachable: {e}")
+            try:
+                conn.request(method, path, body=data, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
+                self._drop_pooled_conn()
+                # Retry policy for request/response-phase failures: GETs
+                # are idempotent — always retriable. Writes retry ONLY for
+                # the stale-keep-alive case (a REUSED socket failing with a
+                # non-timeout error: the server closed it between calls and
+                # the send died cleanly). A timeout may mean the write was
+                # DELIVERED and applied — re-sending would double-apply
+                # (a CAS would see its own rv bump as a spurious Conflict).
+                is_timeout = isinstance(e, TimeoutError)
+                retriable = (method == "GET"
+                             or (not fresh and not is_timeout))
+                if attempt == 0 and retriable:
+                    continue
+                self.requests_total.inc(method=method, outcome="unreachable")
+                raise ApiError(0, f"apiserver unreachable: {e}")
+            if resp.will_close:
+                self._drop_pooled_conn()
+            if resp.status == 409:
+                self.requests_total.inc(method=method, outcome="conflict")
+                raise Conflict(payload.decode(errors="replace")[:300])
+            if resp.status >= 400:
+                self.requests_total.inc(method=method,
+                                        outcome=f"http_{resp.status}")
+                raise ApiError(resp.status,
+                               payload.decode(errors="replace")[:300])
+            self.requests_total.inc(method=method, outcome="ok")
+            return json.loads(payload or b"{}")
 
     # -- informer lifecycle ----------------------------------------------------
 

@@ -71,6 +71,8 @@ class _State:
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    disable_nagle_algorithm = True  # response segments must not wait out
+    # the client's delayed ACK (the keep-alive client sets TCP_NODELAY too)
     state: _State  # injected by serve()
 
     def log_message(self, *args):  # quiet
@@ -152,12 +154,13 @@ class _Handler(BaseHTTPRequestHandler):
                 st.drop_watcher(plural, q)
 
     def do_POST(self):
+        body = self._read_body()  # drain BEFORE any early reply: leftover
+        # body bytes corrupt the next request's framing on keep-alive
         r = self._route()
         if r is None:
             return self._error(404, "NotFound", self.path)
         plural, name, sub, _ = r
         st = self.state
-        body = self._read_body()
         if sub == "binding":
             target = ((body.get("target") or {}).get("name")
                       or body.get("nodeName", ""))
@@ -189,12 +192,12 @@ class _Handler(BaseHTTPRequestHandler):
         return self._json(201, body)
 
     def do_PUT(self):
+        body = self._read_body()  # drain before any early reply (framing)
         r = self._route()
         if r is None or r[1] is None:
             return self._error(404, "NotFound", self.path)
         plural, name, _sub, _ = r
         st = self.state
-        body = self._read_body()
         want_rv = (body.get("metadata") or {}).get("resourceVersion")
         with st.lock:
             bucket = st.bucket(plural)
@@ -214,6 +217,7 @@ class _Handler(BaseHTTPRequestHandler):
         deletes a key — the subset real clients (and HttpKubeStore's
         cordon) use. A /status PATCH scopes to the status portion like the
         real subresource; other content types get 415."""
+        patch = self._read_body()  # drain before any early reply (framing)
         r = self._route()
         if r is None or r[1] is None:
             return self._error(404, "NotFound", self.path)
@@ -226,7 +230,6 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(405, "MethodNotAllowed",
                                f"PATCH on subresource {sub!r} not supported")
         st = self.state
-        patch = self._read_body()
         if not isinstance(patch, dict):
             return self._error(415, "UnsupportedMediaType",
                                "merge-patch body must be a JSON object")
@@ -257,12 +260,12 @@ class _Handler(BaseHTTPRequestHandler):
         return self._json(200, body)
 
     def do_DELETE(self):
+        body = self._read_body()  # drain before any early reply (framing)
         r = self._route()
         if r is None or r[1] is None:
             return self._error(404, "NotFound", self.path)
         plural, name, _sub, _ = r
         st = self.state
-        body = self._read_body()
         want_rv = (body.get("preconditions") or {}).get("resourceVersion")
         with st.lock:
             cur = st.bucket(plural).get(name)

@@ -322,6 +322,13 @@ def resolve_pod_affinity(groups: "list[PodGroup]", zones: Sequence[str],
       anti-affinity uses the anti_affinity_* booleans. Greedy first-wins:
       dependency chains deeper than one round stay best-effort (the
       sequential kube-scheduler has the same horizon).
+
+    THE HORIZON BOUND (adversarially pinned by tests/test_affinity_horizon.py):
+    one dependency level resolves per solve. The tail of a deeper chain
+    PENDS — it is never placed in violation of its term — and retrying
+    with each cycle's claims bound as existing nodes converges one level
+    per reconcile cycle (depth-k chains converge in <= k-1 cycles).
+    Anti-affinity never co-locates a violating pair at any depth.
     """
     has_terms = any(g.spec.pod_affinity or g.spec.pod_anti_affinity
                     for g in groups)
