@@ -104,9 +104,12 @@ def main(argv=None) -> int:
     # real HTTP socket + the gRPC solver sidecar, recorded in the same
     # ladder so the wire tax stays attributable round-over-round
     wire, rc3 = _run_json_lines(["benchmarks.wire_bench"])
-    results += wire
-    if rc3 != 0:
-        print("wire benchmark failed; in-process entries still recorded",
+    if rc3 == 0:
+        results += wire
+    else:
+        # partial wire lines must not become the baseline the next run
+        # diffs against (same invariant as rc1/rc2 below)
+        print("wire benchmark failed; recording in-process entries only",
               file=sys.stderr)
     if rc1 != 0 or rc2 != 0:
         # a broken harness must FAIL the run (and never become the baseline

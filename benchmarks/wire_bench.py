@@ -85,8 +85,14 @@ def boot_wire_operator(catalog, grpc_solver: bool = True, **settings_kw):
 
 
 def wire_provisioning(n_pods: int = 10_000) -> dict:
+    import os
+
     from benchmarks.workloads import mixed_workload
 
+    # the wire benchmark must PAY the gRPC solve leg: the measured routing
+    # policy would otherwise prefer the in-process native scan and the
+    # recorded "deployed topology" would exclude the sidecar entirely
+    os.environ["KARPENTER_TPU_ROUTE_CROSSOVER"] = "0"
     catalog = generate_fleet_catalog()
     op, teardown = boot_wire_operator(catalog)
     try:
@@ -108,6 +114,9 @@ def wire_provisioning(n_pods: int = 10_000) -> dict:
         machines = len(op.kube.list("machines"))
         assert pending == 0, f"{pending} pods still pending after the cycle"
         assert machines > 0
+        assert op.provisioning.last_solver_kind == "tpu", (
+            f"solve did not cross the gRPC boundary "
+            f"(kind={op.provisioning.last_solver_kind})")
         return {"bench": "wire_provisioning", "pods": n_pods,
                 "ingest_seconds": round(ingest_s, 3),
                 "cycle_seconds": round(cycle_s, 3),

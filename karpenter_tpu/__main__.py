@@ -318,6 +318,79 @@ def cmd_sync(args) -> int:
     return 0
 
 
+def cmd_apiserver(args) -> int:
+    """Boot the mini apiserver standalone and write a kubeconfig for it:
+    the offline substrate of the getting-started walkthrough (the real
+    alternative is envtest via hack/fetch_envtest.sh). Serves until ^C."""
+    import time as _time
+
+    from .fake.apiserver import serve
+
+    srv, port, _state = serve(port=args.port)
+    kubeconfig = {
+        "apiVersion": "v1", "kind": "Config",
+        "clusters": [{"name": "mini",
+                      "cluster": {"server": f"http://127.0.0.1:{port}"}}],
+        "users": [{"name": "mini", "user": {}}],
+        "contexts": [{"name": "mini",
+                      "context": {"cluster": "mini", "user": "mini"}}],
+        "current-context": "mini",
+    }
+    import json as _json
+
+    with open(args.write_kubeconfig, "w") as f:
+        _json.dump(kubeconfig, f, indent=1)  # kubeconfigs are YAML, but
+        # JSON is a YAML subset — every loader (ours + kubectl) accepts it
+    print(f"mini apiserver listening on 127.0.0.1:{port}")
+    print(f"kubeconfig written to {args.write_kubeconfig}")
+    print("next: python -m karpenter_tpu controller --simulate "
+          f"--kubeconfig {args.write_kubeconfig} --apply examples/quickstart.yaml")
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.shutdown()
+    return 0
+
+
+def cmd_get(args) -> int:
+    """kubectl-get analogue over the coordination plane: list a kind with
+    the columns an operator checks first (the walkthrough's 'watch the
+    nodes appear' step, no kubectl needed)."""
+    from .coordination.httpkube import HttpKubeStore
+
+    kube = HttpKubeStore.from_kubeconfig(args.kubeconfig)
+    try:
+        # one-shot LIST seed (reads come from the informer cache); no
+        # watch threads needed for a point-in-time get
+        kube._relist(args.kind)
+        objs = kube.list(args.kind)
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if not objs:
+        print(f"no {args.kind} found")
+        return 0
+    try:
+        for o in objs:
+            name = getattr(o, "name", None) or getattr(
+                o, "metadata", {}).get("name", "?")
+            cols = [str(name)]
+            labels = dict(getattr(o, "labels", ()) or {})
+            if args.kind == "nodes":
+                from .apis import wellknown as wk
+
+                cols += [labels.get(wk.LABEL_INSTANCE_TYPE, ""),
+                         labels.get(wk.LABEL_ZONE, ""),
+                         labels.get(wk.LABEL_CAPACITY_TYPE, "")]
+            elif args.kind == "pods":
+                cols.append(getattr(o, "node_name", "") or "<pending>")
+            print("  ".join(c for c in cols if c != ""))
+    except BrokenPipeError:  # | head closed stdout mid-listing
+        pass
+    return 0
+
+
 def main(argv=None) -> int:
     logging.basicConfig(
         level=logging.INFO,
@@ -415,6 +488,19 @@ def main(argv=None) -> int:
                         help="delete managed-kind objects absent from the "
                              "fixture (pods are never pruned)")
     p_sync.set_defaults(fn=cmd_sync)
+
+    p_api = sub.add_parser(
+        "apiserver", help="boot the offline mini apiserver + kubeconfig "
+                          "(getting-started walkthrough substrate)")
+    p_api.add_argument("--port", type=int, default=8001)
+    p_api.add_argument("--write-kubeconfig", default="/tmp/karpenter-tpu-kubeconfig")
+    p_api.set_defaults(fn=cmd_apiserver)
+
+    p_get = sub.add_parser("get", help="list objects from the coordination "
+                                       "plane (kubectl-get analogue)")
+    p_get.add_argument("kind", help="nodes, pods, machines, provisioners, ...")
+    p_get.add_argument("--kubeconfig", required=True)
+    p_get.set_defaults(fn=cmd_get)
 
     p_ver = sub.add_parser("version")
     p_ver.set_defaults(fn=lambda a: print(VERSION) or 0)

@@ -258,21 +258,31 @@ class HttpKubeStore:
                 raise ApiError(0, f"apiserver unreachable: {e}")
             try:
                 conn.request(method, path, body=data, headers=headers)
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
+                # SEND-phase failure: the request never left intact. GETs
+                # always retry; writes retry only for the stale-keep-alive
+                # case (a REUSED socket the server closed between calls —
+                # the send died cleanly, nothing was applied). A timeout
+                # here still means nothing was delivered, but stay
+                # conservative and exclude it for writes.
+                self._drop_pooled_conn()
+                retriable = (method == "GET"
+                             or (not fresh and not isinstance(e, TimeoutError)))
+                if attempt == 0 and retriable:
+                    continue
+                self.requests_total.inc(method=method, outcome="unreachable")
+                raise ApiError(0, f"apiserver unreachable: {e}")
+            try:
                 resp = conn.getresponse()
                 payload = resp.read()
             except (http.client.HTTPException, ConnectionError, OSError) as e:
+                # RESPONSE-phase failure: the request WAS delivered and may
+                # have been applied — re-sending a write would double-apply
+                # (a CAS would see its own rv bump as a spurious Conflict,
+                # a create would 409 AlreadyExists against itself). Only
+                # idempotent GETs retry past this point.
                 self._drop_pooled_conn()
-                # Retry policy for request/response-phase failures: GETs
-                # are idempotent — always retriable. Writes retry ONLY for
-                # the stale-keep-alive case (a REUSED socket failing with a
-                # non-timeout error: the server closed it between calls and
-                # the send died cleanly). A timeout may mean the write was
-                # DELIVERED and applied — re-sending would double-apply
-                # (a CAS would see its own rv bump as a spurious Conflict).
-                is_timeout = isinstance(e, TimeoutError)
-                retriable = (method == "GET"
-                             or (not fresh and not is_timeout))
-                if attempt == 0 and retriable:
+                if attempt == 0 and method == "GET":
                     continue
                 self.requests_total.inc(method=method, outcome="unreachable")
                 raise ApiError(0, f"apiserver unreachable: {e}")
