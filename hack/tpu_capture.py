@@ -135,6 +135,34 @@ def judge_io_probe(probe: dict, reps: int) -> "tuple[bool, bool]":
     return still_streaming, transport_ok
 
 
+def _consolidation_cluster(catalog, n_nodes: int = 500):
+    """The BASELINE configs[3] shape: n under-utilized m5.2xlarge nodes,
+    one small pod each (shared by the streaming- and degraded-regime
+    consolidation sections so their numbers are comparable)."""
+    from karpenter_tpu.apis import wellknown as wkk
+    from karpenter_tpu.apis.provisioner import Provisioner
+    from karpenter_tpu.models.cluster import ClusterState, StateNode
+    from karpenter_tpu.models.pod import make_pod
+
+    cluster = ClusterState()
+    big = catalog.by_name["m5.2xlarge"]
+    for i in range(n_nodes):
+        cluster.add_node(StateNode(
+            name=f"n-{i}",
+            labels={**big.labels_dict(), wkk.LABEL_ZONE: "zone-1a",
+                    wkk.LABEL_CAPACITY_TYPE: "on-demand",
+                    wkk.LABEL_PROVISIONER: "default"},
+            allocatable=big.allocatable_vector(),
+            instance_type=big.name, zone="zone-1a",
+            capacity_type="on-demand", price=big.offerings[0].price,
+            provisioner_name="default",
+            pods=[make_pod(f"p-{i}", cpu="500m", memory="1Gi",
+                           node_name=f"n-{i}")]))
+    cprov = Provisioner(name="default", consolidation_enabled=True)
+    cprov.set_defaults()
+    return cluster, cprov
+
+
 def _capture_payload(reps_headline: int, reps_sweep: int,
                      partial_path: "str | None" = None) -> dict:
     """Run inside the pinned-to-axon subprocess: headline + crossover sweep.
@@ -264,6 +292,49 @@ def _capture_payload(reps_headline: int, reps_sweep: int,
             streaming_after_io = still
         bank(callback_headline=callback_headline)
 
+    # If the callback transport held (link still streaming), measure the
+    # 500-candidate consolidation sweep THROUGH it before any literal read:
+    # device consolidation in the streaming regime is the routing-table
+    # entry that decides where the device beats the 88-180ms host path
+    # (VERDICT r4 ask #2). The degraded-regime number is still taken later.
+    if io_ok and streaming_after_io:
+        import karpenter_tpu.ops.consolidate as cmod
+        import karpenter_tpu.solver.core as score
+
+        prev_rb = score._READBACK
+        score._READBACK = "callback"
+        try:
+            cluster_s, cprov_s = _consolidation_cluster(catalog, 500)
+            cmod.run_consolidation(cluster_s, catalog, [cprov_s])  # warm
+            cts, cphases = [], []
+            for _ in range(max(3, reps_sweep)):
+                t0 = time.perf_counter()
+                cact = cmod.run_consolidation(cluster_s, catalog, [cprov_s])
+                cts.append((time.perf_counter() - t0) * 1000)
+                if cmod.last_timings:  # per-rep, like the degraded block
+                    cphases.append(cmod.last_timings)
+            bank(consolidation_500_streaming={
+                "candidates": 500, "p50_ms": round(st.median(cts), 3),
+                "action": cact.kind if cact else None,
+                "phase_split": cphases,
+                "sync_after": _link_sentinel(jax, jnp)})
+        except Exception as e:
+            bank(consolidation_500_streaming={
+                "error": str(e)[:200],
+                # the failed attempt may itself have consumed the
+                # streaming->degraded flip — record the sentinel so the
+                # attribution below can't silently lie
+                "sync_after": _link_sentinel(jax, jnp)})
+        finally:
+            score._READBACK = prev_rb
+        # did THIS section consume the transition? (mirrors the
+        # callback_headline attribution discipline above)
+        cs = rec.get("consolidation_500_streaming") or {}
+        still = (cs.get("sync_after") or {}).get("p50_ms", 999.0) < 5.0
+        if not still:
+            transition_in = "consolidation_500_streaming"
+            streaming_after_io = False
+
     # wave: K pipelined solves, ONE concatenated read (solver.solve_many)
     K = 8
     t0 = time.perf_counter()
@@ -355,29 +426,10 @@ def _capture_payload(reps_headline: int, reps_sweep: int,
     # recorded CPU number in benchmarks/results/bench_*.json (config 3).
     consolidation = None
     try:
-        from karpenter_tpu.apis import wellknown as wkk
-        from karpenter_tpu.models.cluster import ClusterState, StateNode
-        from karpenter_tpu.models.pod import make_pod
+        import karpenter_tpu.ops.consolidate as _cmod
         from karpenter_tpu.ops.consolidate import run_consolidation
 
-        cluster = ClusterState()
-        big = catalog.by_name["m5.2xlarge"]
-        for i in range(500):
-            cluster.add_node(StateNode(
-                name=f"n-{i}",
-                labels={**big.labels_dict(), wkk.LABEL_ZONE: "zone-1a",
-                        wkk.LABEL_CAPACITY_TYPE: "on-demand",
-                        wkk.LABEL_PROVISIONER: "default"},
-                allocatable=big.allocatable_vector(),
-                instance_type=big.name, zone="zone-1a",
-                capacity_type="on-demand", price=big.offerings[0].price,
-                provisioner_name="default",
-                pods=[make_pod(f"p-{i}", cpu="500m", memory="1Gi",
-                               node_name=f"n-{i}")]))
-        cprov = Provisioner(name="default", consolidation_enabled=True)
-        cprov.set_defaults()
-        import karpenter_tpu.ops.consolidate as _cmod
-
+        cluster, cprov = _consolidation_cluster(catalog, 500)
         run_consolidation(cluster, catalog, [cprov])  # compile + warm
         ctimes, phases = [], []
         for _ in range(max(3, reps_sweep)):
