@@ -135,6 +135,12 @@ def main():
                 # as a kernel regression (docs/designs/solver-boundary.md)
                 "consolidation_500_ms": (cap.get("consolidation_500")
                                          or {}).get("p50_ms"),
+                # streaming-regime consolidation through the callback
+                # transport (the routing-table entry; VERDICT r4 ask #2)
+                "consolidation_500_streaming_ms": (
+                    cap.get("consolidation_500_streaming") or {}).get("p50_ms"),
+                "transition_in": (cap.get("link_state")
+                                  or {}).get("transition_in"),
                 "link_state": cap.get("link_state"),
                 "exec_only_10k_ms": (cap.get("exec_only_10k")
                                      or {}).get("p50_ms"),
