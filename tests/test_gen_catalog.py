@@ -1,0 +1,62 @@
+"""Codegen guard for the real-data fleet catalog (hack/gen_catalog.py).
+
+The checked-in data/fleet_catalog.json must be exactly what the generator
+produces from the reference data artifacts — a hand-edit (or a generator
+change without `make catalog`) breaks the provenance claim. Skipped
+cleanly when the reference tree isn't present.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = os.path.join(REPO, "karpenter_tpu", "providers", "data",
+                    "fleet_catalog.json")
+
+
+@pytest.mark.skipif(not os.path.isdir("/root/reference/pkg"),
+                    reason="reference data artifacts not present")
+def test_checked_in_catalog_matches_generator(tmp_path):
+    """Regenerating into a scratch path yields byte-identical JSON."""
+    env = dict(os.environ)
+    out = tmp_path / "fleet_catalog.json"
+    code = (
+        "import sys, runpy\n"
+        f"sys.argv = ['gen_catalog.py']\n"
+        f"import hack.gen_catalog as g\n"
+        f"g.OUT = {str(out)!r}\n"
+        "g.main()\n")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "anchors validated: 10/10" in r.stdout
+    with open(DATA) as f, open(out) as g:
+        assert f.read() == g.read(), (
+            "checked-in fleet_catalog.json differs from generator output — "
+            "run `make catalog`")
+
+
+def test_catalog_data_invariants():
+    """Facts every consumer relies on, independent of the reference tree."""
+    with open(DATA) as f:
+        doc = json.load(f)
+    types = doc["types"]
+    assert len(types) >= 600
+    names = [t["name"] for t in types]
+    assert names == sorted(names) and len(set(names)) == len(names)
+    for t in types:
+        assert t["vcpu"] >= 1 and t["memory_mib"] >= 512, t["name"]
+        assert 0 < t["od_price_usd"] < 200, t["name"]
+        # the reference pod formula never exceeds the biggest published
+        # eni-max-pods value
+        assert 4 <= t["pods"] <= 737, t["name"]
+        assert t["arch"] in ("amd64", "arm64")
+        if t["pod_eni_branches"]:
+            assert t["trunking"], t["name"]
+    # provenance must be stamped
+    assert doc["provenance"]["pricing"]["generated_at"]
+    assert doc["provenance"]["eni_limits"]["generated_at"]
