@@ -105,6 +105,31 @@ def assert_no_anti_violation(res, all_pods, resident_apps=None):
                     f"{app} co-located with anti-target {avoid} on {node}")
 
 
+def test_reference_utilization_invariant_on_real_catalog():
+    """The reference's real-cluster utilization check, runnable verbatim
+    now that t3a.small is a REAL catalog entry
+    (test/suites/utilization/suite_test.go:55-74): provisioner pinned to
+    instance-type t3a.small, 100 pods of 1.5 CPU — one pod per node
+    enforced by instance size, exactly 100 nodes. Oracle and kernel agree
+    on all 100 decisions."""
+    from karpenter_tpu.models.requirements import OP_IN, Requirements
+    from karpenter_tpu.providers.instancetypes import generate_fleet_catalog
+
+    catalog = generate_fleet_catalog()
+    p = Provisioner(name="default", requirements=Requirements.of(
+        (wk.LABEL_INSTANCE_TYPE, OP_IN, ["t3a.small"])))
+    p.set_defaults()
+    pods = [make_pod(f"u-{i}", cpu="1.5") for i in range(100)]
+    sched = Scheduler(catalog, [p])
+    ores = sched.schedule(list(pods))
+    kres = TPUSolver(catalog, [p]).solve(list(pods))
+    assert kres.decisions() == ores.node_decisions(sched.options)
+    assert kres.unschedulable_count() == 0
+    assert len(kres.nodes) == 100
+    assert all(n.option.itype.name == "t3a.small" and n.pod_count == 1
+               for n in kres.nodes)
+
+
 class TestAffinityChainHorizon:
     def test_depth2_resolves_in_one_solve(self):
         """A <- B: exactly the two-round horizon — fully placed."""
