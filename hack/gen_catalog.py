@@ -259,6 +259,11 @@ def is_graviton(fam: str) -> bool:
 
 
 def main():
+    if not os.path.isdir(REF):
+        sys.exit("reference data artifacts not present at /root/reference — "
+                 "the checked-in karpenter_tpu/providers/data/"
+                 "fleet_catalog.json is the (already generated) output; "
+                 "regeneration needs the source artifacts")
     prices, price_stamp = parse_prices(
         os.path.join(REF, "cloudprovider", "zz_generated.pricing.go"))
     limits, limits_stamp = parse_vpclimits(
