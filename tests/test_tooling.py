@@ -175,3 +175,48 @@ class TestSyncManifests:
                 kube.stop()
         finally:
             srv.shutdown()
+
+
+class TestWalkthroughCLI:
+    """The getting-started walkthrough's CLI surface (docs §4): the
+    kubeconfig the `apiserver` subcommand writes is loadable, and `get`
+    renders listings over it."""
+
+    def test_get_lists_nodes_via_written_kubeconfig(self, tmp_path, capsys):
+        import json
+
+        from karpenter_tpu.__main__ import cmd_get
+        from karpenter_tpu.fake.apiserver import serve
+        from karpenter_tpu.models.cluster import StateNode
+
+        srv, port, state = serve()
+        try:
+            kc = tmp_path / "kubeconfig"
+            kc.write_text(json.dumps({
+                "apiVersion": "v1", "kind": "Config",
+                "clusters": [{"name": "mini", "cluster": {
+                    "server": f"http://127.0.0.1:{port}"}}],
+                "users": [{"name": "mini", "user": {}}],
+                "contexts": [{"name": "mini", "context": {
+                    "cluster": "mini", "user": "mini"}}],
+                "current-context": "mini"}))
+            # seed a node through the wire the way the controller would
+            kube = HttpKubeStore(f"http://127.0.0.1:{port}")
+            from karpenter_tpu.apis import wellknown as wk
+            kube.create("nodes", "n-1", StateNode(
+                name="n-1",
+                labels={wk.LABEL_INSTANCE_TYPE: "t3a.small",
+                        wk.LABEL_ZONE: "zone-1a",
+                        wk.LABEL_CAPACITY_TYPE: "spot"},
+                allocatable=[0] * wk.NUM_RESOURCES))
+
+            class Args:
+                kind = "nodes"
+                kubeconfig = str(kc)
+
+            assert cmd_get(Args()) == 0
+            out = capsys.readouterr().out
+            assert "n-1" in out and "t3a.small" in out and "zone-1a" in out
+        finally:
+            srv.shutdown()
+            srv.server_close()
