@@ -381,3 +381,65 @@ def test_pair_with_spot_member_cannot_replace():
     k = run_consolidation(cluster, pair_catalog(), [prov()])
     assert o is None or o.kind != "replace"
     assert k is None or k.kind != "replace"
+
+
+def test_fuzz_dense_vs_flat_dispatch_bit_parity():
+    """The two-buffer flat dispatch (encode once, ship i32+u8, unpack on
+    device) must be bit-identical to the dense per-leaf dispatch across
+    random shapes — including lanes with per-node caps (anti-affinity
+    pods trigger ex_cap) and heterogeneous prices (multiple feas-table
+    rows). This locks the _flatten_batch/_verdicts_flat layout contract
+    for every optional-array combination, not just the benchmark shape."""
+    import jax
+    import numpy as np
+
+    from karpenter_tpu.oracle.consolidation import (MAX_PAIR_CANDIDATES,
+                                                    candidate_pairs, eligible)
+    from karpenter_tpu.ops import consolidate as cmod
+
+    rng = random.Random(11)
+    for trial in range(6):
+        cat = Catalog(types=[
+            make_instance_type(f"f.{i}", cpu=2 ** (i + 1),
+                               memory=f"{2 ** (i + 3)}Gi",
+                               od_price=round(0.04 * 2 ** i, 3))
+            for i in range(4)
+        ])
+        cluster = ClusterState()
+        for n in range(rng.randint(2, 7)):
+            cpu_alloc = rng.choice([2, 4, 8])
+            pods = []
+            for i in range(rng.randint(0, 3)):
+                pods.append(make_pod(
+                    f"t{trial}n{n}p{i}", cpu=rng.choice(["100m", "500m"]),
+                    memory="256Mi",
+                    # some pods carry hostname anti-affinity: exercises the
+                    # ex_cap optional array in the flat layout
+                    anti_affinity_hostname=(rng.random() < 0.3)))
+            cluster.add_node(node(
+                f"t{trial}-node-{n}", cpu_alloc,
+                round(0.04 * cpu_alloc / 2 * rng.choice([1.0, 1.5]), 3),
+                pods, itype=f"f.{cpu_alloc}"))
+        p = prov(consolidation_enabled=True)
+        provs = [p]
+        cand_nodes = [cluster.nodes[nm] for nm in sorted(cluster.nodes)
+                      if eligible(cluster.nodes[nm], cluster)]
+        if not cand_nodes:
+            continue
+        sets = candidate_pairs(cluster, provs, 0.0, MAX_PAIR_CANDIDATES,
+                               nodes=cand_nodes) + [(n,) for n in cand_nodes]
+        batch = cmod.encode_consolidation(cluster, cat, provs, cand_sets=sets)
+        if batch is None:
+            continue
+        dense = np.asarray(cmod._batched_pack_verdicts(
+            jax.device_put(batch.inputs), cmod.N_SLOTS,
+            feas_table=jax.device_put(batch.feas_table),
+            feas_idx=jax.device_put(batch.feas_idx)))
+        i32, u8, dims = cmod._flatten_batch(batch)
+        da, dt = cmod._dev_grid_arrays(batch.grid)
+        flat = np.asarray(cmod._verdicts_flat(
+            jax.device_put(i32), jax.device_put(u8), da, dt,
+            dims, cmod.N_SLOTS))
+        assert dense.shape == flat.shape and (dense == flat).all(), (
+            f"trial {trial}: dense/flat divergence at "
+            f"{np.argwhere(dense != flat)[:4]}")
