@@ -578,7 +578,11 @@ def main():
     ap.add_argument("--loop", action="store_true",
                     help="probe/capture forever on a backoff schedule")
     ap.add_argument("--probe-interval-s", type=int, default=300,
-                    help="base wait between failed probes (doubles to max 30m)")
+                    help="base wait between failed probes (doubles to "
+                         "--probe-backoff-max-s)")
+    ap.add_argument("--probe-backoff-max-s", type=int, default=1800,
+                    help="backoff ceiling; lower it when a capture window "
+                         "must not be missed (e.g. end of a round)")
     ap.add_argument("--recapture-s", type=int, default=7200,
                     help="refresh a successful capture this often")
     ap.add_argument("--capture-timeout-s", type=int, default=1800)
@@ -605,7 +609,7 @@ def main():
             # failed OR partial: keep retrying on the probe backoff — a
             # partial must not suppress the retry that completes it
             time.sleep(wait)
-            wait = min(wait * 2, 1800)
+            wait = min(wait * 2, args.probe_backoff_max_s)
 
 
 if __name__ == "__main__":
