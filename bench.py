@@ -90,6 +90,61 @@ def workload_10k():
     return mixed_workload(10_000)
 
 
+def _phase_breakdown(catalog, pods):
+    """One full CONTROLLER reconcile at the benchmark workload under the
+    fake cloud, attributed per phase from the tracing recorder. The
+    headline above measures the bare solver; this shows where the cycle
+    around it (mask build, routed solve, launch+bind) spends wall time."""
+    from karpenter_tpu.apis import wellknown as wk
+    from karpenter_tpu.apis.nodetemplate import NodeTemplate
+    from karpenter_tpu.apis.provisioner import Provisioner
+    from karpenter_tpu.apis.settings import Settings
+    from karpenter_tpu.fake.cloud import FakeCloud
+    from karpenter_tpu.models.requirements import OP_IN, Requirements
+    from karpenter_tpu.operator import Operator
+    from karpenter_tpu.tracing import TRACER
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    op = Operator(FakeCloud(catalog=catalog, clock=clock),
+                  Settings(cluster_name="bench",
+                           cluster_endpoint="https://bench",
+                           batch_idle_duration=0.0, batch_max_duration=0.0),
+                  catalog, clock=clock)
+    try:
+        op.kube.create("nodetemplates", "default", NodeTemplate(
+            name="default", subnet_selector={"id": "subnet-zone-1a"},
+            security_group_selector={"id": "sg-default"}))
+        op.cloudprovider.register_nodetemplate(
+            op.kube.get("nodetemplates", "default"))
+        prov = Provisioner(name="default", provider_ref="default",
+                           requirements=Requirements.of(
+                               (wk.LABEL_CAPACITY_TYPE, OP_IN,
+                                ["spot", "on-demand"]),
+                               (wk.LABEL_ARCH, OP_IN, ["amd64", "arm64"])))
+        prov.set_defaults()
+        op.kube.create("provisioners", "default", prov)
+        for p in pods:
+            op.kube.create("pods", p.name, p)
+        TRACER.clear()
+        op.provisioning.reconcile_once()
+        spans = {s.name: s for s in TRACER.finished_spans()
+                 if s.name.startswith("provisioning.")}
+        out = {}
+        for phase in ("cycle", "mask", "solve", "bind"):
+            s = spans.get(f"provisioning.{phase}")
+            if s is not None:
+                out[f"{phase}_ms"] = round((s.duration_s or 0.0) * 1e3, 3)
+        solve = spans.get("provisioning.solve")
+        if solve is not None:
+            out["routing"] = solve.attributes.get("routing")
+            out["compile_cache"] = solve.attributes.get("compile_cache")
+            out["transfer_ms"] = solve.attributes.get("transfer_ms")
+        return out
+    finally:
+        op.stop()
+
+
 def main():
     forced = os.environ.get("KARPENTER_TPU_BENCH_PLATFORM")
     if forced:  # operator knows the tunnel state; skip the probe entirely
@@ -277,6 +332,14 @@ def main():
         "p_min_ms": round(min(times), 3),
         "p_max_ms": round(max(times), 3),
     })
+    # per-phase attribution of a full controller cycle (mask/solve/bind)
+    # from the tracing recorder — must never break the one-JSON-line
+    # contract, so any failure is recorded instead of raised
+    try:
+        _state["detail"]["phase_breakdown_ms"] = _phase_breakdown(
+            catalog, pods)
+    except Exception as e:
+        _state["detail"]["phase_breakdown_error"] = str(e)[:120]
     if backend != "cpu":
         try:  # link-state attribution for THIS run's headline numbers
             import jax.numpy as jnp

@@ -80,7 +80,7 @@ _MIB_PER_VCPU = {
     # storage / dense-IO
     "d2": 7808, "d3": 8192, "d3en": 4096, "h1": 4096,
     "i2": 7808, "i3": 7808, "i3en": 8192, "i4i": 8192,
-    "im4gn": 6144, "is4gen": 6144,
+    "im4gn": 4096, "is4gen": 6144,
     # accelerated
     "dl1": 8192, "f1": 15616, "g2": 1920, "g3": 7808, "g3s": 7808,
     "g4ad": 4096, "g4dn": 4096, "g5": 4096, "g5g": 2048,

@@ -29,6 +29,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..fake.cloud import CreateFleetRequest, FleetOverride, LaunchTemplate
 from ..utils import errors as cloud_errors
 
+# CreateFleet token claimed but outcome not yet recorded (see dispatch)
+_FLEET_IN_FLIGHT = object()
+
 
 def _asdicts(items) -> "list[dict]":
     return [dataclasses.asdict(i) for i in items]
@@ -42,7 +45,9 @@ class CloudAPIServer:
         self.cloud = cloud
         self.region = region
         self._fail_next: "list[int]" = []  # pending injected HTTP statuses
-        self._fleet_replies: "dict[str, dict]" = {}  # client-token dedupe
+        # client-token -> recorded outcome (reply dict, the raised
+        # exception, or _FLEET_IN_FLIGHT while the first attempt runs)
+        self._fleet_replies: "dict[str, object]" = {}
         self._lock = threading.Lock()
         outer = self
 
@@ -138,12 +143,33 @@ class CloudAPIServer:
         if action == "CreateFleet":
             # client-token dedupe (EC2 ClientToken semantics): a transport
             # retry whose first attempt launched but lost the response
-            # replays the recorded result instead of double-launching
+            # replays the recorded result instead of double-launching. The
+            # token is CLAIMED before dispatch: if the first attempt dies
+            # between launching and replying (a 5xx out of the dispatch
+            # path), its outcome — success or the exception itself — is
+            # still on record, so the retry replays it instead of
+            # relaunching. An exception proves nothing about whether
+            # instances came up (fault injection can fire past the launch),
+            # so failures are replayed too rather than treated as new.
             token = p.get("client_token", "")
             if token:
                 with self._lock:
                     hit = self._fleet_replies.get(token)
+                    if hit is None:
+                        self._fleet_replies[token] = _FLEET_IN_FLIGHT
+                        while len(self._fleet_replies) > 1024:  # bounded
+                            self._fleet_replies.pop(
+                                next(iter(self._fleet_replies)))
+                if hit is _FLEET_IN_FLIGHT:
+                    # concurrent duplicate: the first attempt hasn't
+                    # recorded its outcome yet — fail retriably rather
+                    # than race it into a second launch
+                    raise cloud_errors.CloudError(
+                        "IdempotentOperationInProgress",
+                        f"client token {token!r} is still in flight")
                 if hit is not None:
+                    if isinstance(hit, Exception):
+                        raise hit
                     return hit
             req = CreateFleetRequest(
                 launch_template=p["launch_template"],
@@ -151,15 +177,18 @@ class CloudAPIServer:
                 capacity=p["capacity"], capacity_type=p["capacity_type"],
                 tags=p.get("tags") or {}, image_id=p.get("image_id", ""),
                 fleet_context=p.get("fleet_context", ""))
-            resp = cloud.create_fleet(req)
-            out = {"instance_ids": resp.instance_ids,
-                   "errors": _asdicts(resp.errors)}
+            try:
+                resp = cloud.create_fleet(req)
+                out = {"instance_ids": resp.instance_ids,
+                       "errors": _asdicts(resp.errors)}
+            except Exception as e:
+                if token:
+                    with self._lock:
+                        self._fleet_replies[token] = e
+                raise
             if token:
                 with self._lock:
                     self._fleet_replies[token] = out
-                    while len(self._fleet_replies) > 1024:  # bounded memory
-                        self._fleet_replies.pop(
-                            next(iter(self._fleet_replies)))
             return out
         if action == "DescribeInstances":
             return {"instances": _asdicts(cloud.describe_instances(p["ids"]))}

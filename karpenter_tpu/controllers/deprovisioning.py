@@ -22,6 +22,7 @@ from ..metrics import NAMESPACE, REGISTRY, Registry
 from ..models.cluster import ClusterState
 from ..ops.consolidate import run_consolidation
 from ..oracle.consolidation import find_consolidation
+from ..tracing import TRACER
 from ..utils.clock import Clock
 from .termination import TerminationController
 
@@ -248,6 +249,7 @@ class DeprovisioningController:
                                             now=self.clock.now(),
                                             candidate_filter=cand_filter)
         self.eval_duration.observe(_time.perf_counter() - t0, method=method)
+        TRACER.annotate(routing=method)  # which search backend actually ran
         if action is None:
             return None
         nodes = [self.cluster.nodes.get(n) for n in action.nodes]
@@ -456,13 +458,24 @@ class DeprovisioningController:
 
     def reconcile_once(self):
         """Full deprovisioning pass in reference priority order."""
-        acted = list(self.reconcile_emptiness())
-        acted += self.reconcile_expiration()
-        drift_enabled = self.cloudprovider.settings.feature_gates.drift_enabled
-        if drift_enabled:
-            acted += self.reconcile_drift()
-        if acted:
-            # other deprovisioners disrupted the cluster this pass: restart
-            # the consolidation settle window (consolidation.md:65)
-            self._last_action_ts = self.clock.now()
-        return self.reconcile_consolidation()
+        with TRACER.start_span("deprovisioning.cycle",
+                               nodes=len(self.cluster.nodes)) as root:
+            with TRACER.start_span("deprovisioning.emptiness"):
+                acted = list(self.reconcile_emptiness())
+            with TRACER.start_span("deprovisioning.expiration"):
+                acted += self.reconcile_expiration()
+            drift_enabled = \
+                self.cloudprovider.settings.feature_gates.drift_enabled
+            if drift_enabled:
+                with TRACER.start_span("deprovisioning.drift"):
+                    acted += self.reconcile_drift()
+            if acted:
+                # other deprovisioners disrupted the cluster this pass:
+                # restart the consolidation settle window (consolidation.md:65)
+                self._last_action_ts = self.clock.now()
+            with TRACER.start_span("deprovisioning.consolidation") as cons:
+                action = self.reconcile_consolidation()
+                cons.set_attribute("found", action is not None)
+            root.set_attributes(acted=len(acted),
+                                consolidated=action is not None)
+            return action

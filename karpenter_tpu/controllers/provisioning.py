@@ -31,6 +31,7 @@ from ..models.pod import PodSpec
 from ..models.requirements import IncompatibleError, Requirement, Requirements, OP_IN
 from ..oracle.scheduler import Scheduler
 from ..solver.core import NativeSolver, SolveResult, TPUSolver
+from ..tracing import TRACER
 from ..utils.clock import Clock
 
 log = logging.getLogger("karpenter.provisioning")
@@ -153,27 +154,48 @@ class ProvisioningController:
         pods = self.kube.pending_pods() if pods is None else pods
         if not pods:
             return None
-        provisioners = sorted(self.kube.provisioners(),
-                              key=lambda p: (-p.weight, p.name))
-        if not provisioners:
-            self.recorder.warning("controller/provisioning", "NoProvisioners",
-                                  "no provisioners configured")
-            return None
-        catalog = self.cloudprovider.catalog_for(None)
-        provisioners = self.cloudprovider.constrain_to_template_zones(
-            provisioners, catalog)
-        daemon_overhead = self._daemon_overhead()
-        existing = self.cluster.existing_views()
+        with TRACER.start_span("provisioning.cycle", pods=len(pods)) as root:
+            with TRACER.start_span("provisioning.mask") as mask:
+                provisioners = sorted(self.kube.provisioners(),
+                                      key=lambda p: (-p.weight, p.name))
+                if not provisioners:
+                    self.recorder.warning(
+                        "controller/provisioning", "NoProvisioners",
+                        "no provisioners configured")
+                    return None
+                catalog = self.cloudprovider.catalog_for(None)
+                provisioners = self.cloudprovider.constrain_to_template_zones(
+                    provisioners, catalog)
+                daemon_overhead = self._daemon_overhead()
+                existing = self.cluster.existing_views()
+                mask.set_attributes(provisioners=len(provisioners),
+                                    types=len(catalog.types),
+                                    existing=len(existing))
 
-        t0 = time.perf_counter()
-        result, solver_kind = self._routed_solve(
-            catalog, provisioners, pods, existing, daemon_overhead)
-        self.last_solver_kind = solver_kind
-        self.sched_duration.observe(time.perf_counter() - t0, solver=solver_kind)
+            with TRACER.start_span("provisioning.solve",
+                                   pods=len(pods)) as solve_span:
+                t0 = time.perf_counter()
+                result, solver_kind = self._routed_solve(
+                    catalog, provisioners, pods, existing, daemon_overhead)
+                self.last_solver_kind = solver_kind
+                self.sched_duration.observe(time.perf_counter() - t0,
+                                            solver=solver_kind)
+                solve_span.set_attribute("routing", solver_kind)
+                # the chosen solver annotated the span in-place (core.py
+                # last_solve_info); guarantee the load-bearing attrs exist
+                # even on the oracle path
+                solve_span.attributes.setdefault("compile_cache", "n/a")
+                solve_span.attributes.setdefault("transfer_ms", 0.0)
+                root.set_attribute("routing", solver_kind)
 
-        self._apply(result, pods, catalog=catalog, provisioners=provisioners,
-                    daemon_overhead=daemon_overhead)
-        return result
+            with TRACER.start_span("provisioning.bind") as bind:
+                self._apply(result, pods, catalog=catalog,
+                            provisioners=provisioners,
+                            daemon_overhead=daemon_overhead)
+                bind.set_attributes(
+                    nodes=len(result.nodes),
+                    unschedulable=result.unschedulable_count())
+            return result
 
     # -- solver cache + routing ------------------------------------------------
 

@@ -280,9 +280,19 @@ class HttpKubeStore:
                 # have been applied — re-sending a write would double-apply
                 # (a CAS would see its own rv bump as a spurious Conflict,
                 # a create would 409 AlreadyExists against itself). Only
-                # idempotent GETs retry past this point.
+                # idempotent GETs retry past this point — with ONE carve-out:
+                # RemoteDisconnected on a REUSED socket. getresponse raises it
+                # only when ZERO response bytes arrived, and a server that
+                # processed a request sends at least a status line before
+                # closing; an immediate FIN on a pooled connection is the
+                # stale-keep-alive race (server expired the idle socket as our
+                # request was in flight — it never read it), so one replay of
+                # a write is safe.
                 self._drop_pooled_conn()
-                if attempt == 0 and method == "GET":
+                retriable = (method == "GET"
+                             or (not fresh
+                                 and isinstance(e, http.client.RemoteDisconnected)))
+                if attempt == 0 and retriable:
                     continue
                 self.requests_total.inc(method=method, outcome="unreachable")
                 raise ApiError(0, f"apiserver unreachable: {e}")

@@ -537,16 +537,22 @@ def run_consolidation(
                                  daemon_overhead, grid, cand_sets=sets)
     if batch is None:
         return None
-    timings: "dict | None" = {} if _SOLVE_TIMING else None
+    # timings always collected now: the tracing plane records the phase
+    # split + lane count on the active consolidation span; last_timings
+    # stays gated behind the capture tool's flag as before
+    timings: dict = {}
     t1 = _time.perf_counter()
     verdicts = _verdicts(batch, mesh, timings=timings)
     t2 = _time.perf_counter()
     actions = _decode_actions(batch, verdicts, now)
-    if timings is not None:
-        timings["encode_ms"] = round((t1 - t0) * 1000, 3)
-        timings["verdicts_ms"] = round((t2 - t1) * 1000, 3)
-        timings["decode_ms"] = round((_time.perf_counter() - t2) * 1000, 3)
-        timings["lanes"] = len(batch.candidates)
+    timings["encode_ms"] = round((t1 - t0) * 1000, 3)
+    timings["verdicts_ms"] = round((t2 - t1) * 1000, 3)
+    timings["decode_ms"] = round((_time.perf_counter() - t2) * 1000, 3)
+    timings["lanes"] = len(batch.candidates)
+    from ..tracing import TRACER
+
+    TRACER.annotate(transfer_ms=timings.get("fetch_ms", 0.0), **timings)
+    if _SOLVE_TIMING:
         last_timings = timings
     if not actions:
         return None

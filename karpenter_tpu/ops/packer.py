@@ -317,6 +317,22 @@ pack_flat = functools.partial(
     jax.jit, static_argnames=("n_slots", "use_pallas"))(pack_flat_impl)
 
 
+def pack_cache_size() -> int:
+    """Compiled-program count across the jitted pack entry points. A delta
+    across a dispatch means the solve paid an XLA compile (a fresh shape
+    bucket escaped the padding doctrine) — the tracing plane records this
+    as the compile_cache hit/miss attribute because a miss turns a ~ms
+    solve into a multi-second one. Returns -1 when the jit cache
+    introspection API is unavailable (callers report "unknown")."""
+    n = 0
+    for fn in (pack, pack_flat):
+        try:
+            n += fn._cache_size()
+        except Exception:
+            return -1
+    return n
+
+
 def unflatten_result(flat, G: int, N: int, Ne: int) -> PackResult:
     """Host-side parse of pack_flat's single buffer back into PackResult
     (used is omitted — the decoder never reads it)."""

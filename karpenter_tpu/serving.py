@@ -85,6 +85,30 @@ class ServingPlane:
                 if self.path.rstrip("/") in ("", "/metrics"):
                     return self._text(200, op.metrics_text(),
                                       content_type="text/plain; version=0.0.4")
+                if self.path.startswith("/debug/traces"):
+                    # recent traces as JSON; ?id=<trace_id> exports ONE trace
+                    # in Chrome trace_event format (load in Perfetto /
+                    # chrome://tracing); ?limit=N bounds the listing
+                    from urllib.parse import parse_qs, urlsplit
+
+                    from .tracing import TRACER
+
+                    qs = parse_qs(urlsplit(self.path).query)
+                    trace_id = qs.get("id", [None])[0]
+                    if trace_id:
+                        if not TRACER.trace(trace_id):
+                            return self._text(404, "unknown trace id")
+                        return self._text(
+                            200, TRACER.chrome_trace_json(trace_id),
+                            content_type="application/json")
+                    try:
+                        limit = int(qs.get("limit", ["20"])[0])
+                    except ValueError:
+                        limit = 20
+                    return self._text(
+                        200, json.dumps({"traces": TRACER.traces(limit)},
+                                        default=str),
+                        content_type="application/json")
                 return self._text(404, "not found")
 
         return Metrics

@@ -29,6 +29,7 @@ from ..metrics import Counter
 from ..models.instancetype import Catalog
 from ..models.pod import PodGroup, PodSpec
 from ..oracle.scheduler import ExistingNode, Option
+from ..tracing import TRACER
 from .core import SolvedNode, SolveResult
 from . import solver_pb2 as pb
 from . import wire
@@ -96,12 +97,33 @@ class RemoteSolver:
     # -- RPC plumbing --------------------------------------------------------------
 
     def _call(self, name: str, request):
-        try:
-            return self._stubs[name](request, timeout=self.timeout)
-        except grpc.RpcError as e:
-            if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
-                raise StaleSync(e.details())
-            raise SolverUnavailable(f"{name}: {e.code().name}: {e.details()}")
+        cur = TRACER.current_span()
+        with TRACER.start_span(f"solver.rpc.{name}") as span:
+            # inject THIS rpc span's identity so the sidecar's span joins
+            # the trace as its child (requests without a trace_context
+            # field — Health — just skip injection)
+            if hasattr(request, "trace_context"):
+                request.trace_context.CopyFrom(
+                    wire.trace_context_to_wire(span.context()))
+            try:
+                resp = self._stubs[name](request, timeout=self.timeout)
+            except grpc.RpcError as e:
+                if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
+                    raise StaleSync(e.details())
+                raise SolverUnavailable(
+                    f"{name}: {e.code().name}: {e.details()}")
+            if name == "Solve":
+                # the service echoes its device-path observability in the
+                # response — record it on the CLIENT side of the wire too,
+                # and bubble it to the enclosing solve-phase span
+                attrs = {"routing": resp.routing or "unknown",
+                         "compile_cache": resp.compile_cache or "unknown",
+                         "transfer_ms": resp.transfer_ms,
+                         "solve_ms": resp.solve_ms}
+                span.set_attributes(**attrs)
+                if cur is not None:
+                    cur.set_attributes(**attrs)
+            return resp
 
     def catalog_content_hash(self) -> int:
         if self._hash_cache[0] != self.catalog.seqnum:

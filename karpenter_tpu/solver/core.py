@@ -336,6 +336,9 @@ class TPUSolver:
         # is host-side result shaping (docs/designs/solver-boundary.md).
         import time as _time
 
+        from ..ops.packer import pack_cache_size
+        from ..tracing import TRACER
+
         t0 = _time.perf_counter()
         enc = encode_problem(
             self.catalog, self.provisioners, pods, existing,
@@ -343,18 +346,34 @@ class TPUSolver:
             group_cache=self._group_cache,
         )
         t1 = _time.perf_counter()
+        cache_before = pack_cache_size()
         flat, dims = dispatch_pack(enc, self._dev_alloc_t, self._dev_tiebreak)
+        cache_after = pack_cache_size()
         t2 = _time.perf_counter()
         result = fetch_pack(flat, dims)
         t3 = _time.perf_counter()
         out = decode(enc, result, [e.name for e in existing])
+        t4 = _time.perf_counter()
+        # always-on per-solve observability: the tracing plane reads this on
+        # both sides of the solver wire (service.py echoes it into
+        # SolveResponse; the controller's solve span records it). fetch is
+        # the ONE device->host read — its wall time IS the transfer cost.
+        self.last_solve_info = {
+            "encode_ms": round((t1 - t0) * 1000, 3),
+            "dispatch_ms": round((t2 - t1) * 1000, 3),
+            "transfer_ms": round((t3 - t2) * 1000, 3),
+            "decode_ms": round((t4 - t3) * 1000, 3),
+            "compile_cache": ("unknown" if cache_before < 0
+                              else "miss" if cache_after > cache_before
+                              else "hit"),
+        }
+        TRACER.annotate(**self.last_solve_info)
         if _SOLVE_TIMING:
-            t4 = _time.perf_counter()
             self.last_timings = {
-                "encode_ms": round((t1 - t0) * 1000, 3),
-                "dispatch_ms": round((t2 - t1) * 1000, 3),
-                "fetch_ms": round((t3 - t2) * 1000, 3),
-                "decode_ms": round((t4 - t3) * 1000, 3),
+                "encode_ms": self.last_solve_info["encode_ms"],
+                "dispatch_ms": self.last_solve_info["dispatch_ms"],
+                "fetch_ms": self.last_solve_info["transfer_ms"],
+                "decode_ms": self.last_solve_info["decode_ms"],
             }
         return out
 
@@ -470,7 +489,10 @@ class NativeSolver(TPUSolver):
             ex_cap=enc.ex_cap, group_origin=enc.group_origin,
         )
         result = native_pack(inputs, n_slots=enc.n_slots)
-        return decode(enc, result, [e.name for e in existing])
+        out = decode(enc, result, [e.name for e in existing])
+        # host-only path: no device transfer, no jit cache in play
+        self.last_solve_info = {"transfer_ms": 0.0, "compile_cache": "n/a"}
+        return out
 
 
 def build_pack_inputs(enc: EncodedProblem, dev_alloc_t=None,

@@ -24,6 +24,7 @@ import grpc
 
 from ..apis.provisioner import Provisioner
 from ..models.instancetype import Catalog
+from ..tracing import TRACER
 from .core import SolveResult, TPUSolver
 from . import solver_pb2 as pb
 from . import wire
@@ -106,6 +107,14 @@ class SolverService:
     # -- RPC methods (called by the generic handler) -------------------------------
 
     def Sync(self, request: pb.SyncRequest, context) -> pb.SyncResponse:
+        with TRACER.start_span(
+                "solver.service.Sync",
+                context=wire.trace_context_from_wire(request.trace_context),
+                types=len(request.catalog.types)):
+            return self._sync_traced(request, context)
+
+    def _sync_traced(self, request: pb.SyncRequest,
+                     context) -> pb.SyncResponse:
         provisioners = [wire.provisioner_from_wire(m) for m in request.provisioners]
         prov_hash = wire.provisioners_hash(provisioners)
         # Staleness is keyed on catalog CONTENT, not seqnum: seqnums are
@@ -151,6 +160,23 @@ class SolverService:
         return pb.SyncResponse(seqnum=catalog.seqnum, catalog_hash=cat_hash)
 
     def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
+        # join the caller's trace when it sent one (wire trace_context);
+        # an untraced caller roots a fresh service-local trace instead
+        span = TRACER.start_span(
+            "solver.service.Solve",
+            context=wire.trace_context_from_wire(request.trace_context),
+            pods=len(request.pods))
+        try:
+            return self._solve_traced(request, context, span)
+        except BaseException as e:  # noqa: BLE001 — context.abort raises
+            span.set_attribute("error", True)
+            span.set_attribute("error.type", type(e).__name__)
+            raise
+        finally:
+            span.end()
+
+    def _solve_traced(self, request: pb.SolveRequest, context,
+                      span) -> pb.SolveResponse:
         key = (request.catalog_hash, request.provisioner_hash)
         with self._lock:
             entry = self._cache.get(key)
@@ -202,7 +228,18 @@ class SolverService:
             result = solver.solve(pods, existing=existing,
                                   daemon_overhead=overhead)
         solve_ms = (time.perf_counter() - t0) * 1000
-        return result_to_response(result, solve_ms, seqnum)
+        resp = result_to_response(result, solve_ms, seqnum)
+        # echo the device-path observability back over the wire so the
+        # CLIENT-side rpc span carries the same attributes this span does
+        info = getattr(solver, "last_solve_info", None) or {}
+        resp.routing = "tpu"
+        resp.compile_cache = str(info.get("compile_cache", "unknown"))
+        resp.transfer_ms = float(info.get("transfer_ms", 0.0))
+        span.set_attributes(routing=resp.routing,
+                            compile_cache=resp.compile_cache,
+                            transfer_ms=resp.transfer_ms,
+                            solve_ms=solve_ms)
+        return resp
 
     def Consolidate(self, request: pb.ConsolidateRequest,
                     context) -> pb.ConsolidateResponse:
@@ -216,39 +253,44 @@ class SolverService:
         from ..oracle.consolidation import MAX_PAIR_CANDIDATES
         from ..ops.consolidate import run_consolidation
 
-        key = (request.catalog_hash, request.provisioner_hash)
-        with self._lock:
-            entry = self._cache.get(key)
-            if entry is not None:
-                self._cache.move_to_end(key)
-        if entry is None:
-            context.abort(
-                grpc.StatusCode.FAILED_PRECONDITION,
-                f"catalog hash={request.catalog_hash:x} not synced; "
-                f"re-Sync required")
-        solver, _seqnum = entry
-        cluster = ClusterState()
-        eligible_names: "set[str]" = set()
-        for msg in request.nodes:
-            node, node_eligible = wire.consolidation_node_from_wire(msg)
-            cluster.add_node(node)
-            if node_eligible:
-                eligible_names.add(node.name)
-        overhead = list(request.daemon_overhead) or None
-        t0 = time.perf_counter()
-        action = run_consolidation(
-            cluster, solver.catalog, solver.provisioners,
-            daemon_overhead=overhead, now=request.now,
-            grid=solver.grid(),  # the Sync'd device-resident grid — no rebuild
-            multi_node=request.multi_node,
-            # -1 = unset sentinel -> server default; 0 legitimately
-            # DISABLES the pair search (proto3 zero-value trap)
-            max_pair_candidates=(MAX_PAIR_CANDIDATES
-                                 if request.max_pair_candidates < 0
-                                 else request.max_pair_candidates),
-            candidate_filter=lambda n: n.name in eligible_names)
-        ms = (time.perf_counter() - t0) * 1000
-        return wire.action_to_response(action, ms)
+        with TRACER.start_span(
+                "solver.service.Consolidate",
+                context=wire.trace_context_from_wire(request.trace_context),
+                nodes=len(request.nodes)) as span:
+            key = (request.catalog_hash, request.provisioner_hash)
+            with self._lock:
+                entry = self._cache.get(key)
+                if entry is not None:
+                    self._cache.move_to_end(key)
+            if entry is None:
+                context.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"catalog hash={request.catalog_hash:x} not synced; "
+                    f"re-Sync required")
+            solver, _seqnum = entry
+            cluster = ClusterState()
+            eligible_names: "set[str]" = set()
+            for msg in request.nodes:
+                node, node_eligible = wire.consolidation_node_from_wire(msg)
+                cluster.add_node(node)
+                if node_eligible:
+                    eligible_names.add(node.name)
+            overhead = list(request.daemon_overhead) or None
+            t0 = time.perf_counter()
+            action = run_consolidation(
+                cluster, solver.catalog, solver.provisioners,
+                daemon_overhead=overhead, now=request.now,
+                grid=solver.grid(),  # the Sync'd device-resident grid — no rebuild
+                multi_node=request.multi_node,
+                # -1 = unset sentinel -> server default; 0 legitimately
+                # DISABLES the pair search (proto3 zero-value trap)
+                max_pair_candidates=(MAX_PAIR_CANDIDATES
+                                     if request.max_pair_candidates < 0
+                                     else request.max_pair_candidates),
+                candidate_filter=lambda n: n.name in eligible_names)
+            ms = (time.perf_counter() - t0) * 1000
+            span.set_attributes(found=action is not None, consolidate_ms=ms)
+            return wire.action_to_response(action, ms)
 
     def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
         import jax

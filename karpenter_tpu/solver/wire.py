@@ -17,7 +17,25 @@ from ..models.pod import (PodAffinityTerm, PodSpec, Taint, Toleration,
                           TopologySpreadConstraint, group_pods)
 from ..models.requirements import Requirement, Requirements
 from ..oracle.scheduler import ExistingNode
+from ..tracing import SpanContext
 from . import solver_pb2 as pb
+
+# -- trace context ----------------------------------------------------------------
+
+
+def trace_context_to_wire(ctx) -> "pb.TraceContextMsg":
+    """SpanContext (or None) -> wire msg. An empty message means "caller not
+    tracing"; the service then roots its own trace."""
+    if ctx is None:
+        return pb.TraceContextMsg()
+    return pb.TraceContextMsg(trace_id=ctx.trace_id, span_id=ctx.span_id)
+
+
+def trace_context_from_wire(m) -> "SpanContext | None":
+    if m is None or not m.trace_id:
+        return None
+    return SpanContext(trace_id=m.trace_id, span_id=m.span_id)
+
 
 # -- requirements -----------------------------------------------------------------
 

@@ -38,8 +38,11 @@ def pin(platform: str, n_devices=None):
     for key, val in updates:
         try:
             jax.config.update(key, val)
-        except (RuntimeError, ValueError) as e:
-            warning = str(e)[:160]  # backends already initialized; env pin must suffice
+        except (RuntimeError, ValueError, AttributeError) as e:
+            # RuntimeError/ValueError: backends already initialized; the env
+            # pin must suffice. AttributeError: this jax predates the option
+            # (jax_num_cpu_devices) — XLA_FLAGS above covers the device count.
+            warning = str(e)[:160]
     try:
         # persistent compile cache: the stress-shape programs (50k-pod
         # dryrun, consolidation grids) cost 10-60s each to compile on the

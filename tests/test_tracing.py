@@ -1,0 +1,297 @@
+"""Tracing-plane tests: span nesting/parenting, cross-wire trace-context
+propagation (client solve and service span share one trace), Chrome
+trace_event export validity, ring-buffer bounding under concurrent
+writers, and the controller-integrated end-to-end trace surfaced through
+/debug/traces and the phase-duration histogram."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.nodetemplate import NodeTemplate
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.apis.settings import Settings
+from karpenter_tpu.fake.cloud import FakeCloud
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.models.requirements import OP_IN, Requirements
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.tracing import PHASE_METRIC, TRACER, SpanContext, Tracer
+from karpenter_tpu.utils.clock import FakeClock
+
+
+class TestSpanNesting:
+    def test_thread_local_parenting(self):
+        t = Tracer(ring_size=64, registry=None)
+        with t.start_span("root") as root:
+            with t.start_span("child") as child:
+                with t.start_span("grandchild") as grand:
+                    assert t.current_span() is grand
+                assert t.current_span() is child
+        assert t.current_span() is None
+        assert child.trace_id == root.trace_id == grand.trace_id
+        assert child.parent_id == root.span_id
+        assert grand.parent_id == child.span_id
+        assert root.parent_id == ""
+
+    def test_explicit_parent_beats_current(self):
+        t = Tracer(ring_size=64, registry=None)
+        other = t.start_span("other-root")
+        other.end()
+        with t.start_span("cur"):
+            s = t.start_span("adopted", parent=other)
+            assert s.trace_id == other.trace_id
+            assert s.parent_id == other.span_id
+            s.end()
+
+    def test_remote_context_joins_trace(self):
+        t = Tracer(ring_size=64, registry=None)
+        ctx = SpanContext(trace_id="aaaa", span_id="bbbb")
+        with t.start_span("joined", context=ctx) as s:
+            assert s.trace_id == "aaaa"
+            assert s.parent_id == "bbbb"
+        # an empty wire context (not tracing) falls through to a new root
+        with t.start_span("fresh", context=SpanContext("", "")) as s:
+            assert s.trace_id not in ("", "aaaa")
+            assert s.parent_id == ""
+
+    def test_exception_recorded_and_end_idempotent(self):
+        t = Tracer(ring_size=64, registry=None)
+        with pytest.raises(ValueError):
+            with t.start_span("boom") as s:
+                raise ValueError("x")
+        assert s.attributes["error"] is True
+        assert s.attributes["error.type"] == "ValueError"
+        first = s.duration_s
+        s.end()  # double-end is a no-op
+        assert s.duration_s == first
+        assert len(t.finished_spans()) == 1
+
+    def test_annotate_hits_current_span_only(self):
+        t = Tracer(ring_size=64, registry=None)
+        t.annotate(ignored=True)  # no current span: silently dropped
+        with t.start_span("s") as s:
+            t.annotate(transfer_ms=1.5, compile_cache="hit")
+        assert s.attributes == {"transfer_ms": 1.5, "compile_cache": "hit"}
+
+
+class TestChromeExport:
+    def test_chrome_trace_event_validity(self):
+        t = Tracer(ring_size=64, registry=None)
+        with t.start_span("cycle", pods=3) as root:
+            with t.start_span("solve"):
+                pass
+        doc = json.loads(t.chrome_trace_json(root.trace_id))
+        assert set(doc) == {"traceEvents", "displayTimeUnit"}
+        assert doc["displayTimeUnit"] == "ms"
+        assert len(doc["traceEvents"]) == 2
+        for ev in doc["traceEvents"]:
+            assert ev["ph"] == "X"
+            assert isinstance(ev["ts"], float) and ev["ts"] > 0
+            assert isinstance(ev["dur"], float) and ev["dur"] >= 0
+            assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+            assert ev["cat"] == root.trace_id
+        # events are time-sorted; the root opened first
+        assert [e["name"] for e in doc["traceEvents"]] == ["cycle", "solve"]
+        assert doc["traceEvents"][0]["args"] == {"pods": 3}
+
+    def test_trace_id_filter(self):
+        t = Tracer(ring_size=64, registry=None)
+        with t.start_span("a") as a:
+            pass
+        with t.start_span("b"):
+            pass
+        doc = t.chrome_trace(a.trace_id)
+        assert [e["name"] for e in doc["traceEvents"]] == ["a"]
+        assert len(t.chrome_trace()["traceEvents"]) == 2
+
+    def test_traces_listing_groups_and_bounds(self):
+        t = Tracer(ring_size=64, registry=None)
+        for i in range(5):
+            with t.start_span(f"root-{i}"):
+                with t.start_span("child"):
+                    pass
+        out = t.traces(limit=3)
+        assert [tr["root"] for tr in out] == ["root-4", "root-3", "root-2"]
+        assert all(tr["n_spans"] == 2 for tr in out)
+
+
+class TestRingBounding:
+    def test_concurrent_writers_stay_bounded(self):
+        t = Tracer(ring_size=50, registry=None)
+        errors = []
+
+        def writer(k):
+            try:
+                for i in range(200):
+                    with t.start_span(f"w{k}-{i}"):
+                        pass
+            except Exception as e:  # pragma: no cover - diagnostic
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors
+        assert len(t.finished_spans()) == 50
+        # every thread kept an isolated stack: none left a dangling current
+        assert t.current_span() is None
+
+
+class TestCrossWirePropagation:
+    """Client solve -> service spans must share ONE trace id, with the
+    service span parented under the client's rpc span, and the device-path
+    observability (routing / compile_cache / transfer_ms) recorded on BOTH
+    sides of the wire."""
+
+    def _catalog(self):
+        return Catalog(types=[
+            make_instance_type("m.large", cpu=2, memory="8Gi",
+                               od_price=0.10, spot_price=0.03),
+            make_instance_type("m.xlarge", cpu=4, memory="16Gi",
+                               od_price=0.20, spot_price=0.06),
+        ])
+
+    def test_solve_joins_one_trace_with_attrs_on_both_sides(self):
+        import grpc
+
+        from karpenter_tpu.solver.client import RemoteSolver
+        from karpenter_tpu.solver.service import serve
+
+        cat = self._catalog()
+        prov = Provisioner(name="default", requirements=Requirements.of(
+            (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+        prov.set_defaults()
+        srv, port, _svc = serve("127.0.0.1:0")
+        try:
+            rs = RemoteSolver(
+                cat, [prov],
+                channel=grpc.insecure_channel(f"127.0.0.1:{port}"))
+            pods = [make_pod(f"p{i}", cpu="500m", memory="1Gi")
+                    for i in range(8)]
+            TRACER.clear()
+            with TRACER.start_span("provisioning.solve") as outer:
+                result = rs.solve(pods)
+            assert result.nodes
+            spans = {s.name: s for s in TRACER.finished_spans()}
+            need = {"provisioning.solve", "solver.rpc.Sync",
+                    "solver.service.Sync", "solver.rpc.Solve",
+                    "solver.service.Solve"}
+            assert need <= set(spans)
+            # one connected trace across the wire
+            assert {s.trace_id for s in spans.values()} == {outer.trace_id}
+            rpc, svc = spans["solver.rpc.Solve"], spans["solver.service.Solve"]
+            assert svc.parent_id == rpc.span_id
+            assert rpc.parent_id == outer.span_id
+            # both wire sides carry the device-path observability
+            for side in (rpc, svc):
+                assert side.attributes["routing"] == "tpu"
+                assert side.attributes["compile_cache"] in ("hit", "miss")
+                assert side.attributes["transfer_ms"] >= 0.0
+                assert side.attributes["solve_ms"] > 0.0
+            # the service side additionally breaks the pipeline down
+            for key in ("encode_ms", "dispatch_ms", "decode_ms"):
+                assert key in svc.attributes
+            # the echo bubbled up to the enclosing controller-phase span
+            assert outer.attributes["routing"] == "tpu"
+        finally:
+            srv.stop(grace=None)
+
+
+class TestOperatorTrace:
+    """One provisioning cycle under the fake cloud yields one connected
+    trace with mask/solve/bind children, exported through /debug/traces
+    and observed into the phase-duration histogram."""
+
+    def _operator(self):
+        cat = Catalog(types=[
+            make_instance_type("t.small", cpu=2, memory="2Gi",
+                               od_price=0.05, spot_price=0.02),
+            make_instance_type("m.xlarge", cpu=16, memory="64Gi",
+                               od_price=0.80, spot_price=0.28),
+        ])
+        clock = FakeClock()
+        cloud = FakeCloud(catalog=cat, clock=clock)
+        op = Operator(cloud,
+                      Settings(cluster_name="trace",
+                               cluster_endpoint="https://k.example",
+                               batch_idle_duration=0.0,
+                               batch_max_duration=0.0),
+                      cat, clock=clock, serve_http=True,
+                      metrics_port=0, health_port=0, webhook_port=0)
+        op.kube.create("nodetemplates", "default", NodeTemplate(
+            name="default",
+            subnet_selector={"id": "subnet-zone-1a"},
+            security_group_selector={"id": "sg-default"}))
+        op.cloudprovider.register_nodetemplate(
+            op.kube.get("nodetemplates", "default"))
+        p = Provisioner(name="default", provider_ref="default")
+        p.set_defaults()
+        op.kube.create("provisioners", "default", p)
+        return op
+
+    def _get(self, port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, r.read().decode()
+
+    def test_cycle_trace_debug_surface_and_histogram(self):
+        op = self._operator()
+        try:
+            ports = op.serving.start()
+            for i in range(50):
+                op.kube.create("pods", f"p{i}",
+                               make_pod(f"p{i}", cpu="500m", memory="1Gi"))
+            TRACER.clear()
+            op.provisioning.reconcile_once()
+            assert len(op.kube.pending_pods()) == 0
+
+            spans = [s for s in TRACER.finished_spans()
+                     if s.name.startswith("provisioning.")]
+            by_name = {s.name: s for s in spans}
+            root = by_name["provisioning.cycle"]
+            for phase in ("mask", "solve", "bind"):
+                child = by_name[f"provisioning.{phase}"]
+                assert child.trace_id == root.trace_id
+                assert child.parent_id == root.span_id
+            assert root.attributes["pods"] == 50
+            assert by_name["provisioning.solve"].attributes["routing"]
+            assert "compile_cache" in by_name["provisioning.solve"].attributes
+            assert "transfer_ms" in by_name["provisioning.solve"].attributes
+
+            # /debug/traces listing contains the cycle trace
+            status, body = self._get(ports["metrics"], "/debug/traces")
+            assert status == 200
+            listing = json.loads(body)["traces"]
+            ids = {tr["trace_id"] for tr in listing}
+            assert root.trace_id in ids
+            # ?id= exports valid Chrome JSON for exactly that trace
+            status, body = self._get(
+                ports["metrics"], f"/debug/traces?id={root.trace_id}")
+            assert status == 200
+            doc = json.loads(body)
+            names = {e["name"] for e in doc["traceEvents"]}
+            assert {"provisioning.cycle", "provisioning.mask",
+                    "provisioning.solve", "provisioning.bind"} <= names
+            assert all(e["ph"] == "X" for e in doc["traceEvents"])
+            # unknown id is a 404, not an empty export
+            try:
+                status, _ = self._get(ports["metrics"],
+                                      "/debug/traces?id=deadbeef")
+            except urllib.error.HTTPError as e:
+                status = e.code
+            assert status == 404
+            # spans fed the phase-duration histogram
+            status, body = self._get(ports["metrics"], "/metrics")
+            assert status == 200
+            for phase in ("provisioning.cycle", "provisioning.mask",
+                          "provisioning.solve", "provisioning.bind"):
+                assert f'{PHASE_METRIC}_count{{phase="{phase}"}}' in body
+        finally:
+            op.stop()
