@@ -1,0 +1,100 @@
+#!/usr/bin/env python3
+"""Lint: every benchmark number quoted in docs must cite a recorded artifact.
+
+Round docs and the README quote performance numbers (ms, msgs/s, speedup
+factors). Unattributed numbers rot: the next round can neither reproduce
+nor refute them. This lint walks README.md and docs/rounds/*.md at
+paragraph granularity and requires any paragraph quoting a benchmark
+number to also cite where it was recorded — an artifact path
+(benchmarks/results/..., a bench_*/tpu_*/linkprobe_*/chaos_seed* JSON,
+a .trace.json capture) or the harness that records one (benchmarks/*.py).
+
+Numbers that are configuration, not measurement (batcher windows, TTLs),
+are waived inline with:
+
+    <!-- no-artifact: <why this number is config, not a measurement> -->
+
+Run via `make presubmit` (or directly: python hack/check_round_claims.py).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+# a paragraph "quotes a benchmark number" when it matches any of these
+CLAIM_PATTERNS = [
+    re.compile(r"\b\d+(?:\.\d+)?(?:-\d+(?:\.\d+)?)?\s*ms\b"),
+    re.compile(r"\b\d[\d,.]*k?\s*(?:msgs?|ops|pods)/s"),
+    re.compile(r"~?\d+(?:\.\d+)?\s*[x×]\s*(?:faster|slower|speedup|warm|cheaper)"),
+]
+
+# ...and "cites an artifact" when it matches any of these
+ARTIFACT_PATTERNS = [
+    re.compile(r"benchmarks/[\w./*-]+"),
+    re.compile(r"\b(?:tpu|bench|trace_summary|linkprobe|chaos_seed)"
+               r"[\w*-]*\.json(?:\.gz)?"),
+    re.compile(r"[\w*-]+\.trace\.json(?:\.gz)?"),
+]
+
+WAIVER = re.compile(r"<!--\s*no-artifact:\s*\S[^>]*-->")
+
+LINTED = ["README.md"]
+
+
+def paragraphs(text: str):
+    """(first_line_number, paragraph) blocks, blank-line separated."""
+    block, start = [], 1
+    for i, line in enumerate(text.splitlines(), 1):
+        if line.strip():
+            if not block:
+                start = i
+            block.append(line)
+        elif block:
+            yield start, "\n".join(block)
+            block = []
+    if block:
+        yield start, "\n".join(block)
+
+
+def lint_file(path: pathlib.Path) -> "list[str]":
+    problems = []
+    rel = path.relative_to(ROOT)
+    for lineno, para in paragraphs(path.read_text()):
+        claims = [m.group(0) for pat in CLAIM_PATTERNS
+                  for m in pat.finditer(para)]
+        if not claims:
+            continue
+        if WAIVER.search(para):
+            continue
+        if any(pat.search(para) for pat in ARTIFACT_PATTERNS):
+            continue
+        problems.append(
+            f"{rel}:{lineno}: benchmark number(s) {claims[:3]} without a "
+            f"recorded-artifact citation (add a benchmarks/results/ path, "
+            f"or waive config constants with <!-- no-artifact: why -->)")
+    return problems
+
+
+def main() -> int:
+    targets = [ROOT / p for p in LINTED]
+    targets += sorted((ROOT / "docs" / "rounds").glob("*.md"))
+    problems = []
+    for path in targets:
+        if path.exists():
+            problems += lint_file(path)
+    if problems:
+        print(f"check_round_claims: {len(problems)} unattributed "
+              f"benchmark claim(s):")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print(f"check_round_claims: OK ({len(targets)} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

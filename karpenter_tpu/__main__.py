@@ -391,6 +391,35 @@ def cmd_get(args) -> int:
     return 0
 
 
+def cmd_chaos(args) -> int:
+    """Seeded chaos sweep: drive faulted scenarios to convergence, check
+    the cross-layer invariants, and write a replay artifact."""
+    from .chaos import ChaosRunner
+
+    runner = ChaosRunner(seed=args.seed, scenarios=args.scenarios,
+                         intensity=args.intensity,
+                         out_dir=args.out_dir or None)
+    artifact = runner.run()
+    for s in artifact["scenarios"]:
+        verdict = "PASS" if s["passed"] else "FAIL"
+        print(f"seed={s['seed']} scenario={s['scenario']} {verdict} "
+              f"kinds={len(s['fired_kinds'])} layers={','.join(s['layers'])} "
+              f"nodes={s['final_nodes']} settle={s['settle_cycles']}")
+        for v in s["violations"]:
+            print(f"  VIOLATION [{v['invariant']}] {v['message']}")
+    if artifact.get("artifact_path"):
+        print(f"artifact: {artifact['artifact_path']}")
+    if not artifact["passed"]:
+        print(f"REPRODUCE: python -m karpenter_tpu chaos --seed {args.seed} "
+              f"--scenarios {args.scenarios}")
+        return 1
+    print(f"chaos: {artifact['scenario_count']} scenario(s) passed, "
+          f"{len(artifact['fault_kinds'])} fault kinds across "
+          f"{len(artifact['layers'])} layers "
+          f"({artifact['duration_s']}s)")
+    return 0
+
+
 def main(argv=None) -> int:
     logging.basicConfig(
         level=logging.INFO,
@@ -501,6 +530,20 @@ def main(argv=None) -> int:
     p_get.add_argument("kind", help="nodes, pods, machines, provisioners, ...")
     p_get.add_argument("--kubeconfig", required=True)
     p_get.set_defaults(fn=cmd_get)
+
+    p_chaos = sub.add_parser(
+        "chaos", help="seeded deterministic fault-injection sweep with "
+                      "cross-layer invariant checks (docs/designs/chaos.md)")
+    p_chaos.add_argument("--seed", type=int, default=0,
+                         help="plan seed; the same seed replays the identical "
+                              "fault sequence and verdict")
+    p_chaos.add_argument("--scenarios", type=int, default=1,
+                         help="scenarios derived from the seed (0..K-1)")
+    p_chaos.add_argument("--intensity", type=float, default=1.0,
+                         help="fault-count multiplier per site")
+    p_chaos.add_argument("--out-dir", default="benchmarks/results/chaos",
+                         help="replay-artifact directory ('' disables)")
+    p_chaos.set_defaults(fn=cmd_chaos)
 
     p_ver = sub.add_parser("version")
     p_ver.set_defaults(fn=lambda a: print(VERSION) or 0)

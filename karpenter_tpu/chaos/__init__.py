@@ -1,0 +1,19 @@
+"""Deterministic chaos plane: seeded fault injection + safety invariants.
+
+See docs/designs/chaos.md. Entry points:
+
+    python -m karpenter_tpu chaos --seed 7 --scenarios 3
+    make chaos
+"""
+
+from .inject import ChaosInjector
+from .invariants import Violation, check_all
+from .plan import (CALL_SITES, CYCLE_SITES, LAYER_OF_KIND, SITES, ChaosRng,
+                   FaultPlan, FaultSpec)
+from .runner import ChaosRunner
+
+__all__ = [
+    "CALL_SITES", "CYCLE_SITES", "LAYER_OF_KIND", "SITES",
+    "ChaosInjector", "ChaosRng", "ChaosRunner", "FaultPlan", "FaultSpec",
+    "Violation", "check_all",
+]

@@ -1,0 +1,314 @@
+"""Site hooks: wire a FaultPlan into a live operator.
+
+Each hook wraps one boundary the production stack already crosses —
+the cloud API (below the batchers, so coalescing/retry behavior is
+exercised), the kube write surface, the solver client, and the wire
+cloud-API server — and consults the plan by per-site call index. When
+the injector is disabled the hooks are a strict no-op fast path: one
+attribute read, no locks, no counting.
+
+Determinism: the injector also serializes the operator's worker pools
+(launch + interruption, 1 worker each) so every site's call order — and
+therefore which call each scheduled index lands on — is a pure function
+of the seed. Faults FIRE in deterministic order; the recorded `fired`
+sequence is the replay artifact.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from ..apis import wellknown as wk
+from ..batcher.fleet import (CreateFleetBatcher, DescribeInstancesBatcher,
+                             TerminateInstancesBatcher)
+from ..coordination.httpkube import ApiError
+from ..oracle.scheduler import Scheduler
+from ..solver.client import SolverUnavailable
+from ..utils import errors as cloud_errors
+from . import plan as planmod
+from .plan import (KIND_CLOUD_5XX, KIND_CLOUD_ICE, KIND_CLOUD_TIMEOUT,
+                   KIND_CLOCK_SKEW, KIND_KUBE_REQ_DISCONNECT,
+                   KIND_KUBE_RESP_DISCONNECT, KIND_KUBE_WATCH_RESET,
+                   KIND_SOLVER_CRASH, KIND_SPOT_BURST, FaultPlan)
+
+
+class _ChaosSolver:
+    """Primary-backend stand-in: crashes mid-Solve when the plan says so,
+    otherwise delegates to the scalar oracle (pure python — the chaos
+    tier needs deterministic, compile-free solves; backend parity is
+    proven elsewhere). A crash exercises provisioning's real degrade
+    chain: primary -> native -> oracle."""
+
+    def __init__(self, catalog, provisioners, injector: "ChaosInjector"):
+        self._catalog = catalog
+        self._provisioners = provisioners
+        self._injector = injector
+
+    def solve(self, pods, existing=None, daemon_overhead=None):
+        fault = self._injector.maybe("solver.solve")
+        if fault is not None:
+            raise SolverUnavailable(
+                "chaos: solver sidecar crashed mid-Solve")
+        from ..controllers.provisioning import _oracle_to_solve_result
+
+        sched = Scheduler(self._catalog, self._provisioners,
+                          daemon_overhead or [0] * wk.NUM_RESOURCES)
+        return _oracle_to_solve_result(
+            sched.schedule(list(pods), existing=existing or []), sched)
+
+
+class ChaosInjector:
+    def __init__(self, plan: FaultPlan, enabled: bool = True):
+        self.plan = plan
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._counts: "dict[str, int]" = {}
+        self.fired: "list[dict]" = []  # occurrence-ordered (site, index, kind)
+        # wire-mode CreateFleet ledger: client token -> inner launches
+        self.token_launches: "dict[str, int]" = {}
+        self.consolidation_actions: "list[dict]" = []
+        # ICE pools currently injected -> cycle index at which they expire
+        self._ice_expiry: "dict[tuple[str, str, str], int]" = {}
+        self._cycle_rng = planmod.ChaosRng(
+            (plan.seed << 8) ^ plan.scenario).fork("cycle-choices")
+
+    # -- core site query -------------------------------------------------------
+
+    def maybe(self, site: str):
+        """Consult the plan at this site's next call index. Returns the
+        FaultSpec to apply, or None. Disabled => strict no-op."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            idx = self._counts.get(site, 0)
+            self._counts[site] = idx + 1
+            fault = self.plan.at(site, idx)
+            if fault is not None:
+                self.fired.append(fault.as_dict())
+            return fault
+
+    def site_counts(self) -> "dict[str, int]":
+        with self._lock:
+            return dict(self._counts)
+
+    def fired_kinds(self) -> "set[str]":
+        with self._lock:
+            return {f["kind"] for f in self.fired}
+
+    @contextlib.contextmanager
+    def paused(self):
+        """Harness-internal traffic (workload writes, assertions) must not
+        consume fault indices."""
+        prev = self.enabled
+        self.enabled = False
+        try:
+            yield
+        finally:
+            self.enabled = prev
+
+    # -- installation ----------------------------------------------------------
+
+    def install(self, op, cloud) -> None:
+        """Hook every hermetic site on an assembled (not started) operator."""
+        self._wrap_cloud_api(cloud.create_fleet_api, "cloud.create_fleet")
+        self._wrap_cloud_api(cloud.describe_instances_api, "cloud.describe")
+        self._wrap_cloud_api(cloud.terminate_instances_api, "cloud.terminate")
+        self._wrap_kube_writes(op.kube)
+        self._hook_solver(op)
+        self._hook_consolidation_ledger(op)
+        self._serialize_pools(op)
+        self._shrink_batcher_windows(op)
+
+    def _wrap_cloud_api(self, mocked_fn, site: str) -> None:
+        orig = mocked_fn.default_fn
+
+        def wrapped(request, _orig=orig, _site=site):
+            fault = self.maybe(_site)
+            if fault is not None:
+                if fault.kind == KIND_CLOUD_TIMEOUT:
+                    raise TimeoutError(f"chaos: {_site} timed out")
+                raise cloud_errors.CloudError(
+                    "InternalError", f"chaos: injected 5xx at {_site}")
+            return _orig(request)
+
+        mocked_fn.default_fn = wrapped
+
+    def _wrap_kube_writes(self, kube) -> None:
+        """Emulate the httpkube transport's failure phases against the
+        in-process store: request-phase means the write never applied;
+        response-phase means it DID apply and only the ack was lost — the
+        double-apply/retry class PR 1 hardened the real transport against.
+        Event writes pass through unhooked: they are fire-and-forget
+        observability traffic and would soak up every scheduled index."""
+        for method in ("create", "update", "delete", "bind_pod"):
+            orig = getattr(kube, method)
+
+            def wrapped(*args, _orig=orig, _method=method, **kwargs):
+                if _method != "bind_pod" and args and args[0] == "events":
+                    return _orig(*args, **kwargs)
+                fault = self.maybe("kube.write")
+                if fault is not None and fault.kind == KIND_KUBE_REQ_DISCONNECT:
+                    raise ApiError(
+                        0, f"chaos: connection lost before {_method} was sent")
+                out = _orig(*args, **kwargs)
+                if fault is not None and fault.kind == KIND_KUBE_RESP_DISCONNECT:
+                    raise ApiError(
+                        0, f"chaos: {_method} applied but the response was lost")
+                return out
+
+            setattr(kube, method, wrapped)
+
+    def _hook_solver(self, op) -> None:
+        # route_threshold=0 classifies every batch as "large" -> the
+        # primary (our crashing stand-in) runs first and its failures
+        # exercise the real degrade chain
+        op.provisioning.route_threshold = 0
+        op.provisioning._solver_factory = (
+            lambda catalog, provs: _ChaosSolver(catalog, provs, self))
+        op.provisioning._solver_cache.clear()
+
+    def _hook_consolidation_ledger(self, op) -> None:
+        """Record every consolidation action WITH the disrupted nodes'
+        prices at decision time — the cost invariant's evidence."""
+        orig = op.deprovisioning._record_action
+
+        def wrapped(action, now, label="", _orig=orig):
+            prices = {}
+            for name in action.nodes:
+                node = op.cluster.nodes.get(name)
+                if node is not None:
+                    prices[name] = node.price
+            self.consolidation_actions.append({
+                "kind": action.kind,
+                "nodes": list(action.nodes),
+                "savings": action.savings,
+                "replacement_price": (action.replacement[3]
+                                      if action.replacement else None),
+                "node_prices": prices,
+            })
+            return _orig(action, now, label=label)
+
+        op.deprovisioning._record_action = wrapped
+
+    def _serialize_pools(self, op) -> None:
+        for obj, attr, prefix in ((op.provisioning, "_pool", "launch"),
+                                  (op.interruption, "_pool", "interruption")):
+            if obj is None:
+                continue
+            old = getattr(obj, attr)
+            old.shutdown(wait=False)
+            setattr(obj, attr, ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"chaos-{prefix}"))
+
+    def _shrink_batcher_windows(self, op) -> None:
+        """The default Describe/Terminate windows (100ms real-time idle)
+        would dominate a many-cycle scenario's wall clock; sub-ms windows
+        keep the same coalescing code path on the serialized call stream."""
+        inst = op.cloudprovider.instances
+        for attr, cls in (("fleet", CreateFleetBatcher),
+                          ("describe", DescribeInstancesBatcher),
+                          ("terminate", TerminateInstancesBatcher)):
+            old = getattr(inst, attr)
+            old.stop()
+            setattr(inst, attr, cls(inst.cloud, idle=0.0005, max_wait=0.002))
+
+    # -- wire mode -------------------------------------------------------------
+
+    def install_wire(self, server, cloud) -> None:
+        """Hook the cloud-API server: a per-token launch ledger (proof the
+        ClientToken dedupe holds) and the post-dispatch 5xx site — the
+        fault that makes the dedupe load-bearing: the launch ran, the 500
+        ate the response, the client retries the same token."""
+        fleet_lock = threading.Lock()
+        orig = server.dispatch
+
+        def dispatch(action, payload, _orig=orig):
+            if action != "CreateFleet":
+                return _orig(action, payload)
+            token = payload.get("client_token", "")
+            with fleet_lock:  # serialize so launch attribution is exact
+                before = cloud.create_fleet_api.called_with_count
+                try:
+                    out = _orig(action, payload)
+                finally:
+                    if token:
+                        delta = (cloud.create_fleet_api.called_with_count
+                                 - before)
+                        self.token_launches[token] = (
+                            self.token_launches.get(token, 0) + delta)
+                fault = self.maybe("wire.create_fleet")
+                if fault is not None:
+                    raise RuntimeError(
+                        "chaos: connection dropped after CreateFleet "
+                        "dispatched")
+                return out
+
+        server.dispatch = dispatch
+
+    # -- cycle-driven faults ---------------------------------------------------
+
+    def on_cycle(self, op, cloud, cycle: int) -> "list[str]":
+        """Consult every cycle site once; returns the kinds applied (the
+        runner logs them). Also expires previously injected ICE pools."""
+        applied = []
+        for pool, expires in list(self._ice_expiry.items()):
+            if cycle >= expires:
+                cloud.insufficient_capacity_pools.discard(pool)
+                del self._ice_expiry[pool]
+        for site in sorted(planmod.CYCLE_SITES):
+            fault = self.maybe(site)
+            if fault is None:
+                continue
+            if fault.kind == KIND_CLOUD_ICE:
+                self._inject_ice(cloud, cycle, fault)
+            elif fault.kind == KIND_SPOT_BURST:
+                self._inject_spot_burst(op, cloud, fault)
+            elif fault.kind == KIND_CLOCK_SKEW:
+                op.clock.step(fault.param)
+            elif fault.kind == KIND_KUBE_WATCH_RESET:
+                self._inject_watch_reset(op)
+            applied.append(fault.kind)
+        return applied
+
+    def _inject_ice(self, cloud, cycle: int, fault) -> None:
+        if cloud.catalog is None or not cloud.catalog.types:
+            return
+        itype = self._cycle_rng.choice(
+            sorted(t.name for t in cloud.catalog.types))
+        zone = self._cycle_rng.choice(
+            sorted(s.zone for s in cloud.subnets))
+        ct = self._cycle_rng.choice(
+            (wk.CAPACITY_TYPE_ON_DEMAND, wk.CAPACITY_TYPE_SPOT))
+        pool = (ct, itype, zone)
+        cloud.insufficient_capacity_pools.add(pool)
+        self._ice_expiry[pool] = cycle + int(fault.param)
+
+    def _inject_spot_burst(self, op, cloud, fault) -> None:
+        if op.interruption is None:
+            return
+        with cloud.lock:
+            spot = sorted(i.id for i in cloud.instances.values()
+                          if i.state == "running"
+                          and i.capacity_type == wk.CAPACITY_TYPE_SPOT)
+        for _ in range(int(fault.param)):
+            if not spot:
+                break
+            iid = spot.pop(self._cycle_rng.next_u64() % len(spot))
+            op.queue.send(json.dumps({
+                "source": "cloud.spot",
+                "detail-type": "Spot Instance Interruption Warning",
+                "detail": {"instance-id": iid}}))
+
+    def _inject_watch_reset(self, op) -> None:
+        """A dropped watch stream forces a relist, and the relist replays
+        every object as 'modified' — the echo storm every watcher must
+        absorb without corrupting derived state."""
+        kube = op.kube
+        for kind in kube.KINDS:
+            with kube._lock:
+                objs = sorted(kube._objects[kind].items())
+            for _name, obj in objs:
+                kube._notify(kind, "modified", obj)

@@ -1,0 +1,180 @@
+"""Cross-layer safety invariants, asserted at quiescence.
+
+These are the properties the whole controller plane exists to keep true
+no matter what the cloud or the transport did mid-flight (ISSUE 2;
+reference deprovisioning/interruption docs). They are checked against
+FINAL state — transient violations during convergence are expected and
+legal; a violation that survives the settle + GC phases is a real bug.
+
+Each check returns Violation records rather than raising, so one run
+reports every broken property at once and the runner can embed them in
+the replay artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..models.machine import parse_provider_id
+
+# prices are catalog floats; replacement-vs-disrupted comparisons must
+# tolerate representation error, never real cost regressions
+_COST_EPS = 1e-9
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    invariant: str
+    message: str
+
+    def as_dict(self) -> dict:
+        return {"invariant": self.invariant, "message": self.message}
+
+
+def _iid_of_machine(machine) -> str:
+    pid = machine.status.provider_id
+    if not pid:
+        return ""
+    try:
+        return parse_provider_id(pid)[1]
+    except ValueError:
+        return ""
+
+
+def check_token_ledger(token_launches: "dict[str, int]") -> "list[Violation]":
+    """No client token ever double-launches a fleet (EC2 ClientToken
+    semantics; the PR 1 dedupe). The ledger counts INNER launches per
+    token at the cloud-API server — a transport retry must replay, not
+    relaunch."""
+    return [
+        Violation("token-single-launch",
+                  f"client token {tok!r} launched {n} fleets (expected <=1)")
+        for tok, n in sorted(token_launches.items()) if n > 1
+    ]
+
+
+def check_bijection(op, cloud) -> "list[Violation]":
+    """Cloud instances <-> machines <-> nodes form a bijection: no leaked
+    instance without a machine, no machine without live capacity, no
+    cluster node without either, and the kube node objects mirror the
+    cluster state."""
+    out = []
+    with cloud.lock:
+        live = {i.id for i in cloud.instances.values()
+                if i.state != "terminated"}
+    machines = {m.name: _iid_of_machine(m) for m in op.kube.machines()}
+    machine_iids = {iid for iid in machines.values() if iid}
+    node_iids = {}
+    for name, node in sorted(op.cluster.nodes.items()):
+        if node.provider_id:
+            node_iids[node.provider_id.rsplit("/", 1)[-1]] = name
+    for iid in sorted(live - machine_iids):
+        out.append(Violation(
+            "no-leaked-instances",
+            f"cloud instance {iid} is running with no owning machine"))
+    for name, iid in sorted(machines.items()):
+        if iid and iid not in live:
+            out.append(Violation(
+                "no-ghost-machines",
+                f"machine {name} references terminated/absent instance {iid}"))
+    for iid, name in sorted(node_iids.items()):
+        if iid not in live:
+            out.append(Violation(
+                "no-ghost-nodes",
+                f"node {name} references terminated/absent instance {iid}"))
+    for iid in sorted(live - set(node_iids)):
+        out.append(Violation(
+            "instance-has-node",
+            f"running instance {iid} never registered a cluster node"))
+    kube_nodes = {n.name for n in op.kube.nodes()}
+    cluster_nodes = set(op.cluster.nodes)
+    for name in sorted(kube_nodes ^ cluster_nodes):
+        out.append(Violation(
+            "store-cluster-node-sync",
+            f"node {name} present in only one of kube store / cluster state"))
+    return out
+
+
+def check_binds(op) -> "list[Violation]":
+    """Every schedulable (non-daemon) pod binds exactly once: bound to a
+    node that exists, resident on exactly that node's pod list, and no
+    pod left pending at quiescence."""
+    out = []
+    residency: "dict[str, list[str]]" = {}
+    for nname, node in sorted(op.cluster.nodes.items()):
+        for p in node.pods:
+            residency.setdefault(p.name, []).append(nname)
+    for pod in sorted(op.kube.pods(), key=lambda p: p.name):
+        if pod.is_daemon():
+            continue
+        homes = residency.get(pod.name, [])
+        if not pod.node_name:
+            out.append(Violation(
+                "pod-binds-once",
+                f"pod {pod.name} still unbound at quiescence"))
+        elif pod.node_name not in op.cluster.nodes:
+            out.append(Violation(
+                "pod-binds-once",
+                f"pod {pod.name} bound to nonexistent node {pod.node_name}"))
+        elif len(homes) != 1 or homes[0] != pod.node_name:
+            out.append(Violation(
+                "pod-binds-once",
+                f"pod {pod.name} bound to {pod.node_name} but resident on "
+                f"{homes or 'no node'}"))
+    return out
+
+
+def check_termination_terminal(op, cloud) -> "list[Violation]":
+    """Terminating machines always reach deleted: at quiescence nothing
+    may still be marked for deletion, and every terminated instance's
+    machine/node bookkeeping must be gone (covered by the bijection
+    checks for the object side)."""
+    out = []
+    for name, node in sorted(op.cluster.nodes.items()):
+        if node.marked_for_deletion:
+            out.append(Violation(
+                "termination-terminal",
+                f"node {name} still marked for deletion at quiescence"))
+    from ..models import machine as machine_model
+
+    for m in sorted(op.kube.machines(), key=lambda m: m.name):
+        if m.status.state == machine_model.TERMINATING or m.deleted:
+            out.append(Violation(
+                "termination-terminal",
+                f"machine {m.name} stuck in {m.status.state}"))
+    return out
+
+
+def check_consolidation_cost(actions: "list[dict]") -> "list[Violation]":
+    """Consolidation never raises fleet cost: a delete always saves; a
+    replace's new node must not cost more than the nodes it disrupts.
+    Checked per recorded action (mid-flight-safe: a global before/after
+    snapshot would misfire while a two-phase replace is in its legal
+    both-nodes-up window)."""
+    out = []
+    for i, a in enumerate(actions):
+        disrupted = sum(a["node_prices"].values())
+        if a["savings"] < -_COST_EPS:
+            out.append(Violation(
+                "consolidation-cost",
+                f"action #{i} ({a['kind']} {a['nodes']}) claims negative "
+                f"savings {a['savings']:.6f}"))
+        if a["kind"] == "replace" and a["replacement_price"] is not None:
+            if a["replacement_price"] > disrupted + _COST_EPS:
+                out.append(Violation(
+                    "consolidation-cost",
+                    f"action #{i} replaces {a['nodes']} "
+                    f"(${disrupted:.4f}/h) with a pricier node "
+                    f"(${a['replacement_price']:.4f}/h)"))
+    return out
+
+
+def check_all(op, cloud, token_launches=None,
+              consolidation_actions=None) -> "list[Violation]":
+    out = []
+    out += check_token_ledger(token_launches or {})
+    out += check_bijection(op, cloud)
+    out += check_binds(op)
+    out += check_termination_terminal(op, cloud)
+    out += check_consolidation_cost(consolidation_actions or [])
+    return out

@@ -1,0 +1,197 @@
+"""Seeded fault plans: one integer seed -> a deterministic fault schedule.
+
+The plan is the chaos plane's source of truth (docs/designs/chaos.md).
+It owns its PRNG (splitmix64 — the same generator family JAX uses for
+threefry key splitting; zero dependencies, no `random`-module state, no
+wall clock), so two processes given the same seed derive byte-identical
+schedules. Faults are scheduled at named SITES by call index: "the 3rd
+CreateFleet raises a 5xx", "cycle 7 injects a spot-interruption burst".
+Whether a scheduled fault actually FIRES depends on how many times the
+run reaches that site — the fired sequence is the replay artifact
+(runner.py), the plan is the contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# -- fault kinds (>=6 kinds across >=3 layers; ISSUE 2 tentpole) -------------
+
+# cloud backend layer
+KIND_CLOUD_5XX = "cloud-5xx"              # CreateFleet/Describe/Terminate InternalError
+KIND_CLOUD_TIMEOUT = "cloud-timeout"      # API call hangs past the client deadline
+KIND_CLOUD_ICE = "cloud-ice"              # pool goes InsufficientInstanceCapacity
+KIND_WIRE_5XX_POST_DISPATCH = "wire-5xx-post-dispatch"  # 500 AFTER the launch ran
+# kube coordination layer
+KIND_KUBE_REQ_DISCONNECT = "kube-req-disconnect"    # write lost before the apply
+KIND_KUBE_RESP_DISCONNECT = "kube-resp-disconnect"  # write APPLIED, response lost
+KIND_KUBE_WATCH_RESET = "kube-watch-reset"          # watch drop -> relist echo storm
+# solver layer
+KIND_SOLVER_CRASH = "solver-crash"        # sidecar dies mid-Solve (SolverUnavailable)
+# environment layer
+KIND_SPOT_BURST = "spot-burst"            # interruption warnings for running spot
+KIND_CLOCK_SKEW = "clock-skew"            # fake clock jumps forward
+
+LAYER_OF_KIND = {
+    KIND_CLOUD_5XX: "cloud",
+    KIND_CLOUD_TIMEOUT: "cloud",
+    KIND_CLOUD_ICE: "cloud",
+    KIND_WIRE_5XX_POST_DISPATCH: "cloud",
+    KIND_KUBE_REQ_DISCONNECT: "kube",
+    KIND_KUBE_RESP_DISCONNECT: "kube",
+    KIND_KUBE_WATCH_RESET: "kube",
+    KIND_SOLVER_CRASH: "solver",
+    KIND_SPOT_BURST: "environment",
+    KIND_CLOCK_SKEW: "environment",
+}
+
+# -- sites -------------------------------------------------------------------
+# Call-indexed sites are consulted once per call through the hook; cycle
+# sites once per runner cycle. (site -> candidate kinds)
+
+CALL_SITES = {
+    "cloud.create_fleet": (KIND_CLOUD_5XX, KIND_CLOUD_TIMEOUT),
+    "cloud.describe": (KIND_CLOUD_5XX, KIND_CLOUD_TIMEOUT),
+    "cloud.terminate": (KIND_CLOUD_5XX,),
+    "kube.write": (KIND_KUBE_REQ_DISCONNECT, KIND_KUBE_RESP_DISCONNECT),
+    "solver.solve": (KIND_SOLVER_CRASH,),
+    # armed only when the scenario runs over the wire (runner wire=True)
+    "wire.create_fleet": (KIND_WIRE_5XX_POST_DISPATCH,),
+}
+
+CYCLE_SITES = {
+    "cycle.ice": (KIND_CLOUD_ICE,),
+    "cycle.spot": (KIND_SPOT_BURST,),
+    "cycle.clock": (KIND_CLOCK_SKEW,),
+    "cycle.watch": (KIND_KUBE_WATCH_RESET,),
+}
+
+SITES = tuple(sorted(list(CALL_SITES) + list(CYCLE_SITES)))
+
+_MASK = (1 << 64) - 1
+
+
+class ChaosRng:
+    """splitmix64: tiny, fast, full-period, and trivially forkable —
+    every derived stream is a pure function of (seed, label)."""
+
+    def __init__(self, seed: int):
+        self._state = seed & _MASK
+
+    def next_u64(self) -> int:
+        self._state = (self._state + 0x9E3779B97F4A7C15) & _MASK
+        z = self._state
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK
+        return z ^ (z >> 31)
+
+    def uniform(self) -> float:
+        return self.next_u64() / float(1 << 64)
+
+    def randint(self, lo: int, hi: int) -> int:
+        """Uniform integer in [lo, hi] inclusive."""
+        if hi <= lo:
+            return lo
+        return lo + self.next_u64() % (hi - lo + 1)
+
+    def choice(self, seq):
+        return seq[self.next_u64() % len(seq)]
+
+    def sample_indices(self, k: int, horizon: int) -> "list[int]":
+        """k distinct indices in [0, horizon), sorted."""
+        k = min(k, horizon)
+        picked: "set[int]" = set()
+        while len(picked) < k:
+            picked.add(self.next_u64() % horizon)
+        return sorted(picked)
+
+    def fork(self, label: str) -> "ChaosRng":
+        """Derived stream: mixing the label through the generator itself
+        keeps forks independent without hashing machinery."""
+        h = ChaosRng(self._state ^ 0xA5A5A5A5A5A5A5A5)
+        for ch in label:
+            h._state = (h._state ^ ord(ch)) & _MASK
+            h.next_u64()
+        return ChaosRng(h.next_u64())
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    site: str
+    index: int   # 0-based call (or cycle) index at which the fault fires
+    kind: str
+    param: float = 0.0  # kind-specific magnitude (skew seconds, burst size, ...)
+
+    def as_dict(self) -> dict:
+        return {"site": self.site, "index": self.index,
+                "kind": self.kind, "param": self.param}
+
+
+class FaultPlan:
+    """The full schedule for one scenario. `at(site, index)` is the only
+    hot-path query; disabled sites are absent from the map entirely."""
+
+    # how deep into a site's call stream faults may land; kept small so a
+    # short scenario actually reaches the scheduled indices
+    CALL_HORIZON = 12
+    CYCLE_HORIZON = 14  # must stay < ChaosRunner.CHAOS_CYCLES
+
+    def __init__(self, seed: int, scenario: int = 0,
+                 faults: "dict[str, dict[int, FaultSpec]]" = None):
+        self.seed = seed
+        self.scenario = scenario
+        self.faults = faults or {}
+
+    @classmethod
+    def from_seed(cls, seed: int, scenario: int = 0, wire: bool = False,
+                  intensity: float = 1.0) -> "FaultPlan":
+        """Derive the schedule. `intensity` scales fault counts (the slow
+        sweep turns it up); wire=False leaves wire.* sites unarmed."""
+        root = ChaosRng((seed << 8) ^ scenario)
+        faults: "dict[str, dict[int, FaultSpec]]" = {}
+        for site in sorted(CALL_SITES):
+            if site.startswith("wire.") and not wire:
+                continue
+            kinds = CALL_SITES[site]
+            r = root.fork(site)
+            count = min(r.randint(1, max(1, int(3 * intensity))),
+                        cls.CALL_HORIZON)
+            per = {}
+            for idx in r.sample_indices(count, cls.CALL_HORIZON):
+                per[idx] = FaultSpec(site, idx, r.choice(kinds))
+            faults[site] = per
+        for site in sorted(CYCLE_SITES):
+            kinds = CYCLE_SITES[site]
+            r = root.fork(site)
+            count = min(r.randint(1, max(1, int(2 * intensity))),
+                        cls.CYCLE_HORIZON)
+            per = {}
+            for idx in r.sample_indices(count, cls.CYCLE_HORIZON):
+                kind = r.choice(kinds)
+                if kind == KIND_CLOCK_SKEW:
+                    param = float(r.randint(30, 240))  # seconds jumped
+                elif kind == KIND_SPOT_BURST:
+                    param = float(r.randint(1, 3))     # instances interrupted
+                elif kind == KIND_CLOUD_ICE:
+                    param = float(r.randint(2, 5))     # cycles the pool is ICE
+                else:
+                    param = 0.0
+                per[idx] = FaultSpec(site, idx, kind, param)
+            faults[site] = per
+        return cls(seed, scenario, faults)
+
+    def at(self, site: str, index: int) -> "FaultSpec | None":
+        per = self.faults.get(site)
+        if per is None:
+            return None
+        return per.get(index)
+
+    def describe(self) -> "list[dict]":
+        out = []
+        for site in sorted(self.faults):
+            for idx in sorted(self.faults[site]):
+                out.append(self.faults[site][idx].as_dict())
+        return out
+
+    def scheduled_kinds(self) -> "set[str]":
+        return {f.kind for per in self.faults.values() for f in per.values()}

@@ -1,0 +1,259 @@
+"""Chaos scenario driver: seed -> plan -> faulted convergence -> verdict.
+
+One scenario assembles a fresh hermetic operator (FakeClock, FakeCloud,
+in-process kube store), installs the injector, and drives reconcile
+cycles in two phases:
+
+  chaos phase   — CHAOS_CYCLES cycles with faults armed. Every cycle
+                  consults the cycle sites (ICE, spot burst, clock skew,
+                  watch reset), runs each controller once (exceptions
+                  logged, never fatal — crashing on an injected fault is
+                  itself a finding), and lets the workload "ReplicaSet"
+                  replace drained pods.
+  settle phase  — faults disarmed; cycles continue until quiescence or a
+                  step deadline, then the clock jumps past the GC grace
+                  window so leak reaping can run, then a final settle.
+
+After convergence the cross-layer invariants run and the scenario emits
+a JSON-serializable dict. Everything inside a scenario dict is a pure
+function of (seed, scenario) — that is the replay contract the tests
+assert — so volatile fields (wall-clock duration) live only at the
+artifact top level.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+from ..apis import wellknown as wk
+from ..apis.nodetemplate import NodeTemplate
+from ..apis.provisioner import Provisioner
+from ..apis.settings import Settings
+from ..fake.cloud import FakeCloud
+from ..models import machine as machine_model
+from ..models.instancetype import Catalog, make_instance_type
+from ..models.pod import make_pod
+from ..models.requirements import OP_IN, Requirements
+from ..operator import Operator
+from ..utils.clock import FakeClock
+from . import invariants
+from .plan import LAYER_OF_KIND, ChaosRng, FaultPlan
+from .inject import ChaosInjector
+
+log = logging.getLogger("karpenter.chaos")
+
+
+def chaos_catalog() -> Catalog:
+    """Small mixed catalog: enough shape diversity for consolidation to
+    have real choices, small enough that scenarios stay fast."""
+    return Catalog(types=[
+        make_instance_type("t.small", cpu=2, memory="2Gi",
+                           od_price=0.05, spot_price=0.02),
+        make_instance_type("m.large", cpu=4, memory="16Gi",
+                           od_price=0.20, spot_price=0.07),
+        make_instance_type("m.xlarge", cpu=16, memory="64Gi",
+                           od_price=0.80, spot_price=0.28),
+    ])
+
+
+class ChaosRunner:
+    CHAOS_CYCLES = 18          # > FaultPlan.CYCLE_HORIZON so every cycle fault can land
+    SETTLE_DEADLINE = 30       # settle cycles before declaring non-quiescence
+    CYCLE_SECONDS = 30.0
+
+    def __init__(self, seed: int, scenarios: int = 1, wire: bool = False,
+                 intensity: float = 1.0, out_dir: "str | None" = None):
+        self.seed = seed
+        self.scenarios = scenarios
+        self.wire = wire
+        self.intensity = intensity
+        self.out_dir = out_dir
+
+    # -- assembly --------------------------------------------------------------
+
+    def _build(self, clock: FakeClock):
+        catalog = chaos_catalog()
+        cloud = FakeCloud(catalog=catalog, clock=clock)
+        settings = Settings(cluster_name="chaos",
+                            cluster_endpoint="https://chaos.example",
+                            batch_idle_duration=0.0, batch_max_duration=0.0,
+                            interruption_queue_name="chaos-q")
+        op = Operator(cloud, settings, catalog, clock=clock)
+        op.kube.create("nodetemplates", "default", NodeTemplate(
+            name="default",
+            subnet_selector={
+                "id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"},
+            security_group_selector={"id": "sg-default"}))
+        op.cloudprovider.register_nodetemplate(
+            op.kube.get("nodetemplates", "default"))
+        prov = Provisioner(
+            name="default", provider_ref="default",
+            consolidation_enabled=True,
+            requirements=Requirements.of(
+                (wk.LABEL_CAPACITY_TYPE, OP_IN,
+                 [wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND])))
+        prov.set_defaults()
+        prov.validate()
+        op.kube.create("provisioners", "default", prov)
+        return op, cloud
+
+    def _workload(self, plan: FaultPlan) -> "dict[str, dict]":
+        """Derive the steady workload from the plan's PRNG family so every
+        scenario stresses a different shape — deterministically."""
+        r = ChaosRng((plan.seed << 8) ^ plan.scenario).fork("workload")
+        n = r.randint(6, 12)
+        sizes = (("1", "2Gi"), ("2", "4Gi"), ("500m", "1Gi"))
+        return {f"w{i}": {"cpu": c, "memory": m}
+                for i in range(n)
+                for c, m in (r.choice(sizes),)}
+
+    def _reconcile_workload(self, op, workload, injector) -> None:
+        """ReplicaSet analogue: pods drained by termination (the store
+        deletes them) or orphaned on a reaped node come back as fresh
+        unbound pods. Harness traffic must not consume fault indices."""
+        with injector.paused():
+            for name, shape in workload.items():
+                obj = op.kube.get("pods", name)
+                if obj is not None and obj.node_name \
+                        and obj.node_name not in op.cluster.nodes:
+                    op.kube.delete("pods", name)
+                    obj = None
+                if obj is None:
+                    op.kube.create("pods", name, make_pod(name, **shape))
+
+    # -- driving ---------------------------------------------------------------
+
+    _CONTROLLERS = ("settingswatch", "nodetemplate", "machinehydration",
+                    "provisioning", "machinelifecycle", "interruption",
+                    "deprovisioning", "termination", "counters",
+                    "garbagecollection")
+
+    def _drive_once(self, op, errors: "list[str]") -> None:
+        """reconcile_all_once + GC, but each controller individually
+        fenced: an injected fault escaping a controller's own error
+        handling is recorded, not fatal."""
+        for name in self._CONTROLLERS:
+            ctrl = getattr(op, name)
+            if ctrl is None:
+                continue
+            try:
+                ctrl.reconcile_once()
+            except Exception as e:  # noqa: BLE001 — the fence is the point
+                errors.append(f"{name}: {type(e).__name__}: {e}")
+
+    def _quiescent(self, op) -> bool:
+        if op.kube.pending_pods():
+            return False
+        if any(n.marked_for_deletion for n in op.cluster.nodes.values()):
+            return False
+        if getattr(op.deprovisioning, "_pending_replace", None):
+            return False
+        for m in op.kube.machines():
+            if m.status.state != machine_model.INITIALIZED:
+                return False
+        return True
+
+    # -- one scenario ----------------------------------------------------------
+
+    def run_scenario(self, scenario: int) -> dict:
+        plan = FaultPlan.from_seed(self.seed, scenario,
+                                   wire=False, intensity=self.intensity)
+        injector = ChaosInjector(plan)
+        clock = FakeClock()
+        op, cloud = self._build(clock)
+        workload = self._workload(plan)
+        errors: "list[str]" = []
+        try:
+            injector.install(op, cloud)
+            self._reconcile_workload(op, workload, injector)
+            for cycle in range(self.CHAOS_CYCLES):
+                injector.on_cycle(op, cloud, cycle)
+                self._drive_once(op, errors)
+                self._reconcile_workload(op, workload, injector)
+                clock.step(self.CYCLE_SECONDS)
+
+            # settle: disarm, clear injected weather, converge
+            injector.enabled = False
+            for pool in list(injector._ice_expiry):
+                cloud.insufficient_capacity_pools.discard(pool)
+            injector._ice_expiry.clear()
+            settle_cycles = 0
+            for _ in range(self.SETTLE_DEADLINE):
+                settle_cycles += 1
+                self._drive_once(op, errors)
+                self._reconcile_workload(op, workload, injector)
+                clock.step(self.CYCLE_SECONDS)
+                if self._quiescent(op):
+                    break
+            # leak reaping: jump past the GC grace window twice (both GC
+            # directions carry their own eventual-consistency window),
+            # then a short post-GC settle for any termination it queued
+            for _ in range(2):
+                clock.step(360.0)
+                self._drive_once(op, errors)
+            for _ in range(6):
+                self._drive_once(op, errors)
+                self._reconcile_workload(op, workload, injector)
+                clock.step(self.CYCLE_SECONDS)
+                if self._quiescent(op):
+                    break
+
+            violations = invariants.check_all(
+                op, cloud,
+                token_launches=injector.token_launches,
+                consolidation_actions=injector.consolidation_actions)
+            if not self._quiescent(op):
+                violations = [invariants.Violation(
+                    "quiescence",
+                    "scenario never reached quiescence before the step "
+                    "deadline")] + violations
+        finally:
+            op.stop()
+
+        fired_kinds = sorted(injector.fired_kinds())
+        return {
+            "seed": self.seed,
+            "scenario": scenario,
+            "workload_pods": len(workload),
+            "plan": plan.describe(),
+            "fired": list(injector.fired),
+            "site_counts": injector.site_counts(),
+            "fired_kinds": fired_kinds,
+            "layers": sorted({LAYER_OF_KIND[k] for k in fired_kinds}),
+            "controller_errors": errors,
+            "consolidation_actions": len(injector.consolidation_actions),
+            "settle_cycles": settle_cycles,
+            "final_nodes": len(op.cluster.nodes),
+            "violations": [v.as_dict() for v in violations],
+            "passed": not violations,
+        }
+
+    # -- artifact --------------------------------------------------------------
+
+    def run(self) -> dict:
+        t0 = time.time()
+        scenarios = [self.run_scenario(s) for s in range(self.scenarios)]
+        kinds = sorted({k for s in scenarios for k in s["fired_kinds"]})
+        artifact = {
+            "tool": "karpenter_tpu.chaos",
+            "seed": self.seed,
+            "scenario_count": self.scenarios,
+            "fault_kinds": kinds,
+            "layers": sorted({LAYER_OF_KIND[k] for k in kinds}),
+            "passed": all(s["passed"] for s in scenarios),
+            "scenarios": scenarios,
+            # volatile fields below this line only — scenario dicts must
+            # stay a pure function of the seed (replay contract)
+            "duration_s": round(time.time() - t0, 3),
+        }
+        if self.out_dir:
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = os.path.join(self.out_dir,
+                                f"chaos_seed{self.seed}.json")
+            with open(path, "w") as f:
+                json.dump(artifact, f, indent=2, sort_keys=True)
+            artifact["artifact_path"] = path
+        return artifact

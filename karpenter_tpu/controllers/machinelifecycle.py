@@ -21,21 +21,34 @@ import logging
 from typing import Optional
 
 from ..metrics import NAMESPACE, REGISTRY, Registry
-from ..models.machine import INITIALIZED, LAUNCHED, REGISTERED, parse_provider_id
+from ..models.machine import (INITIALIZED, LAUNCHED, PENDING, REGISTERED,
+                              parse_provider_id)
 from ..utils.clock import Clock
-from ..utils.errors import CloudError
+from ..utils.errors import CloudError, is_not_found
 
 log = logging.getLogger("karpenter.machinelifecycle")
+
+# Liveness: a machine that has not registered a node within this window is
+# presumed dead and reaped (karpenter-core's registration TTL). This is the
+# backstop for launch paths whose cleanup was itself interrupted — e.g. a
+# lost machine-delete write leaves a Launched machine that owns a live
+# instance but will never grow a node, which forward GC cannot reap because
+# the instance looks owned.
+REGISTRATION_TTL_SECONDS = 15 * 60.0
 
 
 class MachineLifecycleController:
     def __init__(self, kube, cloudprovider, cluster,
                  clock: Optional[Clock] = None,
-                 registry: Optional[Registry] = None):
+                 registry: Optional[Registry] = None,
+                 registration_ttl: float = REGISTRATION_TTL_SECONDS):
         self.kube = kube
         self.cloudprovider = cloudprovider
         self.cluster = cluster
         self.clock = clock or Clock()
+        self.registration_ttl = registration_ttl
+        # machine name -> first time this controller observed it pre-registration
+        self._pre_registration_since: "dict[str, float]" = {}
         reg = registry or REGISTRY
         self.initialized = reg.counter(
             f"{NAMESPACE}_machines_initialized_total",
@@ -43,6 +56,9 @@ class MachineLifecycleController:
         self.init_time = reg.histogram(
             f"{NAMESPACE}_machines_initialization_time_seconds",
             "Time from launch to Initialized.")
+        self.registration_timeouts = reg.counter(
+            f"{NAMESPACE}_machines_registration_timeout_total",
+            "Machines reaped for failing to register within the TTL.")
 
     def _node_for(self, machine):
         name = machine.status.node_name
@@ -53,11 +69,47 @@ class MachineLifecycleController:
                 return node
         return None
 
+    def _reap_unregistered(self, machine) -> bool:
+        """Registration-TTL liveness: terminate the backing instance (if
+        any) and delete the machine object once a machine has sat
+        pre-registration past the TTL. Returns True when reaped."""
+        now = self.clock.now()
+        since = self._pre_registration_since.setdefault(machine.name, now)
+        if now - since < self.registration_ttl:
+            return False
+        pid = machine.status.provider_id
+        if pid:
+            try:
+                self.cloudprovider.instances.delete(parse_provider_id(pid)[1])
+            except (CloudError, ValueError) as e:
+                if not is_not_found(e):
+                    log.warning("registration-ttl terminate for %s failed: %s",
+                                machine.name, e)
+                    return False  # keep the machine until capacity is gone
+        try:
+            self.kube.delete("machines", machine.name)
+        except Exception as e:
+            log.warning("registration-ttl delete of machine %s failed: %s",
+                        machine.name, e)
+            return False
+        self._pre_registration_since.pop(machine.name, None)
+        self.registration_timeouts.inc()
+        log.info("reaped machine %s: no node registered within %.0fs",
+                 machine.name, self.registration_ttl)
+        return True
+
     def reconcile_once(self) -> int:
         """Advance every machine one lifecycle step; returns transitions."""
         moved = 0
+        live = set()
         for machine in self.kube.machines():
             state = machine.status.state
+            if state in (PENDING, LAUNCHED) and self._node_for(machine) is None:
+                live.add(machine.name)
+                if self._reap_unregistered(machine):
+                    live.discard(machine.name)
+                    moved += 1
+                    continue
             if state == LAUNCHED:
                 if self._node_for(machine) is not None:
                     machine.status.state = REGISTERED
@@ -86,4 +138,8 @@ class MachineLifecycleController:
                 if node.created_ts:
                     self.init_time.observe(
                         max(0.0, self.clock.now() - node.created_ts))
+        # a machine that registered (or vanished) must not inherit a stale
+        # pre-registration clock if its name is ever reused
+        self._pre_registration_since = {
+            k: v for k, v in self._pre_registration_since.items() if k in live}
         return moved

@@ -411,7 +411,14 @@ class ProvisioningController:
         except Exception as e:
             log.warning("machine %s launch failed: %s", name, e)
             self.recorder.warning(f"machine/{name}", "LaunchFailed", str(e))
-            self.kube.delete("machines", name)
+            try:
+                self.kube.delete("machines", name)
+            except Exception as cleanup_err:
+                # a lost cleanup write must not mask the launch failure; the
+                # stranded machine is reaped by the registration-TTL liveness
+                # sweep (machinelifecycle)
+                log.warning("cleanup of failed machine %s deferred to "
+                            "registration TTL: %s", name, cleanup_err)
             return None
         node = StateNode(
             name=machine.status.node_name or name,

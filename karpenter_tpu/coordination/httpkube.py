@@ -25,6 +25,7 @@ import logging
 import socket
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -118,12 +119,25 @@ class HttpKubeStore:
     KINDS = KubeStore.KINDS
     namespace = "default"
 
+    # A pooled socket idle longer than this is dropped before reuse rather
+    # than risk racing the server's own keep-alive reaper: the server may
+    # close an idle connection at any moment, and a write that lands in
+    # that window dies response-phase — the ambiguous "did it apply?"
+    # failure mode. Under the default apiserver/LB idle timeouts (60-300s)
+    # a 30s client horizon means we always blink first.
+    KEEPALIVE_IDLE_SECONDS = 30.0
+
     def __init__(self, server: str, token: Optional[str] = None,
                  verify_tls: bool = True, timeout: float = 10.0,
-                 ssl_context=None, registry=None):
+                 ssl_context=None, registry=None, clock=None,
+                 keepalive_idle_seconds: Optional[float] = None):
         self.server = server.rstrip("/")
         self.token = token
         self.timeout = timeout
+        self._clock = clock  # injectable (FakeClock in tests); None = time.monotonic
+        self.keepalive_idle_seconds = (
+            self.KEEPALIVE_IDLE_SECONDS if keepalive_idle_seconds is None
+            else keepalive_idle_seconds)
         self._ssl = ssl_context
         if self._ssl is None and server.startswith("https") and not verify_tls:
             self._ssl = ssl._create_unverified_context()
@@ -203,12 +217,26 @@ class HttpKubeStore:
         self.requests_total.inc(method=method, outcome="ok")
         return resp
 
+    def _conn_now(self) -> float:
+        return self._clock.now() if self._clock is not None \
+            else time.monotonic()
+
     def _pooled_conn(self) -> "tuple[http.client.HTTPConnection, bool]":
         """(connection, fresh): fresh=True means it was just connected —
         nothing has ever been sent on it. Raises OSError family on
-        connect failure (caller maps to ApiError(0))."""
+        connect failure (caller maps to ApiError(0)). A connection idle
+        past keepalive_idle_seconds is proactively dropped and redialed
+        (see KEEPALIVE_IDLE_SECONDS)."""
         c = getattr(self._pool_local, "conn", None)
         if c is not None:
+            idle = self._conn_now() - getattr(
+                self._pool_local, "last_used", self._conn_now())
+            if self.keepalive_idle_seconds >= 0 \
+                    and idle > self.keepalive_idle_seconds:
+                self._drop_pooled_conn()
+                c = None
+        if c is not None:
+            self._pool_local.last_used = self._conn_now()
             return c, False
         if self._https:
             c = http.client.HTTPSConnection(
@@ -223,6 +251,7 @@ class HttpKubeStore:
         # that stall IS the wire benchmark's whole budget
         c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._pool_local.conn = c
+        self._pool_local.last_used = self._conn_now()
         return c, True
 
     def _drop_pooled_conn(self) -> None:

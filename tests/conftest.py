@@ -32,6 +32,10 @@ def _randomize_enabled() -> bool:
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tier (multi-seed chaos sweeps etc.); the tier-1 "
+        "suite runs -m 'not slow'")
     if _randomize_enabled():
         config._karpenter_seed = int(
             os.environ.get("KARPENTER_TPU_TEST_SEED", 0)) or \

@@ -24,6 +24,7 @@ from karpenter_tpu.fake.kube import Conflict, KubeStore
 from karpenter_tpu.models.instancetype import Catalog, make_instance_type
 from karpenter_tpu.models.pod import make_pod
 from karpenter_tpu.operator import Operator
+from karpenter_tpu.utils.clock import FakeClock
 
 
 @pytest.fixture
@@ -254,6 +255,57 @@ class TestSerde:
         back = serde.from_manifest(
             "nodes", serde.to_manifest("nodes", "n", sn))
         assert back.pods == []
+
+
+class TestKeepAliveIdleDrop:
+    """A pooled keep-alive socket idle past the threshold is proactively
+    dropped before reuse (ISSUE 2 satellite; the complementary fix to the
+    response-phase retry — never race the server's idle reaper)."""
+
+    def test_idle_connection_dropped_and_redialed(self, api):
+        base, _ = api
+        clock = FakeClock()
+        store = HttpKubeStore(base, clock=clock, keepalive_idle_seconds=30.0)
+        c1, fresh = store._pooled_conn()
+        assert fresh
+        c2, fresh = store._pooled_conn()
+        assert c2 is c1 and not fresh      # warm reuse inside the window
+        clock.step(29.0)
+        c3, fresh = store._pooled_conn()
+        assert c3 is c1 and not fresh      # 29s idle: still inside
+        clock.step(31.0)
+        c4, fresh = store._pooled_conn()
+        assert fresh and c4 is not c1      # 31s idle: dropped + redialed
+
+    def test_each_use_restarts_the_idle_window(self, api):
+        base, _ = api
+        clock = FakeClock()
+        store = HttpKubeStore(base, clock=clock, keepalive_idle_seconds=30.0)
+        c1, _ = store._pooled_conn()
+        for _ in range(4):                 # steady traffic never trips it
+            clock.step(20.0)
+            c, fresh = store._pooled_conn()
+            assert c is c1 and not fresh
+
+    def test_requests_still_work_across_the_idle_horizon(self, api):
+        base, _ = api
+        clock = FakeClock()
+        store = HttpKubeStore(base, clock=clock, keepalive_idle_seconds=30.0)
+        store.create("pods", "idle-p1", make_pod("idle-p1", cpu="1"))
+        clock.step(3600.0)                 # a long quiet period
+        store.create("pods", "idle-p2", make_pod("idle-p2", cpu="1"))
+        names = {p["name"] if isinstance(p, dict) else p.name
+                 for p in store.list("pods")}
+        assert {"idle-p1", "idle-p2"} <= names
+
+    def test_negative_threshold_disables_the_drop(self, api):
+        base, _ = api
+        clock = FakeClock()
+        store = HttpKubeStore(base, clock=clock, keepalive_idle_seconds=-1)
+        c1, _ = store._pooled_conn()
+        clock.step(10_000.0)
+        c2, fresh = store._pooled_conn()
+        assert c2 is c1 and not fresh
 
 
 class TestReviewHardening:

@@ -144,3 +144,29 @@ def test_controller_runs_against_remote_provider():
     assert handled == 1
     assert q.receive() == []  # deleted after handling
     ctrl.stop()
+
+
+def test_drain_throughput_recorded_per_batch():
+    # the per-batch msgs/s histogram is the attribution signal for queue
+    # throughput regressions: one observation per non-empty receive batch
+    from karpenter_tpu.fake.kube import KubeStore
+    from karpenter_tpu.metrics import Registry
+    from karpenter_tpu.models.cluster import ClusterState
+
+    class NoIce:
+        def mark_unavailable(self, *a, **kw): pass
+
+    reg = Registry()
+    q = FakeQueue("iq")
+    ctrl = InterruptionController(KubeStore(), ClusterState(), q, NoIce(),
+                                  registry=reg)
+    assert ctrl.reconcile_once() == 0      # empty poll: no observation
+    assert ctrl.drain_throughput.count() == 0
+    for i in range(7):
+        q.send(json.dumps({"source": "cloud.spot",
+                           "detail-type": "Spot Instance Interruption Warning",
+                           "detail": {"instance-id": f"i-{i}"}}))
+    assert ctrl.reconcile_once() == 7
+    assert ctrl.drain_throughput.count() == 1   # one batch, one observation
+    assert ctrl.drain_throughput.sum() > 0      # a positive msgs/s rate
+    ctrl.stop()
