@@ -280,6 +280,82 @@ def cmd_logs(args) -> int:
         _time.sleep(args.interval)
 
 
+def _fetch_json(url: str):
+    import json as _json
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return _json.loads(r.read().decode())
+
+
+def cmd_statusz(args) -> int:
+    """Pretty-print a live controller's /debug/statusz snapshot (the
+    introspection plane's one-consistent-view; metrics listener)."""
+    import json as _json
+
+    base = args.endpoint.rstrip("/")
+    try:
+        snap = _fetch_json(f"{base}/debug/statusz")
+    except OSError as e:
+        print(f"cannot reach {base}/debug/statusz: {e}", file=sys.stderr)
+        return 1
+    print(_json.dumps(snap, indent=2, sort_keys=True, default=str))
+    return 0
+
+
+def cmd_diagnose(args) -> int:
+    """Fetch a diagnostics bundle from a live controller (/debug/bundle:
+    statusz ring + logs + traces + events + metrics text) and write it to
+    --out, or stdout when no path is given. The offline counterpart is the
+    bundle the flight recorder auto-writes on reconcile exceptions,
+    watchdog deadman firings, and chaos invariant breaches."""
+    import json as _json
+
+    base = args.endpoint.rstrip("/")
+    try:
+        bundle = _fetch_json(f"{base}/debug/bundle")
+    except OSError as e:
+        print(f"cannot reach {base}/debug/bundle: {e}", file=sys.stderr)
+        return 1
+    text = _json.dumps(bundle, indent=2, sort_keys=True, default=str)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        ring = bundle.get("statusz_ring") or []
+        trig = bundle.get("trigger") or {}
+        print(f"bundle written to {args.out} "
+              f"(trigger={trig.get('reason', '?')}, "
+              f"snapshots={len(ring)})")
+    else:
+        print(text)
+    return 0
+
+
+def cmd_events(args) -> int:
+    """Fetch the recent event ring from a live controller's /eventz
+    endpoint (health listener) — `kubectl get events` shaped triage,
+    mirroring the `logs` + /logz pair."""
+    import json as _json
+
+    base = args.endpoint.rstrip("/")
+    try:
+        payload = _fetch_json(f"{base}/eventz?n={args.count}")
+    except OSError as e:
+        print(f"cannot reach {base}/eventz: {e}", file=sys.stderr)
+        return 1
+    events = payload.get("events", [])
+    if args.json:
+        print(_json.dumps(events, indent=2, default=str))
+        return 0
+    for e in events:
+        print(f"{e.get('ts', 0):.3f} {e.get('kind', ''):<7} "
+              f"{e.get('reason', ''):<24} {e.get('object', ''):<32} "
+              f"{e.get('message', '')}")
+    if not events:
+        print("no events recorded")
+    return 0
+
+
 def cmd_sync(args) -> int:
     """Make a coordination plane match a manifest fixture set (apply +
     optional prune) — the hermetic analogue of the reference's GitOps
@@ -409,6 +485,10 @@ def cmd_chaos(args) -> int:
             print(f"  VIOLATION [{v['invariant']}] {v['message']}")
     if artifact.get("artifact_path"):
         print(f"artifact: {artifact['artifact_path']}")
+    for bundle in artifact.get("bundles", []):
+        print(f"diagnostics bundle: {bundle} "
+              f"(inspect: python -m karpenter_tpu diagnose, or read the "
+              f"JSON directly)")
     if not artifact["passed"]:
         print(f"REPRODUCE: python -m karpenter_tpu chaos --seed {args.seed} "
               f"--scenarios {args.scenarios}")
@@ -504,6 +584,32 @@ def main(argv=None) -> int:
                         help="poll for new lines")
     p_logs.add_argument("--interval", type=float, default=2.0)
     p_logs.set_defaults(fn=cmd_logs)
+
+    p_statusz = sub.add_parser(
+        "statusz", help="pretty-print a live controller's /debug/statusz "
+                        "snapshot (introspection plane)")
+    p_statusz.add_argument("--endpoint", default="http://127.0.0.1:8080",
+                           help="controller metrics listener base URL")
+    p_statusz.set_defaults(fn=cmd_statusz)
+
+    p_diag = sub.add_parser(
+        "diagnose", help="fetch a diagnostics bundle from a live controller "
+                         "(/debug/bundle) — statusz ring + logs + traces + "
+                         "events + metrics")
+    p_diag.add_argument("--endpoint", default="http://127.0.0.1:8080",
+                        help="controller metrics listener base URL")
+    p_diag.add_argument("-o", "--out", default="",
+                        help="write the bundle to this file (default: stdout)")
+    p_diag.set_defaults(fn=cmd_diagnose)
+
+    p_events = sub.add_parser(
+        "events", help="fetch recent events from a live controller (/eventz)")
+    p_events.add_argument("--endpoint", default="http://127.0.0.1:8081",
+                          help="controller health listener base URL")
+    p_events.add_argument("-n", "--count", type=int, default=100)
+    p_events.add_argument("--json", action="store_true",
+                          help="raw JSON instead of columns")
+    p_events.set_defaults(fn=cmd_events)
 
     p_sync = sub.add_parser(
         "sync", help="apply (and optionally prune to) a manifest fixture "

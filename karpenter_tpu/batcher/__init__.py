@@ -140,6 +140,12 @@ class Batcher(Generic[I, O]):
         for fut, res in zip(bucket.futures, results):
             fut.set_result(res)
 
+    def depth(self) -> int:
+        """Requests currently queued awaiting a flush (statusz/introspection
+        read side — a stuck executor shows up as a growing depth)."""
+        with self._cond:
+            return sum(len(b.requests) for b in self._buckets.values())
+
     def stop(self):
         with self._cond:
             self._stopped = True

@@ -41,6 +41,9 @@ class CreateFleetBatcher:
         """Callers send capacity=1 requests; one merged N-capacity call runs."""
         return self._batcher.add(request)
 
+    def depth(self) -> int:
+        return self._batcher.depth()
+
     def _exec(self, requests):
         total = sum(r.capacity for r in requests)
         merged = dataclasses.replace(requests[0], capacity=total)
@@ -85,6 +88,9 @@ class DescribeInstancesBatcher:
     def describe(self, instance_id: str) -> CloudInstance:
         return self._batcher.add(instance_id)
 
+    def depth(self) -> int:
+        return self._batcher.depth()
+
     def _exec(self, ids):
         try:
             found = {i.id: i for i in self.cloud.describe_instances(list(dict.fromkeys(ids)))}
@@ -122,6 +128,9 @@ class TerminateInstancesBatcher:
 
     def terminate(self, instance_id: str) -> "tuple[str, str]":
         return self._batcher.add(instance_id)
+
+    def depth(self) -> int:
+        return self._batcher.depth()
 
     def _exec(self, ids):
         unique = list(dict.fromkeys(ids))

@@ -71,6 +71,10 @@ class ChaosRunner:
         self.wire = wire
         self.intensity = intensity
         self.out_dir = out_dir
+        # diagnostics bundles auto-dumped by failed scenarios (volatile:
+        # paths depend on out_dir, so they live at the artifact top level,
+        # never inside a scenario dict)
+        self._bundles: "list[str]" = []
 
     # -- assembly --------------------------------------------------------------
 
@@ -143,6 +147,11 @@ class ChaosRunner:
                 ctrl.reconcile_once()
             except Exception as e:  # noqa: BLE001 — the fence is the point
                 errors.append(f"{name}: {type(e).__name__}: {e}")
+        # introspection rides every drive: the flight recorder's snapshot
+        # ring gets per-cycle history and the deadman sees crash-looping
+        # controllers (their failed cycles never refresh the heartbeat)
+        op.flightrecorder.record_snapshot()
+        op.watchdog.check()
 
     def _quiescent(self, op) -> bool:
         if op.kube.pending_pods():
@@ -210,6 +219,23 @@ class ChaosRunner:
                     "quiescence",
                     "scenario never reached quiescence before the step "
                     "deadline")] + violations
+            # a failed seed dumps a diagnostics bundle next to its replay
+            # artifact: the snapshot ring, logs, traces and events from the
+            # exact cycles that broke the invariant (deterministic path —
+            # replaying the seed overwrites the same file)
+            if violations and self.out_dir:
+                os.makedirs(self.out_dir, exist_ok=True)
+                bundle_path = os.path.join(
+                    self.out_dir,
+                    f"chaos_seed{self.seed}_s{scenario}_bundle.json")
+                written = op.flightrecorder.trigger(
+                    "chaos_invariant_breach",
+                    detail="; ".join(
+                        f"[{v.invariant}] {v.message}"
+                        for v in violations)[:500],
+                    force=True, path=bundle_path)
+                if written:
+                    self._bundles.append(written)
         finally:
             op.stop()
 
@@ -235,6 +261,7 @@ class ChaosRunner:
 
     def run(self) -> dict:
         t0 = time.time()
+        self._bundles = []
         scenarios = [self.run_scenario(s) for s in range(self.scenarios)]
         kinds = sorted({k for s in scenarios for k in s["fired_kinds"]})
         artifact = {
@@ -248,6 +275,7 @@ class ChaosRunner:
             # volatile fields below this line only — scenario dicts must
             # stay a pure function of the seed (replay contract)
             "duration_s": round(time.time() - t0, 3),
+            "bundles": list(self._bundles),
         }
         if self.out_dir:
             os.makedirs(self.out_dir, exist_ok=True)

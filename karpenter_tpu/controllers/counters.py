@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 from typing import Optional
 
+from ..introspect.watchdog import cycle as _wd_cycle
 from ..models.cluster import ClusterState
 
 log = logging.getLogger("karpenter.counters")
@@ -27,11 +28,16 @@ def _fmt_resources(cpu_millis: int, mem_bytes: int, nodes: int) -> "dict[str, st
 
 
 class CountersController:
-    def __init__(self, kube, cluster: ClusterState):
+    def __init__(self, kube, cluster: ClusterState, watchdog=None):
         self.kube = kube
         self.cluster = cluster
+        self.watchdog = watchdog
 
     def reconcile_once(self) -> "list[str]":
+        with _wd_cycle(self.watchdog, "counters"):
+            return self._reconcile_once()
+
+    def _reconcile_once(self) -> "list[str]":
         """Write status.resources for every provisioner whose consumption
         changed; returns the names updated."""
         import dataclasses

@@ -20,6 +20,7 @@ from ..apis import wellknown as wk
 from ..events import EventRecorder
 from ..metrics import NAMESPACE, REGISTRY, Registry
 from ..models.cluster import ClusterState
+from ..introspect.watchdog import cycle as _wd_cycle
 from ..ops.consolidate import run_consolidation
 from ..oracle.consolidation import find_consolidation
 from ..tracing import TRACER
@@ -46,8 +47,10 @@ class DeprovisioningController:
                  registry: Optional[Registry] = None,
                  use_tpu_solver: bool = True,
                  provisioning=None,
-                 remote_consolidator=None):
+                 remote_consolidator=None,
+                 watchdog=None):
         self.kube = kube
+        self.watchdog = watchdog
         self.cloudprovider = cloudprovider
         self.cluster = cluster
         self.termination = termination
@@ -457,6 +460,10 @@ class DeprovisioningController:
         return ok
 
     def reconcile_once(self):
+        with _wd_cycle(self.watchdog, "deprovisioning"):
+            return self._reconcile_once()
+
+    def _reconcile_once(self):
         """Full deprovisioning pass in reference priority order."""
         with TRACER.start_span("deprovisioning.cycle",
                                nodes=len(self.cluster.nodes)) as root:

@@ -18,6 +18,7 @@ from __future__ import annotations
 import logging
 from typing import Optional
 
+from ..introspect.watchdog import cycle as _wd_cycle
 from ..metrics import NAMESPACE, REGISTRY, Registry
 from ..models.machine import parse_provider_id
 from ..utils.clock import Clock
@@ -31,8 +32,9 @@ class GarbageCollectionController:
     def __init__(self, kube, cloudprovider, clock: Optional[Clock] = None,
                  registry: Optional[Registry] = None,
                  grace_seconds: float = GRACE_SECONDS,
-                 cluster=None, termination=None):
+                 cluster=None, termination=None, watchdog=None):
         self.kube = kube
+        self.watchdog = watchdog
         self.cloudprovider = cloudprovider
         self.cluster = cluster
         self.termination = termination
@@ -50,6 +52,10 @@ class GarbageCollectionController:
         self._missing_since: "dict[str, float]" = {}
 
     def reconcile_once(self) -> "list[str]":
+        with _wd_cycle(self.watchdog, "garbagecollection"):
+            return self._reconcile_once()
+
+    def _reconcile_once(self) -> "list[str]":
         """One sweep; returns the terminated instance ids. One cluster-tag
         listing per sweep — the listing already carries launch_time, so no
         per-candidate describe round trips."""

@@ -27,6 +27,7 @@ from typing import Optional
 
 from ...apis import wellknown as wk
 from ...events import EventRecorder
+from ...introspect.watchdog import cycle as _wd_cycle
 from ...metrics import NAMESPACE, REGISTRY, Registry
 from ...models.cluster import ClusterState
 from ...utils.clock import Clock
@@ -131,8 +132,10 @@ class InterruptionController:
                  termination=None, clock: Optional[Clock] = None,
                  recorder: Optional[EventRecorder] = None,
                  registry: Optional[Registry] = None,
-                 parallelism: int = 10):
+                 parallelism: int = 10,
+                 watchdog=None):
         self.kube = kube
+        self.watchdog = watchdog
         self.cluster = cluster
         self.queue = queue
         self.ice = unavailable_offerings
@@ -166,6 +169,10 @@ class InterruptionController:
                                         thread_name_prefix="interruption")
 
     def reconcile_once(self, wait_seconds: float = 0.0) -> int:
+        with _wd_cycle(self.watchdog, "interruption"):
+            return self._reconcile_once(wait_seconds)
+
+    def _reconcile_once(self, wait_seconds: float = 0.0) -> int:
         """One poll cycle: receive -> parse -> handle (10-way parallel) ->
         delete (controller.go:83-115)."""
         messages = self.queue.receive(max_messages=10, wait_seconds=wait_seconds)

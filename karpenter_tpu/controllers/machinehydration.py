@@ -20,6 +20,7 @@ import logging
 from typing import Optional
 
 from ..apis import wellknown as wk
+from ..introspect.watchdog import cycle as _wd_cycle
 from ..models.machine import Machine, MachineSpec, parse_provider_id
 from ..models.requirements import OP_IN, Requirement, Requirements
 from ..utils.clock import Clock
@@ -30,13 +31,18 @@ log = logging.getLogger("karpenter.machinehydration")
 
 class MachineHydrationController:
     def __init__(self, kube, cloudprovider, cluster=None,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None, watchdog=None):
         self.kube = kube
         self.cloudprovider = cloudprovider
         self.cluster = cluster
         self.clock = clock or Clock()
+        self.watchdog = watchdog
 
     def reconcile_once(self) -> int:
+        with _wd_cycle(self.watchdog, "machinehydration"):
+            return self._reconcile_once()
+
+    def _reconcile_once(self) -> int:
         """Sweep all nodes; hydrate each provisioner-owned node without a
         Machine. Returns the number hydrated."""
         all_machines = self.kube.list("machines")

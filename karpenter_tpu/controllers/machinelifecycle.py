@@ -20,6 +20,7 @@ from __future__ import annotations
 import logging
 from typing import Optional
 
+from ..introspect.watchdog import cycle as _wd_cycle
 from ..metrics import NAMESPACE, REGISTRY, Registry
 from ..models.machine import (INITIALIZED, LAUNCHED, PENDING, REGISTERED,
                               parse_provider_id)
@@ -41,8 +42,10 @@ class MachineLifecycleController:
     def __init__(self, kube, cloudprovider, cluster,
                  clock: Optional[Clock] = None,
                  registry: Optional[Registry] = None,
-                 registration_ttl: float = REGISTRATION_TTL_SECONDS):
+                 registration_ttl: float = REGISTRATION_TTL_SECONDS,
+                 watchdog=None):
         self.kube = kube
+        self.watchdog = watchdog
         self.cloudprovider = cloudprovider
         self.cluster = cluster
         self.clock = clock or Clock()
@@ -99,6 +102,10 @@ class MachineLifecycleController:
         return True
 
     def reconcile_once(self) -> int:
+        with _wd_cycle(self.watchdog, "machinelifecycle"):
+            return self._reconcile_once()
+
+    def _reconcile_once(self) -> int:
         """Advance every machine one lifecycle step; returns transitions."""
         moved = 0
         live = set()

@@ -12,6 +12,7 @@ import logging
 from typing import Optional
 
 from ..apis.nodetemplate import NodeTemplate, NodeTemplateStatus
+from ..introspect.watchdog import cycle as _wd_cycle
 from ..utils.clock import Clock
 
 log = logging.getLogger("karpenter.nodetemplate")
@@ -21,8 +22,9 @@ REQUEUE_SECONDS = 300.0
 
 class NodeTemplateController:
     def __init__(self, kube, subnet_provider, securitygroup_provider,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None, watchdog=None):
         self.kube = kube
+        self.watchdog = watchdog
         self.subnets = subnet_provider
         self.security_groups = securitygroup_provider
         self.clock = clock or Clock()
@@ -48,6 +50,10 @@ class NodeTemplateController:
         return fresh
 
     def reconcile_once(self) -> int:
+        with _wd_cycle(self.watchdog, "nodetemplate"):
+            return self._reconcile_once()
+
+    def _reconcile_once(self) -> int:
         """Generation-change predicate + periodic requeue."""
         count = 0
         now = self.clock.now()

@@ -30,6 +30,7 @@ from ..models.machine import Machine, MachineSpec
 from ..models.pod import PodSpec
 from ..models.requirements import IncompatibleError, Requirement, Requirements, OP_IN
 from ..oracle.scheduler import Scheduler
+from ..introspect.watchdog import cycle as _wd_cycle
 from ..solver.core import NativeSolver, SolveResult, TPUSolver
 from ..tracing import TRACER
 from ..utils.clock import Clock
@@ -49,8 +50,10 @@ class ProvisioningController:
         registry: Optional[Registry] = None,
         solver_factory=None,
         launch_workers: int = 10,
+        watchdog=None,
     ):
         self.kube = kube
+        self.watchdog = watchdog
         self.cloudprovider = cloudprovider
         self.cluster = cluster
         self.settings = settings
@@ -151,6 +154,10 @@ class ProvisioningController:
     # -- one reconcile ---------------------------------------------------------
 
     def reconcile_once(self, pods: "Optional[list[PodSpec]]" = None) -> "Optional[SolveResult]":
+        with _wd_cycle(self.watchdog, "provisioning"):
+            return self._reconcile_once(pods)
+
+    def _reconcile_once(self, pods: "Optional[list[PodSpec]]" = None) -> "Optional[SolveResult]":
         pods = self.kube.pending_pods() if pods is None else pods
         if not pods:
             return None
@@ -477,6 +484,11 @@ class ProvisioningController:
                 stop_event.wait(0.2)
                 continue
             try:
+                # idle iterations never reach reconcile_once, so the loop
+                # itself is the heartbeat: a live-but-idle batcher must not
+                # read as stalled (only a hung wait/solve goes stale)
+                if self.watchdog is not None:
+                    self.watchdog.beat("provisioning")
                 # idle until the watch reports churn; a slow retry scan
                 # (1 Hz) re-arms for pods left pending by a failed solve —
                 # e.g. an ICE TTL expiring produces no store event at all

@@ -15,6 +15,7 @@ import logging
 from typing import Optional
 
 from ..apis.settings import Settings, SettingsError
+from ..introspect.watchdog import cycle as _wd_cycle
 from ..utils.clock import Clock
 
 log = logging.getLogger("karpenter.settings")
@@ -23,13 +24,19 @@ CONFIGMAP_NAME = "karpenter-global-settings"
 
 
 class SettingsWatchController:
-    def __init__(self, kube, settings: Settings, clock: Optional[Clock] = None):
+    def __init__(self, kube, settings: Settings, clock: Optional[Clock] = None,
+                 watchdog=None):
         self.kube = kube
         self.settings = settings
         self.clock = clock or Clock()
+        self.watchdog = watchdog
         self._last_applied: "Optional[dict]" = None
 
     def reconcile_once(self) -> "list[str]":
+        with _wd_cycle(self.watchdog, "settingswatch"):
+            return self._reconcile_once()
+
+    def _reconcile_once(self) -> "list[str]":
         """Apply the ConfigMap if it changed; returns changed field names."""
         cm = self.kube.get("configmaps", CONFIGMAP_NAME)
         if cm is None:

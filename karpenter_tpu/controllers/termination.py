@@ -13,6 +13,7 @@ import logging
 from typing import Optional
 
 from ..events import EventRecorder
+from ..introspect.watchdog import cycle as _wd_cycle
 from ..metrics import NAMESPACE, REGISTRY, Registry
 from ..models.cluster import ClusterState, pod_evictable
 from ..utils import errors as cloud_errors
@@ -25,8 +26,10 @@ class TerminationController:
     def __init__(self, kube, cloudprovider, cluster: ClusterState,
                  clock: Optional[Clock] = None,
                  recorder: Optional[EventRecorder] = None,
-                 registry: Optional[Registry] = None):
+                 registry: Optional[Registry] = None,
+                 watchdog=None):
         self.kube = kube
+        self.watchdog = watchdog
         self.cloudprovider = cloudprovider
         self.cluster = cluster
         self.clock = clock or Clock()
@@ -69,6 +72,10 @@ class TerminationController:
         return self.MARKED_NEW
 
     def reconcile_once(self) -> "list[str]":
+        with _wd_cycle(self.watchdog, "termination"):
+            return self._reconcile_once()
+
+    def _reconcile_once(self) -> "list[str]":
         """Process all marked nodes; returns names fully terminated."""
         done = []
         for name in sorted(self.cluster.nodes):

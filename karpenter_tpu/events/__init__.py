@@ -84,3 +84,10 @@ class EventRecorder:
     def by_reason(self, reason: str) -> "list[Event]":
         with self._lock:
             return [e for _, e in self.events if e.reason == reason]
+
+    def recent(self, n: "Optional[int]" = None) -> "list[tuple[float, Event]]":
+        """Most recent `n` (ts, event) pairs, oldest first — the /eventz
+        and statusz/bundle read side."""
+        with self._lock:
+            items = list(self.events)
+        return items if n is None else items[-n:]

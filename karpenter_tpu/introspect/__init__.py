@@ -1,0 +1,23 @@
+"""Operator introspection plane: watchdog, statusz, flight recorder.
+
+The serving plane's probes answer a boolean; this package answers *what a
+live controller is doing* and captures consistent state at the moment
+something goes wrong — the layer that makes the tracing plane (PR 1) and
+chaos plane (PR 2) operable:
+
+- `watchdog`       per-controller heartbeat registry + deadman check; feeds
+                   `/readyz`, `karpenter_controller_healthy{controller}` and
+                   stall/recovery events.
+- `statusz`        one consistent JSON snapshot of the whole operator
+                   (cluster state, controller health, queue depths, cache
+                   stats, recent events, metric values) — `GET
+                   /debug/statusz`, `python -m karpenter_tpu statusz`.
+- `flightrecorder` bounded ring of periodic statusz snapshots plus
+                   trigger-based diagnostics bundles (reconcile exception,
+                   watchdog deadman, chaos invariant breach) — `GET
+                   /debug/bundle`, `python -m karpenter_tpu diagnose`.
+"""
+
+from .watchdog import Watchdog, cycle  # noqa: F401
+from .statusz import snapshot  # noqa: F401
+from .flightrecorder import FlightRecorder  # noqa: F401

@@ -1,0 +1,148 @@
+"""Flight recorder: snapshot ring + trigger-based diagnostics bundles.
+
+Two halves:
+
+- a bounded ring of periodic statusz snapshots (`record_snapshot()` on an
+  operator loop) — history *leading up to* an incident, since the
+  post-mortem question is "what changed", not "what is";
+- `trigger(reason)` assembles one JSON diagnostics bundle — triggering
+  reason, the snapshot ring, a fresh statusz, the last N logring records,
+  recent TRACER traces, the event ring, and the metrics exposition text —
+  and writes it to `out_dir` (KARPENTER_TPU_BUNDLE_DIR).
+
+Wired triggers: reconcile exception (watchdog failure listener), watchdog
+deadman firing (stall listener), chaos invariant breach (runner calls with
+`force=True` and a deterministic path next to the replay artifact). Live
+fetch: `GET /debug/bundle` + `python -m karpenter_tpu diagnose`.
+
+Auto-triggers are rate-limited per reason on the injected clock so a
+crash-looping controller produces one bundle per window, not one per
+cycle; `force=True` bypasses the limiter.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from collections import deque
+from typing import Optional
+
+from .. import __version__
+from ..tracing import TRACER
+from ..utils import logring
+from ..utils.clock import Clock
+from .statusz import snapshot
+
+log = logging.getLogger("karpenter.flightrecorder")
+
+DEFAULT_RING = 32
+BUNDLE_LOG_LINES = 200
+BUNDLE_TRACES = 10
+BUNDLE_EVENTS = 100
+# one auto-bundle per reason per window; chaos passes force=True
+TRIGGER_MIN_INTERVAL = 60.0
+
+
+class FlightRecorder:
+    def __init__(self, operator, ring_size: int = DEFAULT_RING,
+                 out_dir: "Optional[str]" = None,
+                 clock: "Optional[Clock]" = None,
+                 min_interval: float = TRIGGER_MIN_INTERVAL):
+        self.op = operator
+        self.clock = clock or getattr(operator, "clock", None) or Clock()
+        self.out_dir = out_dir
+        self.min_interval = min_interval
+        self._lock = threading.Lock()
+        self._ring: "deque[dict]" = deque(maxlen=max(1, ring_size))
+        self._last_trigger: "dict[str, float]" = {}
+        # recent trigger history rides along in every bundle — a bundle
+        # that fires while another reason is already hot should say so
+        self._triggers: "deque[dict]" = deque(maxlen=50)
+
+    # -- snapshot ring ---------------------------------------------------------
+
+    def record_snapshot(self) -> dict:
+        """Take one statusz snapshot into the ring (periodic operator loop,
+        and once per chaos cycle)."""
+        snap = snapshot(self.op)
+        with self._lock:
+            self._ring.append(snap)
+        return snap
+
+    def ring(self) -> "list[dict]":
+        with self._lock:
+            return list(self._ring)
+
+    # -- bundles ---------------------------------------------------------------
+
+    def bundle(self, reason: str, detail: str = "") -> dict:
+        """Assemble one diagnostics bundle. Every section is fenced the
+        same way statusz sections are — capture must not fail because one
+        subsystem is wedged (that subsystem is often WHY we're here)."""
+        def fenced(build):
+            try:
+                return build()
+            except Exception as e:  # noqa: BLE001
+                return {"error": f"{type(e).__name__}: {e}"}
+
+        return {
+            "tool": "karpenter_tpu.diagnostics_bundle",
+            "version": __version__,
+            "ts": fenced(self.clock.now),
+            "trigger": {"reason": reason, "detail": detail},
+            "recent_triggers": list(self._triggers),
+            "statusz": fenced(lambda: snapshot(self.op)),
+            "statusz_ring": self.ring(),
+            "logs": fenced(lambda: logring.dump_records(BUNDLE_LOG_LINES)),
+            "traces": fenced(lambda: TRACER.traces(BUNDLE_TRACES)),
+            "events": fenced(lambda: [
+                {"ts": ts, "kind": e.kind, "reason": e.reason,
+                 "object": e.object_ref, "message": e.message}
+                for ts, e in self.op.recorder.recent(BUNDLE_EVENTS)]),
+            "metrics_text": fenced(self.op.metrics_text),
+        }
+
+    def trigger(self, reason: str, detail: str = "", force: bool = False,
+                path: "Optional[str]" = None) -> "Optional[str]":
+        """Fire the recorder: assemble a bundle and write it to disk.
+        Returns the written path, or None when rate-limited / nowhere to
+        write. `path` overrides the destination (chaos puts the bundle
+        next to the replay artifact); `force` bypasses the limiter."""
+        now = self.clock.now()
+        with self._lock:
+            last = self._last_trigger.get(reason)
+            if not force and last is not None and \
+                    now - last < self.min_interval:
+                return None
+            self._last_trigger[reason] = now
+            self._triggers.append(
+                {"ts": now, "reason": reason, "detail": detail})
+        b = self.bundle(reason, detail)
+        out = path
+        if out is None:
+            if not self.out_dir:
+                log.warning("flight recorder triggered (%s: %s) but no "
+                            "bundle dir configured; bundle not written "
+                            "(fetch via /debug/bundle)", reason, detail)
+                return None
+            safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                           for c in reason)
+            out = os.path.join(
+                self.out_dir, f"bundle_{safe}_{now:.0f}.json")
+        try:
+            parent = os.path.dirname(out)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            tmp = f"{out}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(b, f, indent=2, sort_keys=True, default=str)
+                f.write("\n")
+            os.replace(tmp, out)  # readers never see a torn bundle
+        except Exception as e:
+            log.warning("flight recorder failed to write %s: %s", out, e)
+            return None
+        log.warning("diagnostics bundle written: %s (%s: %s)",
+                    out, reason, detail)
+        return out

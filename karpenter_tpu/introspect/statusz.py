@@ -1,0 +1,155 @@
+"""One consistent JSON snapshot of the whole operator.
+
+`snapshot(op)` walks every subsystem a triage wants to see at once —
+cluster state, per-controller watchdog status and cycle latencies, batcher
+and interruption queue depths, solver/compile-cache and pricing/
+instance-type cache stats, recent events, and current metric values — and
+returns one JSON-serializable dict. Served at `GET /debug/statusz` on the
+metrics listener and via `python -m karpenter_tpu statusz`; the flight
+recorder's snapshot ring is a deque of these.
+
+Every section is individually fenced: statusz is the surface you read when
+something is broken, so one wedged subsystem must degrade its own section
+to an error string, not take the whole snapshot down. Timestamps come from
+the operator's injected clock (deterministic under FakeClock).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .. import __version__
+from ..metrics import REGISTRY, Gauge, Histogram
+
+log = logging.getLogger("karpenter.statusz")
+
+SCHEMA_VERSION = 1
+
+# hard caps so a pathological operator can't make statusz unbounded
+MAX_EVENTS = 50
+MAX_SERIES_PER_METRIC = 50
+
+
+def _fenced(build):
+    try:
+        return build()
+    except Exception as e:  # noqa: BLE001 — a diagnostic surface degrades
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _cluster_section(op) -> dict:
+    nodes = dict(op.cluster.nodes)
+    pods = op.kube.list("pods")
+    by_provisioner: "dict[str, int]" = {}
+    for n in nodes.values():
+        key = n.provisioner_name or ""
+        by_provisioner[key] = by_provisioner.get(key, 0) + 1
+    return {
+        "nodes": len(nodes),
+        "nodes_by_provisioner": dict(sorted(by_provisioner.items())),
+        "nodes_marked_for_deletion": sum(
+            1 for n in nodes.values() if n.marked_for_deletion),
+        "machines": len(op.kube.list("machines")),
+        "pods": len(pods),
+        "pending_pods": len(op.kube.pending_pods()),
+        "provisioners": len(op.kube.list("provisioners")),
+        "nodetemplates": len(op.kube.list("nodetemplates")),
+        "pdbs": len(op.cluster.pdbs),
+    }
+
+
+def _queue_section(op) -> dict:
+    def depth(batcher) -> "int | None":
+        fn = getattr(batcher, "depth", None)
+        return fn() if callable(fn) else None
+
+    inst = op.cloudprovider.instances
+    out = {
+        "create_fleet": depth(getattr(inst, "fleet", None)),
+        "describe_instances": depth(getattr(inst, "describe", None)),
+        "terminate_instances": depth(getattr(inst, "terminate", None)),
+    }
+    queue = getattr(op, "queue", None)
+    out["interruption"] = (queue.approximate_depth()
+                           if queue is not None else None)
+    return out
+
+
+def _cache_section(op) -> dict:
+    prov = op.provisioning
+    cp = op.cloudprovider
+    pricing = cp.pricing
+    last = pricing._last_update
+    return {
+        "solver": {
+            "rebuilds": prov.solver_rebuilds,
+            "resident_primary": len(prov._solver_cache),
+            "resident_native": len(prov._native_cache),
+            "route_threshold": prov.route_threshold,
+            "last_routing": prov.last_solver_kind,
+        },
+        "instance_types": {
+            "memo_entries": len(cp.instance_types._memo),
+            "derived_seqnum": cp.instance_types._version,
+            "source_seqnum": cp.instance_types.source.seqnum,
+        },
+        "ice": {"seqnum": cp.ice.seqnum},
+        "pricing": {
+            "entries": len(pricing._prices),
+            "updates": pricing._updates,
+            "last_update_age_s": (None if last is None
+                                  else round(op.clock.now() - last, 3)),
+        },
+        "launch_templates": {"known": len(cp.launch_templates._known)},
+    }
+
+
+def _events_section(op, n: int = MAX_EVENTS) -> "list[dict]":
+    return [{"ts": ts, "kind": e.kind, "reason": e.reason,
+             "object": e.object_ref, "message": e.message}
+            for ts, e in op.recorder.recent(n)]
+
+
+def _metrics_section(registry=None) -> dict:
+    """Current counter/gauge values and histogram count/sum — the numbers,
+    not the exposition text (the bundle carries the full text)."""
+    reg = registry if registry is not None else REGISTRY
+    out = {}
+    with reg._lock:
+        metrics = dict(reg._metrics)
+    for name in sorted(metrics):
+        m = metrics[name]
+        if isinstance(m, Histogram):
+            with m._lock:
+                series = [{"labels": dict(zip(m.label_names, key)),
+                           "count": m._totals[key],
+                           "sum": round(m._sums[key], 6)}
+                          for key in sorted(m._totals)]
+        else:
+            series = [{"labels": labels, "value": v}
+                      for labels, v in m.collect()]
+        if not series:
+            continue
+        out[name] = {
+            "type": ("histogram" if isinstance(m, Histogram)
+                     else "gauge" if isinstance(m, Gauge) else "counter"),
+            "series": series[:MAX_SERIES_PER_METRIC],
+            "series_total": len(series),
+        }
+    return out
+
+
+def snapshot(op) -> dict:
+    """The one consistent operator snapshot (see module docstring)."""
+    return {
+        "tool": "karpenter_tpu.statusz",
+        "schema": SCHEMA_VERSION,
+        "version": __version__,
+        "ts": _fenced(op.clock.now),
+        "cluster": _fenced(lambda: _cluster_section(op)),
+        "controllers": _fenced(op.watchdog.status),
+        "queues": _fenced(lambda: _queue_section(op)),
+        "caches": _fenced(lambda: _cache_section(op)),
+        "events": _fenced(lambda: _events_section(op)),
+        "metrics": _fenced(_metrics_section),
+    }

@@ -1,0 +1,239 @@
+"""Per-controller heartbeat registry with a deadman check.
+
+Every reconcile loop wraps its cycle in `watchdog.cycle(name)` (the
+controllers carry an optional `watchdog=` and wrap their own
+`reconcile_once`, so beats happen no matter who drives the cycle — the
+operator's loops, `reconcile_all_once`, or the chaos runner). A cycle that
+raises records a failure WITHOUT refreshing the heartbeat: a controller
+stuck in a crash loop goes stale exactly like one hung mid-solve.
+
+`check()` is the deadman: any controller whose last completed cycle is
+older than its threshold flips to stalled. Verdicts feed three surfaces:
+
+- gauges `karpenter_controller_healthy{controller}` (1/0) and
+  `karpenter_controller_last_cycle_seconds{controller}` (age);
+- `/readyz` aggregation (`Operator.readyz` names the stalled controllers);
+- deduped Warning/Normal events on stall/recovery TRANSITIONS only, plus
+  registered stall listeners (the flight recorder auto-dumps a bundle).
+
+Staleness is measured on the injected clock (FakeClock-driven in tests and
+chaos); cycle durations are wall time (they measure real work).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from ..metrics import NAMESPACE, REGISTRY
+from ..utils.clock import Clock
+
+log = logging.getLogger("karpenter.watchdog")
+
+DEFAULT_THRESHOLD = 120.0
+
+HEALTHY_METRIC = f"{NAMESPACE}_controller_healthy"
+LAST_CYCLE_METRIC = f"{NAMESPACE}_controller_last_cycle_seconds"
+CYCLE_DURATION_METRIC = f"{NAMESPACE}_controller_cycle_duration_seconds"
+
+
+class Watchdog:
+    def __init__(self, clock: Optional[Clock] = None, registry=None,
+                 recorder=None):
+        self.clock = clock or Clock()
+        reg = registry if registry is not None else REGISTRY
+        self.recorder = recorder
+        self._lock = threading.Lock()
+        self._controllers: "dict[str, dict]" = {}
+        self._stall_listeners: "list[Callable]" = []
+        self._failure_listeners: "list[Callable]" = []
+        self.healthy_gauge = reg.gauge(
+            HEALTHY_METRIC,
+            "1 when the controller completed a reconcile cycle within its "
+            "deadman threshold, 0 when the watchdog flagged it stalled.",
+            ("controller",))
+        self.last_cycle_gauge = reg.gauge(
+            LAST_CYCLE_METRIC,
+            "Seconds since the controller last completed a reconcile cycle.",
+            ("controller",))
+        self.cycle_duration = reg.histogram(
+            CYCLE_DURATION_METRIC,
+            "Duration of completed reconcile cycles.", ("controller",))
+
+    # -- registration / heartbeats ---------------------------------------------
+
+    def register(self, name: str,
+                 threshold: float = DEFAULT_THRESHOLD) -> None:
+        """Idempotent; re-registering updates the threshold only. A
+        controller that never beats goes stale `threshold` seconds after
+        registration (startup grace == one threshold)."""
+        now = self.clock.now()
+        with self._lock:
+            rec = self._controllers.get(name)
+            if rec is None:
+                rec = self._controllers[name] = {
+                    "threshold": threshold, "registered_at": now,
+                    "last_beat": None, "beats": 0, "failures": 0,
+                    "last_error": None, "last_duration_s": None,
+                    "stalled": False,
+                }
+            else:
+                rec["threshold"] = threshold
+        self.healthy_gauge.set(1.0, controller=name)
+        self.last_cycle_gauge.set(0.0, controller=name)
+
+    def beat(self, name: str, duration_s: "Optional[float]" = None) -> None:
+        """Record one COMPLETED cycle (auto-registers unknown names)."""
+        now = self.clock.now()
+        with self._lock:
+            rec = self._controllers.get(name)
+            if rec is None:
+                rec = self._controllers[name] = {
+                    "threshold": DEFAULT_THRESHOLD, "registered_at": now,
+                    "last_beat": None, "beats": 0, "failures": 0,
+                    "last_error": None, "last_duration_s": None,
+                    "stalled": False,
+                }
+                self.healthy_gauge.set(1.0, controller=name)
+            rec["last_beat"] = now
+            rec["beats"] += 1
+            if duration_s is not None:
+                rec["last_duration_s"] = duration_s
+        if duration_s is not None:
+            self.cycle_duration.observe(duration_s, controller=name)
+        self.last_cycle_gauge.set(0.0, controller=name)
+
+    def fail(self, name: str, error: BaseException) -> None:
+        """Record a cycle that raised; the heartbeat is NOT refreshed."""
+        with self._lock:
+            rec = self._controllers.get(name)
+            if rec is not None:
+                rec["failures"] += 1
+                rec["last_error"] = f"{type(error).__name__}: {error}"
+        for listener in list(self._failure_listeners):
+            try:
+                listener(name, error)
+            except Exception as e:  # diagnostics must never break the loop
+                log.warning("watchdog failure listener raised: %s", e)
+
+    @contextlib.contextmanager
+    def cycle(self, name: str):
+        """Wrap one reconcile cycle: beat on success, fail (and re-raise)
+        on exception."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        except Exception as e:
+            self.fail(name, e)
+            raise
+        else:
+            self.beat(name, time.perf_counter() - t0)
+
+    # -- deadman ---------------------------------------------------------------
+
+    def names(self) -> "list[str]":
+        with self._lock:
+            return sorted(self._controllers)
+
+    def _age(self, rec: dict, now: float) -> float:
+        anchor = rec["last_beat"]
+        if anchor is None:
+            anchor = rec["registered_at"]
+        return max(0.0, now - anchor)
+
+    def check(self) -> "list[str]":
+        """Evaluate every controller against its threshold, update the
+        gauges, emit stall/recovery transition events, fire stall
+        listeners. Returns the currently stalled names, sorted."""
+        now = self.clock.now()
+        newly_stalled, recovered, stalled_now = [], [], []
+        with self._lock:
+            for name in sorted(self._controllers):
+                rec = self._controllers[name]
+                age = self._age(rec, now)
+                stalled = age > rec["threshold"]
+                if stalled and not rec["stalled"]:
+                    newly_stalled.append((name, age, rec["threshold"]))
+                elif rec["stalled"] and not stalled:
+                    recovered.append((name, age))
+                rec["stalled"] = stalled
+                if stalled:
+                    stalled_now.append(name)
+                self.healthy_gauge.set(0.0 if stalled else 1.0,
+                                       controller=name)
+                self.last_cycle_gauge.set(age, controller=name)
+        for name, age, threshold in newly_stalled:
+            log.warning("controller %s stalled: last completed cycle %.1fs "
+                        "ago (threshold %.1fs)", name, age, threshold)
+            if self.recorder is not None:
+                self.recorder.warning(
+                    f"controller/{name}", "ControllerStalled",
+                    f"last completed reconcile cycle {age:.1f}s ago "
+                    f"(threshold {threshold:.1f}s)")
+        for name, age in recovered:
+            log.info("controller %s recovered (last cycle %.1fs ago)",
+                     name, age)
+            if self.recorder is not None:
+                self.recorder.normal(
+                    f"controller/{name}", "ControllerRecovered",
+                    "reconcile cycles resumed within the deadman threshold")
+        if newly_stalled:
+            names = [n for n, _, _ in newly_stalled]
+            for listener in list(self._stall_listeners):
+                try:
+                    listener(names)
+                except Exception as e:
+                    log.warning("watchdog stall listener raised: %s", e)
+        return stalled_now
+
+    def healthy(self) -> bool:
+        return not self.check()
+
+    def add_stall_listener(self, fn: Callable) -> None:
+        """fn(newly_stalled_names: list[str]) on healthy->stalled
+        transitions (the flight recorder's deadman trigger)."""
+        self._stall_listeners.append(fn)
+
+    def add_failure_listener(self, fn: Callable) -> None:
+        """fn(name, exception) on every failed cycle (the flight
+        recorder's reconcile-exception trigger; rate limiting is the
+        listener's job)."""
+        self._failure_listeners.append(fn)
+
+    # -- read side -------------------------------------------------------------
+
+    def status(self) -> "dict[str, dict]":
+        """Read-only per-controller view (no transition side effects) —
+        the statusz `controllers` section."""
+        now = self.clock.now()
+        out = {}
+        with self._lock:
+            for name in sorted(self._controllers):
+                rec = self._controllers[name]
+                age = self._age(rec, now)
+                dur = rec["last_duration_s"]
+                out[name] = {
+                    "healthy": age <= rec["threshold"],
+                    "last_cycle_age_s": round(age, 3),
+                    "threshold_s": rec["threshold"],
+                    "beats": rec["beats"],
+                    "failures": rec["failures"],
+                    "last_error": rec["last_error"],
+                    "last_cycle_ms": (None if dur is None
+                                      else round(dur * 1e3, 3)),
+                }
+        return out
+
+
+@contextlib.contextmanager
+def cycle(watchdog: "Optional[Watchdog]", name: str):
+    """Controller-side wrapper tolerating standalone construction (no
+    watchdog wired): a strict no-op when `watchdog` is None."""
+    if watchdog is None:
+        yield
+        return
+    with watchdog.cycle(name):
+        yield

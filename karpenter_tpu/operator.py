@@ -9,6 +9,7 @@ healthz registry, settings injection, controller registration and Start().
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Optional
 
@@ -25,6 +26,7 @@ from .controllers.nodetemplate import NodeTemplateController
 from .controllers.provisioning import ProvisioningController
 from .controllers.termination import TerminationController
 from .events import EventRecorder
+from .introspect import FlightRecorder, Watchdog
 from .leaderelection import LeaderElector
 from .metrics import REGISTRY, decorate_cloudprovider
 from .models.cluster import ClusterState
@@ -37,6 +39,26 @@ log = logging.getLogger("karpenter.operator")
 
 
 class Operator:
+    # Reconcile cadence per controller: start() drives the background loops
+    # from this table and the watchdog derives its deadman thresholds from
+    # it (provisioning/interruption run their own watch/long-poll threads —
+    # their entries reflect the loop's idle tick, not a timer).
+    LOOP_INTERVALS = {
+        "provisioning": 0.1,
+        "machinelifecycle": 0.2,
+        "settingswatch": 2.0,
+        "termination": 0.2,
+        "deprovisioning": 2.0,
+        "nodetemplate": 5.0,
+        "machinehydration": 5.0,
+        "garbagecollection": 60.0,
+        "counters": 5.0,
+        "interruption": 1.2,
+    }
+    # introspection cadence: deadman sweep + flight-recorder snapshot ring
+    WATCHDOG_CHECK_INTERVAL = 1.0
+    SNAPSHOT_INTERVAL = 10.0
+
     def __init__(self, cloud, settings: Settings, catalog: Catalog,
                  kube: Optional[KubeStore] = None,
                  clock: Optional[Clock] = None,
@@ -68,6 +90,9 @@ class Operator:
         self._event_lock = threading.Lock()  # recorder is shared by 7 threads
         self.cloudprovider = CloudProvider(cloud, settings, catalog, clock=self.clock)
         self.metrics_cloudprovider = decorate_cloudprovider(self.cloudprovider)
+        # introspection plane: deadman watchdog on the injected clock; every
+        # controller below takes it and wraps its reconcile cycle
+        self.watchdog = Watchdog(clock=self.clock, recorder=self.recorder)
         # Leader election (main.go:42 LEADER_ELECT, charts 2-replica/PDB):
         # when enabled, a store-backed lease elects exactly one active
         # replica; controllers idle on standbys and take over within the
@@ -101,10 +126,11 @@ class Operator:
         self.provisioning = ProvisioningController(
             self.kube, self.cloudprovider, self.cluster, settings,
             clock=self.clock, recorder=self.recorder,
-            solver_factory=solver_factory)
+            solver_factory=solver_factory, watchdog=self.watchdog)
         self.termination = TerminationController(
             self.kube, self.cloudprovider, self.cluster,
-            clock=self.clock, recorder=self.recorder)
+            clock=self.clock, recorder=self.recorder,
+            watchdog=self.watchdog)
         remote_consolidator = None
         if solver_target:
             # deployed split (SURVEY 7.1): the sidecar owns the chip, so
@@ -134,10 +160,12 @@ class Operator:
             self.kube, self.cloudprovider, self.cluster, self.termination,
             clock=self.clock, recorder=self.recorder,
             provisioning=self.provisioning,
-            remote_consolidator=remote_consolidator)
+            remote_consolidator=remote_consolidator,
+            watchdog=self.watchdog)
         self.nodetemplate = NodeTemplateController(
             self.kube, self.cloudprovider.subnets,
-            self.cloudprovider.security_groups, clock=self.clock)
+            self.cloudprovider.security_groups, clock=self.clock,
+            watchdog=self.watchdog)
         # the kube store is the single source of truth for templates: deletes
         # take effect immediately and no side-registry can drift
         self.cloudprovider.template_source = (
@@ -152,15 +180,18 @@ class Operator:
         self.kube.set_admission(self.webhooks.admit)
         self.machinehydration = MachineHydrationController(
             self.kube, self.cloudprovider, cluster=self.cluster,
-            clock=self.clock)
+            clock=self.clock, watchdog=self.watchdog)
         self.machinelifecycle = MachineLifecycleController(
-            self.kube, self.cloudprovider, self.cluster, clock=self.clock)
+            self.kube, self.cloudprovider, self.cluster, clock=self.clock,
+            watchdog=self.watchdog)
         self.settingswatch = SettingsWatchController(
-            self.kube, settings, clock=self.clock)
+            self.kube, settings, clock=self.clock, watchdog=self.watchdog)
         self.garbagecollection = GarbageCollectionController(
             self.kube, self.cloudprovider, clock=self.clock,
-            cluster=self.cluster, termination=self.termination)
-        self.counters = CountersController(self.kube, self.cluster)
+            cluster=self.cluster, termination=self.termination,
+            watchdog=self.watchdog)
+        self.counters = CountersController(self.kube, self.cluster,
+                                           watchdog=self.watchdog)
         self.interruption = None
         if settings.interruption_queue_name:
             self.queue = queue or FakeQueue(settings.interruption_queue_name,
@@ -168,7 +199,25 @@ class Operator:
             self.interruption = InterruptionController(
                 self.kube, self.cluster, self.queue, self.cloudprovider.ice,
                 termination=self.termination, clock=self.clock,
-                recorder=self.recorder)
+                recorder=self.recorder, watchdog=self.watchdog)
+        # deadman thresholds: generous multiples of each loop's interval so
+        # a busy-but-alive controller never flaps (floor 120s = the event
+        # dedupe TTL); a controller that misses ~10 turns is genuinely stuck
+        for ctrl, interval in self.LOOP_INTERVALS.items():
+            if ctrl == "interruption" and self.interruption is None:
+                continue
+            self.watchdog.register(ctrl, threshold=max(120.0, 10 * interval))
+        # flight recorder: periodic statusz ring + auto bundles on reconcile
+        # exceptions and deadman firings (chaos adds invariant breaches)
+        self.flightrecorder = FlightRecorder(
+            self, out_dir=os.environ.get("KARPENTER_TPU_BUNDLE_DIR") or None)
+        self.watchdog.add_stall_listener(
+            lambda names: self.flightrecorder.trigger(
+                "watchdog_deadman", detail=", ".join(names)))
+        self.watchdog.add_failure_listener(
+            lambda name, err: self.flightrecorder.trigger(
+                "reconcile_exception",
+                detail=f"{name}: {type(err).__name__}: {err}"))
 
     def _on_watch_event(self, kind: str, action: str, obj) -> None:
         if kind == "pdbs":
@@ -331,20 +380,34 @@ class Operator:
                              name="provisioning", daemon=True)
         t.start()
         self._threads.append(t)
-        loop("machinelifecycle", self.machinelifecycle.reconcile_once, 0.2)
-        loop("settingswatch", self.settingswatch.reconcile_once, 2.0)
-        loop("termination", self.termination.reconcile_once, 0.2)
-        loop("deprovisioning", self.deprovisioning.reconcile_once, 2.0)
-        loop("nodetemplate", self.nodetemplate.reconcile_once, 5.0)
-        loop("machinehydration", self.machinehydration.reconcile_once, 5.0)
-        loop("garbagecollection", self.garbagecollection.reconcile_once, 60.0)
-        loop("counters", self.counters.reconcile_once, 5.0)
+        iv = self.LOOP_INTERVALS
+        loop("machinelifecycle", self.machinelifecycle.reconcile_once,
+             iv["machinelifecycle"])
+        loop("settingswatch", self.settingswatch.reconcile_once,
+             iv["settingswatch"])
+        loop("termination", self.termination.reconcile_once,
+             iv["termination"])
+        loop("deprovisioning", self.deprovisioning.reconcile_once,
+             iv["deprovisioning"])
+        loop("nodetemplate", self.nodetemplate.reconcile_once,
+             iv["nodetemplate"])
+        loop("machinehydration", self.machinehydration.reconcile_once,
+             iv["machinehydration"])
+        loop("garbagecollection", self.garbagecollection.reconcile_once,
+             iv["garbagecollection"])
+        loop("counters", self.counters.reconcile_once, iv["counters"])
         if self.interruption is not None:
             t2 = threading.Thread(target=self.interruption.run,
                                   args=(self._stop, self.elected),
                                   name="interruption", daemon=True)
             t2.start()
             self._threads.append(t2)
+        # introspection loops: the deadman sweep (feeds /readyz, the healthy
+        # gauges and stall/recovery events) and the flight recorder's
+        # periodic statusz ring
+        loop("watchdog", self.watchdog.check, self.WATCHDOG_CHECK_INTERVAL)
+        loop("flightrecorder", self.flightrecorder.record_snapshot,
+             self.SNAPSHOT_INTERVAL)
 
     def stop(self) -> None:
         # The graceful lease release happens inside the election thread's
@@ -368,6 +431,18 @@ class Operator:
 
     def healthz(self) -> bool:
         return True
+
+    def readyz(self) -> "tuple[bool, str]":
+        """Watchdog-aggregated readiness: (ready, detail). Standby replicas
+        report ready — their controllers idle by design, and an unready
+        standby would be restarted by its probe right when it matters."""
+        if self.leader_elect and not self.elected.is_set():
+            return True, "ok (standby)"
+        stalled = self.watchdog.check()
+        if stalled:
+            return False, ("unhealthy: stalled controllers: "
+                           + ", ".join(stalled))
+        return True, "ok"
 
     def livez(self) -> bool:
         return self.cloudprovider.livez()

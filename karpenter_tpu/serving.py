@@ -29,6 +29,10 @@ from typing import Optional
 
 log = logging.getLogger("karpenter.serving")
 
+# /debug/traces ?limit= ceiling: the TRACER ring holds ~200 traces, so a
+# larger ask only serializes the same data with more zeros
+MAX_TRACE_LIMIT = 200
+
 # AdmissionReview resource plural -> store kind
 _PLURALS = {
     "provisioners": "provisioners",
@@ -85,6 +89,25 @@ class ServingPlane:
                 if self.path.rstrip("/") in ("", "/metrics"):
                     return self._text(200, op.metrics_text(),
                                       content_type="text/plain; version=0.0.4")
+                if self.path.startswith("/debug/statusz"):
+                    # one consistent operator snapshot (introspect/statusz) —
+                    # `python -m karpenter_tpu statusz` pretty-prints this
+                    from .introspect import snapshot
+
+                    return self._text(
+                        200, json.dumps(snapshot(op), default=str),
+                        content_type="application/json")
+                if self.path.startswith("/debug/bundle"):
+                    # live diagnostics bundle (no disk write) — the
+                    # `diagnose` CLI's fetch side
+                    fr = getattr(op, "flightrecorder", None)
+                    if fr is None:
+                        return self._text(404, "flight recorder not wired")
+                    return self._text(
+                        200, json.dumps(
+                            fr.bundle("manual", "GET /debug/bundle"),
+                            default=str),
+                        content_type="application/json")
                 if self.path.startswith("/debug/traces"):
                     # recent traces as JSON; ?id=<trace_id> exports ONE trace
                     # in Chrome trace_event format (load in Perfetto /
@@ -104,7 +127,10 @@ class ServingPlane:
                     try:
                         limit = int(qs.get("limit", ["20"])[0])
                     except ValueError:
-                        limit = 20
+                        # a silent default would make a bad dashboard query
+                        # look like a tiny trace ring
+                        return self._text(400, "limit must be an integer")
+                    limit = min(max(limit, 1), MAX_TRACE_LIMIT)
                     return self._text(
                         200, json.dumps({"traces": TRACER.traces(limit)},
                                         default=str),
@@ -120,7 +146,9 @@ class ServingPlane:
             def do_GET(self):
                 if self.path.startswith("/logz"):
                     # recent controller logs (utils/logring) — the `logs`
-                    # CLI's kubectl-logs-shaped triage endpoint
+                    # CLI's kubectl-logs-shaped triage endpoint; ?level=
+                    # filters by minimum severity, ?format=json returns the
+                    # structured records (JSON lines, bundle-shaped)
                     from urllib.parse import parse_qs, urlsplit
 
                     from .utils import logring
@@ -130,15 +158,53 @@ class ServingPlane:
                         n = int(qs.get("n", ["500"])[0])
                     except ValueError:
                         n = 500
-                    return self._text(200, "\n".join(logring.dump(n)) + "\n")
-                if self.path.startswith("/healthz") or \
-                        self.path.startswith("/readyz"):
-                    ok = op.healthz()
+                    level = qs.get("level", [None])[0]
+                    if level is not None:
+                        try:
+                            logring._levelno(level)
+                        except ValueError:
+                            return self._text(
+                                400, f"unknown log level: {level}")
+                    if qs.get("format", [""])[0] == "json":
+                        lines = [json.dumps(r, default=str) for r in
+                                 logring.dump_records(n, level)]
+                    else:
+                        lines = logring.dump(n, level)
+                    return self._text(200, "\n".join(lines) + "\n")
+                if self.path.startswith("/eventz"):
+                    # recent recorded events (post-dedupe ring) — the
+                    # `events` CLI endpoint, mirroring /logz + `logs`
+                    from urllib.parse import parse_qs, urlsplit
+
+                    qs = parse_qs(urlsplit(self.path).query)
+                    try:
+                        n = int(qs.get("n", ["100"])[0])
+                    except ValueError:
+                        return self._text(400, "n must be an integer")
+                    events = [
+                        {"ts": ts, "kind": e.kind, "reason": e.reason,
+                         "object": e.object_ref, "message": e.message}
+                        for ts, e in op.recorder.recent(max(1, n))]
+                    return self._text(
+                        200, json.dumps({"events": events}, default=str),
+                        content_type="application/json")
+                if self.path.startswith("/healthz"):
+                    ok, detail = op.healthz(), "ok"
+                elif self.path.startswith("/readyz"):
+                    # watchdog-aggregated: a stalled reconcile loop makes
+                    # the replica unready, and the body names it
+                    readyz = getattr(op, "readyz", None)
+                    if readyz is None:
+                        ok, detail = op.healthz(), "ok"
+                    else:
+                        ok, detail = readyz()
                 elif self.path.startswith("/livez"):
-                    ok = op.livez()
+                    ok, detail = op.livez(), "ok"
                 else:
                     return self._text(404, "not found")
-                return self._text(200 if ok else 503, "ok" if ok else "unhealthy")
+                return self._text(200 if ok else 503,
+                                  detail if ok else
+                                  (detail if detail != "ok" else "unhealthy"))
 
         return Health
 

@@ -6,6 +6,10 @@ from the log archive by test id). The hermetic analogue keeps the last N
 records in memory and serves them over the health listener — `python -m
 karpenter_tpu logs` is then kubectl-logs-shaped triage against a live
 controller.
+
+Records are kept structured (timestamp, level, logger, formatted line) so
+the serving plane can filter by `?level=` and the flight recorder can
+embed them as JSON without re-parsing formatted text.
 """
 
 from __future__ import annotations
@@ -19,11 +23,11 @@ _HANDLER: "RingHandler | None" = None
 
 
 class RingHandler(logging.Handler):
-    """Keep the last `capacity` formatted records, thread-safe."""
+    """Keep the last `capacity` records, thread-safe."""
 
     def __init__(self, capacity: int = 2000):
         super().__init__()
-        self.ring: "collections.deque[str]" = collections.deque(maxlen=capacity)
+        self.ring: "collections.deque[dict]" = collections.deque(maxlen=capacity)
         self.setFormatter(logging.Formatter(
             "%(asctime)s %(levelname)s %(name)s %(message)s"))
 
@@ -32,13 +36,39 @@ class RingHandler(logging.Handler):
             line = self.format(record)
         except Exception:
             return
+        entry = {
+            "ts": record.created,
+            "level": record.levelname,
+            "levelno": record.levelno,
+            "logger": record.name,
+            "line": line,
+        }
         with _LOCK:
-            self.ring.append(line)
+            self.ring.append(entry)
 
-    def dump(self, n: "int | None" = None) -> "list[str]":
+    def dump_records(self, n: "int | None" = None,
+                     level: "str | int | None" = None) -> "list[dict]":
+        """Recent structured records, oldest first; `level` keeps records
+        at or above that severity (name like "WARNING" or a levelno)."""
         with _LOCK:
-            lines = list(self.ring)
-        return lines if n is None else lines[-n:]
+            records = list(self.ring)
+        if level is not None:
+            threshold = _levelno(level)
+            records = [r for r in records if r["levelno"] >= threshold]
+        return records if n is None else records[-n:]
+
+    def dump(self, n: "int | None" = None,
+             level: "str | int | None" = None) -> "list[str]":
+        return [r["line"] for r in self.dump_records(n, level)]
+
+
+def _levelno(level: "str | int") -> int:
+    if isinstance(level, int):
+        return level
+    no = logging.getLevelName(str(level).strip().upper())
+    if not isinstance(no, int):  # getLevelName echoes "Level FOO" strings
+        raise ValueError(f"unknown log level: {level!r}")
+    return no
 
 
 def install(capacity: int = 2000) -> RingHandler:
@@ -57,7 +87,15 @@ def install(capacity: int = 2000) -> RingHandler:
     return _HANDLER
 
 
-def dump(n: "int | None" = None) -> "list[str]":
-    """Recent records, oldest first (empty when no ring is installed)."""
+def dump(n: "int | None" = None,
+         level: "str | int | None" = None) -> "list[str]":
+    """Recent formatted lines, oldest first (empty when no ring installed)."""
     h = _HANDLER
-    return h.dump(n) if h is not None else []
+    return h.dump(n, level) if h is not None else []
+
+
+def dump_records(n: "int | None" = None,
+                 level: "str | int | None" = None) -> "list[dict]":
+    """Recent structured records for bundle inclusion (JSON-lines shaped)."""
+    h = _HANDLER
+    return h.dump_records(n, level) if h is not None else []

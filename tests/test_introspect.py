@@ -1,0 +1,318 @@
+"""Introspection plane (ISSUE 3): watchdog deadman, statusz snapshot,
+flight recorder triggers and bundles.
+
+Tier-1 pieces: the FakeClock-driven deadman (stall -> unready -> recovery,
+with the stalled controller named in /readyz and the healthy gauge reading
+0 then 1), statusz schema stability (the snapshot is a wire format — the
+ring and bundles persist it), the chaos invariant-breach trigger writing a
+bundle next to the replay artifact, and the /debug/bundle round trip.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.apis.nodetemplate import NodeTemplate
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.apis.settings import Settings
+from karpenter_tpu.chaos import ChaosRunner
+from karpenter_tpu.chaos import invariants as chaos_invariants
+from karpenter_tpu.fake.cloud import FakeCloud
+from karpenter_tpu.introspect import FlightRecorder, Watchdog, snapshot
+from karpenter_tpu.introspect.watchdog import cycle as wd_cycle
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def _catalog():
+    return Catalog(types=[make_instance_type(
+        "m.large", cpu=4, memory="16Gi", od_price=0.2, spot_price=0.07)])
+
+
+def _operator(clock, **kw):
+    op = Operator(FakeCloud(catalog=_catalog(), clock=clock),
+                  Settings(cluster_name="intro",
+                           cluster_endpoint="https://intro"),
+                  _catalog(), clock=clock, **kw)
+    op.kube.create("nodetemplates", "default", NodeTemplate(
+        name="default",
+        subnet_selector={"id": "subnet-zone-1a"},
+        security_group_selector={"id": "sg-default"}))
+    op.cloudprovider.register_nodetemplate(
+        op.kube.get("nodetemplates", "default"))
+    prov = Provisioner(name="default", provider_ref="default")
+    prov.set_defaults()
+    op.kube.create("provisioners", "default", prov)
+    return op
+
+
+@pytest.fixture
+def op():
+    clock = FakeClock()
+    op = _operator(clock)
+    yield op, clock
+    op.stop()
+
+
+class TestWatchdog:
+    def test_beat_and_status(self):
+        clock = FakeClock()
+        wd = Watchdog(clock=clock)
+        wd.register("alpha", threshold=10.0)
+        wd.beat("alpha", duration_s=0.25)
+        st = wd.status()["alpha"]
+        assert st["healthy"] and st["beats"] == 1
+        assert st["last_cycle_ms"] == 250.0
+        assert wd.check() == []
+
+    def test_failure_records_without_refreshing_heartbeat(self):
+        clock = FakeClock()
+        wd = Watchdog(clock=clock)
+        wd.register("alpha", threshold=10.0)
+        wd.beat("alpha")
+        clock.step(11.0)
+        # a crash-looping controller fails every cycle: the failure is
+        # recorded but the heartbeat must NOT refresh — it goes stale
+        # exactly like a hung one
+        with pytest.raises(RuntimeError):
+            with wd.cycle("alpha"):
+                raise RuntimeError("boom")
+        assert wd.check() == ["alpha"]
+        st = wd.status()["alpha"]
+        assert st["failures"] == 1
+        assert "RuntimeError: boom" in st["last_error"]
+
+    def test_startup_grace_is_one_threshold(self):
+        clock = FakeClock()
+        wd = Watchdog(clock=clock)
+        wd.register("quiet", threshold=5.0)
+        clock.step(4.0)
+        assert wd.check() == []  # never beat, still inside the grace
+        clock.step(2.0)
+        assert wd.check() == ["quiet"]
+
+    def test_transition_events_are_edge_triggered(self):
+        from karpenter_tpu.events import EventRecorder
+
+        clock = FakeClock()
+        rec = EventRecorder(clock=clock)
+        wd = Watchdog(clock=clock, recorder=rec)
+        wd.register("alpha", threshold=5.0)
+        clock.step(6.0)
+        wd.check()
+        wd.check()  # still stalled: no second event
+        wd.beat("alpha")
+        wd.check()  # recovery
+        wd.check()
+        reasons = [e.reason for _, e in rec.recent()
+                   if e.object_ref == "controller/alpha"]
+        assert reasons == ["ControllerStalled", "ControllerRecovered"]
+
+    def test_stall_listener_gets_newly_stalled_names(self):
+        clock = FakeClock()
+        wd = Watchdog(clock=clock)
+        seen = []
+        wd.add_stall_listener(seen.append)
+        wd.register("a", threshold=5.0)
+        wd.register("b", threshold=50.0)
+        clock.step(6.0)
+        wd.check()
+        clock.step(60.0)
+        wd.check()
+        assert seen == [["a"], ["b"]]
+
+    def test_module_cycle_tolerates_no_watchdog(self):
+        with wd_cycle(None, "standalone"):
+            pass  # strict no-op
+
+
+class TestDeadmanReadyz:
+    def test_stall_unready_recovery(self, op):
+        op, clock = op
+        op.reconcile_all_once()
+        ok, detail = op.readyz()
+        assert ok and detail == "ok"
+
+        # 500s with no cycles: every 120s-threshold controller stalls;
+        # garbagecollection (600s threshold) must NOT
+        clock.step(500.0)
+        ok, detail = op.readyz()
+        assert not ok
+        assert detail.startswith("unhealthy: stalled controllers: ")
+        assert "provisioning" in detail
+        assert "garbagecollection" not in detail
+
+        def healthy(controller):
+            for labels, v in op.watchdog.healthy_gauge.collect():
+                if labels.get("controller") == controller:
+                    return v
+            raise AssertionError(f"no healthy series for {controller}")
+
+        assert healthy("provisioning") == 0.0
+        assert healthy("garbagecollection") == 1.0
+
+        op.reconcile_all_once()
+        ok, detail = op.readyz()
+        assert ok and detail == "ok"
+        assert healthy("provisioning") == 1.0
+
+    def test_stall_emits_deduped_warning_event(self, op):
+        op, clock = op
+        op.reconcile_all_once()
+        clock.step(500.0)
+        op.watchdog.check()
+        op.watchdog.check()
+        stalls = [e for _, e in op.recorder.recent()
+                  if e.reason == "ControllerStalled"
+                  and e.object_ref == "controller/provisioning"]
+        assert len(stalls) == 1
+        assert stalls[0].kind == "Warning"
+
+
+class TestStatusz:
+    TOP_KEYS = {"tool", "schema", "version", "ts", "cluster", "controllers",
+                "queues", "caches", "events", "metrics"}
+    CLUSTER_KEYS = {"nodes", "nodes_by_provisioner",
+                    "nodes_marked_for_deletion", "machines", "pods",
+                    "pending_pods", "provisioners", "nodetemplates", "pdbs"}
+
+    def test_schema_stability(self, op):
+        op, clock = op
+        op.reconcile_all_once()
+        snap = snapshot(op)
+        # the snapshot is a wire format (ring + bundles persist it):
+        # key-set changes are schema changes and must bump SCHEMA_VERSION
+        assert set(snap) == self.TOP_KEYS
+        assert snap["tool"] == "karpenter_tpu.statusz"
+        assert snap["schema"] == 1
+        assert set(snap["cluster"]) == self.CLUSTER_KEYS
+        assert set(snap["queues"]) == {"create_fleet", "describe_instances",
+                                       "terminate_instances", "interruption"}
+        assert set(snap["caches"]) == {"solver", "instance_types", "ice",
+                                       "pricing", "launch_templates"}
+        ctrl = snap["controllers"]["provisioning"]
+        assert set(ctrl) == {"healthy", "last_cycle_age_s", "threshold_s",
+                             "beats", "failures", "last_error",
+                             "last_cycle_ms"}
+        json.dumps(snap, default=str)  # must serialize
+
+    def test_sections_degrade_independently(self, op):
+        op, clock = op
+        kube = op.kube
+        op.kube = None  # wedge the cluster section
+        try:
+            snap = snapshot(op)
+        finally:
+            op.kube = kube
+        assert "error" in snap["cluster"]
+        assert isinstance(snap["controllers"], dict)  # others survive
+        assert "error" not in snap["caches"]
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self, op):
+        op, clock = op
+        fr = FlightRecorder(op, ring_size=3)
+        for _ in range(5):
+            fr.record_snapshot()
+            clock.step(1.0)
+        ring = fr.ring()
+        assert len(ring) == 3
+        assert ring[0]["ts"] == 2.0  # oldest two evicted
+
+    def test_auto_trigger_rate_limited_per_reason(self, op, tmp_path):
+        op, clock = op
+        fr = FlightRecorder(op, out_dir=str(tmp_path), clock=clock,
+                            min_interval=60.0)
+        first = fr.trigger("reconcile_exception", "boom 1")
+        assert first is not None
+        assert fr.trigger("reconcile_exception", "boom 2") is None
+        clock.step(61.0)
+        assert fr.trigger("reconcile_exception", "boom 3") is not None
+        # force bypasses the limiter (chaos uses this)
+        assert fr.trigger("reconcile_exception", "boom 4",
+                          force=True) is not None
+
+    def test_bundle_shape(self, op, tmp_path):
+        op, clock = op
+        op.reconcile_all_once()
+        fr = FlightRecorder(op, out_dir=str(tmp_path), clock=clock)
+        fr.record_snapshot()
+        path = fr.trigger("watchdog_deadman", "provisioning")
+        with open(path) as f:
+            b = json.load(f)
+        assert b["tool"] == "karpenter_tpu.diagnostics_bundle"
+        assert b["trigger"] == {"reason": "watchdog_deadman",
+                                "detail": "provisioning"}
+        assert set(b) >= {"ts", "statusz", "statusz_ring", "logs", "traces",
+                          "events", "metrics_text", "recent_triggers"}
+        assert len(b["statusz_ring"]) == 1
+        assert "karpenter_controller_healthy" in b["metrics_text"]
+
+    def test_operator_wires_deadman_trigger(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_BUNDLE_DIR", str(tmp_path))
+        clock = FakeClock()
+        op = _operator(clock)
+        try:
+            op.reconcile_all_once()
+            clock.step(500.0)
+            op.watchdog.check()  # deadman fires -> stall listener -> bundle
+        finally:
+            op.stop()
+        bundles = list(tmp_path.glob("bundle_watchdog_deadman_*.json"))
+        assert len(bundles) == 1
+        b = json.loads(bundles[0].read_text())
+        assert b["trigger"]["reason"] == "watchdog_deadman"
+        assert "provisioning" in b["trigger"]["detail"]
+
+
+class TestChaosBundle:
+    def test_invariant_breach_dumps_bundle(self, tmp_path, monkeypatch):
+        # force a breach: every scenario fails one synthetic invariant
+        def always_breach(op, cloud, **kw):
+            return [chaos_invariants.Violation(
+                "synthetic", "injected breach for the trigger test")]
+
+        monkeypatch.setattr(chaos_invariants, "check_all", always_breach)
+        runner = ChaosRunner(seed=7, scenarios=1, out_dir=str(tmp_path))
+        artifact = runner.run()
+        assert artifact["passed"] is False
+        # the bundle lands next to the replay artifact, deterministic name
+        (bundle_path,) = artifact["bundles"]
+        assert bundle_path.endswith("chaos_seed7_s0_bundle.json")
+        with open(bundle_path) as f:
+            b = json.load(f)
+        assert b["trigger"]["reason"] == "chaos_invariant_breach"
+        assert "[synthetic]" in b["trigger"]["detail"]
+        # the ring carries per-cycle history from the exact failed run
+        assert len(b["statusz_ring"]) > 1
+        for section in ("logs", "traces", "events", "statusz"):
+            assert section in b
+        # scenario dicts stay a pure function of the seed: bundle paths
+        # live only at the artifact top level
+        assert "bundles" not in artifact["scenarios"][0]
+
+
+class TestBundleEndpoint:
+    def test_debug_bundle_round_trip(self, tmp_path):
+        clock = FakeClock()
+        op = _operator(clock, serve_http=True, metrics_port=0,
+                       health_port=0, webhook_port=-1)
+        ports = op.serving.start()
+        try:
+            op.reconcile_all_once()
+            op.flightrecorder.record_snapshot()
+            url = (f"http://127.0.0.1:{ports['metrics']}/debug/bundle")
+            with urllib.request.urlopen(url, timeout=5) as r:
+                assert r.status == 200
+                b = json.loads(r.read())
+        finally:
+            op.serving.stop()
+            op.stop()
+        assert b["tool"] == "karpenter_tpu.diagnostics_bundle"
+        assert b["trigger"]["reason"] == "manual"
+        assert len(b["statusz_ring"]) == 1
+        assert b["statusz"]["controllers"]["provisioning"]["beats"] >= 1

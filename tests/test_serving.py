@@ -116,6 +116,101 @@ class TestServingPlane:
         assert resp["response"]["allowed"] is False
 
 
+class TestDebugEndpoints:
+    def test_statusz_serves_snapshot(self, served_op):
+        op, ports = served_op
+        op.reconcile_all_once()
+        code, body = _get(ports["metrics"], "/debug/statusz")
+        assert code == 200
+        snap = json.loads(body)
+        assert snap["tool"] == "karpenter_tpu.statusz"
+        assert snap["controllers"]["provisioning"]["beats"] >= 1
+
+    def test_bundle_serves_live_bundle(self, served_op):
+        op, ports = served_op
+        code, body = _get(ports["metrics"], "/debug/bundle")
+        assert code == 200
+        bundle = json.loads(body)
+        assert bundle["tool"] == "karpenter_tpu.diagnostics_bundle"
+        assert bundle["trigger"]["reason"] == "manual"
+
+    def test_bundle_404_without_flight_recorder(self):
+        from karpenter_tpu.serving import ServingPlane
+
+        class NullOp:
+            def metrics_text(self):
+                return "x"
+
+        plane = ServingPlane(NullOp(), metrics_port=0, health_port=-1,
+                             webhook_port=-1)
+        ports = plane.start()
+        try:
+            code, body = _get(ports["metrics"], "/debug/bundle")
+        finally:
+            plane.stop()
+        assert code == 404
+        assert "flight recorder" in body
+
+    def test_traces_rejects_non_integer_limit(self, served_op):
+        op, ports = served_op
+        code, body = _get(ports["metrics"], "/debug/traces?limit=bogus")
+        assert code == 400
+        assert "integer" in body
+
+    def test_traces_clamps_huge_limit(self, served_op):
+        op, ports = served_op
+        # a limit far past the ring must clamp, not error or balloon
+        code, body = _get(ports["metrics"], "/debug/traces?limit=999999")
+        assert code == 200
+        traces = json.loads(body)["traces"]
+        from karpenter_tpu.serving import MAX_TRACE_LIMIT
+        assert len(traces) <= MAX_TRACE_LIMIT
+
+    def test_eventz_lists_recent_events(self, served_op):
+        op, ports = served_op
+        op.recorder.warning("node/n-1", "TestReason", "hello eventz")
+        code, body = _get(ports["health"], "/eventz?n=10")
+        assert code == 200
+        events = json.loads(body)["events"]
+        assert any(e["reason"] == "TestReason"
+                   and e["object"] == "node/n-1" for e in events)
+
+    def test_eventz_rejects_non_integer_n(self, served_op):
+        op, ports = served_op
+        code, body = _get(ports["health"], "/eventz?n=many")
+        assert code == 400
+
+    def test_logz_rejects_unknown_level(self, served_op):
+        op, ports = served_op
+        code, body = _get(ports["health"], "/logz?level=LOUD")
+        assert code == 400
+        assert "unknown log level" in body
+
+    def test_logz_json_mode_returns_records(self, served_op):
+        import logging
+
+        op, ports = served_op
+        logging.getLogger("karpenter.test_serving").warning("logz json probe")
+        code, body = _get(ports["health"], "/logz?format=json&n=50")
+        assert code == 200
+        records = [json.loads(line) for line in body.splitlines() if line]
+        assert any(r["line"].endswith("logz json probe") and
+                   r["level"] == "WARNING" for r in records)
+
+    def test_readyz_names_stalled_controller(self, served_op):
+        op, ports = served_op
+        op.reconcile_all_once()
+        code, body = _get(ports["health"], "/readyz")
+        assert (code, body) == (200, "ok")
+        op.clock.step(500.0)
+        code, body = _get(ports["health"], "/readyz")
+        assert code == 503
+        assert "stalled controllers" in body and "provisioning" in body
+        op.reconcile_all_once()
+        code, body = _get(ports["health"], "/readyz")
+        assert (code, body) == (200, "ok")
+
+
 class TestServingHardening:
     def test_webhook_fails_closed_without_content_length(self, served_op):
         import http.client
