@@ -8,12 +8,15 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit lint test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos claims diagnose
+.PHONY: presubmit lint noretry test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos claims diagnose
 
-presubmit: lint claims test verify-entry  ## what CI runs
+presubmit: lint claims noretry test verify-entry  ## what CI runs
 
 claims:  ## every benchmark number in docs must cite a recorded artifact
 	$(PY) hack/check_round_claims.py
+
+noretry:  ## retries must flow through resilience.RetryPolicy (shared budget)
+	$(PY) hack/check_no_adhoc_retry.py
 
 diagnose:  ## introspection smoke: deadman, statusz, flight-recorder bundles
 	$(CPU_ENV) $(PY) -m pytest tests/test_introspect.py -q

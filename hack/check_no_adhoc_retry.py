@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""Lint: no ad-hoc retry loops outside the resilience plane.
+
+Every retry in the controller half must flow through
+`resilience.RetryPolicy` so it spends from the per-dependency budget,
+feeds the breaker, and uses seeded, clock-injectable backoff
+(docs/designs/resilience.md). The historical failure mode this guards
+against: a helper grows its own `while ...: try/except + time.sleep`
+loop, works fine in review, and during the next regional 5xx burst
+multiplies into a retry storm the budget never saw.
+
+Detection is AST-based, not textual: a `while`/`for` loop that contains
+BOTH an exception handler and a `time.sleep(...)` (or bare `sleep(...)`
+imported from time) call in the same loop body is flagged. Sleeping
+without catching, or catching without sleeping, is fine — only the
+retry-with-backoff shape is reserved for the resilience plane.
+
+Allowlisted files carry sleeps that are genuinely not dependency
+retries (startup polling for a subprocess the test itself owns, the
+TPU-tunnel environment probe). Add to the allowlist only with a
+comment saying why the loop is not a dependency retry.
+
+Run via `make presubmit` (or directly: python hack/check_no_adhoc_retry.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "karpenter_tpu"
+
+# the one place retry-with-backoff loops are allowed to live
+EXEMPT_DIR = PACKAGE / "resilience"
+
+ALLOWLIST = {
+    # interpreter-boot TPU tunnel probe: retries the axon relay BEFORE the
+    # operator (and its hub) can exist
+    PACKAGE / "utils" / "jaxenv.py",
+    # CLI serve-loop waits for its OWN subprocess/port to come up — process
+    # supervision, not a dependency call
+    PACKAGE / "__main__.py",
+}
+
+
+def _is_sleep_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "sleep" \
+            and isinstance(f.value, ast.Name) and f.value.id == "time":
+        return True
+    return isinstance(f, ast.Name) and f.id == "sleep"
+
+
+def _loop_retries(loop: "ast.While | ast.For") -> bool:
+    """True when the loop body both handles exceptions and sleeps —
+    nested loops are scanned separately, so their bodies are skipped."""
+    has_handler = has_sleep = False
+    stack = list(loop.body) + list(loop.orelse)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.While, ast.For, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.Lambda)):
+            continue  # its own scope; flagged on its own if it retries
+        if isinstance(node, ast.ExceptHandler):
+            has_handler = True
+        if _is_sleep_call(node):
+            has_sleep = True
+        if has_handler and has_sleep:
+            return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def check_file(path: pathlib.Path) -> "list[str]":
+    tree = ast.parse(path.read_text(), filename=str(path))
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.While, ast.For)) and _loop_retries(node):
+            rel = path.relative_to(ROOT) if ROOT in path.parents else path
+            out.append(
+                f"{rel}:{node.lineno}: ad-hoc retry loop (except + "
+                f"time.sleep); route it through resilience.RetryPolicy "
+                f"(docs/designs/resilience.md)")
+    return out
+
+
+def main() -> int:
+    problems: "list[str]" = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        if EXEMPT_DIR in path.parents or path in ALLOWLIST:
+            continue
+        problems.extend(check_file(path))
+    if problems:
+        print("\n".join(problems), file=sys.stderr)
+        print(f"\n{len(problems)} ad-hoc retry loop(s); retries must spend "
+              f"from the shared budget (hack/check_no_adhoc_retry.py "
+              f"docstring has the rules)", file=sys.stderr)
+        return 1
+    print("no-adhoc-retry: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -104,8 +104,15 @@ def cmd_controller(args) -> int:
     if args.solver:
         from .solver.client import RemoteSolver
 
+        # late-binding hub reference: the factory only runs during
+        # reconcile cycles, after the Operator (and its ResilienceHub)
+        # exists — so the remote solver edge shares the solver breaker
+        # and retry budget with every other borrower
+        _op_cell: "list" = []
         solver_factory = (
-            lambda cat, provs: RemoteSolver(cat, provs, target=args.solver))
+            lambda cat, provs: RemoteSolver(
+                cat, provs, target=args.solver,
+                resilience=_op_cell[0].resilience if _op_cell else None))
     cloud = FakeCloud(catalog)
     if args.state and os.path.exists(args.state):
         cloud.load_state(args.state)
@@ -130,6 +137,8 @@ def cmd_controller(args) -> int:
                   health_port=args.health_port,
                   webhook_port=args.webhook_port,
                   webhook_tls=(args.webhook_tls_cert, args.webhook_tls_key))
+    if args.solver:
+        _op_cell.append(op)
     if args.apply:
         # reference-compatible manifests (Provisioner / AWSNodeTemplate /
         # Deployment / Pod / PDB YAML) drive the plane as-is
@@ -474,7 +483,8 @@ def cmd_chaos(args) -> int:
 
     runner = ChaosRunner(seed=args.seed, scenarios=args.scenarios,
                          intensity=args.intensity,
-                         out_dir=args.out_dir or None)
+                         out_dir=args.out_dir or None,
+                         burst=args.burst)
     artifact = runner.run()
     for s in artifact["scenarios"]:
         verdict = "PASS" if s["passed"] else "FAIL"
@@ -491,7 +501,8 @@ def cmd_chaos(args) -> int:
               f"JSON directly)")
     if not artifact["passed"]:
         print(f"REPRODUCE: python -m karpenter_tpu chaos --seed {args.seed} "
-              f"--scenarios {args.scenarios}")
+              f"--scenarios {args.scenarios}"
+              f"{' --burst' if args.burst else ''}")
         return 1
     print(f"chaos: {artifact['scenario_count']} scenario(s) passed, "
           f"{len(artifact['fault_kinds'])} fault kinds across "
@@ -649,6 +660,10 @@ def main(argv=None) -> int:
                          help="fault-count multiplier per site")
     p_chaos.add_argument("--out-dir", default="benchmarks/results/chaos",
                          help="replay-artifact directory ('' disables)")
+    p_chaos.add_argument("--burst", action="store_true",
+                         help="run the fixed resilience-plane burst schedule "
+                              "(dense cloud-5xx + solver crashes) instead of "
+                              "the sampled plan")
     p_chaos.set_defaults(fn=cmd_chaos)
 
     p_ver = sub.add_parser("version")

@@ -29,10 +29,36 @@ def _fleet_hasher(req: CreateFleetRequest):
             tuple(sorted(req.tags.items())), req.image_id, req.fleet_context)
 
 
+# Transient cloud-API failures worth a budgeted retry at this layer.
+# ConnectivityError (the HTTP backend's post-retry give-up) and FleetError
+# (a business outcome, not a transport failure) are deliberately excluded —
+# retrying them here would stack retries on retries.
+_TRANSIENT_CODES = frozenset(
+    {"InternalError", "ServiceUnavailable", "RequestLimitExceeded",
+     "Throttling"})
+
+
+def transient_cloud_failure(e: BaseException) -> bool:
+    if isinstance(e, (TimeoutError, ConnectionError)):
+        return True
+    return (isinstance(e, cloud_errors.CloudError)
+            and not isinstance(e, cloud_errors.FleetError)
+            and e.code in _TRANSIENT_CODES)
+
+
+def _through_policy(policy, fn):
+    """Route one cloud call through the shared cloud-edge RetryPolicy
+    (breaker fail-fast + budgeted backoff); None = direct call."""
+    if policy is None:
+        return fn()
+    return policy.call(fn, retriable=transient_cloud_failure)
+
+
 class CreateFleetBatcher:
     def __init__(self, cloud, clock: Optional[Clock] = None,
-                 idle=0.035, max_wait=1.0, max_items=1000):
+                 idle=0.035, max_wait=1.0, max_items=1000, policy=None):
         self.cloud = cloud
+        self.policy = policy
         self._batcher: Batcher = Batcher(
             self._exec, idle, max_wait, max_items,
             hasher=_fleet_hasher, clock=clock, name="create-fleet")
@@ -48,7 +74,8 @@ class CreateFleetBatcher:
         total = sum(r.capacity for r in requests)
         merged = dataclasses.replace(requests[0], capacity=total)
         try:
-            resp = self.cloud.create_fleet(merged)
+            resp = _through_policy(self.policy,
+                                   lambda: self.cloud.create_fleet(merged))
         except Exception as e:
             return [e] * len(requests)
         results = []
@@ -79,8 +106,9 @@ class CreateFleetBatcher:
 
 class DescribeInstancesBatcher:
     def __init__(self, cloud, clock: Optional[Clock] = None,
-                 idle=0.1, max_wait=1.0, max_items=500):
+                 idle=0.1, max_wait=1.0, max_items=500, policy=None):
         self.cloud = cloud
+        self.policy = policy
         self._batcher: Batcher = Batcher(
             self._exec, idle, max_wait, max_items,
             hasher=one_bucket_hasher, clock=clock, name="describe-instances")
@@ -92,8 +120,10 @@ class DescribeInstancesBatcher:
         return self._batcher.depth()
 
     def _exec(self, ids):
+        unique = list(dict.fromkeys(ids))
         try:
-            found = {i.id: i for i in self.cloud.describe_instances(list(dict.fromkeys(ids)))}
+            found = {i.id: i for i in _through_policy(
+                self.policy, lambda: self.cloud.describe_instances(unique))}
         except Exception:
             found = {}
         results = []
@@ -102,7 +132,9 @@ class DescribeInstancesBatcher:
             if inst is None:
                 # per-ID retry fallback (describeinstances.go:97-120)
                 try:
-                    single = self.cloud.describe_instances([i])
+                    single = _through_policy(
+                        self.policy,
+                        lambda i=i: self.cloud.describe_instances([i]))
                     inst = single[0] if single else None
                 except Exception as e:
                     results.append(e)
@@ -120,8 +152,9 @@ class DescribeInstancesBatcher:
 
 class TerminateInstancesBatcher:
     def __init__(self, cloud, clock: Optional[Clock] = None,
-                 idle=0.1, max_wait=1.0, max_items=500):
+                 idle=0.1, max_wait=1.0, max_items=500, policy=None):
         self.cloud = cloud
+        self.policy = policy
         self._batcher: Batcher = Batcher(
             self._exec, idle, max_wait, max_items,
             hasher=one_bucket_hasher, clock=clock, name="terminate-instances")
@@ -136,13 +169,17 @@ class TerminateInstancesBatcher:
         unique = list(dict.fromkeys(ids))
         changes = {}
         try:
-            for iid, state in self.cloud.terminate_instances(unique):
+            for iid, state in _through_policy(
+                    self.policy,
+                    lambda: self.cloud.terminate_instances(unique)):
                 changes[iid] = (iid, state)
         except Exception:
             # batch failed: per-ID retry (terminateinstances.go:53-128)
             for i in unique:
                 try:
-                    for iid, state in self.cloud.terminate_instances([i]):
+                    for iid, state in _through_policy(
+                            self.policy,
+                            lambda i=i: self.cloud.terminate_instances([i])):
                         changes[iid] = (iid, state)
                 except Exception as e:
                     changes[i] = e

@@ -213,7 +213,10 @@ class ChaosInjector:
                           ("terminate", TerminateInstancesBatcher)):
             old = getattr(inst, attr)
             old.stop()
-            setattr(inst, attr, cls(inst.cloud, idle=0.0005, max_wait=0.002))
+            # keep the cloud-edge RetryPolicy (breaker + budget) the
+            # operator wired in — chaos exists to exercise it
+            setattr(inst, attr, cls(inst.cloud, idle=0.0005, max_wait=0.002,
+                                    policy=getattr(old, "policy", None)))
 
     # -- wire mode -------------------------------------------------------------
 

@@ -169,12 +169,113 @@ def check_consolidation_cost(actions: "list[dict]") -> "list[Violation]":
     return out
 
 
+def check_breaker_discipline(resilience: "dict | None") -> "list[Violation]":
+    """Breakers open within K consecutive failures: no dependency ever
+    accumulates a closed-state failure streak past its threshold without
+    tripping, and the transition ledger itself is a well-formed FSM walk
+    (every hop departs from the state the previous hop arrived at)."""
+    out = []
+    for dep, ev in sorted((resilience or {}).get("breakers", {}).items()):
+        k = ev["failure_threshold"]
+        if ev["max_closed_streak"] > k:
+            out.append(Violation(
+                "breaker-opens-within-k",
+                f"dependency {dep}: {ev['max_closed_streak']} consecutive "
+                f"closed-state failures exceeded threshold {k} without the "
+                f"breaker opening"))
+        state = "closed"
+        for t in ev["transitions"]:
+            if t["from"] != state:
+                out.append(Violation(
+                    "breaker-opens-within-k",
+                    f"dependency {dep}: transition ledger discontinuity — "
+                    f"hop departs {t['from']!r} but breaker was {state!r}"))
+                break
+            state = t["to"]
+        else:
+            if state != ev["final_state"]:
+                out.append(Violation(
+                    "breaker-opens-within-k",
+                    f"dependency {dep}: ledger ends at {state!r} but final "
+                    f"state is {ev['final_state']!r}"))
+    return out
+
+
+def check_retry_budget(resilience: "dict | None") -> "list[Violation]":
+    """Retry budgets are never exceeded: the token bucket's low-water mark
+    stays non-negative (no retry was granted on credit) and refills never
+    push it past capacity."""
+    out = []
+    for dep, ev in sorted((resilience or {}).get("policies", {}).items()):
+        b = ev["budget"]
+        if b["min_tokens"] < 0:
+            out.append(Violation(
+                "retry-budget-never-exceeded",
+                f"dependency {dep}: budget low-water mark "
+                f"{b['min_tokens']:.3f} went negative — a retry was granted "
+                f"beyond the budget"))
+        if b["tokens"] > b["capacity"] + _COST_EPS:
+            out.append(Violation(
+                "retry-budget-never-exceeded",
+                f"dependency {dep}: budget holds {b['tokens']:.3f} tokens, "
+                f"above capacity {b['capacity']:.3f}"))
+    return out
+
+
+def check_degrade_monotone(resilience: "dict | None") -> "list[Violation]":
+    """Degradation is monotone during a fault window: every move DOWN the
+    ladder (rung index up) is driven by a recorded failure, and every move
+    back up is a single-step probe success — no rung skipping, no
+    spontaneous recovery, no ledger discontinuities."""
+    out = []
+    for chain, ev in sorted((resilience or {}).get("ladders", {}).items()):
+        rung = 0
+        broken = False
+        for t in ev["transitions"]:
+            if t["from"] != rung:
+                out.append(Violation(
+                    "degrade-monotone",
+                    f"chain {chain}: transition ledger discontinuity — hop "
+                    f"departs rung {t['from']} but ladder was at {rung}"))
+                broken = True
+                break
+            if t["to"] > t["from"] and t["reason"] != "failure":
+                out.append(Violation(
+                    "degrade-monotone",
+                    f"chain {chain}: degraded {t['from']} -> {t['to']} "
+                    f"with reason {t['reason']!r} (only failures may move "
+                    f"the ladder down)"))
+            if t["to"] < t["from"]:
+                if t["reason"] != "probe-success":
+                    out.append(Violation(
+                        "degrade-monotone",
+                        f"chain {chain}: recovered {t['from']} -> {t['to']} "
+                        f"with reason {t['reason']!r} (only probe successes "
+                        f"may move the ladder up)"))
+                if t["from"] - t["to"] != 1:
+                    out.append(Violation(
+                        "degrade-monotone",
+                        f"chain {chain}: recovery {t['from']} -> {t['to']} "
+                        f"skipped rungs (recovery is one probe, one rung)"))
+            rung = t["to"]
+        if not broken and rung != ev["final_rung"]:
+            out.append(Violation(
+                "degrade-monotone",
+                f"chain {chain}: ledger ends at rung {rung} but final rung "
+                f"is {ev['final_rung']}"))
+    return out
+
+
 def check_all(op, cloud, token_launches=None,
-              consolidation_actions=None) -> "list[Violation]":
+              consolidation_actions=None,
+              resilience=None) -> "list[Violation]":
     out = []
     out += check_token_ledger(token_launches or {})
     out += check_bijection(op, cloud)
     out += check_binds(op)
     out += check_termination_terminal(op, cloud)
     out += check_consolidation_cost(consolidation_actions or [])
+    out += check_breaker_discipline(resilience)
+    out += check_retry_budget(resilience)
+    out += check_degrade_monotone(resilience)
     return out

@@ -180,6 +180,24 @@ class FaultPlan:
             faults[site] = per
         return cls(seed, scenario, faults)
 
+    @classmethod
+    def burst(cls, seed: int, scenario: int = 0) -> "FaultPlan":
+        """The resilience-plane acceptance scenario: a dense cloud-5xx
+        burst (every cloud site fails its first 8 calls — enough
+        consecutive failures to trip the cloud breaker and drain real
+        retry-budget tokens) plus a solver-crash window (first 6 solves —
+        enough to walk the solve ladder down). The schedule is fixed by
+        construction; the seed only varies the derived workload."""
+        faults: "dict[str, dict[int, FaultSpec]]" = {}
+        for site in ("cloud.create_fleet", "cloud.describe",
+                     "cloud.terminate"):
+            faults[site] = {i: FaultSpec(site, i, KIND_CLOUD_5XX)
+                            for i in range(8)}
+        faults["solver.solve"] = {
+            i: FaultSpec("solver.solve", i, KIND_SOLVER_CRASH)
+            for i in range(6)}
+        return cls(seed, scenario, faults)
+
     def at(self, site: str, index: int) -> "FaultSpec | None":
         per = self.faults.get(site)
         if per is None:

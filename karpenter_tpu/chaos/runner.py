@@ -65,12 +65,18 @@ class ChaosRunner:
     CYCLE_SECONDS = 30.0
 
     def __init__(self, seed: int, scenarios: int = 1, wire: bool = False,
-                 intensity: float = 1.0, out_dir: "str | None" = None):
+                 intensity: float = 1.0, out_dir: "str | None" = None,
+                 burst: bool = False):
         self.seed = seed
         self.scenarios = scenarios
         self.wire = wire
         self.intensity = intensity
         self.out_dir = out_dir
+        # burst mode swaps the sampled schedule for FaultPlan.burst — the
+        # dense cloud-5xx + solver-crash window that exercises the
+        # resilience plane (breakers, budgets, ladders) hard enough for
+        # its invariants to have teeth
+        self.burst = burst
         # diagnostics bundles auto-dumped by failed scenarios (volatile:
         # paths depend on out_dir, so they live at the artifact top level,
         # never inside a scenario dict)
@@ -168,11 +174,17 @@ class ChaosRunner:
     # -- one scenario ----------------------------------------------------------
 
     def run_scenario(self, scenario: int) -> dict:
-        plan = FaultPlan.from_seed(self.seed, scenario,
-                                   wire=False, intensity=self.intensity)
+        if self.burst:
+            plan = FaultPlan.burst(self.seed, scenario)
+        else:
+            plan = FaultPlan.from_seed(self.seed, scenario,
+                                       wire=False, intensity=self.intensity)
         injector = ChaosInjector(plan)
         clock = FakeClock()
         op, cloud = self._build(clock)
+        # retry backoffs must advance the FAKE clock: a real time.sleep
+        # under FakeClock would deadlock the single-threaded drive
+        op.resilience.use_virtual_sleep()
         workload = self._workload(plan)
         errors: "list[str]" = []
         try:
@@ -210,10 +222,15 @@ class ChaosRunner:
                 if self._quiescent(op):
                     break
 
+            # resilience-plane evidence (breaker ledgers, budget water
+            # marks, ladder transitions) — captured before stop() and fed
+            # to the structural invariants
+            resilience_evidence = op.resilience.evidence()
             violations = invariants.check_all(
                 op, cloud,
                 token_launches=injector.token_launches,
-                consolidation_actions=injector.consolidation_actions)
+                consolidation_actions=injector.consolidation_actions,
+                resilience=resilience_evidence)
             if not self._quiescent(op):
                 violations = [invariants.Violation(
                     "quiescence",
@@ -253,6 +270,7 @@ class ChaosRunner:
             "consolidation_actions": len(injector.consolidation_actions),
             "settle_cycles": settle_cycles,
             "final_nodes": len(op.cluster.nodes),
+            "resilience": resilience_evidence,
             "violations": [v.as_dict() for v in violations],
             "passed": not violations,
         }
@@ -267,6 +285,7 @@ class ChaosRunner:
         artifact = {
             "tool": "karpenter_tpu.chaos",
             "seed": self.seed,
+            "burst": self.burst,
             "scenario_count": self.scenarios,
             "fault_kinds": kinds,
             "layers": sorted({LAYER_OF_KIND[k] for k in kinds}),
@@ -279,8 +298,9 @@ class ChaosRunner:
         }
         if self.out_dir:
             os.makedirs(self.out_dir, exist_ok=True)
+            stem = "chaos_burst" if self.burst else "chaos"
             path = os.path.join(self.out_dir,
-                                f"chaos_seed{self.seed}.json")
+                                f"{stem}_seed{self.seed}.json")
             with open(path, "w") as f:
                 json.dump(artifact, f, indent=2, sort_keys=True)
             artifact["artifact_path"] = path

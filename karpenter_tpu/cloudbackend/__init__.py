@@ -65,10 +65,17 @@ class CloudSession:
     """
 
     def __init__(self, endpoint: str, region: str = "",
-                 retries: int = DEFAULT_RETRIES, timeout_s: float = 10.0):
+                 retries: int = DEFAULT_RETRIES, timeout_s: float = 10.0,
+                 clock=None, policy=None):
         self.endpoint = endpoint.rstrip("/")
         self.retries = retries
         self.timeout_s = timeout_s
+        # resilience hooks: with a RetryPolicy, replays are budget-gated and
+        # backoff is jittered + clock-injectable; without one, the legacy
+        # linear backoff runs through the (injectable) clock so tests and the
+        # chaos plane never touch the wall clock
+        self.clock = clock
+        self.policy = policy
         self.region = (region or os.environ.get("KARPENTER_TPU_REGION")
                        or self._discover_region())
         self.check_connectivity()
@@ -79,6 +86,12 @@ class CloudSession:
         """POST /api/<action>; retry transient failures (connection errors
         and 5xx) with linear backoff; rehydrate structured cloud errors."""
         body = json.dumps(payload).encode()
+        pol = self.policy
+        if pol is not None and pol.breaker is not None \
+                and not pol.breaker.allow():
+            pol.retries_total.inc(dep=pol.dep, outcome="breaker_open")
+            raise ConnectivityError(
+                f"{action} rejected: cloud circuit breaker open")
         last: "Exception | None" = None
         for attempt in range(self.retries + 1):
             req = urllib.request.Request(
@@ -88,19 +101,38 @@ class CloudSession:
                          "X-Region": self.region or ""})
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-                    return json.loads(r.read() or b"{}")
+                    doc = json.loads(r.read() or b"{}")
+                    if pol is not None:
+                        pol.note_success()
+                    return doc
             except urllib.error.HTTPError as e:
                 data = e.read()
                 if e.code >= 500:  # transient server side: retry
                     last = e
                 else:
+                    # a structured error IS a live server: no breaker hit
                     raise _rehydrate_error(data) from None
             except (urllib.error.URLError, TimeoutError, OSError) as e:
                 last = e
+            if pol is not None:
+                pol.note_failure()
             if attempt < self.retries:
-                time.sleep(RETRY_BACKOFF_S * (attempt + 1))
+                if pol is not None:
+                    if not pol.try_retry():
+                        break  # budget exhausted: give up now
+                    pol.sleep_backoff()
+                else:
+                    self._sleep(RETRY_BACKOFF_S * (attempt + 1))
+        if pol is not None:
+            pol.retries_total.inc(dep=pol.dep, outcome="give_up")
         raise ConnectivityError(
             f"{action} failed after {self.retries + 1} attempts: {last}")
+
+    def _sleep(self, seconds: float) -> None:
+        if self.clock is not None:
+            self.clock.sleep(seconds)
+        else:
+            time.sleep(seconds)
 
     def _discover_region(self) -> str:
         """Metadata-service region discovery (IMDS analogue)."""
@@ -222,8 +254,10 @@ class HttpCloud:
 
 
 def connect(endpoint: str, region: str = "",
-            retries: int = DEFAULT_RETRIES) -> HttpCloud:
+            retries: int = DEFAULT_RETRIES, clock=None,
+            policy=None) -> HttpCloud:
     """Bootstrap a session (region discovery + connectivity dry-run) and
     return the drop-in cloud client. Raises ConnectivityError at boot the
     way the reference's NewOrDie is fatal (context.go:53)."""
-    return HttpCloud(CloudSession(endpoint, region=region, retries=retries))
+    return HttpCloud(CloudSession(endpoint, region=region, retries=retries,
+                                  clock=clock, policy=policy))

@@ -41,9 +41,10 @@ class CloudProvider:
     """Object tree mirrors cloudprovider.New (cloudprovider.go:76-109)."""
 
     def __init__(self, cloud, settings: Settings, source_catalog: Catalog,
-                 clock=None):
+                 clock=None, resilience=None):
         self.cloud = cloud
         self.settings = settings
+        self.resilience = resilience
         self.ice = UnavailableOfferings(clock=clock)
         self.subnets = SubnetProvider(cloud, clock=clock)
         self.security_groups = SecurityGroupProvider(cloud, clock=clock)
@@ -51,9 +52,11 @@ class CloudProvider:
             (t.name, o.capacity_type, o.zone): o.price
             for t in source_catalog.types for o in t.offerings
         }
-        self.pricing = PricingProvider(cloud, clock=clock,
-                                       isolated=settings.isolated_vpc,
-                                       static_prices=static_prices)
+        self.pricing = PricingProvider(
+            cloud, clock=clock, isolated=settings.isolated_vpc,
+            static_prices=static_prices,
+            policy=(resilience.policy("pricing") if resilience else None),
+            ladder=(resilience.ladder("pricing") if resilience else None))
         self.images = ImageProvider(cloud, clock=clock)
         self.launch_templates = LaunchTemplateProvider(
             cloud, self.images, settings, clock=clock,
@@ -61,7 +64,8 @@ class CloudProvider:
         self.instance_types = InstanceTypeProvider(
             source_catalog, self.ice, self.subnets, settings=settings)
         self.instances = InstanceProvider(
-            cloud, settings, self.launch_templates, self.subnets, self.ice)
+            cloud, settings, self.launch_templates, self.subnets, self.ice,
+            policy=(resilience.policy("cloud") if resilience else None))
         self.nodetemplates: "dict[str, NodeTemplate]" = {}
         # zone-fold memos (constrain_to_template_zones): strong refs so
         # identity checks can't alias recycled objects

@@ -23,6 +23,7 @@ from ..models.cluster import ClusterState
 from ..introspect.watchdog import cycle as _wd_cycle
 from ..ops.consolidate import run_consolidation
 from ..oracle.consolidation import find_consolidation
+from ..resilience import DegradeLadder, deadline
 from ..tracing import TRACER
 from ..utils.clock import Clock
 from .termination import TerminationController
@@ -39,6 +40,7 @@ class DeprovisioningController:
     # pending, a short settle window otherwise.
     STABILIZATION_PENDING_S = 300.0
     STABILIZATION_S = 30.0
+    CYCLE_BUDGET_S = deadline.DEFAULT_CYCLE_BUDGET_S
 
     def __init__(self, kube, cloudprovider, cluster: ClusterState,
                  termination: TerminationController,
@@ -48,7 +50,8 @@ class DeprovisioningController:
                  use_tpu_solver: bool = True,
                  provisioning=None,
                  remote_consolidator=None,
-                 watchdog=None):
+                 watchdog=None,
+                 resilience=None):
         self.kube = kube
         self.watchdog = watchdog
         self.cloudprovider = cloudprovider
@@ -64,6 +67,13 @@ class DeprovisioningController:
         # has no chip in the deployed split; in-process stays the fallback.
         self.remote_consolidator = remote_consolidator
         reg = registry or REGISTRY
+        # the remote->tpu->oracle search chain as an explicit DegradeLadder
+        # (sticky + probed recovery) instead of per-cycle try/excepts
+        self.consolidate_ladder = (
+            resilience.ladder("consolidate") if resilience is not None
+            else DegradeLadder("consolidate", ("remote", "tpu", "oracle"),
+                               clock=self.clock, recorder=self.recorder,
+                               registry=reg))
         self.actions = reg.counter(
             f"{NAMESPACE}_deprovisioning_actions_performed_total",
             "Deprovisioning actions.", ("action",))
@@ -201,58 +211,77 @@ class DeprovisioningController:
         all_provs = self.cloudprovider.constrain_to_template_zones(
             sorted(self.kube.provisioners(), key=lambda p: (-p.weight, p.name)),
             catalog)
-        method = "tpu" if self.use_tpu_solver else "oracle"
         # only nodes of consolidation-enabled provisioners may be candidates
         # (pre-search: a vetoed node must not shadow the next-best action)
         cand_filter = lambda n: n.provisioner_name in eligible_provs
         import time as _time
 
-        t0 = _time.perf_counter()
-        action = None
-        remote_done = False
-        if self.remote_consolidator is not None:
+        def run_remote():
             from ..oracle.consolidation import eligible
 
             eligible_names = {
                 name for name, n in cluster.nodes.items()
                 if cand_filter(n) and eligible(n, cluster)}
-            try:
-                action = self.remote_consolidator(
-                    cluster, catalog, all_provs, eligible_names,
-                    self.clock.now())
-                method = "remote"
-                remote_done = True
-            except Exception as e:
-                log.warning("remote consolidation failed (%s); "
-                            "in-process fallback", e)
-        try:
-            if remote_done:
-                pass
-            elif self.use_tpu_solver:
-                action = run_consolidation(cluster, catalog, all_provs,
-                                           now=self.clock.now(),
-                                           candidate_filter=cand_filter)
-            else:
-                raise RuntimeError("oracle requested")
-        except Exception as e:
-            if self.use_tpu_solver:
-                log.warning("TPU consolidation failed (%s); oracle fallback", e)
-            method = "oracle"
+            return self.remote_consolidator(
+                cluster, catalog, all_provs, eligible_names,
+                self.clock.now())
+
+        def run_tpu():
+            return run_consolidation(cluster, catalog, all_provs,
+                                     now=self.clock.now(),
+                                     candidate_filter=cand_filter)
+
+        def run_oracle():
             from ..oracle.consolidation import find_multi_consolidation
 
             # mechanism order matches the reference (multi before single,
             # deprovisioning.md:74-77); sequential pair simulation is
             # O(pairs) scheduler runs, so cap hard (8 candidates -> <=28)
             # on this fallback path
-            action = find_multi_consolidation(
+            a = find_multi_consolidation(
                 cluster, catalog, all_provs, now=self.clock.now(),
                 max_candidates=8, candidate_filter=cand_filter)
-            if action is None:
-                action = find_consolidation(cluster, catalog, all_provs,
-                                            now=self.clock.now(),
-                                            candidate_filter=cand_filter)
-        self.eval_duration.observe(_time.perf_counter() - t0, method=method)
-        TRACER.annotate(routing=method)  # which search backend actually ran
+            if a is None:
+                a = find_consolidation(cluster, catalog, all_provs,
+                                       now=self.clock.now(),
+                                       candidate_filter=cand_filter)
+            return a
+
+        # rung index -> configured backend; None marks rungs this deployment
+        # doesn't have (no solver sidecar / oracle-only mode) — they are
+        # skipped without being judged by the ladder
+        chain = [
+            ("remote", run_remote if self.remote_consolidator is not None
+             else None),
+            ("tpu", run_tpu if self.use_tpu_solver else None),
+            ("oracle", run_oracle),
+        ]
+        ladder = self.consolidate_ladder
+        start = ladder.start_rung()
+        if chain[start][1] is None:
+            ladder.abort_probe()  # probing an unconfigured rung judges nothing
+            start = next(i for i in range(start, len(chain))
+                         if chain[i][1] is not None)
+        t0 = _time.perf_counter()
+        action = None
+        method = None
+        for rung in range(start, len(chain)):
+            name, fn = chain[rung]
+            if fn is None:
+                continue
+            try:
+                action = fn()
+            except Exception as e:
+                log.warning("%s consolidation failed (%s); degrading",
+                            name, e)
+                ladder.record_failure(rung)
+                continue
+            method = name
+            ladder.record_success(rung)
+            break
+        self.eval_duration.observe(_time.perf_counter() - t0,
+                                   method=method or "oracle")
+        TRACER.annotate(routing=method or "none")  # backend that actually ran
         if action is None:
             return None
         nodes = [self.cluster.nodes.get(n) for n in action.nodes]
@@ -461,7 +490,8 @@ class DeprovisioningController:
 
     def reconcile_once(self):
         with _wd_cycle(self.watchdog, "deprovisioning"):
-            return self._reconcile_once()
+            with deadline.cycle(self.clock, self.CYCLE_BUDGET_S):
+                return self._reconcile_once()
 
     def _reconcile_once(self):
         """Full deprovisioning pass in reference priority order."""

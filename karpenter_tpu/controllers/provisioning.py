@@ -31,6 +31,7 @@ from ..models.pod import PodSpec
 from ..models.requirements import IncompatibleError, Requirement, Requirements, OP_IN
 from ..oracle.scheduler import Scheduler
 from ..introspect.watchdog import cycle as _wd_cycle
+from ..resilience import DegradeLadder, deadline
 from ..solver.core import NativeSolver, SolveResult, TPUSolver
 from ..tracing import TRACER
 from ..utils.clock import Clock
@@ -39,6 +40,11 @@ log = logging.getLogger("karpenter.provisioning")
 
 
 class ProvisioningController:
+    # one deadline budget per reconcile cycle; downstream calls (solver RPC,
+    # batched cloud ops) check the REMAINING budget instead of stacking
+    # their own timeouts
+    CYCLE_BUDGET_S = deadline.DEFAULT_CYCLE_BUDGET_S
+
     def __init__(
         self,
         kube,
@@ -51,6 +57,7 @@ class ProvisioningController:
         solver_factory=None,
         launch_workers: int = 10,
         watchdog=None,
+        resilience=None,
     ):
         self.kube = kube
         self.watchdog = watchdog
@@ -93,6 +100,15 @@ class ProvisioningController:
         # KARPENTER_TPU_ROUTE_CROSSOVER.
         from ..utils.capture import route_crossover
         self.route_threshold = route_crossover()
+        # the solver->native->oracle chain as an explicit DegradeLadder:
+        # sticky rung + recovery probes replace per-cycle re-trying of a
+        # broken best backend (shared with the hub when the operator wires
+        # one; standalone controllers get a private ladder)
+        self.solve_ladder = (
+            resilience.ladder("solve") if resilience is not None
+            else DegradeLadder("solve", ("primary", "fallback", "oracle"),
+                               clock=self.clock, recorder=self.recorder,
+                               registry=reg))
         self.last_solver_kind: "Optional[str]" = None
         self._machine_seq = 0
         # per-process machine-name suffix: two HA replicas sharing one store
@@ -155,7 +171,8 @@ class ProvisioningController:
 
     def reconcile_once(self, pods: "Optional[list[PodSpec]]" = None) -> "Optional[SolveResult]":
         with _wd_cycle(self.watchdog, "provisioning"):
-            return self._reconcile_once(pods)
+            with deadline.cycle(self.clock, self.CYCLE_BUDGET_S):
+                return self._reconcile_once(pods)
 
     def _reconcile_once(self, pods: "Optional[list[PodSpec]]" = None) -> "Optional[SolveResult]":
         pods = self.kube.pending_pods() if pods is None else pods
@@ -264,13 +281,36 @@ class ProvisioningController:
         small = self.route_threshold is None or len(pods) < self.route_threshold
         order = [("native", run_native), ("tpu", run_primary)] if small \
             else [("tpu", run_primary), ("native", run_native)]
-        for kind, fn in order:
+        # the ladder maps rung index -> position in the routing order
+        # (0 = preferred backend, 1 = other backend, 2 = scalar oracle);
+        # a degraded ladder skips straight past known-broken rungs and only
+        # re-tries them on its scheduled recovery probes
+        ladder = self.solve_ladder
+        start = ladder.start_rung()
+        dl = deadline.current()
+        for rung in range(start, len(order)):
+            kind, fn = order[rung]
+            if dl is not None and dl.expired():
+                # deadline exhaustion mid-chain: the remaining budget can't
+                # absorb another backend failure — shed straight to the
+                # in-process oracle (no ladder movement: the backends didn't
+                # fail, we just ran out of cycle budget)
+                log.warning("reconcile deadline exhausted before %s solve; "
+                            "falling through to oracle", kind)
+                ladder.abort_probe()
+                break
             try:
-                return fn(), kind
+                result = fn()
             except Exception as e:
                 log.warning("%s solver failed (%s); degrading", kind, e)
-        return self._oracle_solve(catalog, provisioners, pods,
-                                  existing, overhead), "oracle"
+                ladder.record_failure(rung)
+                continue
+            ladder.record_success(rung)
+            return result, kind
+        result = self._oracle_solve(catalog, provisioners, pods,
+                                    existing, overhead)
+        ladder.record_success(len(order))
+        return result, "oracle"
 
     def _oracle_solve(self, catalog, provisioners, pods, existing, overhead):
         sched = Scheduler(catalog, provisioners, overhead)

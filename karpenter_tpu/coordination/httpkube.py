@@ -170,6 +170,13 @@ class HttpKubeStore:
         self._netloc = split.netloc
         self._https = split.scheme == "https"
         self._pool_local = threading.local()
+        # resilience.RetryPolicy for the kube-apiserver edge (operator wires
+        # it): the transparent reconnect retry below spends from its budget
+        # and every unreachable outcome feeds its breaker
+        self._policy = None
+
+    def set_resilience(self, policy) -> None:
+        self._policy = policy
 
     @classmethod
     def from_kubeconfig(cls, path: str, **kw) -> "HttpKubeStore":
@@ -275,13 +282,33 @@ class HttpKubeStore:
             headers["Authorization"] = f"Bearer {self.token}"
         split = urllib.parse.urlsplit(url)
         path = split.path + (f"?{split.query}" if split.query else "")
+        pol = self._policy
+        if pol is not None and pol.breaker is not None \
+                and not pol.breaker.allow():
+            # apiserver known-down: fail fast instead of burning a connect
+            # timeout per call (the breaker's half-open probe lets ONE call
+            # through per recovery window)
+            pol.retries_total.inc(dep=pol.dep, outcome="breaker_open")
+            self.requests_total.inc(method=method, outcome="breaker_open")
+            raise ApiError(0, "apiserver circuit breaker open")
+
+        def _note_failure():
+            if pol is not None:
+                pol.note_failure()
+
+        def _retry_ok():
+            # the transparent reconnect retry also spends a budget token —
+            # a flapping apiserver can't be retried into a storm
+            return pol is None or pol.try_retry()
+
         for attempt in (0, 1):
             try:
                 conn, fresh = self._pooled_conn()
             except (http.client.HTTPException, ConnectionError, OSError) as e:
                 # connect-phase failure: nothing was sent, retrying any
                 # method is safe; exhausted -> the documented contract
-                if attempt == 0:
+                _note_failure()
+                if attempt == 0 and _retry_ok():
                     continue
                 self.requests_total.inc(method=method, outcome="unreachable")
                 raise ApiError(0, f"apiserver unreachable: {e}")
@@ -295,9 +322,10 @@ class HttpKubeStore:
                 # here still means nothing was delivered, but stay
                 # conservative and exclude it for writes.
                 self._drop_pooled_conn()
+                _note_failure()
                 retriable = (method == "GET"
                              or (not fresh and not isinstance(e, TimeoutError)))
-                if attempt == 0 and retriable:
+                if attempt == 0 and retriable and _retry_ok():
                     continue
                 self.requests_total.inc(method=method, outcome="unreachable")
                 raise ApiError(0, f"apiserver unreachable: {e}")
@@ -318,15 +346,21 @@ class HttpKubeStore:
                 # request was in flight — it never read it), so one replay of
                 # a write is safe.
                 self._drop_pooled_conn()
+                _note_failure()
                 retriable = (method == "GET"
                              or (not fresh
                                  and isinstance(e, http.client.RemoteDisconnected)))
-                if attempt == 0 and retriable:
+                if attempt == 0 and retriable and _retry_ok():
                     continue
                 self.requests_total.inc(method=method, outcome="unreachable")
                 raise ApiError(0, f"apiserver unreachable: {e}")
             if resp.will_close:
                 self._drop_pooled_conn()
+            # ANY response means the apiserver is alive: 4xx/409 are
+            # business outcomes, not dependency failures — the breaker and
+            # budget only ever see transport-level unreachability
+            if pol is not None:
+                pol.note_success()
             if resp.status == 409:
                 self.requests_total.inc(method=method, outcome="conflict")
                 raise Conflict(payload.decode(errors="replace")[:300])

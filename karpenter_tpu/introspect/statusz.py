@@ -23,7 +23,7 @@ from ..metrics import REGISTRY, Gauge, Histogram
 
 log = logging.getLogger("karpenter.statusz")
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2  # 2: added the "resilience" section (breakers/budgets/ladders)
 
 # hard caps so a pathological operator can't make statusz unbounded
 MAX_EVENTS = 50
@@ -151,5 +151,6 @@ def snapshot(op) -> dict:
         "queues": _fenced(lambda: _queue_section(op)),
         "caches": _fenced(lambda: _cache_section(op)),
         "events": _fenced(lambda: _events_section(op)),
+        "resilience": _fenced(lambda: op.resilience.snapshot()),
         "metrics": _fenced(_metrics_section),
     }

@@ -29,6 +29,7 @@ from .events import EventRecorder
 from .introspect import FlightRecorder, Watchdog
 from .leaderelection import LeaderElector
 from .metrics import REGISTRY, decorate_cloudprovider
+from .resilience import ResilienceHub
 from .models.cluster import ClusterState
 from .models.instancetype import Catalog
 from .fake.kube import KubeStore
@@ -88,7 +89,17 @@ class Operator:
         self._event_seq = 0
         self._event_suffix = _uuid.uuid4().hex[:5]  # HA replicas can't collide
         self._event_lock = threading.Lock()  # recorder is shared by 7 threads
-        self.cloudprovider = CloudProvider(cloud, settings, catalog, clock=self.clock)
+        # resilience plane: ONE hub per operator — breakers/budgets/ladders
+        # are shared by every call path touching the same dependency
+        # (docs/designs/resilience.md)
+        self.resilience = ResilienceHub(clock=self.clock,
+                                        recorder=self.recorder)
+        set_res = getattr(self.kube, "set_resilience", None)
+        if callable(set_res):
+            set_res(self.resilience.policy("kube"))
+        self.cloudprovider = CloudProvider(cloud, settings, catalog,
+                                           clock=self.clock,
+                                           resilience=self.resilience)
         self.metrics_cloudprovider = decorate_cloudprovider(self.cloudprovider)
         # introspection plane: deadman watchdog on the injected clock; every
         # controller below takes it and wraps its reconcile cycle
@@ -126,7 +137,8 @@ class Operator:
         self.provisioning = ProvisioningController(
             self.kube, self.cloudprovider, self.cluster, settings,
             clock=self.clock, recorder=self.recorder,
-            solver_factory=solver_factory, watchdog=self.watchdog)
+            solver_factory=solver_factory, watchdog=self.watchdog,
+            resilience=self.resilience)
         self.termination = TerminationController(
             self.kube, self.cloudprovider, self.cluster,
             clock=self.clock, recorder=self.recorder,
@@ -144,7 +156,8 @@ class Operator:
 
             def remote_consolidator(cluster, catalog, provisioners,
                                     eligible_names, now,
-                                    _target=solver_target):
+                                    _target=solver_target,
+                                    _hub=self.resilience):
                 from .solver import wire
                 from .solver.client import RemoteSolver
 
@@ -154,14 +167,15 @@ class Operator:
                 if rs is None:
                     _rc_cache.clear()  # one live entry; catalogs don't coexist
                     rs = _rc_cache[key] = RemoteSolver(
-                        catalog, provisioners, target=_target)
+                        catalog, provisioners, target=_target,
+                        resilience=_hub)
                 return rs.consolidate(cluster, eligible_names, now=now)
         self.deprovisioning = DeprovisioningController(
             self.kube, self.cloudprovider, self.cluster, self.termination,
             clock=self.clock, recorder=self.recorder,
             provisioning=self.provisioning,
             remote_consolidator=remote_consolidator,
-            watchdog=self.watchdog)
+            watchdog=self.watchdog, resilience=self.resilience)
         self.nodetemplate = NodeTemplateController(
             self.kube, self.cloudprovider.subnets,
             self.cloudprovider.security_groups, clock=self.clock,
@@ -438,11 +452,16 @@ class Operator:
         standby would be restarted by its probe right when it matters."""
         if self.leader_elect and not self.elected.is_set():
             return True, "ok (standby)"
+        # open breakers are degradation, not death: readiness stays true
+        # (restarting the pod wouldn't heal the dependency) but the detail
+        # names them so the probe's failure story is one curl away
+        open_brk = self.resilience.open_breakers()
+        brk = f" (breakers open: {', '.join(open_brk)})" if open_brk else ""
         stalled = self.watchdog.check()
         if stalled:
             return False, ("unhealthy: stalled controllers: "
-                           + ", ".join(stalled))
-        return True, "ok"
+                           + ", ".join(stalled) + brk)
+        return True, "ok" + brk
 
     def livez(self) -> bool:
         return self.cloudprovider.livez()

@@ -55,15 +55,21 @@ class InstanceProvider:
         fleet_batcher: Optional[CreateFleetBatcher] = None,
         describe_batcher: Optional[DescribeInstancesBatcher] = None,
         terminate_batcher: Optional[TerminateInstancesBatcher] = None,
+        policy=None,
     ):
         self.cloud = cloud
         self.settings = settings
         self.launch_templates = launch_templates
         self.subnets = subnets
         self.ice = unavailable_offerings
-        self.fleet = fleet_batcher or CreateFleetBatcher(cloud)
-        self.describe = describe_batcher or DescribeInstancesBatcher(cloud)
-        self.terminate = terminate_batcher or TerminateInstancesBatcher(cloud)
+        # one shared resilience.RetryPolicy for the cloud-API edge: all
+        # three batchers spend from the same retry budget and feed the
+        # same breaker (they ARE the same dependency)
+        self.fleet = fleet_batcher or CreateFleetBatcher(cloud, policy=policy)
+        self.describe = describe_batcher or DescribeInstancesBatcher(
+            cloud, policy=policy)
+        self.terminate = terminate_batcher or TerminateInstancesBatcher(
+            cloud, policy=policy)
 
     # -- create ----------------------------------------------------------------
 

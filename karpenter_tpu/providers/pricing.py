@@ -50,19 +50,38 @@ class RestPricingSource:
     """
 
     def __init__(self, base_url: str, zones: "list[str]",
-                 timeout: float = 10.0, max_pages: int = 100):
+                 timeout: float = 10.0, max_pages: int = 100, policy=None):
         self.base_url = base_url.rstrip("/")
         self.zones = list(zones)
         self.timeout = timeout
         self.max_pages = max_pages
+        # resilience.RetryPolicy for the pricing edge; when set, every PAGE
+        # fetch is individually retried, so one transient 5xx mid-pagination
+        # no longer aborts the whole refresh ("partial outage degrades,
+        # never blanks" must hold WITHIN a refresh, not just across them)
+        self.policy = policy
+
+    def _fetch_page(self, path: str, page: int) -> dict:
+        with urllib.request.urlopen(
+                f"{self.base_url}/{path}?page={page}",
+                timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    @staticmethod
+    def _transient(e: BaseException) -> bool:
+        if isinstance(e, urllib.error.HTTPError):
+            return e.code >= 500
+        return isinstance(e, (urllib.error.URLError, TimeoutError, OSError))
 
     def _fetch_pages(self, path: str) -> "list[dict]":
         out: "list[dict]" = []
         for page in range(self.max_pages):
-            with urllib.request.urlopen(
-                    f"{self.base_url}/{path}?page={page}",
-                    timeout=self.timeout) as resp:
-                doc = json.loads(resp.read())
+            if self.policy is not None:
+                doc = self.policy.call(
+                    lambda path=path, page=page: self._fetch_page(path, page),
+                    retriable=self._transient)
+            else:
+                doc = self._fetch_page(path, page)
             out.extend(doc.get("prices", []))
             if not doc.get("next"):
                 break
@@ -95,10 +114,19 @@ class RestPricingSource:
 class PricingProvider:
     def __init__(self, cloud: PricingSource, clock: Optional[Clock] = None,
                  isolated: bool = False,
-                 static_prices: "Optional[dict[tuple[str, str, str], float]]" = None):
+                 static_prices: "Optional[dict[tuple[str, str, str], float]]" = None,
+                 policy=None, ladder=None):
         self.cloud = cloud
         self.clock = clock or Clock()
         self.isolated = isolated
+        # live->static promoted to an explicit DegradeLadder: rung 0 = live
+        # refreshes, rung 1 = sticky static fallback with recovery probes
+        self.ladder = ladder
+        # a RestPricingSource built without its own policy inherits ours so
+        # page fetches go through the shared pricing-edge budget/breaker
+        if (policy is not None and hasattr(cloud, "policy")
+                and getattr(cloud, "policy") is None):
+            cloud.policy = policy
         self._lock = threading.Lock()
         # static fallback until first refresh (pricing.go:100-116); by default
         # seeded from the generated fleet catalog table
@@ -127,20 +155,31 @@ class PricingProvider:
             return self._prices.get((instance_type, "spot", zone))
 
     def update(self) -> bool:
-        """One refresh cycle (updatePricing, pricing.go:202). Returns success."""
+        """One refresh cycle (updatePricing, pricing.go:202). Returns success.
+        With a ladder wired, a degraded provider STAYS on static prices
+        between recovery probes instead of re-timing-out against a dead
+        endpoint every period."""
         if self.isolated:
             return False
+        if self.ladder is not None and self.ladder.start_rung() > 0:
+            return False  # sticky static rung; next probe re-attempts live
         try:
             fresh = self.cloud.get_prices()
         except Exception as e:
             log.warning("pricing update failed: %s", e)
+            if self.ladder is not None:
+                self.ladder.record_failure(0)
             return False
         if not fresh:
+            if self.ladder is not None:
+                self.ladder.record_failure(0)
             return False
         with self._lock:
             self._prices.update(fresh)
             self._last_update = self.clock.now()
             self._updates += 1
+        if self.ladder is not None:
+            self.ladder.record_success(0)
         return True
 
     def livez(self) -> bool:

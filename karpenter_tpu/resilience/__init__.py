@@ -1,0 +1,130 @@
+"""Resilience plane: retry budgets, circuit breakers, deadlines, ladders.
+
+One clock-injectable subsystem owning every dependency edge's failure
+policy (docs/designs/resilience.md):
+
+- `policy.RetryPolicy` / `RetryBudget` — budgeted, jittered, seeded retries
+- `breaker.CircuitBreaker` — fail-fast state machine per dependency
+- `deadline.DeadlineBudget` — one cycle budget, propagated not stacked
+- `degrade.DegradeLadder` — explicit fallback chains with recovery probes
+
+`ResilienceHub` assembles the per-dependency instances (cloud, kube,
+solver, pricing) plus the three degradation chains and is constructed once
+by the Operator; controllers, providers, batchers and the solver client
+all borrow from it so state (breaker trips, budget levels) is shared
+across every call path touching the same dependency.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils.clock import Clock
+from .breaker import BreakerOpen, CircuitBreaker
+from .deadline import (DEFAULT_CYCLE_BUDGET_S, DeadlineBudget,
+                       DeadlineExceeded)
+from . import deadline
+from .degrade import DegradeLadder
+from .policy import RetryBudget, RetryPolicy
+
+__all__ = [
+    "BreakerOpen", "CircuitBreaker", "DeadlineBudget", "DeadlineExceeded",
+    "DEFAULT_CYCLE_BUDGET_S", "DegradeLadder", "ResilienceHub",
+    "RetryBudget", "RetryPolicy", "deadline",
+]
+
+# (failure_threshold, recovery_time_s, budget_capacity, refill_per_success,
+#  max_attempts) per dependency edge — the solver and pricing edges trip
+# faster: their calls are expensive and both have in-process fallbacks
+_DEP_TUNING = {
+    "cloud":   (5, 30.0, 10.0, 0.2, 3),
+    "kube":    (5, 15.0, 10.0, 0.2, 2),
+    "solver":  (3, 30.0, 5.0, 0.2, 2),
+    "pricing": (3, 60.0, 5.0, 0.2, 3),
+}
+
+_CHAINS = {
+    "solve": ("primary", "fallback", "oracle"),
+    "consolidate": ("remote", "tpu", "oracle"),
+    "pricing": ("live", "static"),
+}
+
+
+class ResilienceHub:
+    DEPS = tuple(_DEP_TUNING)
+    CHAINS = dict(_CHAINS)
+
+    def __init__(self, clock: Optional[Clock] = None, recorder=None,
+                 registry=None, seed: int = 0):
+        self.clock = clock or Clock()
+        self.breakers: "dict[str, CircuitBreaker]" = {}
+        self.budgets: "dict[str, RetryBudget]" = {}
+        self.policies: "dict[str, RetryPolicy]" = {}
+        for dep, (k, recov, cap, refill, attempts) in _DEP_TUNING.items():
+            br = CircuitBreaker(dep, clock=self.clock,
+                                failure_threshold=k, recovery_time=recov,
+                                recorder=recorder, registry=registry)
+            budget = RetryBudget(capacity=cap, refill_per_success=refill)
+            self.breakers[dep] = br
+            self.budgets[dep] = budget
+            self.policies[dep] = RetryPolicy(
+                dep, clock=self.clock, max_attempts=attempts, seed=seed,
+                budget=budget, breaker=br, registry=registry)
+        self.ladders: "dict[str, DegradeLadder]" = {
+            chain: DegradeLadder(chain, rungs, clock=self.clock,
+                                 recorder=recorder, registry=registry)
+            for chain, rungs in _CHAINS.items()
+        }
+
+    def policy(self, dep: str) -> RetryPolicy:
+        return self.policies[dep]
+
+    def breaker(self, dep: str) -> CircuitBreaker:
+        return self.breakers[dep]
+
+    def ladder(self, chain: str) -> DegradeLadder:
+        return self.ladders[chain]
+
+    def use_virtual_sleep(self) -> None:
+        """Chaos/FakeClock mode: backoff sleeps STEP the fake clock instead
+        of blocking on it (nobody else would advance it mid-cycle —
+        a FakeClock sleep would deadlock the single-threaded driver)."""
+        step = getattr(self.clock, "step", None)
+        if step is None:
+            return
+        for p in self.policies.values():
+            p.set_sleep(step)
+
+    def open_breakers(self) -> "list[str]":
+        return sorted(d for d, b in self.breakers.items()
+                      if b.state() != "closed")
+
+    # -- surfaces ----------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The /debug/statusz "resilience" section. The two summary lists
+        lead so an operator staring at a wedged cluster sees "what is
+        broken right now" before the per-dependency detail."""
+        return {
+            "open_breakers": self.open_breakers(),
+            "degraded": sorted(c for c, ld in self.ladders.items()
+                               if ld.rung() > 0),
+            "breakers": {d: b.snapshot()
+                         for d, b in sorted(self.breakers.items())},
+            "budgets": {d: b.evidence()
+                        for d, b in sorted(self.budgets.items())},
+            "ladders": {c: ld.snapshot()
+                        for c, ld in sorted(self.ladders.items())},
+        }
+
+    def evidence(self) -> dict:
+        """Deterministic ledger for chaos scenario dicts (pure function of
+        the seed under FakeClock + virtual sleep)."""
+        return {
+            "breakers": {d: b.evidence()
+                         for d, b in sorted(self.breakers.items())},
+            "policies": {d: p.evidence()
+                         for d, p in sorted(self.policies.items())},
+            "ladders": {c: ld.evidence()
+                        for c, ld in sorted(self.ladders.items())},
+        }

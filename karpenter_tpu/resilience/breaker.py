@@ -1,0 +1,199 @@
+"""Per-dependency circuit breakers: closed -> open -> half-open probes.
+
+One breaker per dependency edge (cloud API, kube apiserver, solver
+sidecar, pricing endpoint). K consecutive failures while closed trip it
+open; while open every call fails fast (no socket, no timeout burn).
+After `recovery_time` ONE half-open probe is admitted at a time;
+`success_threshold` consecutive probe successes close it again, any probe
+failure re-opens and re-arms the recovery timer (hysteresis — a flapping
+dependency stays open, it does not oscillate per call).
+
+Transitions are edge-triggered events (`BreakerOpened` / `BreakerClosed`)
+through the shared EventRecorder and a `karpenter_resilience_breaker_state`
+gauge (0=closed, 1=open, 2=half-open). The transition ledger feeds the
+chaos *breaker-opens-within-K-consecutive-failures* invariant.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..metrics import NAMESPACE, REGISTRY
+from ..utils.clock import Clock
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+_STATE_VALUE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+
+class BreakerOpen(RuntimeError):
+    """Fail-fast rejection: the dependency's breaker is open."""
+
+    def __init__(self, dep: str):
+        super().__init__(f"circuit breaker for dependency '{dep}' is open")
+        self.dep = dep
+
+
+class CircuitBreaker:
+    def __init__(self, dep: str, clock: Optional[Clock] = None,
+                 failure_threshold: int = 5, recovery_time: float = 30.0,
+                 success_threshold: int = 2, recorder=None, registry=None):
+        self.dep = dep
+        self.clock = clock or Clock()
+        self.failure_threshold = max(1, failure_threshold)
+        self.recovery_time = recovery_time
+        self.success_threshold = max(1, success_threshold)
+        self.recorder = recorder
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._probe_successes = 0
+        self._opened_at: Optional[float] = None
+        self._probe_in_flight = False
+        # evidence for the chaos invariant: the longest failure streak ever
+        # observed while closed must never exceed failure_threshold
+        self.max_closed_streak = 0
+        self.opened_total = 0
+        self.closed_total = 0
+        self.rejected_total = 0
+        self.transitions: "list[dict]" = []
+        reg = registry if registry is not None else REGISTRY
+        self._gauge = reg.gauge(
+            f"{NAMESPACE}_resilience_breaker_state",
+            "Circuit breaker state per dependency "
+            "(0=closed, 1=open, 2=half-open).", ("dep",))
+        self._gauge.set(0, dep=dep)
+
+    # -- admission ---------------------------------------------------------------
+
+    def allow(self) -> bool:
+        """May a call proceed right now? Open breakers admit exactly one
+        probe once the recovery window has elapsed."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            now = self.clock.now()
+            if self._state == OPEN:
+                if (self._opened_at is not None
+                        and now - self._opened_at >= self.recovery_time):
+                    self._transition(HALF_OPEN, "recovery window elapsed")
+                    self._probe_in_flight = True
+                    return True
+                self.rejected_total += 1
+                return False
+            # HALF_OPEN: one probe at a time
+            if self._probe_in_flight:
+                self.rejected_total += 1
+                return False
+            self._probe_in_flight = True
+            return True
+
+    def guard(self, fn):
+        """allow() + record_* around one call; raises BreakerOpen when
+        rejected."""
+        if not self.allow():
+            raise BreakerOpen(self.dep)
+        try:
+            result = fn()
+        except BaseException:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result
+
+    # -- outcome feedback --------------------------------------------------------
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state == HALF_OPEN:
+                self._probe_in_flight = False
+                self._probe_successes += 1
+                if self._probe_successes >= self.success_threshold:
+                    self._transition(
+                        CLOSED,
+                        f"{self._probe_successes} consecutive probe "
+                        "successes")
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == CLOSED:
+                self._consecutive_failures += 1
+                self.max_closed_streak = max(self.max_closed_streak,
+                                             self._consecutive_failures)
+                if self._consecutive_failures >= self.failure_threshold:
+                    self._transition(
+                        OPEN,
+                        f"{self._consecutive_failures} consecutive failures")
+            elif self._state == HALF_OPEN:
+                # failed probe: re-open and re-arm the full recovery window
+                self._probe_in_flight = False
+                self._transition(OPEN, "half-open probe failed")
+
+    # -- state machine internals -------------------------------------------------
+
+    def _transition(self, to: str, why: str) -> None:
+        """Callers hold self._lock."""
+        frm = self._state
+        if frm == to:
+            return
+        self._state = to
+        now = self.clock.now()
+        self.transitions.append(
+            {"ts": round(now, 3), "from": frm, "to": to, "why": why})
+        self._gauge.set(_STATE_VALUE[to], dep=self.dep)
+        if to == OPEN:
+            self._opened_at = now
+            self._probe_successes = 0
+            self.opened_total += 1
+            # edge-triggered: only the closed->open edge warns (the
+            # half-open->open re-trip is the same outage continuing, and
+            # the recorder's dedupe TTL absorbs repeats regardless)
+            if self.recorder is not None and frm == CLOSED:
+                self.recorder.warning(
+                    f"resilience/{self.dep}", "BreakerOpened",
+                    f"{self.dep} circuit opened: {why}")
+        elif to == CLOSED:
+            self._consecutive_failures = 0
+            self._probe_successes = 0
+            self._opened_at = None
+            self.closed_total += 1
+            if self.recorder is not None:
+                self.recorder.normal(
+                    f"resilience/{self.dep}", "BreakerClosed",
+                    f"{self.dep} circuit closed: {why}")
+
+    # -- observability -----------------------------------------------------------
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "failure_threshold": self.failure_threshold,
+                "max_closed_streak": self.max_closed_streak,
+                "opened_total": self.opened_total,
+                "closed_total": self.closed_total,
+                "rejected_total": self.rejected_total,
+                "opened_at": self._opened_at,
+            }
+
+    def evidence(self) -> dict:
+        """Deterministic subset for chaos scenario dicts."""
+        with self._lock:
+            return {
+                "failure_threshold": self.failure_threshold,
+                "max_closed_streak": self.max_closed_streak,
+                "opened_total": self.opened_total,
+                "closed_total": self.closed_total,
+                "rejected_total": self.rejected_total,
+                "final_state": self._state,
+                "transitions": [dict(t) for t in self.transitions],
+            }

@@ -1,0 +1,81 @@
+"""Reconcile-cycle deadline budgets, propagated instead of stacked.
+
+The controller plane's old timeout story was stacked independents: the
+solver client had 10s, every HTTP call had 10s, the batcher had its own
+window — so a cycle could legally burn minutes while every layer was
+individually "within timeout". A DeadlineBudget is created ONCE at the top
+of a controller cycle and every layer below checks *remaining* budget:
+fail fast when it's gone, and ship the remainder across the solver wire
+(`deadline_ms` in solver.proto — the REMAINING milliseconds at send time,
+not an absolute timestamp: the two processes share no clock, and FakeClock
+runs make absolute deadlines meaningless) so the service can shed solves
+whose caller has already given up on the cycle.
+
+Propagation is a thread-local: providers and the solver client consult
+`current()` without threading a parameter through every signature. Launch
+pool threads intentionally do NOT inherit it — an in-flight launch past
+the cycle deadline must complete (half-launched capacity would leak).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+from ..utils.clock import Clock
+
+# a controller cycle's wall budget; generous vs the loop intervals so only
+# genuinely wedged dependencies exhaust it
+DEFAULT_CYCLE_BUDGET_S = 60.0
+
+
+class DeadlineExceeded(RuntimeError):
+    def __init__(self, what: str = "cycle"):
+        super().__init__(f"deadline budget exhausted ({what})")
+        self.what = what
+
+
+class DeadlineBudget:
+    def __init__(self, clock: Optional[Clock] = None,
+                 budget_s: float = DEFAULT_CYCLE_BUDGET_S):
+        self.clock = clock or Clock()
+        self.total = budget_s
+        self._deadline = self.clock.now() + budget_s
+
+    def remaining(self) -> float:
+        return self._deadline - self.clock.now()
+
+    def remaining_ms(self) -> int:
+        return max(0, int(self.remaining() * 1000))
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self, what: str = "cycle") -> None:
+        if self.expired():
+            raise DeadlineExceeded(what)
+
+
+_local = threading.local()
+
+
+def current() -> Optional[DeadlineBudget]:
+    """The active cycle budget on THIS thread (None outside a cycle)."""
+    return getattr(_local, "budget", None)
+
+
+@contextlib.contextmanager
+def cycle(clock: Optional[Clock] = None,
+          budget_s: float = DEFAULT_CYCLE_BUDGET_S):
+    """Install a fresh cycle budget for the duration of one reconcile.
+    Nested cycles keep the OUTER (tighter-scoped callers must not widen
+    an enclosing budget)."""
+    outer = current()
+    budget = outer if outer is not None \
+        else DeadlineBudget(clock=clock, budget_s=budget_s)
+    _local.budget = budget
+    try:
+        yield budget
+    finally:
+        _local.budget = outer

@@ -1,0 +1,147 @@
+"""DegradeLadder: the implicit fallback chains, made explicit and sticky.
+
+The solver->native-packer->scalar-oracle chain and pricing's live->static
+fallback used to be scattered try/excepts: every cycle re-tried the broken
+best rung, paid its full failure latency, and "which backend are we
+actually on" was never observable. A ladder names the rungs (index 0 =
+best), remembers where it is (sticky — no flapping), and climbs back up
+only through scheduled recovery probes:
+
+  start_rung()          -> where this cycle should start attempting
+  record_failure(rung)  -> degrade below the failing rung (event + gauge)
+  record_success(rung)  -> steady state, or promote after a probe success
+
+Recovery is single-step: a probe tries exactly one rung above the current
+one, so a half-healed dependency can't yank the chain all the way up and
+immediately back down. The transition ledger (reason "failure" for every
+down-move, "probe-success" for every up-move) is what the chaos
+*degrade-monotone-during-fault-window* invariant audits.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from ..metrics import NAMESPACE, REGISTRY
+from ..utils.clock import Clock
+
+
+class DegradeLadder:
+    def __init__(self, chain: str, rungs: Sequence[str],
+                 clock: Optional[Clock] = None, recorder=None,
+                 registry=None, probe_interval_s: float = 120.0):
+        if len(rungs) < 2:
+            raise ValueError("a ladder needs at least two rungs")
+        self.chain = chain
+        self.rungs = tuple(rungs)
+        self.clock = clock or Clock()
+        self.recorder = recorder
+        self.probe_interval_s = probe_interval_s
+        self._lock = threading.Lock()
+        self._rung = 0
+        self._probing = False
+        self._since: Optional[float] = None  # last degrade/probe timestamp
+        self.probes_total = 0
+        self.transitions: "list[dict]" = []
+        reg = registry if registry is not None else REGISTRY
+        self._gauge = reg.gauge(
+            f"{NAMESPACE}_resilience_degrade_rung",
+            "Current rung per degradation chain (0 = best).", ("chain",))
+        self._gauge.set(0, chain=chain)
+
+    # -- per-cycle routing -------------------------------------------------------
+
+    def start_rung(self) -> int:
+        """Rung to start attempts at this cycle. Sticky while degraded;
+        when a probe is due, admit ONE attempt a single rung up."""
+        with self._lock:
+            if self._rung == 0:
+                return 0
+            now = self.clock.now()
+            if (not self._probing and self._since is not None
+                    and now - self._since >= self.probe_interval_s):
+                self._probing = True
+                self._since = now
+                self.probes_total += 1
+                return self._rung - 1
+            return self._rung
+
+    def record_failure(self, rung: int) -> None:
+        with self._lock:
+            if self._probing and rung == self._rung - 1:
+                # failed probe: stay put, re-arm the probe timer
+                self._probing = False
+                self._since = self.clock.now()
+                return
+            if rung >= self._rung and rung + 1 < len(self.rungs):
+                self._move(rung + 1, "failure")
+
+    def abort_probe(self) -> None:
+        """A probe admitted by start_rung() that never actually ran (e.g.
+        the cycle deadline expired first): re-arm the timer without judging
+        the rung either way."""
+        with self._lock:
+            if self._probing:
+                self._probing = False
+                self._since = self.clock.now()
+
+    def record_success(self, rung: int) -> None:
+        with self._lock:
+            if self._probing and rung == self._rung - 1:
+                self._probing = False
+                self._move(rung, "probe-success")
+            # success at or below the current rung is steady state; success
+            # ABOVE it without a probe (caller skipped rungs on its own,
+            # e.g. no remote consolidator configured) never promotes
+
+    # -- internals ---------------------------------------------------------------
+
+    def _move(self, to: int, reason: str) -> None:
+        """Callers hold self._lock."""
+        frm = self._rung
+        if to == frm:
+            return
+        self._rung = to
+        now = self.clock.now()
+        self._since = now
+        self.transitions.append({"ts": round(now, 3), "from": frm,
+                                 "to": to, "reason": reason})
+        self._gauge.set(to, chain=self.chain)
+        if self.recorder is not None:
+            if to > frm:
+                self.recorder.warning(
+                    f"resilience/{self.chain}", "DegradedTo",
+                    f"{self.chain} chain degraded "
+                    f"{self.rungs[frm]} -> {self.rungs[to]}")
+            else:
+                self.recorder.normal(
+                    f"resilience/{self.chain}", "RecoveredTo",
+                    f"{self.chain} chain recovered "
+                    f"{self.rungs[frm]} -> {self.rungs[to]}")
+
+    # -- observability -----------------------------------------------------------
+
+    def rung(self) -> int:
+        with self._lock:
+            return self._rung
+
+    def rung_name(self) -> str:
+        with self._lock:
+            return self.rungs[self._rung]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"rungs": list(self.rungs),
+                    "current": self.rungs[self._rung],
+                    "current_index": self._rung,
+                    "probing": self._probing,
+                    "probes_total": self.probes_total,
+                    "transitions": len(self.transitions)}
+
+    def evidence(self) -> dict:
+        with self._lock:
+            return {"rungs": list(self.rungs),
+                    "final_rung": self._rung,
+                    "probes_total": self.probes_total,
+                    "transitions": [dict(t) for t in self.transitions]}

@@ -1,0 +1,209 @@
+"""Declarative retry policy + per-dependency retry budget.
+
+Design target: SRE-style overload control (PAPERS.md) — retries are a
+*budgeted* resource, not a free amplifier. A cloud 5xx burst must never
+turn into a retry storm: every retry spends a token from the dependency's
+budget, successes slowly refill it, and an empty bucket turns retries into
+immediate give-ups until the dependency earns trust back.
+
+Determinism contract (the chaos plane replays seeds): backoff jitter comes
+from a seeded splitmix64 PRNG — no `random` module — and sleeping goes
+through an injectable sleep function (the operator's clock by default; the
+chaos runner swaps in FakeClock.step so retries consume *virtual* time and
+never block the single-threaded scenario driver).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..metrics import NAMESPACE, REGISTRY
+from ..utils.clock import Clock
+
+_MASK = (1 << 64) - 1
+
+
+class _SplitMix64:
+    """Tiny seeded PRNG (same generator family as chaos.plan.ChaosRng,
+    duplicated here so resilience never imports the chaos plane)."""
+
+    def __init__(self, seed: int):
+        self._state = seed & _MASK
+
+    def next_u64(self) -> int:
+        self._state = (self._state + 0x9E3779B97F4A7C15) & _MASK
+        z = self._state
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK
+        return z ^ (z >> 31)
+
+    def uniform(self) -> float:
+        return self.next_u64() / float(1 << 64)
+
+
+class RetryBudget:
+    """Token bucket bounding retries per dependency: a retry spends one
+    token, a success refills `refill_per_success` (slowly — earning back
+    a retry takes many successes). The bucket can never go negative and
+    never exceeds capacity; `min_tokens` is the watermark the chaos
+    *retry-budget-never-exceeded* invariant audits."""
+
+    def __init__(self, capacity: float = 10.0,
+                 refill_per_success: float = 0.2):
+        self.capacity = float(capacity)
+        self.refill_per_success = float(refill_per_success)
+        self._tokens = float(capacity)
+        self._lock = threading.Lock()
+        self.spent_total = 0
+        self.denied_total = 0
+        self.min_tokens = float(capacity)
+
+    def try_spend(self) -> bool:
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self.spent_total += 1
+                self.min_tokens = min(self.min_tokens, self._tokens)
+                return True
+            self.denied_total += 1
+            return False
+
+    def refill(self) -> None:
+        with self._lock:
+            self._tokens = min(self.capacity,
+                               self._tokens + self.refill_per_success)
+
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def evidence(self) -> dict:
+        with self._lock:
+            return {"capacity": self.capacity,
+                    "tokens": round(self._tokens, 3),
+                    "min_tokens": round(self.min_tokens, 3),
+                    "spent_total": self.spent_total,
+                    "denied_total": self.denied_total}
+
+
+class RetryPolicy:
+    """Exponential backoff with decorrelated jitter, budget-gated.
+
+    `call(fn)` is the wrap-a-callable form; in-place retry loops that
+    can't be inverted (httpkube's phase-aware loop, cloudbackend's
+    linear replay) use the lower-level `try_retry()` / `sleep_backoff()` /
+    `note_success()` primitives so *every* retry path still spends from
+    the same budget and feeds the same breaker and metrics.
+    """
+
+    def __init__(self, dep: str, clock: Optional[Clock] = None,
+                 base: float = 0.05, cap: float = 5.0,
+                 max_attempts: int = 4, seed: int = 0,
+                 budget: Optional[RetryBudget] = None,
+                 breaker=None, registry=None,
+                 sleep: "Optional[Callable[[float], None]]" = None):
+        self.dep = dep
+        self.clock = clock or Clock()
+        self.base = base
+        self.cap = cap
+        self.max_attempts = max(1, max_attempts)
+        self.budget = budget if budget is not None else RetryBudget()
+        self.breaker = breaker
+        self._rng = _SplitMix64((seed << 8) ^ _stable_hash(dep))
+        self._prev = base
+        self._sleep = sleep if sleep is not None else self.clock.sleep
+        self._lock = threading.Lock()
+        reg = registry if registry is not None else REGISTRY
+        self.retries_total = reg.counter(
+            f"{NAMESPACE}_resilience_retries_total",
+            "Retry decisions per dependency: retry, give_up, "
+            "budget_exhausted, breaker_open.", ("dep", "outcome"))
+        self.sleeps_total = 0.0  # backoff seconds spent (virtual in chaos)
+
+    # -- primitives (in-place loops) -------------------------------------------
+
+    def set_sleep(self, sleep: "Callable[[float], None]") -> None:
+        self._sleep = sleep
+
+    def next_backoff(self) -> float:
+        """Decorrelated jitter (cap-bounded): uniform in [base, 3*prev]."""
+        with self._lock:
+            span = max(0.0, 3.0 * self._prev - self.base)
+            delay = min(self.cap, self.base + self._rng.uniform() * span)
+            self._prev = delay
+            return delay
+
+    def try_retry(self) -> bool:
+        """Spend one retry token; False means the budget is empty and the
+        caller must give up NOW (counts as budget_exhausted)."""
+        if not self.budget.try_spend():
+            self.retries_total.inc(dep=self.dep, outcome="budget_exhausted")
+            return False
+        self.retries_total.inc(dep=self.dep, outcome="retry")
+        return True
+
+    def sleep_backoff(self) -> float:
+        delay = self.next_backoff()
+        with self._lock:
+            self.sleeps_total += delay
+        self._sleep(delay)
+        return delay
+
+    def note_success(self) -> None:
+        self.budget.refill()
+        with self._lock:
+            self._prev = self.base  # backoff resets once the dep answers
+        if self.breaker is not None:
+            self.breaker.record_success()
+
+    def note_failure(self) -> None:
+        if self.breaker is not None:
+            self.breaker.record_failure()
+
+    # -- the declarative form ----------------------------------------------------
+
+    def call(self, fn: Callable, retriable=(Exception,),
+             description: str = ""):
+        """Run fn; retry retriable failures with jittered backoff while the
+        budget holds and attempts remain. `retriable` is an exception
+        class/tuple or a predicate `exc -> bool` (lets callers match by
+        error CODE, e.g. transient cloud 5xx vs business errors). The
+        breaker (when wired) is consulted once up front — a known-down
+        dependency fails fast."""
+        if self.breaker is not None and not self.breaker.allow():
+            self.retries_total.inc(dep=self.dep, outcome="breaker_open")
+            from .breaker import BreakerOpen
+
+            raise BreakerOpen(self.dep)
+        matches = retriable if callable(retriable) \
+            and not isinstance(retriable, type) \
+            else (lambda e: isinstance(e, retriable))
+        for attempt in range(self.max_attempts):
+            try:
+                result = fn()
+            except Exception as e:
+                if not matches(e):
+                    raise
+                self.note_failure()
+                if attempt + 1 >= self.max_attempts or not self.try_retry():
+                    self.retries_total.inc(dep=self.dep, outcome="give_up")
+                    raise
+                self.sleep_backoff()
+                continue
+            self.note_success()
+            return result
+
+    def evidence(self) -> dict:
+        with self._lock:
+            sleeps = round(self.sleeps_total, 6)
+        return {"budget": self.budget.evidence(),
+                "backoff_seconds_total": sleeps}
+
+
+def _stable_hash(s: str) -> int:
+    """Deterministic across processes (hash() is salted)."""
+    h = 1469598103934665603  # FNV-1a 64
+    for b in s.encode():
+        h = ((h ^ b) * 1099511628211) & _MASK
+    return h

@@ -29,6 +29,7 @@ from ..metrics import Counter
 from ..models.instancetype import Catalog
 from ..models.pod import PodGroup, PodSpec
 from ..oracle.scheduler import ExistingNode, Option
+from ..resilience import deadline
 from ..tracing import TRACER
 from .core import SolvedNode, SolveResult
 from . import solver_pb2 as pb
@@ -73,10 +74,15 @@ class RemoteSolver:
     def __init__(self, catalog: Catalog, provisioners: Sequence[Provisioner],
                  target: str = "127.0.0.1:50151",
                  channel: Optional[grpc.Channel] = None,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, resilience=None):
         self.catalog = catalog
         self.provisioners = list(provisioners)
         self.timeout = timeout
+        # shared solver-edge RetryPolicy (breaker + budget) from the hub;
+        # standalone clients run bare — the provisioning ladder above is
+        # still their safety net
+        self._policy = resilience.policy("solver") if resilience is not None \
+            else None
         self._channel = channel or _shared_channel(target)
         self._synced_hash: Optional[int] = None
         self._prov_hash = wire.provisioners_hash(self.provisioners)
@@ -97,6 +103,20 @@ class RemoteSolver:
     # -- RPC plumbing --------------------------------------------------------------
 
     def _call(self, name: str, request):
+        pol = self._policy
+        if pol is not None and pol.breaker is not None \
+                and not pol.breaker.allow():
+            # fail fast into SolverUnavailable: the callers' fallback chains
+            # (provisioning/deprovisioning ladders) already catch it
+            pol.retries_total.inc(dep=pol.dep, outcome="breaker_open")
+            raise SolverUnavailable(f"{name}: solver circuit breaker open")
+        dl = deadline.current()
+        timeout = self.timeout
+        if dl is not None:
+            if dl.expired():
+                raise SolverUnavailable(
+                    f"{name}: reconcile deadline exhausted before RPC")
+            timeout = min(timeout, dl.remaining())
         cur = TRACER.current_span()
         with TRACER.start_span(f"solver.rpc.{name}") as span:
             # inject THIS rpc span's identity so the sidecar's span joins
@@ -105,13 +125,27 @@ class RemoteSolver:
             if hasattr(request, "trace_context"):
                 request.trace_context.CopyFrom(
                     wire.trace_context_to_wire(span.context()))
+            # deadline propagation: ship the REMAINING budget (ms) so the
+            # service can shed work that can't finish in time — remaining
+            # time, not an absolute timestamp, because the two processes
+            # don't share a clock
+            if hasattr(request, "deadline_ms") and dl is not None:
+                request.deadline_ms = max(1, int(dl.remaining_ms()))
             try:
-                resp = self._stubs[name](request, timeout=self.timeout)
+                resp = self._stubs[name](request, timeout=timeout)
             except grpc.RpcError as e:
                 if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
+                    # a structured rejection from a LIVE server: the solver
+                    # edge is healthy, only the synced state is stale
+                    if pol is not None:
+                        pol.note_success()
                     raise StaleSync(e.details())
+                if pol is not None:
+                    pol.note_failure()
                 raise SolverUnavailable(
                     f"{name}: {e.code().name}: {e.details()}")
+            if pol is not None:
+                pol.note_success()
             if name == "Solve":
                 # the service echoes its device-path observability in the
                 # response — record it on the CLIENT side of the wire too,
@@ -191,7 +225,11 @@ class RemoteSolver:
             self.sync()
         try:
             resp = self._call("Consolidate", req)
-        except StaleSync:
+        except StaleSync as e:
+            if self._policy is not None and not self._policy.try_retry():
+                raise SolverUnavailable(
+                    f"Consolidate: retry budget exhausted after stale "
+                    f"sync: {e}")
             self.sync()
             resp = self._call("Consolidate", req)
         return wire.action_from_response(resp)
@@ -213,8 +251,12 @@ class RemoteSolver:
             self.sync()
         try:
             resp = self._call("Solve", req)
-        except StaleSync:
-            # one re-sync + retry (server restarted or drifted)
+        except StaleSync as e:
+            # one re-sync + retry (server restarted or drifted),
+            # budget-gated like every other retry path
+            if self._policy is not None and not self._policy.try_retry():
+                raise SolverUnavailable(
+                    f"Solve: retry budget exhausted after stale sync: {e}")
             self.sync()
             resp = self._call("Solve", req)
         return self._decode(resp, pods)

@@ -33,6 +33,12 @@ log = logging.getLogger("karpenter.solver.service")
 
 SERVICE_NAME = "karpenter.solver.Solver"
 
+# Requests arriving with less remaining deadline budget (ms) than this are
+# shed up front (DEADLINE_EXCEEDED): the caller's reconcile cycle will have
+# given up on the answer before the solve finishes, so computing it only
+# burns device time someone else is queued for.
+SHED_MIN_BUDGET_MS = 10.0
+
 METHODS = {
     "Sync": (pb.SyncRequest, pb.SyncResponse),
     "Solve": (pb.SolveRequest, pb.SolveResponse),
@@ -187,6 +193,11 @@ class SolverService:
                 grpc.StatusCode.FAILED_PRECONDITION,
                 f"catalog hash={request.catalog_hash:x} not synced; "
                 f"re-Sync required")
+        if request.deadline_ms and request.deadline_ms < SHED_MIN_BUDGET_MS:
+            context.abort(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                f"{request.deadline_ms}ms of cycle budget remaining; "
+                f"shedding solve")
         solver, seqnum = entry
         pods = [wire.pod_from_wire(m) for m in request.pods]
         existing = [wire.existing_from_wire(m) for m in request.existing]
@@ -267,6 +278,12 @@ class SolverService:
                     grpc.StatusCode.FAILED_PRECONDITION,
                     f"catalog hash={request.catalog_hash:x} not synced; "
                     f"re-Sync required")
+            if request.deadline_ms \
+                    and request.deadline_ms < SHED_MIN_BUDGET_MS:
+                context.abort(
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                    f"{request.deadline_ms}ms of cycle budget remaining; "
+                    f"shedding consolidation")
             solver, _seqnum = entry
             cluster = ClusterState()
             eligible_names: "set[str]" = set()

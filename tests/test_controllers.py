@@ -319,7 +319,10 @@ class TestMachineLifecycle:
                                              anti_affinity_hostname=True))
         op.provisioning.reconcile_once()
         assert len(op.cluster.nodes) == 2
-        (n1, n2) = sorted(op.cluster.nodes.values(), key=lambda n: n.name)
+        # pod->node assignment follows launch completion order (the two
+        # launches race), so pick nodes by content: n1 holds pod a, n2 pod b
+        (n1, n2) = sorted(op.cluster.nodes.values(),
+                          key=lambda n: sorted(p.name for p in n.pods))
         n2.pods.clear()
         op.kube.delete("pods", "b")
         # NOT initialized yet: no candidate
