@@ -109,10 +109,20 @@ def cmd_controller(args) -> int:
         # exists — so the remote solver edge shares the solver breaker
         # and retry budget with every other borrower
         _op_cell: "list" = []
-        solver_factory = (
-            lambda cat, provs: RemoteSolver(
+
+        def solver_factory(cat, provs):
+            if not _op_cell:
+                # must not happen in the current boot order (the cell is
+                # filled right after Operator construction); if a future
+                # refactor constructs solvers eagerly, losing the breaker/
+                # budget protection silently would be far worse than a log
+                logging.getLogger("karpenter.cli").warning(
+                    "solver factory ran before the Operator was "
+                    "constructed: remote solver edge has NO resilience "
+                    "hub (no breaker, no retry budget)")
+            return RemoteSolver(
                 cat, provs, target=args.solver,
-                resilience=_op_cell[0].resilience if _op_cell else None))
+                resilience=_op_cell[0].resilience if _op_cell else None)
     cloud = FakeCloud(catalog)
     if args.state and os.path.exists(args.state):
         cloud.load_state(args.state)

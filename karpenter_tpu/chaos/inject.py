@@ -40,7 +40,7 @@ class _ChaosSolver:
     otherwise delegates to the scalar oracle (pure python — the chaos
     tier needs deterministic, compile-free solves; backend parity is
     proven elsewhere). A crash exercises provisioning's real degrade
-    chain: primary -> native -> oracle."""
+    chain: tpu -> native -> oracle."""
 
     def __init__(self, catalog, provisioners, injector: "ChaosInjector"):
         self._catalog = catalog
@@ -163,7 +163,7 @@ class ChaosInjector:
 
     def _hook_solver(self, op) -> None:
         # route_threshold=0 classifies every batch as "large" -> the
-        # primary (our crashing stand-in) runs first and its failures
+        # tpu rung (our crashing stand-in) runs first and its failures
         # exercise the real degrade chain
         op.provisioning.route_threshold = 0
         op.provisioning._solver_factory = (

@@ -93,40 +93,54 @@ class CloudSession:
             raise ConnectivityError(
                 f"{action} rejected: cloud circuit breaker open")
         last: "Exception | None" = None
-        for attempt in range(self.retries + 1):
-            req = urllib.request.Request(
-                f"{self.endpoint}/api/{action}", data=body,
-                headers={"Content-Type": "application/json",
-                         "User-Agent": USER_AGENT,
-                         "X-Region": self.region or ""})
-            try:
-                with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-                    doc = json.loads(r.read() or b"{}")
-                    if pol is not None:
-                        pol.note_success()
-                    return doc
-            except urllib.error.HTTPError as e:
-                data = e.read()
-                if e.code >= 500:  # transient server side: retry
+        try:
+            for attempt in range(self.retries + 1):
+                req = urllib.request.Request(
+                    f"{self.endpoint}/api/{action}", data=body,
+                    headers={"Content-Type": "application/json",
+                             "User-Agent": USER_AGENT,
+                             "X-Region": self.region or ""})
+                try:
+                    with urllib.request.urlopen(req,
+                                                timeout=self.timeout_s) as r:
+                        doc = json.loads(r.read() or b"{}")
+                        if pol is not None:
+                            pol.note_success()
+                        return doc
+                except urllib.error.HTTPError as e:
+                    data = e.read()
+                    if e.code >= 500:  # transient server side: retry
+                        last = e
+                    else:
+                        # a structured error IS a live server: breaker
+                        # success (mirrors the solver client's StaleSync
+                        # handling) — without it the half-open probe the
+                        # allow() above may have admitted would stay
+                        # unjudged and wedge the shared cloud edge open
+                        if pol is not None:
+                            pol.note_success()
+                        raise _rehydrate_error(data) from None
+                except (urllib.error.URLError, TimeoutError, OSError) as e:
                     last = e
-                else:
-                    # a structured error IS a live server: no breaker hit
-                    raise _rehydrate_error(data) from None
-            except (urllib.error.URLError, TimeoutError, OSError) as e:
-                last = e
-            if pol is not None:
-                pol.note_failure()
-            if attempt < self.retries:
                 if pol is not None:
-                    if not pol.try_retry():
-                        break  # budget exhausted: give up now
-                    pol.sleep_backoff()
-                else:
-                    self._sleep(RETRY_BACKOFF_S * (attempt + 1))
-        if pol is not None:
-            pol.retries_total.inc(dep=pol.dep, outcome="give_up")
-        raise ConnectivityError(
-            f"{action} failed after {self.retries + 1} attempts: {last}")
+                    pol.note_failure()
+                if attempt < self.retries:
+                    if pol is not None:
+                        if not pol.try_retry():
+                            break  # budget exhausted: give up now
+                        pol.sleep_backoff()
+                    else:
+                        self._sleep(RETRY_BACKOFF_S * (attempt + 1))
+            if pol is not None:
+                pol.retries_total.inc(dep=pol.dep, outcome="give_up")
+            raise ConnectivityError(
+                f"{action} failed after {self.retries + 1} attempts: {last}")
+        finally:
+            # any exit that judged the call already resolved the probe
+            # (release is then a no-op); unexpected raises (e.g. a body
+            # decode error) must not leave it in flight
+            if pol is not None:
+                pol.release_probe()
 
     def _sleep(self, seconds: float) -> None:
         if self.clock is not None:

@@ -106,7 +106,7 @@ class ProvisioningController:
         # one; standalone controllers get a private ladder)
         self.solve_ladder = (
             resilience.ladder("solve") if resilience is not None
-            else DegradeLadder("solve", ("primary", "fallback", "oracle"),
+            else DegradeLadder("solve", ("tpu", "native", "oracle"),
                                clock=self.clock, recorder=self.recorder,
                                registry=reg))
         self.last_solver_kind: "Optional[str]" = None
@@ -278,38 +278,65 @@ class ProvisioningController:
             return solver.solve(pods, existing=existing,
                                 daemon_overhead=overhead)
 
-        small = self.route_threshold is None or len(pods) < self.route_threshold
-        order = [("native", run_native), ("tpu", run_primary)] if small \
-            else [("tpu", run_primary), ("native", run_native)]
-        # the ladder maps rung index -> position in the routing order
-        # (0 = preferred backend, 1 = other backend, 2 = scalar oracle);
-        # a degraded ladder skips straight past known-broken rungs and only
-        # re-tries them on its scheduled recovery probes
+        # Ladder rungs bind to FIXED backend identities — 0 = tpu,
+        # 1 = native, 2 = oracle (matching the hub's "solve" chain) — so
+        # failures and probe promotions recorded in one cycle mean the same
+        # backend in every later cycle regardless of batch size. The
+        # measured size crossover is applied separately below: it reorders
+        # ATTEMPTS among healthy backends, never the rung a verdict lands
+        # on. A degraded ladder skips straight past known-broken rungs and
+        # only re-tries them on its scheduled recovery probes.
+        backends = (("tpu", run_primary), ("native", run_native))
         ladder = self.solve_ladder
         start = ladder.start_rung()
+        probing = start < ladder.rung()
+        attempts = [(r,) + backends[r] for r in range(start, len(backends))]
+        small = self.route_threshold is None or len(pods) < self.route_threshold
+        if small and start == 0 and not probing:
+            # latency preference (native wins below the crossover): both
+            # backends are healthy candidates, try native first. Never
+            # applied to an admitted recovery probe — skipping the probe
+            # rung would leave the ladder probing forever.
+            attempts.reverse()
         dl = deadline.current()
-        for rung in range(start, len(order)):
-            kind, fn = order[rung]
+        failed: "set[int]" = set()
+
+        def flush_failures(upto: int) -> None:
+            # chain-consistent verdicts: a failure at rung r may degrade
+            # the ladder only when every better candidate rung failed too
+            # (the linear-chain assumption record_failure encodes) — a
+            # worse rung failing while a better one is healthy must not
+            # push the ladder past the healthy backend
+            for r in range(start, upto):
+                if r not in failed:
+                    break
+                ladder.record_failure(r)
+
+        for rung, kind, fn in attempts:
             if dl is not None and dl.expired():
                 # deadline exhaustion mid-chain: the remaining budget can't
                 # absorb another backend failure — shed straight to the
-                # in-process oracle (no ladder movement: the backends didn't
-                # fail, we just ran out of cycle budget)
+                # in-process oracle (only rungs that actually FAILED move
+                # the ladder; an un-run probe is re-armed unjudged)
                 log.warning("reconcile deadline exhausted before %s solve; "
                             "falling through to oracle", kind)
+                flush_failures(len(backends))
                 ladder.abort_probe()
                 break
             try:
                 result = fn()
             except Exception as e:
                 log.warning("%s solver failed (%s); degrading", kind, e)
-                ladder.record_failure(rung)
+                failed.add(rung)
                 continue
+            flush_failures(rung)  # e.g. a failed probe rung: judge it first
             ladder.record_success(rung)
             return result, kind
+        else:
+            flush_failures(len(backends))
         result = self._oracle_solve(catalog, provisioners, pods,
                                     existing, overhead)
-        ladder.record_success(len(order))
+        ladder.record_success(len(backends))
         return result, "oracle"
 
     def _oracle_solve(self, catalog, provisioners, pods, existing, overhead):

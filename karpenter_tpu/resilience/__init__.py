@@ -44,7 +44,10 @@ _DEP_TUNING = {
 }
 
 _CHAINS = {
-    "solve": ("primary", "fallback", "oracle"),
+    # solve rungs are FIXED backend identities (tpu is always rung 0):
+    # provisioning's size-crossover preference reorders attempts, never
+    # the rung a verdict is recorded against (backend-stable ladder state)
+    "solve": ("tpu", "native", "oracle"),
     "consolidate": ("remote", "tpu", "oracle"),
     "pricing": ("live", "static"),
 }

@@ -118,6 +118,19 @@ class CircuitBreaker:
                         f"{self._probe_successes} consecutive probe "
                         "successes")
 
+    def release_probe(self) -> None:
+        """Resolve an admitted half-open probe WITHOUT judging it. For exit
+        paths that say nothing about dependency health — a non-retriable
+        business error from a live server, a caller-side bug, a
+        self-inflicted deadline — where neither record_success nor
+        record_failure is honest. Clears the in-flight flag so the next
+        allow() can probe again; without this an unjudged probe would
+        reject every future HALF_OPEN call forever (no timeout escape).
+        No-op when the probe was already judged or none is in flight."""
+        with self._lock:
+            if self._state == HALF_OPEN and self._probe_in_flight:
+                self._probe_in_flight = False
+
     def record_failure(self) -> None:
         with self._lock:
             if self._state == CLOSED:

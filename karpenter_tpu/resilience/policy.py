@@ -161,6 +161,15 @@ class RetryPolicy:
         if self.breaker is not None:
             self.breaker.record_failure()
 
+    def release_probe(self) -> None:
+        """Resolve a half-open probe the breaker may have admitted for a
+        call that exited without learning anything about dependency health
+        (non-retriable business error, self-inflicted deadline, caller
+        bug). No-op when the probe was already judged via note_success /
+        note_failure — safe to call from a finally."""
+        if self.breaker is not None:
+            self.breaker.release_probe()
+
     # -- the declarative form ----------------------------------------------------
 
     def call(self, fn: Callable, retriable=(Exception,),
@@ -179,20 +188,30 @@ class RetryPolicy:
         matches = retriable if callable(retriable) \
             and not isinstance(retriable, type) \
             else (lambda e: isinstance(e, retriable))
-        for attempt in range(self.max_attempts):
-            try:
-                result = fn()
-            except Exception as e:
-                if not matches(e):
-                    raise
-                self.note_failure()
-                if attempt + 1 >= self.max_attempts or not self.try_retry():
-                    self.retries_total.inc(dep=self.dep, outcome="give_up")
-                    raise
-                self.sleep_backoff()
-                continue
-            self.note_success()
-            return result
+        try:
+            for attempt in range(self.max_attempts):
+                try:
+                    result = fn()
+                except Exception as e:
+                    if not matches(e):
+                        raise
+                    self.note_failure()
+                    if attempt + 1 >= self.max_attempts \
+                            or not self.try_retry():
+                        self.retries_total.inc(dep=self.dep,
+                                               outcome="give_up")
+                        raise
+                    self.sleep_backoff()
+                    continue
+                self.note_success()
+                return result
+        finally:
+            # every exit path must resolve a probe the allow() above may
+            # have admitted: the retriable paths already judged it via
+            # note_success/note_failure (release is then a no-op), but a
+            # non-retriable raise — or a BaseException — would otherwise
+            # leave it unjudged and wedge the breaker in HALF_OPEN forever
+            self.release_probe()
 
     def evidence(self) -> dict:
         with self._lock:

@@ -104,18 +104,21 @@ class RemoteSolver:
 
     def _call(self, name: str, request):
         pol = self._policy
+        dl = deadline.current()
+        # shed a doomed call BEFORE consulting the breaker: an exhausted
+        # cycle budget says nothing about solver health, and admitting it
+        # as the half-open probe would waste (or wedge) the probe slot
+        if dl is not None and dl.expired():
+            raise SolverUnavailable(
+                f"{name}: reconcile deadline exhausted before RPC")
         if pol is not None and pol.breaker is not None \
                 and not pol.breaker.allow():
             # fail fast into SolverUnavailable: the callers' fallback chains
             # (provisioning/deprovisioning ladders) already catch it
             pol.retries_total.inc(dep=pol.dep, outcome="breaker_open")
             raise SolverUnavailable(f"{name}: solver circuit breaker open")
-        dl = deadline.current()
         timeout = self.timeout
         if dl is not None:
-            if dl.expired():
-                raise SolverUnavailable(
-                    f"{name}: reconcile deadline exhausted before RPC")
             timeout = min(timeout, dl.remaining())
         cur = TRACER.current_span()
         with TRACER.start_span(f"solver.rpc.{name}") as span:
@@ -132,20 +135,39 @@ class RemoteSolver:
             if hasattr(request, "deadline_ms") and dl is not None:
                 request.deadline_ms = max(1, int(dl.remaining_ms()))
             try:
-                resp = self._stubs[name](request, timeout=timeout)
-            except grpc.RpcError as e:
-                if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
-                    # a structured rejection from a LIVE server: the solver
-                    # edge is healthy, only the synced state is stale
+                try:
+                    resp = self._stubs[name](request, timeout=timeout)
+                except grpc.RpcError as e:
+                    if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
+                        # a structured rejection from a LIVE server: the
+                        # solver edge is healthy, only the synced state is
+                        # stale
+                        if pol is not None:
+                            pol.note_success()
+                        raise StaleSync(e.details())
+                    if (e.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+                            and dl is not None):
+                        # the RPC timeout was capped to the cycle's
+                        # REMAINING budget (and the service sheds
+                        # past-deadline work): this is self-inflicted, not
+                        # solver sickness — no breaker feedback, or a few
+                        # slow cycles would trip the breaker on a healthy
+                        # sidecar (the finally releases the probe unjudged)
+                        raise SolverUnavailable(
+                            f"{name}: cycle budget exhausted mid-RPC: "
+                            f"{e.details()}")
                     if pol is not None:
-                        pol.note_success()
-                    raise StaleSync(e.details())
+                        pol.note_failure()
+                    raise SolverUnavailable(
+                        f"{name}: {e.code().name}: {e.details()}")
                 if pol is not None:
-                    pol.note_failure()
-                raise SolverUnavailable(
-                    f"{name}: {e.code().name}: {e.details()}")
-            if pol is not None:
-                pol.note_success()
+                    pol.note_success()
+            finally:
+                # resolve a half-open probe the allow() above may have
+                # admitted on ANY exit that didn't judge it (no-op after
+                # note_success/note_failure)
+                if pol is not None:
+                    pol.release_probe()
             if name == "Solve":
                 # the service echoes its device-path observability in the
                 # response — record it on the CLIENT side of the wire too,

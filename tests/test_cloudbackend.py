@@ -209,6 +209,35 @@ class TestHttpDriverSpecifics:
         finally:
             server.stop()
 
+    def test_structured_4xx_resolves_half_open_probe(self, catalog):
+        """A rehydrated business error IS a live server: it must judge the
+        half-open probe as a success, not leave it in flight — an unjudged
+        probe would reject every future call on the shared cloud edge
+        forever (no timeout escape from HALF_OPEN)."""
+        from karpenter_tpu.metrics import Registry
+        from karpenter_tpu.resilience import CircuitBreaker, RetryPolicy
+        from karpenter_tpu.utils.clock import FakeClock
+
+        server = CloudAPIServer(FakeCloud(catalog=catalog)).start()
+        try:
+            clock = FakeClock()
+            reg = Registry()
+            br = CircuitBreaker("cloud", clock=clock, failure_threshold=1,
+                                recovery_time=30.0, success_threshold=1,
+                                registry=reg)
+            pol = RetryPolicy("cloud", clock=clock, breaker=br,
+                              registry=reg, sleep=lambda s: None)
+            cloud = connect(server.endpoint, policy=pol)
+            br.record_failure()  # cloud edge trips open
+            clock.step(30.0)     # recovery window elapses
+            with pytest.raises(cloud_errors.CloudError) as ei:
+                cloud.terminate_instances(["i-missing"])  # the probe call
+            assert cloud_errors.is_not_found(ei.value)
+            assert br.state() == "closed"  # probe judged: server is live
+            assert cloud.describe_instances([]) == []  # edge serves again
+        finally:
+            server.stop()
+
     def test_retries_exhausted_raises_connectivity(self, catalog):
         server = CloudAPIServer(FakeCloud(catalog=catalog)).start()
         try:

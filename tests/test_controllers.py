@@ -513,6 +513,63 @@ class TestSolverCacheAndRouting:
         assert pc.last_solver_kind == "tpu"
         assert pc.solver_rebuilds == 1
 
+    def test_ladder_rungs_are_backend_stable_across_order_swap(
+            self, op, monkeypatch):
+        """Small batches attempt native first, but ladder rungs bind to
+        FIXED backend identities (tpu=0, native=1): a native failure while
+        tpu is healthy must not degrade the ladder past the healthy tpu
+        rung (it would skip it in every later cycle)."""
+        add_provisioner(op)
+        pc = op.provisioning
+        pc.route_threshold = None  # native attempted first on every batch
+
+        class BrokenNative:
+            def __init__(self, *a, **k):
+                pass
+
+            def adopt_static(self, other):
+                pass
+
+            def solve(self, *a, **k):
+                raise RuntimeError("native packer down")
+
+        monkeypatch.setattr(
+            "karpenter_tpu.controllers.provisioning.NativeSolver",
+            BrokenNative)
+        p = make_pod("bs0", cpu="1", memory="1Gi")
+        op.kube.create("pods", p.name, p)
+        pc.reconcile_once()
+        # native failed, the tpu rung answered...
+        assert pc.last_solver_kind == "tpu"
+        # ...and the ladder stays on its best rung: the worse rung's
+        # failure says nothing the ladder routes on while tpu is healthy
+        assert pc.solve_ladder.rung() == 0
+        assert not pc.solve_ladder.evidence()["transitions"]
+
+    def test_tpu_failure_degrades_to_the_native_rung(self, op):
+        add_provisioner(op)
+        pc = op.provisioning
+        pc.route_threshold = 0  # every batch is "large": tpu first
+
+        class Broken:
+            def solve(self, *a, **k):
+                raise RuntimeError("sidecar crashed")
+
+        pc._solver_factory = lambda catalog, provs: Broken()
+        pc._solver_cache.clear()
+        p = make_pod("dg0", cpu="1", memory="1Gi")
+        op.kube.create("pods", p.name, p)
+        pc.reconcile_once()
+        assert pc.last_solver_kind == "native"
+        assert pc.solve_ladder.rung() == 1
+        assert pc.solve_ladder.rung_name() == "native"
+        # sticky: the next cycle starts at native, no tpu re-try
+        q = make_pod("dg1", cpu="1", memory="1Gi")
+        op.kube.create("pods", q.name, q)
+        pc.reconcile_once()
+        assert pc.last_solver_kind == "native"
+        assert pc.solve_ladder.rung() == 1
+
 
 class TestReplaceBeforeDrain:
     def _seed_replaceable(self, op):

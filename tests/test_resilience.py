@@ -228,6 +228,48 @@ class TestBreakerFSM:
         assert ev["opened_total"] == 2
         assert ev["closed_total"] == 1
 
+    def test_release_probe_unwedges_half_open(self):
+        br, clock, rec = self.make()
+        for _ in range(3):
+            br.record_failure()
+        clock.step(30.0)
+        assert br.allow()  # probe admitted...
+        br.release_probe()  # ...but the call said nothing about health
+        assert br.state() == "half-open"
+        assert br.allow()  # the NEXT call becomes the probe — not wedged
+
+    def test_release_probe_noop_after_verdict(self):
+        br, clock, rec = self.make()
+        for _ in range(3):
+            br.record_failure()
+        clock.step(30.0)
+        assert br.allow()
+        br.record_failure()  # judged: probe failed, re-opened
+        br.release_probe()   # late release must not disturb the verdict
+        assert br.state() == "open"
+        assert not br.allow()  # full recovery window still re-armed
+
+    def test_non_retriable_error_resolves_half_open_probe(self):
+        """A non-retriable exception racing the half-open window must not
+        leave the probe in flight: every future allow() would then reject
+        forever (no timeout escape from HALF_OPEN)."""
+        reg = Registry()
+        clock = FakeClock()
+        br = CircuitBreaker("cloud", clock=clock, failure_threshold=1,
+                            recovery_time=30.0, success_threshold=1,
+                            registry=reg)
+        pol = RetryPolicy("cloud", clock=clock, breaker=br, registry=reg,
+                          sleep=lambda s: None)
+        br.record_failure()  # open
+        clock.step(30.0)     # recovery window elapses
+        with pytest.raises(KeyError):  # business error admitted as probe
+            pol.call(lambda: (_ for _ in ()).throw(KeyError("x")),
+                     retriable=(ValueError,))
+        calls = []
+        pol.call(lambda: calls.append(1))  # would raise BreakerOpen if wedged
+        assert calls
+        assert br.state() == "closed"
+
     def test_policy_fails_fast_when_breaker_open(self):
         reg = Registry()
         clock = FakeClock()
@@ -461,6 +503,111 @@ class TestSolverDeadlineWire:
         with pytest.raises(SolverUnavailable, match="breaker open"):
             client.solve([])
         assert not chan.calls
+
+
+class _FailingChannel(_FakeChannel):
+    """_FakeChannel whose named RPC raises the given RpcError (Sync etc.
+    still succeed, so the client's sync handshake passes)."""
+
+    def __init__(self, fail_name, exc):
+        super().__init__()
+        self._fail_name = fail_name
+        self._exc = exc
+
+    def unary_unary(self, path, request_serializer=None,
+                    response_deserializer=None):
+        inner = super().unary_unary(
+            path, request_serializer=request_serializer,
+            response_deserializer=response_deserializer)
+        name = path.rsplit("/", 1)[-1]
+
+        def call(request, timeout=None):
+            if name == self._fail_name:
+                raise self._exc
+            return inner(request, timeout)
+
+        return call
+
+
+def _rpc_error(code, details="injected"):
+    import grpc
+
+    class _Err(grpc.RpcError):
+        def code(self):
+            return code
+
+        def details(self):
+            return details
+
+    return _Err(details)
+
+
+class TestSolverBreakerFeedback:
+    def test_self_inflicted_deadline_is_not_breaker_food(self):
+        """DEADLINE_EXCEEDED while the caller's own cycle budget was
+        propagated means the RPC ran out of OUR time (the timeout was
+        capped to the remaining budget, the service sheds past-deadline
+        work) — a few slow cycles must not trip the solver breaker on a
+        healthy sidecar."""
+        import grpc
+
+        from karpenter_tpu.solver.client import (RemoteSolver,
+                                                 SolverUnavailable)
+
+        catalog, provs = _solver_fixture()
+        clock = FakeClock()
+        hub = ResilienceHub(clock=clock, registry=Registry())
+        chan = _FailingChannel(
+            "Solve", _rpc_error(grpc.StatusCode.DEADLINE_EXCEEDED))
+        client = RemoteSolver(catalog, provs, channel=chan, resilience=hub)
+        for _ in range(5):
+            with deadline.cycle(clock, budget_s=30.0):
+                with pytest.raises(SolverUnavailable, match="cycle budget"):
+                    client.solve([])
+        snap = hub.breaker("solver").snapshot()
+        assert snap["state"] == "closed"
+        assert snap["consecutive_failures"] == 0
+
+    def test_deadline_exceeded_without_cycle_budget_is_breaker_food(self):
+        """No propagated budget: DEADLINE_EXCEEDED is the sidecar being
+        slow on its own terms — normal failure accounting applies."""
+        import grpc
+
+        from karpenter_tpu.solver.client import (RemoteSolver,
+                                                 SolverUnavailable)
+
+        catalog, provs = _solver_fixture()
+        hub = ResilienceHub(clock=FakeClock(), registry=Registry())
+        chan = _FailingChannel(
+            "Solve", _rpc_error(grpc.StatusCode.DEADLINE_EXCEEDED))
+        client = RemoteSolver(catalog, provs, channel=chan, resilience=hub)
+        with pytest.raises(SolverUnavailable):
+            client.solve([])
+        assert hub.breaker("solver").snapshot()["consecutive_failures"] == 1
+
+    def test_deadline_mid_rpc_releases_half_open_probe(self):
+        """A self-inflicted deadline racing the half-open window must
+        release the probe slot unjudged, not wedge the solver edge."""
+        import grpc
+
+        from karpenter_tpu.solver.client import (RemoteSolver,
+                                                 SolverUnavailable)
+
+        catalog, provs = _solver_fixture()
+        clock = FakeClock()
+        hub = ResilienceHub(clock=clock, registry=Registry())
+        br = hub.breaker("solver")
+        for _ in range(3):
+            br.record_failure()  # solver edge trips open
+        clock.step(30.0)         # recovery window elapses
+        chan = _FailingChannel(
+            "Solve", _rpc_error(grpc.StatusCode.DEADLINE_EXCEEDED))
+        client = RemoteSolver(catalog, provs, channel=chan, resilience=hub)
+        with deadline.cycle(clock, budget_s=30.0):
+            with pytest.raises(SolverUnavailable):
+                client.solve([])
+        assert br.state() == "half-open"
+        assert br.allow()  # probe slot is free again — not wedged
 
 
 class TestServiceSheds:
