@@ -8,9 +8,9 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit lint noretry test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos claims diagnose
+.PHONY: presubmit lint noretry crashpoints test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash claims diagnose
 
-presubmit: lint claims noretry test verify-entry  ## what CI runs
+presubmit: lint claims noretry crashpoints test verify-entry  ## what CI runs
 
 claims:  ## every benchmark number in docs must cite a recorded artifact
 	$(PY) hack/check_round_claims.py
@@ -18,11 +18,17 @@ claims:  ## every benchmark number in docs must cite a recorded artifact
 noretry:  ## retries must flow through resilience.RetryPolicy (shared budget)
 	$(PY) hack/check_no_adhoc_retry.py
 
+crashpoints:  ## crashpoint catalog and call sites must stay in lockstep
+	$(PY) hack/check_crashpoints.py
+
 diagnose:  ## introspection smoke: deadman, statusz, flight-recorder bundles
 	$(CPU_ENV) $(PY) -m pytest tests/test_introspect.py -q
 
 chaos:  ## seeded deterministic fault-injection sweep (docs/designs/chaos.md)
 	$(CPU_ENV) $(PY) -m karpenter_tpu chaos --seed $(or $(SEED),0) --scenarios $(or $(SCENARIOS),3)
+
+chaos-crash:  ## crash-restart recovery drill: every crashpoint + fenced failover
+	$(CPU_ENV) $(PY) -m karpenter_tpu chaos --crash --seed $(or $(SEED),0)
 
 lint:  ## static analysis: bytecode-compile everything; ruff when installed
 	$(PY) -m compileall -q karpenter_tpu tests hack benchmarks bench.py __graft_entry__.py

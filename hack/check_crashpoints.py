@@ -1,0 +1,143 @@
+#!/usr/bin/env python3
+"""Lint: the crashpoint catalog and its call sites stay in lockstep.
+
+The crash drill (docs/designs/recovery.md) proves recovery for every
+named crashpoint in `recovery/crashpoints.py:CRASHPOINTS` — so the
+catalog and the code must never drift:
+
+1. every `crashpoint("...")` call site uses a catalogued name (a typo'd
+   or ad-hoc site would silently never be drilled);
+2. every catalogued name has EXACTLY one call site (zero means the drill
+   kills a site that no longer exists; two means the drill's "index 0"
+   kill no longer pins a unique program point);
+3. every file that writes write-ahead intent records
+   (`<something>.journal.record(...)`) declares at least one crashpoint —
+   a new journaled action without a crashpoint is recovery code the drill
+   never exercises;
+4. the site argument must be a string literal — the whole point is a
+   statically enumerable catalog.
+
+Detection is AST-based like hack/check_no_adhoc_retry.py. The catalog is
+read by parsing crashpoints.py (no package import: the lint must run in a
+bare interpreter).
+
+Run via `make presubmit` (or directly: python hack/check_crashpoints.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "karpenter_tpu"
+CATALOG_FILE = PACKAGE / "recovery" / "crashpoints.py"
+
+
+def load_catalog() -> "tuple[str, ...]":
+    tree = ast.parse(CATALOG_FILE.read_text(), filename=str(CATALOG_FILE))
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "CRASHPOINTS":
+                value = node.value
+                if isinstance(value, (ast.Tuple, ast.List)):
+                    return tuple(
+                        el.value for el in value.elts
+                        if isinstance(el, ast.Constant)
+                        and isinstance(el.value, str))
+    raise SystemExit(f"{CATALOG_FILE}: CRASHPOINTS tuple literal not found")
+
+
+def _is_crashpoint_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name) and f.id == "crashpoint":
+        return True
+    return isinstance(f, ast.Attribute) and f.attr == "crashpoint"
+
+
+def _is_journal_record_call(node: ast.AST) -> bool:
+    """`<expr>.journal.record(...)` — a write-ahead intent write."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "record"
+            and isinstance(f.value, ast.Attribute)
+            and f.value.attr == "journal")
+
+
+def check_file(path: pathlib.Path, catalog: "tuple[str, ...]",
+               sites: "dict[str, list[str]]") -> "list[str]":
+    rel = path.relative_to(ROOT)
+    tree = ast.parse(path.read_text(), filename=str(path))
+    problems: "list[str]" = []
+    records = 0
+    crashpoints_here = 0
+    for node in ast.walk(tree):
+        if _is_journal_record_call(node):
+            records += 1
+        if not _is_crashpoint_call(node):
+            continue
+        crashpoints_here += 1
+        args = node.args
+        if len(args) != 1 or not (isinstance(args[0], ast.Constant)
+                                  and isinstance(args[0].value, str)):
+            problems.append(
+                f"{rel}:{node.lineno}: crashpoint() site must be a single "
+                f"string literal (the catalog is static)")
+            continue
+        name = args[0].value
+        if name not in catalog:
+            problems.append(
+                f"{rel}:{node.lineno}: crashpoint {name!r} is not in "
+                f"recovery/crashpoints.py:CRASHPOINTS — the drill will "
+                f"never exercise it")
+        else:
+            sites[name].append(f"{rel}:{node.lineno}")
+    if records and not crashpoints_here:
+        problems.append(
+            f"{rel}: writes journal records ({records} .journal.record "
+            f"call(s)) but declares no crashpoint — the crash drill never "
+            f"exercises this file's recovery path")
+    return problems
+
+
+def main() -> int:
+    catalog = load_catalog()
+    sites: "dict[str, list[str]]" = {name: [] for name in catalog}
+    problems: "list[str]" = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        if path == CATALOG_FILE:
+            continue  # the defining module (and its docstring examples)
+        problems.extend(check_file(path, catalog, sites))
+    for name in catalog:
+        hits = sites[name]
+        if len(hits) == 0:
+            problems.append(
+                f"CRASHPOINTS entry {name!r} has no call site — the drill "
+                f"kills a program point that no longer exists")
+        elif len(hits) > 1:
+            problems.append(
+                f"CRASHPOINTS entry {name!r} has {len(hits)} call sites "
+                f"({', '.join(hits)}) — the drill's kill index no longer "
+                f"pins a unique program point")
+    if problems:
+        print("\n".join(problems), file=sys.stderr)
+        print(f"\n{len(problems)} crashpoint catalog violation(s); see "
+              f"hack/check_crashpoints.py docstring for the rules",
+              file=sys.stderr)
+        return 1
+    print(f"crashpoints: clean ({len(catalog)} catalogued, all uniquely "
+          f"sited)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

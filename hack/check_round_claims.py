@@ -6,7 +6,8 @@ factors). Unattributed numbers rot: the next round can neither reproduce
 nor refute them. This lint walks README.md and docs/rounds/*.md at
 paragraph granularity and requires any paragraph quoting a benchmark
 number to also cite where it was recorded — an artifact path
-(benchmarks/results/..., a bench_*/tpu_*/linkprobe_*/chaos_seed* JSON, a
+(benchmarks/results/..., a bench_*/tpu_*/linkprobe_*/chaos_seed*/
+chaos_burst_*/chaos_crash_* JSON, a
 flight-recorder bundle_*.json diagnostics bundle, a .trace.json capture)
 or the harness that records one (benchmarks/*.py).
 
@@ -36,7 +37,8 @@ CLAIM_PATTERNS = [
 # ...and "cites an artifact" when it matches any of these
 ARTIFACT_PATTERNS = [
     re.compile(r"benchmarks/[\w./*-]+"),
-    re.compile(r"\b(?:tpu|bench|trace_summary|linkprobe|chaos_seed|bundle_)"
+    re.compile(r"\b(?:tpu|bench|trace_summary|linkprobe|chaos_seed"
+               r"|chaos_burst|chaos_crash|bundle_)"
                r"[\w*-]*\.json(?:\.gz)?"),
     re.compile(r"[\w*-]+\.trace\.json(?:\.gz)?"),
 ]

@@ -494,13 +494,20 @@ def cmd_chaos(args) -> int:
     runner = ChaosRunner(seed=args.seed, scenarios=args.scenarios,
                          intensity=args.intensity,
                          out_dir=args.out_dir or None,
-                         burst=args.burst)
+                         burst=args.burst, crash=args.crash)
     artifact = runner.run()
     for s in artifact["scenarios"]:
         verdict = "PASS" if s["passed"] else "FAIL"
-        print(f"seed={s['seed']} scenario={s['scenario']} {verdict} "
-              f"kinds={len(s['fired_kinds'])} layers={','.join(s['layers'])} "
-              f"nodes={s['final_nodes']} settle={s['settle_cycles']}")
+        if args.crash:
+            print(f"seed={s['seed']} scenario={s['scenario']} {verdict} "
+                  f"{s['drill']} crash_cycle={s.get('crash_cycle', '-')} "
+                  f"replayed={len(s['replay'])} nodes={s['final_nodes']} "
+                  f"settle={s['settle_cycles']}")
+        else:
+            print(f"seed={s['seed']} scenario={s['scenario']} {verdict} "
+                  f"kinds={len(s['fired_kinds'])} "
+                  f"layers={','.join(s['layers'])} "
+                  f"nodes={s['final_nodes']} settle={s['settle_cycles']}")
         for v in s["violations"]:
             print(f"  VIOLATION [{v['invariant']}] {v['message']}")
     if artifact.get("artifact_path"):
@@ -512,12 +519,19 @@ def cmd_chaos(args) -> int:
     if not artifact["passed"]:
         print(f"REPRODUCE: python -m karpenter_tpu chaos --seed {args.seed} "
               f"--scenarios {args.scenarios}"
-              f"{' --burst' if args.burst else ''}")
+              f"{' --burst' if args.burst else ''}"
+              f"{' --crash' if args.crash else ''}")
         return 1
-    print(f"chaos: {artifact['scenario_count']} scenario(s) passed, "
-          f"{len(artifact['fault_kinds'])} fault kinds across "
-          f"{len(artifact['layers'])} layers "
-          f"({artifact['duration_s']}s)")
+    if args.crash:
+        print(f"chaos: crash drill passed — {artifact['scenario_count']} "
+              f"scenario(s) across {len(artifact['crashpoints'])} "
+              f"crashpoint(s) + leader failover "
+              f"({artifact['duration_s']}s)")
+    else:
+        print(f"chaos: {artifact['scenario_count']} scenario(s) passed, "
+              f"{len(artifact['fault_kinds'])} fault kinds across "
+              f"{len(artifact['layers'])} layers "
+              f"({artifact['duration_s']}s)")
     return 0
 
 
@@ -674,6 +688,11 @@ def main(argv=None) -> int:
                          help="run the fixed resilience-plane burst schedule "
                               "(dense cloud-5xx + solver crashes) instead of "
                               "the sampled plan")
+    p_chaos.add_argument("--crash", action="store_true",
+                         help="run the crash-restart recovery drill: one "
+                              "scenario per named crashpoint plus a fenced "
+                              "leader-failover scenario "
+                              "(docs/designs/recovery.md)")
     p_chaos.set_defaults(fn=cmd_chaos)
 
     p_ver = sub.add_parser("version")

@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+from ..recovery.crashpoints import crashpoint
 from ..utils import errors as cloud_errors
 from ..utils.clock import Clock
 from . import Batcher, one_bucket_hasher
@@ -65,6 +66,11 @@ class CreateFleetBatcher:
 
     def create_fleet(self, request: CreateFleetRequest) -> CreateFleetResponse:
         """Callers send capacity=1 requests; one merged N-capacity call runs."""
+        # crashpoint on the CALLER's thread (not _exec): the launch intent is
+        # journaled and the request claimed, but nothing was dispatched — a
+        # BaseException on the batcher's trigger thread would instead kill
+        # the flush loop and wedge every waiting caller
+        crashpoint("fleet.pre_dispatch")
         return self._batcher.add(request)
 
     def depth(self) -> int:

@@ -117,10 +117,39 @@ class ChaosInjector:
         self._wrap_cloud_api(cloud.describe_instances_api, "cloud.describe")
         self._wrap_cloud_api(cloud.terminate_instances_api, "cloud.terminate")
         self._wrap_kube_writes(op.kube)
-        self._hook_solver(op)
         self._hook_consolidation_ledger(op)
+        self.tune_operator(op)
+
+    def tune_operator(self, op) -> None:
+        """Determinism + speed tuning shared by every scenario flavor
+        (faulted sweeps AND the crash drill's fault-free incarnations):
+        compile-free solves, serialized worker pools, sub-ms batcher
+        windows."""
+        self._hook_solver(op)
         self._serialize_pools(op)
         self._shrink_batcher_windows(op)
+
+    def install_crash(self) -> None:
+        """Arm the process-wide crashpoint hook (crash drill). Crashpoints
+        are plan sites like any other — `crash.<name>`, consulted by call
+        index — so the kill is deterministic and respects paused()/enabled.
+        SimulatedCrash derives BaseException: it sails past every
+        `except Exception` cleanup fence exactly like a SIGKILL would, and
+        the drill catches it on the drive stack."""
+        from ..recovery import crashpoints
+
+        def hook(site: str, _self=self):
+            fault = _self.maybe(f"crash.{site}")
+            if fault is not None:
+                raise crashpoints.SimulatedCrash(site)
+
+        crashpoints.install(hook)
+
+    @staticmethod
+    def uninstall_crash() -> None:
+        from ..recovery import crashpoints
+
+        crashpoints.uninstall()
 
     def _wrap_cloud_api(self, mocked_fn, site: str) -> None:
         orig = mocked_fn.default_fn
@@ -142,12 +171,18 @@ class ChaosInjector:
         response-phase means it DID apply and only the ack was lost — the
         double-apply/retry class PR 1 hardened the real transport against.
         Event writes pass through unhooked: they are fire-and-forget
-        observability traffic and would soak up every scheduled index."""
+        observability traffic and would soak up every scheduled index.
+        Intent-journal and configmap bookkeeping writes pass through too:
+        they interleave with the object-plane writes the schedules were
+        sampled against (shifting every index), and a faulted write-ahead
+        record would break the exact recovery contract the crash drill's
+        invariants assert."""
+        skip_kinds = ("events", "intents", "configmaps")
         for method in ("create", "update", "delete", "bind_pod"):
             orig = getattr(kube, method)
 
             def wrapped(*args, _orig=orig, _method=method, **kwargs):
-                if _method != "bind_pod" and args and args[0] == "events":
+                if _method != "bind_pod" and args and args[0] in skip_kinds:
                     return _orig(*args, **kwargs)
                 fault = self.maybe("kube.write")
                 if fault is not None and fault.kind == KIND_KUBE_REQ_DISCONNECT:

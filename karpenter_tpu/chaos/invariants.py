@@ -266,6 +266,56 @@ def check_degrade_monotone(resilience: "dict | None") -> "list[Violation]":
     return out
 
 
+def check_exactly_once_launch(cloud) -> "list[Violation]":
+    """Exactly-once launch across restart: no machine name may ever own
+    two live cloud instances. The crash drill's sharpest edge — a fleet
+    call that ran, a process that died before recording it, and a reborn
+    leader that must adopt-or-reap, never relaunch on top."""
+    from ..providers.instance import TAG_MACHINE
+
+    owners: "dict[str, list[str]]" = {}
+    with cloud.lock:
+        for inst in cloud.instances.values():
+            if inst.state == "terminated":
+                continue
+            machine = inst.tags.get(TAG_MACHINE, "")
+            if machine:
+                owners.setdefault(machine, []).append(inst.id)
+    return [
+        Violation("exactly-once-launch",
+                  f"machine {name} owns {len(iids)} live instances: "
+                  f"{sorted(iids)}")
+        for name, iids in sorted(owners.items()) if len(iids) > 1
+    ]
+
+
+def check_journal_resolved(op) -> "list[Violation]":
+    """Every write-ahead intent record reaches a terminal state: at
+    quiescence the journal is empty — nothing is in flight, so nothing may
+    still claim to be."""
+    journal = getattr(op, "journal", None)
+    if journal is None:
+        return []
+    return [
+        Violation("journal-resolved",
+                  f"intent record {rec.name} (epoch {rec.epoch}) still "
+                  "pending at quiescence")
+        for rec in journal.pending()
+    ]
+
+
+def check_fencing(attempts: int, rejected: int) -> "list[Violation]":
+    """Fencing rejects zombie writes: every mutation a deposed ex-leader
+    attempted after the epoch advanced must have been refused by the
+    store."""
+    if attempts == rejected:
+        return []
+    return [Violation(
+        "fencing-rejects-zombie-writes",
+        f"{attempts - rejected} of {attempts} zombie write(s) were accepted "
+        "after the fencing epoch advanced")]
+
+
 def check_all(op, cloud, token_launches=None,
               consolidation_actions=None,
               resilience=None) -> "list[Violation]":

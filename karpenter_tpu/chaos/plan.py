@@ -31,6 +31,8 @@ KIND_SOLVER_CRASH = "solver-crash"        # sidecar dies mid-Solve (SolverUnavai
 # environment layer
 KIND_SPOT_BURST = "spot-burst"            # interruption warnings for running spot
 KIND_CLOCK_SKEW = "clock-skew"            # fake clock jumps forward
+# process layer
+KIND_CRASH = "crash"                      # process dies at a named crashpoint
 
 LAYER_OF_KIND = {
     KIND_CLOUD_5XX: "cloud",
@@ -43,6 +45,7 @@ LAYER_OF_KIND = {
     KIND_SOLVER_CRASH: "solver",
     KIND_SPOT_BURST: "environment",
     KIND_CLOCK_SKEW: "environment",
+    KIND_CRASH: "process",
 }
 
 # -- sites -------------------------------------------------------------------
@@ -65,6 +68,17 @@ CYCLE_SITES = {
     "cycle.clock": (KIND_CLOCK_SKEW,),
     "cycle.watch": (KIND_KUBE_WATCH_RESET,),
 }
+
+
+def crash_sites() -> "dict[str, tuple]":
+    """Call-indexed sites for the crash drill: one per named crashpoint
+    (recovery/crashpoints.py CRASHPOINTS), armed only by FaultPlan.crash —
+    from_seed never schedules process death, so the standard sweeps keep
+    their in-process convergence semantics."""
+    from ..recovery.crashpoints import CRASHPOINTS
+
+    return {f"crash.{site}": (KIND_CRASH,) for site in CRASHPOINTS}
+
 
 SITES = tuple(sorted(list(CALL_SITES) + list(CYCLE_SITES)))
 
@@ -179,6 +193,18 @@ class FaultPlan:
                 per[idx] = FaultSpec(site, idx, kind, param)
             faults[site] = per
         return cls(seed, scenario, faults)
+
+    @classmethod
+    def crash(cls, seed: int, site: str, scenario: int = 0,
+              index: int = 0) -> "FaultPlan":
+        """The crash-drill schedule: the process dies exactly once, at the
+        named crashpoint's `index`-th reach. Fixed by construction — the
+        drill's job is proving each in-flight-intent site recovers, so the
+        kill site is the scenario's identity and the seed only varies the
+        derived workload."""
+        full = f"crash.{site}"
+        return cls(seed, scenario,
+                   {full: {index: FaultSpec(full, index, KIND_CRASH)}})
 
     @classmethod
     def burst(cls, seed: int, scenario: int = 0) -> "FaultPlan":

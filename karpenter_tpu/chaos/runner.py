@@ -19,6 +19,17 @@ a JSON-serializable dict. Everything inside a scenario dict is a pure
 function of (seed, scenario) — that is the replay contract the tests
 assert — so volatile fields (wall-clock duration) live only at the
 artifact top level.
+
+Crash mode (`crash=True`, `make chaos-crash`) swaps the fault sweep for
+the crash–restart drill: one scenario per named crashpoint
+(recovery/crashpoints.py) kills incarnation 1 mid-action via
+SimulatedCrash, discards its object graph while the kube/cloud/queue
+stores survive, boots a fresh operator against those stores, and runs
+the recovery sequence (epoch mint -> hydration -> journal replay) plus
+the recovery invariants — exactly-once launch, journal-resolved-within-K,
+no orphans, write-ahead ordering. A final two-replica scenario drives a
+leader crash through the real LeaderElector and proves fencing rejects
+the zombie ex-leader's late writes.
 """
 
 from __future__ import annotations
@@ -66,7 +77,7 @@ class ChaosRunner:
 
     def __init__(self, seed: int, scenarios: int = 1, wire: bool = False,
                  intensity: float = 1.0, out_dir: "str | None" = None,
-                 burst: bool = False):
+                 burst: bool = False, crash: bool = False):
         self.seed = seed
         self.scenarios = scenarios
         self.wire = wire
@@ -77,6 +88,9 @@ class ChaosRunner:
         # resilience plane (breakers, budgets, ladders) hard enough for
         # its invariants to have teeth
         self.burst = burst
+        # crash mode runs the crash–restart recovery drill instead of the
+        # fault sweep (one scenario per crashpoint + the failover drill)
+        self.crash = crash
         # diagnostics bundles auto-dumped by failed scenarios (volatile:
         # paths depend on out_dir, so they live at the artifact top level,
         # never inside a scenario dict)
@@ -84,31 +98,56 @@ class ChaosRunner:
 
     # -- assembly --------------------------------------------------------------
 
-    def _build(self, clock: FakeClock):
-        catalog = chaos_catalog()
-        cloud = FakeCloud(catalog=catalog, clock=clock)
+    def _build(self, clock: FakeClock, kube=None, cloud=None, queue=None,
+               leader_elect: bool = False, identity: "str | None" = None,
+               name_suffix: "str | None" = None):
+        """Assemble an operator. Passing surviving `kube`/`cloud`/`queue`
+        stores is the crash drill's rebirth: the object graph is brand new,
+        the durable state is whatever the dead incarnation left behind —
+        so the nodetemplate/provisioner bootstrap writes are guarded.
+        `name_suffix` replaces the random machine-name suffix: the crash
+        artifact embeds machine names (journal keys, replay ledger), so the
+        drill pins a deterministic, per-incarnation-unique one."""
+        catalog = cloud.catalog if cloud is not None else chaos_catalog()
+        if cloud is None:
+            cloud = FakeCloud(catalog=catalog, clock=clock)
         settings = Settings(cluster_name="chaos",
                             cluster_endpoint="https://chaos.example",
                             batch_idle_duration=0.0, batch_max_duration=0.0,
                             interruption_queue_name="chaos-q")
-        op = Operator(cloud, settings, catalog, clock=clock)
-        op.kube.create("nodetemplates", "default", NodeTemplate(
-            name="default",
-            subnet_selector={
-                "id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"},
-            security_group_selector={"id": "sg-default"}))
+        op = Operator(cloud, settings, catalog, kube=kube, clock=clock,
+                      queue=queue, leader_elect=leader_elect,
+                      identity=identity)
+        if name_suffix:
+            op.provisioning._name_suffix = name_suffix
+        if op.kube.get("nodetemplates", "default") is None:
+            op.kube.create("nodetemplates", "default", NodeTemplate(
+                name="default",
+                subnet_selector={
+                    "id": "subnet-zone-1a,subnet-zone-1b,subnet-zone-1c"},
+                security_group_selector={"id": "sg-default"}))
         op.cloudprovider.register_nodetemplate(
             op.kube.get("nodetemplates", "default"))
+        if op.kube.get("provisioners", "default") is None:
+            op.kube.create("provisioners", "default",
+                           self._chaos_provisioner())
+        return op, cloud
+
+    def _chaos_provisioner(self, instance_types=None,
+                           capacity_types=None) -> Provisioner:
+        reqs = [(wk.LABEL_CAPACITY_TYPE, OP_IN,
+                 list(capacity_types) if capacity_types else
+                 [wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND])]
+        if instance_types:
+            reqs.append((wk.LABEL_INSTANCE_TYPE, OP_IN,
+                         list(instance_types)))
         prov = Provisioner(
             name="default", provider_ref="default",
             consolidation_enabled=True,
-            requirements=Requirements.of(
-                (wk.LABEL_CAPACITY_TYPE, OP_IN,
-                 [wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND])))
+            requirements=Requirements.of(*reqs))
         prov.set_defaults()
         prov.validate()
-        op.kube.create("provisioners", "default", prov)
-        return op, cloud
+        return prov
 
     def _workload(self, plan: FaultPlan) -> "dict[str, dict]":
         """Derive the steady workload from the plan's PRNG family so every
@@ -275,9 +314,397 @@ class ChaosRunner:
             "passed": not violations,
         }
 
+    # -- crash drill -----------------------------------------------------------
+
+    CRASH_MAX_CYCLES = 24  # cycles granted for the crashpoint to be reached
+
+    # crashpoints the initial workload's own provisioning walks into; the
+    # teardown-family sites need a settled cluster plus one staged action
+    _LAUNCH_SITES = ("fleet.pre_dispatch", "launch.pre_register",
+                     "launch.mid_bind")
+
+    def _crash_workload(self, site: str, plan: FaultPlan) -> "dict[str, dict]":
+        if site == "deprovisioning.mid_replace":
+            # one small pod pinned onto m.large: widening the provisioner
+            # later makes the t.small replace a certainty, so the staged
+            # consolidation deterministically reaches the crashpoint
+            return {"w0": {"cpu": "500m", "memory": "1Gi"}}
+        return self._workload(plan)
+
+    def _stage_crash_trigger(self, op, cloud, site: str, injector) -> bool:
+        """Stage the action that walks into the armed crashpoint. Returns
+        True once staged (launch-family sites need nothing staged)."""
+        if site in self._LAUNCH_SITES:
+            return True
+        if not self._quiescent(op):
+            return False
+        with injector.paused():
+            if site == "termination.mid_delete":
+                op.termination.request_deletion(sorted(op.cluster.nodes)[0])
+            elif site == "deprovisioning.mid_replace":
+                # widen the pinned provisioner: consolidation now sees the
+                # cheaper t.small and stages a replace
+                op.kube.update("provisioners", "default",
+                               self._chaos_provisioner())
+            elif site == "interruption.pre_ack":
+                with cloud.lock:
+                    running = sorted(i.id for i in cloud.instances.values()
+                                     if i.state == "running")
+                op.queue.send(json.dumps({
+                    "source": "cloud.spot",
+                    "detail-type": "Spot Instance Interruption Warning",
+                    "detail": {"instance-id": running[0]}}))
+        return True
+
+    def _recover_and_settle(self, op2, workload, injector, clock,
+                            errors) -> "tuple[list, list, int]":
+        """The reborn operator's first breaths, exactly as start() runs
+        them: epoch mint -> machine hydration -> journal replay, then the
+        replay-budget window, then settle + GC. Returns (replay ledger,
+        stale-records-after-budget, settle cycles)."""
+        from ..recovery import RecoveryManager
+
+        epoch = op2.recovery.begin_incarnation()
+        op2.machinehydration.reconcile_once()
+        replay = op2.recovery.replay()
+        for _ in range(RecoveryManager.REPLAY_BUDGET_CYCLES):
+            self._drive_once(op2, errors)
+            self._reconcile_workload(op2, workload, injector)
+            clock.step(self.CYCLE_SECONDS)
+        stale = [r.name for r in op2.journal.pending(before_epoch=epoch)]
+        settle_cycles = 0
+        for _ in range(self.SETTLE_DEADLINE):
+            settle_cycles += 1
+            self._drive_once(op2, errors)
+            self._reconcile_workload(op2, workload, injector)
+            clock.step(self.CYCLE_SECONDS)
+            if self._quiescent(op2):
+                break
+        for _ in range(2):
+            clock.step(360.0)
+            self._drive_once(op2, errors)
+        for _ in range(6):
+            self._drive_once(op2, errors)
+            self._reconcile_workload(op2, workload, injector)
+            clock.step(self.CYCLE_SECONDS)
+            if self._quiescent(op2):
+                break
+        return replay, stale, settle_cycles
+
+    def _crash_verdict(self, op2, cloud, site, crash, pending_at_rebirth,
+                       stale_after_budget) -> "list":
+        from ..recovery import RecoveryManager
+
+        violations = invariants.check_all(
+            op2, cloud, resilience=op2.resilience.evidence())
+        violations += invariants.check_exactly_once_launch(cloud)
+        violations += invariants.check_journal_resolved(op2)
+        if crash is None:
+            violations.append(invariants.Violation(
+                "crashpoint-reached",
+                f"crashpoint {site} was never reached — the drill proved "
+                "nothing"))
+        if not pending_at_rebirth:
+            violations.append(invariants.Violation(
+                "journal-write-ahead",
+                f"no intent record was pending when the process died at "
+                f"{site} — the write-ahead ordering is broken"))
+        if stale_after_budget:
+            violations.append(invariants.Violation(
+                "journal-replay-budget",
+                f"prior-epoch records {stale_after_budget} still pending "
+                f"{RecoveryManager.REPLAY_BUDGET_CYCLES} cycles after "
+                "replay"))
+        if not self._quiescent(op2):
+            violations.insert(0, invariants.Violation(
+                "quiescence",
+                "reborn operator never reached quiescence before the step "
+                "deadline"))
+        return violations
+
+    def _crash_bundle(self, op2, scenario: int, tag: str, violations) -> None:
+        if not (violations and self.out_dir):
+            return
+        os.makedirs(self.out_dir, exist_ok=True)
+        path = os.path.join(
+            self.out_dir,
+            f"chaos_crash_seed{self.seed}_s{scenario}_bundle.json")
+        written = op2.flightrecorder.trigger(
+            f"chaos_crash_{tag}",
+            detail="; ".join(f"[{v.invariant}] {v.message}"
+                             for v in violations)[:500],
+            force=True, path=path)
+        if written:
+            self._bundles.append(written)
+
+    def run_crash_site(self, site: str, scenario: int) -> dict:
+        """One crashpoint drill: drive incarnation 1 into the armed site,
+        let SimulatedCrash tear it off the drive stack, discard its object
+        graph, and boot incarnation 2 against the surviving stores."""
+        from ..recovery import SimulatedCrash
+
+        plan = FaultPlan.crash(self.seed, site, scenario)
+        injector = ChaosInjector(plan)
+        clock = FakeClock()
+        op, cloud = self._build(clock, name_suffix=f"c{scenario}a")
+        op.resilience.use_virtual_sleep()
+        workload = self._crash_workload(site, plan)
+        errors: "list[str]" = []
+        crash = None
+        crash_cycle = -1
+        ops = [op]
+        try:
+            injector.tune_operator(op)
+            injector.install_crash()
+            if site == "deprovisioning.mid_replace":
+                # pin to on-demand m.large: spot candidates consolidate by
+                # deletion only (capacity-optimized allocation), so a spot
+                # node could never stage the replace this drill needs
+                op.kube.update("provisioners", "default",
+                               self._chaos_provisioner(
+                                   ["m.large"],
+                                   [wk.CAPACITY_TYPE_ON_DEMAND]))
+            # incarnation 1 boots exactly like start(): epoch, then cycles
+            op.recovery.begin_incarnation()
+            epoch1 = op.recovery.epoch
+            self._reconcile_workload(op, workload, injector)
+            staged = False
+            for cycle in range(self.CRASH_MAX_CYCLES):
+                try:
+                    staged = staged or self._stage_crash_trigger(
+                        op, cloud, site, injector)
+                    self._drive_once(op, errors)
+                except SimulatedCrash as e:
+                    crash, crash_cycle = e, cycle
+                    break
+                self._reconcile_workload(op, workload, injector)
+                clock.step(self.CYCLE_SECONDS)
+
+            # the "process" is dead: faults disarm, the object graph goes
+            # away, the kube/cloud/queue stores survive
+            injector.enabled = False
+            ops.remove(op)
+            op.stop()
+            op2, _ = self._build(clock, kube=op.kube, cloud=cloud,
+                                 queue=getattr(op, "queue", None),
+                                 name_suffix=f"c{scenario}b")
+            ops.append(op2)
+            op2.resilience.use_virtual_sleep()
+            injector.tune_operator(op2)
+            pending_at_rebirth = [r.name for r in op2.journal.pending()]
+            replay, stale_after_budget, settle_cycles = \
+                self._recover_and_settle(op2, workload, injector, clock,
+                                         errors)
+            violations = self._crash_verdict(
+                op2, cloud, site, crash, pending_at_rebirth,
+                stale_after_budget)
+            deduped = (op2.interruption.deduped_count
+                       if op2.interruption is not None else 0)
+            if site == "interruption.pre_ack" and deduped < 1:
+                violations.append(invariants.Violation(
+                    "interruption-redelivery-dedupe",
+                    "the queue redelivered the unacked message but the "
+                    "reborn consumer never deduplicated it"))
+            self._crash_bundle(op2, scenario, "invariant_breach", violations)
+        finally:
+            injector.uninstall_crash()
+            for o in ops:
+                o.stop()
+
+        return {
+            "seed": self.seed,
+            "scenario": scenario,
+            "drill": f"crash:{site}",
+            "site": site,
+            "workload_pods": len(workload),
+            "plan": plan.describe(),
+            "crashed": crash is not None,
+            "crash_cycle": crash_cycle,
+            "epochs": {"crashed": epoch1, "reborn": op2.recovery.epoch},
+            "pending_at_rebirth": pending_at_rebirth,
+            "replay": replay,
+            "interruption_deduped": deduped,
+            "controller_errors": errors,
+            "settle_cycles": settle_cycles,
+            "final_nodes": len(op2.cluster.nodes),
+            "violations": [v.as_dict() for v in violations],
+            "passed": not violations,
+        }
+
+    def run_crash_failover(self, scenario: int) -> dict:
+        """Two-replica drill: the leader crashes mid-launch without
+        releasing its lease; the standby takes over through the real
+        LeaderElector once the TTL lapses, replays the stranded intent,
+        and the store must fence out every late write the zombie
+        ex-leader still believes it may make."""
+        from ..fake.kube import Fenced
+        from ..recovery import SimulatedCrash
+
+        site = "launch.pre_register"
+        plan = FaultPlan.crash(self.seed, site, scenario)
+        injector = ChaosInjector(plan)
+        clock = FakeClock()
+        op_a, cloud = self._build(clock, leader_elect=True, identity="op-a",
+                                  name_suffix=f"c{scenario}a")
+        store = op_a.leader.kube  # the raw store (electors mint epochs on it)
+        op_b, _ = self._build(clock, kube=store, cloud=cloud,
+                              leader_elect=True, identity="op-b",
+                              name_suffix=f"c{scenario}b")
+        for o in (op_a, op_b):
+            o.resilience.use_virtual_sleep()
+            injector.tune_operator(o)
+        workload = self._workload(plan)
+        errors: "list[str]" = []
+        crash = None
+        ops = [op_a, op_b]
+        try:
+            injector.install_crash()
+            # manual election ticks (no threads): op-a leads first, and its
+            # _on_started_leading callback runs the recovery sequence
+            assert op_a.leader.try_acquire_or_renew()
+            epoch_a = op_a.leader.fencing_token()
+            self._reconcile_workload(op_a, workload, injector)
+            for _ in range(self.CRASH_MAX_CYCLES):
+                try:
+                    self._drive_once(op_a, errors)
+                except SimulatedCrash as e:
+                    crash = e
+                    break
+                self._reconcile_workload(op_a, workload, injector)
+                clock.step(self.CYCLE_SECONDS)
+
+            injector.enabled = False
+            # HARD kill: no release, the lease dangles until the TTL lapses
+            clock.step(op_a.leader.lease_duration_s + 1.0)
+            assert op_b.leader.try_acquire_or_renew()  # runs recovery hooks
+            epoch_b = op_b.leader.fencing_token()
+            replay = list(op_b.recovery.replayed)
+            pending_after_replay = [r.name for r in op_b.journal.pending(
+                before_epoch=op_b.recovery.epoch)]
+
+            # the zombie still believes it leads (its elector never ticked
+            # again): every late write must bounce off the fence
+            zombie_attempts = 0
+            zombie_rejected = 0
+            rejected_before = store.fenced_writes_rejected
+            with injector.paused():
+                for probe in (
+                        lambda: op_a.kube.create(
+                            "configmaps", "zombie-probe", {"from": "op-a"}),
+                        lambda: op_a.kube.delete("pods",
+                                                 sorted(workload)[0])):
+                    zombie_attempts += 1
+                    try:
+                        probe()
+                    except Fenced:
+                        zombie_rejected += 1
+            store_rejections = store.fenced_writes_rejected - rejected_before
+
+            # now the zombie's object graph goes away for real (the elector
+            # thread never ran, so stop() performs no graceful release —
+            # exactly the hard-kill semantics the drill wants)
+            ops.remove(op_a)
+            op_a.stop()
+            settle_cycles = 0
+            for _ in range(self.SETTLE_DEADLINE):
+                settle_cycles += 1
+                self._drive_once(op_b, errors)
+                self._reconcile_workload(op_b, workload, injector)
+                clock.step(self.CYCLE_SECONDS)
+                if self._quiescent(op_b):
+                    break
+
+            violations = invariants.check_all(
+                op_b, cloud, resilience=op_b.resilience.evidence())
+            violations += invariants.check_exactly_once_launch(cloud)
+            violations += invariants.check_journal_resolved(op_b)
+            violations += invariants.check_fencing(zombie_attempts,
+                                                   zombie_rejected)
+            if crash is None:
+                violations.append(invariants.Violation(
+                    "crashpoint-reached",
+                    "the leader never reached the armed crashpoint"))
+            if not (epoch_a and epoch_b and epoch_b > epoch_a):
+                violations.append(invariants.Violation(
+                    "fencing-epoch-monotone",
+                    f"takeover epoch {epoch_b} is not strictly above the "
+                    f"crashed leader's {epoch_a}"))
+            if not replay:
+                violations.append(invariants.Violation(
+                    "journal-write-ahead",
+                    "the new leader found nothing to replay after a "
+                    "mid-launch leader crash"))
+            if pending_after_replay:
+                violations.append(invariants.Violation(
+                    "journal-replay-budget",
+                    f"prior-epoch records {pending_after_replay} survived "
+                    "the takeover replay"))
+            if not self._quiescent(op_b):
+                violations.insert(0, invariants.Violation(
+                    "quiescence",
+                    "the new leader never reached quiescence before the "
+                    "step deadline"))
+            self._crash_bundle(op_b, scenario, "failover_breach", violations)
+        finally:
+            injector.uninstall_crash()
+            for o in ops:
+                o.stop()
+
+        return {
+            "seed": self.seed,
+            "scenario": scenario,
+            "drill": "crash:leader-failover",
+            "site": site,
+            "workload_pods": len(workload),
+            "plan": plan.describe(),
+            "crashed": crash is not None,
+            "epochs": {"crashed": epoch_a, "reborn": epoch_b},
+            "fence_epoch": store.fence_epoch(),
+            "zombie_writes": {"attempted": zombie_attempts,
+                              "rejected": zombie_rejected,
+                              "store_rejections": store_rejections},
+            "replay": replay,
+            "controller_errors": errors,
+            "settle_cycles": settle_cycles,
+            "final_nodes": len(op_b.cluster.nodes),
+            "violations": [v.as_dict() for v in violations],
+            "passed": not violations,
+        }
+
+    def run_crash_drill(self) -> dict:
+        from ..recovery import CRASHPOINTS
+
+        t0 = time.time()
+        self._bundles = []
+        scenarios = [self.run_crash_site(site, i)
+                     for i, site in enumerate(CRASHPOINTS)]
+        scenarios.append(self.run_crash_failover(len(CRASHPOINTS)))
+        artifact = {
+            "tool": "karpenter_tpu.chaos",
+            "mode": "crash",
+            "seed": self.seed,
+            "crashpoints": list(CRASHPOINTS),
+            "scenario_count": len(scenarios),
+            "passed": all(s["passed"] for s in scenarios),
+            "scenarios": scenarios,
+            # volatile fields below this line only (replay contract)
+            "duration_s": round(time.time() - t0, 3),
+            "bundles": list(self._bundles),
+        }
+        if self.out_dir:
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = os.path.join(self.out_dir,
+                                f"chaos_crash_seed{self.seed}.json")
+            with open(path, "w") as f:
+                json.dump(artifact, f, indent=2, sort_keys=True)
+            artifact["artifact_path"] = path
+        return artifact
+
     # -- artifact --------------------------------------------------------------
 
     def run(self) -> dict:
+        if self.crash:
+            return self.run_crash_drill()
         t0 = time.time()
         self._bundles = []
         scenarios = [self.run_scenario(s) for s in range(self.scenarios)]

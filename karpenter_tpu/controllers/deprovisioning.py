@@ -23,6 +23,8 @@ from ..models.cluster import ClusterState
 from ..introspect.watchdog import cycle as _wd_cycle
 from ..ops.consolidate import run_consolidation
 from ..oracle.consolidation import find_consolidation
+from ..recovery.crashpoints import crashpoint
+from ..recovery.journal import REPLACE, TERMINATION
 from ..resilience import DegradeLadder, deadline
 from ..tracing import TRACER
 from ..utils.clock import Clock
@@ -51,9 +53,11 @@ class DeprovisioningController:
                  provisioning=None,
                  remote_consolidator=None,
                  watchdog=None,
-                 resilience=None):
+                 resilience=None,
+                 journal=None):
         self.kube = kube
         self.watchdog = watchdog
+        self.journal = journal
         self.cloudprovider = cloudprovider
         self.cluster = cluster
         self.termination = termination
@@ -292,9 +296,22 @@ class DeprovisioningController:
             # two-phase replace: launch now, drain once the replacement is
             # initialized (consolidation.md: "when it is ready, delete the
             # existing node") — pods never pass through a pending window
+            if self.journal is not None:
+                # write-ahead: the replace state machine otherwise lives only
+                # in _pending_replace (process memory) — a crash between the
+                # replacement launch and the old nodes' marks would leak a
+                # node no reborn controller remembers launching
+                self.journal.record(REPLACE, action.node, {
+                    "nodes": list(action.nodes), "replacement": None})
             replacement = self._launch_replacement(action)
             if replacement is None:
+                self._resolve_replace(action, "aborted")
                 return None
+            if self.journal is not None:
+                self.journal.record(REPLACE, action.node, {
+                    "nodes": list(action.nodes),
+                    "replacement": replacement.name})
+            crashpoint("deprovisioning.mid_replace")
             self.recorder.normal(
                 f"node/{action.node}", "ConsolidationReplace",
                 f"launched replacement {replacement.name} "
@@ -370,11 +387,20 @@ class DeprovisioningController:
                         self.kube.uncordon_node(done)
                     except Exception as e:
                         log.warning("uncordon %s failed: %s", done, e)
+                    if self.termination.journal is not None:
+                        # the aborted mark's write-ahead record must go with
+                        # it, or a reborn leader re-kills the rolled-back node
+                        self.termination.journal.resolve(
+                            TERMINATION, done, outcome="aborted")
                 log.warning("consolidation aborted: %s not deletable", n)
                 return False
             if status == self.termination.MARKED_NEW:
                 newly_marked.append(n)
         return True
+
+    def _resolve_replace(self, action, outcome: str) -> None:
+        if self.journal is not None:
+            self.journal.resolve(REPLACE, action.node, outcome=outcome)
 
     def _record_action(self, action, now: float, label: str = "") -> None:
         suffix = "-multi" if len(action.nodes) > 1 else ""
@@ -429,6 +455,7 @@ class DeprovisioningController:
             log.warning("replacement %s gone or terminating; abandoning "
                         "replace", rep_name)
             self._pending_replace = None
+            self._resolve_replace(action, "abandoned")
             self._last_action_ts = now
             return None
         if rep.initialized:
@@ -438,9 +465,11 @@ class DeprovisioningController:
                 # cluster moved under us (new pods bound to the old nodes /
                 # members no longer drainable): roll the replacement back
                 self.termination.request_deletion(rep_name)
+                self._resolve_replace(action, "rolled_back")
                 self._last_action_ts = now
                 return None
             self._record_action(action, now)
+            self._resolve_replace(action, "completed")
             return action
         if now - pr["started_ts"] >= self.REPLACE_INIT_TIMEOUT_S:
             log.warning("replacement %s not initialized within %.0fs; "
@@ -449,6 +478,7 @@ class DeprovisioningController:
                                   "replacement failed to initialize; rolled back")
             self.termination.request_deletion(rep_name)
             self._pending_replace = None
+            self._resolve_replace(action, "rolled_back")
             self._last_action_ts = now
         return None
 

@@ -30,6 +30,7 @@ from ...events import EventRecorder
 from ...introspect.watchdog import cycle as _wd_cycle
 from ...metrics import NAMESPACE, REGISTRY, Registry
 from ...models.cluster import ClusterState
+from ...recovery.crashpoints import crashpoint
 from ...utils.clock import Clock
 
 log = logging.getLogger("karpenter.interruption")
@@ -165,8 +166,54 @@ class InterruptionController:
             "Messages drained per second, per receive batch "
             "(handle + delete, wall time).",
             buckets=(50, 100, 250, 500, 1000, 2500, 5000, 10000))
+        self.deduped = reg.counter(
+            f"{NAMESPACE}_interruption_deduped_messages_total",
+            "Redelivered interruption messages skipped by the dedupe set.")
+        # receipt -> handled-at timestamp, persisted through the kube store:
+        # the at-least-once queue redelivers a message whose handler ran but
+        # whose ack was lost to a crash — a REBORN consumer must recognize
+        # it (the in-memory inflight map died with the process)
+        self._dedupe: "Optional[dict]" = None
+        self._dedupe_lock = threading.Lock()
+        self.deduped_count = 0
         self._pool = ThreadPoolExecutor(max_workers=parallelism,
                                         thread_name_prefix="interruption")
+
+    DEDUPE_NAME = "interruption-dedupe"
+    DEDUPE_CAP = 512  # bounded: visibility timeouts expire long before this
+
+    def _dedupe_map(self) -> dict:
+        """Lazy-loaded on first use so a reborn consumer picks up the set a
+        prior incarnation persisted. Caller holds _dedupe_lock."""
+        if self._dedupe is None:
+            stored = self.kube.get("configmaps", self.DEDUPE_NAME)
+            if isinstance(stored, dict):
+                # HttpKubeStore round-trips configmaps as {"data": {...}}
+                stored = stored.get("data", stored)
+            self._dedupe = dict(stored) if isinstance(stored, dict) else {}
+        return self._dedupe
+
+    def _is_duplicate(self, receipt: str) -> bool:
+        if not receipt:
+            return False
+        with self._dedupe_lock:
+            return receipt in self._dedupe_map()
+
+    def _mark_handled(self, receipt: str) -> None:
+        """Persist the receipt BEFORE the ack: crash-between means the
+        redelivered copy is skipped, not re-acted-on (at-least-once queue +
+        this set = effectively-once actions)."""
+        if not receipt:
+            return
+        with self._dedupe_lock:
+            m = self._dedupe_map()
+            m[receipt] = self.clock.now()
+            while len(m) > self.DEDUPE_CAP:
+                m.pop(min(m, key=m.get))
+            try:
+                self.kube.update("configmaps", self.DEDUPE_NAME, dict(m))
+            except Exception as e:
+                log.warning("persisting interruption dedupe set failed: %s", e)
 
     def reconcile_once(self, wait_seconds: float = 0.0) -> int:
         with _wd_cycle(self.watchdog, "interruption"):
@@ -198,6 +245,15 @@ class InterruptionController:
         """instance-id -> node resolution uses the cluster's incrementally
         maintained index (vs makeInstanceIDMap's per-poll rebuild,
         controller.go:236-255 — O(1) per message at any cluster size)."""
+        if self._is_duplicate(qmsg.receipt):
+            # redelivery of a message a prior incarnation handled but never
+            # acked (crash between handle and delete): acting again would
+            # double-fire the termination — ack and skip
+            self.deduped.inc()
+            self.deduped_count += 1
+            self.queue.delete(qmsg.receipt)
+            self.deleted.inc()
+            return
         msg = self.parsers.parse(qmsg.body, qmsg.receipt, qmsg.enqueued_at)
         self.received.inc(message_type=msg.kind)
         if msg.enqueued_at:
@@ -231,6 +287,8 @@ class InterruptionController:
                         f"node/{node_name}", msg.kind,
                         f"advisory interruption event for instance {iid}")
                 self.actions.inc(action=ACTION_NOOP)
+        self._mark_handled(qmsg.receipt)
+        crashpoint("interruption.pre_ack")
         self.queue.delete(qmsg.receipt)
         self.deleted.inc()
 

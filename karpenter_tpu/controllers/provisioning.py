@@ -31,6 +31,8 @@ from ..models.pod import PodSpec
 from ..models.requirements import IncompatibleError, Requirement, Requirements, OP_IN
 from ..oracle.scheduler import Scheduler
 from ..introspect.watchdog import cycle as _wd_cycle
+from ..recovery.crashpoints import crashpoint
+from ..recovery.journal import LAUNCH
 from ..resilience import DegradeLadder, deadline
 from ..solver.core import NativeSolver, SolveResult, TPUSolver
 from ..tracing import TRACER
@@ -58,9 +60,11 @@ class ProvisioningController:
         launch_workers: int = 10,
         watchdog=None,
         resilience=None,
+        journal=None,
     ):
         self.kube = kube
         self.watchdog = watchdog
+        self.journal = journal
         self.cloudprovider = cloudprovider
         self.cluster = cluster
         self.settings = settings
@@ -378,8 +382,20 @@ class ProvisioningController:
         # MaxConcurrentReconciles=10)
         futures = [self._pool.submit(self._launch_node, solved, take, result)
                    for solved, take in zip(result.nodes, assignments)]
+        # Drain EVERY worker before letting a crash propagate: _launch_node
+        # absorbs Exceptions itself, so only BaseException (SimulatedCrash,
+        # ^C) reaches result() — and abandoning the remaining futures would
+        # leave a worker thread mutating the store/cloud while the stack
+        # unwinds (in the crash drill: a zombie launch racing the reborn
+        # leader's replay).
+        crash = None
         for f in futures:
-            f.result()
+            try:
+                f.result()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                crash = crash or e
+        if crash is not None:
+            raise crash
         unsched = result.unschedulable_count()
         self.pods_unschedulable.set(unsched)
         if unsched:
@@ -478,19 +494,29 @@ class ProvisioningController:
             ),
             labels={wk.LABEL_PROVISIONER: prov.name, **dict(prov.labels)},
         )
+        if self.journal is not None:
+            # write-ahead: a crash anywhere between here and resolve would
+            # otherwise strand a cloud instance (or a half-registered node)
+            # until the registration-TTL sweep notices
+            self.journal.record(LAUNCH, name, {
+                "machine": name, "provisioner": prov.name})
         try:
             self.kube.create("machines", name, machine)
             machine = self.cloudprovider.create(machine)
+            crashpoint("launch.pre_register")
             self.kube.update("machines", name, machine)
         except Exception as e:
             log.warning("machine %s launch failed: %s", name, e)
             self.recorder.warning(f"machine/{name}", "LaunchFailed", str(e))
             try:
                 self.kube.delete("machines", name)
+                if self.journal is not None:
+                    self.journal.resolve(LAUNCH, name, outcome="aborted")
             except Exception as cleanup_err:
                 # a lost cleanup write must not mask the launch failure; the
                 # stranded machine is reaped by the registration-TTL liveness
-                # sweep (machinelifecycle)
+                # sweep (machinelifecycle) — and the UNRESOLVED journal
+                # record lets a reborn leader roll it back immediately
                 log.warning("cleanup of failed machine %s deferred to "
                             "registration TTL: %s", name, cleanup_err)
             return None
@@ -515,12 +541,15 @@ class ProvisioningController:
         )
         self.cluster.add_node(node)
         self.kube.create("nodes", node.name, node)
+        crashpoint("launch.mid_bind")
         self.nodes_created.inc(provisioner=prov.name)
         self.recorder.normal(f"machine/{name}", "Launched",
                              f"launched {machine.status.instance_type} in "
                              f"{machine.status.zone}")
         # bind this node's pods
         self._bind_assigned(assigned, node.name)
+        if self.journal is not None:
+            self.journal.resolve(LAUNCH, name)
         return node
 
     def _machine_requests(self, solved, result: SolveResult) -> "dict[str, int]":

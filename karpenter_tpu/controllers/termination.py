@@ -16,6 +16,8 @@ from ..events import EventRecorder
 from ..introspect.watchdog import cycle as _wd_cycle
 from ..metrics import NAMESPACE, REGISTRY, Registry
 from ..models.cluster import ClusterState, pod_evictable
+from ..recovery.crashpoints import crashpoint
+from ..recovery.journal import TERMINATION
 from ..utils import errors as cloud_errors
 from ..utils.clock import Clock
 
@@ -27,11 +29,12 @@ class TerminationController:
                  clock: Optional[Clock] = None,
                  recorder: Optional[EventRecorder] = None,
                  registry: Optional[Registry] = None,
-                 watchdog=None):
+                 watchdog=None, journal=None):
         self.kube = kube
         self.watchdog = watchdog
         self.cloudprovider = cloudprovider
         self.cluster = cluster
+        self.journal = journal
         self.clock = clock or Clock()
         self.recorder = recorder or EventRecorder(clock=self.clock)
         reg = registry or REGISTRY
@@ -60,6 +63,13 @@ class TerminationController:
             return self.MARKED_ALREADY
         node.marked_for_deletion = True
         node.deletion_requested_ts = self.clock.now()
+        if self.journal is not None:
+            # write-ahead: the mark lives only on the in-memory StateNode —
+            # without this record a crash loses the intent and the node
+            # outlives its deletion request until some sweep notices
+            self.journal.record(TERMINATION, node_name, {
+                "node": node_name, "machine": node.machine_name,
+                "provider_id": node.provider_id})
         try:
             # server-side cordon: on a real cluster kube-scheduler must
             # stop targeting the draining node (spec.unschedulable);
@@ -88,6 +98,7 @@ class TerminationController:
                 machine = self.kube.get("machines", node.machine_name)
                 if machine is not None:
                     self.cloudprovider.delete(machine)
+                    crashpoint("termination.mid_delete")
                     self.kube.delete("machines", node.machine_name)
                 elif node.provider_id:
                     from ..models.machine import parse_provider_id
@@ -100,6 +111,8 @@ class TerminationController:
                     continue
             self.cluster.delete_node(name)
             self.kube.delete("nodes", name)
+            if self.journal is not None:
+                self.journal.resolve(TERMINATION, name)
             self.terminated.inc(provisioner=node.provisioner_name)
             if node.deletion_requested_ts:
                 self.termination_time.observe(

@@ -31,7 +31,7 @@ import urllib.parse
 import urllib.request
 from typing import Callable, Optional
 
-from ..fake.kube import Conflict, KubeStore
+from ..fake.kube import Conflict, Fenced, KubeStore
 from ..metrics import NAMESPACE, REGISTRY
 from . import serde
 
@@ -48,6 +48,7 @@ _API = {
     "machines": ("/apis/karpenter.sh/v1alpha5", "machines", False),
     "nodetemplates": ("/apis/karpenter.k8s.tpu/v1alpha1", "nodetemplates", False),
     "events": ("/api/v1", "events", True),
+    "intents": ("/apis/karpenter.sh/v1alpha5", "intents", False),
 }
 
 
@@ -155,6 +156,9 @@ class HttpKubeStore:
             "Watch streams re-established (any cause incl. clean "
             "server-side timeouts).", ("kind",))
         self._admission = None
+        # fencing high-water mark as advertised by the server on every
+        # response (X-Fencing-Epoch); 0 until the first round trip
+        self._fence_epoch = 0
         self._docs: "dict[tuple[str, str], dict]" = {}  # last manifest seen
         self._rv: "dict[tuple[str, str], int]" = {}     # last rv applied
         self._lock = threading.RLock()
@@ -271,13 +275,18 @@ class HttpKubeStore:
                 pass
 
     def _request_json(self, method, url, body=None,
-                      content_type: str = "application/json"):
+                      content_type: str = "application/json",
+                      epoch: "Optional[int]" = None):
         """Full-body request over the per-thread keep-alive connection.
         The response is always consumed completely, so the socket stays
         reusable; a stale pooled socket (server closed it between calls)
-        gets ONE transparent reconnect."""
+        gets ONE transparent reconnect. `epoch` rides as X-Fencing-Epoch:
+        the server refuses the write (409 Fenced) when it is older than
+        the fencing high-water mark."""
         data = None if body is None else json.dumps(body).encode()
         headers = {"Content-Type": content_type}
+        if epoch is not None:
+            headers["X-Fencing-Epoch"] = str(epoch)
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
         split = urllib.parse.urlsplit(url)
@@ -361,9 +370,18 @@ class HttpKubeStore:
             # budget only ever see transport-level unreachability
             if pol is not None:
                 pol.note_success()
+            fe = resp.getheader("X-Fencing-Epoch")
+            if fe is not None:
+                try:
+                    self._fence_epoch = max(self._fence_epoch, int(fe))
+                except ValueError:
+                    pass
             if resp.status == 409:
                 self.requests_total.inc(method=method, outcome="conflict")
-                raise Conflict(payload.decode(errors="replace")[:300])
+                text = payload.decode(errors="replace")[:300]
+                if '"Fenced"' in text:
+                    raise Fenced(text)
+                raise Conflict(text)
             if resp.status >= 400:
                 self.requests_total.inc(method=method,
                                         outcome=f"http_{resp.status}")
@@ -489,6 +507,13 @@ class HttpKubeStore:
             return self._admission(kind, obj, op)
         return obj
 
+    def fence_epoch(self) -> int:
+        """Highest fencing epoch the server has advertised to this client.
+        Lags the authoritative server-side mark by at most one request —
+        callers minting epochs (LeaderElector) also consult the lease
+        object itself, which the same watch keeps current."""
+        return self._fence_epoch
+
     def get(self, kind: str, name: str):
         return self._cache.get(kind, name)
 
@@ -507,22 +532,26 @@ class HttpKubeStore:
             return out
         return self._cache.list(kind)
 
-    def create(self, kind: str, name: str, obj) -> None:
+    def create(self, kind: str, name: str, obj,
+               epoch: "Optional[int]" = None) -> None:
         obj = self._admit(kind, obj, "CREATE")
         doc = serde.to_manifest(kind, name, obj)
-        created = self._request_json("POST", self._url(kind), doc)
+        created = self._request_json("POST", self._url(kind), doc,
+                                     epoch=epoch)
         self._apply_manifest(kind, "ADDED", created, notify=True)
 
-    def update(self, kind: str, name: str, obj) -> None:
+    def update(self, kind: str, name: str, obj,
+               epoch: "Optional[int]" = None) -> None:
         obj = self._admit(kind, obj, "UPDATE")
         doc = serde.to_manifest(kind, name, obj)
-        updated = self._request_json("PUT", self._url(kind, name), doc)
+        updated = self._request_json("PUT", self._url(kind, name), doc,
+                                     epoch=epoch)
         self._apply_manifest(kind, "MODIFIED", updated, notify=True)
 
-    def delete(self, kind: str, name: str):
+    def delete(self, kind: str, name: str, epoch: "Optional[int]" = None):
         obj = self._cache.get(kind, name)
         try:
-            self._request_json("DELETE", self._url(kind, name))
+            self._request_json("DELETE", self._url(kind, name), epoch=epoch)
         except ApiError as e:
             if e.code != 404:
                 raise
@@ -530,7 +559,8 @@ class HttpKubeStore:
                              {"metadata": {"name": name}}, notify=True)
         return obj
 
-    def compare_and_swap(self, kind: str, name: str, expect, obj) -> None:
+    def compare_and_swap(self, kind: str, name: str, expect, obj,
+                         epoch: "Optional[int]" = None) -> None:
         obj = self._admit(kind, obj, "UPDATE")
         with self._lock:
             cur = self._cache.get(kind, name)
@@ -541,10 +571,12 @@ class HttpKubeStore:
         doc = serde.to_manifest(kind, name, obj)
         if doc_rv is not None:
             doc["metadata"]["resourceVersion"] = doc_rv  # server-side CAS
-        updated = self._request_json("PUT", self._url(kind, name), doc)
+        updated = self._request_json("PUT", self._url(kind, name), doc,
+                                     epoch=epoch)
         self._apply_manifest(kind, "MODIFIED", updated, notify=True)
 
-    def delete_if(self, kind: str, name: str, expect) -> bool:
+    def delete_if(self, kind: str, name: str, expect,
+                  epoch: "Optional[int]" = None) -> bool:
         """Atomic over the wire: the DELETE carries a resourceVersion
         precondition, so a successor's write between our check and the
         delete loses nothing (a lease released late must never clobber the
@@ -558,7 +590,7 @@ class HttpKubeStore:
             self._request_json(
                 "DELETE", self._url(kind, name),
                 None if rv is None else
-                {"preconditions": {"resourceVersion": rv}})
+                {"preconditions": {"resourceVersion": rv}}, epoch=epoch)
         except Conflict:
             return False
         except ApiError as e:
@@ -631,13 +663,14 @@ class HttpKubeStore:
         # echo then dedupes by resourceVersion
         self._apply_manifest("nodes", "MODIFIED", doc, notify=True)
 
-    def bind_pod(self, pod_name: str, node_name: str) -> None:
+    def bind_pod(self, pod_name: str, node_name: str,
+                 epoch: "Optional[int]" = None) -> None:
         self._request_json(
             "POST", self._url("pods", pod_name, sub="binding"),
             {"apiVersion": "v1", "kind": "Binding",
              "metadata": {"name": pod_name},
              "target": {"apiVersion": "v1", "kind": "Node",
-                        "name": node_name}})
+                        "name": node_name}}, epoch=epoch)
         # read-your-writes without waiting for the watch echo
         with self._lock:
             pod = self._cache.get("pods", pod_name)

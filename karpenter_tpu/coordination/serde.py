@@ -43,6 +43,7 @@ ROUTES = {
     "machines": ("karpenter.sh/v1alpha5", "Machine", False),
     "nodetemplates": ("karpenter.k8s.tpu/v1alpha1", "NodeTemplate", False),
     "events": ("v1", "Event", True),
+    "intents": ("karpenter.sh/v1alpha5", "Intent", False),
 }
 
 # registered dataclasses for the tagged generic encoder
@@ -63,6 +64,13 @@ def _register_lease():
 
     _TYPES.setdefault("Lease", Lease)
     return Lease
+
+
+def _register_intent():
+    from ..recovery.journal import IntentRecord
+
+    _TYPES.setdefault("IntentRecord", IntentRecord)
+    return IntentRecord
 
 
 def encode(obj):
@@ -96,6 +104,8 @@ def decode(val):
             name = val["__dc__"]
             if name == "Lease":
                 _register_lease()
+            elif name == "IntentRecord":
+                _register_intent()
             cls = _TYPES[name]
             kwargs = {k: decode(v) for k, v in val.items() if k != "__dc__"}
             return cls(**kwargs)

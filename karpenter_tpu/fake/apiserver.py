@@ -15,7 +15,12 @@ Supported surface (JSON only):
 - optimistic concurrency: PUT with metadata.resourceVersion must match or
   409 (the CAS substrate for leader-election leases);
 - the pod `binding` subresource (POST .../pods/{name}/binding) setting
-  spec.nodeName, 409 when already bound.
+  spec.nodeName, 409 when already bound;
+- fencing (mirror of fake/kube.py): mutating requests may present their
+  leadership epoch in an `X-Fencing-Epoch` header — an epoch older than
+  the server's high-water mark is refused with 409 Fenced before the
+  write applies, and lease documents carrying an `epoch` advance the
+  high-water atomically with the leadership change itself.
 
 State is plural-keyed documents; the server neither validates schemas nor
 runs admission — that stays client/controller-side, exactly where the
@@ -46,6 +51,10 @@ class _State:
         self.objects: "dict[str, dict[str, dict]]" = {}
         self.rv = 0
         self.watchers: "dict[str, list[queue.Queue]]" = {}
+        # fencing: highest leadership epoch any request has presented (or
+        # any lease write has carried); stale writers get 409 Fenced
+        self.fence_epoch = 0
+        self.fenced_writes_rejected = 0
 
     def bucket(self, plural: str) -> "dict[str, dict]":
         return self.objects.setdefault(plural, {})
@@ -85,6 +94,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        # advertise the fencing high-water mark on every response so
+        # clients can track it passively (HttpKubeStore.fence_epoch)
+        self.send_header("X-Fencing-Epoch", str(self.state.fence_epoch))
         self.end_headers()
         self.wfile.write(body)
 
@@ -102,6 +114,35 @@ class _Handler(BaseHTTPRequestHandler):
         if m is None:
             return None
         return m.group("plural"), m.group("name"), m.group("sub"), query
+
+    def _fence_rejects(self, plural: str, name: "str | None",
+                       body: "dict | None" = None) -> bool:
+        """Mirror of KubeStore._check_fence over the wire. Caller holds
+        state.lock. Returns True when the request was refused (the 409 is
+        already on the wire); a fresh epoch advances the high-water mark."""
+        st = self.state
+        hdr = self.headers.get("X-Fencing-Epoch")
+        if hdr is not None:
+            try:
+                epoch = int(hdr)
+            except ValueError:
+                self._error(422, "Invalid",
+                            f"X-Fencing-Epoch {hdr!r} is not an integer")
+                return True
+            if epoch < st.fence_epoch:
+                st.fenced_writes_rejected += 1
+                self._error(409, "Fenced",
+                            f"{plural}/{name}: fencing epoch {epoch} < "
+                            f"{st.fence_epoch} (deposed leader)")
+                return True
+            st.fence_epoch = epoch
+        if plural == "leases" and isinstance(body, dict):
+            spec = body.get("spec")
+            lease_epoch = (spec.get("epoch") if isinstance(spec, dict)
+                           else body.get("epoch"))
+            if isinstance(lease_epoch, int) and lease_epoch > st.fence_epoch:
+                st.fence_epoch = lease_epoch
+        return False
 
     # -- verbs -----------------------------------------------------------------
 
@@ -165,6 +206,8 @@ class _Handler(BaseHTTPRequestHandler):
             target = ((body.get("target") or {}).get("name")
                       or body.get("nodeName", ""))
             with st.lock:
+                if self._fence_rejects(plural, name):
+                    return None
                 doc = st.bucket(plural).get(name)
                 if doc is None:
                     return self._error(404, "NotFound", f"{plural}/{name}")
@@ -181,6 +224,8 @@ class _Handler(BaseHTTPRequestHandler):
         if not obj_name:
             return self._error(422, "Invalid", "metadata.name required")
         with st.lock:
+            if self._fence_rejects(plural, obj_name, body):
+                return None
             bucket = st.bucket(plural)
             if obj_name in bucket:
                 return self._error(409, "AlreadyExists",
@@ -200,6 +245,8 @@ class _Handler(BaseHTTPRequestHandler):
         st = self.state
         want_rv = (body.get("metadata") or {}).get("resourceVersion")
         with st.lock:
+            if self._fence_rejects(plural, name, body):
+                return None
             bucket = st.bucket(plural)
             cur = bucket.get(name)
             if cur is not None and want_rv is not None \
@@ -248,6 +295,8 @@ class _Handler(BaseHTTPRequestHandler):
             return out
 
         with st.lock:
+            if self._fence_rejects(plural, name, patch):
+                return None
             bucket = st.bucket(plural)
             cur = bucket.get(name)
             if cur is None:
@@ -268,6 +317,8 @@ class _Handler(BaseHTTPRequestHandler):
         st = self.state
         want_rv = (body.get("preconditions") or {}).get("resourceVersion")
         with st.lock:
+            if self._fence_rejects(plural, name):
+                return None
             cur = st.bucket(plural).get(name)
             if cur is not None and want_rv is not None \
                     and cur["metadata"].get("resourceVersion") != want_rv:

@@ -25,11 +25,16 @@ class Conflict(Exception):
     pass
 
 
+class Fenced(Conflict):
+    """Write rejected: the presented fencing epoch is older than one the
+    store has already observed — a deposed leader's late write."""
+
+
 class KubeStore:
     """Typed object buckets with list/get/create/update/delete + watchers."""
 
     KINDS = ("pods", "nodes", "machines", "provisioners", "nodetemplates",
-             "pdbs", "configmaps", "leases", "events")
+             "pdbs", "configmaps", "leases", "events", "intents")
 
     def __init__(self):
         self._lock = threading.RLock()
@@ -40,6 +45,31 @@ class KubeStore:
         # pipeline): fn(kind, obj, operation) -> obj, raising to reject —
         # the apiserver's admission-webhook call site analogue
         self._admission: "Optional[Callable[[str, object, str], object]]" = None
+        # fencing: the highest leadership epoch this store has observed.
+        # Lease writes carrying an `epoch` advance it atomically with the
+        # leadership change itself; mutations presenting a stale epoch are
+        # rejected (the zombie ex-leader's late write).
+        self._fence_epoch = 0
+        self.fenced_writes_rejected = 0
+
+    def fence_epoch(self) -> int:
+        with self._lock:
+            return self._fence_epoch
+
+    def _check_fence(self, kind: str, name: str, epoch: "Optional[int]",
+                     obj=None) -> None:
+        """Must run under self._lock, before the write is applied."""
+        if epoch is not None:
+            if epoch < self._fence_epoch:
+                self.fenced_writes_rejected += 1
+                raise Fenced(
+                    f"{kind}/{name}: fencing epoch {epoch} < "
+                    f"{self._fence_epoch} (deposed leader)")
+            self._fence_epoch = epoch
+        if kind == "leases":
+            lease_epoch = getattr(obj, "epoch", None)
+            if isinstance(lease_epoch, int) and lease_epoch > self._fence_epoch:
+                self._fence_epoch = lease_epoch
 
     def set_admission(self, fn: "Optional[Callable[[str, object, str], object]]") -> None:
         with self._lock:
@@ -65,20 +95,24 @@ class KubeStore:
         with self._lock:
             self._watchers = [w for w in self._watchers if w is not fn]
 
-    def create(self, kind: str, name: str, obj) -> None:
+    def create(self, kind: str, name: str, obj,
+               epoch: "Optional[int]" = None) -> None:
         if self._admission is not None:
             obj = self._admission(kind, obj, "CREATE")
         with self._lock:
+            self._check_fence(kind, name, epoch, obj)
             bucket = self._objects[kind]
             if name in bucket:
                 raise Conflict(f"{kind}/{name} already exists")
             bucket[name] = obj
         self._notify(kind, "added", obj)
 
-    def update(self, kind: str, name: str, obj) -> None:
+    def update(self, kind: str, name: str, obj,
+               epoch: "Optional[int]" = None) -> None:
         if self._admission is not None:
             obj = self._admission(kind, obj, "UPDATE")
         with self._lock:
+            self._check_fence(kind, name, epoch, obj)
             self._objects[kind][name] = obj
         self._notify(kind, "modified", obj)
 
@@ -86,7 +120,8 @@ class KubeStore:
         with self._lock:
             return self._objects[kind].get(name)
 
-    def compare_and_swap(self, kind: str, name: str, expect, obj) -> None:
+    def compare_and_swap(self, kind: str, name: str, expect, obj,
+                         epoch: "Optional[int]" = None) -> None:
         """Atomic update iff the stored object is still `expect` (identity —
         the apiserver's resourceVersion-precondition analogue). Raises
         Conflict when another writer won the race. Leader-election leases
@@ -96,16 +131,19 @@ class KubeStore:
         if self._admission is not None:
             obj = self._admission(kind, obj, "UPDATE")
         with self._lock:
+            self._check_fence(kind, name, epoch, obj)
             cur = self._objects[kind].get(name)
             if cur is not expect:
                 raise Conflict(f"{kind}/{name} changed since read")
             self._objects[kind][name] = obj
         self._notify(kind, "modified", obj)
 
-    def delete_if(self, kind: str, name: str, expect) -> bool:
+    def delete_if(self, kind: str, name: str, expect,
+                  epoch: "Optional[int]" = None) -> bool:
         """Atomic delete iff the stored object is still `expect` (graceful
         lease release must not clobber a successor's lease)."""
         with self._lock:
+            self._check_fence(kind, name, epoch)
             cur = self._objects[kind].get(name)
             if cur is not expect:
                 return False
@@ -113,8 +151,9 @@ class KubeStore:
         self._notify(kind, "deleted", expect)
         return True
 
-    def delete(self, kind: str, name: str):
+    def delete(self, kind: str, name: str, epoch: "Optional[int]" = None):
         with self._lock:
+            self._check_fence(kind, name, epoch)
             obj = self._objects[kind].pop(name, None)
         if obj is not None:
             self._notify(kind, "deleted", obj)
@@ -154,10 +193,12 @@ class KubeStore:
         if node is not None:
             self._notify("nodes", "modified", node)
 
-    def bind_pod(self, pod_name: str, node_name: str) -> None:
+    def bind_pod(self, pod_name: str, node_name: str,
+                 epoch: "Optional[int]" = None) -> None:
         import dataclasses
 
         with self._lock:
+            self._check_fence("pods", pod_name, epoch)
             pod = self._objects["pods"].get(pod_name)
             if pod is None:
                 return
@@ -181,3 +222,41 @@ class KubeStore:
 
     def pdbs(self) -> "list[PodDisruptionBudget]":
         return self.list("pdbs")
+
+
+class FencedKube:
+    """Per-writer view of a KubeStore carrying that writer's fencing token.
+
+    Reads (and everything else) pass straight through; the mutating surface
+    presents `epoch_fn()` so the store can reject a deposed leader's late
+    writes. Each replica wraps the SHARED store in its own view — the token
+    travels with the caller, as a real apiserver request header would, not
+    with the store.
+    """
+
+    def __init__(self, store: KubeStore, epoch_fn: "Callable[[], Optional[int]]"):
+        self._store = store
+        self._epoch_fn = epoch_fn
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+    def create(self, kind: str, name: str, obj) -> None:
+        self._store.create(kind, name, obj, epoch=self._epoch_fn())
+
+    def update(self, kind: str, name: str, obj) -> None:
+        self._store.update(kind, name, obj, epoch=self._epoch_fn())
+
+    def compare_and_swap(self, kind: str, name: str, expect, obj) -> None:
+        self._store.compare_and_swap(kind, name, expect, obj,
+                                     epoch=self._epoch_fn())
+
+    def delete_if(self, kind: str, name: str, expect) -> bool:
+        return self._store.delete_if(kind, name, expect,
+                                     epoch=self._epoch_fn())
+
+    def delete(self, kind: str, name: str):
+        return self._store.delete(kind, name, epoch=self._epoch_fn())
+
+    def bind_pod(self, pod_name: str, node_name: str) -> None:
+        self._store.bind_pod(pod_name, node_name, epoch=self._epoch_fn())

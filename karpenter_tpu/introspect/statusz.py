@@ -23,7 +23,7 @@ from ..metrics import REGISTRY, Gauge, Histogram
 
 log = logging.getLogger("karpenter.statusz")
 
-SCHEMA_VERSION = 2  # 2: added the "resilience" section (breakers/budgets/ladders)
+SCHEMA_VERSION = 3  # 3: added the "recovery" section (epoch/journal/replay)
 
 # hard caps so a pathological operator can't make statusz unbounded
 MAX_EVENTS = 50
@@ -152,5 +152,6 @@ def snapshot(op) -> dict:
         "caches": _fenced(lambda: _cache_section(op)),
         "events": _fenced(lambda: _events_section(op)),
         "resilience": _fenced(lambda: op.resilience.snapshot()),
+        "recovery": _fenced(lambda: op.recovery.snapshot()),
         "metrics": _fenced(_metrics_section),
     }

@@ -36,6 +36,11 @@ class Lease:
     acquired_ts: float   # when the current holder first became leader
     renew_ts: float      # last successful renewal
     duration_s: float    # holder is presumed dead duration_s after renew_ts
+    # fencing token: strictly increasing across leadership changes (renewals
+    # keep it). The store tracks the highest epoch it has seen, so a deposed
+    # leader's late writes — presented with the old epoch — are rejected
+    # (docs/designs/recovery.md, fencing semantics).
+    epoch: int = 0
 
     def expired(self, now: float) -> bool:
         return now - self.renew_ts >= self.duration_s
@@ -75,6 +80,13 @@ class LeaderElector:
     def is_leader(self) -> bool:
         return self.elected.is_set()
 
+    def fencing_token(self) -> "Optional[int]":
+        """The epoch of the lease this elector believes it holds, or None.
+        Deliberately returns the (possibly stale) epoch while deposed-but-
+        unaware: that IS the zombie write the store must reject."""
+        held = self._held
+        return held.epoch if held is not None else None
+
     # -- one election tick -----------------------------------------------------
 
     def try_acquire_or_renew(self) -> bool:
@@ -87,7 +99,8 @@ class LeaderElector:
         cur = self.kube.get("leases", self.name)
         try:
             if cur is None:
-                fresh = Lease(self.identity, now, now, self.lease_duration_s)
+                fresh = Lease(self.identity, now, now, self.lease_duration_s,
+                              epoch=self._next_epoch(cur))
                 self.kube.create("leases", self.name, fresh)
                 self._became_leader(fresh, takeover_from=None)
             elif cur.holder == self.identity:
@@ -97,7 +110,8 @@ class LeaderElector:
                 if not self.elected.is_set():  # e.g. restart with stale lease
                     self._became_leader(renewed, takeover_from=None)
             elif cur.expired(now):
-                taken = Lease(self.identity, now, now, self.lease_duration_s)
+                taken = Lease(self.identity, now, now, self.lease_duration_s,
+                              epoch=self._next_epoch(cur))
                 self.kube.compare_and_swap("leases", self.name, cur, taken)
                 self._became_leader(taken, takeover_from=cur.holder)
             else:
@@ -108,11 +122,27 @@ class LeaderElector:
             self._demote_if_leading("lost lease race")
         return self.elected.is_set()
 
+    def _next_epoch(self, cur: "Optional[Lease]") -> int:
+        """Mint a fencing epoch strictly above every epoch the store has
+        observed — a gracefully released lease is gone, so `cur` alone
+        can't carry the high-water mark."""
+        prev = getattr(cur, "epoch", 0) if cur is not None else 0
+        fence = getattr(self.kube, "fence_epoch", None)
+        if callable(fence):
+            try:
+                prev = max(prev, fence())
+            except Exception:
+                pass
+        return prev + 1
+
     def release(self) -> None:
-        """Graceful handoff: delete the lease iff it is still ours."""
+        """Graceful handoff: delete the lease iff it is still ours.
+
+        Consults the STORE, not `_held`: an error-path demotion (store
+        hiccup mid-renewal) clears `_held` while our lease object survives
+        in the store — an early-return on `_held is None` would strand that
+        lease and force the standby to wait out the full TTL."""
         with self._mutex:
-            if self._held is None:
-                return
             cur = self.kube.get("leases", self.name)
             if cur is not None and cur.holder == self.identity:
                 self.kube.delete_if("leases", self.name, cur)

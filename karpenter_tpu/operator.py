@@ -29,10 +29,11 @@ from .events import EventRecorder
 from .introspect import FlightRecorder, Watchdog
 from .leaderelection import LeaderElector
 from .metrics import REGISTRY, decorate_cloudprovider
+from .recovery import IntentJournal, RecoveryManager
 from .resilience import ResilienceHub
 from .models.cluster import ClusterState
 from .models.instancetype import Catalog
-from .fake.kube import KubeStore
+from .fake.kube import FencedKube, KubeStore
 from .utils.clock import Clock
 from .webhooks import Webhooks
 
@@ -117,6 +118,13 @@ class Operator:
                 clock=self.clock,
                 on_started_leading=self._on_started_leading)
             self.elected = self.leader.elected
+            # Fencing: every kube mutation from THIS replica presents its
+            # lease epoch, so a deposed-but-unaware ex-leader's late writes
+            # are rejected by the store (fake/kube.py Fenced). The elector
+            # itself keeps the raw store — its lease writes are what MINT
+            # the new epochs.
+            if callable(getattr(self.kube, "fence_epoch", None)):
+                self.kube = FencedKube(self.kube, self.leader.fencing_token)
         else:
             self.leader = None
             self.elected = threading.Event()
@@ -134,15 +142,20 @@ class Operator:
                                         tls_cert=webhook_tls[0] or None,
                                         tls_key=webhook_tls[1] or None)
 
+        # durable intent journal: write-ahead records for in-flight actions,
+        # stamped with this incarnation's epoch (minted by RecoveryManager
+        # at leadership/boot — the lambda reads it lazily)
+        self.journal = IntentJournal(self.kube, clock=self.clock,
+                                     epoch_fn=lambda: self.recovery.epoch)
         self.provisioning = ProvisioningController(
             self.kube, self.cloudprovider, self.cluster, settings,
             clock=self.clock, recorder=self.recorder,
             solver_factory=solver_factory, watchdog=self.watchdog,
-            resilience=self.resilience)
+            resilience=self.resilience, journal=self.journal)
         self.termination = TerminationController(
             self.kube, self.cloudprovider, self.cluster,
             clock=self.clock, recorder=self.recorder,
-            watchdog=self.watchdog)
+            watchdog=self.watchdog, journal=self.journal)
         remote_consolidator = None
         if solver_target:
             # deployed split (SURVEY 7.1): the sidecar owns the chip, so
@@ -175,7 +188,8 @@ class Operator:
             clock=self.clock, recorder=self.recorder,
             provisioning=self.provisioning,
             remote_consolidator=remote_consolidator,
-            watchdog=self.watchdog, resilience=self.resilience)
+            watchdog=self.watchdog, resilience=self.resilience,
+            journal=self.journal)
         self.nodetemplate = NodeTemplateController(
             self.kube, self.cloudprovider.subnets,
             self.cloudprovider.security_groups, clock=self.clock,
@@ -232,6 +246,9 @@ class Operator:
             lambda name, err: self.flightrecorder.trigger(
                 "reconcile_exception",
                 detail=f"{name}: {type(err).__name__}: {err}"))
+        # crash-restart recovery: epoch minting + stranded-intent replay on
+        # each incarnation (docs/designs/recovery.md)
+        self.recovery = RecoveryManager(self)
 
     def _on_watch_event(self, kind: str, action: str, obj) -> None:
         if kind == "pdbs":
@@ -355,6 +372,16 @@ class Operator:
             self.cloudprovider.launch_templates.hydrate()
         except Exception as e:
             log.warning("leader hydration failed: %s", e)
+        # recovery replay before the first reconcile cycles: mint this
+        # life's epoch (the lease's fencing token), rebuild cluster state
+        # from the surviving stores (roll-forward/back decisions read it),
+        # then resolve whatever the previous leader left in the journal
+        try:
+            self.recovery.begin_incarnation()
+            self.machinehydration.reconcile_once()
+            self.recovery.replay()
+        except Exception as e:
+            log.warning("recovery replay at leadership start failed: %s", e)
 
     def start(self) -> None:
         """Start background controller loops (operator Start, main.go:64).
@@ -374,6 +401,11 @@ class Operator:
             # single-process mode hydrates inline and FAILS FAST: a broken
             # cloud API at boot should abort start, not surface per-launch
             self.cloudprovider.launch_templates.hydrate()
+            # replay stranded intents from prior incarnations (boot-counter
+            # epoch) before any controller loop takes its first turn
+            self.recovery.begin_incarnation()
+            self.machinehydration.reconcile_once()
+            self.recovery.replay()
 
         def loop(name, fn, interval):
             def run():

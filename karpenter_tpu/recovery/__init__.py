@@ -1,0 +1,252 @@
+"""Crash-restart recovery plane.
+
+A process death mid-action strands durable state: a cloud instance with no
+registered machine, a node marked for deletion only in the dead process's
+memory, a consolidation replacement nobody remembers launching. The plane
+has three parts:
+
+- **crashpoints** (crashpoints.py): named markers at every in-flight-intent
+  site; the chaos crash drill raises `SimulatedCrash` there to prove each
+  site recovers.
+- **intent journal** (journal.py): write-ahead records persisted through
+  the kube store before the first risky step of each action, resolved after
+  the last.
+- **RecoveryManager** (here): on each incarnation the (re)born leader mints
+  a fencing epoch, replays the journal records stranded by PRIOR epochs —
+  rolling each action forward or back by inspecting the surviving stores —
+  and exposes the whole story to statusz (`recovery` section) and the
+  chaos evidence ledger. Replay replaces the 15-minute registration-TTL
+  wait with first-cycle resolution.
+
+Fencing rides the same epochs: the leader lease carries one, the store
+tracks the highest it has seen, and every leader-gated mutation presents
+its epoch (fake/kube.py FencedKube) so a deposed-but-unaware ex-leader's
+late writes raise `Fenced` instead of corrupting the successor's state.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..metrics import REGISTRY
+from .crashpoints import (CRASHPOINTS, SimulatedCrash, crashpoint,  # noqa: F401
+                          install, uninstall)
+from .journal import (JOURNAL_KIND, LAUNCH, RECORD_KINDS, REPLACE,  # noqa: F401
+                      TERMINATION, IntentJournal, IntentRecord)
+
+log = logging.getLogger("karpenter.recovery")
+
+# boot-counter fallback for epoch minting when no leader election is running
+# (single-process mode still needs strictly-increasing incarnation epochs so
+# replay can tell "stranded by a prior life" from "in flight right now")
+BOOT_EPOCH_NAME = "operator-boot-epoch"
+
+REPLAYED_TOTAL = REGISTRY.counter(
+    "karpenter_recovery_replayed_total",
+    "Stranded intent records replayed on incarnation start, by kind and "
+    "resolution.", ("kind", "outcome"))
+INCARNATIONS_TOTAL = REGISTRY.counter(
+    "karpenter_recovery_incarnations_total",
+    "Operator incarnations that began (epoch mints).")
+EPOCH_GAUGE = REGISTRY.gauge(
+    "karpenter_recovery_epoch",
+    "This process's current incarnation/fencing epoch.")
+
+
+class RecoveryManager:
+    """Epoch minting + journal replay for one operator incarnation."""
+
+    # invariant bound: every stranded record must reach a terminal state
+    # within this many reconcile cycles of the reborn leader
+    REPLAY_BUDGET_CYCLES = 3
+
+    def __init__(self, operator):
+        self.op = operator
+        self.epoch = 0
+        self.replayed: "list[dict]" = []  # replay ledger (statusz/evidence)
+        self.last_replay_count = 0
+
+    @property
+    def journal(self) -> "IntentJournal":
+        return self.op.journal
+
+    # -- incarnation start -----------------------------------------------------
+
+    def begin_incarnation(self) -> int:
+        """Mint this life's epoch. Leader-elected processes inherit the
+        lease's fencing token (epoch advanced atomically with the leadership
+        change); standalone processes persist a boot counter through the
+        store. Both consult the store's fence high-water mark so mixed-mode
+        histories stay strictly monotone."""
+        token = None
+        leader = getattr(self.op, "leader", None)
+        if leader is not None:
+            token = leader.fencing_token()
+        if token is not None:
+            self.epoch = token
+        else:
+            store = self.op.kube
+            stored = store.get("configmaps", BOOT_EPOCH_NAME)
+            if isinstance(stored, dict):
+                # HttpKubeStore round-trips configmaps as {"data": {...}}
+                stored = stored.get("data", stored)
+            prev = stored.get("epoch", 0) if isinstance(stored, dict) else 0
+            try:
+                prev = int(prev)
+            except (TypeError, ValueError):
+                prev = 0
+            fence = getattr(store, "fence_epoch", None)
+            if callable(fence):
+                try:
+                    prev = max(prev, fence())
+                except Exception:
+                    pass
+            self.epoch = prev + 1
+            store.update("configmaps", BOOT_EPOCH_NAME, {"epoch": self.epoch})
+        EPOCH_GAUGE.set(self.epoch)
+        INCARNATIONS_TOTAL.inc()
+        log.info("incarnation epoch %d begins", self.epoch)
+        return self.epoch
+
+    # -- replay ----------------------------------------------------------------
+
+    def replay(self) -> "list[dict]":
+        """Resolve every record stranded by prior epochs. Run AFTER machine
+        hydration (the roll-forward checks read rebuilt cluster state) and
+        before normal reconcile cycles. Current-epoch records are skipped —
+        they are simply in flight."""
+        journal = self.journal
+        if journal is None or self.epoch == 0:
+            return []
+        stale = journal.pending(before_epoch=self.epoch)
+        actions: "list[dict]" = []
+        for rec in stale:
+            try:
+                if rec.kind == LAUNCH:
+                    outcome = self._replay_launch(rec)
+                elif rec.kind == TERMINATION:
+                    outcome = self._replay_termination(rec)
+                elif rec.kind == REPLACE:
+                    outcome = self._replay_replace(rec)
+                else:
+                    journal.resolve(rec.kind, rec.key, outcome="unknown_kind")
+                    outcome = "unknown_kind"
+            except Exception as e:
+                log.warning("replay of %s:%s failed: %s", rec.kind, rec.key, e)
+                outcome = "error"
+            REPLAYED_TOTAL.inc(kind=rec.kind, outcome=outcome)
+            actions.append({"kind": rec.kind, "key": rec.key,
+                            "epoch": rec.epoch, "outcome": outcome})
+            log.info("replayed %s:%s (epoch %d) -> %s",
+                     rec.kind, rec.key, rec.epoch, outcome)
+        self.replayed.extend(actions)
+        self.last_replay_count = len(actions)
+        fr = getattr(self.op, "flightrecorder", None)
+        if actions and fr is not None:
+            fr.trigger("recovery_replay",
+                       detail=f"{len(actions)} stranded intent record(s): "
+                       + ", ".join(f"{a['kind']}:{a['key']}={a['outcome']}"
+                                   for a in actions))
+        return actions
+
+    def _replay_launch(self, rec: IntentRecord) -> str:
+        """Launch stranded mid-flight. Fully registered (machine has a
+        providerID and the kube node exists) rolls FORWARD — the capacity is
+        real, and the dead process's unbound pods are still pending, so the
+        next provisioning cycle schedules them onto it. Anything less rolls
+        BACK: terminate the instance (if one was ever created) and reap the
+        half-written kube objects."""
+        op = self.op
+        machine = op.kube.get("machines", rec.key)
+        node_name = (getattr(machine.status, "node_name", "") or rec.key
+                     if machine is not None else rec.key)
+        registered = (machine is not None
+                      and getattr(machine.status, "provider_id", "")
+                      and op.kube.get("nodes", node_name) is not None)
+        if registered:
+            self.journal.resolve(LAUNCH, rec.key, outcome="rolled_forward")
+            return "rolled_forward"
+        # get_by_machine is tag-scoped and reaps double-launch duplicates
+        # itself — exactly-once across restart even if the fleet call and
+        # its retry both landed
+        inst = None
+        try:
+            inst = op.cloudprovider.instances.get_by_machine(rec.key)
+        except Exception as e:
+            log.warning("instance lookup for %s failed: %s", rec.key, e)
+        if inst is not None:
+            op.cloudprovider.instances.delete(inst.id)
+        if machine is not None:
+            op.kube.delete("machines", rec.key)
+        if node_name in op.cluster.nodes:
+            op.cluster.delete_node(node_name)
+        op.kube.delete("nodes", node_name)
+        self.journal.resolve(LAUNCH, rec.key, outcome="rolled_back")
+        return "rolled_back"
+
+    def _replay_termination(self, rec: IntentRecord) -> str:
+        """Termination stranded mid-teardown. A node still live in cluster
+        state re-enters the normal flow (request_deletion re-establishes the
+        in-memory mark AND refreshes the record under the current epoch — no
+        resolve here, the ordinary path resolves it). Dead capacity with
+        leftover kube objects is reaped directly; nothing left is done."""
+        op = self.op
+        machine_name = str(rec.payload.get("machine") or "")
+        node_kube = op.kube.get("nodes", rec.key)
+        machine = (op.kube.get("machines", machine_name)
+                   if machine_name else None)
+        if op.cluster.nodes.get(rec.key) is not None:
+            if op.termination.request_deletion(rec.key):
+                return "requeued"
+        if node_kube is None and machine is None:
+            self.journal.resolve(TERMINATION, rec.key, outcome="already_done")
+            return "already_done"
+        if machine is not None:
+            op.kube.delete("machines", machine_name)
+        if node_kube is not None:
+            op.kube.delete("nodes", rec.key)
+        self.journal.resolve(TERMINATION, rec.key, outcome="reaped")
+        return "reaped"
+
+    def _replay_replace(self, rec: IntentRecord) -> str:
+        """Two-phase replace stranded after the replacement launch. The
+        in-memory state machine died; if workload already rebound onto the
+        replacement keep it (the old nodes fall to normal consolidation),
+        otherwise roll the empty replacement back."""
+        op = self.op
+        rep_name = rec.payload.get("replacement")
+        rep = op.cluster.nodes.get(rep_name) if rep_name else None
+        if rep is None:
+            outcome = "already_done" if rep_name else "aborted"
+        elif rep.non_daemon_pods():
+            outcome = "rolled_forward"
+        else:
+            op.termination.request_deletion(rep_name)
+            outcome = "rolled_back"
+        self.journal.resolve(REPLACE, rec.key, outcome=outcome)
+        return outcome
+
+    # -- introspection ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The statusz `recovery` section (schema v3)."""
+        out = {"epoch": self.epoch,
+               "replayed_total": len(self.replayed),
+               "last_replay": list(self.replayed[-8:])}
+        journal = self.journal
+        if journal is not None:
+            out["journal"] = journal.snapshot()
+        store = self.op.kube
+        fence = getattr(store, "fence_epoch", None)
+        if callable(fence):
+            try:
+                out["fence_epoch"] = fence()
+            except Exception:
+                pass
+        rejected = getattr(store, "fenced_writes_rejected", None)
+        if isinstance(rejected, int):
+            out["fenced_writes_rejected"] = rejected
+        interruption = getattr(self.op, "interruption", None)
+        if interruption is not None:
+            out["interruption_deduped"] = interruption.deduped_count
+        return out

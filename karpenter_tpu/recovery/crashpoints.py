@@ -1,0 +1,79 @@
+"""Named crashpoints: every in-flight-intent site declares where a process
+death would strand durable state.
+
+A crashpoint is a zero-cost marker (`crashpoint("launch.pre_register")`)
+placed at each point where the controller has written a write-ahead intent
+record (recovery/journal.py) but not yet resolved it. The chaos crash drill
+installs a hook that raises `SimulatedCrash` at a scheduled site, which
+unwinds the drive stack WITHOUT running the `except Exception` cleanup
+fences (SimulatedCrash derives from BaseException precisely so in-band
+cleanup cannot tidy up state a real SIGKILL would have left behind); the
+runner then tears down the operator object graph and boots a fresh one
+against the surviving stores.
+
+`CRASHPOINTS` is the canonical catalog — hack/check_crashpoints.py asserts
+every `crashpoint(...)` call site uses a catalogued name and every
+catalogued name has exactly one call site, and that every file writing
+journal records declares at least one crashpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+# site -> where it lives; ordering is the drill order
+CRASHPOINTS: "tuple[str, ...]" = (
+    # post-token-claim / pre-dispatch: the launch intent is journaled and
+    # the machine object exists, but the CreateFleet call has not left the
+    # batcher yet
+    "fleet.pre_dispatch",
+    # the cloud instance exists but the machine's providerID/status was
+    # never written back (the classic leak the registration-TTL sweep
+    # used to wait 15 minutes for)
+    "launch.pre_register",
+    # node + machine registered, some of the assigned pods bound
+    "launch.mid_bind",
+    # cloud capacity already terminated, kube machine/node objects remain
+    "termination.mid_delete",
+    # consolidation replacement launched, old nodes not yet marked
+    "deprovisioning.mid_replace",
+    # interruption message handled and recorded, but not yet acked —
+    # redelivery lands on the reborn consumer
+    "interruption.pre_ack",
+)
+
+
+class SimulatedCrash(BaseException):
+    """Process death at a crashpoint. BaseException on purpose: the
+    `except Exception` fences that tidy up after *recoverable* errors must
+    not see this — a real crash gives them no chance to run either."""
+
+    def __init__(self, site: str):
+        super().__init__(f"simulated crash at {site}")
+        self.site = site
+
+
+_lock = threading.Lock()
+_hook: "Optional[Callable[[str], None]]" = None
+
+
+def install(hook: "Callable[[str], None]") -> None:
+    """Install the process-wide crash hook (chaos drill only)."""
+    global _hook
+    with _lock:
+        _hook = hook
+
+
+def uninstall() -> None:
+    global _hook
+    with _lock:
+        _hook = None
+
+
+def crashpoint(site: str) -> None:
+    """Marker at an in-flight-intent site. No-op unless a drill hook is
+    installed; the hook may raise SimulatedCrash."""
+    hook = _hook
+    if hook is not None:
+        hook(site)

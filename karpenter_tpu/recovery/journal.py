@@ -1,0 +1,125 @@
+"""Durable intent journal: write-ahead records for in-flight actions.
+
+Every action whose partial completion would strand cloud or kube state
+(a fleet launch, a node termination, a consolidation replace) writes an
+IntentRecord through the kube store BEFORE acting and resolves it after
+the last step. The record survives the process: a reborn leader replays
+unresolved records from prior epochs on its first cycles
+(recovery.RecoveryManager) instead of waiting out the 15-minute
+registration-TTL sweep.
+
+Records are plain kube objects (KubeStore kind "intents"), so they ride
+the same durability, fencing, and watch semantics as every other object —
+and a real deployment can back them with CRDs or a ConfigMap without
+changing the journal surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+from ..fake.kube import Conflict
+from ..metrics import REGISTRY
+from ..utils.clock import Clock
+
+JOURNAL_KIND = "intents"
+
+# record kinds
+LAUNCH = "launch"            # fleet launch in flight (machine name keys it)
+TERMINATION = "termination"  # node marked for deletion, teardown in flight
+REPLACE = "replace"          # consolidation replace action in flight
+RECORD_KINDS = (LAUNCH, TERMINATION, REPLACE)
+
+RECORDS_TOTAL = REGISTRY.counter(
+    "karpenter_recovery_journal_records_total",
+    "Write-ahead intent records written, by kind.", ("kind",))
+RESOLVED_TOTAL = REGISTRY.counter(
+    "karpenter_recovery_journal_resolved_total",
+    "Intent records resolved, by kind and outcome.", ("kind", "outcome"))
+PENDING_GAUGE = REGISTRY.gauge(
+    "karpenter_recovery_journal_pending",
+    "Unresolved intent records currently in the journal, by kind.",
+    ("kind",))
+
+
+@dataclasses.dataclass
+class IntentRecord:
+    kind: str        # one of RECORD_KINDS
+    key: str         # unique within kind (machine name, node name, action id)
+    payload: dict    # everything replay needs; JSON-serializable values only
+    epoch: int = 0   # writer's incarnation epoch (replay targets older ones)
+    created_ts: float = 0.0
+
+    @property
+    def name(self) -> str:
+        return f"{self.kind}:{self.key}"
+
+    def as_dict(self) -> dict:
+        return {"kind": self.kind, "key": self.key,
+                "payload": dict(self.payload), "epoch": self.epoch,
+                "created_ts": self.created_ts}
+
+
+class IntentJournal:
+    """Record/resolve surface over the kube store's "intents" kind."""
+
+    def __init__(self, kube, clock: "Optional[Clock]" = None,
+                 epoch_fn: "Optional[Callable[[], int]]" = None):
+        self.kube = kube
+        self.clock = clock or Clock()
+        self._epoch_fn = epoch_fn or (lambda: 0)
+
+    def record(self, kind: str, key: str, payload: dict) -> IntentRecord:
+        """Write-ahead: persist the intent BEFORE the first risky step.
+        Re-recording an existing key refreshes it under the current epoch
+        (a replayed intent re-entering the normal flow)."""
+        rec = IntentRecord(kind=kind, key=key, payload=dict(payload),
+                           epoch=self._epoch_fn(),
+                           created_ts=self.clock.now())
+        try:
+            self.kube.create(JOURNAL_KIND, rec.name, rec)
+        except Conflict:
+            self.kube.update(JOURNAL_KIND, rec.name, rec)
+        RECORDS_TOTAL.inc(kind=kind)
+        self._refresh_gauge()
+        return rec
+
+    def resolve(self, kind: str, key: str, outcome: str = "completed") -> bool:
+        """The action reached a terminal state; drop the record."""
+        gone = self.kube.delete(JOURNAL_KIND, f"{kind}:{key}") is not None
+        if gone:
+            RESOLVED_TOTAL.inc(kind=kind, outcome=outcome)
+        self._refresh_gauge()
+        return gone
+
+    def get(self, kind: str, key: str) -> "Optional[IntentRecord]":
+        return self.kube.get(JOURNAL_KIND, f"{kind}:{key}")
+
+    def pending(self, kind: "Optional[str]" = None,
+                before_epoch: "Optional[int]" = None) -> "list[IntentRecord]":
+        """Unresolved records, oldest first. `before_epoch` restricts to
+        records stranded by earlier incarnations (what replay targets —
+        the current epoch's records are simply in flight)."""
+        out = [r for r in self.kube.list(JOURNAL_KIND)
+               if (kind is None or r.kind == kind)
+               and (before_epoch is None or r.epoch < before_epoch)]
+        out.sort(key=lambda r: (r.created_ts, r.name))
+        return out
+
+    def snapshot(self) -> dict:
+        by_kind: "dict[str, int]" = {}
+        for r in self.kube.list(JOURNAL_KIND):
+            by_kind[r.kind] = by_kind.get(r.kind, 0) + 1
+        return {"pending": sum(by_kind.values()),
+                "pending_by_kind": dict(sorted(by_kind.items()))}
+
+    def _refresh_gauge(self) -> None:
+        counts = {k: 0 for k in RECORD_KINDS}
+        try:
+            for r in self.kube.list(JOURNAL_KIND):
+                counts[r.kind] = counts.get(r.kind, 0) + 1
+        except Exception:
+            return
+        for k, v in counts.items():
+            PENDING_GAUGE.set(v, kind=k)

@@ -174,7 +174,8 @@ class TestDeadmanReadyz:
 
 class TestStatusz:
     TOP_KEYS = {"tool", "schema", "version", "ts", "cluster", "controllers",
-                "queues", "caches", "events", "resilience", "metrics"}
+                "queues", "caches", "events", "resilience", "recovery",
+                "metrics"}
     CLUSTER_KEYS = {"nodes", "nodes_by_provisioner",
                     "nodes_marked_for_deletion", "machines", "pods",
                     "pending_pods", "provisioners", "nodetemplates", "pdbs"}
@@ -187,9 +188,11 @@ class TestStatusz:
         # key-set changes are schema changes and must bump SCHEMA_VERSION
         assert set(snap) == self.TOP_KEYS
         assert snap["tool"] == "karpenter_tpu.statusz"
-        assert snap["schema"] == 2
+        assert snap["schema"] == 3
         assert set(snap["resilience"]) == {"breakers", "budgets", "ladders",
                                            "degraded", "open_breakers"}
+        assert {"epoch", "replayed_total", "last_replay",
+                "journal"} <= set(snap["recovery"])
         assert set(snap["cluster"]) == self.CLUSTER_KEYS
         assert set(snap["queues"]) == {"create_fleet", "describe_instances",
                                        "terminate_instances", "interruption"}

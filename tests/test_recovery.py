@@ -1,0 +1,309 @@
+"""Crash-restart recovery plane: durable intent journal, crashpoint
+rebirth drills, fenced leader failover, and boot-epoch monotonicity.
+
+The unit tier exercises the journal and fencing primitives directly; the
+drill tier drives ChaosRunner's crash mode — kill the process at a named
+crashpoint, boot a fresh operator against the surviving stores, and
+assert the recovery invariants (exactly-once launch, journal resolved
+within the replay budget, fencing rejects zombie writes).
+"""
+
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from karpenter_tpu.chaos.runner import ChaosRunner
+from karpenter_tpu.fake.apiserver import serve
+from karpenter_tpu.fake.kube import Fenced, FencedKube, KubeStore
+from karpenter_tpu.coordination.httpkube import HttpKubeStore
+from karpenter_tpu.recovery import (BOOT_EPOCH_NAME, CRASHPOINTS,
+                                    RecoveryManager, SimulatedCrash,
+                                    crashpoint, install, uninstall)
+from karpenter_tpu.recovery.journal import (LAUNCH, REPLACE, TERMINATION,
+                                            IntentJournal)
+from karpenter_tpu.utils.clock import FakeClock
+
+
+# -- intent journal ----------------------------------------------------------
+
+
+class TestIntentJournal:
+    def _journal(self, epoch=0):
+        holder = SimpleNamespace(epoch=epoch)
+        j = IntentJournal(KubeStore(), clock=FakeClock(),
+                          epoch_fn=lambda: holder.epoch)
+        return j, holder
+
+    def test_record_get_resolve_roundtrip(self):
+        j, holder = self._journal(epoch=3)
+        rec = j.record(LAUNCH, "m-1", {"machine": "m-1"})
+        assert rec.name == "launch:m-1"
+        assert rec.epoch == 3
+        got = j.get(LAUNCH, "m-1")
+        assert got is not None and got.payload == {"machine": "m-1"}
+        assert [r.name for r in j.pending()] == ["launch:m-1"]
+        assert j.resolve(LAUNCH, "m-1") is True
+        assert j.pending() == []
+        assert j.resolve(LAUNCH, "m-1") is False  # already terminal
+
+    def test_pending_filters_by_kind_and_epoch(self):
+        j, holder = self._journal(epoch=1)
+        j.record(LAUNCH, "m-1", {})
+        j.record(TERMINATION, "n-1", {})
+        holder.epoch = 2
+        j.record(REPLACE, "n-2", {})
+        assert {r.kind for r in j.pending()} == {LAUNCH, TERMINATION, REPLACE}
+        assert [r.kind for r in j.pending(kind=LAUNCH)] == [LAUNCH]
+        # replay targets prior epochs only: the current epoch is in flight
+        stale = j.pending(before_epoch=2)
+        assert {r.name for r in stale} == {"launch:m-1", "termination:n-1"}
+
+    def test_rerecord_refreshes_epoch(self):
+        j, holder = self._journal(epoch=1)
+        j.record(TERMINATION, "n-1", {"node": "n-1"})
+        holder.epoch = 5
+        j.record(TERMINATION, "n-1", {"node": "n-1"})
+        assert j.get(TERMINATION, "n-1").epoch == 5
+        assert j.pending(before_epoch=5) == []  # re-entered the normal flow
+
+    def test_pending_is_oldest_first(self):
+        j, _ = self._journal()
+        j.clock.step(1.0)
+        j.record(LAUNCH, "b", {})
+        j.clock.step(1.0)
+        j.record(LAUNCH, "a", {})
+        assert [r.key for r in j.pending()] == ["b", "a"]
+
+    def test_snapshot_counts_by_kind(self):
+        j, _ = self._journal()
+        j.record(LAUNCH, "m-1", {})
+        j.record(LAUNCH, "m-2", {})
+        j.record(REPLACE, "n-1", {})
+        snap = j.snapshot()
+        assert snap == {"pending": 3,
+                        "pending_by_kind": {LAUNCH: 2, REPLACE: 1}}
+
+
+# -- crashpoints -------------------------------------------------------------
+
+
+class TestCrashpoints:
+    def teardown_method(self):
+        uninstall()
+
+    def test_noop_without_hook(self):
+        crashpoint("launch.pre_register")  # must not raise
+
+    def test_hook_sees_site_and_uninstall_disarms(self):
+        seen = []
+        install(seen.append)
+        crashpoint("launch.mid_bind")
+        assert seen == ["launch.mid_bind"]
+        uninstall()
+        crashpoint("launch.mid_bind")
+        assert seen == ["launch.mid_bind"]
+
+    def test_simulated_crash_sails_past_except_exception(self):
+        """The whole point of BaseException: in-band cleanup fences must
+        not get a chance to tidy state a real SIGKILL would strand."""
+        cleaned = []
+
+        def action():
+            try:
+                raise SimulatedCrash("launch.pre_register")
+            except Exception:  # noqa: BLE001 — the fence under test
+                cleaned.append(True)
+
+        with pytest.raises(SimulatedCrash) as e:
+            action()
+        assert cleaned == []
+        assert e.value.site == "launch.pre_register"
+
+
+# -- fencing -----------------------------------------------------------------
+
+
+class TestFencing:
+    def test_store_rejects_stale_epoch(self):
+        store = KubeStore()
+        new_leader = FencedKube(store, lambda: 2)
+        old_leader = FencedKube(store, lambda: 1)
+        new_leader.create("configmaps", "state", {"owner": "new"})
+        assert store.fence_epoch() == 2
+        with pytest.raises(Fenced):
+            old_leader.update("configmaps", "state", {"owner": "old"})
+        with pytest.raises(Fenced):
+            old_leader.delete("configmaps", "state")
+        assert store.fenced_writes_rejected == 2
+        assert store.get("configmaps", "state") == {"owner": "new"}
+
+    def test_lease_epoch_advances_fence_high_water(self):
+        store = KubeStore()
+        store.create("leases", "karpenter-leader", SimpleNamespace(epoch=7))
+        assert store.fence_epoch() == 7
+        with pytest.raises(Fenced):
+            store.create("configmaps", "late", {}, epoch=6)
+
+    def test_wire_fencing_rejects_zombie_writes(self):
+        """End-to-end over the mini apiserver: X-Fencing-Epoch on mutating
+        verbs, stale epoch -> 409 Fenced, high-water advertised back."""
+        srv, port, state = serve()
+        try:
+            store = HttpKubeStore(f"http://127.0.0.1:{port}")
+            store.create("configmaps", "state", {"owner": "new"}, epoch=2)
+            assert store.fence_epoch() == 2
+            with pytest.raises(Fenced):
+                store.update("configmaps", "state", {"owner": "old"}, epoch=1)
+            with pytest.raises(Fenced):
+                store.delete("configmaps", "state", epoch=1)
+            assert state.fenced_writes_rejected == 2
+            assert state.fence_epoch == 2
+        finally:
+            srv.shutdown()
+
+
+# -- epoch minting -----------------------------------------------------------
+
+
+class TestBootEpoch:
+    def _op(self, store):
+        return SimpleNamespace(kube=store, leader=None, journal=None)
+
+    def test_boot_counter_is_monotone_across_incarnations(self):
+        store = KubeStore()
+        epochs = [RecoveryManager(self._op(store)).begin_incarnation()
+                  for _ in range(3)]
+        assert epochs == [1, 2, 3]
+        stored = store.get("configmaps", BOOT_EPOCH_NAME)
+        assert stored["epoch"] == 3
+
+    def test_boot_counter_respects_store_fence_high_water(self):
+        """A standalone boot after a leader-elected history must not mint
+        an epoch the fence has already seen — mixed-mode stays monotone."""
+        store = KubeStore()
+        store.create("leases", "leader", SimpleNamespace(epoch=9))
+        assert RecoveryManager(self._op(store)).begin_incarnation() == 10
+
+
+# -- journal replay ----------------------------------------------------------
+
+
+class TestReplay:
+    def _op(self):
+        runner = ChaosRunner(seed=0, crash=True, out_dir=None)
+        clock = FakeClock()
+        op, cloud = runner._build(clock, name_suffix="rep")
+        return op, cloud
+
+    def test_stranded_launch_rolls_back(self):
+        op, cloud = self._op()
+        op.journal.record(LAUNCH, "ghost-00001", {"machine": "ghost-00001"})
+        op.recovery.begin_incarnation()
+        actions = op.recovery.replay()
+        assert actions == [{"kind": LAUNCH, "key": "ghost-00001", "epoch": 0,
+                            "outcome": "rolled_back"}]
+        assert op.journal.pending() == []
+
+    def test_stranded_termination_with_nothing_left_is_already_done(self):
+        op, _ = self._op()
+        op.journal.record(TERMINATION, "gone-node", {"node": "gone-node",
+                                                     "machine": ""})
+        op.recovery.begin_incarnation()
+        actions = op.recovery.replay()
+        assert [a["outcome"] for a in actions] == ["already_done"]
+
+    def test_stranded_replace_without_replacement_aborts(self):
+        op, _ = self._op()
+        op.journal.record(REPLACE, "old-node", {"nodes": ["old-node"],
+                                                "replacement": None})
+        op.recovery.begin_incarnation()
+        actions = op.recovery.replay()
+        assert [a["outcome"] for a in actions] == ["aborted"]
+
+    def test_current_epoch_records_are_left_in_flight(self):
+        op, _ = self._op()
+        op.recovery.begin_incarnation()
+        op.journal.record(LAUNCH, "inflight-00001", {})
+        assert op.recovery.replay() == []
+        assert [r.key for r in op.journal.pending()] == ["inflight-00001"]
+
+
+# -- the crash drill ---------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def drill():
+    return ChaosRunner(seed=0, crash=True, out_dir=None).run()
+
+
+class TestCrashDrill:
+    def test_drill_passes_at_seed_zero(self, drill):
+        for s in drill["scenarios"]:
+            assert s["passed"], (s["drill"], s["violations"])
+        assert drill["passed"]
+
+    def test_every_crashpoint_has_a_scenario(self, drill):
+        sites = [s["site"] for s in drill["scenarios"]]
+        for site in CRASHPOINTS:
+            assert site in sites
+        assert any(s["drill"] == "crash:leader-failover"
+                   for s in drill["scenarios"])
+
+    def test_every_scenario_actually_crashed(self, drill):
+        assert all(s["crashed"] for s in drill["scenarios"])
+
+    def test_write_ahead_record_survived_every_crash(self, drill):
+        """At rebirth the journal must hold the dead incarnation's intent —
+        the write-ahead ordering is what makes replay possible at all."""
+        for s in drill["scenarios"]:
+            if s["drill"] == "crash:leader-failover":
+                assert s["replay"], s
+            else:
+                assert s["pending_at_rebirth"], s["drill"]
+
+    def test_failover_fences_all_zombie_writes(self, drill):
+        s = next(x for x in drill["scenarios"]
+                 if x["drill"] == "crash:leader-failover")
+        zw = s["zombie_writes"]
+        assert zw["attempted"] >= 2
+        assert zw["rejected"] == zw["attempted"]
+        assert zw["store_rejections"] == zw["attempted"]
+        assert s["epochs"]["reborn"] > s["epochs"]["crashed"]
+        assert s["fence_epoch"] >= s["epochs"]["reborn"]
+
+    def test_interruption_redelivery_deduped_across_rebirth(self, drill):
+        s = next(x for x in drill["scenarios"]
+                 if x["site"] == "interruption.pre_ack")
+        assert s["interruption_deduped"] >= 1
+
+    def test_scenarios_are_json_serializable(self, drill):
+        json.dumps(drill["scenarios"])
+
+    def test_single_site_drill_is_deterministic(self):
+        """Replay contract: a crash scenario dict is a pure function of
+        (seed, scenario) — two in-process runs must agree byte for byte."""
+        a = ChaosRunner(seed=0, crash=True,
+                        out_dir=None).run_crash_site("launch.pre_register", 1)
+        b = ChaosRunner(seed=0, crash=True,
+                        out_dir=None).run_crash_site("launch.pre_register", 1)
+        assert a == b
+
+
+@pytest.mark.slow
+class TestCrashSweep:
+    def test_full_drill_is_deterministic(self):
+        volatile = ("duration_s", "bundles", "artifact_path")
+        runs = [ChaosRunner(seed=0, crash=True, out_dir=None).run()
+                for _ in range(2)]
+        for artifact in runs:
+            for key in volatile:
+                artifact.pop(key, None)
+        assert runs[0] == runs[1]
+
+    @pytest.mark.parametrize("seed", range(20))
+    def test_crash_sweep_twenty_seeds(self, seed):
+        artifact = ChaosRunner(seed=seed, crash=True, out_dir=None).run()
+        assert artifact["passed"], [
+            (s["drill"], s["violations"])
+            for s in artifact["scenarios"] if not s["passed"]]
