@@ -8,12 +8,18 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit lint noretry crashpoints test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash claims diagnose
+.PHONY: presubmit lint noretry crashpoints test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash claims diagnose provenance multichip
 
-presubmit: lint claims noretry crashpoints test verify-entry  ## what CI runs
+presubmit: lint claims provenance noretry crashpoints test verify-entry  ## what CI runs
 
 claims:  ## every benchmark number in docs must cite a recorded artifact
 	$(PY) hack/check_round_claims.py
+
+provenance:  ## BENCH_*.json headline claims must be on-chip or carry degraded provenance
+	$(PY) hack/check_headline_provenance.py
+
+multichip:  ## wire-served sharded parity at the 50k stress shape (records an artifact)
+	$(CPU_ENV) $(PY) -m benchmarks.multichip_wire
 
 noretry:  ## retries must flow through resilience.RetryPolicy (shared budget)
 	$(PY) hack/check_no_adhoc_retry.py

@@ -20,6 +20,7 @@ so env vars alone can't redirect it. We therefore
      and exits if a device call wedges mid-benchmark.
 """
 
+import argparse
 import json
 import os
 import statistics
@@ -55,10 +56,38 @@ def _emit(value, vs, detail, exit_code=None, degraded=False):
         "native_routed_ms": detail.get("routed_native_p50_ms"),
         "onchip_ms": (value if detail.get("backend") == "tpu" else
                       (detail.get("latest_tpu_capture") or {}).get("p50_ms")),
+        # escape-hatch metrics measured by THIS run (no longer capture-only
+        # nulls): steady-state resident-buffer waves, callback-transport
+        # headline, the post-callback link sentinel, and streaming-regime
+        # consolidation — hack/check_headline_provenance.py reads these as
+        # the fallback evidence for degraded artifacts
+        "wave_steady_per_solve_ms": ((detail.get("wave_steady") or {})
+                                     .get("per_solve_p50_ms")),
+        "callback_headline_ms": ((detail.get("callback_headline") or {})
+                                 .get("p50_ms")),
+        "io_escape_sync_after_ms": (((detail.get("io_callback_escape")
+                                      or {}).get("sync_after") or {})
+                                    .get("p50_ms")),
+        "consolidation_500_streaming_ms": (
+            (detail.get("consolidation_500_streaming") or {}).get("p50_ms")),
         "detail": detail,
     }
     if degraded:
         record["degraded"] = True  # partial reps only — do not trust as headline
+    # headline provenance (lint contract, hack/check_headline_provenance.py):
+    # a non-degraded on-chip value stands on its own; anything else must
+    # name the fallback metric its claim leans on
+    if record["backend"] == "tpu" and not degraded:
+        record["headline_provenance"] = {"source": "onchip-this-run"}
+    else:
+        fallback = next(
+            (m for m in ("wave_steady_per_solve_ms", "native_routed_ms",
+                         "onchip_ms") if record.get(m) is not None), None)
+        record["headline_provenance"] = {
+            "source": "degraded-fallback",
+            "fallback_metric": fallback,
+            "fallback_value": record.get(fallback) if fallback else None,
+        }
     print(json.dumps(record), flush=True)
     if exit_code is not None:
         os._exit(exit_code)
@@ -145,7 +174,90 @@ def _phase_breakdown(catalog, pods):
         op.stop()
 
 
+def _steady_section(solver, pods, reps: int):
+    """Steady-state per-solve latency with resident buffers: waves of K
+    identical problems ride ONE vmapped dispatch + ONE fetch against the
+    device-resident catalog (solve_many), measured over `reps` waves after
+    a warmup wave compiled the [K, ...] program. The per-solve number is
+    the marginal cost of one more solve in a warm serving process — the
+    figure the solver service pays per Solve once Sync residency and the
+    compile cache have done their work."""
+    K = 8
+    probs = [{"pods": pods}] * K
+    solver.solve_many(probs)  # warmup wave (compile + group-cache folds)
+    per = []
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        solver.solve_many(probs)
+        per.append((time.perf_counter() - t0) * 1000 / K)
+    per.sort()
+    _state["detail"]["wave_steady"] = {
+        "wave_k": K, "reps": len(per),
+        "per_solve_p50_ms": round(statistics.median(per), 3),
+        "per_solve_p99_ms": round(per[min(len(per) - 1,
+                                          int(len(per) * 0.99))], 3),
+    }
+
+
+def _escape_sections(jax, solver, pods):
+    """Run the headline through the callback readback transport (results
+    streamed host-ward via io_callback instead of a blocking first read —
+    the 68 ms after_first_read penalty is what this dodges), then take the
+    link sentinel AFTER: sub-ms sync_after means the escape hatch kept the
+    session streaming."""
+    import jax.numpy as jnp
+
+    import karpenter_tpu.solver.core as _score
+    from hack.tpu_capture import _link_sentinel
+
+    saved = _score._READBACK
+    _score._READBACK = "callback"
+    try:
+        solver.solve(pods)  # warm the callback-transport program
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            solver.solve(pods)
+            ts.append((time.perf_counter() - t0) * 1000)
+        _state["detail"]["callback_headline"] = {
+            "p50_ms": round(statistics.median(ts), 3), "reps": len(ts)}
+        _state["detail"]["io_callback_escape"] = {
+            "sync_after": _link_sentinel(jax, jnp)}
+    finally:
+        _score._READBACK = saved
+
+
+def _consolidation_streaming(catalog, reps: int = 3):
+    """BASELINE configs[3] (500-node consolidation sweep) through the
+    callback transport — the streaming-regime consolidation number the
+    capture tool records on-chip, measured here on whatever backend the
+    bench landed on."""
+    import karpenter_tpu.solver.core as _score
+    from hack.tpu_capture import _consolidation_cluster
+    from karpenter_tpu.ops.consolidate import run_consolidation
+
+    cluster, cprov = _consolidation_cluster(catalog, 500)
+    saved = _score._READBACK
+    _score._READBACK = "callback"
+    try:
+        run_consolidation(cluster, catalog, [cprov])  # warm
+        ts = []
+        for _ in range(max(1, reps)):
+            t0 = time.perf_counter()
+            run_consolidation(cluster, catalog, [cprov])
+            ts.append((time.perf_counter() - t0) * 1000)
+        _state["detail"]["consolidation_500_streaming"] = {
+            "p50_ms": round(statistics.median(ts), 3), "reps": len(ts)}
+    finally:
+        _score._READBACK = saved
+
+
 def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steady", type=int, default=5, metavar="N",
+                    help="steady-state waves to measure (resident-buffer "
+                         "solve_many reps after warmup; 0 disables)")
+    args = ap.parse_args()
     forced = os.environ.get("KARPENTER_TPU_BENCH_PLATFORM")
     if forced:  # operator knows the tunnel state; skip the probe entirely
         tpu_ok, note = forced == "axon", f"forced via KARPENTER_TPU_BENCH_PLATFORM={forced}"
@@ -297,7 +409,11 @@ def main():
     placed = sum(n.pod_count for n in res.nodes)
     assert placed + res.unschedulable_count() == len(pods), (placed, res.unschedulable_count())
 
-    solver.solve(pods)  # second warmup: settle tunnel/device caches
+    # settle tunnel/device caches AND the host-side allocator: the first
+    # few repeats still shift ~2ms on the shared-core runner, which is
+    # real at an 18ms headline
+    for _ in range(4):
+        solver.solve(pods)
     for _ in range(20):
         t0 = time.perf_counter()
         res = solver.solve(pods)
@@ -323,6 +439,22 @@ def main():
             statistics.median(nat_times), 3)
     except Exception as e:  # native unavailable: routing falls back anyway
         _state["detail"]["routed_native_error"] = str(e)[:120]
+
+    # escape-hatch sections: each guarded — a failure records an error
+    # field instead of breaking the one-JSON-line contract
+    if args.steady > 0:
+        try:
+            _steady_section(solver, pods, args.steady)
+        except Exception as e:
+            _state["detail"]["wave_steady_error"] = str(e)[:120]
+    try:
+        _escape_sections(jax, solver, pods)
+    except Exception as e:
+        _state["detail"]["callback_headline_error"] = str(e)[:120]
+    try:
+        _consolidation_streaming(catalog)
+    except Exception as e:
+        _state["detail"]["consolidation_streaming_error"] = str(e)[:120]
 
     _state["detail"].update({
         "n_types": len(catalog.types),

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Wire-served sharded parity check (`make multichip`).
+
+`dryrun_multichip` proved the mesh kernel bit-exact — but only as a
+hand-driven entry point. This harness proves the SERVING path: the same
+sharded solve reached through the gRPC solver service (Sync + Solve over
+real sockets, shape router forced to the mesh with crossover=0), with
+three assertions:
+
+  1. wire routing: the service reports routing=tpu-sharded and a
+     device_count matching the mesh — the sharded kernel genuinely served
+     the RPC, it didn't quietly fall back to single-chip;
+  2. bit-parity: the mesh dispatch's flat result buffer equals the
+     single-device dispatch elementwise on the same padded problem
+     (core-level, same ShapeRouter inputs the service used);
+  3. decision parity: the decoded wire response's (type, zone,
+     capacityType, pods) decisions equal the native C++ scan's on the same
+     problem (an independent implementation of the FFD semantics).
+
+Writes benchmarks/results/multichip_wire_<ts>.json. Fixed problem
+construction (benchmarks.baseline_configs.stress_problem_50k is
+deterministic), so reruns are comparable.
+
+Usage: python -m benchmarks.multichip_wire [--pods N] [--devices N]
+(CPU mesh: run under the Makefile's CPU_ENV for 8 virtual devices.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def run(n_pods: int, n_devices: int, out_dir: "str | None") -> dict:
+    from karpenter_tpu.utils.jaxenv import pin_cpu
+
+    jax = pin_cpu(n_devices)
+    import numpy as np
+
+    devs = jax.devices("cpu")
+    assert len(devs) >= n_devices, (
+        f"need {n_devices} devices, have {len(devs)}; run with "
+        f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices}")
+
+    from benchmarks.baseline_configs import stress_problem_50k
+    from karpenter_tpu.models.encode import encode_problem
+    from karpenter_tpu.solver import solver_pb2 as pb
+    from karpenter_tpu.solver import wire
+    from karpenter_tpu.solver.client import RemoteSolver
+    from karpenter_tpu.solver.core import (NativeSolver, TPUSolver,
+                                           build_pack_inputs,
+                                           dispatch_pack_inputs)
+    from karpenter_tpu.solver.service import SolverService, serve
+
+    catalog, provisioners, pods = stress_problem_50k(n_pods)
+
+    # crossover_cells=0: EVERY solve routes to the mesh — the parity run
+    # must exercise the sharded path regardless of problem size
+    service = SolverService(crossover_cells=0)
+    server, port, service = serve(service=service)
+    try:
+        client = RemoteSolver(catalog, provisioners,
+                              target=f"127.0.0.1:{port}", timeout=600.0)
+        client.sync()
+        req = pb.SolveRequest(
+            catalog_seqnum=catalog.seqnum,
+            catalog_hash=client.catalog_content_hash(),
+            provisioner_hash=client._prov_hash,
+            pods=[wire.pod_to_wire(p) for p in pods],
+        )
+        t0 = time.perf_counter()
+        resp = client._call("Solve", req)
+        wire_ms = (time.perf_counter() - t0) * 1000
+        decoded = client._decode(resp, pods)
+
+        # 1) the wire actually served the mesh kernel
+        assert resp.routing == "tpu-sharded", (
+            f"wire solve routed {resp.routing!r}, expected tpu-sharded")
+        assert resp.device_count == n_devices, (
+            resp.device_count, n_devices)
+        placed = sum(n.pod_count for n in decoded.nodes)
+        assert placed + decoded.unschedulable_count() == len(pods), (
+            placed, decoded.unschedulable_count(), len(pods))
+
+        # 2) bit-parity: same padded problem through the service's resident
+        # mesh context vs the single-device dispatch
+        solver, _ = service._cache[(req.catalog_hash,
+                                    req.provisioner_hash)]
+        enc = encode_problem(solver.catalog, solver.provisioners, pods, (),
+                             None, None, grid=solver.grid(),
+                             group_cache=solver._group_cache)
+        inputs, dims, use_pallas = build_pack_inputs(
+            enc, solver._dev_alloc_t, solver._dev_tiebreak)
+        flat_sharded = np.asarray(solver._mesh_ctx.dispatch_flat(
+            inputs, dims[1], use_pallas, enc.grid))
+        flat_single = np.asarray(
+            dispatch_pack_inputs(inputs, dims, use_pallas))
+        bit_parity = (flat_sharded.shape == flat_single.shape
+                      and bool((flat_sharded == flat_single).all()))
+        assert bit_parity, "mesh/single flat-result divergence"
+
+        # 3) decision parity vs the independent native scan
+        native = NativeSolver(catalog, provisioners).solve(pods)
+        decision_parity = decoded.decisions() == native.decisions()
+        assert decision_parity, (
+            f"native divergence: {len(decoded.decisions())} vs "
+            f"{len(native.decisions())} decisions")
+    finally:
+        server.stop(0)
+
+    record = {
+        "captured_at": time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
+        "harness": "benchmarks.multichip_wire",
+        "n_pods": len(pods),
+        "n_types": len(catalog.types),
+        "devices": n_devices,
+        "mesh": solver._mesh_ctx.describe(),
+        "routing": resp.routing,
+        "bucket": resp.bucket,
+        "wire_solve_ms": round(wire_ms, 3),
+        "service_solve_ms": round(resp.solve_ms, 3),
+        "nodes": len(decoded.nodes),
+        "unschedulable": decoded.unschedulable_count(),
+        "bit_parity": bit_parity,
+        "decision_parity": decision_parity,
+        "decisions": len(decoded.decisions()),
+        "backend": jax.devices()[0].platform,
+    }
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(
+            out_dir, f"multichip_wire_{record['captured_at']}.json")
+        with open(path, "w") as f:
+            json.dump(record, f, indent=1)
+        record["artifact"] = path
+    return record
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pods", type=int, default=50_000)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--no-record", action="store_true",
+                    help="don't write an artifact under benchmarks/results")
+    args = ap.parse_args(argv)
+    out_dir = None if args.no_record else os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results")
+    record = run(args.pods, args.devices, out_dir)
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

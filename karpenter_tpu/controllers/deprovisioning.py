@@ -502,9 +502,8 @@ class DeprovisioningController:
             sorted(self.kube.provisioners(), key=lambda p: (-p.weight, p.name)),
             catalog)
         try:
-            from ..solver.core import NativeSolver
-
-            res = NativeSolver(catalog, provs).solve(pods, existing=survivors)
+            res = self._reval_solver(catalog, provs).solve(
+                pods, existing=survivors)
             ok = res.unschedulable_count() == 0 and not res.nodes
         except Exception:
             from ..oracle.scheduler import Scheduler
@@ -517,6 +516,25 @@ class DeprovisioningController:
                         "the surviving cluster; abandoning",
                         ",".join(action.nodes))
         return ok
+
+    def _reval_solver(self, catalog, provs):
+        """Content-keyed memo of the replace-revalidation solver: the init
+        wait re-runs revalidation every reconcile tick, and building a fresh
+        NativeSolver each time re-derives the whole group-encode state. An
+        evicted predecessor donates its static grid arrays (adopt_static)
+        so availability-only catalog churn keeps the folds warm."""
+        from ..solver import wire
+        from ..solver.core import NativeSolver
+
+        key = (wire.catalog_hash(catalog), wire.provisioners_hash(provs))
+        cached = getattr(self, "_reval_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        solver = NativeSolver(catalog, provs)
+        if cached is not None:
+            solver.adopt_static(cached[1])
+        self._reval_cache = (key, solver)
+        return solver
 
     def reconcile_once(self):
         with _wd_cycle(self.watchdog, "deprovisioning"):

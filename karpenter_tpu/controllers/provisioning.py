@@ -208,13 +208,22 @@ class ProvisioningController:
                 self.last_solver_kind = solver_kind
                 self.sched_duration.observe(time.perf_counter() - t0,
                                             solver=solver_kind)
-                solve_span.set_attribute("routing", solver_kind)
+                # the solver may have annotated a MORE specific routing
+                # in-place ("tpu-sharded" when the shape router sent the
+                # solve to the mesh) — keep it; only fill in the generic
+                # ladder-rung name when the solver left nothing
+                routing = solve_span.attributes.get("routing")
+                if not (isinstance(routing, str)
+                        and routing.startswith(solver_kind)):
+                    routing = solver_kind
+                solve_span.set_attribute("routing", routing)
                 # the chosen solver annotated the span in-place (core.py
                 # last_solve_info); guarantee the load-bearing attrs exist
                 # even on the oracle path
                 solve_span.attributes.setdefault("compile_cache", "n/a")
                 solve_span.attributes.setdefault("transfer_ms", 0.0)
-                root.set_attribute("routing", solver_kind)
+                solve_span.attributes.setdefault("bucket", "n/a")
+                root.set_attribute("routing", routing)
 
             with TRACER.start_span("provisioning.bind") as bind:
                 self._apply(result, pods, catalog=catalog,

@@ -269,18 +269,27 @@ def group_pods(pods: "list[PodSpec]") -> "list[PodGroup]":
     # tuples — slower, but correct without any epoch assumption.
     for _ in range(3):
         epoch_before = _group_key_epoch
-        groups: "dict[int, PodGroup]" = {}
-        get = groups.get
+        # accumulate only the name lists (count == len) and a representative
+        # pod per token; building PodGroups inside the loop costs two extra
+        # attribute ops per pod, and with the warm-path token read inlined
+        # (a bound-method call per pod is ~1ms at 10k pods) this loop is the
+        # per-cycle host-encode floor
+        names: "dict[int, list[str]]" = {}
+        first: "dict[int, PodSpec]" = {}
+        get = names.get
         for p in pods:
-            tok = p.group_token()
-            g = get(tok)
-            if g is None:
-                groups[tok] = PodGroup(spec=p, count=1, pod_names=[p.name])
+            c = p.__dict__.get("_group_token")
+            tok = c[0] if (c is not None and c[1] == epoch_before) \
+                else p.group_token()
+            lst = get(tok)
+            if lst is None:
+                names[tok] = [p.name]
+                first[tok] = p
             else:
-                g.count += 1
-                g.pod_names.append(p.name)
+                lst.append(p.name)
         if _group_key_epoch == epoch_before:
-            return list(groups.values())
+            return [PodGroup(spec=first[t], count=len(ns), pod_names=ns)
+                    for t, ns in names.items()]
     bykey: "dict[object, PodGroup]" = {}
     for p in pods:
         g = bykey.get(p.group_key())

@@ -104,6 +104,18 @@ class PackInputs(NamedTuple):
     # the same origin_key; None => every row is its own origin (identity),
     # which is exact whenever no group is capped or no origins are shared.
     group_origin: "jax.Array | None" = None  # i32 [G]
+    # Resource-axis compression (build_pack_inputs): a resource whose demand
+    # is zero in EVERY group contributes INT_BIG to every quotient whatever
+    # its availability, so the kernel only needs the active columns — and
+    # the [N, T, R] quotient tensor is the per-step compute floor. res_sel
+    # gathers the active columns out of the (full-width, device-resident)
+    # alloc_t inside the kernel; every other R-shaped leaf arrives already
+    # compressed from the host. res_sel[0] is ALWAYS the pods resource (the
+    # kubelet pods-cap path needs its index statically). res_mask is False
+    # on ladder-padding lanes (their gathered columns are zeroed: vec=0 and
+    # avail=0 make them INT_BIG no-ops). None => legacy full-width layout.
+    res_sel: "jax.Array | None" = None   # i32 [Rb]
+    res_mask: "jax.Array | None" = None  # bool [Rb]
 
 
 class PackState(NamedTuple):
@@ -130,11 +142,55 @@ class PackResult(NamedTuple):
     n_open: jax.Array      # i32 []
 
 
+def _use_fast_div() -> bool:
+    # trace-time choice, same doctrine as pallas_kernels.enabled(): XLA:CPU
+    # lowers s32 divide to a scalar idiv per element (no SIMD), which at the
+    # step's [N, T, R] quotient tensor is ~85% of kernel step time; the
+    # float32 path below is exact and ~4x faster there. Other backends keep
+    # the native integer divide.
+    return jax.default_backend() == "cpu"
+
+
+def _floor_div(a: jax.Array, v: jax.Array) -> jax.Array:
+    """Exact floor(a / v) for 0 <= a <= INT_BIG, v >= 1 (int32), without
+    the scalar s32 idiv. The a-bound is the encode invariant (every
+    capacity/allocatable array is INT_BIG-clamped at encode) and keeps the
+    f32 estimate's int32 cast in range. float32-reciprocal estimate, then:
+
+    * small-quotient lanes (v > 2^24 so q < 2^7): the estimate's absolute
+      error is q * O(2^-22) << 1, a +-1 integer fix is enough;
+    * everywhere else, a coarse stage first: subtract a margin that
+      provably dominates the f32 error (est >> 20 grows with the quotient
+      exactly as the error does) so q1 <= true q, take the remainder
+      r = a - q1*v (fits int32: r <= a*2^-19 + 11v on these lanes), and
+      estimate r/v — a quotient <= ~2^12, back in +-1 territory.
+
+    The final fix computes rf = a - q*v in wraparound int32 (the true
+    value fits whenever |q - true| <= 1, which both paths guarantee) and
+    nudges by the sign: rf >= v means one more fits, rf < 0 means one too
+    many. Bit-exact vs // for the full int32 domain (property-tested in
+    tests/test_packer_parity.py)."""
+    af = a.astype(jnp.float32)
+    recip = 1.0 / v.astype(jnp.float32)
+    est = jnp.floor(af * recip).astype(jnp.int32)
+    m = (est >> 20) + 4
+    q1 = jnp.maximum(est - m, 0)
+    r = a - q1 * v
+    q2 = q1 + jnp.floor(r.astype(jnp.float32) * recip).astype(jnp.int32)
+    q = jnp.where(v > (1 << 24), est, q2)
+    q = jnp.maximum(q, 0)
+    rf = a - q * v
+    return q + (rf >= v).astype(jnp.int32) - (rf < 0).astype(jnp.int32)
+
+
 def _quotient(avail: jax.Array, vec: jax.Array) -> jax.Array:
     """How many `vec`-sized pods fit into `avail`: min over resources of
     floor(avail/vec), with zero-demand resources ignored. avail [..., R]."""
     pos = vec > 0
-    q = jnp.where(pos, avail // jnp.maximum(vec, 1), INT_BIG)
+    vsafe = jnp.maximum(vec, 1)
+    div = (_floor_div(jnp.maximum(avail, 0), vsafe) if _use_fast_div()
+           else avail // vsafe)
+    q = jnp.where(pos, div, INT_BIG)
     q = jnp.where(avail < 0, jnp.where(pos, -1, INT_BIG), q)
     return jnp.clip(jnp.min(q, axis=-1), -1, INT_BIG)
 
@@ -156,7 +212,10 @@ def _waterfall(count: jax.Array, fill: jax.Array) -> jax.Array:
 def _pods_cap_quotient(cap_avail: jax.Array, vec_pods: jax.Array) -> jax.Array:
     """How many more pods the kubelet pods cap admits: floor(cap_avail/vec)
     with the same zero-demand/negative conventions as _quotient."""
-    q = jnp.where(vec_pods > 0, cap_avail // jnp.maximum(vec_pods, 1), INT_BIG)
+    vsafe = jnp.maximum(vec_pods, 1)
+    div = (_floor_div(jnp.maximum(cap_avail, 0), vsafe) if _use_fast_div()
+           else cap_avail // vsafe)
+    q = jnp.where(vec_pods > 0, div, INT_BIG)
     q = jnp.where(cap_avail < 0, jnp.where(vec_pods > 0, -1, INT_BIG), q)
     return jnp.clip(q, -1, INT_BIG)
 
@@ -184,26 +243,36 @@ def _step(inputs: PackInputs, state: PackState, g: jax.Array,
     rem = count - jnp.sum(m_ex)
 
     # ---- 2) open claims, first-fit in creation order -------------------------
-    feas_n = inputs.group_feas[g][jnp.clip(state.nprov, 0, None)]  # [N, T, S]
+    gf = inputs.group_feas[g]                                      # [Pv, T, S]
+    # Pv is a static shape: with one provisioner every node row gathers the
+    # same feasibility plane, so broadcast instead of an [N]-row gather
+    feas_n = gf[0][None] if gf.shape[0] == 1 \
+        else gf[jnp.clip(state.nprov, 0, None)]                    # [N, T, S]
     nodefeas = state.optmask & feas_n & state.active[:, None, None]
     if use_pallas:
         q_nt = pallas_kernels.quotient_nt_auto(inputs.alloc_t, state.used, vec)
     else:
         q_nt = _quotient(inputs.alloc_t[None, :, :] - state.used[:, None, :], vec)  # [N, T]
+    # pods column index: 0 in the compressed layout (res_sel pins it there),
+    # the wellknown index in the legacy full-width layout
+    pods_i = 0 if inputs.res_sel is not None else _PODS_I
     if inputs.prov_pods_cap is not None:
         # kubelet pods cap of the node's provisioner bounds the quotient
         cap_nt = inputs.prov_pods_cap[jnp.clip(state.nprov, 0, None)]   # [N, T]
         q_extra = _pods_cap_quotient(
-            cap_nt - state.used[:, _PODS_I][:, None], vec[_PODS_I])
+            cap_nt - state.used[:, pods_i][:, None], vec[pods_i])
         q_nt = jnp.minimum(q_nt, q_extra)
-    q_cap = jnp.where(nodefeas, q_nt[:, :, None], -1)              # [N, T, S]
-    qmax = jnp.max(q_cap.reshape(q_cap.shape[0], -1), axis=-1)     # [N]
+    # max feasible quotient per node: q is S-independent, so reduce the
+    # mask over S first instead of building an [N, T, S] quotient tensor
+    feas_t = jnp.any(nodefeas, axis=-1)                            # [N, T]
+    qmax = jnp.max(jnp.where(feas_t, q_nt, -1), axis=-1)           # [N]
     # per-claim remaining budget shared across subgroups of the origin
     cap_n = cap - state.claim_placed[og]                           # [N]
     fill_n = jnp.clip(jnp.minimum(qmax, cap_n), 0, INT_BIG)
     m_n = _waterfall(rem, fill_n)                                  # [N]
     new_used = state.used + m_n[:, None] * vec[None, :]
-    shrunk = nodefeas & (q_nt[:, :, None] >= m_n[:, None, None])
+    # compare on [N, T] and broadcast: the quotient is S-independent
+    shrunk = nodefeas & (q_nt >= m_n[:, None])[:, :, None]
     placed = m_n > 0
     optmask = jnp.where(placed[:, None, None], shrunk, state.optmask)
     used = jnp.where(placed[:, None], new_used, state.used)
@@ -219,7 +288,7 @@ def _step(inputs: PackInputs, state: PackState, g: jax.Array,
     if inputs.prov_pods_cap is not None:
         cap_t = inputs.prov_pods_cap[jnp.clip(p, 0, None)]             # [T]
         q0 = jnp.minimum(q0, _pods_cap_quotient(
-            cap_t - ovh[_PODS_I], vec[_PODS_I]))
+            cap_t - ovh[pods_i], vec[pods_i]))
     kstar = jnp.max(jnp.where(freshfeas, q0[:, None], 0))
     kstar = jnp.clip(jnp.minimum(kstar, cap), 0, INT_BIG)
     n_new = jnp.where(kstar > 0, (rem + kstar - 1) // jnp.maximum(kstar, 1), 0)
@@ -234,7 +303,7 @@ def _step(inputs: PackInputs, state: PackState, g: jax.Array,
     cnt = jnp.where(in_range, cnt, 0)                              # [N]
     fresh_used = ovh[None, :] + cnt[:, None] * vec[None, :]
     used = jnp.where(in_range[:, None], fresh_used, used)
-    fresh_mask = freshfeas[None, :, :] & (q0[None, :, None] >= cnt[:, None, None])
+    fresh_mask = freshfeas[None, :, :] & (q0[None, :] >= cnt[:, None])[:, :, None]
     optmask = jnp.where(in_range[:, None, None], fresh_mask, optmask)
     active = state.active | in_range
     nprov = jnp.where(in_range, p, state.nprov)
@@ -254,6 +323,13 @@ def pack_impl(inputs: PackInputs, n_slots: int,
     # that also folds in the pallas_value_safe() 2**24 exactness check.
     if use_pallas is None:
         use_pallas = pallas_kernels.enabled()
+    if inputs.res_sel is not None:
+        # gather the active resource columns out of the full-width resident
+        # catalog array ONCE per solve (loop-invariant); padding lanes are
+        # zeroed so they stay INT_BIG no-ops in every quotient
+        alloc_a = jnp.where(inputs.res_mask[None, :],
+                            inputs.alloc_t[:, inputs.res_sel], 0)
+        inputs = inputs._replace(alloc_t=alloc_a)
     G = inputs.group_vec.shape[0]
     T, S = inputs.tiebreak.shape
     R = inputs.group_vec.shape[1]
@@ -269,12 +345,28 @@ def pack_impl(inputs: PackInputs, n_slots: int,
         claim_placed=jnp.zeros((G, n_slots), jnp.int32),
     )
 
-    def body(state, g):
-        return _step(inputs, state, g, use_pallas=use_pallas)
+    # Effective trip count: one past the last row holding any pods. A
+    # count=0 row is an exact identity step (every waterfall fills 0, no
+    # mask/state write fires), so the loop simply stops at the last real
+    # row and bucket padding costs memory, not FLOPs — the rung ladder
+    # (solver/buckets.py) can stay coarse without the padded rows taxing
+    # every solve. In-graph scalar: the jit cache key is unchanged; under
+    # vmap the wave runs to the widest member and Sync-warmup's all-zero
+    # synthetic problems compile the full program but execute no steps.
+    gi = jnp.arange(G, dtype=jnp.int32)
+    n_eff = jnp.max(jnp.where(inputs.group_count > 0, gi + 1, 0))
 
-    final, (assign, ex_assign, unsched) = jax.lax.scan(
-        body, init, jnp.arange(G, dtype=jnp.int32)
-    )
+    def body(g, carry):
+        state, assign, ex_assign, unsched = carry
+        new_state, (row_n, row_ex, row_us) = _step(
+            inputs, state, g, use_pallas=use_pallas)
+        return (new_state, assign.at[g].set(row_n),
+                ex_assign.at[g].set(row_ex), unsched.at[g].set(row_us))
+
+    final, assign, ex_assign, unsched = jax.lax.fori_loop(
+        0, n_eff, body,
+        (init, jnp.zeros((G, n_slots), jnp.int32),
+         jnp.zeros((G, Ne), jnp.int32), jnp.zeros((G,), jnp.int32)))
 
     # decision: cheapest surviving option per active claim (instance.go:445-462)
     rank = jnp.where(final.optmask, inputs.tiebreak[None, :, :], INT_BIG)
@@ -306,6 +398,13 @@ def pack_flat_impl(inputs: PackInputs, n_slots: int,
              nprov (N) | decided (N) | n_open (1)]
     """
     r = pack_impl(inputs, n_slots, use_pallas=use_pallas)
+    return flatten_result(r)
+
+
+def flatten_result(r: PackResult) -> jax.Array:
+    """The one flat-layout owner (pack_flat_impl + the sharded flat variant
+    in parallel/sharded.py): both paths MUST produce bit-identical buffers
+    for the same problem, so the concat order lives in exactly one place."""
     return jnp.concatenate([
         r.assign.ravel(), r.ex_assign.ravel(), r.unsched.ravel(),
         r.active.astype(jnp.int32), r.nprov, r.decided,

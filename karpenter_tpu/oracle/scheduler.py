@@ -550,8 +550,12 @@ def split_deferred_pods(pods: "list[PodSpec]") -> "tuple[list[PodSpec], list[Pod
     """
     # fast path: no affinity terms anywhere -> no second round. An attribute
     # scan is ~10x cheaper than the full dedup grouping at 10k pods, and the
-    # headline workloads carry no terms (profiled round 3).
-    if not any(p.pod_affinity or p.pod_anti_affinity for p in pods):
+    # headline workloads carry no terms (profiled round 3). Plain loop, not
+    # any(genexpr): the generator frame resume per pod is ~0.4ms at 10k.
+    for p in pods:
+        if p.pod_affinity or p.pod_anti_affinity:
+            break
+    else:
         return list(pods), []
     groups = group_pods([p for p in pods if not p.is_daemon()])
     # a group defers when any of its terms matches ANOTHER co-pending group
@@ -590,7 +594,9 @@ def prepare_groups(pods: "list[PodSpec]", zones: Sequence[str],
     Shared verbatim between this oracle and the kernel encoder
     (models/encode.py) so group ordering — which FFD results depend on —
     is identical on both paths."""
-    groups = group_pods([p for p in pods if not p.is_daemon()])
+    # attribute compare, not is_daemon(): 10k bound-method calls are ~1ms
+    # of the per-cycle host encode budget
+    groups = group_pods([p for p in pods if p.owner_kind != "DaemonSet"])
     groups = resolve_pod_affinity(groups, zones, existing)
     groups = split_zone_spread(groups, zones, existing)
     groups.sort(key=lambda g: (

@@ -1,0 +1,251 @@
+"""One shape-bucket table for the whole serving path.
+
+Bucket choice, jit cache key, warmup target and single-vs-sharded routing
+were previously derived independently (core._bucket doubled from lo=8, the
+sharded path was reachable only through dryrun_multichip), so the set of
+compiled programs and the set of routed programs could drift. This module
+is the single owner:
+
+* LADDERS — a FIXED rung table per padded dimension. Doubling-from-lo
+  recompiled on every crossing at small sizes (9->16->17->32->33->64 pods
+  groups each minted a program); the coarse x4 ladder trades a little
+  padded compute (scan steps over count=0 groups are no-ops) for an order
+  of magnitude fewer compiles. The wave axis K keeps x2 spacing on
+  purpose: padded wave lanes are REAL vmapped compute (duplicate rows run,
+  they're just never read back), so over-padding K doubles device work
+  rather than adding no-op scan steps.
+* BucketPlan — the padded (groups, slots, existing) shape of one solve;
+  its key() is the jit cache identity and its cells() feed the router.
+* ShapeRouter — single-chip kernel below the crossover, the
+  parallel/sharded.py mesh kernel above it, with hysteresis so jitter
+  around the crossover can't flap the route (each flap risks a compile
+  and resharding churn).
+
+Residency/compile observability lives here too (REGISTRY-registered so
+gen_docs picks them up): host->device upload counters asserted by the
+device-residency tests (metrics, not timing), and the compile-cache
+hit/miss/warmup counters behind `Sync`-time pre-jit.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Optional
+
+from ..metrics import REGISTRY
+
+# -- the ladder --------------------------------------------------------------
+
+# Rung tables per padded dimension. Above the top rung growth continues at
+# the same spacing (x4, or x2 for the wave axis) — the table bounds the
+# compile count at every scale that fits in memory, the tail rule just
+# keeps the function total.
+LADDERS: "dict[str, tuple[int, ...]]" = {
+    "groups": (8, 32, 128, 512, 2048, 8192, 32768),
+    "slots": (8, 32, 128, 512, 2048, 8192, 32768),
+    "existing": (1, 4, 16, 64, 256, 1024, 4096),
+    "wave": (2, 4, 8, 16, 32, 64, 128),
+    # active-resource columns (build_pack_inputs compression): above the
+    # top rung the kernel falls back to the full wellknown-resource width
+    # instead of growing — the table is a compression, not a pad target.
+    "resources": (4, 8),
+}
+
+_TAIL_FACTOR = {"groups": 4, "slots": 4, "existing": 4, "wave": 2,
+                "resources": 2}
+
+
+def bucket_up(n: int, dim: str) -> int:
+    """Smallest ladder rung >= n for the dimension (x4/x2 growth past the
+    table's top rung)."""
+    ladder = LADDERS[dim]
+    for rung in ladder:
+        if n <= rung:
+            return rung
+    b = ladder[-1]
+    f = _TAIL_FACTOR[dim]
+    while b < n:
+        b *= f
+    return b
+
+
+class BucketPlan(NamedTuple):
+    """Padded shape of one solve. One table drives everything derived from
+    it: the pad targets (build_pack_inputs), the jit cache key (shapes ARE
+    the key), the warmup target (warm_shapes synthesizes at these rungs)
+    and the routing decision (cells)."""
+
+    groups: int
+    slots: int
+    existing: int
+
+    def cells(self) -> int:
+        """Routing load proxy: the [G, N] assignment surface. The kernel's
+        per-step work is O(N*T*S) with T*S fixed by the synced catalog, so
+        groups*slots orders problems of one catalog consistently."""
+        return self.groups * self.slots
+
+    def label(self) -> str:
+        return f"g{self.groups}n{self.slots}e{self.existing}"
+
+
+def plan_for(n_groups: int, n_slots: int, n_existing: int) -> BucketPlan:
+    return BucketPlan(
+        groups=bucket_up(n_groups, "groups"),
+        slots=bucket_up(n_slots, "slots"),
+        existing=bucket_up(n_existing, "existing"),
+    )
+
+
+# -- the router --------------------------------------------------------------
+
+# Default single->sharded crossover in plan cells. 512*512: the 10k-pod
+# headline shape (Gb=32..128, Nb<=512) stays on the single-chip kernel
+# (mesh collectives would only add latency at that size), the 50k-pod
+# stress shape (Nb>=2048) goes to the mesh. Deployments tune it per
+# link/topology via the env knob.
+DEFAULT_CROSSOVER_CELLS = 512 * 512
+
+# Hysteresis span in rungs-worth of slack: switch UP at >= crossover,
+# switch back DOWN only below crossover/4 (one x4 rung), so a workload
+# breathing around the crossover keeps its route (and compiled program).
+HYSTERESIS_FACTOR = 4
+
+
+def crossover_cells_default() -> int:
+    try:
+        return int(os.environ.get("KARPENTER_TPU_SHARD_CROSSOVER_CELLS",
+                                  DEFAULT_CROSSOVER_CELLS))
+    except ValueError:
+        return DEFAULT_CROSSOVER_CELLS
+
+
+class ShapeRouter:
+    """Sticky single-vs-sharded route off the bucket plan. Per-solver
+    instance (route state is an attribute of the resident device state,
+    not a global): the solver service builds one per synced solver, all
+    sharing the service's crossover."""
+
+    def __init__(self, n_devices: int = 1,
+                 crossover_cells: "Optional[int]" = None,
+                 hysteresis: int = HYSTERESIS_FACTOR):
+        self.n_devices = max(1, int(n_devices))
+        self.hi = (crossover_cells if crossover_cells is not None
+                   else crossover_cells_default())
+        self.lo = max(1, self.hi // max(1, hysteresis))
+        self._route = "single"
+
+    def route(self, plan: BucketPlan) -> str:
+        """"single" or "sharded". Sticky: between lo and hi the previous
+        route wins, so jitter near the crossover cannot flap."""
+        if self.n_devices < 2:
+            return "single"
+        cells = plan.cells()
+        if cells >= self.hi:
+            self._route = "sharded"
+        elif cells < self.lo:
+            self._route = "single"
+        return self._route
+
+    def steady_route(self, plan: BucketPlan) -> str:
+        """The route a steady stream of this plan would settle on — pure
+        function of the plan, does NOT touch the sticky state. Warmup uses
+        this so pre-jitting a bucket can't flip the live route."""
+        if self.n_devices < 2:
+            return "single"
+        return "sharded" if plan.cells() >= self.hi else "single"
+
+
+# -- residency / compile observability ---------------------------------------
+
+# Host->device upload accounting: every device_put the solver performs goes
+# through core._device_put_tracked, labeled by what crossed. The
+# device-residency contract ("Sync-then-repeat-Solve performs zero redundant
+# uploads of unchanged catalog tensors") is asserted against these counters
+# — a metric delta is deterministic where wall-clock never is.
+UPLOADS = REGISTRY.counter(
+    "karpenter_solver_host_to_device_uploads_total",
+    "Host->device transfers performed by the solver, by tensor class "
+    "(catalog = Sync-resident arrays, delta = per-solve problem arrays).",
+    ("tensor",))
+UPLOAD_BYTES = REGISTRY.counter(
+    "karpenter_solver_host_to_device_bytes_total",
+    "Bytes shipped host->device by the solver, by tensor class.",
+    ("tensor",))
+
+COMPILE_HITS = REGISTRY.counter(
+    "karpenter_solver_compile_cache_hits_total",
+    "Solves served by an already-compiled pack program.")
+COMPILE_MISSES = REGISTRY.counter(
+    "karpenter_solver_compile_cache_misses_total",
+    "Solves that paid an XLA compile (a shape bucket seen for the first "
+    "time escaped warmup).")
+COMPILE_WARMUPS = REGISTRY.counter(
+    "karpenter_solver_compile_cache_warmups_total",
+    "Pack programs compiled ahead of traffic by Sync-time warmup "
+    "(TPUSolver.warm_shapes).")
+
+# How full buckets run: ratio of the raw dimension to its padded rung.
+# Persistently low occupancy on a dimension means the ladder is too coarse
+# for the deployment's workload (wasted padded compute); near-1.0 means the
+# next pod added tips into the next rung.
+BUCKET_OCCUPANCY = REGISTRY.histogram(
+    "karpenter_solver_bucket_occupancy_ratio",
+    "Raw size / padded bucket size per solve, by dimension.",
+    ("dim",),
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+BUCKET_SOLVES = REGISTRY.counter(
+    "karpenter_solver_bucket_solves_total",
+    "Solves dispatched per bucket plan and route.",
+    ("bucket", "route"))
+
+
+def tracked_device_put(arr, tensor: str, sharding=None):
+    """The solver's ONE device_put: counts what actually crosses the
+    host->device boundary. An array that is already a device array (with
+    the requested sharding, when one is given) is returned as-is and
+    counts nothing — that no-op IS the residency win the counters exist
+    to prove."""
+    import jax
+
+    if isinstance(arr, jax.Array):
+        if sharding is None or arr.sharding == sharding:
+            return arr
+    UPLOADS.inc(tensor=tensor)
+    nbytes = getattr(arr, "nbytes", None)
+    if nbytes:
+        UPLOAD_BYTES.inc(float(nbytes), tensor=tensor)
+    return jax.device_put(arr, sharding) if sharding is not None \
+        else jax.device_put(arr)
+
+
+def tracked_tree_put(tree, tensor: str, shardings=None):
+    """tracked_device_put over a pytree (None leaves skipped). shardings,
+    when given, is a matching pytree of shardings (None = replicated/plain
+    put). The plain-put case counts host-side then ships the whole tree in
+    ONE jax.device_put call — per-leaf puts cost a C++ round trip each,
+    measurable on the per-solve delta path."""
+    import jax
+
+    if shardings is not None:
+        return jax.tree.map(
+            lambda a, sh: tracked_device_put(a, tensor, sh), tree, shardings)
+    n = nbytes = 0
+    for a in jax.tree.leaves(tree):
+        if not isinstance(a, jax.Array):
+            n += 1
+            nbytes += getattr(a, "nbytes", 0) or 0
+    if n:
+        UPLOADS.inc(float(n), tensor=tensor)
+        if nbytes:
+            UPLOAD_BYTES.inc(float(nbytes), tensor=tensor)
+    return jax.device_put(tree)
+
+
+def observe_plan(plan: BucketPlan, n_groups: int, n_slots: int,
+                 n_existing: int, route: str) -> None:
+    BUCKET_SOLVES.inc(bucket=plan.label(), route=route)
+    BUCKET_OCCUPANCY.observe(n_groups / plan.groups, dim="groups")
+    BUCKET_OCCUPANCY.observe(n_slots / plan.slots, dim="slots")
+    if plan.existing:
+        BUCKET_OCCUPANCY.observe(n_existing / plan.existing, dim="existing")

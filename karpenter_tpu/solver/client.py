@@ -52,6 +52,29 @@ VERSION_SKEW = Counter(
 _channels: "dict[str, grpc.Channel]" = {}
 _channels_lock = threading.Lock()
 
+# Recent pod counts solved per target (most recent last, bounded) — shipped
+# as SyncRequest.warm_pod_counts so a restarted/re-synced service can pre-jit
+# the shape buckets this controller's traffic actually hits. Module-level
+# like _channels: RemoteSolver instances are per-reconcile, the traffic
+# history is per-target.
+_WARM_HINTS_CAP = 8
+_warm_hints: "dict[str, list[int]]" = {}
+_warm_hints_lock = threading.Lock()
+
+
+def _note_warm_hint(target: str, pod_count: int) -> None:
+    with _warm_hints_lock:
+        hints = _warm_hints.setdefault(target, [])
+        if pod_count in hints:
+            hints.remove(pod_count)
+        hints.append(pod_count)
+        del hints[:-_WARM_HINTS_CAP]
+
+
+def _get_warm_hints(target: str) -> "list[int]":
+    with _warm_hints_lock:
+        return list(reversed(_warm_hints.get(target, ())))
+
 
 def _shared_channel(target: str) -> grpc.Channel:
     with _channels_lock:
@@ -83,6 +106,7 @@ class RemoteSolver:
         # still their safety net
         self._policy = resilience.policy("solver") if resilience is not None \
             else None
+        self._target = target
         self._channel = channel or _shared_channel(target)
         self._synced_hash: Optional[int] = None
         self._prov_hash = wire.provisioners_hash(self.provisioners)
@@ -175,7 +199,9 @@ class RemoteSolver:
                 attrs = {"routing": resp.routing or "unknown",
                          "compile_cache": resp.compile_cache or "unknown",
                          "transfer_ms": resp.transfer_ms,
-                         "solve_ms": resp.solve_ms}
+                         "solve_ms": resp.solve_ms,
+                         "bucket": resp.bucket or "n/a",
+                         "device_count": resp.device_count or 1}
                 span.set_attributes(**attrs)
                 if cur is not None:
                     cur.set_attributes(**attrs)
@@ -190,6 +216,9 @@ class RemoteSolver:
         resp = self._call("Sync", pb.SyncRequest(
             catalog=wire.catalog_to_wire(self.catalog),
             provisioners=[wire.provisioner_to_wire(p) for p in self.provisioners],
+            # compile-cache warmup hints: the pod counts this target's
+            # traffic recently solved for (see service._warm)
+            warm_pod_counts=_get_warm_hints(self._target),
         ))
         # Staleness is content-keyed (see wire.catalog_hash): the server
         # installs whatever content we sent, so a mismatch here means the
@@ -261,6 +290,7 @@ class RemoteSolver:
     def solve(self, pods: "list[PodSpec]",
               existing: Sequence[ExistingNode] = (),
               daemon_overhead: Optional[Sequence[int]] = None) -> SolveResult:
+        _note_warm_hint(self._target, len(pods))
         req = pb.SolveRequest(
             catalog_seqnum=self.catalog.seqnum,
             catalog_hash=self.catalog_content_hash(),

@@ -6,16 +6,19 @@ scalar oracle (karpenter_tpu/oracle/scheduler.py) remains the in-process
 fallback with identical semantics (BASELINE.json north star).
 
 Shape discipline (SURVEY.md §7.3 "dynamic shapes"): pod-group count, claim
-slots and existing-node count are bucketed to powers of two and padded, so a
-stream of differently-sized solves hits a handful of compiled programs, not a
-recompilation per solve. Padded groups have count=0 / feas=False and are
-no-ops in the kernel.
+slots and existing-node count are padded to the fixed rung ladder in
+solver/buckets.py, so a stream of differently-sized solves hits a handful
+of compiled programs, not a recompilation per solve. Padded groups have
+count=0 / feas=False and are no-ops in the kernel. The same table drives
+the jit cache key, Sync-time warmup (warm_shapes) and the single-chip vs
+mesh routing decision (buckets.ShapeRouter + parallel/sharded.py).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -28,8 +31,10 @@ from ..models.instancetype import Catalog
 from ..models.pod import PodSpec
 from ..ops import pallas_kernels
 from ..ops.packer import (INT_BIG, PackInputs, PackResult, pack_flat,
-                          pallas_value_safe, unflatten_result)
+                          pack_flat_impl, pallas_value_safe,
+                          unflatten_result)
 from ..oracle.scheduler import ExistingNode, Option
+from . import buckets
 
 import os as _os
 
@@ -49,10 +54,20 @@ _READBACK = _os.environ.get("KARPENTER_TPU_READBACK", "get")
 
 
 def _bucket(n: int, lo: int = 8) -> int:
-    b = lo
-    while b < n:
-        b *= 2
-    return b
+    """Ladder-rung bucket (historic name/signature kept: the graft entry
+    and the sharded tests pad with it). `lo` names the dimension's ladder:
+    8 -> groups/slots, 1 -> existing nodes, 2 -> wave lanes. The old
+    doubling-from-lo policy minted a program on every power-of-two
+    crossing at small sizes; the fixed ladder (solver/buckets.py) is
+    shared with the router so bucket choice, cache key and sharding plan
+    all derive from one table."""
+    dim = {8: "groups", 1: "existing", 2: "wave"}.get(lo)
+    if dim is None:  # unknown lo: legacy doubling (no in-tree callers)
+        b = lo
+        while b < n:
+            b *= 2
+        return b
+    return buckets.bucket_up(n, dim)
 
 
 @dataclasses.dataclass
@@ -97,13 +112,24 @@ class TPUSolver:
     "ship only the pod delta")."""
 
     def __init__(self, catalog: Catalog, provisioners: Sequence[Provisioner],
-                 reuse_from: "Optional[TPUSolver]" = None):
+                 reuse_from: "Optional[TPUSolver]" = None,
+                 mesh_ctx=None, router: "Optional[buckets.ShapeRouter]" = None):
         self.catalog = catalog
         self.provisioners = list(provisioners)
         self._grid: Optional[OptionGrid] = None
         self._donor_grid: Optional[OptionGrid] = None
         self._dev_alloc_t = None
         self._dev_tiebreak = None
+        # multi-chip serving (solver service wiring): a persistent
+        # parallel/sharded.ShardedContext plus the shape router deciding
+        # single-chip vs mesh per bucket. Both None -> always single-chip
+        # (in-process controller solvers, tests, single-device hosts).
+        self._mesh_ctx = mesh_ctx
+        self._router = router
+        # raw shape key of the last solve ((G, n_slots, Ne, Pv, optional
+        # leaf flags)) — the service's warmup history records these so a
+        # re-Sync can pre-jit what traffic actually looked like
+        self.last_shape_key: "Optional[tuple]" = None
         # encode_group memo across solves (this instance's provisioner set is
         # fixed; layout/seqnum two-level invalidation — see encode_problem)
         self._group_cache: dict = {}
@@ -152,8 +178,10 @@ class TPUSolver:
             self._grid = build_grid(self.catalog, reuse=old)
             if old is None or self._grid.alloc_t is not old.alloc_t \
                     or self._dev_alloc_t is None:
-                self._dev_alloc_t = jax.device_put(self._grid.alloc_t)
-                self._dev_tiebreak = jax.device_put(self._grid.tiebreak)
+                self._dev_alloc_t = buckets.tracked_device_put(
+                    self._grid.alloc_t, "catalog")
+                self._dev_tiebreak = buckets.tracked_device_put(
+                    self._grid.tiebreak, "catalog")
         return self._grid
 
     def solve(
@@ -246,19 +274,21 @@ class TPUSolver:
         # Same-shape problems fold into ONE vmapped dispatch per bucket
         # (degraded-link cost is per device OPERATION, not per byte —
         # solver-boundary.md), then all buckets concatenate into one read.
-        buckets: "dict[tuple, list[int]]" = {}
+        shape_waves: "dict[tuple, list[int]]" = {}
         for i, (mode, payload) in enumerate(slots):
             if mode != "wave":
                 continue
             _enc, inputs, dims, up, _ex = payload
             key = (dims, up, id(inputs.alloc_t),  # grid identity
+                   inputs.group_vec.shape[1],  # compressed resource width
+                   inputs.res_sel is not None,
                    inputs.ex_cap is not None,
                    inputs.group_origin is not None,
                    inputs.prov_overhead is not None,
                    inputs.prov_pods_cap is not None)
-            buckets.setdefault(key, []).append(i)
+            shape_waves.setdefault(key, []).append(i)
         flats: "list[tuple[list[int], object]]" = []  # (slot idxs, [K,L] dev)
-        for key, idxs in buckets.items():
+        for key, idxs in shape_waves.items():
             (_gb, Nb, _neb), up = key[0], key[1]
             members = [slots[i][1][1] for i in idxs]
             if len(members) == 1:
@@ -292,6 +322,94 @@ class TPUSolver:
                 out.append(decode(enc, fetched[i],
                                   [e.name for e in existing]))
         return out
+
+    def warm_shapes(self, shapes: "Sequence[tuple]",
+                    limit: int = 8) -> "list[str]":
+        """Pre-jit the pack programs for raw problem shapes (Sync-time
+        compile-cache warmup): the first real Solve of a bucket then never
+        eats XLA compile latency. Each shape is (G, n_slots, Ne) or the
+        extended last_shape_key (adds Pv + optional-leaf flags). Dispatches
+        a zero-count synthetic problem at the bucketed shape through the
+        REAL dispatch path — group_count=0 rows are kernel no-ops, so the
+        execution is cheap and only the compile is bought. Returns the
+        bucket labels that actually compiled something new."""
+        grid = self.grid()
+        warmed: "list[str]" = []
+        seen: "set[tuple]" = set()
+        for shape in list(shapes)[:max(0, limit)]:
+            G, slots_n, Ne = int(shape[0]), int(shape[1]), int(shape[2])
+            pv = int(shape[3]) if len(shape) > 3 else max(
+                1, len(self.provisioners))
+            flags = (tuple(bool(f) for f in shape[4:8])
+                     if len(shape) >= 8 else (False, False, False, False))
+            plan = buckets.plan_for(G, slots_n, Ne)
+            key = (plan, pv, flags)
+            if key in seen:
+                continue
+            seen.add(key)
+            inputs = self._synth_inputs(grid, plan, pv, flags)
+            # mirror build_pack_inputs' pallas gate: zero deltas are
+            # trivially value-safe, the catalog arrays decide
+            use_pallas = pallas_kernels.enabled() and pallas_value_safe(
+                grid.alloc_t)
+            route = ("single" if self._router is None
+                     or self._mesh_ctx is None
+                     else self._router.steady_route(plan))
+            before = _dispatch_cache_size()
+            if route == "sharded":
+                flat = self._mesh_ctx.dispatch_flat(
+                    inputs, plan.slots, use_pallas, grid,
+                    donate=_donate_deltas())
+            else:
+                flat = dispatch_pack_inputs(
+                    inputs, (plan.groups, plan.slots, plan.existing),
+                    use_pallas)
+            flat.block_until_ready()
+            after = _dispatch_cache_size()
+            if before >= 0 and after > before:
+                buckets.COMPILE_WARMUPS.inc()
+                warmed.append(plan.label())
+        return warmed
+
+    def _synth_inputs(self, grid: OptionGrid, plan: "buckets.BucketPlan",
+                      pv: int, flags: "tuple") -> PackInputs:
+        """Zero-count PackInputs at exactly the padded shapes (and dtypes)
+        build_pack_inputs would produce, against the resident catalog
+        arrays — compiling through these hits the same jit cache entries
+        real solves will."""
+        has_ex_cap, has_origin, has_prov_ovh, has_pods_cap = flags
+        T, S = grid.tiebreak.shape
+        # compressed resource layout, like build_pack_inputs produces for
+        # typical (<=4 active resources) problems: zero-demand synthetic
+        # groups land on the bottom "resources" rung, which is also where
+        # real cpu+mem+pods workloads land — warming any other width would
+        # compile a program no real solve dispatches
+        R = buckets.LADDERS["resources"][0]
+        res_sel = np.zeros((R,), np.int32)
+        res_sel[0] = wk.RESOURCE_INDEX[wk.RESOURCE_PODS]
+        res_mask = np.arange(R) < 1
+        Gb, Nb, Neb = plan.groups, plan.slots, plan.existing
+        return PackInputs(
+            alloc_t=self._dev_alloc_t, tiebreak=self._dev_tiebreak,
+            group_vec=np.zeros((Gb, R), np.int32),
+            group_count=np.zeros((Gb,), np.int32),
+            group_cap=np.full((Gb,), int(INT_BIG), np.int32),
+            group_feas=np.zeros((Gb, pv, T, S), bool),
+            group_newprov=np.full((Gb,), -1, np.int32),
+            overhead=np.zeros((R,), np.int32),
+            ex_alloc=np.zeros((Neb, R), np.int32),
+            ex_used=np.zeros((Neb, R), np.int32),
+            ex_feas=np.zeros((Gb, Neb), bool),
+            prov_overhead=(np.zeros((pv, R), np.int32)
+                           if has_prov_ovh else None),
+            prov_pods_cap=(np.zeros((pv, T), np.int32)
+                           if has_pods_cap else None),
+            ex_cap=(np.full((Gb, Neb), int(INT_BIG), np.int32)
+                    if has_ex_cap else None),
+            group_origin=(np.arange(Gb, dtype=np.int32)
+                          if has_origin else None),
+            res_sel=res_sel, res_mask=res_mask,
+        )
 
     def _nodes_as_existing(self, res: SolveResult,
                            daemon_overhead) -> "list[ExistingNode]":
@@ -336,7 +454,6 @@ class TPUSolver:
         # is host-side result shaping (docs/designs/solver-boundary.md).
         import time as _time
 
-        from ..ops.packer import pack_cache_size
         from ..tracing import TRACER
 
         t0 = _time.perf_counter()
@@ -346,14 +463,44 @@ class TPUSolver:
             group_cache=self._group_cache,
         )
         t1 = _time.perf_counter()
-        cache_before = pack_cache_size()
-        flat, dims = dispatch_pack(enc, self._dev_alloc_t, self._dev_tiebreak)
-        cache_after = pack_cache_size()
+        G = enc.group_vec.shape[0]
+        Ne = enc.ex_alloc.shape[0]
+        cache_before = _dispatch_cache_size()
+        inputs, dims, use_pallas = build_pack_inputs(
+            enc, self._dev_alloc_t, self._dev_tiebreak)
+        plan = buckets.BucketPlan(groups=dims[0], slots=dims[1],
+                                  existing=dims[2])
+        route = "single"
+        if self._router is not None and self._mesh_ctx is not None:
+            route = self._router.route(plan)
+        if route == "sharded":
+            # enc.grid is the grid this encode actually used (the resident
+            # sharded catalog arrays are keyed on its identity)
+            flat = self._mesh_ctx.dispatch_flat(
+                inputs, dims[1], use_pallas, enc.grid,
+                donate=_donate_deltas())
+        else:
+            flat = dispatch_pack_inputs(inputs, dims, use_pallas)
+        cache_after = _dispatch_cache_size()
         t2 = _time.perf_counter()
         result = fetch_pack(flat, dims)
         t3 = _time.perf_counter()
         out = decode(enc, result, [e.name for e in existing])
         t4 = _time.perf_counter()
+        if cache_before < 0 or cache_after < 0:
+            compile_cache = "unknown"
+        elif cache_after > cache_before:
+            compile_cache = "miss"
+            buckets.COMPILE_MISSES.inc()
+        else:
+            compile_cache = "hit"
+            buckets.COMPILE_HITS.inc()
+        buckets.observe_plan(plan, G, enc.n_slots, Ne, route)
+        pv = enc.group_feas.shape[1]
+        self.last_shape_key = (
+            G, enc.n_slots, Ne, pv,
+            enc.ex_cap is not None, enc.group_origin is not None,
+            enc.prov_overhead is not None, enc.prov_pods_cap is not None)
         # always-on per-solve observability: the tracing plane reads this on
         # both sides of the solver wire (service.py echoes it into
         # SolveResponse; the controller's solve span records it). fetch is
@@ -363,9 +510,11 @@ class TPUSolver:
             "dispatch_ms": round((t2 - t1) * 1000, 3),
             "transfer_ms": round((t3 - t2) * 1000, 3),
             "decode_ms": round((t4 - t3) * 1000, 3),
-            "compile_cache": ("unknown" if cache_before < 0
-                              else "miss" if cache_after > cache_before
-                              else "hit"),
+            "compile_cache": compile_cache,
+            "routing": "tpu-sharded" if route == "sharded" else "tpu",
+            "bucket": plan.label(),
+            "device_count": (self._mesh_ctx.device_count
+                             if route == "sharded" else 1),
         }
         TRACER.annotate(**self.last_solve_info)
         if _SOLVE_TIMING:
@@ -514,6 +663,43 @@ def build_pack_inputs(enc: EncodedProblem, dev_alloc_t=None,
         widths[axis] = (0, n - a.shape[axis])
         return np.pad(a, widths, constant_values=fill)
 
+    # Resource-axis compression (packer.PackInputs.res_sel): the [N, T, R]
+    # quotient tensor is the kernel's per-step compute floor and typical
+    # workloads demand 3-4 of the wellknown resources, so gather the active
+    # columns (demanded by ANY group; pods always, and always first — the
+    # kernel's pods-cap path needs a static index) and ship the compressed
+    # leaves. alloc_t stays full-width (it is the Sync-resident catalog
+    # array); the kernel gathers its columns device-side off res_sel.
+    # Exact by the INT_BIG convention: a column with zero demand everywhere
+    # quotients to INT_BIG whatever its availability. Wider-than-ladder
+    # problems keep the legacy full-width layout.
+    pods_res = wk.RESOURCE_INDEX[wk.RESOURCE_PODS]
+    R_full = enc.group_vec.shape[1]
+    act = enc.group_vec.max(axis=0) > 0
+    act[pods_res] = True
+    n_act = int(act.sum())
+    res_sel = res_mask = None
+    if n_act <= buckets.LADDERS["resources"][-1] < R_full:
+        Rb = buckets.bucket_up(n_act, "resources")
+        others = np.flatnonzero(act)
+        sel = np.concatenate(
+            ([pods_res], others[others != pods_res])).astype(np.int32)
+        res_sel = np.zeros((Rb,), np.int32)
+        res_sel[:n_act] = sel
+        res_mask = np.arange(Rb) < n_act
+
+        def rsel(a):
+            if a is None:
+                return None
+            out = a[..., res_sel]
+            out[..., n_act:] = 0
+            return out
+
+        enc = dataclasses.replace(
+            enc, group_vec=rsel(enc.group_vec), overhead=rsel(enc.overhead),
+            ex_alloc=rsel(enc.ex_alloc), ex_used=rsel(enc.ex_used),
+            prov_overhead=rsel(enc.prov_overhead))
+
     ex_feas = pad(enc.ex_feas, Gb)
     if ex_feas.shape[1] != Neb:
         ex_feas = pad(ex_feas, Neb, axis=1)
@@ -541,6 +727,7 @@ def build_pack_inputs(enc: EncodedProblem, dev_alloc_t=None,
         ex_feas=ex_feas,
         prov_overhead=enc.prov_overhead, prov_pods_cap=enc.prov_pods_cap,
         ex_cap=ex_cap, group_origin=group_origin,
+        res_sel=res_sel, res_mask=res_mask,
     )
     # Pallas engages only when the env flag is on AND every input magnitude
     # is below the f32-exactness bound (checked on host arrays; see
@@ -549,6 +736,79 @@ def build_pack_inputs(enc: EncodedProblem, dev_alloc_t=None,
         enc.alloc_t, enc.ex_alloc, enc.group_vec, enc.overhead,
         enc.prov_overhead)
     return inputs, (Gb, Nb, Neb), use_pallas
+
+
+def _donate_deltas() -> bool:
+    """Donate per-solve delta buffers to the kernel where the backend can
+    actually reuse them (donation is unimplemented on CPU and only emits
+    warnings there). The resident catalog tuple is NEVER donated — it must
+    survive the solve for the next cycle."""
+    return jax.default_backend() not in ("cpu",)
+
+
+_PACK_FNS: "dict[bool, object]" = {}
+_PACK_FNS_LOCK = threading.Lock()
+
+
+def _resident_pack_fn(donate: bool):
+    """Jitted single-device pack over SPLIT arguments: (cat, delta) where
+    cat = (alloc_t, tiebreak) is the Sync-resident catalog tuple and delta
+    is the per-solve PackInputs with those two leaves None'd out. The split
+    exists so donation can cover exactly the delta (argnums=1): donated
+    catalog buffers would be consumed by the first solve and force a
+    re-upload every cycle — the opposite of residency."""
+    with _PACK_FNS_LOCK:
+        fn = _PACK_FNS.get(donate)
+        if fn is None:
+            def impl(cat, delta, n_slots, use_pallas):
+                inputs = delta._replace(alloc_t=cat[0], tiebreak=cat[1])
+                return pack_flat_impl(inputs, n_slots,
+                                      use_pallas=use_pallas)
+
+            fn = jax.jit(impl, static_argnames=("n_slots", "use_pallas"),
+                         donate_argnums=(1,) if donate else ())
+            _PACK_FNS[donate] = fn
+        return fn
+
+
+def dispatch_pack_inputs(inputs: PackInputs, dims, use_pallas):
+    """ENQUEUE already-padded PackInputs on the single-chip kernel — no
+    device read. Catalog leaves ride resident (tracked_device_put is a
+    counted no-op when they already live on device); delta leaves are
+    uploaded per solve and donated back to the kernel off-CPU."""
+    cat = (buckets.tracked_device_put(inputs.alloc_t, "catalog"),
+           buckets.tracked_device_put(inputs.tiebreak, "catalog"))
+    delta = buckets.tracked_tree_put(
+        inputs._replace(alloc_t=None, tiebreak=None), "delta")
+    # One jitted dispatch returning ONE flat buffer: decode pays exactly one
+    # device->host round trip (the tunnel RTT floor; SURVEY.md §7.3).
+    return _resident_pack_fn(_donate_deltas())(cat, delta, dims[1],
+                                               use_pallas)
+
+
+def _dispatch_cache_size() -> int:
+    """Total compiled-program count across every solver dispatch entry
+    point (packer jits + resident split fns + wave vmap + sharded mesh
+    fns). -1 when the jit cache introspection API is unavailable — callers
+    treat that as 'unknown', never as 'hit'."""
+    from ..ops.packer import pack_cache_size
+
+    total = pack_cache_size()
+    if total < 0:
+        return -1
+    try:
+        with _PACK_FNS_LOCK:
+            for fn in _PACK_FNS.values():
+                total += fn._cache_size()
+        total += _wave_pack_flat._cache_size()
+    except Exception:
+        return -1
+    from ..parallel.sharded import sharded_flat_cache_size
+
+    sharded = sharded_flat_cache_size()
+    if sharded < 0:
+        return -1
+    return total + sharded
 
 
 def dispatch_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None):
@@ -563,10 +823,7 @@ def dispatch_pack(enc: EncodedProblem, dev_alloc_t=None, dev_tiebreak=None):
     scarcest resource the solver spends."""
     inputs, dims, use_pallas = build_pack_inputs(enc, dev_alloc_t,
                                                  dev_tiebreak)
-    inputs = jax.device_put(inputs)  # async enqueue; no sync round trip
-    # One jitted dispatch returning ONE flat buffer: decode pays exactly one
-    # device->host round trip (the tunnel RTT floor; SURVEY.md §7.3).
-    flat = pack_flat(inputs, n_slots=dims[1], use_pallas=use_pallas)
+    flat = dispatch_pack_inputs(inputs, dims, use_pallas)
     return flat, dims
 
 

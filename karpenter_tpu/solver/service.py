@@ -26,6 +26,7 @@ from ..apis.provisioner import Provisioner
 from ..models.instancetype import Catalog
 from ..tracing import TRACER
 from .core import SolveResult, TPUSolver
+from . import buckets
 from . import solver_pb2 as pb
 from . import wire
 
@@ -38,6 +39,27 @@ SERVICE_NAME = "karpenter.solver.Solver"
 # given up on the answer before the solve finishes, so computing it only
 # burns device time someone else is queued for.
 SHED_MIN_BUDGET_MS = 10.0
+
+# Consolidation requests with at least this many nodes run their candidate
+# lanes over the lane mesh (pure data parallelism); below it the mesh's
+# collective/pad overhead beats the win, mirroring the solve router's
+# crossover doctrine.
+CONSOLIDATE_LANE_MESH_MIN = 64
+
+# Most shape buckets a single Sync will pre-jit: warmup runs inline in the
+# Sync RPC, and each compile is hundreds of ms — the cap bounds Sync
+# latency, the shape history keeps the spent compiles the most useful ones.
+WARMUP_LIMIT = 8
+
+def _hint_shape(pods: int) -> tuple:
+    """Crude pod-count -> problem-shape mapping for warm_pod_counts hints:
+    ~16 pods fold into one scheduling group in the deployment's workloads
+    and slot demand tracks group count. Only the ladder rung matters —
+    plan_for() buckets the result, so being 2x off usually lands on the
+    same compiled program anyway."""
+    g = max(1, pods // 16)
+    return (g, max(8, g), 0)
+
 
 METHODS = {
     "Sync": (pb.SyncRequest, pb.SyncResponse),
@@ -81,11 +103,24 @@ class SolverService:
     LRU_CAPACITY = 4
 
     def __init__(self, trace_dir: "Optional[str]" = None,
-                 trace_every: int = 100):
+                 trace_every: int = 100,
+                 crossover_cells: "Optional[int]" = None):
         self._lock = threading.Lock()
         # (cat_hash, prov_hash) -> (TPUSolver, seqnum); insertion order = LRU
         self._cache: "OrderedDict[tuple[int, int], tuple[TPUSolver, int]]" = \
             OrderedDict()
+        # single-vs-sharded crossover shared by every solver's router
+        # (None = env/default); tests force 0 to shard everything
+        self._crossover_cells = crossover_cells
+        # persistent device context (parallel/sharded.ShardedContext):
+        # built lazily at first Sync, lives for the process — the mesh and
+        # the sharded-resident catalog arrays inside it are what make
+        # repeat Solves upload nothing. None on single-device hosts.
+        self._mesh_ctx = None
+        self._mesh_ctx_built = False
+        # raw shape keys of recent Solves (most recent last, bounded):
+        # the warmup working set a re-Sync pre-jits first
+        self._shape_seen: "OrderedDict[tuple, int]" = OrderedDict()
         # device-path profiling (SURVEY §5.1): when trace_dir is set, every
         # trace_every-th Solve runs under jax.profiler.trace so production
         # captures the on-chip timeline continuously (the evidence class of
@@ -110,6 +145,60 @@ class SolverService:
         with self._lock:
             return self._mru()[2]
 
+    def _device_context(self):
+        """The process-lifetime mesh context (parallel/sharded
+        .ShardedContext), built at the FIRST Sync — never in __init__, so
+        constructing a service object can't initialize a JAX backend.
+        None on single-device hosts (router then always picks
+        single-chip)."""
+        with self._lock:
+            if self._mesh_ctx_built:
+                return self._mesh_ctx
+        import jax
+
+        ctx = None
+        try:
+            if len(jax.devices()) >= 2:
+                from ..parallel.sharded import ShardedContext
+
+                ctx = ShardedContext()
+        except Exception as e:  # mesh trouble degrades to single-chip
+            log.warning("mesh context unavailable, serving single-chip: %s",
+                        e)
+        with self._lock:
+            if not self._mesh_ctx_built:
+                self._mesh_ctx = ctx
+                self._mesh_ctx_built = True
+            return self._mesh_ctx
+
+    def _record_shape(self, solver: TPUSolver) -> None:
+        key = solver.last_shape_key
+        if key is None:
+            return
+        with self._lock:
+            self._shape_seen[key] = self._shape_seen.pop(key, 0) + 1
+            while len(self._shape_seen) > 32:
+                self._shape_seen.popitem(last=False)
+
+    def _warm(self, solver: TPUSolver, request: pb.SyncRequest) -> int:
+        """Sync-time compile-cache warmup: pre-jit the shape buckets traffic
+        actually hits — the service's own recent-solve history first (exact
+        shape keys), then the client's pod-count hints (crude pods->shape
+        mapping; the ladder's coarse rungs absorb the sloppiness). Guarded:
+        warmup can never fail a Sync."""
+        shapes: "list[tuple]" = []
+        with self._lock:
+            shapes.extend(reversed(self._shape_seen))  # most recent first
+        for count in request.warm_pod_counts:
+            shapes.append(_hint_shape(int(count)))
+        if not shapes:
+            return 0
+        try:
+            return len(solver.warm_shapes(shapes, limit=WARMUP_LIMIT))
+        except Exception as e:
+            log.warning("shape warmup failed (serving cold): %s", e)
+            return 0
+
     # -- RPC methods (called by the generic handler) -------------------------------
 
     def Sync(self, request: pb.SyncRequest, context) -> pb.SyncResponse:
@@ -130,6 +219,7 @@ class SolverService:
         # lower than an installed one (content owns identity, not ordering).
         cat_hash = wire.catalog_hash(request.catalog)
         key = (cat_hash, prov_hash)
+        ctx = self._device_context()
         with self._lock:
             hit = self._cache.get(key)
             if hit is not None:
@@ -137,10 +227,17 @@ class SolverService:
                 self._cache.move_to_end(key)
                 self._cache[key] = (hit[0], request.catalog.seqnum)
         if hit is not None:
-            return pb.SyncResponse(seqnum=request.catalog.seqnum,
-                                   catalog_hash=cat_hash)
+            # re-Sync still warms: the client may ship fresh hints and the
+            # shape history may have grown since the solver was installed
+            warmed = self._warm(hit[0], request)
+            return self._sync_response(request.catalog.seqnum, cat_hash,
+                                       ctx, warmed)
         catalog = wire.catalog_from_wire(request.catalog)
-        solver = TPUSolver(catalog, provisioners)
+        solver = TPUSolver(
+            catalog, provisioners, mesh_ctx=ctx,
+            router=buckets.ShapeRouter(
+                n_devices=ctx.device_count if ctx is not None else 1,
+                crossover_cells=self._crossover_cells))
         # the most recent resident solver donates its static grid arrays +
         # group-encode folds: an ICE-only catalog change (spot storms bump
         # content per message) then skips the grid rebuild AND the device
@@ -161,9 +258,21 @@ class SolverService:
             while len(self._cache) > self.LRU_CAPACITY:
                 evicted_key, _ = self._cache.popitem(last=False)
                 log.info("evicted solver for catalog hash=%x", evicted_key[0])
-        log.info("synced catalog seqnum=%d hash=%x (%d types, %d provisioners)",
-                 catalog.seqnum, cat_hash, len(catalog.types), len(provisioners))
-        return pb.SyncResponse(seqnum=catalog.seqnum, catalog_hash=cat_hash)
+        warmed = self._warm(solver, request)
+        log.info("synced catalog seqnum=%d hash=%x (%d types, %d "
+                 "provisioners, %d buckets warmed)",
+                 catalog.seqnum, cat_hash, len(catalog.types),
+                 len(provisioners), warmed)
+        return self._sync_response(catalog.seqnum, cat_hash, ctx, warmed)
+
+    @staticmethod
+    def _sync_response(seqnum: int, cat_hash: int, ctx,
+                       warmed: int) -> pb.SyncResponse:
+        return pb.SyncResponse(
+            seqnum=seqnum, catalog_hash=cat_hash,
+            device_count=ctx.device_count if ctx is not None else 1,
+            mesh=ctx.describe() if ctx is not None else "",
+            warmed_buckets=warmed)
 
     def Solve(self, request: pb.SolveRequest, context) -> pb.SolveResponse:
         # join the caller's trace when it sent one (wire trace_context);
@@ -239,16 +348,21 @@ class SolverService:
             result = solver.solve(pods, existing=existing,
                                   daemon_overhead=overhead)
         solve_ms = (time.perf_counter() - t0) * 1000
+        self._record_shape(solver)
         resp = result_to_response(result, solve_ms, seqnum)
         # echo the device-path observability back over the wire so the
         # CLIENT-side rpc span carries the same attributes this span does
         info = getattr(solver, "last_solve_info", None) or {}
-        resp.routing = "tpu"
+        resp.routing = str(info.get("routing", "tpu"))
         resp.compile_cache = str(info.get("compile_cache", "unknown"))
         resp.transfer_ms = float(info.get("transfer_ms", 0.0))
+        resp.bucket = str(info.get("bucket", ""))
+        resp.device_count = int(info.get("device_count", 1))
         span.set_attributes(routing=resp.routing,
                             compile_cache=resp.compile_cache,
                             transfer_ms=resp.transfer_ms,
+                            bucket=resp.bucket,
+                            device_count=resp.device_count,
                             solve_ms=solve_ms)
         return resp
 
@@ -293,11 +407,19 @@ class SolverService:
                 if node_eligible:
                     eligible_names.add(node.name)
             overhead = list(request.daemon_overhead) or None
+            # big clusters shard their candidate lanes over the persistent
+            # lane mesh (data parallelism); small ones stay single-chip —
+            # the same crossover doctrine as the solve router
+            ctx = self._device_context()
+            lane_mesh = (ctx.lane_mesh if ctx is not None
+                         and len(request.nodes) >= CONSOLIDATE_LANE_MESH_MIN
+                         else None)
             t0 = time.perf_counter()
             action = run_consolidation(
                 cluster, solver.catalog, solver.provisioners,
                 daemon_overhead=overhead, now=request.now,
                 grid=solver.grid(),  # the Sync'd device-resident grid — no rebuild
+                mesh=lane_mesh,
                 multi_node=request.multi_node,
                 # -1 = unset sentinel -> server default; 0 legitimately
                 # DISABLES the pair search (proto3 zero-value trap)

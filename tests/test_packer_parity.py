@@ -647,3 +647,89 @@ def test_parity_round2_sees_round1_existing_consumption():
                         existing=existing)
     assert res.existing_counts.get("node-a", 0) == 4  # fillers only
     assert res.unschedulable_count() == 1  # dep: anchor node is full
+
+
+def test_floor_div_fast_exact():
+    """The f32-reciprocal floor-div (ops/packer._floor_div) must be
+    bit-exact vs // over the encode domain (0 <= a <= INT_BIG, v >= 1):
+    adversarial sweep of divisor regimes (v=1 maximizes the estimate's
+    absolute error; v > 2^24 exercises the single-stage lane) plus exact
+    multiples and off-by-one boundaries, where the +-1 fix must not
+    over/under-shoot."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from karpenter_tpu.ops.packer import INT_BIG, _floor_div
+
+    rng = np.random.default_rng(1234)
+    a = rng.integers(0, INT_BIG + 1, size=200_000, dtype=np.int64)
+    v = np.concatenate([
+        np.ones(20_000, dtype=np.int64),
+        rng.integers(1, 8, size=40_000),
+        rng.integers(8, 1 << 20, size=60_000),
+        rng.integers(1 << 20, 1 << 24, size=40_000),
+        rng.integers((1 << 24) + 1, 2**31 - 1, size=40_000),
+    ])
+    rng.shuffle(v)
+    # boundary cases: a = q*v - 1, q*v, q*v + 1 for assorted (q, v)
+    qs = np.array([0, 1, 2, 3, 127, 128, 129, 4095, 1 << 15, (1 << 26) - 1])
+    vs = np.array([1, 2, 3, 7, 997, (1 << 20) - 1, (1 << 24) + 1, 2**31 - 1])
+    for qq in qs:
+        for vv in vs:
+            prod = qq * vv
+            for aa in (prod - 1, prod, prod + 1):
+                if 0 <= aa <= INT_BIG:
+                    a = np.append(a, aa)
+                    v = np.append(v, vv)
+    expect = a // v
+    got = np.asarray(_floor_div(jnp.asarray(a, jnp.int32),
+                                jnp.asarray(v, jnp.int32)))
+    bad = np.nonzero(got != expect)[0]
+    assert bad.size == 0, (
+        f"{bad.size} mismatches, first: a={a[bad[0]]} v={v[bad[0]]} "
+        f"got={got[bad[0]]} want={expect[bad[0]]}")
+
+
+def test_resource_compression_bit_parity():
+    """build_pack_inputs ships compressed resource columns (res_sel); the
+    kernel must produce a bit-identical flat buffer to the same problem
+    dispatched full-width with res_sel stripped."""
+    import numpy as np
+
+    from karpenter_tpu.models.encode import encode_problem
+    from karpenter_tpu.ops.packer import pack_flat
+    from karpenter_tpu.solver.core import build_pack_inputs
+
+    catalog = catalog5()
+    pods = [make_pod(f"p-{i}", cpu=100 * (1 + i % 7), memory=2**20 * (i % 5 + 1))
+            for i in range(40)]
+    enc = encode_problem(catalog, [prov()], pods)
+    inputs, dims, use_pallas = build_pack_inputs(enc)
+    assert inputs.res_sel is not None, "compression should engage (<=4 active)"
+    assert int(inputs.res_sel[0]) == wk.RESOURCE_INDEX[wk.RESOURCE_PODS]
+
+    compressed = np.asarray(
+        pack_flat(inputs, dims[1], use_pallas=use_pallas))
+
+    # full-width control: re-pad every compressed leaf back out by hand
+    sel = np.asarray(inputs.res_sel)
+    n_act = int(np.asarray(inputs.res_mask).sum())
+    R = enc.alloc_t.shape[1]
+
+    def widen(a):
+        if a is None:
+            return None
+        out = np.zeros(a.shape[:-1] + (R,), a.dtype)
+        out[..., sel[:n_act]] = a[..., :n_act]
+        return out
+
+    full = inputs._replace(
+        group_vec=widen(np.asarray(inputs.group_vec)),
+        overhead=widen(np.asarray(inputs.overhead)),
+        ex_alloc=widen(np.asarray(inputs.ex_alloc)),
+        ex_used=widen(np.asarray(inputs.ex_used)),
+        prov_overhead=(None if inputs.prov_overhead is None
+                       else widen(np.asarray(inputs.prov_overhead))),
+        res_sel=None, res_mask=None)
+    control = np.asarray(pack_flat(full, dims[1], use_pallas=use_pallas))
+    assert np.array_equal(compressed, control)

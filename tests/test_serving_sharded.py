@@ -1,0 +1,329 @@
+"""Device-resident serving path: bucket ladder, shape router, Sync-time
+residency/warmup, and the wire-served sharded solve (PR 7 tentpole).
+
+The residency contract is asserted via the host->device upload COUNTERS
+(solver/buckets.py), never timing: `Sync`-then-repeat-`Solve` must perform
+zero redundant uploads of unchanged catalog tensors, and that is a metric
+delta of exactly zero, deterministic on any backend. The wire parity tests
+force the shape router's crossover to 0 so even small problems take the
+mesh kernel (conftest pins an 8-device virtual CPU mesh)."""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import TopologySpreadConstraint, make_pod
+from karpenter_tpu.models.requirements import OP_IN, Requirements
+from karpenter_tpu.solver import buckets
+from karpenter_tpu.solver.buckets import BucketPlan, ShapeRouter, plan_for
+from karpenter_tpu.solver.client import RemoteSolver
+from karpenter_tpu.solver.core import NativeSolver, TPUSolver, _bucket
+from karpenter_tpu.solver.service import SolverService, serve
+from karpenter_tpu.solver import solver_pb2 as pb
+from karpenter_tpu.solver import wire
+
+
+def small_catalog():
+    return Catalog(types=[
+        make_instance_type("m.large", cpu=2, memory="8Gi",
+                           od_price=0.10, spot_price=0.03),
+        make_instance_type("m.xlarge", cpu=4, memory="16Gi",
+                           od_price=0.20, spot_price=0.06),
+        make_instance_type("c.xlarge", cpu=4, memory="8Gi",
+                           od_price=0.17, spot_price=0.05),
+    ])
+
+
+def default_provisioner(**kw):
+    p = Provisioner(name="default", requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])), **kw)
+    p.set_defaults()
+    return p
+
+
+def mixed_pods(n=40):
+    pods = [make_pod(f"web-{i}", cpu="500m", memory="1Gi",
+                     topology=(TopologySpreadConstraint(1, wk.LABEL_ZONE),))
+            for i in range(n // 2)]
+    pods += [make_pod(f"db-{i}", cpu="1", memory="4Gi",
+                      node_selector={wk.LABEL_ZONE: "zone-1a"})
+             for i in range(n - n // 2)]
+    return pods
+
+
+def uploads(tensor: str) -> float:
+    return buckets.UPLOADS.value(tensor=tensor)
+
+
+# -- the ladder ---------------------------------------------------------------
+
+class TestBucketLadder:
+    def test_fixed_rungs(self):
+        assert buckets.bucket_up(1, "groups") == 8
+        assert buckets.bucket_up(8, "groups") == 8
+        assert buckets.bucket_up(9, "groups") == 32
+        assert buckets.bucket_up(513, "groups") == 2048
+        assert buckets.bucket_up(0, "existing") == 1
+        assert buckets.bucket_up(5, "existing") == 16
+        assert buckets.bucket_up(3, "wave") == 4
+
+    def test_tail_growth_beyond_table(self):
+        top = buckets.LADDERS["groups"][-1]
+        assert buckets.bucket_up(top + 1, "groups") == top * 4
+        wtop = buckets.LADDERS["wave"][-1]
+        assert buckets.bucket_up(wtop + 1, "wave") == wtop * 2
+
+    def test_ladder_not_doubling(self):
+        # the point of the fix: 9 and 17 groups share ONE rung (32) where
+        # the old doubling policy minted 16 and 32 (two compiles)
+        assert buckets.bucket_up(9, "groups") == buckets.bucket_up(
+            17, "groups")
+
+    def test_core_bucket_shim_routes_to_ladders(self):
+        # core._bucket keys the dimension on its legacy lo: 8 -> groups,
+        # 1 -> existing, 2 -> wave
+        assert _bucket(9) == buckets.bucket_up(9, "groups")
+        assert _bucket(5, lo=1) == buckets.bucket_up(5, "existing")
+        assert _bucket(3, lo=2) == buckets.bucket_up(3, "wave")
+
+    def test_plan_label_and_cells(self):
+        plan = plan_for(9, 100, 0)
+        assert plan == BucketPlan(groups=32, slots=128, existing=1)
+        assert plan.cells() == 32 * 128
+        assert plan.label() == "g32n128e1"
+
+
+# -- the router ---------------------------------------------------------------
+
+class TestShapeRouter:
+    def test_single_below_sharded_above(self):
+        r = ShapeRouter(n_devices=8, crossover_cells=1000)
+        assert r.route(BucketPlan(8, 8, 1)) == "single"
+        assert r.route(BucketPlan(128, 128, 1)) == "sharded"
+
+    def test_single_device_never_shards(self):
+        r = ShapeRouter(n_devices=1, crossover_cells=1)
+        assert r.route(BucketPlan(2048, 2048, 1)) == "single"
+
+    def test_sticky_under_jitter_near_crossover(self):
+        # hysteresis: above hi -> sharded; dipping into (lo, hi) keeps the
+        # previous route in BOTH directions; only below lo flips back
+        r = ShapeRouter(n_devices=8, crossover_cells=1024, hysteresis=4)
+        between = BucketPlan(16, 32, 1)  # 512 cells: lo=256 <= 512 < hi
+        assert r.route(between) == "single"  # initial route is single
+        assert r.route(BucketPlan(32, 32, 1)) == "sharded"  # 1024 >= hi
+        assert r.route(between) == "sharded"  # sticky: no flap
+        assert r.route(BucketPlan(8, 8, 1)) == "single"  # 64 < lo=256
+        assert r.route(between) == "single"  # sticky again
+
+    def test_steady_route_is_stateless(self):
+        r = ShapeRouter(n_devices=8, crossover_cells=1024)
+        r.route(BucketPlan(32, 32, 1))  # live route now sharded
+        assert r.steady_route(BucketPlan(8, 8, 1)) == "single"
+        # the stateless query didn't disturb the sticky live route
+        assert r._route == "sharded"
+
+    def test_env_crossover_override(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_SHARD_CROSSOVER_CELLS", "42")
+        assert buckets.crossover_cells_default() == 42
+        monkeypatch.setenv("KARPENTER_TPU_SHARD_CROSSOVER_CELLS", "junk")
+        assert (buckets.crossover_cells_default()
+                == buckets.DEFAULT_CROSSOVER_CELLS)
+
+
+# -- device residency (metric-asserted, never timing) -------------------------
+
+class TestDeviceResidency:
+    def test_repeat_solve_uploads_no_catalog_tensors(self):
+        solver = TPUSolver(small_catalog(), [default_provisioner()])
+        pods = mixed_pods(24)
+        solver.solve(pods)
+        cat_before = uploads("catalog")
+        delta_before = uploads("delta")
+        solver.solve(pods)
+        assert uploads("catalog") == cat_before, (
+            "unchanged catalog tensors re-crossed the host->device "
+            "boundary on a repeat solve")
+        # the per-solve problem delta DOES ship (that's the contract: only
+        # the delta crosses per cycle)
+        assert uploads("delta") > delta_before
+
+    def test_repeat_solve_hits_compile_cache(self):
+        solver = TPUSolver(small_catalog(), [default_provisioner()])
+        pods = mixed_pods(24)
+        solver.solve(pods)
+        solver.solve(pods)
+        assert solver.last_solve_info["compile_cache"] == "hit"
+        assert solver.last_solve_info["bucket"].startswith("g")
+
+    def test_catalog_mutation_reuploads(self):
+        cat = small_catalog()
+        solver = TPUSolver(cat, [default_provisioner()])
+        pods = mixed_pods(12)
+        solver.solve(pods)
+        before = uploads("catalog")
+        # availability-only churn bumps the seqnum but shares the static
+        # arrays (build_grid reuse) — still no re-upload
+        from karpenter_tpu.models.instancetype import Offering, Offerings
+        big = cat.by_name["m.large"]
+        object.__setattr__(big, "offerings", Offerings(
+            Offering(o.zone, o.capacity_type, o.price, available=False)
+            for o in big.offerings))
+        cat.bump()
+        solver.solve(pods)
+        assert uploads("catalog") == before
+
+    def test_wire_sync_then_repeat_solve_zero_catalog_uploads(self):
+        srv, port, svc = serve("127.0.0.1:0")
+        try:
+            client = RemoteSolver(small_catalog(), [default_provisioner()],
+                                  target=f"127.0.0.1:{port}")
+            pods = mixed_pods(24)
+            client.solve(pods)  # sync-on-demand + first solve
+            cat_before = uploads("catalog")
+            client.solve(pods)
+            client.solve(pods)
+            assert uploads("catalog") == cat_before
+        finally:
+            srv.stop(grace=None)
+
+
+# -- warmup -------------------------------------------------------------------
+
+class TestWarmup:
+    def test_warm_shapes_pre_jits_buckets(self):
+        solver = TPUSolver(small_catalog(), [default_provisioner()])
+        warm_before = buckets.COMPILE_WARMUPS.value()
+        warmed = solver.warm_shapes([(9, 100, 0)])
+        assert warmed == ["g32n128e1"]
+        assert buckets.COMPILE_WARMUPS.value() == warm_before + 1
+        # re-warming the same bucket compiles nothing new
+        assert solver.warm_shapes([(9, 100, 0)]) == []
+
+    def test_warmed_bucket_first_solve_is_a_cache_hit(self):
+        solver = TPUSolver(small_catalog(), [default_provisioner()])
+        pods = mixed_pods(24)
+        probe = TPUSolver(small_catalog(), [default_provisioner()])
+        probe.solve(pods)
+        # warm THIS solver at the shape the probe just observed; the first
+        # real solve then finds the bucket's program compiled
+        solver.warm_shapes([probe.last_shape_key])
+        solver.solve(pods)
+        assert solver.last_solve_info["compile_cache"] == "hit"
+        assert solver.last_shape_key == probe.last_shape_key
+
+    def test_warm_shapes_respects_limit(self):
+        solver = TPUSolver(small_catalog(), [default_provisioner()])
+        shapes = [(g, 100, 0) for g in (1, 9, 33, 129, 513)]
+        warmed = solver.warm_shapes(shapes, limit=2)
+        assert len(warmed) <= 2
+
+    def test_sync_warms_from_client_hints(self):
+        svc = SolverService()
+        srv, port, _ = serve(service=svc)
+        try:
+            cat = small_catalog()
+            req = pb.SyncRequest(
+                catalog=wire.catalog_to_wire(cat),
+                provisioners=[wire.provisioner_to_wire(
+                    default_provisioner())],
+                warm_pod_counts=[4000],
+            )
+            client = RemoteSolver(cat, [default_provisioner()],
+                                  target=f"127.0.0.1:{port}")
+            resp = client._call("Sync", req)
+            assert resp.device_count >= 2  # 8-device virtual CPU mesh
+            assert "x" in resp.mesh
+            assert resp.warmed_buckets >= 1
+            # idempotent re-Sync with the same hints: nothing new compiles
+            resp2 = client._call("Sync", req)
+            assert resp2.warmed_buckets == 0
+        finally:
+            srv.stop(grace=None)
+
+    def test_solve_records_shape_history(self):
+        svc = SolverService()
+        srv, port, _ = serve(service=svc)
+        try:
+            client = RemoteSolver(small_catalog(), [default_provisioner()],
+                                  target=f"127.0.0.1:{port}")
+            client.solve(mixed_pods(24))
+            assert len(svc._shape_seen) == 1
+            (key, count), = svc._shape_seen.items()
+            assert count == 1 and len(key) == 8
+        finally:
+            srv.stop(grace=None)
+
+
+# -- wire-served sharded parity ----------------------------------------------
+
+def _wire_sharded_solve(pods, catalog, provisioners):
+    """Solve over gRPC with the router's crossover forced to 0 (everything
+    shards); returns (raw response, decoded result, service)."""
+    svc = SolverService(crossover_cells=0)
+    srv, port, svc = serve(service=svc)
+    try:
+        client = RemoteSolver(catalog, provisioners,
+                              target=f"127.0.0.1:{port}", timeout=120.0)
+        client.sync()
+        req = pb.SolveRequest(
+            catalog_seqnum=catalog.seqnum,
+            catalog_hash=client.catalog_content_hash(),
+            provisioner_hash=client._prov_hash,
+            pods=[wire.pod_to_wire(p) for p in pods],
+        )
+        resp = client._call("Solve", req)
+        return resp, client._decode(resp, pods), svc
+    finally:
+        srv.stop(grace=None)
+
+
+class TestWireServedSharded:
+    def test_sharded_solve_served_and_bit_identical(self):
+        """Fixed-seed smoke of the `make multichip` contract: the gRPC-served
+        sharded solve must report the mesh route and produce decisions
+        bit-identical to the independent native scan."""
+        catalog, provisioners, pods = small_catalog(), \
+            [default_provisioner()], mixed_pods(40)
+        resp, decoded, svc = _wire_sharded_solve(pods, catalog, provisioners)
+        assert resp.routing == "tpu-sharded"
+        assert resp.device_count >= 2
+        assert resp.bucket.startswith("g")
+        placed = sum(n.pod_count for n in decoded.nodes)
+        assert placed + decoded.unschedulable_count() == len(pods)
+        native = NativeSolver(catalog, provisioners).solve(pods)
+        assert decoded.decisions() == native.decisions()
+
+    def test_sharded_flat_bit_parity_with_single_device(self):
+        """Core-level: the mesh dispatch and the single-device dispatch of
+        the SAME padded problem return bit-identical flat buffers."""
+        from karpenter_tpu.models.encode import encode_problem
+        from karpenter_tpu.parallel.sharded import ShardedContext
+        from karpenter_tpu.solver.core import (build_pack_inputs,
+                                               dispatch_pack_inputs)
+
+        solver = TPUSolver(small_catalog(), [default_provisioner()])
+        pods = mixed_pods(32)
+        enc = encode_problem(solver.catalog, solver.provisioners, pods, (),
+                             None, None, grid=solver.grid(),
+                             group_cache=solver._group_cache)
+        inputs, dims, up = build_pack_inputs(
+            enc, solver._dev_alloc_t, solver._dev_tiebreak)
+        ctx = ShardedContext()
+        flat_sharded = np.asarray(
+            ctx.dispatch_flat(inputs, dims[1], up, enc.grid))
+        flat_single = np.asarray(dispatch_pack_inputs(inputs, dims, up))
+        assert flat_sharded.shape == flat_single.shape
+        assert (flat_sharded == flat_single).all()
+
+    @pytest.mark.slow
+    def test_full_stress_parity_50k(self):
+        """The full `make multichip` run (50k pods x 603 types over the
+        8-device mesh) — slow tier; the smoke above carries tier-1."""
+        from benchmarks.multichip_wire import run
+
+        record = run(50_000, 8, out_dir=None)
+        assert record["bit_parity"] and record["decision_parity"]
+        assert record["routing"] == "tpu-sharded"
