@@ -8,7 +8,7 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit lint noretry crashpoints test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash claims diagnose provenance multichip
+.PHONY: presubmit lint noretry crashpoints test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm fleet-bench claims diagnose provenance multichip
 
 presubmit: lint claims provenance noretry crashpoints test verify-entry  ## what CI runs
 
@@ -35,6 +35,12 @@ chaos:  ## seeded deterministic fault-injection sweep (docs/designs/chaos.md)
 
 chaos-crash:  ## crash-restart recovery drill: every crashpoint + fenced failover
 	$(CPU_ENV) $(PY) -m karpenter_tpu chaos --crash --seed $(or $(SEED),0)
+
+chaos-storm:  ## multi-tenant storm drill: fairness bound + shed paths, replayable
+	$(CPU_ENV) $(PY) -m karpenter_tpu chaos --storm --seed $(or $(SEED),42) --scenarios $(or $(SCENARIOS),2)
+
+fleet-bench:  ## multi-tenant fleet benchmark: sustained solves/sec + p99, RECORDED
+	$(CPU_ENV) $(PY) bench.py --fleet
 
 lint:  ## static analysis: bytecode-compile everything; ruff when installed
 	$(PY) -m compileall -q karpenter_tpu tests hack benchmarks bench.py __graft_entry__.py

@@ -7,7 +7,7 @@ nor refute them. This lint walks README.md and docs/rounds/*.md at
 paragraph granularity and requires any paragraph quoting a benchmark
 number to also cite where it was recorded — an artifact path
 (benchmarks/results/..., a bench_*/tpu_*/linkprobe_*/chaos_seed*/
-chaos_burst_*/chaos_crash_* JSON, a
+chaos_burst_*/chaos_crash_*/chaos_storm_*/fleet_* JSON, a
 flight-recorder bundle_*.json diagnostics bundle, a .trace.json capture)
 or the harness that records one (benchmarks/*.py).
 
@@ -38,7 +38,7 @@ CLAIM_PATTERNS = [
 ARTIFACT_PATTERNS = [
     re.compile(r"benchmarks/[\w./*-]+"),
     re.compile(r"\b(?:tpu|bench|trace_summary|linkprobe|chaos_seed"
-               r"|chaos_burst|chaos_crash|bundle_)"
+               r"|chaos_burst|chaos_crash|chaos_storm|fleet|bundle_)"
                r"[\w*-]*\.json(?:\.gz)?"),
     re.compile(r"[\w*-]+\.trace\.json(?:\.gz)?"),
 ]

@@ -494,11 +494,20 @@ def cmd_chaos(args) -> int:
     runner = ChaosRunner(seed=args.seed, scenarios=args.scenarios,
                          intensity=args.intensity,
                          out_dir=args.out_dir or None,
-                         burst=args.burst, crash=args.crash)
+                         burst=args.burst, crash=args.crash,
+                         storm=args.storm)
     artifact = runner.run()
     for s in artifact["scenarios"]:
         verdict = "PASS" if s["passed"] else "FAIL"
-        if args.crash:
+        if args.storm:
+            t = s["totals"]
+            print(f"seed={s['seed']} scenario={s['scenario']} {verdict} "
+                  f"tenants={s['tenants']} submitted={t['submitted']} "
+                  f"served={t['served']} "
+                  f"shed={t['shed_admission']}+{t['shed_queue']} "
+                  f"mega_solves={s['mega_solves']} "
+                  f"drain={s['drain_ticks']}")
+        elif args.crash:
             print(f"seed={s['seed']} scenario={s['scenario']} {verdict} "
                   f"{s['drill']} crash_cycle={s.get('crash_cycle', '-')} "
                   f"replayed={len(s['replay'])} nodes={s['final_nodes']} "
@@ -520,9 +529,14 @@ def cmd_chaos(args) -> int:
         print(f"REPRODUCE: python -m karpenter_tpu chaos --seed {args.seed} "
               f"--scenarios {args.scenarios}"
               f"{' --burst' if args.burst else ''}"
-              f"{' --crash' if args.crash else ''}")
+              f"{' --crash' if args.crash else ''}"
+              f"{' --storm' if args.storm else ''}")
         return 1
-    if args.crash:
+    if args.storm:
+        print(f"chaos: tenant storm passed — {artifact['scenario_count']} "
+              f"scenario(s), {artifact['tenants']} tenants each, fairness "
+              f"bound held ({artifact['duration_s']}s)")
+    elif args.crash:
         print(f"chaos: crash drill passed — {artifact['scenario_count']} "
               f"scenario(s) across {len(artifact['crashpoints'])} "
               f"crashpoint(s) + leader failover "
@@ -693,6 +707,12 @@ def main(argv=None) -> int:
                               "scenario per named crashpoint plus a fenced "
                               "leader-failover scenario "
                               "(docs/designs/recovery.md)")
+    p_chaos.add_argument("--storm", action="store_true",
+                         help="run the multi-tenant fleet storm drill: a hot "
+                              "tenant bursting against light tenants through "
+                              "the fleet frontend, asserting the "
+                              "fairness-never-starves invariant "
+                              "(docs/designs/fleet.md)")
     p_chaos.set_defaults(fn=cmd_chaos)
 
     p_ver = sub.add_parser("version")

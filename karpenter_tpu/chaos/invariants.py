@@ -316,6 +316,37 @@ def check_fencing(attempts: int, rejected: int) -> "list[Violation]":
         "after the fencing epoch advanced")]
 
 
+def check_fairness_never_starves(fleet: "dict | None") -> "list[Violation]":
+    """The fleet frontend's fairness contract (fleet/frontend.py): no
+    served request ever waited past the starvation bound, every tenant
+    that submitted made progress (served or explicitly shed — never
+    silently parked), and the drain left nothing queued. Evidence is
+    `FleetFrontend.evidence()` captured after the storm drains."""
+    out = []
+    if not fleet:
+        return out
+    bound = fleet["starvation_bound"]
+    for tid, st in sorted(fleet.get("tenants", {}).items()):
+        if st["max_wait_ticks"] > bound:
+            out.append(Violation(
+                "fairness-never-starves",
+                f"tenant {tid}: a served request waited "
+                f"{st['max_wait_ticks']} tick(s), past the starvation "
+                f"bound {bound}"))
+        unresolved = (st["submitted"] - st["served"] - st["shed_admission"]
+                      - st["shed_queue"] - st["errors"])
+        if st["submitted"] and st["served"] == 0 and unresolved > 0:
+            out.append(Violation(
+                "fairness-never-starves",
+                f"tenant {tid}: submitted {st['submitted']} request(s) and "
+                f"was never served nor shed"))
+    if fleet.get("queued"):
+        out.append(Violation(
+            "fairness-never-starves",
+            f"{fleet['queued']} request(s) still queued after the drain"))
+    return out
+
+
 def check_all(op, cloud, token_launches=None,
               consolidation_actions=None,
               resilience=None) -> "list[Violation]":

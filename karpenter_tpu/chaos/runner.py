@@ -77,7 +77,8 @@ class ChaosRunner:
 
     def __init__(self, seed: int, scenarios: int = 1, wire: bool = False,
                  intensity: float = 1.0, out_dir: "str | None" = None,
-                 burst: bool = False, crash: bool = False):
+                 burst: bool = False, crash: bool = False,
+                 storm: bool = False):
         self.seed = seed
         self.scenarios = scenarios
         self.wire = wire
@@ -91,6 +92,12 @@ class ChaosRunner:
         # crash mode runs the crash–restart recovery drill instead of the
         # fault sweep (one scenario per crashpoint + the failover drill)
         self.crash = crash
+        # storm mode runs the multi-tenant fleet admission drill: one hot
+        # tenant bursting against light tenants through a FleetFrontend
+        # with a deterministic stub backend, asserting the fairness
+        # invariant (no tenant waits past the starvation bound) and that
+        # both shed paths (admission, queue) actually fire
+        self.storm = storm
         # diagnostics bundles auto-dumped by failed scenarios (volatile:
         # paths depend on out_dir, so they live at the artifact top level,
         # never inside a scenario dict)
@@ -700,11 +707,142 @@ class ChaosRunner:
             artifact["artifact_path"] = path
         return artifact
 
+    # -- tenant storm ----------------------------------------------------------
+
+    STORM_TICKS = 48            # armed phase: bursts + steady light traffic
+    STORM_DRAIN_DEADLINE = 64   # drain ticks before declaring non-quiescence
+    STORM_TENANTS = 8           # 1 hot + 7 light
+    STORM_MAX_WAVE = 16
+    STORM_BOUND = 4             # starvation bound the invariant asserts
+
+    def run_storm_scenario(self, scenario: int) -> dict:
+        """One tenant-storm drill: a hot tenant bursting 16–32 requests
+        every 4th tick against 7 light tenants (1 request/tick each),
+        all landing in ONE admission bucket of a FleetFrontend whose
+        backend is a deterministic stub — the drill measures ADMISSION
+        (fairness, shedding, batch composition), not the solver. Offered
+        load averages under capacity, so the fairness contract must hold:
+        bursts are absorbed without any tenant waiting past the bound.
+        Shed probes ride the bursts: one request whose budget cannot
+        survive a tick (admission shed) and one whose budget expires
+        behind the burst backlog (queue shed). Everything in the returned
+        dict is a pure function of (seed, scenario)."""
+        from ..fleet import FleetFrontend
+
+        r = ChaosRng((self.seed << 8) ^ scenario).fork("storm")
+        clock = FakeClock()
+        mega = []
+
+        def backend(key, problems):
+            # deterministic stub: echo per-problem shape so demux order is
+            # observable; never touches JAX
+            mega.append(len(problems))
+            return [{"pods": len(p["pods"])} for p in problems]
+
+        tick_s = 0.02
+        fleet = FleetFrontend(solve_batch=backend, clock=clock,
+                              tick_interval_s=tick_s,
+                              max_wave=self.STORM_MAX_WAVE,
+                              starvation_bound=self.STORM_BOUND,
+                              name=f"storm-s{scenario}")
+        # one shared content key: the fleet's common case — every cluster
+        # on the same generated catalog — so all tenants batch together
+        key = (0x570124, 0xF1EE7)
+        tenants = ["hot"] + [f"t{i}" for i in range(1, self.STORM_TENANTS)]
+        for tid in tenants:
+            fleet.register_key(tid, key)
+
+        def pods(tid, tick, tag, n=4):
+            return [make_pod(f"{tid}-k{tick}-{tag}{i}",
+                             cpu="1", memory="2Gi") for i in range(n)]
+
+        bursts = []
+        for tick in range(self.STORM_TICKS):
+            for tid in tenants[1:]:
+                fleet.submit(tid, pods(tid, tick, "l"))
+            if tick % 4 == 0:
+                burst = r.randint(16, 32)
+                bursts.append(burst)
+                for i in range(burst):
+                    fleet.submit("hot", pods("hot", tick, f"b{i}-"))
+                # shed probes: 5ms cannot survive the ~20ms tick -> shed at
+                # admission; 45ms survives admission but sits behind the
+                # burst (>= 16 ahead in hot's queue, drained ~9/tick) and
+                # expires after two ticks -> shed in queue, before compute
+                fleet.submit("hot", pods("hot", tick, "pa"), deadline_ms=5)
+                fleet.submit("hot", pods("hot", tick, "pq"), deadline_ms=45)
+            clock.step(tick_s)
+            fleet.tick()
+
+        # drain: no new arrivals, tick until every queue is empty
+        drain_ticks = 0
+        while fleet.queued() and drain_ticks < self.STORM_DRAIN_DEADLINE:
+            drain_ticks += 1
+            clock.step(tick_s)
+            fleet.tick()
+
+        evidence = fleet.evidence()
+        violations = invariants.check_fairness_never_starves(evidence)
+        hot = evidence["tenants"]["hot"]
+        if hot["shed_admission"] == 0 or hot["shed_queue"] == 0:
+            violations.append(invariants.Violation(
+                "shed-paths-exercised",
+                f"storm fired {hot['shed_admission']} admission shed(s) and "
+                f"{hot['shed_queue']} queue shed(s) — both paths must fire "
+                f"or the drill proved nothing"))
+        totals = {k: sum(st[k] for st in evidence["tenants"].values())
+                  for k in ("submitted", "served", "shed_admission",
+                            "shed_queue", "errors")}
+        return {
+            "seed": self.seed,
+            "scenario": scenario,
+            "tenants": len(tenants),
+            "storm_ticks": self.STORM_TICKS,
+            "drain_ticks": drain_ticks,
+            "bursts": bursts,
+            "max_wave": self.STORM_MAX_WAVE,
+            "starvation_bound": self.STORM_BOUND,
+            "mega_solves": len(mega),
+            "max_batch": max(mega) if mega else 0,
+            "mean_batch": round(sum(mega) / len(mega), 3) if mega else 0.0,
+            "totals": totals,
+            "evidence": evidence,
+            "violations": [v.as_dict() for v in violations],
+            "passed": not violations,
+        }
+
+    def run_storm(self) -> dict:
+        t0 = time.time()
+        self._bundles = []
+        scenarios = [self.run_storm_scenario(s) for s in range(self.scenarios)]
+        artifact = {
+            "tool": "karpenter_tpu.chaos",
+            "mode": "storm",
+            "seed": self.seed,
+            "tenants": self.STORM_TENANTS,
+            "scenario_count": len(scenarios),
+            "passed": all(s["passed"] for s in scenarios),
+            "scenarios": scenarios,
+            # volatile fields below this line only (replay contract)
+            "duration_s": round(time.time() - t0, 3),
+            "bundles": list(self._bundles),
+        }
+        if self.out_dir:
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = os.path.join(self.out_dir,
+                                f"chaos_storm_seed{self.seed}.json")
+            with open(path, "w") as f:
+                json.dump(artifact, f, indent=2, sort_keys=True)
+            artifact["artifact_path"] = path
+        return artifact
+
     # -- artifact --------------------------------------------------------------
 
     def run(self) -> dict:
         if self.crash:
             return self.run_crash_drill()
+        if self.storm:
+            return self.run_storm()
         t0 = time.time()
         self._bundles = []
         scenarios = [self.run_scenario(s) for s in range(self.scenarios)]

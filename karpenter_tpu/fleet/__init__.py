@@ -1,0 +1,31 @@
+"""Solver fleet layer: batched multi-tenant serving for thousands of
+clusters (docs/designs/fleet.md).
+
+The pieces:
+
+* `FleetFrontend` (frontend.py) — tenant-tagged admission queues keyed by
+  `BucketPlan` rungs, a tick loop that coalesces same-bucket requests
+  from different tenants into one vmapped mega-solve, weighted
+  round-robin fairness with a starvation bound, and deadline-budget
+  shedding at admission (never after compute).
+* `FleetService` (frontend.py) — the gRPC adapter: drop it into
+  `solver.service.serve()` and the wire Solve path batches.
+* `FleetRouter` (router.py) — rendezvous-hash tenant -> replica mapping
+  across N fleet replicas; rebalance-safe by construction.
+* metrics.py — queue depth, batch occupancy, shed counts, per-tenant
+  latency (surfaced in /debug/statusz and docs/metrics.md "Fleet").
+"""
+
+from .frontend import (DEFAULT_TENANT, FleetFrontend, FleetService,
+                       FleetShed, TenantNotSynced, active_frontends)
+from .router import FleetRouter
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "FleetFrontend",
+    "FleetRouter",
+    "FleetService",
+    "FleetShed",
+    "TenantNotSynced",
+    "active_frontends",
+]

@@ -1,0 +1,564 @@
+"""FleetFrontend: continuously-batching multi-tenant admission for the
+solver service.
+
+The single-tenant `SolverService` serves one Solve per RPC; the fleet
+frontend turns it into a batched service for thousands of clusters
+(ROADMAP item 2, CvxCluster direction: many small problems as one
+structured batch). Requests arrive tagged with a `tenant_id`
+(SolveRequest field 9), are admitted into per-bucket queues keyed by the
+SAME `BucketPlan` rung table that keys the jit cache (solver/buckets.py),
+and a tick loop coalesces same-bucket requests from different tenants
+into ONE vmapped mega-solve (`TPUSolver.solve_many` — the wave-pipelined
+device path PR 7 built, whose batch axis here is tenants, not pods), then
+demuxes the results back to each caller.
+
+Admission discipline, in order:
+
+* deadline shed at ADMISSION — a request whose remaining budget
+  (`deadline_ms`, resilience/deadline.py semantics) cannot survive the
+  next tick plus the service's shed floor is refused before it ever
+  queues. Shedding after compute would burn device time every other
+  tenant is queued behind; the whole point of the budget is that the
+  caller has already given up by then.
+* deadline shed in QUEUE — budgets keep draining while queued; the tick
+  loop re-checks at dispatch and sheds expired tickets without compute.
+* weighted round-robin fairness with a starvation bound — each tick runs
+  a fair-share pass first (one rotation over the tenant queues, each
+  granted up to `weight` slots), then gives spare capacity to the oldest
+  queued admissions. A hot tenant's backlog can fill the spare but never
+  a light tenant's guaranteed share, so a within-weight tenant's wait is
+  bounded by the rotation reach time and never exceeds
+  `starvation_bound` ticks (the chaos `tenant storm` drill asserts
+  exactly this).
+
+Tenants sharing identical catalog+provisioner CONTENT dedupe onto one
+resident solver (the service LRU is content-hash keyed), so the common
+fleet case — thousands of clusters on the same generated catalog —
+batches across tenants with zero extra device residency.
+
+Determinism: the tick loop takes time ONLY from the injected clock and
+sequence numbers, so under FakeClock a submission schedule replays to the
+identical batch composition — the property the chaos storm scenario's
+replay contract leans on.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import weakref
+from collections import OrderedDict, deque
+from typing import Callable, Optional, Sequence
+
+from ..models.pod import group_pods
+from ..utils.clock import Clock
+from . import metrics as fm
+from ..solver import buckets
+from ..solver import solver_pb2 as pb
+from ..solver import wire
+from ..solver.service import SHED_MIN_BUDGET_MS, result_to_response
+
+log = logging.getLogger("karpenter.fleet")
+
+DEFAULT_TENANT = "default"
+
+# module registry of live frontends for /debug/statusz (weak: a frontend's
+# lifetime is owned by whoever built it, the diagnostic surface just peeks)
+_ACTIVE: "weakref.WeakSet[FleetFrontend]" = weakref.WeakSet()
+
+
+def active_frontends() -> "list[FleetFrontend]":
+    return sorted(_ACTIVE, key=lambda f: f.name)
+
+
+class FleetShed(RuntimeError):
+    """Request refused without compute; `where` is "admission" or "queue"."""
+
+    def __init__(self, where: str, message: str):
+        super().__init__(message)
+        self.where = where
+
+
+class TenantNotSynced(RuntimeError):
+    """The tenant's (catalog, provisioner) content is not resident on the
+    backing service — the fleet analogue of Solve's FAILED_PRECONDITION."""
+
+
+class _Ticket:
+    """One admitted request: the demux handle the submitting caller blocks
+    on. Resolution is exactly-once (result or error, never both)."""
+
+    __slots__ = ("tenant_id", "pods", "existing", "daemon_overhead", "key",
+                 "plan", "deadline_ms", "admitted_tick", "admitted_at",
+                 "served_tick", "latency_s", "result", "error", "_event",
+                 "seq")
+
+    def __init__(self, tenant_id, pods, existing, daemon_overhead, key,
+                 plan, deadline_ms, admitted_tick, admitted_at, seq):
+        self.tenant_id = tenant_id
+        self.pods = pods
+        self.existing = existing
+        self.daemon_overhead = daemon_overhead
+        self.key = key
+        self.plan = plan
+        self.deadline_ms = deadline_ms
+        self.admitted_tick = admitted_tick
+        self.admitted_at = admitted_at
+        self.served_tick = None
+        self.latency_s = None
+        self.result = None
+        self.error = None
+        self._event = threading.Event()
+        self.seq = seq
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: "Optional[float]" = None):
+        """Block for the demuxed result; raises the ticket's error (a shed
+        raises FleetShed). Returns the SolveResult."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"fleet ticket for tenant {self.tenant_id!r} not served "
+                f"within {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+    def _resolve(self, result=None, error=None) -> None:
+        if self._event.is_set():
+            return
+        self.result = result
+        self.error = error
+        self._event.set()
+
+
+class _TenantState:
+    __slots__ = ("key", "weight", "submitted", "served", "shed_admission",
+                 "shed_queue", "errors", "max_wait_ticks")
+
+    def __init__(self, key, weight: int):
+        self.key = key
+        self.weight = max(1, int(weight))
+        self.submitted = 0
+        self.served = 0
+        self.shed_admission = 0
+        self.shed_queue = 0
+        self.errors = 0
+        self.max_wait_ticks = 0
+
+    def as_dict(self) -> dict:
+        return {"weight": self.weight, "submitted": self.submitted,
+                "served": self.served,
+                "shed_admission": self.shed_admission,
+                "shed_queue": self.shed_queue, "errors": self.errors,
+                "max_wait_ticks": self.max_wait_ticks}
+
+
+class FleetFrontend:
+    """Batched multi-tenant admission in front of a `SolverService` (or any
+    `solve_batch` callable — the chaos drill injects a deterministic stub).
+
+    Queue topology: queues[(solver_key, plan)][tenant_id] -> deque of
+    tickets. solver_key is the service's LRU identity
+    (catalog_hash, provisioner_hash) — requests can only batch when they
+    run against the same resident device state; plan is the padded
+    `BucketPlan` rung, so everything in one queue folds into one vmapped
+    program."""
+
+    def __init__(self, service=None, clock: "Optional[Clock]" = None,
+                 tick_interval_s: float = 0.02, max_wave: int = 16,
+                 starvation_bound: int = 4,
+                 solve_batch: "Optional[Callable]" = None,
+                 name: str = "fleet"):
+        if service is None and solve_batch is None:
+            raise ValueError("FleetFrontend needs a service or solve_batch")
+        self.service = service
+        self.clock = clock or Clock()
+        self.tick_interval_s = float(tick_interval_s)
+        self.max_wave = max(1, int(max_wave))
+        self.starvation_bound = max(1, int(starvation_bound))
+        self.name = name
+        self._solve_batch = solve_batch or self._service_solve_batch
+        self._lock = threading.Lock()
+        self._tenants: "OrderedDict[str, _TenantState]" = OrderedDict()
+        # (key, plan) -> tenant_id -> deque[_Ticket]; OrderedDict keeps
+        # tenant iteration order deterministic (registration order)
+        self._queues: "OrderedDict[tuple, OrderedDict[str, deque]]" = \
+            OrderedDict()
+        self._rr: "dict[tuple, int]" = {}   # per-bucket rotation offset
+        self._tick = 0
+        self._seq = itertools.count()
+        self._thread: "Optional[threading.Thread]" = None
+        self._stop = threading.Event()
+        self.ticks_run = 0
+        self.mega_solves = 0
+        _ACTIVE.add(self)
+
+    # -- tenant registration ---------------------------------------------------
+
+    def register(self, tenant_id: str, catalog, provisioners: Sequence,
+                 weight: int = 1) -> "tuple[int, int]":
+        """Sync the tenant's catalog+provisioners into the backing service
+        and admit the tenant. Content-identical tenants share one resident
+        solver (the LRU key is the content hash), which is what makes
+        cross-tenant mega-solves possible. Returns the solver key."""
+        key = (wire.catalog_hash(catalog),
+               wire.provisioners_hash(list(provisioners)))
+        if self.service is not None:
+            self.service.Sync(pb.SyncRequest(
+                catalog=wire.catalog_to_wire(catalog),
+                provisioners=[wire.provisioner_to_wire(p)
+                              for p in provisioners]), None)
+        self.register_key(tenant_id, key, weight=weight)
+        return key
+
+    def register_key(self, tenant_id: str, key: "tuple[int, int]",
+                     weight: int = 1) -> None:
+        """Admit a tenant whose catalog is ALREADY synced (the wire path:
+        the client Sync'd through the fleet's delegated Sync RPC)."""
+        with self._lock:
+            st = self._tenants.get(tenant_id)
+            if st is None:
+                self._tenants[tenant_id] = _TenantState(key, weight)
+            else:
+                st.key = key
+                st.weight = max(1, int(weight))
+
+    # -- admission -------------------------------------------------------------
+
+    def _plan_of(self, pods, existing) -> buckets.BucketPlan:
+        # Admission-queue key only — NOT the jit key (build_pack_inputs
+        # re-derives the exact padded shape at encode time). The group/slot
+        # estimate mirrors service._hint_shape's doctrine: the ladder's
+        # coarse rungs absorb estimate error, so same-shaped tenant traffic
+        # reliably lands in the same queue.
+        g = max(1, len(group_pods(list(pods))))
+        return buckets.plan_for(g, max(8, g), len(existing))
+
+    def submit(self, tenant_id: str, pods, existing=(),
+               daemon_overhead=None, deadline_ms: int = 0,
+               weight: "Optional[int]" = None) -> _Ticket:
+        """Admit one solve request; returns its ticket (already resolved
+        with a FleetShed error when admission shed it). deadline_ms is the
+        caller's REMAINING cycle budget, wire semantics (0 = none)."""
+        tenant_id = tenant_id or DEFAULT_TENANT
+        with self._lock:
+            st = self._tenants.get(tenant_id)
+            if st is None:
+                raise TenantNotSynced(
+                    f"tenant {tenant_id!r} not registered with the fleet")
+            if weight is not None:
+                st.weight = max(1, int(weight))
+            st.submitted += 1
+            plan = self._plan_of(pods, existing)
+            ticket = _Ticket(tenant_id, list(pods), list(existing),
+                             daemon_overhead, st.key, plan, int(deadline_ms),
+                             self._tick, self.clock.now(), next(self._seq))
+            fm.REQUESTS.inc(tenant=tenant_id)
+            # shed at ADMISSION: the request must survive at least one full
+            # tick of queueing plus the service's own shed floor, or the
+            # answer would arrive after the caller's cycle gave up on it
+            min_budget = self.tick_interval_s * 1000.0 + SHED_MIN_BUDGET_MS
+            if ticket.deadline_ms and ticket.deadline_ms < min_budget:
+                st.shed_admission += 1
+                fm.SHED.inc(tenant=tenant_id, where="admission")
+                ticket._resolve(error=FleetShed(
+                    "admission",
+                    f"{ticket.deadline_ms}ms of budget cannot survive the "
+                    f"next {self.tick_interval_s * 1000:.0f}ms tick; "
+                    f"shedding at admission"))
+                return ticket
+            bucket = (st.key, plan)
+            per_tenant = self._queues.setdefault(bucket, OrderedDict())
+            per_tenant.setdefault(tenant_id, deque()).append(ticket)
+            self._observe_depths_locked()
+        return ticket
+
+    def solve(self, tenant_id: str, pods, existing=(), daemon_overhead=None,
+              deadline_ms: int = 0, timeout: "Optional[float]" = 30.0):
+        """Synchronous convenience: submit + wait (the tick thread must be
+        running, or the caller must tick from another thread)."""
+        return self.submit(tenant_id, pods, existing, daemon_overhead,
+                           deadline_ms).wait(timeout)
+
+    # -- the tick loop ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"{self.name}-tick", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.clock.sleep(self.tick_interval_s)
+            if self._stop.is_set():
+                break
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("fleet tick failed")
+
+    def tick(self) -> int:
+        """One batching round over every bucket: shed expired tickets,
+        select up to max_wave per bucket (fair + starvation-bounded), run
+        each selection as ONE mega-solve, demux. Returns requests served.
+        Deterministic given the clock and the submission sequence."""
+        with self._lock:
+            self._tick += 1
+            self.ticks_run += 1
+            now = self.clock.now()
+            batches: "list[tuple[tuple, list[_Ticket]]]" = []
+            for bucket in list(self._queues):
+                self._shed_expired_locked(bucket, now)
+                batch = self._select_locked(bucket)
+                if batch:
+                    batches.append((bucket, batch))
+                if not any(self._queues.get(bucket, {}).values()):
+                    self._queues.pop(bucket, None)
+            self._observe_depths_locked()
+        served = 0
+        for (key, plan), batch in batches:
+            served += self._dispatch(key, plan, batch)
+        return served
+
+    def _shed_expired_locked(self, bucket, now: float) -> None:
+        for tenant_id, q in self._queues.get(bucket, {}).items():
+            kept: "deque[_Ticket]" = deque()
+            for t in q:
+                if t.deadline_ms:
+                    remaining = t.deadline_ms - (now - t.admitted_at) * 1000.0
+                    if remaining < SHED_MIN_BUDGET_MS:
+                        st = self._tenants[tenant_id]
+                        st.shed_queue += 1
+                        fm.SHED.inc(tenant=tenant_id, where="queue")
+                        t._resolve(error=FleetShed(
+                            "queue",
+                            f"budget expired after "
+                            f"{self._tick - t.admitted_tick} tick(s) in "
+                            f"queue; shedding before compute"))
+                        continue
+                kept.append(t)
+            q.clear()
+            q.extend(kept)
+
+    def _select_locked(self, bucket) -> "list[_Ticket]":
+        """Up to max_wave tickets in two passes: a FAIR-SHARE pass first —
+        one rotation over the tenant queues, each granted up to `weight` —
+        then spare capacity to the oldest admissions overall. Order
+        matters: running the fair pass before any backlog drain is what
+        bounds a light tenant's wait (a backlog-first policy hands every
+        slot to a hot tenant's aged queue under sustained overload — FIFO
+        over an unbounded backlog IS starvation for everyone behind it).
+        The rotation start advances by the number of tenants granted, so
+        when one pass cannot reach every tenant the window tiles the
+        tenant list across ticks: any within-weight tenant is reached
+        within ceil(tenants*weight / max_wave) ticks, the floor the
+        starvation bound must sit above."""
+        per_tenant = self._queues.get(bucket)
+        if not per_tenant:
+            return []
+        budget = self.max_wave
+        picked: "list[_Ticket]" = []
+        tenants = [tid for tid in per_tenant if per_tenant[tid]]
+        if tenants:
+            start = self._rr.get(bucket, 0) % len(tenants)
+            granted = 0
+            for tid in tenants[start:] + tenants[:start]:
+                if budget <= 0:
+                    break
+                q = per_tenant[tid]
+                take = min(self._tenants[tid].weight, budget, len(q))
+                for _ in range(take):
+                    picked.append(q.popleft())
+                if take:
+                    granted += 1
+                budget -= take
+            self._rr[bucket] = self._rr.get(bucket, 0) + max(1, granted)
+        # spare capacity drains backlog: oldest admission first, across
+        # every tenant (a hot tenant may fill this, never the fair pass)
+        if budget > 0:
+            backlog = sorted(
+                (t for q in per_tenant.values() for t in q),
+                key=lambda t: (t.admitted_tick, t.seq))
+            for t in backlog[:budget]:
+                per_tenant[t.tenant_id].remove(t)
+                picked.append(t)
+        return picked
+
+    # -- dispatch / demux ------------------------------------------------------
+
+    def _service_solve_batch(self, key, problems: "list[dict]"):
+        """Default backend: the mega-solve. Resolve the resident solver for
+        the content key and run the whole batch through solve_many — one
+        vmapped dispatch per padded shape, one device->host read for all
+        tenants (solver/core.py)."""
+        svc = self.service
+        with svc._lock:
+            entry = svc._cache.get(key)
+            if entry is not None:
+                svc._cache.move_to_end(key)
+        if entry is None:
+            raise TenantNotSynced(
+                f"catalog hash={key[0]:x} not synced; re-Sync required")
+        solver, _seqnum = entry
+        return solver.solve_many(problems)
+
+    def _dispatch(self, key, plan, batch: "list[_Ticket]") -> int:
+        fm.BATCH_OCCUPANCY.observe(len(batch) / self.max_wave)
+        fm.MEGA_SOLVES.inc(bucket=plan.label())
+        self.mega_solves += 1
+        problems = [{"pods": t.pods, "existing": t.existing,
+                     "daemon_overhead": t.daemon_overhead} for t in batch]
+        try:
+            results = self._solve_batch(key, problems)
+        except Exception as e:  # noqa: BLE001 — resolve, never wedge callers
+            with self._lock:
+                for t in batch:
+                    self._tenants[t.tenant_id].errors += 1
+                    t._resolve(error=e)
+            return 0
+        now = self.clock.now()
+        with self._lock:
+            for t, res in zip(batch, results):
+                st = self._tenants[t.tenant_id]
+                st.served += 1
+                t.served_tick = self._tick
+                wait = t.served_tick - t.admitted_tick
+                st.max_wait_ticks = max(st.max_wait_ticks, wait)
+                t.latency_s = max(0.0, now - t.admitted_at)
+                fm.WAIT_TICKS.observe(wait, tenant=t.tenant_id)
+                fm.TENANT_SOLVE_SECONDS.observe(t.latency_s,
+                                                tenant=t.tenant_id)
+                t._resolve(result=res)
+        return len(batch)
+
+    def _observe_depths_locked(self) -> None:
+        for (key, plan), per_tenant in self._queues.items():
+            fm.QUEUE_DEPTH.set(
+                float(sum(len(q) for q in per_tenant.values())),
+                bucket=plan.label())
+
+    # -- observability ---------------------------------------------------------
+
+    def reset_stats(self) -> None:
+        """Zero the ledgers (tenant counters, tick/mega-solve totals) while
+        keeping registrations and queues. For benchmarks: the measured
+        window must not inherit the warmup phase's compile-stall waits."""
+        with self._lock:
+            self.ticks_run = 0
+            self.mega_solves = 0
+            for st in self._tenants.values():
+                st.submitted = st.served = 0
+                st.shed_admission = st.shed_queue = st.errors = 0
+                st.max_wait_ticks = 0
+
+    def queued(self) -> int:
+        with self._lock:
+            return sum(len(q) for per in self._queues.values()
+                       for q in per.values())
+
+    def stats(self) -> dict:
+        """statusz section payload (introspect/statusz.py "fleet")."""
+        with self._lock:
+            return {
+                "name": self.name,
+                "tick_interval_s": self.tick_interval_s,
+                "max_wave": self.max_wave,
+                "starvation_bound": self.starvation_bound,
+                "ticks": self.ticks_run,
+                "mega_solves": self.mega_solves,
+                "queued": sum(len(q) for per in self._queues.values()
+                              for q in per.values()),
+                "buckets": {plan.label(): sum(len(q) for q in per.values())
+                            for (_k, plan), per in self._queues.items()},
+                "tenants": {tid: st.as_dict()
+                            for tid, st in self._tenants.items()},
+            }
+
+    def evidence(self) -> dict:
+        """The fairness-invariant input (chaos/invariants.py
+        check_fairness_never_starves): per-tenant ledger + the bound."""
+        s = self.stats()
+        return {"starvation_bound": self.starvation_bound,
+                "queued": s["queued"], "tenants": s["tenants"]}
+
+
+class FleetService:
+    """Wire adapter: a drop-in for `SolverService` in `serve()` whose Solve
+    queues through the fleet frontend (tenant-tagged, batched, fair, shed)
+    while Sync/Consolidate/Health delegate straight to the backing
+    service. A Sync through this adapter also admits the requesting tenant
+    — the wire client never needs a separate registration RPC."""
+
+    def __init__(self, frontend: FleetFrontend,
+                 solve_timeout_s: float = 30.0):
+        if frontend.service is None:
+            raise ValueError("FleetService needs a service-backed frontend")
+        self.frontend = frontend
+        self.service = frontend.service
+        self.solve_timeout_s = solve_timeout_s
+
+    def Sync(self, request, context):
+        resp = self.service.Sync(request, context)
+        # the synced content IS the tenant's solver key; tenants announce
+        # themselves on their first Solve (tenant_id), so admission here is
+        # keyed for everyone sharing this content
+        return resp
+
+    def Consolidate(self, request, context):
+        return self.service.Consolidate(request, context)
+
+    def Health(self, request, context):
+        return self.service.Health(request, context)
+
+    def Solve(self, request, context):
+        import grpc
+
+        tenant = request.tenant_id or DEFAULT_TENANT
+        key = (request.catalog_hash, request.provisioner_hash)
+        svc = self.service
+        with svc._lock:
+            entry = svc._cache.get(key)
+        if entry is None:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"catalog hash={request.catalog_hash:x} not synced; "
+                f"re-Sync required")
+        _solver, seqnum = entry
+        self.frontend.register_key(tenant, key)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        ticket = self.frontend.submit(
+            tenant,
+            [wire.pod_from_wire(m) for m in request.pods],
+            [wire.existing_from_wire(m) for m in request.existing],
+            list(request.daemon_overhead) or None,
+            deadline_ms=int(request.deadline_ms))
+        timeout = self.solve_timeout_s
+        if request.deadline_ms:
+            timeout = min(timeout, request.deadline_ms / 1000.0 + 1.0)
+        try:
+            result = ticket.wait(timeout)
+        except FleetShed as e:
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+        except TenantNotSynced as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        except TimeoutError as e:
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+        solve_ms = (_time.perf_counter() - t0) * 1000
+        resp = result_to_response(result, solve_ms, seqnum)
+        resp.routing = "fleet"
+        resp.bucket = ticket.plan.label()
+        return resp

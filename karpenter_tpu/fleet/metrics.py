@@ -1,0 +1,54 @@
+"""Fleet-serving metrics (REGISTRY-registered so gen_docs and statusz pick
+them up). The fleet is the first layer whose batch axis is TENANTS, so the
+families here answer the multi-tenant triage questions the solver metrics
+can't: who is queued, how full the mega-solves run, who is being shed and
+why, and what latency each tenant actually sees through the queue."""
+
+from __future__ import annotations
+
+from ..metrics import REGISTRY
+
+QUEUE_DEPTH = REGISTRY.gauge(
+    "karpenter_fleet_queue_depth",
+    "Requests waiting in a fleet admission queue, by bucket-plan label. "
+    "Sustained depth means ticks are under-provisioned for the offered "
+    "load (raise max_wave or add replicas).",
+    ("bucket",))
+
+REQUESTS = REGISTRY.counter(
+    "karpenter_fleet_requests_total",
+    "Solve requests admitted to the fleet frontend, by tenant.",
+    ("tenant",))
+
+SHED = REGISTRY.counter(
+    "karpenter_fleet_shed_total",
+    "Requests shed without compute, by tenant and where the shed happened "
+    "(admission = remaining deadline budget could not survive the next "
+    "tick; queue = the budget expired while enqueued).",
+    ("tenant", "where"))
+
+MEGA_SOLVES = REGISTRY.counter(
+    "karpenter_fleet_mega_solves_total",
+    "Coalesced multi-tenant dispatches, by bucket-plan label. One count "
+    "here covers every request in the batch (see batch occupancy).",
+    ("bucket",))
+
+BATCH_OCCUPANCY = REGISTRY.histogram(
+    "karpenter_fleet_batch_occupancy_ratio",
+    "Mega-solve batch size / max_wave per tick dispatch. Persistently low "
+    "occupancy means the tick interval is too short (batches never fill); "
+    "pinned at 1.0 means the wave cap is the throughput ceiling.",
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+
+TENANT_SOLVE_SECONDS = REGISTRY.histogram(
+    "karpenter_fleet_tenant_solve_seconds",
+    "End-to-end fleet latency per served request (admission to demuxed "
+    "result), by tenant — queue wait included, which is the point.",
+    ("tenant",))
+
+WAIT_TICKS = REGISTRY.histogram(
+    "karpenter_fleet_wait_ticks",
+    "Ticks a served request spent queued before dispatch, by tenant. The "
+    "fairness invariant bounds this at the frontend's starvation bound.",
+    ("tenant",),
+    buckets=(0, 1, 2, 4, 8, 16, 32))

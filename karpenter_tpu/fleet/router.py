@@ -1,0 +1,75 @@
+"""Tenant -> replica routing for the solver fleet (rendezvous hashing).
+
+With thousands of clusters behind N fleet replicas, the router must keep a
+tenant pinned to one replica (its synced catalog and compiled programs are
+resident THERE) while surviving replica churn gracefully. Rendezvous
+(highest-random-weight) hashing gives both for free:
+
+* stability — a tenant moves only when its own top-scoring replica leaves
+  the set (or a new replica out-scores every incumbent). Removing one of R
+  replicas remaps exactly the tenants that lived on it (~1/R of traffic);
+  adding one steals only the tenants the newcomer now wins (~1/(R+1)).
+  A modulo hash would remap almost everything on any membership change,
+  invalidating device-resident state fleet-wide.
+* no token ring to persist — the score is a pure function of
+  (tenant, replica), so every controller computes the same answer with no
+  coordination and no shared state to journal/recover.
+
+Scores come from blake2b (the repo's content-hash primitive, wire.py):
+python's hash() is per-process salted and MUST NOT be used here — two
+controllers would route the same tenant to different replicas.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, Optional
+
+
+def _score(tenant_id: str, replica: str) -> int:
+    h = hashlib.blake2b(digest_size=8)
+    h.update(tenant_id.encode("utf-8"))
+    h.update(b"\x00")  # unambiguous boundary: ("ab","c") != ("a","bc")
+    h.update(replica.encode("utf-8"))
+    return int.from_bytes(h.digest(), "little")
+
+
+class FleetRouter:
+    """Rendezvous-hash map from tenant_id to a replica name. Replicas are
+    opaque strings (typically "host:port" solver-service targets); ties —
+    cryptographically negligible but not impossible — break by replica
+    name so the choice stays deterministic across processes."""
+
+    def __init__(self, replicas: Iterable[str] = ()):
+        self._replicas: "list[str]" = []
+        for r in replicas:
+            self.add_replica(r)
+
+    @property
+    def replicas(self) -> "tuple[str, ...]":
+        return tuple(sorted(self._replicas))
+
+    def add_replica(self, replica: str) -> None:
+        if not replica:
+            raise ValueError("replica name must be non-empty")
+        if replica not in self._replicas:
+            self._replicas.append(replica)
+
+    def remove_replica(self, replica: str) -> None:
+        if replica in self._replicas:
+            self._replicas.remove(replica)
+
+    def route(self, tenant_id: str) -> str:
+        """The tenant's home replica. Raises if the fleet is empty —
+        routing nowhere is a caller decision, not a silent default."""
+        if not self._replicas:
+            raise LookupError("fleet has no replicas")
+        return max(sorted(self._replicas),
+                   key=lambda r: (_score(tenant_id, r), r))
+
+    def route_or_none(self, tenant_id: str) -> Optional[str]:
+        return self.route(tenant_id) if self._replicas else None
+
+    def assignment(self, tenant_ids: Iterable[str]) -> "dict[str, str]":
+        """tenant -> replica for a whole tenant set (rebalance previews)."""
+        return {t: self.route(t) for t in tenant_ids}

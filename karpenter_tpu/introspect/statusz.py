@@ -23,7 +23,7 @@ from ..metrics import REGISTRY, Gauge, Histogram
 
 log = logging.getLogger("karpenter.statusz")
 
-SCHEMA_VERSION = 3  # 3: added the "recovery" section (epoch/journal/replay)
+SCHEMA_VERSION = 4  # 4: added the "fleet" section (multi-tenant frontends)
 
 # hard caps so a pathological operator can't make statusz unbounded
 MAX_EVENTS = 50
@@ -139,6 +139,14 @@ def _metrics_section(registry=None) -> dict:
     return out
 
 
+def _fleet_section() -> dict:
+    # lazy import: the fleet layer is optional (and imports the solver
+    # stack); statusz must stay importable without it
+    from ..fleet.frontend import active_frontends
+
+    return {"frontends": [f.stats() for f in active_frontends()]}
+
+
 def snapshot(op) -> dict:
     """The one consistent operator snapshot (see module docstring)."""
     return {
@@ -153,5 +161,6 @@ def snapshot(op) -> dict:
         "events": _fenced(lambda: _events_section(op)),
         "resilience": _fenced(lambda: op.resilience.snapshot()),
         "recovery": _fenced(lambda: op.recovery.snapshot()),
+        "fleet": _fenced(_fleet_section),
         "metrics": _fenced(_metrics_section),
     }

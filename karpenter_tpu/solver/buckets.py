@@ -29,10 +29,13 @@ hit/miss/warmup counters behind `Sync`-time pre-jit.
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import NamedTuple, Optional
 
 from ..metrics import REGISTRY
+
+log = logging.getLogger("karpenter.solver.buckets")
 
 # -- the ladder --------------------------------------------------------------
 
@@ -112,12 +115,37 @@ DEFAULT_CROSSOVER_CELLS = 512 * 512
 HYSTERESIS_FACTOR = 4
 
 
+# Canonical knob first; the short alias is accepted for compatibility with
+# docs/runbooks that predate the SHARD_ prefix (canonical wins when both
+# are set). See docs/designs/serving-sharded.md "Tuning the crossover".
+_CROSSOVER_ENV_VARS = ("KARPENTER_TPU_SHARD_CROSSOVER_CELLS",
+                       "KARPENTER_TPU_CROSSOVER_CELLS")
+
+
 def crossover_cells_default() -> int:
-    try:
-        return int(os.environ.get("KARPENTER_TPU_SHARD_CROSSOVER_CELLS",
-                                  DEFAULT_CROSSOVER_CELLS))
-    except ValueError:
-        return DEFAULT_CROSSOVER_CELLS
+    """The env-tunable single->sharded crossover, validated: a knob that
+    silently falls back misroutes EVERY solve until someone diffs env
+    against code, so a bad value warns loudly (once per read) and a
+    negative one clamps to 0 (= always sharded) rather than pretending a
+    negative cell count means something."""
+    for var in _CROSSOVER_ENV_VARS:
+        raw = os.environ.get(var)
+        if raw is None:
+            continue
+        try:
+            cells = int(raw)
+        except ValueError:
+            log.warning(
+                "%s=%r is not an integer; falling back to the default "
+                "crossover of %d cells", var, raw, DEFAULT_CROSSOVER_CELLS)
+            return DEFAULT_CROSSOVER_CELLS
+        if cells < 0:
+            log.warning(
+                "%s=%d is negative; clamping to 0 (every solve routes to "
+                "the sharded mesh kernel)", var, cells)
+            return 0
+        return cells
+    return DEFAULT_CROSSOVER_CELLS
 
 
 class ShapeRouter:

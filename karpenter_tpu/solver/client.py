@@ -97,10 +97,15 @@ class RemoteSolver:
     def __init__(self, catalog: Catalog, provisioners: Sequence[Provisioner],
                  target: str = "127.0.0.1:50151",
                  channel: Optional[grpc.Channel] = None,
-                 timeout: float = 10.0, resilience=None):
+                 timeout: float = 10.0, resilience=None,
+                 tenant_id: str = ""):
         self.catalog = catalog
         self.provisioners = list(provisioners)
         self.timeout = timeout
+        # fleet-serving identity: stamped on every SolveRequest so a
+        # multi-tenant frontend can queue/shed/account per tenant. Empty =
+        # legacy single-tenant caller (the frontend admits it as "default").
+        self.tenant_id = tenant_id
         # shared solver-edge RetryPolicy (breaker + budget) from the hub;
         # standalone clients run bare — the provisioning ladder above is
         # still their safety net
@@ -298,6 +303,7 @@ class RemoteSolver:
             pods=[wire.pod_to_wire(p) for p in pods],
             existing=[wire.existing_to_wire(e) for e in existing],
             daemon_overhead=list(daemon_overhead or ()),
+            tenant_id=self.tenant_id,
         )
         if self._synced_hash != self.catalog_content_hash():
             self.sync()

@@ -281,6 +281,10 @@ class SolverService:
             "solver.service.Solve",
             context=wire.trace_context_from_wire(request.trace_context),
             pods=len(request.pods))
+        if request.tenant_id:
+            # multi-tenant fleet callers (karpenter_tpu/fleet/) tag their
+            # cluster; the solver stays tenant-blind but the trace shouldn't
+            span.set_attribute("tenant", request.tenant_id)
         try:
             return self._solve_traced(request, context, span)
         except BaseException as e:  # noqa: BLE001 — context.abort raises

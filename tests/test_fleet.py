@@ -1,0 +1,444 @@
+"""Multi-tenant solver fleet tests (karpenter_tpu/fleet/): mega-solve
+bit-parity with sequential single-tenant solves, batching determinism
+under FakeClock, fairness bounds, shed-at-admission vs shed-in-queue
+(never after compute), rendezvous-router stability under replica churn,
+two in-process wire replicas end-to-end, and the statusz/metrics surface.
+"""
+
+import logging
+
+import pytest
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.chaos.invariants import check_fairness_never_starves
+from karpenter_tpu.fleet import (DEFAULT_TENANT, FleetFrontend, FleetRouter,
+                                 FleetService, FleetShed, TenantNotSynced)
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.models.requirements import OP_IN, Requirements
+from karpenter_tpu.solver import solver_pb2 as pb
+from karpenter_tpu.solver.service import SolverService, serve
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def small_catalog():
+    return Catalog(types=[
+        make_instance_type("m.large", cpu=4, memory="16Gi",
+                           od_price=0.20, spot_price=0.07),
+        make_instance_type("m.xlarge", cpu=16, memory="64Gi",
+                           od_price=0.80, spot_price=0.28),
+    ])
+
+
+def default_provisioner():
+    p = Provisioner(name="default", requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+    p.set_defaults()
+    return p
+
+
+def pods_for(tag, n=4, cpu="1", memory="2Gi"):
+    return [make_pod(f"{tag}-p{i}", cpu=cpu, memory=memory)
+            for i in range(n)]
+
+
+def stub_frontend(record=None, **kw):
+    """FleetFrontend over a deterministic stub backend (no JAX): the demux
+    echoes each problem's pod count so callers can verify ordering."""
+    def backend(key, problems):
+        if record is not None:
+            record.append([p["_tag"] for p in problems]
+                          if "_tag" in (problems[0] if problems else {})
+                          else len(problems))
+        return [{"pods": len(p["pods"])} for p in problems]
+
+    kw.setdefault("clock", FakeClock())
+    kw.setdefault("tick_interval_s", 0.02)
+    return FleetFrontend(solve_batch=backend, **kw)
+
+
+class TestMegaSolveParity:
+    def test_mega_solve_matches_sequential_single_tenant_solves(self):
+        """The acceptance bar: K tenants coalesced into one mega-solve get
+        bit-identical decisions to K sequential solver.solve calls."""
+        catalog, prov = small_catalog(), default_provisioner()
+        svc = SolverService()
+        f = FleetFrontend(svc, clock=FakeClock(), tick_interval_s=0.05,
+                          max_wave=8, name="parity")
+        for t in range(4):
+            f.register(f"tenant-{t}", catalog, [prov])
+        submissions = []
+        for t in range(4):
+            # different pod counts per tenant: the demux must route each
+            # tenant ITS result, not just any result of the right shape
+            pods = pods_for(f"t{t}", n=4 + t)
+            submissions.append((pods, f.submit(f"tenant-{t}", pods)))
+        served = f.tick()
+        assert served == 4
+        assert f.mega_solves == 1  # one vmapped dispatch covered all four
+        with svc._lock:
+            solver = next(iter(svc._cache.values()))[0]
+        for pods, ticket in submissions:
+            res = ticket.wait(1)
+            seq = solver.solve(pods)
+            assert res.decisions() == seq.decisions()
+            assert sum(n.pod_count for n in res.nodes) == len(pods)
+
+    def test_content_identical_tenants_share_one_resident_solver(self):
+        svc = SolverService()
+        f = FleetFrontend(svc, clock=FakeClock(), name="dedupe")
+        keys = {f.register(f"t{i}", small_catalog(), [default_provisioner()])
+                for i in range(5)}
+        assert len(keys) == 1
+        with svc._lock:
+            assert len(svc._cache) == 1
+
+
+class TestBatchingDeterminism:
+    def drive(self):
+        """Fixed submission schedule against a fresh frontend; returns the
+        exact batch compositions the backend saw plus who was served on
+        which tick — the whole observable batching behavior."""
+        batches = []
+
+        def backend(key, problems):
+            batches.append(tuple(p["pods"][0].name.rsplit("-", 1)[0]
+                                 for p in problems))
+            return [None] * len(problems)
+
+        f = FleetFrontend(solve_batch=backend, clock=FakeClock(),
+                          tick_interval_s=0.02, max_wave=6,
+                          starvation_bound=3, name="det")
+        for tid in ("a", "b", "c"):
+            f.register_key(tid, (1, 1))
+        schedule = [("a", 4), ("b", 2), ("c", 1), ("a", 3), ("b", 1),
+                    ("c", 2), ("a", 2)]
+        tickets = []
+        for tick, (tid, n) in enumerate(schedule):
+            for i in range(n):
+                tk = f.submit(tid, pods_for(f"{tid}{tick}{i}"))
+                tickets.append((tid, tk))
+            f.clock.step(0.02)
+            f.tick()
+        guard = 0
+        while f.queued() and guard < 50:
+            guard += 1
+            f.clock.step(0.02)
+            f.tick()
+        assert f.queued() == 0
+        return batches, [(tid, tk.served_tick) for tid, tk in tickets]
+
+    def test_same_schedule_same_batches(self):
+        first, second = self.drive(), self.drive()
+        assert first == second
+        batches, served = first
+        assert len(batches) >= 7  # every tick with work dispatched
+        assert all(tick is not None for _, tick in served)
+
+
+class TestFairness:
+    def test_hot_tenant_cannot_starve_light_tenants(self):
+        f = stub_frontend(max_wave=8, starvation_bound=4, name="fair")
+        for tid in ("hot", "l1", "l2", "l3"):
+            f.register_key(tid, (1, 1))
+        for tick in range(30):
+            for i in range(12):  # hot floods every tick, over capacity
+                f.submit("hot", pods_for(f"h{tick}-{i}"))
+            for tid in ("l1", "l2", "l3"):
+                f.submit(tid, pods_for(f"{tid}-{tick}"))
+            f.clock.step(0.02)
+            f.tick()
+        stats = f.stats()["tenants"]
+        for tid in ("l1", "l2", "l3"):
+            # light tenants ride the WRR pass every tick: bounded wait even
+            # while the hot tenant's own backlog grows without bound
+            assert stats[tid]["served"] >= 28
+            assert stats[tid]["max_wait_ticks"] <= f.starvation_bound
+        assert stats["hot"]["served"] > 0  # capped, not blocked
+
+    def test_weight_shifts_share_without_starving_anyone(self):
+        f = stub_frontend(max_wave=6, starvation_bound=4, name="weights")
+        f.register_key("gold", (1, 1), weight=3)
+        f.register_key("bronze", (1, 1), weight=1)
+        for tick in range(20):
+            for i in range(6):  # gold floods past even its 3x share
+                f.submit("gold", pods_for(f"g{tick}-{i}"))
+            # bronze stays WITHIN its weight — the bound protects exactly
+            # the within-weight tenant, an over-rate one queues behind
+            # its own excess by construction
+            f.submit("bronze", pods_for(f"b{tick}"))
+            f.clock.step(0.02)
+            f.tick()
+        stats = f.stats()["tenants"]
+        assert stats["gold"]["served"] > stats["bronze"]["served"]
+        assert stats["bronze"]["max_wait_ticks"] <= f.starvation_bound
+
+    def test_unregistered_tenant_is_refused(self):
+        f = stub_frontend(name="refuse")
+        with pytest.raises(TenantNotSynced):
+            f.submit("nobody", pods_for("x"))
+
+    def test_fairness_invariant_flags_bound_breach(self):
+        good = {"starvation_bound": 4, "queued": 0,
+                "tenants": {"a": {"weight": 1, "submitted": 5, "served": 5,
+                                  "shed_admission": 0, "shed_queue": 0,
+                                  "errors": 0, "max_wait_ticks": 4}}}
+        assert check_fairness_never_starves(good) == []
+        bad = {"starvation_bound": 4, "queued": 2,
+               "tenants": {"a": {"weight": 1, "submitted": 5, "served": 5,
+                                 "shed_admission": 0, "shed_queue": 0,
+                                 "errors": 0, "max_wait_ticks": 9}}}
+        found = {v.invariant for v in check_fairness_never_starves(bad)}
+        assert found == {"fairness-never-starves"}
+        assert len(check_fairness_never_starves(bad)) == 2  # wait + queued
+
+
+class TestShedding:
+    def test_shed_at_admission_never_reaches_backend(self):
+        calls = []
+
+        def backend(key, problems):
+            calls.append(len(problems))
+            return [None] * len(problems)
+
+        f = FleetFrontend(solve_batch=backend, clock=FakeClock(),
+                          tick_interval_s=0.02, name="shed-adm")
+        f.register_key("t", (1, 1))
+        # 5ms of budget cannot survive the ~20ms tick + 10ms floor
+        ticket = f.submit("t", pods_for("x"), deadline_ms=5)
+        assert ticket.done()  # resolved synchronously, never queued
+        with pytest.raises(FleetShed) as e:
+            ticket.wait(0)
+        assert e.value.where == "admission"
+        f.clock.step(0.02)
+        f.tick()
+        assert calls == []  # the backend never saw it
+        assert f.stats()["tenants"]["t"]["shed_admission"] == 1
+
+    def test_shed_in_queue_before_compute(self):
+        calls = []
+
+        def backend(key, problems):
+            calls.append(len(problems))
+            return [None] * len(problems)
+
+        f = FleetFrontend(solve_batch=backend, clock=FakeClock(),
+                          tick_interval_s=0.02, name="shed-q")
+        f.register_key("t", (1, 1))
+        ticket = f.submit("t", pods_for("x"), deadline_ms=100)
+        assert not ticket.done()  # admitted: 100ms survives one tick
+        f.clock.step(0.2)  # ...but the budget drains while queued
+        f.tick()
+        with pytest.raises(FleetShed) as e:
+            ticket.wait(0)
+        assert e.value.where == "queue"
+        assert calls == []  # shed BEFORE compute, not after
+        st = f.stats()["tenants"]["t"]
+        assert (st["shed_queue"], st["served"]) == (1, 0)
+
+    def test_healthy_budget_is_served(self):
+        f = stub_frontend(name="shed-ok")
+        f.register_key("t", (1, 1))
+        ticket = f.submit("t", pods_for("x"), deadline_ms=5000)
+        f.clock.step(0.02)
+        f.tick()
+        assert ticket.wait(0) == {"pods": 4}
+
+
+class TestRouter:
+    def test_empty_fleet_raises(self):
+        r = FleetRouter()
+        with pytest.raises(LookupError):
+            r.route("acme")
+        assert r.route_or_none("acme") is None
+
+    def test_route_is_deterministic_and_order_independent(self):
+        a = FleetRouter(["r1", "r2", "r3"])
+        b = FleetRouter(["r3", "r1", "r2"])
+        for i in range(50):
+            assert a.route(f"t{i}") == b.route(f"t{i}")
+
+    def test_remove_remaps_only_the_lost_replicas_tenants(self):
+        tenants = [f"cluster-{i}" for i in range(200)]
+        r = FleetRouter(["r1", "r2", "r3"])
+        before = r.assignment(tenants)
+        assert set(before.values()) == {"r1", "r2", "r3"}
+        r.remove_replica("r2")
+        after = r.assignment(tenants)
+        for t in tenants:
+            if before[t] != "r2":
+                assert after[t] == before[t]  # survivors keep their home
+            else:
+                assert after[t] in ("r1", "r3")
+        # rejoin restores the exact original assignment (pure function)
+        r.add_replica("r2")
+        assert r.assignment(tenants) == before
+
+    def test_add_steals_only_for_the_newcomer(self):
+        tenants = [f"cluster-{i}" for i in range(200)]
+        r = FleetRouter(["r1", "r2", "r3"])
+        before = r.assignment(tenants)
+        r.add_replica("r4")
+        after = r.assignment(tenants)
+        moved = [t for t in tenants if after[t] != before[t]]
+        assert moved  # the newcomer takes a share...
+        assert all(after[t] == "r4" for t in moved)  # ...and ONLY it gains
+        # ~1/4 of tenants move, not ~all (the modulo-hash failure mode)
+        assert len(moved) < 200 * 0.45
+
+    def test_rejects_empty_replica_name(self):
+        with pytest.raises(ValueError):
+            FleetRouter().add_replica("")
+
+
+class TestWireFleet:
+    @pytest.fixture()
+    def replicas(self):
+        servers, frontends, targets = [], [], []
+        for _ in range(2):
+            svc = SolverService()
+            fe = FleetFrontend(svc, tick_interval_s=0.005, name="wire")
+            fe.start()
+            srv, port, _ = serve("127.0.0.1:0", service=FleetService(fe))
+            servers.append(srv)
+            frontends.append(fe)
+            targets.append(f"127.0.0.1:{port}")
+        yield frontends, targets
+        for fe in frontends:
+            fe.stop()
+        for srv in servers:
+            srv.stop(grace=None)
+
+    def test_two_replicas_route_sync_and_solve(self, replicas):
+        from karpenter_tpu.solver.client import RemoteSolver
+        from karpenter_tpu.solver.core import TPUSolver
+
+        frontends, targets = replicas
+        router = FleetRouter(targets)
+        catalog, prov = small_catalog(), default_provisioner()
+        local = TPUSolver(catalog, [prov])
+        tenants = [f"cluster-{i}" for i in range(6)]
+        homes = router.assignment(tenants)
+        assert set(homes.values()) == set(targets)  # both replicas used
+        for tid in tenants:
+            remote = RemoteSolver(catalog, [prov], target=homes[tid],
+                                  tenant_id=tid)
+            pods = pods_for(tid, n=5)
+            res = remote.solve(pods)
+            assert res.decisions() == local.solve(pods).decisions()
+        served_by = {t: fe.stats()["tenants"]
+                     for t, fe in zip(targets, frontends)}
+        for tid in tenants:
+            # each tenant was admitted and served on ITS home replica only
+            assert served_by[homes[tid]][tid]["served"] == 1
+            other = next(t for t in targets if t != homes[tid])
+            assert tid not in served_by[other]
+
+    def test_wire_solve_without_tenant_runs_as_default(self, replicas):
+        from karpenter_tpu.solver.client import RemoteSolver
+
+        frontends, targets = replicas
+        catalog, prov = small_catalog(), default_provisioner()
+        remote = RemoteSolver(catalog, [prov], target=targets[0])
+        res = remote.solve(pods_for("legacy", n=3))
+        assert sum(n.pod_count for n in res.nodes) == 3
+        assert DEFAULT_TENANT in frontends[0].stats()["tenants"]
+
+
+class TestTenantWire:
+    def test_solve_request_carries_tenant_id(self):
+        req = pb.SolveRequest(tenant_id="acme", catalog_seqnum=3)
+        blob = req.SerializeToString()
+        back = pb.SolveRequest()
+        back.ParseFromString(blob)
+        assert back.tenant_id == "acme"
+        assert pb.SolveRequest().tenant_id == ""  # proto3 default: legacy
+
+
+class TestIntrospection:
+    def test_statusz_schema_bumped_with_fleet_section(self):
+        from karpenter_tpu.introspect import statusz
+
+        assert statusz.SCHEMA_VERSION == 4
+        f = stub_frontend(name="statusz-probe")
+        f.register_key("t", (1, 1))
+        f.submit("t", pods_for("x"))
+        f.clock.step(0.02)
+        f.tick()
+        section = statusz._fleet_section()
+        mine = [s for s in section["frontends"]
+                if s["name"] == "statusz-probe"]
+        assert len(mine) == 1
+        assert mine[0]["tenants"]["t"]["served"] == 1
+        assert mine[0]["mega_solves"] == 1
+
+    def test_fleet_metrics_registered(self):
+        from karpenter_tpu.metrics import REGISTRY
+
+        with REGISTRY._lock:
+            names = set(REGISTRY._metrics)
+        for name in ("karpenter_fleet_queue_depth",
+                     "karpenter_fleet_requests_total",
+                     "karpenter_fleet_shed_total",
+                     "karpenter_fleet_mega_solves_total",
+                     "karpenter_fleet_batch_occupancy_ratio",
+                     "karpenter_fleet_tenant_solve_seconds",
+                     "karpenter_fleet_wait_ticks"):
+            assert name in names
+
+
+class TestTenantStorm:
+    def test_storm_scenario_passes_and_replays(self):
+        from karpenter_tpu.chaos import ChaosRunner
+
+        runner = ChaosRunner(seed=7, storm=True)
+        s1 = runner.run_storm_scenario(0)
+        assert s1["passed"], s1["violations"]
+        t = s1["totals"]
+        assert t["shed_admission"] > 0 and t["shed_queue"] > 0
+        assert t["served"] > 0
+        for tid, st in s1["evidence"]["tenants"].items():
+            assert st["max_wait_ticks"] <= s1["starvation_bound"], tid
+        # replay contract: the scenario dict is a pure function of the seed
+        assert ChaosRunner(seed=7, storm=True).run_storm_scenario(0) == s1
+
+
+class TestCrossoverKnob:
+    def test_default_when_unset(self, monkeypatch):
+        from karpenter_tpu.solver import buckets
+
+        for var in buckets._CROSSOVER_ENV_VARS:
+            monkeypatch.delenv(var, raising=False)
+        assert buckets.crossover_cells_default() == \
+            buckets.DEFAULT_CROSSOVER_CELLS
+
+    def test_valid_value_both_names(self, monkeypatch):
+        from karpenter_tpu.solver import buckets
+
+        for var in buckets._CROSSOVER_ENV_VARS:
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("KARPENTER_TPU_CROSSOVER_CELLS", "4096")
+        assert buckets.crossover_cells_default() == 4096
+        # the canonical SHARD_ name wins when both are set
+        monkeypatch.setenv("KARPENTER_TPU_SHARD_CROSSOVER_CELLS", "65536")
+        assert buckets.crossover_cells_default() == 65536
+
+    def test_garbage_warns_and_falls_back(self, monkeypatch, caplog):
+        from karpenter_tpu.solver import buckets
+
+        monkeypatch.setenv("KARPENTER_TPU_SHARD_CROSSOVER_CELLS", "lots")
+        with caplog.at_level(logging.WARNING,
+                             logger="karpenter.solver.buckets"):
+            assert buckets.crossover_cells_default() == \
+                buckets.DEFAULT_CROSSOVER_CELLS
+        assert "not an integer" in caplog.text
+
+    def test_negative_clamps_to_zero_with_warning(self, monkeypatch, caplog):
+        from karpenter_tpu.solver import buckets
+
+        monkeypatch.setenv("KARPENTER_TPU_SHARD_CROSSOVER_CELLS", "-5")
+        with caplog.at_level(logging.WARNING,
+                             logger="karpenter.solver.buckets"):
+            assert buckets.crossover_cells_default() == 0
+        assert "clamping to 0" in caplog.text
