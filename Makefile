@@ -8,9 +8,9 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit lint noretry crashpoints test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm fleet-bench claims diagnose provenance multichip
+.PHONY: presubmit lint noretry hotloops crashpoints test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm fleet-bench claims diagnose provenance multichip soak
 
-presubmit: lint claims provenance noretry crashpoints test verify-entry  ## what CI runs
+presubmit: lint claims provenance noretry hotloops crashpoints test verify-entry  ## what CI runs
 
 claims:  ## every benchmark number in docs must cite a recorded artifact
 	$(PY) hack/check_round_claims.py
@@ -23,6 +23,12 @@ multichip:  ## wire-served sharded parity at the 50k stress shape (records an ar
 
 noretry:  ## retries must flow through resilience.RetryPolicy (shared budget)
 	$(PY) hack/check_no_adhoc_retry.py
+
+hotloops:  ## no per-pod/per-node Python loops in HOT:BEGIN/END sections
+	$(PY) hack/check_hot_loops.py
+
+soak:  ## columnar-state soak: 100k nodes / 1M pods under churn, RECORDED
+	$(CPU_ENV) $(PY) bench.py --soak
 
 crashpoints:  ## crashpoint catalog and call sites must stay in lockstep
 	$(PY) hack/check_crashpoints.py

@@ -1,0 +1,166 @@
+#!/usr/bin/env python3
+"""Lint: no per-pod/per-node Python `for` loops in marked hot sections.
+
+The columnar cluster-state refactor (docs/designs/columnar-state.md) moved
+the reconcile hot paths — provisioning mask construction, the
+deprovisioning sweeps, solver encode over existing capacity — onto
+contiguous numpy columns. The failure mode this lint guards against: a
+later change quietly reintroduces a `for pod in pods` / `for node in
+nodes` scan inside one of those sections, works fine at test scale, and
+at 100k nodes turns a column scan back into a multi-second fleet walk
+(the soak artifact in benchmarks/results/soak/ is sized on these loops
+NOT existing).
+
+Mechanics, AST-based not textual:
+
+  * Hot sections are delimited by `# HOT:BEGIN(name)` / `# HOT:END(name)`
+    comment pairs in the source. Pairs must balance per file.
+  * Inside a section, any `ast.For` whose iterator expression references a
+    per-pod/per-node collection identifier (BANNED below, exact match on
+    Name ids and Attribute attrs) is flagged. Loops over already-filtered
+    subsets (`np.nonzero(mask)[0]`, `np.unique(codes)`, dirty rows) and
+    per-GROUP loops (groups are deduped, O(10) not O(pods)) pass.
+  * `# hot-loop-ok: <why>` on the loop's line, or in the contiguous
+    comment block directly above it, allowlists the loop. Today's uses are
+    the legacy dataclass-view compatibility branches in encode.py — kept
+    for oracle callers and old tests, never the production path. Add new
+    ones only with a comment saying why the loop is not O(fleet).
+  * The three files that own the hot paths MUST carry at least one marker
+    each (REQUIRED_MARKED) — deleting the markers does not pass the lint.
+
+Run via `make presubmit` (or directly: python hack/check_hot_loops.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "karpenter_tpu"
+
+# identifiers that mean "the whole pod/node population"
+BANNED = {
+    "pods", "pending", "all_pods", "non_daemon_pods",
+    "nodes", "all_nodes", "node_names",
+    "existing", "views", "all_views",
+    "resident_counts",
+}
+
+# these own the reconcile hot paths; each must keep its markers
+REQUIRED_MARKED = (
+    PACKAGE / "models" / "encode.py",
+    PACKAGE / "controllers" / "provisioning.py",
+    PACKAGE / "controllers" / "deprovisioning.py",
+)
+
+_BEGIN = re.compile(r"#\s*HOT:BEGIN\(([\w-]+)\)")
+_END = re.compile(r"#\s*HOT:END\(([\w-]+)\)")
+_OK = re.compile(r"#\s*hot-loop-ok")
+
+
+def hot_ranges(lines: "list[str]", path: pathlib.Path
+               ) -> "tuple[list[tuple[int, int, str]], list[str]]":
+    """(1-indexed inclusive line ranges, errors) from the marker comments."""
+    ranges, errors = [], []
+    open_at: "tuple[int, str] | None" = None
+    for i, line in enumerate(lines, start=1):
+        b, e = _BEGIN.search(line), _END.search(line)
+        if b:
+            if open_at is not None:
+                errors.append(f"{path}:{i}: HOT:BEGIN({b.group(1)}) inside "
+                              f"unclosed HOT:BEGIN({open_at[1]})")
+            open_at = (i, b.group(1))
+        elif e:
+            if open_at is None:
+                errors.append(f"{path}:{i}: HOT:END({e.group(1)}) "
+                              "without HOT:BEGIN")
+            else:
+                if open_at[1] != e.group(1):
+                    errors.append(
+                        f"{path}:{i}: HOT:END({e.group(1)}) closes "
+                        f"HOT:BEGIN({open_at[1]})")
+                ranges.append((open_at[0], i, open_at[1]))
+                open_at = None
+    if open_at is not None:
+        errors.append(f"{path}:{open_at[0]}: unclosed "
+                      f"HOT:BEGIN({open_at[1]})")
+    return ranges, errors
+
+
+def allowlisted(lines: "list[str]", lineno: int) -> bool:
+    """hot-loop-ok on the loop line, or in the contiguous comment block
+    (possibly the tail of the preceding code line) directly above it."""
+    if _OK.search(lines[lineno - 1]):
+        return True
+    i = lineno - 2
+    while i >= 0:
+        stripped = lines[i].strip()
+        if _OK.search(lines[i]):
+            return True
+        if not stripped.startswith("#"):
+            return False
+        i -= 1
+    return False
+
+
+def iter_names(expr: ast.AST) -> "set[str]":
+    out = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+    return out
+
+
+def check_file(path: pathlib.Path) -> "list[str]":
+    src = path.read_text()
+    lines = src.splitlines()
+    ranges, errors = hot_ranges(lines, path)
+    if not ranges:
+        return errors
+    tree = ast.parse(src, filename=str(path))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.For):
+            continue
+        section = next((name for lo, hi, name in ranges
+                        if lo <= node.lineno <= hi), None)
+        if section is None:
+            continue
+        banned = iter_names(node.iter) & BANNED
+        if not banned:
+            continue
+        if allowlisted(lines, node.lineno):
+            continue
+        errors.append(
+            f"{path}:{node.lineno}: per-pod/per-node `for` over "
+            f"{sorted(banned)} inside HOT section ({section}) — vectorize "
+            "over the columns, or annotate `# hot-loop-ok: <why>` if the "
+            "loop is provably not O(fleet)")
+    return errors
+
+
+def main() -> int:
+    errors: "list[str]" = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        errors.extend(check_file(path))
+    for path in REQUIRED_MARKED:
+        if "HOT:BEGIN(" not in path.read_text():
+            errors.append(f"{path}: no HOT:BEGIN markers — the hot sections "
+                          "must stay marked (see docs/designs/"
+                          "columnar-state.md)")
+    if errors:
+        print("hot-loop lint FAILED:", file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    print("hot-loop lint ok "
+          f"({sum(1 for _ in PACKAGE.rglob('*.py'))} files scanned)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

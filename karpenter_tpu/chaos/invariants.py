@@ -347,6 +347,94 @@ def check_fairness_never_starves(fleet: "dict | None") -> "list[Violation]":
     return out
 
 
+def check_columnar_coherence(op) -> "list[Violation]":
+    """The columnar mirror IS the cluster: every incrementally-maintained
+    column and aggregate equals what a from-scratch rebuild of the node set
+    would produce. Catches a missed delta anywhere in the StateNode
+    write-interception path — the failure mode an incremental design trades
+    for its O(1) updates."""
+    import numpy as np
+
+    from ..models.cluster import ANNOTATION_DO_NOT_CONSOLIDATE
+
+    inv = "columnar-coherence"
+    out = []
+    cluster = op.cluster
+    cols = getattr(cluster, "columns", None)
+    if cols is None:
+        return out
+
+    def bad(msg):
+        out.append(Violation(inv, msg))
+
+    # row interning is a bijection over exactly the live node set
+    if set(cols.row_of) != set(cluster.nodes):
+        bad("row interning desynced from the node set: "
+            f"{sorted(set(cols.row_of) ^ set(cluster.nodes))}")
+        return out
+    if list(cluster._sorted_names) != sorted(cluster.nodes):
+        bad("sorted-names cache out of order or out of sync")
+    if set(np.nonzero(cols.occupied)[0].tolist()) != set(cols.row_of.values()):
+        bad("occupied mask disagrees with the row interning table")
+    for name, node in sorted(cluster.nodes.items()):
+        row = cols.row_of[name]
+        if cols.name_of[row] != name:
+            bad(f"name_of[{row}] = {cols.name_of[row]!r}, expected {name!r}")
+        fresh = [0] * len(node.allocatable)
+        non_daemon = 0
+        for p in node.pods:
+            for i, v in enumerate(p.resource_vector()):
+                fresh[i] += v
+            if p.owner_kind != "DaemonSet":
+                non_daemon += 1
+        if list(cols.used[row]) != fresh or node.used_vector() != fresh:
+            bad(f"node {name}: used column/aggregate != pod-scan sum")
+        if int(cols.non_daemon[row]) != non_daemon:
+            bad(f"node {name}: non_daemon column {int(cols.non_daemon[row])}"
+                f" != scan {non_daemon}")
+        if list(cols.alloc[row]) != list(node.allocatable):
+            bad(f"node {name}: alloc column != node.allocatable")
+        if cols.price[row] != node.price:
+            bad(f"node {name}: price column out of sync")
+        for attr, col in (("marked_for_deletion", cols.marked),
+                          ("initialized", cols.initialized),
+                          ("drifted", cols.drifted)):
+            if bool(col[row]) != bool(getattr(node, attr)):
+                bad(f"node {name}: {attr} column out of sync")
+        veto = node.annotations.get(ANNOTATION_DO_NOT_CONSOLIDATE) == "true"
+        if bool(cols.no_consolidate[row]) != veto:
+            bad(f"node {name}: do-not-consolidate column out of sync")
+        if cols.prov_names[cols.prov_code[row]] != node.provisioner_name:
+            bad(f"node {name}: provisioner code decodes to "
+                f"{cols.prov_names[cols.prov_code[row]]!r}")
+        if tuple(cols.taint_sets[cols.taint_code[row]]) != tuple(node.taints):
+            bad(f"node {name}: taint-set code out of sync")
+    # per-provisioner running totals vs the full scan they replaced
+    prov_names = ({n.provisioner_name for n in cluster.nodes.values()}
+                  | set(cluster._prov_totals))
+    for pname in sorted(prov_names):
+        from ..apis import wellknown as wk
+
+        cpu = mem = 0
+        for n in cluster.nodes.values():
+            if n.provisioner_name != pname:
+                continue
+            cpu += n.allocatable[wk.RESOURCE_INDEX[wk.RESOURCE_CPU]]
+            mem += n.allocatable[wk.RESOURCE_INDEX[wk.RESOURCE_MEMORY]] * 2**20
+        if cluster.total_usage(pname) != (cpu, mem):
+            bad(f"provisioner {pname}: running totals "
+                f"{cluster.total_usage(pname)} != scan {(cpu, mem)}")
+    # incremental PDB healthy counts vs a full pod recount
+    recount = {
+        pdb.name: sum(1 for n in cluster.nodes.values()
+                      for p in n.pods if pdb.matches(p))
+        for pdb in cluster.pdbs
+    }
+    if cluster.pdb_healthy() != recount:
+        bad(f"pdb healthy counts {cluster.pdb_healthy()} != recount {recount}")
+    return out
+
+
 def check_all(op, cloud, token_launches=None,
               consolidation_actions=None,
               resilience=None) -> "list[Violation]":
@@ -359,4 +447,5 @@ def check_all(op, cloud, token_launches=None,
     out += check_breaker_discipline(resilience)
     out += check_retry_budget(resilience)
     out += check_degrade_monotone(resilience)
+    out += check_columnar_coherence(op)
     return out

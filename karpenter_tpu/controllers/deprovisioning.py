@@ -16,6 +16,8 @@ from __future__ import annotations
 import logging
 from typing import Optional
 
+import numpy as np
+
 from ..apis import wellknown as wk
 from ..events import EventRecorder
 from ..metrics import NAMESPACE, REGISTRY, Registry
@@ -95,23 +97,47 @@ class DeprovisioningController:
     def _prov(self, name: str):
         return next((p for p in self.kube.provisioners() if p.name == name), None)
 
+    def _prov_ttl_columns(self, attr: str):
+        """(ttl-by-provisioner-name dict, ttl vector aligned with the
+        cluster's provisioner intern table). First matching provisioner wins
+        (the `_prov` convention); nan marks provisioners that are unknown or
+        carry no TTL of this kind — one nan test replaces the per-node
+        `_prov(...)`/`is None` probe pair in the sweeps."""
+        cols = self.cluster.columns
+        ttl_by_prov: "dict[str, Optional[float]]" = {}
+        for p in self.kube.provisioners():
+            ttl_by_prov.setdefault(p.name, getattr(p, attr))
+        ttl_of_code = np.full(len(cols.prov_names) + 1, np.nan)
+        for code, pname in enumerate(cols.prov_names):
+            ttl = ttl_by_prov.get(pname)
+            if ttl is not None:
+                ttl_of_code[code] = ttl
+        # -1 codes (never occupied rows) route to the trailing nan slot
+        ttl = ttl_of_code[np.where(cols.prov_code >= 0, cols.prov_code,
+                                   len(cols.prov_names))]
+        return ttl_by_prov, ttl
+
     # -- emptiness -------------------------------------------------------------
 
     def reconcile_emptiness(self) -> "list[str]":
         acted = []
         now = self.clock.now()
-        for name in sorted(self.cluster.nodes):
-            node = self.cluster.nodes[name]
-            if node.marked_for_deletion:
-                continue
-            prov = self._prov(node.provisioner_name)
-            if prov is None or prov.ttl_seconds_after_empty is None:
-                continue
-            if not node.is_empty():
-                self._empty_since.pop(name, None)
-                continue
+        cols = self.cluster.columns
+        # HOT:BEGIN(emptiness-sweep) — the per-node loop below only visits
+        # nodes that are actually empty; tracked-but-refilled nodes drop
+        # their empty-since mark in one vectorized pass
+        ttl_by_prov, ttl = self._prov_ttl_columns("ttl_seconds_after_empty")
+        tracked = cols.occupied & ~cols.marked & ~np.isnan(ttl)
+        refilled = tracked & (cols.non_daemon > 0)
+        for r in np.nonzero(refilled)[0]:
+            self._empty_since.pop(cols.name_of[r], None)
+        empty = tracked & (cols.non_daemon == 0)
+        names = sorted(cols.name_of[r] for r in np.nonzero(empty)[0])
+        # HOT:END(emptiness-sweep)
+        for name in names:
             since = self._empty_since.setdefault(name, now)
-            if now - since >= prov.ttl_seconds_after_empty:
+            node = self.cluster.nodes[name]
+            if now - since >= ttl_by_prov[node.provisioner_name]:
                 if self.termination.request_deletion(name):
                     self.actions.inc(action="emptiness")
                     self.recorder.normal(f"node/{name}", "EmptinessTTLExpired",
@@ -124,29 +150,30 @@ class DeprovisioningController:
     def reconcile_expiration(self) -> "list[str]":
         acted = []
         now = self.clock.now()
-        for name in sorted(self.cluster.nodes):
-            node = self.cluster.nodes[name]
-            if node.marked_for_deletion:
-                continue
-            prov = self._prov(node.provisioner_name)
-            if prov is None or prov.ttl_seconds_until_expired is None:
-                continue
-            if now - node.created_ts >= prov.ttl_seconds_until_expired:
-                if self.termination.request_deletion(name):
-                    self.actions.inc(action="expiration")
-                    self.recorder.normal(f"node/{name}", "Expired",
-                                         "node exceeded ttlSecondsUntilExpired")
-                    acted.append(name)
+        cols = self.cluster.columns
+        # HOT:BEGIN(expiration-sweep) — age test vectorized over created_ts;
+        # nan TTLs (unknown provisioner / no expiry) compare False
+        ttl_by_prov, ttl = self._prov_ttl_columns("ttl_seconds_until_expired")
+        with np.errstate(invalid="ignore"):
+            expired = (cols.occupied & ~cols.marked
+                       & (now - cols.created_ts >= ttl))
+        names = sorted(cols.name_of[r] for r in np.nonzero(expired)[0])
+        # HOT:END(expiration-sweep)
+        for name in names:
+            if self.termination.request_deletion(name):
+                self.actions.inc(action="expiration")
+                self.recorder.normal(f"node/{name}", "Expired",
+                                     "node exceeded ttlSecondsUntilExpired")
+                acted.append(name)
         return acted
 
     # -- drift -----------------------------------------------------------------
 
     def reconcile_drift(self) -> "list[str]":
         acted = []
-        for name in sorted(self.cluster.nodes):
+        # column prefilter: marked nodes skip the per-node kube/cloud probes
+        for name in self.cluster.scan_names(unmarked=True):
             node = self.cluster.nodes[name]
-            if node.marked_for_deletion:
-                continue
             machine = self.kube.get("machines", node.machine_name)
             if machine is None:
                 continue
@@ -186,17 +213,21 @@ class DeprovisioningController:
         # preferences can prevent consolidation — surface each once so a
         # "nothing consolidates" cluster is explicable without a debugger.
         # The seen-set is rebuilt from the LIVE preference pods each pass,
-        # so deleted pods don't pin memory for the controller's lifetime
+        # so deleted pods don't pin memory for the controller's lifetime.
+        # cluster.pref_pod_nodes() is maintained incrementally on bind/
+        # unbind, so this pass touches only nodes actually hosting
+        # preference pods instead of sweeping every pod in the cluster
         current_pref_pods = set()
-        for name in sorted(self.cluster.nodes):
-            if self.cluster.nodes[name].provisioner_name not in eligible_provs:
+        pref_nodes = self.cluster.pref_pod_nodes()
+        for name in sorted(pref_nodes):
+            node = self.cluster.nodes.get(name)
+            if node is None or node.provisioner_name not in eligible_provs:
                 continue  # never a candidate: its pods can't block anything
-            for pod in self.cluster.nodes[name].non_daemon_pods():
-                if pod.preferences:
-                    current_pref_pods.add(pod.name)
-                    if pod.name not in self._pref_logged:
-                        log.info("pod %s has scheduling preferences which "
-                                 "can prevent consolidation", pod.name)
+            for pod_name in sorted(pref_nodes[name]):
+                current_pref_pods.add(pod_name)
+                if pod_name not in self._pref_logged:
+                    log.info("pod %s has scheduling preferences which "
+                             "can prevent consolidation", pod_name)
         self._pref_logged = current_pref_pods
         # Mechanism 1 — Empty Node Consolidation (deprovisioning.md:74-77):
         # entirely empty nodes delete in PARALLEL before any search. With
@@ -218,22 +249,23 @@ class DeprovisioningController:
         # only nodes of consolidation-enabled provisioners may be candidates
         # (pre-search: a vetoed node must not shadow the next-best action)
         cand_filter = lambda n: n.provisioner_name in eligible_provs
+        # HOT:BEGIN(consolidation-candidates) — dirty-driven generation,
+        # shared by all three rungs: the column prefilter plus cached
+        # per-node evictability verdicts mean only rows dirtied since their
+        # last evaluation rerun the pod-level checks
+        cands = cluster.consolidation_candidates(cand_filter)
+        # HOT:END(consolidation-candidates)
         import time as _time
 
         def run_remote():
-            from ..oracle.consolidation import eligible
-
-            eligible_names = {
-                name for name, n in cluster.nodes.items()
-                if cand_filter(n) and eligible(n, cluster)}
             return self.remote_consolidator(
-                cluster, catalog, all_provs, eligible_names,
+                cluster, catalog, all_provs, {n.name for n in cands},
                 self.clock.now())
 
         def run_tpu():
             return run_consolidation(cluster, catalog, all_provs,
                                      now=self.clock.now(),
-                                     candidate_filter=cand_filter)
+                                     cand_nodes=cands)
 
         def run_oracle():
             from ..oracle.consolidation import find_multi_consolidation
@@ -244,11 +276,10 @@ class DeprovisioningController:
             # on this fallback path
             a = find_multi_consolidation(
                 cluster, catalog, all_provs, now=self.clock.now(),
-                max_candidates=8, candidate_filter=cand_filter)
+                max_candidates=8, nodes=cands)
             if a is None:
                 a = find_consolidation(cluster, catalog, all_provs,
-                                       now=self.clock.now(),
-                                       candidate_filter=cand_filter)
+                                       now=self.clock.now(), nodes=cands)
             return a
 
         # rung index -> configured backend; None marks rungs this deployment
@@ -344,15 +375,22 @@ class DeprovisioningController:
 
         if self.kube.pending_pods():
             return None
+        cols = self.cluster.columns
+        # HOT:BEGIN(empty-consolidation) — the whole eligibility gate is one
+        # column expression; only the surviving handful re-read live state
+        prov_codes = [c for c, pname in enumerate(cols.prov_names)
+                      if pname in eligible_provs]
+        mask = (cols.occupied & ~cols.marked & cols.initialized
+                & (cols.non_daemon == 0) & ~cols.no_consolidate
+                & (now - cols.created_ts >= self.EMPTY_NODE_PROTECT_S)
+                & np.isin(cols.prov_code, prov_codes))
+        names = sorted(cols.name_of[r] for r in np.nonzero(mask)[0])
+        # HOT:END(empty-consolidation)
         empties = []
-        for name in sorted(self.cluster.nodes):
+        for name in names:
             node = self.cluster.nodes[name]
-            if (node.marked_for_deletion or not node.initialized
-                    or not node.is_empty()
-                    or node.provisioner_name not in eligible_provs
-                    or now - node.created_ts < self.EMPTY_NODE_PROTECT_S
-                    or node.annotations.get(
-                        ANNOTATION_DO_NOT_CONSOLIDATE) == "true"):
+            # live veto re-read (tests poke node.annotations in place)
+            if node.annotations.get(ANNOTATION_DO_NOT_CONSOLIDATE) == "true":
                 continue
             empties.append(node)
         if not empties:

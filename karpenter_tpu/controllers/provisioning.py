@@ -195,7 +195,12 @@ class ProvisioningController:
                 provisioners = self.cloudprovider.constrain_to_template_zones(
                     provisioners, catalog)
                 daemon_overhead = self._daemon_overhead()
-                existing = self.cluster.existing_views()
+                # HOT:BEGIN(provisioning-mask) — columnar snapshot: encode
+                # reads label/taint/resource columns directly, per-node
+                # dataclass views only materialize if the oracle fallback or
+                # an affinity pass touches them (hack/check_hot_loops.py)
+                existing = self.cluster.existing_columns()
+                # HOT:END(provisioning-mask)
                 mask.set_attributes(provisioners=len(provisioners),
                                     types=len(catalog.types),
                                     existing=len(existing))

@@ -1,24 +1,180 @@
-"""In-memory cluster state.
+"""In-memory cluster state, columnar (struct-of-arrays) form.
 
 Parity target: karpenter-core's `state.Cluster` (consumed at
 /root/reference/cmd/controller/main.go:54) — the node/pod/machine snapshot the
 scheduler and deprovisioner read. State is rebuildable from the cluster+cloud
 (reference checkpoint story, SURVEY.md §5.4): nothing here is persisted.
+
+Layout (docs/designs/columnar-state.md): `ClusterState` keeps the dataclass
+surface (`StateNode`, `nodes` dict, `pdbs` list) every existing consumer and
+test speaks, but mirrors it into a `ColumnarCluster` of contiguous numpy
+columns — allocatable/used resource rows, price/created/flag scalars,
+interned per-key label codes, interned taint-set codes — maintained
+*incrementally*: `bind_pod`, `delete_node`, and every watch delta update the
+columns and a per-row change sequence in O(1) amortized, never rescanning.
+The hot consumers (provisioning mask construction, deprovisioning sweeps,
+solver host-encode) read the columns; the dataclasses remain the
+compatibility view.
+
+Synchronization contract: same as the dict-based state this replaces — the
+GIL makes individual column writes atomic, and the operator already
+serializes whole reconcile passes against watch appliers exactly as it did
+before (no new locking is introduced, and no new races either: a torn read
+across columns corresponds to the torn read across `StateNode` fields the
+legacy views had).
 """
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
+import threading
 from typing import Iterable, Optional
+
+import numpy as np
 
 from ..apis import wellknown as wk
 from .pod import PodSpec, Taint
-from .requirements import Requirements
+
+# node-level consolidation veto (kubectl-settable, reference
+# deprovisioning.md); lives here so both the columnar mirror and the oracle
+# read one constant without an import cycle
+ANNOTATION_DO_NOT_CONSOLIDATE = "karpenter.sh/do-not-consolidate"
+
+_CPU = wk.RESOURCE_INDEX[wk.RESOURCE_CPU]
+_MEM = wk.RESOURCE_INDEX[wk.RESOURCE_MEMORY]
+_R = wk.NUM_RESOURCES
+
+# StateNode fields whose writes must reach the columns; everything else goes
+# through the fast plain-setattr path
+_TRACKED_FIELDS = frozenset((
+    "pods", "labels", "annotations", "allocatable", "taints",
+    "provisioner_name", "price", "created_ts", "initialized",
+    "marked_for_deletion", "drifted",
+))
+
+
+class _SyncedDict(dict):
+    """Dict that tells its owning node when mutated in place (tests and the
+    veto surface poke `node.annotations[...]` / `node.labels[...]` directly;
+    the columns must not go stale underneath them)."""
+
+    __slots__ = ("_node", "_field")
+
+    def _sync(self):
+        node = getattr(self, "_node", None)
+        if node is not None:
+            node._dict_changed(self._field)
+
+    def __setitem__(self, k, v):
+        super().__setitem__(k, v)
+        self._sync()
+
+    def __delitem__(self, k):
+        super().__delitem__(k)
+        self._sync()
+
+    def update(self, *a, **kw):
+        super().update(*a, **kw)
+        self._sync()
+
+    def pop(self, *a):
+        out = super().pop(*a)
+        self._sync()
+        return out
+
+    def popitem(self):
+        out = super().popitem()
+        self._sync()
+        return out
+
+    def clear(self):
+        super().clear()
+        self._sync()
+
+    def setdefault(self, k, d=None):
+        out = super().setdefault(k, d)
+        self._sync()
+        return out
+
+
+class _PodList(list):
+    """Pod list that keeps the node's incremental aggregates (used vector,
+    non-daemon count, resident group counts) in sync on every mutation, so
+    `used_vector()` is O(R) instead of O(pods x R)."""
+
+    __slots__ = ("_node",)
+
+    def _delta(self, added, removed):
+        node = getattr(self, "_node", None)
+        if node is not None:
+            node._pods_delta(added, removed)
+
+    def append(self, pod):
+        super().append(pod)
+        self._delta((pod,), ())
+
+    def extend(self, pods):
+        pods = list(pods)
+        super().extend(pods)
+        self._delta(pods, ())
+
+    def insert(self, i, pod):
+        super().insert(i, pod)
+        self._delta((pod,), ())
+
+    def remove(self, pod):
+        super().remove(pod)
+        self._delta((), (pod,))
+
+    def pop(self, i=-1):
+        pod = super().pop(i)
+        self._delta((), (pod,))
+        return pod
+
+    def clear(self):
+        old = list(self)
+        super().clear()
+        self._delta((), old)
+
+    def __setitem__(self, i, value):
+        if isinstance(i, slice):
+            old = self[i]
+            value = list(value)
+            super().__setitem__(i, value)
+            self._delta(value, old)
+        else:
+            old = self[i]
+            super().__setitem__(i, value)
+            self._delta((value,), (old,))
+
+    def __delitem__(self, i):
+        old = self[i] if isinstance(i, slice) else (self[i],)
+        super().__delitem__(i)
+        self._delta((), old)
+
+    def __iadd__(self, pods):
+        self.extend(pods)
+        return self
+
+    def __imul__(self, n):  # pragma: no cover - not used; full recount
+        out = super().__imul__(n)
+        node = getattr(self, "_node", None)
+        if node is not None:
+            node._recount_pods()
+            node._notify_pods_rewritten(())
+        return out
 
 
 @dataclasses.dataclass
 class StateNode:
-    """One launched node plus its resident pods."""
+    """One launched node plus its resident pods.
+
+    Attribute writes and in-place pod/label/annotation mutations are
+    intercepted (`__setattr__`, `_PodList`, `_SyncedDict`) and mirrored into
+    the owning `ClusterState`'s columns; a detached node (never added, or
+    already deleted) behaves exactly like the plain dataclass did.
+    """
 
     name: str
     labels: "dict[str, str]"
@@ -44,18 +200,103 @@ class StateNode:
     deletion_requested_ts: float = 0.0
     drifted: bool = False
 
-    def used_vector(self) -> "list[int]":
-        vec = [0] * wk.NUM_RESOURCES
+    def __setattr__(self, name, value):
+        if name not in _TRACKED_FIELDS:
+            object.__setattr__(self, name, value)
+            return
+        if name == "pods":
+            old = self.__dict__.get("pods")
+            if value is old:
+                return
+            wrapped = _PodList(value if value is not None else ())
+            wrapped._node = self
+            object.__setattr__(self, "pods", wrapped)
+            self._recount_pods()
+            self._notify_pods_rewritten(old if old is not None else ())
+            return
+        if name in ("labels", "annotations") and not (
+                isinstance(value, _SyncedDict)
+                and getattr(value, "_node", None) is self):
+            synced = _SyncedDict(value if value is not None else ())
+            synced._node = self
+            synced._field = name
+            value = synced
+        old = self.__dict__.get(name)
+        object.__setattr__(self, name, value)
+        owner = self.__dict__.get("_owner")
+        if owner is not None:
+            owner._node_field_changed(self, name, old)
+
+    # -- incremental aggregates ---------------------------------------------------
+
+    def _recount_pods(self) -> None:
+        used = [0] * _R
+        non_daemon = 0
+        resident: "dict[tuple, int]" = {}
+        prefs: "set[str]" = set()
         for p in self.pods:
-            for i, v in enumerate(p.resource_vector()):
-                vec[i] += v
-        return vec
+            vec = p.resource_vector()
+            for i in range(_R):
+                used[i] += vec[i]
+            if p.owner_kind != "DaemonSet":
+                non_daemon += 1
+                k = p.group_key()
+                resident[k] = resident.get(k, 0) + 1
+                if p.preferences:
+                    prefs.add(p.name)
+        object.__setattr__(self, "_used", used)
+        object.__setattr__(self, "_non_daemon", non_daemon)
+        object.__setattr__(self, "_resident_counts", resident)
+        object.__setattr__(self, "_pref_names", prefs)
+
+    def _pods_delta(self, added, removed) -> None:
+        used = self._used
+        resident = self._resident_counts
+        non_daemon = self._non_daemon
+        prefs = self._pref_names
+        for sign, pods in ((-1, removed), (1, added)):
+            for p in pods:
+                vec = p.resource_vector()
+                for i in range(_R):
+                    used[i] += sign * vec[i]
+                if p.owner_kind != "DaemonSet":
+                    non_daemon += sign
+                    k = p.group_key()
+                    n = resident.get(k, 0) + sign
+                    if n:
+                        resident[k] = n
+                    else:
+                        resident.pop(k, None)
+                    if p.preferences:
+                        if sign > 0:
+                            prefs.add(p.name)
+                        else:
+                            prefs.discard(p.name)
+        object.__setattr__(self, "_non_daemon", non_daemon)
+        owner = self.__dict__.get("_owner")
+        if owner is not None:
+            owner._node_pods_delta(self, added, removed)
+
+    def _notify_pods_rewritten(self, old_pods) -> None:
+        owner = self.__dict__.get("_owner")
+        if owner is not None:
+            owner._node_pods_replaced(self, old_pods)
+
+    def _dict_changed(self, field: str) -> None:
+        owner = self.__dict__.get("_owner")
+        if owner is not None:
+            owner._node_field_changed(self, field, None)
+
+    # -- views --------------------------------------------------------------------
+
+    def used_vector(self) -> "list[int]":
+        return list(self._used)
 
     def non_daemon_pods(self) -> "list[PodSpec]":
         return [p for p in self.pods if not p.is_daemon()]
 
     def is_empty(self) -> bool:
-        return not self.non_daemon_pods()
+        return self._non_daemon == 0
 
     def to_existing(self):
         """ExistingNode view for the scheduler (used capacity included)."""
@@ -93,18 +334,414 @@ class PodDisruptionBudget:
         return matching_healthy
 
 
+def _selector_matches(selector: "dict[str, str]",
+                      labels: "dict[str, str]") -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class PDBIndex:
+    """PDBs bucketed by one representative selector item.
+
+    A pod matching a PDB must carry EVERY (k, v) of the selector, so bucketing
+    each PDB under a single representative item and probing with the pod's own
+    label items yields a candidate superset; a full `matches()` check on the
+    candidates gives semantics identical to scanning every PDB
+    (tests/test_columnar_state.py parity suite). Empty selectors match
+    everything and live in their own always-probed bucket.
+    """
+
+    def __init__(self, pdbs: "list[PodDisruptionBudget]"):
+        self.pdbs = list(pdbs)
+        self.by_item: "dict[tuple[str, str], list[int]]" = {}
+        self.empty: "list[int]" = []
+        for pos, pdb in enumerate(self.pdbs):
+            if pdb.selector:
+                rep = min(pdb.selector.items())
+                self.by_item.setdefault(rep, []).append(pos)
+            else:
+                self.empty.append(pos)
+
+    def candidate_positions(self, labels: "dict[str, str]") -> "list[int]":
+        out = list(self.empty)
+        if self.by_item:
+            for item in labels.items():
+                hit = self.by_item.get(item)
+                if hit:
+                    out.extend(hit)
+        return out
+
+    def matching_positions(self, labels: "dict[str, str]") -> "list[int]":
+        return [pos for pos in self.candidate_positions(labels)
+                if _selector_matches(self.pdbs[pos].selector, labels)]
+
+
+class _KeyColumn:
+    """One label key's interned value column: codes[row] (-1 = absent) plus a
+    numeric shadow for Gt/Lt folds (nan = absent or non-integer)."""
+
+    __slots__ = ("codes", "num", "vocab")
+
+    def __init__(self, capacity: int):
+        self.codes = np.full(capacity, -1, dtype=np.int32)
+        self.num = np.full(capacity, np.nan, dtype=np.float64)
+        self.vocab: "dict[str, int]" = {}
+
+    def grow(self, capacity: int) -> None:
+        codes = np.full(capacity, -1, dtype=np.int32)
+        codes[: len(self.codes)] = self.codes
+        self.codes = codes
+        num = np.full(capacity, np.nan, dtype=np.float64)
+        num[: len(self.num)] = self.num
+        self.num = num
+
+    def set(self, row: int, value: str) -> None:
+        code = self.vocab.get(value)
+        if code is None:
+            code = self.vocab[value] = len(self.vocab)
+        self.codes[row] = code
+        try:
+            # mirror Requirement.has(): int() parse, not float()
+            self.num[row] = float(int(value))
+        except ValueError:
+            self.num[row] = np.nan
+
+    def clear(self, row: int) -> None:
+        self.codes[row] = -1
+        self.num[row] = np.nan
+
+
+class ColumnarCluster:
+    """Struct-of-arrays mirror of the node set.
+
+    Rows are interned node slots (freelist-recycled); every column is a
+    contiguous numpy array over the same row space, so the reconcile sweeps
+    (emptiness, expiration, consolidation prefilter) and the solver's
+    existing-node encode are single vectorized expressions instead of per-node
+    Python. `changed_seq[row]` carries the cluster-wide mutation sequence of
+    the row's last change — the dirty-set the deprovisioner keys its
+    incremental re-evaluation off.
+    """
+
+    def __init__(self, capacity: int = 64):
+        self.capacity = capacity
+        self.row_of: "dict[str, int]" = {}
+        self.name_of: "list[Optional[str]]" = [None] * capacity
+        self._free = list(range(capacity - 1, -1, -1))
+        self.alloc = np.zeros((capacity, _R), dtype=np.int64)
+        self.used = np.zeros((capacity, _R), dtype=np.int64)
+        self.price = np.zeros(capacity, dtype=np.float64)
+        self.created_ts = np.zeros(capacity, dtype=np.float64)
+        self.occupied = np.zeros(capacity, dtype=bool)
+        self.marked = np.zeros(capacity, dtype=bool)
+        self.initialized = np.zeros(capacity, dtype=bool)
+        self.drifted = np.zeros(capacity, dtype=bool)
+        self.no_consolidate = np.zeros(capacity, dtype=bool)
+        self.non_daemon = np.zeros(capacity, dtype=np.int64)
+        self.prov_code = np.full(capacity, -1, dtype=np.int32)
+        self.taint_code = np.zeros(capacity, dtype=np.int32)
+        self.changed_seq = np.zeros(capacity, dtype=np.int64)
+        self.label_cols: "dict[str, _KeyColumn]" = {}
+        # LABEL_HOSTNAME defaulted to the node name when absent — the
+        # effective_labels() convention the scheduler's label fit uses
+        self.eff_hostname = _KeyColumn(capacity)
+        self.prov_names: "list[str]" = []
+        self._prov_vocab: "dict[str, int]" = {}
+        self.taint_sets: "list[tuple[Taint, ...]]" = [()]
+        self._taint_vocab: "dict[tuple[Taint, ...], int]" = {(): 0}
+
+    def _grow(self) -> None:
+        old = self.capacity
+        cap = self.capacity = old * 2
+        self.name_of.extend([None] * old)
+        self._free.extend(range(cap - 1, old - 1, -1))
+        for attr in ("price", "created_ts", "occupied", "marked",
+                     "initialized", "drifted", "no_consolidate",
+                     "non_daemon", "changed_seq"):
+            col = getattr(self, attr)
+            grown = np.zeros(cap, dtype=col.dtype)
+            grown[:old] = col
+            setattr(self, attr, grown)
+        for attr, fill in (("prov_code", -1), ("taint_code", 0)):
+            col = getattr(self, attr)
+            grown = np.full(cap, fill, dtype=col.dtype)
+            grown[:old] = col
+            setattr(self, attr, grown)
+        for attr in ("alloc", "used"):
+            col = getattr(self, attr)
+            grown = np.zeros((cap, _R), dtype=col.dtype)
+            grown[:old] = col
+            setattr(self, attr, grown)
+        for kc in self.label_cols.values():
+            kc.grow(cap)
+        self.eff_hostname.grow(cap)
+
+    def acquire(self, name: str) -> int:
+        if not self._free:
+            self._grow()
+        row = self._free.pop()
+        self.row_of[name] = row
+        self.name_of[row] = name
+        self.occupied[row] = True
+        return row
+
+    def release(self, name: str, label_keys: "tuple[str, ...]") -> None:
+        row = self.row_of.pop(name)
+        self.name_of[row] = None
+        self.occupied[row] = False
+        self.marked[row] = False
+        self.initialized[row] = False
+        self.drifted[row] = False
+        self.no_consolidate[row] = False
+        self.non_daemon[row] = 0
+        self.alloc[row] = 0
+        self.used[row] = 0
+        self.price[row] = 0.0
+        self.created_ts[row] = 0.0
+        self.prov_code[row] = -1
+        self.taint_code[row] = 0
+        for key in label_keys:
+            kc = self.label_cols.get(key)
+            if kc is not None:
+                kc.clear(row)
+        self.eff_hostname.clear(row)
+        self._free.append(row)
+
+    def label_col(self, key: str) -> _KeyColumn:
+        kc = self.label_cols.get(key)
+        if kc is None:
+            kc = self.label_cols[key] = _KeyColumn(self.capacity)
+        return kc
+
+    def intern_provisioner(self, name: str) -> int:
+        code = self._prov_vocab.get(name)
+        if code is None:
+            code = self._prov_vocab[name] = len(self.prov_names)
+            self.prov_names.append(name)
+        return code
+
+    def intern_taints(self, taints: "tuple[Taint, ...]") -> int:
+        taints = tuple(taints)
+        code = self._taint_vocab.get(taints)
+        if code is None:
+            code = self._taint_vocab[taints] = len(self.taint_sets)
+            self.taint_sets.append(taints)
+        return code
+
+    def set_labels(self, row: int, labels: "dict[str, str]",
+                   old_keys: "tuple[str, ...]", node_name: str) -> "tuple[str, ...]":
+        for key in old_keys:
+            if key not in labels:
+                kc = self.label_cols.get(key)
+                if kc is not None:
+                    kc.clear(row)
+        for key, value in labels.items():
+            self.label_col(key).set(row, value)
+        self.eff_hostname.set(
+            row, labels.get(wk.LABEL_HOSTNAME, node_name))
+        return tuple(labels.keys())
+
+
+class ExistingColumns:
+    """Columnar snapshot of the schedulable (unmarked) nodes, name-sorted.
+
+    Dual personality: a Sequence of `ExistingNode` for every legacy consumer
+    (the oracle scheduler, wire serialization, round-2 carry), AND direct
+    column access (`alloc_rows` / `used_rows` / `label_lookup` /
+    `taint_codes`) for the vectorized encode fast path
+    (models/encode.py fold_node_mask / existing_fit_vector). Views and their
+    `resident` tuples materialize lazily, so a 100k-node snapshot that only
+    feeds the columnar encode never builds a single per-node dataclass.
+
+    Resource rows are gathered eagerly at snapshot time — the same
+    point-in-time copy semantics `existing_views()` had.
+    """
+
+    def __init__(self, cluster: "ClusterState", names: "list[str]",
+                 rows: "np.ndarray"):
+        self.cluster = cluster
+        self.names = names
+        self.rows = rows
+        cols = cluster.columns
+        self._nodes = [cluster.nodes[n] for n in names]
+        if len(rows):
+            self.alloc_rows = cols.alloc[rows]
+            self.used_rows = cols.used[rows]
+            self.taint_codes = cols.taint_code[rows]
+        else:
+            self.alloc_rows = np.zeros((0, _R), dtype=np.int64)
+            self.used_rows = np.zeros((0, _R), dtype=np.int64)
+            self.taint_codes = np.zeros(0, dtype=np.int32)
+        self._views: "dict[int, object]" = {}
+        self._label_gather: "dict[str, Optional[tuple]]" = {}
+        self._fit_cache: "dict[int, tuple]" = {}
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def __iter__(self):
+        for i in range(len(self.names)):
+            yield self._view(i)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self._view(j) for j in range(*i.indices(len(self.names)))]
+        if i < 0:
+            i += len(self.names)
+        if not 0 <= i < len(self.names):
+            raise IndexError(i)
+        return self._view(i)
+
+    def _view(self, i: int):
+        view = self._views.get(i)
+        if view is None:
+            from ..oracle.scheduler import ExistingNode
+
+            node = self._nodes[i]
+            view = self._views[i] = ExistingNode(
+                name=node.name,
+                labels=dict(node.labels),
+                allocatable=[int(x) for x in self.alloc_rows[i]],
+                used=[int(x) for x in self.used_rows[i]],
+                taints=node.taints,
+                resident=_LazyResident(node),
+                resident_counts=dict(node._resident_counts),
+            )
+        return view
+
+    def materialized_view(self, i: int):
+        """Already-built view or None (encode's cap path reads group_counts
+        only off views someone else materialized)."""
+        return self._views.get(i)
+
+    def label_lookup(self, key: str) -> "Optional[tuple]":
+        """(codes[i32 Ne], num[f64 Ne], vocab) for a label key, hostname
+        defaulted to the node name; None when no node ever carried the key."""
+        out = self._label_gather.get(key, False)
+        if out is False:
+            cols = self.cluster.columns
+            kc = (cols.eff_hostname if key == wk.LABEL_HOSTNAME
+                  else cols.label_cols.get(key))
+            if kc is None:
+                out = None
+            elif len(self.rows):
+                out = (kc.codes[self.rows], kc.num[self.rows], kc.vocab)
+            else:
+                out = (np.zeros(0, dtype=np.int32), np.zeros(0), kc.vocab)
+            self._label_gather[key] = out
+        return out
+
+    def taint_set_of(self, code: int) -> "tuple[Taint, ...]":
+        return self.cluster.columns.taint_sets[code]
+
+    def resident_count_vector(self, origin_key) -> "np.ndarray":
+        """Per-node resident count of one pod group (zone-spread / cap
+        accounting) straight from the incremental node aggregates."""
+        return np.fromiter(
+            (n._resident_counts.get(origin_key, 0) for n in self._nodes),
+            dtype=np.int64, count=len(self._nodes))
+
+
+class _LazyResident:
+    """tuple-compatible lazy view of a node's non-daemon pods: iteration,
+    len, indexing, and concatenation all materialize on first touch, so
+    snapshots of pod-heavy nodes cost nothing until an affinity term or the
+    oracle actually reads residents."""
+
+    __slots__ = ("_node", "_tuple")
+
+    def __init__(self, node: "StateNode"):
+        self._node = node
+        self._tuple = None
+
+    def _materialize(self) -> tuple:
+        out = self._tuple
+        if out is None:
+            out = self._tuple = tuple(
+                p for p in self._node.pods if not p.is_daemon())
+        return out
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+    def __len__(self):
+        return len(self._materialize())
+
+    def __getitem__(self, i):
+        return self._materialize()[i]
+
+    def __bool__(self):
+        return bool(self._materialize())
+
+    def __add__(self, other):
+        return self._materialize() + tuple(other)
+
+    def __radd__(self, other):
+        return tuple(other) + self._materialize()
+
+    def __eq__(self, other):
+        if isinstance(other, _LazyResident):
+            other = other._materialize()
+        return self._materialize() == tuple(other)
+
+    def __repr__(self):
+        return f"_LazyResident({self._materialize()!r})"
+
+
 class ClusterState:
-    """Mutable cluster snapshot; the deprovisioner and scheduler read this."""
+    """Mutable cluster snapshot; the deprovisioner and scheduler read this.
+
+    Incremental invariants (chaos-checked, chaos/invariants.py
+    check_columnar_coherence):
+      * columns.used[row] == sum of the row's pod resource vectors
+      * _prov_totals[p] == the full allocatable scan for provisioner p
+      * _pdb_counts[i] == pods matching pdbs[i] across ALL nodes and pods
+      * sorted name list == sorted(nodes)
+    """
 
     def __init__(self):
         self.nodes: "dict[str, StateNode]" = {}
-        self.pdbs: "list[PodDisruptionBudget]" = []
+        self._pdbs: "list[PodDisruptionBudget]" = []
         # instance-id -> node name, maintained incrementally so interruption
         # handling is O(1) per message instead of rebuilding the map per poll
         # (the reference rebuilds per reconcile, controller.go:236-255 — at
         # 15k nodes that rebuild dominates; an indexed view is the same
         # versioned-state trick as the device-resident catalog)
         self._by_instance_id: "dict[str, str]" = {}
+        self.columns = ColumnarCluster()
+        self._sorted_names: "list[str]" = []
+        self._seq = 0
+        self._prov_totals: "dict[str, list[int]]" = {}
+        self._pdb_memo: "Optional[tuple]" = None
+        self._pdb_counts: "list[int]" = []
+        self._pdb_epoch = 0
+        self._healthy_memo: "Optional[dict[str, int]]" = None
+        # node name -> (row seq, pdb epoch, all pods evictable, {pdb pos: n})
+        self._evict_cache: "dict[str, tuple]" = {}
+        # cumulative count of full per-node evictability recomputes — the
+        # soak benchmark's "re-evaluated nodes per cycle" reads deltas of this
+        self.evict_recomputes = 0
+        self._pref_nodes: "dict[str, set[str]]" = {}
+        # mutator lock: the legacy dict-of-dataclasses state tolerated
+        # GIL-interleaved writers (parallel launches call add_node from a
+        # thread pool), but the columnar freelist + array-doubling grow do
+        # not — a thread popping a just-grown row while another still holds
+        # the pre-grow arrays would index out of bounds or lose its write.
+        # Readers stay lockless (same snapshot semantics as before: the
+        # controllers read between joined launch batches).
+        self._lock = threading.RLock()
+
+    # -- pdb surface (list-compatible, index kept coherent) -----------------------
+
+    @property
+    def pdbs(self) -> "list[PodDisruptionBudget]":
+        return self._pdbs
+
+    @pdbs.setter
+    def pdbs(self, value) -> None:
+        self._pdbs = value if isinstance(value, list) else list(value)
+        self._pdb_memo = None
+
+    # -- identity -----------------------------------------------------------------
 
     @staticmethod
     def _instance_id(node: StateNode) -> str:
@@ -112,18 +749,93 @@ class ClusterState:
             return ""
         return node.provider_id.rsplit("/", 1)[-1]
 
+    @property
+    def seq(self) -> int:
+        """Cluster-wide mutation sequence (monotone; per-row last-change
+        values live in columns.changed_seq)."""
+        return self._seq
+
+    def _mark_dirty(self, row: int) -> None:
+        self._seq += 1
+        self.columns.changed_seq[row] = self._seq
+
+    def dirty_since(self, cursor: int) -> "list[str]":
+        """Names of nodes changed after `cursor` (a previously observed
+        `seq`), name-sorted. O(rows) numpy compare, no Python per-node work
+        until the (small) changed set is gathered."""
+        cols = self.columns
+        hits = np.nonzero(cols.occupied & (cols.changed_seq > cursor))[0]
+        return sorted(cols.name_of[r] for r in hits)
+
+    # -- node membership ----------------------------------------------------------
+
     def add_node(self, node: StateNode) -> None:
+        with self._lock:
+            self._add_node_locked(node)
+
+    def _add_node_locked(self, node: StateNode) -> None:
+        if node.name in self.nodes:
+            self.delete_node(node.name)
         self.nodes[node.name] = node
         iid = self._instance_id(node)
         if iid:
             self._by_instance_id[iid] = node.name
+        if "_used" not in node.__dict__:  # detached node built before tracking
+            node._recount_pods()
+        object.__setattr__(node, "_owner", self)
+        cols = self.columns
+        row = cols.acquire(node.name)
+        object.__setattr__(node, "_row", row)
+        cols.alloc[row] = node.allocatable
+        cols.used[row] = node._used
+        cols.price[row] = node.price
+        cols.created_ts[row] = node.created_ts
+        cols.marked[row] = node.marked_for_deletion
+        cols.initialized[row] = node.initialized
+        cols.drifted[row] = node.drifted
+        cols.no_consolidate[row] = (
+            node.annotations.get(ANNOTATION_DO_NOT_CONSOLIDATE) == "true")
+        cols.non_daemon[row] = node._non_daemon
+        cols.prov_code[row] = cols.intern_provisioner(node.provisioner_name)
+        cols.taint_code[row] = cols.intern_taints(node.taints)
+        object.__setattr__(
+            node, "_label_keys",
+            cols.set_labels(row, node.labels, (), node.name))
+        bisect.insort(self._sorted_names, node.name)
+        totals = self._prov_totals.setdefault(node.provisioner_name, [0, 0])
+        totals[0] += node.allocatable[_CPU]
+        totals[1] += node.allocatable[_MEM]
+        if node.pods:
+            self._healthy_swap((), node.pods)
+        if node._pref_names:
+            self._pref_nodes[node.name] = node._pref_names
+        self._mark_dirty(row)
 
     def delete_node(self, name: str) -> Optional[StateNode]:
+        with self._lock:
+            return self._delete_node_locked(name)
+
+    def _delete_node_locked(self, name: str) -> Optional[StateNode]:
         node = self.nodes.pop(name, None)
-        if node is not None:
-            iid = self._instance_id(node)
-            if iid and self._by_instance_id.get(iid) == name:
-                del self._by_instance_id[iid]
+        if node is None:
+            return None
+        iid = self._instance_id(node)
+        if iid and self._by_instance_id.get(iid) == name:
+            del self._by_instance_id[iid]
+        if node.pods:
+            self._healthy_swap(node.pods, ())
+        totals = self._prov_totals.get(node.provisioner_name)
+        if totals is not None:
+            totals[0] -= node.allocatable[_CPU]
+            totals[1] -= node.allocatable[_MEM]
+        self.columns.release(name, node._label_keys)
+        idx = bisect.bisect_left(self._sorted_names, name)
+        if idx < len(self._sorted_names) and self._sorted_names[idx] == name:
+            self._sorted_names.pop(idx)
+        object.__setattr__(node, "_owner", None)
+        self._evict_cache.pop(name, None)
+        self._pref_nodes.pop(name, None)
+        self._seq += 1  # membership change is itself a delta
         return node
 
     def node_by_instance_id(self, instance_id: str) -> Optional[StateNode]:
@@ -134,30 +846,307 @@ class ClusterState:
         self.nodes[node_name].pods.append(
             dataclasses.replace(pod, node_name=node_name))
 
+    # -- mutation fan-in from StateNode hooks -------------------------------------
+
+    def _node_field_changed(self, node: StateNode, field: str, old) -> None:
+        with self._lock:
+            self._node_field_changed_locked(node, field, old)
+
+    def _node_field_changed_locked(self, node: StateNode, field: str,
+                                   old) -> None:
+        cols = self.columns
+        row = node._row
+        if field == "price":
+            cols.price[row] = node.price
+        elif field == "marked_for_deletion":
+            cols.marked[row] = node.marked_for_deletion
+        elif field == "initialized":
+            cols.initialized[row] = node.initialized
+        elif field == "drifted":
+            cols.drifted[row] = node.drifted
+        elif field == "created_ts":
+            cols.created_ts[row] = node.created_ts
+        elif field == "annotations":
+            cols.no_consolidate[row] = (
+                node.annotations.get(ANNOTATION_DO_NOT_CONSOLIDATE) == "true")
+        elif field == "labels":
+            object.__setattr__(
+                node, "_label_keys",
+                cols.set_labels(row, dict(node.labels),
+                                getattr(node, "_label_keys", ()), node.name))
+        elif field == "taints":
+            cols.taint_code[row] = cols.intern_taints(node.taints)
+        elif field == "allocatable":
+            totals = self._prov_totals.setdefault(
+                node.provisioner_name, [0, 0])
+            if old is not None:
+                totals[0] -= old[_CPU]
+                totals[1] -= old[_MEM]
+            totals[0] += node.allocatable[_CPU]
+            totals[1] += node.allocatable[_MEM]
+            cols.alloc[row] = node.allocatable
+        elif field == "provisioner_name":
+            if old is not None:
+                prev = self._prov_totals.get(old)
+                if prev is not None:
+                    prev[0] -= node.allocatable[_CPU]
+                    prev[1] -= node.allocatable[_MEM]
+            totals = self._prov_totals.setdefault(
+                node.provisioner_name, [0, 0])
+            totals[0] += node.allocatable[_CPU]
+            totals[1] += node.allocatable[_MEM]
+            cols.prov_code[row] = cols.intern_provisioner(
+                node.provisioner_name)
+        self._mark_dirty(row)
+
+    def _node_pods_delta(self, node: StateNode, added, removed) -> None:
+        with self._lock:
+            cols = self.columns
+            row = node._row
+            cols.used[row] = node._used
+            cols.non_daemon[row] = node._non_daemon
+            if added or removed:
+                self._healthy_swap(removed, added)
+            if node._pref_names:
+                self._pref_nodes[node.name] = node._pref_names
+            else:
+                self._pref_nodes.pop(node.name, None)
+            self._mark_dirty(row)
+
+    def _node_pods_replaced(self, node: StateNode, old_pods) -> None:
+        with self._lock:
+            cols = self.columns
+            row = node._row
+            cols.used[row] = node._used
+            cols.non_daemon[row] = node._non_daemon
+            if old_pods or node.pods:
+                self._healthy_swap(old_pods, node.pods)
+            if node._pref_names:
+                self._pref_nodes[node.name] = node._pref_names
+            else:
+                self._pref_nodes.pop(node.name, None)
+            self._mark_dirty(row)
+
+    # -- PDB index + incremental healthy counts -----------------------------------
+
+    def _pdb_index(self) -> PDBIndex:
+        pdbs = self._pdbs
+        key = (id(pdbs), len(pdbs))
+        memo = self._pdb_memo
+        if memo is not None and memo[0] == key:
+            return memo[1]
+        index = PDBIndex(pdbs)
+        counts = [0] * len(pdbs)
+        if pdbs:
+            for node in self.nodes.values():
+                for p in node.pods:
+                    labels = dict(p.labels)
+                    for pos in index.matching_positions(labels):
+                        counts[pos] += 1
+        self._pdb_counts = counts
+        self._pdb_memo = (key, index)
+        self._pdb_epoch += 1
+        self._healthy_memo = None
+        self._evict_cache.clear()
+        return index
+
+    def _healthy_swap(self, removed, added) -> None:
+        """Apply a pod-membership delta to the per-PDB healthy counts. When
+        the index itself had to rebuild (stale memo), the rebuild scanned the
+        CURRENT pod lists — which already include this delta — so it must not
+        be applied again (epoch check)."""
+        epoch = self._pdb_epoch
+        index = self._pdb_index()
+        if self._pdb_epoch != epoch or not index.pdbs:
+            return
+        counts = self._pdb_counts
+        for sign, pods in ((-1, removed), (1, added)):
+            for p in pods:
+                labels = dict(p.labels)
+                for pos in index.matching_positions(labels):
+                    counts[pos] += sign
+        self._healthy_memo = None
+
+    def pdb_healthy(self) -> "dict[str, int]":
+        """pdb name -> cluster-wide matching pod count, the `healthy` map the
+        eviction checks consume (duplicate names: last wins, matching the
+        legacy dict comprehension)."""
+        index = self._pdb_index()
+        memo = self._healthy_memo
+        if memo is None:
+            memo = {}
+            for pdb, count in zip(index.pdbs, self._pdb_counts):
+                memo[pdb.name] = count
+            self._healthy_memo = memo
+        return memo
+
+    # -- eviction / consolidation eligibility -------------------------------------
+
+    def _node_evictability(self, node: StateNode) -> "tuple[bool, dict]":
+        """(every non-daemon pod is controller-owned and not do-not-evict,
+        {pdb position: matching pods on this node}), cached until the node's
+        row or the PDB set changes."""
+        index = self._pdb_index()
+        row = node._row
+        seq = int(self.columns.changed_seq[row])
+        cached = self._evict_cache.get(node.name)
+        if cached is not None and cached[0] == seq \
+                and cached[1] == self._pdb_epoch:
+            return cached[2], cached[3]
+        all_ok = True
+        on_node: "dict[int, int]" = {}
+        for p in node.pods:
+            if p.owner_kind == "DaemonSet":
+                continue
+            if p.do_not_evict or not p.owner_kind:
+                all_ok = False
+            labels = dict(p.labels)
+            for pos in index.matching_positions(labels):
+                on_node[pos] = on_node.get(pos, 0) + 1
+        self.evict_recomputes += 1
+        self._evict_cache[node.name] = (seq, self._pdb_epoch, all_ok, on_node)
+        return all_ok, on_node
+
+    def node_consolidation_clear(self, node: StateNode) -> bool:
+        """Every resident non-daemon pod may be evicted: controller-owned,
+        not do-not-evict, and every matching PDB keeps headroom for ALL of
+        this node's matching pods at once. Equivalent to the per-pod
+        `pod_evictable` sweep plus the aggregate headroom check (a matching
+        pod implies on_node >= 1, so allowed < on_node subsumes allowed < 1),
+        but O(1) when cached."""
+        all_ok, on_node = self._node_evictability(node)
+        if not all_ok:
+            return False
+        if on_node:
+            index = self._pdb_index()
+            healthy = self.pdb_healthy()
+            for pos, count in on_node.items():
+                pdb = index.pdbs[pos]
+                if pdb.disruptions_allowed(
+                        healthy.get(pdb.name, 0)) < count:
+                    return False
+        return True
+
+    def pair_pdb_clear(self, a: StateNode, b: StateNode) -> bool:
+        """PDB headroom covers evicting BOTH nodes' matching pods at once."""
+        _, on_a = self._node_evictability(a)
+        _, on_b = self._node_evictability(b)
+        merged = dict(on_a)
+        for pos, count in on_b.items():
+            merged[pos] = merged.get(pos, 0) + count
+        if merged:
+            index = self._pdb_index()
+            healthy = self.pdb_healthy()
+            for pos, count in merged.items():
+                pdb = index.pdbs[pos]
+                if pdb.disruptions_allowed(
+                        healthy.get(pdb.name, 0)) < count:
+                    return False
+        return True
+
+    def consolidation_candidates(self, candidate_filter=None
+                                 ) -> "list[StateNode]":
+        """Name-sorted nodes passing the consolidation eligibility gate:
+        column prefilter (occupied, unmarked, initialized, non-empty, no
+        do-not-consolidate veto) then the cached per-node evictability/PDB
+        check. Matches a full `eligible()` sweep exactly, but the per-node
+        pod scans only rerun for rows dirtied since their last verdict."""
+        cols = self.columns
+        mask = (cols.occupied & ~cols.marked & cols.initialized
+                & (cols.non_daemon > 0) & ~cols.no_consolidate)
+        out = []
+        for name in sorted(cols.name_of[r] for r in np.nonzero(mask)[0]):
+            node = self.nodes[name]
+            # live veto read: tests poke node.annotations[...] in place
+            if node.annotations.get(ANNOTATION_DO_NOT_CONSOLIDATE) == "true":
+                continue
+            if not self.node_consolidation_clear(node):
+                continue
+            if candidate_filter is not None and not candidate_filter(node):
+                continue
+            out.append(node)
+        return out
+
+    # -- column scans (deprovisioning sweeps) -------------------------------------
+
+    def scan_names(self, *, empty: "Optional[bool]" = None,
+                   unmarked: bool = False) -> "list[str]":
+        """Name-sorted node names matching vectorized flag predicates."""
+        cols = self.columns
+        mask = cols.occupied.copy()
+        if unmarked:
+            mask &= ~cols.marked
+        if empty is True:
+            mask &= cols.non_daemon == 0
+        elif empty is False:
+            mask &= cols.non_daemon > 0
+        return sorted(cols.name_of[r] for r in np.nonzero(mask)[0])
+
+    def pref_pod_nodes(self) -> "dict[str, set[str]]":
+        """node name -> names of resident non-daemon pods carrying scheduling
+        preferences (incremental; the consolidation pref-awareness pass reads
+        this instead of sweeping every pod)."""
+        return self._pref_nodes
+
+    # -- snapshots ----------------------------------------------------------------
+
     def existing_views(self, exclude: "set[str]" = frozenset()):
         return [n.to_existing() for name, n in sorted(self.nodes.items())
                 if name not in exclude and not n.marked_for_deletion]
 
+    def existing_columns(self, exclude: "set[str]" = frozenset()
+                         ) -> ExistingColumns:
+        """Columnar twin of existing_views(): same nodes, same order, same
+        point-in-time resource copies — but per-node dataclass views build
+        lazily (see ExistingColumns)."""
+        cols = self.columns
+        if exclude:
+            names = [n for n in self._sorted_names if n not in exclude]
+        else:
+            names = list(self._sorted_names)
+        if names:
+            rows = np.fromiter((cols.row_of[n] for n in names),
+                               dtype=np.int64, count=len(names))
+            keep = ~cols.marked[rows]
+            if not keep.all():
+                names = [n for n, k in zip(names, keep) if k]
+                rows = rows[keep]
+        else:
+            rows = np.zeros(0, dtype=np.int64)
+        return ExistingColumns(self, names, rows)
+
     def total_usage(self, provisioner_name: str) -> "tuple[int, int]":
         """(cpu_millis, memory_bytes) of allocatable committed to a
-        provisioner's nodes (limits enforcement, designs/limits.md)."""
-        cpu = mem = 0
-        for n in self.nodes.values():
-            if n.provisioner_name != provisioner_name:
-                continue
-            cpu += n.allocatable[wk.RESOURCE_INDEX[wk.RESOURCE_CPU]]
-            mem += n.allocatable[wk.RESOURCE_INDEX[wk.RESOURCE_MEMORY]] * 2**20
-        return cpu, mem
+        provisioner's nodes (limits enforcement, designs/limits.md).
+        O(1): running totals maintained on add/delete/reassign, asserted
+        against the full scan in tests and the chaos coherence check."""
+        totals = self._prov_totals.get(provisioner_name)
+        if totals is None:
+            return 0, 0
+        return totals[0], totals[1] * 2**20
 
 
 def pod_evictable(pod: PodSpec, pdbs: "Iterable[PodDisruptionBudget]",
-                  peers_healthy: "dict[str, int]") -> bool:
+                  peers_healthy: "dict[str, int]",
+                  index: "Optional[PDBIndex]" = None) -> bool:
     """Consolidation eligibility per pod (consolidation.md 'Pods that Prevent
-    Consolidation'): controller-owned, not do-not-evict, PDB headroom > 0."""
+    Consolidation'): controller-owned, not do-not-evict, PDB headroom > 0.
+
+    With an index, only the PDBs bucketed under the pod's own label items are
+    probed — identical verdicts (PDBIndex is a candidate superset + full
+    match), O(labels) instead of O(PDBs) per pod."""
     if pod.do_not_evict:
         return False
     if not pod.owner_kind:  # bare pod without controller
         return False
+    if index is not None:
+        labels = dict(pod.labels)
+        for pos in index.matching_positions(labels):
+            pdb = index.pdbs[pos]
+            if pdb.disruptions_allowed(
+                    peers_healthy.get(pdb.name, 0)) < 1:
+                return False
+        return True
     for pdb in pdbs:
         if pdb.matches(pod) and pdb.disruptions_allowed(
                 peers_healthy.get(pdb.name, 0)) < 1:

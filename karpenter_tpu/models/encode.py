@@ -31,6 +31,7 @@ from ..models.instancetype import Catalog
 from ..models.pod import PodGroup, PodSpec
 from ..models.requirements import IncompatibleError, Requirements
 from ..models.pod import tolerates_all
+from .cluster import ExistingColumns
 from ..oracle.scheduler import (
     ExistingNode, Option, feasible_options, prepare_groups, _group_cap_per_node,
     kubelet_is_default, kubelet_overhead_vector, kubelet_pods_cap,
@@ -348,9 +349,20 @@ def encode_problem(
     ex_used = np.zeros((max(len(existing), 1), R), dtype=np.int32)
     ex_feas = np.zeros((max(G, 1), max(len(existing), 1)), dtype=bool)
 
-    for ei, e in enumerate(existing):
-        ex_alloc[ei] = np.minimum(e.allocatable, INT_BIG)
-        ex_used[ei] = np.minimum(e.used, INT_BIG)
+    # HOT:BEGIN(existing-encode) — per-node work here must be vectorized;
+    # hack/check_hot_loops.py bans new per-pod/per-node Python loops
+    ex_cols = existing if isinstance(existing, ExistingColumns) else None
+    if ex_cols is not None:
+        ne = len(ex_cols)
+        ex_alloc[:ne] = np.minimum(ex_cols.alloc_rows, INT_BIG)
+        ex_used[:ne] = np.minimum(ex_cols.used_rows, INT_BIG)
+    else:
+        # hot-loop-ok: legacy dataclass-view compatibility path (round-2
+        # carry lists, oracle callers); the columnar branch above is the
+        # production path
+        for ei, e in enumerate(existing):
+            ex_alloc[ei] = np.minimum(e.allocatable, INT_BIG)
+            ex_used[ei] = np.minimum(e.used, INT_BIG)
 
     prov_overhead, prov_pods_cap = kubelet_arrays(provs, catalog)
 
@@ -409,8 +421,12 @@ def encode_problem(
         group_cap[gi] = cap
         group_feas[gi] = feas
         group_newprov[gi] = newprov
-        for ei, e in enumerate(existing):
-            ex_feas[gi, ei] = _ex_label_fit(e, g.spec)
+        if ex_cols is not None:
+            ex_feas[gi, :len(ex_cols)] = existing_fit_vector(ex_cols, g.spec)
+        else:
+            # hot-loop-ok: legacy dataclass-view compatibility path
+            for ei, e in enumerate(existing):
+                ex_feas[gi, ei] = _ex_label_fit(e, g.spec)
 
     # Per-existing-node REMAINING group caps: hostname spread/anti-affinity
     # counts pods already RESIDENT on the node (the oracle does the same via
@@ -430,9 +446,20 @@ def encode_problem(
             # round (the two-round co-pending affinity driver) — the oracle's
             # cap check is resident_counts[okey] + group_counts[okey]
             okey = g.spec.origin_key()
-            for ei, e in enumerate(existing):
-                ex_cap[gi, ei] = max(0, cap - e.resident_counts.get(okey, 0)
-                                     - e.group_counts.get(okey, 0))
+            if ex_cols is not None:
+                remaining = cap - ex_cols.resident_count_vector(okey)
+                # in-run placements (group_counts) only exist on views some
+                # earlier consumer materialized; a fresh snapshot has none
+                for ei, view in ex_cols._views.items():  # hot-loop-ok: sparse
+                    remaining[ei] -= view.group_counts.get(okey, 0)
+                ex_cap[gi, :len(ex_cols)] = np.maximum(0, remaining)
+            else:
+                # hot-loop-ok: legacy dataclass-view compatibility path
+                for ei, e in enumerate(existing):
+                    ex_cap[gi, ei] = max(0, cap
+                                         - e.resident_counts.get(okey, 0)
+                                         - e.group_counts.get(okey, 0))
+    # HOT:END(existing-encode)
 
     if n_slots is None:
         # Tight upper bound on claim slots: group g opens at most
@@ -676,3 +703,59 @@ def _ex_label_fit(e: ExistingNode, spec: PodSpec) -> bool:
 
     return (tolerates_all(spec.tolerations, e.taints)
             and spec.requirements.matches_labels(e.effective_labels()))
+
+
+def fold_node_mask(reqs: Requirements, lookup, n: int) -> np.ndarray:
+    """Requirements -> bool mask over node rows. Vectorized equivalent of
+    `reqs.matches_labels(labels_of_row)` per row (the node-axis twin of
+    fold_option_mask — no provisioner overlay; whether hostname defaults to
+    the node name is the caller's choice of `lookup`).
+
+    `lookup(key)` returns (codes [i32 n], num [f64 n], vocab) with -1/nan for
+    rows lacking the key, or None when no row anywhere carries the key.
+    Checked against matches_labels property-test-style in
+    tests/test_columnar_state.py."""
+    mask = np.ones(n, dtype=bool)
+    for req in reqs:
+        col = lookup(req.key)
+        if col is None:
+            if not req.allows_absent():
+                return np.zeros(n, dtype=bool)
+            continue
+        codes, num, vocab = col
+        present = codes >= 0
+        if req.forbid_key:
+            mask &= ~present
+            continue
+        value_codes = [vocab[v] for v in req.values if v in vocab]
+        hits = np.isin(codes, value_codes) if value_codes \
+            else np.zeros(n, dtype=bool)
+        ok_present = ~hits if req.complement else hits
+        if req.gt is not None or req.lt is not None:
+            with np.errstate(invalid="ignore"):
+                if req.gt is not None:
+                    ok_present &= num > req.gt
+                if req.lt is not None:
+                    ok_present &= num < req.lt
+        mask &= np.where(present, ok_present, req.allows_absent())
+    return mask
+
+
+def existing_fit_vector(ex: "ExistingColumns", spec: PodSpec) -> np.ndarray:
+    """Columnar `_ex_label_fit`: one [Ne] bool vector per group spec, folded
+    over the snapshot's label-code columns (hostname defaulted to node name,
+    effective_labels() semantics) and the interned taint-set codes — each
+    distinct taint set is checked against the tolerations once, not per node.
+    Memoized per (snapshot, spec)."""
+    cached = ex._fit_cache.get(id(spec))
+    if cached is not None and cached[0] is spec:
+        return cached[1]
+    n = len(ex)
+    mask = fold_node_mask(spec.requirements, ex.label_lookup, n)
+    codes = ex.taint_codes
+    for code in np.unique(codes):
+        taints = ex.taint_set_of(int(code))
+        if taints and not tolerates_all(spec.tolerations, taints):
+            mask = mask & (codes != code)
+    ex._fit_cache[id(spec)] = (spec, mask)
+    return mask

@@ -33,7 +33,8 @@ import numpy as np
 from ..apis import wellknown as wk
 from ..apis.provisioner import Provisioner
 from ..models.cluster import ClusterState, StateNode
-from ..models.encode import INT_BIG, OptionGrid, build_grid, encode_group
+from ..models.encode import (INT_BIG, OptionGrid, build_grid, encode_group,
+                             fold_node_mask)
 from ..models.instancetype import Catalog
 from ..models.pod import tolerates_all
 from ..oracle.consolidation import (
@@ -131,21 +132,20 @@ def encode_consolidation(
     all_nodes = sorted(cluster.nodes)
     node_index = {n: i for i, n in enumerate(all_nodes)}
     Ne = len(all_nodes)
-    ex_alloc = np.zeros((Ne, R), dtype=np.int32)
-    ex_used = np.zeros((Ne, R), dtype=np.int32)
-    for n, i in node_index.items():
-        sn = cluster.nodes[n]
-        ex_alloc[i] = np.minimum(sn.allocatable, INT_BIG)
-        ex_used[i] = np.minimum(sn.used_vector(), INT_BIG)
+    # HOT:BEGIN(consolidation-encode) — existing rows gather straight off
+    # the cluster's columns (int64 clamped to the kernel's i32 domain);
+    # per-node dataclass views never materialize on this path unless an
+    # affinity/topology pre-pass touches them
+    ccols = cluster.columns
+    rows = np.fromiter((ccols.row_of[n] for n in all_nodes),
+                       dtype=np.int64, count=Ne)
+    ex_alloc = np.minimum(ccols.alloc[rows], INT_BIG).astype(np.int32)
+    ex_used = np.minimum(ccols.used[rows], INT_BIG).astype(np.int32)
+    # HOT:END(consolidation-encode)
 
     C = len(candidates)
     per_cand = []
     gmax = 1
-    # Existing views are built ONCE and shared across candidate lanes:
-    # the per-candidate pre-passes only READ them (resident counts, zones),
-    # and rebuilding per lane was the dominant encode cost at 500 candidates
-    # (O(C x Ne x pods) view construction, profiled round 3).
-    all_views = cluster.existing_views()
     # cheaper-option mask + zone set depend ONLY on the set's total price —
     # homogeneous clusters (and especially the O(n^2) pair sweep) repeat a
     # handful of distinct prices across thousands of lanes, so both are
@@ -167,12 +167,14 @@ def encode_consolidation(
         pods = [p for n in cand for p in n.non_daemon_pods()]
         # domain-population-aware split must see the surviving nodes (the
         # oracle path passes cluster.existing_views(exclude=cand) the same
-        # way, oracle/consolidation.py:107)
+        # way, oracle/consolidation.py:107); the columnar snapshot keeps
+        # per-node views lazy — prepare_groups only iterates them when the
+        # pod set carries affinity/topology terms
         cand_names = {n.name for n in cand}
-        survivors = [v for v in all_views if v.name not in cand_names]
+        survivors = cluster.existing_columns(exclude=cand_names)
         groups = prepare_groups(pods, zones_c, survivors)
         gmax = max(gmax, len(groups))
-        per_cand.append((cand, total_price, groups, survivors))
+        per_cand.append((cand, total_price, groups))
 
     Gb = gmax
     group_vec = np.zeros((C, Gb, R), dtype=np.int32)
@@ -192,34 +194,61 @@ def encode_consolidation(
     # ONE boolean vector per distinct group spec (token-keyed): the same
     # spec recurs across most candidate lanes in a homogeneous cluster, and
     # per-(lane, node) scalar checks were the pair-sweep encode hotspot
-    # (125k calls at 64 nodes, profiled round 4)
-    alive = np.ones((Ne,), dtype=bool)
-    for name, i in node_index.items():
-        if cluster.nodes[name].marked_for_deletion:
-            alive[i] = False
+    # (125k calls at 64 nodes, profiled round 4). Now folded over the label
+    # columns (RAW labels — this path tests matches_labels(node.labels)
+    # with no hostname defaulting, unlike the scheduler's effective view)
+    # with each distinct interned taint set checked once, not per node.
+    # HOT:BEGIN(consolidation-fit)
+    alive = ~ccols.marked[rows]
+    taint_codes = ccols.taint_code[rows]
+    gather_cache: "dict[str, object]" = {}
+
+    def _label_lookup(key):
+        hit = gather_cache.get(key, False)
+        if hit is not False:
+            return hit
+        kc = ccols.label_cols.get(key)
+        out = None if kc is None else (kc.codes[rows], kc.num[rows], kc.vocab)
+        gather_cache[key] = out
+        return out
+
     fitvec_cache: "dict[int, np.ndarray]" = {}
 
     def fit_vector(spec) -> "np.ndarray":
         tok = spec.group_token()
         vec = fitvec_cache.get(tok)
         if vec is None:
-            vec = np.fromiter(
-                (tolerates_all(spec.tolerations, cluster.nodes[n].taints)
-                 and spec.requirements.matches_labels(cluster.nodes[n].labels)
-                 for n in all_nodes), dtype=bool, count=Ne)
+            vec = fold_node_mask(spec.requirements, _label_lookup, Ne)
+            for code in np.unique(taint_codes):
+                taints = ccols.taint_sets[int(code)]
+                if taints and not tolerates_all(spec.tolerations, taints):
+                    vec = vec & (taint_codes != code)
             vec &= alive
             fitvec_cache[tok] = vec
         return vec
+    # HOT:END(consolidation-fit)
 
     from ..models.encode import kubelet_arrays
 
     prov_overhead, prov_pods_cap = kubelet_arrays(provs, catalog)
     feas_cache: "dict[tuple, tuple]" = {}
     ex_cap_arr = None  # [C, Gb, Ne] remaining caps; built on first capped group
-    for ci, (cand, total_price, groups, survivors) in enumerate(per_cand):
+    # per-origin-key resident counts over ALL nodes, memoized across lanes
+    # (the incremental StateNode aggregates make this O(Ne) with no pod scan)
+    rc_cache: "dict[object, np.ndarray]" = {}
+
+    def resident_vec(okey) -> "np.ndarray":
+        v = rc_cache.get(okey)
+        if v is None:
+            v = np.fromiter(
+                (cluster.nodes[n]._resident_counts.get(okey, 0)
+                 for n in all_nodes), dtype=np.int32, count=Ne)
+            rc_cache[okey] = v
+        return v
+
+    for ci, (cand, total_price, groups) in enumerate(per_cand):
         cheaper_opt = by_price[total_price][0]
         member_idx = [node_index[n.name] for n in cand]
-        res_by_name = {e.name: e.resident_counts for e in survivors}
         first_by_origin: "dict[object, int]" = {}
         for gi, g in enumerate(groups):
             group_origin[ci, gi] = first_by_origin.setdefault(
@@ -249,16 +278,15 @@ def encode_consolidation(
             if cap < int(INT_BIG):
                 # hostname spread/anti-affinity counts pods RESIDENT on the
                 # surviving nodes (mirrors encode_problem's ex_cap; the
-                # in-run group_counts term is zero here — survivor views are
-                # built fresh from cluster state each sweep)
+                # in-run group_counts term is zero here — resident counts
+                # come fresh off the node aggregates each sweep). Candidate
+                # members keep the raw cap: their pods are the ones being
+                # moved, and ex_feas already bars landing back on the set
                 if ex_cap_arr is None:
                     ex_cap_arr = np.full((C, Gb, Ne), INT_BIG, dtype=np.int32)
                 okey = g.spec.origin_key()
-                ex_cap_arr[ci, gi, :] = cap
-                for name, i in node_index.items():
-                    rc = res_by_name.get(name)
-                    if rc:
-                        ex_cap_arr[ci, gi, i] = max(0, cap - rc.get(okey, 0))
+                ex_cap_arr[ci, gi, :] = np.maximum(0, cap - resident_vec(okey))
+                ex_cap_arr[ci, gi, member_idx] = cap
 
     feas_table = np.zeros((1 + len(feas_rows), Pv, T, S), dtype=bool)
     for i, feas in enumerate(feas_rows):
@@ -511,6 +539,7 @@ def run_consolidation(
     max_pair_candidates: int = MAX_PAIR_CANDIDATES,
     candidate_filter=None,
     mesh=None,
+    cand_nodes: "Optional[Sequence[StateNode]]" = None,
 ) -> Optional[ConsolidationAction]:
     """Batched equivalent of the oracle search (bit-parity tested).
 
@@ -519,14 +548,18 @@ def run_consolidation(
     shadows a smaller one. Pair lanes and single lanes ride ONE combined
     dispatch (one device round trip — the unit a tunneled link charges);
     mechanism precedence is applied to the decoded verdicts instead of
-    sequencing two dispatches."""
+    sequencing two dispatches. `cand_nodes` reuses an eligibility sweep
+    already done (the controller's dirty-driven candidate list)."""
     global last_timings
     t0 = _time.perf_counter()
     provs_sorted = sorted(provisioners, key=lambda p: (-p.weight, p.name))
-    cand_nodes = [cluster.nodes[name] for name in sorted(cluster.nodes)
-                  if eligible(cluster.nodes[name], cluster)
-                  and (candidate_filter is None
-                       or candidate_filter(cluster.nodes[name]))]
+    if cand_nodes is None:
+        cand_nodes = [cluster.nodes[name] for name in sorted(cluster.nodes)
+                      if eligible(cluster.nodes[name], cluster)
+                      and (candidate_filter is None
+                           or candidate_filter(cluster.nodes[name]))]
+    else:
+        cand_nodes = list(cand_nodes)
     if not cand_nodes:
         return None
     sets: "list[tuple]" = [(n,) for n in cand_nodes]

@@ -21,7 +21,8 @@ from typing import Optional, Sequence
 
 from ..apis import wellknown as wk
 from ..apis.provisioner import Provisioner
-from ..models.cluster import ClusterState, StateNode, pod_evictable
+from ..models.cluster import (ANNOTATION_DO_NOT_CONSOLIDATE, ClusterState,
+                              StateNode, pod_evictable)
 from ..models.instancetype import Catalog
 from ..oracle.scheduler import Scheduler
 
@@ -69,9 +70,6 @@ def disruption_cost(node: StateNode, prov: Optional[Provisioner], now: float) ->
     return cost * lifetime_factor(node, prov, now)
 
 
-ANNOTATION_DO_NOT_CONSOLIDATE = "karpenter.sh/do-not-consolidate"
-
-
 def eligible(node: StateNode, cluster: ClusterState) -> bool:
     if node.marked_for_deletion or not node.initialized:
         return False
@@ -79,6 +77,11 @@ def eligible(node: StateNode, cluster: ClusterState) -> bool:
         return False  # node-level veto (reference deprovisioning.md)
     if node.is_empty():
         return False  # emptiness path handles these (cheaper than simulation)
+    if cluster.nodes.get(node.name) is node:
+        # cluster-owned node: the cached columnar verdict, recomputed only
+        # when the node's row or the PDB set changed since the last call
+        # (parity with the scalar sweep below is property-tested)
+        return cluster.node_consolidation_clear(node)
     healthy = {
         pdb.name: sum(1 for n in cluster.nodes.values() for p in n.pods if pdb.matches(p))
         for pdb in cluster.pdbs
@@ -174,16 +177,18 @@ def find_consolidation(
     daemon_overhead: Optional[Sequence[int]] = None,
     now: float = 0.0,
     candidate_filter=None,
+    nodes: "Optional[Sequence[StateNode]]" = None,
 ) -> Optional[ConsolidationAction]:
     """Best single-node action, min disruption cost first (consolidation.md
     'Selecting Nodes for Consolidation'). `candidate_filter` restricts which
     nodes may be candidates (e.g. consolidation-enabled provisioners only);
-    all nodes still host rescheduled pods."""
+    all nodes still host rescheduled pods. Pass `nodes` to reuse an
+    eligibility sweep already done (the controller's dirty-driven list)."""
+    if nodes is None:
+        nodes = (cluster.nodes[name] for name in sorted(cluster.nodes)
+                 if eligible(cluster.nodes[name], cluster))
     actions = []
-    for name in sorted(cluster.nodes):
-        node = cluster.nodes[name]
-        if not eligible(node, cluster):
-            continue
+    for node in nodes:
         if candidate_filter is not None and not candidate_filter(node):
             continue
         act = evaluate_candidate(node, cluster, catalog, provisioners,
@@ -204,6 +209,10 @@ def _pair_pdb_safe(a: StateNode, b: StateNode, cluster: ClusterState) -> bool:
     the union at once, so the combined set must fit the budget too."""
     if not cluster.pdbs:
         return True
+    if cluster.nodes.get(a.name) is a and cluster.nodes.get(b.name) is b:
+        # cluster-owned pair: merged per-PDB counts off the cached per-node
+        # evictability maps (same aggregate check, no full pod sweep)
+        return cluster.pair_pdb_clear(a, b)
     healthy = {
         pdb.name: sum(1 for n in cluster.nodes.values()
                       for p in n.pods if pdb.matches(p))
@@ -250,6 +259,7 @@ def find_multi_consolidation(
     now: float = 0.0,
     max_candidates: int = MAX_PAIR_CANDIDATES,
     candidate_filter=None,
+    nodes: "Optional[Sequence[StateNode]]" = None,
 ) -> Optional[ConsolidationAction]:
     """Best two-node action — mechanism 2 of consolidation, which the
     reference runs BEFORE the single-node search (deprovisioning.md:74-77
@@ -259,6 +269,7 @@ def find_multi_consolidation(
     controller's oracle fallback uses 8 -> <=28 simulations)."""
     actions = []
     for pair in candidate_pairs(cluster, provisioners, now, max_candidates,
+                                nodes=nodes,
                                 candidate_filter=candidate_filter):
         act = evaluate_candidate_set(pair, cluster, catalog, provisioners,
                                      daemon_overhead, now)

@@ -235,6 +235,10 @@ class ExistingNode:
     resident_counts: "dict[object, int]" = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
+        if self.resident_counts:
+            # pre-seeded (columnar snapshot: counts come off the node's
+            # incremental aggregates, so `resident` can stay lazy)
+            return
         # seed resident counts (same group_key space as the pending batch:
         # identical specs hash identically; residents are never zone-split)
         for p in self.resident:

@@ -1,0 +1,370 @@
+"""Columnar cluster state: incremental-aggregate and parity properties.
+
+The struct-of-arrays refactor (docs/designs/columnar-state.md) trades full
+rescans for incremental column updates; every test here pins an incremental
+value to the from-scratch computation it replaced:
+
+  * StateNode.used_vector() == sum of pod resource vectors (satellite 1)
+  * ClusterState.total_usage() == the full allocatable scan (satellite 2)
+  * PDBIndex-accelerated pod_evictable == the every-PDB sweep (satellite 3)
+  * existing_columns() == existing_views() as scheduler input, bit-identical
+    encode arrays, across randomized add/bind/delete/mark sequences, and a
+    dirtied node always reappears in dirty_since() (satellite 4)
+  * fold_node_mask == Requirements.matches_labels row-by-row
+
+Property-style tests use seeded random.Random loops (hypothesis is not in
+the image).
+"""
+
+import random
+
+import numpy as np
+
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.chaos.invariants import check_columnar_coherence
+from karpenter_tpu.models.cluster import (ClusterState, PDBIndex,
+                                          PodDisruptionBudget, StateNode,
+                                          pod_evictable)
+from karpenter_tpu.models.encode import encode_problem, fold_node_mask
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import Taint, make_pod
+from karpenter_tpu.models.requirements import (OP_DOES_NOT_EXIST, OP_EXISTS,
+                                               OP_GT, OP_IN, OP_LT, OP_NOT_IN,
+                                               IncompatibleError, Requirement,
+                                               Requirements)
+from karpenter_tpu.oracle.consolidation import eligible
+
+_CPU = wk.RESOURCE_INDEX[wk.RESOURCE_CPU]
+_MEM = wk.RESOURCE_INDEX[wk.RESOURCE_MEMORY]
+
+
+class _FakeOp:
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+
+def _alloc(cpu_m=4000, mem_mi=16384, pods=110):
+    return wk.capacity_vector({wk.RESOURCE_CPU: cpu_m,
+                               wk.RESOURCE_MEMORY: mem_mi * 2**20,
+                               wk.RESOURCE_PODS: pods})
+
+
+def _node(name, zone="z-a", prov="default", taints=(), extra_labels=None,
+          **kw):
+    labels = {wk.LABEL_ZONE: zone, wk.LABEL_CAPACITY_TYPE: "on-demand",
+              wk.LABEL_INSTANCE_TYPE: "m.large"}
+    labels.update(extra_labels or {})
+    return StateNode(name=name, labels=labels, allocatable=_alloc(),
+                     provisioner_name=prov, taints=tuple(taints), **kw)
+
+
+def _rand_pod(rng, name, node_name=None):
+    return make_pod(
+        name, cpu=f"{rng.randint(1, 8) * 100}m",
+        memory=f"{rng.randint(1, 16) * 128}Mi",
+        node_name=node_name,
+        owner_kind=rng.choice(["ReplicaSet", "ReplicaSet", "DaemonSet", ""]),
+        do_not_evict=rng.random() < 0.1,
+        labels=tuple(sorted({f"k{rng.randint(0, 2)}": f"v{rng.randint(0, 2)}"
+                             for _ in range(rng.randint(0, 3))}.items())),
+    )
+
+
+def _assert_coherent(cluster):
+    violations = check_columnar_coherence(_FakeOp(cluster))
+    assert not violations, [v.message for v in violations]
+
+
+# -- satellite 1: incremental used vector --------------------------------------
+
+def test_used_vector_incremental_matches_scan():
+    rng = random.Random(7)
+    cluster = ClusterState()
+    node = _node("n0")
+    cluster.add_node(node)
+    k = 0
+    for step in range(300):
+        op = rng.random()
+        if op < 0.5 or not node.pods:
+            cluster.bind_pod("n0", _rand_pod(rng, f"p{k}"))
+            k += 1
+        elif op < 0.8:
+            node.pods.pop(rng.randrange(len(node.pods)))
+        elif op < 0.9:
+            node.pods.remove(rng.choice(list(node.pods)))
+        else:
+            # wholesale reassignment (the watch-refresh path)
+            node.pods = list(node.pods)[: rng.randrange(len(node.pods) + 1)]
+        fresh = [0] * wk.NUM_RESOURCES
+        for p in node.pods:
+            for i, v in enumerate(p.resource_vector()):
+                fresh[i] += v
+        assert node.used_vector() == fresh, f"step {step}"
+    _assert_coherent(cluster)
+
+
+def test_used_vector_detached_node_still_works():
+    node = _node("loose")
+    node.pods.append(make_pod("a", cpu="500m", memory="1Gi"))
+    assert node.used_vector()[_CPU] == 500
+    node.pods.clear()
+    assert node.used_vector() == [0] * wk.NUM_RESOURCES
+
+
+# -- satellite 2: per-provisioner running totals -------------------------------
+
+def test_total_usage_matches_full_scan():
+    rng = random.Random(11)
+    cluster = ClusterState()
+    provs = ["p-a", "p-b", "p-c"]
+    for step in range(200):
+        op = rng.random()
+        names = sorted(cluster.nodes)
+        if op < 0.5 or not names:
+            cluster.add_node(_node(f"n{step}", prov=rng.choice(provs)))
+        elif op < 0.75:
+            cluster.delete_node(rng.choice(names))
+        else:  # reassignment moves the totals between provisioners
+            cluster.nodes[rng.choice(names)].provisioner_name = \
+                rng.choice(provs)
+        for pname in provs:
+            cpu = mem = 0
+            for n in cluster.nodes.values():
+                if n.provisioner_name == pname:
+                    cpu += n.allocatable[_CPU]
+                    mem += n.allocatable[_MEM] * 2**20
+            assert cluster.total_usage(pname) == (cpu, mem), f"step {step}"
+    _assert_coherent(cluster)
+
+
+# -- satellite 3: PDB selector-key index ---------------------------------------
+
+def _rand_pdbs(rng):
+    pdbs = []
+    for i in range(rng.randint(0, 8)):
+        selector = {f"k{rng.randint(0, 2)}": f"v{rng.randint(0, 2)}"
+                    for _ in range(rng.randint(0, 2))}
+        if rng.random() < 0.5:
+            pdbs.append(PodDisruptionBudget(
+                f"pdb{i}", selector, min_available=rng.randint(0, 4)))
+        else:
+            pdbs.append(PodDisruptionBudget(
+                f"pdb{i}", selector, max_unavailable=rng.randint(0, 3)))
+    return pdbs
+
+
+def test_pod_evictable_index_parity_random():
+    rng = random.Random(13)
+    for trial in range(40):
+        pdbs = _rand_pdbs(rng)
+        index = PDBIndex(pdbs)
+        pods = [_rand_pod(rng, f"p{i}") for i in range(30)]
+        healthy = {
+            pdb.name: sum(1 for p in pods if pdb.matches(p)) for pdb in pdbs}
+        for p in pods:
+            fast = pod_evictable(p, pdbs, healthy, index=index)
+            slow = pod_evictable(p, pdbs, healthy)
+            assert fast == slow, (trial, p.name, p.labels)
+
+
+def test_eligible_columnar_matches_scalar_sweep():
+    """eligible()'s cached columnar verdict vs the same function forced down
+    the scalar path (a detached twin node not owned by the cluster)."""
+    rng = random.Random(17)
+    for trial in range(25):
+        cluster = ClusterState()
+        cluster.pdbs.extend(_rand_pdbs(rng))
+        twins = []
+        for i in range(8):
+            pods = [_rand_pod(rng, f"t{trial}-{i}-{j}", node_name=f"n{i}")
+                    for j in range(rng.randint(0, 4))]
+            marked = rng.random() < 0.15
+            annotations = (
+                {"karpenter.sh/do-not-consolidate": "true"}
+                if rng.random() < 0.15 else {})
+            cluster.add_node(_node(
+                f"n{i}", pods=[*pods], marked_for_deletion=marked,
+                initialized=rng.random() < 0.9, annotations=dict(annotations)))
+            twins.append(_node(
+                f"n{i}", pods=[*pods], marked_for_deletion=marked,
+                initialized=cluster.nodes[f"n{i}"].initialized,
+                annotations=dict(annotations)))
+        for i in range(8):
+            col = eligible(cluster.nodes[f"n{i}"], cluster)
+            scalar = eligible(twins[i], cluster)
+            assert col == scalar, (trial, f"n{i}")
+        # the verdict cache must not survive a relevant delta
+        names = [n for n in sorted(cluster.nodes)
+                 if eligible(cluster.nodes[n], cluster)]
+        if names:
+            victim = cluster.nodes[names[0]]
+            victim.pods.append(make_pod(
+                f"bare{trial}", cpu="100m", node_name=victim.name,
+                owner_kind=""))  # bare pod: never evictable
+            assert not eligible(victim, cluster)
+
+
+# -- satellite 4: columnar <-> dataclass parity + dirty set --------------------
+
+def _random_mutation(rng, cluster, step):
+    names = sorted(cluster.nodes)
+    op = rng.random()
+    if op < 0.30 or not names:
+        zone = rng.choice(["z-a", "z-b"])
+        taints = ((Taint("dedicated", "gpu", "NoSchedule"),)
+                  if rng.random() < 0.2 else ())
+        extra = {"team": f"t{rng.randint(0, 3)}"} if rng.random() < 0.5 else {}
+        cluster.add_node(_node(f"n{step:03d}", zone=zone, taints=taints,
+                               extra_labels=extra))
+    elif op < 0.55:
+        target = rng.choice(names)
+        cluster.bind_pod(target, _rand_pod(rng, f"b{step}", node_name=target))
+    elif op < 0.70:
+        node = cluster.nodes[rng.choice(names)]
+        if node.pods:
+            node.pods.pop(rng.randrange(len(node.pods)))
+    elif op < 0.80:
+        cluster.delete_node(rng.choice(names))
+    elif op < 0.90:
+        node = cluster.nodes[rng.choice(names)]
+        node.marked_for_deletion = not node.marked_for_deletion
+    else:
+        node = cluster.nodes[rng.choice(names)]
+        node.labels["team"] = f"t{rng.randint(0, 3)}"
+
+
+def test_columnar_views_parity_random_sequences():
+    catalog = Catalog(types=[
+        make_instance_type("m.large", cpu=4, memory="16Gi", od_price=0.20,
+                           spot_price=0.07),
+        make_instance_type("m.xlarge", cpu=16, memory="64Gi", od_price=0.80),
+    ])
+    prov = Provisioner(name="default", requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+    prov.set_defaults()
+    rng = random.Random(23)
+    cluster = ClusterState()
+    pending = [make_pod(f"p-{k}", cpu="500m", memory="1Gi") for k in range(12)]
+    for step in range(120):
+        _random_mutation(rng, cluster, step)
+        if step % 20 != 19:
+            continue
+        views = cluster.existing_views()
+        cols = cluster.existing_columns()
+        assert [e.name for e in views] == list(cols.names)
+        for v, name in zip(views, cols.names):
+            c = cols[list(cols.names).index(name)]
+            assert v.name == c.name
+            assert list(v.allocatable) == list(c.allocatable)
+            assert list(v.used) == list(c.used)
+            assert dict(v.labels) == dict(c.labels)
+            assert tuple(v.taints) == tuple(c.taints)
+            assert v.resident_counts == c.resident_counts
+        a = encode_problem(catalog, [prov], pending, existing=views)
+        b = encode_problem(catalog, [prov], pending,
+                           existing=cluster.existing_columns())
+        for f in ("group_vec", "group_count", "group_cap", "group_feas",
+                  "group_newprov", "ex_alloc", "ex_used", "ex_feas",
+                  "daemon_overhead", "ex_cap", "group_origin"):
+            x, y = getattr(a, f, None), getattr(b, f, None)
+            if x is None and y is None:
+                continue
+            assert x is not None and y is not None, f
+            assert np.array_equal(np.asarray(x), np.asarray(y)), f
+        assert a.n_slots == b.n_slots
+        _assert_coherent(cluster)
+
+
+def test_dirty_set_never_skips_a_delta():
+    """Every relevant delta to a node lands it in dirty_since(cursor): a
+    consumer that re-evaluates only dirty nodes can never miss a change."""
+    rng = random.Random(29)
+    cluster = ClusterState()
+    for i in range(10):
+        cluster.add_node(_node(f"n{i}"))
+    for step in range(150):
+        cursor = cluster.seq
+        names = sorted(cluster.nodes)
+        target = rng.choice(names)
+        node = cluster.nodes[target]
+        op = rng.random()
+        if op < 0.25:
+            cluster.bind_pod(target, _rand_pod(rng, f"d{step}",
+                                               node_name=target))
+        elif op < 0.40 and node.pods:
+            node.pods.pop()
+        elif op < 0.55:
+            node.marked_for_deletion = not node.marked_for_deletion
+        elif op < 0.70:
+            node.price = rng.random()
+        elif op < 0.85:
+            node.annotations["karpenter.sh/do-not-consolidate"] = \
+                rng.choice(["true", "false"])
+        else:
+            node.initialized = not node.initialized
+        assert target in cluster.dirty_since(cursor), f"step {step}"
+        # unrelated nodes stay clean unless they actually changed
+        assert set(cluster.dirty_since(cluster.seq)) == set()
+
+
+def test_dirty_cursor_survives_node_churn():
+    cluster = ClusterState()
+    cluster.add_node(_node("a"))
+    cursor = cluster.seq
+    cluster.add_node(_node("b"))
+    cluster.bind_pod("a", make_pod("x", cpu="100m", node_name="a"))
+    assert set(cluster.dirty_since(cursor)) == {"a", "b"}
+    cluster.delete_node("b")
+    assert "a" in cluster.dirty_since(cursor)
+
+
+# -- fold_node_mask vs matches_labels ------------------------------------------
+
+def _rand_requirement(rng):
+    key = rng.choice(["k0", "k1", "k2", "num"])
+    op = rng.choice([OP_IN, OP_NOT_IN, OP_EXISTS, OP_DOES_NOT_EXIST,
+                     OP_GT, OP_LT])
+    if op in (OP_GT, OP_LT):
+        return Requirement.create("num", op, [str(rng.randint(0, 9))])
+    values = [f"v{rng.randint(0, 3)}" for _ in range(rng.randint(1, 3))]
+    return Requirement.create(key, op, values)
+
+
+def test_fold_node_mask_matches_scalar_matches_labels():
+    rng = random.Random(31)
+    for trial in range(60):
+        label_sets = []
+        for i in range(15):
+            labels = {}
+            for key in ("k0", "k1", "k2"):
+                if rng.random() < 0.6:
+                    labels[key] = f"v{rng.randint(0, 3)}"
+            if rng.random() < 0.5:
+                labels["num"] = str(rng.randint(0, 9))
+            label_sets.append(labels)
+        cluster = ClusterState()
+        for i, labels in enumerate(label_sets):
+            cluster.add_node(StateNode(
+                name=f"n{i:02d}", labels=dict(labels), allocatable=_alloc()))
+        cols = cluster.columns
+        order = sorted(cluster.nodes)
+        rows = np.fromiter((cols.row_of[n] for n in order), dtype=np.int64)
+
+        def lookup(key):
+            kc = cols.label_cols.get(key)
+            if kc is None:
+                return None
+            return kc.codes[rows], kc.num[rows], kc.vocab
+
+        try:
+            reqs = Requirements.of()
+            for _ in range(rng.randint(1, 4)):
+                reqs.add(_rand_requirement(rng))
+        except IncompatibleError:
+            continue  # contradictory draw (e.g. num>5 ∩ num<3); redraw
+        mask = fold_node_mask(reqs, lookup, len(order))
+        for i, name in enumerate(order):
+            want = reqs.matches_labels(cluster.nodes[name].labels)
+            assert bool(mask[i]) == want, (trial, name, list(reqs),
+                                           dict(cluster.nodes[name].labels))
