@@ -8,9 +8,15 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit lint noretry hotloops crashpoints test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm fleet-bench claims diagnose provenance multichip soak
+.PHONY: presubmit lint noretry hotloops crashpoints test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm fleet-bench claims diagnose provenance multichip soak perf-regress ledger-backfill
 
-presubmit: lint claims provenance noretry hotloops crashpoints test verify-entry  ## what CI runs
+presubmit: lint claims provenance noretry hotloops crashpoints perf-regress test verify-entry  ## what CI runs
+
+perf-regress:  ## tier-1-sized micro-benches must stay inside the ledger's noise bands
+	$(CPU_ENV) $(PY) hack/check_perf_regress.py
+
+ledger-backfill:  ## seed/refresh the perf ledger from historical artifacts (idempotent)
+	$(PY) -m benchmarks.ledger backfill
 
 claims:  ## every benchmark number in docs must cite a recorded artifact
 	$(PY) hack/check_round_claims.py

@@ -72,11 +72,25 @@ def spot_message(instance_id: str) -> str:
     })
 
 
+# the drain pipeline's phases, as instrumented by the controller's
+# karpenter_interruption_phase_seconds histogram
+PHASES = ("parse", "index_lookup", "store_write", "ack")
+
+
+def phase_deltas(hist, before: "dict[str, float]", n: int) -> dict:
+    """Per-message microseconds each phase spent since `before` — the
+    registry is process-global, so ladder rungs must diff, not read."""
+    return {p: round((hist.sum(phase=p) - before[p]) / n * 1e6, 2)
+            for p in PHASES}
+
+
 def run_scale(n: int) -> dict:
     op = build_operator(n)
     try:
         for i in range(n):
             op.queue.send(spot_message(f"i-{i:08d}"))
+        hist = op.interruption.phase_seconds
+        before = {p: hist.sum(phase=p) for p in PHASES}
         t0 = time.perf_counter()
         drained = 0
         while drained < n:
@@ -90,17 +104,44 @@ def run_scale(n: int) -> dict:
         assert acted >= n, f"only {acted}/{n} cordon actions"
         return {"bench": "interruption", "messages": n,
                 "seconds": round(seconds, 4),
-                "msgs_per_sec": round(n / seconds, 1)}
+                "msgs_per_sec": round(n / seconds, 1),
+                "phase_us_per_msg": phase_deltas(hist, before, n)}
     finally:
         op.stop()
 
 
+def droop_attribution(results: "list[dict]") -> "dict | None":
+    """Which phase carries the ladder's msgs/s droop: per-message growth
+    of each phase from the smallest scale to the largest."""
+    ladder = [r for r in results if r.get("phase_us_per_msg")]
+    if len(ladder) < 2:
+        return None
+    lo, hi = ladder[0], ladder[-1]
+    growth = {p: round(hi["phase_us_per_msg"][p] - lo["phase_us_per_msg"][p],
+                       2) for p in PHASES}
+    return {"bench": "interruption_phase_droop",
+            "from_messages": lo["messages"], "to_messages": hi["messages"],
+            "msgs_per_sec": [lo["msgs_per_sec"], hi["msgs_per_sec"]],
+            "phase_growth_us_per_msg": growth,
+            "dominant_phase": max(growth, key=lambda p: growth[p])}
+
+
 def main(argv=None) -> int:
+    from benchmarks import ledger
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--scales", default="100,1000,5000,15000")
     args = parser.parse_args(argv)
+    results = []
     for scale in (int(s) for s in args.scales.split(",")):
-        print(json.dumps(run_scale(scale)), flush=True)
+        results.append(run_scale(scale))
+        print(json.dumps(results[-1]), flush=True)
+    droop = droop_attribution(results)
+    if droop:
+        results.append(droop)
+        print(json.dumps(droop), flush=True)
+    ledger.write_ladder_artifact(results, "interruption",
+                                 "benchmarks.interruption_bench")
     return 0
 
 

@@ -1,0 +1,422 @@
+"""The unified perf ledger: one append-only JSONL trend file for every
+benchmark this repo records (docs/designs/slo.md).
+
+Bench numbers used to live in ~30 ad-hoc JSON artifacts with no shared
+schema and no trend: a regression could only be found by a human diffing
+BENCH_r{N} against r{N-1}. This module is the single write path — every
+bench entrypoint (`bench.py` headline/steady/fleet/soak,
+`benchmarks/wire_bench.py`, `benchmarks/interruption_bench.py`,
+`benchmarks/multichip_wire.py`) records its headline numbers through
+`record()` — and the single read path for trend consumers
+(`hack/check_perf_regress.py` noise bands, `hack/check_round_claims.py`
+ledger citations).
+
+Each entry carries the full provenance a future reader needs to trust or
+discard it: git sha, backend, the `degraded` flag, the workload shape,
+the source entrypoint, and the artifact path the number came from.
+Entries are one JSON object per line, append-only (history is never
+rewritten; a corrected number is a NEW entry at a newer sha). The ledger
+itself must never break a bench: `record()` swallows write failures after
+logging them.
+
+`backfill()` seeds the trend from history — BENCH_r01–r05 at the repo
+root plus every artifact already under benchmarks/results/ — and is
+idempotent: entries are deduped on (artifact, metric, workload), so
+re-running it is a no-op.
+
+CLI:
+    python -m benchmarks.ledger backfill        # seed/refresh from history
+    python -m benchmarks.ledger band METRIC     # print a noise band
+    python -m benchmarks.ledger tail [N]        # last N entries
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import subprocess
+import time
+
+log = logging.getLogger("karpenter.ledger")
+
+SCHEMA_VERSION = 1
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATH = os.path.join(_ROOT, "benchmarks", "results", "ledger.jsonl")
+
+
+def ledger_path(path: "str | None" = None) -> str:
+    """Resolution order: explicit arg > KARPENTER_TPU_LEDGER env (tests and
+    ad-hoc runs must not pollute the committed trend) > the committed file."""
+    return path or os.environ.get("KARPENTER_TPU_LEDGER") or DEFAULT_PATH
+
+
+def _git_sha() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=_ROOT,
+            capture_output=True, text=True, timeout=10)
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except Exception:
+        pass
+    return ""
+
+
+def _relpath(p: "str | None") -> "str | None":
+    if not p:
+        return p
+    try:
+        ap = os.path.abspath(p)
+        if ap.startswith(_ROOT + os.sep):
+            return os.path.relpath(ap, _ROOT)
+    except Exception:
+        pass
+    return p
+
+
+def make_entry(metric: str, value, unit: str, *, source: str,
+               backend: "str | None" = None, degraded: bool = False,
+               workload: "dict | None" = None,
+               artifact: "str | None" = None,
+               recorded_at: "str | None" = None,
+               git_sha: "str | None" = None,
+               detail: "dict | None" = None) -> dict:
+    entry = {
+        "schema": SCHEMA_VERSION,
+        "recorded_at": recorded_at or time.strftime(
+            "%Y%m%dT%H%M%SZ", time.gmtime()),
+        "git_sha": _git_sha() if git_sha is None else git_sha,
+        "source": source,
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "backend": backend or "",
+        "degraded": bool(degraded),
+        "workload": dict(workload or {}),
+        "artifact": _relpath(artifact),
+    }
+    if detail:
+        entry["detail"] = detail
+    return entry
+
+
+def append(entry: dict, path: "str | None" = None) -> bool:
+    """Append one prepared entry; one os.write of a full line (O_APPEND) so
+    concurrent writers can't interleave partial lines. Never raises."""
+    target = ledger_path(path)
+    try:
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        line = json.dumps(entry, sort_keys=True) + "\n"
+        fd = os.open(target, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, line.encode())
+        finally:
+            os.close(fd)
+        return True
+    except Exception as e:  # noqa: BLE001 — the ledger must not break a bench
+        log.warning("perf-ledger append failed (%s): %s", target, e)
+        return False
+
+
+def record(metric: str, value, unit: str, *, source: str,
+           backend: "str | None" = None, degraded: bool = False,
+           workload: "dict | None" = None, artifact: "str | None" = None,
+           detail: "dict | None" = None,
+           path: "str | None" = None) -> dict:
+    """The one write path every bench entrypoint records through. Returns
+    the entry (written or not — a failed append is logged, not raised)."""
+    entry = make_entry(metric, value, unit, source=source, backend=backend,
+                       degraded=degraded, workload=workload,
+                       artifact=artifact, detail=detail)
+    append(entry, path=path)
+    return entry
+
+
+def entries(path: "str | None" = None) -> "list[dict]":
+    """Every parseable entry, in file order. Malformed lines are skipped
+    (append-only files survive crashes mid-write; a torn tail line must not
+    poison the whole trend)."""
+    target = ledger_path(path)
+    out: "list[dict]" = []
+    try:
+        with open(target) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(e, dict) and "metric" in e:
+                    out.append(e)
+    except OSError:
+        pass
+    return out
+
+
+def _median(xs: "list[float]") -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def noise_band(metric: str, backend: "str | None" = None,
+               path: "str | None" = None,
+               ledger_entries: "list[dict] | None" = None,
+               include_degraded: bool = False) -> "dict | None":
+    """Median ± MAD over the ledger's history for one (metric, backend).
+    Degraded entries are excluded by default — a relay-wedged CPU fallback
+    must not widen the band the real numbers are judged against."""
+    es = ledger_entries if ledger_entries is not None else entries(path)
+    vals = [float(e["value"]) for e in es
+            if e.get("metric") == metric
+            and isinstance(e.get("value"), (int, float))
+            and (backend is None or e.get("backend") == backend)
+            and (include_degraded or not e.get("degraded"))]
+    if not vals:
+        return None
+    med = _median(vals)
+    mad = _median([abs(v - med) for v in vals])
+    return {"metric": metric, "backend": backend, "n": len(vals),
+            "median": med, "mad": mad}
+
+
+# -- backfill -----------------------------------------------------------------
+#
+# One extractor per historical artifact family; each yields
+# (metric, value, unit, backend, degraded, workload, recorded_at) tuples.
+# The dedupe key is (artifact, metric, workload-json), so backfill is
+# idempotent and can be re-run after new artifacts land.
+
+
+def _bench_round_entries(doc: dict):
+    """BENCH_r0N.json driver wrappers: {n, cmd, rc, tail, parsed} where
+    `parsed` is bench.py's one emitted JSON line."""
+    p = doc.get("parsed") or {}
+    if not isinstance(p, dict) or p.get("value") is None:
+        return
+    detail = p.get("detail") or {}
+    yield (p.get("metric", "scheduling_cycle_p50_ms_10k_pods_600_types"),
+           p["value"], p.get("unit", "ms"), p.get("backend", ""),
+           bool(p.get("degraded")),
+           {"round": doc.get("n")},
+           (detail.get("latest_tpu_capture") or {}).get("captured_at"))
+    for extra in ("native_routed_ms", "onchip_ms", "wave_steady_per_solve_ms",
+                  "callback_headline_ms", "io_escape_sync_after_ms",
+                  "consolidation_500_streaming_ms"):
+        v = p.get(extra)
+        if isinstance(v, (int, float)):
+            yield (extra, v, "ms", p.get("backend", ""),
+                   bool(p.get("degraded")), {"round": doc.get("n")}, None)
+
+
+def _ladder_entries(doc: dict):
+    """benchmarks/record.py ladder artifacts (bench_*.json): interruption /
+    wire_interruption msgs/s ladders, baseline-config ms sweep, and the
+    wire provisioning cycle."""
+    ts = doc.get("recorded_at")
+    backend = doc.get("backend", "")
+    for e in doc.get("entries") or []:
+        kind = e.get("bench")
+        if kind in ("interruption", "wire_interruption"):
+            if isinstance(e.get("msgs_per_sec"), (int, float)):
+                yield (f"{kind}_msgs_per_sec", e["msgs_per_sec"], "msgs/s",
+                       backend, False, {"messages": e.get("messages")}, ts)
+        elif kind == "baseline_config":
+            if isinstance(e.get("ms"), (int, float)):
+                yield ("baseline_config_ms", e["ms"], "ms", backend, False,
+                       {"name": e.get("name")}, ts)
+        elif kind == "wire_provisioning":
+            for field, metric in (("cycle_seconds", "wire_cycle_seconds"),
+                                  ("ingest_seconds", "wire_ingest_seconds")):
+                if isinstance(e.get(field), (int, float)):
+                    yield (metric, e[field], "s", backend, False,
+                           {"pods": e.get("pods")}, ts)
+
+
+def _tpu_capture_entries(doc: dict):
+    ts = doc.get("captured_at")
+    backend = doc.get("backend", "tpu")
+    head = doc.get("headline") or {}
+    if isinstance(head.get("p50_ms"), (int, float)):
+        yield ("onchip_headline_p50_ms", head["p50_ms"], "ms", backend,
+               bool(doc.get("partial")), {"device": doc.get("device")}, ts)
+    for section, metric in (("exec_only_10k", "onchip_exec_only_10k_ms"),
+                            ("consolidation_500", "consolidation_500_ms")):
+        v = (doc.get(section) or {}).get("p50_ms")
+        if isinstance(v, (int, float)):
+            yield (metric, v, "ms", backend, bool(doc.get("partial")), {}, ts)
+
+
+def _fleet_entries(doc: dict):
+    ts = None
+    backend = doc.get("backend", "")
+    wl = {"tenants": doc.get("tenants"), "requests": doc.get("requests")}
+    if isinstance(doc.get("value"), (int, float)):
+        yield (doc.get("metric", "fleet_sustained_solves_per_sec"),
+               doc["value"], doc.get("unit", "solves/s"), backend,
+               not doc.get("passed", True), wl, ts)
+    if isinstance(doc.get("p99_ms"), (int, float)):
+        yield ("fleet_p99_ms", doc["p99_ms"], "ms", backend,
+               not doc.get("passed", True), wl, ts)
+
+
+def _soak_entries(doc: dict):
+    wl = {"nodes": doc.get("nodes"), "pods": doc.get("pods")}
+    if isinstance(doc.get("value"), (int, float)):
+        yield (doc.get("metric", "soak_cycle_p99_ms"), doc["value"],
+               doc.get("unit", "ms"), "cpu", not doc.get("passed", True),
+               wl, None)
+    if isinstance(doc.get("cycle_p50_ms"), (int, float)):
+        yield ("soak_cycle_p50_ms", doc["cycle_p50_ms"], "ms", "cpu",
+               not doc.get("passed", True), wl, None)
+
+
+def _multichip_entries(doc: dict):
+    wl = {"n_pods": doc.get("n_pods"), "devices": doc.get("devices"),
+          "mesh": doc.get("mesh")}
+    degraded = not (doc.get("bit_parity") and doc.get("decision_parity"))
+    for field in ("wire_solve_ms", "service_solve_ms"):
+        if isinstance(doc.get(field), (int, float)):
+            yield (f"multichip_{field}", doc[field], "ms",
+                   doc.get("backend", ""), degraded, wl,
+                   doc.get("captured_at"))
+
+
+def _trace_summary_entries(doc: dict):
+    if isinstance(doc.get("device_exec_per_run_ms"), (int, float)):
+        yield ("device_exec_per_run_ms", doc["device_exec_per_run_ms"], "ms",
+               "tpu", False, {"workload": doc.get("workload")},
+               doc.get("captured_at"))
+
+
+_BACKFILL_SOURCES = (
+    ("BENCH_r0*.json", "bench.py", _bench_round_entries),
+    ("benchmarks/results/bench_*.json", "benchmarks.record",
+     _ladder_entries),
+    ("benchmarks/results/interruption_*.json", "benchmarks.interruption_bench",
+     _ladder_entries),
+    ("benchmarks/results/wire_bench_*.json", "benchmarks.wire_bench",
+     _ladder_entries),
+    ("benchmarks/results/tpu_*.json", "bench.py", _tpu_capture_entries),
+    ("benchmarks/results/fleet/fleet_bench.json", "bench.py --fleet",
+     _fleet_entries),
+    ("benchmarks/results/soak/soak_*.json", "bench.py --soak",
+     _soak_entries),
+    ("benchmarks/results/multichip_wire_*.json", "benchmarks.multichip_wire",
+     _multichip_entries),
+    ("benchmarks/results/trace_summary_*.json", "hack/summarize_trace",
+     _trace_summary_entries),
+)
+
+
+def _dedupe_key(e: dict) -> tuple:
+    return (e.get("artifact"), e.get("metric"),
+            json.dumps(e.get("workload") or {}, sort_keys=True))
+
+
+def backfill(root: "str | None" = None,
+             path: "str | None" = None) -> int:
+    """Seed the ledger from historical artifacts; returns the number of
+    entries added. Idempotent: existing (artifact, metric, workload) keys
+    are skipped, so `backfill(); backfill()` adds zero the second time."""
+    base = root or _ROOT
+    seen = {_dedupe_key(e) for e in entries(path)}
+    added = 0
+    for pattern, source, extract in _BACKFILL_SOURCES:
+        for ap in sorted(glob.glob(os.path.join(base, pattern))):
+            try:
+                with open(ap) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError) as e:
+                log.warning("backfill skipping %s: %s", ap, e)
+                continue
+            if not isinstance(doc, dict):
+                continue
+            rel = os.path.relpath(ap, base)
+            for (metric, value, unit, backend, degraded,
+                 workload, ts) in extract(doc):
+                entry = make_entry(
+                    metric, value, unit, source=source, backend=backend,
+                    degraded=degraded, workload=workload, artifact=rel,
+                    recorded_at=ts or "backfill", git_sha="")
+                key = _dedupe_key(entry)
+                if key in seen:
+                    continue
+                seen.add(key)
+                if append(entry, path=path):
+                    added += 1
+    return added
+
+
+def record_artifact_entries(doc: dict, artifact: str, source: str,
+                            path: "str | None" = None) -> int:
+    """Ledger entries for one freshly written ladder-shaped artifact,
+    via the SAME extractor backfill uses — so a later `backfill()` dedupes
+    against what the live run already recorded."""
+    added = 0
+    for (metric, value, unit, backend, degraded,
+         workload, ts) in _ladder_entries(doc):
+        entry = make_entry(metric, value, unit, source=source,
+                           backend=backend, degraded=degraded,
+                           workload=workload, artifact=artifact,
+                           recorded_at=ts)
+        if append(entry, path=path):
+            added += 1
+    return added
+
+
+def write_ladder_artifact(results: "list[dict]", prefix: str,
+                          source: str) -> "str | None":
+    """Standalone bench mains call this: write one dated
+    benchmarks/results/<prefix>_<ts>.json and record its entries. Returns
+    the artifact path, or None when KARPENTER_TPU_BENCH_ARTIFACT=0 —
+    benchmarks.record sets that for its subprocesses because it archives
+    and records the same lines itself (one artifact, not two)."""
+    if os.environ.get("KARPENTER_TPU_BENCH_ARTIFACT") == "0":
+        return None
+    ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    doc = {"recorded_at": ts, "backend": "cpu", "entries": results}
+    out_dir = os.path.join(_ROOT, "benchmarks", "results")
+    os.makedirs(out_dir, exist_ok=True)
+    ap = os.path.join(out_dir, f"{prefix}_{ts}.json")
+    with open(ap, "w") as f:
+        json.dump(doc, f, indent=1)
+    record_artifact_entries(doc, os.path.relpath(ap, _ROOT), source)
+    return ap
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("backfill")
+    band = sub.add_parser("band")
+    band.add_argument("metric")
+    band.add_argument("--backend", default=None)
+    tail = sub.add_parser("tail")
+    tail.add_argument("n", nargs="?", type=int, default=10)
+    args = ap.parse_args(argv)
+    if args.cmd == "backfill":
+        n = backfill()
+        print(f"ledger backfill: {n} entries added "
+              f"({len(entries())} total in {ledger_path()})")
+    elif args.cmd == "band":
+        b = noise_band(args.metric, backend=args.backend)
+        print(json.dumps(b, indent=1) if b else
+              f"no entries for metric {args.metric!r}")
+        return 0 if b else 1
+    elif args.cmd == "tail":
+        for e in entries()[-args.n:]:
+            print(json.dumps(e, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

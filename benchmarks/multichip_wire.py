@@ -135,6 +135,17 @@ def run(n_pods: int, n_devices: int, out_dir: "str | None") -> dict:
         with open(path, "w") as f:
             json.dump(record, f, indent=1)
         record["artifact"] = path
+        from benchmarks import ledger
+
+        wl = {"n_pods": record["n_pods"], "devices": record["devices"],
+              "mesh": record["mesh"]}
+        degraded = not (bit_parity and decision_parity)
+        for field in ("wire_solve_ms", "service_solve_ms"):
+            ledger.record(f"multichip_{field}", record[field], "ms",
+                          source="benchmarks.multichip_wire",
+                          backend=record["backend"], degraded=degraded,
+                          workload=wl, artifact=path,
+                          detail={"routing": record["routing"]})
     return record
 
 

@@ -28,7 +28,10 @@ RESULTS_DIR = os.path.join(REPO, "benchmarks", "results")
 
 
 def _run_json_lines(argv: "list[str]") -> "tuple[list[dict], int]":
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # the recorder archives and ledgers every line itself; the benches must
+    # not also write their standalone artifacts (one artifact, not two)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               KARPENTER_TPU_BENCH_ARTIFACT="0")
     env.pop("PALLAS_AXON_POOL_IPS", None)  # never grab the real chip here
     try:
         proc = subprocess.run([sys.executable, "-m", *argv], cwd=REPO, env=env,
@@ -124,6 +127,10 @@ def main(argv=None) -> int:
     with open(path, "w") as f:
         json.dump(record, f, indent=1)
     print(f"recorded {len(results)} entries -> {path}")
+    from benchmarks import ledger
+    n = ledger.record_artifact_entries(record, os.path.relpath(path, REPO),
+                                       "benchmarks.record")
+    print(f"perf ledger: {n} entries -> {ledger.ledger_path()}")
 
     if prev:
         prev_by_key = {_key(r): r for r in prev.get("entries", [])}

@@ -100,6 +100,17 @@ def wire_provisioning(n_pods: int = 10_000) -> dict:
         prov.set_defaults()
         op.kube.create("provisioners", "default", prov)
 
+        from karpenter_tpu.tracing import TRACER
+
+        # full-cycle phase attribution: diff the global phase histogram
+        # around the run (watch-ingest decode/apply spans flush in batches
+        # of HttpKubeStore.INGEST_SPAN_BATCH, so the tail batch of a
+        # 10k-pod ingest may land after the read — attribution, not audit)
+        phases = ("ingest.decode", "ingest.apply", "provisioning.solve",
+                  "provisioning.create", "provisioning.bind.existing",
+                  "provisioning.bind.pods")
+        before = {p: TRACER.phase_sum(p) for p in phases}
+
         pods = mixed_workload(n_pods)
         t0 = time.perf_counter()
         for p in pods:
@@ -117,11 +128,14 @@ def wire_provisioning(n_pods: int = 10_000) -> dict:
         assert op.provisioning.last_solver_kind == "tpu", (
             f"solve did not cross the gRPC boundary "
             f"(kind={op.provisioning.last_solver_kind})")
+        phase_s = {p: round(TRACER.phase_sum(p) - before[p], 4)
+                   for p in phases}
         return {"bench": "wire_provisioning", "pods": n_pods,
                 "ingest_seconds": round(ingest_s, 3),
                 "cycle_seconds": round(cycle_s, 3),
                 "machines": machines,
                 "solver": op.provisioning.last_solver_kind,
+                "phase_seconds": phase_s,
                 "detail": {"n_types": len(catalog.types),
                            "topology": "HttpKubeStore + gRPC solver"}}
     finally:
@@ -158,6 +172,10 @@ def wire_interruption(n: int) -> dict:
                 "source": "cloud.spot",
                 "detail-type": "Spot Instance Interruption Warning",
                 "detail": {"instance-id": f"i-{i:08d}"}}))
+        from benchmarks.interruption_bench import PHASES, phase_deltas
+
+        hist = op.interruption.phase_seconds
+        before = {p: hist.sum(phase=p) for p in PHASES}
         t0 = time.perf_counter()
         drained = 0
         while drained < n:
@@ -170,19 +188,33 @@ def wire_interruption(n: int) -> dict:
         return {"bench": "wire_interruption", "messages": n,
                 "seconds": round(seconds, 4),
                 "msgs_per_sec": round(n / seconds, 1),
+                "phase_us_per_msg": phase_deltas(hist, before, n),
                 "detail": {"topology": "HttpKubeStore"}}
     finally:
         teardown()
 
 
 def main(argv=None) -> int:
+    from benchmarks import ledger
+    from benchmarks.interruption_bench import droop_attribution
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--scales", default="100,1000,5000,15000")
     ap.add_argument("--pods", type=int, default=10_000)
     args = ap.parse_args(argv)
+    results = []
     for scale in (int(s) for s in args.scales.split(",") if s):
-        print(json.dumps(wire_interruption(scale)), flush=True)
-    print(json.dumps(wire_provisioning(args.pods)), flush=True)
+        results.append(wire_interruption(scale))
+        print(json.dumps(results[-1]), flush=True)
+    droop = droop_attribution(results)
+    if droop:
+        droop["bench"] = "wire_interruption_phase_droop"
+        results.append(droop)
+        print(json.dumps(droop), flush=True)
+    results.append(wire_provisioning(args.pods))
+    print(json.dumps(results[-1]), flush=True)
+    ledger.write_ladder_artifact(results, "wire_bench",
+                                 "benchmarks.wire_bench")
     return 0
 
 

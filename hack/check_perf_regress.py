@@ -1,0 +1,163 @@
+#!/usr/bin/env python3
+"""Perf regression gate: tier-1-sized micro-benches vs the ledger's noise
+bands (docs/designs/slo.md).
+
+Runs two micro-benchmarks small enough for presubmit — the in-process
+interruption drain at 1000 messages and the inflate-100 baseline config —
+and compares each against the noise band of its own history in the perf
+ledger (benchmarks/results/ledger.jsonl). The band is
+
+    median ± max(K_MAD * MAD, REL_FLOOR * median)
+
+over non-degraded history for the same (metric, backend, workload, host):
+absolute wall-clock numbers only trend within one machine (this repo's
+history spans boxes that differ 10x on the same drain), so the band is
+keyed by a host fingerprint (KARPENTER_TPU_PERF_HOST env, else
+platform.node()) carried in each entry's detail. MAD alone collapses to
+~0 on a quiet history, so the relative floor keeps single-machine jitter
+from tripping the gate. The comparison is direction-aware: throughput
+metrics (msgs/s) only fail when they fall BELOW the band, latency metrics
+(ms) only when they rise ABOVE it — getting faster is never a regression.
+
+With fewer than MIN_SAMPLES same-host history points the gate SEEDS
+instead of judging: it appends the measurement to the ledger (detail
+marks it a gate seed) and passes, so a fresh machine builds its own band
+over its first few presubmits rather than being judged against someone
+else's hardware.
+
+Falsifiability hooks (exercised by tests/test_slo.py):
+    --inject METRIC=VALUE   use VALUE as the measured number instead of
+                            running that micro-bench (never seeds)
+    --ledger PATH           read/seed bands at PATH instead of the
+                            committed ledger
+
+Run via `make perf-regress` (part of `make presubmit`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+K_MAD = 5.0        # band half-width in MADs...
+REL_FLOOR = 0.5    # ...but never narrower than 50% of the median
+MIN_SAMPLES = 3    # seed (don't judge) below this much same-host history
+RECENT_N = 20      # band over at most this many newest same-host entries
+
+
+def _fingerprint() -> str:
+    return os.environ.get("KARPENTER_TPU_PERF_HOST") or platform.node()
+
+
+def _bench_interruption() -> float:
+    from benchmarks.interruption_bench import run_scale
+
+    return float(run_scale(1000)["msgs_per_sec"])
+
+
+def _bench_inflate() -> float:
+    from benchmarks.baseline_configs import config_0_inflate
+
+    return float(config_0_inflate()["ms"])
+
+
+# (metric, workload filter, backend, unit, direction, runner). `direction`
+# is the GOOD direction: "higher" fails below the band, "lower" above it.
+GATES = (
+    ("interruption_msgs_per_sec", {"messages": 1000}, "cpu", "msgs/s",
+     "higher", _bench_interruption),
+    ("baseline_config_ms", {"name": "inflate-100"}, "cpu", "ms",
+     "lower", _bench_inflate),
+)
+
+
+def _band(ledger, metric: str, backend: str, workload: dict, host: str,
+          path: "str | None"):
+    """The noise band for one gate: same metric, backend, workload shape,
+    AND host fingerprint (an interruption drain at 15k — or on different
+    hardware — must not widen the band this 1k drain is judged against)."""
+    es = [e for e in ledger.entries(path)
+          if (e.get("detail") or {}).get("host") == host
+          and all((e.get("workload") or {}).get(k) == v
+                  for k, v in workload.items())]
+    return ledger.noise_band(metric, backend=backend,
+                             ledger_entries=es[-RECENT_N:])
+
+
+def check_gate(metric, workload, backend, unit, direction, runner,
+               injected: "dict[str, float]", ledger_path: "str | None",
+               host: str):
+    """-> (status, report_line); status in {"ok", "seeded", "regress"}."""
+    from benchmarks import ledger
+
+    band = _band(ledger, metric, backend, workload, host, ledger_path)
+    what = f"{metric} {json.dumps(workload, sort_keys=True)}"
+    if metric in injected:
+        measured, how = injected[metric], "injected"
+    else:
+        measured, how = runner(), "measured"
+    n = 0 if band is None else band["n"]
+    if n < MIN_SAMPLES:
+        if how == "measured":
+            ledger.record(metric, round(measured, 3), unit,
+                          source="hack.check_perf_regress", backend=backend,
+                          workload=workload, path=ledger_path,
+                          detail={"host": host, "gate_seed": True})
+        return "seeded", (f"SEED  {what}: {how} {measured:.3f} {unit}; only "
+                          f"{n} point(s) for host {host!r} (need "
+                          f"{MIN_SAMPLES}) — recorded, not judged")
+    tol = max(K_MAD * band["mad"], REL_FLOOR * band["median"])
+    lo, hi = band["median"] - tol, band["median"] + tol
+    detail = (f"{how} {measured:.3f} {unit} vs median {band['median']:.3f} "
+              f"±{tol:.3f} (n={band['n']}, mad={band['mad']:.3f}, "
+              f"good={direction}, host={host!r})")
+    regressed = (measured < lo) if direction == "higher" else (measured > hi)
+    if regressed:
+        return "regress", f"FAIL  {what}: {detail}"
+    return "ok", f"ok    {what}: {detail}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--inject", action="append", default=[],
+                    metavar="METRIC=VALUE",
+                    help="use VALUE as the measured number for METRIC "
+                         "(falsifiability hook; skips running that bench)")
+    ap.add_argument("--ledger", default=None,
+                    help="read/seed noise bands at this ledger file "
+                         "instead of the committed one")
+    args = ap.parse_args(argv)
+
+    injected: "dict[str, float]" = {}
+    for spec in args.inject:
+        name, _, val = spec.partition("=")
+        try:
+            injected[name] = float(val)
+        except ValueError:
+            ap.error(f"--inject expects METRIC=VALUE, got {spec!r}")
+
+    host = _fingerprint()
+    failures = 0
+    for gate in GATES:
+        status, line = check_gate(*gate, injected=injected,
+                                  ledger_path=args.ledger, host=host)
+        print(f"check_perf_regress: {line}")
+        if status == "regress":
+            failures += 1
+    if failures:
+        print(f"check_perf_regress: {failures} metric(s) regressed past "
+              f"the ledger noise band (history: "
+              f"`python -m benchmarks.ledger band METRIC`)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,6 @@
 #!/usr/bin/env python3
-"""Lint: every benchmark number quoted in docs must cite a recorded artifact.
+"""Lint: every benchmark number quoted in docs must cite a recorded artifact
+or a perf-ledger entry.
 
 Round docs and the README quote performance numbers (ms, msgs/s, speedup
 factors). Unattributed numbers rot: the next round can neither reproduce
@@ -8,8 +9,11 @@ paragraph granularity and requires any paragraph quoting a benchmark
 number to also cite where it was recorded — an artifact path
 (benchmarks/results/..., a bench_*/tpu_*/linkprobe_*/chaos_seed*/
 chaos_burst_*/chaos_crash_*/chaos_storm_*/fleet_* JSON, a
-flight-recorder bundle_*.json diagnostics bundle, a .trace.json capture)
-or the harness that records one (benchmarks/*.py).
+flight-recorder bundle_*.json diagnostics bundle, a .trace.json capture),
+the harness that records one (benchmarks/*.py), or a perf-ledger citation
+`ledger:<metric>` naming a metric that actually has entries in
+benchmarks/results/ledger.jsonl (a citation to a metric the ledger has
+never recorded is itself a lint error — see docs/designs/slo.md).
 
 Numbers that are configuration, not measurement (batcher windows, TTLs),
 are waived inline with:
@@ -21,6 +25,7 @@ Run via `make presubmit` (or directly: python hack/check_round_claims.py).
 
 from __future__ import annotations
 
+import json
 import pathlib
 import re
 import sys
@@ -43,9 +48,29 @@ ARTIFACT_PATTERNS = [
     re.compile(r"[\w*-]+\.trace\.json(?:\.gz)?"),
 ]
 
+# ...or cites the perf ledger trend by metric name: `ledger:<metric>`
+LEDGER_CITE = re.compile(r"ledger:([A-Za-z_][\w]*)")
+
 WAIVER = re.compile(r"<!--\s*no-artifact:\s*\S[^>]*-->")
 
 LINTED = ["README.md"]
+
+
+def _ledger_metrics() -> "set[str]":
+    """Metric names that actually have entries in the committed ledger."""
+    metrics: "set[str]" = set()
+    path = ROOT / "benchmarks" / "results" / "ledger.jsonl"
+    try:
+        for line in path.read_text().splitlines():
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(e, dict) and isinstance(e.get("metric"), str):
+                metrics.add(e["metric"])
+    except OSError:
+        pass
+    return metrics
 
 
 def paragraphs(text: str):
@@ -63,32 +88,44 @@ def paragraphs(text: str):
         yield start, "\n".join(block)
 
 
-def lint_file(path: pathlib.Path) -> "list[str]":
+def lint_file(path: pathlib.Path,
+              known_metrics: "set[str]") -> "list[str]":
     problems = []
     rel = path.relative_to(ROOT)
     for lineno, para in paragraphs(path.read_text()):
+        cited = LEDGER_CITE.findall(para)
+        for metric in cited:
+            if metric not in known_metrics:
+                problems.append(
+                    f"{rel}:{lineno}: ledger citation ledger:{metric} names "
+                    f"a metric with no entries in benchmarks/results/"
+                    f"ledger.jsonl (typo, or the bench never recorded?)")
         claims = [m.group(0) for pat in CLAIM_PATTERNS
                   for m in pat.finditer(para)]
         if not claims:
             continue
         if WAIVER.search(para):
             continue
+        if any(m in known_metrics for m in cited):
+            continue
         if any(pat.search(para) for pat in ARTIFACT_PATTERNS):
             continue
         problems.append(
             f"{rel}:{lineno}: benchmark number(s) {claims[:3]} without a "
-            f"recorded-artifact citation (add a benchmarks/results/ path, "
-            f"or waive config constants with <!-- no-artifact: why -->)")
+            f"recorded-artifact citation (add a benchmarks/results/ path "
+            f"or a ledger:<metric> citation, or waive config constants "
+            f"with <!-- no-artifact: why -->)")
     return problems
 
 
 def main() -> int:
     targets = [ROOT / p for p in LINTED]
     targets += sorted((ROOT / "docs" / "rounds").glob("*.md"))
+    known_metrics = _ledger_metrics()
     problems = []
     for path in targets:
         if path.exists():
-            problems += lint_file(path)
+            problems += lint_file(path, known_metrics)
     if problems:
         print(f"check_round_claims: {len(problems)} unattributed "
               f"benchmark claim(s):")

@@ -169,6 +169,17 @@ class InterruptionController:
         self.deduped = reg.counter(
             f"{NAMESPACE}_interruption_deduped_messages_total",
             "Redelivered interruption messages skipped by the dedupe set.")
+        # per-message pipeline phase split (docs/designs/slo.md): the drain
+        # ladder droops superlinearly with scale, and without per-phase
+        # timing the droop cannot be localized to parse vs index lookup vs
+        # the dedupe store write vs the ack round-trip. Sub-ms buckets —
+        # individual phases are microseconds-to-milliseconds each.
+        self.phase_seconds = reg.histogram(
+            f"{NAMESPACE}_interruption_phase_seconds",
+            "Per-message interruption pipeline phase wall time "
+            "(parse / index_lookup / store_write / ack).", ("phase",),
+            buckets=(1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3,
+                     0.01, 0.05, 0.1, 0.5, 1, 5))
         # receipt -> handled-at timestamp, persisted through the kube store:
         # the at-least-once queue redelivers a message whose handler ran but
         # whose ack was lost to a crash — a REBORN consumer must recognize
@@ -254,12 +265,17 @@ class InterruptionController:
             self.queue.delete(qmsg.receipt)
             self.deleted.inc()
             return
+        t0 = time.perf_counter()
         msg = self.parsers.parse(qmsg.body, qmsg.receipt, qmsg.enqueued_at)
+        self.phase_seconds.observe(time.perf_counter() - t0, phase="parse")
         self.received.inc(message_type=msg.kind)
         if msg.enqueued_at:
             self.latency.observe(max(0.0, self.clock.now() - msg.enqueued_at))
+        lookup_s = 0.0
         for iid in msg.instance_ids:
+            t1 = time.perf_counter()
             node = self.cluster.node_by_instance_id(iid)
+            lookup_s += time.perf_counter() - t1
             node_name = node.name if node is not None else None
             if msg.kind == KIND_SPOT_INTERRUPTION and node is not None:
                 if node.capacity_type == wk.CAPACITY_TYPE_SPOT:
@@ -287,9 +303,15 @@ class InterruptionController:
                         f"node/{node_name}", msg.kind,
                         f"advisory interruption event for instance {iid}")
                 self.actions.inc(action=ACTION_NOOP)
+        self.phase_seconds.observe(lookup_s, phase="index_lookup")
+        t2 = time.perf_counter()
         self._mark_handled(qmsg.receipt)
+        self.phase_seconds.observe(time.perf_counter() - t2,
+                                   phase="store_write")
         crashpoint("interruption.pre_ack")
+        t3 = time.perf_counter()
         self.queue.delete(qmsg.receipt)
+        self.phase_seconds.observe(time.perf_counter() - t3, phase="ack")
         self.deleted.inc()
 
     def run(self, stop_event: threading.Event, gate=None) -> None:

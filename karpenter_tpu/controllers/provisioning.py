@@ -375,12 +375,19 @@ class ProvisioningController:
 
     def _apply(self, result: SolveResult, pods: "list[PodSpec]",
                catalog, provisioners, daemon_overhead) -> None:
+        # binding fan-out attribution (docs/designs/slo.md): the pool
+        # workers below run OFF the reconcile thread, so their create/bind
+        # spans need the bind span passed explicitly (thread-local
+        # parenting can't see across the executor boundary)
+        bind_span = TRACER.current_span()
         # per-group pod-name queues; binding pops from the front
         by_group = {g_idx: list(group.pod_names)
                     for g_idx, group in enumerate(result.groups)}
         # bind pods placed onto existing nodes (exact per-group plan)
-        for node_name, per_group in result.existing_by_group.items():
-            self._bind_from_groups(by_group, per_group, node_name)
+        with TRACER.start_span("provisioning.bind.existing",
+                               nodes=len(result.existing_by_group)):
+            for node_name, per_group in result.existing_by_group.items():
+                self._bind_from_groups(by_group, per_group, node_name)
         # Pre-partition each new node's pod names HERE, in the reconcile
         # thread: concurrent launch workers must not pop from the shared
         # per-group queues (double-bind/skip race under the thread pool).
@@ -394,7 +401,8 @@ class ProvisioningController:
             assignments.append(take)
         # launch new nodes in parallel (reconcile-loop concurrency analogue,
         # MaxConcurrentReconciles=10)
-        futures = [self._pool.submit(self._launch_node, solved, take, result)
+        futures = [self._pool.submit(self._launch_node, solved, take, result,
+                                     bind_span)
                    for solved, take in zip(result.nodes, assignments)]
         # Drain EVERY worker before letting a crash propagate: _launch_node
         # absorbs Exceptions itself, so only BaseException (SimulatedCrash,
@@ -480,7 +488,8 @@ class ProvisioningController:
                 except Exception as e:
                     log.warning("bind %s -> %s failed: %s", pod_name, node_name, e)
 
-    def _launch_node(self, solved, assigned, result: SolveResult) -> Optional[StateNode]:
+    def _launch_node(self, solved, assigned, result: SolveResult,
+                     parent_span=None) -> Optional[StateNode]:
         prov: Provisioner = solved.provisioner
         if not self._within_limits(prov, solved):
             self.recorder.warning(
@@ -514,12 +523,19 @@ class ProvisioningController:
             # until the registration-TTL sweep notices
             self.journal.record(LAUNCH, name, {
                 "machine": name, "provisioner": prov.name})
+        # create-vs-bind split (docs/designs/slo.md): the cloud/machine
+        # create and the pod-bind fan-out are distinct phases of the bind
+        # span; parented explicitly because this runs on a pool thread
+        create_span = TRACER.start_span("provisioning.create",
+                                        parent=parent_span, machine=name)
         try:
             self.kube.create("machines", name, machine)
             machine = self.cloudprovider.create(machine)
             crashpoint("launch.pre_register")
             self.kube.update("machines", name, machine)
         except Exception as e:
+            create_span.set_attribute("error", True)
+            create_span.end()
             log.warning("machine %s launch failed: %s", name, e)
             self.recorder.warning(f"machine/{name}", "LaunchFailed", str(e))
             try:
@@ -534,6 +550,7 @@ class ProvisioningController:
                 log.warning("cleanup of failed machine %s deferred to "
                             "registration TTL: %s", name, cleanup_err)
             return None
+        create_span.end()
         node = StateNode(
             name=machine.status.node_name or name,
             labels=dict(machine.labels),
@@ -561,7 +578,10 @@ class ProvisioningController:
                              f"launched {machine.status.instance_type} in "
                              f"{machine.status.zone}")
         # bind this node's pods
-        self._bind_assigned(assigned, node.name)
+        with TRACER.start_span("provisioning.bind.pods",
+                               parent=parent_span, node=node.name,
+                               pods=sum(len(v) for v in assigned.values())):
+            self._bind_assigned(assigned, node.name)
         if self.journal is not None:
             self.journal.resolve(LAUNCH, name)
         return node

@@ -128,6 +128,11 @@ class HttpKubeStore:
     # a 30s client horizon means we always blink first.
     KEEPALIVE_IDLE_SECONDS = 30.0
 
+    # watch-ingest attribution: decode/apply wall time flushes as one
+    # synthesized span pair per this many events (per-event spans would
+    # flood the trace ring during a 10k-pod ingest)
+    INGEST_SPAN_BATCH = 256
+
     def __init__(self, server: str, token: Optional[str] = None,
                  verify_tls: bool = True, timeout: float = 10.0,
                  ssl_context=None, registry=None, clock=None,
@@ -440,6 +445,28 @@ class HttpKubeStore:
                 # watches with a clean close, which must count too
                 self.watch_restarts.inc(kind=kind)
             attached_before = True
+            # Watch-ingest attribution (docs/designs/slo.md): per-event
+            # spans would flood the trace ring at 10k-pod ingest rates, so
+            # decode (json.loads) and apply (cache + watcher fan-out) wall
+            # time accumulate and flush as ONE synthesized span per batch —
+            # the deployed topology's dominant cycle cost becomes a
+            # first-class phase instead of dark time.
+            from ..tracing import TRACER
+
+            decode_s = apply_s = 0.0
+            batched = 0
+
+            def flush_ingest():
+                nonlocal decode_s, apply_s, batched
+                if not batched:
+                    return
+                TRACER.record_span("ingest.decode", decode_s,
+                                   kind=kind, events=batched)
+                TRACER.record_span("ingest.apply", apply_s,
+                                   kind=kind, events=batched)
+                decode_s = apply_s = 0.0
+                batched = 0
+
             try:
                 resp = self._request("GET", self._url(kind, query="watch=true"),
                                      timeout=86400)
@@ -451,16 +478,26 @@ class HttpKubeStore:
                     self._relist(kind)
                     for line in resp:
                         if self._stop.is_set():
+                            flush_ingest()
                             return
                         if not line.strip():
                             continue
+                        t0 = time.perf_counter()
                         event = json.loads(line)
+                        t1 = time.perf_counter()
+                        decode_s += t1 - t0
                         if event.get("type") == "BOOKMARK":
                             continue
                         self._apply_manifest(
                             kind, event["type"], event.get("object") or {},
                             notify=True)
+                        apply_s += time.perf_counter() - t1
+                        batched += 1
+                        if batched >= self.INGEST_SPAN_BATCH:
+                            flush_ingest()
+                flush_ingest()  # clean server-side close: drain the batch
             except (ApiError, Conflict, OSError, ValueError) as e:
+                flush_ingest()  # the partial batch's time is still real
                 if self._stop.is_set():
                     return
                 log.warning("watch %s dropped (%s); relisting", kind, e)

@@ -52,6 +52,7 @@ from collections import OrderedDict, deque
 from typing import Callable, Optional, Sequence
 
 from ..models.pod import group_pods
+from ..tracing import TRACER
 from ..utils.clock import Clock
 from . import metrics as fm
 from ..solver import buckets
@@ -418,6 +419,11 @@ class FleetFrontend:
         fm.BATCH_OCCUPANCY.observe(len(batch) / self.max_wave)
         fm.MEGA_SOLVES.inc(bucket=plan.label())
         self.mega_solves += 1
+        # queue-wait attribution (docs/designs/slo.md): admission-to-
+        # dispatch wall time, captured BEFORE the solve so the wait phase
+        # excludes solve cost; filed per ticket as a synthesized span at
+        # resolution below (fleet.queue_wait in the phase histogram)
+        dispatch_started = self.clock.now()
         problems = [{"pods": t.pods, "existing": t.existing,
                      "daemon_overhead": t.daemon_overhead} for t in batch]
         try:
@@ -440,6 +446,11 @@ class FleetFrontend:
                 fm.WAIT_TICKS.observe(wait, tenant=t.tenant_id)
                 fm.TENANT_SOLVE_SECONDS.observe(t.latency_s,
                                                 tenant=t.tenant_id)
+                TRACER.record_span(
+                    "fleet.queue_wait",
+                    max(0.0, dispatch_started - t.admitted_at),
+                    tenant=t.tenant_id, bucket=plan.label(),
+                    wait_ticks=wait)
                 t._resolve(result=res)
         return len(batch)
 

@@ -1,0 +1,328 @@
+"""Live SLOs with multi-window burn rates, evaluated from the metrics
+registry (docs/designs/slo.md).
+
+The registry's families are cumulative — counters and histogram buckets
+only ever grow — so "how are we doing *lately*" needs a time dimension
+the registry doesn't have. The evaluator adds it: on every tick it
+snapshots each SLO's (good, total) event counts into a bounded ring and
+differences the ring against window horizons (5m / 1h by default) to get
+windowed bad-event fractions.
+
+Two SLO shapes cover everything in the table:
+
+- **latency**: a histogram family + threshold. Good events are
+  observations at or under the threshold (counted at the nearest bucket
+  boundary ≥ threshold, the conservative side); the objective is "≥ N%
+  of events under the threshold".
+- **share**: a ratio of histogram *sums* (e.g. watch-ingest seconds as
+  a share of cycle seconds). The objective is "the windowed ratio stays
+  under the threshold".
+
+Burn rate is the standard SRE definition: the rate the error budget is
+being consumed, where 1.0 means exactly on budget — bad_fraction /
+(1 - objective) for latency SLOs, ratio / threshold for share SLOs. A
+short-window burn ≥ BURN_THRESHOLD edge-triggers an `SloBurn` warning
+event and a flight-recorder bundle (the statusz snapshot at the moment
+of the burn is exactly the evidence a triage needs); dropping back under
+triggers `SloRecovered`. Results land in `karpenter_slo_*` gauges and
+the statusz `slo` section (schema 5).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Optional
+
+from ..metrics import NAMESPACE, REGISTRY, Histogram
+from ..utils.clock import Clock
+
+# evaluation windows: (label, seconds). The short window is the paging
+# signal (fast burn), the long window the trend (slow burn).
+WINDOWS = (("5m", 300.0), ("1h", 3600.0))
+
+# short-window burn at or above this edge-triggers SloBurn
+BURN_THRESHOLD = 1.0
+
+PHASE_METRIC = f"{NAMESPACE}_scheduling_phase_duration_seconds"
+
+
+class Slo:
+    """One declarative objective. kind is "latency" (histogram + per-event
+    threshold + objective fraction) or "share" (sum-ratio + ceiling)."""
+
+    __slots__ = ("name", "kind", "metric", "labels", "threshold_s",
+                 "objective", "num_metric", "num_labels", "den_metric",
+                 "den_labels", "threshold", "description")
+
+    def __init__(self, name: str, kind: str, description: str = "", *,
+                 metric: str = "", labels: "Optional[dict]" = None,
+                 threshold_s: float = 0.0, objective: float = 0.99,
+                 num_metric: str = "", num_labels: "Optional[dict]" = None,
+                 den_metric: str = "", den_labels: "Optional[dict]" = None,
+                 threshold: float = 1.0):
+        self.name = name
+        self.kind = kind
+        self.description = description
+        self.metric = metric
+        self.labels = dict(labels or {})
+        self.threshold_s = threshold_s
+        self.objective = objective
+        self.num_metric = num_metric
+        self.num_labels = dict(num_labels or {})
+        self.den_metric = den_metric
+        self.den_labels = dict(den_labels or {})
+        self.threshold = threshold
+
+
+# The SLO table (ISSUE 10). Latency thresholds are error-budget lines, not
+# aspirations: cycle p99 gets the soak-proven budget (soak artifact p99
+# 534 ms at 100k nodes -> 1 s line), the solve p50 gets the paper's
+# < 100 ms north star, fleet solves get the bench-proven 1 s tail.
+SLO_TABLE = (
+    Slo("cycle_p99", "latency",
+        "99% of provisioning cycles complete within 1 s",
+        metric=PHASE_METRIC, labels={"phase": "provisioning.cycle"},
+        threshold_s=1.0, objective=0.99),
+    Slo("solve_p50", "latency",
+        "50% of solves complete within the 100 ms north star",
+        metric=PHASE_METRIC, labels={"phase": "provisioning.solve"},
+        threshold_s=0.1, objective=0.50),
+    Slo("fleet_p99", "latency",
+        "99% of fleet tenant solves complete within 1 s",
+        metric=f"{NAMESPACE}_fleet_tenant_solve_seconds", labels={},
+        threshold_s=1.0, objective=0.99),
+    Slo("fleet_shed_rate", "share",
+        "shed fleet requests stay under 5% of submissions",
+        num_metric=f"{NAMESPACE}_fleet_shed_total",
+        den_metric=f"{NAMESPACE}_fleet_requests_total",
+        threshold=0.05),
+    Slo("ingest_share", "share",
+        "watch-ingest stays under 50% of provisioning-cycle wall clock",
+        num_metric=PHASE_METRIC, num_labels={"phase": "ingest."},
+        den_metric=PHASE_METRIC,
+        den_labels={"phase": "provisioning.cycle"},
+        threshold=0.5),
+)
+
+
+def _match(series_labels: dict, want: dict) -> bool:
+    """Label filter; a value ending in "." is a prefix match (lets one SLO
+    aggregate the ingest.decode/ingest.apply span family)."""
+    for k, v in want.items():
+        got = series_labels.get(k, "")
+        if v.endswith("."):
+            if not got.startswith(v[:-1]):
+                return False
+        elif got != v:
+            return False
+    return True
+
+
+class SloEvaluator:
+    """Periodic evaluator: metrics registry -> karpenter_slo_* gauges,
+    statusz `slo` section, and edge-triggered burn events."""
+
+    def __init__(self, registry=None, clock: "Optional[Clock]" = None,
+                 recorder=None, flightrecorder=None,
+                 slos: "tuple[Slo, ...]" = SLO_TABLE,
+                 windows: "tuple[tuple[str, float], ...]" = WINDOWS,
+                 burn_threshold: float = BURN_THRESHOLD):
+        self.registry = registry if registry is not None else REGISTRY
+        self.clock = clock or Clock()
+        self.recorder = recorder
+        self.flightrecorder = flightrecorder
+        self.slos = slos
+        self.windows = windows
+        self.burn_threshold = burn_threshold
+        self._lock = threading.Lock()
+        # per-SLO snapshot ring: (ts, good, total). Ring length bounds
+        # memory: long window / min evaluation cadence (1s) is the worst
+        # case; 4096 covers 1h at sub-second ticks with slack.
+        self._rings: "dict[str, collections.deque]" = {
+            s.name: collections.deque(maxlen=4096) for s in slos}
+        self._burning: "dict[str, bool]" = {s.name: False for s in slos}
+        self._last: "dict[str, dict]" = {}
+        reg = self.registry
+        self.g_current = reg.gauge(
+            f"{NAMESPACE}_slo_current",
+            "Current windowed measurement per SLO (bad-event fraction for "
+            "latency SLOs, the ratio itself for share SLOs).",
+            ("slo", "window"))
+        self.g_burn = reg.gauge(
+            f"{NAMESPACE}_slo_burn_rate",
+            "Error-budget burn rate per SLO and window (1.0 = consuming "
+            "budget exactly as fast as allowed).", ("slo", "window"))
+        self.g_healthy = reg.gauge(
+            f"{NAMESPACE}_slo_healthy",
+            "1 when the SLO's short-window burn is under the alert "
+            "threshold, else 0.", ("slo",))
+        self.g_target = reg.gauge(
+            f"{NAMESPACE}_slo_objective",
+            "Declared objective per SLO (good-event fraction for latency "
+            "SLOs; 1 - threshold for share SLOs).", ("slo",))
+
+    # -- registry reads --------------------------------------------------------
+
+    def _histogram(self, name: str) -> "Optional[Histogram]":
+        with self.registry._lock:
+            m = self.registry._metrics.get(name)
+        return m if isinstance(m, Histogram) else None
+
+    def _latency_counts(self, slo: Slo) -> "tuple[float, float]":
+        """(good, total) cumulative events under/at the threshold, counted
+        at the first bucket boundary >= threshold (conservative: events in
+        the straddling bucket count as good only if the whole bucket is)."""
+        h = self._histogram(slo.metric)
+        if h is None:
+            return 0.0, 0.0
+        good = total = 0.0
+        with h._lock:
+            for key, counts in h._counts.items():
+                labels = dict(zip(h.label_names, key))
+                if not _match(labels, slo.labels):
+                    continue
+                total += h._totals[key]
+                cum = 0.0
+                for b, c in zip(h.buckets, counts):
+                    cum = c  # counts are already cumulative per bucket
+                    if b >= slo.threshold_s:
+                        break
+                else:
+                    cum = h._totals[key]
+                good += cum
+        return good, total
+
+    def _sum(self, name: str, want: dict) -> float:
+        h = self._histogram(name)
+        if h is not None:
+            out = 0.0
+            with h._lock:
+                for key, s in h._sums.items():
+                    if _match(dict(zip(h.label_names, key)), want):
+                        out += s
+            return out
+        with self.registry._lock:
+            m = self.registry._metrics.get(name)
+        if m is None or isinstance(m, Histogram):
+            return 0.0
+        return sum(v for labels, v in m.collect() if _match(labels, want))
+
+    def _counts(self, slo: Slo) -> "tuple[float, float]":
+        """Cumulative (numerator, denominator) for this SLO. For latency:
+        (good, total) events. For share: (num_sum, den_sum)."""
+        if slo.kind == "latency":
+            return self._latency_counts(slo)
+        return (self._sum(slo.num_metric, slo.num_labels),
+                self._sum(slo.den_metric, slo.den_labels))
+
+    # -- evaluation ------------------------------------------------------------
+
+    def _window_delta(self, ring, now: float,
+                      horizon: float) -> "tuple[float, float]":
+        """(num_delta, den_delta) between now's snapshot (ring[-1]) and the
+        oldest snapshot inside the window. Falls back to the full ring when
+        history is shorter than the window (cold start: judge what we
+        have, never divide the future by zero)."""
+        newest = ring[-1]
+        base = ring[0]
+        for ts, num, den in ring:
+            if ts >= now - horizon:
+                base = (ts, num, den)
+                break
+        return max(0.0, newest[1] - base[1]), max(0.0, newest[2] - base[2])
+
+    def evaluate(self) -> "dict[str, dict]":
+        """One tick: snapshot cumulative counts, compute windowed burn
+        rates, set gauges, edge-trigger burn/recovery events. Returns the
+        per-SLO result dict (also cached for statusz)."""
+        now = self.clock.now()
+        results: "dict[str, dict]" = {}
+        # edge transitions collected under the lock, fired after releasing
+        # it: the burn bundle captures statusz, which re-enters snapshot()
+        edges: "list[tuple[str, Slo, dict, str]]" = []
+        with self._lock:
+            for slo in self.slos:
+                num, den = self._counts(slo)
+                ring = self._rings[slo.name]
+                ring.append((now, num, den))
+                res = {"kind": slo.kind, "description": slo.description,
+                       "objective": (slo.objective if slo.kind == "latency"
+                                     else 1.0 - slo.threshold),
+                       "windows": {}}
+                budget = (max(1e-9, 1.0 - slo.objective)
+                          if slo.kind == "latency"
+                          else max(1e-9, slo.threshold))
+                for wname, horizon in self.windows:
+                    dn, dd = self._window_delta(ring, now, horizon)
+                    if slo.kind == "latency":
+                        # dn is GOOD events; bad fraction burns the budget
+                        value = (1.0 - dn / dd) if dd > 0 else 0.0
+                    else:
+                        value = dn / dd if dd > 0 else 0.0
+                    burn = value / budget
+                    res["windows"][wname] = {
+                        "value": round(value, 6),
+                        "burn_rate": round(burn, 4),
+                        "events": dd if slo.kind == "latency" else None,
+                    }
+                    self.g_current.set(value, slo=slo.name, window=wname)
+                    self.g_burn.set(burn, slo=slo.name, window=wname)
+                short = self.windows[0][0]
+                burning = (res["windows"][short]["burn_rate"]
+                           >= self.burn_threshold)
+                res["burning"] = burning
+                self.g_healthy.set(0.0 if burning else 1.0, slo=slo.name)
+                self.g_target.set(res["objective"], slo=slo.name)
+                was = self._burning[slo.name]
+                self._burning[slo.name] = burning
+                results[slo.name] = res
+                if burning and not was:
+                    edges.append(("burn", slo, res, short))
+                elif was and not burning:
+                    edges.append(("recovered", slo, res, short))
+            self._last = results
+        for kind, slo, res, short in edges:
+            if kind == "burn":
+                self._on_burn(slo, res, short)
+            else:
+                self._on_recovered(slo, res, short)
+        return results
+
+    def _on_burn(self, slo: Slo, res: dict, window: str) -> None:
+        detail = (f"{slo.name} burn_rate="
+                  f"{res['windows'][window]['burn_rate']} over {window} "
+                  f"(objective: {slo.description})")
+        if self.recorder is not None:
+            self.recorder.warning("slo/" + slo.name, "SloBurn", detail)
+        if self.flightrecorder is not None:
+            # the bundle captures statusz AT the burn edge — the phase
+            # split and queue depths that explain it are still hot
+            try:
+                self.flightrecorder.trigger(f"slo_burn_{slo.name}",
+                                            detail=detail)
+            except Exception:  # noqa: BLE001 — diagnostics must not cascade
+                pass
+
+    def _on_recovered(self, slo: Slo, res: dict, window: str) -> None:
+        if self.recorder is not None:
+            self.recorder.normal(
+                "slo/" + slo.name, "SloRecovered",
+                f"{slo.name} burn back under {self.burn_threshold} "
+                f"over {window}")
+
+    # -- read side -------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The statusz `slo` section: last evaluation per SLO plus the
+        window/threshold configuration (evaluates inline when no tick has
+        run yet, so a fresh statusz is never empty)."""
+        with self._lock:
+            last = dict(self._last)
+        if not last:
+            last = self.evaluate()
+        return {
+            "windows": {name: secs for name, secs in self.windows},
+            "burn_threshold": self.burn_threshold,
+            "slos": last,
+        }

@@ -23,7 +23,7 @@ from ..metrics import REGISTRY, Gauge, Histogram
 
 log = logging.getLogger("karpenter.statusz")
 
-SCHEMA_VERSION = 4  # 4: added the "fleet" section (multi-tenant frontends)
+SCHEMA_VERSION = 5  # 5: added the "slo" section (burn rates; 4: "fleet")
 
 # hard caps so a pathological operator can't make statusz unbounded
 MAX_EVENTS = 50
@@ -123,7 +123,11 @@ def _metrics_section(registry=None) -> dict:
             with m._lock:
                 series = [{"labels": dict(zip(m.label_names, key)),
                            "count": m._totals[key],
-                           "sum": round(m._sums[key], 6)}
+                           "sum": round(m._sums[key], 6),
+                           # last exemplar: the trace id that resolves this
+                           # series at /debug/traces?id=
+                           **({"exemplar": m._exemplars[key]["trace_id"]}
+                              if key in m._exemplars else {})}
                           for key in sorted(m._totals)]
         else:
             series = [{"labels": labels, "value": v}
@@ -162,5 +166,6 @@ def snapshot(op) -> dict:
         "resilience": _fenced(lambda: op.resilience.snapshot()),
         "recovery": _fenced(lambda: op.recovery.snapshot()),
         "fleet": _fenced(_fleet_section),
+        "slo": _fenced(lambda: op.slo.snapshot()),
         "metrics": _fenced(_metrics_section),
     }

@@ -62,8 +62,13 @@ class Histogram(_Metric):
         self._counts: "dict[tuple, list[int]]" = {}
         self._sums: "dict[tuple, float]" = {}
         self._totals: "dict[tuple, int]" = {}
+        # last exemplar per series: {key: {"trace_id", "value", "ts"}} —
+        # an aggregate that looks wrong must name ONE concrete trace to
+        # pull from /debug/traces (OpenMetrics exemplar semantics)
+        self._exemplars: "dict[tuple, dict]" = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: "Optional[str]" = None,
+                **labels) -> None:
         key = self._label_key(labels)
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
@@ -72,6 +77,14 @@ class Histogram(_Metric):
                     counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+            if exemplar:
+                self._exemplars[key] = {"trace_id": exemplar,
+                                        "value": value, "ts": time.time()}
+
+    def exemplar(self, **labels) -> "Optional[dict]":
+        with self._lock:
+            e = self._exemplars.get(self._label_key(labels))
+            return dict(e) if e else None
 
     def count(self, **labels) -> int:
         with self._lock:
@@ -149,9 +162,17 @@ class Registry:
                         for b, c in zip(m.buckets, counts):
                             lab = ",".join(f'{k}="{v}"' for k, v in {**labels, "le": b}.items())
                             lines.append(f"{m.name}_bucket{{{lab}}} {c}")
-                        # mandatory +Inf bucket == total observation count
+                        # mandatory +Inf bucket == total observation count;
+                        # the series' last exemplar rides on it (OpenMetrics
+                        # `# {trace_id=...}` suffix — ignored by classic
+                        # Prometheus text parsers, resolvable at
+                        # /debug/traces?id=<trace_id>)
                         lab = ",".join(f'{k}="{v}"' for k, v in {**labels, "le": "+Inf"}.items())
-                        lines.append(f"{m.name}_bucket{{{lab}}} {m._totals[key]}")
+                        ex = m._exemplars.get(key)
+                        suffix = (f' # {{trace_id="{ex["trace_id"]}"}} '
+                                  f'{ex["value"]} {ex["ts"]}' if ex else "")
+                        lines.append(
+                            f"{m.name}_bucket{{{lab}}} {m._totals[key]}{suffix}")
                         lab = ",".join(f'{k}="{v}"' for k, v in labels.items())
                         sep = f"{{{lab}}}" if lab else ""
                         lines.append(f"{m.name}_sum{sep} {m._sums[key]}")

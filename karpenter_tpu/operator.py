@@ -60,6 +60,8 @@ class Operator:
     # introspection cadence: deadman sweep + flight-recorder snapshot ring
     WATCHDOG_CHECK_INTERVAL = 1.0
     SNAPSHOT_INTERVAL = 10.0
+    # SLO burn-rate evaluation tick (introspect/slo.py)
+    SLO_INTERVAL = 5.0
 
     def __init__(self, cloud, settings: Settings, catalog: Catalog,
                  kube: Optional[KubeStore] = None,
@@ -246,6 +248,14 @@ class Operator:
             lambda name, err: self.flightrecorder.trigger(
                 "reconcile_exception",
                 detail=f"{name}: {type(err).__name__}: {err}"))
+        # perf SLO plane: declarative objectives evaluated from the metric
+        # families into karpenter_slo_* gauges with multi-window burn
+        # rates; a short-window burn edge-triggers an SloBurn event and a
+        # flight-recorder bundle (docs/designs/slo.md)
+        from .introspect.slo import SloEvaluator
+
+        self.slo = SloEvaluator(clock=self.clock, recorder=self.recorder,
+                                flightrecorder=self.flightrecorder)
         # crash-restart recovery: epoch minting + stranded-intent replay on
         # each incarnation (docs/designs/recovery.md)
         self.recovery = RecoveryManager(self)
@@ -454,6 +464,7 @@ class Operator:
         loop("watchdog", self.watchdog.check, self.WATCHDOG_CHECK_INTERVAL)
         loop("flightrecorder", self.flightrecorder.record_snapshot,
              self.SNAPSHOT_INTERVAL)
+        loop("slo", self.slo.evaluate, self.SLO_INTERVAL)
 
     def stop(self) -> None:
         # The graceful lease release happens inside the election thread's

@@ -197,6 +197,32 @@ class Tracer:
         if cur is not None:
             cur.attributes.update(attrs)
 
+    def record_span(self, name: str, duration_s: float,
+                    parent: "Optional[Span]" = None,
+                    context: "Optional[SpanContext]" = None,
+                    **attributes) -> Span:
+        """Synthesize an already-measured span (backdated by `duration_s`).
+        Hot paths that time phases with raw perf_counter deltas — solver
+        encode/dispatch, fleet queue wait, watch-ingest batches — file
+        those measurements as first-class spans without paying a context
+        manager per inner iteration. Parent resolution matches
+        start_span; the span never touches the thread-local stack."""
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif context is not None and context.trace_id:
+            trace_id, parent_id = context.trace_id, context.span_id
+        else:
+            cur = self.current_span()
+            if cur is not None:
+                trace_id, parent_id = cur.trace_id, cur.span_id
+            else:
+                trace_id, parent_id = _new_id(), ""
+        span = Span(self, name, trace_id, _new_id(), parent_id, attributes)
+        span.start_ts -= duration_s
+        span.duration_s = max(0.0, duration_s)
+        self._finish(span)
+        return span
+
     def _finish(self, span: Span) -> None:
         st = self._stack()
         if span in st:  # tolerate out-of-order ends from with-blocks
@@ -204,13 +230,22 @@ class Tracer:
         with self._lock:
             self._finished.append(span)
         if self._phase_hist is not None:
-            self._phase_hist.observe(span.duration_s, phase=span.name)
+            # the trace id rides along as the series exemplar, so a slow
+            # histogram percentile resolves to a concrete trace
+            self._phase_hist.observe(span.duration_s,
+                                     exemplar=span.trace_id, phase=span.name)
 
     # -- read side -----------------------------------------------------------
 
     def finished_spans(self) -> "list[Span]":
         with self._lock:
             return list(self._finished)
+
+    def phase_sum(self, phase: str) -> float:
+        """Cumulative seconds observed for one phase; benchmarks read
+        deltas of this around a measured window to attribute wall clock."""
+        return (self._phase_hist.sum(phase=phase)
+                if self._phase_hist is not None else 0.0)
 
     def trace(self, trace_id: str) -> "list[dict]":
         return [s.to_dict() for s in self.finished_spans()
@@ -265,6 +300,41 @@ class Tracer:
 
     def chrome_trace_json(self, trace_id: "Optional[str]" = None) -> str:
         return json.dumps(self.chrome_trace(trace_id), default=str)
+
+    def phase_coverage(self, trace_id: "Optional[str]" = None,
+                       root_name: str = "provisioning.cycle") -> "Optional[dict]":
+        """How much of a root span's wall clock its direct children account
+        for. The SLO plane's attribution invariant (docs/designs/slo.md):
+        if coverage drops below ~95%, someone added work to the cycle
+        outside any phase span, and a cycle-latency burn can no longer be
+        attributed. Picks the newest finished trace containing `root_name`
+        when `trace_id` is not given."""
+        spans = self.finished_spans()
+        if trace_id is None:
+            for s in reversed(spans):
+                if s.name == root_name and not s.parent_id:
+                    trace_id = s.trace_id
+                    break
+            if trace_id is None:
+                return None
+        in_trace = [s for s in spans if s.trace_id == trace_id]
+        roots = [s for s in in_trace
+                 if s.name == root_name or not s.parent_id]
+        if not roots or not in_trace:
+            return None
+        root = roots[0]
+        children = [s for s in in_trace if s.parent_id == root.span_id]
+        root_s = root.duration_s or 0.0
+        covered_s = sum(s.duration_s or 0.0 for s in children)
+        return {
+            "trace_id": trace_id,
+            "root": root.name,
+            "root_s": root_s,
+            "covered_s": covered_s,
+            "coverage": (min(1.0, covered_s / root_s) if root_s > 0 else 1.0),
+            "phases": {s.name: round(s.duration_s or 0.0, 6)
+                       for s in children},
+        }
 
     def clear(self) -> None:
         with self._lock:

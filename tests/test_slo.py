@@ -1,0 +1,456 @@
+"""Perf SLO plane tests (docs/designs/slo.md): perf-ledger roundtrip and
+backfill idempotence, burn-rate window math under a stepped clock,
+edge-triggered SloBurn/SloRecovered events with flight-recorder bundles,
+the >=95% phase-attribution invariant over a real provisioning cycle,
+histogram trace-id exemplars resolving through /debug/traces, and the
+perf-regress gate's falsifiability (a seeded regression MUST trip it)."""
+
+import json
+import threading
+import urllib.request
+
+from karpenter_tpu.apis.nodetemplate import NodeTemplate
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.apis.settings import Settings
+from karpenter_tpu.fake.cloud import FakeCloud
+from karpenter_tpu.introspect.slo import PHASE_METRIC, Slo, SloEvaluator
+from karpenter_tpu.metrics import Registry
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.tracing import TRACER
+from karpenter_tpu.utils.clock import FakeClock
+
+from benchmarks import ledger
+
+
+# -- the perf ledger ----------------------------------------------------------
+
+
+class TestLedger:
+    def test_record_roundtrip_via_env_override(self, tmp_path, monkeypatch):
+        path = tmp_path / "ledger.jsonl"
+        monkeypatch.setenv("KARPENTER_TPU_LEDGER", str(path))
+        entry = ledger.record("cycle_ms", 12.5, "ms", source="test",
+                              backend="cpu", workload={"pods": 10},
+                              detail={"k": "v"})
+        got = ledger.entries()
+        assert len(got) == 1
+        assert got[0] == entry
+        assert got[0]["schema"] == ledger.SCHEMA_VERSION
+        assert got[0]["metric"] == "cycle_ms"
+        assert got[0]["value"] == 12.5
+        assert got[0]["workload"] == {"pods": 10}
+        assert got[0]["degraded"] is False
+        # provenance fields exist even when empty
+        for field in ("git_sha", "recorded_at", "artifact", "backend"):
+            assert field in got[0]
+
+    def test_torn_tail_line_does_not_poison_the_trend(self, tmp_path):
+        path = tmp_path / "ledger.jsonl"
+        ledger.record("m", 1.0, "ms", source="test", path=str(path))
+        with open(path, "a") as f:
+            f.write('{"metric": "m", "value": 2.0, "uncl')  # torn write
+        assert [e["value"] for e in ledger.entries(str(path))] == [1.0]
+        # and appending after the torn line still lands on its own line
+        ledger.record("m", 3.0, "ms", source="test", path=str(path))
+        assert len(ledger.entries(str(path))) >= 1
+
+    def test_backfill_is_idempotent(self, tmp_path):
+        root = tmp_path / "repo"
+        (root / "benchmarks" / "results").mkdir(parents=True)
+        artifact = {
+            "recorded_at": "20260801T000000Z", "backend": "cpu",
+            "entries": [
+                {"bench": "interruption", "messages": 1000,
+                 "msgs_per_sec": 5000.0},
+                {"bench": "baseline_config", "name": "inflate-100",
+                 "ms": 1.25},
+                {"bench": "wire_provisioning", "pods": 10000,
+                 "ingest_seconds": 4.0, "cycle_seconds": 9.0},
+            ]}
+        (root / "benchmarks" / "results" / "bench_x.json").write_text(
+            json.dumps(artifact))
+        path = str(tmp_path / "ledger.jsonl")
+        first = ledger.backfill(root=str(root), path=path)
+        assert first == 4  # msgs/s + ms + ingest_s + cycle_s
+        metrics = {e["metric"] for e in ledger.entries(path)}
+        assert metrics == {"interruption_msgs_per_sec", "baseline_config_ms",
+                           "wire_ingest_seconds", "wire_cycle_seconds"}
+        # every backfilled entry cites its artifact
+        assert all(e["artifact"] for e in ledger.entries(path))
+        assert ledger.backfill(root=str(root), path=path) == 0  # idempotent
+
+    def test_committed_ledger_backfill_is_a_noop(self):
+        """The committed trend already contains its own history: re-running
+        backfill against the real repo must add nothing."""
+        assert ledger.backfill() == 0
+        assert len(ledger.entries()) > 200
+
+    def test_noise_band_excludes_degraded(self, tmp_path):
+        path = str(tmp_path / "l.jsonl")
+        for v in (10.0, 11.0, 12.0):
+            ledger.record("m", v, "ms", source="t", backend="cpu", path=path)
+        ledger.record("m", 500.0, "ms", source="t", backend="cpu",
+                      degraded=True, path=path)
+        band = ledger.noise_band("m", backend="cpu", path=path)
+        assert band["n"] == 3
+        assert band["median"] == 11.0
+        assert band["mad"] == 1.0
+        wide = ledger.noise_band("m", backend="cpu", path=path,
+                                 include_degraded=True)
+        assert wide["n"] == 4
+
+
+# -- burn-rate window math ----------------------------------------------------
+
+
+class FakeRecorder:
+    def __init__(self):
+        self.events = []
+
+    def warning(self, ref, reason, message):
+        self.events.append(("warning", ref, reason, message))
+        return True
+
+    def normal(self, ref, reason, message):
+        self.events.append(("normal", ref, reason, message))
+        return True
+
+
+class FakeFlightRecorder:
+    def __init__(self):
+        self.triggers = []
+
+    def trigger(self, reason, detail="", force=False):
+        self.triggers.append((reason, detail))
+        return "/tmp/bundle.json"
+
+
+class TestBurnMath:
+    def _evaluator(self, slos):
+        reg = Registry()
+        clock = FakeClock()
+        rec, fr = FakeRecorder(), FakeFlightRecorder()
+        ev = SloEvaluator(registry=reg, clock=clock, recorder=rec,
+                          flightrecorder=fr, slos=slos)
+        hist = reg.histogram(PHASE_METRIC, "", ("phase",))
+        return ev, reg, clock, rec, fr, hist
+
+    def test_latency_burn_edge_triggers_and_recovers(self):
+        slo = Slo("cycle_p99", "latency", "cycles under 1s",
+                  metric=PHASE_METRIC,
+                  labels={"phase": "provisioning.cycle"},
+                  threshold_s=1.0, objective=0.90)
+        ev, reg, clock, rec, fr, hist = self._evaluator((slo,))
+
+        res = ev.evaluate()  # cold start: single snapshot, zero deltas
+        assert res["cycle_p99"]["burning"] is False
+
+        for _ in range(10):
+            hist.observe(0.1, phase="provisioning.cycle")
+        clock.step(60)
+        res = ev.evaluate()
+        # all 10 events inside the 5m window were good
+        assert res["cycle_p99"]["windows"]["5m"]["value"] == 0.0
+        assert res["cycle_p99"]["windows"]["5m"]["events"] == 10
+        assert ev.g_healthy.value(slo="cycle_p99") == 1.0
+
+        clock.step(60)
+        for _ in range(10):
+            hist.observe(2.0, phase="provisioning.cycle")  # all bad
+        res = ev.evaluate()
+        w = res["cycle_p99"]["windows"]["5m"]
+        # window delta vs t=0: 10 of 20 events exceeded the threshold
+        assert abs(w["value"] - 0.5) < 1e-9
+        # burn = bad_fraction / (1 - objective) = 0.5 / 0.1
+        assert abs(w["burn_rate"] - 5.0) < 1e-9
+        assert res["cycle_p99"]["burning"] is True
+        assert ev.g_healthy.value(slo="cycle_p99") == 0.0
+        assert abs(ev.g_burn.value(slo="cycle_p99", window="5m")
+                   - w["burn_rate"]) < 1e-6
+        # edge-triggered exactly once, with a flight-recorder bundle
+        burns = [e for e in rec.events if e[2] == "SloBurn"]
+        assert len(burns) == 1
+        assert [r for r, _ in fr.triggers] == ["slo_burn_cycle_p99"]
+
+        # still burning on the next tick: NO duplicate event
+        clock.step(10)
+        assert ev.evaluate()["cycle_p99"]["burning"] is True
+        assert len([e for e in rec.events if e[2] == "SloBurn"]) == 1
+
+        # the bad burst ages out of the 5m window -> recovery, once
+        clock.step(400)
+        res = ev.evaluate()
+        assert res["cycle_p99"]["burning"] is False
+        recs = [e for e in rec.events if e[2] == "SloRecovered"]
+        assert len(recs) == 1
+        assert len(fr.triggers) == 1
+
+    def test_burn_bundle_may_reenter_snapshot(self):
+        """The real flight recorder's bundle captures statusz, whose slo
+        section calls SloEvaluator.snapshot() — from the SAME thread that
+        is inside evaluate(). Edge events must fire outside the evaluator
+        lock or the first genuine burn wedges the slo loop forever."""
+        slo = Slo("cycle_p99", "latency", "", metric=PHASE_METRIC,
+                  labels={"phase": "provisioning.cycle"},
+                  threshold_s=1.0, objective=0.90)
+        ev, reg, clock, rec, fr, hist = self._evaluator((slo,))
+        snaps = []
+        fr.trigger = lambda reason, detail="", force=False: snaps.append(
+            ev.snapshot())  # what statusz does inside the bundle
+        ev.evaluate()
+        hist.observe(5.0, phase="provisioning.cycle")  # bad: will burn
+        clock.step(30)
+
+        worker = threading.Thread(target=ev.evaluate, daemon=True)
+        worker.start()
+        worker.join(timeout=10)
+        assert not worker.is_alive(), "evaluate() deadlocked in _on_burn"
+        assert len(snaps) == 1
+        assert snaps[0]["slos"]["cycle_p99"]["burning"] is True
+
+    def test_long_window_still_sees_what_short_forgot(self):
+        slo = Slo("cycle_p99", "latency", "", metric=PHASE_METRIC,
+                  labels={"phase": "provisioning.cycle"},
+                  threshold_s=1.0, objective=0.90)
+        ev, reg, clock, rec, fr, hist = self._evaluator((slo,))
+        ev.evaluate()
+        hist.observe(5.0, phase="provisioning.cycle")
+        clock.step(30)
+        ev.evaluate()
+        clock.step(600)  # past the 5m horizon, inside 1h
+        res = ev.evaluate()["cycle_p99"]["windows"]
+        assert res["5m"]["value"] == 0.0
+        assert res["1h"]["value"] == 1.0
+
+    def test_share_slo_prefix_aggregation(self):
+        slo = Slo("ingest_share", "share", "ingest under half the cycle",
+                  num_metric=PHASE_METRIC, num_labels={"phase": "ingest."},
+                  den_metric=PHASE_METRIC,
+                  den_labels={"phase": "provisioning.cycle"},
+                  threshold=0.5)
+        ev, reg, clock, rec, fr, hist = self._evaluator((slo,))
+        ev.evaluate()
+        # ingest.* family aggregates across decode+apply via prefix match
+        hist.observe(0.2, phase="ingest.decode")
+        hist.observe(0.2, phase="ingest.apply")
+        hist.observe(1.0, phase="provisioning.cycle")
+        clock.step(10)
+        res = ev.evaluate()["ingest_share"]["windows"]["5m"]
+        assert abs(res["value"] - 0.4) < 1e-9
+        assert abs(res["burn_rate"] - 0.8) < 1e-9  # 0.4 / 0.5 ceiling
+        # push ingest past the ceiling -> burning
+        hist.observe(0.5, phase="ingest.apply")
+        clock.step(10)
+        res = ev.evaluate()
+        assert res["ingest_share"]["burning"] is True
+
+    def test_snapshot_never_empty_and_statusz_shaped(self):
+        slo = Slo("cycle_p99", "latency", "", metric=PHASE_METRIC,
+                  labels={"phase": "provisioning.cycle"},
+                  threshold_s=1.0, objective=0.99)
+        ev, *_ = self._evaluator((slo,))
+        snap = ev.snapshot()  # no tick has run: evaluates inline
+        assert set(snap) == {"windows", "burn_threshold", "slos"}
+        assert "cycle_p99" in snap["slos"]
+        assert set(snap["slos"]["cycle_p99"]["windows"]) == {"5m", "1h"}
+
+
+# -- phase attribution over a real cycle --------------------------------------
+
+
+def _operator(**kw):
+    cat = Catalog(types=[
+        make_instance_type("t.small", cpu=2, memory="2Gi",
+                           od_price=0.05, spot_price=0.02),
+        make_instance_type("m.xlarge", cpu=16, memory="64Gi",
+                           od_price=0.80, spot_price=0.28),
+    ])
+    clock = FakeClock()
+    op = Operator(FakeCloud(catalog=cat, clock=clock),
+                  Settings(cluster_name="slo",
+                           cluster_endpoint="https://k.example",
+                           batch_idle_duration=0.0, batch_max_duration=0.0),
+                  cat, clock=clock, **kw)
+    op.kube.create("nodetemplates", "default", NodeTemplate(
+        name="default", subnet_selector={"id": "subnet-zone-1a"},
+        security_group_selector={"id": "sg-default"}))
+    op.cloudprovider.register_nodetemplate(
+        op.kube.get("nodetemplates", "default"))
+    p = Provisioner(name="default", provider_ref="default")
+    p.set_defaults()
+    op.kube.create("provisioners", "default", p)
+    return op
+
+
+class TestPhaseCoverage:
+    def test_cycle_phases_cover_95_percent_of_wall_clock(self):
+        """The attribution invariant: a cycle-latency burn must be
+        explainable from the phase split alone. If this drops below 95%,
+        someone added cycle work outside any phase span."""
+        op = _operator()
+        try:
+            for i in range(60):
+                op.kube.create("pods", f"p{i}",
+                               make_pod(f"p{i}", cpu="500m", memory="1Gi"))
+            TRACER.clear()
+            op.provisioning.reconcile_once()
+            assert len(op.kube.pending_pods()) == 0
+            cov = TRACER.phase_coverage()
+            assert cov is not None
+            assert cov["root"] == "provisioning.cycle"
+            assert cov["root_s"] > 0
+            assert {"provisioning.mask", "provisioning.solve",
+                    "provisioning.bind"} <= set(cov["phases"])
+            assert cov["coverage"] >= 0.95, (
+                f"phases cover only {cov['coverage']:.1%} of the cycle: "
+                f"{cov['phases']}")
+        finally:
+            op.stop()
+
+    def test_dark_phases_are_spanned(self, monkeypatch):
+        """The formerly-dark phases record real spans: solver interior
+        (encode/dispatch/transfer/decode) and the binding fan-out. Routing
+        is pinned to the device solver — the native scan path these pod
+        counts would otherwise take has no interior to attribute."""
+        monkeypatch.setenv("KARPENTER_TPU_ROUTE_CROSSOVER", "0")
+        op = _operator()
+        try:
+            for i in range(40):
+                op.kube.create("pods", f"p{i}",
+                               make_pod(f"p{i}", cpu="500m", memory="1Gi"))
+            TRACER.clear()
+            op.provisioning.reconcile_once()
+            names = {s.name for s in TRACER.finished_spans()}
+            assert {"solver.encode", "solver.transfer",
+                    "solver.decode"} <= names
+            assert ("solver.dispatch.compile" in names
+                    or "solver.dispatch.execute" in names)
+            assert "provisioning.create" in names
+            assert "provisioning.bind.pods" in names
+            # fan-out spans joined the cycle's trace, not new roots
+            root = next(s for s in TRACER.finished_spans()
+                        if s.name == "provisioning.cycle")
+            create = next(s for s in TRACER.finished_spans()
+                          if s.name == "provisioning.create")
+            assert create.trace_id == root.trace_id
+        finally:
+            op.stop()
+
+
+# -- exemplars ----------------------------------------------------------------
+
+
+class TestExemplars:
+    def test_histogram_stores_and_exposes_exemplar(self):
+        reg = Registry()
+        h = reg.histogram("x_seconds", "help", ("m",))
+        h.observe(0.2, exemplar="tid123", m="a")
+        h.observe(0.3, m="a")  # no exemplar: last one sticks
+        ex = h.exemplar(m="a")
+        assert ex["trace_id"] == "tid123"
+        assert ex["value"] == 0.2
+        text = reg.expose()
+        assert '# {trace_id="tid123"}' in text
+        # the exemplar rides the +Inf bucket line only
+        assert text.count("tid123") == 1
+
+    def test_phase_exemplar_resolves_via_debug_traces(self):
+        op = _operator(serve_http=True, metrics_port=0, health_port=0,
+                       webhook_port=0)
+        try:
+            ports = op.serving.start()
+            for i in range(30):
+                op.kube.create("pods", f"p{i}",
+                               make_pod(f"p{i}", cpu="500m", memory="1Gi"))
+            TRACER.clear()
+            op.provisioning.reconcile_once()
+            ex = TRACER._phase_hist.exemplar(phase="provisioning.cycle")
+            assert ex is not None
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{ports['metrics']}"
+                    f"/debug/traces?id={ex['trace_id']}") as r:
+                assert r.status == 200
+                doc = json.loads(r.read().decode())
+            assert "provisioning.cycle" in {e["name"]
+                                            for e in doc["traceEvents"]}
+            # the /metrics text carries the same trace id as an exemplar
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{ports['metrics']}/metrics") as r:
+                body = r.read().decode()
+            assert f'trace_id="{ex["trace_id"]}"' in body
+        finally:
+            op.stop()
+
+
+# -- the regression gate: falsifiability --------------------------------------
+
+
+class TestRegressGate:
+    HOST = "slo-test-host"
+
+    def _seed(self, path, metric, workload, values, unit):
+        for v in values:
+            ledger.record(metric, v, unit, source="hack.check_perf_regress",
+                          backend="cpu", workload=workload, path=path,
+                          detail={"host": self.HOST})
+
+    def _ledger(self, tmp_path):
+        path = str(tmp_path / "ledger.jsonl")
+        self._seed(path, "interruption_msgs_per_sec", {"messages": 1000},
+                   (5000.0, 5100.0, 4900.0, 5050.0), "msgs/s")
+        self._seed(path, "baseline_config_ms", {"name": "inflate-100"},
+                   (1.2, 1.3, 1.25, 1.28), "ms")
+        return path
+
+    def _run(self, tmp_path, monkeypatch, *inject):
+        import hack.check_perf_regress as gate
+
+        monkeypatch.setenv("KARPENTER_TPU_PERF_HOST", self.HOST)
+        argv = ["--ledger", self._ledger(tmp_path)]
+        for spec in inject:
+            argv += ["--inject", spec]
+        return gate.main(argv)
+
+    def test_seeded_regression_trips_the_gate(self, tmp_path, monkeypatch,
+                                              capsys):
+        rc = self._run(tmp_path, monkeypatch,
+                       "interruption_msgs_per_sec=100",
+                       "baseline_config_ms=1.3")
+        out = capsys.readouterr().out
+        assert rc == 1, out
+        assert "FAIL  interruption_msgs_per_sec" in out
+        assert "ok    baseline_config_ms" in out
+
+    def test_latency_regression_trips_too(self, tmp_path, monkeypatch,
+                                          capsys):
+        rc = self._run(tmp_path, monkeypatch,
+                       "interruption_msgs_per_sec=5000",
+                       "baseline_config_ms=99")
+        out = capsys.readouterr().out
+        assert rc == 1, out
+        assert "FAIL  baseline_config_ms" in out
+
+    def test_in_band_passes_and_faster_is_never_a_regression(
+            self, tmp_path, monkeypatch, capsys):
+        # 10x the throughput and half the latency: both GOOD directions
+        rc = self._run(tmp_path, monkeypatch,
+                       "interruption_msgs_per_sec=50000",
+                       "baseline_config_ms=0.6")
+        assert rc == 0, capsys.readouterr().out
+
+    def test_unknown_host_seeds_instead_of_judging(self, tmp_path,
+                                                   monkeypatch, capsys):
+        """History from OTHER hardware must not judge this machine: with no
+        same-host points the gate reports SEED and passes even on numbers
+        that would fail the other host's band."""
+        import hack.check_perf_regress as gate
+
+        monkeypatch.setenv("KARPENTER_TPU_PERF_HOST", "brand-new-box")
+        rc = gate.main(["--ledger", self._ledger(tmp_path),
+                        "--inject", "interruption_msgs_per_sec=100",
+                        "--inject", "baseline_config_ms=99"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert out.count("SEED") == 2
