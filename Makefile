@@ -8,9 +8,9 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit lint noretry hotloops crashpoints test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm fleet-bench claims diagnose provenance multichip soak perf-regress ledger-backfill
+.PHONY: presubmit lint noretry hotloops crashpoints cardinality test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm fleet-bench telemetry-drill claims diagnose provenance multichip soak perf-regress ledger-backfill
 
-presubmit: lint claims provenance noretry hotloops crashpoints perf-regress test verify-entry  ## what CI runs
+presubmit: lint claims provenance noretry hotloops crashpoints cardinality perf-regress test verify-entry  ## what CI runs
 
 perf-regress:  ## tier-1-sized micro-benches must stay inside the ledger's noise bands
 	$(CPU_ENV) $(PY) hack/check_perf_regress.py
@@ -33,6 +33,9 @@ noretry:  ## retries must flow through resilience.RetryPolicy (shared budget)
 hotloops:  ## no per-pod/per-node Python loops in HOT:BEGIN/END sections
 	$(PY) hack/check_hot_loops.py
 
+cardinality:  ## identity labels on metrics must route through the tenant guard
+	$(PY) hack/check_label_cardinality.py
+
 soak:  ## columnar-state soak: 100k nodes / 1M pods under churn, RECORDED
 	$(CPU_ENV) $(PY) bench.py --soak
 
@@ -53,6 +56,9 @@ chaos-storm:  ## multi-tenant storm drill: fairness bound + shed paths, replayab
 
 fleet-bench:  ## multi-tenant fleet benchmark: sustained solves/sec + p99, RECORDED
 	$(CPU_ENV) $(PY) bench.py --fleet
+
+telemetry-drill:  ## 2-replica/1000-tenant telemetry acceptance drill, RECORDED
+	$(CPU_ENV) $(PY) -m benchmarks.telemetry_drill
 
 lint:  ## static analysis: bytecode-compile everything; ruff when installed
 	$(PY) -m compileall -q karpenter_tpu tests hack benchmarks bench.py __graft_entry__.py

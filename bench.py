@@ -403,9 +403,22 @@ def _fleet_bench(args, jax):
         "violations": violations,
         "passed": not violations,
     }
+    # cardinality-bounded tenant telemetry: the top-K table plus the
+    # per-family series counts — proof the metric surface stayed O(K)
+    # even when --tenants dwarfs K
+    telemetry = fstats.get("tenant_telemetry", {})
+    series = telemetry.get("series_per_family", {})
+    record["tenant_telemetry"] = {
+        "k": telemetry.get("k"),
+        "top": telemetry.get("tracked", [])[:16],
+        "series_per_family": series,
+        "series_max": max(series.values()) if series else 0,
+    }
     print(json.dumps(record), flush=True)
-    out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "benchmarks", "results", "fleet")
+    out_dir = os.environ.get(
+        "KARPENTER_TPU_FLEET_BENCH_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "benchmarks", "results", "fleet"))
     os.makedirs(out_dir, exist_ok=True)
     artifact = os.path.join(out_dir, "fleet_bench.json")
     with open(artifact, "w") as f:
@@ -422,6 +435,11 @@ def _fleet_bench(args, jax):
                        source="bench.py --fleet", backend=record["backend"],
                        degraded=not record["passed"], workload=wl,
                        artifact=artifact)
+    _ledger.record("fleet_tenant_series_max",
+                   record["tenant_telemetry"]["series_max"], "series",
+                   source="bench.py --fleet", backend=record["backend"],
+                   degraded=not record["passed"], workload=wl,
+                   artifact=artifact)
     return 0 if record["passed"] else 1
 
 
@@ -777,6 +795,10 @@ def main():
                          "instead of the single-solver headline")
     ap.add_argument("--fleet-tenants", type=int, default=8, metavar="N",
                     help="concurrent tenants in --fleet mode")
+    ap.add_argument("--tenants", type=int, default=None, metavar="N",
+                    help="alias for --fleet-tenants (grows the tenant "
+                         "axis; the cardinality guard keeps per-tenant "
+                         "series bounded at K+1 no matter how large)")
     ap.add_argument("--fleet-rate", type=float, default=10.0, metavar="R",
                     help="offered solves/sec PER TENANT in --fleet mode")
     ap.add_argument("--fleet-seconds", type=float, default=4.0, metavar="S",
@@ -795,6 +817,8 @@ def main():
                          "before/after section (legacy per-node loop must "
                          "still terminate)")
     args = ap.parse_args()
+    if args.tenants is not None:
+        args.fleet_tenants = args.tenants
     if args.soak:  # host-only path: columns + numpy, no jax device needed
         sys.exit(_soak_bench(args))
     forced = os.environ.get("KARPENTER_TPU_BENCH_PLATFORM")

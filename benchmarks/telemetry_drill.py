@@ -1,0 +1,235 @@
+"""The fleet telemetry drill: 2 replicas, 1000 tenants, one artifact.
+
+Deterministic end-to-end proof (FakeClock, fixed tenant ids, stub solve
+backends — no device, no wall clock) that the fleet-scale telemetry plane
+holds its four contracts at a tenant cardinality far past the top-K:
+
+1. **Series bound** — after 1000 distinct tenants submit through two
+   FleetFrontends, every guarded metric family holds at most K+1 tenant
+   label values (the top-K exact series plus the `_other` rollup).
+2. **fleetz** — `FleetView.fleetz()` names BOTH replicas (healthy rows
+   with their HBM residency) and the router's tenant pinning for the
+   tenants in the merged top-K table.
+3. **Federated trace** — one solve traced across the wire yields ONE
+   Perfetto document with a client lane and a replica lane joined by the
+   shared trace id.
+4. **Per-tenant SLO burn** — one deliberately-throttled tenant (every
+   solve held 2 s against a 1 s p99 objective) fires the templated
+   `fleet_tenant_p99{tenant=...}` burn edge: an SloBurn warning event
+   AND a flight-recorder bundle on disk, while the other tenants'
+   instances stay healthy.
+
+Run as `make telemetry-drill` (or `python -m benchmarks.telemetry_drill`)
+for the JSON artifact under benchmarks/results/telemetry/, or in-process
+from the tier-1 test (tests/test_telemetry_drill.py)."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+from types import SimpleNamespace
+
+from karpenter_tpu.events import EventRecorder
+from karpenter_tpu.fleet import metrics as fm
+from karpenter_tpu.fleet.frontend import FleetFrontend
+from karpenter_tpu.fleet.router import FleetRouter
+from karpenter_tpu.introspect.flightrecorder import FlightRecorder
+from karpenter_tpu.introspect.fleetview import FleetView, LocalReplica
+from karpenter_tpu.introspect.slo import SloEvaluator
+from karpenter_tpu.metrics import REGISTRY
+from karpenter_tpu.models.pod import make_pod
+from karpenter_tpu.solver import buckets
+from karpenter_tpu.tracing import TRACER, SpanContext, Tracer
+from karpenter_tpu.utils.clock import FakeClock
+
+N_TENANTS = 1000
+HOT = "tenant-hot"
+REPLICAS = ("replica-a", "replica-b")
+SOLVER_KEY = (0xD1A11, 0xBEEF)
+
+
+def _backend(key, problems):
+    # deterministic stub demux: the drill measures telemetry, not packing
+    return [{"pods": len(p["pods"])} for p in problems]
+
+
+def _one_pod(tid):
+    return [make_pod(f"{tid}-p0", cpu="1", memory="2Gi")]
+
+
+def run_drill(out_dir: "str | None" = None) -> dict:
+    """Run the drill; returns the artifact dict (also written to
+    `out_dir` along with the burn bundle when a directory is given)."""
+    clock = FakeClock()
+    recorder = EventRecorder(clock=clock)
+    router = FleetRouter()
+    fronts = {name: FleetFrontend(solve_batch=_backend, clock=clock,
+                                  tick_interval_s=0.01, max_wave=1024,
+                                  name=name)
+              for name in REPLICAS}
+
+    # per-replica HBM ledgers (instance-scoped so the drill leaves the
+    # process-global ledger alone when run inside the test suite)
+    ledgers = {name: buckets.HbmLedger() for name in REPLICAS}
+    key_str = f"{SOLVER_KEY[0]:x}/{SOLVER_KEY[1]:x}"
+    for name, ledger in ledgers.items():
+        with buckets.hbm_scope(key_str):
+            ledger.track(4 << 20, "catalog")       # Sync-resident static
+            ledger.track(1 << 20, "pack_inputs")   # per-solve delta
+        ledger.attribute_delta(key_str, "g8s64")
+
+    def statusz_for(name):
+        def build():
+            return {
+                "schema": 6,
+                "version": "drill",
+                "ts": clock.now(),
+                "resilience": {"watchdog": {"healthy": True}},
+                "hbm": ledgers[name].snapshot(),
+                "fleet": {"frontends": [fronts[name].stats()]},
+            }
+        return build
+
+    # the replica-side trace ring (its serving plane's tracer); the
+    # client half lives in the process-global TRACER
+    replica_tracers = {name: Tracer(ring_size=256, registry=None)
+                       for name in REPLICAS}
+    fleetview = FleetView(router=router, name="drill")
+    for name in REPLICAS:
+        fleetview.add_replica(LocalReplica(
+            name, statusz=statusz_for(name), tracer=replica_tracers[name]))
+
+    # -- traffic: 999 light tenants + 1 hot, routed by rendezvous pinning --
+    tenants = [f"tenant-{i:04d}" for i in range(N_TENANTS - 1)] + [HOT]
+    homes = router.assignment(tenants)
+    for tid in tenants:
+        fronts[homes[tid]].register_key(tid, SOLVER_KEY)
+
+    # phase 1: one fast (good) solve per light tenant, then a good
+    # baseline for the hot tenant LAST so it is still inside the top-K
+    # sketch when the SLO evaluator first discovers its series
+    for tid in tenants[:-1]:
+        fronts[homes[tid]].submit(tid, _one_pod(tid))
+    clock.step(0.01)
+    for fe in fronts.values():
+        fe.tick()
+    for _ in range(2):
+        fronts[homes[HOT]].submit(HOT, _one_pod(HOT))
+    clock.step(0.01)
+    fronts[homes[HOT]].tick()
+
+    # -- per-tenant SLO machinery (stub op: the bundle's statusz sections
+    # it cannot build degrade to fenced errors, by design) --
+    bundle_dir = os.path.join(out_dir, "bundles") if out_dir else None
+    stub_op = SimpleNamespace(clock=clock, recorder=recorder,
+                              metrics_text=REGISTRY.expose)
+    flightrec = FlightRecorder(stub_op, out_dir=bundle_dir, clock=clock)
+    stub_op.flightrecorder = flightrec
+    evaluator = SloEvaluator(clock=clock, recorder=recorder,
+                             flightrecorder=flightrec)
+    stub_op.slo = evaluator
+    evaluator.evaluate()  # seed the rings: every instance's baseline
+
+    # phase 2: throttle the hot tenant — 48 solves each held 2 s against
+    # the 1 s p99 line (the only traffic between the two evaluations, so
+    # the windowed delta is unambiguous)
+    for i in range(48):
+        fronts[homes[HOT]].submit(HOT, _one_pod(HOT))
+    clock.step(2.0)
+    fronts[homes[HOT]].tick()
+    clock.step(1.0)
+    results = evaluator.evaluate()
+
+    hot_iname = f"fleet_tenant_p99{{tenant={HOT}}}"
+    hot_res = results.get(hot_iname, {})
+    burn_events = [(ts, e.object_ref, e.message)
+                   for ts, e in recorder.recent()
+                   if e.reason == "SloBurn" and HOT in e.object_ref]
+    bundles = (sorted(glob.glob(os.path.join(bundle_dir, "bundle_*.json")))
+               if bundle_dir else [])
+    hot_bundles = [b for b in bundles if "fleet_tenant_p99" in b]
+    healthy_peers = [iname for iname, res in results.items()
+                     if iname.startswith("fleet_tenant_p99{")
+                     and iname != hot_iname and not res["burning"]]
+
+    # -- one federated trace for a single solve --
+    with TRACER.start_span("fleet.solve", tenant=HOT) as client_span:
+        server = replica_tracers[homes[HOT]].start_span(
+            "solver.service.Solve",
+            context=SpanContext(client_span.trace_id, client_span.span_id),
+            tenant=HOT)
+        server.end()
+    fed = fleetview.federated_trace(client_span.trace_id)
+    fed_lanes = sorted(e["args"]["name"] for e in (fed or {})["traceEvents"]
+                       if e["ph"] == "M")
+    fed_spans = [e for e in (fed or {})["traceEvents"] if e["ph"] == "X"]
+
+    # -- the joined snapshot --
+    fleetz = fleetview.fleetz()
+    snap = fm.TENANT_GUARD.snapshot()
+
+    criteria = {
+        "series_bounded_k_plus_1": bool(snap["series_per_family"]) and all(
+            n <= snap["k"] + 1 for n in snap["series_per_family"].values()),
+        "fleetz_names_both_replicas": (
+            set(REPLICAS) <= set(fleetz["replicas"])
+            and all(fleetz["replicas"][r].get("healthy") for r in REPLICAS)
+            and fleetz["pinning"].get(HOT) == homes[HOT]),
+        "federated_trace_stitches_client_and_replica": (
+            fed is not None
+            and f"client:{fleetview.name}" in fed_lanes
+            and homes[HOT] in fed_lanes
+            and len(fed_spans) == 2),
+        "per_tenant_slo_burn_fired": (
+            bool(hot_res.get("burning"))
+            and bool(burn_events)
+            and (bool(hot_bundles) if bundle_dir else True)
+            and len(healthy_peers) > 0),
+    }
+    artifact = {
+        "tool": "karpenter-tpu-telemetry-drill",
+        "schema": 1,
+        "tenants": N_TENANTS,
+        "replicas": list(REPLICAS),
+        "hot_tenant": {"id": HOT, "home": homes[HOT],
+                       "slo_instance": hot_iname,
+                       "result": hot_res,
+                       "burn_events": burn_events,
+                       "bundles": hot_bundles,
+                       "healthy_peer_instances": len(healthy_peers)},
+        "tenant_guard": snap,
+        "fleetz": fleetz,
+        "federated_trace": {"trace_id": client_span.trace_id,
+                            "lanes": fed_lanes,
+                            "n_spans": len(fed_spans)},
+        "criteria": criteria,
+        "passed": all(criteria.values()),
+    }
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, "telemetry_drill.json")
+        with open(path, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True, default=str)
+            f.write("\n")
+        artifact["artifact_path"] = path
+    return artifact
+
+
+def main() -> int:
+    out_dir = os.environ.get(
+        "KARPENTER_TPU_DRILL_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+            "benchmarks", "results", "telemetry"))
+    artifact = run_drill(out_dir)
+    print(json.dumps({"passed": artifact["passed"],
+                      "criteria": artifact["criteria"],
+                      "artifact": artifact.get("artifact_path")},
+                     indent=2))
+    return 0 if artifact["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,154 @@
+#!/usr/bin/env python3
+"""Lint: no unbounded identity labels on metric call sites.
+
+The cardinality guard (karpenter_tpu/metrics/cardinality.py) exists
+because one `tenant=tenant_id` on a counter is all it takes to grow one
+series per tenant forever — fine at test scale, a label explosion that
+melts the metrics plane at 1000+ tenants. The guard bounds tenant
+families at K+1 series, but only when call sites actually route their
+label values through it. This lint enforces that: a later change that
+files a raw tenant/pod/node identity straight into `.inc()/.set()/
+.observe()` fails presubmit instead of shipping a time bomb.
+
+Mechanics, AST-based not textual:
+
+  * Every call whose callee attribute is `inc`, `set`, or `observe` is a
+    metric call site; every keyword argument whose name is in UNBOUNDED
+    (tenant/tenant_id/pod/pod_name/node/node_name — labels whose value
+    universe is the fleet, not a code-enumerable set) is checked.
+  * The value passes when it is provably bounded or guarded:
+      - a string literal (code-enumerable by definition);
+      - a call through the guard — `tenant_label(...)`, `tenant_peek(...)`,
+        `<guard>.label(...)`, `<guard>.peek(...)`;
+      - a name that carries a guarded value by convention: `tlabel`,
+        `OTHER`, or any identifier containing "label" (the guard helpers
+        return label values; call sites bind them to *label names).
+  * Anything else — a raw identifier, an f-string, str(x), a subscript —
+    is flagged unless the line (or the contiguous comment block directly
+    above it) carries `# label-cardinality-ok: <why>`. Add new allowlist
+    entries only with a comment proving the value set is bounded.
+  * fleet/metrics.py MUST keep registering tenant families with the
+    guard (`TENANT_GUARD.watch`) — deleting the guard does not pass.
+
+Run via `make presubmit` (or directly: python
+hack/check_label_cardinality.py [files...]; with no arguments the whole
+karpenter_tpu package is scanned).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "karpenter_tpu"
+
+# metric-mutation method names; a keyword on anything else is not a label
+METRIC_METHODS = {"inc", "set", "observe"}
+
+# label names whose value universe is the fleet (unbounded at runtime)
+UNBOUNDED = {"tenant", "tenant_id", "pod", "pod_name", "node", "node_name"}
+
+# calls that ARE the guard: their return value is cardinality-bounded
+GUARD_FUNCS = {"tenant_label", "tenant_peek", "label", "peek"}
+
+# names that carry a guarded value by repo convention
+SAFE_NAMES = {"tlabel", "OTHER"}
+
+# the guard registration that must not silently disappear
+GUARDED_REGISTRATION = PACKAGE / "fleet" / "metrics.py"
+
+_OK = re.compile(r"#\s*label-cardinality-ok")
+
+
+def allowlisted(lines: "list[str]", lineno: int) -> bool:
+    """label-cardinality-ok on the call's line, or in the contiguous
+    comment block directly above it."""
+    if _OK.search(lines[lineno - 1]):
+        return True
+    i = lineno - 2
+    while i >= 0:
+        if _OK.search(lines[i]):
+            return True
+        if not lines[i].strip().startswith("#"):
+            return False
+        i -= 1
+    return False
+
+
+def _callee_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def value_is_guarded(value: ast.AST) -> bool:
+    """Provably bounded: a literal, a guard call, or a name bound to a
+    guarded value by convention."""
+    if isinstance(value, ast.Constant) and isinstance(value.value, str):
+        return True
+    if isinstance(value, ast.Call):
+        return _callee_name(value.func) in GUARD_FUNCS
+    if isinstance(value, ast.Name):
+        return value.id in SAFE_NAMES or "label" in value.id.lower()
+    if isinstance(value, ast.Attribute):
+        return value.attr in SAFE_NAMES or "label" in value.attr.lower()
+    return False
+
+
+def check_file(path: pathlib.Path) -> "list[str]":
+    src = path.read_text()
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}: unparseable ({e})"]
+    errors = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _callee_name(node.func) not in METRIC_METHODS:
+            continue
+        for kw in node.keywords:
+            if kw.arg not in UNBOUNDED:
+                continue
+            if value_is_guarded(kw.value):
+                continue
+            if allowlisted(lines, node.lineno):
+                continue
+            errors.append(
+                f"{path}:{node.lineno}: label `{kw.arg}=` fed from an "
+                "unbounded runtime value — route it through the "
+                "cardinality guard (fleet.metrics.tenant_label/"
+                "tenant_peek) or annotate `# label-cardinality-ok: "
+                "<why bounded>`")
+    return errors
+
+
+def main(argv: "list[str]") -> int:
+    targets = ([pathlib.Path(a) for a in argv]
+               if argv else sorted(PACKAGE.rglob("*.py")))
+    errors: "list[str]" = []
+    for path in targets:
+        errors.extend(check_file(path))
+    if not argv and "TENANT_GUARD.watch" not in \
+            GUARDED_REGISTRATION.read_text():
+        errors.append(
+            f"{GUARDED_REGISTRATION}: tenant families are no longer "
+            "registered with the cardinality guard (TENANT_GUARD.watch) — "
+            "the K+1 series bound is gone")
+    if errors:
+        print("label-cardinality lint FAILED:", file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    print(f"label-cardinality lint ok ({len(targets)} files scanned)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

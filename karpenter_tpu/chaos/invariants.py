@@ -347,6 +347,54 @@ def check_fairness_never_starves(fleet: "dict | None") -> "list[Violation]":
     return out
 
 
+def check_shed_attribution(attribution: "dict | None", totals: dict,
+                           tenants: dict) -> "list[Violation]":
+    """Shed attribution sums match totals: the per-tenant x where x reason
+    table (FleetFrontend.shed_attribution()) must account for EVERY shed
+    the ledger counted — per tenant (each tenant's attributed sheds equal
+    its ledger counters) and in aggregate (the table's admission/queue sums
+    equal the storm totals). An attribution that under-counts would let a
+    fairness drill blame the wrong tenant; one that over-counts would
+    invent shedding that never happened."""
+    inv = "shed-attribution-sums-match-totals"
+    out = []
+    attribution = attribution or {}
+    attr_admission = attr_queue = 0
+    for tid, entry in sorted(attribution.items()):
+        a = sum((entry.get("admission") or {}).values())
+        q = sum((entry.get("queue") or {}).values())
+        attr_admission += a
+        attr_queue += q
+        st = tenants.get(tid)
+        if st is None:
+            out.append(Violation(
+                inv, f"attribution names tenant {tid!r} the ledger never "
+                     f"saw"))
+            continue
+        if a != st["shed_admission"] or q != st["shed_queue"]:
+            out.append(Violation(
+                inv, f"tenant {tid}: attribution says "
+                     f"admission={a}/queue={q}, ledger says "
+                     f"admission={st['shed_admission']}/"
+                     f"queue={st['shed_queue']}"))
+    # tenants with sheds but no attribution row
+    for tid, st in sorted(tenants.items()):
+        if (st["shed_admission"] or st["shed_queue"]) \
+                and tid not in attribution:
+            out.append(Violation(
+                inv, f"tenant {tid} shed "
+                     f"{st['shed_admission'] + st['shed_queue']} "
+                     f"request(s) but has no attribution row"))
+    if attr_admission != totals.get("shed_admission", 0) \
+            or attr_queue != totals.get("shed_queue", 0):
+        out.append(Violation(
+            inv, f"attribution sums admission={attr_admission}/"
+                 f"queue={attr_queue} != totals "
+                 f"admission={totals.get('shed_admission', 0)}/"
+                 f"queue={totals.get('shed_queue', 0)}"))
+    return out
+
+
 def check_columnar_coherence(op) -> "list[Violation]":
     """The columnar mirror IS the cluster: every incrementally-maintained
     column and aggregate equals what a from-scratch rebuild of the node set

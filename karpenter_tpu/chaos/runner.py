@@ -793,6 +793,12 @@ class ChaosRunner:
         totals = {k: sum(st[k] for st in evidence["tenants"].values())
                   for k in ("submitted", "served", "shed_admission",
                             "shed_queue", "errors")}
+        # per-tenant shed attribution (tenant x where x reason): the replay
+        # artifact names WHO absorbed the shedding, and the invariant
+        # reconciles the attribution against the ledger totals
+        attribution = fleet.shed_attribution()
+        violations.extend(invariants.check_shed_attribution(
+            attribution, totals, evidence["tenants"]))
         return {
             "seed": self.seed,
             "scenario": scenario,
@@ -806,6 +812,7 @@ class ChaosRunner:
             "max_batch": max(mega) if mega else 0,
             "mean_batch": round(sum(mega) / len(mega), 3) if mega else 0.0,
             "totals": totals,
+            "shed_attribution": attribution,
             "evidence": evidence,
             "violations": [v.as_dict() for v in violations],
             "passed": not violations,

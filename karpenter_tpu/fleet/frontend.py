@@ -195,6 +195,7 @@ class FleetFrontend:
         self._stop = threading.Event()
         self.ticks_run = 0
         self.mega_solves = 0
+        self._depth_labels: "set[str]" = set()
         _ACTIVE.add(self)
 
     # -- tenant registration ---------------------------------------------------
@@ -257,14 +258,21 @@ class FleetFrontend:
             ticket = _Ticket(tenant_id, list(pods), list(existing),
                              daemon_overhead, st.key, plan, int(deadline_ms),
                              self._tick, self.clock.now(), next(self._seq))
-            fm.REQUESTS.inc(tenant=tenant_id)
+            # the guard offers the tenant to the top-K sketch exactly once
+            # per submission; every other family this submission touches
+            # reuses the same guarded label (peek) so sketch counts track
+            # submissions, not metric fan-out
+            tlabel = fm.tenant_label(tenant_id)
+            fm.REQUESTS.inc(tenant=tlabel)
             # shed at ADMISSION: the request must survive at least one full
             # tick of queueing plus the service's own shed floor, or the
             # answer would arrive after the caller's cycle gave up on it
             min_budget = self.tick_interval_s * 1000.0 + SHED_MIN_BUDGET_MS
             if ticket.deadline_ms and ticket.deadline_ms < min_budget:
                 st.shed_admission += 1
-                fm.SHED.inc(tenant=tenant_id, where="admission")
+                fm.SHED.inc(tenant=tlabel, where="admission")
+                fm.TENANT_SHED.inc(tenant=tlabel, where="admission",
+                                   reason="deadline")
                 ticket._resolve(error=FleetShed(
                     "admission",
                     f"{ticket.deadline_ms}ms of budget cannot survive the "
@@ -342,7 +350,10 @@ class FleetFrontend:
                     if remaining < SHED_MIN_BUDGET_MS:
                         st = self._tenants[tenant_id]
                         st.shed_queue += 1
-                        fm.SHED.inc(tenant=tenant_id, where="queue")
+                        tlabel = fm.tenant_peek(tenant_id)
+                        fm.SHED.inc(tenant=tlabel, where="queue")
+                        fm.TENANT_SHED.inc(tenant=tlabel, where="queue",
+                                           reason="deadline")
                         t._resolve(error=FleetShed(
                             "queue",
                             f"budget expired after "
@@ -443,9 +454,9 @@ class FleetFrontend:
                 wait = t.served_tick - t.admitted_tick
                 st.max_wait_ticks = max(st.max_wait_ticks, wait)
                 t.latency_s = max(0.0, now - t.admitted_at)
-                fm.WAIT_TICKS.observe(wait, tenant=t.tenant_id)
-                fm.TENANT_SOLVE_SECONDS.observe(t.latency_s,
-                                                tenant=t.tenant_id)
+                tlabel = fm.tenant_peek(t.tenant_id)
+                fm.WAIT_TICKS.observe(wait, tenant=tlabel)
+                fm.TENANT_SOLVE_SECONDS.observe(t.latency_s, tenant=tlabel)
                 TRACER.record_span(
                     "fleet.queue_wait",
                     max(0.0, dispatch_started - t.admitted_at),
@@ -459,6 +470,34 @@ class FleetFrontend:
             fm.QUEUE_DEPTH.set(
                 float(sum(len(q) for q in per_tenant.values())),
                 bucket=plan.label())
+        # per-tenant depth + fair-share deficit, guarded (peek: a gauge
+        # sweep is not traffic and must not inflate sketch counts). The
+        # rollup label aggregates every untracked tenant's depth; labels
+        # set last sweep but absent now are zeroed so a drained tenant
+        # doesn't report a stale depth forever.
+        depths: "dict[str, float]" = {}
+        deficits: "dict[str, float]" = {}
+        for per_tenant in self._queues.values():
+            for tid, q in per_tenant.items():
+                if not q:
+                    continue
+                tlabel = fm.tenant_peek(tid)
+                depths[tlabel] = depths.get(tlabel, 0.0) + len(q)
+                share = float(self._tenants[tid].weight)
+                deficits[tlabel] = deficits.get(tlabel, 0.0) + \
+                    max(0.0, len(q) - share)
+        for tlabel in self._depth_labels - set(depths):
+            # zero only labels still live in the sketch: re-setting an
+            # evicted label would resurrect the series its fold deleted
+            if not fm.TENANT_GUARD.is_tracked_label(tlabel):
+                continue
+            fm.TENANT_QUEUE_DEPTH.set(0.0, tenant=tlabel)
+            fm.TENANT_FAIR_SHARE_DEFICIT.set(0.0, tenant=tlabel)
+        for tlabel, depth in depths.items():
+            fm.TENANT_QUEUE_DEPTH.set(depth, tenant=tlabel)
+            fm.TENANT_FAIR_SHARE_DEFICIT.set(
+                deficits.get(tlabel, 0.0), tenant=tlabel)
+        self._depth_labels = set(depths)
 
     # -- observability ---------------------------------------------------------
 
@@ -495,6 +534,7 @@ class FleetFrontend:
                             for (_k, plan), per in self._queues.items()},
                 "tenants": {tid: st.as_dict()
                             for tid, st in self._tenants.items()},
+                "tenant_telemetry": fm.TENANT_GUARD.snapshot(),
             }
 
     def evidence(self) -> dict:
@@ -503,6 +543,26 @@ class FleetFrontend:
         s = self.stats()
         return {"starvation_bound": self.starvation_bound,
                 "queued": s["queued"], "tenants": s["tenants"]}
+
+    def shed_attribution(self) -> dict:
+        """Per-tenant shed attribution (tenant -> where -> reason -> count)
+        for the chaos storm artifact. Built from the frontend's own exact
+        ledgers — NOT the guarded metric families — so every tenant is
+        named even past the top-K, and the sums reconcile against totals
+        (the shed-attribution-sums-match-totals invariant). The only shed
+        reason today is a deadline that could not survive the queue."""
+        with self._lock:
+            out: "dict[str, dict]" = {}
+            for tid, st in sorted(self._tenants.items()):
+                if not (st.shed_admission or st.shed_queue):
+                    continue
+                entry: "dict[str, dict]" = {}
+                if st.shed_admission:
+                    entry["admission"] = {"deadline": st.shed_admission}
+                if st.shed_queue:
+                    entry["queue"] = {"deadline": st.shed_queue}
+                out[tid] = entry
+            return out
 
 
 class FleetService:

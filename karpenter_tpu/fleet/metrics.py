@@ -7,6 +7,24 @@ why, and what latency each tenant actually sees through the queue."""
 from __future__ import annotations
 
 from ..metrics import REGISTRY
+from ..metrics.cardinality import OTHER, CardinalityGuard
+
+# Every tenant-labeled family below routes its label values through this
+# guard: exact series for the top-K heaviest tenants, everything else in
+# one `tenant="_other"` rollup, so series stay O(K) at 1000+ tenants.
+TENANT_GUARD = CardinalityGuard()
+
+
+def tenant_label(tenant_id: str, amount: float = 1.0) -> str:
+    """The guarded label value for one tenant observation (offers to the
+    top-K sketch; an eviction folds the loser's series into the rollup)."""
+    return TENANT_GUARD.label(tenant_id, amount)
+
+
+def tenant_peek(tenant_id: str) -> str:
+    """Read-only guarded label (for gauge sweeps: tracked id or _other)."""
+    return TENANT_GUARD.peek(tenant_id)
+
 
 QUEUE_DEPTH = REGISTRY.gauge(
     "karpenter_fleet_queue_depth",
@@ -52,3 +70,33 @@ WAIT_TICKS = REGISTRY.histogram(
     "fairness invariant bounds this at the frontend's starvation bound.",
     ("tenant",),
     buckets=(0, 1, 2, 4, 8, 16, 32))
+
+TENANT_QUEUE_DEPTH = REGISTRY.gauge(
+    "karpenter_fleet_tenant_queue_depth",
+    "Requests waiting in fleet queues per tracked tenant (top-K exact; "
+    f"everything else rolls up under tenant=\"{OTHER}\"). A tenant pinned "
+    "high here while others drain is the fairness-triage entry point.",
+    ("tenant",))
+
+TENANT_FAIR_SHARE_DEFICIT = REGISTRY.gauge(
+    "karpenter_fleet_tenant_fair_share_deficit",
+    "Queued requests beyond the tenant's per-tick fair share (depth minus "
+    "weighted share, floored at 0), per tracked tenant. Persistent "
+    "deficit means the tenant offers more than its share and is the one "
+    "paying queue latency for it.",
+    ("tenant",))
+
+TENANT_SHED = REGISTRY.counter(
+    "karpenter_fleet_tenant_shed_total",
+    "Shed requests per tracked tenant, split by where the shed happened "
+    "(admission/queue) and reason. The chaos storm's shed-attribution "
+    "invariant reconciles this family against frontend totals.",
+    ("tenant", "where", "reason"))
+
+# Guarded tenant families: an eviction from the top-K folds each of these
+# families' evicted series into the rollup (counters/histograms merge,
+# gauges drop and re-set on the next sweep).
+for _m in (REQUESTS, SHED, TENANT_SOLVE_SECONDS, WAIT_TICKS,
+           TENANT_QUEUE_DEPTH, TENANT_FAIR_SHARE_DEFICIT, TENANT_SHED):
+    TENANT_GUARD.watch(_m, label="tenant")
+del _m

@@ -1,0 +1,323 @@
+"""FleetView: cross-replica observability federation (`/debug/fleetz`).
+
+A multi-replica fleet (fleet/router.py rendezvous pinning, ROADMAP item
+2b) has N disjoint trace rings, N statusz snapshots, and N metric
+registries — a triage that starts from "tenant X is slow" first has to
+guess WHICH replica owns tenant X before any existing surface helps.
+FleetView closes the gap without inventing a control plane: it is an
+in-process registry of replica endpoints whose membership mirrors the
+FleetRouter's, and it answers two questions by fan-out + join over the
+debug surfaces every replica already serves:
+
+* `fleetz()` — one schema-versioned snapshot joining per-replica health,
+  schema, membership epoch, resident-solver keys (the HBM ledger), and
+  per-tenant telemetry, plus the router's tenant->replica pinning and a
+  merged fleet-wide top-K tenant table.
+* `federated_trace(trace_id)` — ONE Perfetto-loadable trace stitching
+  the client-side spans (local tracer) and every replica's server-side
+  spans for the id. No new wire protocol: the trace_context already
+  crosses the solver wire (solver/wire.py), so both halves share the
+  trace id — federation is just collecting the halves into one file,
+  with one Perfetto "process" lane per replica.
+
+Replica endpoints come in two transports behind one duck type
+(`name`, `statusz()`, `trace_spans(id)`, `trace_index(limit)`):
+`LocalReplica` wraps in-process callables (same-process replicas, the
+telemetry drill, and the operator's own "self" row); `HttpReplica`
+fetches the debug endpoints of a remote serving plane over urllib.
+Replica failures degrade to an `"error"` entry in the join — a dead
+replica must never take fleetz down with it; naming the corpse is the
+feature.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+from ..tracing import TRACER, Tracer
+
+FLEETZ_SCHEMA_VERSION = 1
+
+# fan-out budget per replica fetch; a wedged replica costs one timeout,
+# not a hung fleetz
+DEFAULT_TIMEOUT_S = 2.0
+
+
+class LocalReplica:
+    """An in-process replica endpoint: callables instead of HTTP. The
+    operator registers itself this way (its own statusz is a function
+    call), and the telemetry drill builds its 2-replica fleet from
+    these."""
+
+    def __init__(self, name: str,
+                 statusz: "Optional[Callable[[], dict]]" = None,
+                 tracer: "Optional[Tracer]" = None):
+        self.name = name
+        self._statusz = statusz
+        self.tracer = tracer
+
+    def statusz(self) -> "Optional[dict]":
+        return self._statusz() if self._statusz is not None else None
+
+    def trace_spans(self, trace_id: str) -> "list[dict]":
+        return self.tracer.trace(trace_id) if self.tracer is not None else []
+
+    def trace_index(self, limit: int = 20) -> "list[dict]":
+        return (self.tracer.trace_index(limit)
+                if self.tracer is not None else [])
+
+
+class HttpReplica:
+    """A remote replica endpoint: the debug surfaces of its serving
+    plane (serving.py) over HTTP. Every fetch is individually guarded —
+    errors surface as None/[] and the join names them."""
+
+    def __init__(self, name: str, base_url: str,
+                 timeout_s: float = DEFAULT_TIMEOUT_S):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _get_json(self, path: str):
+        req = urllib.request.Request(self.base_url + path,
+                                     headers={"Accept": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def statusz(self) -> "Optional[dict]":
+        return self._get_json("/debug/statusz")
+
+    def trace_spans(self, trace_id: str) -> "list[dict]":
+        try:
+            doc = self._get_json(f"/debug/traces?id={trace_id}&format=spans")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:  # replica has no spans for this id
+                return []
+            raise
+        return doc.get("spans", [])
+
+    def trace_index(self, limit: int = 20) -> "list[dict]":
+        doc = self._get_json(f"/debug/traces?index=1&limit={limit}")
+        return doc.get("traces", [])
+
+
+class FleetView:
+    """The aggregator. Membership changes go through add/remove_replica,
+    which keep the (optional) FleetRouter's member set in lockstep — the
+    pinning fleetz reports is computed by the SAME router instance that
+    routes traffic, so the joined view can never disagree with routing."""
+
+    def __init__(self, router=None, name: str = "fleet",
+                 tracer: "Optional[Tracer]" = None):
+        self.router = router
+        self.name = name
+        # the CLIENT-side ring: where the fleet frontend's queue-wait and
+        # rpc spans live (the other half of every federated trace)
+        self.tracer = tracer if tracer is not None else TRACER
+        self._lock = threading.Lock()
+        self._replicas: "dict[str, object]" = {}
+        self._joined_epoch: "dict[str, int]" = {}
+        self._epoch = 0
+
+    # -- membership ------------------------------------------------------------
+
+    def add_replica(self, replica) -> None:
+        with self._lock:
+            self._epoch += 1
+            self._replicas[replica.name] = replica
+            self._joined_epoch[replica.name] = self._epoch
+        if self.router is not None:
+            self.router.add_replica(replica.name)
+
+    def remove_replica(self, name: str) -> None:
+        with self._lock:
+            if name in self._replicas:
+                self._epoch += 1
+            self._replicas.pop(name, None)
+            self._joined_epoch.pop(name, None)
+        if self.router is not None:
+            try:
+                self.router.remove_replica(name)
+            except KeyError:
+                pass
+
+    def replicas(self) -> "list[str]":
+        with self._lock:
+            return sorted(self._replicas)
+
+    # -- fleetz ----------------------------------------------------------------
+
+    def _replica_summary(self, replica) -> dict:
+        """One replica's row: fetched + fenced. The summary extracts the
+        triage-relevant subset of statusz (full snapshots federate badly
+        — N x 100KB joins help nobody) and keeps the raw sections it
+        came from discoverable by name."""
+        try:
+            snap = replica.statusz()
+        except Exception as e:  # noqa: BLE001 — a dead replica is a row, not an outage
+            return {"healthy": False, "error": f"{type(e).__name__}: {e}"}
+        if not snap:
+            return {"healthy": False, "error": "no statusz"}
+        if "error" in snap and len(snap) == 1:
+            return {"healthy": False, "error": snap["error"]}
+        out = {
+            "healthy": True,
+            "schema": snap.get("schema"),
+            "version": snap.get("version"),
+            "ts": snap.get("ts"),
+        }
+        watchdog = (snap.get("resilience") or {}).get("watchdog")
+        if isinstance(watchdog, dict):
+            out["healthy"] = bool(watchdog.get("healthy", True))
+        hbm = snap.get("hbm") or {}
+        if isinstance(hbm, dict) and "solvers" in hbm:
+            out["resident_solvers"] = sorted(hbm["solvers"])
+            out["hbm_resident_bytes"] = hbm.get("resident_bytes_total")
+            out["hbm_pressure"] = hbm.get("pressure")
+        fleet = snap.get("fleet") or {}
+        fronts = fleet.get("frontends") if isinstance(fleet, dict) else None
+        if fronts:
+            out["tenants"] = {
+                f.get("name", "?"): f.get("tenant_telemetry")
+                for f in fronts if isinstance(f, dict)}
+            out["queued"] = sum(f.get("queued", 0) for f in fronts
+                                if isinstance(f, dict))
+        return out
+
+    def _merged_tenant_table(self, rows: "dict[str, dict]") -> "list[dict]":
+        """Fleet-wide top tenants: sum each tenant's sketch count across
+        replicas (a tenant pinned to one replica appears once; counts are
+        upper bounds exactly as in the per-replica sketches), heaviest
+        first."""
+        totals: "dict[str, float]" = {}
+        errors: "dict[str, float]" = {}
+        for row in rows.values():
+            for telemetry in (row.get("tenants") or {}).values():
+                if not isinstance(telemetry, dict):
+                    continue
+                for ent in telemetry.get("tracked", ()):
+                    t = ent.get("tenant", "")
+                    totals[t] = totals.get(t, 0.0) + ent.get("count", 0.0)
+                    errors[t] = errors.get(t, 0.0) + ent.get("error", 0.0)
+        return [{"tenant": t, "count": totals[t], "error": errors.get(t, 0.0)}
+                for t in sorted(totals, key=lambda t: (-totals[t], t))]
+
+    def fleetz(self, tenant_ids=None) -> dict:
+        """The joined snapshot. `tenant_ids` scopes the pinning table
+        (routing is a pure function, so the full tenant universe isn't
+        enumerable from the router — callers name the tenants they care
+        about; the merged tenant table's tenants are used otherwise)."""
+        with self._lock:
+            replicas = dict(self._replicas)
+            joined = dict(self._joined_epoch)
+            epoch = self._epoch
+        rows = {name: self._replica_summary(r)
+                for name, r in sorted(replicas.items())}
+        for name, row in rows.items():
+            row["joined_epoch"] = joined.get(name)
+        tenants = self._merged_tenant_table(rows)
+        pinning: "dict[str, str]" = {}
+        if self.router is not None:
+            if tenant_ids is None:
+                tenant_ids = [t["tenant"] for t in tenants
+                              if not t["tenant"].startswith("_")]
+            try:
+                pinning = self.router.assignment(tenant_ids)
+            except Exception:  # noqa: BLE001 — empty membership etc.
+                pinning = {}
+        return {
+            "tool": "karpenter-tpu-fleetz",
+            "schema": FLEETZ_SCHEMA_VERSION,
+            "ts": time.time(),
+            "name": self.name,
+            "membership_epoch": epoch,
+            "replicas": rows,
+            "pinning": pinning,
+            "tenants": tenants,
+        }
+
+    # -- trace federation ------------------------------------------------------
+
+    def federated_trace(self, trace_id: str) -> "Optional[dict]":
+        """One Chrome/Perfetto trace for the id, client + every replica.
+
+        Layout: pid 0 is the client process (this view's tracer — fleet
+        queue-wait, rpc spans), each replica gets its own pid with a
+        process_name metadata event, so Perfetto renders the federation
+        as parallel process lanes sharing one clock. Spans are deduped by
+        span_id (an in-process replica may share the client's ring).
+        Returns None when NOBODY has spans for the id (-> 404)."""
+        lanes: "list[tuple[str, list[dict]]]" = [
+            ("client:" + self.name, self.tracer.trace(trace_id))]
+        with self._lock:
+            replicas = sorted(self._replicas.items())
+        for name, replica in replicas:
+            try:
+                spans = replica.trace_spans(trace_id)
+            except Exception:  # noqa: BLE001 — a dead replica drops its lane only
+                spans = []
+            lanes.append((name, spans))
+        if not any(spans for _name, spans in lanes):
+            return None
+        events: "list[dict]" = []
+        seen: "set[str]" = set()
+        for pid, (lane_name, spans) in enumerate(lanes):
+            if not spans:
+                continue
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "tid": 0, "args": {"name": lane_name}})
+            tids: "dict[str, int]" = {}
+            for s in spans:
+                sid = s.get("span_id", "")
+                if sid and sid in seen:
+                    continue
+                seen.add(sid)
+                thread = str(s.get("thread", ""))
+                tid = tids.setdefault(thread, len(tids))
+                args = dict(s.get("attributes", {}))
+                args["replica"] = lane_name
+                events.append({
+                    "name": s.get("name", "?"),
+                    "cat": s.get("trace_id", trace_id),
+                    "ph": "X",
+                    "ts": s.get("start_ts", 0.0) * 1e6,
+                    "dur": s.get("duration_ms", 0.0) * 1e3,
+                    "pid": pid,
+                    "tid": tid,
+                    "args": args,
+                })
+        events.sort(key=lambda e: (e["ph"] != "M", e.get("ts", 0.0)))
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def trace_index(self, limit: int = 20) -> "list[dict]":
+        """The merged `/debug/traces` index: client + replica indexes,
+        deduped by trace id (client row wins — it has the tenant
+        annotations), newest first."""
+        merged: "dict[str, dict]" = {}
+        for row in self.tracer.trace_index(limit):
+            merged.setdefault(row["trace_id"], row)
+        with self._lock:
+            replicas = sorted(self._replicas.items())
+        for name, replica in replicas:
+            try:
+                rows = replica.trace_index(limit)
+            except Exception:  # noqa: BLE001
+                continue
+            for row in rows:
+                prev = merged.get(row["trace_id"])
+                if prev is None:
+                    row = dict(row)
+                    row.setdefault("replicas", [])
+                    merged[row["trace_id"]] = row
+                    prev = row
+                reps = set(prev.get("replicas") or [])
+                reps.add(name)
+                prev["replicas"] = sorted(reps)
+        rows = sorted(merged.values(),
+                      key=lambda r: r.get("start_ts", 0.0), reverse=True)
+        return rows[:limit] if limit else rows

@@ -25,7 +25,14 @@ short-window burn ≥ BURN_THRESHOLD edge-triggers an `SloBurn` warning
 event and a flight-recorder bundle (the statusz snapshot at the moment
 of the burn is exactly the evidence a triage needs); dropping back under
 triggers `SloRecovered`. Results land in `karpenter_slo_*` gauges and
-the statusz `slo` section (schema 5).
+the statusz `slo` section.
+
+Label-templated SLOs (`per_label`): one declarative row expands into one
+evaluated instance per distinct value of that label found in the metric's
+series — `fleet_tenant_p99` becomes `fleet_tenant_p99{tenant=hot}`,
+`...{tenant=_other}`, etc. Instance count is bounded because tenant
+families sit behind the cardinality guard (metrics/cardinality.py):
+at most K+1 label values exist, so at most K+1 instances ring up.
 """
 
 from __future__ import annotations
@@ -53,14 +60,14 @@ class Slo:
 
     __slots__ = ("name", "kind", "metric", "labels", "threshold_s",
                  "objective", "num_metric", "num_labels", "den_metric",
-                 "den_labels", "threshold", "description")
+                 "den_labels", "threshold", "description", "per_label")
 
     def __init__(self, name: str, kind: str, description: str = "", *,
                  metric: str = "", labels: "Optional[dict]" = None,
                  threshold_s: float = 0.0, objective: float = 0.99,
                  num_metric: str = "", num_labels: "Optional[dict]" = None,
                  den_metric: str = "", den_labels: "Optional[dict]" = None,
-                 threshold: float = 1.0):
+                 threshold: float = 1.0, per_label: str = ""):
         self.name = name
         self.kind = kind
         self.description = description
@@ -73,6 +80,10 @@ class Slo:
         self.den_metric = den_metric
         self.den_labels = dict(den_labels or {})
         self.threshold = threshold
+        # label-templated SLO: evaluate one instance per distinct value of
+        # this label found in the metric's series (bounded by the
+        # cardinality guard — at most K+1 values for tenant families)
+        self.per_label = per_label
 
 
 # The SLO table (ISSUE 10). Latency thresholds are error-budget lines, not
@@ -92,6 +103,11 @@ SLO_TABLE = (
         "99% of fleet tenant solves complete within 1 s",
         metric=f"{NAMESPACE}_fleet_tenant_solve_seconds", labels={},
         threshold_s=1.0, objective=0.99),
+    Slo("fleet_tenant_p99", "latency",
+        "99% of each tracked tenant's fleet solves complete within 1 s "
+        "(one burn rate per tenant in the top-K, plus the _other rollup)",
+        metric=f"{NAMESPACE}_fleet_tenant_solve_seconds", labels={},
+        threshold_s=1.0, objective=0.99, per_label="tenant"),
     Slo("fleet_shed_rate", "share",
         "shed fleet requests stay under 5% of submissions",
         num_metric=f"{NAMESPACE}_fleet_shed_total",
@@ -169,18 +185,23 @@ class SloEvaluator:
             m = self.registry._metrics.get(name)
         return m if isinstance(m, Histogram) else None
 
-    def _latency_counts(self, slo: Slo) -> "tuple[float, float]":
+    def _latency_counts(self, slo: Slo,
+                        want: "Optional[dict]" = None
+                        ) -> "tuple[float, float]":
         """(good, total) cumulative events under/at the threshold, counted
         at the first bucket boundary >= threshold (conservative: events in
-        the straddling bucket count as good only if the whole bucket is)."""
+        the straddling bucket count as good only if the whole bucket is).
+        `want` overrides the SLO's label filter (templated instances)."""
         h = self._histogram(slo.metric)
         if h is None:
             return 0.0, 0.0
+        if want is None:
+            want = slo.labels
         good = total = 0.0
         with h._lock:
             for key, counts in h._counts.items():
                 labels = dict(zip(h.label_names, key))
-                if not _match(labels, slo.labels):
+                if not _match(labels, want):
                     continue
                 total += h._totals[key]
                 cum = 0.0
@@ -208,13 +229,45 @@ class SloEvaluator:
             return 0.0
         return sum(v for labels, v in m.collect() if _match(labels, want))
 
-    def _counts(self, slo: Slo) -> "tuple[float, float]":
+    def _counts(self, slo: Slo, want: "Optional[dict]" = None
+                ) -> "tuple[float, float]":
         """Cumulative (numerator, denominator) for this SLO. For latency:
         (good, total) events. For share: (num_sum, den_sum)."""
         if slo.kind == "latency":
-            return self._latency_counts(slo)
+            return self._latency_counts(slo, want)
         return (self._sum(slo.num_metric, slo.num_labels),
                 self._sum(slo.den_metric, slo.den_labels))
+
+    def _label_values(self, metric_name: str, label: str) -> "list[str]":
+        """Distinct values of `label` across the histogram's series —
+        the instance axis for a templated SLO. Bounded in practice: tenant
+        families sit behind the cardinality guard (<= K+1 values)."""
+        h = self._histogram(metric_name)
+        if h is None:
+            return []
+        try:
+            idx = h.label_names.index(label)
+        except ValueError:
+            return []
+        with h._lock:
+            return sorted({key[idx] for key in h._totals})
+
+    def _instances(self) -> "list[tuple[str, Slo, Optional[dict]]]":
+        """The evaluation list: (instance_name, slo, label_filter). Plain
+        SLOs evaluate once under their own name; a per_label SLO expands
+        into one instance per discovered label value, named
+        `slo{label=value}` (the key for its ring, gauges, and edges)."""
+        out: "list[tuple[str, Slo, Optional[dict]]]" = []
+        for slo in self.slos:
+            if not slo.per_label:
+                out.append((slo.name, slo, None))
+                continue
+            for value in self._label_values(slo.metric, slo.per_label):
+                want = dict(slo.labels)
+                want[slo.per_label] = value
+                out.append((f"{slo.name}{{{slo.per_label}={value}}}",
+                            slo, want))
+        return out
 
     # -- evaluation ------------------------------------------------------------
 
@@ -240,16 +293,21 @@ class SloEvaluator:
         results: "dict[str, dict]" = {}
         # edge transitions collected under the lock, fired after releasing
         # it: the burn bundle captures statusz, which re-enters snapshot()
-        edges: "list[tuple[str, Slo, dict, str]]" = []
+        edges: "list[tuple[str, str, Slo, dict, str]]" = []
         with self._lock:
-            for slo in self.slos:
-                num, den = self._counts(slo)
-                ring = self._rings[slo.name]
+            for iname, slo, want in self._instances():
+                num, den = self._counts(slo, want)
+                # templated instances appear (and ring up) lazily, as
+                # their label values first show in the metric series
+                ring = self._rings.setdefault(
+                    iname, collections.deque(maxlen=4096))
                 ring.append((now, num, den))
                 res = {"kind": slo.kind, "description": slo.description,
                        "objective": (slo.objective if slo.kind == "latency"
                                      else 1.0 - slo.threshold),
                        "windows": {}}
+                if want is not None:
+                    res["labels"] = {slo.per_label: want[slo.per_label]}
                 budget = (max(1e-9, 1.0 - slo.objective)
                           if slo.kind == "latency"
                           else max(1e-9, slo.threshold))
@@ -266,49 +324,52 @@ class SloEvaluator:
                         "burn_rate": round(burn, 4),
                         "events": dd if slo.kind == "latency" else None,
                     }
-                    self.g_current.set(value, slo=slo.name, window=wname)
-                    self.g_burn.set(burn, slo=slo.name, window=wname)
+                    self.g_current.set(value, slo=iname, window=wname)
+                    self.g_burn.set(burn, slo=iname, window=wname)
                 short = self.windows[0][0]
                 burning = (res["windows"][short]["burn_rate"]
                            >= self.burn_threshold)
                 res["burning"] = burning
-                self.g_healthy.set(0.0 if burning else 1.0, slo=slo.name)
-                self.g_target.set(res["objective"], slo=slo.name)
-                was = self._burning[slo.name]
-                self._burning[slo.name] = burning
-                results[slo.name] = res
+                self.g_healthy.set(0.0 if burning else 1.0, slo=iname)
+                self.g_target.set(res["objective"], slo=iname)
+                was = self._burning.get(iname, False)
+                self._burning[iname] = burning
+                results[iname] = res
                 if burning and not was:
-                    edges.append(("burn", slo, res, short))
+                    edges.append(("burn", iname, slo, res, short))
                 elif was and not burning:
-                    edges.append(("recovered", slo, res, short))
+                    edges.append(("recovered", iname, slo, res, short))
             self._last = results
-        for kind, slo, res, short in edges:
+        for kind, iname, slo, res, short in edges:
             if kind == "burn":
-                self._on_burn(slo, res, short)
+                self._on_burn(iname, slo, res, short)
             else:
-                self._on_recovered(slo, res, short)
+                self._on_recovered(iname, slo, res, short)
         return results
 
-    def _on_burn(self, slo: Slo, res: dict, window: str) -> None:
-        detail = (f"{slo.name} burn_rate="
+    def _on_burn(self, iname: str, slo: Slo, res: dict,
+                 window: str) -> None:
+        detail = (f"{iname} burn_rate="
                   f"{res['windows'][window]['burn_rate']} over {window} "
                   f"(objective: {slo.description})")
         if self.recorder is not None:
-            self.recorder.warning("slo/" + slo.name, "SloBurn", detail)
+            self.recorder.warning("slo/" + iname, "SloBurn", detail)
         if self.flightrecorder is not None:
             # the bundle captures statusz AT the burn edge — the phase
             # split and queue depths that explain it are still hot
+            # (the trigger sanitizes iname's {tenant=...} for the filename)
             try:
-                self.flightrecorder.trigger(f"slo_burn_{slo.name}",
+                self.flightrecorder.trigger(f"slo_burn_{iname}",
                                             detail=detail)
             except Exception:  # noqa: BLE001 — diagnostics must not cascade
                 pass
 
-    def _on_recovered(self, slo: Slo, res: dict, window: str) -> None:
+    def _on_recovered(self, iname: str, slo: Slo, res: dict,
+                      window: str) -> None:
         if self.recorder is not None:
             self.recorder.normal(
-                "slo/" + slo.name, "SloRecovered",
-                f"{slo.name} burn back under {self.burn_threshold} "
+                "slo/" + iname, "SloRecovered",
+                f"{iname} burn back under {self.burn_threshold} "
                 f"over {window}")
 
     # -- read side -------------------------------------------------------------

@@ -23,7 +23,7 @@ from ..metrics import REGISTRY, Gauge, Histogram
 
 log = logging.getLogger("karpenter.statusz")
 
-SCHEMA_VERSION = 5  # 5: added the "slo" section (burn rates; 4: "fleet")
+SCHEMA_VERSION = 6  # 6: added the "hbm" section (5: "slo"; 4: "fleet")
 
 # hard caps so a pathological operator can't make statusz unbounded
 MAX_EVENTS = 50
@@ -151,6 +151,14 @@ def _fleet_section() -> dict:
     return {"frontends": [f.stats() for f in active_frontends()]}
 
 
+def _hbm_section() -> dict:
+    # lazy import mirrors _fleet_section (buckets imports no jax at module
+    # level, but the solver package is still optional surface area here)
+    from ..solver.buckets import HBM
+
+    return HBM.snapshot()
+
+
 def snapshot(op) -> dict:
     """The one consistent operator snapshot (see module docstring)."""
     return {
@@ -167,5 +175,6 @@ def snapshot(op) -> dict:
         "recovery": _fenced(lambda: op.recovery.snapshot()),
         "fleet": _fenced(_fleet_section),
         "slo": _fenced(lambda: op.slo.snapshot()),
+        "hbm": _fenced(_hbm_section),
         "metrics": _fenced(_metrics_section),
     }

@@ -1,0 +1,286 @@
+"""Cardinality-bounded per-tenant telemetry (the top-K tenant guard).
+
+ROADMAP item 2 wants the fleet provable at >= 1000 tenants, but every
+tenant-labeled metric family grows one series per distinct tenant id —
+at fleet scale that is an unbounded label explosion that melts Prometheus
+and makes `/debug/statusz` unreadable exactly when it matters. This
+module bounds it: a space-saving sketch (Metwally et al. "Efficient
+Computation of Frequent and Top-k Elements in Data Streams") tracks the
+K heaviest tenants EXACTLY (within the sketch's documented error bound)
+and every other tenant folds into one `tenant="_other"` rollup series,
+so a guarded family holds at most K+1 tenant values no matter how many
+tenants exist.
+
+Mechanics:
+
+* `TenantTracker` is the sketch: at most K counters. A tracked tenant's
+  offer increments its counter. An untracked tenant REPLACES the
+  minimum-count entry (count = min + amount, error = min) — the classic
+  space-saving admission that guarantees any tenant with true frequency
+  above N/K is tracked.
+* `CardinalityGuard` wraps the sketch around metric families. Call sites
+  route label values through `guard.label(tenant_id)`; when an offer
+  evicts a tenant from the top-K, the guard FOLDS that tenant's existing
+  series — counter values added into `_other`, histogram buckets/sums/
+  totals merged into `_other`, gauge series dropped (gauges are
+  last-write; the next tick re-sets the rollup) — so no observation is
+  ever double-counted and no evicted series lingers.
+* Tenant ids are escaped so a real tenant literally named "_other" can
+  never collide with the rollup: any id starting with "_" gains one more
+  leading "_" (injective), and only the guard itself ever emits the bare
+  `_other`.
+
+K is env-tunable (KARPENTER_TPU_TENANT_TOPK, default 32), validated the
+same way the crossover knob is (solver/buckets.py): a garbage value
+warns and falls back rather than silently changing series budgets.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Iterable, Optional
+
+from . import Counter, Gauge, Histogram, _Metric
+
+log = logging.getLogger("karpenter.metrics.cardinality")
+
+# the rollup label value; real tenant ids are escaped away from it
+OTHER = "_other"
+
+DEFAULT_K = 32
+K_ENV = "KARPENTER_TPU_TENANT_TOPK"
+
+
+def top_k_default() -> int:
+    """The env-tunable K, validated: a bad value warns and falls back,
+    a value < 1 clamps to 1 (a zero-width sketch cannot exist — every
+    guarded family needs at least the rollup plus one exact series)."""
+    raw = os.environ.get(K_ENV)
+    if raw is None:
+        return DEFAULT_K
+    try:
+        k = int(raw)
+    except ValueError:
+        log.warning("%s=%r is not an integer; falling back to K=%d",
+                    K_ENV, raw, DEFAULT_K)
+        return DEFAULT_K
+    if k < 1:
+        log.warning("%s=%d is < 1; clamping to 1", K_ENV, k)
+        return 1
+    return k
+
+
+def escape(tenant_id: str) -> str:
+    """Injective escape keeping real tenant ids out of the rollup's
+    namespace: ids starting with "_" gain one more "_" (so "_other" ->
+    "__other", "__other" -> "___other", ...); everything else passes
+    through unchanged. Only the guard emits the bare OTHER value."""
+    if tenant_id.startswith("_"):
+        return "_" + tenant_id
+    return tenant_id
+
+
+class TenantTracker:
+    """The space-saving sketch: at most `k` (tenant -> (count, error))
+    counters. Not thread-safe on its own — CardinalityGuard serializes
+    access (and tests drive it single-threaded)."""
+
+    __slots__ = ("k", "_counts", "_errors", "offers", "evictions")
+
+    def __init__(self, k: "Optional[int]" = None):
+        self.k = top_k_default() if k is None else max(1, int(k))
+        self._counts: "dict[str, float]" = {}
+        self._errors: "dict[str, float]" = {}
+        self.offers = 0
+        self.evictions = 0
+
+    def offer(self, key: str, amount: float = 1.0
+              ) -> "tuple[str, Optional[str]]":
+        """One observation of `key`. Returns (key, evicted): `key` is now
+        tracked; `evicted` names the entry it displaced (None when the
+        sketch had room or the key was already tracked)."""
+        self.offers += 1
+        if key in self._counts:
+            self._counts[key] += amount
+            return key, None
+        if len(self._counts) < self.k:
+            self._counts[key] = amount
+            self._errors[key] = 0.0
+            return key, None
+        # full: displace the minimum-count entry (ties break by key so
+        # the choice is deterministic across processes/replays)
+        victim = min(self._counts, key=lambda t: (self._counts[t], t))
+        floor = self._counts.pop(victim)
+        self._errors.pop(victim, None)
+        self._counts[key] = floor + amount
+        self._errors[key] = floor
+        self.evictions += 1
+        return key, victim
+
+    def tracked(self) -> "dict[str, float]":
+        return dict(self._counts)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._counts
+
+    def table(self) -> "list[dict]":
+        """The top-K table, heaviest first (count is an upper bound on the
+        true frequency; count - error a lower bound)."""
+        return [{"tenant": t, "count": self._counts[t],
+                 "error": self._errors.get(t, 0.0)}
+                for t in sorted(self._counts,
+                                key=lambda t: (-self._counts[t], t))]
+
+
+class CardinalityGuard:
+    """The label gate in front of tenant-labeled metric families.
+
+    Families are registered with `watch(metric, label="tenant")`; call
+    sites route ids through `label(tenant_id)` (which offers to the
+    sketch and folds evictions) or `peek(tenant_id)` (read-only: for
+    per-tick gauge sweeps that must not inflate sketch counts).
+    """
+
+    def __init__(self, k: "Optional[int]" = None,
+                 tracker: "Optional[TenantTracker]" = None):
+        self._lock = threading.Lock()
+        self.tracker = tracker if tracker is not None else TenantTracker(k)
+        self.k = self.tracker.k
+        self._families: "list[tuple[_Metric, str]]" = []
+        self.folded = 0  # evictions whose series were folded into OTHER
+
+    # -- registration ----------------------------------------------------------
+
+    def watch(self, metric: _Metric, label: str = "tenant") -> _Metric:
+        """Register a family for eviction folding; returns the metric so
+        registration can wrap construction. A family without the label is
+        rejected loudly — guarding it would silently do nothing."""
+        if label not in metric.label_names:
+            raise ValueError(
+                f"metric {metric.name} has no {label!r} label "
+                f"(labels: {metric.label_names})")
+        with self._lock:
+            if (metric, label) not in self._families:
+                self._families.append((metric, label))
+        return metric
+
+    def families(self) -> "list[tuple[_Metric, str]]":
+        with self._lock:
+            return list(self._families)
+
+    # -- the gate --------------------------------------------------------------
+
+    def label(self, tenant_id: str, amount: float = 1.0) -> str:
+        """The label value to emit for one observation of `tenant_id`:
+        the (escaped) id itself — offering it to the sketch, folding any
+        eviction — since an offered tenant is always tracked afterwards.
+        Empty ids go straight to the rollup."""
+        if not tenant_id:
+            return OTHER
+        key = escape(tenant_id)
+        with self._lock:
+            _, evicted = self.tracker.offer(key, amount)
+            families = list(self._families)
+            if evicted is not None:
+                self.folded += 1
+        if evicted is not None:
+            for metric, lname in families:
+                _fold_series(metric, lname, evicted, OTHER)
+        return key
+
+    def peek(self, tenant_id: str) -> str:
+        """Read-only gate: the id when tracked, else OTHER. For gauge
+        sweeps (queue depth per tick) that must not count as traffic."""
+        if not tenant_id:
+            return OTHER
+        key = escape(tenant_id)
+        with self._lock:
+            return key if key in self.tracker else OTHER
+
+    def is_tracked_label(self, label: str) -> bool:
+        """Whether an ALREADY-ESCAPED label value is currently live (the
+        rollup always is). Gauge sweeps consult this before zeroing a
+        stale label: re-setting a label the sketch evicted would
+        resurrect the series the eviction fold just deleted."""
+        if label == OTHER:
+            return True
+        with self._lock:
+            return label in self.tracker
+
+    # -- read side -------------------------------------------------------------
+
+    def series_values(self, metric: _Metric, label: str = "tenant"
+                      ) -> "set[str]":
+        """Distinct label values currently present in the family."""
+        try:
+            idx = metric.label_names.index(label)
+        except ValueError:
+            return set()
+        with metric._lock:
+            if isinstance(metric, Histogram):
+                keys: "Iterable[tuple]" = metric._totals.keys()
+            else:
+                keys = metric._values.keys()
+            return {k[idx] for k in keys}
+
+    def series_count(self, metric: _Metric, label: str = "tenant") -> int:
+        return len(self.series_values(metric, label))
+
+    def snapshot(self) -> dict:
+        """The statusz/fleetz tenant table: K, the top-K with counts and
+        error bounds, offer/eviction totals, and per-family series
+        counts (each must stay <= K+1 — the whole point)."""
+        with self._lock:
+            table = self.tracker.table()
+            offers = self.tracker.offers
+            evictions = self.tracker.evictions
+            families = list(self._families)
+            folded = self.folded
+        return {
+            "k": self.k,
+            "tracked": table,
+            "offers": offers,
+            "evictions": evictions,
+            "folded": folded,
+            "series_per_family": {
+                m.name: self.series_count(m, lname)
+                for m, lname in families},
+        }
+
+
+def _fold_series(metric: _Metric, label: str, from_value: str,
+                 to_value: str) -> None:
+    """Merge every series of `metric` whose `label` equals `from_value`
+    into the matching series with `to_value` (other labels preserved),
+    then drop the source series. Counters add, histograms merge
+    buckets/sums/totals (the source's exemplar is discarded — its trace
+    names a tenant the rollup no longer identifies), gauges drop (last-
+    write semantics: summing two gauges fabricates a number nobody set)."""
+    try:
+        idx = metric.label_names.index(label)
+    except ValueError:
+        return
+    with metric._lock:
+        if isinstance(metric, Histogram):
+            for key in [k for k in metric._totals if k[idx] == from_value]:
+                dst = key[:idx] + (to_value,) + key[idx + 1:]
+                counts = metric._counts.pop(key)
+                dst_counts = metric._counts.setdefault(
+                    dst, [0] * len(metric.buckets))
+                for i, c in enumerate(counts):
+                    dst_counts[i] += c
+                metric._sums[dst] = metric._sums.get(dst, 0.0) + \
+                    metric._sums.pop(key)
+                metric._totals[dst] = metric._totals.get(dst, 0) + \
+                    metric._totals.pop(key)
+                metric._exemplars.pop(key, None)
+        elif isinstance(metric, Gauge):
+            for key in [k for k in metric._values if k[idx] == from_value]:
+                metric._values.pop(key)
+        elif isinstance(metric, Counter):
+            for key in [k for k in metric._values if k[idx] == from_value]:
+                dst = key[:idx] + (to_value,) + key[idx + 1:]
+                metric._values[dst] = metric._values.get(dst, 0.0) + \
+                    metric._values.pop(key)

@@ -256,6 +256,17 @@ class Operator:
 
         self.slo = SloEvaluator(clock=self.clock, recorder=self.recorder,
                                 flightrecorder=self.flightrecorder)
+        # fleet federation view (/debug/fleetz): this replica is always its
+        # own first member; multi-replica deployments add Http/Local
+        # replicas (and a FleetRouter) as they join
+        from .introspect import statusz as _statusz
+        from .introspect.fleetview import FleetView, LocalReplica
+
+        self.fleetview = FleetView(name=os.environ.get(
+            "KARPENTER_TPU_REPLICA_NAME", "self"))
+        self.fleetview.add_replica(LocalReplica(
+            self.fleetview.name,
+            statusz=lambda: _statusz.snapshot(self)))
         # crash-restart recovery: epoch minting + stranded-intent replay on
         # each incarnation (docs/designs/recovery.md)
         self.recovery = RecoveryManager(self)

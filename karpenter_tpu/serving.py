@@ -108,17 +108,48 @@ class ServingPlane:
                             fr.bundle("manual", "GET /debug/bundle"),
                             default=str),
                         content_type="application/json")
+                if self.path.startswith("/debug/fleetz"):
+                    # cross-replica joined snapshot (introspect/fleetview):
+                    # per-replica health/epoch/residency/tenants + the
+                    # router's tenant pinning, one schema-versioned doc
+                    fv = getattr(op, "fleetview", None)
+                    if fv is None:
+                        return self._text(404, "fleet view not wired")
+                    return self._text(
+                        200, json.dumps(fv.fleetz(), default=str),
+                        content_type="application/json")
                 if self.path.startswith("/debug/traces"):
                     # recent traces as JSON; ?id=<trace_id> exports ONE trace
                     # in Chrome trace_event format (load in Perfetto /
-                    # chrome://tracing); ?limit=N bounds the listing
+                    # chrome://tracing) — federated across replicas when a
+                    # fleet view is wired; ?id=&format=spans returns the raw
+                    # span dicts (the fetch side of federation); ?index=1
+                    # lists ids only (root, duration, tenant/replica
+                    # annotations); ?limit=N bounds the listing
                     from urllib.parse import parse_qs, urlsplit
 
                     from .tracing import TRACER
 
                     qs = parse_qs(urlsplit(self.path).query)
                     trace_id = qs.get("id", [None])[0]
+                    fv = getattr(op, "fleetview", None)
                     if trace_id:
+                        if qs.get("format", [""])[0] == "spans":
+                            spans = TRACER.trace(trace_id)
+                            if not spans:
+                                return self._text(404, "unknown trace id")
+                            return self._text(
+                                200, json.dumps(
+                                    {"trace_id": trace_id, "spans": spans},
+                                    default=str),
+                                content_type="application/json")
+                        if fv is not None:
+                            doc = fv.federated_trace(trace_id)
+                            if doc is None:
+                                return self._text(404, "unknown trace id")
+                            return self._text(
+                                200, json.dumps(doc, default=str),
+                                content_type="application/json")
                         if not TRACER.trace(trace_id):
                             return self._text(404, "unknown trace id")
                         return self._text(
@@ -131,6 +162,12 @@ class ServingPlane:
                         # look like a tiny trace ring
                         return self._text(400, "limit must be an integer")
                     limit = min(max(limit, 1), MAX_TRACE_LIMIT)
+                    if qs.get("index", [""])[0]:
+                        index = (fv.trace_index(limit) if fv is not None
+                                 else TRACER.trace_index(limit))
+                        return self._text(
+                            200, json.dumps({"traces": index}, default=str),
+                            content_type="application/json")
                     return self._text(
                         200, json.dumps({"traces": TRACER.traces(limit)},
                                         default=str),

@@ -29,8 +29,10 @@ hit/miss/warmup counters behind `Sync`-time pre-jit.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
+import threading
 from typing import NamedTuple, Optional
 
 from ..metrics import REGISTRY
@@ -228,6 +230,185 @@ BUCKET_SOLVES = REGISTRY.counter(
     ("bucket", "route"))
 
 
+# -- HBM residency ledger -----------------------------------------------------
+
+HBM_RESIDENT_BYTES = REGISTRY.gauge(
+    "karpenter_solver_hbm_resident_bytes",
+    "Device bytes resident per solver key (catalog content hash pair) and "
+    "tensor class — catalog classes accumulate across Sync, per-solve "
+    "delta classes carry the LAST solve's bytes per BucketPlan rung "
+    "(donated buffers reuse, they don't stack). The LRU reads the summed "
+    "pressure against KARPENTER_TPU_HBM_CAPACITY_BYTES.",
+    ("solver_key", "tensor"))
+
+HBM_CAPACITY_ENV = "KARPENTER_TPU_HBM_CAPACITY_BYTES"
+
+# delta bytes tracked mid-solve land on this pending rung until
+# attribute_delta files them under the solve's actual bucket label
+_PENDING_RUNG = "_pending"
+
+
+def hbm_capacity_default() -> "Optional[int]":
+    """Env-declared device HBM budget in bytes; None (unset/invalid) means
+    capacity is unknown and pressure-based eviction stays disarmed — the
+    right default on CPU hosts where "HBM" is just process heap."""
+    raw = os.environ.get(HBM_CAPACITY_ENV)
+    if raw is None:
+        return None
+    try:
+        cap = int(raw)
+    except ValueError:
+        log.warning("%s=%r is not an integer; HBM pressure disabled",
+                    HBM_CAPACITY_ENV, raw)
+        return None
+    if cap <= 0:
+        log.warning("%s=%d is not positive; HBM pressure disabled",
+                    HBM_CAPACITY_ENV, cap)
+        return None
+    return cap
+
+
+class _HbmScope(threading.local):
+    solver_key: str = ""
+    bucket: str = ""
+
+
+_SCOPE = _HbmScope()
+
+
+@contextlib.contextmanager
+def hbm_scope(solver_key: str, bucket: str = ""):
+    """Attribute every tracked device put on this thread to `solver_key`
+    (and, for delta tensors, to `bucket` when known at entry). The scope
+    travels through core.py untouched — call sites keep their signatures;
+    the service wraps build/solve in the scope it already knows the key
+    for."""
+    prev_key, prev_bucket = _SCOPE.solver_key, _SCOPE.bucket
+    _SCOPE.solver_key, _SCOPE.bucket = solver_key, bucket
+    try:
+        yield
+    finally:
+        _SCOPE.solver_key, _SCOPE.bucket = prev_key, prev_bucket
+
+
+class HbmLedger:
+    """Bytes resident on device per solver key, split static vs delta.
+
+    * STATIC classes ("catalog", anything Sync-resident) accumulate: each
+      tracked upload is new residency (tracked_device_put already skips
+      arrays that are resident, so re-Sync of unchanged content adds 0).
+    * DELTA classes (per-solve problem arrays) REPLACE per BucketPlan
+      rung: donated ping-pong buffers reuse the same device allocation,
+      so the latest solve's bytes per rung are what is actually held.
+      Mid-solve the bytes sit on a pending rung; `attribute_delta` files
+      them under the solve's real bucket label once the service knows it.
+
+    `pressure()` (resident / declared capacity) is the eviction signal
+    the resident-solver LRU consults at Sync."""
+
+    # tensor classes that accumulate (everything else is per-solve delta)
+    STATIC_CLASSES = ("catalog",)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._static: "dict[str, dict[str, float]]" = {}
+        self._delta: "dict[str, dict[str, float]]" = {}
+
+    def track(self, nbytes: float, tensor: str) -> None:
+        """File `nbytes` of fresh device residency under the current
+        thread's hbm_scope (no scope = no attribution: uploads outside a
+        solver context, e.g. tests poking device_put, stay unledgered)."""
+        key = _SCOPE.solver_key
+        if not key or nbytes <= 0:
+            return
+        with self._lock:
+            if tensor in self.STATIC_CLASSES:
+                per = self._static.setdefault(key, {})
+                per[tensor] = per.get(tensor, 0.0) + nbytes
+                HBM_RESIDENT_BYTES.set(per[tensor], solver_key=key,
+                                       tensor=tensor)
+            else:
+                rung = _SCOPE.bucket or _PENDING_RUNG
+                per = self._delta.setdefault(key, {})
+                per[rung] = per.get(rung, 0.0) + nbytes
+
+    def attribute_delta(self, solver_key: str, bucket: str) -> None:
+        """Move the pending delta bytes onto the solve's actual bucket
+        rung, REPLACING that rung's previous residency (donated buffers
+        reuse the allocation; stacking them would double-count)."""
+        with self._lock:
+            per = self._delta.get(solver_key)
+            if per is None:
+                return
+            pending = per.pop(_PENDING_RUNG, None)
+            if pending is None:
+                return
+            per[f"delta:{bucket}"] = pending
+            HBM_RESIDENT_BYTES.set(pending, solver_key=solver_key,
+                                   tensor=f"delta:{bucket}")
+
+    def release(self, solver_key: str) -> float:
+        """Drop every ledger entry for an evicted solver; returns the
+        bytes freed. Gauges zero rather than vanish so the eviction is
+        visible as a step, not a gap."""
+        with self._lock:
+            freed = 0.0
+            for table in (self._static, self._delta):
+                per = table.pop(solver_key, None)
+                if per:
+                    for tensor, b in per.items():
+                        freed += b
+                        label = (tensor if table is self._static
+                                 else (tensor if tensor.startswith("delta:")
+                                       else f"delta:{tensor}"))
+                        HBM_RESIDENT_BYTES.set(0.0, solver_key=solver_key,
+                                               tensor=label)
+            return freed
+
+    def resident_bytes(self, solver_key: "Optional[str]" = None) -> float:
+        with self._lock:
+            keys = ([solver_key] if solver_key is not None
+                    else set(self._static) | set(self._delta))
+            return sum(
+                sum(self._static.get(k, {}).values()) +
+                sum(self._delta.get(k, {}).values())
+                for k in keys)
+
+    def pressure(self) -> "Optional[float]":
+        """resident / capacity, or None when no capacity is declared (the
+        LRU treats None as "pressure eviction disarmed")."""
+        cap = hbm_capacity_default()
+        if cap is None:
+            return None
+        return self.resident_bytes() / cap
+
+    def snapshot(self) -> dict:
+        """The statusz `hbm` section: per-solver residency split by
+        class, fleet totals, and the pressure signal."""
+        with self._lock:
+            solvers = {}
+            for key in sorted(set(self._static) | set(self._delta)):
+                static = dict(self._static.get(key, {}))
+                delta = dict(self._delta.get(key, {}))
+                solvers[key] = {
+                    "static_bytes": static,
+                    "delta_bytes": delta,
+                    "total_bytes": sum(static.values()) +
+                    sum(delta.values()),
+                }
+        total = sum(s["total_bytes"] for s in solvers.values())
+        cap = hbm_capacity_default()
+        return {
+            "solvers": solvers,
+            "resident_bytes_total": total,
+            "capacity_bytes": cap,
+            "pressure": (total / cap) if cap else None,
+        }
+
+
+HBM = HbmLedger()
+
+
 def tracked_device_put(arr, tensor: str, sharding=None):
     """The solver's ONE device_put: counts what actually crosses the
     host->device boundary. An array that is already a device array (with
@@ -243,6 +424,7 @@ def tracked_device_put(arr, tensor: str, sharding=None):
     nbytes = getattr(arr, "nbytes", None)
     if nbytes:
         UPLOAD_BYTES.inc(float(nbytes), tensor=tensor)
+        HBM.track(float(nbytes), tensor)
     return jax.device_put(arr, sharding) if sharding is not None \
         else jax.device_put(arr)
 
@@ -267,6 +449,7 @@ def tracked_tree_put(tree, tensor: str, shardings=None):
         UPLOADS.inc(float(n), tensor=tensor)
         if nbytes:
             UPLOAD_BYTES.inc(float(nbytes), tensor=tensor)
+            HBM.track(float(nbytes), tensor)
     return jax.device_put(tree)
 
 

@@ -51,6 +51,19 @@ CONSOLIDATE_LANE_MESH_MIN = 64
 # latency, the shape history keeps the spent compiles the most useful ones.
 WARMUP_LIMIT = 8
 
+# When the HBM ledger reports residency above this fraction of the declared
+# device capacity (KARPENTER_TPU_HBM_CAPACITY_BYTES), Sync evicts extra LRU
+# entries beyond the count cap until pressure clears — a count-only LRU is
+# blind to one giant catalog crowding out three small ones. Disarmed (no-op)
+# when no capacity is declared, which is the CPU-host default.
+HBM_PRESSURE_EVICT = 0.9
+
+
+def hbm_key(key: "tuple[int, int]") -> str:
+    """The ledger/metric label for a resident solver: the content-hash
+    pair that IS the LRU identity, hex (matches the eviction log lines)."""
+    return f"{key[0]:x}/{key[1]:x}"
+
 def _hint_shape(pods: int) -> tuple:
     """Crude pod-count -> problem-shape mapping for warm_pod_counts hints:
     ~16 pods fold into one scheduling group in the deployment's workloads
@@ -250,14 +263,28 @@ class SolverService:
             # static fold level rather than sharing the live cache dict
             solver.adopt_static(donor, share_group_cache=False)
         # build + device-put the option grid OUTSIDE the lock so Health stays
-        # responsive during catalog churn, then swap atomically
-        solver.grid()
+        # responsive during catalog churn, then swap atomically; the hbm
+        # scope files the grid's device puts under this solver's ledger key
+        with buckets.hbm_scope(hbm_key(key)):
+            solver.grid()
         with self._lock:
             self._cache[key] = (solver, catalog.seqnum)
             self._cache.move_to_end(key)
             while len(self._cache) > self.LRU_CAPACITY:
                 evicted_key, _ = self._cache.popitem(last=False)
+                buckets.HBM.release(hbm_key(evicted_key))
                 log.info("evicted solver for catalog hash=%x", evicted_key[0])
+            # HBM pressure pass: residency, not count, is what actually
+            # overflows a device — keep at least the entry just installed
+            pressure = buckets.HBM.pressure()
+            while (pressure is not None and pressure > HBM_PRESSURE_EVICT
+                   and len(self._cache) > 1):
+                evicted_key, _ = self._cache.popitem(last=False)
+                freed = buckets.HBM.release(hbm_key(evicted_key))
+                log.info("HBM pressure %.2f: evicted solver for catalog "
+                         "hash=%x (freed %d bytes)",
+                         pressure, evicted_key[0], int(freed))
+                pressure = buckets.HBM.pressure()
         warmed = self._warm(solver, request)
         log.info("synced catalog seqnum=%d hash=%x (%d types, %d "
                  "provisioners, %d buckets warmed)",
@@ -323,6 +350,9 @@ class SolverService:
             if trace_now:
                 self._trace_active = True
         t0 = time.perf_counter()
+        # the hbm scope attributes this solve's delta uploads to the
+        # resident solver; the rung is attributed after the solve, once
+        # the bucket label is known (attribute_delta below)
         if trace_now:
             # profiling must never fail a production Solve: start/stop are
             # individually guarded so an unwritable dir or a wedged profiler
@@ -336,8 +366,9 @@ class SolverService:
             except Exception as e:
                 log.warning("profiler start failed: %s", e)
             try:
-                result = solver.solve(pods, existing=existing,
-                                      daemon_overhead=overhead)
+                with buckets.hbm_scope(hbm_key(key)):
+                    result = solver.solve(pods, existing=existing,
+                                          daemon_overhead=overhead)
             finally:
                 if started:
                     try:
@@ -349,8 +380,9 @@ class SolverService:
                 with self._lock:
                     self._trace_active = False
         else:
-            result = solver.solve(pods, existing=existing,
-                                  daemon_overhead=overhead)
+            with buckets.hbm_scope(hbm_key(key)):
+                result = solver.solve(pods, existing=existing,
+                                      daemon_overhead=overhead)
         solve_ms = (time.perf_counter() - t0) * 1000
         self._record_shape(solver)
         resp = result_to_response(result, solve_ms, seqnum)
@@ -362,6 +394,8 @@ class SolverService:
         resp.transfer_ms = float(info.get("transfer_ms", 0.0))
         resp.bucket = str(info.get("bucket", ""))
         resp.device_count = int(info.get("device_count", 1))
+        # file the solve's pending delta bytes under its actual rung
+        buckets.HBM.attribute_delta(hbm_key(key), resp.bucket or "unknown")
         span.set_attributes(routing=resp.routing,
                             compile_cache=resp.compile_cache,
                             transfer_ms=resp.transfer_ms,

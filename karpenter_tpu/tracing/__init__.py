@@ -276,6 +276,34 @@ class Tracer:
             })
         return out
 
+    def trace_index(self, limit: int = 20) -> "list[dict]":
+        """The `/debug/traces` index: the most recent `limit` trace ids,
+        newest first, WITHOUT span bodies — just what a triage needs to
+        pick an id: root span name, duration, span count, and the tenant/
+        replica annotations found anywhere in the trace (the fleet files
+        `tenant` on queue-wait and Solve spans, federation files
+        `replica`)."""
+        out = []
+        for t in self.traces(limit):
+            tenants: "set[str]" = set()
+            replicas: "set[str]" = set()
+            for s in t["spans"]:
+                attrs = s.get("attributes", {})
+                if attrs.get("tenant"):
+                    tenants.add(str(attrs["tenant"]))
+                if attrs.get("replica"):
+                    replicas.add(str(attrs["replica"]))
+            out.append({
+                "trace_id": t["trace_id"],
+                "root": t["root"],
+                "start_ts": t["start_ts"],
+                "duration_ms": t["duration_ms"],
+                "n_spans": t["n_spans"],
+                "tenants": sorted(tenants),
+                "replicas": sorted(replicas),
+            })
+        return out
+
     def chrome_trace(self, trace_id: "Optional[str]" = None) -> dict:
         """Chrome trace_event JSON (the Perfetto / chrome://tracing
         format): complete ("X") events, µs timestamps, one pid, tid =

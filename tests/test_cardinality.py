@@ -1,0 +1,208 @@
+"""Cardinality guard edge cases: the top-K tenant sketch must bound every
+guarded family at K+1 series (K exact tenants + the `_other` rollup) with
+no observation lost or double-counted across evictions, no matter how
+adversarial the tenant id stream — including a tenant literally named
+"_other" and a sketch of width one."""
+
+import random
+
+import pytest
+
+from karpenter_tpu.metrics import Counter, Gauge, Histogram
+from karpenter_tpu.metrics.cardinality import (
+    DEFAULT_K,
+    K_ENV,
+    OTHER,
+    CardinalityGuard,
+    TenantTracker,
+    escape,
+    top_k_default,
+)
+
+
+def _counter_sum(c):
+    with c._lock:
+        return sum(c._values.values())
+
+
+class TestTracker:
+    def test_space_saving_admission(self):
+        t = TenantTracker(k=2)
+        assert t.offer("a") == ("a", None)
+        assert t.offer("b") == ("b", None)
+        assert t.offer("a") == ("a", None)  # tracked: plain increment
+        # full sketch: "c" displaces the min-count entry ("b")
+        key, evicted = t.offer("c")
+        assert (key, evicted) == ("c", "b")
+        # space-saving: the newcomer inherits the victim's count as floor
+        assert t.tracked()["c"] == 2.0
+        assert t.table()[0]["tenant"] in ("a", "c")
+        assert t.evictions == 1 and t.offers == 4
+
+    def test_eviction_tie_breaks_deterministically(self):
+        t = TenantTracker(k=2)
+        t.offer("b")
+        t.offer("a")  # both count 1: victim is the lexicographic min
+        _, evicted = t.offer("z")
+        assert evicted == "a"
+
+    def test_k_one_tracks_exactly_the_last_offered(self):
+        t = TenantTracker(k=1)
+        t.offer("a")
+        _, evicted = t.offer("b")
+        assert evicted == "a"
+        assert set(t.tracked()) == {"b"}
+        # a heavy hitter stays resident once its count dominates
+        for _ in range(10):
+            t.offer("hot")
+        assert "hot" in t and len(t.tracked()) == 1
+
+    def test_table_error_bounds(self):
+        t = TenantTracker(k=1)
+        for _ in range(5):
+            t.offer("a")
+        t.offer("b")  # count = 5 (floor) + 1, error = 5
+        (row,) = t.table()
+        assert row == {"tenant": "b", "count": 6.0, "error": 5.0}
+        # count is an upper bound, count - error a lower bound on truth
+        assert row["count"] - row["error"] == 1.0
+
+
+class TestEscape:
+    def test_other_collision_is_impossible(self):
+        # a tenant literally named "_other" can never alias the rollup
+        assert escape("_other") == "__other"
+        assert escape("__other") == "___other"
+        assert escape("t1") == "t1"
+        # injective on the underscore-prefixed namespace
+        ids = ["_other", "__other", "_x", "x", "other"]
+        assert len({escape(i) for i in ids}) == len(ids)
+
+    def test_guard_keeps_impostor_distinct_from_rollup(self):
+        g = CardinalityGuard(k=1)
+        c = g.watch(Counter("imp_total", label_names=("tenant",)))
+        assert g.label("_other") == "__other"
+        c.inc(tenant="__other")
+        # evicting the impostor folds it into the REAL rollup; the two
+        # never shared a series
+        g.label("real")
+        c.inc(tenant="real")
+        assert g.series_values(c) == {OTHER, "real"}
+        assert c.value(tenant=OTHER) == 1.0
+
+    def test_empty_id_goes_straight_to_rollup(self):
+        g = CardinalityGuard(k=4)
+        assert g.label("") == OTHER
+        assert g.peek("") == OTHER
+        assert g.tracker.offers == 0  # the rollup is not sketch traffic
+
+
+class TestFolding:
+    def _guard(self, k=2):
+        g = CardinalityGuard(k=k)
+        c = g.watch(Counter("fold_total", label_names=("tenant", "where")))
+        h = g.watch(Histogram("fold_seconds", label_names=("tenant",),
+                              buckets=(0.1, 1.0)))
+        ga = g.watch(Gauge("fold_depth", label_names=("tenant",)))
+        return g, c, h, ga
+
+    def test_eviction_folds_counter_without_double_counting(self):
+        g, c, h, ga = self._guard(k=2)
+        for tid, n in (("a", 3), ("b", 2)):
+            for _ in range(n):
+                c.inc(tenant=g.label(tid), where="q")
+        before = _counter_sum(c)
+        # "z" evicts "b" (min count); b's series must fold into _other
+        tl = g.label("z")
+        c.inc(tenant=tl, where="q")
+        assert _counter_sum(c) == before + 1  # nothing lost, nothing doubled
+        assert c.value(tenant=OTHER, where="q") == 2.0
+        assert g.series_values(c) == {"a", "z", OTHER}
+
+    def test_eviction_merges_histogram_buckets_sums_totals(self):
+        g, c, h, ga = self._guard(k=2)
+        h.observe(0.05, tenant=g.label("a"))
+        h.observe(0.5, tenant=g.label("b"))
+        h.observe(2.0, tenant=g.label("b"))
+        g.label("z")  # evicts the lighter of a/b -> folds its series
+        with h._lock:
+            total = sum(h._totals.values())
+            ssum = sum(h._sums.values())
+        assert total == 3  # observation count preserved across the fold
+        assert ssum == pytest.approx(2.55)
+        assert len(g.series_values(h)) <= g.k + 1
+        # the rollup inherited cumulative bucket counts, not raw values
+        with h._lock:
+            assert (OTHER,) in h._totals
+
+    def test_eviction_drops_gauge_series(self):
+        g, c, h, ga = self._guard(k=1)
+        ga.set(7.0, tenant=g.label("a"))
+        g.label("b")  # evicts a: last-write gauges drop, never sum
+        assert g.series_values(ga) == set()
+        assert g.folded == 1
+
+    def test_fold_preserves_other_labels(self):
+        g, c, h, ga = self._guard(k=1)
+        t = g.label("a")
+        c.inc(tenant=t, where="admission")
+        c.inc(tenant=t, where="queue")
+        g.label("b")
+        assert c.value(tenant=OTHER, where="admission") == 1.0
+        assert c.value(tenant=OTHER, where="queue") == 1.0
+
+    def test_peek_never_inflates_the_sketch(self):
+        g, c, h, ga = self._guard(k=2)
+        g.label("a")
+        offers = g.tracker.offers
+        assert g.peek("a") == "a"
+        assert g.peek("stranger") == OTHER
+        assert g.tracker.offers == offers
+
+    def test_watch_rejects_unlabeled_family(self):
+        g = CardinalityGuard(k=2)
+        with pytest.raises(ValueError, match="no 'tenant' label"):
+            g.watch(Counter("bare_total", label_names=("where",)))
+
+
+class TestSeriesBoundProperty:
+    def test_10k_random_tenants_stay_within_k_plus_one(self):
+        """Property: after 10k observations over a heavy-tailed random id
+        stream, every guarded family holds <= K+1 tenant values and no
+        counter increment was lost."""
+        rng = random.Random(0xC0FFEE)
+        g = CardinalityGuard(k=8)
+        c = g.watch(Counter("prop_total", label_names=("tenant",)))
+        h = g.watch(Histogram("prop_seconds", label_names=("tenant",),
+                              buckets=(0.1, 1.0)))
+        ids = [f"tenant-{rng.randrange(10_000)}" for _ in range(5_000)]
+        ids += [f"hot-{rng.randrange(4)}" for _ in range(5_000)]
+        rng.shuffle(ids)
+        for tid in ids:
+            t = g.label(tid)
+            c.inc(tenant=t)
+            h.observe(0.01, tenant=t)
+        snap = g.snapshot()
+        assert snap["offers"] == 10_000
+        for name, n in snap["series_per_family"].items():
+            assert n <= g.k + 1, (name, n)
+        assert _counter_sum(c) == 10_000  # folds never lose increments
+        with h._lock:
+            assert sum(h._totals.values()) == 10_000
+        # the heavy hitters survive the churn (true freq ~1250 >> N/K)
+        tracked = set(g.tracker.tracked())
+        assert {f"hot-{i}" for i in range(4)} <= tracked
+
+
+class TestEnvKnob:
+    def test_default_and_validation(self, monkeypatch):
+        monkeypatch.delenv(K_ENV, raising=False)
+        assert top_k_default() == DEFAULT_K
+        monkeypatch.setenv(K_ENV, "7")
+        assert top_k_default() == 7
+        monkeypatch.setenv(K_ENV, "banana")
+        assert top_k_default() == DEFAULT_K  # warn + fall back
+        monkeypatch.setenv(K_ENV, "0")
+        assert top_k_default() == 1  # clamp: zero-width sketch impossible
+        monkeypatch.setenv(K_ENV, "-3")
+        assert top_k_default() == 1
