@@ -295,6 +295,22 @@ def _trace_summary_entries(doc: dict):
                doc.get("captured_at"))
 
 
+def _profiling_entries(doc: dict):
+    """benchmarks/profile_drill.py artifacts: per-path attribution shares
+    (the perf-regress gate trends profile_unaccounted_share)."""
+    if doc.get("tool") != "karpenter_tpu.profile_drill":
+        return
+    for name, p in (doc.get("paths") or {}).items():
+        degraded = not p.get("passed", False)
+        wl = {"name": "profile_drill", "path": name, "pods": doc.get("pods")}
+        for field, metric in (
+                ("unaccounted_share", "profile_unaccounted_share"),
+                ("attributed_share", "profile_attributed_share"),
+                ("overhead_share", "profile_overhead_share")):
+            if isinstance(p.get(field), (int, float)):
+                yield (metric, p[field], "ratio", "cpu", degraded, wl, None)
+
+
 _BACKFILL_SOURCES = (
     ("BENCH_r0*.json", "bench.py", _bench_round_entries),
     ("benchmarks/results/bench_*.json", "benchmarks.record",
@@ -312,6 +328,8 @@ _BACKFILL_SOURCES = (
      _multichip_entries),
     ("benchmarks/results/trace_summary_*.json", "hack/summarize_trace",
      _trace_summary_entries),
+    ("benchmarks/results/profiling/*.json", "benchmarks.profile_drill",
+     _profiling_entries),
 )
 
 

@@ -68,6 +68,16 @@ def _bench_inflate() -> float:
     return float(config_0_inflate()["ms"])
 
 
+def _bench_profile_unaccounted() -> float:
+    """Gap-ledger attribution probe (benchmarks/profile_drill.gate_probe):
+    one warmed 400-pod solve; the gate trends the unaccounted residue
+    share so attribution rot (a new unspanned phase creeping into the
+    solve path) fails presubmit like any other regression."""
+    from benchmarks.profile_drill import gate_probe
+
+    return float(gate_probe()["unaccounted_share"])
+
+
 # (metric, workload filter, backend, unit, direction, runner). `direction`
 # is the GOOD direction: "higher" fails below the band, "lower" above it.
 GATES = (
@@ -75,6 +85,8 @@ GATES = (
      "higher", _bench_interruption),
     ("baseline_config_ms", {"name": "inflate-100"}, "cpu", "ms",
      "lower", _bench_inflate),
+    ("profile_unaccounted_share", {"name": "profile_gate", "pods": 400},
+     "cpu", "ratio", "lower", _bench_profile_unaccounted),
 )
 
 

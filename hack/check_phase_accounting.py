@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""Phase-accounting lint: the attribution vocabulary cannot drift.
+
+Three AST-level checks (no package import — the lint must run without jax,
+the check_crashpoints idiom):
+
+1. every Tracer span name the gap-ledger PHASES table maps onto
+   (karpenter_tpu/profiling/gapledger.py) exists in the Tracer phase
+   registry (karpenter_tpu/tracing/__init__.py PHASE_REGISTRY);
+2. every LITERAL span name passed to start_span()/record_span() anywhere
+   in karpenter_tpu/ is registered (or matches a DYNAMIC_PHASE_PREFIXES
+   family) — a new span recorded without registering it fails presubmit,
+   so the gap ledger can never silently lose a phase;
+3. every registry entry is actually recorded somewhere — dead registry
+   entries would make the docs lie about what the tracer emits.
+
+f-string span names (e.g. the client's solver.rpc.<Method>) are checked
+by their static prefix against DYNAMIC_PHASE_PREFIXES; non-literal names
+(variables) are skipped — they are the Tracer API's own plumbing.
+
+Run via `make phaseacct` (part of `make presubmit`).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "karpenter_tpu"
+GAPLEDGER = PACKAGE / "profiling" / "gapledger.py"
+TRACING = PACKAGE / "tracing" / "__init__.py"
+
+SPAN_CALLS = ("start_span", "record_span")
+
+
+def _module_assign(path: pathlib.Path, name: str):
+    """The AST value node of a module-level `name = ...` assignment."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets):
+            return node.value
+    raise SystemExit(f"check_phase_accounting: {name} not found in {path}")
+
+
+def load_phases() -> "dict[str, tuple[str, ...]]":
+    value = _module_assign(GAPLEDGER, "PHASES")
+    phases = ast.literal_eval(value)
+    return {phase: tuple(spans) for phase, spans in phases}
+
+
+def load_registry() -> "tuple[tuple[str, ...], tuple[str, ...]]":
+    registry = ast.literal_eval(_module_assign(TRACING, "PHASE_REGISTRY"))
+    prefixes = ast.literal_eval(
+        _module_assign(TRACING, "DYNAMIC_PHASE_PREFIXES"))
+    return tuple(registry), tuple(prefixes)
+
+
+def _span_name_args(tree: ast.AST):
+    """Yield (node, first-positional-arg) of every start_span/record_span
+    call in the tree."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if name in SPAN_CALLS:
+            yield node, node.args[0]
+
+
+def _literal_strings(arg: ast.expr):
+    """Constant-string values an expression can evaluate to: plain
+    constants, and both arms of conditional expressions (core.py picks
+    dispatch.execute vs dispatch.compile with an IfExp)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        yield arg.value
+    elif isinstance(arg, ast.IfExp):
+        yield from _literal_strings(arg.body)
+        yield from _literal_strings(arg.orelse)
+
+
+def _static_prefix(joined: ast.JoinedStr) -> str:
+    """Leading constant text of an f-string ('solver.rpc.' of
+    f'solver.rpc.{name}')."""
+    out = []
+    for part in joined.values:
+        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+            out.append(part.value)
+        else:
+            break
+    return "".join(out)
+
+
+def main() -> int:
+    phases = load_phases()
+    registry, prefixes = load_registry()
+    problems: "list[str]" = []
+
+    # 1) gap-ledger table maps onto registered spans only
+    for phase, spans in phases.items():
+        for span in spans:
+            if span not in registry:
+                problems.append(
+                    f"{GAPLEDGER.relative_to(ROOT)}: gap phase {phase!r} "
+                    f"maps to span {span!r} which is not in "
+                    f"tracing.PHASE_REGISTRY")
+
+    # 2) every literal call site is registered; 3) registry has no dead rows
+    used: "set[str]" = set()
+    for path in sorted(PACKAGE.rglob("*.py")):
+        if path == TRACING:
+            continue  # the Tracer's own API plumbing passes names through
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            problems.append(f"{path.relative_to(ROOT)}: unparseable: {e}")
+            continue
+        rel = path.relative_to(ROOT)
+        for node, arg in _span_name_args(tree):
+            names = list(_literal_strings(arg))
+            if names:
+                for value in names:
+                    used.add(value)
+                    if value not in registry and not any(
+                            value.startswith(p) for p in prefixes):
+                        problems.append(
+                            f"{rel}:{node.lineno}: span {value!r} is not "
+                            f"in tracing.PHASE_REGISTRY (register it, or "
+                            f"the gap ledger can never account for it)")
+            elif isinstance(arg, ast.JoinedStr):
+                prefix = _static_prefix(arg)
+                if not any(prefix.startswith(p) for p in prefixes):
+                    problems.append(
+                        f"{rel}:{node.lineno}: dynamic span name with "
+                        f"static prefix {prefix!r} matches no "
+                        f"DYNAMIC_PHASE_PREFIXES entry")
+    for span in registry:
+        if span not in used and not any(span.startswith(p)
+                                        for p in prefixes):
+            problems.append(
+                f"{TRACING.relative_to(ROOT)}: PHASE_REGISTRY entry "
+                f"{span!r} is recorded nowhere in karpenter_tpu/ "
+                f"(dead registry rows make the docs lie)")
+
+    for p in problems:
+        print(f"check_phase_accounting: {p}", file=sys.stderr)
+    if problems:
+        print(f"check_phase_accounting: {len(problems)} problem(s)",
+              file=sys.stderr)
+        return 1
+    print(f"check_phase_accounting: ok ({len(phases)} gap phases, "
+          f"{len(registry)} registered spans, {len(used)} literal call "
+          f"sites)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -483,9 +483,31 @@ def check_columnar_coherence(op) -> "list[Violation]":
     return out
 
 
+def check_profiling_noop(profiling) -> "list[Violation]":
+    """profiling-strict-noop: the profiling plane is advisory — with the
+    plane disabled it must do NOTHING. The runner disables profiling for
+    the scenario and hands us before/after activity counters
+    (karpenter_tpu.profiling.activity()); ANY growth — host samples,
+    device events, gap-ledger rows, ring lengths — means a producer
+    ignored the switch and the plane has become load-bearing."""
+    if not profiling or profiling.get("enabled", True):
+        return []  # not part of this drill, or plane was left on
+    out: "list[Violation]" = []
+    before = profiling.get("before") or {}
+    after = profiling.get("after") or {}
+    for key in sorted(set(before) | set(after)):
+        grew = after.get(key, 0) - before.get(key, 0)
+        if grew > 0:
+            out.append(Violation(
+                "profiling-strict-noop",
+                f"profiling disabled but {key} grew by {grew} "
+                f"({before.get(key, 0)} -> {after.get(key, 0)})"))
+    return out
+
+
 def check_all(op, cloud, token_launches=None,
               consolidation_actions=None,
-              resilience=None) -> "list[Violation]":
+              resilience=None, profiling=None) -> "list[Violation]":
     out = []
     out += check_token_ledger(token_launches or {})
     out += check_bijection(op, cloud)
@@ -496,4 +518,5 @@ def check_all(op, cloud, token_launches=None,
     out += check_retry_budget(resilience)
     out += check_degrade_monotone(resilience)
     out += check_columnar_coherence(op)
+    out += check_profiling_noop(profiling)
     return out

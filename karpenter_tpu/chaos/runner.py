@@ -233,6 +233,13 @@ class ChaosRunner:
         op.resilience.use_virtual_sleep()
         workload = self._workload(plan)
         errors: "list[str]" = []
+        # profiling-strict-noop drill: the profiling plane is disabled for
+        # the whole scenario (including --burst, which shares this path)
+        # and its activity counters are diffed at the end — any growth
+        # means a producer ignored the switch (invariants.py)
+        from .. import profiling
+        prof_prev = profiling.set_enabled(False)
+        prof_before = profiling.activity()
         try:
             injector.install(op, cloud)
             self._reconcile_workload(op, workload, injector)
@@ -272,11 +279,27 @@ class ChaosRunner:
             # marks, ladder transitions) — captured before stop() and fed
             # to the structural invariants
             resilience_evidence = op.resilience.evidence()
+            prof_after = profiling.activity()
+            profiling_evidence = {
+                "enabled": False,
+                "before": prof_before,
+                "after": prof_after,
+            }
+            # the replayed scenario dict stores only the DELTAS (all zero
+            # when the noop invariant holds): the absolute counters depend
+            # on whatever ran in this process before the scenario, and the
+            # replay contract says the dict is a pure function of the seed
+            profiling_stored = {
+                "enabled": False,
+                "deltas": {k: prof_after[k] - prof_before[k]
+                           for k in prof_before},
+            }
             violations = invariants.check_all(
                 op, cloud,
                 token_launches=injector.token_launches,
                 consolidation_actions=injector.consolidation_actions,
-                resilience=resilience_evidence)
+                resilience=resilience_evidence,
+                profiling=profiling_evidence)
             if not self._quiescent(op):
                 violations = [invariants.Violation(
                     "quiescence",
@@ -300,6 +323,7 @@ class ChaosRunner:
                 if written:
                     self._bundles.append(written)
         finally:
+            profiling.set_enabled(prof_prev)
             op.stop()
 
         fired_kinds = sorted(injector.fired_kinds())
@@ -317,6 +341,7 @@ class ChaosRunner:
             "settle_cycles": settle_cycles,
             "final_nodes": len(op.cluster.nodes),
             "resilience": resilience_evidence,
+            "profiling": profiling_stored,
             "violations": [v.as_dict() for v in violations],
             "passed": not violations,
         }
@@ -726,7 +751,36 @@ class ChaosRunner:
         Shed probes ride the bursts: one request whose budget cannot
         survive a tick (admission shed) and one whose budget expires
         behind the burst backlog (queue shed). Everything in the returned
-        dict is a pure function of (seed, scenario)."""
+        dict is a pure function of (seed, scenario).
+
+        The burst drill doubles as the profiling strict-noop proof: the
+        whole storm — fleet ``_dispatch`` gap scopes included — runs with
+        the plane disabled and must leave ZERO profiling activity behind
+        (invariants.check_profiling_noop)."""
+        from .. import profiling as _profiling
+
+        prof_prev = _profiling.set_enabled(False)
+        prof_before = _profiling.activity()
+        try:
+            out = self._storm_scenario_impl(scenario)
+            prof_after = _profiling.activity()
+            evidence = {"enabled": False, "before": prof_before,
+                        "after": prof_after}
+            noop = invariants.check_profiling_noop(evidence)
+            # store deltas, not absolute counters — replay-deterministic
+            out["profiling"] = {
+                "enabled": False,
+                "deltas": {k: prof_after[k] - prof_before[k]
+                           for k in prof_before},
+            }
+            if noop:
+                out["violations"].extend(v.as_dict() for v in noop)
+                out["passed"] = False
+            return out
+        finally:
+            _profiling.set_enabled(prof_prev)
+
+    def _storm_scenario_impl(self, scenario: int) -> dict:
         from ..fleet import FleetFrontend
 
         r = ChaosRng((self.seed << 8) ^ scenario).fork("storm")

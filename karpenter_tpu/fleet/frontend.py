@@ -438,7 +438,13 @@ class FleetFrontend:
         problems = [{"pods": t.pods, "existing": t.existing,
                      "daemon_overhead": t.daemon_overhead} for t in batch]
         try:
-            results = self._solve_batch(key, problems)
+            # gap-ledger wall bracket for the mega-solve: the wave path's
+            # phase notes (solver.solve_many) file against this wall, so
+            # routed-fleet attribution rows carry the batch size
+            from ..profiling import GAP_LEDGER
+            with GAP_LEDGER.solve_scope("fleet"):
+                GAP_LEDGER.annotate(bucket=plan.label(), batch=len(batch))
+                results = self._solve_batch(key, problems)
         except Exception as e:  # noqa: BLE001 — resolve, never wedge callers
             with self._lock:
                 for t in batch:

@@ -44,6 +44,20 @@ BUNDLE_EVENTS = 100
 # one auto-bundle per reason per window; chaos passes force=True
 TRIGGER_MIN_INTERVAL = 60.0
 
+# folded stacks carried per bundle — enough flame to triage, bounded so a
+# bundle stays a bundle
+BUNDLE_PROFILE_STACKS = 50
+
+
+def _profile_section() -> dict:
+    from ..profiling import PROFILER, snapshot as profiling_snapshot
+
+    return {
+        **profiling_snapshot(),
+        "folded": [f"{stack} {count}" for stack, count in
+                   PROFILER.host.folded(BUNDLE_PROFILE_STACKS)],
+    }
+
 
 class FlightRecorder:
     def __init__(self, operator, ring_size: int = DEFAULT_RING,
@@ -102,6 +116,10 @@ class FlightRecorder:
                  "object": e.object_ref, "message": e.message}
                 for ts, e in self.op.recorder.recent(BUNDLE_EVENTS)]),
             "metrics_text": fenced(self.op.metrics_text),
+            # profile snapshot rides in every bundle: an SLO-burn trigger's
+            # first question is "which phase ate the budget" (gap ledger),
+            # and the folded stacks say what the host was doing meanwhile
+            "profile": fenced(_profile_section),
         }
 
     def trigger(self, reason: str, detail: str = "", force: bool = False,

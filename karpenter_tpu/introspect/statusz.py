@@ -23,7 +23,7 @@ from ..metrics import REGISTRY, Gauge, Histogram
 
 log = logging.getLogger("karpenter.statusz")
 
-SCHEMA_VERSION = 6  # 6: added the "hbm" section (5: "slo"; 4: "fleet")
+SCHEMA_VERSION = 7  # 7: added "profiling" (6: "hbm"; 5: "slo"; 4: "fleet")
 
 # hard caps so a pathological operator can't make statusz unbounded
 MAX_EVENTS = 50
@@ -159,6 +159,14 @@ def _hbm_section() -> dict:
     return HBM.snapshot()
 
 
+def _profiling_section() -> dict:
+    # the attribution plane's own snapshot: sampler health/overhead, device
+    # ladder mode, and the gap ledger's phase totals + last rows
+    from ..profiling import snapshot as profiling_snapshot
+
+    return profiling_snapshot()
+
+
 def snapshot(op) -> dict:
     """The one consistent operator snapshot (see module docstring)."""
     return {
@@ -176,5 +184,6 @@ def snapshot(op) -> dict:
         "fleet": _fenced(_fleet_section),
         "slo": _fenced(lambda: op.slo.snapshot()),
         "hbm": _fenced(_hbm_section),
+        "profiling": _fenced(_profiling_section),
         "metrics": _fenced(_metrics_section),
     }

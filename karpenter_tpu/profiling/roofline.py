@@ -1,0 +1,153 @@
+"""Roofline cost model keyed on the BucketPlan rung.
+
+Every solve dispatches one pack kernel at a padded rung shape
+``(Gb groups, Nb slots, Neb existing)`` (solver/buckets.py LADDERS), so the
+bytes that must cross the host-device boundary and the FLOPs the kernel
+must execute are *functions of the rung*, not of the live pod set. That
+gives a theoretical floor per solve:
+
+    floor = max(bytes_moved / peak_bandwidth,
+                flops / (peak_flops * device_count))
+
+The floor is deliberately optimistic (it prices neither dispatch latency
+nor XLA link time) — its job is to be the denominator of
+``karpenter_profile_roofline_ratio`` (measured device-exec / floor). A
+ratio near 1 means the device phase is at the hardware limit and the
+remaining headline milliseconds live on the host side of the gap ledger;
+a large ratio means the device phase itself is leaving performance on the
+table. Monotone in every rung dimension by construction (sums and maxima
+of monotone terms), which tests/test_profiling.py locks in.
+
+Byte model (matches build_pack_inputs' per-solve delta — the catalog
+arrays are device-resident and NOT counted, SURVEY.md §7.3 "ship only the
+pod delta"):
+
+    h2d  = Gb·(R·4 + 3·4)            group vec / count / cap / newprov
+         + Gb·Pv                      feasibility mask (bool)
+         + Neb·(2·R·4)                existing alloc + used
+         + Gb·Neb                     existing feasibility (bool)
+         + R·4                        daemon overhead
+    d2h  = Nb·4 + Gb·4 + Neb·4 + 64   flat result + headers
+    flops = Gb·Nb·T·S·OPS_PER_CELL    per-slot-step candidate scan
+
+Peaks are per-backend defaults overridable with
+``KARPENTER_TPU_ROOFLINE_GBPS`` / ``KARPENTER_TPU_ROOFLINE_GFLOPS``
+(warn-and-fallback on garbage, the crossover_cells_default idiom).
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import NamedTuple
+
+from ..metrics import REGISTRY
+
+log = logging.getLogger(__name__)
+
+DTYPE_BYTES = 4
+#: modelled kernel work per candidate cell per tiebreak step: feasibility
+#: compare, capacity subtract, score blend, argmin update (vectorised).
+OPS_PER_CELL = 8
+
+BW_ENV = "KARPENTER_TPU_ROOFLINE_GBPS"
+FLOPS_ENV = "KARPENTER_TPU_ROOFLINE_GFLOPS"
+
+#: per-backend (bandwidth GB/s, compute GFLOP/s) defaults. The TPU row is
+#: a v4-class HBM/VPU envelope; the CPU row is a single-socket host — both
+#: are deliberately round: the ratio gauge is for trend-spotting, not
+#: datasheet accounting.
+PEAKS = {
+    "tpu": (1200.0, 45_000.0),
+    "gpu": (900.0, 30_000.0),
+    "cpu": (20.0, 50.0),
+}
+
+ROOFLINE_BYTES = REGISTRY.gauge(
+    "karpenter_profile_roofline_bytes",
+    "Modelled bytes crossing the host-device boundary per solve at this rung",
+    ("bucket",))
+ROOFLINE_FLOPS = REGISTRY.gauge(
+    "karpenter_profile_roofline_flops",
+    "Modelled kernel FLOPs per solve at this rung",
+    ("bucket",))
+ROOFLINE_FLOOR_MS = REGISTRY.gauge(
+    "karpenter_profile_roofline_floor_ms",
+    "Theoretical per-solve floor ms = max(bytes/bw, flops/peak) at this rung",
+    ("bucket",))
+ROOFLINE_RATIO = REGISTRY.gauge(
+    "karpenter_profile_roofline_ratio",
+    "Measured device-exec ms / roofline floor ms (1.0 = at the roofline)",
+    ("bucket",))
+
+
+class Roofline(NamedTuple):
+    bucket: str
+    bytes_moved: int
+    flops: int
+    floor_ms: float
+    bw_gbps: float
+    peak_gflops: float
+    backend: str
+    device_count: int
+
+
+def _env_float(env: str, fallback: float) -> float:
+    raw = os.environ.get(env)
+    if raw is None:
+        return fallback
+    try:
+        v = float(raw)
+        if v <= 0:
+            raise ValueError(raw)
+        return v
+    except ValueError:
+        log.warning("%s=%r invalid (want a positive number); using %s",
+                    env, raw, fallback)
+        return fallback
+
+
+def peaks_for(backend: str) -> "tuple[float, float]":
+    bw, fl = PEAKS.get(backend, PEAKS["cpu"])
+    return _env_float(BW_ENV, bw), _env_float(FLOPS_ENV, fl)
+
+
+def estimate(groups: int, slots: int, existing: int, *,
+             pv: int = 1, t: int = 16, s: int = 4,
+             resources: int = 8, device_count: int = 1,
+             backend: str = "cpu", bucket: str = "") -> Roofline:
+    """Roofline for one solve at the padded rung (duck-typed on the
+    BucketPlan dims so hack/ lints can call it without importing jax)."""
+    gb, nb, neb = max(1, int(groups)), max(1, int(slots)), max(0, int(existing))
+    pv = max(1, int(pv))
+    h2d = (gb * (resources * DTYPE_BYTES + 3 * DTYPE_BYTES)
+           + gb * pv
+           + neb * (2 * resources * DTYPE_BYTES)
+           + gb * neb
+           + resources * DTYPE_BYTES)
+    d2h = nb * DTYPE_BYTES + gb * DTYPE_BYTES + neb * DTYPE_BYTES + 64
+    flops = gb * nb * max(1, int(t)) * max(1, int(s)) * OPS_PER_CELL
+    bw_gbps, peak_gflops = peaks_for(backend)
+    dc = max(1, int(device_count))
+    floor_s = max((h2d + d2h) / (bw_gbps * 1e9),
+                  flops / (peak_gflops * 1e9 * dc))
+    return Roofline(
+        bucket=bucket or f"g{gb}n{nb}e{neb}",
+        bytes_moved=h2d + d2h,
+        flops=flops,
+        floor_ms=floor_s * 1e3,
+        bw_gbps=bw_gbps,
+        peak_gflops=peak_gflops,
+        backend=backend,
+        device_count=dc,
+    )
+
+
+def observe(rf: Roofline, device_exec_ms: float) -> float:
+    """Publish the rung's roofline gauges; returns the measured/floor ratio
+    (callers record it into the gap-ledger row)."""
+    ROOFLINE_BYTES.set(float(rf.bytes_moved), bucket=rf.bucket)
+    ROOFLINE_FLOPS.set(float(rf.flops), bucket=rf.bucket)
+    ROOFLINE_FLOOR_MS.set(rf.floor_ms, bucket=rf.bucket)
+    ratio = device_exec_ms / rf.floor_ms if rf.floor_ms > 0 else 0.0
+    ROOFLINE_RATIO.set(ratio, bucket=rf.bucket)
+    return ratio

@@ -33,6 +33,23 @@ log = logging.getLogger("karpenter.serving")
 # larger ask only serializes the same data with more zeros
 MAX_TRACE_LIMIT = 200
 
+# /debug/profilez ?n= ceiling: distinct folded stacks worth serializing —
+# beyond this the tail is single-sample noise
+MAX_PROFILE_STACKS = 500
+
+
+def clamped_int_param(qs: dict, key: str, default: int,
+                      ceiling: int) -> "Optional[int]":
+    """Shared /debug listing-param discipline (/debug/traces ?limit=,
+    /debug/profilez ?n=): a non-integer returns None — the caller answers
+    400, because a silent default would make a bad dashboard query look
+    like a tiny ring — and a well-formed value clamps into [1, ceiling]."""
+    try:
+        value = int(qs.get(key, [str(default)])[0])
+    except ValueError:
+        return None
+    return min(max(value, 1), ceiling)
+
 # AdmissionReview resource plural -> store kind
 _PLURALS = {
     "provisioners": "provisioners",
@@ -143,25 +160,29 @@ class ServingPlane:
                                     {"trace_id": trace_id, "spans": spans},
                                     default=str),
                                 content_type="application/json")
+                        # chrome-trace exports carry the continuous
+                        # profiler's samples as a `profiling` process lane
+                        # (no-op while the profiling plane is disabled)
+                        from .profiling import merge_chrome
                         if fv is not None:
                             doc = fv.federated_trace(trace_id)
                             if doc is None:
                                 return self._text(404, "unknown trace id")
                             return self._text(
-                                200, json.dumps(doc, default=str),
+                                200, json.dumps(merge_chrome(doc),
+                                                default=str),
                                 content_type="application/json")
                         if not TRACER.trace(trace_id):
                             return self._text(404, "unknown trace id")
                         return self._text(
-                            200, TRACER.chrome_trace_json(trace_id),
+                            200, json.dumps(
+                                merge_chrome(TRACER.chrome_trace(trace_id)),
+                                default=str),
                             content_type="application/json")
-                    try:
-                        limit = int(qs.get("limit", ["20"])[0])
-                    except ValueError:
-                        # a silent default would make a bad dashboard query
-                        # look like a tiny trace ring
+                    limit = clamped_int_param(qs, "limit", 20,
+                                              MAX_TRACE_LIMIT)
+                    if limit is None:
                         return self._text(400, "limit must be an integer")
-                    limit = min(max(limit, 1), MAX_TRACE_LIMIT)
                     if qs.get("index", [""])[0]:
                         index = (fv.trace_index(limit) if fv is not None
                                  else TRACER.trace_index(limit))
@@ -171,6 +192,32 @@ class ServingPlane:
                     return self._text(
                         200, json.dumps({"traces": TRACER.traces(limit)},
                                         default=str),
+                        content_type="application/json")
+                if self.path.startswith("/debug/profilez"):
+                    # continuous-profiler read surface: ?format=json is the
+                    # pprof-style aggregation (stacks + device ladder + gap
+                    # ledger), ?format=folded is flamegraph-ready folded
+                    # stacks; ?n= bounds the stack listing (clamped like
+                    # /debug/traces ?limit=)
+                    from urllib.parse import parse_qs, urlsplit
+
+                    from . import profiling
+
+                    qs = parse_qs(urlsplit(self.path).query)
+                    n = clamped_int_param(qs, "n", 100, MAX_PROFILE_STACKS)
+                    if n is None:
+                        return self._text(400, "n must be an integer")
+                    fmt = qs.get("format", ["json"])[0]
+                    if fmt not in ("json", "folded"):
+                        return self._text(
+                            400, f"unknown format: {fmt} (json|folded)")
+                    # reading the endpoint is the always-on profiler's lazy
+                    # ignition (no-op while the plane is disabled)
+                    profiling.PROFILER.ensure_started()
+                    if fmt == "folded":
+                        return self._text(200, profiling.folded_text(n) + "\n")
+                    return self._text(
+                        200, json.dumps(profiling.profilez(n), default=str),
                         content_type="application/json")
                 return self._text(404, "not found")
 

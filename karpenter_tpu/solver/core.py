@@ -196,21 +196,41 @@ class TPUSolver:
         groups are deferred; round 1's solved claims join `existing` as
         pseudo nodes carrying their pods as residents, so round 2 resolves
         the terms through the resident-based affinity machinery."""
-        from ..oracle.scheduler import split_deferred_pods
+        import time as _time
 
-        primary, deferred = split_deferred_pods(pods)
-        if not deferred:
-            return self._solve_once(pods, existing, daemon_overhead, n_slots)
-        res = self._solve_once(primary, existing, daemon_overhead, n_slots)
-        # Round 2 must see round 1's consumption of the REAL existing nodes
-        # (the oracle mutates its views in place; this path re-encodes, so
-        # carry used + origin-keyed in-run counts on fresh copies).
-        carried = _carry_round1_existing(existing, res)
-        pseudo = self._nodes_as_existing(res, daemon_overhead)
-        res2 = self._solve_once(deferred, carried + pseudo,
-                                daemon_overhead, n_slots)
-        return _merge_rounds(res, res2, {p.name: i for i, p in
-                                         enumerate(pseudo)})
+        from ..oracle.scheduler import split_deferred_pods
+        from ..profiling import GAP_LEDGER
+
+        # gap-ledger wall bracket: outermost opener wins (the service RPC
+        # scope subsumes this one), so for in-process callers this IS the
+        # headline wall both rounds' phase notes are accounted against
+        with GAP_LEDGER.solve_scope("solver"):
+            # the affinity-round split scans every pod — that is host
+            # problem preparation, so it files under encode (at 10k pods
+            # it is ~1 ms, the biggest pre-_solve_once chunk of wall)
+            _t0 = _time.perf_counter()
+            primary, deferred = split_deferred_pods(pods)
+            GAP_LEDGER.note("encode", _time.perf_counter() - _t0)
+            if not deferred:
+                return self._solve_once(pods, existing, daemon_overhead,
+                                        n_slots)
+            res = self._solve_once(primary, existing, daemon_overhead,
+                                   n_slots)
+            # Round 2 must see round 1's consumption of the REAL existing
+            # nodes (the oracle mutates its views in place; this path
+            # re-encodes, so carry used + origin-keyed in-run counts on
+            # fresh copies).
+            _t1 = _time.perf_counter()
+            carried = _carry_round1_existing(existing, res)
+            pseudo = self._nodes_as_existing(res, daemon_overhead)
+            GAP_LEDGER.note("encode", _time.perf_counter() - _t1)
+            res2 = self._solve_once(deferred, carried + pseudo,
+                                    daemon_overhead, n_slots)
+            _t2 = _time.perf_counter()
+            merged = _merge_rounds(res, res2, {p.name: i for i, p in
+                                               enumerate(pseudo)})
+            GAP_LEDGER.note("decode", _time.perf_counter() - _t2)
+            return merged
 
     def solve_many(
         self,
@@ -232,9 +252,24 @@ class TPUSolver:
         need the two-round driver and fall back to solve() (still correct,
         one extra read each — rare in practice).
         """
+        from ..profiling import GAP_LEDGER
+
+        # one wall bracket for the whole wave: solo fallbacks recurse into
+        # solve(), whose nested scope is transparent, so every problem's
+        # phase notes accumulate against this single wall measurement
+        with GAP_LEDGER.solve_scope("solver.many"):
+            return self._solve_many_impl(problems)
+
+    def _solve_many_impl(
+        self,
+        problems: "Sequence[dict]",
+    ) -> "list[SolveResult]":
+        import time as _time
+
         import jax.numpy as jnp
 
         from ..oracle.scheduler import split_deferred_pods
+        from ..profiling import GAP_LEDGER
 
         # ONE catalog snapshot for the whole wave — but encode_problem
         # rebuilds a grid whose seqnum went stale (a concurrent catalog
@@ -246,6 +281,7 @@ class TPUSolver:
         # different grids can never stack.
         wave_grid = self.grid()
         dev_alloc_t, dev_tiebreak = self._dev_alloc_t, self._dev_tiebreak
+        t_enc0 = _time.perf_counter()
         slots: "list[tuple]" = []  # (mode, payload)
         for prob in problems:
             pods = prob.get("pods", [])
@@ -270,6 +306,7 @@ class TPUSolver:
             else:  # encode rebuilt a fresh grid (catalog bumped mid-wave)
                 inputs, dims, up = build_pack_inputs(enc)
             slots.append(("wave", (enc, inputs, dims, up, list(existing))))
+        GAP_LEDGER.note("encode", _time.perf_counter() - t_enc0)
 
         # Same-shape problems fold into ONE vmapped dispatch per bucket
         # (degraded-link cost is per device OPERATION, not per byte —
@@ -287,6 +324,7 @@ class TPUSolver:
                    inputs.prov_overhead is not None,
                    inputs.prov_pods_cap is not None)
             shape_waves.setdefault(key, []).append(i)
+        t_link0 = _time.perf_counter()
         flats: "list[tuple[list[int], object]]" = []  # (slot idxs, [K,L] dev)
         for key, idxs in shape_waves.items():
             (_gb, Nb, _neb), up = key[0], key[1]
@@ -298,10 +336,13 @@ class TPUSolver:
                 dev = jax.device_put(_stack_pack_inputs(members))
                 flat2d = _wave_pack_flat(dev, Nb, up)
             flats.append((idxs, flat2d))
+        GAP_LEDGER.note("link", _time.perf_counter() - t_link0)
         fetched: "dict[int, PackResult]" = {}
         if flats:
+            t_fetch0 = _time.perf_counter()
             cat = host_fetch(jnp.concatenate(
                 [f.reshape(-1) for _, f in flats]))
+            GAP_LEDGER.note("device_exec", _time.perf_counter() - t_fetch0)
             off = 0
             for idxs, f in flats:
                 K, L = f.shape
@@ -312,6 +353,7 @@ class TPUSolver:
                 off += K * L
 
         out: "list[SolveResult]" = []
+        t_dec = 0.0
         for i, (mode, payload) in enumerate(slots):
             if mode == "solo":
                 out.append(self.solve(
@@ -319,8 +361,11 @@ class TPUSolver:
                     payload.get("daemon_overhead"), payload.get("n_slots")))
             else:
                 enc, _, _, _, existing = payload
+                t_dec0 = _time.perf_counter()
                 out.append(decode(enc, fetched[i],
                                   [e.name for e in existing]))
+                t_dec += _time.perf_counter() - t_dec0
+        GAP_LEDGER.note("decode", t_dec)
         return out
 
     def warm_shapes(self, shapes: "Sequence[tuple]",
@@ -530,6 +575,23 @@ class TPUSolver:
             t2 - t1, compile_cache=compile_cache, bucket=plan.label())
         TRACER.record_span("solver.transfer", t3 - t2)
         TRACER.record_span("solver.decode", t4 - t3)
+        # gap-ledger attribution: the same intervals, filed against the
+        # enclosing wall scope (solve()/service). fetch is the device sync,
+        # so t3-t2 is the device_exec evidence; dispatch wall is host
+        # link/compile work plus the async enqueue.
+        from ..profiling import GAP_LEDGER
+        from ..profiling.continuous import detect_backend
+        GAP_LEDGER.note("encode", t1 - t0)
+        GAP_LEDGER.note("link", t2 - t1)
+        GAP_LEDGER.note("device_exec", t3 - t2)
+        GAP_LEDGER.note("decode", t4 - t3)
+        tb_shape = getattr(enc.grid.tiebreak, "shape", (16, 4))
+        GAP_LEDGER.annotate(
+            bucket=plan.label(), route=route,
+            groups=plan.groups, slots=plan.slots, existing=plan.existing,
+            pv=pv, t=int(tb_shape[0]), s=int(tb_shape[-1]),
+            backend=detect_backend(),
+            device_count=self.last_solve_info["device_count"])
         if _SOLVE_TIMING:
             self.last_timings = {
                 "encode_ms": self.last_solve_info["encode_ms"],

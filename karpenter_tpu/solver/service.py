@@ -339,70 +339,87 @@ class SolverService:
                 f"{request.deadline_ms}ms of cycle budget remaining; "
                 f"shedding solve")
         solver, seqnum = entry
-        pods = [wire.pod_from_wire(m) for m in request.pods]
-        existing = [wire.existing_from_wire(m) for m in request.existing]
-        overhead = list(request.daemon_overhead) or None
-        with self._lock:
-            self._solve_count += 1
-            trace_now = (self._trace_dir is not None
-                         and (self._solve_count - 1) % self._trace_every == 0
-                         and not self._trace_active)  # jax: ONE global profiler
-            if trace_now:
-                self._trace_active = True
-        t0 = time.perf_counter()
-        # the hbm scope attributes this solve's delta uploads to the
-        # resident solver; the rung is attributed after the solve, once
-        # the bucket label is known (attribute_delta below)
-        if trace_now:
-            # profiling must never fail a production Solve: start/stop are
-            # individually guarded so an unwritable dir or a wedged profiler
-            # degrades to an untraced solve, never an aborted RPC
-            started = False
-            try:
-                import jax
+        from ..profiling import GAP_LEDGER
 
-                jax.profiler.start_trace(self._trace_dir)
-                started = True
-            except Exception as e:
-                log.warning("profiler start failed: %s", e)
-            try:
+        # the gap ledger's OUTERMOST wall bracket for remote callers: wire
+        # decode + solve + response encode all file against this wall, and
+        # the residue (lock handoffs, trace glue) is published as
+        # `unaccounted` rather than silently disappearing
+        with GAP_LEDGER.solve_scope("service"):
+            w0 = time.perf_counter()
+            pods = [wire.pod_from_wire(m) for m in request.pods]
+            existing = [wire.existing_from_wire(m) for m in request.existing]
+            overhead = list(request.daemon_overhead) or None
+            wire_in_s = time.perf_counter() - w0
+            TRACER.record_span("solver.serialize", wire_in_s,
+                               direction="decode", pods=len(pods))
+            GAP_LEDGER.note("serialize", wire_in_s)
+            with self._lock:
+                self._solve_count += 1
+                trace_now = (self._trace_dir is not None
+                             and (self._solve_count - 1) % self._trace_every == 0
+                             and not self._trace_active)  # jax: ONE global profiler
+                if trace_now:
+                    self._trace_active = True
+            t0 = time.perf_counter()
+            # the hbm scope attributes this solve's delta uploads to the
+            # resident solver; the rung is attributed after the solve, once
+            # the bucket label is known (attribute_delta below)
+            if trace_now:
+                # profiling must never fail a production Solve: start/stop are
+                # individually guarded so an unwritable dir or a wedged profiler
+                # degrades to an untraced solve, never an aborted RPC
+                started = False
+                try:
+                    import jax
+
+                    jax.profiler.start_trace(self._trace_dir)
+                    started = True
+                except Exception as e:
+                    log.warning("profiler start failed: %s", e)
+                try:
+                    with buckets.hbm_scope(hbm_key(key)):
+                        result = solver.solve(pods, existing=existing,
+                                              daemon_overhead=overhead)
+                finally:
+                    if started:
+                        try:
+                            jax.profiler.stop_trace()
+                            log.info("profiler trace for solve #%d -> %s",
+                                     self._solve_count, self._trace_dir)
+                        except Exception as e:
+                            log.warning("profiler stop failed: %s", e)
+                    with self._lock:
+                        self._trace_active = False
+            else:
                 with buckets.hbm_scope(hbm_key(key)):
                     result = solver.solve(pods, existing=existing,
                                           daemon_overhead=overhead)
-            finally:
-                if started:
-                    try:
-                        jax.profiler.stop_trace()
-                        log.info("profiler trace for solve #%d -> %s",
-                                 self._solve_count, self._trace_dir)
-                    except Exception as e:
-                        log.warning("profiler stop failed: %s", e)
-                with self._lock:
-                    self._trace_active = False
-        else:
-            with buckets.hbm_scope(hbm_key(key)):
-                result = solver.solve(pods, existing=existing,
-                                      daemon_overhead=overhead)
-        solve_ms = (time.perf_counter() - t0) * 1000
-        self._record_shape(solver)
-        resp = result_to_response(result, solve_ms, seqnum)
-        # echo the device-path observability back over the wire so the
-        # CLIENT-side rpc span carries the same attributes this span does
-        info = getattr(solver, "last_solve_info", None) or {}
-        resp.routing = str(info.get("routing", "tpu"))
-        resp.compile_cache = str(info.get("compile_cache", "unknown"))
-        resp.transfer_ms = float(info.get("transfer_ms", 0.0))
-        resp.bucket = str(info.get("bucket", ""))
-        resp.device_count = int(info.get("device_count", 1))
-        # file the solve's pending delta bytes under its actual rung
-        buckets.HBM.attribute_delta(hbm_key(key), resp.bucket or "unknown")
-        span.set_attributes(routing=resp.routing,
-                            compile_cache=resp.compile_cache,
-                            transfer_ms=resp.transfer_ms,
-                            bucket=resp.bucket,
-                            device_count=resp.device_count,
-                            solve_ms=solve_ms)
-        return resp
+            solve_ms = (time.perf_counter() - t0) * 1000
+            self._record_shape(solver)
+            e0 = time.perf_counter()
+            resp = result_to_response(result, solve_ms, seqnum)
+            wire_out_s = time.perf_counter() - e0
+            TRACER.record_span("solver.serialize", wire_out_s,
+                               direction="encode")
+            GAP_LEDGER.note("serialize", wire_out_s)
+            # echo the device-path observability back over the wire so the
+            # CLIENT-side rpc span carries the same attributes this span does
+            info = getattr(solver, "last_solve_info", None) or {}
+            resp.routing = str(info.get("routing", "tpu"))
+            resp.compile_cache = str(info.get("compile_cache", "unknown"))
+            resp.transfer_ms = float(info.get("transfer_ms", 0.0))
+            resp.bucket = str(info.get("bucket", ""))
+            resp.device_count = int(info.get("device_count", 1))
+            # file the solve's pending delta bytes under its actual rung
+            buckets.HBM.attribute_delta(hbm_key(key), resp.bucket or "unknown")
+            span.set_attributes(routing=resp.routing,
+                                compile_cache=resp.compile_cache,
+                                transfer_ms=resp.transfer_ms,
+                                bucket=resp.bucket,
+                                device_count=resp.device_count,
+                                solve_ms=solve_ms)
+            return resp
 
     def Consolidate(self, request: pb.ConsolidateRequest,
                     context) -> pb.ConsolidateResponse:

@@ -51,6 +51,46 @@ PHASE_METRIC = f"{NAMESPACE}_scheduling_phase_duration_seconds"
 # overrides for soak tests)
 _DEFAULT_RING = 2048
 
+#: Every LITERAL span name the codebase records, in one place. The
+#: profiling gap ledger maps its phase table onto entries here, and
+#: hack/check_phase_accounting.py (make presubmit) fails the build when a
+#: span literal appears in code without appearing below — the drift
+#: tripwire that keeps attribution accounting honest. Span families built
+#: with f-strings (client RPC methods, deprovisioning mechanisms) are
+#: covered by DYNAMIC_PHASE_PREFIXES instead.
+PHASE_REGISTRY = (
+    "provisioning.cycle",
+    "provisioning.mask",
+    "provisioning.solve",
+    "provisioning.bind",
+    "provisioning.bind.existing",
+    "provisioning.bind.pods",
+    "provisioning.create",
+    "deprovisioning.cycle",
+    "deprovisioning.emptiness",
+    "deprovisioning.expiration",
+    "deprovisioning.drift",
+    "deprovisioning.consolidation",
+    "solver.service.Sync",
+    "solver.service.Solve",
+    "solver.service.Consolidate",
+    "solver.encode",
+    "solver.serialize",
+    "solver.dispatch.execute",
+    "solver.dispatch.compile",
+    "solver.transfer",
+    "solver.decode",
+    "ingest.decode",
+    "ingest.apply",
+    "fleet.queue_wait",
+)
+
+#: prefixes legitimising dynamically-built span names (f-strings)
+DYNAMIC_PHASE_PREFIXES = (
+    "solver.rpc.",
+    "deprovisioning.",
+)
+
 
 def _new_id(nbytes: int = 8) -> str:
     return os.urandom(nbytes).hex()

@@ -14,7 +14,9 @@ import threading
 import time
 
 import pytest
-from hypothesis import given, settings, strategies as st
+
+pytest.importorskip("hypothesis")  # not in every image; skip, don't error
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from karpenter_tpu.apis import wellknown as wk
 from karpenter_tpu.apis.provisioner import Provisioner

@@ -1,0 +1,380 @@
+"""Profiling plane (karpenter_tpu/profiling): gap-ledger accounting laws,
+roofline monotonicity, the continuous profiler's lifecycle and CPU fallback
+parity, the strict-noop contract, and the /debug/profilez endpoint."""
+
+import importlib.util
+import json
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from karpenter_tpu import profiling
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.apis.settings import Settings
+from karpenter_tpu.fake.cloud import FakeCloud
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.profiling import (GAP_LEDGER, PHASE_NAMES, PROFILER,
+                                     continuous, roofline)
+from karpenter_tpu.solver.core import TPUSolver
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiling():
+    """Plane ON and an empty gap ring around every test; restore after."""
+    prev = profiling.set_enabled(True)
+    GAP_LEDGER.clear()
+    yield
+    GAP_LEDGER.clear()
+    profiling.set_enabled(prev)
+
+
+@pytest.fixture(scope="module")
+def small_solver():
+    """One compiled small solver shared across the module (compile once)."""
+    cat = Catalog(types=[
+        make_instance_type("small.2x", cpu=2, memory="8Gi", od_price=0.10),
+        make_instance_type("large.8x", cpu=8, memory="32Gi", od_price=0.40),
+    ])
+    prov = Provisioner(name="default")
+    prov.set_defaults()
+    solver = TPUSolver(cat, [prov])
+    from karpenter_tpu.models.pod import make_pod
+    pods = [make_pod(f"p{i}", cpu="250m", memory="512Mi") for i in range(12)]
+    solver.solve(pods)  # compile outside the measured tests
+    return solver, pods
+
+
+# -- gap ledger accounting laws ----------------------------------------------------
+
+
+class TestGapLedger:
+    def test_phases_sum_to_wall_within_tolerance(self):
+        with GAP_LEDGER.solve_scope("test"):
+            t0 = time.perf_counter()
+            time.sleep(0.005)
+            GAP_LEDGER.note("encode", time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            time.sleep(0.003)
+            GAP_LEDGER.note("device_exec", time.perf_counter() - t1)
+        row = GAP_LEDGER.rows()[-1]
+        assert row["source"] == "test"
+        # attributed + residue is the wall by construction...
+        assert row["attributed_ms"] + row["unaccounted_ms"] == pytest.approx(
+            row["wall_ms"], abs=0.01)
+        # ...and the back-to-back notes cover nearly all of it
+        assert row["attributed_share"] > 0.9
+        assert row["attributed_share"] + row["unaccounted_share"] == (
+            pytest.approx(1.0, abs=1e-6))
+
+    def test_residue_never_negative_under_clock_skew(self):
+        # a phase note LARGER than the wall (cross-thread clock skew, or a
+        # nested layer double-filing) must clamp the residue to zero, not
+        # go negative — shares still sum to exactly 1
+        with GAP_LEDGER.solve_scope("skew"):
+            GAP_LEDGER.note("encode", 10.0)
+        row = GAP_LEDGER.rows()[-1]
+        assert row["unaccounted_ms"] == 0.0
+        assert row["unaccounted_share"] == 0.0
+        assert row["attributed_share"] == pytest.approx(1.0)
+
+    def test_unknown_phase_raises(self):
+        with GAP_LEDGER.solve_scope("bad"):
+            with pytest.raises(ValueError, match="unknown gap phase"):
+                GAP_LEDGER.note("warp_drive", 0.001)
+            GAP_LEDGER.note("encode", 0.001)  # keep the row non-empty
+
+    def test_note_outside_scope_is_noop(self):
+        before = GAP_LEDGER.rows_total
+        GAP_LEDGER.note("encode", 0.5)
+        assert GAP_LEDGER.rows_total == before
+        assert GAP_LEDGER.rows() == []
+
+    def test_nested_scopes_accumulate_into_one_row(self):
+        before = GAP_LEDGER.rows_total
+        with GAP_LEDGER.solve_scope("outer"):
+            GAP_LEDGER.note("serialize", 0.001)
+            with GAP_LEDGER.solve_scope("inner") as rec:
+                assert rec is not None  # transparent: the OUTER record
+                GAP_LEDGER.note("encode", 0.002)
+        assert GAP_LEDGER.rows_total == before + 1
+        row = GAP_LEDGER.rows()[-1]
+        assert row["source"] == "outer"
+        assert set(row["phases_ms"]) == {"serialize", "encode"}
+
+    def test_empty_scope_produces_no_row(self):
+        before = GAP_LEDGER.rows_total
+        with GAP_LEDGER.solve_scope("empty"):
+            pass  # native solver / error path: nothing measured
+        assert GAP_LEDGER.rows_total == before
+
+    def test_solve_rows_full_accounting(self, small_solver):
+        solver, pods = small_solver
+        solver.solve(pods)
+        row = GAP_LEDGER.rows()[-1]
+        assert row["source"] == "solver"
+        for phase in ("encode", "device_exec", "decode"):
+            assert row["phases_ms"][phase] >= 0, phase
+        assert set(row["phases_ms"]) <= set(PHASE_NAMES)
+        assert row["unaccounted_ms"] >= 0
+        assert row["route"] == "single"
+        assert row["bucket"]
+        rf = row["roofline"]
+        assert rf["floor_ms"] > 0
+        assert rf["bytes_moved"] > 0 and rf["flops"] > 0
+
+    def test_snapshot_shape(self, small_solver):
+        solver, pods = small_solver
+        solver.solve(pods)
+        snap = GAP_LEDGER.snapshot()
+        assert snap["phases"] == list(PHASE_NAMES)
+        assert snap["rows_total"] >= 1
+        assert "unaccounted" in snap["phase_ms_total"]
+        assert snap["last"]
+        shares = snap["phase_share"]
+        assert sum(shares.values()) == pytest.approx(1.0, abs=0.01)
+
+
+# -- roofline ----------------------------------------------------------------------
+
+
+class TestRoofline:
+    RUNGS = ((8, 32, 8), (16, 64, 16), (64, 256, 64), (256, 1024, 256))
+
+    def test_floor_monotone_in_rung_size(self):
+        floors, bytes_, flops = [], [], []
+        for g, n, e in self.RUNGS:
+            rf = roofline.estimate(g, n, e, pv=2, t=16, s=4)
+            floors.append(rf.floor_ms)
+            bytes_.append(rf.bytes_moved)
+            flops.append(rf.flops)
+        assert floors == sorted(floors)
+        assert bytes_ == sorted(bytes_) and len(set(bytes_)) == len(bytes_)
+        assert flops == sorted(flops) and len(set(flops)) == len(flops)
+
+    def test_observe_ratio(self):
+        rf = roofline.estimate(16, 64, 16, bucket="g16n64e16")
+        ratio = roofline.observe(rf, rf.floor_ms * 2)
+        assert ratio == pytest.approx(2.0, rel=1e-6)
+
+    def test_env_override_moves_floor(self, monkeypatch):
+        base = roofline.estimate(64, 256, 64).floor_ms
+        monkeypatch.setenv(roofline.BW_ENV, "0.0001")  # starve bandwidth
+        slow = roofline.estimate(64, 256, 64).floor_ms
+        assert slow > base
+
+    def test_bad_env_falls_back(self, monkeypatch):
+        base = roofline.estimate(16, 64, 16).floor_ms
+        monkeypatch.setenv(roofline.BW_ENV, "not-a-number")
+        assert roofline.estimate(16, 64, 16).floor_ms == base
+
+
+# -- continuous profiler -----------------------------------------------------------
+
+
+class TestContinuousProfiler:
+    def test_host_sampler_start_stop(self):
+        s = continuous.HostSampler(hz=200.0, ring=256)
+        assert s.ensure_started()
+        deadline = time.monotonic() + 2.0
+        while s.samples_total == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        s.stop()
+        assert not s.running()
+        assert s.samples_total > 0
+        folded = s.folded(10)
+        assert folded and all(
+            isinstance(st_, str) and cnt >= 1 for st_, cnt in folded)
+        # stacks are root;...;leaf module.qualname chains
+        assert any(";" in st_ for st_, _ in folded)
+        snap = s.snapshot()
+        assert snap["samples_total"] == s.samples_total
+        # loose sanity bound: this runs at 10x the default Hz while the
+        # rest of the suite loads every core, so the ratio is noisy here;
+        # the <5% acceptance at default Hz is the drill artifact's job
+        assert 0 <= snap["overhead_ratio"] < 0.5
+
+    def test_sampler_refuses_while_disabled(self):
+        s = continuous.HostSampler(hz=100.0, ring=64)
+        with profiling.disabled():
+            assert not s.ensure_started()
+            assert not s.running()
+        assert s.samples_total == 0
+
+    def test_device_ladder_cpu_fallback_mode(self):
+        # tier-1 runs under JAX_PLATFORMS=cpu: the ladder must land on the
+        # synthetic-timer rung, honestly labelled, and trace capture (a
+        # tpu-sync-only passthrough) must refuse
+        assert PROFILER.device.mode() == "cpu-synthetic"
+        assert PROFILER.device.start_trace("/tmp/nope") is False
+
+    def test_fallback_timer_parity_with_gap_row(self, small_solver):
+        # cpu-synthetic device events are the SAME perf_counter interval
+        # the gap ledger files as device_exec — parity is exact
+        solver, pods = small_solver
+        solver.solve(pods)
+        row = GAP_LEDGER.rows()[-1]
+        ev = PROFILER.device.events()[-1]
+        assert ev["mode"] == "cpu-synthetic"
+        assert ev["ms"] == pytest.approx(row["phases_ms"]["device_exec"],
+                                         abs=0.01)
+        assert ev["route"] == "single"
+
+    def test_merge_chrome_adds_profiling_lane(self):
+        PROFILER.device.observe(0.0005, bucket="g8n32e1")
+        now_us = time.time() * 1e6
+        doc = {"traceEvents": [
+            {"name": "provisioning.cycle", "ph": "X", "pid": 1, "tid": 1,
+             "ts": now_us - 2e6, "dur": 4e6},
+        ]}
+        merged = profiling.merge_chrome(doc)
+        lane = [e for e in merged["traceEvents"]
+                if e.get("pid") == profiling.PROFILE_LANE_PID]
+        assert any(e.get("ph") == "M" and
+                   e["args"]["name"] == "profiling" for e in lane)
+        assert any(e.get("ph") == "X" and
+                   e["name"].startswith("device_exec[") for e in lane)
+        # original doc untouched (merge copies)
+        assert len(doc["traceEvents"]) == 1
+
+    def test_merge_chrome_disabled_is_identity(self):
+        doc = {"traceEvents": [{"name": "x", "ph": "X", "ts": 0, "dur": 1}]}
+        with profiling.disabled():
+            assert profiling.merge_chrome(doc) is doc
+
+
+# -- strict-noop contract ----------------------------------------------------------
+
+
+class TestStrictNoop:
+    def test_disabled_plane_produces_nothing(self, small_solver):
+        solver, pods = small_solver
+        with profiling.disabled():
+            before = profiling.activity()
+            assert PROFILER.ensure_started() is False
+            solver.solve(pods)
+            with GAP_LEDGER.solve_scope("noop") as rec:
+                assert rec is None
+                GAP_LEDGER.note("encode", 0.5)
+                GAP_LEDGER.annotate(bucket="nope")
+            after = profiling.activity()
+        assert after == before
+
+    def test_chaos_invariant_flags_growth(self):
+        from karpenter_tpu.chaos.invariants import check_profiling_noop
+
+        before = {"host_samples": 3, "gap_rows": 1}
+        grown = {"host_samples": 7, "gap_rows": 1}
+        vs = check_profiling_noop(
+            {"enabled": False, "before": before, "after": grown})
+        assert len(vs) == 1
+        assert vs[0].invariant == "profiling-strict-noop"
+        assert "host_samples" in vs[0].message
+
+    def test_chaos_invariant_quiet_when_clean_or_enabled(self):
+        from karpenter_tpu.chaos.invariants import check_profiling_noop
+
+        same = {"host_samples": 3, "gap_rows": 1}
+        assert check_profiling_noop(
+            {"enabled": False, "before": same, "after": dict(same)}) == []
+        assert check_profiling_noop(
+            {"enabled": True, "before": same,
+             "after": {"host_samples": 99}}) == []
+        assert check_profiling_noop(None) == []
+
+
+# -- /debug/profilez ---------------------------------------------------------------
+
+
+@pytest.fixture
+def served_op():
+    clock = FakeClock()
+    cat = Catalog(types=[make_instance_type("m.large", cpu=4, memory="16Gi",
+                                            od_price=0.2)])
+    op = Operator(FakeCloud(catalog=cat, clock=clock),
+                  Settings(cluster_name="prof", cluster_endpoint="https://k"),
+                  cat, clock=clock, serve_http=True,
+                  metrics_port=0, health_port=0, webhook_port=0)
+    ports = op.serving.start()
+    yield op, ports
+    op.serving.stop()
+    op.stop()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                    timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestProfilezEndpoint:
+    def test_json_default(self, served_op):
+        op, ports = served_op
+        code, body = _get(ports["metrics"], "/debug/profilez")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["tool"] == "karpenter_tpu.profilez"
+        assert doc["enabled"] is True
+        assert isinstance(doc["stacks"], list)
+        assert doc["gap"]["phases"] == list(PHASE_NAMES)
+        assert doc["device"]["mode"] == "cpu-synthetic"
+
+    def test_folded_format(self, served_op):
+        op, ports = served_op
+        code, body = _get(ports["metrics"], "/debug/profilez?format=folded")
+        assert code == 200
+        for line in body.strip().splitlines():
+            stack, _, count = line.rpartition(" ")
+            assert stack and count.isdigit()
+
+    def test_malformed_n_is_400(self, served_op):
+        op, ports = served_op
+        code, body = _get(ports["metrics"], "/debug/profilez?n=bogus")
+        assert code == 400
+        assert "integer" in body
+
+    def test_unknown_format_is_400(self, served_op):
+        op, ports = served_op
+        code, body = _get(ports["metrics"], "/debug/profilez?format=xml")
+        assert code == 400
+        assert "xml" in body
+
+    def test_oversized_and_negative_n_clamp(self, served_op):
+        from karpenter_tpu.serving import MAX_PROFILE_STACKS
+
+        op, ports = served_op
+        code, body = _get(ports["metrics"], "/debug/profilez?n=999999")
+        assert code == 200
+        assert len(json.loads(body)["stacks"]) <= MAX_PROFILE_STACKS
+        code, _ = _get(ports["metrics"], "/debug/profilez?n=-5")
+        assert code == 200  # clamped up to 1, same as /debug/traces
+
+    def test_statusz_carries_profiling_section(self, served_op):
+        op, ports = served_op
+        code, body = _get(ports["metrics"], "/debug/statusz")
+        assert code == 200
+        doc = json.loads(body)
+        assert "profiling" in doc
+        assert doc["profiling"]["enabled"] is True
+        assert doc["profiling"]["gap"]["phases"] == list(PHASE_NAMES)
+
+
+# -- presubmit lint ----------------------------------------------------------------
+
+
+def test_phase_accounting_lint_passes():
+    """The committed tree must satisfy its own phase-vocabulary lint."""
+    path = Path(__file__).resolve().parent.parent / "hack" / \
+        "check_phase_accounting.py"
+    spec = importlib.util.spec_from_file_location("check_phase_accounting",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0

@@ -402,6 +402,9 @@ class TestRegressGate:
                    (5000.0, 5100.0, 4900.0, 5050.0), "msgs/s")
         self._seed(path, "baseline_config_ms", {"name": "inflate-100"},
                    (1.2, 1.3, 1.25, 1.28), "ms")
+        self._seed(path, "profile_unaccounted_share",
+                   {"name": "profile_gate", "pods": 400},
+                   (0.02, 0.025, 0.022, 0.018), "ratio")
         return path
 
     def _run(self, tmp_path, monkeypatch, *inject):
@@ -417,27 +420,35 @@ class TestRegressGate:
                                               capsys):
         rc = self._run(tmp_path, monkeypatch,
                        "interruption_msgs_per_sec=100",
-                       "baseline_config_ms=1.3")
+                       "baseline_config_ms=1.3",
+                       "profile_unaccounted_share=0.02")
         out = capsys.readouterr().out
         assert rc == 1, out
         assert "FAIL  interruption_msgs_per_sec" in out
         assert "ok    baseline_config_ms" in out
+        assert "ok    profile_unaccounted_share" in out
 
     def test_latency_regression_trips_too(self, tmp_path, monkeypatch,
                                           capsys):
         rc = self._run(tmp_path, monkeypatch,
                        "interruption_msgs_per_sec=5000",
-                       "baseline_config_ms=99")
+                       "baseline_config_ms=99",
+                       "profile_unaccounted_share=0.9")
         out = capsys.readouterr().out
         assert rc == 1, out
         assert "FAIL  baseline_config_ms" in out
+        # attribution rot judges in the same pass: 90% unaccounted is
+        # way past the seeded ~2% band ("lower" is the good direction)
+        assert "FAIL  profile_unaccounted_share" in out
 
     def test_in_band_passes_and_faster_is_never_a_regression(
             self, tmp_path, monkeypatch, capsys):
-        # 10x the throughput and half the latency: both GOOD directions
+        # 10x the throughput, half the latency, tighter attribution:
+        # all GOOD directions
         rc = self._run(tmp_path, monkeypatch,
                        "interruption_msgs_per_sec=50000",
-                       "baseline_config_ms=0.6")
+                       "baseline_config_ms=0.6",
+                       "profile_unaccounted_share=0.005")
         assert rc == 0, capsys.readouterr().out
 
     def test_unknown_host_seeds_instead_of_judging(self, tmp_path,
@@ -450,7 +461,8 @@ class TestRegressGate:
         monkeypatch.setenv("KARPENTER_TPU_PERF_HOST", "brand-new-box")
         rc = gate.main(["--ledger", self._ledger(tmp_path),
                         "--inject", "interruption_msgs_per_sec=100",
-                        "--inject", "baseline_config_ms=99"])
+                        "--inject", "baseline_config_ms=99",
+                        "--inject", "profile_unaccounted_share=0.9"])
         out = capsys.readouterr().out
         assert rc == 0, out
-        assert out.count("SEED") == 2
+        assert out.count("SEED") == 3

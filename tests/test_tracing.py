@@ -280,8 +280,9 @@ class TestOperatorTrace:
             assert {"provisioning.cycle", "provisioning.mask",
                     "provisioning.solve", "provisioning.bind"} <= names
             # span events are complete ("X"); federation may add "M"
-            # process_name metadata rows (standard chrome trace format)
-            assert all(e["ph"] in ("X", "M") for e in doc["traceEvents"])
+            # process_name metadata rows, and the profiling lane adds "i"
+            # instant events per host sample (standard chrome trace format)
+            assert all(e["ph"] in ("X", "M", "i") for e in doc["traceEvents"])
             assert any(e["ph"] == "X" for e in doc["traceEvents"])
             # unknown id is a 404, not an empty export
             try:
