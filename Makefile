@@ -8,9 +8,9 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit lint noretry hotloops crashpoints cardinality phaseacct test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm fleet-bench telemetry-drill claims diagnose provenance multichip soak perf-regress ledger-backfill profile-drill
+.PHONY: presubmit lint noretry hotloops crashpoints cardinality phaseacct reasons test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm fleet-bench telemetry-drill claims diagnose provenance multichip soak perf-regress ledger-backfill profile-drill explain-drill
 
-presubmit: lint claims provenance noretry hotloops crashpoints cardinality phaseacct perf-regress test verify-entry  ## what CI runs
+presubmit: lint claims provenance noretry hotloops crashpoints cardinality phaseacct reasons perf-regress test verify-entry  ## what CI runs
 
 perf-regress:  ## tier-1-sized micro-benches must stay inside the ledger's noise bands
 	$(CPU_ENV) $(PY) hack/check_perf_regress.py
@@ -45,8 +45,14 @@ crashpoints:  ## crashpoint catalog and call sites must stay in lockstep
 phaseacct:  ## gap-ledger phases and Tracer span registry must stay in lockstep
 	$(PY) hack/check_phase_accounting.py
 
+reasons:  ## explain reason vocabulary, mask dimensions and citing call sites must stay in lockstep
+	$(PY) hack/check_decision_reasons.py
+
 profile-drill:  ## 10k-pod attribution drill: >=95% of wall accounted, <5% overhead, RECORDED
 	$(CPU_ENV) $(PY) -m benchmarks.profile_drill
+
+explain-drill:  ## 10k-pod decision-provenance drill: 100% attribution, oracle parity, <1% overhead, RECORDED
+	$(CPU_ENV) $(PY) -m benchmarks.explain_drill
 
 diagnose:  ## introspection smoke: deadman, statusz, flight-recorder bundles
 	$(CPU_ENV) $(PY) -m pytest tests/test_introspect.py -q

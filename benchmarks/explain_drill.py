@@ -1,0 +1,294 @@
+"""The explain drill: prove the decision-provenance plane earns its keep.
+
+ISSUE 14's acceptance instrument: a deterministic 10k-pod problem over the
+full fleet catalog whose pods are split into labelled failure categories —
+
+  - ``fit``       fitting pods (tolerate the drill taint, small requests),
+  - ``taintpod``  taint-blocked (no toleration for the provisioner taint),
+  - ``selpod``    requirement-blocked (node selector names an instance
+                  type the catalog does not sell),
+  - ``hugepod``   resource-blocked (4000-core request no type can fit),
+  - ``aaz``       affinity-blocked (zone anti-affinity group larger than
+                  the zone universe; surplus pods are pinned to the
+                  sentinel no-zone and become unschedulable) —
+
+and the drill asserts three things:
+
+  1. **attribution** — every unschedulable group (100% of unassigned
+     pods) gets a ranked mask-attribution verdict, and each category's
+     dominant dimension is the one the mix was built to trip;
+  2. **parity** — every attribution ``reason`` clause is string-identical
+     (``==``) to the scalar oracle's ``diagnose_unschedulable`` verdict
+     for the same pod — the north-star audit for the explain plane;
+  3. **overhead** — min-of-repeats solve wall with the explain plane ON
+     is within 1% of the plane-disabled baseline (the plane is lazy:
+     nothing on the solve hot path), with the interleaved-p50 delta
+     recorded alongside.
+
+The artifact lands at benchmarks/results/explain/explain_drill.json
+(deterministic path — re-running overwrites) and coverage/parity/overhead
+are recorded through benchmarks/ledger.py so `make perf-regress` gates
+them like any other perf metric. Run via `make explain-drill`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+OUT_DIR = os.path.join(os.path.dirname(__file__), "results", "explain")
+ARTIFACT = os.path.join(OUT_DIR, "explain_drill.json")
+
+PODS = 10_000
+REPEATS = 9
+WARMUP = 2
+MAX_OVERHEAD_SHARE = 0.01
+N_DEVICES = 8
+AAZ_COUNT = 8  # > the fleet's 3 zones, so 5 surplus pods cannot place
+
+# pod-name prefix -> the mask dimension that category was built to trip
+# (None = the category must schedule). aaz surplus pods carry the no-zone
+# sentinel requirement after the zone-spread pre-pass, so their verdict
+# is the requirements clause — on the REWRITTEN spec, same as the oracle.
+CATEGORY_EXPECT = {
+    "fit": None,
+    "taintpod": "taints",
+    "selpod": "requirements",
+    "hugepod": "resources",
+    "aaz": "requirements",
+}
+
+
+def drill_problem(n_pods: int = PODS):
+    """(catalog, provisioners, pods): full fleet catalog, two provisioners
+    both carrying the drill taint, and the labelled category mix."""
+    from karpenter_tpu.apis import wellknown as wk
+    from karpenter_tpu.apis.provisioner import Provisioner
+    from karpenter_tpu.models.pod import Taint, Toleration, make_pod
+    from karpenter_tpu.models.requirements import OP_IN, Requirements
+    from karpenter_tpu.providers.instancetypes import generate_fleet_catalog
+
+    catalog = generate_fleet_catalog()
+    taint = (Taint(key="drill", effect="NoSchedule"),)
+    provisioners = []
+    for name, ct in (("drill-mixed", ["spot", "on-demand"]),
+                     ("drill-od", ["on-demand"])):
+        p = Provisioner(name=name, taints=taint,
+                        requirements=Requirements.of(
+                            (wk.LABEL_CAPACITY_TYPE, OP_IN, ct)))
+        p.set_defaults()
+        provisioners.append(p)
+
+    tol = (Toleration(key="drill", operator="Exists"),)
+    n_fit = n_pods - 1000 - 1000 - (1000 - AAZ_COUNT) - AAZ_COUNT
+    pods = []
+    # fitting: 10 deployments of small pods that tolerate the taint
+    per = n_fit // 10
+    for d in range(10):
+        for i in range(per + (1 if d < n_fit % 10 else 0)):
+            pods.append(make_pod(
+                f"fit-d{d}-{i}", cpu=f"{250 * (d % 4 + 1)}m",
+                memory=f"{512 * (d % 4 + 1)}Mi", tolerations=tol))
+    # taint-blocked: no toleration, otherwise schedulable
+    pods += [make_pod(f"taintpod-{i}", cpu="250m", memory="512Mi")
+             for i in range(1000)]
+    # requirement-blocked: selector names a type the catalog does not sell
+    pods += [make_pod(f"selpod-{i}", cpu="250m", memory="512Mi",
+                      tolerations=tol,
+                      node_selector={wk.LABEL_INSTANCE_TYPE:
+                                     "drill.absent-type"})
+             for i in range(1000)]
+    # resource-blocked: no instance type fits 4000 cores
+    pods += [make_pod(f"hugepod-{i}", cpu="4000", memory="1Gi",
+                      tolerations=tol)
+             for i in range(1000 - AAZ_COUNT)]
+    # affinity-blocked: zone anti-affinity wider than the zone universe
+    pods += [make_pod(f"aaz-{i}", cpu="250m", memory="512Mi",
+                      tolerations=tol, anti_affinity_zone=True)
+             for i in range(AAZ_COUNT)]
+    assert len(pods) == n_pods, len(pods)
+    return catalog, provisioners, pods
+
+
+def _category(pod_name: str) -> str:
+    return pod_name.split("-", 1)[0]
+
+
+def audit_attribution(result, provisioners, catalog) -> dict:
+    """Attribute every unschedulable group; compare each verdict with the
+    scalar oracle's clause (==) and with the category's expected
+    dimension. Returns coverage/parity/per-category evidence."""
+    from karpenter_tpu import explain
+    from karpenter_tpu.models.encode import (build_grid,
+                                             diagnose_unschedulable,
+                                             kubelet_arrays)
+
+    grid = build_grid(catalog)
+    kub = kubelet_arrays(provisioners, catalog)
+    groups_total = len(result.unschedulable)
+    attributed = parity_ok = 0
+    pods_unassigned = 0
+    categories: "dict[str, dict]" = {}
+    mismatches: "list[dict]" = []
+    samples: "list[dict]" = []
+    t0 = time.perf_counter()
+    for g_idx, count in sorted(result.unschedulable.items()):
+        group = result.groups[g_idx]
+        spec = group.spec
+        oracle = diagnose_unschedulable(spec, provisioners, catalog,
+                                        grid=grid, kubelet=kub)
+        verdict = explain.attribute_pod(spec, provisioners, catalog,
+                                        grid=grid, kubelet=kub)
+        attributed += 1
+        pods_unassigned += count
+        ok = verdict["reason"] == oracle
+        parity_ok += ok
+        cat = _category(group.pod_names[0])
+        expected = CATEGORY_EXPECT.get(cat)
+        slot = categories.setdefault(cat, {
+            "pods": 0, "groups": 0, "dimension": verdict["dimension"],
+            "expected_dimension": expected,
+            "dimension_ok": True, "parity_ok": True})
+        slot["pods"] += count
+        slot["groups"] += 1
+        slot["parity_ok"] &= ok
+        slot["dimension_ok"] &= (verdict["dimension"] == expected)
+        if not ok:
+            mismatches.append({"group": g_idx, "pod": group.pod_names[0],
+                               "oracle": oracle,
+                               "attribution": verdict["reason"]})
+        if len(samples) < 4 and cat not in {s["category"] for s in samples}:
+            samples.append({"category": cat, "pod": group.pod_names[0],
+                            "reason": verdict["reason"],
+                            "summary": verdict["summary"],
+                            "ranked": verdict["ranked"],
+                            "nearest": verdict["nearest"]})
+    wall = time.perf_counter() - t0
+    coverage = attributed / groups_total if groups_total else 1.0
+    parity = parity_ok / groups_total if groups_total else 1.0
+    return {
+        "groups_unschedulable": groups_total,
+        "pods_unassigned": pods_unassigned,
+        "groups_attributed": attributed,
+        "attribution_coverage": round(coverage, 6),
+        "reason_parity": round(parity, 6),
+        "parity_mismatches": mismatches,
+        "categories": {k: categories[k] for k in sorted(categories)},
+        "categories_ok": all(c["dimension_ok"] and c["parity_ok"]
+                             for c in categories.values()),
+        "samples": samples,
+        "attribution_wall_ms": round(wall * 1e3, 3),
+        "attribution_ms_per_group": round(
+            wall * 1e3 / max(groups_total, 1), 4),
+    }
+
+
+def measure_overhead(solver, pods, repeats: int = REPEATS,
+                     warmup: int = WARMUP) -> dict:
+    """Solve walls with the explain plane ON vs OFF, interleaved with
+    alternating order (the profile_drill idiom) so allocator / jit-cache
+    warm-drift cancels instead of billing one side. min-of-repeats is the
+    gated overhead estimator (container noise is additive-positive); the
+    p50 delta over the same interleaved samples is recorded alongside."""
+    from karpenter_tpu import explain
+
+    for _ in range(warmup):
+        solver.solve(pods)
+    prev = explain.set_enabled(True)
+    walls_on: "list[float]" = []
+    walls_off: "list[float]" = []
+    try:
+        for i in range(repeats):
+            for side in (("on", "off") if i % 2 == 0 else ("off", "on")):
+                if side == "on":
+                    t0 = time.perf_counter()
+                    solver.solve(pods)
+                    walls_on.append(time.perf_counter() - t0)
+                else:
+                    with explain.disabled():
+                        t0 = time.perf_counter()
+                        solver.solve(pods)
+                        walls_off.append(time.perf_counter() - t0)
+    finally:
+        explain.set_enabled(prev)
+    on_min, off_min = min(walls_on), min(walls_off)
+    on_p50 = statistics.median(walls_on)
+    off_p50 = statistics.median(walls_off)
+    overhead = max(0.0, (on_min - off_min) / off_min) if off_min > 0 else 0.0
+    p50_delta = (on_p50 - off_p50) / off_p50 if off_p50 > 0 else 0.0
+    return {
+        "repeats": repeats,
+        "wall_ms_min_on": round(on_min * 1e3, 3),
+        "wall_ms_min_off": round(off_min * 1e3, 3),
+        "wall_ms_p50_on": round(on_p50 * 1e3, 3),
+        "wall_ms_p50_off": round(off_p50 * 1e3, 3),
+        "overhead_share": round(overhead, 6),
+        "p50_delta_share": round(p50_delta, 6),
+    }
+
+
+def run_drill(repeats: int = REPEATS) -> dict:
+    from karpenter_tpu.utils.jaxenv import pin_cpu
+
+    pin_cpu(N_DEVICES)
+    from benchmarks import ledger
+    from karpenter_tpu.solver.core import TPUSolver
+
+    catalog, provisioners, pods = drill_problem()
+    solver = TPUSolver(catalog, provisioners)
+    result = solver.solve(pods)
+    audit = audit_attribution(result, provisioners, catalog)
+    overhead = measure_overhead(solver, pods, repeats)
+
+    passed = (audit["attribution_coverage"] == 1.0
+              and audit["reason_parity"] == 1.0
+              and audit["categories_ok"]
+              and audit["pods_unassigned"] > 0
+              and overhead["overhead_share"] < MAX_OVERHEAD_SHARE)
+    record = {
+        "tool": "karpenter_tpu.explain_drill",
+        "schema": 1,
+        "pods": PODS,
+        "nodes": len(result.nodes),
+        "thresholds": {"max_overhead_share": MAX_OVERHEAD_SHARE},
+        "attribution": audit,
+        "overhead": overhead,
+        "passed": passed,
+    }
+    os.makedirs(OUT_DIR, exist_ok=True)
+    with open(ARTIFACT, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    workload = {"name": "explain_drill", "pods": PODS,
+                "unassigned": audit["pods_unassigned"]}
+    for metric, value in (
+            ("explain_attribution_coverage", audit["attribution_coverage"]),
+            ("explain_reason_parity", audit["reason_parity"]),
+            ("explain_overhead_share", overhead["overhead_share"])):
+        ledger.record(metric, value, "ratio",
+                      source="benchmarks.explain_drill", backend="cpu",
+                      workload=workload, degraded=not passed,
+                      artifact=ARTIFACT)
+    return record
+
+
+def main(argv=None) -> int:
+    record = run_drill()
+    print(json.dumps({
+        "passed": record["passed"],
+        "pods_unassigned": record["attribution"]["pods_unassigned"],
+        "attribution_coverage": record["attribution"][
+            "attribution_coverage"],
+        "reason_parity": record["attribution"]["reason_parity"],
+        "overhead_share": record["overhead"]["overhead_share"],
+        "p50_delta_share": record["overhead"]["p50_delta_share"],
+        "artifact": ARTIFACT,
+    }))
+    return 0 if record["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -311,6 +311,26 @@ def _profiling_entries(doc: dict):
                 yield (metric, p[field], "ratio", "cpu", degraded, wl, None)
 
 
+def _explain_entries(doc: dict):
+    """benchmarks/explain_drill.py artifacts: attribution coverage, oracle
+    parity, and the enabled-vs-disabled solve overhead (perf-regress
+    trends explain_overhead_share)."""
+    if doc.get("tool") != "karpenter_tpu.explain_drill":
+        return
+    degraded = not doc.get("passed", False)
+    att = doc.get("attribution") or {}
+    ovh = doc.get("overhead") or {}
+    wl = {"name": "explain_drill", "pods": doc.get("pods"),
+          "unassigned": att.get("pods_unassigned")}
+    for section, field, metric in (
+            (att, "attribution_coverage", "explain_attribution_coverage"),
+            (att, "reason_parity", "explain_reason_parity"),
+            (ovh, "overhead_share", "explain_overhead_share")):
+        if isinstance(section.get(field), (int, float)):
+            yield (metric, section[field], "ratio", "cpu", degraded, wl,
+                   None)
+
+
 _BACKFILL_SOURCES = (
     ("BENCH_r0*.json", "bench.py", _bench_round_entries),
     ("benchmarks/results/bench_*.json", "benchmarks.record",
@@ -330,6 +350,8 @@ _BACKFILL_SOURCES = (
      _trace_summary_entries),
     ("benchmarks/results/profiling/*.json", "benchmarks.profile_drill",
      _profiling_entries),
+    ("benchmarks/results/explain/*.json", "benchmarks.explain_drill",
+     _explain_entries),
 )
 
 

@@ -1,0 +1,169 @@
+#!/usr/bin/env python3
+"""Decision-reason lint: the explain vocabulary cannot drift.
+
+The provenance plane's contract is that every decision cites a reason
+from one registry (karpenter_tpu/explain/reasons.py), and that registry
+stays in lockstep with the code that produces the decisions. Four
+AST-level checks (no package import — the lint must run without jax, the
+check_phase_accounting idiom):
+
+1. reasons.DIMENSIONS equals solver/core.py MASK_DIMENSIONS exactly (the
+   mask factors the dense admission rule multiplies are the dimensions
+   attribution decomposes);
+2. reasons.CLAUSES covers the dimensions 1:1 in order, and its clause
+   strings are EXACTLY the literals models/encode.py
+   diagnose_unschedulable returns — the parity audit compares verdicts
+   with `==`, so a reworded oracle clause without the registry edit (or
+   vice versa) fails here before it fails in production;
+3. every literal `reason` passed to note_shed() in karpenter_tpu/ is a
+   SHED_REASONS entry, and every entry is cited somewhere (a dead reason
+   row would make the docs lie);
+4. every literal `verdict` passed to _note_verdict() (ops/consolidate.py
+   per-lane capture) is a CONSOLIDATION_VERDICTS entry, and every entry
+   is cited somewhere.
+
+Run via `make reasons` (part of `make presubmit`).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "karpenter_tpu"
+REASONS = PACKAGE / "explain" / "reasons.py"
+SOLVER_CORE = PACKAGE / "solver" / "core.py"
+ENCODE = PACKAGE / "models" / "encode.py"
+
+# call name -> (positional index of the cited literal, registry name)
+CITING_CALLS = {
+    "note_shed": (2, "SHED_REASONS"),
+    "_note_verdict": (2, "CONSOLIDATION_VERDICTS"),
+}
+
+
+def _module_assign(path: pathlib.Path, name: str):
+    """The AST value node of a module-level `name = ...` assignment."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets):
+            return node.value
+    raise SystemExit(f"check_decision_reasons: {name} not found in {path}")
+
+
+def _oracle_clauses() -> "set[str]":
+    """Constant strings returned by diagnose_unschedulable (implicit
+    string concatenation is already one ast.Constant)."""
+    tree = ast.parse(ENCODE.read_text(), filename=str(ENCODE))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and \
+                node.name == "diagnose_unschedulable":
+            return {r.value.value for r in ast.walk(node)
+                    if isinstance(r, ast.Return)
+                    and isinstance(r.value, ast.Constant)
+                    and isinstance(r.value.value, str)}
+    raise SystemExit(
+        f"check_decision_reasons: diagnose_unschedulable not in {ENCODE}")
+
+
+def _cited_literals() -> "dict[str, list[tuple[str, int, str]]]":
+    """registry name -> [(relpath, lineno, literal)] for every citing
+    call site in karpenter_tpu/ (the registry module itself excluded)."""
+    out: "dict[str, list[tuple[str, int, str]]]" = {
+        reg: [] for _, reg in CITING_CALLS.values()}
+    for path in sorted(PACKAGE.rglob("*.py")):
+        if path == REASONS:
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        rel = str(path.relative_to(ROOT))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if name not in CITING_CALLS:
+                continue
+            idx, reg = CITING_CALLS[name]
+            if len(node.args) > idx and \
+                    isinstance(node.args[idx], ast.Constant) and \
+                    isinstance(node.args[idx].value, str):
+                out[reg].append((rel, node.lineno, node.args[idx].value))
+    return out
+
+
+def main() -> int:
+    problems: "list[str]" = []
+    dimensions = tuple(ast.literal_eval(_module_assign(REASONS,
+                                                       "DIMENSIONS")))
+    clauses = tuple(ast.literal_eval(_module_assign(REASONS, "CLAUSES")))
+    shed_reasons = tuple(ast.literal_eval(_module_assign(REASONS,
+                                                         "SHED_REASONS")))
+    verdicts = tuple(ast.literal_eval(
+        _module_assign(REASONS, "CONSOLIDATION_VERDICTS")))
+    mask_dims = tuple(ast.literal_eval(
+        _module_assign(SOLVER_CORE, "MASK_DIMENSIONS")))
+
+    # 1) the registry's dimensions ARE the solver's mask factors
+    if dimensions != mask_dims:
+        problems.append(
+            f"explain/reasons.py DIMENSIONS {dimensions!r} != "
+            f"solver/core.py MASK_DIMENSIONS {mask_dims!r}")
+
+    # 2) clauses cover the dimensions 1:1 in order, strings match the
+    # scalar oracle verbatim
+    if tuple(dim for dim, _ in clauses) != dimensions:
+        problems.append(
+            f"explain/reasons.py CLAUSES keys "
+            f"{tuple(d for d, _ in clauses)!r} != DIMENSIONS "
+            f"{dimensions!r} (1:1, same order)")
+    registry_clauses = {clause for _, clause in clauses}
+    oracle = _oracle_clauses()
+    for clause in sorted(registry_clauses - oracle):
+        problems.append(
+            f"explain/reasons.py clause {clause!r} is not returned by "
+            f"models/encode.py diagnose_unschedulable (parity audit "
+            f"compares with ==)")
+    for clause in sorted(oracle - registry_clauses):
+        problems.append(
+            f"models/encode.py diagnose_unschedulable returns {clause!r} "
+            f"which is not in explain/reasons.py CLAUSES")
+
+    # 3+4) every cited literal is registered; every registry row is cited
+    cited = _cited_literals()
+    for reg, vocab in (("SHED_REASONS", shed_reasons),
+                       ("CONSOLIDATION_VERDICTS", verdicts)):
+        seen: "set[str]" = set()
+        for rel, lineno, literal in cited[reg]:
+            seen.add(literal)
+            if literal not in vocab:
+                problems.append(
+                    f"{rel}:{lineno}: cites {literal!r} which is not in "
+                    f"explain/reasons.py {reg}")
+        for entry in vocab:
+            if entry not in seen:
+                problems.append(
+                    f"explain/reasons.py {reg} entry {entry!r} is cited "
+                    f"nowhere in karpenter_tpu/ (dead vocabulary rows "
+                    f"make the docs lie)")
+
+    for p in problems:
+        print(f"check_decision_reasons: {p}", file=sys.stderr)
+    if problems:
+        print(f"check_decision_reasons: {len(problems)} problem(s)",
+              file=sys.stderr)
+        return 1
+    n_cited = sum(len(v) for v in cited.values())
+    print(f"check_decision_reasons: ok ({len(dimensions)} dimensions, "
+          f"{len(clauses)} oracle clauses, {len(shed_reasons)} shed "
+          f"reasons, {len(verdicts)} consolidation verdicts, "
+          f"{n_cited} citing call sites)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -375,6 +375,65 @@ def cmd_events(args) -> int:
     return 0
 
 
+def cmd_explain(args) -> int:
+    """Answer WHY for a pod from a live controller's decision-provenance
+    ring (/debug/decisions, the explain plane): the DecisionRecord that
+    assigned the pod, or its per-dimension unschedulability attribution
+    with the ranked reason summary. With no pod, prints the decision
+    index (or one full record with --id)."""
+    import json as _json
+    from urllib.error import HTTPError
+
+    base = args.endpoint.rstrip("/")
+    if args.pod:
+        url = f"{base}/debug/decisions?pod={args.pod}"
+    elif args.id:
+        url = f"{base}/debug/decisions?id={args.id}"
+    else:
+        url = f"{base}/debug/decisions?limit={args.limit}"
+    try:
+        payload = _fetch_json(url)
+    except HTTPError as e:
+        try:
+            body = e.read().decode().strip()
+        except Exception:  # noqa: BLE001 — CLI boundary
+            body = ""
+        print(body or f"{url}: {e}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"cannot reach {url}: {e}", file=sys.stderr)
+        return 1
+    if args.json or not args.pod:
+        print(_json.dumps(payload, indent=2, sort_keys=True, default=str))
+        return 0
+    # human verdict for ONE pod: its assignment, or the ranked attribution
+    rid = payload.get("id", "?")
+    for a in payload.get("assignments", ()):
+        if args.pod in (a.get("pods") or ()):
+            print(f"pod {args.pod}: ASSIGNED by decision {rid} -> "
+                  f"{a.get('itype')}/{a.get('zone')}/"
+                  f"{a.get('capacity_type')} "
+                  f"(provisioner {a.get('provisioner')}, "
+                  f"${a.get('price', 0)}/h)")
+            return 0
+    for u in payload.get("unassigned", ()):
+        if u.get("pod") == args.pod:
+            print(f"pod {args.pod}: UNSCHEDULABLE (decision {rid})")
+            print(f"  reason:  {u.get('reason')}")
+            print(f"  summary: {u.get('summary')}")
+            print(f"  ranked:  {', '.join(u.get('ranked') or ())}")
+            nearest = u.get("nearest")
+            if nearest:
+                print(f"  nearest fit: short by {nearest.get('display')}")
+            if not u.get("parity", True):
+                print("  WARNING: attribution disagrees with the scalar "
+                      "oracle (reason parity audit failed)")
+            return 0
+    print(f"pod {args.pod}: mentioned by decision {rid} "
+          f"(kind {payload.get('kind')})")
+    return 0
+
+
 def cmd_sync(args) -> int:
     """Make a coordination plane match a manifest fixture set (apply +
     optional prune) — the hermetic analogue of the reference's GitOps
@@ -659,6 +718,22 @@ def main(argv=None) -> int:
     p_events.add_argument("--json", action="store_true",
                           help="raw JSON instead of columns")
     p_events.set_defaults(fn=cmd_events)
+
+    p_explain = sub.add_parser(
+        "explain", help="answer WHY for a pod from a live controller's "
+                        "decision-provenance ring (/debug/decisions)")
+    p_explain.add_argument("pod", nargs="?", default="",
+                           help="pod name to resolve (omit to list the "
+                                "decision index)")
+    p_explain.add_argument("--id", default="",
+                           help="fetch one decision record by id instead")
+    p_explain.add_argument("--endpoint", default="http://127.0.0.1:8080",
+                           help="controller metrics listener base URL")
+    p_explain.add_argument("--limit", type=int, default=20,
+                           help="index size when listing")
+    p_explain.add_argument("--json", action="store_true",
+                           help="raw JSON instead of the human verdict")
+    p_explain.set_defaults(fn=cmd_explain)
 
     p_sync = sub.add_parser(
         "sync", help="apply (and optionally prune to) a manifest fixture "

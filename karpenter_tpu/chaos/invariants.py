@@ -505,9 +505,33 @@ def check_profiling_noop(profiling) -> "list[Violation]":
     return out
 
 
+def check_explain_noop(explain) -> "list[Violation]":
+    """explain-strict-noop: the decision-provenance plane is advisory —
+    with the plane disabled it must do NOTHING. The runner disables
+    explain for the scenario and hands us before/after activity counters
+    (karpenter_tpu.explain.activity()); ANY growth — records emitted,
+    attributions run, sheds or consolidations noted, ring depth — means
+    a producer ignored the switch and the plane has become
+    load-bearing."""
+    if not explain or explain.get("enabled", True):
+        return []  # not part of this drill, or plane was left on
+    out: "list[Violation]" = []
+    before = explain.get("before") or {}
+    after = explain.get("after") or {}
+    for key in sorted(set(before) | set(after)):
+        grew = after.get(key, 0) - before.get(key, 0)
+        if grew > 0:
+            out.append(Violation(
+                "explain-strict-noop",
+                f"explain disabled but {key} grew by {grew} "
+                f"({before.get(key, 0)} -> {after.get(key, 0)})"))
+    return out
+
+
 def check_all(op, cloud, token_launches=None,
               consolidation_actions=None,
-              resilience=None, profiling=None) -> "list[Violation]":
+              resilience=None, profiling=None,
+              explain=None) -> "list[Violation]":
     out = []
     out += check_token_ledger(token_launches or {})
     out += check_bijection(op, cloud)
@@ -519,4 +543,5 @@ def check_all(op, cloud, token_launches=None,
     out += check_degrade_monotone(resilience)
     out += check_columnar_coherence(op)
     out += check_profiling_noop(profiling)
+    out += check_explain_noop(explain)
     return out

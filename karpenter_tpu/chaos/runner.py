@@ -240,6 +240,14 @@ class ChaosRunner:
         from .. import profiling
         prof_prev = profiling.set_enabled(False)
         prof_before = profiling.activity()
+        # explain-strict-noop drill: same contract for the decision-
+        # provenance plane — disabled for the whole scenario, activity
+        # diffed at the end (invariants.check_explain_noop). The --storm
+        # drill is the complement: it runs with explain ON and asserts
+        # every shed cites a vocabulary reason.
+        from .. import explain
+        expl_prev = explain.set_enabled(False)
+        expl_before = explain.activity()
         try:
             injector.install(op, cloud)
             self._reconcile_workload(op, workload, injector)
@@ -294,12 +302,24 @@ class ChaosRunner:
                 "deltas": {k: prof_after[k] - prof_before[k]
                            for k in prof_before},
             }
+            expl_after = explain.activity()
+            explain_evidence = {
+                "enabled": False,
+                "before": expl_before,
+                "after": expl_after,
+            }
+            explain_stored = {
+                "enabled": False,
+                "deltas": {k: expl_after[k] - expl_before[k]
+                           for k in expl_before},
+            }
             violations = invariants.check_all(
                 op, cloud,
                 token_launches=injector.token_launches,
                 consolidation_actions=injector.consolidation_actions,
                 resilience=resilience_evidence,
-                profiling=profiling_evidence)
+                profiling=profiling_evidence,
+                explain=explain_evidence)
             if not self._quiescent(op):
                 violations = [invariants.Violation(
                     "quiescence",
@@ -324,6 +344,7 @@ class ChaosRunner:
                     self._bundles.append(written)
         finally:
             profiling.set_enabled(prof_prev)
+            explain.set_enabled(expl_prev)
             op.stop()
 
         fired_kinds = sorted(injector.fired_kinds())
@@ -342,6 +363,7 @@ class ChaosRunner:
             "final_nodes": len(op.cluster.nodes),
             "resilience": resilience_evidence,
             "profiling": profiling_stored,
+            "explain": explain_stored,
             "violations": [v.as_dict() for v in violations],
             "passed": not violations,
         }
@@ -756,11 +778,18 @@ class ChaosRunner:
         The burst drill doubles as the profiling strict-noop proof: the
         whole storm — fleet ``_dispatch`` gap scopes included — runs with
         the plane disabled and must leave ZERO profiling activity behind
-        (invariants.check_profiling_noop)."""
+        (invariants.check_profiling_noop). The explain plane runs the
+        OPPOSITE way: enabled for the storm, and every shed the fleet
+        fires must land in the decision ring citing a SHED_REASONS
+        vocabulary entry — the positive half of the provenance
+        contract."""
+        from .. import explain as _explain
         from .. import profiling as _profiling
 
         prof_prev = _profiling.set_enabled(False)
         prof_before = _profiling.activity()
+        expl_prev = _explain.set_enabled(True)
+        expl_before = _explain.activity()
         try:
             out = self._storm_scenario_impl(scenario)
             prof_after = _profiling.activity()
@@ -776,9 +805,41 @@ class ChaosRunner:
             if noop:
                 out["violations"].extend(v.as_dict() for v in noop)
                 out["passed"] = False
+            expl_after = _explain.activity()
+            new_sheds = (expl_after["sheds_total"]
+                         - expl_before["sheds_total"])
+            fired = (out["totals"]["shed_admission"]
+                     + out["totals"]["shed_queue"])
+            tail = _explain.DECISIONS.records(kind="shed")
+            tail = tail[len(tail) - min(new_sheds, len(tail)):]
+            reasons: "dict[str, int]" = {}
+            uncited = 0
+            for rec in tail:
+                if rec.get("reason") in _explain.SHED_REASONS and \
+                        rec.get("where") in ("admission", "queue"):
+                    reasons[rec["reason"]] = reasons.get(rec["reason"], 0) + 1
+                else:
+                    uncited += 1
+            if new_sheds != fired or uncited:
+                out["violations"].append(invariants.Violation(
+                    "shed-citations",
+                    f"storm fired {fired} shed(s) but the decision ring "
+                    f"recorded {new_sheds} ({uncited} without a vocabulary "
+                    f"reason) — every shed must cite its cause").as_dict())
+                out["passed"] = False
+            # counts only (never record ids): the ring's monotonic ids
+            # depend on process history, and the replay contract says the
+            # scenario dict is a pure function of (seed, scenario)
+            out["explain"] = {
+                "enabled": True,
+                "sheds_fired": fired,
+                "shed_records": new_sheds,
+                "reasons": dict(sorted(reasons.items())),
+            }
             return out
         finally:
             _profiling.set_enabled(prof_prev)
+            _explain.set_enabled(expl_prev)
 
     def _storm_scenario_impl(self, scenario: int) -> dict:
         from ..fleet import FleetFrontend

@@ -23,6 +23,7 @@ from ..events import EventRecorder
 from ..metrics import NAMESPACE, REGISTRY, Registry
 from ..models.cluster import ClusterState
 from ..introspect.watchdog import cycle as _wd_cycle
+from ..ops import consolidate as consolidate_ops
 from ..ops.consolidate import run_consolidation
 from ..oracle.consolidation import find_consolidation
 from ..recovery.crashpoints import crashpoint
@@ -291,6 +292,11 @@ class DeprovisioningController:
             ("tpu", run_tpu if self.use_tpu_solver else None),
             ("oracle", run_oracle),
         ]
+        from .. import explain
+        if explain.enabled():
+            # clear the previous pass's capture so the audit record below
+            # can't cite stale verdicts when a non-TPU rung serves this pass
+            consolidate_ops.last_verdicts = None
         ladder = self.consolidate_ladder
         start = ladder.start_rung()
         if chain[start][1] is None:
@@ -317,6 +323,9 @@ class DeprovisioningController:
         self.eval_duration.observe(_time.perf_counter() - t0,
                                    method=method or "oracle")
         TRACER.annotate(routing=method or "none")  # backend that actually ran
+        decision_id = self._emit_consolidation_decision(
+            action, method or "none",
+            consolidate_ops.last_verdicts if method == "tpu" else None)
         if action is None:
             return None
         nodes = [self.cluster.nodes.get(n) for n in action.nodes]
@@ -343,17 +352,19 @@ class DeprovisioningController:
                     "nodes": list(action.nodes),
                     "replacement": replacement.name})
             crashpoint("deprovisioning.mid_replace")
+            cite = f" (decision {decision_id})" if decision_id else ""
             self.recorder.normal(
                 f"node/{action.node}", "ConsolidationReplace",
                 f"launched replacement {replacement.name} "
-                f"({action.replacement[0]}); draining once initialized")
+                f"({action.replacement[0]}); draining once initialized{cite}")
             self._pending_replace = {"action": action,
                                      "replacement": replacement.name,
-                                     "started_ts": now}
+                                     "started_ts": now,
+                                     "decision_id": decision_id}
             return action
         if not self._mark_all_or_nothing(action):
             return None
-        self._record_action(action, now)
+        self._record_action(action, now, decision_id=decision_id)
         return action
 
     # a just-launched node may be empty only because its workload has not
@@ -401,7 +412,10 @@ class DeprovisioningController:
             nodes=tuple(n.name for n in empties))
         if not self._mark_all_or_nothing(action):
             return None
-        self._record_action(action, now, label="consolidation-delete-empty")
+        decision_id = self._emit_consolidation_decision(
+            action, "empty-sweep", None)
+        self._record_action(action, now, label="consolidation-delete-empty",
+                            decision_id=decision_id)
         return action
 
     def _mark_all_or_nothing(self, action) -> bool:
@@ -440,13 +454,52 @@ class DeprovisioningController:
         if self.journal is not None:
             self.journal.resolve(REPLACE, action.node, outcome=outcome)
 
-    def _record_action(self, action, now: float, label: str = "") -> None:
+    def _emit_consolidation_decision(self, action, method: str,
+                                     verdicts) -> "Optional[str]":
+        """One consolidation audit DecisionRecord: the action taken (or
+        None), the backend that decided it, and — when the TPU batched
+        search ran with the explain plane on — every candidate lane's
+        keep/evict verdict with its cost delta. Advisory: failures are
+        swallowed, and an idle pass (no action, no verdicts) emits
+        nothing."""
+        from .. import explain
+
+        if not explain.enabled() or (action is None and not verdicts):
+            return None
+        try:
+            span = TRACER.current_span()
+            record = {
+                "trace_id": span.trace_id if span else None,
+                "routing": method,
+                "action": None if action is None else {
+                    "kind": action.kind,
+                    "nodes": list(action.nodes),
+                    "savings_per_hour": round(action.savings, 6),
+                    "replacement": (list(action.replacement)
+                                    if getattr(action, "replacement", None)
+                                    else None),
+                },
+                "verdicts": list(verdicts or ()),
+                "verdict_vocabulary": list(explain.CONSOLIDATION_VERDICTS),
+            }
+            rid = explain.DECISIONS.emit("consolidation", record,
+                                         ts=self.clock.now())
+            if rid:
+                TRACER.annotate(decision_id=rid)
+            return rid
+        except Exception as e:
+            log.debug("consolidation decision record failed: %s", e)
+            return None
+
+    def _record_action(self, action, now: float, label: str = "",
+                       decision_id: "Optional[str]" = None) -> None:
         suffix = "-multi" if len(action.nodes) > 1 else ""
         self.actions.inc(action=label or f"consolidation-{action.kind}{suffix}")
+        cite = f" (decision {decision_id})" if decision_id else ""
         self.recorder.normal(
             f"node/{action.node}", "Consolidated",
             f"{action.kind} {','.join(action.nodes)}: "
-            f"saves ${action.savings:.4f}/h")
+            f"saves ${action.savings:.4f}/h{cite}")
         self._last_action_ts = now
 
     def _launch_replacement(self, action):
@@ -506,7 +559,8 @@ class DeprovisioningController:
                 self._resolve_replace(action, "rolled_back")
                 self._last_action_ts = now
                 return None
-            self._record_action(action, now)
+            self._record_action(action, now,
+                                decision_id=pr.get("decision_id"))
             self._resolve_replace(action, "completed")
             return action
         if now - pr["started_ts"] >= self.REPLACE_INIT_TIMEOUT_S:

@@ -233,7 +233,8 @@ class ProvisioningController:
             with TRACER.start_span("provisioning.bind") as bind:
                 self._apply(result, pods, catalog=catalog,
                             provisioners=provisioners,
-                            daemon_overhead=daemon_overhead)
+                            daemon_overhead=daemon_overhead,
+                            solve_attrs=dict(solve_span.attributes))
                 bind.set_attributes(
                     nodes=len(result.nodes),
                     unschedulable=result.unschedulable_count())
@@ -374,7 +375,8 @@ class ProvisioningController:
     # -- applying a solve ------------------------------------------------------
 
     def _apply(self, result: SolveResult, pods: "list[PodSpec]",
-               catalog, provisioners, daemon_overhead) -> None:
+               catalog, provisioners, daemon_overhead,
+               solve_attrs: "Optional[dict]" = None) -> None:
         # binding fan-out attribution (docs/designs/slo.md): the pool
         # workers below run OFF the reconcile thread, so their create/bind
         # spans need the bind span passed explicitly (thread-local
@@ -399,10 +401,69 @@ class ProvisioningController:
                 take[g_idx] = names[:count]
                 by_group[g_idx] = names[count:]
             assignments.append(take)
+        # Diagnose the unschedulable groups BEFORE the launch fan-out so
+        # the DecisionRecord (and the id the events cite) exists when the
+        # first Launched event fires. Diagnosed against the SAME
+        # catalog/provisioners/overhead the failed solve used (a refresh
+        # between solve and apply must not contradict it); one diagnosis
+        # per GROUP — identical pods fail identically — and a hard cap
+        # bounds the fold cost in pathological storms.
+        unsched = result.unschedulable_count()
+        diagnoses: "list[tuple[list[str], str]]" = []
+        explain_unassigned: "list[dict]" = []
+        if unsched:
+            from .. import explain
+            from ..models.encode import (build_grid, diagnose_unschedulable,
+                                         kubelet_arrays)
+
+            diag_grid = diag_kub = None
+            diagnosed = 0
+            for g_idx, count in result.unschedulable.items():
+                names = by_group.get(g_idx, [])[:count]
+                if not names:
+                    continue
+                why = "no compatible instance type available"
+                attribution = None
+                if diagnosed < 32:
+                    diagnosed += 1
+                    try:
+                        # the group's OWN spec — the exact pod the solve
+                        # failed on (a store fetch could race an edit/delete
+                        # and explain a different pod)
+                        pod = result.groups[g_idx].spec
+                        if diag_grid is None:  # once per cycle
+                            diag_grid = build_grid(catalog)
+                            diag_kub = kubelet_arrays(provisioners, catalog)
+                        why = diagnose_unschedulable(
+                            pod, provisioners, catalog,
+                            daemon_overhead=daemon_overhead,
+                            grid=diag_grid, kubelet=diag_kub)
+                        if explain.enabled():
+                            # the lazy mask-attribution pass: per-dimension
+                            # rejection counts + ranked summary, recorded
+                            # next to the oracle's clause so the parity
+                            # audit rides in the record itself
+                            attribution = explain.attribute_pod(
+                                pod, provisioners, catalog,
+                                daemon_overhead=daemon_overhead,
+                                grid=diag_grid, kubelet=diag_kub)
+                    except Exception:
+                        pass  # diagnosis must never break the event
+                diagnoses.append((names, why))
+                if attribution is not None:
+                    explain_unassigned.append({
+                        "pod": names[0], "group": g_idx, "count": count,
+                        "pods": names[:8],
+                        "oracle_reason": why,
+                        "parity": attribution["reason"] == why,
+                        **attribution,
+                    })
+        decision_id = self._emit_decision(result, assignments,
+                                          explain_unassigned, solve_attrs)
         # launch new nodes in parallel (reconcile-loop concurrency analogue,
         # MaxConcurrentReconciles=10)
         futures = [self._pool.submit(self._launch_node, solved, take, result,
-                                     bind_span)
+                                     bind_span, decision_id)
                    for solved, take in zip(result.nodes, assignments)]
         # Drain EVERY worker before letting a crash propagate: _launch_node
         # absorbs Exceptions itself, so only BaseException (SimulatedCrash,
@@ -418,44 +479,65 @@ class ProvisioningController:
                 crash = crash or e
         if crash is not None:
             raise crash
-        unsched = result.unschedulable_count()
         self.pods_unschedulable.set(unsched)
-        if unsched:
-            # name the failing constraint (the reference's scheduler errors
-            # say WHY: "incompatible with provisioner …"). Diagnosed against
-            # the SAME catalog/provisioners/overhead the failed solve used
-            # (a refresh between solve and apply must not contradict it);
-            # one diagnosis per GROUP — identical pods fail identically —
-            # and a hard cap bounds the fold cost in pathological storms.
-            from ..models.encode import (build_grid, diagnose_unschedulable,
-                                         kubelet_arrays)
+        # name the failing constraint (the reference's scheduler errors
+        # say WHY: "incompatible with provisioner …"); when the explain
+        # plane recorded this solve, the event cites the DecisionRecord
+        # holding the full per-dimension attribution.
+        cite = f" (decision {decision_id})" if decision_id else ""
+        for names, why in diagnoses:
+            for name in names:
+                self.recorder.warning(
+                    f"pod/{name}", "FailedScheduling", why + cite)
 
-            diag_grid = diag_kub = None
-            diagnosed = 0
-            for g_idx, count in result.unschedulable.items():
-                names = by_group.get(g_idx, [])[:count]
-                if not names:
-                    continue
-                why = "no compatible instance type available"
-                if diagnosed < 32:
-                    diagnosed += 1
-                    try:
-                        # the group's OWN spec — the exact pod the solve
-                        # failed on (a store fetch could race an edit/delete
-                        # and explain a different pod)
-                        pod = result.groups[g_idx].spec
-                        if diag_grid is None:  # once per cycle
-                            diag_grid = build_grid(catalog)
-                            diag_kub = kubelet_arrays(provisioners, catalog)
-                        why = diagnose_unschedulable(
-                            pod, provisioners, catalog,
-                            daemon_overhead=daemon_overhead,
-                            grid=diag_grid, kubelet=diag_kub)
-                    except Exception:
-                        pass  # diagnosis must never break the event
-                for name in names:
-                    self.recorder.warning(
-                        f"pod/{name}", "FailedScheduling", why)
+    def _emit_decision(self, result: SolveResult, assignments,
+                       unassigned: "list[dict]",
+                       solve_attrs: "Optional[dict]") -> "Optional[str]":
+        """One provisioning DecisionRecord per solve into the explain ring
+        (assignments with the winning bucket rung, per-unassigned-pod
+        attribution, the solve's trace id); returns the record id, or None
+        when the plane is disabled (strict-noop) or emission fails."""
+        from .. import explain
+
+        if not explain.enabled():
+            return None
+        try:
+            attrs = dict(solve_attrs or {})
+            span = TRACER.current_span()
+            assigns = []
+            for solved, take in zip(result.nodes[:64], assignments):
+                assigns.append({
+                    "itype": solved.option.itype.name,
+                    "zone": solved.option.zone,
+                    "capacity_type": solved.option.capacity_type,
+                    "price": solved.option.price,
+                    "provisioner": solved.provisioner.name,
+                    "pod_count": solved.pod_count,
+                    "pods": [n for names in take.values() for n in names][:8],
+                })
+            record = {
+                "trace_id": span.trace_id if span is not None else None,
+                "routing": attrs.get("routing"),
+                "bucket": attrs.get("bucket", "n/a"),
+                "rung": (attrs.get("decision") or {}).get("rung"),
+                "dimensions": list(explain.DIMENSIONS),
+                "nodes": len(result.nodes),
+                "nodes_listed": min(len(result.nodes), 64),
+                "existing_nodes": len(result.existing_by_group),
+                "unschedulable_pods": result.unschedulable_count(),
+                "assignments": assigns,
+                "unassigned": unassigned,
+            }
+            rid = explain.DECISIONS.emit("provisioning", record,
+                                         ts=self.clock.now())
+            if rid is not None and span is not None:
+                # decision <-> trace cross-link: the record carries the
+                # trace id above; the span carries the record id here
+                TRACER.annotate(decision_id=rid)
+            return rid
+        except Exception:
+            log.debug("decision record emission failed", exc_info=True)
+            return None
 
     def _bind_from_groups(self, by_group: "dict[int, list[str]]",
                           group_counts: "dict[int, int]", node_name: str) -> None:
@@ -489,7 +571,8 @@ class ProvisioningController:
                     log.warning("bind %s -> %s failed: %s", pod_name, node_name, e)
 
     def _launch_node(self, solved, assigned, result: SolveResult,
-                     parent_span=None) -> Optional[StateNode]:
+                     parent_span=None,
+                     decision_id: "Optional[str]" = None) -> Optional[StateNode]:
         prov: Provisioner = solved.provisioner
         if not self._within_limits(prov, solved):
             self.recorder.warning(
@@ -576,7 +659,9 @@ class ProvisioningController:
         self.nodes_created.inc(provisioner=prov.name)
         self.recorder.normal(f"machine/{name}", "Launched",
                              f"launched {machine.status.instance_type} in "
-                             f"{machine.status.zone}")
+                             f"{machine.status.zone}"
+                             + (f" (decision {decision_id})"
+                                if decision_id else ""))
         # bind this node's pods
         with TRACER.start_span("provisioning.bind.pods",
                                parent=parent_span, node=node.name,

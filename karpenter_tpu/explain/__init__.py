@@ -1,0 +1,60 @@
+"""Decision-provenance (explain) plane (ISSUE 14).
+
+Answers *why* for every solve the observability arc already times: why a
+pod landed on a node type (assignment + winning bucket rung), why a pod
+is unschedulable (per-dimension mask attribution, parity-audited against
+the scalar oracle), why consolidation kept or evicted a node (verdict +
+cost delta), and why the fleet shed a solve — one schema-versioned
+DecisionRecord per decision in a bounded ring, each carrying its solve's
+trace id.
+
+Surfaces: ``GET /debug/decisions`` (index + ``?id=`` detail +
+``?pod=`` lookup), ``python -m karpenter_tpu explain <pod>``, statusz
+schema-8 ``decisions`` section, flight-recorder bundles, and
+``karpenter_decisions_*`` metrics. The plane is advisory and strict-noop
+when disabled (``KARPENTER_TPU_EXPLAIN=0``) — chaos-invariant-enforced
+(``explain-strict-noop``); the attribution pass is lazy/on-demand only,
+never on the solve hot path (``make explain-drill`` records the ON/OFF
+solve p50 delta).
+"""
+from __future__ import annotations
+
+from .records import DECISIONS, SCHEMA_VERSION, note_shed  # noqa: F401
+from .reasons import (CLAUSES, CONSOLIDATION_VERDICTS,  # noqa: F401
+                      DIMENSIONS, SHED_REASONS, clause_for)
+from .state import disabled, enabled, set_enabled  # noqa: F401
+
+
+def attribute_pod(*args, **kwargs) -> dict:
+    """Lazy wrapper over attribution.attribute_pod (keeps this package
+    import-light for statusz/serving; the pass itself pulls in numpy and
+    the encode substrate)."""
+    from .attribution import attribute_pod as impl
+
+    return impl(*args, **kwargs)
+
+
+def activity() -> dict:
+    """Monotonic activity counters + ring length — the chaos
+    ``explain-strict-noop`` invariant diffs two of these."""
+    return DECISIONS.activity()
+
+
+def snapshot() -> dict:
+    """The statusz schema-8 ``decisions`` section (also bundled by the
+    flight recorder)."""
+    act = DECISIONS.activity()
+    recent = DECISIONS.records(limit=5)
+    return {
+        "enabled": enabled(),
+        "schema": SCHEMA_VERSION,
+        "records_total": act["records_total"],
+        "attributions_total": act["attributions_total"],
+        "sheds_total": act["sheds_total"],
+        "consolidations_total": act["consolidations_total"],
+        "ring_depth": act["ring"],
+        "dimensions": list(DIMENSIONS),
+        "recent": [{"id": r.get("id"), "kind": r.get("kind"),
+                    "ts": r.get("ts"), "trace_id": r.get("trace_id")}
+                   for r in recent],
+    }

@@ -1,0 +1,174 @@
+"""Vectorized mask attribution: WHY is this pod unschedulable, by count.
+
+The dense formulation folds admission into per-dimension mask factors
+(taints ∧ requirements ∧ fresh-node fit ∧ availability over the
+provisioner × type × slot option lattice — solver/core.py
+MASK_DIMENSIONS). Attribution replays that fold for ONE pod and counts,
+per dimension, how many candidate options each factor zeroed FIRST (the
+encoder's rejection order), then reduces to a ranked reason summary
+("897 of 4824 candidates rejected by resources; nearest fit short by
+1.2 cores (cpu)").
+
+The pass is lazy/on-demand only — it runs per unassigned pod after a
+solve (or from the explain CLI), never on the solve hot path — and it
+walks the SAME stages in the SAME order as the scalar oracle's
+diagnose_unschedulable (models/encode.py), so the dominant clause is
+string-identical to the oracle's verdict by construction; the parity
+audit (tests/test_explain.py, benchmarks/explain_drill.py) enforces it
+with ``==``. Cost is O(Pv · T · S) numpy over the shared grid arrays;
+callers diagnosing many pods per cycle pass `grid`/`kubelet` in once,
+exactly like provisioning's event diagnosis.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..apis import wellknown as wk
+from ..models.encode import (INT_BIG, build_grid, fold_option_mask,
+                             kubelet_arrays)
+from ..models.pod import PodGroup, PodSpec, tolerates_all
+from ..models.requirements import IncompatibleError
+from .reasons import CLAUSE_OF, DIMENSIONS
+from .records import DECISIONS
+
+
+def _fmt_deficit(resource: str, raw: float) -> str:
+    """Axis units -> operator units (cpu millicores -> cores, memory MiB,
+    ephemeral GiB, counts as-is)."""
+    if resource == wk.RESOURCE_CPU:
+        return f"{raw / 1000:g} cores ({resource})"
+    if resource == wk.RESOURCE_MEMORY:
+        return f"{raw:g} MiB ({resource})"
+    if resource == wk.RESOURCE_EPHEMERAL:
+        return f"{raw:g} GiB ({resource})"
+    return f"{raw:g} {resource}"
+
+
+def attribute_pod(
+    pod: PodSpec,
+    provisioners: "Sequence",
+    catalog,
+    daemon_overhead: "Optional[Sequence[int]]" = None,
+    grid=None,
+    kubelet: "Optional[tuple]" = None,
+) -> dict:
+    """Per-dimension rejection counts + ranked summary for one pod.
+
+    Returns ``{"dimension", "reason", "summary", "candidates", "counts",
+    "nearest", "provisioners"}`` where ``reason`` is the scalar oracle's
+    verbatim clause for the dominant dimension (parity-audited)."""
+    t0 = time.perf_counter()
+    if grid is None or grid.seqnum != catalog.seqnum:
+        grid = build_grid(catalog, reuse=grid)
+    provs = list(provisioners)
+    cols = grid.get_cols()
+    overhead = list(daemon_overhead or [0] * wk.NUM_RESOURCES)
+    group = PodGroup(spec=pod, count=1, pod_names=[pod.name])
+    vec64 = np.minimum(group.vector, INT_BIG).astype(np.int64)
+    ovh = np.asarray(overhead, dtype=np.int64)
+    alloc64 = grid.alloc_t.astype(np.int64)
+    avail_flat = grid.valid.reshape(-1)
+    prov_overhead, prov_pods_cap = (
+        kubelet if kubelet is not None else kubelet_arrays(provs, catalog))
+    T, S = grid.T, grid.S
+    n_defined = int(cols.flat_valid.sum())
+    pods_i = wk.RESOURCE_INDEX[wk.RESOURCE_PODS]
+
+    counts = {dim: 0 for dim in DIMENSIONS}
+    any_tol = any_req = any_fit = any_avail = False
+    nearest: "Optional[dict]" = None
+    for pi, prov in enumerate(provs):
+        if not tolerates_all(pod.tolerations, prov.taints):
+            counts["taints"] += n_defined
+            continue
+        any_tol = True
+        try:
+            reqs = prov.scheduling_requirements().union(pod.requirements)
+        except IncompatibleError:
+            counts["requirements"] += n_defined
+            continue
+        req_mask = fold_option_mask(reqs, cols, prov)
+        n_req = int(req_mask.sum())
+        counts["requirements"] += n_defined - n_req
+        if not n_req:
+            continue
+        any_req = True
+        ovh_p = ovh if prov_overhead is None \
+            else ovh + prov_overhead[pi].astype(np.int64)
+        fits_t = np.all(alloc64 - ovh_p[None, :] - vec64[None, :] >= 0,
+                        axis=1)
+        if prov_pods_cap is not None:
+            fits_t &= (prov_pods_cap[pi].astype(np.int64)
+                       - ovh_p[pods_i] - vec64[pods_i] >= 0)
+        m1 = req_mask & np.repeat(fits_t, S)
+        n_fit = int(m1.sum())
+        counts["resources"] += n_req - n_fit
+        # nearest-fit shortfall over the types this prov's requirement fold
+        # admits but whose allocatable the pod doesn't fit
+        fail_t = req_mask.reshape(T, S).any(axis=1) & ~fits_t
+        if fail_t.any():
+            deficits = (vec64[None, :] + ovh_p[None, :]
+                        - alloc64[fail_t]).astype(np.float64)
+            rel = deficits / np.maximum(alloc64[fail_t], 1)
+            scores = rel.max(axis=1)
+            k = int(scores.argmin())
+            if nearest is None or scores[k] < nearest["_score"]:
+                ri = int(rel[k].argmax())
+                nearest = {
+                    "_score": float(scores[k]),
+                    "resource": wk.RESOURCE_AXIS[ri],
+                    "short_by": float(max(deficits[k, ri], 0.0)),
+                    "display": _fmt_deficit(
+                        wk.RESOURCE_AXIS[ri], max(deficits[k, ri], 0.0)),
+                }
+        if not n_fit:
+            continue
+        any_fit = True
+        m2 = m1 & avail_flat
+        n_avail = int(m2.sum())
+        counts["availability"] += n_fit - n_avail
+        counts["constraints"] += n_avail
+        if n_avail:
+            any_avail = True
+
+    # dominant clause: the exact stage walk diagnose_unschedulable does —
+    # first stage no provisioner survives
+    if not any_tol:
+        dim = "taints"
+    elif not any_req:
+        dim = "requirements"
+    elif not any_fit:
+        dim = "resources"
+    elif not any_avail:
+        dim = "availability"
+    else:
+        dim = "constraints"
+    total = n_defined * len(provs)
+    ranked = sorted(DIMENSIONS, key=lambda d: (-counts[d],
+                                               DIMENSIONS.index(d)))
+    if dim == "constraints":
+        summary = (f"{counts['constraints']} of {total} candidates "
+                   f"admissible but blocked by cross-pod constraints "
+                   f"(affinity/topology/limits) this cycle")
+    else:
+        summary = (f"{counts[dim]} of {total} candidates rejected "
+                   f"by {dim}")
+        if dim == "resources" and nearest is not None:
+            summary += f"; nearest fit short by {nearest['display']}"
+    if nearest is not None:
+        nearest = {k: v for k, v in nearest.items() if k != "_score"}
+    out = {
+        "dimension": dim,
+        "reason": CLAUSE_OF[dim],
+        "summary": summary,
+        "candidates": total,
+        "counts": counts,
+        "ranked": ranked,
+        "nearest": nearest,
+        "provisioners": len(provs),
+    }
+    DECISIONS.note_attribution(time.perf_counter() - t0, dim)
+    return out

@@ -1,0 +1,76 @@
+"""The decision-reason vocabulary: one name per admission-mask dimension.
+
+The dense formulation makes attribution nearly free — every rejection is
+already a zero in a named mask factor — but only if the NAMES stay
+honest. This module is the single registry: the constraint dimensions (in
+the encoder's first-rejection order), the verbatim scalar-oracle clause
+each dimension maps onto (models/encode.py diagnose_unschedulable — the
+mapping is string-exact so the parity audit can compare verdicts with
+``==``), the fleet shed reasons, and the consolidation keep/evict
+verdicts.
+
+Every table here is a module-level PURE LITERAL:
+hack/check_decision_reasons.py AST-parses this file (no package import,
+no jax) and fails presubmit when the vocabulary drifts from
+solver/core.py MASK_DIMENSIONS, from the oracle's clause strings, or
+from the call sites that cite verdicts/shed reasons.
+"""
+from __future__ import annotations
+
+# Constraint dimensions in the admission rule's first-rejection order
+# (the order diagnose_unschedulable walks its stages). Must equal
+# solver/core.py MASK_DIMENSIONS — lint-enforced.
+DIMENSIONS = (
+    "taints",
+    "requirements",
+    "resources",
+    "availability",
+    "constraints",
+)
+
+# dimension -> the scalar oracle's verbatim clause. These strings are the
+# EXACT literals diagnose_unschedulable returns; the attribution pass and
+# the oracle are parity-audited on string equality, so editing one side
+# without the other fails both the lint and tests/test_explain.py.
+CLAUSES = (
+    ("taints",
+     "pod does not tolerate the taints of any provisioner"),
+    ("requirements",
+     "pod requirements are incompatible with every "
+     "provisioner and instance type"),
+    ("resources",
+     "resource requests do not fit any compatible instance type"),
+    ("availability",
+     "every compatible offering is currently unavailable "
+     "(insufficient capacity)"),
+    ("constraints",
+     "compatible capacity exists but scheduling constraints "
+     "(affinity/topology/limits) were unsatisfiable this cycle"),
+)
+
+# Fleet admission/queue shed causes (fleet/frontend.py note_shed call
+# sites cite these literally; the storm drill asserts every shed in the
+# artifact carries one).
+SHED_REASONS = (
+    "deadline",
+)
+
+# Consolidation keep/evict verdicts (ops/consolidate.py cites these per
+# candidate lane; "delete"/"replace" are the evict outcomes, the rest are
+# keep branches in ladder order).
+CONSOLIDATION_VERDICTS = (
+    "unschedulable-pods",
+    "opens-more-than-one-node",
+    "spot-replace-barred",
+    "no-cheaper-option",
+    "delete",
+    "replace",
+)
+
+CLAUSE_OF = dict(CLAUSES)
+DIMENSION_OF_CLAUSE = {clause: dim for dim, clause in CLAUSES}
+
+
+def clause_for(dimension: str) -> str:
+    """The oracle clause a dominant dimension maps onto."""
+    return CLAUSE_OF[dimension]

@@ -1,0 +1,159 @@
+"""Schema-versioned DecisionRecords in a bounded ring.
+
+One DecisionRecord per solve (kind ``provisioning``), per consolidation
+pass (kind ``consolidation``), and per fleet shed (kind ``shed``), each
+carrying the solve's trace id so ``/debug/traces?id=`` resolves the
+record back to its spans. The ring is bounded
+(``KARPENTER_TPU_EXPLAIN_RING``, default 256) and thread-safe; the
+flight recorder embeds its tail in every diagnostics bundle and
+``GET /debug/decisions`` serves it live.
+
+Every write path guards :func:`state.enabled` — with the plane disabled
+nothing here moves (counters, ring, metrics), which is exactly what the
+chaos ``explain-strict-noop`` invariant diffs.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from ..metrics import REGISTRY
+from . import state
+
+SCHEMA_VERSION = 1
+
+DEFAULT_RING = 256
+RING_ENV = "KARPENTER_TPU_EXPLAIN_RING"
+
+
+def _ring_size() -> int:
+    try:
+        return max(1, int(os.environ.get(RING_ENV, DEFAULT_RING)))
+    except ValueError:
+        return DEFAULT_RING
+
+
+RECORDS_TOTAL = REGISTRY.counter(
+    "karpenter_decisions_records_total",
+    "DecisionRecords emitted into the explain ring", ("kind",))
+UNSCHEDULABLE_REASONS = REGISTRY.counter(
+    "karpenter_decisions_unschedulable_total",
+    "Unassigned-pod attributions by dominant constraint dimension",
+    ("dimension",))
+RING_DEPTH = REGISTRY.gauge(
+    "karpenter_decisions_ring_depth",
+    "DecisionRecords currently resident in the explain ring")
+ATTRIBUTION_SECONDS = REGISTRY.histogram(
+    "karpenter_decisions_attribution_seconds",
+    "Wall time of one per-pod mask-attribution pass (lazy, off the "
+    "solve hot path)")
+
+
+class DecisionRing:
+    """Bounded, thread-safe ring of DecisionRecords with monotonic ids."""
+
+    def __init__(self, maxlen: "Optional[int]" = None):
+        self._lock = threading.Lock()
+        self._ring: "deque[dict]" = deque(maxlen=maxlen or _ring_size())
+        self._next_id = 0
+        # monotonic activity counters — the chaos strict-noop invariant
+        # diffs these across a disabled-plane scenario
+        self.records_total = 0
+        self.attributions_total = 0
+        self.sheds_total = 0
+        self.consolidations_total = 0
+
+    def emit(self, kind: str, record: dict,
+             ts: "Optional[float]" = None) -> "Optional[str]":
+        """Stamp + append one record; returns its id, or None when the
+        plane is disabled (strict-noop: nothing moves)."""
+        if not state.enabled():
+            return None
+        with self._lock:
+            rid = f"d-{self._next_id}"
+            self._next_id += 1
+            rec = {"schema": SCHEMA_VERSION, "id": rid, "kind": kind,
+                   "ts": time.time() if ts is None else ts, **record}
+            self._ring.append(rec)
+            self.records_total += 1
+            if kind == "shed":
+                self.sheds_total += 1
+            elif kind == "consolidation":
+                self.consolidations_total += 1
+            depth = len(self._ring)
+        RECORDS_TOTAL.inc(kind=kind)
+        RING_DEPTH.set(depth)
+        return rid
+
+    def note_attribution(self, seconds: float, dimension: str) -> None:
+        """Account one completed per-pod attribution pass."""
+        if not state.enabled():
+            return
+        with self._lock:
+            self.attributions_total += 1
+        ATTRIBUTION_SECONDS.observe(max(0.0, seconds))
+        UNSCHEDULABLE_REASONS.inc(dimension=dimension)
+
+    def get(self, rid: str) -> "Optional[dict]":
+        with self._lock:
+            for rec in self._ring:
+                if rec.get("id") == rid:
+                    return rec
+        return None
+
+    def records(self, limit: "Optional[int]" = None,
+                kind: "Optional[str]" = None) -> "list[dict]":
+        """Newest-last tail of the ring, optionally filtered by kind."""
+        with self._lock:
+            out = [r for r in self._ring
+                   if kind is None or r.get("kind") == kind]
+        return out if limit is None else out[-max(0, limit):]
+
+    def find_pod(self, pod: str) -> "Optional[dict]":
+        """Newest record mentioning pod `pod` (by assignment or
+        unassigned attribution) — the `explain <pod>` CLI's lookup."""
+        with self._lock:
+            ring = list(self._ring)
+        for rec in reversed(ring):
+            for u in rec.get("unassigned", ()):
+                if u.get("pod") == pod:
+                    return rec
+            for a in rec.get("assignments", ()):
+                if pod in a.get("pods", ()):
+                    return rec
+        return None
+
+    def ring_len(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def activity(self) -> dict:
+        with self._lock:
+            return {
+                "records_total": self.records_total,
+                "attributions_total": self.attributions_total,
+                "sheds_total": self.sheds_total,
+                "consolidations_total": self.consolidations_total,
+                "ring": len(self._ring),
+            }
+
+    def clear(self) -> None:
+        """Drop resident records (tests); monotonic counters stay."""
+        with self._lock:
+            self._ring.clear()
+
+
+DECISIONS = DecisionRing()
+
+
+def note_shed(tenant: str, where: str, reason: str,
+              ts: "Optional[float]" = None) -> "Optional[str]":
+    """One fleet shed cause into the ring (fleet/frontend.py cites a
+    reasons.SHED_REASONS literal — lint-enforced)."""
+    if not state.enabled():
+        return None
+    return DECISIONS.emit(
+        "shed", {"tenant": tenant, "where": where, "reason": reason}, ts=ts)

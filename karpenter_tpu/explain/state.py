@@ -1,0 +1,48 @@
+"""Global on/off switch for the decision-provenance (explain) plane.
+
+Like the profiling plane, explain is advisory-never-load-bearing: every
+producer — the solve-record emitter, the mask-attribution pass, the
+consolidation verdict capture, the fleet shed notes — checks
+:func:`enabled` before doing ANY work, so disabling explain is a strict
+no-op (zero records, zero ring growth, zero counter movement). The chaos
+drill enforces exactly that invariant (``explain-strict-noop``).
+
+Default is ON (decisions exist to be explainable); ``KARPENTER_TPU_EXPLAIN=0``
+(or ``false``/``off``/``no``) disables it at process start, and
+:func:`set_enabled` / :func:`disabled` flip it at runtime (chaos drills,
+overhead baselines).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+FLAG_ENV = "KARPENTER_TPU_EXPLAIN"
+_FALSY = ("0", "false", "off", "no")
+
+_lock = threading.Lock()
+_enabled = os.environ.get(FLAG_ENV, "1").strip().lower() not in _FALSY
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip the plane; returns the previous state (restore token)."""
+    global _enabled
+    with _lock:
+        prev = _enabled
+        _enabled = bool(on)
+        return prev
+
+
+@contextlib.contextmanager
+def disabled():
+    """Scoped hard-off: overhead baselines and the chaos strict-noop drill."""
+    prev = set_enabled(False)
+    try:
+        yield
+    finally:
+        set_enabled(prev)

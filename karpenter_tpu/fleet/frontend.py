@@ -51,6 +51,7 @@ import weakref
 from collections import OrderedDict, deque
 from typing import Callable, Optional, Sequence
 
+from ..explain import note_shed
 from ..models.pod import group_pods
 from ..tracing import TRACER
 from ..utils.clock import Clock
@@ -273,6 +274,8 @@ class FleetFrontend:
                 fm.SHED.inc(tenant=tlabel, where="admission")
                 fm.TENANT_SHED.inc(tenant=tlabel, where="admission",
                                    reason="deadline")
+                note_shed(tenant_id, "admission", "deadline",
+                          ts=self.clock.now())
                 ticket._resolve(error=FleetShed(
                     "admission",
                     f"{ticket.deadline_ms}ms of budget cannot survive the "
@@ -354,6 +357,7 @@ class FleetFrontend:
                         fm.SHED.inc(tenant=tlabel, where="queue")
                         fm.TENANT_SHED.inc(tenant=tlabel, where="queue",
                                            reason="deadline")
+                        note_shed(tenant_id, "queue", "deadline", ts=now)
                         t._resolve(error=FleetShed(
                             "queue",
                             f"budget expired after "

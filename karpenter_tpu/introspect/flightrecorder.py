@@ -48,6 +48,11 @@ TRIGGER_MIN_INTERVAL = 60.0
 # bundle stays a bundle
 BUNDLE_PROFILE_STACKS = 50
 
+# DecisionRecords carried per bundle — the tail of the explain ring, so a
+# post-mortem bundle answers "why" for the decisions leading into the
+# incident (`/debug/bundle?decisions=` overrides, clamped)
+BUNDLE_DECISIONS = 50
+
 
 def _profile_section() -> dict:
     from ..profiling import PROFILER, snapshot as profiling_snapshot
@@ -57,6 +62,13 @@ def _profile_section() -> dict:
         "folded": [f"{stack} {count}" for stack, count in
                    PROFILER.host.folded(BUNDLE_PROFILE_STACKS)],
     }
+
+
+def _decisions_section(limit: int = BUNDLE_DECISIONS) -> dict:
+    from .. import explain
+
+    return {**explain.snapshot(),
+            "records": explain.DECISIONS.records(limit)}
 
 
 class FlightRecorder:
@@ -91,7 +103,8 @@ class FlightRecorder:
 
     # -- bundles ---------------------------------------------------------------
 
-    def bundle(self, reason: str, detail: str = "") -> dict:
+    def bundle(self, reason: str, detail: str = "",
+               decisions: int = BUNDLE_DECISIONS) -> dict:
         """Assemble one diagnostics bundle. Every section is fenced the
         same way statusz sections are — capture must not fail because one
         subsystem is wedged (that subsystem is often WHY we're here)."""
@@ -120,6 +133,10 @@ class FlightRecorder:
             # first question is "which phase ate the budget" (gap ledger),
             # and the folded stacks say what the host was doing meanwhile
             "profile": fenced(_profile_section),
+            # the explain ring's tail: every bundle carries the decisions
+            # (assignments, unschedulable attributions, consolidation
+            # verdicts, sheds) that led into the trigger
+            "decisions": fenced(lambda: _decisions_section(decisions)),
         }
 
     def trigger(self, reason: str, detail: str = "", force: bool = False,

@@ -23,7 +23,7 @@ from ..metrics import REGISTRY, Gauge, Histogram
 
 log = logging.getLogger("karpenter.statusz")
 
-SCHEMA_VERSION = 7  # 7: added "profiling" (6: "hbm"; 5: "slo"; 4: "fleet")
+SCHEMA_VERSION = 8  # 8: added "decisions" (7: "profiling"; 6: "hbm"; 5: "slo")
 
 # hard caps so a pathological operator can't make statusz unbounded
 MAX_EVENTS = 50
@@ -167,6 +167,15 @@ def _profiling_section() -> dict:
     return profiling_snapshot()
 
 
+def _decisions_section() -> dict:
+    # the explain plane's snapshot: ring activity counters, the reason
+    # vocabulary, and the most recent DecisionRecord ids (full records
+    # live at /debug/decisions and in flight-recorder bundles)
+    from ..explain import snapshot as explain_snapshot
+
+    return explain_snapshot()
+
+
 def snapshot(op) -> dict:
     """The one consistent operator snapshot (see module docstring)."""
     return {
@@ -185,5 +194,6 @@ def snapshot(op) -> dict:
         "slo": _fenced(lambda: op.slo.snapshot()),
         "hbm": _fenced(_hbm_section),
         "profiling": _fenced(_profiling_section),
+        "decisions": _fenced(_decisions_section),
         "metrics": _fenced(_metrics_section),
     }

@@ -351,16 +351,47 @@ def _batched_pack_verdicts(inputs: PackInputs, n_slots: int,
     return _reduce_verdicts(_batched_pack(inputs, n_slots))
 
 
+def _note_verdict(capture: "list[dict]", cand, verdict: str,
+                  savings: float = 0.0, replacement=None) -> None:
+    """One consolidation keep/evict verdict into the explain capture.
+    `verdict` must be a reasons.CONSOLIDATION_VERDICTS literal at every
+    call site — hack/check_decision_reasons.py lints the lockstep."""
+    total_price = sum(n.price for n in cand)
+    capture.append({
+        "nodes": sorted(n.name for n in cand),
+        "verdict": verdict,
+        "evict": verdict in ("delete", "replace"),
+        "current_price_per_hour": round(total_price, 6),
+        "savings_per_hour": round(savings, 6),
+        "cost_delta_per_hour": round(-savings, 6),
+        "replacement": replacement,
+    })
+
+
+# Per-pass keep/evict verdicts for the deprovisioner's consolidation
+# audit record (set by _decode_actions when the explain plane is ON;
+# untouched — strict-noop — when it is disabled).
+last_verdicts: "list[dict] | None" = None
+
+
 def _decode_actions(batch: ConsolidationBatch, verdicts, now: float
                     ) -> "list[ConsolidationAction]":
     """verdicts: [C, 3] host array — (unsched_total, n_open, decided0) per
     candidate lane (see _batched_pack_verdicts)."""
+    global last_verdicts
+    from .. import explain
+
+    capture: "list[dict] | None" = [] if explain.enabled() else None
     actions = []
     for ci, cand in enumerate(batch.candidates):
         if int(verdicts[ci, 0]) > 0:  # any pod unschedulable in this lane
+            if capture is not None:
+                _note_verdict(capture, cand, "unschedulable-pods")
             continue
         opened = int(verdicts[ci, 1])
         if opened > 1:
+            if capture is not None:
+                _note_verdict(capture, cand, "opens-more-than-one-node")
             continue
         total_price = sum(n.price for n in cand)
         cost = sum(
@@ -370,6 +401,8 @@ def _decode_actions(batch: ConsolidationBatch, verdicts, now: float
             for n in cand)
         names = tuple(sorted(n.name for n in cand))
         if opened == 0:
+            if capture is not None:
+                _note_verdict(capture, cand, "delete", savings=total_price)
             actions.append(ConsolidationAction(
                 "delete", names[0], cost, savings=total_price, nodes=names))
             continue
@@ -379,6 +412,8 @@ def _decode_actions(batch: ConsolidationBatch, verdicts, now: float
             # selection and raise interruption rates (reference
             # website deprovisioning.md:88; mirrored in the oracle's
             # evaluate_candidate_set)
+            if capture is not None:
+                _note_verdict(capture, cand, "spot-replace-barred")
             continue
         flat = int(verdicts[ci, 2])
         if flat < 0:
@@ -386,11 +421,19 @@ def _decode_actions(batch: ConsolidationBatch, verdicts, now: float
                 f"candidate {names}: open claim slot has no surviving option")
         opt = batch.grid.options[flat]
         if opt.price >= total_price - REPLACE_PRICE_EPS:
+            if capture is not None:
+                _note_verdict(capture, cand, "no-cheaper-option")
             continue
+        repl = (opt.itype.name, opt.zone, opt.capacity_type, opt.price)
+        if capture is not None:
+            _note_verdict(capture, cand, "replace",
+                          savings=total_price - opt.price, replacement=repl)
         actions.append(ConsolidationAction(
             "replace", names[0], cost, savings=total_price - opt.price,
-            replacement=(opt.itype.name, opt.zone, opt.capacity_type, opt.price),
+            replacement=repl,
             nodes=names))
+    if capture is not None:
+        last_verdicts = capture
     return actions
 
 

@@ -37,6 +37,14 @@ MAX_TRACE_LIMIT = 200
 # beyond this the tail is single-sample noise
 MAX_PROFILE_STACKS = 500
 
+# /debug/decisions ?limit= and /debug/bundle ?decisions= ceiling: the
+# explain ring defaults to 256 resident records — a larger ask only
+# re-serializes the same tail
+MAX_DECISIONS = 256
+
+# /eventz ?n= ceiling: the recorder's post-dedupe ring bound
+MAX_EVENTS = 1000
+
 
 def clamped_int_param(qs: dict, key: str, default: int,
                       ceiling: int) -> "Optional[int]":
@@ -116,13 +124,26 @@ class ServingPlane:
                         content_type="application/json")
                 if self.path.startswith("/debug/bundle"):
                     # live diagnostics bundle (no disk write) — the
-                    # `diagnose` CLI's fetch side
+                    # `diagnose` CLI's fetch side; ?decisions=N bounds the
+                    # explain-ring tail carried along (clamped like
+                    # /debug/traces ?limit=)
+                    from urllib.parse import parse_qs, urlsplit
+
+                    from .introspect.flightrecorder import BUNDLE_DECISIONS
+
                     fr = getattr(op, "flightrecorder", None)
                     if fr is None:
                         return self._text(404, "flight recorder not wired")
+                    qs = parse_qs(urlsplit(self.path).query)
+                    decisions = clamped_int_param(
+                        qs, "decisions", BUNDLE_DECISIONS, MAX_DECISIONS)
+                    if decisions is None:
+                        return self._text(400,
+                                          "decisions must be an integer")
                     return self._text(
                         200, json.dumps(
-                            fr.bundle("manual", "GET /debug/bundle"),
+                            fr.bundle("manual", "GET /debug/bundle",
+                                      decisions=decisions),
                             default=str),
                         content_type="application/json")
                 if self.path.startswith("/debug/fleetz"):
@@ -219,6 +240,52 @@ class ServingPlane:
                     return self._text(
                         200, json.dumps(profiling.profilez(n), default=str),
                         content_type="application/json")
+                if self.path.startswith("/debug/decisions"):
+                    # decision-provenance ring (the explain plane): index
+                    # of recent DecisionRecords; ?id= returns one record in
+                    # full, ?pod= resolves the newest record mentioning the
+                    # pod (the `explain <pod>` CLI's fetch side); ?kind=
+                    # filters the index, ?limit= bounds it (clamped like
+                    # /debug/traces ?limit=)
+                    from urllib.parse import parse_qs, urlsplit
+
+                    from . import explain
+
+                    qs = parse_qs(urlsplit(self.path).query)
+                    rid = qs.get("id", [None])[0]
+                    if rid:
+                        rec = explain.DECISIONS.get(rid)
+                        if rec is None:
+                            return self._text(404, "unknown decision id")
+                        return self._text(
+                            200, json.dumps(rec, default=str),
+                            content_type="application/json")
+                    pod = qs.get("pod", [None])[0]
+                    if pod:
+                        rec = explain.DECISIONS.find_pod(pod)
+                        if rec is None:
+                            return self._text(
+                                404,
+                                f"no decision record mentions pod {pod}")
+                        return self._text(
+                            200, json.dumps(rec, default=str),
+                            content_type="application/json")
+                    limit = clamped_int_param(qs, "limit", 50,
+                                              MAX_DECISIONS)
+                    if limit is None:
+                        return self._text(400, "limit must be an integer")
+                    kind = qs.get("kind", [None])[0]
+                    index = [
+                        {"id": r.get("id"), "kind": r.get("kind"),
+                         "ts": r.get("ts"), "trace_id": r.get("trace_id")}
+                        for r in explain.DECISIONS.records(limit,
+                                                           kind=kind)]
+                    return self._text(
+                        200, json.dumps(
+                            {"enabled": explain.enabled(),
+                             "schema": explain.SCHEMA_VERSION,
+                             "decisions": index}, default=str),
+                        content_type="application/json")
                 return self._text(404, "not found")
 
         return Metrics
@@ -261,14 +328,13 @@ class ServingPlane:
                     from urllib.parse import parse_qs, urlsplit
 
                     qs = parse_qs(urlsplit(self.path).query)
-                    try:
-                        n = int(qs.get("n", ["100"])[0])
-                    except ValueError:
+                    n = clamped_int_param(qs, "n", 100, MAX_EVENTS)
+                    if n is None:
                         return self._text(400, "n must be an integer")
                     events = [
                         {"ts": ts, "kind": e.kind, "reason": e.reason,
                          "object": e.object_ref, "message": e.message}
-                        for ts, e in op.recorder.recent(max(1, n))]
+                        for ts, e in op.recorder.recent(n)]
                     return self._text(
                         200, json.dumps({"events": events}, default=str),
                         content_type="application/json")

@@ -93,6 +93,14 @@ class BucketPlan(NamedTuple):
     def label(self) -> str:
         return f"g{self.groups}n{self.slots}e{self.existing}"
 
+    def rung(self) -> dict:
+        """Decision-record provenance: the winning ladder rung, as data
+        (the explain plane embeds it per solve — "why THIS compiled
+        program" is the bucket half of "why this decision")."""
+        return {"label": self.label(), "groups": self.groups,
+                "slots": self.slots, "existing": self.existing,
+                "cells": self.cells()}
+
 
 def plan_for(n_groups: int, n_slots: int, n_existing: int) -> BucketPlan:
     return BucketPlan(

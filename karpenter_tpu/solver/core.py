@@ -52,6 +52,22 @@ _SOLVE_TIMING = _os.environ.get("KARPENTER_TPU_SOLVE_TIMING") == "1"
 # sub-ms).
 _READBACK = _os.environ.get("KARPENTER_TPU_READBACK", "get")
 
+# The admission rule's mask factorization, in first-rejection order: the
+# encoder ANDs exactly these constraint dimensions into group_feas
+# (tolerations -> requirement fold -> fresh-node resource fit -> offering
+# availability), and whatever survives option admission can only be
+# zeroed by cross-pod constraints inside the kernel. The explain plane's
+# reason vocabulary (explain/reasons.py DIMENSIONS, one scalar-oracle
+# clause per entry) must stay in lockstep — hack/check_decision_reasons.py
+# AST-lints both literals.
+MASK_DIMENSIONS = (
+    "taints",
+    "requirements",
+    "resources",
+    "availability",
+    "constraints",
+)
+
 
 def _bucket(n: int, lo: int = 8) -> int:
     """Ladder-rung bucket (historic name/signature kept: the graft entry
@@ -562,6 +578,16 @@ class TPUSolver:
                              if route == "sharded" else 1),
         }
         TRACER.annotate(**self.last_solve_info)
+        # decision provenance: the winning bucket rung + mask-dimension
+        # vocabulary ride along for the DecisionRecord the controller
+        # emits after this solve. Gated so a disabled explain plane
+        # leaves the hot path byte-identical (explain-strict-noop).
+        from .. import explain
+        if explain.enabled():
+            self.last_solve_info["decision"] = {
+                "rung": plan.rung(),
+                "dimensions": MASK_DIMENSIONS,
+            }
         # The formerly-dark solver interior becomes first-class phase spans
         # (children of the current solve/service span). Dispatch splits by
         # compile-cache outcome: a hit is pure execute; a miss's wall time

@@ -1,0 +1,412 @@
+"""Decision-provenance plane (ISSUE 14): explain ring, mask attribution,
+oracle parity, strict-noop, and the /debug/decisions + CLI surfaces.
+
+Tier-1 pieces: the attribution/oracle parity audit on a seeded workload
+mix (the clause strings must match with ``==`` — reasons.CLAUSES is
+lint-locked to diagnose_unschedulable's literals), ranked-summary
+determinism, the strict-noop contract while the plane is disabled (the
+chaos ``explain-strict-noop`` invariant diffs the same counters), the
+HTTP listing-param discipline shared with /debug/traces (200/400/404 +
+clamp), the statusz schema-8 ``decisions`` section, and the
+``explain <pod>`` CLI verdict.
+"""
+
+import json
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_tpu import explain
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.explain.records import DecisionRing
+from karpenter_tpu.models.encode import (build_grid, diagnose_unschedulable,
+                                         kubelet_arrays)
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import Taint, Toleration, make_pod
+
+
+def _catalog():
+    return Catalog(types=[
+        make_instance_type("m.large", cpu=4, memory="16Gi",
+                           od_price=0.2, spot_price=0.07),
+        make_instance_type("m.xlarge", cpu=8, memory="32Gi",
+                           od_price=0.4, spot_price=0.11)])
+
+
+def _provisioners():
+    """Two provisioners, BOTH tainted — untolerating pods are genuinely
+    taint-blocked (the explain-drill problem shape at test size)."""
+    taint = (Taint(key="team", value="infra"),)
+    provs = []
+    for name in ("tainted-a", "tainted-b"):
+        p = Provisioner(name=name, taints=taint)
+        p.set_defaults()
+        provs.append(p)
+    return provs
+
+
+TOL = (Toleration(key="team", operator="Exists"),)
+
+
+def _category_pod(cat: str, i: int, rng):
+    if cat == "taints":  # schedulable but for the taint
+        return make_pod(f"t-{i}", cpu=f"{rng.choice((100, 250, 500))}m",
+                        memory="256Mi")
+    if cat == "requirements":  # selector names a type nobody sells
+        return make_pod(f"r-{i}", cpu="250m", memory="256Mi",
+                        tolerations=TOL,
+                        node_selector={wk.LABEL_INSTANCE_TYPE:
+                                       f"absent.{rng.randint(0, 9)}"})
+    if cat == "resources":  # bigger than the largest type
+        return make_pod(f"b-{i}", cpu=str(rng.choice((16, 64, 128))),
+                        memory="1Gi", tolerations=TOL)
+    # "constraints": admissible — the oracle's residual clause
+    return make_pod(f"c-{i}", cpu="250m", memory="256Mi", tolerations=TOL)
+
+
+class TestOracleParity:
+    def test_parity_on_seeded_workload(self):
+        import random
+
+        rng = random.Random(1307)
+        catalog, provs = _catalog(), _provisioners()
+        grid = build_grid(catalog)
+        kub = kubelet_arrays(provs, catalog)
+        cats = ("taints", "requirements", "resources", "constraints")
+        for i in range(40):
+            cat = cats[i % len(cats)]
+            pod = _category_pod(cat, i, rng)
+            oracle = diagnose_unschedulable(pod, provs, catalog,
+                                            grid=grid, kubelet=kub)
+            verdict = explain.attribute_pod(pod, provs, catalog,
+                                            grid=grid, kubelet=kub)
+            assert verdict["reason"] == oracle, (cat, pod.name)
+            assert verdict["dimension"] == cat
+            assert verdict["reason"] == explain.clause_for(cat)
+
+    def test_ranked_summary_deterministic(self):
+        import random
+
+        catalog, provs = _catalog(), _provisioners()
+        pod = _category_pod("resources", 0, random.Random(7))
+        a = explain.attribute_pod(pod, provs, catalog)
+        b = explain.attribute_pod(pod, provs, catalog)
+        assert a == b
+        # the dominant dimension comes from the oracle's stage walk, not
+        # the raw counts (the default capacity-type fold rejects more)
+        assert a["dimension"] == "resources"
+        assert a["counts"]["resources"] > 0
+        assert "nearest fit short by" in a["summary"]
+        assert a["nearest"]["resource"] == wk.RESOURCE_CPU
+
+    def test_counts_cover_the_candidate_lattice(self):
+        catalog, provs = _catalog(), _provisioners()
+        pod = make_pod("lone", cpu="250m", memory="256Mi", tolerations=TOL)
+        v = explain.attribute_pod(pod, provs, catalog)
+        assert sum(v["counts"].values()) == v["candidates"]
+        assert set(v["counts"]) == set(explain.DIMENSIONS)
+
+
+class TestDecisionRing:
+    def test_strict_noop_when_disabled(self):
+        ring = DecisionRing(maxlen=8)
+        with explain.disabled():
+            before = ring.activity()
+            assert ring.emit("provisioning", {"nodes": 1}) is None
+            ring.note_attribution(0.001, "resources")
+            assert explain.note_shed("tenant-a", "queue",
+                                     "deadline") is None
+            assert ring.activity() == before
+        assert before["records_total"] == 0 and before["ring"] == 0
+
+    def test_ring_bounded_with_monotonic_ids(self):
+        ring = DecisionRing(maxlen=3)
+        ids = [ring.emit("provisioning", {"n": i}, ts=float(i))
+               for i in range(5)]
+        assert ids == [f"d-{i}" for i in range(5)]
+        assert ring.ring_len() == 3
+        assert [r["n"] for r in ring.records()] == [2, 3, 4]  # oldest out
+        assert ring.activity()["records_total"] == 5
+        assert ring.get("d-0") is None and ring.get("d-4")["n"] == 4
+
+    def test_kind_filter_and_limit(self):
+        ring = DecisionRing(maxlen=16)
+        for i in range(4):
+            ring.emit("provisioning", {"n": i}, ts=float(i))
+        ring.emit("consolidation", {"n": 99}, ts=9.0)
+        assert len(ring.records(kind="consolidation")) == 1
+        assert [r["n"] for r in ring.records(limit=2)] == [3, 99]
+        act = ring.activity()
+        assert act["consolidations_total"] == 1
+        assert act["sheds_total"] == 0
+
+    def test_find_pod_prefers_newest(self):
+        ring = DecisionRing(maxlen=8)
+        ring.emit("provisioning",
+                  {"assignments": [{"pods": ["web-1", "web-2"]}],
+                   "unassigned": []}, ts=1.0)
+        ring.emit("provisioning",
+                  {"assignments": [],
+                   "unassigned": [{"pod": "web-2", "reason": "x"}]},
+                  ts=2.0)
+        assert ring.find_pod("web-1")["ts"] == 1.0
+        assert ring.find_pod("web-2")["ts"] == 2.0  # newest wins
+        assert ring.find_pod("nope") is None
+
+    def test_note_shed_cites_vocabulary(self):
+        ring_before = explain.DECISIONS.activity()["sheds_total"]
+        rid = explain.note_shed("tenant-a", "admission", "deadline", ts=1.0)
+        try:
+            assert rid is not None
+            rec = explain.DECISIONS.get(rid)
+            assert rec["kind"] == "shed"
+            assert rec["reason"] in explain.SHED_REASONS
+            assert rec["where"] == "admission"
+            act = explain.DECISIONS.activity()
+            assert act["sheds_total"] == ring_before + 1
+        finally:
+            explain.DECISIONS.clear()
+
+    def test_snapshot_shape(self):
+        doc = explain.snapshot()
+        assert doc["schema"] == explain.SCHEMA_VERSION
+        assert doc["enabled"] is True
+        assert doc["dimensions"] == list(explain.DIMENSIONS)
+        assert {"records_total", "attributions_total", "sheds_total",
+                "consolidations_total", "ring_depth",
+                "recent"} <= set(doc)
+        json.dumps(doc, default=str)  # statusz embeds it: must serialize
+
+
+class TestConsolidationVerdicts:
+    def test_note_verdict_shape_and_vocabulary(self):
+        from karpenter_tpu.ops import consolidate
+
+        node = types.SimpleNamespace(name="node-a", price=0.25)
+        capture = []
+        consolidate._note_verdict(capture, [node], "delete", savings=0.25)
+        consolidate._note_verdict(capture, [node], "no-cheaper-option")
+        (evict, keep) = capture
+        assert evict["verdict"] in explain.CONSOLIDATION_VERDICTS
+        assert evict["evict"] is True and keep["evict"] is False
+        assert evict["savings_per_hour"] == 0.25
+        assert evict["cost_delta_per_hour"] == -0.25
+        assert keep["nodes"] == ["node-a"]
+
+
+@pytest.fixture
+def server():
+    from karpenter_tpu.apis.nodetemplate import NodeTemplate
+    from karpenter_tpu.apis.settings import Settings
+    from karpenter_tpu.fake.cloud import FakeCloud
+    from karpenter_tpu.operator import Operator
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    op = Operator(FakeCloud(catalog=_catalog(), clock=clock),
+                  Settings(cluster_name="explain",
+                           cluster_endpoint="https://explain"),
+                  _catalog(), clock=clock, serve_http=True,
+                  metrics_port=0, health_port=0, webhook_port=-1)
+    op.kube.create("nodetemplates", "default", NodeTemplate(
+        name="default",
+        subnet_selector={"id": "subnet-zone-1a"},
+        security_group_selector={"id": "sg-default"}))
+    op.cloudprovider.register_nodetemplate(
+        op.kube.get("nodetemplates", "default"))
+    prov = Provisioner(name="default", provider_ref="default")
+    prov.set_defaults()
+    op.kube.create("provisioners", "default", prov)
+    ports = op.serving.start()
+    try:
+        yield op, ports
+    finally:
+        op.serving.stop()
+        op.stop()
+        explain.DECISIONS.clear()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestDebugDecisionsEndpoint:
+    def test_index_detail_pod_lookup(self, server):
+        op, ports = server
+        rid = explain.DECISIONS.emit(
+            "provisioning",
+            {"trace_id": "t-1",
+             "assignments": [{"pods": ["web-1"], "itype": "m.large",
+                              "zone": "zone-1a", "capacity_type":
+                              "on-demand", "provisioner": "default",
+                              "price": 0.2}],
+             "unassigned": [{"pod": "web-9",
+                             "reason": explain.clause_for("resources"),
+                             "summary": "s", "ranked": ["resources"]}]},
+            ts=1.0)
+        base = f"http://127.0.0.1:{ports['metrics']}/debug/decisions"
+        status, doc = _get(base)
+        assert status == 200
+        assert doc["schema"] == explain.SCHEMA_VERSION
+        assert doc["enabled"] is True
+        assert any(d["id"] == rid for d in doc["decisions"])
+        status, rec = _get(f"{base}?id={rid}")
+        assert status == 200 and rec["trace_id"] == "t-1"
+        status, rec = _get(f"{base}?pod=web-9")
+        assert status == 200 and rec["id"] == rid
+        status, doc = _get(f"{base}?kind=shed")
+        assert status == 200 and doc["decisions"] == []
+
+    def test_unknown_id_and_pod_404(self, server):
+        op, ports = server
+        base = f"http://127.0.0.1:{ports['metrics']}/debug/decisions"
+        for q in ("?id=d-99999", "?pod=absent-pod"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(base + q)
+            assert e.value.code == 404
+
+    def test_malformed_limit_400_and_clamp(self, server):
+        op, ports = server
+        base = f"http://127.0.0.1:{ports['metrics']}/debug/decisions"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{base}?limit=abc")
+        assert e.value.code == 400
+        for i in range(3):
+            explain.DECISIONS.emit("provisioning", {"n": i}, ts=float(i))
+        status, doc = _get(f"{base}?limit=999999")  # clamped, not rejected
+        assert status == 200 and len(doc["decisions"]) <= 256
+        status, doc = _get(f"{base}?limit=1")
+        assert status == 200 and len(doc["decisions"]) == 1
+
+    def test_eventz_param_discipline(self, server):
+        op, ports = server
+        base = f"http://127.0.0.1:{ports['health']}/eventz"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{base}?n=abc")
+        assert e.value.code == 400
+        status, doc = _get(f"{base}?n=999999")  # clamps to the ring bound
+        assert status == 200 and "events" in doc
+
+    def test_bundle_decisions_param(self, server):
+        op, ports = server
+        op.reconcile_all_once()
+        for i in range(5):
+            explain.DECISIONS.emit("provisioning", {"n": i}, ts=float(i))
+        base = f"http://127.0.0.1:{ports['metrics']}/debug/bundle"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{base}?decisions=abc")
+        assert e.value.code == 400
+        status, b = _get(f"{base}?decisions=2")
+        assert status == 200
+        assert len(b["decisions"]["records"]) == 2
+        assert b["decisions"]["schema"] == explain.SCHEMA_VERSION
+
+    def test_statusz_carries_decisions_section(self, server):
+        op, ports = server
+        status, snap = _get(
+            f"http://127.0.0.1:{ports['metrics']}/debug/statusz")
+        assert status == 200
+        assert snap["schema"] == 8
+        assert snap["decisions"]["dimensions"] == list(explain.DIMENSIONS)
+
+
+class TestExplainCLI:
+    def _args(self, ports, **kw):
+        base = dict(pod=None, id=None, limit=20, json=False,
+                    endpoint=f"http://127.0.0.1:{ports['metrics']}")
+        base.update(kw)
+        return types.SimpleNamespace(**base)
+
+    def test_unschedulable_verdict(self, server, capsys):
+        from karpenter_tpu.__main__ import cmd_explain
+
+        op, ports = server
+        explain.DECISIONS.emit(
+            "provisioning",
+            {"assignments": [],
+             "unassigned": [{"pod": "web-9",
+                             "reason": explain.clause_for("resources"),
+                             "summary": "3 of 4 candidates rejected",
+                             "ranked": list(explain.DIMENSIONS),
+                             "nearest": {"display": "1.2 cores (cpu)"},
+                             "parity": True}]}, ts=1.0)
+        assert cmd_explain(self._args(ports, pod="web-9")) == 0
+        out = capsys.readouterr().out
+        assert "UNSCHEDULABLE" in out
+        assert explain.clause_for("resources") in out
+        assert "short by 1.2 cores" in out
+
+    def test_assigned_verdict_and_index(self, server, capsys):
+        from karpenter_tpu.__main__ import cmd_explain
+
+        op, ports = server
+        explain.DECISIONS.emit(
+            "provisioning",
+            {"assignments": [{"pods": ["web-1"], "itype": "m.large",
+                              "zone": "zone-1a",
+                              "capacity_type": "on-demand",
+                              "provisioner": "default", "price": 0.2}],
+             "unassigned": []}, ts=1.0)
+        assert cmd_explain(self._args(ports, pod="web-1")) == 0
+        assert "ASSIGNED" in capsys.readouterr().out
+        assert cmd_explain(self._args(ports)) == 0  # index mode
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["schema"] == explain.SCHEMA_VERSION
+
+    def test_unknown_pod_is_an_error(self, server, capsys):
+        from karpenter_tpu.__main__ import cmd_explain
+
+        op, ports = server
+        assert cmd_explain(self._args(ports, pod="ghost")) == 1
+        assert "ghost" in capsys.readouterr().err
+
+
+class TestProvisioningDecisions:
+    def test_solve_emits_record_with_attribution(self):
+        """End-to-end through the controller: an unschedulable pod's
+        FailedScheduling diagnosis lands in a DecisionRecord with the
+        parity bit set."""
+        from karpenter_tpu.apis.nodetemplate import NodeTemplate
+        from karpenter_tpu.apis.settings import Settings
+        from karpenter_tpu.fake.cloud import FakeCloud
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        op = Operator(FakeCloud(catalog=_catalog(), clock=clock),
+                      Settings(cluster_name="explain",
+                               cluster_endpoint="https://explain"),
+                      _catalog(), clock=clock)
+        try:
+            op.kube.create("nodetemplates", "default", NodeTemplate(
+                name="default",
+                subnet_selector={"id": "subnet-zone-1a"},
+                security_group_selector={"id": "sg-default"}))
+            op.cloudprovider.register_nodetemplate(
+                op.kube.get("nodetemplates", "default"))
+            prov = Provisioner(name="default", provider_ref="default")
+            prov.set_defaults()
+            op.kube.create("provisioners", "default", prov)
+            op.kube.create("pods", "ok-1",
+                           make_pod("ok-1", cpu="1", memory="1Gi"))
+            op.kube.create("pods", "huge-1",
+                           make_pod("huge-1", cpu="64", memory="1Gi"))
+            op.reconcile_all_once()
+        finally:
+            op.stop()
+        recs = explain.DECISIONS.records(kind="provisioning")
+        try:
+            assert recs, "no provisioning DecisionRecord emitted"
+            rec = recs[-1]
+            assert rec["dimensions"] == list(explain.DIMENSIONS)
+            (u,) = [u for u in rec["unassigned"] if u["pod"] == "huge-1"]
+            assert u["parity"] is True
+            assert u["reason"] == explain.clause_for("resources")
+            assert explain.DECISIONS.find_pod("huge-1")["id"] == rec["id"]
+            assert explain.DECISIONS.find_pod("ok-1")["id"] == rec["id"]
+        finally:
+            explain.DECISIONS.clear()
