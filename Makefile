@@ -8,9 +8,9 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit lint noretry hotloops crashpoints cardinality phaseacct reasons test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm fleet-bench telemetry-drill claims diagnose provenance multichip soak perf-regress ledger-backfill profile-drill explain-drill
+.PHONY: presubmit lint noretry hotloops crashpoints cardinality phaseacct reasons test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm failover-drill fleet-bench telemetry-drill claims diagnose provenance multichip soak perf-regress ledger-backfill profile-drill explain-drill
 
-presubmit: lint claims provenance noretry hotloops crashpoints cardinality phaseacct reasons perf-regress test verify-entry  ## what CI runs
+presubmit: lint claims provenance noretry hotloops crashpoints cardinality phaseacct reasons perf-regress failover-drill test verify-entry  ## what CI runs
 
 perf-regress:  ## tier-1-sized micro-benches must stay inside the ledger's noise bands
 	$(CPU_ENV) $(PY) hack/check_perf_regress.py
@@ -65,6 +65,9 @@ chaos-crash:  ## crash-restart recovery drill: every crashpoint + fenced failove
 
 chaos-storm:  ## multi-tenant storm drill: fairness bound + shed paths, replayable
 	$(CPU_ENV) $(PY) -m karpenter_tpu chaos --storm --seed $(or $(SEED),42) --scenarios $(or $(SCENARIOS),2)
+
+failover-drill:  ## fleet membership/failover drill: kill, partition, gray, poison, rejoin
+	$(CPU_ENV) $(PY) -m karpenter_tpu chaos --partition --seed $(or $(SEED),0)
 
 fleet-bench:  ## multi-tenant fleet benchmark: sustained solves/sec + p99, RECORDED
 	$(CPU_ENV) $(PY) bench.py --fleet

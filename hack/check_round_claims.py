@@ -43,8 +43,8 @@ CLAIM_PATTERNS = [
 ARTIFACT_PATTERNS = [
     re.compile(r"benchmarks/[\w./*-]+"),
     re.compile(r"\b(?:tpu|bench|trace_summary|linkprobe|chaos_seed"
-               r"|chaos_burst|chaos_crash|chaos_storm|fleet|bundle_"
-               r"|explain)[\w*-]*\.json(?:\.gz)?"),
+               r"|chaos_burst|chaos_crash|chaos_storm|failover|fleet"
+               r"|bundle_|explain)[\w*-]*\.json(?:\.gz)?"),
     re.compile(r"[\w*-]+\.trace\.json(?:\.gz)?"),
 ]
 

@@ -545,6 +545,31 @@ def cmd_get(args) -> int:
     return 0
 
 
+def _ledger_partition(artifact) -> None:
+    """Ledger the partition drill's key numbers (remap fraction, recovery
+    cycles, warm-state loss) so gen_docs citations and the perf trend have
+    a source of truth. Best-effort: the ledger lives in benchmarks/, which
+    an installed wheel may not carry."""
+    try:
+        from benchmarks import ledger
+    except ImportError:
+        return
+    key = artifact["key_numbers"]
+    workload = {"replicas": artifact["replicas"],
+                "tenants": artifact["tenants"],
+                "seed": artifact["seed"]}
+    art = artifact.get("artifact_path")
+    for metric, value, unit in (
+            ("fleet_failover_remap_fraction",
+             key["remap_fraction"], "fraction"),
+            ("fleet_failover_recovery_to_green",
+             key["recovery_to_green_cycles"], "cycles"),
+            ("fleet_failover_warm_state_losses",
+             key["warm_state_losses"], "count")):
+        ledger.record(metric, value, unit, source="chaos-partition",
+                      workload=workload, artifact=art)
+
+
 def cmd_chaos(args) -> int:
     """Seeded chaos sweep: drive faulted scenarios to convergence, check
     the cross-layer invariants, and write a replay artifact."""
@@ -554,11 +579,23 @@ def cmd_chaos(args) -> int:
                          intensity=args.intensity,
                          out_dir=args.out_dir or None,
                          burst=args.burst, crash=args.crash,
-                         storm=args.storm)
+                         storm=args.storm, partition=args.partition)
     artifact = runner.run()
     for s in artifact["scenarios"]:
         verdict = "PASS" if s["passed"] else "FAIL"
-        if args.storm:
+        if args.partition:
+            if s["drill"] == "partition":
+                t = s["totals"]
+                print(f"seed={s['seed']} scenario={s['scenario']} {verdict} "
+                      f"{s['drill']} remap={s['remap_fraction']} "
+                      f"(~{s['remap_expected']}) served={t['served']} "
+                      f"shed={t['shed_quarantine']} "
+                      f"cold_remaps={t['cold_remaps']} "
+                      f"epoch={s['membership_epoch']}")
+            else:
+                print(f"seed={s['seed']} scenario={s['scenario']} {verdict} "
+                      f"{s['drill']} epoch={s['epoch']}")
+        elif args.storm:
             t = s["totals"]
             print(f"seed={s['seed']} scenario={s['scenario']} {verdict} "
                   f"tenants={s['tenants']} submitted={t['submitted']} "
@@ -589,9 +626,20 @@ def cmd_chaos(args) -> int:
               f"--scenarios {args.scenarios}"
               f"{' --burst' if args.burst else ''}"
               f"{' --crash' if args.crash else ''}"
-              f"{' --storm' if args.storm else ''}")
+              f"{' --storm' if args.storm else ''}"
+              f"{' --partition' if args.partition else ''}")
         return 1
-    if args.storm:
+    if args.partition:
+        key = artifact["key_numbers"]
+        print(f"chaos: partition drill passed — remap fraction "
+              f"{key['remap_fraction']} (expected ~"
+              f"{key['remap_expected']}), recovery to green in "
+              f"{key['recovery_to_green_cycles']} cycle(s), "
+              f"{key['warm_state_losses']} warm-state loss(es), "
+              f"{key['poisons_quarantined']} poison(s) quarantined "
+              f"({artifact['duration_s']}s)")
+        _ledger_partition(artifact)
+    elif args.storm:
         print(f"chaos: tenant storm passed — {artifact['scenario_count']} "
               f"scenario(s), {artifact['tenants']} tenants each, fairness "
               f"bound held ({artifact['duration_s']}s)")
@@ -788,6 +836,13 @@ def main(argv=None) -> int:
                               "the fleet frontend, asserting the "
                               "fairness-never-starves invariant "
                               "(docs/designs/fleet.md)")
+    p_chaos.add_argument("--partition", action="store_true",
+                         help="run the fleet membership/failover drill: "
+                              "replica kill, blackhole partition, gray "
+                              "slow-replica, poison request and rejoin, "
+                              "auditing remap blast radius, "
+                              "completes-or-sheds, quarantine cascade "
+                              "bounds and epoch monotonicity")
     p_chaos.set_defaults(fn=cmd_chaos)
 
     p_ver = sub.add_parser("version")

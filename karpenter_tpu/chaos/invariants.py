@@ -528,10 +528,125 @@ def check_explain_noop(explain) -> "list[Violation]":
     return out
 
 
+def check_membership_noop(membership) -> "list[Violation]":
+    """membership-strict-noop: the membership plane is advisory — with
+    the plane disabled it must do NOTHING. The runner disables membership
+    for the scenario and hands us before/after activity counters
+    (karpenter_tpu.fleet.membership.activity()); ANY growth — probes
+    issued, transitions fired, epoch bumps — means the plane mutated
+    routing behind the switch and static membership is no longer
+    bit-identical."""
+    if not membership or membership.get("enabled", True):
+        return []  # not part of this drill, or plane was left on
+    out: "list[Violation]" = []
+    before = membership.get("before") or {}
+    after = membership.get("after") or {}
+    for key in sorted(set(before) | set(after)):
+        grew = after.get(key, 0) - before.get(key, 0)
+        if grew > 0:
+            out.append(Violation(
+                "membership-strict-noop",
+                f"membership disabled but {key} grew by {grew} "
+                f"({before.get(key, 0)} -> {after.get(key, 0)})"))
+    return out
+
+
+def check_remap_blast_radius(before: "dict[str, str]",
+                             after: "dict[str, str]",
+                             lost: "set[str] | list[str]",
+                             ) -> "list[Violation]":
+    """remap-blast-radius: when replicas leave the member set, EXACTLY
+    the tenants homed on them remap — a tenant whose home survived must
+    keep it (rendezvous stability is the whole point), and no tenant may
+    keep routing to a lost replica. `before`/`after` are full
+    tenant->replica assignments bracketing the loss; `lost` is the set
+    of replicas that left."""
+    inv = "remap-blast-radius"
+    lost_set = set(lost)
+    out = []
+    for tenant in sorted(before):
+        home, now = before[tenant], after.get(tenant)
+        if now is None:
+            out.append(Violation(
+                inv, f"tenant {tenant} vanished from the assignment after "
+                     f"losing {sorted(lost_set)}"))
+        elif home in lost_set and now == home:
+            out.append(Violation(
+                inv, f"tenant {tenant} still routes to lost replica "
+                     f"{home}"))
+        elif home not in lost_set and now != home:
+            out.append(Violation(
+                inv, f"tenant {tenant} remapped {home} -> {now} but its "
+                     f"home never left the member set (blast radius "
+                     f"exceeded)"))
+    return out
+
+
+def check_completes_or_sheds(outcomes: "list[dict]") -> "list[Violation]":
+    """solve-completes-or-sheds: every admitted solve reaches a terminal
+    outcome — served, or shed with a vocabulary reason. A request that
+    silently vanished (no outcome), errored out of the failover path, or
+    shed citing a reason outside explain/reasons.py SHED_REASONS is a
+    violation: under replica churn "we lost it somewhere" is exactly the
+    failure mode this plane exists to kill."""
+    from ..explain.reasons import SHED_REASONS
+
+    inv = "solve-completes-or-sheds"
+    out = []
+    for i, rec in enumerate(outcomes):
+        tenant = rec.get("tenant", f"#{i}")
+        outcome = rec.get("outcome")
+        if outcome == "served":
+            continue
+        if outcome == "shed":
+            reason = rec.get("reason")
+            if reason not in SHED_REASONS:
+                out.append(Violation(
+                    inv, f"tenant {tenant}: shed with reason {reason!r} "
+                         f"not in the SHED_REASONS vocabulary"))
+            continue
+        out.append(Violation(
+            inv, f"tenant {tenant}: solve ended as {outcome!r} "
+                 f"(expected served or shed-with-reason)"))
+    return out
+
+
+def check_quarantine_cascade(victims: "dict[str, list]",
+                             limit: int = 2) -> "list[Violation]":
+    """quarantine-bounds-cascade: no request fingerprint may fell more
+    than `limit` distinct replicas — the quarantine ring must trip on the
+    second victim and shed every later attempt, never hand the poison a
+    third target. `victims` is the ring's fingerprint -> victim-replicas
+    evidence."""
+    return [
+        Violation("quarantine-bounds-cascade",
+                  f"request {fp} took down {len(reps)} replicas "
+                  f"{sorted(reps)} (quarantine must cap the cascade at "
+                  f"{limit})")
+        for fp, reps in sorted(victims.items()) if len(set(reps)) > limit
+    ]
+
+
+def check_epoch_monotone(epochs: "list[int]") -> "list[Violation]":
+    """membership-epoch-monotone: the observed membership epoch sequence
+    never regresses. Epochs are how observers (fleetz, clients) order
+    membership views; one regression and a stale view can masquerade as
+    the freshest."""
+    out = []
+    prev = None
+    for i, epoch in enumerate(epochs):
+        if prev is not None and epoch < prev:
+            out.append(Violation(
+                "membership-epoch-monotone",
+                f"epoch regressed at observation #{i}: {prev} -> {epoch}"))
+        prev = epoch
+    return out
+
+
 def check_all(op, cloud, token_launches=None,
               consolidation_actions=None,
               resilience=None, profiling=None,
-              explain=None) -> "list[Violation]":
+              explain=None, membership=None) -> "list[Violation]":
     out = []
     out += check_token_ledger(token_launches or {})
     out += check_bijection(op, cloud)
@@ -544,4 +659,5 @@ def check_all(op, cloud, token_launches=None,
     out += check_columnar_coherence(op)
     out += check_profiling_noop(profiling)
     out += check_explain_noop(explain)
+    out += check_membership_noop(membership)
     return out

@@ -70,6 +70,68 @@ def chaos_catalog() -> Catalog:
     ])
 
 
+class _StubReplica:
+    """In-process fleet replica for the partition drill: serves solves in
+    a deterministic per-replica base latency, and fails in exactly the
+    three shapes the failover plane distinguishes — dead (refused fast),
+    partitioned (blackholed: the caller burns its whole deadline), slow
+    (gray: answers, late). Service time advances the FakeClock, so the
+    drill's p99 ledger and the membership detectors see the same physics.
+    A poison request crashes whichever replica serves it."""
+
+    SLOW_FACTOR = 20.0     # gray replica: ~20x its healthy latency
+    REFUSED_S = 0.0001     # a connection refused is near-instant
+
+    def __init__(self, name: str, base_latency_s: float, clock):
+        self.name = name
+        self.base_latency_s = base_latency_s
+        self.clock = clock
+        self.state = "ok"   # ok | dead | partitioned | slow
+        self.synced: "set[str]" = set()   # tenants with a warm catalog
+        self.served = 0
+
+    def probe(self) -> float:
+        """Health surface for the MembershipManager: returns the probe
+        latency (gray evidence) or raises (missed beat)."""
+        from ..fleet import ReplicaTimeout, ReplicaUnavailable
+
+        if self.state == "dead":
+            raise ReplicaUnavailable(self.name, "connection refused")
+        if self.state == "partitioned":
+            raise ReplicaTimeout(self.name, "probe blackholed")
+        if self.state == "slow":
+            return self.base_latency_s * self.SLOW_FACTOR
+        return self.base_latency_s
+
+    def solve(self, tenant_id: str, request, timeout_s):
+        from ..fleet import (ReplicaCrashed, ReplicaTimeout,
+                             ReplicaUnavailable)
+
+        if self.state == "dead":
+            self.clock.step(self.REFUSED_S)
+            raise ReplicaUnavailable(self.name, "connection refused")
+        if self.state == "partitioned":
+            # blackhole: nothing answers, the caller waits out its deadline
+            self.clock.step(timeout_s if timeout_s else 1.0)
+            raise ReplicaTimeout(self.name, "request blackholed")
+        if isinstance(request, dict) and request.get("poison"):
+            self.clock.step(self.base_latency_s)
+            self.state = "dead"   # the request killed its server
+            raise ReplicaCrashed(self.name, "replica died serving request")
+        latency = self.base_latency_s * (
+            self.SLOW_FACTOR if self.state == "slow" else 1.0)
+        if timeout_s is not None and latency > timeout_s:
+            self.clock.step(timeout_s)
+            raise ReplicaTimeout(
+                self.name, f"{latency:.4f}s exceeds {timeout_s:.4f}s "
+                "deadline")
+        self.clock.step(latency)
+        self.served += 1
+        return {"tenant": tenant_id, "replica": self.name,
+                "pods": request.get("pods", 0)
+                if isinstance(request, dict) else 0}
+
+
 class ChaosRunner:
     CHAOS_CYCLES = 18          # > FaultPlan.CYCLE_HORIZON so every cycle fault can land
     SETTLE_DEADLINE = 30       # settle cycles before declaring non-quiescence
@@ -78,7 +140,7 @@ class ChaosRunner:
     def __init__(self, seed: int, scenarios: int = 1, wire: bool = False,
                  intensity: float = 1.0, out_dir: "str | None" = None,
                  burst: bool = False, crash: bool = False,
-                 storm: bool = False):
+                 storm: bool = False, partition: bool = False):
         self.seed = seed
         self.scenarios = scenarios
         self.wire = wire
@@ -98,6 +160,12 @@ class ChaosRunner:
         # invariant (no tenant waits past the starvation bound) and that
         # both shed paths (admission, queue) actually fire
         self.storm = storm
+        # partition mode runs the multi-replica fleet failover drill:
+        # replica kill, blackhole partition, gray slow-replica, poison
+        # request and rejoin against a MembershipManager + FailoverClient,
+        # auditing remap blast radius, completes-or-sheds, quarantine
+        # cascade bounds and membership epoch monotonicity
+        self.partition = partition
         # diagnostics bundles auto-dumped by failed scenarios (volatile:
         # paths depend on out_dir, so they live at the artifact top level,
         # never inside a scenario dict)
@@ -248,6 +316,13 @@ class ChaosRunner:
         from .. import explain
         expl_prev = explain.set_enabled(False)
         expl_before = explain.activity()
+        # membership-strict-noop drill: third plane, same contract — the
+        # sweep runs with health-gated membership off and any activity
+        # delta (a probe, a transition, an epoch bump) is a violation;
+        # the --partition drill is where the plane runs hot
+        from ..fleet import membership as fleet_membership
+        mem_prev = fleet_membership.set_enabled(False)
+        mem_before = fleet_membership.activity()
         try:
             injector.install(op, cloud)
             self._reconcile_workload(op, workload, injector)
@@ -313,13 +388,25 @@ class ChaosRunner:
                 "deltas": {k: expl_after[k] - expl_before[k]
                            for k in expl_before},
             }
+            mem_after = fleet_membership.activity()
+            membership_evidence = {
+                "enabled": False,
+                "before": mem_before,
+                "after": mem_after,
+            }
+            membership_stored = {
+                "enabled": False,
+                "deltas": {k: mem_after[k] - mem_before[k]
+                           for k in mem_before},
+            }
             violations = invariants.check_all(
                 op, cloud,
                 token_launches=injector.token_launches,
                 consolidation_actions=injector.consolidation_actions,
                 resilience=resilience_evidence,
                 profiling=profiling_evidence,
-                explain=explain_evidence)
+                explain=explain_evidence,
+                membership=membership_evidence)
             if not self._quiescent(op):
                 violations = [invariants.Violation(
                     "quiescence",
@@ -345,6 +432,7 @@ class ChaosRunner:
         finally:
             profiling.set_enabled(prof_prev)
             explain.set_enabled(expl_prev)
+            fleet_membership.set_enabled(mem_prev)
             op.stop()
 
         fired_kinds = sorted(injector.fired_kinds())
@@ -364,6 +452,7 @@ class ChaosRunner:
             "resilience": resilience_evidence,
             "profiling": profiling_stored,
             "explain": explain_stored,
+            "membership": membership_stored,
             "violations": [v.as_dict() for v in violations],
             "passed": not violations,
         }
@@ -785,11 +874,17 @@ class ChaosRunner:
         contract."""
         from .. import explain as _explain
         from .. import profiling as _profiling
+        from ..fleet import membership as _membership
 
         prof_prev = _profiling.set_enabled(False)
         prof_before = _profiling.activity()
         expl_prev = _explain.set_enabled(True)
         expl_before = _explain.activity()
+        # the storm never registers replicas, so the membership plane is
+        # disabled for the drill and its strict-noop contract is audited
+        # on the side (the --partition drill is its positive half)
+        mem_prev = _membership.set_enabled(False)
+        mem_before = _membership.activity()
         try:
             out = self._storm_scenario_impl(scenario)
             prof_after = _profiling.activity()
@@ -804,6 +899,18 @@ class ChaosRunner:
             }
             if noop:
                 out["violations"].extend(v.as_dict() for v in noop)
+                out["passed"] = False
+            mem_after = _membership.activity()
+            mem_noop = invariants.check_membership_noop(
+                {"enabled": False, "before": mem_before,
+                 "after": mem_after})
+            out["membership"] = {
+                "enabled": False,
+                "deltas": {k: mem_after[k] - mem_before[k]
+                           for k in mem_before},
+            }
+            if mem_noop:
+                out["violations"].extend(v.as_dict() for v in mem_noop)
                 out["passed"] = False
             expl_after = _explain.activity()
             new_sheds = (expl_after["sheds_total"]
@@ -840,6 +947,7 @@ class ChaosRunner:
         finally:
             _profiling.set_enabled(prof_prev)
             _explain.set_enabled(expl_prev)
+            _membership.set_enabled(mem_prev)
 
     def _storm_scenario_impl(self, scenario: int) -> dict:
         from ..fleet import FleetFrontend
@@ -958,6 +1066,390 @@ class ChaosRunner:
             artifact["artifact_path"] = path
         return artifact
 
+    # -- fleet partition / failover drill --------------------------------------
+
+    PARTITION_REPLICAS = 5
+    PARTITION_TENANTS = 40
+    PARTITION_WARMUP_TICKS = 12    # > GRAY_MIN_SAMPLES so windows fill
+    PARTITION_PHASE_TICKS = 12     # per injected fault
+    PARTITION_TIMEOUT_S = 0.25     # caller solve deadline
+    PARTITION_HEDGE_S = 0.02       # hedge horizon: ~5x a healthy solve
+    GRAY_EJECT_BOUND = 4           # cycles the gray replica may poison p99
+
+    @staticmethod
+    def _p99(values: "list[float]") -> float:
+        if not values:
+            return 0.0
+        ordered = sorted(values)
+        idx = min(len(ordered) - 1, int(0.99 * len(ordered)))
+        return round(ordered[idx], 6)
+
+    def run_partition_scenario(self, scenario: int) -> dict:
+        """The failover drill proper, wrapped in the plane switches: the
+        membership plane is ON (it is the system under test), the explain
+        plane is ON so the poison-quarantine shed lands as a DecisionRecord
+        (audited below, storm-style), and profiling stays OFF."""
+        from .. import explain as _explain
+        from .. import profiling as _profiling
+        from ..fleet import membership as _membership
+
+        prof_prev = _profiling.set_enabled(False)
+        expl_prev = _explain.set_enabled(True)
+        mem_prev = _membership.set_enabled(True)
+        expl_before = _explain.activity()
+        try:
+            out = self._partition_scenario_impl(scenario)
+            expl_after = _explain.activity()
+            new_sheds = (expl_after["sheds_total"]
+                         - expl_before["sheds_total"])
+            fired = out["totals"]["shed_quarantine"]
+            tail = _explain.DECISIONS.records(kind="shed")
+            tail = tail[len(tail) - min(new_sheds, len(tail)):]
+            uncited = sum(
+                1 for rec in tail
+                if rec.get("reason") not in _explain.SHED_REASONS
+                or rec.get("where") != "failover")
+            if new_sheds != fired or uncited:
+                out["violations"].append(invariants.Violation(
+                    "shed-citations",
+                    f"drill fired {fired} quarantine shed(s) but the "
+                    f"decision ring recorded {new_sheds} ({uncited} without "
+                    f"a failover vocabulary reason) — every shed must cite "
+                    f"its cause").as_dict())
+                out["passed"] = False
+            out["explain"] = {
+                "enabled": True,
+                "sheds_fired": fired,
+                "shed_records": new_sheds,
+            }
+            return out
+        finally:
+            _membership.set_enabled(mem_prev)
+            _explain.set_enabled(expl_prev)
+            _profiling.set_enabled(prof_prev)
+
+    def _partition_scenario_impl(self, scenario: int) -> dict:
+        from ..fleet import (FailoverClient, FailoverExhausted, FleetRouter,
+                             MembershipManager, QuarantineRing,
+                             ReplicaUnavailable, RequestQuarantined)
+        from ..resilience import RetryBudget
+
+        r = ChaosRng((self.seed << 8) ^ scenario).fork("partition")
+        clock = FakeClock()
+        names = [f"replica-{i}" for i in range(self.PARTITION_REPLICAS)]
+        faults = {"unavailable": 0, "timeout": 0, "crash": 0}
+        stubs = {n: _StubReplica(n, round(0.002 + r.uniform() * 0.002, 6),
+                                 clock)
+                 for n in names}
+        # the three single-fault victims, distinct by construction
+        kill_n, part_n, gray_n = (
+            names[i] for i in r.sample_indices(3, len(names)))
+
+        router = FleetRouter()
+        ejection_triggers: "list[str]" = []
+        manager = MembershipManager(
+            router, clock=clock,
+            flight_trigger=lambda reason, detail:
+                ejection_triggers.append(reason))
+        for n in names:
+            manager.register(n, stubs[n].probe)
+
+        def make_transport(stub):
+            def transport(tenant_id, request, timeout_s):
+                try:
+                    return stub.solve(tenant_id, request, timeout_s)
+                except ReplicaUnavailable as e:
+                    faults[e.fault_kind] += 1
+                    raise
+            return transport
+
+        resyncs = {"n": 0}
+
+        def on_remap(tenant_id, replica):
+            # cold-start handling: re-Sync the tenant's catalog onto its
+            # new home before the solve is handed over
+            resyncs["n"] += 1
+            stubs[replica].synced.add(tenant_id)
+
+        client = FailoverClient(
+            router, {n: make_transport(stubs[n]) for n in names},
+            clock=clock, quarantine=QuarantineRing(), on_remap=on_remap,
+            seed=self.seed, hedge_horizon_s=self.PARTITION_HEDGE_S,
+            budget=RetryBudget(capacity=64.0, refill_per_success=0.5))
+
+        tenants = [f"tenant-{i:02d}" for i in range(self.PARTITION_TENANTS)]
+        poison_req = {"poison": True, "tenant": "tenant-toxic", "pods": 4}
+
+        epochs = [manager.epoch()]
+        outcomes: "list[dict]" = []
+        tick_no = {"n": 0}
+
+        def one_cycle(phase_events, cyc_lats, greens, poison=False):
+            tick_no["n"] += 1
+            phase_events.extend(manager.tick())
+            epochs.append(manager.epoch())
+            f0 = sum(faults.values())
+            lats: "list[float]" = []
+            if router.replicas:
+                todo = [(t, {"tenant": t, "cycle": tick_no["n"], "pods": 4})
+                        for t in tenants]
+                if poison:
+                    todo.append(("tenant-toxic", poison_req))
+                for t, req in todo:
+                    t0 = clock.now()
+                    try:
+                        client.solve(t, req,
+                                     timeout_s=self.PARTITION_TIMEOUT_S)
+                    except RequestQuarantined:
+                        outcomes.append({"tenant": t, "outcome": "shed",
+                                         "reason": "poison-quarantine"})
+                    except (FailoverExhausted, LookupError) as e:
+                        outcomes.append({
+                            "tenant": t, "outcome": "error",
+                            "detail": f"{type(e).__name__}: {e}"})
+                    else:
+                        outcomes.append({"tenant": t, "outcome": "served"})
+                        lats.append(round(clock.now() - t0, 6))
+            cyc_lats.append(self._p99(lats))
+            greens.append(sum(faults.values()) == f0)
+            clock.step(1.0)  # heartbeat cadence
+            return lats
+
+        def run_phase(name, ticks, poison=False):
+            events: "list[dict]" = []
+            p99s: "list[float]" = []
+            greens: "list[bool]" = []
+            all_lats: "list[float]" = []
+            for _ in range(ticks):
+                all_lats.extend(one_cycle(events, p99s, greens,
+                                          poison=poison))
+            green_at = next((i + 1 for i, g in enumerate(greens) if g), -1)
+            return {"phase": name, "ticks": ticks, "events": events,
+                    "cycle_p99": p99s, "p99": self._p99(all_lats),
+                    "recovery_to_green_cycles": green_at}
+
+        violations: "list[invariants.Violation]" = []
+        phases = [run_phase("warmup", self.PARTITION_WARMUP_TICKS)]
+        baseline_p99 = phases[0]["p99"]
+        a0 = router.assignment(tenants)
+
+        # phase 2: hard kill — K missed beats must eject, the client must
+        # reroute the dead replica's tenants, nobody else may move
+        stubs[kill_n].state = "dead"
+        phases.append(run_phase("kill", self.PARTITION_PHASE_TICKS))
+        a_kill = router.assignment(tenants)
+        violations += invariants.check_remap_blast_radius(
+            a0, a_kill, {kill_n})
+        remapped = sum(1 for t in tenants if a0[t] != a_kill[t])
+        remap_fraction = round(remapped / float(len(tenants)), 4)
+
+        # phase 3: blackhole partition — probes and requests time out
+        # instead of failing fast; same detector, the hedge covers clients
+        stubs[kill_n].state = "ok"
+        stubs[part_n].state = "partitioned"
+        phases.append(run_phase("partition", self.PARTITION_PHASE_TICKS))
+
+        # phase 4: gray failure — the replica still answers, slowly; the
+        # latency-quantile detector must eject it before fleet p99 stays
+        # doubled (the hedge bounds the damage while detection runs)
+        stubs[part_n].state = "ok"
+        stubs[gray_n].state = "slow"
+        phases.append(run_phase("gray", self.PARTITION_PHASE_TICKS))
+        gray = phases[-1]
+        gray_ejections = [e for e in gray["events"]
+                          if e.get("reason") == "gray-failure"]
+        elevated = sum(1 for p in gray["cycle_p99"]
+                       if p >= 2.0 * baseline_p99)
+        if not gray_ejections:
+            violations.append(invariants.Violation(
+                "gray-ejection-before-p99-doubles",
+                f"the slow replica {gray_n} was never ejected by the "
+                "latency-quantile detector"))
+        elif elevated > self.GRAY_EJECT_BOUND \
+                or gray["cycle_p99"][-1] >= 2.0 * baseline_p99:
+            violations.append(invariants.Violation(
+                "gray-ejection-before-p99-doubles",
+                f"fleet p99 stayed >= 2x baseline ({baseline_p99}s) for "
+                f"{elevated} gray-phase cycle(s) (bound "
+                f"{self.GRAY_EJECT_BOUND}), last cycle "
+                f"{gray['cycle_p99'][-1]}s — ejection came too late"))
+
+        # phase 5: poison pill — one request crashes whatever replica
+        # serves it; after exactly VICTIM_LIMIT distinct victims it must be
+        # quarantined and shed, never handed a third replica
+        stubs[gray_n].state = "ok"
+        phases.append(run_phase("poison", self.PARTITION_PHASE_TICKS,
+                                poison=True))
+        q_evidence = client.quarantine.evidence()
+        violations += invariants.check_quarantine_cascade(
+            q_evidence["victims"], limit=client.quarantine.victim_limit)
+        from ..fleet.failover import request_fingerprint
+        poison_fp = request_fingerprint(poison_req)
+        poison_victims = client.quarantine.victims(poison_fp)
+        if len(poison_victims) != client.quarantine.victim_limit:
+            violations.append(invariants.Violation(
+                "quarantine-bounds-cascade",
+                f"the poison request claimed {len(poison_victims)} "
+                f"victim(s) {poison_victims} — the drill expects exactly "
+                f"{client.quarantine.victim_limit} before quarantine"))
+
+        # phase 6: rejoin — every faulted replica heals, recovers through
+        # the probe gate, and the rendezvous assignment must come back
+        # bit-identical to the pre-fault baseline
+        for stub in stubs.values():
+            stub.state = "ok"
+        phases.append(run_phase("rejoin", self.PARTITION_PHASE_TICKS))
+        a_final = router.assignment(tenants)
+        violations += invariants.check_remap_blast_radius(
+            a0, a_final, set())
+
+        violations += invariants.check_completes_or_sheds(outcomes)
+        violations += invariants.check_epoch_monotone(epochs)
+        ejections = [e for p in phases for e in p["events"]
+                     if e["event"] == "ReplicaEjected"]
+        if len(ejection_triggers) != len(ejections):
+            violations.append(invariants.Violation(
+                "membership-epoch-monotone",
+                f"{len(ejections)} ejection(s) fired but "
+                f"{len(ejection_triggers)} flight-recorder trigger(s) were "
+                "pulled — the forensics edge is miswired"))
+
+        outcome_counts = {"served": 0, "shed": 0, "error": 0}
+        for o in outcomes:
+            outcome_counts[o["outcome"]] += 1
+        totals = {
+            "solves": len(outcomes),
+            "served": outcome_counts["served"],
+            "shed_quarantine": outcome_counts["shed"],
+            "errors": outcome_counts["error"],
+            "faults": dict(faults),
+            "cold_remaps": client.warm_state_losses,
+            "resyncs": resyncs["n"],
+        }
+        return {
+            "seed": self.seed,
+            "scenario": scenario,
+            "drill": "partition",
+            "replicas": len(names),
+            "tenants": len(tenants),
+            "faulted": {"killed": kill_n, "partitioned": part_n,
+                        "gray": gray_n},
+            "baseline_p99_s": baseline_p99,
+            "remap_fraction": remap_fraction,
+            "remap_expected": round(1.0 / len(names), 4),
+            "recovery_to_green_cycles": {
+                p["phase"]: p["recovery_to_green_cycles"]
+                for p in phases[1:]},
+            "gray_elevated_cycles": elevated,
+            "membership_epoch": manager.epoch(),
+            "epoch_observations": len(epochs),
+            "ejection_flight_triggers": len(ejection_triggers),
+            "phases": phases,
+            "totals": totals,
+            "quarantine": q_evidence,
+            "failover": client.evidence(),
+            "membership": manager.snapshot(),
+            "violations": [v.as_dict() for v in violations],
+            "passed": not violations,
+        }
+
+    def run_partition_noop(self, scenario: int) -> dict:
+        """The strict-noop half: with the membership plane disabled, a
+        replica death must change NOTHING — register() and tick() are
+        inert, routing stays bit-identical to the static member set, and
+        the plane's activity counters stay frozen."""
+        from ..fleet import FleetRouter, MembershipManager
+        from ..fleet import membership as _membership
+
+        names = [f"replica-{i}" for i in range(self.PARTITION_REPLICAS)]
+        tenants = [f"tenant-{i:02d}" for i in range(self.PARTITION_TENANTS)]
+        router = FleetRouter(names)
+        a0 = router.assignment(tenants)
+
+        def dead_probe():
+            raise RuntimeError("replica is down")
+
+        prev = _membership.set_enabled(False)
+        before = _membership.activity()
+        try:
+            clock = FakeClock()
+            manager = MembershipManager(router, clock=clock)
+            for n in names:
+                manager.register(n, dead_probe)
+            events: "list[dict]" = []
+            for _ in range(2 * MembershipManager.MISSED_BEATS_K):
+                events.extend(manager.tick())
+                clock.step(1.0)
+            after = _membership.activity()
+        finally:
+            _membership.set_enabled(prev)
+
+        evidence = {"enabled": False, "before": before, "after": after}
+        violations = invariants.check_membership_noop(evidence)
+        a1 = router.assignment(tenants)
+        moved = sorted(t for t in tenants if a0[t] != a1[t])
+        if moved or tuple(router.replicas) != tuple(names):
+            violations.append(invariants.Violation(
+                "membership-strict-noop",
+                f"routing moved with the plane disabled: {len(moved)} "
+                f"tenant(s) remapped, members {list(router.replicas)}"))
+        if events:
+            violations.append(invariants.Violation(
+                "membership-strict-noop",
+                f"tick() returned {len(events)} event(s) while disabled"))
+        return {
+            "seed": self.seed,
+            "scenario": scenario,
+            "drill": "partition-noop",
+            "replicas": len(names),
+            "tenants": len(tenants),
+            "membership": {
+                "enabled": False,
+                "deltas": {k: after[k] - before[k] for k in before},
+            },
+            "epoch": manager.epoch(),
+            "violations": [v.as_dict() for v in violations],
+            "passed": not violations,
+        }
+
+    def run_partition_drill(self) -> dict:
+        t0 = time.time()
+        self._bundles = []
+        scenarios = [self.run_partition_scenario(0),
+                     self.run_partition_noop(1)]
+        drill = scenarios[0]
+        artifact = {
+            "tool": "karpenter_tpu.chaos",
+            "mode": "partition",
+            "seed": self.seed,
+            "replicas": self.PARTITION_REPLICAS,
+            "tenants": self.PARTITION_TENANTS,
+            "scenario_count": len(scenarios),
+            "passed": all(s["passed"] for s in scenarios),
+            "key_numbers": {
+                "remap_fraction": drill["remap_fraction"],
+                "remap_expected": drill["remap_expected"],
+                "recovery_to_green_cycles": max(
+                    drill["recovery_to_green_cycles"].values()),
+                "warm_state_losses": drill["totals"]["cold_remaps"],
+                "gray_elevated_cycles": drill["gray_elevated_cycles"],
+                "poisons_quarantined": len(
+                    drill["quarantine"]["quarantined"]),
+            },
+            "scenarios": scenarios,
+            # volatile fields below this line only (replay contract)
+            "duration_s": round(time.time() - t0, 3),
+            "bundles": list(self._bundles),
+        }
+        if self.out_dir:
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = os.path.join(self.out_dir,
+                                f"failover_seed{self.seed}.json")
+            with open(path, "w") as f:
+                json.dump(artifact, f, indent=2, sort_keys=True)
+            artifact["artifact_path"] = path
+        return artifact
+
     # -- artifact --------------------------------------------------------------
 
     def run(self) -> dict:
@@ -965,6 +1457,8 @@ class ChaosRunner:
             return self.run_crash_drill()
         if self.storm:
             return self.run_storm()
+        if self.partition:
+            return self.run_partition_drill()
         t0 = time.time()
         self._bundles = []
         scenarios = [self.run_scenario(s) for s in range(self.scenarios)]

@@ -48,11 +48,13 @@ CLAUSES = (
      "(affinity/topology/limits) were unsatisfiable this cycle"),
 )
 
-# Fleet admission/queue shed causes (fleet/frontend.py note_shed call
-# sites cite these literally; the storm drill asserts every shed in the
-# artifact carries one).
+# Fleet shed causes (fleet/frontend.py and fleet/failover.py note_shed
+# call sites cite these literally; the storm drill asserts every
+# admission/queue shed in the artifact carries one, the partition drill
+# asserts the quarantine shed does).
 SHED_REASONS = (
     "deadline",
+    "poison-quarantine",
 )
 
 # Consolidation keep/evict verdicts (ops/consolidate.py cites these per

@@ -12,20 +12,42 @@ The pieces:
   `solver.service.serve()` and the wire Solve path batches.
 * `FleetRouter` (router.py) — rendezvous-hash tenant -> replica mapping
   across N fleet replicas; rebalance-safe by construction.
+* `MembershipManager` (membership.py) — health-gated membership: probe
+  evidence (K-missed-beats + latency-quantile gray-failure detectors)
+  drives the router's member set; monotone epochs, edge-triggered
+  Replica{Joined,Ejected,Recovered} events; strict no-op when disabled.
+* `FailoverClient` (failover.py) — client-side re-route to the next
+  rendezvous choice through per-replica breakers and one shared retry
+  budget, bounded tail hedging, cold-remap re-Sync, and the poison-pill
+  `QuarantineRing`.
 * metrics.py — queue depth, batch occupancy, shed counts, per-tenant
-  latency (surfaced in /debug/statusz and docs/metrics.md "Fleet").
+  latency, membership/failover families (surfaced in /debug/statusz and
+  docs/metrics.md "Fleet").
 """
 
+from .failover import (FailoverClient, FailoverExhausted, QuarantineRing,
+                       ReplicaCrashed, ReplicaTimeout, ReplicaUnavailable,
+                       RequestQuarantined, request_fingerprint)
 from .frontend import (DEFAULT_TENANT, FleetFrontend, FleetService,
                        FleetShed, TenantNotSynced, active_frontends)
+from .membership import MembershipManager
 from .router import FleetRouter
 
 __all__ = [
     "DEFAULT_TENANT",
+    "FailoverClient",
+    "FailoverExhausted",
     "FleetFrontend",
     "FleetRouter",
     "FleetService",
     "FleetShed",
+    "MembershipManager",
+    "QuarantineRing",
+    "ReplicaCrashed",
+    "ReplicaTimeout",
+    "ReplicaUnavailable",
+    "RequestQuarantined",
     "TenantNotSynced",
     "active_frontends",
+    "request_fingerprint",
 ]

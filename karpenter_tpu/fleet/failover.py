@@ -1,0 +1,344 @@
+"""Client-side fleet failover: re-route, hedge, quarantine.
+
+The membership manager (membership.py) heals the router on the seconds
+scale; this module is the request-scale complement. A solve in flight
+when its home replica dies must not wait K missed beats to be told —
+the client re-routes it to the tenant's NEXT rendezvous choice
+(``FleetRouter.ranked``), which is by construction the replica the
+tenant would remap to anyway, so client failover and membership remap
+always land the tenant in the same place.
+
+Discipline, not heroics:
+
+* every extra attempt — failover hop or hedge — flows through the
+  existing resilience primitives: one shared ``RetryBudget`` bounds the
+  client's total retry amplification, per-replica ``CircuitBreaker``s
+  fail known-dead replicas fast, and the ``check_no_adhoc_retry`` lint
+  stays green because there is no sleep-in-except loop here at all
+  (failover re-routes immediately; waiting out a dead replica is the
+  membership plane's job).
+* **bounded tail hedging** — the home-replica attempt carries a hedge
+  horizon (``HEDGE_HORIZON_S``): if the primary is merely SLOW (times
+  out at the horizon rather than failing), the client fires exactly one
+  hedge to the next choice, charged to the retry budget like any retry.
+  At most one hedge per request, ever — hedging is a tail-latency tool,
+  not a second retry channel.
+* **explicit cold remaps** — serving a tenant from a replica other than
+  its last home means the new home has no synced catalog and no warm
+  compiled programs: the client counts the warm-state loss, and the
+  ``on_remap`` hook re-Syncs the tenant's catalog before the solve is
+  handed over (the drill ledgers the loss; ~1/R of tenants per replica
+  death, the rendezvous contract).
+* **poison-pill quarantine** — a request implicated in crashing or
+  timing out ``VICTIM_LIMIT`` (two) distinct replicas is quarantined:
+  shed with the vocabulary reason ``"poison-quarantine"`` as a ``shed``
+  DecisionRecord in the explain plane, instead of hunting a third
+  victim. The chaos partition drill's ``quarantine-bounds-cascade``
+  invariant enforces the blast radius.
+
+Transports are callables ``transport(tenant_id, request, timeout_s)``
+raising :class:`ReplicaUnavailable` (connection refused — the replica is
+already down), :class:`ReplicaTimeout` (slow or blackholed past the
+deadline), or :class:`ReplicaCrashed` (the request killed its server).
+Only the latter two count the request a victim: a refused connection
+indicts the replica, not the request.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from ..explain import note_shed
+from ..resilience import CircuitBreaker, RetryBudget, RetryPolicy
+from ..utils.clock import Clock
+from . import metrics as fleet_metrics
+from .metrics import tenant_label
+
+# a request is quarantined once this many DISTINCT replicas fell to it
+VICTIM_LIMIT = 2
+
+
+class ReplicaUnavailable(RuntimeError):
+    """The replica refused/reset the connection — it is down or
+    unreachable; the request is innocent."""
+
+    fault_kind = "unavailable"  # metrics/ledger vocabulary for the cause
+
+    def __init__(self, replica: str, detail: str = ""):
+        super().__init__(
+            f"replica {replica} unavailable{': ' + detail if detail else ''}")
+        self.replica = replica
+
+
+class ReplicaTimeout(ReplicaUnavailable):
+    """The replica did not answer within the deadline (slow, or
+    blackholed by a partition)."""
+
+    fault_kind = "timeout"
+
+
+class ReplicaCrashed(ReplicaUnavailable):
+    """The replica died WHILE serving this request — the request is a
+    suspect."""
+
+    fault_kind = "crash"
+
+
+class RequestQuarantined(RuntimeError):
+    """The request is in the poison quarantine ring: shed, not served."""
+
+    def __init__(self, tenant_id: str, fingerprint: str):
+        super().__init__(
+            f"request {fingerprint} from tenant {tenant_id} is quarantined "
+            f"(implicated in {VICTIM_LIMIT} replica failures)")
+        self.tenant_id = tenant_id
+        self.fingerprint = fingerprint
+
+
+class FailoverExhausted(RuntimeError):
+    """Every eligible replica was tried (or the retry budget ran dry)."""
+
+    def __init__(self, tenant_id: str, detail: str):
+        super().__init__(f"failover exhausted for tenant {tenant_id}: "
+                         f"{detail}")
+        self.tenant_id = tenant_id
+
+
+def request_fingerprint(request) -> str:
+    """Content-addressed identity for the quarantine ring: the same
+    poison payload resubmitted by any tenant hits the same ring entry.
+    blake2b over canonical JSON (the repo's content-hash primitive) —
+    never id() or hash(), which are per-process."""
+    try:
+        blob = json.dumps(request, sort_keys=True, default=repr)
+    except (TypeError, ValueError):
+        blob = repr(request)
+    return hashlib.blake2b(blob.encode("utf-8"), digest_size=8).hexdigest()
+
+
+class QuarantineRing:
+    """Bounded LRU of suspect request fingerprints and their victim
+    replicas. ``note_victim`` returns True exactly once per fingerprint
+    — on the observation that trips quarantine — so callers can fire the
+    edge (shed record, metric) without double counting."""
+
+    def __init__(self, capacity: int = 64,
+                 victim_limit: int = VICTIM_LIMIT):
+        self.capacity = max(1, capacity)
+        self.victim_limit = max(1, victim_limit)
+        self._lock = threading.Lock()
+        self._victims: "OrderedDict[str, set]" = OrderedDict()
+        self._quarantined: "OrderedDict[str, bool]" = OrderedDict()
+
+    def note_victim(self, fingerprint: str, replica: str) -> bool:
+        with self._lock:
+            victims = self._victims.get(fingerprint)
+            if victims is None:
+                victims = set()
+                self._victims[fingerprint] = victims
+                while len(self._victims) > self.capacity:
+                    self._victims.popitem(last=False)
+            self._victims.move_to_end(fingerprint)
+            victims.add(replica)
+            if len(victims) >= self.victim_limit \
+                    and fingerprint not in self._quarantined:
+                self._quarantined[fingerprint] = True
+                while len(self._quarantined) > self.capacity:
+                    self._quarantined.popitem(last=False)
+                return True
+            return False
+
+    def is_quarantined(self, fingerprint: str) -> bool:
+        with self._lock:
+            return fingerprint in self._quarantined
+
+    def victims(self, fingerprint: str) -> "list[str]":
+        with self._lock:
+            return sorted(self._victims.get(fingerprint, ()))
+
+    def evidence(self) -> dict:
+        """Deterministic state for the chaos artifact and statusz."""
+        with self._lock:
+            return {
+                "victim_limit": self.victim_limit,
+                "quarantined": sorted(self._quarantined),
+                "victims": {fp: sorted(v)
+                            for fp, v in sorted(self._victims.items())},
+            }
+
+
+class FailoverClient:
+    """Routes one tenant's solve to its rendezvous home with failover,
+    hedging and quarantine. Shares ONE retry budget across every replica
+    (amplification is a client-wide resource) and one breaker per
+    replica (health is per-replica)."""
+
+    HEDGE_HORIZON_S = 0.25    # slow-primary deadline before the one hedge
+    BREAKER_THRESHOLD = 3     # consecutive failures before fail-fast
+    BREAKER_RECOVERY_S = 10.0
+
+    def __init__(self, router, transports: "dict[str, Callable]",
+                 clock: "Optional[Clock]" = None, *,
+                 quarantine: "Optional[QuarantineRing]" = None,
+                 on_remap: "Optional[Callable[[str, str], None]]" = None,
+                 recorder=None, seed: int = 0,
+                 hedge_horizon_s: "Optional[float]" = None,
+                 budget: "Optional[RetryBudget]" = None):
+        self.router = router
+        self.transports = transports
+        self.clock = clock or Clock()
+        self.quarantine = quarantine or QuarantineRing()
+        # on_remap(tenant_id, new_replica): re-Sync the tenant's catalog
+        # on its new home before the solve proceeds (cold-start handling)
+        self.on_remap = on_remap
+        self.recorder = recorder
+        self.seed = seed
+        self.hedge_horizon_s = (hedge_horizon_s if hedge_horizon_s
+                                is not None else self.HEDGE_HORIZON_S)
+        self.budget = budget or RetryBudget()
+        self._lock = threading.Lock()
+        self._policies: "dict[str, RetryPolicy]" = {}
+        self._home: "dict[str, str]" = {}   # tenant -> last served replica
+        self.warm_state_losses = 0          # cold remaps observed
+
+    def _policy(self, replica: str) -> RetryPolicy:
+        """Per-replica resilience edge, built lazily: one breaker per
+        replica, the client-wide shared budget, FakeClock-safe (no real
+        sleeps are ever issued — failover re-routes, it never waits)."""
+        with self._lock:
+            policy = self._policies.get(replica)
+            if policy is None:
+                breaker = CircuitBreaker(
+                    f"replica:{replica}", clock=self.clock,
+                    failure_threshold=self.BREAKER_THRESHOLD,
+                    recovery_time=self.BREAKER_RECOVERY_S,
+                    recorder=self.recorder)
+                policy = RetryPolicy(
+                    f"replica:{replica}", clock=self.clock,
+                    seed=self.seed, budget=self.budget, breaker=breaker,
+                    sleep=lambda _delay: None)
+                self._policies[replica] = policy
+            return policy
+
+    # -- the solve path -----------------------------------------------------
+
+    def solve(self, tenant_id: str, request, timeout_s:
+              "Optional[float]" = None):
+        """One solve with failover. Raises RequestQuarantined (the shed),
+        FailoverExhausted, or LookupError on an empty fleet."""
+        fp = request_fingerprint(request)
+        if self.quarantine.is_quarantined(fp):
+            self._shed_quarantined(tenant_id, fp)
+        candidates = self.router.ranked(tenant_id)
+        if not candidates:
+            raise LookupError("fleet has no replicas")
+        hedge_spent = False
+        last_detail = "no replica attempted"
+        for i, replica in enumerate(candidates):
+            policy = self._policy(replica)
+            if i > 0 and not policy.try_retry():
+                # budget dry: give up NOW (overload control beats heroics)
+                raise FailoverExhausted(
+                    tenant_id, f"retry budget exhausted after {last_detail}")
+            breaker = policy.breaker
+            if not breaker.allow():
+                fleet_metrics.FAILOVER_REROUTES.inc(cause="breaker-open")
+                last_detail = f"replica {replica} breaker open"
+                continue
+            # the home attempt runs under the hedge horizon: a slow (not
+            # dead) primary times out there and the one hedge fires; the
+            # tighter of (caller deadline, horizon) applies
+            attempt_timeout = timeout_s
+            if i == 0 and not hedge_spent:
+                attempt_timeout = (self.hedge_horizon_s if timeout_s is None
+                                   else min(timeout_s, self.hedge_horizon_s))
+            try:
+                result = self.transports[replica](
+                    tenant_id, request, attempt_timeout)
+            except ReplicaCrashed as e:
+                policy.note_failure()
+                last_detail = str(e)
+                fleet_metrics.FAILOVER_REROUTES.inc(cause="crash")
+                if self._note_victim(tenant_id, fp, replica):
+                    self._shed_quarantined(tenant_id, fp)
+            except ReplicaTimeout as e:
+                policy.note_failure()
+                last_detail = str(e)
+                fleet_metrics.FAILOVER_REROUTES.inc(cause="timeout")
+                if i == 0 and not hedge_spent:
+                    # the tail hedge: one budgeted backup attempt, fired
+                    # only for the slow-primary case (metrics outcome is
+                    # judged when the backup resolves below)
+                    hedge_spent = True
+                    fleet_metrics.FAILOVER_HEDGES.inc(outcome="fired")
+                if self._note_victim(tenant_id, fp, replica):
+                    self._shed_quarantined(tenant_id, fp)
+            except ReplicaUnavailable as e:
+                # refused outright: the replica is down, the request is
+                # innocent — no victim note
+                policy.note_failure()
+                last_detail = str(e)
+                fleet_metrics.FAILOVER_REROUTES.inc(cause="unavailable")
+            else:
+                policy.note_success()
+                if hedge_spent and i == 1:
+                    fleet_metrics.FAILOVER_HEDGES.inc(outcome="win")
+                self._note_served(tenant_id, replica)
+                return result
+        raise FailoverExhausted(tenant_id, last_detail)
+
+    # -- internals ----------------------------------------------------------
+
+    def _note_victim(self, tenant_id: str, fp: str, replica: str) -> bool:
+        tripped = self.quarantine.note_victim(fp, replica)
+        if tripped:
+            fleet_metrics.FAILOVER_QUARANTINED.inc()
+            if self.recorder is not None:
+                self.recorder.warning(
+                    f"fleet/tenant/{tenant_id}", "RequestQuarantined",
+                    f"request {fp} quarantined after crashing/timing out "
+                    f"{self.quarantine.victim_limit} replicas: "
+                    f"{self.quarantine.victims(fp)}")
+        return tripped
+
+    def _shed_quarantined(self, tenant_id: str, fp: str) -> None:
+        """The quarantine shed: a DecisionRecord with a vocabulary
+        reason (explain plane), the fleet shed counters, then the
+        raise — the caller gets an explicit refusal, never a third
+        victim."""
+        now = self.clock.now()
+        note_shed(tenant_id, "failover", "poison-quarantine", ts=now)
+        tlabel = tenant_label(tenant_id)
+        fleet_metrics.SHED.inc(tenant=tlabel, where="failover")
+        fleet_metrics.TENANT_SHED.inc(tenant=tlabel, where="failover",
+                                      reason="poison-quarantine")
+        raise RequestQuarantined(tenant_id, fp)
+
+    def _note_served(self, tenant_id: str, replica: str) -> None:
+        prev = self._home.get(tenant_id)
+        if prev is not None and prev != replica:
+            # cold remap: the new home has neither the synced catalog nor
+            # the warm compiled programs — count the loss, re-Sync first
+            self.warm_state_losses += 1
+            fleet_metrics.FAILOVER_COLD_REMAPS.inc()
+            if self.on_remap is not None:
+                self.on_remap(tenant_id, replica)
+        self._home[tenant_id] = replica
+
+    def evidence(self) -> dict:
+        """Deterministic client state for the chaos artifact."""
+        with self._lock:
+            deps = sorted(self._policies)
+            budget = self.budget.evidence()
+            breakers = {d: self._policies[d].breaker.evidence()
+                        for d in deps}
+        return {
+            "budget": budget,
+            "breakers": breakers,
+            "warm_state_losses": self.warm_state_losses,
+            "quarantine": self.quarantine.evidence(),
+        }

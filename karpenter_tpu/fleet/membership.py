@@ -1,0 +1,400 @@
+"""Health-gated fleet membership: evidence drives the router, not config.
+
+The rendezvous router (router.py) gives stable tenant pinning, but a
+static member list means a dead replica keeps winning its tenants'
+hashes forever and a merely-slow replica quietly poisons fleet p99. The
+membership manager closes that gap: it owns the router's member set and
+mutates it only on detector evidence —
+
+* **K-missed-beats failure detector** — every `tick()` probes each
+  registered replica's health surface (a callable: `/healthz`, a gRPC
+  ping, or an in-process stub under FakeClock); ``MISSED_BEATS_K``
+  consecutive probe failures eject the replica from the router.
+* **latency-quantile gray-failure detector** — a replica that still
+  answers probes but whose recent ``GRAY_QUANTILE`` latency exceeds
+  ``GRAY_FACTOR`` x the median of its peers is ejected *before* it
+  drags fleet p99 up (gray failures kill tail latency long before they
+  kill health checks). Needs ``GRAY_MIN_SAMPLES`` observations and at
+  least one peer with samples — "slow" is relative, a fleet of one has
+  no baseline.
+* **monotone membership epochs** — every join/eject/recover bumps one
+  counter that never regresses; `/debug/fleetz` stamps it
+  (``FleetView.set_epoch_source``) so observers can order membership
+  views, and the chaos partition drill's ``membership-epoch-monotone``
+  invariant audits the full observed sequence.
+* **edge-triggered events** — ``ReplicaJoined`` / ``ReplicaEjected`` /
+  ``ReplicaRecovered`` through the shared EventRecorder, plus a
+  flight-recorder bundle at the ejection edge (the cycles that led to
+  an ejection are exactly the forensics a 3am page needs).
+
+An ejected replica keeps being probed (cheaply — probing is the
+manager's job precisely so the router never routes to test a corpse);
+``RECOVERY_PROBES`` consecutive successes re-admit it
+(``ReplicaRecovered``), and rendezvous hashing guarantees its old
+tenants — and only those — come home. A gray-ejected replica clears a
+higher bar: its recovery probes only count while the observed latency
+is back under the gray threshold — a slow replica still ANSWERS, so
+plain success-counting would flap it in and out forever.
+
+Strict no-op contract (chaos-invariant-enforced, like the profiling and
+explain planes): with the plane disabled (``KARPENTER_TPU_MEMBERSHIP=0``
+or :func:`set_enabled`), ``register()`` and ``tick()`` do NOTHING — no
+probes, no router mutation, no epoch movement, no metrics — so routing
+is bit-identical to the static-membership behavior and
+:func:`activity` counters stay frozen (invariants.check_membership_noop).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from collections import deque
+from typing import Callable, Optional
+
+from ..utils.clock import Clock
+from . import metrics as fleet_metrics
+
+# -- plane switch (explain/state.py idiom) ---------------------------------
+
+FLAG_ENV = "KARPENTER_TPU_MEMBERSHIP"
+_FALSY = ("0", "false", "off", "no")
+
+_state_lock = threading.Lock()
+_enabled = os.environ.get(FLAG_ENV, "1").strip().lower() not in _FALSY
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip the plane; returns the previous state (restore token)."""
+    global _enabled
+    with _state_lock:
+        prev = _enabled
+        _enabled = bool(on)
+        return prev
+
+
+@contextlib.contextmanager
+def disabled():
+    """Scoped hard-off: the chaos strict-noop drill."""
+    prev = set_enabled(False)
+    try:
+        yield
+    finally:
+        set_enabled(prev)
+
+
+# -- activity counters (the strict-noop evidence) --------------------------
+
+_activity_lock = threading.Lock()
+_ACTIVITY = {
+    "probes_total": 0,
+    "probe_failures_total": 0,
+    "transitions_total": 0,
+    "epoch_bumps_total": 0,
+}
+
+
+def activity() -> dict:
+    """Monotonic process-wide activity counters — the chaos
+    ``membership-strict-noop`` invariant diffs two of these."""
+    with _activity_lock:
+        return dict(_ACTIVITY)
+
+
+def _count(key: str, n: int = 1) -> None:
+    with _activity_lock:
+        _ACTIVITY[key] += n
+
+
+def _quantile(values: "list[float]", q: float) -> float:
+    """Nearest-rank quantile over a small latency window (no numpy: the
+    detector runs per heartbeat, the windows hold <= LATENCY_WINDOW
+    floats)."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, max(0, int(q * len(ordered))))
+    return ordered[idx]
+
+
+class _ReplicaHealth:
+    """Per-replica detector state. `probe` is the replica's health
+    surface: a callable returning the observed probe latency in seconds
+    (or any truthy/None success) and raising on failure — `/healthz`
+    over HTTP, a gRPC ping, or an in-process stub under FakeClock all
+    fit."""
+
+    __slots__ = ("name", "probe", "endpoint", "member", "ever_joined",
+                 "gray_ejected", "consecutive_misses",
+                 "consecutive_successes", "latencies")
+
+    def __init__(self, name: str, probe: "Callable[[], object]",
+                 endpoint=None, window: int = 16):
+        self.name = name
+        self.probe = probe
+        self.endpoint = endpoint  # optional FleetView replica duck object
+        self.member = False
+        self.ever_joined = False
+        self.gray_ejected = False  # last ejection was the gray detector's
+        self.consecutive_misses = 0
+        self.consecutive_successes = 0
+        self.latencies: "deque[float]" = deque(maxlen=window)
+
+
+class MembershipManager:
+    """Drives a FleetRouter's member set from probe evidence. All state
+    transitions happen inside `tick()` — callers (the operator's
+    reconcile loop, the chaos drill) decide the heartbeat cadence, the
+    manager decides membership."""
+
+    MISSED_BEATS_K = 3        # consecutive probe failures before ejection
+    RECOVERY_PROBES = 2       # consecutive successes before re-admission
+    LATENCY_WINDOW = 16       # recent probe latencies kept per replica
+    GRAY_QUANTILE = 0.9       # the replica-side tail the detector inspects
+    GRAY_FACTOR = 4.0         # ...ejected when > GRAY_FACTOR x peer median
+    GRAY_MIN_SAMPLES = 8      # observations before "slow" is believable
+
+    def __init__(self, router, clock: "Optional[Clock]" = None, *,
+                 view=None, recorder=None, flight_trigger=None,
+                 missed_beats_k: "Optional[int]" = None,
+                 recovery_probes: "Optional[int]" = None,
+                 gray_factor: "Optional[float]" = None,
+                 gray_min_samples: "Optional[int]" = None):
+        self.router = router
+        self.clock = clock or Clock()
+        # optional FleetView kept in lockstep: when both are wired, the
+        # view mirrors into the SAME router, so fleetz pinning and live
+        # routing can never disagree (fleetview.py docstring contract)
+        self.view = view
+        self.recorder = recorder
+        # flight_trigger(reason, detail) -> path|None; the operator wires
+        # flightrecorder.trigger so the ejection edge dumps a bundle
+        self.flight_trigger = flight_trigger
+        self.missed_beats_k = missed_beats_k or self.MISSED_BEATS_K
+        self.recovery_probes = recovery_probes or self.RECOVERY_PROBES
+        self.gray_factor = gray_factor or self.GRAY_FACTOR
+        self.gray_min_samples = gray_min_samples or self.GRAY_MIN_SAMPLES
+        self._lock = threading.Lock()
+        self._replicas: "dict[str, _ReplicaHealth]" = {}
+        self._epoch = 0
+        if self.view is not None:
+            self.view.set_epoch_source(self.epoch)
+
+    # -- registration -------------------------------------------------------
+
+    def register(self, name: str, probe: "Callable[[], object]",
+                 endpoint=None) -> None:
+        """Track a replica. It joins the router only after its FIRST
+        successful probe round (evidence-gated even at birth — a replica
+        that never answered a heartbeat never owned a tenant). With the
+        plane disabled this is a strict no-op: membership stays whatever
+        configuration put in the router."""
+        if not enabled():
+            return
+        with self._lock:
+            if name in self._replicas:
+                return
+            self._replicas[name] = _ReplicaHealth(
+                name, probe, endpoint=endpoint, window=self.LATENCY_WINDOW)
+
+    def forget(self, name: str) -> None:
+        """Administratively drop a replica (scale-in, not failure)."""
+        if not enabled():
+            return
+        with self._lock:
+            h = self._replicas.pop(name, None)
+        if h is not None and h.member:
+            self._transition_out(h, "forgotten", "administrative removal")
+
+    # -- the heartbeat ------------------------------------------------------
+
+    def tick(self) -> "list[dict]":
+        """One heartbeat round: probe every tracked replica, run both
+        detectors, mutate membership on edges. Returns the edge events
+        fired this round (drill ledger food); [] when disabled."""
+        if not enabled():
+            return []
+        with self._lock:
+            handles = [self._replicas[n] for n in sorted(self._replicas)]
+        # recovery bar for gray-ejected replicas: a gray casualty still
+        # ANSWERS probes — that is what made it gray — so successes only
+        # count toward re-admission once its probe latency is back under
+        # the same threshold that ejected it (else eject/rejoin flaps and
+        # the slow replica re-poisons p99 every RECOVERY_PROBES beats)
+        member_medians = [
+            _quantile(list(h.latencies), 0.5) for h in handles
+            if h.member and h.latencies]
+        gray_bar = (self.gray_factor * _quantile(member_medians, 0.5)
+                    if member_medians else None)
+        events: "list[dict]" = []
+        for h in handles:
+            _count("probes_total")
+            try:
+                latency = h.probe()
+            except Exception as e:  # noqa: BLE001 — a probe failure IS the signal
+                _count("probe_failures_total")
+                fleet_metrics.MEMBERSHIP_PROBES.inc(outcome="fail")
+                h.consecutive_misses += 1
+                h.consecutive_successes = 0
+                if h.member and h.consecutive_misses >= self.missed_beats_k:
+                    events.append(self._transition_out(
+                        h, "k-missed-beats",
+                        f"{h.consecutive_misses} consecutive missed "
+                        f"beats (K={self.missed_beats_k}): "
+                        f"{type(e).__name__}: {e}"))
+            else:
+                fleet_metrics.MEMBERSHIP_PROBES.inc(outcome="ok")
+                h.consecutive_misses = 0
+                if isinstance(latency, (int, float)):
+                    h.latencies.append(float(latency))
+                if not h.member:
+                    if h.gray_ejected and gray_bar is not None \
+                            and isinstance(latency, (int, float)) \
+                            and float(latency) > gray_bar:
+                        h.consecutive_successes = 0  # answering, still slow
+                    else:
+                        h.consecutive_successes += 1
+                        if h.consecutive_successes >= self.recovery_probes:
+                            events.append(self._transition_in(h))
+        events.extend(self._gray_pass())
+        self._sweep_gauges()
+        return events
+
+    def _gray_pass(self) -> "list[dict]":
+        """Eject at most ONE gray replica per tick (the worst offender):
+        mass ejection on a shared blip would trade a slow fleet for no
+        fleet."""
+        with self._lock:
+            members = [h for h in self._replicas.values() if h.member]
+        worst = None
+        worst_ratio = 0.0
+        for h in members:
+            if len(h.latencies) < self.gray_min_samples:
+                continue
+            peer_medians = [
+                _quantile(list(p.latencies), 0.5) for p in members
+                if p is not h and len(p.latencies) >= self.gray_min_samples]
+            if not peer_medians:
+                continue
+            peer_median = _quantile(peer_medians, 0.5)
+            if peer_median <= 0.0:
+                continue
+            tail = _quantile(list(h.latencies), self.GRAY_QUANTILE)
+            ratio = tail / peer_median
+            if ratio > self.gray_factor and ratio > worst_ratio:
+                worst, worst_ratio = h, ratio
+        if worst is None:
+            return []
+        tail = _quantile(list(worst.latencies), self.GRAY_QUANTILE)
+        return [self._transition_out(
+            worst, "gray-failure",
+            f"p{int(self.GRAY_QUANTILE * 100)} probe latency {tail:.4f}s "
+            f"is {worst_ratio:.1f}x the peer median "
+            f"(threshold {self.gray_factor:.1f}x)")]
+
+    # -- transitions (edge-triggered) ---------------------------------------
+
+    def _bump_epoch(self) -> int:
+        with self._lock:
+            self._epoch += 1
+            epoch = self._epoch
+        _count("epoch_bumps_total")
+        _count("transitions_total")
+        return epoch
+
+    def _transition_in(self, h: _ReplicaHealth) -> dict:
+        event = "ReplicaRecovered" if h.ever_joined else "ReplicaJoined"
+        h.member = True
+        h.ever_joined = True
+        h.gray_ejected = False
+        h.consecutive_successes = 0
+        # fresh member, fresh evidence: latencies observed while ejected
+        # (e.g. the slow tail that caused a gray ejection) must not
+        # instantly re-trip the detector on the replica's first beat back
+        h.latencies.clear()
+        epoch = self._bump_epoch()
+        if self.view is not None and h.endpoint is not None:
+            self.view.add_replica(h.endpoint)  # mirrors into the router
+        else:
+            self.router.add_replica(h.name)
+        fleet_metrics.MEMBERSHIP_TRANSITIONS.inc(
+            event="recovered" if event == "ReplicaRecovered" else "joined")
+        if self.recorder is not None:
+            self.recorder.normal(
+                f"fleet/{h.name}", event,
+                f"replica {h.name} admitted at membership epoch {epoch}")
+        return {"event": event, "replica": h.name, "epoch": epoch}
+
+    def _transition_out(self, h: _ReplicaHealth, reason: str,
+                        detail: str) -> dict:
+        h.member = False
+        h.gray_ejected = reason == "gray-failure"
+        h.consecutive_successes = 0
+        h.latencies.clear()  # stale latencies must not re-trip detectors
+        epoch = self._bump_epoch()
+        if self.view is not None:
+            self.view.remove_replica(h.name)  # mirrors into the router
+        else:
+            self.router.remove_replica(h.name)
+        fleet_metrics.MEMBERSHIP_TRANSITIONS.inc(event="ejected")
+        if self.recorder is not None:
+            self.recorder.warning(
+                f"fleet/{h.name}", "ReplicaEjected",
+                f"replica {h.name} ejected ({reason}) at membership "
+                f"epoch {epoch}: {detail}")
+        if self.flight_trigger is not None:
+            try:  # forensics must never break the ejection itself
+                self.flight_trigger(
+                    "fleet_replica_ejected", f"{h.name}: {reason}: {detail}")
+            except Exception:  # noqa: BLE001
+                pass
+        return {"event": "ReplicaEjected", "replica": h.name,
+                "reason": reason, "epoch": epoch}
+
+    def _sweep_gauges(self) -> None:
+        with self._lock:
+            member = sum(1 for h in self._replicas.values() if h.member)
+            total = len(self._replicas)
+            epoch = self._epoch
+        fleet_metrics.MEMBERSHIP_EPOCH.set(epoch)
+        fleet_metrics.MEMBERSHIP_REPLICAS.set(member, state="member")
+        fleet_metrics.MEMBERSHIP_REPLICAS.set(total - member, state="ejected")
+
+    # -- read side ----------------------------------------------------------
+
+    def epoch(self) -> int:
+        """The monotone membership epoch (FleetView's epoch source)."""
+        with self._lock:
+            return self._epoch
+
+    def members(self) -> "list[str]":
+        with self._lock:
+            return sorted(n for n, h in self._replicas.items() if h.member)
+
+    def snapshot(self) -> dict:
+        """Deterministic detector state for statusz/fleetz and the chaos
+        drill artifact."""
+        with self._lock:
+            rows = {
+                n: {
+                    "member": h.member,
+                    "consecutive_misses": h.consecutive_misses,
+                    "latency_p50": round(
+                        _quantile(list(h.latencies), 0.5), 6),
+                    "latency_p90": round(
+                        _quantile(list(h.latencies), 0.9), 6),
+                    "samples": len(h.latencies),
+                }
+                for n, h in sorted(self._replicas.items())
+            }
+            return {
+                "enabled": enabled(),
+                "epoch": self._epoch,
+                "missed_beats_k": self.missed_beats_k,
+                "gray_factor": self.gray_factor,
+                "replicas": rows,
+            }

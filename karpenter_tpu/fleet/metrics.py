@@ -42,7 +42,8 @@ SHED = REGISTRY.counter(
     "karpenter_fleet_shed_total",
     "Requests shed without compute, by tenant and where the shed happened "
     "(admission = remaining deadline budget could not survive the next "
-    "tick; queue = the budget expired while enqueued).",
+    "tick; queue = the budget expired while enqueued; failover = the "
+    "request is poison-quarantined).",
     ("tenant", "where"))
 
 MEGA_SOLVES = REGISTRY.counter(
@@ -92,6 +93,71 @@ TENANT_SHED = REGISTRY.counter(
     "(admission/queue) and reason. The chaos storm's shed-attribution "
     "invariant reconciles this family against frontend totals.",
     ("tenant", "where", "reason"))
+
+# -- membership plane (fleet/membership.py) --------------------------------
+# All labels below are code-enumerable or bounded by fleet size (replica
+# counts are deployment config, not tenant-scale), so none need the guard.
+
+MEMBERSHIP_EPOCH = REGISTRY.gauge(
+    "karpenter_fleet_membership_epoch",
+    "The monotone membership epoch: bumped on every evidence-driven "
+    "join/eject/recover. Observers order membership views by it "
+    "(/debug/fleetz stamps the same source); it NEVER regresses — the "
+    "chaos partition drill's membership-epoch-monotone invariant.")
+
+MEMBERSHIP_REPLICAS = REGISTRY.gauge(
+    "karpenter_fleet_membership_replicas",
+    "Tracked replicas by membership state (member = in the router's "
+    "rendezvous set; ejected = failed a detector, still probed for "
+    "recovery).",
+    ("state",))
+
+MEMBERSHIP_PROBES = REGISTRY.counter(
+    "karpenter_fleet_membership_probes_total",
+    "Heartbeat probes by outcome (ok/fail). The K-missed-beats detector "
+    "ejects a replica after MISSED_BEATS_K consecutive failures; a "
+    "sustained fail rate with no ejection means detection is wedged.",
+    ("outcome",))
+
+MEMBERSHIP_TRANSITIONS = REGISTRY.counter(
+    "karpenter_fleet_membership_transitions_total",
+    "Edge-triggered membership transitions (joined/ejected/recovered). "
+    "Ejections fire a ReplicaEjected event and a flight-recorder bundle; "
+    "a joined/recovered edge means rendezvous routing just remapped "
+    "~1/R of tenants.",
+    ("event",))
+
+# -- failover plane (fleet/failover.py) ------------------------------------
+
+FAILOVER_REROUTES = REGISTRY.counter(
+    "karpenter_fleet_failover_reroutes_total",
+    "Client-side failover hops past a replica, by cause (unavailable = "
+    "connection refused; timeout = deadline/blackhole; crash = the "
+    "request killed its server; breaker-open = failed fast without a "
+    "socket). Every hop beyond the first attempt is charged to the "
+    "shared retry budget.",
+    ("cause",))
+
+FAILOVER_HEDGES = REGISTRY.counter(
+    "karpenter_fleet_failover_hedges_total",
+    "Tail hedges by outcome (fired = the home replica timed out at the "
+    "hedge horizon and the one budgeted backup launched; win = that "
+    "backup served the request). At most one hedge per request.",
+    ("outcome",))
+
+FAILOVER_QUARANTINED = REGISTRY.counter(
+    "karpenter_fleet_failover_quarantined_total",
+    "Requests quarantined as poison pills: implicated in crashing or "
+    "timing out VICTIM_LIMIT distinct replicas. Each is shed with "
+    "reason \"poison-quarantine\" (a shed DecisionRecord in the explain "
+    "plane) instead of hunting further victims.")
+
+FAILOVER_COLD_REMAPS = REGISTRY.counter(
+    "karpenter_fleet_failover_cold_remaps_total",
+    "Tenants served by a replica other than their previous home: the "
+    "new home held neither the synced catalog nor warm compiled "
+    "programs (warm-state loss; the on_remap hook re-Syncs before the "
+    "solve). Expect ~1/R of active tenants per replica death.")
 
 # Guarded tenant families: an eviction from the top-K folds each of these
 # families' evicted series into the rollup (counters/histograms merge,

@@ -22,6 +22,7 @@ controllers would route the same tenant to different replicas.
 
 from __future__ import annotations
 
+import bisect
 import hashlib
 from typing import Iterable, Optional
 
@@ -41,19 +42,23 @@ class FleetRouter:
     name so the choice stays deterministic across processes."""
 
     def __init__(self, replicas: Iterable[str] = ()):
+        # kept sorted at mutation time (bisect.insort): route() runs once
+        # per request, membership changes run once per epoch — sorting on
+        # the hot path was pure waste, and the scan order doesn't affect
+        # the winner anyway (max with a total-order key)
         self._replicas: "list[str]" = []
         for r in replicas:
             self.add_replica(r)
 
     @property
     def replicas(self) -> "tuple[str, ...]":
-        return tuple(sorted(self._replicas))
+        return tuple(self._replicas)
 
     def add_replica(self, replica: str) -> None:
         if not replica:
             raise ValueError("replica name must be non-empty")
         if replica not in self._replicas:
-            self._replicas.append(replica)
+            bisect.insort(self._replicas, replica)
 
     def remove_replica(self, replica: str) -> None:
         if replica in self._replicas:
@@ -64,8 +69,18 @@ class FleetRouter:
         routing nowhere is a caller decision, not a silent default."""
         if not self._replicas:
             raise LookupError("fleet has no replicas")
-        return max(sorted(self._replicas),
+        return max(self._replicas,
                    key=lambda r: (_score(tenant_id, r), r))
+
+    def ranked(self, tenant_id: str) -> "list[str]":
+        """Every replica in descending rendezvous preference for the
+        tenant. ranked()[0] == route(); ranked()[1] is the failover
+        client's next choice when the home replica is down — exactly the
+        replica the tenant would remap to if the home left the set, so a
+        client-side reroute and a membership-driven remap always agree."""
+        return sorted(self._replicas,
+                      key=lambda r: (_score(tenant_id, r), r),
+                      reverse=True)
 
     def route_or_none(self, tenant_id: str) -> Optional[str]:
         return self.route(tenant_id) if self._replicas else None

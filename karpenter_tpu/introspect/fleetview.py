@@ -39,13 +39,22 @@ import urllib.error
 import urllib.request
 from typing import Callable, Optional
 
+from ..resilience import CircuitBreaker
 from ..tracing import TRACER, Tracer
+from ..utils.clock import Clock
 
 FLEETZ_SCHEMA_VERSION = 1
 
 # fan-out budget per replica fetch; a wedged replica costs one timeout,
 # not a hung fleetz
 DEFAULT_TIMEOUT_S = 2.0
+
+# per-replica probe breaker: after PROBE_FAILURE_THRESHOLD consecutive
+# statusz failures the fetch is suppressed for PROBE_BACKOFF_S (then one
+# half-open probe at a time) — a dead replica costs fleetz one timeout
+# per backoff window, not DEFAULT_TIMEOUT_S on EVERY snapshot forever
+PROBE_FAILURE_THRESHOLD = 3
+PROBE_BACKOFF_S = 30.0
 
 
 class LocalReplica:
@@ -113,16 +122,29 @@ class FleetView:
     routes traffic, so the joined view can never disagree with routing."""
 
     def __init__(self, router=None, name: str = "fleet",
-                 tracer: "Optional[Tracer]" = None):
+                 tracer: "Optional[Tracer]" = None,
+                 clock: "Optional[Clock]" = None):
         self.router = router
         self.name = name
         # the CLIENT-side ring: where the fleet frontend's queue-wait and
         # rpc spans live (the other half of every federated trace)
         self.tracer = tracer if tracer is not None else TRACER
+        self.clock = clock or Clock()
         self._lock = threading.Lock()
         self._replicas: "dict[str, object]" = {}
         self._joined_epoch: "dict[str, int]" = {}
         self._epoch = 0
+        # health-gated membership (fleet/membership.py) is the epoch
+        # authority when wired: fleetz stamps ITS monotone epoch so every
+        # observer orders membership views off one source
+        self._epoch_source: "Optional[Callable[[], int]]" = None
+        self._probe_breakers: "dict[str, CircuitBreaker]" = {}
+        self._consec_failures: "dict[str, int]" = {}
+
+    def set_epoch_source(self, source: "Callable[[], int]") -> None:
+        """Delegate the fleetz membership epoch to an external monotone
+        counter (the MembershipManager's)."""
+        self._epoch_source = source
 
     # -- membership ------------------------------------------------------------
 
@@ -140,6 +162,8 @@ class FleetView:
                 self._epoch += 1
             self._replicas.pop(name, None)
             self._joined_epoch.pop(name, None)
+            self._probe_breakers.pop(name, None)
+            self._consec_failures.pop(name, None)
         if self.router is not None:
             try:
                 self.router.remove_replica(name)
@@ -152,24 +176,58 @@ class FleetView:
 
     # -- fleetz ----------------------------------------------------------------
 
+    def _probe_breaker(self, name: str) -> CircuitBreaker:
+        """Callers hold self._lock or run single-threaded (fleetz)."""
+        br = self._probe_breakers.get(name)
+        if br is None:
+            br = CircuitBreaker(
+                f"fleetz:{name}", clock=self.clock,
+                failure_threshold=PROBE_FAILURE_THRESHOLD,
+                recovery_time=PROBE_BACKOFF_S)
+            self._probe_breakers[name] = br
+        return br
+
     def _replica_summary(self, replica) -> dict:
         """One replica's row: fetched + fenced. The summary extracts the
         triage-relevant subset of statusz (full snapshots federate badly
         — N x 100KB joins help nobody) and keeps the raw sections it
-        came from discoverable by name."""
+        came from discoverable by name. A replica that keeps failing is
+        probed through a breaker: PROBE_FAILURE_THRESHOLD consecutive
+        failures suppress the fetch until the backoff window lapses, so
+        a corpse never costs every snapshot a full timeout."""
+        name = replica.name
+        breaker = self._probe_breaker(name)
+        fails = self._consec_failures.get(name, 0)
+        if not breaker.allow():
+            return {"healthy": False,
+                    "error": f"probe suppressed ({fails} consecutive "
+                             f"failures; retry after "
+                             f"{PROBE_BACKOFF_S:.0f}s backoff)",
+                    "probe_suppressed": True,
+                    "consecutive_failures": fails}
         try:
             snap = replica.statusz()
         except Exception as e:  # noqa: BLE001 — a dead replica is a row, not an outage
-            return {"healthy": False, "error": f"{type(e).__name__}: {e}"}
+            breaker.record_failure()
+            self._consec_failures[name] = fails + 1
+            return {"healthy": False, "error": f"{type(e).__name__}: {e}",
+                    "consecutive_failures": fails + 1}
+        # the transport answered: the backoff targets timeout burn, so a
+        # reachable replica with a degraded payload still resets it
+        breaker.record_success()
+        self._consec_failures[name] = 0
         if not snap:
-            return {"healthy": False, "error": "no statusz"}
+            return {"healthy": False, "error": "no statusz",
+                    "consecutive_failures": 0}
         if "error" in snap and len(snap) == 1:
-            return {"healthy": False, "error": snap["error"]}
+            return {"healthy": False, "error": snap["error"],
+                    "consecutive_failures": 0}
         out = {
             "healthy": True,
             "schema": snap.get("schema"),
             "version": snap.get("version"),
             "ts": snap.get("ts"),
+            "consecutive_failures": 0,
         }
         watchdog = (snap.get("resilience") or {}).get("watchdog")
         if isinstance(watchdog, dict):
@@ -216,6 +274,8 @@ class FleetView:
             replicas = dict(self._replicas)
             joined = dict(self._joined_epoch)
             epoch = self._epoch
+        if self._epoch_source is not None:
+            epoch = self._epoch_source()
         rows = {name: self._replica_summary(r)
                 for name, r in sorted(replicas.items())}
         for name, row in rows.items():

@@ -263,7 +263,7 @@ class Operator:
         from .introspect.fleetview import FleetView, LocalReplica
 
         self.fleetview = FleetView(name=os.environ.get(
-            "KARPENTER_TPU_REPLICA_NAME", "self"))
+            "KARPENTER_TPU_REPLICA_NAME", "self"), clock=self.clock)
         self.fleetview.add_replica(LocalReplica(
             self.fleetview.name,
             statusz=lambda: _statusz.snapshot(self)))

@@ -1,0 +1,632 @@
+"""Fleet membership & failover plane tests (karpenter_tpu/fleet/
+membership.py + failover.py): router sorted-at-insert (zero sorts on the
+route hot path, deterministic tie-break), blast-radius property over
+1000 tenants, the K-missed-beats and gray-failure detectors with their
+recovery gates, monotone epochs into fleetz, client failover through
+breakers and the shared budget, bounded hedging, poison-pill quarantine
+with its shed DecisionRecord, the fleetz probe backoff, falsifiability
+of all four partition-drill invariants, and the drill itself (FakeClock
+smoke in tier 1, a real subprocess under the slow marker).
+"""
+
+import builtins
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from karpenter_tpu.chaos import invariants
+from karpenter_tpu.chaos.runner import ChaosRunner
+from karpenter_tpu.fleet import (FailoverClient, FailoverExhausted,
+                                 FleetRouter, MembershipManager,
+                                 QuarantineRing, ReplicaCrashed,
+                                 ReplicaTimeout, ReplicaUnavailable,
+                                 RequestQuarantined, request_fingerprint)
+from karpenter_tpu.fleet import membership
+from karpenter_tpu.fleet import router as router_mod
+from karpenter_tpu.resilience import RetryBudget
+from karpenter_tpu.utils.clock import FakeClock
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- router: sorted-at-insert ----------------------------------------------
+
+
+class TestRouterHotPath:
+    def test_route_never_sorts(self, monkeypatch):
+        """10k routes, zero sorted() calls: membership mutations sort (at
+        insert, via bisect), the per-request path only scans with max."""
+        router = FleetRouter([f"replica-{i}" for i in range(8)])
+        calls = {"n": 0}
+        real_sorted = builtins.sorted
+
+        def counting(*args, **kwargs):
+            calls["n"] += 1
+            return real_sorted(*args, **kwargs)
+
+        monkeypatch.setattr(builtins, "sorted", counting)
+        for i in range(10_000):
+            router.route(f"tenant-{i}")
+        monkeypatch.undo()
+        assert calls["n"] == 0
+
+    def test_replicas_sorted_at_insert(self):
+        router = FleetRouter()
+        for name in ("r3", "r1", "r9", "r2"):
+            router.add_replica(name)
+        assert router.replicas == ("r1", "r2", "r3", "r9")
+        router.add_replica("r0")
+        assert router.replicas == ("r0", "r1", "r2", "r3", "r9")
+
+    def test_duplicate_score_tie_break_is_deterministic(self, monkeypatch):
+        """With every score forced equal, the name breaks the tie — the
+        same way regardless of insertion order (cryptographic collisions
+        are negligible but the contract must not depend on luck)."""
+        monkeypatch.setattr(router_mod, "_score", lambda t, r: 7)
+        a = FleetRouter(["r1", "r2", "r3"])
+        b = FleetRouter(["r3", "r1", "r2"])
+        assert a.route("t") == b.route("t") == "r3"
+        assert a.ranked("t") == b.ranked("t") == ["r3", "r2", "r1"]
+
+    def test_ranked_head_is_route(self):
+        router = FleetRouter([f"replica-{i}" for i in range(5)])
+        for i in range(50):
+            tenant = f"tenant-{i}"
+            ranked = router.ranked(tenant)
+            assert ranked[0] == router.route(tenant)
+            assert sorted(ranked) == list(router.replicas)
+
+
+class TestBlastRadius:
+    def test_remove_remaps_exactly_the_lost_replicas_tenants(self):
+        """1000 tenants / 5 replicas: removing one remaps exactly its own
+        tenants (each to its next ranked choice), and rejoin restores the
+        assignment bit-identically."""
+        replicas = [f"replica-{i}" for i in range(5)]
+        tenants = [f"tenant-{i:04d}" for i in range(1000)]
+        router = FleetRouter(replicas)
+        before = router.assignment(tenants)
+        next_choice = {t: router.ranked(t)[1] for t in tenants}
+
+        lost = replicas[2]
+        router.remove_replica(lost)
+        after = router.assignment(tenants)
+        moved = {t for t in tenants if before[t] != after[t]}
+        assert moved == {t for t in tenants if before[t] == lost}
+        # a sane spread: ~1/5 of tenants lived there
+        assert 100 < len(moved) < 300
+        for t in moved:
+            assert after[t] == next_choice[t]
+        assert not invariants.check_remap_blast_radius(
+            before, after, {lost})
+
+        router.add_replica(lost)
+        assert router.assignment(tenants) == before
+
+
+# -- membership: detectors, epochs, events ---------------------------------
+
+
+class _Probe:
+    """Scriptable health surface: latency-returning success or raise."""
+
+    def __init__(self, latency=0.001):
+        self.latency = latency
+        self.fail = False
+
+    def __call__(self):
+        if self.fail:
+            raise RuntimeError("probe: connection refused")
+        return self.latency
+
+
+def make_manager(n=3, **kw):
+    clock = FakeClock()
+    router = FleetRouter()
+    manager = MembershipManager(router, clock=clock, **kw)
+    probes = {}
+    for i in range(n):
+        name = f"replica-{i}"
+        probes[name] = _Probe()
+        manager.register(name, probes[name])
+    return manager, router, probes, clock
+
+
+class TestMembership:
+    def test_join_is_evidence_gated(self):
+        manager, router, _, _ = make_manager(3)
+        assert router.replicas == ()  # registered, never probed: no member
+        events = manager.tick()
+        assert events == [] and router.replicas == ()
+        events = manager.tick()  # RECOVERY_PROBES=2 consecutive successes
+        assert sorted(e["event"] for e in events) == ["ReplicaJoined"] * 3
+        assert len(router.replicas) == 3
+        assert manager.members() == sorted(router.replicas)
+
+    def test_k_missed_beats_ejects_then_recovery_readmits(self):
+        manager, router, probes, _ = make_manager(3)
+        for _ in range(2):
+            manager.tick()
+        probes["replica-1"].fail = True
+        ejections = []
+        for _ in range(MembershipManager.MISSED_BEATS_K):
+            ejections += [e for e in manager.tick()
+                          if e["event"] == "ReplicaEjected"]
+        assert [e["replica"] for e in ejections] == ["replica-1"]
+        assert ejections[0]["reason"] == "k-missed-beats"
+        assert "replica-1" not in router.replicas
+        # one beat short must NOT have ejected: exactly K, not K-1
+        snap = manager.snapshot()
+        assert snap["replicas"]["replica-1"]["member"] is False
+
+        probes["replica-1"].fail = False
+        recovered = []
+        for _ in range(MembershipManager.RECOVERY_PROBES):
+            recovered += [e for e in manager.tick()
+                          if e["event"] == "ReplicaRecovered"]
+        assert [e["replica"] for e in recovered] == ["replica-1"]
+        assert "replica-1" in router.replicas
+
+    def test_gray_failure_ejected_and_gated_on_recovery(self):
+        manager, router, probes, _ = make_manager(3)
+        for _ in range(MembershipManager.GRAY_MIN_SAMPLES + 2):
+            manager.tick()  # fill every latency window with fast beats
+        probes["replica-2"].latency = 0.05  # ~50x the peers
+        ejections = []
+        for _ in range(4):
+            ejections += [e for e in manager.tick()
+                          if e.get("reason") == "gray-failure"]
+        assert [e["replica"] for e in ejections] == ["replica-2"]
+        assert "replica-2" not in router.replicas
+
+        # still slow: probe SUCCESSES must not re-admit it (no flapping)
+        for _ in range(6):
+            assert not [e for e in manager.tick()
+                        if e["event"] == "ReplicaRecovered"]
+        assert "replica-2" not in router.replicas
+
+        # healed: back under the gray bar, recovery proceeds
+        probes["replica-2"].latency = 0.001
+        recovered = []
+        for _ in range(MembershipManager.RECOVERY_PROBES + 1):
+            recovered += [e for e in manager.tick()
+                          if e["event"] == "ReplicaRecovered"]
+        assert [e["replica"] for e in recovered] == ["replica-2"]
+
+    def test_gray_needs_a_peer_baseline(self):
+        """A fleet of one has no 'slow': the gray detector never fires
+        without at least one peer carrying samples."""
+        manager, router, probes, _ = make_manager(1)
+        probes["replica-0"].latency = 10.0
+        for _ in range(MembershipManager.GRAY_MIN_SAMPLES + 4):
+            events = manager.tick()
+            assert not [e for e in events if e.get("reason") ==
+                        "gray-failure"]
+        assert "replica-0" in router.replicas
+
+    def test_epochs_are_monotone_and_observed_by_fleetz(self):
+        from karpenter_tpu.introspect.fleetview import FleetView
+
+        manager, router, probes, _ = make_manager(3)
+        view = FleetView(name="t")
+        view.set_epoch_source(manager.epoch)
+        epochs = [manager.epoch()]
+        for _ in range(2):
+            manager.tick()
+            epochs.append(manager.epoch())
+        assert view.fleetz()["membership_epoch"] == manager.epoch() == 3
+        probes["replica-0"].fail = True
+        for _ in range(MembershipManager.MISSED_BEATS_K):
+            manager.tick()
+            epochs.append(manager.epoch())
+        probes["replica-0"].fail = False
+        for _ in range(MembershipManager.RECOVERY_PROBES):
+            manager.tick()
+            epochs.append(manager.epoch())
+        assert not invariants.check_epoch_monotone(epochs)
+        assert epochs[-1] == 5  # 3 joins + 1 eject + 1 recover
+        assert view.fleetz()["membership_epoch"] == 5
+
+    def test_flight_trigger_fires_at_the_ejection_edge(self):
+        triggers = []
+        clock = FakeClock()
+        router = FleetRouter()
+        manager = MembershipManager(
+            router, clock=clock,
+            flight_trigger=lambda reason, detail:
+                triggers.append((reason, detail)))
+        probe = _Probe()
+        manager.register("replica-0", probe)
+        for _ in range(2):
+            manager.tick()
+        assert triggers == []  # joins are not forensic events
+        probe.fail = True
+        for _ in range(MembershipManager.MISSED_BEATS_K):
+            manager.tick()
+        assert len(triggers) == 1
+        assert triggers[0][0] == "fleet_replica_ejected"
+        assert "k-missed-beats" in triggers[0][1]
+
+    def test_disabled_plane_is_a_strict_noop(self):
+        router = FleetRouter([f"replica-{i}" for i in range(3)])
+        tenants = [f"tenant-{i}" for i in range(64)]
+        before_assign = router.assignment(tenants)
+        with membership.disabled():
+            before = membership.activity()
+            manager = MembershipManager(router, clock=FakeClock())
+            probe = _Probe()
+            probe.fail = True  # a dead probe that must never be consulted
+            manager.register("replica-0", probe)
+            events = []
+            for _ in range(6):
+                events.extend(manager.tick())
+            after = membership.activity()
+        assert events == []
+        assert after == before
+        assert router.assignment(tenants) == before_assign
+        assert manager.epoch() == 0
+        assert not invariants.check_membership_noop(
+            {"enabled": False, "before": before, "after": after})
+
+
+# -- client failover --------------------------------------------------------
+
+
+class _Script:
+    """Scriptable transport for one replica: raises the scripted failure
+    class, else serves. Records (replica, timeout_s) per attempt."""
+
+    def __init__(self, name, log):
+        self.name = name
+        self.log = log
+        self.failure = None   # exception CLASS or None
+
+    def __call__(self, tenant_id, request, timeout_s):
+        self.log.append((self.name, timeout_s))
+        if self.failure is not None:
+            raise self.failure(self.name, "scripted")
+        return {"replica": self.name}
+
+
+def make_client(n=3, **kw):
+    names = [f"replica-{i}" for i in range(n)]
+    router = FleetRouter(names)
+    log = []
+    scripts = {name: _Script(name, log) for name in names}
+    client = FailoverClient(router, dict(scripts), clock=FakeClock(), **kw)
+    return client, router, scripts, log
+
+
+class TestFailoverClient:
+    def test_reroutes_to_next_ranked_on_unavailable(self):
+        client, router, scripts, log = make_client()
+        ranked = router.ranked("tenant-a")
+        scripts[ranked[0]].failure = ReplicaUnavailable
+        out = client.solve("tenant-a", {"pods": 1})
+        assert out["replica"] == ranked[1]
+        assert [r for r, _ in log] == ranked[:2]
+        # a refused connection indicts the replica, never the request
+        assert client.quarantine.victims(
+            request_fingerprint({"pods": 1})) == []
+
+    def test_hedge_horizon_bounds_the_home_attempt(self):
+        client, router, scripts, log = make_client()
+        ranked = router.ranked("tenant-a")
+        scripts[ranked[0]].failure = ReplicaTimeout
+        out = client.solve("tenant-a", {"pods": 2}, timeout_s=5.0)
+        assert out["replica"] == ranked[1]
+        # home ran under the hedge horizon, the hedge under the caller's
+        # full deadline
+        assert log[0] == (ranked[0], client.hedge_horizon_s)
+        assert log[1] == (ranked[1], 5.0)
+
+    def test_two_timeout_victims_quarantine_the_request(self):
+        client, router, scripts, _ = make_client()
+        for s in scripts.values():
+            s.failure = ReplicaTimeout
+        with pytest.raises(RequestQuarantined):
+            client.solve("tenant-a", {"pods": 3}, timeout_s=5.0)
+        fp = request_fingerprint({"pods": 3})
+        assert client.quarantine.victims(fp) == sorted(
+            router.ranked("tenant-a")[:2])
+
+    def test_poison_quarantined_after_exactly_two_crashes(self):
+        client, router, scripts, log = make_client()
+        for s in scripts.values():
+            s.failure = ReplicaCrashed
+        request = {"poison": True}
+        with pytest.raises(RequestQuarantined):
+            client.solve("tenant-a", request)
+        # exactly two victims, the third candidate never contacted
+        assert len(log) == 2
+        fp = request_fingerprint(request)
+        assert client.quarantine.is_quarantined(fp)
+        assert len(client.quarantine.victims(fp)) == 2
+        # resubmission sheds at the door: zero transport calls
+        with pytest.raises(RequestQuarantined):
+            client.solve("tenant-b", request)
+        assert len(log) == 2
+
+    def test_quarantine_shed_lands_as_a_decision_record(self):
+        from karpenter_tpu import explain
+
+        client, _, scripts, _ = make_client()
+        for s in scripts.values():
+            s.failure = ReplicaCrashed
+        prev = explain.set_enabled(True)
+        try:
+            before = explain.activity()["sheds_total"]
+            with pytest.raises(RequestQuarantined):
+                client.solve("tenant-a", {"poison": "yes"})
+            assert explain.activity()["sheds_total"] == before + 1
+            rec = explain.DECISIONS.records(kind="shed")[-1]
+            assert rec["reason"] == "poison-quarantine"
+            assert rec["where"] == "failover"
+            assert rec["reason"] in explain.SHED_REASONS
+        finally:
+            explain.set_enabled(prev)
+
+    def test_breaker_fails_known_dead_replica_fast(self):
+        client, router, scripts, log = make_client()
+        ranked = router.ranked("tenant-a")
+        scripts[ranked[0]].failure = ReplicaUnavailable
+        for _ in range(FailoverClient.BREAKER_THRESHOLD):
+            client.solve("tenant-a", {"pods": 4})
+        del log[:]
+        out = client.solve("tenant-a", {"pods": 4})
+        assert out["replica"] == ranked[1]
+        assert [r for r, _ in log] == [ranked[1]]  # home skipped, not dialed
+
+    def test_budget_exhaustion_gives_up_not_retries(self):
+        client, router, scripts, log = make_client(
+            budget=RetryBudget(capacity=1.0, refill_per_success=0.0))
+        for s in scripts.values():
+            s.failure = ReplicaUnavailable
+        with pytest.raises(FailoverExhausted) as e:
+            client.solve("tenant-a", {"pods": 5})
+        assert "budget" in str(e.value)
+        assert len(log) == 2  # home + the single budgeted reroute
+
+    def test_cold_remap_counts_loss_and_resyncs(self):
+        remaps = []
+        client, router, scripts, _ = make_client(
+            on_remap=lambda tenant, replica: remaps.append(
+                (tenant, replica)))
+        ranked = router.ranked("tenant-a")
+        client.solve("tenant-a", {"pods": 6})
+        assert client.warm_state_losses == 0  # first home is not a remap
+        scripts[ranked[0]].failure = ReplicaUnavailable
+        client.solve("tenant-a", {"pods": 6})
+        assert client.warm_state_losses == 1
+        assert remaps == [("tenant-a", ranked[1])]
+        scripts[ranked[0]].failure = None
+        client.solve("tenant-a", {"pods": 6})  # comes home: another remap
+        assert client.warm_state_losses == 2
+        assert remaps[-1] == ("tenant-a", ranked[0])
+
+    def test_no_sleep_anywhere_in_the_failover_loop(self):
+        """Failover re-routes, it never waits: the retry policies are
+        built with a no-op sleep so FakeClock tests can't deadlock and
+        the no-adhoc-retry discipline holds by construction."""
+        client, _, scripts, _ = make_client()
+        for s in scripts.values():
+            s.failure = ReplicaUnavailable
+        t0 = client.clock.now()
+        with pytest.raises(FailoverExhausted):
+            client.solve("tenant-a", {"pods": 7})
+        assert client.clock.now() == t0
+
+    def test_evidence_is_deterministic_shape(self):
+        client, _, scripts, _ = make_client()
+        client.solve("tenant-a", {"pods": 8})
+        ev = client.evidence()
+        assert set(ev) == {"budget", "breakers", "warm_state_losses",
+                           "quarantine"}
+        assert ev["quarantine"]["victim_limit"] == 2
+
+
+class TestQuarantineRing:
+    def test_trips_exactly_once_on_the_second_distinct_victim(self):
+        ring = QuarantineRing()
+        assert ring.note_victim("fp", "r1") is False
+        assert ring.note_victim("fp", "r1") is False  # same replica: no-op
+        assert ring.note_victim("fp", "r2") is True   # the trip, exactly once
+        assert ring.note_victim("fp", "r3") is False  # already quarantined
+        assert ring.is_quarantined("fp")
+
+    def test_capacity_bounds_the_ring(self):
+        ring = QuarantineRing(capacity=4)
+        for i in range(10):
+            ring.note_victim(f"fp{i}", "r1")
+        assert len(ring.evidence()["victims"]) == 4
+
+
+# -- fleetz probe backoff ----------------------------------------------------
+
+
+class TestFleetviewBackoff:
+    def test_dead_replica_probe_is_suppressed_then_retried(self):
+        from karpenter_tpu.introspect.fleetview import (
+            PROBE_BACKOFF_S, PROBE_FAILURE_THRESHOLD, FleetView,
+            LocalReplica)
+
+        clock = FakeClock()
+        view = FleetView(name="t", clock=clock)
+        state = {"up": False}
+
+        def statusz():
+            if not state["up"]:
+                raise ConnectionError("refused")
+            return {"schema": 1, "version": "t", "ts": clock.now()}
+
+        view.add_replica(LocalReplica("replica-0", statusz=statusz))
+        for i in range(PROBE_FAILURE_THRESHOLD):
+            row = view.fleetz()["replicas"]["replica-0"]
+            assert row["healthy"] is False
+            assert row["consecutive_failures"] == i + 1
+            assert "probe_suppressed" not in row
+        # threshold reached: the fetch itself is now suppressed
+        row = view.fleetz()["replicas"]["replica-0"]
+        assert row["probe_suppressed"] is True
+        assert row["consecutive_failures"] == PROBE_FAILURE_THRESHOLD
+        # after the backoff window one probe goes through; the replica is
+        # back, so the row heals and the failure streak resets
+        state["up"] = True
+        clock.step(PROBE_BACKOFF_S + 1.0)
+        row = view.fleetz()["replicas"]["replica-0"]
+        assert row["healthy"] is True
+        assert row["consecutive_failures"] == 0
+
+    def test_healthy_replica_rows_carry_zero_streak(self):
+        from karpenter_tpu.introspect.fleetview import (FleetView,
+                                                        LocalReplica)
+
+        view = FleetView(name="t", clock=FakeClock())
+        view.add_replica(LocalReplica(
+            "replica-0", statusz=lambda: {"schema": 1}))
+        row = view.fleetz()["replicas"]["replica-0"]
+        assert row["consecutive_failures"] == 0
+
+
+# -- invariant falsifiability ------------------------------------------------
+
+
+class TestInvariantFalsifiability:
+    """Each partition-drill invariant must actually reject the failure it
+    exists for — an invariant that cannot fail proves nothing."""
+
+    def test_remap_blast_radius(self):
+        before = {"t1": "r1", "t2": "r2", "t3": "r1"}
+        ok = {"t1": "r1", "t2": "r3", "t3": "r1"}
+        assert not invariants.check_remap_blast_radius(before, ok, {"r2"})
+        still_lost = {"t1": "r1", "t2": "r2", "t3": "r1"}
+        assert invariants.check_remap_blast_radius(
+            before, still_lost, {"r2"})
+        over_radius = {"t1": "r3", "t2": "r3", "t3": "r1"}
+        assert invariants.check_remap_blast_radius(
+            before, over_radius, {"r2"})
+        vanished = {"t1": "r1", "t3": "r1"}
+        assert invariants.check_remap_blast_radius(
+            before, vanished, {"r2"})
+        # the rejoin check: with nothing lost, ANY movement violates
+        assert invariants.check_remap_blast_radius(before, ok, set())
+
+    def test_completes_or_sheds(self):
+        good = [{"tenant": "a", "outcome": "served"},
+                {"tenant": "b", "outcome": "shed", "reason": "deadline"},
+                {"tenant": "c", "outcome": "shed",
+                 "reason": "poison-quarantine"}]
+        assert not invariants.check_completes_or_sheds(good)
+        assert invariants.check_completes_or_sheds(
+            [{"tenant": "a", "outcome": "shed", "reason": "cosmic-rays"}])
+        assert invariants.check_completes_or_sheds(
+            [{"tenant": "a", "outcome": "error", "detail": "boom"}])
+        assert invariants.check_completes_or_sheds(
+            [{"tenant": "a", "outcome": None}])
+
+    def test_quarantine_cascade(self):
+        assert not invariants.check_quarantine_cascade(
+            {"fp1": ["r1", "r2"], "fp2": ["r3"]})
+        bad = invariants.check_quarantine_cascade(
+            {"fp1": ["r1", "r2", "r3"]})
+        assert bad and "fp1" in bad[0].message
+
+    def test_epoch_monotone(self):
+        assert not invariants.check_epoch_monotone([0, 0, 1, 2, 2, 5])
+        bad = invariants.check_epoch_monotone([0, 2, 1, 3])
+        assert bad and "regressed" in bad[0].message
+
+    def test_membership_noop(self):
+        frozen = {"probes_total": 4, "transitions_total": 1}
+        assert not invariants.check_membership_noop(
+            {"enabled": False, "before": frozen, "after": dict(frozen)})
+        moved = dict(frozen, probes_total=5)
+        assert invariants.check_membership_noop(
+            {"enabled": False, "before": frozen, "after": moved})
+        # plane on: not this drill's concern
+        assert not invariants.check_membership_noop(
+            {"enabled": True, "before": frozen, "after": moved})
+
+
+# -- the drill ---------------------------------------------------------------
+
+
+class TestPartitionDrill:
+    def test_fakeclock_drill_passes_at_seed_zero(self):
+        artifact = ChaosRunner(seed=0, partition=True).run_partition_drill()
+        assert artifact["passed"], json.dumps(
+            [v for s in artifact["scenarios"] for v in s["violations"]],
+            indent=2)
+        drill = artifact["scenarios"][0]
+        # the headline physics: ~1/R remap, recovery bounded by the
+        # detectors, the poison stopped at two victims
+        assert abs(drill["remap_fraction"] - 0.2) < 0.15
+        assert max(drill["recovery_to_green_cycles"].values()) <= \
+            MembershipManager.MISSED_BEATS_K + 1
+        assert len(drill["quarantine"]["quarantined"]) == 1
+        assert drill["totals"]["shed_quarantine"] > 0
+        assert drill["ejection_flight_triggers"] >= 4
+        noop = artifact["scenarios"][1]
+        assert noop["passed"]
+        assert all(v == 0 for v in noop["membership"]["deltas"].values())
+
+    def test_drill_is_replay_identical(self):
+        a = ChaosRunner(seed=3, partition=True).run_partition_drill()
+        b = ChaosRunner(seed=3, partition=True).run_partition_drill()
+        for art in (a, b):
+            art.pop("duration_s")
+            art.pop("bundles")
+        assert a == b
+
+    def test_gray_ejected_before_p99_stays_doubled(self):
+        drill = ChaosRunner(
+            seed=0, partition=True).run_partition_scenario(0)
+        gray = [p for p in drill["phases"] if p["phase"] == "gray"][0]
+        assert any(e.get("reason") == "gray-failure"
+                   for e in gray["events"])
+        # once ejected, per-cycle p99 returns to baseline and stays there
+        assert gray["cycle_p99"][-1] < 2.0 * drill["baseline_p99_s"]
+        assert drill["gray_elevated_cycles"] <= ChaosRunner.GRAY_EJECT_BOUND
+
+
+_DRILL_WORKER = r'''
+import json, os, sys
+sys.path.insert(0, os.environ["KT_REPO"])
+from karpenter_tpu.chaos.runner import ChaosRunner
+artifact = ChaosRunner(seed=7, partition=True).run_partition_drill()
+drill = artifact["scenarios"][0]
+print("WORKER_OK " + json.dumps({
+    "passed": artifact["passed"],
+    "remap_fraction": drill["remap_fraction"],
+    "epoch": drill["membership_epoch"],
+    "quarantined": len(drill["quarantine"]["quarantined"]),
+}), flush=True)
+'''
+
+
+@pytest.mark.slow
+def test_partition_drill_in_real_subprocess():
+    """The drill run as a genuinely separate OS process (the harness
+    tests/test_multiprocess.py uses): proves the plane carries no hidden
+    dependence on this process's global plane switches or metric state."""
+    env = dict(os.environ)
+    env["KT_REPO"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen([sys.executable, "-c", _DRILL_WORKER],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT,
+                            env=env, cwd=REPO, text=True)
+    out, _ = proc.communicate(timeout=240)
+    assert proc.returncode == 0, out
+    payload = [ln for ln in out.splitlines()
+               if ln.startswith("WORKER_OK ")]
+    assert payload, out
+    result = json.loads(payload[0][len("WORKER_OK "):])
+    assert result["passed"] is True
+    assert abs(result["remap_fraction"] - 0.2) < 0.15
+    assert result["quarantined"] == 1
