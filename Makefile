@@ -8,9 +8,9 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit lint noretry hotloops crashpoints cardinality phaseacct reasons test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm failover-drill fleet-bench telemetry-drill claims diagnose provenance multichip soak perf-regress ledger-backfill profile-drill explain-drill
+.PHONY: presubmit lint noretry hotloops crashpoints cardinality phaseacct reasons test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm failover-drill fleet-bench fleet-drill fleet-drill-small telemetry-drill claims diagnose provenance multichip soak perf-regress ledger-backfill profile-drill explain-drill
 
-presubmit: lint claims provenance noretry hotloops crashpoints cardinality phaseacct reasons perf-regress failover-drill test verify-entry  ## what CI runs
+presubmit: lint claims provenance noretry hotloops crashpoints cardinality phaseacct reasons perf-regress failover-drill fleet-drill-small test verify-entry  ## what CI runs
 
 perf-regress:  ## tier-1-sized micro-benches must stay inside the ledger's noise bands
 	$(CPU_ENV) $(PY) hack/check_perf_regress.py
@@ -71,6 +71,14 @@ failover-drill:  ## fleet membership/failover drill: kill, partition, gray, pois
 
 fleet-bench:  ## multi-tenant fleet benchmark: sustained solves/sec + p99, RECORDED
 	$(CPU_ENV) $(PY) bench.py --fleet
+
+fleet-drill:  ## REAL-replica drill: 4 subprocesses, 1000 tenants, mid-run kill, RECORDED
+	$(CPU_ENV) $(PY) -m benchmarks.fleet_drill
+
+fleet-drill-small:  ## tier-1-sized real-replica drill (2 subprocesses, no throughput floor)
+	$(CPU_ENV) KARPENTER_TPU_DRILL_DIR=$(or $(DRILL_DIR),/tmp/karpenter-fleet-drill) \
+		KARPENTER_TPU_LEDGER=$(or $(DRILL_DIR),/tmp/karpenter-fleet-drill)/ledger.jsonl \
+		$(PY) -m benchmarks.fleet_drill --small
 
 telemetry-drill:  ## 2-replica/1000-tenant telemetry acceptance drill, RECORDED
 	$(CPU_ENV) $(PY) -m benchmarks.telemetry_drill

@@ -1,0 +1,662 @@
+"""The real-replica fleet drill: N subprocesses, 1000 tenants, one kill.
+
+Every fleet-layer claim this repo has proven in-process — rendezvous
+routing, health-gated membership, client-side failover, federated
+observability — re-proven across REAL process boundaries:
+
+* each solver replica is its own OS process (fleet/replica.py), booted
+  on ephemeral ports and discovered through the filesystem rendezvous;
+* FleetView scrapes live `/debug/statusz` + `/debug/traces` over HTTP
+  (HttpReplica), so every row carries genuine scrape evidence:
+  scrape_ms, staleness_s, and the serving process's real pid;
+* MembershipManager heartbeats measure real HTTP round-trips;
+  FailoverClient speaks the real gRPC solver wire;
+* mid-run, one replica is SIGKILLed. The drill then audits blast
+  radius, kill absorption, survivor progress, fairness, epoch
+  monotonicity and quarantine bounds PURELY from federated scrape
+  evidence — the instrument panel is the witness, not the harness.
+
+The traffic schedule (sweep-first + zipf tail) is derived from one
+seeded RNG; `build_replay_plan()` reproduces it bit-for-bit without
+spawning anything, so the committed artifact's schedule digest is
+replayable and testable in tier-1 time.
+
+Run as `make fleet-drill` (full: 4 replicas, 1000 tenants, throughput
+floor 2x the single-process fleet baseline) or `make fleet-drill-small`
+(2 replicas, tier-1 sized — also exercised by tests/test_fleet_drill.py).
+Artifact: benchmarks/results/fleet/fleet_drill.json (or _small)."""
+
+from __future__ import annotations
+
+import argparse
+import bisect
+import collections
+import hashlib
+import itertools
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, asdict
+from typing import Optional
+
+# single-process fleet baseline (ledger: fleet_sustained_solves_per_sec,
+# benchmarks/results/fleet/fleet_bench.json): the full drill must sustain
+# at least 2x this across the replica fleet to prove the processes add
+# capacity instead of just overhead
+SINGLE_PROCESS_BASELINE = 79.944
+PODS_PER_SOLVE = 4
+CLIENT_SPAN = "fleet.drill.federation"
+
+
+@dataclass(frozen=True)
+class DrillConfig:
+    name: str
+    replicas: int
+    tenants: int
+    duration_s: float
+    workers: int
+    max_wave: int
+    seed: int = 0
+    tick_interval_s: float = 0.01
+    membership_tick_s: float = 0.25
+    kill_frac: float = 0.45          # kill at this fraction of the window
+    recovery_limit: int = 3          # membership cycles to absorb the kill
+    # the fairness contract each replica declares (and the drill audits):
+    # closed-loop zipf traffic plus the post-kill remap flood queues a hot
+    # tenant several rotations deep, so the bound is sized for the drill's
+    # offered depth rather than the open-loop default of 4
+    starvation_bound: int = 16
+    zipf_exponent: float = 1.1
+    solve_timeout_s: float = 30.0
+    hedge_horizon_s: float = 10.0    # >> queue waits on a loaded host
+    gray_factor: float = 50.0        # CPU-contended probes must not gray-eject
+    throughput_floor: "Optional[float]" = None
+    boot_timeout_s: float = 240.0
+    warmup_rungs: "tuple[int, ...]" = (2, 4, 8)
+
+
+FULL = DrillConfig(name="full", replicas=4, tenants=1000, duration_s=10.0,
+                   workers=48, max_wave=32,
+                   throughput_floor=round(2 * SINGLE_PROCESS_BASELINE, 3))
+SMALL = DrillConfig(name="small", replicas=2, tenants=48, duration_s=4.0,
+                    workers=8, max_wave=4, warmup_rungs=(2, 4))
+
+
+# -- deterministic schedule (shared by the drill and its replay plan) -------
+
+
+def _tenant_ids(cfg: DrillConfig) -> "list[str]":
+    return [f"tenant-{i:04d}" for i in range(cfg.tenants)]
+
+
+def _replica_names(cfg: DrillConfig) -> "list[str]":
+    return [f"r{i}" for i in range(cfg.replicas)]
+
+
+def _zipf_cum(n: int, exponent: float) -> "list[float]":
+    """Cumulative zipf weights over tenant ranks (tenant-0000 heaviest)."""
+    cum, total = [], 0.0
+    for i in range(n):
+        total += 1.0 / ((i + 1) ** exponent)
+        cum.append(total)
+    return cum
+
+
+def _zipf_pick(tenants, cum, r: float) -> str:
+    return tenants[bisect.bisect_left(cum, r * cum[-1])]
+
+
+def schedule_digest(sweep: "list[str]", tail: "list[str]") -> str:
+    h = hashlib.blake2b(digest_size=16)
+    for tid in sweep:
+        h.update(tid.encode())
+        h.update(b"\x00")
+    h.update(b"--tail--")
+    for tid in tail:
+        h.update(tid.encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def build_replay_plan(cfg: DrillConfig) -> dict:
+    """The drill's deterministic skeleton, computed WITHOUT spawning
+    anything: the shuffled sweep order, the zipf tail preview, and a
+    digest over both. `_Schedule` consumes the identical RNG stream, so
+    the digest in a committed artifact replays bit-for-bit from (seed,
+    config) alone — no wall time, no pids, no ports."""
+    tenants = _tenant_ids(cfg)
+    rng = random.Random(cfg.seed)
+    sweep = list(tenants)
+    rng.shuffle(sweep)
+    cum = _zipf_cum(len(tenants), cfg.zipf_exponent)
+    tail = [_zipf_pick(tenants, cum, rng.random())
+            for _ in range(2 * cfg.tenants)]
+    names = _replica_names(cfg)
+    return {
+        "schema": 1,
+        "seed": cfg.seed,
+        "tenants": cfg.tenants,
+        "replicas": names,
+        "kill_victim": names[1 % len(names)],
+        "zipf_exponent": cfg.zipf_exponent,
+        "sweep_head": sweep[:8],
+        "tail_head": tail[:8],
+        "schedule_digest": schedule_digest(sweep, tail),
+    }
+
+
+class _Schedule:
+    """Thread-safe tenant-id source: the shuffled sweep FIRST (every
+    tenant exactly once, completed even past the deadline — the 1000
+    tenants are the point), then the zipf tail until the deadline. The
+    RNG stream is consumed in exactly the order `build_replay_plan`
+    previews, so the plan's digest covers this sequence."""
+
+    def __init__(self, cfg: DrillConfig):
+        tenants = _tenant_ids(cfg)
+        rng = random.Random(cfg.seed)
+        sweep = list(tenants)
+        rng.shuffle(sweep)
+        self._sweep = collections.deque(sweep)
+        self._rng = rng
+        self._tenants = tenants
+        self._cum = _zipf_cum(len(tenants), cfg.zipf_exponent)
+        self._lock = threading.Lock()
+        self.deadline: "Optional[float]" = None
+
+    def next(self) -> "Optional[str]":
+        with self._lock:
+            if self._sweep:
+                return self._sweep.popleft()
+            if self.deadline is not None \
+                    and time.perf_counter() < self.deadline:
+                return _zipf_pick(self._tenants, self._cum,
+                                  self._rng.random())
+            return None
+
+
+# -- the drill --------------------------------------------------------------
+
+
+def _workload():
+    """The fleet bench workload (bench.py --fleet): identical content for
+    every tenant, so the whole fleet dedupes onto one resident solver per
+    replica and batches across tenants."""
+    from karpenter_tpu.apis import wellknown as wk
+    from karpenter_tpu.apis.provisioner import Provisioner
+    from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+    from karpenter_tpu.models.requirements import OP_IN, Requirements
+
+    catalog = Catalog(types=[
+        make_instance_type("m.large", cpu=4, memory="16Gi",
+                           od_price=0.20, spot_price=0.07),
+        make_instance_type("m.xlarge", cpu=16, memory="64Gi",
+                           od_price=0.80, spot_price=0.28),
+    ])
+    prov = Provisioner(name="default", requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+    prov.set_defaults()
+    return catalog, [prov]
+
+
+def _percentile(sorted_ms: "list[float]", q: float) -> "Optional[float]":
+    if not sorted_ms:
+        return None
+    idx = min(len(sorted_ms) - 1, int(len(sorted_ms) * q))
+    return round(sorted_ms[idx], 3)
+
+
+def _log_tail(path: str, n: int = 20) -> str:
+    try:
+        with open(path, errors="replace") as f:
+            return "".join(f.readlines()[-n:])
+    except OSError as e:
+        return f"<no log: {e}>"
+
+
+def run_drill(cfg: DrillConfig, out_dir: "Optional[str]" = None) -> dict:
+    """Run the drill against real subprocesses; returns the artifact
+    dict (written to `out_dir/fleet_drill[_small].json` when given)."""
+    from karpenter_tpu.chaos import invariants as inv
+    from karpenter_tpu.fleet.failover import FailoverClient
+    from karpenter_tpu.fleet.membership import MembershipManager
+    from karpenter_tpu.fleet.replica import (
+        GrpcReplicaTransport, http_probe, spawn_replica,
+        wait_for_registrations)
+    from karpenter_tpu.fleet.router import FleetRouter
+    from karpenter_tpu.introspect.fleetview import FleetView, HttpReplica
+    from karpenter_tpu.resilience.policy import RetryBudget
+    from karpenter_tpu.solver import solver_pb2 as pb
+    from karpenter_tpu.solver import wire
+    from karpenter_tpu.models.pod import make_pod
+    from karpenter_tpu.tracing import TRACER
+    from karpenter_tpu.utils.clock import WallClock
+
+    plan = build_replay_plan(cfg)
+    names = _replica_names(cfg)
+    victim = plan["kill_victim"]
+    survivors = [n for n in names if n != victim]
+    tenants = _tenant_ids(cfg)
+    rendezvous = tempfile.mkdtemp(prefix="fleet-drill-")
+    procs: "dict[str, object]" = {}
+    transports: "dict[str, GrpcReplicaTransport]" = {}
+    threads: "list[threading.Thread]" = []
+    stop_tick = threading.Event()
+    failed = True
+    try:
+        # -- boot the fleet: real subprocesses on ephemeral ports -----------
+        for name in names:
+            procs[name] = spawn_replica(
+                name, rendezvous, max_wave=cfg.max_wave,
+                tick_interval_s=cfg.tick_interval_s,
+                starvation_bound=cfg.starvation_bound)
+        regs = wait_for_registrations(rendezvous, names,
+                                      timeout_s=cfg.boot_timeout_s)
+
+        # -- sync content + warm the wave rungs on every replica ------------
+        catalog, provs = _workload()
+        prov_hash = wire.provisioners_hash(provs)
+        cat_hash = None
+        for name in names:
+            transports[name] = GrpcReplicaTransport(name, regs[name]["grpc"])
+            resp = transports[name].sync(catalog, provs)
+            cat_hash = resp.catalog_hash
+        seq = itertools.count()
+
+        def build_request(tid: str, trace_ctx=None):
+            i = next(seq)
+            pods = [make_pod(f"{tid}-q{i}-p{j}", cpu="1", memory="2Gi")
+                    for j in range(PODS_PER_SOLVE)]
+            req = pb.SolveRequest(
+                catalog_hash=cat_hash, provisioner_hash=prov_hash,
+                pods=[wire.pod_to_wire(p) for p in pods])
+            if trace_ctx is not None:
+                req.trace_context.CopyFrom(
+                    wire.trace_context_to_wire(trace_ctx))
+            return req
+
+        def warm(name: str):
+            # solo first (compile the K=1..pad rung), then concurrent
+            # bursts so every batch rung the window will see is jitted
+            # before the clock starts
+            transports[name]("warm-solo", build_request("warm-solo"),
+                             cfg.solve_timeout_s * 4)
+            for k in cfg.warmup_rungs:
+                burst = [threading.Thread(
+                    target=transports[name],
+                    args=(f"warm-{k}-{j}", build_request(f"warm-{k}-{j}"),
+                          cfg.solve_timeout_s * 4))
+                    for j in range(k)]
+                for t in burst:
+                    t.start()
+                for t in burst:
+                    t.join()
+
+        for name in names:
+            warm(name)
+
+        # -- wire the observability + membership + failover planes ----------
+        # WallClock: statusz timestamps cross process boundaries, so the
+        # view's staleness arithmetic must share the replicas' clock domain
+        router = FleetRouter()
+        view = FleetView(router=router, name="fleet-drill",
+                         clock=WallClock())
+        membership = MembershipManager(router, view=view,
+                                       gray_factor=cfg.gray_factor)
+        # the audit view scrapes EVERY replica (including the corpse,
+        # post-kill) independently of membership, so partial-scrape
+        # degradation itself is auditable evidence
+        audit_view = FleetView(name="fleet-drill-audit", clock=WallClock())
+        audit_eps: "dict[str, HttpReplica]" = {}
+        for name in names:
+            membership.register(
+                name, http_probe(regs[name]["health"]),
+                endpoint=HttpReplica(name, regs[name]["debug"]))
+            audit_eps[name] = HttpReplica(name, regs[name]["debug"])
+            audit_view.add_replica(audit_eps[name])
+        for _ in range(20):
+            membership.tick()
+            if set(membership.members()) == set(names):
+                break
+        else:
+            raise RuntimeError(
+                f"fleet never converged: members={membership.members()}")
+
+        cycles: "list[dict]" = []
+        cycles_lock = threading.Lock()
+
+        def ticker():
+            while not stop_tick.is_set():
+                events = membership.tick()
+                rec = {"ts": time.time(), "epoch": membership.epoch(),
+                       "members": sorted(membership.members()),
+                       "events": events,
+                       "ejected": [e["replica"] for e in events
+                                   if e.get("event") == "ReplicaEjected"]}
+                with cycles_lock:
+                    cycles.append(rec)
+                stop_tick.wait(cfg.membership_tick_s)
+
+        remaps: "collections.Counter" = collections.Counter()
+        failover = FailoverClient(
+            router, transports, seed=cfg.seed,
+            hedge_horizon_s=cfg.hedge_horizon_s,
+            budget=RetryBudget(capacity=128.0, refill_per_success=0.5),
+            on_remap=lambda tid, new: remaps.update([new]))
+
+        # -- federation probe: one trace across client + 2 real replicas ----
+        fed_targets = names[:2]
+        with TRACER.start_span(CLIENT_SPAN, targets=len(fed_targets)) as sp:
+            for name in fed_targets:
+                transports[name]("tenant-0000",
+                                 build_request("tenant-0000", sp.context()),
+                                 cfg.solve_timeout_s)
+        fed = view.federated_trace(sp.trace_id)
+        fed_lanes = {e["args"]["name"]: e["pid"]
+                     for e in (fed or {}).get("traceEvents", ())
+                     if e["ph"] == "M"}
+        fed_spans = [e for e in (fed or {}).get("traceEvents", ())
+                     if e["ph"] == "X"]
+        federation = {
+            "trace_id": sp.trace_id,
+            "lanes": fed_lanes,
+            "n_spans": len(fed_spans),
+            "client_pid": os.getpid(),
+            "replica_pids": {n: regs[n]["pid"] for n in fed_targets},
+        }
+        federation_ok = (
+            fed is not None
+            and fed_lanes.get("client:fleet-drill") == os.getpid()
+            and all(fed_lanes.get(n) == regs[n]["pid"] for n in fed_targets)
+            and len(set(fed_lanes.values())) >= 3)
+
+        # -- baseline brackets ----------------------------------------------
+        pinning_before = router.assignment(tenants)
+        rows0 = audit_view.fleetz()["replicas"]
+        served_start = {n: r["served"] for n, r in rows0.items()
+                        if isinstance(r.get("served"), int)}
+
+        # -- traffic + kill -------------------------------------------------
+        sched = _Schedule(cfg)
+        outcomes: "list[dict]" = []
+        kill_state: "dict[str, object]" = {}
+
+        def worker():
+            while True:
+                tid = sched.next()
+                if tid is None:
+                    return
+                t0 = time.perf_counter()
+                try:
+                    failover.solve(tid, build_request(tid),
+                                   timeout_s=cfg.solve_timeout_s)
+                    outcomes.append({
+                        "tenant": tid, "outcome": "served",
+                        "ms": (time.perf_counter() - t0) * 1e3})
+                except Exception as e:  # noqa: BLE001 — audited as an outcome
+                    outcomes.append({
+                        "tenant": tid, "outcome": "error",
+                        "error": f"{type(e).__name__}: {e}"})
+
+        def killer():
+            stop_tick.wait(cfg.duration_s * cfg.kill_frac)
+            if stop_tick.is_set():
+                return
+            kill_state["kill_wall"] = time.time()
+            procs[victim].kill()  # SIGKILL: no goodbye, no deregistration
+            # wait for membership to eject the corpse, then bracket the
+            # survivors' served counters for the progress invariant
+            deadline = time.monotonic() + max(10.0, cfg.duration_s)
+            while time.monotonic() < deadline and not stop_tick.is_set():
+                with cycles_lock:
+                    post = [c for c in cycles
+                            if c["ts"] >= kill_state["kill_wall"]]
+                if any(victim in c["ejected"] for c in post):
+                    break
+                time.sleep(0.05)
+            rows = audit_view.fleetz()["replicas"]
+            kill_state["served_mid"] = {
+                n: rows[n]["served"] for n in survivors
+                if isinstance(rows.get(n, {}).get("served"), int)}
+
+        tick_thread = threading.Thread(target=ticker, daemon=True)
+        kill_thread = threading.Thread(target=killer, daemon=True)
+        workers = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(cfg.workers)]
+        t_start = time.perf_counter()
+        sched.deadline = t_start + cfg.duration_s
+        tick_thread.start()
+        kill_thread.start()
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        wall = time.perf_counter() - t_start
+        kill_thread.join(timeout=15.0)
+        stop_tick.set()
+        tick_thread.join(timeout=5.0)
+
+        # -- the audit: every invariant from federated scrape evidence ------
+        pinning_after = router.assignment(tenants)
+        fleetz_after = audit_view.fleetz()
+        rows_after = fleetz_after["replicas"]
+        served_end = {n: r["served"] for n, r in rows_after.items()
+                      if isinstance(r.get("served"), int)}
+        with cycles_lock:
+            all_cycles = list(cycles)
+        kill_wall = kill_state.get("kill_wall")
+        post_kill = [c for c in all_cycles
+                     if kill_wall is not None and c["ts"] >= kill_wall]
+        recovery_cycles = next(
+            (i + 1 for i, c in enumerate(post_kill)
+             if victim in c["ejected"]), None)
+
+        fairness_rows: "dict[str, dict]" = {}
+        violations = []
+        violations += inv.check_scrape_evidence(
+            rows_after,
+            expect_pids={n: regs[n]["pid"] for n in survivors})
+        if not rows_after.get(victim, {}).get("healthy", True):
+            pass  # the corpse degraded to a named error row — as designed
+        else:
+            violations += [inv.Violation(
+                "scrape-evidence-complete",
+                f"killed replica {victim} still scrapes healthy")]
+        violations += inv.check_remap_blast_radius(
+            pinning_before, pinning_after, {victim})
+        violations += inv.check_kill_absorbed(
+            post_kill, victim, limit=cfg.recovery_limit)
+        violations += inv.check_survivors_progress(
+            kill_state.get("served_mid") or {}, served_end, {victim})
+        violations += inv.check_epoch_monotone(
+            [c["epoch"] for c in all_cycles])
+        violations += inv.check_quarantine_cascade(
+            failover.evidence()["quarantine"]["victims"])
+        violations += inv.check_completes_or_sheds(outcomes)
+        for name in survivors:
+            snap = audit_eps[name].statusz()  # full scrape
+            fronts = (snap.get("fleet") or {}).get("frontends") or []
+            ours = next((f for f in fronts if f.get("name") == name), None)
+            if ours is None:
+                violations += [inv.Violation(
+                    "fairness-never-starves",
+                    f"replica {name}: scraped statusz carries no frontend "
+                    f"row to audit")]
+                continue
+            fairness_rows[name] = {"starvation_bound":
+                                   ours.get("starvation_bound"),
+                                   "queued": ours.get("queued"),
+                                   "tenants": ours.get("tenants") or {}}
+            violations += inv.check_fairness_never_starves(
+                fairness_rows[name])
+
+        # -- throughput -----------------------------------------------------
+        served = [o for o in outcomes if o["outcome"] == "served"]
+        errors = [o for o in outcomes if o["outcome"] != "served"]
+        lats = sorted(o["ms"] for o in served)
+        aggregate = round(len(served) / wall, 3) if wall > 0 else 0.0
+        per_replica = {}
+        for name in names:
+            start = served_start.get(name)
+            end = served_end.get(name)
+            mid = (kill_state.get("served_mid") or {}).get(name)
+            per_replica[name] = {
+                "served_start": start, "served_mid": mid,
+                "served_end": end,
+                "solves_per_sec": (round((end - start) / wall, 3)
+                                   if name != victim
+                                   and isinstance(start, int)
+                                   and isinstance(end, int) else None),
+            }
+
+        floor = cfg.throughput_floor
+        criteria = {
+            "replicas_are_real_subprocesses": (
+                len({regs[n]["pid"] for n in names}) == len(names)
+                and os.getpid() not in {regs[n]["pid"] for n in names}),
+            "every_tenant_served": (
+                {o["tenant"] for o in served} >= set(tenants)),
+            "aggregate_throughput_over_floor": (
+                floor is None or aggregate >= floor),
+            "kill_absorbed_within_limit": (
+                recovery_cycles is not None
+                and recovery_cycles <= cfg.recovery_limit),
+            "federated_trace_spans_real_processes": federation_ok,
+            "invariants_hold": not violations,
+        }
+        artifact = {
+            "tool": "karpenter-tpu-fleet-drill",
+            "schema": 1,
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+            "config": asdict(cfg),
+            "replay": plan,
+            "registrations": {n: {"pid": regs[n]["pid"],
+                                  "grpc": regs[n]["grpc"],
+                                  "debug": regs[n]["debug"]}
+                              for n in names},
+            "baseline": {"single_process_solves_per_sec":
+                         SINGLE_PROCESS_BASELINE,
+                         "floor_solves_per_sec": floor},
+            "traffic": {
+                "requests": len(outcomes),
+                "served": len(served),
+                "errors": len(errors),
+                "error_head": [o["error"] for o in errors[:5]],
+                "distinct_tenants": len({o["tenant"] for o in outcomes}),
+                "wall_s": round(wall, 3),
+                "aggregate_solves_per_sec": aggregate,
+                "p50_ms": _percentile(lats, 0.50),
+                "p99_ms": _percentile(lats, 0.99),
+            },
+            "kill": {
+                "victim": victim,
+                "kill_wall": kill_wall,
+                "recovery_cycles": recovery_cycles,
+                "recovery_limit": cfg.recovery_limit,
+                "post_kill_cycles": [
+                    {k: c[k] for k in ("epoch", "members", "ejected")}
+                    for c in post_kill[:8]],
+                "remaps": dict(remaps),
+                "warm_state_losses":
+                    failover.evidence()["warm_state_losses"],
+            },
+            "per_replica": per_replica,
+            "federation": federation,
+            "scrape": {
+                "membership_epoch": membership.epoch(),
+                # rows minus the per-tenant tables (full evidence is huge;
+                # the invariants already consumed it above)
+                "rows": {n: {k: v for k, v in r.items() if k != "tenants"}
+                         for n, r in rows_after.items()},
+            },
+            "violations": [v.as_dict() for v in violations],
+            "criteria": criteria,
+            "passed": all(criteria.values()),
+        }
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            suffix = "" if cfg.name == "full" else f"_{cfg.name}"
+            path = os.path.join(out_dir, f"fleet_drill{suffix}.json")
+            with open(path, "w") as f:
+                json.dump(artifact, f, indent=2, sort_keys=True,
+                          default=str)
+                f.write("\n")
+            artifact["artifact_path"] = path
+        failed = not artifact["passed"]
+        return artifact
+    finally:
+        stop_tick.set()
+        for name, proc in procs.items():
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+        for name, proc in procs.items():
+            try:
+                proc.wait(timeout=10.0)
+            except Exception:  # noqa: BLE001 — escalate, then move on
+                proc.kill()
+        for tr in transports.values():
+            tr.close()
+        if failed:
+            for name in procs:
+                tail = _log_tail(os.path.join(rendezvous, f"{name}.log"))
+                print(f"--- {name} log tail ({rendezvous}) ---\n{tail}",
+                      file=sys.stderr)
+        else:
+            shutil.rmtree(rendezvous, ignore_errors=True)
+
+
+def _ledger_records(artifact: dict) -> None:
+    """Record the drill's trend metrics through the SAME extractor the
+    ledger's backfill uses, against the repo-relative artifact path — a
+    later `backfill()` dedupes against what the live run wrote."""
+    from benchmarks import ledger
+
+    path = artifact.get("artifact_path")
+    if not path:
+        return
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rel = os.path.relpath(path, root)
+    for (metric, value, unit, backend, degraded,
+         workload, ts) in ledger._fleet_drill_entries(artifact):
+        ledger.append(ledger.make_entry(
+            metric, value, unit, source="benchmarks.fleet_drill",
+            backend=backend, degraded=degraded, workload=workload,
+            artifact=rel, recorded_at=ts))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--small", action="store_true",
+                    help="tier-1-sized config (2 replicas, no floor)")
+    ap.add_argument("--out-dir", default=None)
+    args = ap.parse_args(argv)
+    cfg = SMALL if args.small else FULL
+    out_dir = args.out_dir or os.environ.get(
+        "KARPENTER_TPU_DRILL_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+            "benchmarks", "results", "fleet"))
+    artifact = run_drill(cfg, out_dir)
+    _ledger_records(artifact)
+    print(json.dumps({"passed": artifact["passed"],
+                      "criteria": artifact["criteria"],
+                      "aggregate_solves_per_sec":
+                          artifact["traffic"]["aggregate_solves_per_sec"],
+                      "recovery_cycles":
+                          artifact["kill"]["recovery_cycles"],
+                      "violations": artifact["violations"][:10],
+                      "artifact": artifact.get("artifact_path")},
+                     indent=2))
+    return 0 if artifact["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -331,6 +331,39 @@ def _explain_entries(doc: dict):
                    None)
 
 
+def _fleet_drill_entries(doc: dict):
+    """benchmarks/fleet_drill.py artifacts (full + _small): aggregate
+    fleet throughput across REAL replica subprocesses, the slowest
+    surviving replica's rate, and how many membership cycles the mid-run
+    kill took to absorb. Degraded whenever the drill failed a criterion."""
+    if doc.get("tool") != "karpenter-tpu-fleet-drill":
+        return
+    cfg = doc.get("config") or {}
+    traffic = doc.get("traffic") or {}
+    degraded = not doc.get("passed", False)
+    ts = doc.get("captured_at")
+    wl = {"name": "fleet_drill", "config": cfg.get("name"),
+          "replicas": cfg.get("replicas"), "tenants": cfg.get("tenants")}
+    if isinstance(traffic.get("aggregate_solves_per_sec"), (int, float)):
+        yield ("fleet_drill_aggregate_solves_per_sec",
+               traffic["aggregate_solves_per_sec"], "solves/s", "cpu",
+               degraded, wl, ts)
+    if isinstance(traffic.get("p99_ms"), (int, float)):
+        yield ("fleet_drill_p99_ms", traffic["p99_ms"], "ms", "cpu",
+               degraded, wl, ts)
+    rc = (doc.get("kill") or {}).get("recovery_cycles")
+    if isinstance(rc, (int, float)):
+        yield ("fleet_drill_recovery_cycles", rc, "cycles", "cpu",
+               degraded, wl, ts)
+    rates = [r.get("solves_per_sec")
+             for r in (doc.get("per_replica") or {}).values()
+             if isinstance(r, dict)
+             and isinstance(r.get("solves_per_sec"), (int, float))]
+    if rates:
+        yield ("fleet_drill_replica_min_solves_per_sec", min(rates),
+               "solves/s", "cpu", degraded, wl, ts)
+
+
 _BACKFILL_SOURCES = (
     ("BENCH_r0*.json", "bench.py", _bench_round_entries),
     ("benchmarks/results/bench_*.json", "benchmarks.record",
@@ -342,6 +375,8 @@ _BACKFILL_SOURCES = (
     ("benchmarks/results/tpu_*.json", "bench.py", _tpu_capture_entries),
     ("benchmarks/results/fleet/fleet_bench.json", "bench.py --fleet",
      _fleet_entries),
+    ("benchmarks/results/fleet/fleet_drill*.json", "benchmarks.fleet_drill",
+     _fleet_drill_entries),
     ("benchmarks/results/soak/soak_*.json", "bench.py --soak",
      _soak_entries),
     ("benchmarks/results/multichip_wire_*.json", "benchmarks.multichip_wire",

@@ -23,7 +23,9 @@ With fewer than MIN_SAMPLES same-host history points the gate SEEDS
 instead of judging: it appends the measurement to the ledger (detail
 marks it a gate seed) and passes, so a fresh machine builds its own band
 over its first few presubmits rather than being judged against someone
-else's hardware.
+else's hardware. Passing measured runs keep recording (gate samples), so
+the band is a moving window over the newest RECENT_N same-host points —
+it tracks gradual host drift without ever absorbing a failing number.
 
 Falsifiability hooks (exercised by tests/test_slo.py):
     --inject METRIC=VALUE   use VALUE as the measured number instead of
@@ -133,6 +135,19 @@ def check_gate(metric, workload, backend, unit, direction, runner,
     regressed = (measured < lo) if direction == "higher" else (measured > hi)
     if regressed:
         return "regress", f"FAIL  {what}: {detail}"
+    # a PASSING measured run joins the band (detail marks it a gate
+    # sample; injected values never record). Without this the band stays
+    # frozen at its MIN_SAMPLES seeds forever, and ordinary host drift —
+    # a shared-tenancy VM slowing 30% week over week — eventually fails
+    # every presubmit on both the working tree AND the seed commit. With
+    # it the band is a moving window (newest RECENT_N same-host points)
+    # that tracks the machine while still trapping step regressions: a
+    # real slowdown fails the CURRENT band before it can pull the median.
+    if how == "measured":
+        ledger.record(metric, round(measured, 3), unit,
+                      source="hack.check_perf_regress", backend=backend,
+                      workload=workload, path=ledger_path,
+                      detail={"host": host, "gate_sample": True})
     return "ok", f"ok    {what}: {detail}"
 
 

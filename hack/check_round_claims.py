@@ -8,8 +8,10 @@ nor refute them. This lint walks README.md and docs/rounds/*.md at
 paragraph granularity and requires any paragraph quoting a benchmark
 number to also cite where it was recorded — an artifact path
 (benchmarks/results/..., a bench_*/tpu_*/linkprobe_*/chaos_seed*/
-chaos_burst_*/chaos_crash_*/chaos_storm_*/fleet_* JSON, a
-flight-recorder bundle_*.json diagnostics bundle, a .trace.json capture),
+chaos_burst_*/chaos_crash_*/chaos_storm_*/fleet_* JSON — the fleet
+family covers both fleet_bench.json and the real-replica drill's
+fleet_drill*.json — a flight-recorder bundle_*.json diagnostics bundle,
+a .trace.json capture),
 the harness that records one (benchmarks/*.py), or a perf-ledger citation
 `ledger:<metric>` naming a metric that actually has entries in
 benchmarks/results/ledger.jsonl (a citation to a metric the ledger has

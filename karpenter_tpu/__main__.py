@@ -72,6 +72,12 @@ def cmd_solver_serve(args) -> int:
     return 0
 
 
+def cmd_fleet_replica(args) -> int:
+    from .fleet.replica import run_replica_main
+
+    return run_replica_main(args)
+
+
 def cmd_controller(args) -> int:
     from .apis.nodetemplate import NodeTemplate
     from .apis.provisioner import Provisioner
@@ -684,6 +690,30 @@ def main(argv=None) -> int:
              "io_callback streaming (callback) — for relays whose link "
              "degrades after the first literal read")
     p_serve.set_defaults(fn=cmd_solver_serve)
+
+    p_replica = sub.add_parser(
+        "fleet-replica",
+        help="host ONE fleet solver replica (gRPC + debug listeners on "
+             "ephemeral ports, announced via a rendezvous directory) — "
+             "the subprocess half of the real-replica fleet drill")
+    p_replica.add_argument("--name", required=True,
+                           help="replica name (rendezvous + fleetz row)")
+    p_replica.add_argument("--rendezvous", required=True,
+                           help="directory to publish <name>.json with "
+                                "the resolved addresses into")
+    p_replica.add_argument("--grpc-port", type=int, default=0,
+                           help="solve wire port (0 = ephemeral)")
+    p_replica.add_argument("--debug-port", type=int, default=0,
+                           help="metrics/debug listener port (0 = "
+                                "ephemeral; the ACTUAL port is published "
+                                "through the rendezvous record)")
+    p_replica.add_argument("--max-wave", type=int, default=16)
+    p_replica.add_argument("--tick-interval", type=float, default=0.01)
+    p_replica.add_argument("--starvation-bound", type=int, default=4,
+                           help="fairness contract the frontend declares "
+                                "(and the drill audits) in ticks; size "
+                                "for the offered closed-loop depth")
+    p_replica.set_defaults(fn=cmd_fleet_replica)
 
     p_ctrl = sub.add_parser("controller", help="run the controller plane")
     p_ctrl.add_argument("--simulate", action="store_true",

@@ -643,6 +643,93 @@ def check_epoch_monotone(epochs: "list[int]") -> "list[Violation]":
     return out
 
 
+def check_scrape_evidence(rows: "dict[str, dict]",
+                          expect_pids: "dict[str, int] | None" = None,
+                          ) -> "list[Violation]":
+    """scrape-evidence-complete: the real-replica drill audits its
+    invariants from FEDERATED SCRAPE EVIDENCE, so the evidence itself is
+    audited first. Every healthy /debug/fleetz row must carry the scrape
+    provenance fields (scrape_ms, pid — proof the row came over a live
+    HTTP round-trip from a real process, not a stub), the pid must match
+    the rendezvous record when one is expected, and an unhealthy row
+    must NAME its failure (error text; transport failures additionally
+    carry the classified scrape_error kind)."""
+    inv = "scrape-evidence-complete"
+    out = []
+    for name, row in sorted(rows.items()):
+        if not isinstance(row, dict):
+            out.append(Violation(inv, f"replica {name}: row is not a dict"))
+            continue
+        if row.get("healthy"):
+            for field in ("scrape_ms", "pid"):
+                if not isinstance(row.get(field), (int, float)):
+                    out.append(Violation(
+                        inv, f"replica {name}: healthy row missing scrape "
+                             f"provenance field {field!r}"))
+            expected = (expect_pids or {}).get(name)
+            if expected is not None and row.get("pid") != expected:
+                out.append(Violation(
+                    inv, f"replica {name}: scraped pid {row.get('pid')} != "
+                         f"registered pid {expected} (the row did not come "
+                         f"from the process it claims)"))
+        elif not row.get("error"):
+            out.append(Violation(
+                inv, f"replica {name}: unhealthy row with no named error "
+                     f"(partial-scrape degradation must name the corpse)"))
+    return out
+
+
+def check_kill_absorbed(cycles: "list[dict]", victim: str,
+                        limit: int = 3) -> "list[Violation]":
+    """kill-absorbed-within-cycles: after a replica is killed mid-run,
+    the membership plane must absorb the loss within `limit` recovery
+    cycles — the victim ejected from the member set AND every survivor
+    still a member. `cycles` is the drill's post-kill probe-cycle log,
+    one dict per cycle: {"members": [...], "ejected": [...]}."""
+    inv = "kill-absorbed-within-cycles"
+    for i, cyc in enumerate(cycles):
+        if victim in (cyc.get("ejected") or ()):  # absorbed at cycle i+1
+            if i + 1 > limit:
+                return [Violation(
+                    inv, f"victim {victim} ejected only at post-kill "
+                         f"cycle {i + 1} (limit {limit})")]
+            return []
+    return [Violation(
+        inv, f"victim {victim} never ejected across {len(cycles)} "
+             f"post-kill cycles (limit {limit})")]
+
+
+def check_survivors_progress(before: "dict[str, int]",
+                             after: "dict[str, int]",
+                             lost: "set[str] | list[str]",
+                             ) -> "list[Violation]":
+    """survivors-make-progress: SLO recovery, read purely from scraped
+    per-replica served totals (frontend stats "served"). Bracketing the
+    kill, every SURVIVING replica's served count must strictly increase
+    — traffic remapped off the corpse and kept completing — and no
+    counter may regress (a regression means the scrape mixed up replica
+    identities or a replica silently restarted)."""
+    inv = "survivors-make-progress"
+    lost_set = set(lost)
+    out = []
+    for name in sorted(before):
+        b, a = before[name], after.get(name)
+        if name in lost_set:
+            continue
+        if a is None:
+            out.append(Violation(
+                inv, f"surviving replica {name} has no post-kill served "
+                     f"count (scrape lost it)"))
+        elif a < b:
+            out.append(Violation(
+                inv, f"replica {name} served count regressed {b} -> {a}"))
+        elif a == b:
+            out.append(Violation(
+                inv, f"surviving replica {name} made no progress after "
+                     f"the kill (served stuck at {b})"))
+    return out
+
+
 def check_all(op, cloud, token_launches=None,
               consolidation_actions=None,
               resilience=None, profiling=None,

@@ -94,10 +94,11 @@ class _Ticket:
     __slots__ = ("tenant_id", "pods", "existing", "daemon_overhead", "key",
                  "plan", "deadline_ms", "admitted_tick", "admitted_at",
                  "served_tick", "latency_s", "result", "error", "_event",
-                 "seq")
+                 "seq", "trace_ctx")
 
     def __init__(self, tenant_id, pods, existing, daemon_overhead, key,
-                 plan, deadline_ms, admitted_tick, admitted_at, seq):
+                 plan, deadline_ms, admitted_tick, admitted_at, seq,
+                 trace_ctx=None):
         self.tenant_id = tenant_id
         self.pods = pods
         self.existing = existing
@@ -113,6 +114,10 @@ class _Ticket:
         self.error = None
         self._event = threading.Event()
         self.seq = seq
+        # the caller's SpanContext when it sent one over the wire: the
+        # queue-wait span joins ITS trace, so a federated trace shows the
+        # wait inside this replica's lane, not as an orphan trace
+        self.trace_ctx = trace_ctx
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -242,10 +247,12 @@ class FleetFrontend:
 
     def submit(self, tenant_id: str, pods, existing=(),
                daemon_overhead=None, deadline_ms: int = 0,
-               weight: "Optional[int]" = None) -> _Ticket:
+               weight: "Optional[int]" = None,
+               trace_context=None) -> _Ticket:
         """Admit one solve request; returns its ticket (already resolved
         with a FleetShed error when admission shed it). deadline_ms is the
-        caller's REMAINING cycle budget, wire semantics (0 = none)."""
+        caller's REMAINING cycle budget, wire semantics (0 = none).
+        trace_context joins the caller's distributed trace (SpanContext)."""
         tenant_id = tenant_id or DEFAULT_TENANT
         with self._lock:
             st = self._tenants.get(tenant_id)
@@ -258,7 +265,8 @@ class FleetFrontend:
             plan = self._plan_of(pods, existing)
             ticket = _Ticket(tenant_id, list(pods), list(existing),
                              daemon_overhead, st.key, plan, int(deadline_ms),
-                             self._tick, self.clock.now(), next(self._seq))
+                             self._tick, self.clock.now(), next(self._seq),
+                             trace_ctx=trace_context)
             # the guard offers the tenant to the top-K sketch exactly once
             # per submission; every other family this submission touches
             # reuses the same guarded label (peek) so sketch counts track
@@ -470,6 +478,7 @@ class FleetFrontend:
                 TRACER.record_span(
                     "fleet.queue_wait",
                     max(0.0, dispatch_started - t.admitted_at),
+                    context=t.trace_ctx,
                     tenant=t.tenant_id, bucket=plan.label(),
                     wait_ticks=wait)
                 t._resolve(result=res)
@@ -538,6 +547,12 @@ class FleetFrontend:
                 "starvation_bound": self.starvation_bound,
                 "ticks": self.ticks_run,
                 "mega_solves": self.mega_solves,
+                # fleet-wide totals so a federated scraper computes
+                # per-replica solves/s from ONE row instead of summing
+                # the (possibly top-K-guarded) per-tenant table
+                "served": sum(st.served for st in self._tenants.values()),
+                "submitted": sum(st.submitted
+                                 for st in self._tenants.values()),
                 "queued": sum(len(q) for per in self._queues.values()
                               for q in per.values()),
                 "buckets": {plan.label(): sum(len(q) for q in per.values())
@@ -620,26 +635,40 @@ class FleetService:
         self.frontend.register_key(tenant, key)
         import time as _time
 
-        t0 = _time.perf_counter()
-        ticket = self.frontend.submit(
-            tenant,
-            [wire.pod_from_wire(m) for m in request.pods],
-            [wire.existing_from_wire(m) for m in request.existing],
-            list(request.daemon_overhead) or None,
-            deadline_ms=int(request.deadline_ms))
-        timeout = self.solve_timeout_s
-        if request.deadline_ms:
-            timeout = min(timeout, request.deadline_ms / 1000.0 + 1.0)
-        try:
-            result = ticket.wait(timeout)
-        except FleetShed as e:
-            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
-        except TenantNotSynced as e:
-            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
-        except TimeoutError as e:
-            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
-        solve_ms = (_time.perf_counter() - t0) * 1000
-        resp = result_to_response(result, solve_ms, seqnum)
-        resp.routing = "fleet"
-        resp.bucket = ticket.plan.label()
-        return resp
+        # join the caller's trace (wire trace_context) exactly like the
+        # direct SolverService.Solve path does — this is what makes a
+        # FEDERATED trace work across real processes: the client's trace
+        # id crosses the wire, this replica's queue-wait + Solve spans
+        # land in its own ring under the same id, and fleetview stitches
+        # the rings into one Perfetto file with one lane per pid
+        ctx = wire.trace_context_from_wire(request.trace_context)
+        with TRACER.start_span("solver.service.Solve", context=ctx,
+                               pods=len(request.pods), tenant=tenant,
+                               transport="fleet") as span:
+            t0 = _time.perf_counter()
+            ticket = self.frontend.submit(
+                tenant,
+                [wire.pod_from_wire(m) for m in request.pods],
+                [wire.existing_from_wire(m) for m in request.existing],
+                list(request.daemon_overhead) or None,
+                deadline_ms=int(request.deadline_ms),
+                trace_context=span.context())
+            timeout = self.solve_timeout_s
+            if request.deadline_ms:
+                timeout = min(timeout, request.deadline_ms / 1000.0 + 1.0)
+            try:
+                result = ticket.wait(timeout)
+            except FleetShed as e:
+                span.set_attribute("outcome", "shed")
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+            except TenantNotSynced as e:
+                span.set_attribute("outcome", "not-synced")
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+            except TimeoutError as e:
+                span.set_attribute("outcome", "timeout")
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+            solve_ms = (_time.perf_counter() - t0) * 1000
+            resp = result_to_response(result, solve_ms, seqnum)
+            resp.routing = "fleet"
+            resp.bucket = ticket.plan.label()
+            return resp

@@ -127,6 +127,26 @@ MEMBERSHIP_TRANSITIONS = REGISTRY.counter(
     "~1/R of tenants.",
     ("event",))
 
+# -- federation scrape plane (introspect/fleetview.py) ---------------------
+# `kind` is the closed ScrapeError vocabulary (timeout/connect/http-NNN/
+# invalid-json/oversized-response) — bounded, so no guard.
+
+SCRAPE_ERRORS = REGISTRY.counter(
+    "karpenter_fleet_scrape_errors_total",
+    "Federated statusz scrapes that degraded to a named error row, by "
+    "failure kind (HttpReplica hardening: timeout, connect, http-<code>, "
+    "invalid-json, oversized-response). Each failure also feeds the "
+    "per-replica probe breaker, so a corpse backs off instead of "
+    "costing every fleetz snapshot a timeout.",
+    ("kind",))
+
+SCRAPE_LATENCY = REGISTRY.histogram(
+    "karpenter_fleet_scrape_latency_seconds",
+    "Wall-clock cost of one successful per-replica statusz scrape over "
+    "HTTP (the same number surfaced per row as scrape_ms in "
+    "/debug/fleetz). Rising scrape latency is the gray-failure smell "
+    "at the observability layer.")
+
 # -- failover plane (fleet/failover.py) ------------------------------------
 
 FAILOVER_REROUTES = REGISTRY.counter(

@@ -1,0 +1,399 @@
+"""Replica runtime: one solver replica as its own OS process.
+
+Everything the fleet layer proved in-process (rendezvous routing,
+health-gated membership, client-side failover, federated observability)
+meets real process boundaries here. A replica subprocess runs
+
+    python -m karpenter_tpu fleet-replica --name r0 --rendezvous DIR
+
+which boots a SolverService behind a FleetFrontend + FleetService on an
+EPHEMERAL gRPC port, starts the standard ServingPlane debug listeners
+(also port 0 — N replicas on one host never collide), and then announces
+its resolved addresses through a filesystem rendezvous: one atomically
+renamed `<name>.json` per replica in a shared directory. The parent
+(benchmarks/fleet_drill.py, tests) waits on those files and wires the
+REAL endpoints into the same client objects the in-process drills use:
+
+* `HttpReplica(debug_url)` -> FleetView federates live `/debug/statusz`
+  and `/debug/traces` over HTTP (introspect/fleetview.py);
+* `http_probe(health_url)` -> MembershipManager heartbeats measure real
+  HTTP round-trips, so the gray-failure latency detector sees genuine
+  tail inflation, not a FakeClock script;
+* `GrpcReplicaTransport(grpc_target)` -> FailoverClient's per-replica
+  transport table speaks the real solver wire protocol, with gRPC
+  status codes mapped onto the failover taxonomy (UNAVAILABLE ->
+  ReplicaUnavailable, DEADLINE_EXCEEDED -> ReplicaTimeout, anything
+  else -> ReplicaCrashed).
+
+The serving side reuses ServingPlane + statusz verbatim: the replica's
+"operator" is a shim that carries exactly the surfaces a solver replica
+has (metrics registry, event recorder, wall clock, flight recorder) and
+lets the op-scoped statusz sections degrade through their fences. The
+sections federation actually reads — fleet frontends, the HBM ledger,
+profiling's gap ledger, the decision ring, metrics — are all op-free and
+therefore REAL in the subprocess.
+
+Clocks: rendezvous records and the shim's statusz `ts` use wall time
+(utils.clock.WallClock), because these timestamps are compared ACROSS
+processes (fleetz staleness_s); monotonic clocks are per-process.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+from ..utils.clock import WallClock
+
+log = logging.getLogger("karpenter.fleet.replica")
+
+RENDEZVOUS_SCHEMA = 1
+
+# parent-side default: how long to wait for a spawned replica's
+# rendezvous file before declaring the boot failed (cold JAX import on a
+# busy single-core host takes tens of seconds)
+DEFAULT_BOOT_TIMEOUT_S = 180.0
+
+
+# -- rendezvous (filesystem handshake) --------------------------------------
+
+
+def registration_path(rendezvous_dir: str, name: str) -> str:
+    return os.path.join(rendezvous_dir, f"{name}.json")
+
+
+def write_registration(rendezvous_dir: str, record: dict) -> str:
+    """Atomically publish one replica's resolved addresses: write to a
+    tmp file, fsync, rename. A reader either sees no file or a COMPLETE
+    record — never a torn JSON body (the HttpReplica invalid-json
+    hardening exists for the network path, not for the handshake)."""
+    os.makedirs(rendezvous_dir, exist_ok=True)
+    path = registration_path(rendezvous_dir, record["name"])
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_registrations(rendezvous_dir: str) -> "dict[str, dict]":
+    """All complete registrations in the directory, by replica name.
+    Unreadable/partial files are skipped (the writer is mid-rename)."""
+    out: "dict[str, dict]" = {}
+    if not os.path.isdir(rendezvous_dir):
+        return out
+    for fn in sorted(os.listdir(rendezvous_dir)):
+        if not fn.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(rendezvous_dir, fn)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(rec, dict) and rec.get("name"):
+            out[rec["name"]] = rec
+    return out
+
+
+def wait_for_registrations(rendezvous_dir: str, names,
+                           timeout_s: float = DEFAULT_BOOT_TIMEOUT_S,
+                           poll_s: float = 0.25) -> "dict[str, dict]":
+    """Block until every named replica has published its registration;
+    raises TimeoutError naming the stragglers."""
+    names = set(names)
+    deadline = time.monotonic() + timeout_s
+    while True:
+        regs = read_registrations(rendezvous_dir)
+        if names <= set(regs):
+            return {n: regs[n] for n in sorted(names)}
+        if time.monotonic() >= deadline:
+            missing = sorted(names - set(regs))
+            raise TimeoutError(
+                f"replicas never registered in {rendezvous_dir} within "
+                f"{timeout_s:.0f}s: {missing}")
+        time.sleep(poll_s)
+
+
+# -- the serving side (runs inside the subprocess) --------------------------
+
+
+class _ReplicaShim:
+    """The minimal "operator" a solver replica has. statusz(op) walks
+    this: the sections a replica genuinely owns (metrics, events, fleet
+    frontends, HBM, profiling, decisions, serving ports) are real; the
+    controller-plane sections (cluster, watchdog, queues, caches) degrade
+    through their per-section fences — statusz was built to stay up with
+    subsystems missing, and a replica is exactly that."""
+
+    def __init__(self, name: str):
+        from ..events import EventRecorder
+        from ..introspect.flightrecorder import FlightRecorder
+        from ..metrics import REGISTRY
+
+        self.name = name
+        self.clock = WallClock()
+        self.recorder = EventRecorder(clock=self.clock)
+        self.flightrecorder = FlightRecorder(self, clock=self.clock)
+        self.fleetview = None  # replicas are federated, they don't federate
+        self.serving = None    # set once the plane is started
+        self._registry = REGISTRY
+
+    def metrics_text(self) -> str:
+        return self._registry.expose()
+
+    def healthz(self) -> bool:
+        return True
+
+    def livez(self) -> bool:
+        return True
+
+    class _Resilience:
+        @staticmethod
+        def snapshot() -> dict:
+            return {"watchdog": {"healthy": True}}
+
+    resilience = _Resilience()
+
+
+class ReplicaRuntime:
+    """Boots and owns one replica's serving stack inside the current
+    process: SolverService -> FleetFrontend -> FleetService on gRPC,
+    plus the ServingPlane debug listeners, plus the rendezvous
+    announcement. `start()` returns the published registration record."""
+
+    def __init__(self, name: str, rendezvous_dir: str,
+                 grpc_port: int = 0, debug_port: int = 0,
+                 max_wave: int = 16, tick_interval_s: float = 0.01,
+                 solve_timeout_s: float = 60.0,
+                 starvation_bound: int = 4):
+        self.name = name
+        self.rendezvous_dir = rendezvous_dir
+        self.grpc_port = grpc_port
+        self.debug_port = debug_port
+        self.max_wave = max_wave
+        self.tick_interval_s = tick_interval_s
+        self.solve_timeout_s = solve_timeout_s
+        self.starvation_bound = starvation_bound
+        self.registration: "Optional[dict]" = None
+        self.frontend = None
+        self.service = None
+        self._grpc_server = None
+        self._plane = None
+        self._op: "Optional[_ReplicaShim]" = None
+
+    def start(self) -> dict:
+        from ..serving import ServingPlane
+        from ..solver.service import SolverService, serve
+        from .frontend import FleetFrontend, FleetService
+
+        self.service = SolverService()
+        self.frontend = FleetFrontend(
+            self.service, tick_interval_s=self.tick_interval_s,
+            max_wave=self.max_wave, name=self.name,
+            starvation_bound=self.starvation_bound)
+        self.frontend.start()
+        fleet_service = FleetService(self.frontend,
+                                     solve_timeout_s=self.solve_timeout_s)
+        self._grpc_server, grpc_port, _svc = serve(
+            f"127.0.0.1:{self.grpc_port}", max_workers=8,
+            service=fleet_service)
+        self._op = _ReplicaShim(self.name)
+        self._plane = ServingPlane(self._op, metrics_port=self.debug_port,
+                                   health_port=0, webhook_port=-1)
+        bound = self._plane.start()
+        self._op.serving = self._plane
+        self.registration = {
+            "schema": RENDEZVOUS_SCHEMA,
+            "name": self.name,
+            "pid": os.getpid(),
+            "ts": time.time(),
+            "grpc": f"127.0.0.1:{grpc_port}",
+            "debug": f"http://127.0.0.1:{bound['metrics']}",
+            "health": f"http://127.0.0.1:{bound['health']}",
+        }
+        write_registration(self.rendezvous_dir, self.registration)
+        log.info("replica %s registered: %s", self.name, self.registration)
+        return self.registration
+
+    def stop(self) -> None:
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace=1.0)
+            self._grpc_server = None
+        if self.frontend is not None:
+            self.frontend.stop()
+        if self._plane is not None:
+            self._plane.stop()
+            self._plane = None
+        # withdraw the registration so a rendezvous reader doesn't keep
+        # discovering a gone replica (a SIGKILLed replica can't — its
+        # stale record is exactly what the membership probes then eject)
+        try:
+            os.unlink(registration_path(self.rendezvous_dir, self.name))
+        except OSError:
+            pass
+
+
+def run_replica_main(args) -> int:
+    """`python -m karpenter_tpu fleet-replica` body: boot, announce,
+    serve until SIGTERM/SIGINT."""
+    import signal
+
+    rt = ReplicaRuntime(
+        args.name, args.rendezvous, grpc_port=args.grpc_port,
+        debug_port=args.debug_port, max_wave=args.max_wave,
+        tick_interval_s=args.tick_interval,
+        starvation_bound=getattr(args, "starvation_bound", 4))
+    reg = rt.start()
+    # one parseable ready line for humans/logs; the rendezvous FILE is
+    # the machine-readable handshake
+    print("REPLICA_READY " + json.dumps(reg, sort_keys=True), flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda signum, frame: stop.set())
+    while not stop.is_set():
+        stop.wait(0.2)
+    rt.stop()
+    return 0
+
+
+# -- the client side (runs in the parent / drill process) -------------------
+
+
+def subprocess_env(name: "Optional[str]" = None) -> dict:
+    """The hygienic environment every drill subprocess launches with:
+    force the CPU backend with ONE XLA host device (N subprocesses
+    timesharing one core must not each fan out eight device threads) and
+    drop any inherited accelerator-pool pointers. Shared by
+    `spawn_replica` and the subprocess-spawning tests so there is one
+    harness, not several half-copies of it."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    if name:
+        env["KARPENTER_TPU_REPLICA_NAME"] = name
+    return env
+
+
+def spawn_replica(name: str, rendezvous_dir: str, *, grpc_port: int = 0,
+                  debug_port: int = 0, max_wave: int = 16,
+                  tick_interval_s: float = 0.01,
+                  starvation_bound: int = 4,
+                  log_dir: "Optional[str]" = None) -> subprocess.Popen:
+    """Launch one replica subprocess (env hygiene: `subprocess_env`).
+    stdout/stderr land in `<log_dir>/<name>.log` for post-mortems."""
+    env = subprocess_env(name)
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    cmd = [sys.executable, "-m", "karpenter_tpu", "fleet-replica",
+           "--name", name, "--rendezvous", rendezvous_dir,
+           "--grpc-port", str(grpc_port), "--debug-port", str(debug_port),
+           "--max-wave", str(max_wave),
+           "--tick-interval", str(tick_interval_s),
+           "--starvation-bound", str(starvation_bound)]
+    os.makedirs(log_dir or rendezvous_dir, exist_ok=True)
+    logf = open(os.path.join(log_dir or rendezvous_dir, f"{name}.log"),
+                "wb")
+    try:
+        return subprocess.Popen(cmd, stdout=logf, stderr=subprocess.STDOUT,
+                                env=env, cwd=repo_root)
+    finally:
+        logf.close()  # the child holds its own fd
+
+
+def http_probe(health_url: str, timeout_s: float = 2.0):
+    """A MembershipManager probe against a live replica's /healthz:
+    returns the measured round-trip LATENCY in seconds (feeding the
+    gray-failure quantile detector with real numbers), raises on any
+    failure (feeding the K-missed-beats detector)."""
+    url = health_url.rstrip("/") + "/healthz"
+
+    def probe() -> float:
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            body = resp.read(64)
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"{url}: HTTP {resp.status} {body[:32]!r}")
+        return time.perf_counter() - t0
+
+    return probe
+
+
+class GrpcReplicaTransport:
+    """One replica's solve transport, shaped for FailoverClient's
+    transports table: `transport(tenant_id, request, timeout_s)`.
+
+    `request` is a pb.SolveRequest TEMPLATE; each call sends a copy with
+    the tenant stamped, so hedges (two replicas racing one logical
+    request from two threads) never serialize a message being mutated.
+    gRPC status codes map onto the failover taxonomy the in-process
+    drills established; trace_context on the template rides through
+    unchanged, which is how a drill's client span federates with the
+    serving replica's `solver.service.Solve` span."""
+
+    def __init__(self, name: str, target: str):
+        import grpc
+
+        from ..solver.service import METHODS, SERVICE_NAME
+
+        self.name = name
+        self.target = target
+        self._grpc = grpc
+        self._channel = grpc.insecure_channel(target)
+        self._stubs = {
+            method: self._channel.unary_unary(
+                f"/{SERVICE_NAME}/{method}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=resp_cls.FromString,
+            )
+            for method, (_req_cls, resp_cls) in METHODS.items()
+        }
+
+    def sync(self, catalog, provisioners, timeout_s: float = 120.0):
+        """Push (catalog, provisioners) content to the replica; the fleet
+        frontend admits tenants against the returned content hashes."""
+        from ..solver import solver_pb2 as pb
+        from ..solver import wire
+
+        req = pb.SyncRequest(
+            catalog=wire.catalog_to_wire(catalog),
+            provisioners=[wire.provisioner_to_wire(p)
+                          for p in provisioners])
+        return self._stubs["Sync"](req, timeout=timeout_s)
+
+    def __call__(self, tenant_id: str, request, timeout_s: float):
+        from ..solver import solver_pb2 as pb
+        from .failover import (ReplicaCrashed, ReplicaTimeout,
+                               ReplicaUnavailable)
+
+        msg = pb.SolveRequest()
+        msg.CopyFrom(request)
+        msg.tenant_id = tenant_id
+        grpc = self._grpc
+        try:
+            return self._stubs["Solve"](msg, timeout=timeout_s)
+        except grpc.RpcError as e:
+            code = e.code()
+            if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                raise ReplicaTimeout(
+                    f"{self.name}: {e.details()}") from e
+            if code == grpc.StatusCode.UNAVAILABLE:
+                raise ReplicaUnavailable(
+                    f"{self.name}: {e.details()}") from e
+            # INTERNAL/UNKNOWN/CANCELLED: the replica broke while holding
+            # this request — the failover layer treats it as a suspect
+            raise ReplicaCrashed(
+                f"{self.name}: {code.name}: {e.details()}") from e
+
+    def close(self) -> None:
+        self._channel.close()

@@ -33,6 +33,8 @@ feature.
 from __future__ import annotations
 
 import json
+import os
+import socket
 import threading
 import time
 import urllib.error
@@ -43,7 +45,7 @@ from ..resilience import CircuitBreaker
 from ..tracing import TRACER, Tracer
 from ..utils.clock import Clock
 
-FLEETZ_SCHEMA_VERSION = 1
+FLEETZ_SCHEMA_VERSION = 2  # 2: scrape_ms/staleness_s/pid per row
 
 # fan-out budget per replica fetch; a wedged replica costs one timeout,
 # not a hung fleetz
@@ -55,6 +57,24 @@ DEFAULT_TIMEOUT_S = 2.0
 # per backoff window, not DEFAULT_TIMEOUT_S on EVERY snapshot forever
 PROBE_FAILURE_THRESHOLD = 3
 PROBE_BACKOFF_S = 30.0
+
+# oversized-response clamp: a statusz/spans payload past this bound is a
+# misbehaving replica (the summary extracts KBs, full snapshots are
+# ~100KB) — name it instead of buffering an unbounded body into the join
+MAX_SCRAPE_BYTES = 4 << 20
+
+
+class ScrapeError(RuntimeError):
+    """A classified scrape failure. `kind` is the closed vocabulary the
+    error row (and the karpenter_fleet_scrape_errors_total counter) is
+    named with: timeout | connect | http-<code> | invalid-json |
+    oversized-response. Raised (not swallowed) so the caller's probe
+    breaker still counts the failure and backs off."""
+
+    def __init__(self, kind: str, detail: str):
+        super().__init__(f"{kind}: {detail}")
+        self.kind = kind
+        self.detail = detail
 
 
 class LocalReplica:
@@ -69,6 +89,9 @@ class LocalReplica:
         self.name = name
         self._statusz = statusz
         self.tracer = tracer
+        # same-process by definition; federated_trace lanes it under the
+        # client's own pid (span dedupe keeps a shared ring honest)
+        self.pid = os.getpid()
 
     def statusz(self) -> "Optional[dict]":
         return self._statusz() if self._statusz is not None else None
@@ -83,20 +106,73 @@ class LocalReplica:
 
 class HttpReplica:
     """A remote replica endpoint: the debug surfaces of its serving
-    plane (serving.py) over HTTP. Every fetch is individually guarded —
-    errors surface as None/[] and the join names them."""
+    plane (serving.py) over HTTP, hardened for the live-fleet case.
+
+    Every failure mode of the scrape path is CLASSIFIED, never raised
+    raw: connect refusal, read/connect timeout, HTTP error status, a
+    truncated or otherwise invalid JSON body, and an oversized response
+    (clamped at MAX_SCRAPE_BYTES) each raise `ScrapeError` with a named
+    kind — the FleetView join turns that into a named error row, and
+    because it still RAISES, the existing per-replica probe breaker
+    counts the failure and backs off exactly as before.
+
+    `pid` is learned from the replica's own statusz/spans payloads
+    (serving.py stamps os.getpid()); the federated trace lanes spans
+    under the replica's REAL pid once observed."""
 
     def __init__(self, name: str, base_url: str,
-                 timeout_s: float = DEFAULT_TIMEOUT_S):
+                 timeout_s: float = DEFAULT_TIMEOUT_S,
+                 max_bytes: int = MAX_SCRAPE_BYTES):
         self.name = name
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        self.max_bytes = max_bytes
+        self.pid: "Optional[int]" = None       # learned from payloads
+        self.last_scrape_ms: "Optional[float]" = None
+        self.last_scrape_ts: "Optional[float]" = None
 
     def _get_json(self, path: str):
-        req = urllib.request.Request(self.base_url + path,
+        url = self.base_url + path
+        req = urllib.request.Request(url,
                                      headers={"Accept": "application/json"})
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-            return json.loads(resp.read().decode("utf-8"))
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as resp:
+                # read ONE byte past the clamp: len > max_bytes proves the
+                # body kept going without ever buffering all of it
+                body = resp.read(self.max_bytes + 1)
+        except urllib.error.HTTPError as e:
+            raise ScrapeError(f"http-{e.code}", f"{url}: {e.reason}") from e
+        except (socket.timeout, TimeoutError) as e:
+            raise ScrapeError(
+                "timeout", f"{url}: no response within "
+                f"{self.timeout_s:.1f}s") from e
+        except urllib.error.URLError as e:
+            reason = getattr(e, "reason", e)
+            if isinstance(reason, (socket.timeout, TimeoutError)):
+                raise ScrapeError(
+                    "timeout", f"{url}: no response within "
+                    f"{self.timeout_s:.1f}s") from e
+            raise ScrapeError("connect", f"{url}: {reason}") from e
+        except OSError as e:  # connection reset mid-read and kin
+            raise ScrapeError("connect", f"{url}: {e}") from e
+        if len(body) > self.max_bytes:
+            raise ScrapeError(
+                "oversized-response",
+                f"{url}: body exceeds {self.max_bytes} bytes (clamped)")
+        try:
+            doc = json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as e:
+            raise ScrapeError(
+                "invalid-json",
+                f"{url}: unparseable body ({e}; truncated write or "
+                f"non-JSON error page)") from e
+        self.last_scrape_ms = (time.perf_counter() - t0) * 1e3
+        self.last_scrape_ts = time.time()
+        if isinstance(doc, dict) and isinstance(doc.get("pid"), int):
+            self.pid = doc["pid"]
+        return doc
 
     def statusz(self) -> "Optional[dict]":
         return self._get_json("/debug/statusz")
@@ -104,8 +180,8 @@ class HttpReplica:
     def trace_spans(self, trace_id: str) -> "list[dict]":
         try:
             doc = self._get_json(f"/debug/traces?id={trace_id}&format=spans")
-        except urllib.error.HTTPError as e:
-            if e.code == 404:  # replica has no spans for this id
+        except ScrapeError as e:
+            if e.kind == "http-404":  # replica has no spans for this id
                 return []
             raise
         return doc.get("spans", [])
@@ -187,6 +263,16 @@ class FleetView:
             self._probe_breakers[name] = br
         return br
 
+    @staticmethod
+    def _scrape_metrics():
+        """Lazy: fleet/metrics pulls the fleet package (and through it the
+        solver stack); fleetview must stay importable without either."""
+        try:
+            from ..fleet import metrics as fleet_metrics
+        except Exception:  # noqa: BLE001 — metrics are best-effort here
+            return None
+        return fleet_metrics
+
     def _replica_summary(self, replica) -> dict:
         """One replica's row: fetched + fenced. The summary extracts the
         triage-relevant subset of statusz (full snapshots federate badly
@@ -194,7 +280,13 @@ class FleetView:
         came from discoverable by name. A replica that keeps failing is
         probed through a breaker: PROBE_FAILURE_THRESHOLD consecutive
         failures suppress the fetch until the backoff window lapses, so
-        a corpse never costs every snapshot a full timeout."""
+        a corpse never costs every snapshot a full timeout.
+
+        With real subprocess replicas the row additionally carries the
+        scrape evidence itself: scrape_ms (HTTP round-trip), staleness_s
+        (view clock minus the snapshot's own ts), and the serving
+        process's pid. Classified transport failures (ScrapeError) keep
+        their kind as `scrape_error` so the error row names WHY."""
         name = replica.name
         breaker = self._probe_breaker(name)
         fails = self._consec_failures.get(name, 0)
@@ -205,30 +297,56 @@ class FleetView:
                              f"{PROBE_BACKOFF_S:.0f}s backoff)",
                     "probe_suppressed": True,
                     "consecutive_failures": fails}
+        t0 = time.perf_counter()
         try:
             snap = replica.statusz()
         except Exception as e:  # noqa: BLE001 — a dead replica is a row, not an outage
             breaker.record_failure()
             self._consec_failures[name] = fails + 1
-            return {"healthy": False, "error": f"{type(e).__name__}: {e}",
-                    "consecutive_failures": fails + 1}
+            row = {"healthy": False, "error": f"{type(e).__name__}: {e}",
+                   "consecutive_failures": fails + 1}
+            if isinstance(e, ScrapeError):
+                row["scrape_error"] = e.kind
+                fm = self._scrape_metrics()
+                if fm is not None:
+                    fm.SCRAPE_ERRORS.inc(kind=e.kind)
+            return row
+        scrape_ms = (time.perf_counter() - t0) * 1e3
+        fm = self._scrape_metrics()
+        if fm is not None:
+            fm.SCRAPE_LATENCY.observe(scrape_ms / 1e3)
         # the transport answered: the backoff targets timeout burn, so a
         # reachable replica with a degraded payload still resets it
         breaker.record_success()
         self._consec_failures[name] = 0
         if not snap:
             return {"healthy": False, "error": "no statusz",
+                    "scrape_ms": round(scrape_ms, 3),
                     "consecutive_failures": 0}
         if "error" in snap and len(snap) == 1:
             return {"healthy": False, "error": snap["error"],
+                    "scrape_ms": round(scrape_ms, 3),
                     "consecutive_failures": 0}
         out = {
             "healthy": True,
             "schema": snap.get("schema"),
             "version": snap.get("version"),
             "ts": snap.get("ts"),
+            "scrape_ms": round(scrape_ms, 3),
             "consecutive_failures": 0,
         }
+        pid = snap.get("pid")
+        if isinstance(pid, int):
+            out["pid"] = pid
+        ts = snap.get("ts")
+        if isinstance(ts, (int, float)):
+            # staleness of the EVIDENCE: how old the replica's self-report
+            # is by the view's clock (meaningful when both share a clock
+            # domain — wall time in the live fleet, FakeClock in tests)
+            out["staleness_s"] = round(max(0.0, self.clock.now() - ts), 3)
+        serving = snap.get("serving")
+        if isinstance(serving, dict) and serving.get("bound"):
+            out["serving"] = serving.get("bound")
         watchdog = (snap.get("resilience") or {}).get("watchdog")
         if isinstance(watchdog, dict):
             out["healthy"] = bool(watchdog.get("healthy", True))
@@ -244,6 +362,10 @@ class FleetView:
                 f.get("name", "?"): f.get("tenant_telemetry")
                 for f in fronts if isinstance(f, dict)}
             out["queued"] = sum(f.get("queued", 0) for f in fronts
+                                if isinstance(f, dict))
+            # per-replica throughput evidence: the drill computes each
+            # replica's solves/s by differencing this across scrapes
+            out["served"] = sum(f.get("served", 0) for f in fronts
                                 if isinstance(f, dict))
         return out
 
@@ -306,14 +428,21 @@ class FleetView:
     def federated_trace(self, trace_id: str) -> "Optional[dict]":
         """One Chrome/Perfetto trace for the id, client + every replica.
 
-        Layout: pid 0 is the client process (this view's tracer — fleet
-        queue-wait, rpc spans), each replica gets its own pid with a
-        process_name metadata event, so Perfetto renders the federation
-        as parallel process lanes sharing one clock. Spans are deduped by
-        span_id (an in-process replica may share the client's ring).
-        Returns None when NOBODY has spans for the id (-> 404)."""
-        lanes: "list[tuple[str, list[dict]]]" = [
-            ("client:" + self.name, self.tracer.trace(trace_id))]
+        Layout: one Perfetto "process" lane per participating OS process
+        — the client lane under THIS process's pid, each replica under
+        its REAL pid when the transport has learned one (HttpReplica
+        reads it off the spans payload; serving.py stamps os.getpid()).
+        Lanes whose pid is unknown or would collide with an
+        already-assigned lane (e.g. two LocalReplicas sharing the
+        client's process) fall back to small synthetic pids, so lanes
+        always stay distinct. Each lane carries a process_name metadata
+        event, so Perfetto renders the federation as parallel process
+        lanes sharing one clock. Spans are deduped by span_id (an
+        in-process replica may share the client's ring). Returns None
+        when NOBODY has spans for the id (-> 404)."""
+        lanes: "list[tuple[str, list[dict], Optional[int]]]" = [
+            ("client:" + self.name, self.tracer.trace(trace_id),
+             os.getpid())]
         with self._lock:
             replicas = sorted(self._replicas.items())
         for name, replica in replicas:
@@ -321,14 +450,27 @@ class FleetView:
                 spans = replica.trace_spans(trace_id)
             except Exception:  # noqa: BLE001 — a dead replica drops its lane only
                 spans = []
-            lanes.append((name, spans))
-        if not any(spans for _name, spans in lanes):
+            # read pid AFTER the fetch: HttpReplica learns it from the
+            # payload it just scraped
+            real = getattr(replica, "pid", None)
+            lanes.append((name, spans,
+                          real if isinstance(real, int) else None))
+        if not any(spans for _name, spans, _pid in lanes):
             return None
         events: "list[dict]" = []
         seen: "set[str]" = set()
-        for pid, (lane_name, spans) in enumerate(lanes):
+        used_pids: "set[int]" = set()
+        synthetic = 0
+        for lane_name, spans, real_pid in lanes:
             if not spans:
                 continue
+            if real_pid is not None and real_pid not in used_pids:
+                pid = real_pid
+            else:
+                while synthetic in used_pids:
+                    synthetic += 1
+                pid = synthetic
+            used_pids.add(pid)
             events.append({"name": "process_name", "ph": "M", "pid": pid,
                            "tid": 0, "args": {"name": lane_name}})
             tids: "dict[str, int]" = {}

@@ -23,7 +23,9 @@ from ..metrics import REGISTRY, Gauge, Histogram
 
 log = logging.getLogger("karpenter.statusz")
 
-SCHEMA_VERSION = 8  # 8: added "decisions" (7: "profiling"; 6: "hbm"; 5: "slo")
+# 9: added "pid" + "serving" (bound listener ports) for cross-process
+# federation (8: "decisions"; 7: "profiling"; 6: "hbm"; 5: "slo")
+SCHEMA_VERSION = 9
 
 # hard caps so a pathological operator can't make statusz unbounded
 MAX_EVENTS = 50
@@ -176,15 +178,34 @@ def _decisions_section() -> dict:
     return explain_snapshot()
 
 
+def _serving_section(op) -> "dict | None":
+    """The ACTUAL bound listener ports (serving.py `ServingPlane.bound`):
+    with port-0 ephemeral binds this is the only place the resolved
+    address is observable from the outside, so federation (fleetview /
+    the replica rendezvous handshake) reads it here."""
+    serving = getattr(op, "serving", None)
+    if serving is None:
+        return None
+    return {"ports": dict(getattr(serving, "ports", {}) or {}),
+            "bound": dict(getattr(serving, "bound", {}) or {})}
+
+
 def snapshot(op) -> dict:
     """The one consistent operator snapshot (see module docstring)."""
+    import os
+
     return {
         "tool": "karpenter_tpu.statusz",
         "schema": SCHEMA_VERSION,
         "version": __version__,
-        "ts": _fenced(op.clock.now),
+        "pid": os.getpid(),
+        # every accessor is deferred into the fence — `op.watchdog.status`
+        # evaluated HERE would escape it on an operator (a replica shim)
+        # that doesn't carry the attribute at all
+        "ts": _fenced(lambda: op.clock.now()),
+        "serving": _fenced(lambda: _serving_section(op)),
         "cluster": _fenced(lambda: _cluster_section(op)),
-        "controllers": _fenced(op.watchdog.status),
+        "controllers": _fenced(lambda: op.watchdog.status()),
         "queues": _fenced(lambda: _queue_section(op)),
         "caches": _fenced(lambda: _cache_section(op)),
         "events": _fenced(lambda: _events_section(op)),

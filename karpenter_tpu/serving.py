@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import ssl
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -67,7 +68,12 @@ _PLURALS = {
 
 
 class ServingPlane:
-    """Owns the three listeners; start() returns the bound ports."""
+    """Owns the three listeners; start() returns the bound ports.
+
+    Port 0 requests an ephemeral bind (N replica subprocesses on one host
+    never collide); the ACTUAL bound ports are returned by start() and
+    kept on `self.bound` so replica registration can hand the resolved
+    address to the rendezvous handshake (fleet/replica.py)."""
 
     def __init__(self, operator, metrics_port: int = 8080,
                  health_port: int = 8081, webhook_port: int = 8443,
@@ -77,6 +83,7 @@ class ServingPlane:
         self.ports = {"metrics": metrics_port, "health": health_port,
                       "webhook": webhook_port}
         self.tls_cert, self.tls_key = tls_cert, tls_key
+        self.bound: "dict[str, int]" = {}
         self._servers: "list[ThreadingHTTPServer]" = []
 
     def start(self) -> "dict[str, int]":
@@ -96,6 +103,7 @@ class ServingPlane:
                              name=f"serve-{name}").start()
             self._servers.append(srv)
             bound[name] = srv.server_address[1]
+        self.bound = dict(bound)
         return bound
 
     def stop(self) -> None:
@@ -176,9 +184,13 @@ class ServingPlane:
                             spans = TRACER.trace(trace_id)
                             if not spans:
                                 return self._text(404, "unknown trace id")
+                            # the serving process's REAL pid rides along so
+                            # a federating client (fleetview) lanes this
+                            # replica's spans under its actual OS process
                             return self._text(
                                 200, json.dumps(
-                                    {"trace_id": trace_id, "spans": spans},
+                                    {"trace_id": trace_id,
+                                     "pid": os.getpid(), "spans": spans},
                                     default=str),
                                 content_type="application/json")
                         # chrome-trace exports carry the continuous

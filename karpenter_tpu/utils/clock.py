@@ -15,6 +15,17 @@ class Clock:
         time.sleep(seconds)
 
 
+class WallClock(Clock):
+    """Epoch-time clock for cross-process evidence. `Clock` is monotonic,
+    which is per-process — timestamps that must be COMPARED across
+    processes (the federated scrape plane's staleness_s: view clock minus
+    a subprocess replica's self-reported statusz ts) need a shared clock
+    domain, and wall time is the only one two pids have."""
+
+    def now(self) -> float:
+        return time.time()
+
+
 class FakeClock(Clock):
     """Manually stepped clock; wakes sleepers when stepped past their deadline."""
 

@@ -310,7 +310,7 @@ class TestDebugDecisionsEndpoint:
         status, snap = _get(
             f"http://127.0.0.1:{ports['metrics']}/debug/statusz")
         assert status == 200
-        assert snap["schema"] == 8
+        assert snap["schema"] == 9
         assert snap["decisions"]["dimensions"] == list(explain.DIMENSIONS)
 
 

@@ -609,14 +609,16 @@ print("WORKER_OK " + json.dumps({
 
 @pytest.mark.slow
 def test_partition_drill_in_real_subprocess():
-    """The drill run as a genuinely separate OS process (the harness
-    tests/test_multiprocess.py uses): proves the plane carries no hidden
-    dependence on this process's global plane switches or metric state."""
-    env = dict(os.environ)
+    """The drill run as a genuinely separate OS process: proves the
+    plane carries no hidden dependence on this process's global plane
+    switches or metric state. Spawn hygiene comes from the SAME harness
+    the real-replica fleet drill uses (fleet/replica.py
+    subprocess_env), so this test and benchmarks/fleet_drill.py can
+    never drift apart on backend/device-count/pool-pointer handling."""
+    from karpenter_tpu.fleet.replica import subprocess_env
+
+    env = subprocess_env()
     env["KT_REPO"] = REPO
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.pop("XLA_FLAGS", None)
     proc = subprocess.Popen([sys.executable, "-c", _DRILL_WORKER],
                             stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT,

@@ -1,0 +1,314 @@
+"""Tier-1 coverage for the real-replica fleet drill (ISSUE 16 tentpole).
+
+Three layers, cheapest first:
+
+* pure determinism: the replay plan is a function of (seed, config)
+  only, and its schedule digest covers the EXACT tenant-id stream the
+  live drill consumes — no subprocesses involved;
+* scrape-plane hardening: every classified HttpReplica failure mode
+  (connect, timeout, invalid JSON, oversized body, HTTP status)
+  degrades to a NAMED fleetz error row while the probe breaker still
+  counts and backs off;
+* the real thing, small: `run_drill` against two genuine replica
+  subprocesses with a mid-run SIGKILL, short window — the tier-1 proof
+  that rendezvous, federation, membership, failover and the invariant
+  audit work across live process boundaries. The full 4-replica /
+  1000-tenant / throughput-floored run rides the slow marker
+  (`make fleet-drill` is its recorded entrypoint).
+"""
+
+import http.server
+import json
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from benchmarks.fleet_drill import (
+    FULL, SMALL, DrillConfig, _Schedule, build_replay_plan, run_drill,
+    schedule_digest)
+from karpenter_tpu.introspect.fleetview import (
+    PROBE_FAILURE_THRESHOLD, FleetView, HttpReplica, ScrapeError)
+from karpenter_tpu.fleet import replica as replica_mod
+
+
+class TestReplayPlan:
+    def test_replay_identical_under_fixed_seed(self):
+        assert build_replay_plan(FULL) == build_replay_plan(FULL)
+        assert build_replay_plan(SMALL) == build_replay_plan(SMALL)
+
+    def test_seed_and_config_change_the_digest(self):
+        base = build_replay_plan(SMALL)
+        reseeded = build_replay_plan(
+            DrillConfig(**{**base_kwargs(SMALL), "seed": 1}))
+        resized = build_replay_plan(
+            DrillConfig(**{**base_kwargs(SMALL), "tenants": 49}))
+        assert reseeded["schedule_digest"] != base["schedule_digest"]
+        assert resized["schedule_digest"] != base["schedule_digest"]
+
+    def test_digest_covers_the_live_schedule_stream(self):
+        """The live _Schedule must emit exactly the stream the plan's
+        digest commits to: the shuffled sweep, then the zipf tail."""
+        cfg = SMALL
+        plan = build_replay_plan(cfg)
+        sched = _Schedule(cfg)
+        sched.deadline = time.perf_counter() + 3600.0
+        drawn = [sched.next() for _ in range(3 * cfg.tenants)]
+        assert plan["schedule_digest"] == schedule_digest(
+            drawn[:cfg.tenants], drawn[cfg.tenants:])
+        assert drawn[:8] == plan["sweep_head"]
+        assert drawn[cfg.tenants:cfg.tenants + 8] == plan["tail_head"]
+        # the sweep names every tenant exactly once
+        assert sorted(drawn[:cfg.tenants]) == [
+            f"tenant-{i:04d}" for i in range(cfg.tenants)]
+
+    def test_schedule_stops_at_deadline_after_sweep(self):
+        cfg = SMALL
+        sched = _Schedule(cfg)
+        sched.deadline = time.perf_counter() - 1.0  # already past
+        drawn = [sched.next() for _ in range(cfg.tenants)]
+        assert all(t is not None for t in drawn)  # sweep always completes
+        assert sched.next() is None               # tail is deadline-gated
+
+    def test_victim_is_a_named_replica(self):
+        for cfg in (FULL, SMALL):
+            plan = build_replay_plan(cfg)
+            assert plan["kill_victim"] in plan["replicas"]
+
+
+def base_kwargs(cfg: DrillConfig) -> dict:
+    from dataclasses import asdict
+
+    d = asdict(cfg)
+    d["warmup_rungs"] = tuple(d["warmup_rungs"])
+    return d
+
+
+# -- scrape-plane hardening (satellite 2's acceptance) ----------------------
+
+
+class _StubHandler(http.server.BaseHTTPRequestHandler):
+    """One behavior per server instance, set via class attribute."""
+
+    behavior = "ok"
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        b = self.behavior
+        if b == "hang":
+            time.sleep(5.0)
+            return
+        if b == "http-500":
+            self.send_error(500, "boom")
+            return
+        if b == "invalid-json":
+            body = b"<html>this is not json</html>"
+        elif b == "oversized":
+            body = b"[" + b"1," * 4096 + b"1]"
+        else:
+            body = json.dumps({"schema": 9, "pid": os.getpid(),
+                               "ts": time.time(),
+                               "resilience": {"watchdog": {"healthy":
+                                                           True}}}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture
+def stub_server():
+    servers = []
+
+    def start(behavior):
+        handler = type("H", (_StubHandler,), {"behavior": behavior})
+        srv = http.server.HTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+        return f"http://127.0.0.1:{srv.server_address[1]}"
+
+    yield start
+    for srv in servers:
+        srv.shutdown()
+        srv.server_close()
+
+
+class TestHttpReplicaHardening:
+    def test_connect_refused_is_classified(self):
+        # bind-then-close guarantees a dead port
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        rep = HttpReplica("corpse", f"http://127.0.0.1:{port}")
+        with pytest.raises(ScrapeError) as ei:
+            rep.statusz()
+        assert ei.value.kind == "connect"
+
+    def test_read_timeout_is_classified(self, stub_server):
+        rep = HttpReplica("slug", stub_server("hang"), timeout_s=0.2)
+        with pytest.raises(ScrapeError) as ei:
+            rep.statusz()
+        assert ei.value.kind == "timeout"
+
+    def test_http_status_is_classified(self, stub_server):
+        rep = HttpReplica("angry", stub_server("http-500"))
+        with pytest.raises(ScrapeError) as ei:
+            rep.statusz()
+        assert ei.value.kind == "http-500"
+
+    def test_invalid_json_is_classified(self, stub_server):
+        rep = HttpReplica("garbled", stub_server("invalid-json"))
+        with pytest.raises(ScrapeError) as ei:
+            rep.statusz()
+        assert ei.value.kind == "invalid-json"
+
+    def test_oversized_body_is_clamped_and_classified(self, stub_server):
+        rep = HttpReplica("bloated", stub_server("oversized"),
+                          max_bytes=64)
+        with pytest.raises(ScrapeError) as ei:
+            rep.statusz()
+        assert ei.value.kind == "oversized-response"
+
+    def test_healthy_scrape_learns_pid_and_latency(self, stub_server):
+        rep = HttpReplica("live", stub_server("ok"))
+        snap = rep.statusz()
+        assert snap["pid"] == os.getpid()
+        assert rep.pid == os.getpid()
+        assert rep.last_scrape_ms > 0
+
+    def test_every_kind_degrades_to_named_error_row(self, stub_server):
+        """The FleetView contract: a failing replica is a NAMED error
+        row carrying the classified kind — never a raised exception,
+        never an anonymous corpse."""
+        view = FleetView(name="hardening")
+        view.add_replica(HttpReplica("garbled", stub_server("invalid-json")))
+        view.add_replica(HttpReplica("bloated", stub_server("oversized"),
+                                     max_bytes=64))
+        view.add_replica(HttpReplica("angry", stub_server("http-500")))
+        rows = view.fleetz()["replicas"]
+        assert rows["garbled"]["scrape_error"] == "invalid-json"
+        assert rows["bloated"]["scrape_error"] == "oversized-response"
+        assert rows["angry"]["scrape_error"] == "http-500"
+        for row in rows.values():
+            assert row["healthy"] is False
+            assert row["error"]
+
+    def test_probe_breaker_still_backs_off(self, stub_server):
+        view = FleetView(name="backoff")
+        view.add_replica(HttpReplica("angry", stub_server("http-500")))
+        for i in range(PROBE_FAILURE_THRESHOLD):
+            row = view.fleetz()["replicas"]["angry"]
+            assert row["scrape_error"] == "http-500"
+            assert row["consecutive_failures"] == i + 1
+        row = view.fleetz()["replicas"]["angry"]
+        assert row.get("probe_suppressed") is True
+
+
+# -- rendezvous handshake ---------------------------------------------------
+
+
+class TestRendezvous:
+    def test_write_then_read_roundtrip(self, tmp_path):
+        rec = {"schema": 1, "name": "r0", "pid": 1234,
+               "grpc": "127.0.0.1:5", "debug": "http://127.0.0.1:6"}
+        replica_mod.write_registration(str(tmp_path), rec)
+        assert replica_mod.read_registrations(str(tmp_path)) == {"r0": rec}
+
+    def test_torn_files_are_skipped(self, tmp_path):
+        (tmp_path / "torn.json").write_text('{"name": "r1", ')
+        replica_mod.write_registration(
+            str(tmp_path), {"schema": 1, "name": "r0"})
+        regs = replica_mod.read_registrations(str(tmp_path))
+        assert list(regs) == ["r0"]
+
+    def test_wait_names_the_stragglers(self, tmp_path):
+        replica_mod.write_registration(
+            str(tmp_path), {"schema": 1, "name": "r0"})
+        with pytest.raises(TimeoutError) as ei:
+            replica_mod.wait_for_registrations(
+                str(tmp_path), ["r0", "r1", "r2"],
+                timeout_s=0.3, poll_s=0.05)
+        assert "r1" in str(ei.value) and "r2" in str(ei.value)
+        assert "r0" not in str(ei.value).split(":")[-1]
+
+
+# -- the real thing, small --------------------------------------------------
+
+# tier-1-sized: two REAL subprocesses, a ~2.5s window, one SIGKILL. The
+# boot dominates (two cold JAX imports timesharing the core), the physics
+# is identical to the full drill.
+TINY = DrillConfig(name="tiny", replicas=2, tenants=24, duration_s=3.0,
+                   workers=6, max_wave=4, warmup_rungs=(2,),
+                   starvation_bound=16)
+
+
+class TestSmallDrill:
+    @pytest.fixture(scope="class")
+    def artifact(self, tmp_path_factory):
+        out = str(tmp_path_factory.mktemp("fleet-drill"))
+        return run_drill(TINY, out)
+
+    def test_drill_passes(self, artifact):
+        assert artifact["passed"], json.dumps(
+            {"criteria": artifact["criteria"],
+             "violations": artifact["violations"]}, indent=2)
+
+    def test_replicas_were_real_processes(self, artifact):
+        pids = {r["pid"] for r in artifact["registrations"].values()}
+        assert len(pids) == TINY.replicas
+        assert os.getpid() not in pids
+
+    def test_every_tenant_reached_a_real_replica(self, artifact):
+        assert artifact["traffic"]["distinct_tenants"] == TINY.tenants
+        assert artifact["traffic"]["errors"] == 0
+
+    def test_kill_was_absorbed_from_scrape_evidence(self, artifact):
+        kill = artifact["kill"]
+        assert kill["recovery_cycles"] is not None
+        assert kill["recovery_cycles"] <= TINY.recovery_limit
+        victim_row = artifact["scrape"]["rows"][kill["victim"]]
+        assert victim_row["healthy"] is False
+        assert victim_row["scrape_error"] == "connect"
+
+    def test_survivor_rows_carry_scrape_provenance(self, artifact):
+        victim = artifact["kill"]["victim"]
+        for name, row in artifact["scrape"]["rows"].items():
+            if name == victim:
+                continue
+            assert row["pid"] == artifact["registrations"][name]["pid"]
+            assert row["scrape_ms"] > 0
+            assert "staleness_s" in row
+
+    def test_federated_trace_spanned_real_processes(self, artifact):
+        fed = artifact["federation"]
+        lanes = fed["lanes"]
+        assert lanes["client:fleet-drill"] == os.getpid()
+        for name, pid in fed["replica_pids"].items():
+            assert lanes[name] == pid
+        assert len(set(lanes.values())) >= 3
+
+    def test_artifact_written_and_replayable(self, artifact):
+        path = artifact["artifact_path"]
+        on_disk = json.load(open(path))
+        assert on_disk["replay"] == build_replay_plan(TINY)
+
+
+@pytest.mark.slow
+def test_full_scale_drill():
+    """The recorded acceptance run: 4 real replicas, 1000 tenants, the
+    2x-single-process throughput floor, one mid-run SIGKILL."""
+    with tempfile.TemporaryDirectory() as out:
+        artifact = run_drill(FULL, out)
+    assert artifact["passed"], json.dumps(
+        {"criteria": artifact["criteria"],
+         "violations": artifact["violations"]}, indent=2)
+    floor = artifact["baseline"]["floor_solves_per_sec"]
+    assert artifact["traffic"]["aggregate_solves_per_sec"] >= floor

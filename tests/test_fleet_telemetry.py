@@ -14,7 +14,7 @@ import pytest
 from karpenter_tpu.apis import wellknown as wk
 from karpenter_tpu.apis.provisioner import Provisioner
 from karpenter_tpu.introspect.fleetview import (FleetView, HttpReplica,
-                                                LocalReplica)
+                                                LocalReplica, ScrapeError)
 from karpenter_tpu.fleet.router import FleetRouter
 from karpenter_tpu.models.instancetype import Catalog, make_instance_type
 from karpenter_tpu.models.requirements import OP_IN, Requirements
@@ -68,7 +68,7 @@ class TestFleetView:
                                                              "t1": 1.0})))
         doc = fv.fleetz()
         assert doc["tool"] == "karpenter-tpu-fleetz"
-        assert doc["schema"] == 1
+        assert doc["schema"] == 2
         assert doc["membership_epoch"] == 2
         assert set(doc["replicas"]) == {"rep-a", "rep-b"}
         for name, row in doc["replicas"].items():
@@ -173,13 +173,23 @@ class TestFleetView:
         assert others and others[0]["replicas"] == ["rep-a"]
 
     def test_http_replica_404_means_no_spans(self, monkeypatch):
+        # _get_json classifies every raw urllib failure into ScrapeError;
+        # trace_spans treats the http-404 kind as "no spans for this id"
+        # (an empty ring, not a scrape failure) and re-raises the rest
         rep = HttpReplica("r", "http://127.0.0.1:1")
 
         def raise_404(*a, **kw):
-            raise urllib.error.HTTPError("u", 404, "nf", {}, None)
+            raise ScrapeError("http-404", "u: nf")
 
         monkeypatch.setattr(rep, "_get_json", raise_404)
         assert rep.trace_spans("abc") == []
+
+        def raise_500(*a, **kw):
+            raise ScrapeError("http-500", "u: boom")
+
+        monkeypatch.setattr(rep, "_get_json", raise_500)
+        with pytest.raises(ScrapeError):
+            rep.trace_spans("abc")
 
 
 class TestHbmLedger:

@@ -451,6 +451,31 @@ class TestRegressGate:
                        "profile_unaccounted_share=0.005")
         assert rc == 0, capsys.readouterr().out
 
+    def test_passing_measured_run_joins_the_band(self, tmp_path,
+                                                 monkeypatch):
+        """The band is a moving window: an in-band MEASURED run records a
+        gate_sample so the band tracks gradual host drift, while a
+        regressing measurement records nothing — a real slowdown must
+        fail the current band, never pull the median toward itself."""
+        import hack.check_perf_regress as gate
+
+        path = self._ledger(tmp_path)
+        before = len(ledger.entries(path))
+        status, _ = gate.check_gate(
+            "baseline_config_ms", {"name": "inflate-100"}, "cpu", "ms",
+            "lower", lambda: 1.3, {}, path, self.HOST)
+        assert status == "ok"
+        es = ledger.entries(path)
+        assert len(es) == before + 1
+        assert es[-1]["value"] == 1.3
+        assert es[-1]["detail"] == {"host": self.HOST, "gate_sample": True}
+
+        status, _ = gate.check_gate(
+            "baseline_config_ms", {"name": "inflate-100"}, "cpu", "ms",
+            "lower", lambda: 99.0, {}, path, self.HOST)
+        assert status == "regress"
+        assert len(ledger.entries(path)) == before + 1
+
     def test_unknown_host_seeds_instead_of_judging(self, tmp_path,
                                                    monkeypatch, capsys):
         """History from OTHER hardware must not judge this machine: with no
