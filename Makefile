@@ -8,9 +8,9 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit lint noretry hotloops crashpoints cardinality phaseacct reasons test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm failover-drill fleet-bench fleet-drill fleet-drill-small telemetry-drill claims diagnose provenance multichip soak perf-regress ledger-backfill profile-drill explain-drill
+.PHONY: presubmit lint noretry hotloops crashpoints cardinality phaseacct reasons test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm failover-drill fleet-bench fleet-drill fleet-drill-small telemetry-drill claims diagnose provenance multichip soak incremental-soak perf-regress ledger-backfill profile-drill explain-drill
 
-presubmit: lint claims provenance noretry hotloops crashpoints cardinality phaseacct reasons perf-regress failover-drill fleet-drill-small test verify-entry  ## what CI runs
+presubmit: lint claims provenance noretry hotloops crashpoints cardinality phaseacct reasons perf-regress failover-drill fleet-drill-small incremental-soak test verify-entry  ## what CI runs
 
 perf-regress:  ## tier-1-sized micro-benches must stay inside the ledger's noise bands
 	$(CPU_ENV) $(PY) hack/check_perf_regress.py
@@ -38,6 +38,11 @@ cardinality:  ## identity labels on metrics must route through the tenant guard
 
 soak:  ## columnar-state soak: 100k nodes / 1M pods under churn, RECORDED
 	$(CPU_ENV) $(PY) bench.py --soak
+
+incremental-soak:  ## tier-1-sized incremental-plane soak (artifact + ledger land in /tmp)
+	$(CPU_ENV) KARPENTER_TPU_SOAK_DIR=$(or $(SOAK_DIR),/tmp/karpenter-incremental-soak) \
+		KARPENTER_TPU_LEDGER=$(or $(SOAK_DIR),/tmp/karpenter-incremental-soak)/ledger.jsonl \
+		$(PY) bench.py --soak --soak-nodes 2000 --soak-pods 20000 --soak-cycles 12
 
 crashpoints:  ## crashpoint catalog and call sites must stay in lockstep
 	$(PY) hack/check_crashpoints.py

@@ -1,0 +1,161 @@
+"""Presubmit-sized probe for the incremental plane's headline ratio.
+
+`gate_probe()` rebuilds the soak's measurement (bench.py --soak,
+incremental section) at gate scale: a small churned fleet, a handful of
+reconcile cycles, the incremental resident-patch cycle timed against the
+legacy full-recompute sweeps it replaces. It returns the steady-state
+encode share — incremental cycle p50 over legacy cycle p50 — which
+hack/check_perf_regress.py trends through the ledger noise band: a
+structural regression (resident patching drifting back toward
+fleet-proportional work) moves this ratio long before any absolute
+latency band would notice at probe scale.
+
+Parity is asserted, not returned: a probe that got faster by diverging
+from the legacy sweeps is a bug, so divergence raises instead of
+reporting a flattering share.
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+import statistics
+import time
+
+
+def gate_probe(n_nodes: int = 1500, cycles: int = 8, qps: int = 120) -> dict:
+    import numpy as np
+
+    from benchmarks.workloads import mixed_workload
+    from karpenter_tpu.apis import wellknown as wk
+    from karpenter_tpu.apis.provisioner import Provisioner
+    from karpenter_tpu.controllers.deprovisioning import \
+        DeprovisioningController
+    from karpenter_tpu.incremental import (DeltaTracker, ResidentCandidates,
+                                           ResidentMasks, empty_node_rows,
+                                           expired_node_rows)
+    from karpenter_tpu.models.cluster import ClusterState, StateNode
+    from karpenter_tpu.models.encode import existing_fit_vector
+    from karpenter_tpu.models.pod import group_pods, make_pod
+    from karpenter_tpu.utils.clock import FakeClock
+
+    rng = random.Random(20260806)
+    now = 1_000_000.0
+    clock = FakeClock(now)
+    provs = [Provisioner(name="p-empty", ttl_seconds_after_empty=10**9),
+             Provisioner(name="p-plain")]
+    for p in provs:
+        p.set_defaults()
+
+    class _Kube:
+        def provisioners(self):
+            return provs
+
+    class _Termination:
+        def request_deletion(self, name):
+            return False
+
+    alloc = wk.capacity_vector({wk.RESOURCE_CPU: 16_000,
+                                wk.RESOURCE_MEMORY: 64 * 2**30,
+                                wk.RESOURCE_PODS: 110})
+    templates = [make_pod(f"tmpl-{i}", cpu=f"{250 * (1 + i % 4)}m",
+                          memory=f"{512 * (1 + i % 4)}Mi",
+                          owner_kind="ReplicaSet") for i in range(4)]
+
+    def fresh_node(name):
+        i = rng.randrange(1 << 30)
+        return StateNode(
+            name=name,
+            labels={wk.LABEL_ZONE: f"zone-1{'abc'[i % 3]}",
+                    wk.LABEL_CAPACITY_TYPE: ("spot" if i % 4 == 0
+                                             else "on-demand"),
+                    wk.LABEL_INSTANCE_TYPE: f"m.size{i % 6}",
+                    "team": f"t{i % 12}"},
+            allocatable=list(alloc),
+            provisioner_name=provs[i % len(provs)].name,
+            price=0.05 + (i % 100) / 1000.0,
+            created_ts=now - (i % 86_400),
+            pods=[dataclasses.replace(templates[j % len(templates)],
+                                      name=f"{name}-p{j}", node_name=name)
+                  for j in range(8)])
+
+    cluster = ClusterState()
+    names = []
+    for k in range(n_nodes):
+        name = f"probe-{k:05d}"
+        cluster.add_node(fresh_node(name))
+        names.append(name)
+    ctrl = DeprovisioningController(
+        kube=_Kube(), cloudprovider=None, cluster=cluster,
+        termination=_Termination(), clock=clock, use_tpu_solver=False)
+    mask_specs = [g.spec for g in group_pods(mixed_workload(40))]
+
+    rmasks = ResidentMasks(cluster)
+    rcands = ResidentCandidates(cluster)
+    tracker = DeltaTracker(cluster)
+    tracker.advance()
+
+    def churn(cycle):
+        for j in range(qps):
+            node = cluster.nodes[names[rng.randrange(len(names))]]
+            op = rng.random()
+            if op < 0.5:
+                t = templates[rng.randrange(len(templates))]
+                cluster.bind_pod(node.name, dataclasses.replace(
+                    t, name=f"probe-churn-{cycle}-{j}", node_name=node.name))
+            elif op < 0.8:
+                if node.pods:
+                    node.pods.pop(rng.randrange(len(node.pods)))
+            else:
+                node.labels["team"] = f"t{rng.randrange(12)}"
+
+    def inc_cycle():
+        t0 = time.perf_counter()
+        tracker.dirty_names()
+        tracker.advance()
+        rmasks.sync(mask_specs)
+        rcands.sync()
+        rcands.eligible_rows()
+        _, ttl_e = ctrl._prov_ttl_columns("ttl_seconds_after_empty")
+        _, ttl_x = ctrl._prov_ttl_columns("ttl_seconds_until_expired")
+        empty_node_rows(cluster, ttl_e)
+        expired_node_rows(cluster, ttl_x, clock.now())
+        return (time.perf_counter() - t0) * 1000
+
+    inc_ms, legacy_ms = [], []
+    for cycle in range(max(3, cycles)):
+        churn(cycle)
+        clock.step(1.0)
+        # incremental first: the resident patch pays the dirty rows'
+        # evictability recomputes itself (same ordering as the soak)
+        ims = inc_cycle()
+        t0 = time.perf_counter()
+        ctrl.reconcile_emptiness()
+        ctrl.reconcile_expiration()
+        cands = cluster.consolidation_candidates()
+        ex = cluster.existing_columns()
+        legacy_vecs = [existing_fit_vector(ex, s) for s in mask_specs]
+        lms = (time.perf_counter() - t0) * 1000
+        if cycle == 0:  # cold full build / cache seeding — not steady state
+            continue
+        inc_ms.append(ims)
+        legacy_ms.append(lms)
+        if not all(np.array_equal(rmasks.mask_for(ex, s), lv)
+                   for s, lv in zip(mask_specs, legacy_vecs)):
+            raise AssertionError("incremental probe: resident mask diverged "
+                                 "from fresh existing_fit_vector fold")
+        if rcands.candidate_names() != sorted(n.name for n in cands):
+            raise AssertionError("incremental probe: resident candidate set "
+                                 "diverged from consolidation_candidates")
+
+    share = statistics.median(inc_ms) / max(statistics.median(legacy_ms),
+                                            1e-9)
+    return {"encode_share": round(share, 4),
+            "inc_cycle_p50_ms": round(statistics.median(inc_ms), 3),
+            "legacy_cycle_p50_ms": round(statistics.median(legacy_ms), 3),
+            "nodes": n_nodes, "cycles_measured": len(inc_ms), "qps": qps}
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(gate_probe(), indent=2, sort_keys=True))

@@ -364,6 +364,27 @@ def _fleet_drill_entries(doc: dict):
                "solves/s", "cpu", degraded, wl, ts)
 
 
+def _incremental_entries(doc: dict):
+    """bench.py --soak incremental artifacts: steady-state incremental
+    cycle p99, the share of the legacy full-recompute cycle it costs
+    (the perf-regress gate trends incremental_steady_encode_share), and
+    the per-cycle bit-parity verdict. Degraded whenever any cycle's
+    parity audit failed."""
+    if doc.get("tool") != "karpenter-tpu-incremental-soak":
+        return
+    degraded = not doc.get("parity_green_every_cycle", False)
+    wl = {"nodes": doc.get("nodes"), "pods": doc.get("pods"),
+          "qps": doc.get("churn_qps_equiv")}
+    for field, metric, unit in (
+            ("cycle_p99_incremental_ms", "cycle_p99_incremental_ms", "ms"),
+            ("cycle_p50_incremental_ms", "cycle_p50_incremental_ms", "ms"),
+            ("steady_encode_share_of_legacy_cycle",
+             "incremental_steady_encode_share", "share"),
+            ("dirty_rows_p50", "incremental_dirty_rows_p50", "rows")):
+        if isinstance(doc.get(field), (int, float)):
+            yield (metric, doc[field], unit, "cpu", degraded, wl, None)
+
+
 _BACKFILL_SOURCES = (
     ("BENCH_r0*.json", "bench.py", _bench_round_entries),
     ("benchmarks/results/bench_*.json", "benchmarks.record",
@@ -379,6 +400,8 @@ _BACKFILL_SOURCES = (
      _fleet_drill_entries),
     ("benchmarks/results/soak/soak_*.json", "bench.py --soak",
      _soak_entries),
+    ("benchmarks/results/incremental/incremental_*.json", "bench.py --soak",
+     _incremental_entries),
     ("benchmarks/results/multichip_wire_*.json", "benchmarks.multichip_wire",
      _multichip_entries),
     ("benchmarks/results/trace_summary_*.json", "hack/summarize_trace",

@@ -80,6 +80,19 @@ def _bench_profile_unaccounted() -> float:
     return float(gate_probe()["unaccounted_share"])
 
 
+def _bench_incremental_share() -> float:
+    """Incremental-plane probe (benchmarks/incremental_probe.gate_probe):
+    a small churned fleet reconciled both ways; the gate trends the
+    steady-state encode share (incremental cycle p50 over the legacy
+    full-recompute cycle p50) so resident patching drifting back toward
+    fleet-proportional work fails presubmit. The probe raises on any
+    mask/candidate parity divergence rather than report a fast-but-wrong
+    share."""
+    from benchmarks.incremental_probe import gate_probe
+
+    return float(gate_probe()["encode_share"])
+
+
 # (metric, workload filter, backend, unit, direction, runner). `direction`
 # is the GOOD direction: "higher" fails below the band, "lower" above it.
 GATES = (
@@ -89,6 +102,9 @@ GATES = (
      "lower", _bench_inflate),
     ("profile_unaccounted_share", {"name": "profile_gate", "pods": 400},
      "cpu", "ratio", "lower", _bench_profile_unaccounted),
+    ("incremental_steady_encode_share",
+     {"name": "incremental_gate", "nodes": 1500}, "cpu", "share",
+     "lower", _bench_incremental_share),
 )
 
 

@@ -551,6 +551,53 @@ def check_membership_noop(membership) -> "list[Violation]":
     return out
 
 
+def check_incremental_noop(incremental) -> "list[Violation]":
+    """incremental-strict-noop: the delta-aware solving plane is an
+    optimization, never load-bearing — with KARPENTER_TPU_INCREMENTAL off
+    every solve is the legacy full solve and the plane does NOTHING. The
+    runner disables it for the scenario and hands us before/after
+    activity counters (karpenter_tpu.incremental.activity()); ANY growth
+    — cycles entered, subproblems extracted, masks patched, escapes
+    tripped — means a producer ignored the switch."""
+    if not incremental or incremental.get("enabled", True):
+        return []  # not part of this drill, or plane was left on
+    out: "list[Violation]" = []
+    before = incremental.get("before") or {}
+    after = incremental.get("after") or {}
+    for key in sorted(set(before) | set(after)):
+        grew = after.get(key, 0) - before.get(key, 0)
+        if grew > 0:
+            out.append(Violation(
+                "incremental-strict-noop",
+                f"incremental disabled but {key} grew by {grew} "
+                f"({before.get(key, 0)} -> {after.get(key, 0)})"))
+    return out
+
+
+def check_incremental_parity(incremental) -> "list[Violation]":
+    """incremental-parity-never-diverges: whenever the plane IS on, every
+    incremental solve carries a scalar-oracle bit-parity audit on the
+    dirty subproblem; a divergence means the small solve would have bound
+    pods differently from the full solve. The plane falls back to the
+    full solve when it happens (correctness survives), but the event
+    itself is the invariant violation — the extractor's soundness
+    argument failed. Evidence: before/after activity counters from an
+    ENABLED window; the audit_divergences counter must not move."""
+    if not incremental:
+        return []
+    if not incremental.get("enabled", True):
+        return []  # the noop check covers the disabled window
+    before = (incremental.get("before") or {}).get("audit_divergences", 0)
+    after = (incremental.get("after") or {}).get("audit_divergences", 0)
+    if after > before:
+        return [Violation(
+            "incremental-parity-never-diverges",
+            f"bit-parity audit diverged {after - before} time(s) during "
+            f"the scenario ({before} -> {after}): the dirty-subproblem "
+            f"solve disagreed with the scalar oracle")]
+    return []
+
+
 def check_remap_blast_radius(before: "dict[str, str]",
                              after: "dict[str, str]",
                              lost: "set[str] | list[str]",
@@ -733,7 +780,8 @@ def check_survivors_progress(before: "dict[str, int]",
 def check_all(op, cloud, token_launches=None,
               consolidation_actions=None,
               resilience=None, profiling=None,
-              explain=None, membership=None) -> "list[Violation]":
+              explain=None, membership=None,
+              incremental=None) -> "list[Violation]":
     out = []
     out += check_token_ledger(token_launches or {})
     out += check_bijection(op, cloud)
@@ -747,4 +795,10 @@ def check_all(op, cloud, token_launches=None,
     out += check_profiling_noop(profiling)
     out += check_explain_noop(explain)
     out += check_membership_noop(membership)
+    # the incremental plane carries TWO windows: the chaotic cycles run
+    # with the plane ON (parity evidence) and the settle runs with it OFF
+    # (strict-noop evidence) — see chaos/runner.py run_scenario
+    inc = incremental or {}
+    out += check_incremental_noop(inc.get("noop"))
+    out += check_incremental_parity(inc.get("parity"))
     return out

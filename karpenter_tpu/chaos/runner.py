@@ -323,6 +323,15 @@ class ChaosRunner:
         from ..fleet import membership as fleet_membership
         mem_prev = fleet_membership.set_enabled(False)
         mem_before = fleet_membership.activity()
+        # incremental plane: TWO windows. The chaotic cycles run with the
+        # plane ON — every reconcile's dirty-subproblem solve carries its
+        # bit-parity audit, and the audit_divergences delta is the
+        # incremental-parity-never-diverges evidence. The settle phase
+        # then flips it OFF for the strict-noop diff (same contract as
+        # profiling/explain/membership).
+        from .. import incremental
+        inc_prev = incremental.set_enabled(True)
+        inc_parity_before = incremental.activity()
         try:
             injector.install(op, cloud)
             self._reconcile_workload(op, workload, injector)
@@ -331,6 +340,9 @@ class ChaosRunner:
                 self._drive_once(op, errors)
                 self._reconcile_workload(op, workload, injector)
                 clock.step(self.CYCLE_SECONDS)
+            inc_parity_after = incremental.activity()
+            incremental.set_enabled(False)
+            inc_noop_before = incremental.activity()
 
             # settle: disarm, clear injected weather, converge
             injector.enabled = False
@@ -399,6 +411,25 @@ class ChaosRunner:
                 "deltas": {k: mem_after[k] - mem_before[k]
                            for k in mem_before},
             }
+            inc_noop_after = incremental.activity()
+            incremental_evidence = {
+                "parity": {"enabled": True,
+                           "before": inc_parity_before,
+                           "after": inc_parity_after},
+                "noop": {"enabled": False,
+                         "before": inc_noop_before,
+                         "after": inc_noop_after},
+            }
+            incremental_stored = {
+                "parity": {"enabled": True,
+                           "deltas": {k: inc_parity_after[k]
+                                      - inc_parity_before[k]
+                                      for k in inc_parity_before}},
+                "noop": {"enabled": False,
+                         "deltas": {k: inc_noop_after[k]
+                                    - inc_noop_before[k]
+                                    for k in inc_noop_before}},
+            }
             violations = invariants.check_all(
                 op, cloud,
                 token_launches=injector.token_launches,
@@ -406,7 +437,8 @@ class ChaosRunner:
                 resilience=resilience_evidence,
                 profiling=profiling_evidence,
                 explain=explain_evidence,
-                membership=membership_evidence)
+                membership=membership_evidence,
+                incremental=incremental_evidence)
             if not self._quiescent(op):
                 violations = [invariants.Violation(
                     "quiescence",
@@ -433,6 +465,7 @@ class ChaosRunner:
             profiling.set_enabled(prof_prev)
             explain.set_enabled(expl_prev)
             fleet_membership.set_enabled(mem_prev)
+            incremental.set_enabled(inc_prev)
             op.stop()
 
         fired_kinds = sorted(injector.fired_kinds())
@@ -453,6 +486,7 @@ class ChaosRunner:
             "profiling": profiling_stored,
             "explain": explain_stored,
             "membership": membership_stored,
+            "incremental": incremental_stored,
             "violations": [v.as_dict() for v in violations],
             "passed": not violations,
         }

@@ -264,6 +264,14 @@ class DeprovisioningController:
                 self.clock.now())
 
         def run_tpu():
+            from .. import incremental
+            if incremental.enabled():
+                # streamed candidate batches: constant-shape chunks through
+                # the resident program instead of one C-lane mega-encode
+                from ..ops.consolidate import stream_consolidation
+                return stream_consolidation(cluster, catalog, all_provs,
+                                            now=self.clock.now(),
+                                            cand_nodes=cands)
             return run_consolidation(cluster, catalog, all_provs,
                                      now=self.clock.now(),
                                      cand_nodes=cands)

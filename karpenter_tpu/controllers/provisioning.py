@@ -114,6 +114,11 @@ class ProvisioningController:
                                clock=self.clock, recorder=self.recorder,
                                registry=reg))
         self.last_solver_kind: "Optional[str]" = None
+        # delta-aware solving plane: extracts the dirty subproblem and
+        # warm-starts a small solve when KARPENTER_TPU_INCREMENTAL is on
+        # (strict-noop otherwise); holds the resident masks between cycles
+        from ..incremental import IncrementalSolver
+        self._incremental = IncrementalSolver(cluster)
         self._machine_seq = 0
         # per-process machine-name suffix: two HA replicas sharing one store
         # must never collide on create (the reference uses generateName)
@@ -208,8 +213,17 @@ class ProvisioningController:
             with TRACER.start_span("provisioning.solve",
                                    pods=len(pods)) as solve_span:
                 t0 = time.perf_counter()
-                result, solver_kind = self._routed_solve(
-                    catalog, provisioners, pods, existing, daemon_overhead)
+                from ..incremental import enabled as _inc_enabled
+                if _inc_enabled():
+                    result, solver_kind = self._incremental.solve(
+                        pods, existing,
+                        lambda ps, ex: self._routed_solve(
+                            catalog, provisioners, ps, ex, daemon_overhead),
+                        catalog=catalog, provisioners=provisioners,
+                        overhead=daemon_overhead)
+                else:
+                    result, solver_kind = self._routed_solve(
+                        catalog, provisioners, pods, existing, daemon_overhead)
                 self.last_solver_kind = solver_kind
                 self.sched_duration.observe(time.perf_counter() - t0,
                                             solver=solver_kind)

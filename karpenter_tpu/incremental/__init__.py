@@ -1,0 +1,55 @@
+"""Incremental solving plane: delta-aware resident repacking.
+
+Makes per-cycle solve cost proportional to CHURN, not fleet size. The
+columnar cluster state already stamps every mutated row with a monotone
+``changed_seq``; this plane keeps three derived structures resident
+between cycles and patches them only at dirty rows:
+
+* :class:`ResidentMasks` — per-spec existing-node fit masks (the mask
+  fold that costs ~145 ms/cycle at 100k nodes when rebuilt from scratch)
+* :class:`ResidentCandidates` — consolidation-eligibility verdicts (the
+  ~407 ms/cycle candidate sweep)
+* the :class:`IncrementalSolver` — extracts the dirty subproblem (changed
+  rows + per-group feasible prefixes), warm-starts a small solve on it,
+  and audits the result against the scalar oracle at bit parity, with a
+  full-solve escape hatch (cold start, churn threshold, entangled
+  constraints, deletion-log gap, audit divergence)
+
+Strict-noop contract: with ``KARPENTER_TPU_INCREMENTAL=0`` nothing here
+runs and no counter moves (chaos invariant ``incremental-strict-noop``);
+while enabled, decisions are bit-identical to the full solve
+(``incremental-parity-never-diverges``).
+"""
+from __future__ import annotations
+
+from .extract import (DEFAULT_MAX_DIRTY_FRAC, ESCAPE_AUDIT_DIVERGENCE,
+                      ESCAPE_COLD_START, ESCAPE_DELETION_LOG_GAP,
+                      ESCAPE_DIRTY_THRESHOLD, ESCAPE_ENTANGLED_GROUP,
+                      ESCAPE_REASONS, MAX_DIRTY_FRAC_ENV, DeltaTracker,
+                      Subproblem, check_escape, entangled,
+                      extract_subproblem, max_dirty_frac,
+                      select_neighborhood)
+from .resident import (ResidentCandidates, ResidentMasks, account_residency,
+                       empty_node_rows, expired_node_rows)
+from .solver import (AUDIT_ENV, IncrementalSolver, audit_enabled, counters,
+                     oracle_fingerprint, solve_fingerprint)
+from .state import FLAG_ENV, disabled, enabled, set_enabled
+
+__all__ = [
+    "AUDIT_ENV", "DEFAULT_MAX_DIRTY_FRAC", "DeltaTracker",
+    "ESCAPE_AUDIT_DIVERGENCE", "ESCAPE_COLD_START",
+    "ESCAPE_DELETION_LOG_GAP", "ESCAPE_DIRTY_THRESHOLD",
+    "ESCAPE_ENTANGLED_GROUP", "ESCAPE_REASONS", "FLAG_ENV",
+    "IncrementalSolver", "MAX_DIRTY_FRAC_ENV", "ResidentCandidates",
+    "ResidentMasks", "Subproblem", "account_residency", "activity",
+    "audit_enabled", "check_escape", "counters", "disabled", "enabled",
+    "empty_node_rows", "entangled", "expired_node_rows",
+    "extract_subproblem", "max_dirty_frac", "oracle_fingerprint",
+    "select_neighborhood", "set_enabled", "solve_fingerprint",
+]
+
+
+def activity() -> "dict[str, int]":
+    """Flat monotone counters for the chaos strict-noop diff: every number
+    here must stay frozen while the plane is disabled."""
+    return dict(counters())

@@ -1,0 +1,174 @@
+"""Dirty-subproblem extraction: changed rows + interference neighborhood.
+
+Given the pending pods and a full snapshot, select the small set of
+existing nodes that can possibly matter to THIS solve, so the solver
+encodes a subproblem whose size tracks churn and demand rather than fleet
+size — while staying DECISION-IDENTICAL to the full solve.
+
+Soundness of the prefix selection: the solver (all rungs parity-match the
+scalar oracle) walks existing nodes in name order and binds at most
+``total_pods`` pods. Every node another group fills consumes at least one
+of those pods, so for any group the full solve's existing-node placements
+land within its first ``2 x total_pods`` nodes that pass (label/taint fit
+AND one-pod headroom): at most ``total_pods`` feasible nodes can fill up
+mid-solve, and the group itself lands on at most its own count — solves
+only ADD pods, so a node without headroom now never gains any mid-solve. The union of those per-group prefixes (plus
+every dirty node, which keeps recently-touched capacity in view for the
+audit) therefore reproduces the full solve's placements exactly.
+
+Groups carrying topology spread, zone anti-affinity, or inter-pod
+(anti-)affinity terms are ENTANGLED: their feasibility depends on domain
+population counts over nodes we'd exclude, so they escape to the full
+solve rather than risk a divergence (docs/troubleshooting.md runbook
+"Why did the full-solve escape hatch fire?").
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..models.cluster import ExistingColumns
+
+MAX_DIRTY_FRAC_ENV = "KARPENTER_TPU_INCREMENTAL_MAX_DIRTY_FRAC"
+DEFAULT_MAX_DIRTY_FRAC = 0.25
+
+# escape-hatch reason vocabulary (the runbook documents each)
+ESCAPE_COLD_START = "cold-start"
+ESCAPE_DIRTY_THRESHOLD = "dirty-set-threshold"
+ESCAPE_ENTANGLED_GROUP = "entangled-group"
+ESCAPE_DELETION_LOG_GAP = "deletion-log-gap"
+ESCAPE_AUDIT_DIVERGENCE = "audit-divergence"
+ESCAPE_REASONS = (ESCAPE_COLD_START, ESCAPE_DIRTY_THRESHOLD,
+                  ESCAPE_ENTANGLED_GROUP, ESCAPE_DELETION_LOG_GAP,
+                  ESCAPE_AUDIT_DIVERGENCE)
+
+
+def max_dirty_frac() -> float:
+    raw = os.environ.get(MAX_DIRTY_FRAC_ENV)
+    if raw is None:
+        return DEFAULT_MAX_DIRTY_FRAC
+    try:
+        val = float(raw)
+    except ValueError:
+        return DEFAULT_MAX_DIRTY_FRAC
+    return val if 0.0 < val <= 1.0 else DEFAULT_MAX_DIRTY_FRAC
+
+
+def entangled(spec) -> bool:
+    """Constraints whose feasibility reads global domain counts — not
+    separable onto a node subset (hostname anti-affinity is fine: its cap
+    is per-node local)."""
+    return bool(spec.topology or spec.pod_affinity or spec.pod_anti_affinity
+                or spec.anti_affinity_zone or spec.anti_affinity_hostname)
+
+
+@dataclasses.dataclass
+class Subproblem:
+    """The dirty subproblem: all pending pods against the selected
+    existing-node neighborhood (a snapshot-order subset of `full`)."""
+    existing: ExistingColumns
+    dirty_names: "list[str]"
+    full_nodes: int
+    escape: "Optional[str]" = None  # set => caller must full-solve
+
+    @property
+    def shrink(self) -> float:
+        """Existing-node reduction factor (1.0 = no shrink)."""
+        if self.full_nodes == 0:
+            return 1.0
+        return len(self.existing) / self.full_nodes
+
+
+class DeltaTracker:
+    """Per-solver cursor over the cluster's mutation sequence. One tracker
+    per consumer (provisioning solver, soak harness) — cursors are consumer
+    state, not cluster state."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self.cursor: "Optional[int]" = None
+
+    def advance(self, seq: "Optional[int]" = None) -> None:
+        self.cursor = self.cluster.seq if seq is None else seq
+
+    def dirty_names(self) -> "tuple[list[str], bool]":
+        """(changed node names since the cursor, deletion-log-complete).
+        Incomplete means deletions beyond the bounded log horizon — the
+        caller must treat the whole fleet as dirty."""
+        if self.cursor is None:
+            return [], False
+        names = self.cluster.dirty_since(self.cursor)
+        deleted, complete = self.cluster.deleted_since(self.cursor)
+        return names, complete
+
+
+def check_escape(groups, full: ExistingColumns, tracker: DeltaTracker,
+                 threshold: "Optional[float]" = None,
+                 ) -> "tuple[Optional[str], list[str]]":
+    """The extract-phase escape gate: (reason or None, dirty node names).
+    Cheap by construction — dirty bookkeeping and spec flag tests only."""
+    if tracker.cursor is None:
+        return ESCAPE_COLD_START, []
+    dirty, complete = tracker.dirty_names()
+    if not complete:
+        return ESCAPE_DELETION_LOG_GAP, dirty
+    limit = max_dirty_frac() if threshold is None else threshold
+    if len(full) and len(dirty) / len(full) > limit:
+        return ESCAPE_DIRTY_THRESHOLD, dirty
+    if any(entangled(g.spec) for g in groups):
+        return ESCAPE_ENTANGLED_GROUP, dirty
+    return None, dirty
+
+
+def select_neighborhood(cluster, groups, full: ExistingColumns,
+                        dirty: "list[str]",
+                        masks: "Optional[object]" = None) -> Subproblem:
+    """The warm-start-phase neighborhood gather (escape gate already
+    passed): per-group feasible prefixes off the resident masks, plus the
+    dirty nodes."""
+    from ..models.encode import existing_fit_vector
+
+    total_pods = sum(g.count for g in groups)
+    # a group walks past at most total_pods nodes that THIS solve filled,
+    # and lands on at most its own count of nodes — 2x covers both
+    depth = 2 * total_pods
+    n = len(full)
+    keep = np.zeros(n, dtype=bool)
+    if n and total_pods:
+        # one-pod headroom per group: alloc - used >= one pod's vector
+        free = full.alloc_rows - full.used_rows
+        for g in groups:
+            fit = None if masks is None else masks.mask_for(full, g.spec)
+            if fit is None:
+                fit = existing_fit_vector(full, g.spec)
+            vec = np.asarray(g.spec.resource_vector(), dtype=np.int64)
+            ok = np.nonzero(fit & np.all(free >= vec, axis=1))[0]
+            keep[ok[:depth]] = True
+    # dirty nodes ride along: recently-touched capacity stays in view and
+    # the audit subproblem covers exactly the churned neighborhood
+    if dirty:
+        pos = {name: i for i, name in enumerate(full.names)}
+        for name in dirty:
+            i = pos.get(name)
+            if i is not None:
+                keep[i] = True
+    idx = np.nonzero(keep)[0]
+    names = [full.names[i] for i in idx]
+    rows = full.rows[idx] if n else np.zeros(0, dtype=np.int64)
+    return Subproblem(existing=ExistingColumns(cluster, names, rows),
+                      dirty_names=dirty, full_nodes=n)
+
+
+def extract_subproblem(cluster, groups, full: ExistingColumns,
+                       tracker: DeltaTracker,
+                       masks: "Optional[object]" = None,
+                       threshold: "Optional[float]" = None) -> Subproblem:
+    """check_escape + select_neighborhood in one call (test surface)."""
+    reason, dirty = check_escape(groups, full, tracker, threshold)
+    if reason is not None:
+        return Subproblem(existing=full, dirty_names=dirty,
+                          full_nodes=len(full), escape=reason)
+    return select_neighborhood(cluster, groups, full, dirty, masks)

@@ -1,0 +1,251 @@
+"""Persistent resident assignment state carried between solve cycles.
+
+The full-solve path rebuilds three things from scratch every cycle, each
+O(fleet): the per-group label/taint fit masks (``existing_fit_vector``
+over a fresh snapshot), the consolidation candidate verdict sweep, and the
+emptiness/expiration scans. The columnar state already tells us exactly
+which rows changed (``changed_seq``), so this module keeps those
+structures RESIDENT in cluster row space and patches them only at dirty
+rows — the host-side analogue of the device-resident catalog: encode cost
+proportional to churn, not fleet size.
+
+Residency is accounted: the arrays file under the ``assignment`` class of
+the HBM ledger (solver/buckets.py) with REPLACE semantics — patching in
+place never grows the footprint, so the ledger carries the actual bytes
+held, exactly like the donated delta buffers.
+
+Coherence contract (audited per cycle by the soak, property-tested in
+tests/test_incremental.py): after ``sync()``, for every tracked spec and
+any snapshot ``ex``, ``masks_for(ex)[key]`` is bit-identical to a fresh
+``existing_fit_vector(ex, spec)``, and ``candidate_names()`` equals
+``cluster.consolidation_candidates()``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..models.cluster import ExistingColumns
+
+
+def _mask_key(spec) -> tuple:
+    """Identity of a fit mask: only requirements + tolerations feed
+    ``existing_fit_vector``, so masks are shared across groups that differ
+    only in resources/counts (the common deployment-scaling churn)."""
+    return (spec.requirements.canonical(), spec.tolerations)
+
+
+class ResidentMasks:
+    """Per-spec node-fit masks in cluster ROW space, patched at dirty rows.
+
+    Row space (not snapshot space) is the trick: snapshots reorder when
+    membership changes, rows don't. A freed row keeps its stale mask bits
+    harmlessly (never gathered — gathers go through ``ex.rows``, which only
+    contains live rows), and row reuse is safe because ``add_node`` marks
+    the reused row dirty.
+    """
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self._cursor: "Optional[int]" = None  # None => cold, full build
+        self._masks: "dict[tuple, np.ndarray]" = {}
+        self._specs: "dict[tuple, object]" = {}
+        # monotone activity counters (chaos strict-noop diffs these)
+        self.patched_rows_total = 0
+        self.full_builds_total = 0
+
+    def nbytes(self) -> int:
+        return sum(m.nbytes for m in self._masks.values())
+
+    def _grow(self, capacity: int) -> None:
+        for key, mask in self._masks.items():
+            if len(mask) < capacity:
+                grown = np.zeros(capacity, dtype=bool)
+                grown[: len(mask)] = mask
+                self._masks[key] = grown
+
+    def _full_snapshot(self) -> ExistingColumns:
+        """Row-space snapshot over ALL occupied rows, marked included —
+        mask bits are maintained for every live row; whether a marked row
+        participates in a solve is the gather's concern, not ours."""
+        cols = self.cluster.columns
+        rows = np.nonzero(cols.occupied)[0]
+        names = [cols.name_of[r] for r in rows]
+        return ExistingColumns(self.cluster, names, rows)
+
+    def sync(self, specs) -> int:
+        """Bring every tracked mask (plus any new `specs`) coherent with
+        the cluster; returns the number of row-patches applied. Cold start
+        (or first sight of a spec) pays one full fold; afterwards the cost
+        is O(dirty rows x specs)."""
+        from ..models.encode import existing_fit_vector
+
+        cluster = self.cluster
+        cols = cluster.columns
+        seq0 = cluster.seq  # capture BEFORE folding: late writers re-patch
+        self._grow(cols.capacity)
+        fresh = []
+        for spec in specs:
+            key = _mask_key(spec)
+            if key not in self._masks:
+                fresh.append((key, spec))
+                self._specs[key] = spec
+        patched = 0
+        if self._cursor is None or (fresh and not self._masks):
+            full = self._full_snapshot()
+            for key, spec in self._specs.items():
+                mask = np.zeros(cols.capacity, dtype=bool)
+                if len(full.rows):
+                    mask[full.rows] = existing_fit_vector(full, spec)
+                self._masks[key] = mask
+                self.full_builds_total += 1
+                patched += len(full.rows)
+            self._cursor = seq0
+            self.patched_rows_total += patched
+            return patched
+        if fresh:
+            full = self._full_snapshot()
+            for key, spec in fresh:
+                mask = np.zeros(cols.capacity, dtype=bool)
+                if len(full.rows):
+                    mask[full.rows] = existing_fit_vector(full, spec)
+                self._masks[key] = mask
+                self.full_builds_total += 1
+                patched += len(full.rows)
+        dirty = np.nonzero(cols.occupied & (cols.changed_seq > self._cursor))[0]
+        if len(dirty):
+            names = [cols.name_of[r] for r in dirty]
+            sub = ExistingColumns(cluster, names, dirty)
+            for key, spec in self._specs.items():
+                self._masks[key][dirty] = existing_fit_vector(sub, spec)
+                patched += len(dirty)
+        self._cursor = seq0
+        self.patched_rows_total += patched
+        return patched
+
+    def mask_for(self, ex: ExistingColumns, spec) -> "Optional[np.ndarray]":
+        """The spec's fit mask gathered into `ex` snapshot order, or None
+        when the spec isn't resident (caller folds fresh)."""
+        mask = self._masks.get(_mask_key(spec))
+        if mask is None:
+            return None
+        if len(ex.rows) == 0:
+            return np.zeros(0, dtype=bool)
+        return mask[ex.rows]
+
+    def drop(self) -> None:
+        """Release all resident masks (escape-hatch full rebuild)."""
+        self._masks.clear()
+        self._specs.clear()
+        self._cursor = None
+
+
+class ResidentCandidates:
+    """Consolidation-eligibility verdicts in row space, patched at dirty
+    rows. The column prefilter (occupied/unmarked/initialized/non-empty/
+    no-veto) stays a vectorized expression; only the expensive per-node
+    evictability+PDB verdict (``node_consolidation_clear``) is cached here
+    and recomputed for dirtied rows. A PDB-set change shifts verdicts on
+    CLEAN rows too (shared headroom), so a pdb-epoch bump drops the cache
+    wholesale."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self._cursor: "Optional[int]" = None
+        self._clear = np.zeros(0, dtype=bool)
+        self._pdb_epoch: "Optional[int]" = None
+        self.patched_rows_total = 0
+        self.full_builds_total = 0
+
+    def nbytes(self) -> int:
+        return int(self._clear.nbytes)
+
+    def sync(self) -> int:
+        """Patch verdicts for dirty rows; returns rows re-verdicted."""
+        cluster = self.cluster
+        cols = cluster.columns
+        seq0 = cluster.seq
+        if len(self._clear) < cols.capacity:
+            grown = np.zeros(cols.capacity, dtype=bool)
+            grown[: len(self._clear)] = self._clear
+            self._clear = grown
+        # epoch bumps lazily inside _pdb_index(); force it current FIRST or
+        # a just-changed PDB set would leave clean rows' verdicts stale for
+        # one cycle (epoch read old -> dirty-only patch -> bump mid-loop)
+        cluster._pdb_index()
+        epoch = cluster._pdb_epoch
+        if self._cursor is None or epoch != self._pdb_epoch:
+            rows = np.nonzero(cols.occupied)[0]
+            self.full_builds_total += 1
+        else:
+            rows = np.nonzero(
+                cols.occupied & (cols.changed_seq > self._cursor))[0]
+        for r in rows:
+            node = cluster.nodes.get(cols.name_of[r])
+            self._clear[r] = (node is not None
+                              and cluster.node_consolidation_clear(node))
+        self._cursor = seq0
+        self._pdb_epoch = epoch
+        self.patched_rows_total += len(rows)
+        return len(rows)
+
+    def eligible_rows(self) -> np.ndarray:
+        """Row indices passing the full gate (prefilter AND verdict) —
+        one vectorized expression, no per-node Python."""
+        cols = self.cluster.columns
+        n = len(self._clear)
+        gate = (cols.occupied[:n] & ~cols.marked[:n] & cols.initialized[:n]
+                & (cols.non_daemon[:n] > 0) & ~cols.no_consolidate[:n]
+                & self._clear[:n])
+        return np.nonzero(gate)[0]
+
+    def candidate_names(self, candidate_filter=None) -> "list[str]":
+        """Name-sorted candidates — the parity twin of
+        ``cluster.consolidation_candidates`` (which returns nodes)."""
+        cols = self.cluster.columns
+        names = sorted(cols.name_of[r] for r in self.eligible_rows())
+        if candidate_filter is None:
+            return names
+        return [n for n in names
+                if candidate_filter(self.cluster.nodes[n])]
+
+    def drop(self) -> None:
+        self._clear = np.zeros(0, dtype=bool)
+        self._cursor = None
+        self._pdb_epoch = None
+
+
+def empty_node_rows(cluster, ttl_rows: "Optional[np.ndarray]" = None,
+                    ) -> np.ndarray:
+    """Vectorized emptiness set: occupied, unmarked, zero non-daemon pods.
+    With `ttl_rows` (the per-row emptiness-TTL array the deprovisioner
+    builds, nan = untracked) this is bit-identical to the emptiness
+    sweep's `empty` mask."""
+    cols = cluster.columns
+    mask = cols.occupied & ~cols.marked & (cols.non_daemon == 0)
+    if ttl_rows is not None:
+        mask = mask & ~np.isnan(ttl_rows)
+    return np.nonzero(mask)[0]
+
+
+def expired_node_rows(cluster, ttl_rows: np.ndarray,
+                      now: float) -> np.ndarray:
+    """Vectorized expiration set against the per-row expiry-TTL array
+    (nan = no expiry), mirroring reconcile_expiration's age test."""
+    cols = cluster.columns
+    with np.errstate(invalid="ignore"):
+        mask = (cols.occupied & ~cols.marked
+                & (now - cols.created_ts >= ttl_rows))
+    return np.nonzero(mask)[0]
+
+
+def account_residency(*residents) -> int:
+    """File the resident arrays' bytes under the HBM ledger's
+    ``assignment`` class (replace semantics — see HbmLedger.set_resident);
+    returns the bytes filed."""
+    from ..solver.buckets import HBM
+
+    total = sum(r.nbytes() for r in residents)
+    HBM.set_resident("incremental", "assignment", float(total))
+    return total

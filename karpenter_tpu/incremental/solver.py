@@ -1,0 +1,190 @@
+"""IncrementalSolver: delta-aware solve orchestration with a bit-parity
+audit and a full-solve escape hatch.
+
+Wraps any base solve callable (the provisioning controller's routed
+ladder, the oracle in tests) behind two new gap-ledger phases:
+
+* ``extract``    — dirty bookkeeping + the escape gate (cold cursor,
+  deletion-log gap, dirty set past the churn threshold, entangled group)
+* ``warm_start`` — resident mask patch (O(dirty x specs)), neighborhood
+  selection, subproblem assembly, HBM ``assignment`` residency accounting
+
+The small solve runs the base callable on the subproblem snapshot; the
+scalar oracle then re-solves THE SAME subproblem and the two decision
+fingerprints must match bit-for-bit (``incremental-parity-never-
+diverges``). Any divergence — or any escape — falls back to the legacy
+full solve, so the plane can only ever cost correctness nothing.
+
+Both phases appear in the Tracer PHASE_REGISTRY and the gap ledger's
+phase table, so "encode cost proportional to churn, not fleet size" is a
+ledger-attributable claim, not a log line.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from ..profiling.gapledger import GAP_LEDGER
+from ..tracing import TRACER
+from . import state
+from .extract import (ESCAPE_AUDIT_DIVERGENCE, ESCAPE_REASONS, DeltaTracker,
+                      check_escape, select_neighborhood)
+from .resident import ResidentMasks, account_residency
+
+AUDIT_ENV = "KARPENTER_TPU_INCREMENTAL_AUDIT"
+
+# plane-global monotone activity counters (chaos strict-noop diffs these)
+_lock = threading.Lock()
+_COUNTS = {
+    "cycles": 0,
+    "incremental_solves": 0,
+    "full_solves": 0,
+    "escape_trips": 0,
+    "audit_divergences": 0,
+    "extracted_rows": 0,
+    "mask_patches": 0,
+}
+_ESCAPES = {reason: 0 for reason in ESCAPE_REASONS}
+
+
+def _bump(**deltas) -> None:
+    with _lock:
+        for key, d in deltas.items():
+            _COUNTS[key] += d
+
+
+def _bump_escape(reason: str) -> None:
+    with _lock:
+        _ESCAPES[reason] = _ESCAPES.get(reason, 0) + 1
+
+
+def counters() -> dict:
+    with _lock:
+        out = dict(_COUNTS)
+        out.update({f"escape_{k.replace('-', '_')}": v
+                    for k, v in _ESCAPES.items()})
+        return out
+
+
+def audit_enabled() -> bool:
+    return os.environ.get(AUDIT_ENV, "1").strip().lower() \
+        not in ("0", "false", "off", "no")
+
+
+def solve_fingerprint(result) -> tuple:
+    """Decision identity of a SolveResult: new-node decisions, per-node
+    existing placements, unschedulable count. Two solves agreeing here
+    bind the same pods to the same capacity."""
+    return (tuple(result.decisions()),
+            tuple(sorted((n, c) for n, c in result.existing_counts.items()
+                         if c)),
+            result.unschedulable_count())
+
+
+def oracle_fingerprint(catalog, provisioners, pods, existing,
+                       overhead=None) -> tuple:
+    """The scalar oracle's fingerprint on the same (sub)problem."""
+    from ..oracle.scheduler import Scheduler
+
+    sched = Scheduler(catalog, provisioners, overhead)
+    res = sched.schedule(list(pods), existing=existing)
+    return (tuple(res.node_decisions(sched.options)),
+            tuple(sorted((n, len(ps))
+                         for n, ps in res.existing_assignments.items()
+                         if ps)),
+            len(res.unschedulable))
+
+
+class IncrementalSolver:
+    """One per consumer (the provisioning controller owns one). Not
+    thread-safe by design: the owning reconcile loop is single-threaded,
+    matching the solver caches it sits beside."""
+
+    def __init__(self, cluster, *, threshold: "Optional[float]" = None):
+        self.cluster = cluster
+        self.tracker = DeltaTracker(cluster)
+        self.masks = ResidentMasks(cluster)
+        self.threshold = threshold
+        self.last: "Optional[dict]" = None  # statusz / debug surface
+
+    # -- the one entry point ------------------------------------------------
+
+    def solve(self, pods, full_existing, base, *, catalog=None,
+              provisioners=None, overhead=None):
+        """base(pods, existing) -> (SolveResult, kind). Returns the same
+        pair. With the plane disabled this method must not run (callers
+        gate on state.enabled()); it still degrades to a bare full solve
+        if reached, touching no counters."""
+        if not state.enabled():
+            return base(pods, full_existing)
+        from ..models.pod import group_pods
+
+        with GAP_LEDGER.solve_scope("solver"):
+            seq0 = self.cluster.seq
+            t0 = time.perf_counter()
+            groups = group_pods(list(pods))
+            reason, dirty = check_escape(groups, full_existing, self.tracker,
+                                         self.threshold)
+            dt = time.perf_counter() - t0
+            TRACER.record_span("solver.extract", dt)
+            GAP_LEDGER.note("extract", dt)
+            _bump(cycles=1, extracted_rows=len(dirty))
+            if reason is not None:
+                return self._full_solve(pods, full_existing, base, reason,
+                                        seq0, dirty)
+
+            t0 = time.perf_counter()
+            patched = self.masks.sync([g.spec for g in groups])
+            sub = select_neighborhood(self.cluster, groups, full_existing,
+                                      dirty, masks=self.masks)
+            resident_bytes = account_residency(self.masks)
+            dt = time.perf_counter() - t0
+            TRACER.record_span("solver.warm_start", dt,
+                               patched_rows=patched,
+                               sub_nodes=len(sub.existing),
+                               full_nodes=sub.full_nodes)
+            GAP_LEDGER.note("warm_start", dt)
+            _bump(mask_patches=patched)
+
+            result, kind = base(pods, sub.existing)
+            if (audit_enabled() and catalog is not None
+                    and provisioners is not None):
+                want = oracle_fingerprint(catalog, provisioners, pods,
+                                          sub.existing, overhead)
+                got = solve_fingerprint(result)
+                if want != got:
+                    _bump(audit_divergences=1)
+                    return self._full_solve(pods, full_existing, base,
+                                            ESCAPE_AUDIT_DIVERGENCE, seq0,
+                                            dirty)
+            self.tracker.advance(seq0)
+            _bump(incremental_solves=1)
+            self.last = {
+                "mode": "incremental",
+                "dirty_nodes": len(dirty),
+                "sub_nodes": len(sub.existing),
+                "full_nodes": sub.full_nodes,
+                "shrink": round(sub.shrink, 5),
+                "patched_rows": patched,
+                "resident_bytes": resident_bytes,
+                "kind": kind,
+            }
+            return result, kind
+
+    def _full_solve(self, pods, full_existing, base, reason, seq0, dirty):
+        _bump(full_solves=1, escape_trips=1)
+        _bump_escape(reason)
+        result, kind = base(pods, full_existing)
+        # the full solve re-establishes coherence as of seq0; mutations
+        # landed after the capture (the solve's own binds) stay dirty
+        self.tracker.advance(seq0)
+        self.last = {
+            "mode": "full",
+            "escape": reason,
+            "dirty_nodes": len(dirty),
+            "full_nodes": len(full_existing),
+            "kind": kind,
+        }
+        return result, kind

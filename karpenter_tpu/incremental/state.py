@@ -1,0 +1,51 @@
+"""Global on/off switch for the incremental solving plane.
+
+The incremental plane is advisory-never-load-bearing (same contract as the
+profiling/explain/membership planes): every producer — the delta tracker,
+the resident mask/candidate patchers, the subproblem solver — checks
+:func:`enabled` before doing ANY work, so disabling the plane is a strict
+no-op (zero counters, zero resident arrays, every solve is the legacy full
+solve). The chaos drill enforces exactly that invariant
+(``incremental-strict-noop``), and the parity audit inside the plane
+enforces the stronger one: whenever it IS on, its decisions are
+bit-identical to the full solve (``incremental-parity-never-diverges``).
+
+Default is ON (the plane exists to carry the steady-state cycle);
+``KARPENTER_TPU_INCREMENTAL=0`` (or ``false``/``off``/``no``) disables it
+at process start, and :func:`set_enabled` / :func:`disabled` flip it at
+runtime (chaos drills, A/B overhead baselines).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+FLAG_ENV = "KARPENTER_TPU_INCREMENTAL"
+_FALSY = ("0", "false", "off", "no")
+
+_lock = threading.Lock()
+_enabled = os.environ.get(FLAG_ENV, "1").strip().lower() not in _FALSY
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip the plane; returns the previous state (restore token)."""
+    global _enabled
+    with _lock:
+        prev = _enabled
+        _enabled = bool(on)
+        return prev
+
+
+@contextlib.contextmanager
+def disabled():
+    """Scoped hard-off: A/B baselines and the chaos strict-noop drill."""
+    prev = set_enabled(False)
+    try:
+        yield
+    finally:
+        set_enabled(prev)

@@ -23,9 +23,10 @@ from ..metrics import REGISTRY, Gauge, Histogram
 
 log = logging.getLogger("karpenter.statusz")
 
-# 9: added "pid" + "serving" (bound listener ports) for cross-process
-# federation (8: "decisions"; 7: "profiling"; 6: "hbm"; 5: "slo")
-SCHEMA_VERSION = 9
+# 10: added "incremental" (delta-solving plane counters + resident
+# residency) (9: "pid" + "serving"; 8: "decisions"; 7: "profiling";
+# 6: "hbm"; 5: "slo")
+SCHEMA_VERSION = 10
 
 # hard caps so a pathological operator can't make statusz unbounded
 MAX_EVENTS = 50
@@ -161,6 +162,20 @@ def _hbm_section() -> dict:
     return HBM.snapshot()
 
 
+def _incremental_section(op) -> dict:
+    # the delta-aware solving plane: gate state, monotone activity
+    # counters, and the provisioning controller's last solve (mode,
+    # dirty/sub/full node counts, escape reason when one fired)
+    from .. import incremental
+
+    out = {"enabled": incremental.enabled(),
+           "counters": incremental.activity()}
+    inc = getattr(getattr(op, "provisioning", None), "_incremental", None)
+    if inc is not None and inc.last is not None:
+        out["last_solve"] = dict(inc.last)
+    return out
+
+
 def _profiling_section() -> dict:
     # the attribution plane's own snapshot: sampler health/overhead, device
     # ladder mode, and the gap ledger's phase totals + last rows
@@ -214,6 +229,7 @@ def snapshot(op) -> dict:
         "fleet": _fenced(_fleet_section),
         "slo": _fenced(lambda: op.slo.snapshot()),
         "hbm": _fenced(_hbm_section),
+        "incremental": _fenced(lambda: _incremental_section(op)),
         "profiling": _fenced(_profiling_section),
         "decisions": _fenced(_decisions_section),
         "metrics": _fenced(_metrics_section),

@@ -27,6 +27,7 @@ legacy views had).
 from __future__ import annotations
 
 import bisect
+import collections
 import dataclasses
 import threading
 from typing import Iterable, Optional
@@ -721,6 +722,14 @@ class ClusterState:
         # soak benchmark's "re-evaluated nodes per cycle" reads deltas of this
         self.evict_recomputes = 0
         self._pref_nodes: "dict[str, set[str]]" = {}
+        # bounded deletion log for delta consumers: deletions release the
+        # row (so changed_seq can't carry them) and only bump _seq. Each
+        # entry is (seq-after-bump, name); once the deque evicts, the floor
+        # rises and deleted_since() reports cursors below it as incomplete,
+        # forcing those consumers to a full resync.
+        self._deletion_log: "collections.deque[tuple[int, str]]" = \
+            collections.deque(maxlen=4096)
+        self._deletion_floor = 0
         # mutator lock: the legacy dict-of-dataclasses state tolerated
         # GIL-interleaved writers (parallel launches call add_node from a
         # thread pool), but the columnar freelist + array-doubling grow do
@@ -766,6 +775,18 @@ class ClusterState:
         cols = self.columns
         hits = np.nonzero(cols.occupied & (cols.changed_seq > cursor))[0]
         return sorted(cols.name_of[r] for r in hits)
+
+    def deleted_since(self, cursor: int) -> "tuple[list[str], bool]":
+        """(names deleted after `cursor`, complete). Deletions release the
+        row, so `changed_seq` cannot carry them; they land in a bounded
+        log instead. `complete` is False when the cursor predates the log
+        horizon (evicted entries) — the caller must treat the whole fleet
+        as dirty."""
+        if cursor < self._deletion_floor:
+            return [], False
+        names = sorted({name for seq, name in self._deletion_log
+                        if seq > cursor})
+        return names, True
 
     # -- node membership ----------------------------------------------------------
 
@@ -836,6 +857,10 @@ class ClusterState:
         self._evict_cache.pop(name, None)
         self._pref_nodes.pop(name, None)
         self._seq += 1  # membership change is itself a delta
+        log = self._deletion_log
+        if log.maxlen is not None and len(log) == log.maxlen:
+            self._deletion_floor = log[0][0]
+        log.append((self._seq, name))
         return node
 
     def node_by_instance_id(self, instance_id: str) -> Optional[StateNode]:

@@ -167,11 +167,18 @@ def encode_consolidation(
         pods = [p for n in cand for p in n.non_daemon_pods()]
         # domain-population-aware split must see the surviving nodes (the
         # oracle path passes cluster.existing_views(exclude=cand) the same
-        # way, oracle/consolidation.py:107); the columnar snapshot keeps
-        # per-node views lazy — prepare_groups only iterates them when the
-        # pod set carries affinity/topology terms
-        cand_names = {n.name for n in cand}
-        survivors = cluster.existing_columns(exclude=cand_names)
+        # way, oracle/consolidation.py:107) — but both pre-passes gate on
+        # the pod set's terms before touching `existing`
+        # (resolve_pod_affinity: pod_(anti_)affinity; split_zone_spread:
+        # zone topology / anti_affinity_zone), so lanes with term-free
+        # pods skip the per-lane snapshot entirely: it was ~40% of the
+        # 996-lane streamed encode on a plain-pod cluster
+        if any(p.pod_affinity or p.pod_anti_affinity or p.topology
+               or p.anti_affinity_zone for p in pods):
+            survivors = cluster.existing_columns(
+                exclude={n.name for n in cand})
+        else:
+            survivors = ()
         groups = prepare_groups(pods, zones_c, survivors)
         gmax = max(gmax, len(groups))
         per_cand.append((cand, total_price, groups))
@@ -628,6 +635,156 @@ def run_consolidation(
     from ..tracing import TRACER
 
     TRACER.annotate(transfer_ms=timings.get("fetch_ms", 0.0), **timings)
+    if _SOLVE_TIMING:
+        last_timings = timings
+    if not actions:
+        return None
+    multi_actions = [a for a in actions if len(a.nodes) > 1]
+    return min(multi_actions or actions, key=ConsolidationAction.sort_key)
+
+
+STREAM_LANES_ENV = "KARPENTER_TPU_CONSOLIDATE_STREAM_LANES"
+# 128 lanes/chunk: the width sweep on the 996-lane 500-node sweep bottoms
+# out here (32/64/96/128 -> 219/195/175/158 ms p50 on the 1-core CPU
+# ladder host) — wide enough to amortize per-dispatch overhead, small
+# enough that the working set stays chunk-sized; fewer dispatches also
+# means fewer per-operation charges on the tunneled device link
+DEFAULT_STREAM_LANES = 128
+
+
+class _TypePrunedGrid:
+    """Type-axis subset view of an OptionGrid for the streamed sweep's
+    dispatch+decode: every feasibility row is already ANDed with the
+    cheaper-option mask at encode (encode_group extra_mask), so types not
+    cheaper than ANY candidate set's price carry all-False feasibility in
+    every lane and can never be decided — slicing them off the [T, S] axis
+    shrinks the pack kernel's option scan without changing any verdict.
+    Exposes exactly what _dev_grid_arrays (alloc_t/tiebreak/seqnum) and
+    _decode_actions (options[flat]) read; `flat` indexes PRUNED coords, so
+    the options list is re-laid-out to match. Tiebreak ranks are a subset
+    of the full grid's total order — relative rank among survivors is
+    preserved, so min-rank picks the same option."""
+
+    def __init__(self, grid: OptionGrid, keep_idx: np.ndarray):
+        S = grid.S
+        self.alloc_t = np.ascontiguousarray(grid.alloc_t[keep_idx])
+        self.tiebreak = np.ascontiguousarray(grid.tiebreak[keep_idx])
+        self.seqnum = grid.seqnum
+        self.options = [grid.options[int(t) * S + s]
+                        for t in keep_idx for s in range(S)]
+
+
+def stream_lanes() -> int:
+    raw = _os.environ.get(STREAM_LANES_ENV)
+    if raw is None:
+        return DEFAULT_STREAM_LANES
+    try:
+        v = int(raw)
+        return v if v > 0 else DEFAULT_STREAM_LANES
+    except ValueError:
+        return DEFAULT_STREAM_LANES
+
+
+def stream_consolidation(
+    cluster: ClusterState,
+    catalog: Catalog,
+    provisioners: Sequence[Provisioner],
+    daemon_overhead: Optional[Sequence[int]] = None,
+    now: float = 0.0,
+    grid: Optional[OptionGrid] = None,
+    multi_node: bool = True,
+    max_pair_candidates: int = MAX_PAIR_CANDIDATES,
+    candidate_filter=None,
+    mesh=None,
+    cand_nodes: "Optional[Sequence[StateNode]]" = None,
+    batch_lanes: "Optional[int]" = None,
+) -> Optional[ConsolidationAction]:
+    """run_consolidation, streamed: the same candidate sets in the same
+    order, encoded and dispatched as fixed-width chunks of `batch_lanes`
+    lanes instead of one C-lane mega-batch. The one-shot 500-node sweep
+    flattens+uploads a [C,Gb,Ne] problem in one go (~1.7 s at C=500);
+    chunking keeps the per-dispatch working set small and constant-shaped
+    — the last chunk is PADDED by repeating its final set so every chunk
+    reuses one compiled program — while decode still sees every lane, and
+    mechanism precedence (multi-node shadows single) plus min-cost
+    selection are applied over the FULL action list, so the chosen action
+    is identical to the mega-batch's."""
+    global last_timings
+    t0 = _time.perf_counter()
+    provs_sorted = sorted(provisioners, key=lambda p: (-p.weight, p.name))
+    if cand_nodes is None:
+        cand_nodes = [cluster.nodes[name] for name in sorted(cluster.nodes)
+                      if eligible(cluster.nodes[name], cluster)
+                      and (candidate_filter is None
+                           or candidate_filter(cluster.nodes[name]))]
+    else:
+        cand_nodes = list(cand_nodes)
+    if not cand_nodes:
+        return None
+    sets: "list[tuple]" = [(n,) for n in cand_nodes]
+    if multi_node:
+        sets = candidate_pairs(cluster, provs_sorted, now,
+                               max_pair_candidates, nodes=cand_nodes) + sets
+    width = batch_lanes if batch_lanes is not None else stream_lanes()
+    # type-axis prune, ONE shape for the whole call: types not cheaper
+    # (after availability) than the PRICIEST candidate set can't be a
+    # replacement for any lane — their feasibility rows are all-False by
+    # the encode-time cheaper mask, so slicing them shrinks the option
+    # scan with provably identical verdicts (see _TypePrunedGrid)
+    full_grid = _grid_for(catalog, grid)
+    max_price = max(sum(n.price for n in s) for s in sets)
+    cheap_any = (full_grid.price < (max_price - REPLACE_PRICE_EPS)) \
+        & full_grid.valid
+    keep_t = cheap_any.any(axis=1)
+    keep_idx = np.nonzero(keep_t)[0]
+    pruned = (_TypePrunedGrid(full_grid, keep_idx)
+              if 0 < len(keep_idx) < full_grid.T else None)
+    timings: dict = {"encode_ms": 0.0, "verdicts_ms": 0.0, "decode_ms": 0.0}
+    actions: "list[ConsolidationAction]" = []
+    chunks = 0
+    for start in range(0, len(sets), width):
+        chunk = sets[start:start + width]
+        live = len(chunk)
+        if len(chunk) < width and chunks > 0:
+            # pad to the compiled width (duplicate verdicts are dropped
+            # below); a single undersized chunk (C <= width) just runs
+            # at its natural size — nothing to reuse a program with
+            chunk = chunk + [chunk[-1]] * (width - len(chunk))
+        tc0 = _time.perf_counter()
+        batch = encode_consolidation(cluster, catalog, provisioners,
+                                     daemon_overhead, full_grid,
+                                     cand_sets=chunk)
+        tc1 = _time.perf_counter()
+        if batch is None:
+            continue
+        if pruned is not None \
+                and not batch.feas_table[:, :, ~keep_t, :].any():
+            # safety net: a feasible bit on a pruned type (can't happen
+            # while encode applies the cheaper mask) dispatches this
+            # chunk on the full grid instead of silently mis-decoding
+            batch.feas_table = np.ascontiguousarray(
+                batch.feas_table[:, :, keep_t, :])
+            batch.inputs = batch.inputs._replace(
+                alloc_t=pruned.alloc_t, tiebreak=pruned.tiebreak)
+            batch.grid = pruned
+        verdicts = _verdicts(batch, mesh)
+        tc2 = _time.perf_counter()
+        # decode walks batch.candidates by lane index: truncating to the
+        # live prefix skips the padded lanes' (duplicate) verdict rows
+        batch.candidates = batch.candidates[:live]
+        actions.extend(_decode_actions(batch, verdicts, now))
+        timings["encode_ms"] += tc1 - tc0
+        timings["verdicts_ms"] += tc2 - tc1
+        timings["decode_ms"] += _time.perf_counter() - tc2
+        chunks += 1
+    timings = {k: round(v * 1000, 3) for k, v in timings.items()}
+    timings["lanes"] = len(sets)
+    timings["chunks"] = chunks
+    timings["stream_width"] = width
+    timings["total_ms"] = round((_time.perf_counter() - t0) * 1000, 3)
+    from ..tracing import TRACER
+
+    TRACER.annotate(streamed=True, **timings)
     if _SOLVE_TIMING:
         last_timings = timings
     if not actions:

@@ -46,6 +46,8 @@ log = logging.getLogger(__name__)
 #: gap phase -> backing Tracer span names. Order is presentation order in
 #: statusz / profilez / the drill artifact.
 PHASES = (
+    ("extract", ("solver.extract",)),
+    ("warm_start", ("solver.warm_start",)),
     ("encode", ("solver.encode",)),
     ("serialize", ("solver.serialize",)),
     ("link", ("solver.dispatch.execute", "solver.dispatch.compile")),

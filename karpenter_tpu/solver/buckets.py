@@ -314,8 +314,11 @@ class HbmLedger:
     `pressure()` (resident / declared capacity) is the eviction signal
     the resident-solver LRU consults at Sync."""
 
-    # tensor classes that accumulate (everything else is per-solve delta)
-    STATIC_CLASSES = ("catalog",)
+    # tensor classes that accumulate (everything else is per-solve delta);
+    # "assignment" is the incremental plane's resident packing state —
+    # static (carried between cycles) but REPLACE-semantics via
+    # set_resident, since it is patched in place rather than re-uploaded
+    STATIC_CLASSES = ("catalog", "assignment")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -339,6 +342,21 @@ class HbmLedger:
                 rung = _SCOPE.bucket or _PENDING_RUNG
                 per = self._delta.setdefault(key, {})
                 per[rung] = per.get(rung, 0.0) + nbytes
+
+    def set_resident(self, solver_key: str, tensor: str,
+                     nbytes: float) -> None:
+        """REPLACE a static class's residency for `solver_key` (vs track's
+        accumulate): resident state that is patched in place — the
+        incremental plane's `assignment` arrays — holds `nbytes` total, so
+        each sync files the current footprint, not another increment."""
+        if tensor not in self.STATIC_CLASSES:
+            raise ValueError(f"set_resident is for static classes, "
+                             f"got {tensor!r}")
+        with self._lock:
+            per = self._static.setdefault(solver_key, {})
+            per[tensor] = float(nbytes)
+            HBM_RESIDENT_BYTES.set(per[tensor], solver_key=solver_key,
+                                   tensor=tensor)
 
     def attribute_delta(self, solver_key: str, bucket: str) -> None:
         """Move the pending delta bytes onto the solve's actual bucket

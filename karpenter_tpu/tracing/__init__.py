@@ -74,6 +74,8 @@ PHASE_REGISTRY = (
     "solver.service.Sync",
     "solver.service.Solve",
     "solver.service.Consolidate",
+    "solver.extract",
+    "solver.warm_start",
     "solver.encode",
     "solver.serialize",
     "solver.dispatch.execute",

@@ -22,6 +22,7 @@ from karpenter_tpu import explain
 from karpenter_tpu.apis.provisioner import Provisioner
 from karpenter_tpu.apis import wellknown as wk
 from karpenter_tpu.explain.records import DecisionRing
+from karpenter_tpu.introspect import statusz
 from karpenter_tpu.models.encode import (build_grid, diagnose_unschedulable,
                                          kubelet_arrays)
 from karpenter_tpu.models.instancetype import Catalog, make_instance_type
@@ -310,7 +311,7 @@ class TestDebugDecisionsEndpoint:
         status, snap = _get(
             f"http://127.0.0.1:{ports['metrics']}/debug/statusz")
         assert status == 200
-        assert snap["schema"] == 9
+        assert snap["schema"] == statusz.SCHEMA_VERSION
         assert snap["decisions"]["dimensions"] == list(explain.DIMENSIONS)
 
 

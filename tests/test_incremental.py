@@ -1,0 +1,516 @@
+"""Incremental solving plane: decision identity, escapes, resident parity.
+
+The plane's contract is absolute: enabled, every solve must produce the
+SAME decisions a full solve would (the subproblem is a proof-carrying
+optimization, not an approximation); disabled, it must be strictly
+inert. Tests here pin both directions:
+
+  * N-cycle property test: seeded add/bind/delete/mark churn streams,
+    incremental solve fingerprint == full solve fingerprint every cycle,
+    with real incremental (non-escape) cycles exercised
+  * every escape-hatch reason trips exactly when its condition holds,
+    and the escaped solve still equals the full solve (trivially)
+  * the merge-back audit catches a corrupted subproblem solve and falls
+    back to the full result
+  * ResidentMasks / ResidentCandidates stay bit-identical to the fresh
+    folds they cache, across churn, spec arrival, and PDB-set changes
+  * empty/expired row sets match the deprovisioning sweeps' masks
+  * KARPENTER_TPU_INCREMENTAL=0 means zero counter movement
+  * the deletion log reports completeness honestly past its horizon
+  * HbmLedger.set_resident REPLACE semantics + static-class guard
+
+Property-style tests use seeded random.Random loops (hypothesis is not
+in the image).
+"""
+
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu import incremental
+from karpenter_tpu.apis import wellknown as wk
+from karpenter_tpu.apis.provisioner import Provisioner
+from karpenter_tpu.incremental import (DeltaTracker, IncrementalSolver,
+                                       ResidentCandidates, ResidentMasks,
+                                       empty_node_rows, expired_node_rows,
+                                       extract_subproblem, solve_fingerprint)
+from karpenter_tpu.incremental.extract import (ESCAPE_AUDIT_DIVERGENCE,
+                                               ESCAPE_COLD_START,
+                                               ESCAPE_DELETION_LOG_GAP,
+                                               ESCAPE_DIRTY_THRESHOLD,
+                                               ESCAPE_ENTANGLED_GROUP)
+from karpenter_tpu.models.cluster import (ClusterState, PodDisruptionBudget,
+                                          StateNode)
+from karpenter_tpu.models.encode import existing_fit_vector
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.models.pod import TopologySpreadConstraint, make_pod
+from karpenter_tpu.models.requirements import OP_IN, Requirements
+from karpenter_tpu.solver.core import TPUSolver
+
+
+def _catalog():
+    return Catalog(types=[
+        make_instance_type("m.large", cpu=4, memory="16Gi",
+                           od_price=0.20, spot_price=0.07),
+        make_instance_type("m.xlarge", cpu=16, memory="64Gi",
+                           od_price=0.80, spot_price=0.28),
+    ])
+
+
+def _prov(name="default"):
+    p = Provisioner(name=name, requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+    p.set_defaults()
+    return p
+
+
+def _alloc(cpu_m=4000, mem_mi=16384, pods=110):
+    return wk.capacity_vector({wk.RESOURCE_CPU: cpu_m,
+                               wk.RESOURCE_MEMORY: mem_mi * 2**20,
+                               wk.RESOURCE_PODS: pods})
+
+
+def _node(name, i=0, now=1_000_000.0):
+    return StateNode(
+        name=name,
+        labels={wk.LABEL_ZONE: f"z-{'abc'[i % 3]}",
+                wk.LABEL_CAPACITY_TYPE: "on-demand",
+                wk.LABEL_INSTANCE_TYPE: "m.large",
+                "team": f"t{i % 5}"},
+        allocatable=_alloc(),
+        provisioner_name="default",
+        created_ts=now - (i % 1000),
+        pods=[make_pod(f"{name}-p{j}", cpu="250m", memory="512Mi",
+                       node_name=name, owner_kind="ReplicaSet")
+              for j in range(i % 4)])
+
+
+def _cluster(n=24):
+    cluster = ClusterState()
+    for k in range(n):
+        cluster.add_node(_node(f"n-{k:03d}", k))
+    return cluster
+
+
+def _base(catalog, provisioners):
+    solver = TPUSolver(catalog, provisioners)
+
+    def run(pods, existing):
+        return solver.solve(list(pods), existing=existing), "tpu"
+
+    return run
+
+
+def _pending(rng, cycle, count=3):
+    return [make_pod(f"pend-{cycle}-{j}",
+                     cpu=f"{rng.randint(1, 6) * 250}m",
+                     memory=f"{rng.randint(1, 8) * 256}Mi",
+                     owner_kind="ReplicaSet")
+            for j in range(count)]
+
+
+def _churn(rng, cluster, names, cycle, events=6):
+    for j in range(events):
+        op = rng.random()
+        name = names[rng.randrange(len(names))]
+        node = cluster.nodes[name]
+        if op < 0.4:
+            cluster.bind_pod(name, make_pod(
+                f"churn-{cycle}-{j}", cpu="250m", memory="256Mi",
+                node_name=name, owner_kind="ReplicaSet"))
+        elif op < 0.65:
+            if node.pods:
+                node.pods.pop(rng.randrange(len(node.pods)))
+        elif op < 0.8:
+            node.labels["team"] = f"t{rng.randrange(5)}"
+        elif op < 0.9:
+            node.marked_for_deletion = not node.marked_for_deletion
+        else:
+            idx = names.index(name)
+            cluster.delete_node(name)
+            names[idx] = f"n-r{cycle}-{j}"
+            cluster.add_node(_node(names[idx], rng.randrange(1000)))
+
+
+# -- the tentpole property: decision identity under churn ----------------------
+
+
+@pytest.mark.parametrize("seed", [0, 7, 20260806])
+def test_incremental_solve_decision_identity(seed):
+    """N cycles of seeded churn: the incremental solve's fingerprint must
+    equal a from-scratch full solve's, every cycle, and the run must
+    contain genuine incremental (non-escape) cycles for the claim to have
+    teeth. The oracle merge-back audit runs live throughout."""
+    rng = random.Random(seed)
+    catalog, provisioners = _catalog(), [_prov()]
+    cluster = _cluster(24)
+    names = [f"n-{k:03d}" for k in range(24)]
+    inc = IncrementalSolver(cluster)
+    base = _base(catalog, provisioners)
+    before = incremental.activity()
+
+    incremental_cycles = 0
+    for cycle in range(12):
+        _churn(rng, cluster, names, cycle)
+        pods = _pending(rng, cycle)
+        full = cluster.existing_columns()
+        want, _ = base(pods, full)
+        got, _ = inc.solve(pods, full, base, catalog=catalog,
+                           provisioners=provisioners)
+        assert solve_fingerprint(got) == solve_fingerprint(want), (
+            f"cycle {cycle}: incremental diverged from full solve "
+            f"(mode={inc.last and inc.last.get('mode')})")
+        if inc.last["mode"] == "incremental":
+            incremental_cycles += 1
+            assert len(full) >= inc.last["sub_nodes"]
+
+    after = incremental.activity()
+    assert incremental_cycles >= 3, "escape hatch swallowed the whole run"
+    assert after["audit_divergences"] == before["audit_divergences"]
+
+
+def test_incremental_subproblem_shrinks():
+    """At steady state with small churn the subproblem must be strictly
+    smaller than the fleet — otherwise the plane optimizes nothing."""
+    catalog, provisioners = _catalog(), [_prov()]
+    cluster = _cluster(40)
+    inc = IncrementalSolver(cluster)
+    base = _base(catalog, provisioners)
+    pods = [make_pod("pend-0", cpu="250m", memory="256Mi",
+                     owner_kind="ReplicaSet")]
+    inc.solve(pods, cluster.existing_columns(), base)  # cold start
+    cluster.bind_pod("n-000", make_pod("b0", cpu="100m", memory="128Mi",
+                                       node_name="n-000",
+                                       owner_kind="ReplicaSet"))
+    result, _ = inc.solve(pods, cluster.existing_columns(), base,
+                          catalog=catalog, provisioners=provisioners)
+    assert inc.last["mode"] == "incremental"
+    assert inc.last["sub_nodes"] < inc.last["full_nodes"]
+    assert inc.last["resident_bytes"] > 0
+
+
+# -- escape hatch reasons ------------------------------------------------------
+
+
+def test_escape_cold_start_then_warm():
+    catalog, provisioners = _catalog(), [_prov()]
+    cluster = _cluster(8)
+    inc = IncrementalSolver(cluster)
+    base = _base(catalog, provisioners)
+    pods = _pending(random.Random(1), 0)
+    inc.solve(pods, cluster.existing_columns(), base)
+    assert inc.last == {
+        "mode": "full", "escape": ESCAPE_COLD_START, "dirty_nodes": 0,
+        "full_nodes": 8, "kind": "tpu"}
+    inc.solve(pods, cluster.existing_columns(), base)
+    assert inc.last["mode"] == "incremental"
+
+
+def test_escape_dirty_threshold():
+    cluster = _cluster(8)
+    tracker = DeltaTracker(cluster)
+    tracker.advance()
+    for k in range(6):  # dirty 6/8 = 0.75 > 0.25 default
+        cluster.nodes[f"n-{k:03d}"].labels["team"] = "tX"
+    from karpenter_tpu.models.pod import group_pods
+
+    groups = group_pods(_pending(random.Random(2), 0))
+    sub = extract_subproblem(cluster, groups, cluster.existing_columns(),
+                             tracker)
+    assert sub.escape == ESCAPE_DIRTY_THRESHOLD
+    # an explicit generous threshold lets the same dirty set through
+    sub2 = extract_subproblem(cluster, groups, cluster.existing_columns(),
+                              tracker, threshold=0.9)
+    assert sub2.escape is None
+
+
+def test_escape_entangled_group():
+    cluster = _cluster(8)
+    tracker = DeltaTracker(cluster)
+    tracker.advance()
+    from karpenter_tpu.models.pod import group_pods
+
+    spread = make_pod("spread-0", cpu="250m", memory="256Mi",
+                      owner_kind="ReplicaSet",
+                      topology=(TopologySpreadConstraint(
+                          topology_key=wk.LABEL_ZONE, max_skew=1),))
+    sub = extract_subproblem(cluster, group_pods([spread]),
+                             cluster.existing_columns(), tracker)
+    assert sub.escape == ESCAPE_ENTANGLED_GROUP
+
+
+def test_escape_deletion_log_gap():
+    cluster = _cluster(8)
+    tracker = DeltaTracker(cluster)
+    tracker.advance()
+    # push the log horizon past the cursor: the tracker can no longer
+    # prove which rows vanished, so the gate must refuse the delta path
+    cluster._deletion_floor = cluster.seq + 10
+    cluster.nodes["n-000"].labels["team"] = "tX"
+    from karpenter_tpu.models.pod import group_pods
+
+    groups = group_pods(_pending(random.Random(3), 0))
+    sub = extract_subproblem(cluster, groups, cluster.existing_columns(),
+                             tracker)
+    assert sub.escape == ESCAPE_DELETION_LOG_GAP
+
+
+def test_audit_divergence_falls_back_to_full():
+    """A base solve that corrupts subproblem results (only) must be caught
+    by the oracle audit; the returned result is the FULL solve's."""
+    catalog, provisioners = _catalog(), [_prov()]
+    cluster = _cluster(10)
+    inc = IncrementalSolver(cluster)
+    honest = _base(catalog, provisioners)
+    pods = _pending(random.Random(4), 0)
+    inc.solve(pods, cluster.existing_columns(), honest)  # warm the cursor
+    cluster.bind_pod("n-001", make_pod("b1", cpu="100m", memory="128Mi",
+                                       node_name="n-001",
+                                       owner_kind="ReplicaSet"))
+    full = cluster.existing_columns()
+
+    class _Corrupt:
+        def __init__(self, res):
+            self._res = res
+
+        def decisions(self):
+            return ["bogus.node"]
+
+        @property
+        def existing_counts(self):
+            return self._res.existing_counts
+
+        def unschedulable_count(self):
+            return self._res.unschedulable_count()
+
+    def lying(ps, ex):
+        res, kind = honest(ps, ex)
+        if len(ex) < len(full):  # corrupt ONLY the subproblem solve
+            return _Corrupt(res), kind
+        return res, kind
+
+    before = incremental.activity()
+    got, _ = inc.solve(pods, full, lying, catalog=catalog,
+                       provisioners=provisioners)
+    after = incremental.activity()
+    want, _ = honest(pods, full)
+    assert inc.last["mode"] == "full"
+    assert inc.last["escape"] == ESCAPE_AUDIT_DIVERGENCE
+    assert after["audit_divergences"] == before["audit_divergences"] + 1
+    assert solve_fingerprint(got) == solve_fingerprint(want)
+
+
+# -- resident structures -------------------------------------------------------
+
+
+def test_resident_masks_parity_under_churn():
+    rng = random.Random(11)
+    cluster = _cluster(20)
+    names = [f"n-{k:03d}" for k in range(20)]
+    specs = [
+        make_pod("a", cpu="250m", memory="256Mi",
+                 node_selector={"team": "t1"}),
+        make_pod("b", cpu="500m", memory="512Mi",
+                 node_selector={wk.LABEL_ZONE: "z-a"}),
+        make_pod("c", cpu="1", memory="1Gi"),
+    ]
+    rmasks = ResidentMasks(cluster)
+    for cycle in range(8):
+        _churn(rng, cluster, names, cycle)
+        rmasks.sync(specs)
+        ex = cluster.existing_columns()
+        for s in specs:
+            assert np.array_equal(rmasks.mask_for(ex, s),
+                                  existing_fit_vector(ex, s)), (
+                f"cycle {cycle}: resident mask diverged for {s.name}")
+    # the patch path must actually be incremental after the cold build
+    assert rmasks.full_builds_total == len(specs)
+
+
+def test_resident_masks_new_spec_arrival():
+    cluster = _cluster(10)
+    rmasks = ResidentMasks(cluster)
+    first = [make_pod("a", cpu="250m", memory="256Mi")]
+    rmasks.sync(first)
+    late = make_pod("z", cpu="250m", memory="256Mi",
+                    node_selector={"team": "t2"})
+    rmasks.sync(first + [late])
+    ex = cluster.existing_columns()
+    assert np.array_equal(rmasks.mask_for(ex, late),
+                          existing_fit_vector(ex, late))
+
+
+def test_resident_candidates_parity_and_pdb_epoch():
+    rng = random.Random(13)
+    cluster = _cluster(20)
+    names = [f"n-{k:03d}" for k in range(20)]
+    rcands = ResidentCandidates(cluster)
+    for cycle in range(6):
+        _churn(rng, cluster, names, cycle)
+        rcands.sync()
+        assert rcands.candidate_names() == [
+            n.name for n in cluster.consolidation_candidates()]
+    # a PDB-set change shifts verdicts on CLEAN rows: the cache must drop
+    builds = rcands.full_builds_total
+    cluster.pdbs = [PodDisruptionBudget(
+        name="block-all", selector={}, max_unavailable=0)]
+    rcands.sync()
+    assert rcands.full_builds_total == builds + 1
+    assert rcands.candidate_names() == [
+        n.name for n in cluster.consolidation_candidates()]
+
+
+def test_empty_and_expired_rows_match_sweeps():
+    from karpenter_tpu.controllers.deprovisioning import \
+        DeprovisioningController
+    from karpenter_tpu.utils.clock import FakeClock
+
+    now = 1_000_000.0
+    provs = [Provisioner(name="default", ttl_seconds_after_empty=30,
+                         ttl_seconds_until_expired=500)]
+    for p in provs:
+        p.set_defaults()
+
+    class _Kube:
+        def provisioners(self):
+            return provs
+
+    class _Termination:
+        def request_deletion(self, name):
+            return False
+
+    cluster = ClusterState()
+    for k in range(12):
+        node = _node(f"n-{k:03d}", k, now=now)
+        node.created_ts = now - k * 100  # k>=5 ages past the 500s expiry
+        if k % 3 == 0:
+            node.pods = []  # empty
+        cluster.add_node(node)
+    ctrl = DeprovisioningController(
+        kube=_Kube(), cloudprovider=None, cluster=cluster,
+        termination=_Termination(), clock=FakeClock(now),
+        use_tpu_solver=False)
+    cols = cluster.columns
+    _, ttl_e = ctrl._prov_ttl_columns("ttl_seconds_after_empty")
+    _, ttl_x = ctrl._prov_ttl_columns("ttl_seconds_until_expired")
+
+    e_rows = empty_node_rows(cluster, ttl_e)
+    want_empty = sorted(
+        name for name, n in cluster.nodes.items()
+        if not n.pods and not n.marked_for_deletion)
+    assert sorted(cols.name_of[r] for r in e_rows) == want_empty
+
+    x_rows = expired_node_rows(cluster, ttl_x, now)
+    want_expired = sorted(
+        name for name, n in cluster.nodes.items()
+        if not n.marked_for_deletion and now - n.created_ts >= 500)
+    assert sorted(cols.name_of[r] for r in x_rows) == want_expired
+
+
+# -- gate / noop / bookkeeping -------------------------------------------------
+
+
+def test_disabled_is_strictly_noop():
+    catalog, provisioners = _catalog(), [_prov()]
+    cluster = _cluster(6)
+    inc = IncrementalSolver(cluster)
+    base = _base(catalog, provisioners)
+    pods = _pending(random.Random(5), 0)
+    prev = incremental.set_enabled(False)
+    try:
+        before = incremental.activity()
+        got, kind = inc.solve(pods, cluster.existing_columns(), base,
+                              catalog=catalog, provisioners=provisioners)
+        after = incremental.activity()
+        assert after == before, "disabled plane moved a counter"
+        assert inc.last is None
+        want, _ = base(pods, cluster.existing_columns())
+        assert solve_fingerprint(got) == solve_fingerprint(want)
+    finally:
+        incremental.set_enabled(prev)
+
+
+def test_deleted_since_honest_past_horizon():
+    cluster = _cluster(4)
+    cursor = cluster.seq
+    cluster.delete_node("n-000")
+    names, complete = cluster.deleted_since(cursor)
+    assert names == ["n-000"] and complete
+    # a cursor older than the log floor must report incomplete, not guess
+    cluster._deletion_floor = cursor + 1
+    _, complete = cluster.deleted_since(cursor)
+    assert not complete
+
+
+def test_set_resident_replace_semantics():
+    from karpenter_tpu.solver.buckets import HbmLedger
+
+    ledger = HbmLedger()
+    ledger.set_resident("inc", "assignment", 1024.0)
+    ledger.set_resident("inc", "assignment", 512.0)
+    # replace, not accumulate: the second filing overwrites the first
+    assert ledger._static["inc"]["assignment"] == 512.0
+    with pytest.raises(ValueError):
+        ledger.set_resident("inc", "not-a-static-class", 1.0)
+    import json
+
+    json.dumps(ledger.snapshot())  # snapshot stays serializable
+
+
+def _consolidatable_cluster(n=36, now=1_000_000.0):
+    """Heterogeneous consolidation fleet: under-utilized on-demand
+    m.xlarge rows (repack/replace candidates), a couple of spot rows
+    (delete-only), zone-spread pods on some rows (forces the encoder's
+    survivors snapshot), and a marked row (never a candidate)."""
+    catalog = _catalog()
+    big = catalog.by_name["m.xlarge"]
+    cluster = ClusterState()
+    for i in range(n):
+        spot = i % 9 == 4
+        pods = [make_pod(f"c{i}-p0", cpu="250m", memory="512Mi",
+                         node_name=f"c-{i:03d}", owner_kind="ReplicaSet")]
+        if i % 7 == 2:  # zone-spread pods exercise prepare_groups(existing)
+            pods.append(dataclasses.replace(
+                make_pod(f"c{i}-tp", cpu="100m", memory="128Mi",
+                         node_name=f"c-{i:03d}", owner_kind="ReplicaSet"),
+                topology=(TopologySpreadConstraint(
+                    topology_key=wk.LABEL_ZONE, max_skew=1,
+                    when_unsatisfiable="DoNotSchedule"),)))
+        node = StateNode(
+            name=f"c-{i:03d}",
+            labels={**big.labels_dict(),
+                    wk.LABEL_ZONE: f"z-{'abc'[i % 3]}",
+                    wk.LABEL_CAPACITY_TYPE: "spot" if spot else "on-demand",
+                    wk.LABEL_PROVISIONER: "default"},
+            allocatable=big.allocatable_vector(),
+            instance_type=big.name, zone=f"z-{'abc'[i % 3]}",
+            capacity_type="spot" if spot else "on-demand",
+            price=0.28 if spot else 0.80, provisioner_name="default",
+            created_ts=now - 3600.0, pods=pods)
+        cluster.add_node(node)
+    cluster.nodes["c-001"].marked_for_deletion = True
+    return cluster, catalog
+
+
+def test_stream_consolidation_matches_oneshot():
+    """The streamed sweep (chunked encode + type-pruned dispatch + padded
+    tail) must pick exactly the one-shot mega-batch's action at every
+    stream width — including widths that force padding and a single
+    undersized chunk."""
+    from karpenter_tpu.ops.consolidate import (run_consolidation,
+                                               stream_consolidation)
+
+    cluster, catalog = _consolidatable_cluster()
+    prov = Provisioner(name="default", consolidation_enabled=True)
+    prov.set_defaults()
+    want = run_consolidation(cluster, catalog, [prov])
+    assert want is not None  # the fleet must actually consolidate
+    for width in (5, 16, 1000):
+        got = stream_consolidation(cluster, catalog, [prov],
+                                   batch_lanes=width)
+        assert got is not None, width
+        assert (got.kind, got.nodes, got.replacement) == \
+            (want.kind, want.nodes, want.replacement), width
+        assert got.savings == pytest.approx(want.savings)

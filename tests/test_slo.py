@@ -487,7 +487,8 @@ class TestRegressGate:
         rc = gate.main(["--ledger", self._ledger(tmp_path),
                         "--inject", "interruption_msgs_per_sec=100",
                         "--inject", "baseline_config_ms=99",
-                        "--inject", "profile_unaccounted_share=0.9"])
+                        "--inject", "profile_unaccounted_share=0.9",
+                        "--inject", "incremental_steady_encode_share=0.99"])
         out = capsys.readouterr().out
         assert rc == 0, out
-        assert out.count("SEED") == 3
+        assert out.count("SEED") == 4
