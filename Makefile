@@ -8,9 +8,9 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit lint noretry hotloops crashpoints cardinality phaseacct reasons test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm failover-drill fleet-bench fleet-drill fleet-drill-small telemetry-drill claims diagnose provenance multichip soak incremental-soak perf-regress ledger-backfill profile-drill explain-drill
+.PHONY: presubmit lint noretry hotloops crashpoints cardinality phaseacct reasons test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm failover-drill fleet-bench fleet-drill fleet-drill-small telemetry-drill claims diagnose provenance multichip soak incremental-soak perf-regress ledger-backfill profile-drill explain-drill critical-drill critical-drill-small
 
-presubmit: lint claims provenance noretry hotloops crashpoints cardinality phaseacct reasons perf-regress failover-drill fleet-drill-small incremental-soak test verify-entry  ## what CI runs
+presubmit: lint claims provenance noretry hotloops crashpoints cardinality phaseacct reasons perf-regress failover-drill fleet-drill-small critical-drill-small incremental-soak test verify-entry  ## what CI runs
 
 perf-regress:  ## tier-1-sized micro-benches must stay inside the ledger's noise bands
 	$(CPU_ENV) $(PY) hack/check_perf_regress.py
@@ -58,6 +58,14 @@ profile-drill:  ## 10k-pod attribution drill: >=95% of wall accounted, <5% overh
 
 explain-drill:  ## 10k-pod decision-provenance drill: 100% attribution, oracle parity, <1% overhead, RECORDED
 	$(CPU_ENV) $(PY) -m benchmarks.explain_drill
+
+critical-drill:  ## 10k-pod critical-path drill: >=95% attribution, serial overlap ~0, serialize share named, RECORDED
+	$(CPU_ENV) $(PY) -m benchmarks.critical_drill
+
+critical-drill-small:  ## presubmit-sized critical-path drill (400 pods, /tmp artifact + ledger)
+	$(CPU_ENV) KARPENTER_TPU_CRITICAL_DIR=$(or $(CRITICAL_DIR),/tmp/karpenter-critical-drill) \
+		KARPENTER_TPU_LEDGER=$(or $(CRITICAL_DIR),/tmp/karpenter-critical-drill)/ledger.jsonl \
+		$(PY) -m benchmarks.critical_drill --small
 
 diagnose:  ## introspection smoke: deadman, statusz, flight-recorder bundles
 	$(CPU_ENV) $(PY) -m pytest tests/test_introspect.py -q

@@ -497,9 +497,12 @@ def _profile_bench(args):
     from benchmarks.profile_drill import MAX_UNACCOUNTED_SHARE, run_path
     from karpenter_tpu.solver.core import TPUSolver
 
+    from karpenter_tpu.profiling import critical as _critical
+
     n = max(100, args.profile_pods)
     catalog, provisioners, pods = stress_problem_50k(n)
     solver = TPUSolver(catalog, provisioners)
+    _critical.set_enabled(True)
     workloads = {}
     for label, wl_pods in ((f"stress-{n}", pods),
                            (f"stress-{max(100, n // 4)}",
@@ -511,12 +514,14 @@ def _profile_bench(args):
     # jitter, and the <5% overhead acceptance belongs to the 10k drill
     passed = all(w["unaccounted_share"] < MAX_UNACCOUNTED_SHARE
                  for w in workloads.values())
+    critical_summary = _bench_critical_summary()
     record = {
         "tool": "karpenter_tpu.bench_profile",
         "mode": "profile",
         "backend": "cpu",
         "pods": n,
         "workloads": workloads,
+        "critical": critical_summary,
         "passed": passed,
     }
     out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -537,7 +542,46 @@ def _profile_bench(args):
                        "ratio", source="bench.py --profile", backend="cpu",
                        degraded=w["unaccounted_share"] >= MAX_UNACCOUNTED_SHARE,
                        workload={"name": label, "pods": n}, artifact=out)
+    if critical_summary:
+        _ledger.record("critical_overlap_ratio",
+                       critical_summary["overlap_ratio"], "ratio",
+                       source="bench.py --profile", backend="cpu",
+                       workload={"name": "bench_profile", "pods": n},
+                       detail=critical_summary, artifact=out)
     return 0 if passed else 1
+
+
+def _bench_critical_summary(limit: int = 6) -> "dict | None":
+    """The critical-path read of the solves a bench mode just ran: median
+    overlap ratio (the serial baseline), the phase owning the biggest
+    chain share, and the measured-roofline rung count — the bench-sized
+    echo of `make critical-drill` (None when the plane recorded
+    nothing)."""
+    import statistics
+
+    from karpenter_tpu.profiling import critical, roofline
+
+    rows = critical.CRITICAL.rows()[-limit:]
+    if not rows:
+        return None
+    shares: "dict[str, list[float]]" = {}
+    for r in rows:
+        for p, s in (r.get("critical_share") or {}).items():
+            shares.setdefault(p, []).append(s)
+    med_share = {p: round(statistics.median(v), 6)
+                 for p, v in shares.items()}
+    top = max(med_share, key=med_share.get) if med_share else None
+    measured = roofline.measured_snapshot()
+    return {
+        "overlap_ratio": round(statistics.median(
+            r["overlap_ratio"] for r in rows), 6),
+        "critical_path_ms": round(statistics.median(
+            r["critical_path_ms"] for r in rows), 4),
+        "top_critical_phase": top,
+        "critical_share": med_share,
+        "roofline_measured_rungs": len(measured.get("rungs") or {}),
+        "roofline_drift_flagged": measured.get("drift_flagged") or [],
+    }
 
 
 def _soak_bench(args):
@@ -903,6 +947,9 @@ def _soak_bench(args):
             "bit_identical": encode_parity,
             "fields": list(enc_fields),
         },
+        # the chain view of whatever solves the soak drove (None on the
+        # pure-host sweep — no solve scope opened, honestly absent)
+        "critical": _bench_critical_summary(),
         "passed": passed,
     }
     print(json.dumps(record), flush=True)
@@ -925,6 +972,11 @@ def _soak_bench(args):
     _ledger.record("soak_cycle_p50_ms", record["cycle_p50_ms"], "ms",
                    source="bench.py --soak", backend="cpu",
                    degraded=not passed, workload=wl, artifact=out)
+    if record["critical"]:
+        _ledger.record("critical_overlap_ratio",
+                       record["critical"]["overlap_ratio"], "ratio",
+                       source="bench.py --soak", backend="cpu",
+                       workload=wl, detail=record["critical"], artifact=out)
 
     # -- incremental plane artifact -----------------------------------------
     if inc_on and inc_cycle_ms:

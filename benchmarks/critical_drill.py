@@ -1,0 +1,298 @@
+"""The critical-path drill: prove the chain view explains the headline.
+
+ISSUE 18's acceptance instrument: a 10k-pod solve (the BASELINE
+stress_problem_50k shape) is driven through BOTH routing paths
+
+  - ``single``  — one-device dispatch (TPUSolver, no mesh), and
+  - ``sharded`` — the routed mesh path (ShardedContext over the CPU_ENV's
+    8 virtual devices, ShapeRouter forced with crossover_cells=0),
+
+plus a ``service`` leg — the same single solver behind an in-process
+SolverService Sync/Solve round-trip, so the ``serialize`` phase (wire
+lane) appears on a measured path — with the critical ledger ON. Per path
+the drill asserts and records:
+
+  1. **attribution** — the flat gap-ledger projection still covers
+     >= 95% of the solve wall (``attributed_share >= 0.95``; the interval
+     view must not have cost the flat view anything);
+  2. **overlap baseline** — today's solve is serial, so the measured
+     ``overlap_ratio`` must sit at ~0 (< 0.05): the ledger's headroom
+     claim starts from an honest zero, and any future pipelining shows up
+     as the ratio lifting off this recorded floor;
+  3. **critical shares named** — the per-phase on-critical-path share,
+     with ``serialize``/``encode`` called out per path (serialize is 0 by
+     construction off the service leg);
+  4. **measured vs modelled** — the warmup-captured XLA cost-analysis
+     rungs (roofline.measured_snapshot()), with per-rung drift deltas
+     against the hand model, ledgered so drift trends are gated.
+
+Artifact: benchmarks/results/critical/critical_drill.json (deterministic
+path, KARPENTER_TPU_CRITICAL_DIR redirects for presubmit). Each path's
+shares are recorded through benchmarks/ledger.py; `make perf-regress`
+gates critical_serialize_share via gate_probe(). Run via
+`make critical-drill` (`--small` for the presubmit-sized variant).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+OUT_DIR = (os.environ.get("KARPENTER_TPU_CRITICAL_DIR")
+           or os.path.join(os.path.dirname(__file__), "results", "critical"))
+ARTIFACT = os.path.join(OUT_DIR, "critical_drill.json")
+
+PODS = 10_000
+SMALL_PODS = 400
+REPEATS = 5
+WARMUP = 2
+MIN_ATTRIBUTED_SHARE = 0.95
+# the serial baseline: a measured ratio above this means the ledger is
+# claiming overlap a serial dispatch cannot have produced (a chain bug),
+# not that the solver got faster
+MAX_SERIAL_OVERLAP = 0.05
+N_DEVICES = 8
+
+
+def _solvers(pods_n: int = PODS, n_devices: int = N_DEVICES):
+    """(catalog, provisioners, pods, single solver, sharded solver) — the
+    profile_drill harness. The sharded half is None when the mesh can't
+    build (single-device host)."""
+    from karpenter_tpu.utils.jaxenv import pin_cpu
+
+    pin_cpu(n_devices)
+    from benchmarks.baseline_configs import stress_problem_50k
+    from karpenter_tpu.solver import buckets
+    from karpenter_tpu.solver.core import TPUSolver
+
+    catalog, provisioners, pods = stress_problem_50k(pods_n)
+    single = TPUSolver(catalog, provisioners)
+    sharded = None
+    try:
+        from karpenter_tpu.parallel.sharded import ShardedContext
+
+        ctx = ShardedContext()
+        router = buckets.ShapeRouter(n_devices=ctx.device_count,
+                                     crossover_cells=0)
+        sharded = TPUSolver(catalog, provisioners,
+                            mesh_ctx=ctx, router=router)
+    except Exception as e:  # noqa: BLE001 — mesh is optional surface
+        print(f"critical_drill: mesh unavailable ({e}); sharded path "
+              f"skipped", file=sys.stderr)
+    return catalog, provisioners, pods, single, sharded
+
+
+def _service_solve(catalog, provisioners, pods):
+    """An in-process SolverService Sync + a Solve callable: the one leg
+    where ``serialize`` is a real measured phase (wire lane), not zero.
+    In-process keeps the drill hermetic; the wire encode/decode work is
+    identical to the remote path."""
+    from karpenter_tpu.solver import wire
+    from karpenter_tpu.solver.service import SolverService, pb
+
+    svc = SolverService()
+    svc.Sync(pb.SyncRequest(
+        catalog=wire.catalog_to_wire(catalog),
+        provisioners=[wire.provisioner_to_wire(p) for p in provisioners],
+    ), None)
+    req = pb.SolveRequest(
+        catalog_seqnum=catalog.seqnum,
+        catalog_hash=wire.catalog_hash(catalog),
+        provisioner_hash=wire.provisioners_hash(provisioners),
+        pods=[wire.pod_to_wire(p) for p in pods],
+    )
+    return lambda: svc.Solve(req, None)
+
+
+def _critical_summary(name: str, rows: "list[dict]",
+                      walls_ms: "list[float]") -> dict:
+    """Fold one path's gap-ledger rows (each carrying its ``critical``
+    section) into the drill's per-path record."""
+    crits = [r["critical"] for r in rows if r.get("critical")]
+    if not crits:
+        return {"path": name, "error": "no critical rows", "passed": False}
+    med = lambda key: statistics.median(c[key] for c in crits)  # noqa: E731
+    phase_names = sorted({p for c in crits
+                          for p in c["on_critical_path_ms"]})
+    on_ms = {p: round(statistics.median(
+        c["on_critical_path_ms"].get(p, 0.0) for c in crits), 4)
+        for p in phase_names}
+    share = {p: round(statistics.median(
+        c["critical_share"].get(p, 0.0) for c in crits), 6)
+        for p in phase_names}
+    waits = {w: round(statistics.median(
+        c["waits_ms"].get(w, 0.0) for c in crits), 4)
+        for w in sorted({w for c in crits for w in c["waits_ms"]})}
+    attributed = statistics.median(r["attributed_share"] for r in rows)
+    overlap = med("overlap_ratio")
+    return {
+        "path": name,
+        "repeats": len(rows),
+        "wall_ms_min": round(min(walls_ms), 3),
+        "wall_ms_median": round(statistics.median(walls_ms), 3),
+        "critical_path_ms": round(med("critical_path_ms"), 4),
+        "total_work_ms": round(med("total_work_ms"), 4),
+        "overlap_ratio": round(overlap, 6),
+        "attributed_share": round(attributed, 6),
+        "on_critical_path_ms": on_ms,
+        "critical_share": share,
+        # the two shares the acceptance names per path: what fraction of
+        # the chain is wire serialization vs host encode
+        "critical_serialize_share": share.get("serialize", 0.0),
+        "critical_encode_share": share.get("encode", 0.0),
+        "waits_ms": waits,
+        "passed": (attributed >= MIN_ATTRIBUTED_SHARE
+                   and 0.0 <= overlap < MAX_SERIAL_OVERLAP),
+    }
+
+
+def run_path(name: str, solve, repeats: int = REPEATS,
+             warmup: int = WARMUP) -> dict:
+    """Measure one leg: warmup compiles, then `repeats` solves with the
+    profiling + critical planes ON; the per-solve interval records land in
+    the gap-ledger rows' ``critical`` sections."""
+    from karpenter_tpu import profiling
+    from karpenter_tpu.profiling import GAP_LEDGER, critical
+
+    for _ in range(warmup):
+        solve()
+    profiling.set_enabled(True)
+    critical.set_enabled(True)
+    GAP_LEDGER.clear()
+    walls_ms: "list[float]" = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        solve()
+        walls_ms.append((time.perf_counter() - t0) * 1e3)
+    rows = GAP_LEDGER.rows()[-repeats:]
+    return _critical_summary(name, rows, walls_ms)
+
+
+def gate_probe(pods: int = SMALL_PODS) -> dict:
+    """Small service-routed probe for `make perf-regress`: one warmed
+    Solve through the in-process service, returns the per-path summary
+    (the gate reads critical_serialize_share — serialization creeping
+    onto the critical path is a regression the wall clock alone hides)."""
+    from karpenter_tpu.utils.jaxenv import pin_cpu
+
+    pin_cpu(N_DEVICES)
+    catalog, provisioners, probe_pods, _single, _sharded = \
+        _solvers(pods, n_devices=N_DEVICES)
+    solve = _service_solve(catalog, provisioners, probe_pods)
+    return run_path("service", solve, repeats=3, warmup=1)
+
+
+def _roofline_section() -> dict:
+    """The measured-roofline evidence: warmup-captured XLA rungs with the
+    per-rung measured-vs-modelled drift deltas the acceptance ledgers."""
+    from karpenter_tpu.profiling import roofline
+
+    snap = roofline.measured_snapshot()
+    deltas = {}
+    for bucket, rung in (snap.get("rungs") or {}).items():
+        if "flops_drift" in rung:
+            deltas[bucket] = {
+                "flops_drift": rung["flops_drift"],
+                "measured_flops": rung.get("flops"),
+                "modelled_flops": rung.get("modelled_flops"),
+                "flagged": rung.get("flagged", False),
+            }
+    snap["drift_deltas"] = deltas
+    return snap
+
+
+def run_drill(pods_n: int = PODS, repeats: int = REPEATS) -> dict:
+    from benchmarks import ledger
+    from karpenter_tpu import profiling
+    from karpenter_tpu.profiling import critical, roofline
+
+    # the planes must be on BEFORE the solvers warm: the measured-roofline
+    # capture fires inside warm_shapes and gates on both flags
+    profiling.set_enabled(True)
+    critical.set_enabled(True)
+    roofline.clear_measured()
+    catalog, provisioners, pods, single, sharded = _solvers(pods_n)
+    paths = {"single": run_path("single", lambda: single.solve(pods),
+                                repeats)}
+    if sharded is not None:
+        paths["sharded"] = run_path("sharded",
+                                    lambda: sharded.solve(pods), repeats)
+    paths["service"] = run_path(
+        "service", _service_solve(catalog, provisioners, pods), repeats)
+    # warm the single solver's observed rung explicitly so the measured
+    # roofline has at least one captured entry even on a cold run
+    try:
+        if single.last_shape_key is not None:
+            single.warm_shapes([single.last_shape_key])
+    except Exception as e:  # noqa: BLE001 — advisory capture
+        print(f"critical_drill: roofline warm capture failed: {e}",
+              file=sys.stderr)
+    record = {
+        "tool": "karpenter_tpu.critical_drill",
+        "schema": 1,
+        "pods": pods_n,
+        "repeats": repeats,
+        "thresholds": {
+            "min_attributed_share": MIN_ATTRIBUTED_SHARE,
+            "max_serial_overlap": MAX_SERIAL_OVERLAP,
+        },
+        "paths": paths,
+        "roofline_measured": _roofline_section(),
+        "passed": bool(paths) and all(p["passed"] for p in paths.values()),
+    }
+    os.makedirs(OUT_DIR, exist_ok=True)
+    with open(ARTIFACT, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    for name, p in paths.items():
+        if "error" in p:
+            continue
+        workload = {"name": "critical_drill", "path": name, "pods": pods_n}
+        degraded = not p["passed"]
+        for metric, value, unit in (
+                ("critical_overlap_ratio", p["overlap_ratio"], "ratio"),
+                ("critical_attributed_share", p["attributed_share"],
+                 "ratio"),
+                ("critical_serialize_share", p["critical_serialize_share"],
+                 "share"),
+                ("critical_path_ms", p["critical_path_ms"], "ms")):
+            ledger.record(metric, value, unit,
+                          source="benchmarks.critical_drill", backend="cpu",
+                          workload=workload, degraded=degraded,
+                          artifact=ARTIFACT)
+    for bucket, delta in record["roofline_measured"]["drift_deltas"].items():
+        ledger.record("roofline_flops_drift", delta["flops_drift"], "ratio",
+                      source="benchmarks.critical_drill", backend="cpu",
+                      workload={"name": "critical_drill", "bucket": bucket},
+                      degraded=bool(delta["flagged"]), artifact=ARTIFACT)
+    return record
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    small = "--small" in argv
+    record = run_drill(pods_n=SMALL_PODS if small else PODS,
+                       repeats=3 if small else REPEATS)
+    print(json.dumps({
+        "passed": record["passed"],
+        "paths": {k: {"overlap_ratio": v.get("overlap_ratio"),
+                      "attributed_share": v.get("attributed_share"),
+                      "critical_serialize_share":
+                          v.get("critical_serialize_share"),
+                      "critical_encode_share":
+                          v.get("critical_encode_share"),
+                      "wall_ms_min": v.get("wall_ms_min")}
+                  for k, v in record["paths"].items()},
+        "roofline_rungs": len(
+            record["roofline_measured"].get("rungs") or {}),
+        "drift_flagged": record["roofline_measured"].get("drift_flagged"),
+        "artifact": ARTIFACT,
+    }))
+    return 0 if record["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -385,6 +385,36 @@ def _incremental_entries(doc: dict):
             yield (metric, doc[field], unit, "cpu", degraded, wl, None)
 
 
+def _critical_entries(doc: dict):
+    """critical_drill artifacts: per-path overlap ratio (the serial
+    baseline any future pipelining lifts off), flat-attribution share,
+    and the serialize critical share the perf-regress gate trends; plus
+    per-rung measured-vs-modelled FLOPs drift. Degraded whenever a path
+    missed its acceptance or a rung's drift tripped the 2x flag."""
+    if doc.get("tool") != "karpenter_tpu.critical_drill":
+        return
+    pods = doc.get("pods")
+    for name, p in sorted((doc.get("paths") or {}).items()):
+        if not isinstance(p, dict) or "error" in p:
+            continue
+        wl = {"name": "critical_drill", "path": name, "pods": pods}
+        degraded = not p.get("passed", False)
+        for field, metric, unit in (
+                ("overlap_ratio", "critical_overlap_ratio", "ratio"),
+                ("attributed_share", "critical_attributed_share", "ratio"),
+                ("critical_serialize_share", "critical_serialize_share",
+                 "share"),
+                ("critical_path_ms", "critical_path_ms", "ms")):
+            if isinstance(p.get(field), (int, float)):
+                yield (metric, p[field], unit, "cpu", degraded, wl, None)
+    roof = doc.get("roofline_measured") or {}
+    for bucket, delta in sorted((roof.get("drift_deltas") or {}).items()):
+        if isinstance(delta.get("flops_drift"), (int, float)):
+            yield ("roofline_flops_drift", delta["flops_drift"], "ratio",
+                   "cpu", bool(delta.get("flagged")),
+                   {"name": "critical_drill", "bucket": bucket}, None)
+
+
 _BACKFILL_SOURCES = (
     ("BENCH_r0*.json", "bench.py", _bench_round_entries),
     ("benchmarks/results/bench_*.json", "benchmarks.record",
@@ -402,6 +432,8 @@ _BACKFILL_SOURCES = (
      _soak_entries),
     ("benchmarks/results/incremental/incremental_*.json", "bench.py --soak",
      _incremental_entries),
+    ("benchmarks/results/critical/critical_*.json",
+     "benchmarks.critical_drill", _critical_entries),
     ("benchmarks/results/multichip_wire_*.json", "benchmarks.multichip_wire",
      _multichip_entries),
     ("benchmarks/results/trace_summary_*.json", "hack/summarize_trace",

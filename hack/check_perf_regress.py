@@ -93,6 +93,17 @@ def _bench_incremental_share() -> float:
     return float(gate_probe()["encode_share"])
 
 
+def _bench_critical_serialize() -> float:
+    """Critical-path probe (benchmarks/critical_drill.gate_probe): a
+    warmed 400-pod Solve through the in-process service; the gate trends
+    the serialize share of the critical path so wire encode/decode
+    creeping onto the chain (where the wall clock alone hides it behind
+    faster phases) fails presubmit like any other regression."""
+    from benchmarks.critical_drill import gate_probe
+
+    return float(gate_probe()["critical_serialize_share"])
+
+
 # (metric, workload filter, backend, unit, direction, runner). `direction`
 # is the GOOD direction: "higher" fails below the band, "lower" above it.
 GATES = (
@@ -105,6 +116,9 @@ GATES = (
     ("incremental_steady_encode_share",
      {"name": "incremental_gate", "nodes": 1500}, "cpu", "share",
      "lower", _bench_incremental_share),
+    ("critical_serialize_share",
+     {"name": "critical_gate", "pods": 400}, "cpu", "share",
+     "lower", _bench_critical_serialize),
 )
 
 

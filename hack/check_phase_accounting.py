@@ -14,6 +14,18 @@ the check_crashpoints idiom):
 3. every registry entry is actually recorded somewhere — dead registry
    entries would make the docs lie about what the tracer emits.
 
+Plus the critical-path plane's lane/wait lockstep (same drift argument,
+one vocabulary over in karpenter_tpu/profiling/critical.py):
+
+4. every literal `lane=` at a note()/note_wait() call site is in LANES,
+   every PHASE_LANES key is a gap-ledger phase and every value a lane,
+   and no lane is dead (unreachable from PHASE_LANES defaults or a
+   literal call-site override — a dead lane would render as an empty
+   Perfetto track forever);
+5. every literal wait kind passed to note_wait() is in WAITS, and every
+   WAITS entry is producible — by a note_wait() literal somewhere, or by
+   the gap classifier in critical.py itself.
+
 f-string span names (e.g. the client's solver.rpc.<Method>) are checked
 by their static prefix against DYNAMIC_PHASE_PREFIXES; non-literal names
 (variables) are skipped — they are the Tracer API's own plumbing.
@@ -30,9 +42,11 @@ import sys
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 PACKAGE = ROOT / "karpenter_tpu"
 GAPLEDGER = PACKAGE / "profiling" / "gapledger.py"
+CRITICAL = PACKAGE / "profiling" / "critical.py"
 TRACING = PACKAGE / "tracing" / "__init__.py"
 
 SPAN_CALLS = ("start_span", "record_span")
+NOTE_CALLS = ("note", "note_wait")
 
 
 def _module_assign(path: pathlib.Path, name: str):
@@ -57,6 +71,25 @@ def load_registry() -> "tuple[tuple[str, ...], tuple[str, ...]]":
     prefixes = ast.literal_eval(
         _module_assign(TRACING, "DYNAMIC_PHASE_PREFIXES"))
     return tuple(registry), tuple(prefixes)
+
+
+def load_critical() -> "tuple[tuple, tuple, dict]":
+    lanes = tuple(ast.literal_eval(_module_assign(CRITICAL, "LANES")))
+    waits = tuple(ast.literal_eval(_module_assign(CRITICAL, "WAITS")))
+    phase_lanes = dict(ast.literal_eval(
+        _module_assign(CRITICAL, "PHASE_LANES")))
+    return lanes, waits, phase_lanes
+
+
+def _note_calls(tree: ast.AST):
+    """Yield (node, method-name) of every .note()/.note_wait() call."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else None
+        if name in NOTE_CALLS:
+            yield node, name
 
 
 def _span_name_args(tree: ast.AST):
@@ -98,7 +131,19 @@ def _static_prefix(joined: ast.JoinedStr) -> str:
 def main() -> int:
     phases = load_phases()
     registry, prefixes = load_registry()
+    lanes, waits, phase_lanes = load_critical()
     problems: "list[str]" = []
+
+    # 4a) the PHASE_LANES defaults stay in lockstep with both vocabularies
+    for phase, lane in sorted(phase_lanes.items()):
+        if phase not in phases:
+            problems.append(
+                f"{CRITICAL.relative_to(ROOT)}: PHASE_LANES key {phase!r} "
+                f"is not a gap-ledger phase")
+        if lane not in lanes:
+            problems.append(
+                f"{CRITICAL.relative_to(ROOT)}: PHASE_LANES maps {phase!r} "
+                f"to unknown lane {lane!r}")
 
     # 1) gap-ledger table maps onto registered spans only
     for phase, spans in phases.items():
@@ -111,6 +156,8 @@ def main() -> int:
 
     # 2) every literal call site is registered; 3) registry has no dead rows
     used: "set[str]" = set()
+    lane_literals: "set[str]" = set()
+    wait_literals: "set[str]" = set()
     for path in sorted(PACKAGE.rglob("*.py")):
         if path == TRACING:
             continue  # the Tracer's own API plumbing passes names through
@@ -120,6 +167,27 @@ def main() -> int:
             problems.append(f"{path.relative_to(ROOT)}: unparseable: {e}")
             continue
         rel = path.relative_to(ROOT)
+        # 4b/5a) literal lane overrides and wait kinds at note call sites
+        # stay inside the critical.py vocabularies (gapledger.py is the
+        # API's own plumbing — its defs, not calls, carry the kwargs)
+        if path != GAPLEDGER:
+            for node, name in _note_calls(tree):
+                for kw in node.keywords:
+                    if kw.arg != "lane":
+                        continue
+                    for value in _literal_strings(kw.value):
+                        lane_literals.add(value)
+                        if value not in lanes:
+                            problems.append(
+                                f"{rel}:{node.lineno}: lane {value!r} is "
+                                f"not in critical.LANES")
+                if name == "note_wait" and node.args:
+                    for value in _literal_strings(node.args[0]):
+                        wait_literals.add(value)
+                        if value not in waits:
+                            problems.append(
+                                f"{rel}:{node.lineno}: wait kind "
+                                f"{value!r} is not in critical.WAITS")
         for node, arg in _span_name_args(tree):
             names = list(_literal_strings(arg))
             if names:
@@ -146,6 +214,37 @@ def main() -> int:
                 f"{span!r} is recorded nowhere in karpenter_tpu/ "
                 f"(dead registry rows make the docs lie)")
 
+    # 4c) no dead lanes: every lane must be reachable, via a PHASE_LANES
+    # default or a literal call-site override
+    reachable = set(phase_lanes.values()) | lane_literals
+    for lane in lanes:
+        if lane not in reachable:
+            problems.append(
+                f"{CRITICAL.relative_to(ROOT)}: lane {lane!r} is neither a "
+                f"PHASE_LANES default nor a literal lane= at any note call "
+                f"site (a dead lane renders as an empty track forever)")
+
+    # 5b) no dead waits: every wait kind must be producible — a literal
+    # note_wait() somewhere, or attributed by the gap classifier in
+    # critical.py (its out[...] subscripts carry the kind literals)
+    crit_tree = ast.parse(CRITICAL.read_text(), filename=str(CRITICAL))
+    classifier_kinds: "set[str]" = set()
+    for fn in ast.walk(crit_tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for n in ast.walk(fn):
+            if (isinstance(n, ast.Subscript)
+                    and isinstance(n.slice, ast.Constant)
+                    and isinstance(n.slice.value, str)):
+                classifier_kinds.add(n.slice.value)
+    for kind in waits:
+        if kind not in wait_literals and kind not in classifier_kinds:
+            problems.append(
+                f"{CRITICAL.relative_to(ROOT)}: wait kind {kind!r} is "
+                f"produced nowhere (no note_wait literal, not attributed "
+                f"by the classifier) — dead vocabulary rows make the docs "
+                f"lie")
+
     for p in problems:
         print(f"check_phase_accounting: {p}", file=sys.stderr)
     if problems:
@@ -154,7 +253,7 @@ def main() -> int:
         return 1
     print(f"check_phase_accounting: ok ({len(phases)} gap phases, "
           f"{len(registry)} registered spans, {len(used)} literal call "
-          f"sites)")
+          f"sites, {len(lanes)} lanes, {len(waits)} wait kinds)")
     return 0
 
 

@@ -505,6 +505,29 @@ def check_profiling_noop(profiling) -> "list[Violation]":
     return out
 
 
+def check_critical_noop(critical) -> "list[Violation]":
+    """critical-strict-noop: the critical-path ledger is advisory — with
+    KARPENTER_TPU_CRITICAL off the gap ledger's flat accumulation keeps
+    working but NO interval records, wait notes, or ring rows may appear.
+    The runner runs a probe window with the plane disabled and hands us
+    before/after activity counters (karpenter_tpu.profiling.critical
+    .activity()); ANY growth means a producer ignored the switch and the
+    chain view has become load-bearing."""
+    if not critical or critical.get("enabled", True):
+        return []  # not part of this drill, or plane was left on
+    out: "list[Violation]" = []
+    before = critical.get("before") or {}
+    after = critical.get("after") or {}
+    for key in sorted(set(before) | set(after)):
+        grew = after.get(key, 0) - before.get(key, 0)
+        if grew > 0:
+            out.append(Violation(
+                "critical-strict-noop",
+                f"critical ledger disabled but {key} grew by {grew} "
+                f"({before.get(key, 0)} -> {after.get(key, 0)})"))
+    return out
+
+
 def check_explain_noop(explain) -> "list[Violation]":
     """explain-strict-noop: the decision-provenance plane is advisory —
     with the plane disabled it must do NOTHING. The runner disables
@@ -781,7 +804,7 @@ def check_all(op, cloud, token_launches=None,
               consolidation_actions=None,
               resilience=None, profiling=None,
               explain=None, membership=None,
-              incremental=None) -> "list[Violation]":
+              incremental=None, critical=None) -> "list[Violation]":
     out = []
     out += check_token_ledger(token_launches or {})
     out += check_bijection(op, cloud)
@@ -793,6 +816,9 @@ def check_all(op, cloud, token_launches=None,
     out += check_degrade_monotone(resilience)
     out += check_columnar_coherence(op)
     out += check_profiling_noop(profiling)
+    # the critical plane runs a dedicated probe window after the scenario
+    # (enabled evidence + disabled strict-noop) — see chaos/runner.py
+    out += check_critical_noop((critical or {}).get("noop"))
     out += check_explain_noop(explain)
     out += check_membership_noop(membership)
     # the incremental plane carries TWO windows: the chaotic cycles run

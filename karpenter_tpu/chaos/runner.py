@@ -389,6 +389,59 @@ class ChaosRunner:
                 "deltas": {k: prof_after[k] - prof_before[k]
                            for k in prof_before},
             }
+            # critical plane: probe-based TWO-window evidence, run after
+            # prof_after is captured so the probe's own gap-ledger rows
+            # cannot disturb the profiling-noop diff. The enabled window
+            # proves the plane records when on (producers wired); the
+            # noop window proves KARPENTER_TPU_CRITICAL=0 moves zero
+            # counters and leaves the interval ring empty (strict-noop,
+            # invariants.check_critical_noop).
+            from ..profiling import GAP_LEDGER
+            from ..profiling import critical as critical_plane
+
+            def _critical_probe():
+                with GAP_LEDGER.solve_scope("chaos_probe"):
+                    GAP_LEDGER.note("encode", 1e-4, lane="encode")
+                    GAP_LEDGER.note("device_exec", 1e-4, lane="device")
+                    GAP_LEDGER.note_wait("queue_wait", 1e-4, lane="tick")
+
+            crit_prof_prev = profiling.set_enabled(True)
+            crit_prev = critical_plane.set_enabled(True)
+            crit_on_before = critical_plane.activity()
+            _critical_probe()
+            _critical_probe()
+            crit_on_after = critical_plane.activity()
+            critical_plane.set_enabled(False)
+            crit_off_before = critical_plane.activity()
+            _critical_probe()
+            _critical_probe()
+            crit_off_after = critical_plane.activity()
+            critical_plane.set_enabled(crit_prev)
+            profiling.set_enabled(crit_prof_prev)
+            critical_evidence = {
+                "enabled": {"enabled": True,
+                            "before": crit_on_before,
+                            "after": crit_on_after},
+                "noop": {"enabled": False,
+                         "before": crit_off_before,
+                         "after": crit_off_after},
+            }
+            # stored enabled-window deltas carry only the MONOTONIC
+            # counters: the ring-length delta is not a pure function of
+            # the seed once the ring is at capacity, and the replay
+            # contract forbids nondeterministic fields
+            _crit_monotone = ("records_total", "intervals_total",
+                              "wait_notes_total")
+            critical_stored = {
+                "enabled": {"enabled": True,
+                            "deltas": {k: crit_on_after[k]
+                                       - crit_on_before[k]
+                                       for k in _crit_monotone}},
+                "noop": {"enabled": False,
+                         "deltas": {k: crit_off_after[k]
+                                    - crit_off_before[k]
+                                    for k in crit_off_before}},
+            }
             expl_after = explain.activity()
             explain_evidence = {
                 "enabled": False,
@@ -438,7 +491,8 @@ class ChaosRunner:
                 profiling=profiling_evidence,
                 explain=explain_evidence,
                 membership=membership_evidence,
-                incremental=incremental_evidence)
+                incremental=incremental_evidence,
+                critical=critical_evidence)
             if not self._quiescent(op):
                 violations = [invariants.Violation(
                     "quiescence",
@@ -487,6 +541,7 @@ class ChaosRunner:
             "explain": explain_stored,
             "membership": membership_stored,
             "incremental": incremental_stored,
+            "critical": critical_stored,
             "violations": [v.as_dict() for v in violations],
             "passed": not violations,
         }

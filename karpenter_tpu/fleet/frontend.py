@@ -456,6 +456,16 @@ class FleetFrontend:
             from ..profiling import GAP_LEDGER
             with GAP_LEDGER.solve_scope("fleet"):
                 GAP_LEDGER.annotate(bucket=plan.label(), batch=len(batch))
+                # explicit cross-thread wait: each ticket's admission->
+                # dispatch queue time happened on OTHER threads before
+                # this scope opened, so lane-gap classification cannot
+                # see it — file it as queue_wait on the tick lane (the
+                # critical plane's wait vocabulary, ISSUE 18)
+                for t in batch:
+                    GAP_LEDGER.note_wait(
+                        "queue_wait",
+                        max(0.0, dispatch_started - t.admitted_at),
+                        lane="tick")
                 results = self._solve_batch(key, problems)
         except Exception as e:  # noqa: BLE001 — resolve, never wedge callers
             with self._lock:

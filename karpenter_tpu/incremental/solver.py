@@ -129,7 +129,7 @@ class IncrementalSolver:
                                          self.threshold)
             dt = time.perf_counter() - t0
             TRACER.record_span("solver.extract", dt)
-            GAP_LEDGER.note("extract", dt)
+            GAP_LEDGER.note("extract", dt, lane="encode")
             _bump(cycles=1, extracted_rows=len(dirty))
             if reason is not None:
                 return self._full_solve(pods, full_existing, base, reason,
@@ -145,7 +145,7 @@ class IncrementalSolver:
                                patched_rows=patched,
                                sub_nodes=len(sub.existing),
                                full_nodes=sub.full_nodes)
-            GAP_LEDGER.note("warm_start", dt)
+            GAP_LEDGER.note("warm_start", dt, lane="encode")
             _bump(mask_patches=patched)
 
             result, kind = base(pods, sub.existing)

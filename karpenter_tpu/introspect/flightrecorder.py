@@ -64,6 +64,17 @@ def _profile_section() -> dict:
     }
 
 
+# critical rows carried per bundle — the chain/wait view of the solves
+# leading into the trigger (full ring at /debug/criticalz)
+BUNDLE_CRITICAL_ROWS = 20
+
+
+def _critical_section() -> dict:
+    from ..profiling import critical
+
+    return critical.criticalz(BUNDLE_CRITICAL_ROWS)
+
+
 def _decisions_section(limit: int = BUNDLE_DECISIONS) -> dict:
     from .. import explain
 
@@ -133,6 +144,10 @@ class FlightRecorder:
             # first question is "which phase ate the budget" (gap ledger),
             # and the folded stacks say what the host was doing meanwhile
             "profile": fenced(_profile_section),
+            # the critical-path view of the same solves: which phase was
+            # on the chain, what the lanes waited on, and whether the
+            # measured roofline flagged model drift
+            "critical": fenced(_critical_section),
             # the explain ring's tail: every bundle carries the decisions
             # (assignments, unschedulable attributions, consolidation
             # verdicts, sheds) that led into the trigger

@@ -23,10 +23,11 @@ from ..metrics import REGISTRY, Gauge, Histogram
 
 log = logging.getLogger("karpenter.statusz")
 
-# 10: added "incremental" (delta-solving plane counters + resident
-# residency) (9: "pid" + "serving"; 8: "decisions"; 7: "profiling";
-# 6: "hbm"; 5: "slo")
-SCHEMA_VERSION = 10
+# 11: added "critical" (critical-path ledger: overlap ratio, wait
+# vocabulary totals, measured-roofline rungs + drift flags)
+# (10: "incremental"; 9: "pid" + "serving"; 8: "decisions";
+# 7: "profiling"; 6: "hbm"; 5: "slo")
+SCHEMA_VERSION = 11
 
 # hard caps so a pathological operator can't make statusz unbounded
 MAX_EVENTS = 50
@@ -184,6 +185,16 @@ def _profiling_section() -> dict:
     return profiling_snapshot()
 
 
+def _critical_section() -> dict:
+    # the critical-path plane's snapshot: overlap ratio + chain of the
+    # most recent solves, cumulative wait-vocabulary totals, and the
+    # measured-roofline rung table with drift flags (full rows live at
+    # /debug/criticalz and in flight-recorder bundles)
+    from ..profiling import critical
+
+    return critical.snapshot()
+
+
 def _decisions_section() -> dict:
     # the explain plane's snapshot: ring activity counters, the reason
     # vocabulary, and the most recent DecisionRecord ids (full records
@@ -231,6 +242,7 @@ def snapshot(op) -> dict:
         "hbm": _fenced(_hbm_section),
         "incremental": _fenced(lambda: _incremental_section(op)),
         "profiling": _fenced(_profiling_section),
+        "critical": _fenced(_critical_section),
         "decisions": _fenced(_decisions_section),
         "metrics": _fenced(_metrics_section),
     }

@@ -18,7 +18,9 @@ overhead <5% vs a disabled baseline, on both routing paths.
 """
 from __future__ import annotations
 
+from . import critical  # noqa: F401
 from .continuous import PROFILE_LANE_PID, PROFILER  # noqa: F401
+from .critical import CRITICAL, LANES, WAITS  # noqa: F401
 from .gapledger import GAP_LEDGER, PHASE_NAMES, PHASES  # noqa: F401
 from .state import disabled, enabled, set_enabled  # noqa: F401
 
@@ -74,5 +76,7 @@ def folded_text(limit: "int | None" = None) -> str:
 
 
 def merge_chrome(doc: dict) -> dict:
-    """Append the ``profiling`` process lane to a chrome-trace doc."""
-    return PROFILER.merge_chrome(doc)
+    """Append the ``profiling`` process lane to a chrome-trace doc, then
+    the ``critical`` lane (interval records with critical-path marks +
+    wait markers) when that plane has evidence in the window."""
+    return critical.merge_chrome(PROFILER.merge_chrome(doc))

@@ -1,0 +1,522 @@
+"""Critical-path ledger: overlap-aware wait/work attribution (ISSUE 18).
+
+The gap ledger is a FLAT decomposition — phases sum to the wall — which is
+structurally blind to concurrency: once host encode overlaps device
+execute (the ROADMAP 2a pipelining arc), the sum-to-wall invariant breaks
+and the flat instrument can no longer say which phase gates the wall.
+This module makes the same ``note()`` measurements speak intervals, lanes
+and waits:
+
+- every duration note becomes an Interval (monotonic start/end relative
+  to the scope open, plus a LANE id: the encode thread, the fleet tick
+  loop, the solver wave, the device stream, wire serialize), kept in a
+  bounded per-solve interval list and a bounded cross-solve ring;
+- the longest dependency chain over those intervals is the CRITICAL PATH
+  (weighted longest chain of non-overlapping intervals — an interval can
+  only depend on work that finished before it started);
+- every phase splits into ``on_critical_path_ms`` / ``off_critical_path_ms``;
+- gaps between consecutive intervals on a lane are classified into an
+  explicit WAIT vocabulary (queue_wait / device_wait / encode_wait /
+  lock_wait), and cross-thread waits the lane geometry cannot see (the
+  fleet frontend's admission->dispatch queue time) are filed explicitly
+  via ``GAP_LEDGER.note_wait``;
+- ``karpenter_profile_overlap_ratio`` = 1 − critical_path / sum-of-work.
+  On today's strictly serial path the chain contains EVERY interval in
+  end order, both sums fold identically, and the ratio is exactly 0.0 —
+  the baseline number the pipelining PR must move.
+
+The flat ledger survives as a PROJECTION: ``project_flat(intervals)``
+folds interval durations per phase in append order, bit-identical to the
+``rec.phases`` accumulation the gap ledger never stopped doing — every
+existing phases-sum-to-wall consumer is untouched.
+
+Strict-noop contract (the profiling/state.py pattern): with
+``KARPENTER_TPU_CRITICAL=0`` no interval is recorded, no wait is filed,
+no counter moves and the ring stays empty — the chaos
+``critical-strict-noop`` invariant diffs :func:`activity` to prove it.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+import time
+from bisect import bisect_right
+from collections import deque
+from typing import NamedTuple
+
+from ..metrics import REGISTRY
+
+log = logging.getLogger(__name__)
+
+FLAG_ENV = "KARPENTER_TPU_CRITICAL"
+_FALSY = ("0", "false", "off", "no")
+
+_lock = threading.Lock()
+_enabled = os.environ.get(FLAG_ENV, "1").strip().lower() not in _FALSY
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip the plane; returns the previous state (restore token)."""
+    global _enabled
+    with _lock:
+        prev = _enabled
+        _enabled = bool(on)
+        return prev
+
+
+@contextlib.contextmanager
+def disabled():
+    """Scoped hard-off: overhead baselines and the chaos strict-noop drill."""
+    prev = set_enabled(False)
+    try:
+        yield
+    finally:
+        set_enabled(prev)
+
+
+#: interval lanes — WHERE work runs. hack/check_phase_accounting.py keeps
+#: every literal ``lane=`` at a note() call site inside this tuple and
+#: flags dead lanes, the PHASES-table contract applied to concurrency.
+#:
+#:   encode   host problem preparation (extract/warm_start/encode/decode)
+#:   tick     the fleet frontend tick loop (admission -> wave dispatch)
+#:   solver   the solver wave driver (host dispatch / XLA link)
+#:   device   the device stream (the one blocking device->host fetch)
+#:   wire     wire serialize at the service boundary
+LANES = ("encode", "tick", "solver", "device", "wire")
+
+#: wait vocabulary — WHY a lane sat idle between two work intervals.
+#: Classified from lane geometry (precedence below) or filed explicitly
+#: via GAP_LEDGER.note_wait (cross-thread waits a single-threaded lane
+#: trace cannot see, e.g. the fleet queue).
+WAITS = ("queue_wait", "device_wait", "encode_wait", "lock_wait")
+
+#: default lane per gap phase — callers override with note(lane=...).
+PHASE_LANES = {
+    "extract": "encode",
+    "warm_start": "encode",
+    "encode": "encode",
+    "decode": "encode",
+    "serialize": "wire",
+    "link": "solver",
+    "device_exec": "device",
+}
+
+RING_ENV = "KARPENTER_TPU_CRITICAL_RING"
+DEFAULT_RING = 256
+#: per-solve interval bound: a runaway wave cannot grow one record
+#: without limit (solve_many at max wave files ~4 notes per problem)
+MAX_INTERVALS_PER_SOLVE = 4096
+#: gaps shorter than this are timer jitter, not a wait (10 microseconds)
+MIN_WAIT_S = 1e-5
+
+OVERLAP_RATIO = REGISTRY.gauge(
+    "karpenter_profile_overlap_ratio",
+    "1 - critical_path/sum_of_work for the most recent solve "
+    "(0 = strictly serial; the pipelining arc must raise this)",
+    ("source",))
+CRITICAL_PATH_MS = REGISTRY.gauge(
+    "karpenter_profile_critical_path_ms",
+    "Longest dependency chain of the most recent solve's intervals",
+    ("source",))
+WAIT_MS = REGISTRY.counter(
+    "karpenter_profile_wait_ms_total",
+    "Cumulative lane idle milliseconds by wait kind",
+    ("wait",))
+
+
+class Interval(NamedTuple):
+    """One duration note as an interval on a lane. ``dur`` is the MEASURED
+    duration (clamped >= 0 exactly like the flat accumulation clamps), and
+    ``start = max(0, end - dur)`` so clock skew can never produce a
+    negative interval; start/end are seconds relative to the scope open."""
+    lane: str
+    phase: str
+    start: float
+    end: float
+    dur: float
+
+
+def make_interval(lane: str, phase: str, rel_end: float,
+                  seconds: float) -> Interval:
+    dur = max(0.0, seconds)
+    end = max(0.0, rel_end)
+    return Interval(lane, phase, max(0.0, end - dur), end, dur)
+
+
+def _ring_cap() -> int:
+    raw = os.environ.get(RING_ENV)
+    if raw is None:
+        return DEFAULT_RING
+    try:
+        v = int(raw)
+        if v <= 0:
+            raise ValueError(raw)
+        return min(v, 65536)
+    except ValueError:
+        log.warning("%s=%r invalid (want a positive integer); using %d",
+                    RING_ENV, raw, DEFAULT_RING)
+        return DEFAULT_RING
+
+
+# -- pure analysis (no state; tests drive these on synthetic DAGs) -------------
+
+
+def project_flat(intervals: "list[Interval]") -> "dict[str, float]":
+    """The flat gap-ledger projection: per-phase duration sums folded in
+    APPEND order — the exact accumulation order ``GapLedger.note`` uses
+    for ``rec.phases``, so the result is bit-identical to the flat row
+    every existing consumer reads (tests assert equality, not closeness)."""
+    out: "dict[str, float]" = {}
+    for iv in intervals:
+        out[iv.phase] = out.get(iv.phase, 0.0) + iv.dur
+    return out
+
+
+def critical_path(intervals: "list[Interval]") -> "tuple[float, list[int]]":
+    """Longest weighted chain of non-overlapping intervals: interval j can
+    precede i iff ``end_j <= start_i`` (work can only depend on work that
+    had finished when it started). Returns (chain seconds, member indices
+    into `intervals`). DP over end-sorted order with a prefix-max +
+    bisect, O(n log n).
+
+    Exact-0 serial guarantee: on a strictly serial trace the chain visits
+    every interval in end order, accumulating ``dur_i + best`` — by IEEE
+    commutativity that matches the left-fold ``sum()`` over the same order
+    bit-for-bit, so ``analyze`` reports overlap_ratio exactly 0.0."""
+    n = len(intervals)
+    if n == 0:
+        return 0.0, []
+    order = sorted(range(n), key=lambda i: (intervals[i].end,
+                                            intervals[i].start, i))
+    ends = [intervals[i].end for i in order]
+    best = [0.0] * n       # best chain sum ending at order[k]
+    pred = [-1] * n        # predecessor in order-space
+    # prefix_best[k] = (max over best[0..k], argmax) — monotone, so the
+    # bisect below lands on the best chain finishing by start_i
+    prefix_best = [0.0] * n
+    prefix_arg = [0] * n
+    for k, idx in enumerate(order):
+        iv = intervals[idx]
+        j = bisect_right(ends, iv.start, 0, k) - 1
+        if j >= 0:
+            best[k] = iv.dur + prefix_best[j]
+            pred[k] = prefix_arg[j]
+        else:
+            best[k] = iv.dur
+        if k == 0 or best[k] >= prefix_best[k - 1]:
+            prefix_best[k] = best[k]
+            prefix_arg[k] = k
+        else:
+            prefix_best[k] = prefix_best[k - 1]
+            prefix_arg[k] = prefix_arg[k - 1]
+    k = prefix_arg[n - 1]
+    members: "list[int]" = []
+    while k >= 0:
+        members.append(order[k])
+        k = pred[k]
+    members.reverse()
+    return best[prefix_arg[n - 1]], members
+
+
+def classify_waits(intervals: "list[Interval]") -> "dict[str, float]":
+    """Gap-between-intervals wait attribution: for each lane, the idle
+    span between consecutive work intervals is classified by what the
+    OTHER lanes were doing during it (precedence order: a busy device
+    lane wins, then a busy encode lane; a gap on the tick lane with no
+    busy producer is queue time; anything else is a lock/handoff wait)."""
+    out = {w: 0.0 for w in WAITS}
+    by_lane: "dict[str, list[Interval]]" = {}
+    for iv in intervals:
+        by_lane.setdefault(iv.lane, []).append(iv)
+
+    def busy(lane: str, a: float, b: float) -> bool:
+        return any(iv.end > a + MIN_WAIT_S and iv.start < b - MIN_WAIT_S
+                   for iv in by_lane.get(lane, ()))
+
+    for lane, ivs in by_lane.items():
+        ivs = sorted(ivs, key=lambda iv: (iv.start, iv.end))
+        frontier = ivs[0].end
+        for iv in ivs[1:]:
+            gap = iv.start - frontier
+            if gap > MIN_WAIT_S:
+                if lane != "device" and busy("device", frontier, iv.start):
+                    out["device_wait"] += gap
+                elif lane != "encode" and busy("encode", frontier, iv.start):
+                    out["encode_wait"] += gap
+                elif lane == "tick":
+                    out["queue_wait"] += gap
+                else:
+                    out["lock_wait"] += gap
+            frontier = max(frontier, iv.end)
+    return out
+
+
+def analyze(intervals: "list[Interval]",
+            explicit_waits: "list[tuple[str, str, float]] | None" = None,
+            wall_ms: "float | None" = None) -> dict:
+    """The per-solve critical view: chain length, overlap ratio, per-phase
+    on/off-critical split, wait breakdown (classified gaps + explicit
+    notes). Pure — the ledger calls it at observe time, tests call it on
+    hand-built DAGs. Ratio is structurally in [0, 1): the chain contains
+    at least the longest single interval, so critical >= max(dur) > 0
+    whenever any work was measured."""
+    # sum-of-work folded over END-sorted order — the same order the DP
+    # accumulates the serial chain in, which is what makes serial traces
+    # report exactly 0.0 (see critical_path docstring)
+    order = sorted(range(len(intervals)),
+                   key=lambda i: (intervals[i].end, intervals[i].start, i))
+    total_work = 0.0
+    for i in order:
+        total_work += intervals[i].dur
+    crit, members = critical_path(intervals)
+    member_set = set(members)
+    ratio = 0.0
+    if total_work > 0 and crit < total_work:
+        ratio = 1.0 - crit / total_work
+    ratio = min(max(ratio, 0.0), 1.0)
+    on_ms: "dict[str, float]" = {}
+    off_ms: "dict[str, float]" = {}
+    for i, iv in enumerate(intervals):
+        side = on_ms if i in member_set else off_ms
+        side[iv.phase] = side.get(iv.phase, 0.0) + iv.dur * 1e3
+    waits = classify_waits(intervals)
+    for kind, _lane, dur in (explicit_waits or ()):
+        if kind in waits:
+            waits[kind] += max(0.0, dur)
+    crit_ms = crit * 1e3
+    out = {
+        "critical_path_ms": round(crit_ms, 4),
+        "total_work_ms": round(total_work * 1e3, 4),
+        "overlap_ratio": round(ratio, 6),
+        "intervals": len(intervals),
+        "lanes": sorted({iv.lane for iv in intervals}),
+        "on_critical_path_ms": {k: round(v, 4)
+                                for k, v in sorted(on_ms.items())},
+        "off_critical_path_ms": {k: round(v, 4)
+                                 for k, v in sorted(off_ms.items())},
+        "critical_share": {
+            k: round(v / crit_ms, 6) for k, v in sorted(on_ms.items())
+        } if crit_ms > 0 else {},
+        "waits_ms": {k: round(v * 1e3, 4) for k, v in waits.items()},
+    }
+    if wall_ms is not None:
+        out["wall_ms"] = round(wall_ms, 4)
+    return out
+
+
+# -- the ledger ----------------------------------------------------------------
+
+
+class CriticalLedger:
+    """Bounded ring of per-solve critical analyses + monotone activity
+    counters. Fed by GapLedger._observe; read by /debug/criticalz, the
+    statusz ``critical`` section, flight-recorder bundles and the
+    Perfetto critical lane."""
+
+    #: synthetic pid for the critical lane in merged Perfetto traces,
+    #: adjacent to continuous.PROFILE_LANE_PID (0x70F1)
+    LANE_PID = 0x70F2
+
+    def __init__(self, ring: "int | None" = None):
+        self._lock = threading.Lock()
+        self._rows: "deque[dict]" = deque(
+            maxlen=ring if ring is not None else _ring_cap())
+        self.records_total = 0
+        self.intervals_total = 0
+        self.wait_notes_total = 0
+        self._wait_ms_total: "dict[str, float]" = {w: 0.0 for w in WAITS}
+
+    # -- write side ----------------------------------------------------------
+
+    def observe(self, source: str, intervals: "list[Interval]",
+                explicit_waits: "list[tuple[str, str, float]]",
+                wall_ms: float, anchor_ts: float) -> "dict | None":
+        """Analyze one solve's intervals and file the result. Returns the
+        analysis row (the gap ledger embeds a copy in its flat row) or
+        None when the plane is disabled or nothing was measured."""
+        if not enabled() or not intervals:
+            return None
+        row = analyze(intervals, explicit_waits, wall_ms=wall_ms)
+        row["ts"] = time.time()
+        row["source"] = source
+        # wall-clock anchor + relative interval records: everything the
+        # Perfetto merge needs to place slices without re-deriving time
+        row["anchor_ts"] = anchor_ts
+        row["records"] = [
+            {"lane": iv.lane, "phase": iv.phase,
+             "start_ms": round(iv.start * 1e3, 4),
+             "end_ms": round(iv.end * 1e3, 4),
+             "dur_ms": round(iv.dur * 1e3, 4)}
+            for iv in intervals[:64]
+        ]
+        with self._lock:
+            self._rows.append(row)
+            self.records_total += 1
+            self.intervals_total += len(intervals)
+            for k, ms in row["waits_ms"].items():
+                self._wait_ms_total[k] = self._wait_ms_total.get(k, 0.0) + ms
+        OVERLAP_RATIO.set(row["overlap_ratio"], source=source)
+        CRITICAL_PATH_MS.set(row["critical_path_ms"], source=source)
+        for k, ms in row["waits_ms"].items():
+            if ms > 0:
+                WAIT_MS.inc(ms, wait=k)
+        return row
+
+    def count_wait_note(self) -> None:
+        with self._lock:
+            self.wait_notes_total += 1
+
+    # -- read side -----------------------------------------------------------
+
+    def ring_len(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    def rows(self, limit: "int | None" = None) -> "list[dict]":
+        with self._lock:
+            out = list(self._rows)
+        return out[-limit:] if limit else out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rows.clear()
+
+    def activity(self) -> dict:
+        """Monotone counters + ring length — the chaos
+        ``critical-strict-noop`` invariant diffs two of these."""
+        with self._lock:
+            return {
+                "records_total": self.records_total,
+                "intervals_total": self.intervals_total,
+                "wait_notes_total": self.wait_notes_total,
+                "ring": len(self._rows),
+            }
+
+    def snapshot(self) -> dict:
+        """The statusz schema-11 ``critical`` section (also embedded in
+        flight-recorder bundles)."""
+        from . import roofline
+
+        with self._lock:
+            rows = list(self._rows)
+            waits = {k: round(v, 3) for k, v in self._wait_ms_total.items()}
+        return {
+            "enabled": enabled(),
+            "lanes": list(LANES),
+            "waits": list(WAITS),
+            "records_total": self.records_total,
+            "intervals_total": self.intervals_total,
+            "wait_notes_total": self.wait_notes_total,
+            "ring_len": len(rows),
+            "wait_ms_total": waits,
+            "last": [{k: v for k, v in r.items() if k != "records"}
+                     for r in rows[-3:]],
+            "roofline_measured": roofline.measured_snapshot(),
+        }
+
+    def criticalz(self, limit: int = 50) -> dict:
+        """/debug/criticalz?format=json — the full read surface."""
+        from . import roofline
+
+        rows = self.rows(limit)
+        return {
+            "tool": "karpenter_tpu.criticalz",
+            "schema": 1,
+            "enabled": enabled(),
+            "lanes": list(LANES),
+            "waits": list(WAITS),
+            "phase_lanes": dict(PHASE_LANES),
+            "records_total": self.records_total,
+            "ring_len": self.ring_len(),
+            "rows": rows,
+            "roofline_measured": roofline.measured_snapshot(),
+        }
+
+    def merge_chrome(self, doc: dict) -> dict:
+        """Append a ``critical`` process lane to a chrome-trace doc: one
+        complete-slice per interval record (args mark critical-path
+        membership) plus instant markers for the classified waits —
+        the fleetview/profiling process-lane idiom, pid 0x70F2."""
+        if not enabled() or not isinstance(doc, dict):
+            return doc
+        events = doc.get("traceEvents")
+        if not isinstance(events, list) or not events:
+            return doc
+        spans = [e for e in events if e.get("ph") != "M"]
+        if not spans:
+            return doc
+        lo = min(e["ts"] for e in spans)
+        hi = max(e["ts"] + e.get("dur", 0) for e in spans)
+        lane_events: "list[dict]" = []
+        tid_of = {lane: i for i, lane in enumerate(LANES)}
+        for row in self.rows():
+            anchor_us = row.get("anchor_ts", 0.0) * 1e6
+            if anchor_us < lo - 1e6 or anchor_us > hi:
+                continue
+            on_crit = row.get("critical_share", {})
+            for rec in row.get("records", ()):
+                ts = anchor_us + rec["start_ms"] * 1e3
+                if ts < lo or ts > hi:
+                    continue
+                lane_events.append({
+                    "name": rec["phase"], "ph": "X",
+                    "ts": ts, "dur": max(rec["dur_ms"], 1e-3) * 1e3,
+                    "pid": self.LANE_PID,
+                    "tid": tid_of.get(rec["lane"], len(LANES)),
+                    "args": {"lane": rec["lane"],
+                             "on_critical_path": rec["phase"] in on_crit,
+                             "source": row.get("source", "")},
+                })
+            for kind, ms in row.get("waits_ms", {}).items():
+                if ms <= 0 or anchor_us < lo or anchor_us > hi:
+                    continue
+                lane_events.append({
+                    "name": kind, "ph": "i", "s": "t",
+                    "ts": anchor_us, "pid": self.LANE_PID,
+                    "tid": len(LANES),
+                    "args": {"wait_ms": ms, "source": row.get("source", "")},
+                })
+        if not lane_events:
+            return doc
+        meta = [e for e in events if e.get("ph") == "M"]
+        rest = [e for e in events if e.get("ph") != "M"] + lane_events
+        rest.sort(key=lambda e: e["ts"])
+        meta.append({"name": "process_name", "ph": "M",
+                     "pid": self.LANE_PID, "tid": 0,
+                     "args": {"name": "critical"}})
+        for lane, tid in tid_of.items():
+            meta.append({"name": "thread_name", "ph": "M",
+                         "pid": self.LANE_PID, "tid": tid,
+                         "args": {"name": f"lane:{lane}"}})
+        meta.append({"name": "thread_name", "ph": "M",
+                     "pid": self.LANE_PID, "tid": len(LANES),
+                     "args": {"name": "waits"}})
+        doc = dict(doc)
+        doc["traceEvents"] = meta + rest
+        return doc
+
+
+CRITICAL = CriticalLedger()
+
+
+def activity() -> dict:
+    return CRITICAL.activity()
+
+
+def snapshot() -> dict:
+    return CRITICAL.snapshot()
+
+
+def criticalz(limit: int = 50) -> dict:
+    return CRITICAL.criticalz(limit)
+
+
+def merge_chrome(doc: dict) -> dict:
+    return CRITICAL.merge_chrome(doc)

@@ -39,7 +39,7 @@ import time
 from collections import deque
 
 from ..metrics import REGISTRY
-from . import roofline, state
+from . import critical, roofline, state
 
 log = logging.getLogger(__name__)
 
@@ -89,11 +89,19 @@ def _ring_cap() -> int:
 
 
 class _Record:
-    __slots__ = ("phases", "attrs")
+    __slots__ = ("phases", "attrs", "intervals", "waits", "wall0", "perf0")
 
     def __init__(self):
         self.phases: "dict[str, float]" = {}
         self.attrs: "dict[str, object]" = {}
+        # the critical-plane side of the record: interval records per
+        # note, explicit cross-thread wait notes, and the wall/monotonic
+        # anchor pair (wall places Perfetto slices; perf positions the
+        # relative interval times)
+        self.intervals: "list[critical.Interval]" = []
+        self.waits: "list[tuple[str, str, float]]" = []
+        self.wall0 = time.time()
+        self.perf0 = time.perf_counter()
 
 
 class GapLedger:
@@ -133,10 +141,24 @@ class GapLedger:
             self._tls.rec = None
             self._observe(source, time.perf_counter() - t0, rec)
 
-    def note(self, phase: str, seconds: float) -> None:
+    def note(self, phase: str, seconds: float, *,
+             lane: "str | None" = None,
+             end_pc: "float | None" = None) -> None:
         """File measured seconds into a named phase of the open record.
         No-op without an open scope (a bare encode_problem in a test) or
-        while the plane is disabled."""
+        while the plane is disabled.
+
+        The flat accumulation below is the ORIGINAL ledger semantics,
+        byte-for-byte — the critical plane rides along as an ADDITIONAL
+        interval record (lane + monotonic start/end), so the flat view
+        stays a bit-compatible projection of the interval records
+        (critical.project_flat; tests assert equality).
+
+        ``lane`` overrides the phase's default lane
+        (critical.PHASE_LANES); ``end_pc`` is the perf_counter timestamp
+        the measured span ENDED at (defaults to now) — call sites that
+        batch several notes after the fact pass their own phase-boundary
+        timestamps so the intervals don't artificially stack."""
         rec = getattr(self._tls, "rec", None)
         if rec is None or not state.enabled():
             return
@@ -144,6 +166,34 @@ class GapLedger:
             raise ValueError(
                 f"unknown gap phase {phase!r} (want one of {PHASE_NAMES})")
         rec.phases[phase] = rec.phases.get(phase, 0.0) + max(0.0, seconds)
+        if (critical.enabled()
+                and len(rec.intervals) < critical.MAX_INTERVALS_PER_SOLVE):
+            if lane is not None and lane not in critical.LANES:
+                raise ValueError(
+                    f"unknown lane {lane!r} (want one of {critical.LANES})")
+            end = (end_pc if end_pc is not None
+                   else time.perf_counter()) - rec.perf0
+            rec.intervals.append(critical.make_interval(
+                lane or critical.PHASE_LANES.get(phase, "solver"),
+                phase, end, seconds))
+
+    def note_wait(self, kind: str, seconds: float, *,
+                  lane: str = "tick") -> None:
+        """File an EXPLICIT wait (critical.WAITS vocabulary) against the
+        open record — the cross-thread waits lane geometry cannot see,
+        e.g. the fleet frontend's admission->dispatch queue time. No-op
+        without an open scope or while either plane is disabled."""
+        rec = getattr(self._tls, "rec", None)
+        if rec is None or not state.enabled() or not critical.enabled():
+            return
+        if kind not in critical.WAITS:
+            raise ValueError(
+                f"unknown wait {kind!r} (want one of {critical.WAITS})")
+        if lane not in critical.LANES:
+            raise ValueError(
+                f"unknown lane {lane!r} (want one of {critical.LANES})")
+        rec.waits.append((kind, lane, max(0.0, seconds)))
+        critical.CRITICAL.count_wait_note()
 
     def annotate(self, **attrs) -> None:
         """Attach rung/route metadata to the open record (bucket label,
@@ -185,6 +235,18 @@ class GapLedger:
                 "floor_ms": round(rf.floor_ms, 6),
                 "backend": rf.backend,
                 "ratio": round(roofline.observe(rf, device_ms), 3),
+            }
+        # hand the interval records to the critical plane — the row grows
+        # a `critical` subsection (chain, overlap ratio, waits) but every
+        # pre-existing key above is computed exactly as before
+        crit_row = critical.CRITICAL.observe(
+            source, rec.intervals, rec.waits, wall_ms, rec.wall0)
+        if crit_row is not None:
+            row["critical"] = {
+                k: crit_row[k]
+                for k in ("critical_path_ms", "total_work_ms",
+                          "overlap_ratio", "critical_share", "waits_ms",
+                          "on_critical_path_ms", "off_critical_path_ms")
             }
         if device_ms > 0:
             from .continuous import PROFILER

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from typing import NamedTuple
 
 from ..metrics import REGISTRY
@@ -78,6 +79,34 @@ ROOFLINE_RATIO = REGISTRY.gauge(
     "karpenter_profile_roofline_ratio",
     "Measured device-exec ms / roofline floor ms (1.0 = at the roofline)",
     ("bucket",))
+
+# -- the measured (not modelled) floor (ISSUE 18) ------------------------------
+# At compile-cache warmup the solver captures `compiled.cost_analysis()` /
+# `memory_analysis()` per BucketPlan rung — XLA's own bytes/FLOPs for the
+# exact compiled program — so the floor the kernel arc chases is the
+# compiler's number. The modelled gauges above survive for trend
+# continuity; drift between the two >DRIFT_THRESHOLD× is a warning event
+# plus a statusz flag (the hand model silently diverging from the real
+# program is exactly the failure mode this layer exists to catch).
+
+#: modelled-vs-measured FLOPs ratio above which the model is flagged
+DRIFT_THRESHOLD = 2.0
+
+ROOFLINE_MEASURED_BYTES = REGISTRY.gauge(
+    "karpenter_profile_roofline_measured_bytes",
+    "XLA cost_analysis bytes accessed per solve at this rung",
+    ("bucket",))
+ROOFLINE_MEASURED_FLOPS = REGISTRY.gauge(
+    "karpenter_profile_roofline_measured_flops",
+    "XLA cost_analysis FLOPs per solve at this rung",
+    ("bucket",))
+ROOFLINE_MEASURED_FLOOR_MS = REGISTRY.gauge(
+    "karpenter_profile_roofline_measured_floor_ms",
+    "Per-solve floor ms = max(bytes/bw, flops/peak) from MEASURED numbers",
+    ("bucket",))
+
+_measured_lock = threading.Lock()
+_measured: "dict[str, dict]" = {}
 
 
 class Roofline(NamedTuple):
@@ -151,3 +180,76 @@ def observe(rf: Roofline, device_exec_ms: float) -> float:
     ratio = device_exec_ms / rf.floor_ms if rf.floor_ms > 0 else 0.0
     ROOFLINE_RATIO.set(ratio, bucket=rf.bucket)
     return ratio
+
+
+def record_measured(bucket: str, *, flops: float, bytes_accessed: float,
+                    backend: str = "cpu", device_count: int = 1,
+                    modelled: "Roofline | None" = None,
+                    memory_bytes: "float | None" = None) -> dict:
+    """File one rung's XLA-measured cost numbers: publish the measured
+    gauges, compute the measured floor against the same per-backend peaks
+    the model uses, and run the drift check — modelled-vs-measured FLOPs
+    ratio beyond DRIFT_THRESHOLD in either direction logs a warning event
+    and flags the rung in the statusz snapshot (the drill and tests read
+    the flag; flagged rungs are reported, never hidden).
+
+    FLOPs compare like-for-like (same quantity, two estimators); the byte
+    numbers measure DIFFERENT quantities (the model prices host<->device
+    boundary traffic, cost_analysis prices total memory traffic inside
+    the program), so the bytes delta is reported informationally and
+    never flags."""
+    fl = max(0.0, float(flops))
+    by = max(0.0, float(bytes_accessed))
+    bw_gbps, peak_gflops = peaks_for(backend)
+    dc = max(1, int(device_count))
+    floor_ms = max(by / (bw_gbps * 1e9),
+                   fl / (peak_gflops * 1e9 * dc)) * 1e3
+    entry = {
+        "bucket": bucket,
+        "backend": backend,
+        "flops": fl,
+        "bytes_accessed": by,
+        "floor_ms": round(floor_ms, 6),
+        "flagged": False,
+    }
+    if memory_bytes is not None:
+        entry["memory_bytes"] = float(memory_bytes)
+    if modelled is not None:
+        entry["modelled_flops"] = float(modelled.flops)
+        entry["modelled_bytes"] = float(modelled.bytes_moved)
+        entry["modelled_floor_ms"] = round(modelled.floor_ms, 6)
+        if fl > 0 and modelled.flops > 0:
+            drift = max(fl / modelled.flops, modelled.flops / fl)
+            entry["flops_drift"] = round(drift, 3)
+            if drift > DRIFT_THRESHOLD:
+                entry["flagged"] = True
+                log.warning(
+                    "roofline drift at rung %s: modelled %.3g FLOPs vs "
+                    "measured %.3g (%.1fx > %.1fx) — the cost model has "
+                    "diverged from the compiled program",
+                    bucket, modelled.flops, fl, drift, DRIFT_THRESHOLD)
+    ROOFLINE_MEASURED_BYTES.set(by, bucket=bucket)
+    ROOFLINE_MEASURED_FLOPS.set(fl, bucket=bucket)
+    ROOFLINE_MEASURED_FLOOR_MS.set(floor_ms, bucket=bucket)
+    with _measured_lock:
+        _measured[bucket] = entry
+    return entry
+
+
+def measured_snapshot() -> dict:
+    """Per-rung measured entries + an any-rung-flagged rollup (the statusz
+    `critical` section embeds this; the drill ledgers the deltas)."""
+    with _measured_lock:
+        rungs = {k: dict(v) for k, v in _measured.items()}
+    return {
+        "drift_threshold": DRIFT_THRESHOLD,
+        "rungs": rungs,
+        "drift_flagged": sorted(k for k, v in rungs.items()
+                                if v.get("flagged")),
+    }
+
+
+def clear_measured() -> None:
+    """Test hook: drop recorded measured entries."""
+    with _measured_lock:
+        _measured.clear()

@@ -38,6 +38,10 @@ MAX_TRACE_LIMIT = 200
 # beyond this the tail is single-sample noise
 MAX_PROFILE_STACKS = 500
 
+# /debug/criticalz ?n= ceiling: the critical ledger's ring default — a
+# larger ask only re-serializes the same tail
+MAX_CRITICAL_ROWS = 256
+
 # /debug/decisions ?limit= and /debug/bundle ?decisions= ceiling: the
 # explain ring defaults to 256 resident records — a larger ask only
 # re-serializes the same tail
@@ -251,6 +255,23 @@ class ServingPlane:
                         return self._text(200, profiling.folded_text(n) + "\n")
                     return self._text(
                         200, json.dumps(profiling.profilez(n), default=str),
+                        content_type="application/json")
+                if self.path.startswith("/debug/criticalz"):
+                    # critical-path read surface (ISSUE 18): per-solve
+                    # interval analyses — chain length, overlap ratio,
+                    # on/off-critical phase split, wait breakdown, plus
+                    # the measured-roofline rung table; ?n= bounds the
+                    # row listing (clamped like /debug/profilez ?n=)
+                    from urllib.parse import parse_qs, urlsplit
+
+                    from .profiling import critical
+
+                    qs = parse_qs(urlsplit(self.path).query)
+                    n = clamped_int_param(qs, "n", 50, MAX_CRITICAL_ROWS)
+                    if n is None:
+                        return self._text(400, "n must be an integer")
+                    return self._text(
+                        200, json.dumps(critical.criticalz(n), default=str),
                         content_type="application/json")
                 if self.path.startswith("/debug/decisions"):
                     # decision-provenance ring (the explain plane): index

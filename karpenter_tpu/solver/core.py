@@ -226,7 +226,8 @@ class TPUSolver:
             # it is ~1 ms, the biggest pre-_solve_once chunk of wall)
             _t0 = _time.perf_counter()
             primary, deferred = split_deferred_pods(pods)
-            GAP_LEDGER.note("encode", _time.perf_counter() - _t0)
+            GAP_LEDGER.note("encode", _time.perf_counter() - _t0,
+                            lane="encode")
             if not deferred:
                 return self._solve_once(pods, existing, daemon_overhead,
                                         n_slots)
@@ -239,13 +240,15 @@ class TPUSolver:
             _t1 = _time.perf_counter()
             carried = _carry_round1_existing(existing, res)
             pseudo = self._nodes_as_existing(res, daemon_overhead)
-            GAP_LEDGER.note("encode", _time.perf_counter() - _t1)
+            GAP_LEDGER.note("encode", _time.perf_counter() - _t1,
+                            lane="encode")
             res2 = self._solve_once(deferred, carried + pseudo,
                                     daemon_overhead, n_slots)
             _t2 = _time.perf_counter()
             merged = _merge_rounds(res, res2, {p.name: i for i, p in
                                                enumerate(pseudo)})
-            GAP_LEDGER.note("decode", _time.perf_counter() - _t2)
+            GAP_LEDGER.note("decode", _time.perf_counter() - _t2,
+                            lane="encode")
             return merged
 
     def solve_many(
@@ -322,7 +325,8 @@ class TPUSolver:
             else:  # encode rebuilt a fresh grid (catalog bumped mid-wave)
                 inputs, dims, up = build_pack_inputs(enc)
             slots.append(("wave", (enc, inputs, dims, up, list(existing))))
-        GAP_LEDGER.note("encode", _time.perf_counter() - t_enc0)
+        GAP_LEDGER.note("encode", _time.perf_counter() - t_enc0,
+                        lane="encode")
 
         # Same-shape problems fold into ONE vmapped dispatch per bucket
         # (degraded-link cost is per device OPERATION, not per byte —
@@ -352,13 +356,15 @@ class TPUSolver:
                 dev = jax.device_put(_stack_pack_inputs(members))
                 flat2d = _wave_pack_flat(dev, Nb, up)
             flats.append((idxs, flat2d))
-        GAP_LEDGER.note("link", _time.perf_counter() - t_link0)
+        GAP_LEDGER.note("link", _time.perf_counter() - t_link0,
+                        lane="solver")
         fetched: "dict[int, PackResult]" = {}
         if flats:
             t_fetch0 = _time.perf_counter()
             cat = host_fetch(jnp.concatenate(
                 [f.reshape(-1) for _, f in flats]))
-            GAP_LEDGER.note("device_exec", _time.perf_counter() - t_fetch0)
+            GAP_LEDGER.note("device_exec",
+                            _time.perf_counter() - t_fetch0, lane="device")
             off = 0
             for idxs, f in flats:
                 K, L = f.shape
@@ -381,7 +387,7 @@ class TPUSolver:
                 out.append(decode(enc, fetched[i],
                                   [e.name for e in existing]))
                 t_dec += _time.perf_counter() - t_dec0
-        GAP_LEDGER.note("decode", t_dec)
+        GAP_LEDGER.note("decode", t_dec, lane="encode")
         return out
 
     def warm_shapes(self, shapes: "Sequence[tuple]",
@@ -430,6 +436,13 @@ class TPUSolver:
             if before >= 0 and after > before:
                 buckets.COMPILE_WARMUPS.inc()
                 warmed.append(plan.label())
+            # measured roofline (ISSUE 18): warmup is the one moment the
+            # rung's compiled program is in hand and off the hot path, so
+            # capture XLA's own cost/memory analysis here — the floor the
+            # kernel arc chases becomes the compiler's number, and drift
+            # against the hand model is checked per rung
+            if route == "single":
+                _capture_measured_roofline(inputs, plan, pv, use_pallas)
         return warmed
 
     def _synth_inputs(self, grid: OptionGrid, plan: "buckets.BucketPlan",
@@ -607,10 +620,14 @@ class TPUSolver:
         # link/compile work plus the async enqueue.
         from ..profiling import GAP_LEDGER
         from ..profiling.continuous import detect_backend
-        GAP_LEDGER.note("encode", t1 - t0)
-        GAP_LEDGER.note("link", t2 - t1)
-        GAP_LEDGER.note("device_exec", t3 - t2)
-        GAP_LEDGER.note("decode", t4 - t3)
+        # end_pc pins each interval at its REAL phase boundary (these four
+        # notes fire in a burst after the fact): the critical plane then
+        # sees the true serial chain encode->link->fetch->decode instead
+        # of four artificially stacked intervals
+        GAP_LEDGER.note("encode", t1 - t0, lane="encode", end_pc=t1)
+        GAP_LEDGER.note("link", t2 - t1, lane="solver", end_pc=t2)
+        GAP_LEDGER.note("device_exec", t3 - t2, lane="device", end_pc=t3)
+        GAP_LEDGER.note("decode", t4 - t3, lane="encode", end_pc=t4)
         tb_shape = getattr(enc.grid.tiebreak, "shape", (16, 4))
         GAP_LEDGER.annotate(
             bucket=plan.label(), route=route,
@@ -870,6 +887,56 @@ def _resident_pack_fn(donate: bool):
                          donate_argnums=(1,) if donate else ())
             _PACK_FNS[donate] = fn
         return fn
+
+
+def _capture_measured_roofline(inputs: PackInputs, plan, pv: int,
+                               use_pallas: bool) -> None:
+    """AOT-lower the rung's resident pack program and file XLA's own
+    cost_analysis / memory_analysis numbers into the measured roofline
+    (profiling/roofline.record_measured, with the drift check against the
+    hand model). Warmup-only and advisory: any failure degrades to the
+    modelled floor, never to a failed warmup."""
+    from ..profiling import critical as profiling_critical
+    from ..profiling import roofline as profiling_roofline
+    from ..profiling import state as profiling_state
+
+    if not (profiling_state.enabled() and profiling_critical.enabled()):
+        return
+    try:
+        cat = (inputs.alloc_t, inputs.tiebreak)
+        delta = inputs._replace(alloc_t=None, tiebreak=None)
+        compiled = _resident_pack_fn(_donate_deltas()).lower(
+            cat, delta, plan.slots, use_pallas).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if not isinstance(ca, dict):
+            return
+        flops = float(ca.get("flops", 0.0) or 0.0)
+        bytes_accessed = float(ca.get("bytes accessed", 0.0) or 0.0)
+        mem = None
+        try:
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                mem = float(
+                    getattr(ma, "temp_size_in_bytes", 0)
+                    + getattr(ma, "argument_size_in_bytes", 0)
+                    + getattr(ma, "output_size_in_bytes", 0))
+        except Exception:  # noqa: BLE001 — optional on some backends
+            mem = None
+        from ..profiling.continuous import detect_backend
+
+        backend = detect_backend()
+        tb_shape = getattr(inputs.tiebreak, "shape", (16, 4))
+        modelled = profiling_roofline.estimate(
+            plan.groups, plan.slots, plan.existing, pv=pv,
+            t=int(tb_shape[0]), s=int(tb_shape[-1]),
+            backend=backend, bucket=plan.label())
+        profiling_roofline.record_measured(
+            plan.label(), flops=flops, bytes_accessed=bytes_accessed,
+            backend=backend, modelled=modelled, memory_bytes=mem)
+    except Exception:  # noqa: BLE001 — advisory capture only
+        pass
 
 
 def dispatch_pack_inputs(inputs: PackInputs, dims, use_pallas):

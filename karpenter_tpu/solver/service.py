@@ -353,7 +353,7 @@ class SolverService:
             wire_in_s = time.perf_counter() - w0
             TRACER.record_span("solver.serialize", wire_in_s,
                                direction="decode", pods=len(pods))
-            GAP_LEDGER.note("serialize", wire_in_s)
+            GAP_LEDGER.note("serialize", wire_in_s, lane="wire")
             with self._lock:
                 self._solve_count += 1
                 trace_now = (self._trace_dir is not None
@@ -402,7 +402,7 @@ class SolverService:
             wire_out_s = time.perf_counter() - e0
             TRACER.record_span("solver.serialize", wire_out_s,
                                direction="encode")
-            GAP_LEDGER.note("serialize", wire_out_s)
+            GAP_LEDGER.note("serialize", wire_out_s, lane="wire")
             # echo the device-path observability back over the wire so the
             # CLIENT-side rpc span carries the same attributes this span does
             info = getattr(solver, "last_solve_info", None) or {}

@@ -1,0 +1,491 @@
+"""Critical-path ledger (karpenter_tpu/profiling/critical): longest-chain
+analysis on synthetic DAGs (serial / overlapped / diamond), the exact-0
+serial guarantee, flat-projection bit-equality, wait attribution (lane
+geometry + explicit notes), never-negative intervals under clock skew,
+the strict-noop contract, /debug/criticalz, the statusz schema-11 pin,
+and measured-roofline drift falsifiability."""
+
+import json
+import logging
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_tpu import profiling
+from karpenter_tpu.apis.settings import Settings
+from karpenter_tpu.fake.cloud import FakeCloud
+from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.profiling import GAP_LEDGER, critical, roofline
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _clean_critical():
+    """Both planes ON, empty rings and no measured rungs around every test."""
+    prev_prof = profiling.set_enabled(True)
+    prev_crit = critical.set_enabled(True)
+    GAP_LEDGER.clear()
+    critical.CRITICAL.clear()
+    roofline.clear_measured()
+    yield
+    GAP_LEDGER.clear()
+    critical.CRITICAL.clear()
+    roofline.clear_measured()
+    critical.set_enabled(prev_crit)
+    profiling.set_enabled(prev_prof)
+
+
+def iv(lane, phase, start, dur):
+    """Synthetic-DAG helper: an interval by (start, dur)."""
+    return critical.make_interval(lane, phase, start + dur, dur)
+
+
+# -- critical_path / analyze on synthetic DAGs --------------------------------------
+
+
+class TestCriticalPath:
+    def test_serial_chain_is_exactly_zero_overlap(self):
+        # three back-to-back intervals: the chain IS the trace, and the
+        # ratio is exactly 0.0 — not approximately — because analyze folds
+        # total_work over the same end-sorted order the DP accumulates
+        ivs = [iv("encode", "encode", 0.0, 0.125),
+               iv("device", "device_exec", 0.125, 0.25),
+               iv("encode", "decode", 0.375, 0.0625)]
+        crit, members = critical.critical_path(ivs)
+        assert sorted(members) == [0, 1, 2]
+        row = critical.analyze(ivs)
+        assert row["overlap_ratio"] == 0.0
+        assert row["critical_path_ms"] == row["total_work_ms"]
+
+    def test_serial_exact_zero_on_awkward_float_durations(self):
+        # durations chosen to NOT be exactly representable — the bit-equal
+        # fold guarantee is what keeps the ratio at literal 0.0 anyway
+        durs = [0.1, 0.2, 0.3, 0.7, 0.011, 0.0043]
+        ivs, t = [], 0.0
+        for d in durs:
+            ivs.append(iv("solver", "link", t, d))
+            t += d + 0.001  # the real trace's between-phase gap
+        assert critical.analyze(ivs)["overlap_ratio"] == 0.0
+
+    def test_fully_overlapped_pair_is_half(self):
+        ivs = [iv("encode", "encode", 0.0, 1.0),
+               iv("device", "device_exec", 0.0, 1.0)]
+        row = critical.analyze(ivs)
+        assert row["overlap_ratio"] == pytest.approx(0.5)
+        assert row["critical_path_ms"] == pytest.approx(1000.0)
+
+    def test_diamond_puts_short_branch_off_critical(self):
+        # encode -> (device ∥ serialize) -> decode; the device branch is
+        # longer, so serialize is the off-critical branch
+        ivs = [iv("encode", "encode", 0.0, 1.0),
+               iv("device", "device_exec", 1.0, 1.0),
+               iv("wire", "serialize", 1.0, 0.5),
+               iv("encode", "decode", 2.0, 1.0)]
+        row = critical.analyze(ivs)
+        assert row["critical_path_ms"] == pytest.approx(3000.0)
+        assert row["total_work_ms"] == pytest.approx(3500.0)
+        assert row["overlap_ratio"] == pytest.approx(1 - 3 / 3.5, abs=1e-6)
+        assert set(row["on_critical_path_ms"]) == {
+            "encode", "device_exec", "decode"}
+        assert set(row["off_critical_path_ms"]) == {"serialize"}
+        # critical_share is share OF THE CHAIN, so it sums to 1 (each
+        # share is rounded to 6 places before summing)
+        assert sum(row["critical_share"].values()) == pytest.approx(
+            1.0, abs=1e-5)
+
+    def test_ratio_bounds_half_open(self):
+        # heavy overlap cannot reach 1.0: the chain always contains at
+        # least the longest single interval
+        ivs = [iv("encode", "encode", 0.0, 1.0) for _ in range(16)]
+        row = critical.analyze(ivs)
+        assert 0.0 <= row["overlap_ratio"] < 1.0
+        assert row["critical_path_ms"] >= 1000.0 - 1e-6
+
+    def test_empty_trace(self):
+        assert critical.critical_path([]) == (0.0, [])
+        row = critical.analyze([])
+        assert row["overlap_ratio"] == 0.0
+        assert row["critical_path_ms"] == 0.0
+        assert row["critical_share"] == {}
+
+    def test_chain_respects_precedence_not_lane(self):
+        # two lanes, interleaved serially — precedence is end<=start, not
+        # same-lane adjacency, so the chain spans both lanes
+        ivs = [iv("encode", "encode", 0.0, 1.0),
+               iv("device", "device_exec", 1.0, 1.0),
+               iv("encode", "decode", 2.0, 1.0),
+               iv("device", "device_exec", 3.0, 1.0)]
+        crit, members = critical.critical_path(ivs)
+        assert crit == pytest.approx(4.0)
+        assert sorted(members) == [0, 1, 2, 3]
+
+
+class TestIntervalSkew:
+    def test_make_interval_never_negative(self):
+        # end earlier than the duration implies (cross-thread clock skew):
+        # start clamps to 0, never negative
+        a = critical.make_interval("encode", "encode", 0.001, 0.5)
+        assert a.start == 0.0 and a.end == 0.001 and a.dur == 0.5
+        # negative relative end (note filed before the scope anchor)
+        b = critical.make_interval("device", "device_exec", -0.5, 0.25)
+        assert b.start == 0.0 and b.end == 0.0 and b.dur == 0.25
+        # negative measured duration clamps like the flat accumulation
+        c = critical.make_interval("wire", "serialize", 1.0, -3.0)
+        assert c.dur == 0.0 and c.start == c.end == 1.0
+
+    def test_analyze_skewed_trace_stays_in_bounds(self):
+        ivs = [critical.make_interval("encode", "encode", -1.0, 2.0),
+               critical.make_interval("device", "device_exec", 0.001, 5.0)]
+        row = critical.analyze(ivs)
+        assert 0.0 <= row["overlap_ratio"] < 1.0
+        assert row["critical_path_ms"] > 0.0
+
+
+# -- flat projection bit-equality ---------------------------------------------------
+
+
+class TestFlatProjection:
+    def test_project_flat_folds_in_append_order(self):
+        ivs = [iv("encode", "encode", 0.0, 0.1),
+               iv("device", "device_exec", 0.1, 0.2),
+               iv("encode", "encode", 0.3, 0.3)]
+        flat = critical.project_flat(ivs)
+        assert flat == {"encode": 0.1 + 0.3, "device_exec": 0.2}
+
+    def test_real_trace_projection_is_bit_identical(self):
+        # the flat row and the interval records are fed by the SAME note()
+        # calls; the projection must equal rec.phases EXACTLY (==), not
+        # approximately — awkward durations on purpose
+        with GAP_LEDGER.solve_scope("proj") as rec:
+            GAP_LEDGER.note("encode", 0.1)
+            GAP_LEDGER.note("device_exec", 0.033)
+            GAP_LEDGER.note("encode", 0.2)
+            GAP_LEDGER.note("decode", 0.0077)
+            assert critical.project_flat(rec.intervals) == rec.phases
+
+    def test_real_serial_trace_reports_exact_zero(self):
+        # end_pc pins phase boundaries so the intervals are strictly
+        # serial; the embedded critical row must say 0.0 exactly
+        with GAP_LEDGER.solve_scope("serial") as rec:
+            t0 = rec.perf0
+            GAP_LEDGER.note("encode", 0.01, end_pc=t0 + 0.011)
+            GAP_LEDGER.note("device_exec", 0.02, end_pc=t0 + 0.035)
+            GAP_LEDGER.note("decode", 0.005, end_pc=t0 + 0.045)
+        row = GAP_LEDGER.rows()[-1]
+        assert row["critical"]["overlap_ratio"] == 0.0
+        assert (row["critical"]["critical_path_ms"]
+                == row["critical"]["total_work_ms"])
+        for key in ("critical_share", "waits_ms", "on_critical_path_ms",
+                    "off_critical_path_ms"):
+            assert key in row["critical"]
+
+    def test_flat_row_keys_unchanged_by_critical_plane(self):
+        # pre-existing consumers: attributed/unaccounted computed as before
+        with GAP_LEDGER.solve_scope("compat"):
+            GAP_LEDGER.note("encode", 10.0)
+        row = GAP_LEDGER.rows()[-1]
+        assert row["unaccounted_ms"] == 0.0
+        assert row["attributed_share"] == pytest.approx(1.0)
+
+
+# -- wait attribution ---------------------------------------------------------------
+
+
+class TestWaitAttribution:
+    def test_device_busy_gap_is_device_wait(self):
+        ivs = [iv("solver", "link", 0.0, 1.0),
+               iv("device", "device_exec", 1.0, 1.0),
+               iv("solver", "link", 2.0, 1.0)]
+        waits = critical.classify_waits(ivs)
+        assert waits["device_wait"] == pytest.approx(1.0)
+        assert waits["lock_wait"] == 0.0
+
+    def test_encode_busy_gap_is_encode_wait(self):
+        ivs = [iv("solver", "link", 0.0, 1.0),
+               iv("encode", "encode", 1.0, 1.0),
+               iv("solver", "link", 2.0, 1.0)]
+        waits = critical.classify_waits(ivs)
+        assert waits["encode_wait"] == pytest.approx(1.0)
+        assert waits["device_wait"] == 0.0
+
+    def test_idle_tick_gap_is_queue_wait(self):
+        ivs = [iv("tick", "link", 0.0, 0.5),
+               iv("tick", "link", 1.5, 0.5)]
+        waits = critical.classify_waits(ivs)
+        assert waits["queue_wait"] == pytest.approx(1.0)
+
+    def test_unexplained_gap_is_lock_wait(self):
+        ivs = [iv("solver", "link", 0.0, 0.5),
+               iv("solver", "link", 1.5, 0.5)]
+        waits = critical.classify_waits(ivs)
+        assert waits["lock_wait"] == pytest.approx(1.0)
+        assert waits["queue_wait"] == 0.0
+
+    def test_jitter_gaps_are_not_waits(self):
+        ivs = [iv("solver", "link", 0.0, 0.5),
+               iv("solver", "link", 0.5 + 5e-6, 0.5)]  # < MIN_WAIT_S
+        assert all(v == 0.0
+                   for v in critical.classify_waits(ivs).values())
+
+    def test_explicit_waits_fold_into_analyze(self):
+        ivs = [iv("encode", "encode", 0.0, 1.0)]
+        row = critical.analyze(
+            ivs, explicit_waits=[("queue_wait", "tick", 0.25),
+                                 ("not_a_wait", "tick", 9.0),
+                                 ("lock_wait", "solver", -1.0)])
+        assert row["waits_ms"]["queue_wait"] == pytest.approx(250.0)
+        assert row["waits_ms"]["lock_wait"] == 0.0  # negative clamps
+        assert "not_a_wait" not in row["waits_ms"]
+
+    def test_note_wait_files_against_open_record(self):
+        before = critical.activity()["wait_notes_total"]
+        with GAP_LEDGER.solve_scope("w") as rec:
+            GAP_LEDGER.note("encode", 0.001)
+            GAP_LEDGER.note_wait("queue_wait", 0.5, lane="tick")
+            assert rec.waits == [("queue_wait", "tick", 0.5)]
+        assert critical.activity()["wait_notes_total"] == before + 1
+        row = GAP_LEDGER.rows()[-1]
+        assert row["critical"]["waits_ms"]["queue_wait"] >= 500.0
+
+    def test_note_wait_unknown_kind_raises(self):
+        with GAP_LEDGER.solve_scope("bad"):
+            GAP_LEDGER.note("encode", 0.001)
+            with pytest.raises(ValueError, match="unknown wait"):
+                GAP_LEDGER.note_wait("coffee_wait", 0.1)
+            with pytest.raises(ValueError, match="unknown lane"):
+                GAP_LEDGER.note_wait("queue_wait", 0.1, lane="conveyor")
+
+    def test_note_unknown_lane_raises(self):
+        with GAP_LEDGER.solve_scope("bad"):
+            with pytest.raises(ValueError, match="unknown lane"):
+                GAP_LEDGER.note("encode", 0.001, lane="conveyor")
+            GAP_LEDGER.note("encode", 0.001)  # keep the row non-empty
+
+
+# -- strict-noop contract -----------------------------------------------------------
+
+
+class TestStrictNoop:
+    def test_disabled_plane_records_nothing(self):
+        with critical.disabled():
+            before = critical.activity()
+            with GAP_LEDGER.solve_scope("noop") as rec:
+                GAP_LEDGER.note("encode", 0.01)
+                GAP_LEDGER.note("device_exec", 0.02)
+                GAP_LEDGER.note_wait("queue_wait", 0.5)
+                assert rec.intervals == []
+                assert rec.waits == []
+            assert critical.activity() == before
+            assert critical.CRITICAL.ring_len() == 0
+        # ...while the FLAT ledger kept accounting the whole time
+        row = GAP_LEDGER.rows()[-1]
+        assert row["phases_ms"]["encode"] == pytest.approx(10.0)
+        assert "critical" not in row
+
+    def test_observe_refuses_disabled_and_empty(self):
+        with critical.disabled():
+            assert critical.CRITICAL.observe(
+                "x", [iv("encode", "encode", 0.0, 1.0)], [], 1.0, 0.0) is None
+        assert critical.CRITICAL.observe("x", [], [], 1.0, 0.0) is None
+
+    def test_set_enabled_returns_restore_token(self):
+        assert critical.set_enabled(False) is True
+        assert critical.enabled() is False
+        assert critical.set_enabled(True) is False
+        assert critical.enabled() is True
+
+    def test_chaos_invariant_flags_noop_violation(self):
+        from karpenter_tpu.chaos.invariants import check_critical_noop
+
+        same = {"records_total": 3, "intervals_total": 9,
+                "wait_notes_total": 1, "ring": 3}
+        moved = dict(same, intervals_total=12)
+        assert check_critical_noop(
+            {"enabled": False, "before": same, "after": same}) == []
+        out = check_critical_noop(
+            {"enabled": False, "before": same, "after": moved})
+        assert [v.invariant for v in out] == ["critical-strict-noop"]
+        # enabled windows and absent evidence are out of scope
+        assert check_critical_noop(
+            {"enabled": True, "before": same, "after": moved}) == []
+        assert check_critical_noop(None) == []
+
+
+# -- ring / read surfaces -----------------------------------------------------------
+
+
+class TestLedgerSurfaces:
+    def _observe_one(self, source="t"):
+        ivs = [iv("encode", "encode", 0.0, 0.01),
+               iv("device", "device_exec", 0.01, 0.02)]
+        return critical.CRITICAL.observe(source, ivs, [], 30.0, 1e9)
+
+    def test_observe_row_shape(self):
+        row = self._observe_one()
+        assert row["source"] == "t"
+        assert row["wall_ms"] == 30.0
+        assert row["anchor_ts"] == 1e9
+        assert len(row["records"]) == 2
+        rec = row["records"][0]
+        assert set(rec) == {"lane", "phase", "start_ms", "end_ms", "dur_ms"}
+
+    def test_snapshot_and_criticalz(self):
+        self._observe_one()
+        snap = critical.snapshot()
+        assert snap["enabled"] is True
+        assert snap["lanes"] == list(critical.LANES)
+        assert snap["records_total"] >= 1
+        assert snap["last"] and "records" not in snap["last"][-1]
+        assert "roofline_measured" in snap
+        doc = critical.criticalz(limit=5)
+        assert doc["tool"] == "karpenter_tpu.criticalz"
+        assert doc["schema"] == 1
+        assert doc["phase_lanes"] == dict(critical.PHASE_LANES)
+        assert len(doc["rows"]) <= 5
+
+    def test_merge_chrome_appends_critical_lane(self):
+        self._observe_one()
+        base = {"traceEvents": [
+            {"name": "solve", "ph": "X", "ts": 1e15, "dur": 1e6, "pid": 1,
+             "tid": 1}]}
+        merged = critical.merge_chrome(base)
+        crit_events = [e for e in merged["traceEvents"]
+                       if e.get("pid") == critical.CriticalLedger.LANE_PID]
+        assert any(e.get("ph") == "X" for e in crit_events)
+        names = {e["args"]["name"] for e in crit_events
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert names == {"critical"}
+
+    def test_merge_chrome_skips_out_of_window_rows(self):
+        self._observe_one()  # anchored at ts=1e9 s, far from the doc below
+        base = {"traceEvents": [
+            {"name": "solve", "ph": "X", "ts": 0.0, "dur": 10.0, "pid": 1,
+             "tid": 1}]}
+        assert critical.merge_chrome(base) == base
+
+
+# -- /debug/criticalz + statusz -----------------------------------------------------
+
+
+@pytest.fixture
+def served_op():
+    clock = FakeClock()
+    cat = Catalog(types=[make_instance_type("m.large", cpu=4, memory="16Gi",
+                                            od_price=0.2)])
+    op = Operator(FakeCloud(catalog=cat, clock=clock),
+                  Settings(cluster_name="crit", cluster_endpoint="https://k"),
+                  cat, clock=clock, serve_http=True,
+                  metrics_port=0, health_port=0, webhook_port=0)
+    ports = op.serving.start()
+    yield op, ports
+    op.serving.stop()
+    op.stop()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                    timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestCriticalzEndpoint:
+    def test_json_default(self, served_op):
+        op, ports = served_op
+        code, body = _get(ports["metrics"], "/debug/criticalz")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["tool"] == "karpenter_tpu.criticalz"
+        assert doc["enabled"] is True
+        assert doc["lanes"] == list(critical.LANES)
+        assert doc["waits"] == list(critical.WAITS)
+        assert isinstance(doc["rows"], list)
+
+    def test_malformed_n_is_400(self, served_op):
+        op, ports = served_op
+        code, body = _get(ports["metrics"], "/debug/criticalz?n=bogus")
+        assert code == 400
+        assert "integer" in body
+
+    def test_oversized_and_negative_n_clamp(self, served_op):
+        from karpenter_tpu.serving import MAX_CRITICAL_ROWS
+
+        op, ports = served_op
+        code, body = _get(ports["metrics"], "/debug/criticalz?n=999999")
+        assert code == 200
+        assert len(json.loads(body)["rows"]) <= MAX_CRITICAL_ROWS
+        code, _ = _get(ports["metrics"], "/debug/criticalz?n=-5")
+        assert code == 200  # clamped up, same as /debug/profilez
+
+    def test_statusz_schema_11_carries_critical_section(self, served_op):
+        op, ports = served_op
+        code, body = _get(ports["metrics"], "/debug/statusz")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["schema"] == 11
+        sect = doc["critical"]
+        assert sect["enabled"] is True
+        assert sect["lanes"] == list(critical.LANES)
+        assert set(sect["wait_ms_total"]) == set(critical.WAITS)
+        assert "roofline_measured" in sect
+
+
+# -- measured roofline drift --------------------------------------------------------
+
+
+class TestMeasuredRoofline:
+    def _modelled(self, flops):
+        return roofline.Roofline(
+            bucket="b1", bytes_moved=1_000_000, flops=flops, floor_ms=0.1,
+            bw_gbps=50.0, peak_gflops=100.0, backend="cpu", device_count=1)
+
+    def test_drift_beyond_threshold_flags_and_warns(self, caplog):
+        with caplog.at_level(logging.WARNING,
+                             logger="karpenter_tpu.profiling.roofline"):
+            entry = roofline.record_measured(
+                "b1", flops=1e10, bytes_accessed=2e6,
+                modelled=self._modelled(1e9))  # 10x > DRIFT_THRESHOLD
+        assert entry["flagged"] is True
+        assert entry["flops_drift"] == pytest.approx(10.0)
+        assert any("roofline drift" in r.message for r in caplog.records)
+        snap = roofline.measured_snapshot()
+        assert snap["drift_flagged"] == ["b1"]
+        assert snap["drift_threshold"] == roofline.DRIFT_THRESHOLD
+
+    def test_drift_is_symmetric(self):
+        # measured 10x BELOW the model flags just the same
+        entry = roofline.record_measured(
+            "b2", flops=1e8, bytes_accessed=2e6,
+            modelled=self._modelled(1e9))
+        assert entry["flagged"] is True
+        assert entry["flops_drift"] == pytest.approx(10.0)
+
+    def test_within_threshold_not_flagged(self):
+        entry = roofline.record_measured(
+            "b3", flops=1.5e9, bytes_accessed=2e6,
+            modelled=self._modelled(1e9))
+        assert entry["flagged"] is False
+        assert roofline.measured_snapshot()["drift_flagged"] == []
+
+    def test_no_model_no_drift_keys(self):
+        entry = roofline.record_measured("b4", flops=1e9, bytes_accessed=2e6)
+        assert entry["flagged"] is False
+        assert "flops_drift" not in entry
+        assert "modelled_flops" not in entry
+
+    def test_measured_floor_uses_backend_peaks(self):
+        entry = roofline.record_measured("b5", flops=0.0, bytes_accessed=0.0)
+        assert entry["floor_ms"] == 0.0
+        bigger = roofline.record_measured("b6", flops=1e12,
+                                          bytes_accessed=1e9)
+        assert bigger["floor_ms"] > 0.0
+
+    def test_clear_measured_drops_rungs(self):
+        roofline.record_measured("b7", flops=1.0, bytes_accessed=1.0)
+        assert roofline.measured_snapshot()["rungs"]
+        roofline.clear_measured()
+        assert roofline.measured_snapshot()["rungs"] == {}

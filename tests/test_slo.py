@@ -488,7 +488,8 @@ class TestRegressGate:
                         "--inject", "interruption_msgs_per_sec=100",
                         "--inject", "baseline_config_ms=99",
                         "--inject", "profile_unaccounted_share=0.9",
-                        "--inject", "incremental_steady_encode_share=0.99"])
+                        "--inject", "incremental_steady_encode_share=0.99",
+                        "--inject", "critical_serialize_share=0.99"])
         out = capsys.readouterr().out
         assert rc == 0, out
-        assert out.count("SEED") == 4
+        assert out.count("SEED") == 5
