@@ -8,9 +8,9 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit lint noretry hotloops crashpoints cardinality phaseacct reasons test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm failover-drill fleet-bench fleet-drill fleet-drill-small telemetry-drill claims diagnose provenance multichip soak incremental-soak perf-regress ledger-backfill profile-drill explain-drill critical-drill critical-drill-small
+.PHONY: presubmit lint noretry hotloops crashpoints cardinality phaseacct reasons test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm failover-drill spot-storm spot-storm-small fleet-bench fleet-drill fleet-drill-small telemetry-drill claims diagnose provenance multichip soak incremental-soak perf-regress ledger-backfill profile-drill explain-drill critical-drill critical-drill-small
 
-presubmit: lint claims provenance noretry hotloops crashpoints cardinality phaseacct reasons perf-regress failover-drill fleet-drill-small critical-drill-small incremental-soak test verify-entry  ## what CI runs
+presubmit: lint claims provenance noretry hotloops crashpoints cardinality phaseacct reasons perf-regress failover-drill fleet-drill-small critical-drill-small spot-storm-small incremental-soak test verify-entry  ## what CI runs
 
 perf-regress:  ## tier-1-sized micro-benches must stay inside the ledger's noise bands
 	$(CPU_ENV) $(PY) hack/check_perf_regress.py
@@ -81,6 +81,14 @@ chaos-storm:  ## multi-tenant storm drill: fairness bound + shed paths, replayab
 
 failover-drill:  ## fleet membership/failover drill: kill, partition, gray, poison, rejoin
 	$(CPU_ENV) $(PY) -m karpenter_tpu chaos --partition --seed $(or $(SEED),0)
+
+spot-storm:  ## spot reclaim-storm drill: 10k nodes, 2000 simultaneous reclaims, RECORDED
+	$(CPU_ENV) $(PY) -m karpenter_tpu chaos --spot-storm --seed $(or $(SEED),0) --out-dir benchmarks/results/spot
+
+spot-storm-small:  ## presubmit-sized spot storm (240 nodes / 60 reclaims, /tmp artifact + ledger)
+	$(CPU_ENV) KARPENTER_TPU_LEDGER=$(or $(SPOT_DIR),/tmp/karpenter-spot-storm)/ledger.jsonl \
+		$(PY) -m karpenter_tpu chaos --spot-storm --spot-nodes 240 --spot-reclaims 60 \
+		--seed $(or $(SEED),0) --out-dir $(or $(SPOT_DIR),/tmp/karpenter-spot-storm)
 
 fleet-bench:  ## multi-tenant fleet benchmark: sustained solves/sec + p99, RECORDED
 	$(CPU_ENV) $(PY) bench.py --fleet

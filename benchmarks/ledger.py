@@ -415,6 +415,32 @@ def _critical_entries(doc: dict):
                    {"name": "critical_drill", "bucket": bucket}, None)
 
 
+def _spot_entries(doc: dict):
+    """chaos --spot-storm artifacts: restore latency for the headline
+    reclaim storm, the proactive-rebalance volume the rate limiter
+    admitted, and the fleet's sticker cost either side of the storm.
+    Degraded whenever the drill failed an invariant."""
+    if doc.get("tool") != "karpenter_tpu.chaos" or \
+            doc.get("mode") != "spot-storm":
+        return
+    degraded = not doc.get("passed", False)
+    key = doc.get("key_numbers") or {}
+    wl = {"name": "spot_storm", "nodes": doc.get("nodes"),
+          "reclaims": doc.get("reclaims"), "seed": doc.get("seed")}
+    for field, metric, unit in (
+            ("restore_cycles", "spot_storm_restore_cycles", "cycles"),
+            ("proactive_rebalances", "spot_storm_proactive_rebalances",
+             "count"),
+            ("hourly_cost_before", "spot_storm_hourly_cost_before",
+             "usd_per_hour"),
+            ("hourly_cost_after", "spot_storm_hourly_cost_after",
+             "usd_per_hour"),
+            ("wrong_forecast_post_clear_launches",
+             "spot_storm_wrong_forecast_post_clear_launches", "count")):
+        if isinstance(key.get(field), (int, float)):
+            yield (metric, key[field], unit, "cpu", degraded, wl, None)
+
+
 _BACKFILL_SOURCES = (
     ("BENCH_r0*.json", "bench.py", _bench_round_entries),
     ("benchmarks/results/bench_*.json", "benchmarks.record",
@@ -442,6 +468,8 @@ _BACKFILL_SOURCES = (
      _profiling_entries),
     ("benchmarks/results/explain/*.json", "benchmarks.explain_drill",
      _explain_entries),
+    ("benchmarks/results/spot/spotstorm_*.json",
+     "python -m karpenter_tpu chaos --spot-storm", _spot_entries),
 )
 
 

@@ -20,7 +20,10 @@ check_phase_accounting idiom):
    row would make the docs lie);
 4. every literal `verdict` passed to _note_verdict() (ops/consolidate.py
    per-lane capture) is a CONSOLIDATION_VERDICTS entry, and every entry
-   is cited somewhere.
+   is cited somewhere;
+5. every literal `reason` passed to note_drain() (interruption's reactive
+   reclaim path, spot/rebalance.py's proactive path) is a DRAIN_REASONS
+   entry, and every entry is cited somewhere.
 
 Run via `make reasons` (part of `make presubmit`).
 """
@@ -41,6 +44,7 @@ ENCODE = PACKAGE / "models" / "encode.py"
 CITING_CALLS = {
     "note_shed": (2, "SHED_REASONS"),
     "_note_verdict": (2, "CONSOLIDATION_VERDICTS"),
+    "note_drain": (2, "DRAIN_REASONS"),
 }
 
 
@@ -105,6 +109,8 @@ def main() -> int:
                                                          "SHED_REASONS")))
     verdicts = tuple(ast.literal_eval(
         _module_assign(REASONS, "CONSOLIDATION_VERDICTS")))
+    drain_reasons = tuple(ast.literal_eval(
+        _module_assign(REASONS, "DRAIN_REASONS")))
     mask_dims = tuple(ast.literal_eval(
         _module_assign(SOLVER_CORE, "MASK_DIMENSIONS")))
 
@@ -136,7 +142,8 @@ def main() -> int:
     # 3+4) every cited literal is registered; every registry row is cited
     cited = _cited_literals()
     for reg, vocab in (("SHED_REASONS", shed_reasons),
-                       ("CONSOLIDATION_VERDICTS", verdicts)):
+                       ("CONSOLIDATION_VERDICTS", verdicts),
+                       ("DRAIN_REASONS", drain_reasons)):
         seen: "set[str]" = set()
         for rel, lineno, literal in cited[reg]:
             seen.add(literal)
@@ -161,6 +168,7 @@ def main() -> int:
     print(f"check_decision_reasons: ok ({len(dimensions)} dimensions, "
           f"{len(clauses)} oracle clauses, {len(shed_reasons)} shed "
           f"reasons, {len(verdicts)} consolidation verdicts, "
+          f"{len(drain_reasons)} drain reasons, "
           f"{n_cited} citing call sites)")
     return 0
 

@@ -576,6 +576,24 @@ def _ledger_partition(artifact) -> None:
                       workload=workload, artifact=art)
 
 
+def _ledger_spotstorm(artifact) -> None:
+    """Ledger the spot-storm drill's key numbers (restore latency,
+    proactive rebalances, cost delta) — same best-effort contract as
+    _ledger_partition."""
+    try:
+        from benchmarks import ledger
+    except ImportError:
+        return
+    art = artifact.get("artifact_path")
+    # the SAME extractor backfill uses, so a later `backfill()` dedupes
+    # against what the live run recorded (key = artifact+metric+workload)
+    for (metric, value, unit, backend, degraded,
+         workload, _ts) in ledger._spot_entries(artifact):
+        ledger.record(metric, value, unit, source="chaos-spot-storm",
+                      backend=backend, degraded=degraded,
+                      workload=workload, artifact=art)
+
+
 def cmd_chaos(args) -> int:
     """Seeded chaos sweep: drive faulted scenarios to convergence, check
     the cross-layer invariants, and write a replay artifact."""
@@ -585,7 +603,10 @@ def cmd_chaos(args) -> int:
                          intensity=args.intensity,
                          out_dir=args.out_dir or None,
                          burst=args.burst, crash=args.crash,
-                         storm=args.storm, partition=args.partition)
+                         storm=args.storm, partition=args.partition,
+                         spot_storm=args.spot_storm,
+                         spot_storm_nodes=args.spot_nodes,
+                         spot_storm_reclaims=args.spot_reclaims)
     artifact = runner.run()
     for s in artifact["scenarios"]:
         verdict = "PASS" if s["passed"] else "FAIL"
@@ -601,6 +622,23 @@ def cmd_chaos(args) -> int:
             else:
                 print(f"seed={s['seed']} scenario={s['scenario']} {verdict} "
                       f"{s['drill']} epoch={s['epoch']}")
+        elif args.spot_storm:
+            if s["drill"] == "spot-storm":
+                print(f"seed={s['seed']} scenario={s['scenario']} {verdict} "
+                      f"{s['drill']} nodes={s['fleet']['nodes']} "
+                      f"reclaims={s['storm']['reclaims_delivered']} "
+                      f"restore={s['storm']['restore_cycles']}"
+                      f"/{s['storm']['restore_bound']} "
+                      f"rebalances={len(s['rebalance']['ledger'])}")
+            elif s["drill"] == "spot-wrong-forecast":
+                print(f"seed={s['seed']} scenario={s['scenario']} {verdict} "
+                      f"{s['drill']} reclaims={s['reclaims_delivered']} "
+                      f"restore={s['restore_cycles']} "
+                      f"post_clear_launches={s['post_clear_launches']}")
+            else:
+                print(f"seed={s['seed']} scenario={s['scenario']} {verdict} "
+                      f"{s['drill']} decisions_identical="
+                      f"{s['decisions_identical']}")
         elif args.storm:
             t = s["totals"]
             print(f"seed={s['seed']} scenario={s['scenario']} {verdict} "
@@ -633,8 +671,21 @@ def cmd_chaos(args) -> int:
               f"{' --burst' if args.burst else ''}"
               f"{' --crash' if args.crash else ''}"
               f"{' --storm' if args.storm else ''}"
-              f"{' --partition' if args.partition else ''}")
+              f"{' --partition' if args.partition else ''}"
+              f"{' --spot-storm' if args.spot_storm else ''}")
         return 1
+    if args.spot_storm:
+        key = artifact["key_numbers"]
+        print(f"chaos: spot storm passed — {key['fleet_nodes']} nodes, "
+              f"{key['storm_reclaims']} simultaneous reclaim(s), capacity "
+              f"restored in {key['restore_cycles']} cycle(s) (bound "
+              f"{artifact['restore_bound_cycles']}), "
+              f"{key['proactive_rebalances']} proactive rebalance(s), "
+              f"cost ${key['hourly_cost_before']}/h -> "
+              f"${key['hourly_cost_after']}/h "
+              f"({artifact['duration_s']}s)")
+        _ledger_spotstorm(artifact)
+        return 0
     if args.partition:
         key = artifact["key_numbers"]
         print(f"chaos: partition drill passed — remap fraction "
@@ -873,6 +924,17 @@ def main(argv=None) -> int:
                               "auditing remap blast radius, "
                               "completes-or-sheds, quarantine cascade "
                               "bounds and epoch monotonicity")
+    p_chaos.add_argument("--spot-storm", action="store_true",
+                         help="spot reclaim-storm drill: 10k-node fleet, "
+                              "2000 simultaneous reclaims in one tick, "
+                              "forecaster-was-wrong adversarial schedule, "
+                              "and the strict-noop decision-parity window")
+    p_chaos.add_argument("--spot-nodes", type=int, default=None,
+                         help="override the spot-storm fleet size "
+                              "(default 10000)")
+    p_chaos.add_argument("--spot-reclaims", type=int, default=None,
+                         help="override the simultaneous reclaim count "
+                              "(default 2000)")
     p_chaos.set_defaults(fn=cmd_chaos)
 
     p_ver = sub.add_parser("version")

@@ -35,6 +35,24 @@ from .plan import (KIND_CLOUD_5XX, KIND_CLOUD_ICE, KIND_CLOUD_TIMEOUT,
                    KIND_SOLVER_CRASH, KIND_SPOT_BURST, FaultPlan)
 
 
+def shrink_batcher_windows(op) -> None:
+    """The default CreateFleet/Describe/Terminate windows (35-100ms
+    real-time idle) would dominate a many-cycle scenario's wall clock —
+    and make a 10k-node fleet drill take hours of pure batcher latency.
+    Sub-ms windows keep the same coalescing code path on the serialized
+    call stream."""
+    inst = op.cloudprovider.instances
+    for attr, cls in (("fleet", CreateFleetBatcher),
+                      ("describe", DescribeInstancesBatcher),
+                      ("terminate", TerminateInstancesBatcher)):
+        old = getattr(inst, attr)
+        old.stop()
+        # keep the cloud-edge RetryPolicy (breaker + budget) the
+        # operator wired in — chaos exists to exercise it
+        setattr(inst, attr, cls(inst.cloud, idle=0.0005, max_wait=0.002,
+                                policy=getattr(old, "policy", None)))
+
+
 class _ChaosSolver:
     """Primary-backend stand-in: crashes mid-Solve when the plan says so,
     otherwise delegates to the scalar oracle (pure python — the chaos
@@ -47,15 +65,31 @@ class _ChaosSolver:
         self._provisioners = provisioners
         self._injector = injector
 
-    def solve(self, pods, existing=None, daemon_overhead=None):
+    def solve(self, pods, existing=None, daemon_overhead=None,
+              option_mask=None):
         fault = self._injector.maybe("solver.solve")
         if fault is not None:
             raise SolverUnavailable(
                 "chaos: solver sidecar crashed mid-Solve")
         from ..controllers.provisioning import _oracle_to_solve_result
 
+        barred = None
+        if option_mask is not None:
+            # the spot objective's dense mask bars whole (type, zone,
+            # capacityType) pools — recover them so the oracle sees the
+            # same dimension (axis layout mirrors spot.objective.pool_mask)
+            zones = sorted({o.zone for t in self._catalog.types
+                            for o in t.offerings})
+            cts = list(wk.CAPACITY_TYPES)
+            barred = set()
+            for ti, t in enumerate(self._catalog.types):
+                for zi, z in enumerate(zones):
+                    for ci, c in enumerate(cts):
+                        if not option_mask[ti, zi * len(cts) + ci]:
+                            barred.add((t.name, z, c))
         sched = Scheduler(self._catalog, self._provisioners,
-                          daemon_overhead or [0] * wk.NUM_RESOURCES)
+                          daemon_overhead or [0] * wk.NUM_RESOURCES,
+                          barred=barred)
         return _oracle_to_solve_result(
             sched.schedule(list(pods), existing=existing or []), sched)
 
@@ -239,19 +273,7 @@ class ChaosInjector:
                 max_workers=1, thread_name_prefix=f"chaos-{prefix}"))
 
     def _shrink_batcher_windows(self, op) -> None:
-        """The default Describe/Terminate windows (100ms real-time idle)
-        would dominate a many-cycle scenario's wall clock; sub-ms windows
-        keep the same coalescing code path on the serialized call stream."""
-        inst = op.cloudprovider.instances
-        for attr, cls in (("fleet", CreateFleetBatcher),
-                          ("describe", DescribeInstancesBatcher),
-                          ("terminate", TerminateInstancesBatcher)):
-            old = getattr(inst, attr)
-            old.stop()
-            # keep the cloud-edge RetryPolicy (breaker + budget) the
-            # operator wired in — chaos exists to exercise it
-            setattr(inst, attr, cls(inst.cloud, idle=0.0005, max_wait=0.002,
-                                    policy=getattr(old, "policy", None)))
+        shrink_batcher_windows(op)
 
     # -- wire mode -------------------------------------------------------------
 

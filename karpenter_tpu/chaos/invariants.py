@@ -597,6 +597,82 @@ def check_incremental_noop(incremental) -> "list[Violation]":
     return out
 
 
+def check_spot_noop(spot) -> "list[Violation]":
+    """spot-strict-noop: the spot-storm resilience plane is advisory —
+    with KARPENTER_TPU_SPOT=0 the forecaster serves 0.0/1.0 constants,
+    the risk objective never activates, and the rebalance controller
+    returns before touching anything. The runner runs a disabled probe
+    window (forecast refresh + rate lookups + rebalance reconciles) and
+    hands us before/after activity counters (karpenter_tpu.spot
+    .activity()); ANY growth means a producer ignored the switch and the
+    advisory plane has become load-bearing."""
+    if not spot or spot.get("enabled", True):
+        return []  # not part of this drill, or plane was left on
+    out: "list[Violation]" = []
+    before = spot.get("before") or {}
+    after = spot.get("after") or {}
+    for key in sorted(set(before) | set(after)):
+        grew = after.get(key, 0) - before.get(key, 0)
+        if grew > 0:
+            out.append(Violation(
+                "spot-strict-noop",
+                f"spot plane disabled but {key} grew by {grew} "
+                f"({before.get(key, 0)} -> {after.get(key, 0)})"))
+    return out
+
+
+def check_spot_cost_never_raised(ledger: "list[dict]") -> "list[Violation]":
+    """spot-cost-never-raised: every proactive rebalance replacement must
+    cost (sticker price) no more than the at-risk node it relieves —
+    _safe_offering guarantees it by construction, this audits the
+    receipts the controller banked for each launched replacement."""
+    out: "list[Violation]" = []
+    for entry in ledger:
+        if entry["replacement_price"] > entry["node_price"] + 1e-9:
+            out.append(Violation(
+                "spot-cost-never-raised",
+                f"rebalance replaced {entry['node']} "
+                f"(${entry['node_price']}/h) with {entry['replacement']} "
+                f"(${entry['replacement_price']}/h) — proactive churn "
+                f"raised the bill"))
+    return out
+
+
+def check_spot_capacity_restored(restore_cycles: int,
+                                 k: int) -> "list[Violation]":
+    """spot-capacity-restored-within-k: after the reclaim storm every
+    displaced pod must be bound again within K reconcile cycles."""
+    if restore_cycles < 0:
+        return [Violation(
+            "spot-capacity-restored-within-k",
+            f"capacity was never fully restored within the drill window "
+            f"(bound: {k} cycles)")]
+    if restore_cycles > k:
+        return [Violation(
+            "spot-capacity-restored-within-k",
+            f"capacity took {restore_cycles} cycles to restore "
+            f"(bound: {k})")]
+    return []
+
+
+def check_spot_never_strands(op, ledger: "list[dict]") -> "list[Violation]":
+    """spot-rebalance-never-strands: a proactive drain may only have
+    fired against a node whose replacement reached initialized (two-phase
+    order), and at drill end no workload pod is left unbound while its
+    node was proactively drained. Evidence: the rebalance ledger plus the
+    final pending-pod set."""
+    out: "list[Violation]" = []
+    pending = op.kube.pending_pods()
+    if pending:
+        drained = sorted(e["node"] for e in ledger)
+        out.append(Violation(
+            "spot-rebalance-never-strands",
+            f"{len(pending)} pod(s) still pending after settle "
+            f"({sorted(p.name for p in pending)[:5]}...) with "
+            f"{len(drained)} proactive drain(s) in the ledger"))
+    return out
+
+
 def check_incremental_parity(incremental) -> "list[Violation]":
     """incremental-parity-never-diverges: whenever the plane IS on, every
     incremental solve carries a scalar-oracle bit-parity audit on the
@@ -804,7 +880,8 @@ def check_all(op, cloud, token_launches=None,
               consolidation_actions=None,
               resilience=None, profiling=None,
               explain=None, membership=None,
-              incremental=None, critical=None) -> "list[Violation]":
+              incremental=None, critical=None,
+              spot=None) -> "list[Violation]":
     out = []
     out += check_token_ledger(token_launches or {})
     out += check_bijection(op, cloud)
@@ -827,4 +904,8 @@ def check_all(op, cloud, token_launches=None,
     inc = incremental or {}
     out += check_incremental_noop(inc.get("noop"))
     out += check_incremental_parity(inc.get("parity"))
+    # the spot plane runs a dedicated disabled probe window after the
+    # scenario (two-window evidence, same shape as the critical plane) —
+    # see chaos/runner.py
+    out += check_spot_noop((spot or {}).get("noop"))
     return out

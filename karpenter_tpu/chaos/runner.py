@@ -52,7 +52,7 @@ from ..operator import Operator
 from ..utils.clock import FakeClock
 from . import invariants
 from .plan import LAYER_OF_KIND, ChaosRng, FaultPlan
-from .inject import ChaosInjector
+from .inject import ChaosInjector, shrink_batcher_windows
 
 log = logging.getLogger("karpenter.chaos")
 
@@ -140,7 +140,10 @@ class ChaosRunner:
     def __init__(self, seed: int, scenarios: int = 1, wire: bool = False,
                  intensity: float = 1.0, out_dir: "str | None" = None,
                  burst: bool = False, crash: bool = False,
-                 storm: bool = False, partition: bool = False):
+                 storm: bool = False, partition: bool = False,
+                 spot_storm: bool = False,
+                 spot_storm_nodes: "int | None" = None,
+                 spot_storm_reclaims: "int | None" = None):
         self.seed = seed
         self.scenarios = scenarios
         self.wire = wire
@@ -166,6 +169,16 @@ class ChaosRunner:
         # auditing remap blast radius, completes-or-sheds, quarantine
         # cascade bounds and membership epoch monotonicity
         self.partition = partition
+        # spot-storm mode runs the 10k-node reclaim-storm drill: a large
+        # mostly-spot fleet, a live interruption forecast, a proactive
+        # rebalance window, then thousands of simultaneous reclaim
+        # warnings in ONE tick — auditing cost-never-raised,
+        # capacity-restored-within-K, rebalance-never-strands and the
+        # quarantine/forecast composition, plus the forecaster-was-wrong
+        # adversarial schedule and the strict-noop decision-parity half
+        self.spot_storm = spot_storm
+        self.spot_storm_nodes = spot_storm_nodes
+        self.spot_storm_reclaims = spot_storm_reclaims
         # diagnostics bundles auto-dumped by failed scenarios (volatile:
         # paths depend on out_dir, so they live at the artifact top level,
         # never inside a scenario dict)
@@ -209,7 +222,8 @@ class ChaosRunner:
         return op, cloud
 
     def _chaos_provisioner(self, instance_types=None,
-                           capacity_types=None) -> Provisioner:
+                           capacity_types=None,
+                           consolidation: bool = True) -> Provisioner:
         reqs = [(wk.LABEL_CAPACITY_TYPE, OP_IN,
                  list(capacity_types) if capacity_types else
                  [wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND])]
@@ -218,7 +232,7 @@ class ChaosRunner:
                          list(instance_types)))
         prov = Provisioner(
             name="default", provider_ref="default",
-            consolidation_enabled=True,
+            consolidation_enabled=consolidation,
             requirements=Requirements.of(*reqs))
         prov.set_defaults()
         prov.validate()
@@ -267,6 +281,14 @@ class ChaosRunner:
                 ctrl.reconcile_once()
             except Exception as e:  # noqa: BLE001 — the fence is the point
                 errors.append(f"{name}: {type(e).__name__}: {e}")
+        # the spot plane rides every drive like the operator's own loop
+        # (forecast refresh + proactive rebalance; strict noop when the
+        # plane is disabled) — skipping it would trip the spotrebalance
+        # deadman the Operator registers unconditionally
+        try:
+            op._spot_tick()
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"spotrebalance: {type(e).__name__}: {e}")
         # introspection rides every drive: the flight recorder's snapshot
         # ring gets per-cycle history and the deadman sees crash-looping
         # controllers (their failed cycles never refresh the heartbeat)
@@ -442,6 +464,59 @@ class ChaosRunner:
                                     - crit_off_before[k]
                                     for k in crit_off_before}},
             }
+            # spot plane: TWO probe windows after the scenario, same shape
+            # as the critical plane. The sweep itself runs with the plane
+            # at its default — advisory, ledger/static rung, below the
+            # rebalance threshold, so it never steers a solve. The enabled
+            # window proves the producers are wired (a refresh, a rate
+            # lookup and a rebalance reconcile all move counters); the
+            # disabled window drives the same surface and any counter
+            # growth is a spot-strict-noop violation. The --spot-storm
+            # drill is the complement where the plane runs hot.
+            from .. import spot as spot_plane
+
+            def _spot_probe():
+                op.spotforecaster.refresh()
+                op.spotforecaster.rate("t.small", "zone-1a", "spot")
+                op.spotforecaster.penalty("t.small", "zone-1a", "spot")
+                if op.spotrebalance is not None:
+                    op.spotrebalance.reconcile_once()
+
+            spot_prev = spot_plane.set_enabled(True)
+            spot_on_before = spot_plane.activity()
+            _spot_probe()
+            _spot_probe()
+            spot_on_after = spot_plane.activity()
+            spot_plane.set_enabled(False)
+            spot_off_before = spot_plane.activity()
+            _spot_probe()
+            _spot_probe()
+            spot_off_after = spot_plane.activity()
+            spot_plane.set_enabled(spot_prev)
+            spot_evidence = {
+                "enabled": {"enabled": True,
+                            "before": spot_on_before,
+                            "after": spot_on_after},
+                "noop": {"enabled": False,
+                         "before": spot_off_before,
+                         "after": spot_off_after},
+            }
+            # enabled-window stored deltas carry only the counters the
+            # probe touches deterministically (ladder fallbacks depend on
+            # sticky rung state, which the replay contract must not see)
+            _spot_monotone = ("spot_forecast_refreshes",
+                              "spot_forecasts_computed",
+                              "spot_rebalance_cycles")
+            spot_stored = {
+                "enabled": {"enabled": True,
+                            "deltas": {k: spot_on_after[k]
+                                       - spot_on_before[k]
+                                       for k in _spot_monotone}},
+                "noop": {"enabled": False,
+                         "deltas": {k: spot_off_after[k]
+                                    - spot_off_before[k]
+                                    for k in spot_off_before}},
+            }
             expl_after = explain.activity()
             explain_evidence = {
                 "enabled": False,
@@ -492,7 +567,8 @@ class ChaosRunner:
                 explain=explain_evidence,
                 membership=membership_evidence,
                 incremental=incremental_evidence,
-                critical=critical_evidence)
+                critical=critical_evidence,
+                spot=spot_evidence)
             if not self._quiescent(op):
                 violations = [invariants.Violation(
                     "quiescence",
@@ -542,6 +618,7 @@ class ChaosRunner:
             "membership": membership_stored,
             "incremental": incremental_stored,
             "critical": critical_stored,
+            "spot": spot_stored,
             "violations": [v.as_dict() for v in violations],
             "passed": not violations,
         }
@@ -586,6 +663,23 @@ class ChaosRunner:
                     "source": "cloud.spot",
                     "detail-type": "Spot Instance Interruption Warning",
                     "detail": {"instance-id": running[0]}}))
+            elif site == "spot.mid_rebalance":
+                # storm the pool the first spot node sits in: the next
+                # forecast refresh consumes the injected live schedule,
+                # the rebalance controller banks the at-risk mass,
+                # launches the replacement, and walks into the crashpoint
+                # between the journal re-record and the phase-2 drain
+                spot_nodes = [
+                    op.cluster.nodes[n] for n in sorted(op.cluster.nodes)
+                    if op.cluster.nodes[n].capacity_type ==
+                    wk.CAPACITY_TYPE_SPOT
+                    and op.cluster.nodes[n].initialized]
+                if not spot_nodes:
+                    return False
+                target = spot_nodes[0]
+                schedule = {(target.instance_type, target.zone,
+                             wk.CAPACITY_TYPE_SPOT): 0.9}
+                op.spotforecaster.set_live_source(lambda: dict(schedule))
         return True
 
     def _recover_and_settle(self, op2, workload, injector, clock,
@@ -1539,6 +1633,606 @@ class ChaosRunner:
             artifact["artifact_path"] = path
         return artifact
 
+    # -- spot reclaim-storm drill ----------------------------------------------
+
+    SPOT_STORM_NODES = 10_000     # fleet size for the headline drill
+    SPOT_STORM_RECLAIMS = 2_000   # simultaneous reclaim warnings, ONE tick
+    SPOT_RESTORE_K = 5            # cycles granted to rebind every displaced pod
+    SPOT_PRESTORM_CYCLES = 4      # proactive-rebalance window before the burst
+    SPOT_SEED_DEADLINE = 12       # cycles granted for the fleet to initialize
+    SPOT_WRONG_NODES = 90         # forecaster-was-wrong fleet
+    SPOT_WRONG_RECLAIMS = 12
+    SPOT_NOOP_CYCLES = 6          # decision-parity window, strict-noop half
+    SPOT_OD_EVERY = 10            # every Nth seeded node is on-demand
+
+    def _seed_spot_fleet(self, op, n_nodes: int) -> "dict[str, dict]":
+        """Bulk-bootstrap a large, mostly-spot t.small fleet: every node
+        carries one full-node pod (cpu fills the allocatable, so displaced
+        pods can never double-stack onto survivors — restoring capacity
+        means launching real replacements). Round-robin zones, every
+        SPOT_OD_EVERY-th node on-demand. Nodes go through the REAL launch
+        path (_launch_node: journal write-ahead, machine object, cloud
+        instance, lifecycle hydration) so the reclaim storm exercises the
+        same machinery production would."""
+        from ..oracle.scheduler import Option
+        from ..solver.core import SolvedNode, SolveResult
+
+        catalog = op.cloudprovider.catalog_for(None)
+        itype = catalog.by_name["t.small"]
+        prov = op.kube.get("provisioners", "default")
+        empty = SolveResult(nodes=[], existing_counts={}, unschedulable={},
+                            groups=[])
+        price_of = {(o.zone, o.capacity_type): o.price
+                    for o in itype.offerings}
+        zones = sorted({o.zone for o in itype.offerings})
+        fleet: "dict[str, dict]" = {}
+        for i in range(n_nodes):
+            zone = zones[i % len(zones)]
+            ct = (wk.CAPACITY_TYPE_ON_DEMAND
+                  if i % self.SPOT_OD_EVERY == self.SPOT_OD_EVERY - 1
+                  else wk.CAPACITY_TYPE_SPOT)
+            solved = SolvedNode(
+                option=Option(index=-1, itype=itype, zone=zone,
+                              capacity_type=ct, price=price_of[(zone, ct)],
+                              alloc=tuple(itype.allocatable_vector())),
+                pod_counts={}, provisioner=prov)
+            node = op.provisioning._launch_node(solved, {}, empty)
+            if node is None:
+                continue
+            pod_name = f"sp-{i:05d}"
+            shape = {"cpu": "2", "memory": "1Gi"}
+            op.kube.create("pods", pod_name, make_pod(pod_name, **shape))
+            op.provisioning._bind_assigned({0: [pod_name]}, node.name)
+            fleet[pod_name] = shape
+        return fleet
+
+    def _storm_replicaset(self, op, fleet: "dict[str, dict]") -> None:
+        """ReplicaSet analogue for the storm fleet: pods whose node was
+        reclaimed come back as fresh unbound pods (same contract as
+        _reconcile_workload, without an injector in the loop)."""
+        for name, shape in fleet.items():
+            obj = op.kube.get("pods", name)
+            if obj is not None and obj.node_name \
+                    and obj.node_name not in op.cluster.nodes:
+                op.kube.delete("pods", name)
+                obj = None
+            if obj is None:
+                op.kube.create("pods", name, make_pod(name, **shape))
+
+    def _drain_interruption_queue(self, op) -> int:
+        """Deliver EVERY queued reclaim warning inside the current tick:
+        the interruption controller receives in batches of 10, so one
+        reconcile per cycle would smear a 2000-message storm across 200
+        cycles — a storm is simultaneous by definition."""
+        drained = 0
+        while True:
+            n = op.interruption.reconcile_once()
+            if n == 0:
+                return drained
+            drained += n
+
+    @staticmethod
+    def _fleet_cost(op) -> float:
+        return round(sum(n.price for n in op.cluster.nodes.values()), 4)
+
+    def _pool_nodes(self, op, pool) -> "list":
+        return [n for n in op.cluster.nodes.values()
+                if (n.instance_type, n.zone, n.capacity_type) == pool]
+
+    def run_spot_storm_scenario(self, scenario: int, n_nodes: int,
+                                n_reclaims: int) -> dict:
+        """The headline drill: forecast the storm, rebalance ahead of it,
+        then reclaim n_reclaims spot nodes in one tick and audit the
+        recovery. Explain is ON (risk-term DecisionRecords are part of
+        the contract), profiling OFF, the spot plane hot."""
+        from .. import explain as _explain
+        from .. import profiling as _profiling
+        from .. import spot as spot_plane
+
+        prof_prev = _profiling.set_enabled(False)
+        expl_prev = _explain.set_enabled(True)
+        spot_prev = spot_plane.set_enabled(True)
+        rng = ChaosRng((self.seed << 8) ^ scenario).fork("spotstorm")
+        clock = FakeClock()
+        op, cloud = self._build(clock, name_suffix=f"ss{scenario}")
+        op.resilience.use_virtual_sleep()
+        shrink_batcher_windows(op)
+        # consolidation would spend the whole drill bin-packing the huge
+        # fleet; the storm is about the interruption/rebalance planes
+        op.kube.update("provisioners", "default",
+                       self._chaos_provisioner(consolidation=False))
+        errors: "list[str]" = []
+        violations: "list[invariants.Violation]" = []
+        storm_pool = ("t.small", "zone-1a", wk.CAPACITY_TYPE_SPOT)
+        try:
+            fleet = self._seed_spot_fleet(op, n_nodes)
+            seed_cycles = 0
+            for _ in range(self.SPOT_SEED_DEADLINE):
+                seed_cycles += 1
+                self._drive_once(op, errors)
+                clock.step(self.CYCLE_SECONDS)
+                if self._quiescent(op):
+                    break
+            pre_cost = self._fleet_cost(op)
+            pre_nodes = len(op.cluster.nodes)
+            # phase A — the forecaster sees the storm coming: live feed
+            # pins the stormed pool at rate 0.9, the rebalance controller
+            # starts draining ahead of it (rate-limited, cost-guarded)
+            schedule = {storm_pool: 0.9}
+            op.spotforecaster.set_live_source(lambda: dict(schedule))
+            for _ in range(self.SPOT_PRESTORM_CYCLES):
+                self._drive_once(op, errors)
+                self._storm_replicaset(op, fleet)
+                clock.step(self.CYCLE_SECONDS)
+            prestorm_rebalances = len(op.spotrebalance.ledger)
+            # phase B — the storm tick: the forecaster was RIGHT, and the
+            # platform reclaims n_reclaims instances of the stormed pool
+            # simultaneously. Every warning is delivered inside this tick.
+            pool_iids = sorted(
+                i.id for i in cloud.instances.values()
+                if i.state == "running"
+                and (i.instance_type, i.zone, i.capacity_type) == storm_pool)
+            picks = rng.sample_indices(min(n_reclaims, len(pool_iids)),
+                                       len(pool_iids))
+            targets = [pool_iids[i] for i in sorted(picks)]
+            machines_before_storm = {m.name for m in op.kube.machines()}
+            for iid in targets:
+                op.queue.send(json.dumps({
+                    "source": "cloud.spot",
+                    "detail-type": "Spot Instance Interruption Warning",
+                    "detail": {"instance-id": iid}}))
+            delivered = self._drain_interruption_queue(op)
+            self._drive_once(op, errors)
+            self._storm_replicaset(op, fleet)
+            clock.step(self.CYCLE_SECONDS)
+            # phase C — restore: every displaced pod must be bound again
+            # within SPOT_RESTORE_K cycles
+            restore_cycles = -1
+            for c in range(1, 2 * self.SPOT_RESTORE_K + 1):
+                self._drive_once(op, errors)
+                self._storm_replicaset(op, fleet)
+                if not op.kube.pending_pods():
+                    restore_cycles = c
+                    clock.step(self.CYCLE_SECONDS)
+                    break
+                clock.step(self.CYCLE_SECONDS)
+            # composition audit evidence BEFORE the GC time-jumps expire
+            # the ICE marks: no post-storm launch may land in the stormed
+            # (quarantined) pool while the forecast still brands it
+            post_storm_in_pool = [
+                n.name for n in self._pool_nodes(op, storm_pool)
+                if n.machine_name not in machines_before_storm]
+            pool_iced = not any(
+                o.available and o.zone == storm_pool[1]
+                and o.capacity_type == storm_pool[2]
+                for o in op.cloudprovider.catalog_for(None)
+                .by_name[storm_pool[0]].offerings)
+            risk_records = [r for r in _explain.DECISIONS.records(
+                kind="spot-objective") if r.get("forecast_rung") == 0]
+            # the storm has happened: the live feed stops branding the
+            # pool (ICE keeps quarantining it) — otherwise the rebalance
+            # controller would churn zone-1a survivors through the whole
+            # settle phase and the fleet could never quiesce
+            op.spotforecaster.set_live_source(lambda: {})
+            # settle + GC mop-up (clears the reclaimed machine objects)
+            settle_cycles = 0
+            for _ in range(self.SETTLE_DEADLINE):
+                settle_cycles += 1
+                self._drive_once(op, errors)
+                self._storm_replicaset(op, fleet)
+                clock.step(self.CYCLE_SECONDS)
+                if self._quiescent(op):
+                    break
+            for _ in range(2):
+                clock.step(360.0)
+                self._drive_once(op, errors)
+            for _ in range(6):
+                self._drive_once(op, errors)
+                self._storm_replicaset(op, fleet)
+                clock.step(self.CYCLE_SECONDS)
+                if self._quiescent(op):
+                    break
+            post_cost = self._fleet_cost(op)
+            spot_after = spot_plane.activity()
+
+            violations += invariants.check_all(
+                op, cloud, resilience=op.resilience.evidence())
+            violations += invariants.check_spot_cost_never_raised(
+                op.spotrebalance.ledger)
+            violations += invariants.check_spot_capacity_restored(
+                restore_cycles, self.SPOT_RESTORE_K)
+            violations += invariants.check_spot_never_strands(
+                op, op.spotrebalance.ledger)
+            if delivered < n_reclaims:
+                violations.append(invariants.Violation(
+                    "spot-storm-delivery",
+                    f"only {delivered} of {n_reclaims} reclaim warnings "
+                    "were delivered in the storm tick"))
+            if post_storm_in_pool:
+                violations.append(invariants.Violation(
+                    "spot-quarantine-composition",
+                    f"{len(post_storm_in_pool)} post-storm launch(es) "
+                    f"landed in the stormed pool {list(storm_pool)} while "
+                    f"it was ICE-quarantined: {post_storm_in_pool[:5]}"))
+            if not pool_iced:
+                violations.append(invariants.Violation(
+                    "spot-quarantine-composition",
+                    f"the stormed pool {list(storm_pool)} was never "
+                    "ICE-marked by the interruption handler"))
+            if not risk_records:
+                violations.append(invariants.Violation(
+                    "spot-risk-citations",
+                    "no spot-objective DecisionRecord cites the live "
+                    "forecast (rung 0) — risk-influenced assignments "
+                    "must carry their risk term"))
+            lim = op.spotrebalance.limiter.snapshot()
+            if lim["spent"] > lim["accrued"] + 1e-9:
+                violations.append(invariants.Violation(
+                    "spot-churn-le-risk-avoided",
+                    f"rebalance spent {lim['spent']} drain token(s) but "
+                    f"only {lim['accrued']} of predicted-interruption "
+                    "mass ever accrued"))
+            if not self._quiescent(op):
+                violations.insert(0, invariants.Violation(
+                    "quiescence",
+                    "storm fleet never reached quiescence before the "
+                    "step deadline"))
+            if violations and self.out_dir:
+                os.makedirs(self.out_dir, exist_ok=True)
+                bundle_path = os.path.join(
+                    self.out_dir,
+                    f"spotstorm_seed{self.seed}_s{scenario}_bundle.json")
+                written = op.flightrecorder.trigger(
+                    "spot_storm_invariant_breach",
+                    detail="; ".join(f"[{v.invariant}] {v.message}"
+                                     for v in violations)[:500],
+                    force=True, path=bundle_path)
+                if written:
+                    self._bundles.append(written)
+        finally:
+            spot_plane.set_enabled(spot_prev)
+            _explain.set_enabled(expl_prev)
+            _profiling.set_enabled(prof_prev)
+            op.stop()
+
+        reb = op.spotrebalance
+        return {
+            "seed": self.seed,
+            "scenario": scenario,
+            "drill": "spot-storm",
+            "fleet": {
+                "nodes": pre_nodes,
+                "seed_cycles": seed_cycles,
+                "pods": len(fleet),
+                "stormed_pool": list(storm_pool),
+                "stormed_pool_size": len(pool_iids),
+                "hourly_cost_before": pre_cost,
+            },
+            "storm": {
+                "reclaims_sent": len(targets),
+                "reclaims_delivered": delivered,
+                "restore_cycles": restore_cycles,
+                "restore_bound": self.SPOT_RESTORE_K,
+            },
+            "rebalance": {
+                "prestorm_proactive": prestorm_rebalances,
+                "ledger": [dict(e) for e in reb.ledger],
+                "limiter": lim,
+                "snapshot": reb.snapshot(),
+            },
+            "composition": {
+                "stormed_pool_iced": pool_iced,
+                "post_storm_launches_into_stormed_pool":
+                    len(post_storm_in_pool),
+                "risk_decision_records": len(risk_records),
+            },
+            "forecast": op.spotforecaster.snapshot(),
+            "spot_activity": {k: v for k, v in sorted(spot_after.items())},
+            "hourly_cost_after": post_cost,
+            "controller_errors": errors,
+            "settle_cycles": settle_cycles,
+            "final_nodes": len(op.cluster.nodes),
+            "violations": [v.as_dict() for v in violations],
+            "passed": not violations,
+        }
+
+    def run_spot_wrong_forecast(self, scenario: int) -> dict:
+        """The adversarial half: the forecaster predicts a storm on pool
+        B, the platform reclaims pool A. The drill audits that being
+        WRONG costs bounded churn — proactive drains never exceed the
+        accrued predicted-interruption mass, clearing the forecast stops
+        rebalancing within one reconcile, recovery still lands within the
+        restore bound, and no replacement ever raised the bill."""
+        from .. import explain as _explain
+        from .. import profiling as _profiling
+        from .. import spot as spot_plane
+
+        prof_prev = _profiling.set_enabled(False)
+        expl_prev = _explain.set_enabled(True)
+        spot_prev = spot_plane.set_enabled(True)
+        rng = ChaosRng((self.seed << 8) ^ scenario).fork("spotwrong")
+        clock = FakeClock()
+        op, cloud = self._build(clock, name_suffix=f"sw{scenario}")
+        op.resilience.use_virtual_sleep()
+        shrink_batcher_windows(op)
+        op.kube.update("provisioners", "default",
+                       self._chaos_provisioner(consolidation=False))
+        errors: "list[str]" = []
+        violations: "list[invariants.Violation]" = []
+        forecast_pool = ("t.small", "zone-1b", wk.CAPACITY_TYPE_SPOT)
+        actual_pool = ("t.small", "zone-1a", wk.CAPACITY_TYPE_SPOT)
+        try:
+            fleet = self._seed_spot_fleet(op, self.SPOT_WRONG_NODES)
+            for _ in range(self.SPOT_SEED_DEADLINE):
+                self._drive_once(op, errors)
+                clock.step(self.CYCLE_SECONDS)
+                if self._quiescent(op):
+                    break
+            schedule = {forecast_pool: 0.9}
+            op.spotforecaster.set_live_source(lambda: dict(schedule))
+            for _ in range(self.SPOT_PRESTORM_CYCLES):
+                self._drive_once(op, errors)
+                self._storm_replicaset(op, fleet)
+                clock.step(self.CYCLE_SECONDS)
+            # the storm lands where the forecast did NOT point
+            pool_iids = sorted(
+                i.id for i in cloud.instances.values()
+                if i.state == "running"
+                and (i.instance_type, i.zone, i.capacity_type)
+                == actual_pool)
+            picks = rng.sample_indices(
+                min(self.SPOT_WRONG_RECLAIMS, len(pool_iids)),
+                len(pool_iids))
+            for idx in sorted(picks):
+                op.queue.send(json.dumps({
+                    "source": "cloud.spot",
+                    "detail-type": "Spot Instance Interruption Warning",
+                    "detail": {"instance-id": pool_iids[idx]}}))
+            delivered = self._drain_interruption_queue(op)
+            self._drive_once(op, errors)
+            self._storm_replicaset(op, fleet)
+            clock.step(self.CYCLE_SECONDS)
+            restore_cycles = -1
+            for c in range(1, 2 * self.SPOT_RESTORE_K + 1):
+                self._drive_once(op, errors)
+                self._storm_replicaset(op, fleet)
+                if not op.kube.pending_pods():
+                    restore_cycles = c
+                    clock.step(self.CYCLE_SECONDS)
+                    break
+                clock.step(self.CYCLE_SECONDS)
+            # the operator admits the forecast was wrong: the live feed
+            # clears, and proactive churn must STOP within one reconcile
+            # (the limiter zeroes its bank on the first zero-mass cycle)
+            op.spotforecaster.set_live_source(lambda: {})
+            launched_at_clear = spot_plane.activity()[
+                "spot_rebalance_launched"]
+            post_clear_cycles = 3
+            for _ in range(post_clear_cycles):
+                self._drive_once(op, errors)
+                self._storm_replicaset(op, fleet)
+                clock.step(self.CYCLE_SECONDS)
+            launched_after = spot_plane.activity()["spot_rebalance_launched"]
+            settle_cycles = 0
+            for _ in range(self.SETTLE_DEADLINE):
+                settle_cycles += 1
+                self._drive_once(op, errors)
+                self._storm_replicaset(op, fleet)
+                clock.step(self.CYCLE_SECONDS)
+                if self._quiescent(op):
+                    break
+            for _ in range(2):
+                clock.step(360.0)
+                self._drive_once(op, errors)
+            for _ in range(6):
+                self._drive_once(op, errors)
+                self._storm_replicaset(op, fleet)
+                clock.step(self.CYCLE_SECONDS)
+                if self._quiescent(op):
+                    break
+
+            violations += invariants.check_all(
+                op, cloud, resilience=op.resilience.evidence())
+            violations += invariants.check_spot_cost_never_raised(
+                op.spotrebalance.ledger)
+            violations += invariants.check_spot_capacity_restored(
+                restore_cycles, self.SPOT_RESTORE_K)
+            violations += invariants.check_spot_never_strands(
+                op, op.spotrebalance.ledger)
+            lim = op.spotrebalance.limiter.snapshot()
+            if lim["spent"] > lim["accrued"] + 1e-9:
+                violations.append(invariants.Violation(
+                    "spot-churn-le-risk-avoided",
+                    f"a WRONG forecast let rebalance spend {lim['spent']} "
+                    f"drain(s) against {lim['accrued']} accrued mass"))
+            if launched_after > launched_at_clear:
+                violations.append(invariants.Violation(
+                    "spot-churn-le-risk-avoided",
+                    f"{launched_after - launched_at_clear} proactive "
+                    f"launch(es) fired in the {post_clear_cycles} cycles "
+                    "AFTER the forecast cleared — a wrong forecaster must "
+                    "stop causing churn within one reconcile"))
+            if not self._quiescent(op):
+                violations.insert(0, invariants.Violation(
+                    "quiescence",
+                    "wrong-forecast fleet never reached quiescence"))
+        finally:
+            spot_plane.set_enabled(spot_prev)
+            _explain.set_enabled(expl_prev)
+            _profiling.set_enabled(prof_prev)
+            op.stop()
+
+        return {
+            "seed": self.seed,
+            "scenario": scenario,
+            "drill": "spot-wrong-forecast",
+            "fleet_nodes": self.SPOT_WRONG_NODES,
+            "forecast_pool": list(forecast_pool),
+            "actual_pool": list(actual_pool),
+            "reclaims_delivered": delivered,
+            "restore_cycles": restore_cycles,
+            "proactive_rebalances": len(op.spotrebalance.ledger),
+            "rebalance_ledger": [dict(e) for e in op.spotrebalance.ledger],
+            "limiter": lim,
+            "post_clear_launches": launched_after - launched_at_clear,
+            "controller_errors": errors,
+            "settle_cycles": settle_cycles,
+            "final_nodes": len(op.cluster.nodes),
+            "violations": [v.as_dict() for v in violations],
+            "passed": not violations,
+        }
+
+    def _spot_noop_window(self, live_schedule) -> "tuple[dict, dict]":
+        """One decision-parity window: a fresh operator with a pinned
+        machine-name suffix, a fixed workload, SPOT_NOOP_CYCLES drives.
+        Returns (decisions, controller-error list). The caller flips the
+        spot plane around this; `live_schedule` is injected regardless —
+        the SWITCH, not the feed, must gate the plane."""
+        clock = FakeClock()
+        op, _cloud = self._build(clock, name_suffix="ssnoop")
+        op.resilience.use_virtual_sleep()
+        shrink_batcher_windows(op)
+        op.kube.update("provisioners", "default",
+                       self._chaos_provisioner(consolidation=False))
+        op.spotforecaster.set_live_source(lambda: dict(live_schedule))
+        workload = {f"np{i}": {"cpu": c, "memory": m}
+                    for i, (c, m) in enumerate(
+                        [("1", "2Gi"), ("2", "4Gi"), ("500m", "1Gi")] * 4)}
+        errors: "list[str]" = []
+        try:
+            for name, shape in workload.items():
+                op.kube.create("pods", name, make_pod(name, **shape))
+            for _ in range(self.SPOT_NOOP_CYCLES):
+                self._drive_once(op, errors)
+                clock.step(self.CYCLE_SECONDS)
+        finally:
+            op.stop()
+        machines = sorted(
+            (m.name, m.status.instance_type, m.status.zone,
+             m.status.capacity_type)
+            for m in op.kube.machines())
+        bindings = {p.name: p.node_name
+                    for p in (op.kube.get("pods", n) for n in workload)
+                    if p is not None}
+        decisions = {
+            "machines": [list(m) for m in machines],
+            "bindings": dict(sorted(bindings.items())),
+            "nodes": sorted(
+                (n.name, n.instance_type, n.zone, n.capacity_type,
+                 round(n.price, 6))
+                for n in op.cluster.nodes.values()),
+        }
+        return decisions, {"errors": errors}
+
+    def run_spot_noop(self, scenario: int) -> dict:
+        """The strict-noop half, two windows: window A runs with the
+        plane ENABLED, window B DISABLED — both get the same hot live
+        schedule injected. Disabled must mean disabled: zero counter
+        movement AND launch/bind decisions bit-identical to... nothing,
+        because window A's forecast steers its solve. So window A runs
+        WITHOUT an elevated schedule (the advisory plane at its quiet
+        default — the no-plane baseline by construction) and window B
+        runs DISABLED with the hot schedule: if the switch leaks, B's
+        decisions drift from A's baseline or B's counters move."""
+        from .. import explain as _explain
+        from .. import profiling as _profiling
+        from .. import spot as spot_plane
+
+        prof_prev = _profiling.set_enabled(False)
+        expl_prev = _explain.set_enabled(False)
+        storm_pool = ("t.small", "zone-1a", wk.CAPACITY_TYPE_SPOT)
+        try:
+            spot_prev = spot_plane.set_enabled(True)
+            baseline, base_meta = self._spot_noop_window({})
+            spot_plane.set_enabled(False)
+            before = spot_plane.activity()
+            disabled, dis_meta = self._spot_noop_window({storm_pool: 0.9})
+            after = spot_plane.activity()
+            spot_plane.set_enabled(spot_prev)
+        finally:
+            _explain.set_enabled(expl_prev)
+            _profiling.set_enabled(prof_prev)
+
+        evidence = {"noop": {"enabled": False,
+                             "before": before, "after": after}}
+        violations = invariants.check_spot_noop(evidence["noop"])
+        if disabled != baseline:
+            drift = sorted(k for k in baseline
+                           if baseline[k] != disabled[k])
+            violations.append(invariants.Violation(
+                "spot-strict-noop",
+                f"solve decisions with the plane DISABLED diverge from "
+                f"the quiet-baseline window in {drift} — disabling the "
+                "plane must be bit-identical to a build without it"))
+        return {
+            "seed": self.seed,
+            "scenario": scenario,
+            "drill": "spot-noop",
+            "cycles": self.SPOT_NOOP_CYCLES,
+            "workload_pods": 12,
+            "machines_launched": len(baseline["machines"]),
+            "decisions_identical": disabled == baseline,
+            "spot": {"noop": {
+                "enabled": False,
+                "deltas": {k: after[k] - before[k] for k in before},
+            }},
+            "controller_errors": base_meta["errors"] + dis_meta["errors"],
+            "violations": [v.as_dict() for v in violations],
+            "passed": not violations,
+        }
+
+    def run_spot_storm_drill(self) -> dict:
+        t0 = time.time()
+        self._bundles = []
+        n_nodes = self.spot_storm_nodes or self.SPOT_STORM_NODES
+        n_reclaims = self.spot_storm_reclaims or self.SPOT_STORM_RECLAIMS
+        scenarios = [
+            self.run_spot_storm_scenario(0, n_nodes, n_reclaims),
+            self.run_spot_wrong_forecast(1),
+            self.run_spot_noop(2),
+        ]
+        storm = scenarios[0]
+        artifact = {
+            "tool": "karpenter_tpu.chaos",
+            "mode": "spot-storm",
+            "seed": self.seed,
+            "nodes": n_nodes,
+            "reclaims": n_reclaims,
+            "restore_bound_cycles": self.SPOT_RESTORE_K,
+            "scenario_count": len(scenarios),
+            "passed": all(s["passed"] for s in scenarios),
+            "key_numbers": {
+                "fleet_nodes": storm["fleet"]["nodes"],
+                "storm_reclaims": storm["storm"]["reclaims_delivered"],
+                "restore_cycles": storm["storm"]["restore_cycles"],
+                "proactive_rebalances": len(
+                    storm["rebalance"]["ledger"]),
+                "post_storm_launches_into_stormed_pool":
+                    storm["composition"][
+                        "post_storm_launches_into_stormed_pool"],
+                "risk_decision_records":
+                    storm["composition"]["risk_decision_records"],
+                "hourly_cost_before": storm["fleet"]["hourly_cost_before"],
+                "hourly_cost_after": storm["hourly_cost_after"],
+                "wrong_forecast_post_clear_launches":
+                    scenarios[1]["post_clear_launches"],
+                "noop_decisions_identical":
+                    scenarios[2]["decisions_identical"],
+            },
+            "scenarios": scenarios,
+            # volatile fields below this line only (replay contract)
+            "duration_s": round(time.time() - t0, 3),
+            "bundles": list(self._bundles),
+        }
+        if self.out_dir:
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = os.path.join(self.out_dir,
+                                f"spotstorm_seed{self.seed}.json")
+            with open(path, "w") as f:
+                json.dump(artifact, f, indent=2, sort_keys=True)
+            artifact["artifact_path"] = path
+        return artifact
+
     # -- artifact --------------------------------------------------------------
 
     def run(self) -> dict:
@@ -1548,6 +2242,8 @@ class ChaosRunner:
             return self.run_storm()
         if self.partition:
             return self.run_partition_drill()
+        if self.spot_storm:
+            return self.run_spot_storm_drill()
         t0 = time.time()
         self._bundles = []
         scenarios = [self.run_scenario(s) for s in range(self.scenarios)]

@@ -25,6 +25,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
+from ... import explain
 from ...apis import wellknown as wk
 from ...events import EventRecorder
 from ...introspect.watchdog import cycle as _wd_cycle
@@ -160,11 +161,14 @@ class InterruptionController:
         # per-batch drain rate: the attribution signal for queue-throughput
         # regressions — a ladder that degrades superlinearly with batch
         # size shows up HERE (per-batch msgs/s falling as batches fill)
-        # before it shows up in end-to-end latency
+        # before it shows up in end-to-end latency. `reason` splits drains
+        # the platform forced (reactive-reclaim) from drains the spot
+        # plane chose (proactive-rebalance, observed by RebalanceController
+        # against this same family) so a storm's churn is attributable.
         self.drain_throughput = reg.histogram(
             f"{NAMESPACE}_interruption_drain_throughput_msgs_per_second",
             "Messages drained per second, per receive batch "
-            "(handle + delete, wall time).",
+            "(handle + delete, wall time), by drain reason.", ("reason",),
             buckets=(50, 100, 250, 500, 1000, 2500, 5000, 10000))
         self.deduped = reg.counter(
             f"{NAMESPACE}_interruption_deduped_messages_total",
@@ -249,7 +253,8 @@ class InterruptionController:
                 log.warning("interruption message handling failed: %s", e)
         elapsed = time.perf_counter() - batch_start
         if elapsed > 0:
-            self.drain_throughput.observe(len(messages) / elapsed)
+            self.drain_throughput.observe(len(messages) / elapsed,
+                                          reason="reactive-reclaim")
         return len(messages)
 
     def _handle(self, qmsg) -> None:
@@ -287,9 +292,15 @@ class InterruptionController:
             if action == ACTION_CORDON_AND_DRAIN and node_name:
                 if self.termination is not None:
                     self.termination.request_deletion(node_name)
+                explain.note_drain(node_name, "interruption",
+                                   "reactive-reclaim",
+                                   ts=self.clock.now(),
+                                   detail={"instance": iid,
+                                           "kind": msg.kind})
                 self.recorder.warning(
                     f"node/{node_name}", msg.kind,
-                    f"interruption event for instance {iid}")
+                    f"interruption event for instance {iid} "
+                    f"(reason reactive-reclaim)")
                 self.actions.inc(action=ACTION_CORDON_AND_DRAIN)
             else:
                 if node_name and msg.kind == KIND_REBALANCE:

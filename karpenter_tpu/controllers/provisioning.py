@@ -119,6 +119,10 @@ class ProvisioningController:
         # (strict-noop otherwise); holds the resident masks between cycles
         from ..incremental import IncrementalSolver
         self._incremental = IncrementalSolver(cluster)
+        # spot plane's risk-aware objective (spot/objective.py), injected by
+        # the operator; None (or inactive: no elevated forecast) leaves
+        # every solve on the exact pre-spot path
+        self.spot_objective = None
         self._machine_seq = 0
         # per-process machine-name suffix: two HA replicas sharing one store
         # must never collide on create (the reference uses generateName)
@@ -214,7 +218,28 @@ class ProvisioningController:
                                    pods=len(pods)) as solve_span:
                 t0 = time.perf_counter()
                 from ..incremental import enabled as _inc_enabled
-                if _inc_enabled():
+                spot_obj = self.spot_objective
+                if spot_obj is not None and spot_obj.active():
+                    # elevated interruption forecast: the risk-aware
+                    # objective drives the solve (adjusted prices +
+                    # diversity floor) through the same routed chain; it
+                    # bypasses the incremental plane for the storm window —
+                    # a delta-solve against risk-adjusted prices would
+                    # compare against residents packed under real ones
+                    kinds: "list[str]" = []
+
+                    def _spot_solve(cat, mask, barred, pod_xform=None):
+                        ps = pods if pod_xform is None else pod_xform(pods)
+                        r, k = self._routed_solve(
+                            cat, provisioners, ps, existing,
+                            daemon_overhead, option_mask=mask, barred=barred)
+                        kinds.append(k)
+                        return r
+
+                    result, _spot_info = spot_obj.solve(catalog, _spot_solve)
+                    solver_kind = kinds[-1] if kinds else "oracle"
+                    solve_span.set_attribute("spot_risk", True)
+                elif _inc_enabled():
                     result, solver_kind = self._incremental.solve(
                         pods, existing,
                         lambda ps, ex: self._routed_solve(
@@ -283,12 +308,22 @@ class ProvisioningController:
             cache[key] = solver
         return solver
 
-    def _routed_solve(self, catalog, provisioners, pods, existing, overhead):
+    def _routed_solve(self, catalog, provisioners, pods, existing, overhead,
+                      option_mask=None, barred=None):
         """Route by batch size (measured crossover), degrade down the chain.
         Order: preferred backend -> other backend -> scalar oracle; every
         backend enforces identical semantics (parity-tested), so routing is
-        purely a latency decision."""
+        purely a latency decision.
+
+        `option_mask` / `barred` carry the spot plane's diversity-floor bar
+        in both backends' vocabularies ([T,S] dense mask for the kernels,
+        pool-key set for the scalar oracle) — same dimension, parity-
+        audited; both None on every non-spot solve."""
         key = self._content_key(catalog, provisioners)
+        # only thread the kwarg when a mask is actually set: injected
+        # solver factories (tests, chaos fault doubles) predate the
+        # parameter, and every non-spot solve must stay byte-identical
+        mask_kw = {} if option_mask is None else {"option_mask": option_mask}
 
         def run_primary():
             def build(old):
@@ -299,7 +334,7 @@ class ProvisioningController:
                 return s
             solver = self._cached(self._solver_cache, key, build)
             return solver.solve(pods, existing=existing,
-                                daemon_overhead=overhead)
+                                daemon_overhead=overhead, **mask_kw)
 
         def run_native():
             def build(old):
@@ -309,7 +344,7 @@ class ProvisioningController:
                 return s
             solver = self._cached(self._native_cache, key, build)
             return solver.solve(pods, existing=existing,
-                                daemon_overhead=overhead)
+                                daemon_overhead=overhead, **mask_kw)
 
         # Ladder rungs bind to FIXED backend identities — 0 = tpu,
         # 1 = native, 2 = oracle (matching the hub's "solve" chain) — so
@@ -368,12 +403,13 @@ class ProvisioningController:
         else:
             flush_failures(len(backends))
         result = self._oracle_solve(catalog, provisioners, pods,
-                                    existing, overhead)
+                                    existing, overhead, barred=barred)
         ladder.record_success(len(backends))
         return result, "oracle"
 
-    def _oracle_solve(self, catalog, provisioners, pods, existing, overhead):
-        sched = Scheduler(catalog, provisioners, overhead)
+    def _oracle_solve(self, catalog, provisioners, pods, existing, overhead,
+                      barred=None):
+        sched = Scheduler(catalog, provisioners, overhead, barred=barred)
         res = sched.schedule(list(pods), existing=existing)
         return _oracle_to_solve_result(res, sched)
 

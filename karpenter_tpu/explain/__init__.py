@@ -19,9 +19,10 @@ solve p50 delta).
 """
 from __future__ import annotations
 
-from .records import DECISIONS, SCHEMA_VERSION, note_shed  # noqa: F401
+from .records import (DECISIONS, SCHEMA_VERSION, note_drain,  # noqa: F401
+                      note_shed)
 from .reasons import (CLAUSES, CONSOLIDATION_VERDICTS,  # noqa: F401
-                      DIMENSIONS, SHED_REASONS, clause_for)
+                      DIMENSIONS, DRAIN_REASONS, SHED_REASONS, clause_for)
 from .state import disabled, enabled, set_enabled  # noqa: F401
 
 
@@ -52,6 +53,7 @@ def snapshot() -> dict:
         "attributions_total": act["attributions_total"],
         "sheds_total": act["sheds_total"],
         "consolidations_total": act["consolidations_total"],
+        "drains_total": act["drains_total"],
         "ring_depth": act["ring"],
         "dimensions": list(DIMENSIONS),
         "recent": [{"id": r.get("id"), "kind": r.get("kind"),

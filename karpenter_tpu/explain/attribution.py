@@ -54,6 +54,7 @@ def attribute_pod(
     daemon_overhead: "Optional[Sequence[int]]" = None,
     grid=None,
     kubelet: "Optional[tuple]" = None,
+    option_mask: "Optional[np.ndarray]" = None,
 ) -> dict:
     """Per-dimension rejection counts + ranked summary for one pod.
 
@@ -78,7 +79,12 @@ def attribute_pod(
     pods_i = wk.RESOURCE_INDEX[wk.RESOURCE_PODS]
 
     counts = {dim: 0 for dim in DIMENSIONS}
-    any_tol = any_req = any_fit = any_avail = False
+    any_tol = any_req = any_fit = any_avail = any_divers = False
+    # the spot plane's diversity-floor mask joins the fold after
+    # availability (solver/core.py MASK_DIMENSIONS order); None means the
+    # dimension zeroes nothing and the walk is bit-identical to before
+    divers_flat = (avail_flat if option_mask is None
+                   else (avail_flat & option_mask.reshape(-1)))
     nearest: "Optional[dict]" = None
     for pi, prov in enumerate(provs):
         if not tolerates_all(pod.tolerations, prov.taints):
@@ -130,9 +136,14 @@ def attribute_pod(
         m2 = m1 & avail_flat
         n_avail = int(m2.sum())
         counts["availability"] += n_fit - n_avail
-        counts["constraints"] += n_avail
+        m3 = m1 & divers_flat
+        n_divers = int(m3.sum())
+        counts["diversity"] += n_avail - n_divers
+        counts["constraints"] += n_divers
         if n_avail:
             any_avail = True
+        if n_divers:
+            any_divers = True
 
     # dominant clause: the exact stage walk diagnose_unschedulable does —
     # first stage no provisioner survives
@@ -144,6 +155,8 @@ def attribute_pod(
         dim = "resources"
     elif not any_avail:
         dim = "availability"
+    elif not any_divers:
+        dim = "diversity"
     else:
         dim = "constraints"
     total = n_defined * len(provs)

@@ -25,6 +25,7 @@ DIMENSIONS = (
     "requirements",
     "resources",
     "availability",
+    "diversity",
     "constraints",
 )
 
@@ -43,6 +44,9 @@ CLAUSES = (
     ("availability",
      "every compatible offering is currently unavailable "
      "(insufficient capacity)"),
+    ("diversity",
+     "every remaining compatible offering is barred by the spot "
+     "diversity floor this cycle"),
     ("constraints",
      "compatible capacity exists but scheduling constraints "
      "(affinity/topology/limits) were unsatisfiable this cycle"),
@@ -55,6 +59,15 @@ CLAUSES = (
 SHED_REASONS = (
     "deadline",
     "poison-quarantine",
+)
+
+# Node drain causes (controllers/interruption cites the reactive one per
+# handled reclaim message, spot/rebalance.py cites the proactive one per
+# ahead-of-reclaim replace; the spot-storm drill audits attribution from
+# the drain-throughput histogram's matching `reason` label).
+DRAIN_REASONS = (
+    "reactive-reclaim",
+    "proactive-rebalance",
 )
 
 # Consolidation keep/evict verdicts (ops/consolidate.py cites these per

@@ -65,6 +65,7 @@ class DecisionRing:
         self.attributions_total = 0
         self.sheds_total = 0
         self.consolidations_total = 0
+        self.drains_total = 0
 
     def emit(self, kind: str, record: dict,
              ts: "Optional[float]" = None) -> "Optional[str]":
@@ -83,6 +84,8 @@ class DecisionRing:
                 self.sheds_total += 1
             elif kind == "consolidation":
                 self.consolidations_total += 1
+            elif kind == "drain":
+                self.drains_total += 1
             depth = len(self._ring)
         RECORDS_TOTAL.inc(kind=kind)
         RING_DEPTH.set(depth)
@@ -137,6 +140,7 @@ class DecisionRing:
                 "attributions_total": self.attributions_total,
                 "sheds_total": self.sheds_total,
                 "consolidations_total": self.consolidations_total,
+                "drains_total": self.drains_total,
                 "ring": len(self._ring),
             }
 
@@ -157,3 +161,17 @@ def note_shed(tenant: str, where: str, reason: str,
         return None
     return DECISIONS.emit(
         "shed", {"tenant": tenant, "where": where, "reason": reason}, ts=ts)
+
+
+def note_drain(node: str, source: str, reason: str,
+               ts: "Optional[float]" = None,
+               detail: "Optional[dict]" = None) -> "Optional[str]":
+    """One node drain cause into the ring (the interruption controller and
+    spot/rebalance.py cite reasons.DRAIN_REASONS literals — lint-enforced;
+    the spot-storm drill audits reactive vs proactive attribution)."""
+    if not state.enabled():
+        return None
+    rec = {"node": node, "source": source, "reason": reason}
+    if detail:
+        rec.update(detail)
+    return DECISIONS.emit("drain", rec, ts=ts)

@@ -23,11 +23,12 @@ from ..metrics import REGISTRY, Gauge, Histogram
 
 log = logging.getLogger("karpenter.statusz")
 
-# 11: added "critical" (critical-path ledger: overlap ratio, wait
-# vocabulary totals, measured-roofline rungs + drift flags)
-# (10: "incremental"; 9: "pid" + "serving"; 8: "decisions";
-# 7: "profiling"; 6: "hbm"; 5: "slo")
-SCHEMA_VERSION = 11
+# 12: added "spot" (forecaster rung + per-pool rates, risk-objective
+# counters, rebalance pending/limiter/ledger) and caches.pricing gained
+# the per-rung staleness fragment
+# (11: "critical"; 10: "incremental"; 9: "pid" + "serving";
+# 8: "decisions"; 7: "profiling"; 6: "hbm"; 5: "slo")
+SCHEMA_VERSION = 12
 
 # hard caps so a pathological operator can't make statusz unbounded
 MAX_EVENTS = 50
@@ -103,6 +104,7 @@ def _cache_section(op) -> dict:
             "updates": pricing._updates,
             "last_update_age_s": (None if last is None
                                   else round(op.clock.now() - last, 3)),
+            "staleness": pricing.observe_staleness(),
         },
         "launch_templates": {"known": len(cp.launch_templates._known)},
     }
@@ -204,6 +206,23 @@ def _decisions_section() -> dict:
     return explain_snapshot()
 
 
+def _spot_section(op) -> dict:
+    # the spot-storm resilience plane: forecaster rung + per-pool rate
+    # table, risk-objective/rebalance activity counters, and the
+    # rebalance controller's in-flight replace + rate-limiter bank
+    from .. import spot as spot_plane
+
+    out = {"enabled": spot_plane.enabled(),
+           "counters": spot_plane.activity()}
+    forecaster = getattr(op, "spotforecaster", None)
+    if forecaster is not None:
+        out["forecast"] = forecaster.snapshot()
+    rebalance = getattr(op, "spotrebalance", None)
+    if rebalance is not None:
+        out["rebalance"] = rebalance.snapshot()
+    return out
+
+
 def _serving_section(op) -> "dict | None":
     """The ACTUAL bound listener ports (serving.py `ServingPlane.bound`):
     with port-0 ephemeral binds this is the only place the resolved
@@ -243,6 +262,7 @@ def snapshot(op) -> dict:
         "incremental": _fenced(lambda: _incremental_section(op)),
         "profiling": _fenced(_profiling_section),
         "critical": _fenced(_critical_section),
+        "spot": _fenced(lambda: _spot_section(op)),
         "decisions": _fenced(_decisions_section),
         "metrics": _fenced(_metrics_section),
     }

@@ -322,13 +322,20 @@ def encode_problem(
     n_slots: Optional[int] = None,
     grid: Optional[OptionGrid] = None,
     group_cache: "Optional[dict]" = None,
+    option_mask: Optional[np.ndarray] = None,
 ) -> EncodedProblem:
     """`group_cache` (owned by a solver instance whose provisioner set is
     fixed) memoizes encode_group results across solves keyed by (group key,
     grid seqnum, daemon overhead): steady-state controllers re-solve the
     same deployments against an unchanged grid, and the mask folding is the
     dominant per-group cost (the reference memoizes the analogous
-    instance-type construction, instancetypes.go:104-120)."""
+    instance-type construction, instancetypes.go:104-120).
+
+    `option_mask` (bool [T, S], the spot plane's diversity-floor dimension)
+    ANDs into availability for NEW-node admission only — existing-node
+    feasibility is untouched, matching the oracle's barred-option filter.
+    The final cache level is bypassed while a mask is active (masks change
+    within a solve loop); the static folds are still reused."""
     if grid is None or grid.seqnum != catalog.seqnum:
         grid = build_grid(catalog, reuse=grid)
     provs = sorted(provisioners, key=lambda p: (-p.weight, p.name))
@@ -392,12 +399,14 @@ def encode_problem(
             group_cache["seqnum"] = grid.seqnum
             group_cache["entries"] = {}
     ovh_key = tuple(overhead)
+    avail = grid.valid if option_mask is None else (grid.valid & option_mask)
     for gi, g in enumerate(groups):
         entry = None
         ck = None
         if group_cache is not None:
             ck = (g.spec.group_key(), ovh_key)
-            entry = group_cache["entries"].get(ck)
+            if option_mask is None:
+                entry = group_cache["entries"].get(ck)
         if entry is None:
             static = group_cache["static"].get(ck) if ck is not None else None
             if static is None:
@@ -409,8 +418,8 @@ def encode_problem(
                     if len(statics) > 2048:  # bound churny-workload growth
                         statics.clear()
                     statics[ck] = static
-            entry = combine_group(static, grid.valid)
-            if ck is not None:
+            entry = combine_group(static, avail)
+            if ck is not None and option_mask is None:
                 entries = group_cache["entries"]
                 if len(entries) > 2048:
                     entries.clear()
@@ -611,6 +620,7 @@ def diagnose_unschedulable(
     daemon_overhead: Optional[Sequence[int]] = None,
     grid: Optional[OptionGrid] = None,
     kubelet: "Optional[tuple]" = None,
+    option_mask: Optional[np.ndarray] = None,
 ) -> str:
     """WHY a pod cannot schedule, as a human-readable clause for the
     FailedScheduling event — the reference's scheduler errors name the
@@ -631,7 +641,9 @@ def diagnose_unschedulable(
     # groups per cycle pass them in once (indexed by position in `provs`)
     prov_overhead, prov_pods_cap = (
         kubelet if kubelet is not None else kubelet_arrays(provs, catalog))
-    any_tol = any_req = any_fit = any_avail = False
+    any_tol = any_req = any_fit = any_avail = any_divers = False
+    eff_valid = grid.valid if option_mask is None \
+        else (grid.valid & option_mask)
     for pi, prov in enumerate(provs):
         if not tolerates_all(pod.tolerations, prov.taints):
             continue
@@ -657,6 +669,8 @@ def diagnose_unschedulable(
         any_fit = True
         if (m & grid.valid).any():
             any_avail = True
+            if (m & eff_valid).any():
+                any_divers = True
     if not any_tol:
         return "pod does not tolerate the taints of any provisioner"
     if not any_req:
@@ -667,6 +681,9 @@ def diagnose_unschedulable(
     if not any_avail:
         return ("every compatible offering is currently unavailable "
                 "(insufficient capacity)")
+    if not any_divers:
+        return ("every remaining compatible offering is barred by the spot "
+                "diversity floor this cycle")
     # option-level admission passes; the failure is cross-pod (affinity /
     # topology caps / provisioner limits interplay) this cycle
     return ("compatible capacity exists but scheduling constraints "

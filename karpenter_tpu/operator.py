@@ -56,6 +56,7 @@ class Operator:
         "garbagecollection": 60.0,
         "counters": 5.0,
         "interruption": 1.2,
+        "spotrebalance": 2.0,
     }
     # introspection cadence: deadman sweep + flight-recorder snapshot ring
     WATCHDOG_CHECK_INTERVAL = 1.0
@@ -230,6 +231,21 @@ class Operator:
                 self.kube, self.cluster, self.queue, self.cloudprovider.ice,
                 termination=self.termination, clock=self.clock,
                 recorder=self.recorder, watchdog=self.watchdog)
+        # spot-storm resilience plane (spot/): interruption forecasts feed
+        # a risk-aware solve objective (injected into provisioning) and a
+        # proactive rebalance controller. Advisory — strict noop under
+        # KARPENTER_TPU_SPOT=0, and inert at the static forecast baseline.
+        from .spot import RebalanceController, RiskObjective, SpotForecaster
+
+        self.spotforecaster = SpotForecaster(clock=self.clock,
+                                             recorder=self.recorder)
+        self.spotobjective = RiskObjective(self.spotforecaster)
+        self.provisioning.spot_objective = self.spotobjective
+        self.spotrebalance = RebalanceController(
+            self.kube, self.cloudprovider, self.cluster, self.termination,
+            self.provisioning, self.spotforecaster, clock=self.clock,
+            recorder=self.recorder, journal=self.journal,
+            watchdog=self.watchdog)
         # deadman thresholds: generous multiples of each loop's interval so
         # a busy-but-alive controller never flaps (floor 120s = the event
         # dedupe TTL); a controller that misses ~10 turns is genuinely stuck
@@ -463,6 +479,7 @@ class Operator:
         loop("garbagecollection", self.garbagecollection.reconcile_once,
              iv["garbagecollection"])
         loop("counters", self.counters.reconcile_once, iv["counters"])
+        loop("spotrebalance", self._spot_tick, iv["spotrebalance"])
         if self.interruption is not None:
             t2 = threading.Thread(target=self.interruption.run,
                                   args=(self._stop, self.elected),
@@ -525,6 +542,13 @@ class Operator:
 
     # -- synchronous drive (tests / single-shot CLI) ----------------------------
 
+    def _spot_tick(self) -> None:
+        """One spot-plane turn: refresh the interruption forecast, then
+        give the proactive rebalance controller a cycle. Both are strict
+        noops while KARPENTER_TPU_SPOT=0."""
+        self.spotforecaster.refresh()
+        self.spotrebalance.reconcile_once()
+
     def reconcile_all_once(self) -> None:
         """One deterministic pass over every controller (hermetic tests)."""
         self.settingswatch.reconcile_once()
@@ -534,6 +558,7 @@ class Operator:
         self.machinelifecycle.reconcile_once()
         if self.interruption is not None:
             self.interruption.reconcile_once()
+        self._spot_tick()
         self.deprovisioning.reconcile_once()
         self.termination.reconcile_once()
         self.counters.reconcile_once()

@@ -145,11 +145,16 @@ def feasible_options(
     prov: Provisioner,
     options: Sequence[Option],
     daemon_overhead: Sequence[int],
+    barred: "frozenset[int] | set[int]" = frozenset(),
 ) -> "set[int]":
     """Options admitting ONE pod of this spec on a fresh node of `prov`.
 
     Mirrors resolveInstanceTypes' compatible ∧ available ∧ fits filter
-    (cloudprovider.go:302-321)."""
+    (cloudprovider.go:302-321). `barred` option indices (the spot plane's
+    diversity floor) are excluded BEFORE preference relaxation — the
+    kernel folds its option mask into availability ahead of the prefix
+    choice (models/encode.py combine_group), so the scalar walk must too
+    or the two paths pick different preference prefixes."""
     if not tolerates_all(group.tolerations, prov.taints):
         return set()
     try:
@@ -162,6 +167,8 @@ def feasible_options(
     def feasible(r: Requirements) -> "set[int]":
         out: "set[int]" = set()
         for opt in options:
+            if opt.index in barred:
+                continue
             if not r.matches_labels(option_labels(opt, prov)):
                 continue
             alloc = effective_alloc(opt, prov)
@@ -620,14 +627,24 @@ class Scheduler:
         catalog: Catalog,
         provisioners: Sequence[Provisioner],
         daemon_overhead: Optional[Sequence[int]] = None,
+        barred: "Optional[set[tuple[str, str, str]]]" = None,
     ):
         self.catalog = catalog
         self.options = build_options(catalog)
+        # the zone-spread universe is computed BEFORE the barred filter —
+        # parity with the kernel path, where active_zones() folds only
+        # availability, never the spot plane's diversity option mask
         self.zones = sorted({o.zone for o in self.options})
         # weight desc, then name asc (core: higher weight preferred)
         self.provisioners = sorted(provisioners, key=lambda p: (-p.weight, p.name))
         self.daemon_overhead = list(daemon_overhead or [0] * wk.NUM_RESOURCES)
         self._eff_cache: "dict[tuple[str, int], tuple[int, ...]]" = {}
+        # barred (instance type, zone, capacityType) pools — the scalar
+        # analogue of encode_problem's option_mask: removed from NEW-node
+        # admission only (existing-node fits are untouched on both paths)
+        self._barred: "set[int]" = set() if not barred else {
+            o.index for o in self.options
+            if (o.itype.name, o.zone, o.capacity_type) in barred}
 
     def _eff_alloc(self, prov: Provisioner, opt_index: int) -> "tuple[int, ...]":
         key = (prov.name, opt_index)
@@ -730,7 +747,8 @@ class Scheduler:
                     pk = (gi, n.provisioner.name)
                     if pk not in feas_cache:
                         feas_cache[pk] = feasible_options(
-                            g.spec, n.provisioner, self.options, self.daemon_overhead
+                            g.spec, n.provisioner, self.options,
+                            self.daemon_overhead, barred=self._barred
                         )
                     shared = n.options & feas_cache[pk]
                     if not shared:
@@ -756,7 +774,8 @@ class Scheduler:
                     pk2 = (gi, prov.name)
                     if pk2 not in feas_cache:
                         feas_cache[pk2] = feasible_options(
-                            g.spec, prov, self.options, self.daemon_overhead
+                            g.spec, prov, self.options,
+                            self.daemon_overhead, barred=self._barred
                         )
                     if feas_cache[pk2]:
                         kovh = kubelet_overhead_vector(prov.kubelet)

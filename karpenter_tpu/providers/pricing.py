@@ -23,6 +23,7 @@ import urllib.request
 from typing import Optional, Protocol, runtime_checkable
 
 from ..cache import PRICING_REFRESH_PERIOD
+from ..metrics import NAMESPACE, REGISTRY
 from ..utils.clock import Clock
 
 log = logging.getLogger("karpenter.pricing")
@@ -115,10 +116,20 @@ class PricingProvider:
     def __init__(self, cloud: PricingSource, clock: Optional[Clock] = None,
                  isolated: bool = False,
                  static_prices: "Optional[dict[tuple[str, str, str], float]]" = None,
-                 policy=None, ladder=None):
+                 policy=None, ladder=None, registry=None):
         self.cloud = cloud
         self.clock = clock or Clock()
         self.isolated = isolated
+        # how old the price map a consumer would read right now is, split
+        # by the fallback rung serving it — the spot forecaster and the
+        # storm runbook both key off "static AND stale" (a live rung is
+        # allowed to be briefly stale between refresh periods)
+        reg = registry if registry is not None else REGISTRY
+        self._staleness_gauge = reg.gauge(
+            f"{NAMESPACE}_pricing_price_staleness_seconds",
+            "Age of the served price map in seconds, by fallback rung "
+            "(the static rung ages from provider start).", ("rung",))
+        self._created_ts = self.clock.now()
         # live->static promoted to an explicit DegradeLadder: rung 0 = live
         # refreshes, rung 1 = sticky static fallback with recovery probes
         self.ladder = ladder
@@ -180,7 +191,38 @@ class PricingProvider:
             self._updates += 1
         if self.ladder is not None:
             self.ladder.record_success(0)
+        self.observe_staleness()
         return True
+
+    def rung_name(self) -> str:
+        """Which fallback rung the served prices come from: the ladder's
+        verdict when one is wired, else live-after-first-update."""
+        if self.ladder is not None:
+            try:
+                return self.ladder.rung_name()
+            except Exception:
+                pass
+        return "live" if self._updates else "static"
+
+    def staleness_seconds(self) -> float:
+        """Age of the price map a read would serve right now. On the
+        static rung (never updated) this ages from provider start — the
+        embedded table's numbers are as old as the process."""
+        with self._lock:
+            last = self._last_update
+        base = self._created_ts if last is None else last
+        return max(0.0, self.clock.now() - base)
+
+    def observe_staleness(self) -> dict:
+        """Refresh the per-rung staleness gauge; returns the statusz
+        `pricing` fields."""
+        age = self.staleness_seconds()
+        rung = self.rung_name()
+        self._staleness_gauge.set(round(age, 3), rung=rung)
+        with self._lock:
+            updates = self._updates
+        return {"rung": rung, "staleness_seconds": round(age, 3),
+                "updates": updates}
 
     def livez(self) -> bool:
         """Healthy if updates aren't wedged (pricing.go:437-443): either we

@@ -31,8 +31,9 @@ import logging
 from ..metrics import REGISTRY
 from .crashpoints import (CRASHPOINTS, SimulatedCrash, crashpoint,  # noqa: F401
                           install, uninstall)
-from .journal import (JOURNAL_KIND, LAUNCH, RECORD_KINDS, REPLACE,  # noqa: F401
-                      TERMINATION, IntentJournal, IntentRecord)
+from .journal import (JOURNAL_KIND, LAUNCH, REBALANCE,  # noqa: F401
+                      RECORD_KINDS, REPLACE, TERMINATION, IntentJournal,
+                      IntentRecord)
 
 log = logging.getLogger("karpenter.recovery")
 
@@ -128,6 +129,8 @@ class RecoveryManager:
                     outcome = self._replay_termination(rec)
                 elif rec.kind == REPLACE:
                     outcome = self._replay_replace(rec)
+                elif rec.kind == REBALANCE:
+                    outcome = self._replay_rebalance(rec)
                 else:
                     journal.resolve(rec.kind, rec.key, outcome="unknown_kind")
                     outcome = "unknown_kind"
@@ -224,6 +227,27 @@ class RecoveryManager:
             op.termination.request_deletion(rep_name)
             outcome = "rolled_back"
         self.journal.resolve(REPLACE, rec.key, outcome=outcome)
+        return outcome
+
+    def _replay_rebalance(self, rec: IntentRecord) -> str:
+        """Proactive spot rebalance stranded mid-flight (spot/rebalance.py
+        two-phase). The drain only ever fires AFTER the replacement
+        initializes, so the stranded states mirror replace: workload
+        already on the replacement keeps it (roll forward), an empty
+        replacement is reaped (roll back), a never-launched one is just
+        resolved. The original at-risk node was never touched — reactive
+        interruption handling still covers it either way."""
+        op = self.op
+        rep_name = rec.payload.get("replacement")
+        rep = op.cluster.nodes.get(rep_name) if rep_name else None
+        if rep is None:
+            outcome = "already_done" if rep_name else "aborted"
+        elif rep.non_daemon_pods():
+            outcome = "rolled_forward"
+        else:
+            op.termination.request_deletion(rep_name)
+            outcome = "rolled_back"
+        self.journal.resolve(REBALANCE, rec.key, outcome=outcome)
         return outcome
 
     # -- introspection ---------------------------------------------------------

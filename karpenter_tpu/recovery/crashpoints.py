@@ -41,6 +41,9 @@ CRASHPOINTS: "tuple[str, ...]" = (
     # interruption message handled and recorded, but not yet acked —
     # redelivery lands on the reborn consumer
     "interruption.pre_ack",
+    # proactive spot rebalance: replacement launched and journaled, the
+    # at-risk node not yet drained (spot/rebalance.py two-phase)
+    "spot.mid_rebalance",
 )
 
 

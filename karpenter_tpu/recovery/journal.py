@@ -29,7 +29,8 @@ JOURNAL_KIND = "intents"
 LAUNCH = "launch"            # fleet launch in flight (machine name keys it)
 TERMINATION = "termination"  # node marked for deletion, teardown in flight
 REPLACE = "replace"          # consolidation replace action in flight
-RECORD_KINDS = (LAUNCH, TERMINATION, REPLACE)
+REBALANCE = "rebalance"      # proactive spot rebalance in flight
+RECORD_KINDS = (LAUNCH, TERMINATION, REPLACE, REBALANCE)
 
 RECORDS_TOTAL = REGISTRY.counter(
     "karpenter_recovery_journal_records_total",

@@ -55,8 +55,9 @@ _READBACK = _os.environ.get("KARPENTER_TPU_READBACK", "get")
 # The admission rule's mask factorization, in first-rejection order: the
 # encoder ANDs exactly these constraint dimensions into group_feas
 # (tolerations -> requirement fold -> fresh-node resource fit -> offering
-# availability), and whatever survives option admission can only be
-# zeroed by cross-pod constraints inside the kernel. The explain plane's
+# availability -> the spot plane's optional diversity-floor option mask),
+# and whatever survives option admission can only be zeroed by cross-pod
+# constraints inside the kernel. The explain plane's
 # reason vocabulary (explain/reasons.py DIMENSIONS, one scalar-oracle
 # clause per entry) must stay in lockstep — hack/check_decision_reasons.py
 # AST-lints both literals.
@@ -65,6 +66,7 @@ MASK_DIMENSIONS = (
     "requirements",
     "resources",
     "availability",
+    "diversity",
     "constraints",
 )
 
@@ -206,12 +208,18 @@ class TPUSolver:
         existing: Sequence[ExistingNode] = (),
         daemon_overhead: Optional[Sequence[int]] = None,
         n_slots: Optional[int] = None,
+        option_mask=None,
     ) -> SolveResult:
         """Two-round driver (shared semantics with the oracle's schedule):
         groups whose required pod-(anti-)affinity terms target CO-PENDING
         groups are deferred; round 1's solved claims join `existing` as
         pseudo nodes carrying their pods as residents, so round 2 resolves
-        the terms through the resident-based affinity machinery."""
+        the terms through the resident-based affinity machinery.
+
+        `option_mask` (bool [T, S] or None) is the spot plane's
+        diversity-floor dimension: it ANDs into new-node admission on both
+        rounds (models/encode.py encode_problem), matching the oracle
+        Scheduler's `barred` pool filter bit-for-bit."""
         import time as _time
 
         from ..oracle.scheduler import split_deferred_pods
@@ -230,9 +238,9 @@ class TPUSolver:
                             lane="encode")
             if not deferred:
                 return self._solve_once(pods, existing, daemon_overhead,
-                                        n_slots)
+                                        n_slots, option_mask=option_mask)
             res = self._solve_once(primary, existing, daemon_overhead,
-                                   n_slots)
+                                   n_slots, option_mask=option_mask)
             # Round 2 must see round 1's consumption of the REAL existing
             # nodes (the oracle mutates its views in place; this path
             # re-encodes, so carry used + origin-keyed in-run counts on
@@ -243,7 +251,8 @@ class TPUSolver:
             GAP_LEDGER.note("encode", _time.perf_counter() - _t1,
                             lane="encode")
             res2 = self._solve_once(deferred, carried + pseudo,
-                                    daemon_overhead, n_slots)
+                                    daemon_overhead, n_slots,
+                                    option_mask=option_mask)
             _t2 = _time.perf_counter()
             merged = _merge_rounds(res, res2, {p.name: i for i, p in
                                                enumerate(pseudo)})
@@ -520,6 +529,7 @@ class TPUSolver:
         existing: Sequence[ExistingNode] = (),
         daemon_overhead: Optional[Sequence[int]] = None,
         n_slots: Optional[int] = None,
+        option_mask=None,
     ) -> SolveResult:
         # one code path, timed always (perf_counter is ns against a multi-ms
         # solve); .last_timings is only published under the capture tool's
@@ -534,7 +544,7 @@ class TPUSolver:
         enc = encode_problem(
             self.catalog, self.provisioners, pods, existing,
             daemon_overhead, n_slots, grid=self.grid(),
-            group_cache=self._group_cache,
+            group_cache=self._group_cache, option_mask=option_mask,
         )
         t1 = _time.perf_counter()
         G = enc.group_vec.shape[0]
@@ -738,13 +748,14 @@ class NativeSolver(TPUSolver):
         existing: Sequence[ExistingNode] = (),
         daemon_overhead: Optional[Sequence[int]] = None,
         n_slots: Optional[int] = None,
+        option_mask=None,
     ) -> SolveResult:
         from ..native import native_pack
 
         enc = encode_problem(
             self.catalog, self.provisioners, pods, existing,
             daemon_overhead, n_slots, grid=self.grid(),
-            group_cache=self._group_cache,
+            group_cache=self._group_cache, option_mask=option_mask,
         )
         inputs = PackInputs(
             alloc_t=enc.alloc_t, tiebreak=enc.tiebreak,

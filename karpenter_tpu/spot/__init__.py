@@ -1,0 +1,58 @@
+"""Spot-storm resilience plane: forecast, risk-aware solve, proactive drain.
+
+Three cooperating pieces (ISSUE 19):
+
+* :class:`SpotForecaster` (forecaster.py) — per-(instance-type, zone,
+  capacity-type) price and interruption-rate estimates behind a
+  live → ledger → static DegradeLadder (the pricing degrade chain's
+  shape), exposed as ``karpenter_spot_*`` gauges and a statusz section.
+* :class:`RiskObjective` (objective.py) — the solve's price vector
+  becomes price × interruption penalty, plus an iterative diversity
+  floor encoded through the dense-mask "diversity" dimension (kernel
+  ``option_mask`` / oracle ``barred``, bit-parity audited). Real sticker
+  prices are restored before any result reaches apply.
+* :class:`RebalanceController` (rebalance.py) — drains at-risk nodes
+  ahead of predicted reclaims through the two-phase replace shape,
+  journaled via the recovery plane, rate-limited so churn never exceeds
+  the interruption mass it avoids.
+
+Strict-noop contract: with ``KARPENTER_TPU_SPOT=0`` nothing here runs
+and no counter in :func:`activity` moves (chaos invariant
+``spot-strict-noop``); solve decisions are bit-identical to a build
+without the plane.
+"""
+from __future__ import annotations
+
+from .forecaster import (FORECAST_RUNGS, RATE_CAP, REBALANCE_RATE_THRESHOLD,
+                         RISK_WEIGHT, STATIC_RATES, SpotForecaster)
+from .objective import (DEFAULT_DIVERSITY_FLOOR, DIVERSITY_FLOOR_ENV,
+                        RiskObjective, diversity_floor, diversity_report,
+                        diversity_violations, pool_mask, restore_real_prices,
+                        risk_adjusted_catalog, spread_transform)
+from .rebalance import RebalanceController, RebalanceRateLimiter
+from .state import FLAG_ENV, disabled, enabled, set_enabled
+
+from . import forecaster as _forecaster_mod
+from . import objective as _objective_mod
+from . import rebalance as _rebalance_mod
+
+__all__ = [
+    "DEFAULT_DIVERSITY_FLOOR", "DIVERSITY_FLOOR_ENV", "FLAG_ENV",
+    "FORECAST_RUNGS", "RATE_CAP", "REBALANCE_RATE_THRESHOLD", "RISK_WEIGHT",
+    "RebalanceController", "RebalanceRateLimiter", "RiskObjective",
+    "STATIC_RATES", "SpotForecaster", "activity", "disabled",
+    "diversity_floor", "diversity_report", "diversity_violations", "enabled",
+    "pool_mask", "restore_real_prices", "risk_adjusted_catalog",
+    "set_enabled", "spread_transform",
+]
+
+
+def activity() -> "dict[str, int]":
+    """Flat monotone counters for the chaos strict-noop diff: every number
+    here must stay frozen while the plane is disabled (forecaster refreshes,
+    objective solves, rebalance actions)."""
+    out: "dict[str, int]" = {}
+    out.update(_forecaster_mod.counters())
+    out.update(_objective_mod.counters())
+    out.update(_rebalance_mod.counters())
+    return out

@@ -1,0 +1,263 @@
+"""Spot interruption forecaster: per-pool reclaim-rate estimates.
+
+Extends the pricing provider's view of an offering — (instance type,
+zone, capacity type) → $/h — with an *interruption-rate* estimate for the
+same key, fed by a live→ledger→static fallback ladder of the exact shape
+the pricing live→static chain already uses (resilience.DegradeLadder,
+sticky with single-step recovery probes):
+
+  rung 0  live    an injected feed (the cloud's rebalance-recommendation /
+                  spot-advisor analogue; the storm drill injects its
+                  schedule here, including the adversarial wrong one)
+  rung 1  ledger  rates derived deterministically from the committed perf
+                  ledger corpus (benchmarks/results/ledger.jsonl) — same
+                  seed + same ledger bytes → bit-identical forecasts
+  rung 2  static  the embedded per-capacity-type table, always available
+
+The forecast is advisory-never-load-bearing: with the plane disabled
+(``KARPENTER_TPU_SPOT=0``, spot.state) every rate is 0.0, every penalty
+is exactly 1.0, and no counter or gauge moves — the chaos
+``spot-strict-noop`` invariant audits that. On-demand capacity is never
+forecast to be reclaimed (rate pinned 0.0), so the risk-adjusted price of
+an on-demand offering equals its real price bit-for-bit.
+
+The penalty the risk-aware objective multiplies into the price vector:
+
+    penalty = 1.0 + RISK_WEIGHT * min(rate, RATE_CAP)
+
+i.e. a pool forecast at the 5 %/cycle static baseline costs 10 % extra in
+the objective; a pool in a predicted storm (rate ≥ RATE_CAP) costs at
+most 1 + RISK_WEIGHT times its sticker price. Bounded and monotone so the
+oracle tie-break order stays total.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pathlib
+import threading
+from typing import Callable, Optional
+
+from ..metrics import NAMESPACE, REGISTRY
+from ..resilience.degrade import DegradeLadder
+from ..utils.clock import Clock
+from . import state
+
+log = logging.getLogger("karpenter.spot")
+
+FORECAST_RUNGS = ("live", "ledger", "static")
+
+# objective shaping knobs (docs/spot.md documents all three)
+RISK_WEIGHT = float(os.environ.get("KARPENTER_TPU_SPOT_RISK_WEIGHT", "2.0"))
+RATE_CAP = 0.5
+# embedded static baseline: interruption probability per reconcile cycle
+STATIC_RATES = {"spot": 0.05, "on-demand": 0.0}
+# the rebalance controller only acts on pools forecast ABOVE this — the
+# static baseline sits below it, so a forecaster running on the static
+# rung never triggers proactive churn
+REBALANCE_RATE_THRESHOLD = 0.15
+
+_DEFAULT_LEDGER = (pathlib.Path(__file__).resolve().parent.parent.parent
+                   / "benchmarks" / "results" / "ledger.jsonl")
+
+_counters_lock = threading.Lock()
+_COUNTERS = {
+    "spot_forecast_refreshes": 0,
+    "spot_forecasts_computed": 0,
+    "spot_forecast_ladder_fallbacks": 0,
+    "spot_forecast_rung_warnings": 0,
+}
+
+
+def _count(key: str, n: int = 1) -> None:
+    with _counters_lock:
+        _COUNTERS[key] += n
+
+
+def counters() -> "dict[str, int]":
+    with _counters_lock:
+        return dict(_COUNTERS)
+
+
+def _stable_u01(*parts) -> float:
+    """Deterministic [0,1) from a sha256 of the parts — hash() is salted
+    per-process (PYTHONHASHSEED) and would break the same-seed+same-ledger
+    → identical-forecasts property."""
+    h = hashlib.sha256("\x1f".join(str(p) for p in parts).encode())
+    return int.from_bytes(h.digest()[:8], "big") / 2**64
+
+
+class SpotForecaster:
+    """Per-(instance type, zone, capacity type) interruption-rate feed.
+
+    ``live_source`` is an optional callable returning
+    ``dict[(itype, zone, ct)] -> rate`` (the drill injects schedules
+    here); returning ``None``/raising fails the live rung and the ladder
+    falls to the ledger corpus, then to the static table.
+    """
+
+    def __init__(self, clock: "Optional[Clock]" = None, recorder=None,
+                 registry=None, seed: int = 0,
+                 ledger_path: "Optional[str]" = None,
+                 live_source: "Optional[Callable[[], Optional[dict]]]" = None):
+        self.clock = clock or Clock()
+        self.seed = int(seed)
+        self.live_source = live_source
+        self._recorder = recorder
+        self._registry = registry
+        self.ledger_path = pathlib.Path(
+            ledger_path or os.environ.get("KARPENTER_TPU_LEDGER",
+                                          str(_DEFAULT_LEDGER)))
+        self.ladder = DegradeLadder(
+            "spot.forecast", FORECAST_RUNGS, clock=self.clock,
+            recorder=recorder, registry=registry)
+        reg = registry if registry is not None else REGISTRY
+        self._rate_gauge = reg.gauge(
+            f"{NAMESPACE}_spot_interruption_rate",
+            "Forecast interruption probability per cycle, per spot pool.",
+            ("instance_type", "zone"))
+        self._rung_gauge = reg.gauge(
+            f"{NAMESPACE}_spot_forecast_rung",
+            "Fallback-ladder rung the current forecast came from "
+            "(0=live 1=ledger 2=static).")
+        self._lock = threading.Lock()
+        self._rates: "dict[tuple[str, str, str], float]" = {}
+        self._rung: "Optional[int]" = None
+        self._last_refresh: "Optional[float]" = None
+
+    # -- the fallback ladder -----------------------------------------------------
+
+    def set_live_source(self, source: "Optional[Callable]") -> None:
+        """Swap the live feed and re-arm the ladder at the live rung. The
+        ladder's single-step recovery probes exist to keep a *flapping*
+        dependency from yanking the chain around; a *replaced* feed (config
+        reload, drill injection) carries no such history, so the next
+        refresh() tries it immediately."""
+        self.live_source = source
+        self.ladder = DegradeLadder(
+            "spot.forecast", FORECAST_RUNGS, clock=self.clock,
+            recorder=self._recorder, registry=self._registry)
+
+    def refresh(self) -> "Optional[int]":
+        """One forecast refresh down the ladder; returns the rung that
+        served it (None while the plane is disabled — strict noop)."""
+        if not state.enabled():
+            return None
+        start = self.ladder.start_rung()
+        for rung in range(start, len(FORECAST_RUNGS)):
+            try:
+                rates = self._source(rung)
+            except Exception as e:  # noqa: BLE001 — fall down the ladder
+                log.warning("spot forecast rung %s failed: %s",
+                            FORECAST_RUNGS[rung], e)
+                rates = None
+            if rates is None:
+                self.ladder.record_failure(rung)
+                _count("spot_forecast_ladder_fallbacks")
+                continue
+            self.ladder.record_success(rung)
+            with self._lock:
+                prev_rung = self._rung
+                self._rates = dict(rates)
+                self._rung = rung
+                self._last_refresh = self.clock.now()
+            _count("spot_forecast_refreshes")
+            self._rung_gauge.set(rung)
+            for (itype, zone, ct), r in rates.items():
+                if ct == "spot":
+                    self._rate_gauge.set(round(r, 6), instance_type=itype,
+                                         zone=zone)
+            if rung > 0 and rung != prev_rung:
+                # satellite contract: a forecaster entering a degraded rung
+                # says so out loud — once per transition, not per refresh
+                # (the runbook greps for this line)
+                log.warning(
+                    "spot forecaster running on %s rung (live feed "
+                    "unavailable); rates are %s estimates",
+                    FORECAST_RUNGS[rung],
+                    "ledger-derived" if rung == 1 else "static baseline")
+                _count("spot_forecast_rung_warnings")
+            return rung
+        return None  # unreachable: the static rung never fails
+
+    def _source(self, rung: int) -> "Optional[dict]":
+        if rung == 0:
+            return self.live_source() if self.live_source is not None else None
+        if rung == 1:
+            return self._ledger_rates()
+        return {}  # static: rate() falls back to STATIC_RATES per lookup
+
+    def _ledger_rates(self) -> "Optional[dict]":
+        """Deterministic fleet-wide spot rate from the committed ledger
+        corpus: the static baseline modulated by a stable jitter keyed on
+        (seed, sha256 of the ledger bytes). The corpus carries no per-pool
+        signal, so the rung publishes one wildcard rate — still strictly
+        better than the static table because it moves with the committed
+        evidence, and same seed + same ledger → bit-identical forecasts
+        (tests/test_spot.py property test)."""
+        try:
+            raw = self.ledger_path.read_bytes()
+        except OSError:
+            return None
+        if not any(ln.strip() for ln in raw.splitlines()):
+            return None
+        digest = hashlib.sha256(raw).hexdigest()
+        jitter = _stable_u01(self.seed, digest)
+        return {("*", "*", "spot"): round(
+            STATIC_RATES["spot"] * (0.5 + jitter), 6)}
+
+    # -- the advisory surface ----------------------------------------------------
+
+    def rate(self, instance_type: str, zone: str, capacity_type: str) -> float:
+        """Forecast interruption probability per cycle for one offering.
+        0.0 for on-demand always; 0.0 for everything while disabled."""
+        if not state.enabled():
+            return 0.0
+        if capacity_type != "spot":
+            return 0.0
+        _count("spot_forecasts_computed")
+        with self._lock:
+            r = self._rates.get((instance_type, zone, capacity_type))
+            if r is None:  # ledger rung publishes one fleet-wide rate
+                r = self._rates.get(("*", "*", capacity_type))
+        if r is None:
+            r = STATIC_RATES.get(capacity_type, 0.0)
+        return min(max(r, 0.0), 1.0)
+
+    def penalty(self, instance_type: str, zone: str,
+                capacity_type: str) -> float:
+        """The multiplicative risk term the objective applies to price.
+        Exactly 1.0 for on-demand and whenever the plane is disabled."""
+        if not state.enabled():
+            return 1.0
+        r = self.rate(instance_type, zone, capacity_type)
+        if r <= 0.0:
+            return 1.0
+        return 1.0 + RISK_WEIGHT * min(r, RATE_CAP)
+
+    # -- observability -----------------------------------------------------------
+
+    def rung(self) -> "Optional[int]":
+        with self._lock:
+            return self._rung
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            rates = dict(self._rates)
+            rung = self._rung
+            last = self._last_refresh
+        return {
+            "enabled": state.enabled(),
+            "rung": None if rung is None else FORECAST_RUNGS[rung],
+            "risk_weight": RISK_WEIGHT,
+            "rate_cap": RATE_CAP,
+            "rebalance_rate_threshold": REBALANCE_RATE_THRESHOLD,
+            "pools": len(rates),
+            "max_rate": (round(max(rates.values()), 6) if rates else None),
+            "last_refresh_age_s": (None if last is None
+                                   else round(self.clock.now() - last, 3)),
+            "ladder": self.ladder.snapshot(),
+            "counters": counters(),
+        }

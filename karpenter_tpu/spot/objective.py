@@ -1,0 +1,359 @@
+"""Risk-aware solve objective: price × interruption penalty + diversity floor.
+
+Two mechanisms, both advisory and strict-noop under ``KARPENTER_TPU_SPOT=0``:
+
+* **Risk-adjusted prices** — the solve's price vector becomes
+  ``price × forecaster.penalty(pool)`` via a cloned catalog whose spot
+  offering prices carry the penalty (on-demand penalties are exactly 1.0,
+  so those prices are bit-identical). Both solver backends and the scalar
+  oracle consume catalogs, so kernel/oracle parity on the adjusted
+  objective follows from the existing parity machinery with zero new
+  device code. After the solve, :func:`restore_real_prices` maps every
+  decision back onto the REAL catalog's options — node records, the price
+  column, and the consolidation cost invariant only ever see sticker
+  prices (check_consolidation_cost compares real catalog floats).
+
+* **Diversity floor** — no more than ``DIVERSITY_FLOOR`` of a workload's
+  newly-placed capacity may land on one spot pool. Enforced in two
+  phases. Phase 1 *splits*: the violating workloads get a soft zone
+  topology-spread injected (``ScheduleAnyway``), so the shared
+  ``prepare_groups`` pre-pass water-fills the group across zones on BOTH
+  solver paths — the only mechanism that can break up a single pod group,
+  since a whole-solve re-run moves a group in one piece. Phase 2 *bars*
+  residual over-concentrated pools through the extra dense-mask dimension
+  (encode_problem ``option_mask`` on the kernel path, Scheduler
+  ``barred`` on the oracle path — bit-parity enforced by the "diversity"
+  MASK_DIMENSIONS entry + clause) and re-solves, bounded by the spot-pool
+  count. Both phases are guarded, in precedence order
+  never-strands > cost-never-raised > diversity: an attempt that raises
+  the unschedulable count above the baseline, or raises the total
+  STICKER cost of the placement (real catalog prices, not risk-adjusted
+  ones), is rolled back and the concentration accepted — recorded in the
+  DecisionRecord either way.
+
+The objective only activates when the forecaster sees ELEVATED risk
+(max forecast rate ≥ forecaster.REBALANCE_RATE_THRESHOLD). At the static
+baseline every solve is bit-identical to a build without this module —
+the advisory plane stays out of the steady-state hot path, and the chaos
+``spot-strict-noop`` two-window evidence holds trivially outside storms.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..apis import wellknown as wk
+from ..models.instancetype import Catalog, InstanceType, Offerings
+from ..models.pod import TopologySpreadConstraint
+from . import state
+from .forecaster import REBALANCE_RATE_THRESHOLD, SpotForecaster
+
+log = logging.getLogger("karpenter.spot")
+
+# max fraction of one workload's newly-placed pods on a single spot pool
+DIVERSITY_FLOOR_ENV = "KARPENTER_TPU_SPOT_DIVERSITY_FLOOR"
+DEFAULT_DIVERSITY_FLOOR = 0.5
+
+_counters_lock = threading.Lock()
+_COUNTERS = {
+    "spot_objective_solves": 0,
+    "spot_objective_resolves": 0,
+    "spot_workloads_spread": 0,
+    "spot_spreads_rolled_back": 0,
+    "spot_pools_barred": 0,
+    "spot_bars_rolled_back": 0,
+    "spot_assignments_cited": 0,
+}
+
+
+def _count(key: str, n: int = 1) -> None:
+    with _counters_lock:
+        _COUNTERS[key] += n
+
+
+def counters() -> "dict[str, int]":
+    with _counters_lock:
+        return dict(_COUNTERS)
+
+
+def diversity_floor() -> float:
+    try:
+        f = float(os.environ.get(DIVERSITY_FLOOR_ENV,
+                                 DEFAULT_DIVERSITY_FLOOR))
+    except ValueError:
+        return DEFAULT_DIVERSITY_FLOOR
+    return min(max(f, 0.0), 1.0)
+
+
+def risk_adjusted_catalog(catalog: Catalog,
+                          forecaster: SpotForecaster) -> Catalog:
+    """Clone with spot offering prices × penalty (same types, same zones,
+    same offering lattice — the grid layout differs only in price floats,
+    on-demand rows bit-identical because their penalty is exactly 1.0)."""
+    types = []
+    for t in catalog.types:
+        offerings = Offerings(
+            dataclasses.replace(
+                o, price=o.price * forecaster.penalty(
+                    t.name, o.zone, o.capacity_type))
+            for o in t.offerings)
+        types.append(dataclasses.replace(t, offerings=offerings))
+    return Catalog(types=types, seqnum=catalog.seqnum)
+
+
+def pool_mask(catalog: Catalog,
+              barred: "set[tuple[str, str, str]]") -> np.ndarray:
+    """bool [T, S] option mask with the barred (type, zone, capacityType)
+    pools False — same axis derivation as models/encode.py build_grid
+    (types in catalog order; S = sorted-zone × wk.CAPACITY_TYPES)."""
+    zones = sorted({o.zone for t in catalog.types for o in t.offerings})
+    cts = list(wk.CAPACITY_TYPES)
+    zi_of = {z: i for i, z in enumerate(zones)}
+    ci_of = {c: i for i, c in enumerate(cts)}
+    mask = np.ones((len(catalog.types), len(zones) * len(cts)), dtype=bool)
+    for ti, t in enumerate(catalog.types):
+        for name, zone, ct in barred:
+            if name != t.name:
+                continue
+            zi, ci = zi_of.get(zone), ci_of.get(ct)
+            if zi is not None and ci is not None:
+                mask[ti, zi * len(cts) + ci] = False
+    return mask
+
+
+def diversity_report(result, floor: float
+                     ) -> "dict[object, set[tuple[str, str, str]]]":
+    """Per-workload over-concentration: origin key -> the spot pools
+    holding more than `floor` of that workload's newly placed pods
+    (workload = pod-group origin key, the same identity the per-node
+    topology caps budget on). A pool is always allowed one pod per
+    workload — a 1-pod workload is 100 % concentrated by definition and
+    barring would just flap."""
+    per_wl: "dict[object, dict[tuple[str, str, str], int]]" = {}
+    totals: "dict[object, int]" = {}
+    for n in result.nodes:
+        pool = (n.option.itype.name, n.option.zone, n.option.capacity_type)
+        for g_idx, cnt in n.pod_counts.items():
+            okey = result.groups[g_idx].spec.origin_key()
+            totals[okey] = totals.get(okey, 0) + cnt
+            if n.option.capacity_type == wk.CAPACITY_TYPE_SPOT:
+                pools = per_wl.setdefault(okey, {})
+                pools[pool] = pools.get(pool, 0) + cnt
+    report: "dict[object, set[tuple[str, str, str]]]" = {}
+    for okey, pools in per_wl.items():
+        tot = totals.get(okey, 0)
+        bad = {pool for pool, c in pools.items()
+               if c > max(floor * tot, 1.0) + 1e-9}
+        if bad:
+            report[okey] = bad
+    return report
+
+
+def diversity_violations(result, floor: float) -> "set[tuple[str, str, str]]":
+    """The union of over-concentrated spot pools across all workloads."""
+    viol: "set[tuple[str, str, str]]" = set()
+    for pools in diversity_report(result, floor).values():
+        viol |= pools
+    return viol
+
+
+def _sticker_prices(catalog: Catalog) -> "dict[tuple[str, str, str], float]":
+    return {(t.name, o.zone, o.capacity_type): o.price
+            for t in catalog.types for o in t.offerings}
+
+
+def _sticker_cost(result, prices: "dict[tuple[str, str, str], float]") -> float:
+    """Total REAL hourly cost of a placement — what the diversity guards
+    compare. The risk-adjusted prices shape the choice; the invariant the
+    storm drill audits (cost-never-raised) is on sticker dollars."""
+    total = 0.0
+    for n in result.nodes:
+        total += prices.get(
+            (n.option.itype.name, n.option.zone, n.option.capacity_type),
+            n.option.price)
+    return total
+
+
+def spread_transform(keys: "set") -> "Callable[[list], list]":
+    """Pod transform injecting a SOFT zone topology-spread on every pod
+    whose workload over-concentrated: the shared prepare_groups pre-pass
+    (oracle/scheduler.py split_zone_spread, verbatim on the kernel encode
+    path) then water-fills the group across zones — the only lever that
+    can split a single pod group, since whole-solve re-runs move a group
+    in one piece. ScheduleAnyway, so relaxation drops the pin rather than
+    strand a pod a zone can't host. Pods that already carry a zone
+    topology constraint are left alone (the user's spread wins)."""
+    spread = TopologySpreadConstraint(
+        max_skew=1, topology_key=wk.LABEL_ZONE,
+        when_unsatisfiable="ScheduleAnyway")
+
+    def xform(pods):
+        out = []
+        for p in pods:
+            if p.origin_key() in keys and not any(
+                    c.topology_key == wk.LABEL_ZONE for c in p.topology):
+                p = dataclasses.replace(p, topology=p.topology + (spread,))
+            out.append(p)
+        return out
+    return xform
+
+
+def restore_real_prices(result, catalog: Catalog) -> None:
+    """Map every solved node's option back onto the REAL catalog (in
+    place): the risk penalty shapes the CHOICE, never the recorded price —
+    node records, the cluster price column, and the consolidation cost
+    invariant all compare sticker prices."""
+    for i, n in enumerate(result.nodes):
+        real_t = catalog.by_name.get(n.option.itype.name)
+        if real_t is None:
+            continue
+        price = None
+        for o in real_t.offerings:
+            if o.zone == n.option.zone and \
+                    o.capacity_type == n.option.capacity_type:
+                price = o.price
+                break
+        if price is None:
+            continue
+        result.nodes[i] = dataclasses.replace(
+            n, option=dataclasses.replace(
+                n.option, itype=real_t, price=price))
+
+
+class RiskObjective:
+    """The risk-aware solve driver provisioning calls when the forecaster
+    sees elevated risk. ``solve_fn(catalog, option_mask, barred,
+    pod_transform)`` runs one routed solve — the kernel backends consume
+    the mask, the oracle fallback the barred pool set (both encode the
+    same dimension), and ``pod_transform`` (or None) rewrites the pending
+    pod list before grouping (the spread-injection phase rides on it)."""
+
+    def __init__(self, forecaster: SpotForecaster,
+                 floor: "Optional[float]" = None):
+        self.forecaster = forecaster
+        self.floor = diversity_floor() if floor is None else floor
+        self._memo: "Optional[tuple]" = None
+
+    def active(self) -> bool:
+        if not state.enabled():
+            return False
+        snap = self.forecaster.snapshot()
+        mx = snap.get("max_rate")
+        return mx is not None and mx >= REBALANCE_RATE_THRESHOLD
+
+    def adjusted(self, catalog: Catalog) -> Catalog:
+        key = (id(catalog), catalog.seqnum,
+               tuple(sorted(self.forecaster._rates.items())))
+        if self._memo is not None and self._memo[0] == key:
+            return self._memo[1]
+        adj = risk_adjusted_catalog(catalog, self.forecaster)
+        self._memo = (key, adj)
+        return adj
+
+    def solve(self, catalog: Catalog,
+              solve_fn: "Callable[..., object]") -> "tuple[object, dict]":
+        """Risk-adjusted solve + two-phase diversity-floor enforcement
+        (spread-split, then cost-guarded pool bars). Returns (result with
+        REAL prices restored, info dict for the DecisionRecord/evidence)."""
+        adj = self.adjusted(catalog)
+        _count("spot_objective_solves")
+        prices = _sticker_prices(catalog)
+        result = solve_fn(adj, None, None, None)
+        baseline_unsched = result.unschedulable_count()
+        base_cost = _sticker_cost(result, prices)
+        barred: "set[tuple[str, str, str]]" = set()
+        accepted_viol: "set[tuple[str, str, str]]" = set()
+        spread_names: "list[str]" = []
+        xform = None
+        # phase 1 — split: a whole-solve re-run cannot break up one pod
+        # group (FFD moves it in a piece), so inject a soft zone spread on
+        # the over-concentrated workloads and let the shared pre-pass
+        # water-fill them across zones on both solver paths
+        report = diversity_report(result, self.floor)
+        if report:
+            keys = set(report)
+            names = sorted({g.spec.name for g in result.groups
+                            if g.spec.origin_key() in keys})
+            cand_xform = spread_transform(keys)
+            attempt = solve_fn(adj, None, None, cand_xform)
+            _count("spot_objective_resolves")
+            if attempt.unschedulable_count() <= baseline_unsched and \
+                    _sticker_cost(attempt, prices) <= base_cost + 1e-9:
+                result = attempt
+                xform = cand_xform
+                spread_names = names
+                _count("spot_workloads_spread", len(keys))
+            else:
+                # spreading stranded a pod or cost sticker dollars (zones
+                # price spot differently) — fall through to the bar loop
+                _count("spot_spreads_rolled_back", len(keys))
+        # phase 2 — bar: each round bars at least one new spot pool, so
+        # the loop is bounded by the (finite) spot-pool universe
+        n_spot_pools = sum(1 for t in adj.types for o in t.offerings
+                           if o.capacity_type == wk.CAPACITY_TYPE_SPOT)
+        for _ in range(n_spot_pools):
+            viol = diversity_violations(result, self.floor) \
+                - barred - accepted_viol
+            if not viol:
+                break
+            candidate = barred | viol
+            mask = pool_mask(adj, candidate)
+            attempt = solve_fn(adj, mask, candidate, xform)
+            _count("spot_objective_resolves")
+            if attempt.unschedulable_count() > baseline_unsched or \
+                    _sticker_cost(attempt, prices) > base_cost + 1e-9:
+                # the floor would strand pods or raise real cost — roll
+                # the bar back and accept the concentration
+                # (never-strands > cost-never-raised > diversity)
+                accepted_viol |= viol
+                _count("spot_bars_rolled_back", len(viol))
+                continue
+            barred = candidate
+            result = attempt
+            _count("spot_pools_barred", len(viol))
+        info = self._cite(result, barred, accepted_viol, spread_names)
+        restore_real_prices(result, catalog)
+        return result, info
+
+    def _cite(self, result, barred, accepted_viol, spread_names) -> dict:
+        """DecisionRecord citing the risk term for every spot-influenced
+        assignment (ISSUE 19 tentpole contract)."""
+        from ..explain import DECISIONS
+
+        rung = self.forecaster.rung()
+        cites = []
+        for n in result.nodes:
+            if n.option.capacity_type != wk.CAPACITY_TYPE_SPOT:
+                continue
+            rate = self.forecaster.rate(n.option.itype.name, n.option.zone,
+                                        n.option.capacity_type)
+            cites.append({
+                "pool": [n.option.itype.name, n.option.zone, "spot"],
+                "pods": n.pod_count,
+                "rate": round(rate, 6),
+                "penalty": round(self.forecaster.penalty(
+                    n.option.itype.name, n.option.zone,
+                    n.option.capacity_type), 6),
+            })
+        _count("spot_assignments_cited", len(cites))
+        info = {
+            "risk_weight": __import__(
+                "karpenter_tpu.spot.forecaster",
+                fromlist=["RISK_WEIGHT"]).RISK_WEIGHT,
+            "forecast_rung": rung,
+            "diversity_floor": self.floor,
+            "workloads_spread": spread_names,
+            "barred_pools": sorted(list(p) for p in barred),
+            "accepted_concentrations": sorted(
+                list(p) for p in accepted_viol),
+            "spot_assignments": cites[:50],
+            "spot_assignments_total": len(cites),
+        }
+        DECISIONS.emit("spot-objective", info)
+        return info

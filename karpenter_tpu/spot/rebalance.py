@@ -1,0 +1,361 @@
+"""Proactive spot rebalance: drain ahead of the reclaim, not after it.
+
+When the forecaster predicts elevated interruption risk for a spot pool
+(rate ≥ ``REBALANCE_RATE_THRESHOLD``), this controller moves capacity off
+the at-risk nodes BEFORE the platform reclaims them, through the same
+two-phase shape as consolidation's replace path (deprovisioning.py):
+launch the replacement first, drain the old node only once the
+replacement is initialized — pods never pass through a pending window,
+so a crash or a mispredicted storm can never strand workload.
+
+Guard rails, in order of precedence:
+
+1. **Never strands pods** — phase 2 (the drain) only fires when the
+   replacement is live and initialized; a replacement that dies or times
+   out is rolled back and the at-risk node keeps running (reactive
+   interruption handling still covers it).
+2. **Cost never raised** — a replacement is only considered if a pool
+   with forecast rate BELOW the threshold exists at a real (sticker)
+   price ≤ the at-risk node's price. No safe pool at equal-or-lower
+   cost ⇒ skip (counted), defer to reactive handling.
+3. **Churn ≤ risk avoided** — :class:`RebalanceRateLimiter` banks the
+   predicted-interruption mass (Σ forecast rates over at-risk nodes) as
+   tokens; each proactive drain spends one. Lifetime drains can never
+   exceed lifetime predicted-interruption mass, and the bank zeroes the
+   moment the forecast clears — a wrong forecaster stops causing churn
+   within one cycle (the chaos forecaster-was-wrong schedule audits
+   exactly this).
+
+Every phase journals through the recovery plane (``REBALANCE`` intent
+records): a crash mid-rebalance rolls forward (workload already on the
+replacement) or back (empty replacement reaped) on the next incarnation.
+Strict-noop under ``KARPENTER_TPU_SPOT=0``: reconcile returns before
+touching any counter, journal, or node.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from .. import explain
+from ..apis import wellknown as wk
+from ..events import EventRecorder
+from ..introspect.watchdog import cycle as _wd_cycle
+from ..metrics import NAMESPACE, REGISTRY, Registry
+from ..recovery.crashpoints import crashpoint
+from ..recovery.journal import REBALANCE
+from ..utils.clock import Clock
+from . import state
+from .forecaster import REBALANCE_RATE_THRESHOLD
+
+log = logging.getLogger("karpenter.spot")
+
+_counters_lock = threading.Lock()
+_COUNTERS = {
+    "spot_rebalance_cycles": 0,
+    "spot_rebalance_launched": 0,
+    "spot_rebalance_drained": 0,
+    "spot_rebalance_rate_limited": 0,
+    "spot_rebalance_no_safe_pool": 0,
+    "spot_rebalance_rolled_back": 0,
+    "spot_rebalance_overtaken": 0,
+}
+
+
+def _count(key: str, n: int = 1) -> None:
+    with _counters_lock:
+        _COUNTERS[key] += n
+
+
+def counters() -> "dict[str, int]":
+    with _counters_lock:
+        return dict(_COUNTERS)
+
+
+class RebalanceRateLimiter:
+    """Token bank encoding "churn never exceeds the interruption rate it
+    avoids": `accrue(mass)` deposits the cycle's predicted-interruption
+    mass (Σ forecast rates over currently at-risk nodes, capped at a
+    small burst), each drain spends 1.0. Lifetime ``spent`` ≤ lifetime
+    ``accrued`` by construction (the property test falsifies this with
+    adversarial accrual schedules), and a cycle with zero at-risk mass
+    ZEROES the bank — a cleared forecast stops proactive churn at the
+    next reconcile, banked history notwithstanding."""
+
+    BURST = 2.0  # bank at most this many cycles' worth of mass
+
+    def __init__(self):
+        self.tokens = 0.0
+        self.accrued = 0.0
+        self.spent = 0
+
+    def accrue(self, mass: float) -> int:
+        """Deposit one cycle's at-risk mass; returns the whole-drain
+        budget now affordable."""
+        if mass <= 0.0:
+            self.tokens = 0.0
+            return 0
+        deposit = min(mass, max(self.BURST * mass - self.tokens, 0.0))
+        self.tokens += deposit
+        self.accrued += deposit
+        return int(self.tokens)
+
+    def spend(self, n: int = 1) -> None:
+        self.tokens = max(0.0, self.tokens - n)
+        self.spent += n
+
+    def snapshot(self) -> dict:
+        return {"tokens": round(self.tokens, 6),
+                "accrued": round(self.accrued, 6),
+                "spent": self.spent}
+
+
+class RebalanceController:
+    """One proactive rebalance in flight at a time (the deprovisioning
+    single-action-per-cycle discipline), driven from the operator loop
+    and the chaos drill alike."""
+
+    REBALANCE_INIT_TIMEOUT_S = 300.0
+
+    def __init__(self, kube, cloudprovider, cluster, termination,
+                 provisioning, forecaster,
+                 clock: "Optional[Clock]" = None,
+                 recorder: "Optional[EventRecorder]" = None,
+                 registry: "Optional[Registry]" = None,
+                 journal=None, watchdog=None):
+        self.kube = kube
+        self.cloudprovider = cloudprovider
+        self.cluster = cluster
+        self.termination = termination
+        self.provisioning = provisioning
+        self.forecaster = forecaster
+        self.clock = clock or Clock()
+        self.recorder = recorder or EventRecorder(clock=self.clock)
+        self.journal = journal
+        self.watchdog = watchdog
+        self.limiter = RebalanceRateLimiter()
+        self._pending: "Optional[dict]" = None
+        # per-action cost ledger: the cost-never-raised guarantee is by
+        # construction (_safe_offering), but the storm drill audits the
+        # receipts — every replacement's sticker price vs the node it
+        # relieves (chaos/invariants.check_spot_cost_never_raised)
+        self.ledger: "list[dict]" = []
+        reg = registry or REGISTRY
+        self.actions = reg.counter(
+            f"{NAMESPACE}_spot_rebalance_actions_total",
+            "Proactive spot rebalance actions.", ("action",))
+        self.budget_gauge = reg.gauge(
+            f"{NAMESPACE}_spot_rebalance_budget",
+            "Rebalance drains currently affordable under the "
+            "churn-le-risk-avoided token bank.")
+        # the SAME family the interruption controller registers — the
+        # registry returns the existing metric, so reactive and proactive
+        # drains land in one histogram split by `reason`
+        self.drain_throughput = reg.histogram(
+            f"{NAMESPACE}_interruption_drain_throughput_msgs_per_second",
+            "Messages drained per second, per receive batch "
+            "(handle + delete, wall time), by drain reason.", ("reason",),
+            buckets=(50, 100, 250, 500, 1000, 2500, 5000, 10000))
+
+    # -- reconcile -------------------------------------------------------------
+
+    def reconcile_once(self) -> int:
+        with _wd_cycle(self.watchdog, "spotrebalance"):
+            return self._reconcile_once()
+
+    def _reconcile_once(self) -> int:
+        if not state.enabled():
+            return 0
+        _count("spot_rebalance_cycles")
+        now = self.clock.now()
+        if self._pending is not None:
+            return self._finish_pending(now)
+        at_risk = self._at_risk_nodes()
+        mass = sum(rate for _, rate in at_risk)
+        budget = self.limiter.accrue(mass)
+        self.budget_gauge.set(budget)
+        if not at_risk:
+            return 0
+        if budget < 1:
+            _count("spot_rebalance_rate_limited")
+            self.actions.inc(action="rate-limited")
+            return 0
+        # highest predicted risk first; name tiebreak keeps the drill
+        # deterministic
+        for node, rate in sorted(at_risk, key=lambda p: (-p[1], p[0].name)):
+            if self._begin_rebalance(node, rate, now):
+                return 1
+        return 0
+
+    def _at_risk_nodes(self) -> "list[tuple[object, float]]":
+        out = []
+        for name in sorted(self.cluster.nodes):
+            node = self.cluster.nodes[name]
+            if node.capacity_type != wk.CAPACITY_TYPE_SPOT:
+                continue
+            if node.marked_for_deletion or not node.initialized:
+                continue
+            rate = self.forecaster.rate(node.instance_type, node.zone,
+                                        wk.CAPACITY_TYPE_SPOT)
+            if rate >= REBALANCE_RATE_THRESHOLD:
+                out.append((node, rate))
+        return out
+
+    def _safe_offering(self, node):
+        """Cheapest offering of the node's instance type with forecast
+        rate below the threshold at sticker price ≤ the node's — the
+        cost-never-raised guarantee is by construction, not by audit."""
+        catalog = self.cloudprovider.catalog_for(None)
+        itype = catalog.by_name.get(node.instance_type)
+        if itype is None:
+            return None, None
+        best = None
+        for o in itype.offerings:
+            if not o.available:
+                continue
+            if o.zone == node.zone and o.capacity_type == node.capacity_type:
+                continue
+            if self.forecaster.rate(itype.name, o.zone, o.capacity_type) \
+                    >= REBALANCE_RATE_THRESHOLD:
+                continue
+            if o.price > node.price + 1e-9:
+                continue
+            key = (o.price, o.capacity_type != wk.CAPACITY_TYPE_SPOT, o.zone)
+            if best is None or key < best[0]:
+                best = (key, o)
+        return (itype, best[1]) if best else (itype, None)
+
+    def _begin_rebalance(self, node, rate: float, now: float) -> bool:
+        from ..oracle.scheduler import Option
+        from ..solver.core import SolvedNode, SolveResult
+
+        itype, offering = self._safe_offering(node)
+        if offering is None:
+            _count("spot_rebalance_no_safe_pool")
+            self.actions.inc(action="no-safe-pool")
+            return False
+        prov = next((p for p in self.kube.provisioners()
+                     if p.name == node.provisioner_name), None)
+        if prov is None or self.provisioning is None:
+            return False
+        if self.journal is not None:
+            # write-ahead BEFORE the launch: the pending state machine
+            # otherwise lives only in process memory
+            self.journal.record(REBALANCE, node.name, {
+                "node": node.name, "replacement": None})
+        solved = SolvedNode(
+            option=Option(index=-1, itype=itype, zone=offering.zone,
+                          capacity_type=offering.capacity_type,
+                          price=offering.price,
+                          alloc=tuple(itype.allocatable_vector())),
+            pod_counts={}, provisioner=prov)
+        empty = SolveResult(nodes=[], existing_counts={}, unschedulable={},
+                            groups=[])
+        try:
+            replacement = self.provisioning._launch_node(solved, {}, empty)
+        except Exception as e:
+            log.warning("rebalance replacement launch failed: %s", e)
+            replacement = None
+        if replacement is None:
+            self._resolve(node.name, "aborted")
+            return False
+        if self.journal is not None:
+            self.journal.record(REBALANCE, node.name, {
+                "node": node.name, "replacement": replacement.name})
+        crashpoint("spot.mid_rebalance")
+        self.limiter.spend(1)
+        self.ledger.append({
+            "node": node.name,
+            "node_pool": [node.instance_type, node.zone, node.capacity_type],
+            "node_price": node.price,
+            "replacement": replacement.name,
+            "replacement_pool": [itype.name, offering.zone,
+                                 offering.capacity_type],
+            "replacement_price": offering.price,
+            "rate": round(rate, 6),
+        })
+        _count("spot_rebalance_launched")
+        self.actions.inc(action="launched")
+        self.recorder.normal(
+            f"node/{node.name}", "SpotRebalance",
+            f"forecast rate {rate:.3f} >= {REBALANCE_RATE_THRESHOLD}; "
+            f"launched {replacement.name} "
+            f"({itype.name}/{offering.zone}/{offering.capacity_type}); "
+            f"draining once initialized")
+        self._pending = {"node": node.name, "replacement": replacement.name,
+                         "rate": rate, "started_ts": now}
+        return True
+
+    def _finish_pending(self, now: float) -> int:
+        pr = self._pending
+        node = self.cluster.nodes.get(pr["node"])
+        rep = self.cluster.nodes.get(pr["replacement"])
+        if node is None or node.marked_for_deletion:
+            # the platform reclaimed it first (or another path is draining
+            # it) — the proactive move is moot; the replacement stays as
+            # restored capacity
+            self._pending = None
+            self._resolve(pr["node"], "overtaken")
+            _count("spot_rebalance_overtaken")
+            self.actions.inc(action="overtaken")
+            return 0
+        if rep is None or rep.marked_for_deletion:
+            log.warning("rebalance replacement %s gone; abandoning",
+                        pr["replacement"])
+            self._pending = None
+            self._resolve(pr["node"], "abandoned")
+            self.actions.inc(action="abandoned")
+            return 0
+        if rep.initialized:
+            self._pending = None
+            t0 = time.perf_counter()
+            if self.termination is None or \
+                    not self.termination.request_deletion(pr["node"]):
+                # old node no longer drainable: roll the replacement back
+                if self.termination is not None:
+                    self.termination.request_deletion(pr["replacement"])
+                self._resolve(pr["node"], "rolled_back")
+                _count("spot_rebalance_rolled_back")
+                self.actions.inc(action="rolled-back")
+                return 0
+            explain.note_drain(pr["node"], "rebalance",
+                               "proactive-rebalance", ts=now,
+                               detail={"replacement": pr["replacement"],
+                                       "rate": pr["rate"]})
+            elapsed = time.perf_counter() - t0
+            if elapsed > 0:
+                self.drain_throughput.observe(
+                    1.0 / elapsed, reason="proactive-rebalance")
+            self._resolve(pr["node"], "completed")
+            _count("spot_rebalance_drained")
+            self.actions.inc(action="drained")
+            self.recorder.normal(
+                f"node/{pr['node']}", "SpotRebalance",
+                f"drained ahead of predicted reclaim "
+                f"(rate {pr['rate']:.3f}, reason proactive-rebalance); "
+                f"workload lands on {pr['replacement']}")
+            return 1
+        if now - pr["started_ts"] >= self.REBALANCE_INIT_TIMEOUT_S:
+            log.warning("rebalance replacement %s not initialized within "
+                        "%.0fs; rolling back", pr["replacement"],
+                        self.REBALANCE_INIT_TIMEOUT_S)
+            if self.termination is not None:
+                self.termination.request_deletion(pr["replacement"])
+            self._pending = None
+            self._resolve(pr["node"], "rolled_back")
+            _count("spot_rebalance_rolled_back")
+            self.actions.inc(action="rolled-back")
+        return 0
+
+    def _resolve(self, key: str, outcome: str) -> None:
+        if self.journal is not None:
+            self.journal.resolve(REBALANCE, key, outcome=outcome)
+
+    def snapshot(self) -> dict:
+        return {"pending": dict(self._pending) if self._pending else None,
+                "limiter": self.limiter.snapshot(),
+                "ledger_entries": len(self.ledger),
+                "counters": counters()}

@@ -1,0 +1,52 @@
+"""Global on/off switch for the spot resilience plane.
+
+The spot plane is advisory-never-load-bearing (same contract as the
+profiling/explain/membership/incremental planes): every producer — the
+interruption forecaster, the risk-aware objective, the proactive
+rebalance controller — checks :func:`enabled` before doing ANY work, so
+disabling the plane is a strict no-op (zero counters, penalty factors
+pinned at 1.0, no diversity mask, no proactive drains — every solve is
+bit-identical to a build without the plane). The chaos drill enforces
+exactly that invariant (``spot-strict-noop``) with two-window evidence:
+activity counters frozen while disabled AND solve decisions identical to
+the baseline.
+
+Default is ON (forecasts are advisory and cheap); ``KARPENTER_TPU_SPOT=0``
+(or ``false``/``off``/``no``) disables it at process start, and
+:func:`set_enabled` / :func:`disabled` flip it at runtime (chaos drills,
+A/B cost baselines).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+FLAG_ENV = "KARPENTER_TPU_SPOT"
+_FALSY = ("0", "false", "off", "no")
+
+_lock = threading.Lock()
+_enabled = os.environ.get(FLAG_ENV, "1").strip().lower() not in _FALSY
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip the plane; returns the previous state (restore token)."""
+    global _enabled
+    with _lock:
+        prev = _enabled
+        _enabled = bool(on)
+        return prev
+
+
+@contextlib.contextmanager
+def disabled():
+    """Scoped hard-off: A/B baselines and the chaos strict-noop drill."""
+    prev = set_enabled(False)
+    try:
+        yield
+    finally:
+        set_enabled(prev)

@@ -2,7 +2,7 @@
 analysis on synthetic DAGs (serial / overlapped / diamond), the exact-0
 serial guarantee, flat-projection bit-equality, wait attribution (lane
 geometry + explicit notes), never-negative intervals under clock skew,
-the strict-noop contract, /debug/criticalz, the statusz schema-11 pin,
+the strict-noop contract, /debug/criticalz, the statusz schema pin,
 and measured-roofline drift falsifiability."""
 
 import json
@@ -421,12 +421,12 @@ class TestCriticalzEndpoint:
         code, _ = _get(ports["metrics"], "/debug/criticalz?n=-5")
         assert code == 200  # clamped up, same as /debug/profilez
 
-    def test_statusz_schema_11_carries_critical_section(self, served_op):
+    def test_statusz_schema_carries_critical_section(self, served_op):
         op, ports = served_op
         code, body = _get(ports["metrics"], "/debug/statusz")
         assert code == 200
         doc = json.loads(body)
-        assert doc["schema"] == 11
+        assert doc["schema"] == 12
         sect = doc["critical"]
         assert sect["enabled"] is True
         assert sect["lanes"] == list(critical.LANES)

@@ -161,12 +161,13 @@ def test_drain_throughput_recorded_per_batch():
     ctrl = InterruptionController(KubeStore(), ClusterState(), q, NoIce(),
                                   registry=reg)
     assert ctrl.reconcile_once() == 0      # empty poll: no observation
-    assert ctrl.drain_throughput.count() == 0
+    assert ctrl.drain_throughput.count(reason="reactive-reclaim") == 0
     for i in range(7):
         q.send(json.dumps({"source": "cloud.spot",
                            "detail-type": "Spot Instance Interruption Warning",
                            "detail": {"instance-id": f"i-{i}"}}))
     assert ctrl.reconcile_once() == 7
-    assert ctrl.drain_throughput.count() == 1   # one batch, one observation
-    assert ctrl.drain_throughput.sum() > 0      # a positive msgs/s rate
+    # one batch, one observation, attributed to the platform-forced reason
+    assert ctrl.drain_throughput.count(reason="reactive-reclaim") == 1
+    assert ctrl.drain_throughput.sum(reason="reactive-reclaim") > 0
     ctrl.stop()
