@@ -8,9 +8,9 @@ PY ?= python
 # tunnel" note and karpenter_tpu/utils/jaxenv.py.
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: presubmit lint noretry hotloops crashpoints cardinality phaseacct reasons test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm failover-drill spot-storm spot-storm-small fleet-bench fleet-drill fleet-drill-small telemetry-drill claims diagnose provenance multichip soak incremental-soak perf-regress ledger-backfill profile-drill explain-drill critical-drill critical-drill-small
+.PHONY: presubmit lint noretry hotloops crashpoints cardinality phaseacct reasons test battletest deflake benchmark bench e2e foreigntest docs native run solver-serve verify-entry catalog chaos chaos-crash chaos-storm failover-drill spot-storm spot-storm-small fleet-bench fleet-drill fleet-drill-small churn-drill churn-drill-small telemetry-drill claims diagnose provenance multichip soak incremental-soak perf-regress ledger-backfill profile-drill explain-drill critical-drill critical-drill-small
 
-presubmit: lint claims provenance noretry hotloops crashpoints cardinality phaseacct reasons perf-regress failover-drill fleet-drill-small critical-drill-small spot-storm-small incremental-soak test verify-entry  ## what CI runs
+presubmit: lint claims provenance noretry hotloops crashpoints cardinality phaseacct reasons perf-regress failover-drill fleet-drill-small churn-drill-small critical-drill-small spot-storm-small incremental-soak test verify-entry  ## what CI runs
 
 perf-regress:  ## tier-1-sized micro-benches must stay inside the ledger's noise bands
 	$(CPU_ENV) $(PY) hack/check_perf_regress.py
@@ -100,6 +100,14 @@ fleet-drill-small:  ## tier-1-sized real-replica drill (2 subprocesses, no throu
 	$(CPU_ENV) KARPENTER_TPU_DRILL_DIR=$(or $(DRILL_DIR),/tmp/karpenter-fleet-drill) \
 		KARPENTER_TPU_LEDGER=$(or $(DRILL_DIR),/tmp/karpenter-fleet-drill)/ledger.jsonl \
 		$(PY) -m benchmarks.fleet_drill --small
+
+churn-drill:  ## catalog-churn endurance drill: 1000 zipf tenants, HBM cap, A/B thrash audit, RECORDED
+	$(CPU_ENV) $(PY) -m benchmarks.churn_drill
+
+churn-drill-small:  ## tier-1-sized churn drill (2 replicas, 32 tenants, same audits)
+	$(CPU_ENV) KARPENTER_TPU_DRILL_DIR=$(or $(DRILL_DIR),/tmp/karpenter-churn-drill) \
+		KARPENTER_TPU_LEDGER=$(or $(DRILL_DIR),/tmp/karpenter-churn-drill)/ledger.jsonl \
+		$(PY) -m benchmarks.churn_drill --small
 
 telemetry-drill:  ## 2-replica/1000-tenant telemetry acceptance drill, RECORDED
 	$(CPU_ENV) $(PY) -m benchmarks.telemetry_drill

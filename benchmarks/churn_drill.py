@@ -1,0 +1,955 @@
+"""The catalog-churn endurance drill: 1000 zipf tenants, 4 real replicas,
+an HBM cap fitting ~1/4 of the hot set, and an in-artifact A/B proving
+the admission filter halves eviction thrash.
+
+The overload plane's whole story — graduated backpressure, anti-thrash
+resident eviction, fairness under shedding — re-proven across REAL
+process boundaries:
+
+* each solver replica is its own OS process (fleet/replica.py) booted
+  with `KARPENTER_TPU_HBM_CAPACITY_BYTES` sized (by an in-process grid
+  calibration) so the residency cap fits roughly a quarter of the
+  per-replica hot catalog set;
+* traffic is a catalog-churn mix from ONE seeded RNG: every request
+  Syncs a catalog — usually one of the replica's skew-popular hot
+  variants, with probability `churn_prob` a never-seen-again one-shot —
+  then solves through the fleet frontend's fairness queue;
+* the SAME fixed-length schedule runs twice: once with the overload
+  plane forced off (`KARPENTER_TPU_OVERLOAD=0`, plain LRU, unbounded
+  backlog, no shedding) and once with it on. Both windows report the
+  always-on thrash ledger (solver/service.py eviction_stats), so the
+  halving claim is an in-artifact A/B, not a cross-run comparison;
+* every audit reads federated scrape evidence (`/debug/statusz` over
+  HTTP): resident bytes vs the cap each scrape cycle, per-tenant shed
+  attribution citing SHED_REASONS, fairness (no tenant waits past the
+  starvation bound), and the guard's transition ledger for monotone
+  one-step brownout recovery.
+
+`build_replay_plan()` reproduces the full (tenant, variant) sequence
+bit-for-bit without spawning anything, so the committed artifact's
+schedule digest is replayable in tier-1 time.
+
+Run as `make churn-drill` (full: 4 replicas, 1000 tenants) or
+`make churn-drill-small` (2 replicas, tier-1 sized). Artifact:
+benchmarks/results/churn/churn_drill.json (or _small)."""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import hashlib
+import itertools
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import zlib
+from dataclasses import dataclass, asdict
+from typing import Optional
+
+PODS_PER_SOLVE = 2
+# zipf skew over a replica's hot variants: the head must dominate so the
+# residency cap (≈ hot/4) can hold the working set ONLY when one-shots
+# are kept out of the main LRU — exactly the property the A/B measures
+HOT_SKEW = 2.0
+ONE_SHOT_BASE = 1_000_000
+# resident-bytes audit slack: grid builds run OUTSIDE the service lock
+# (Health stays responsive during churn), so an async scrape can observe
+# up to a couple of in-flight builds on top of the retained set — the
+# RETAINED set (final scrape, post-drain) is held to the cap strictly
+INFLIGHT_ALLOWANCE_SOLVERS = 2.0
+
+
+@dataclass(frozen=True)
+class DrillConfig:
+    name: str
+    replicas: int
+    tenants: int
+    tail_len: int                  # fixed-length zipf tail after the sweep
+    workers: int
+    max_wave: int
+    seed: int = 0
+    hot_variants: int = 6          # per-replica hot catalog set size
+    churn_prob: float = 0.55       # P(a tail draw Syncs a one-shot catalog)
+    # residency cap in calibrated solver-grid units. The geometry that
+    # makes every audit non-vacuous (solved hot = 3116B, synced one-shot
+    # probationer = 2x-heavier 3264B): the TYPICAL steady state — 3
+    # solved hots plus a resident probationer, 12612B — sits in the
+    # guard's [0.75, 0.9) shed band, so over-rate sheds flow whenever a
+    # churned catalog is on probation; the PEAK state — a full 4-entry
+    # LRU plus probationer, 15728B — crosses 0.9, so brownouts and
+    # low-water drains happen but only at the peak, not on every
+    # one-shot install (a cap where the TYPICAL state crosses 0.9 makes
+    # each one-shot strip two warm hots and collapses the A/B margin in
+    # both windows). The hot set (6 variants, 18696B solved) still
+    # outweighs the cap, so its zipf tail is forced through eviction
+    cap_solvers: float = 5.2
+    tick_interval_s: float = 0.01
+    starvation_bound: int = 16
+    zipf_exponent: float = 1.1     # tenant-rank skew (fleet drill's value)
+    solve_timeout_s: float = 60.0
+    boot_timeout_s: float = 240.0
+    scrape_interval_s: float = 0.1
+    drain_timeout_s: float = 20.0
+    # bound on sync->solve eviction races per request: each retry re-Syncs
+    # (cheap — the catalog is known) and under 32-worker churn a hot
+    # solver can lose this race several times in a row, so the bound is
+    # generous; the OFF window (no probation side-car) races hardest
+    sync_retries: int = 10
+    warmup_rungs: "tuple[int, ...]" = (2, 4)
+    # ON must divide the OFF thrash ratio by at least this factor
+    thrash_improvement: float = 2.0
+    # FULL requires the ON window to actually shed (falsifiability: an
+    # A/B whose ON window never sheds proves nothing about attribution)
+    require_sheds: bool = False
+
+
+FULL = DrillConfig(name="full", replicas=4, tenants=1000, tail_len=2000,
+                   workers=32, max_wave=8, require_sheds=True)
+SMALL = DrillConfig(name="small", replicas=2, tenants=32, tail_len=144,
+                    workers=6, max_wave=4)
+
+
+# -- deterministic schedule (shared by the drill and its replay plan) -------
+
+
+def _tenant_ids(cfg: DrillConfig) -> "list[str]":
+    return [f"tenant-{i:04d}" for i in range(cfg.tenants)]
+
+
+def _replica_names(cfg: DrillConfig) -> "list[str]":
+    return [f"r{i}" for i in range(cfg.replicas)]
+
+
+def _replica_of(cfg: DrillConfig, tid: str) -> int:
+    # stable content hash, NOT salted builtin hash(): routing must agree
+    # between the run that produced an artifact and the replay audit
+    return zlib.crc32(tid.encode()) % cfg.replicas
+
+
+def _zipf_cum(n: int, exponent: float) -> "list[float]":
+    cum, total = [], 0.0
+    for i in range(n):
+        total += 1.0 / ((i + 1) ** exponent)
+        cum.append(total)
+    return cum
+
+
+def _zipf_idx(cum: "list[float]", r: float) -> int:
+    import bisect
+
+    return bisect.bisect_left(cum, r * cum[-1])
+
+
+def _hot_variant(cfg: DrillConfig, tid: str, hot_cum, r: float) -> int:
+    """One hot-catalog draw for `tid`: its replica's hot set, zipf-skewed
+    so the head variants carry most of the mass."""
+    rep = _replica_of(cfg, tid)
+    return rep * cfg.hot_variants + _zipf_idx(hot_cum, r)
+
+
+def build_items(cfg: DrillConfig) -> "list[tuple[str, int, str]]":
+    """The full deterministic (tenant, variant, kind) sequence: a
+    shuffled sweep (every tenant once, hot draw — warms the hot set and
+    pins down the within-weight population) followed by a FIXED-length
+    zipf tail with the churn mix. Fixed length — not wall-clock bounded —
+    so both A/B windows realize the identical schedule and the artifact
+    digest covers exactly what ran."""
+    tenants = _tenant_ids(cfg)
+    rng = random.Random(cfg.seed)
+    sweep = list(tenants)
+    rng.shuffle(sweep)
+    hot_cum = _zipf_cum(cfg.hot_variants, HOT_SKEW)
+    tenant_cum = _zipf_cum(len(tenants), cfg.zipf_exponent)
+    one_shot = itertools.count(ONE_SHOT_BASE)
+    items: "list[tuple[str, int, str]]" = []
+    for tid in sweep:
+        items.append((tid, _hot_variant(cfg, tid, hot_cum, rng.random()),
+                      "hot"))
+    for _ in range(cfg.tail_len):
+        tid = tenants[_zipf_idx(tenant_cum, rng.random())]
+        if rng.random() < cfg.churn_prob:
+            items.append((tid, next(one_shot), "one"))
+        else:
+            items.append((tid, _hot_variant(cfg, tid, hot_cum,
+                                            rng.random()), "hot"))
+    return items
+
+
+def schedule_digest(items) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    for tid, variant, kind in items:
+        h.update(f"{tid}:{variant}:{kind}".encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def build_replay_plan(cfg: DrillConfig) -> dict:
+    """The drill's deterministic skeleton, computed WITHOUT spawning
+    anything: the full churn schedule and a digest over it. A committed
+    artifact replays bit-for-bit from (seed, config) alone."""
+    items = build_items(cfg)
+    counts = collections.Counter(tid for tid, _, _ in items)
+    return {
+        "schema": 1,
+        "seed": cfg.seed,
+        "tenants": cfg.tenants,
+        "replicas": _replica_names(cfg),
+        "requests": len(items),
+        "one_shots": sum(1 for _, _, k in items if k == "one"),
+        "hot_variants_per_replica": cfg.hot_variants,
+        "within_weight_tenants": sum(1 for c in counts.values() if c == 1),
+        "head": [f"{t}:{v}:{k}" for t, v, k in items[:8]],
+        "schedule_digest": schedule_digest(items),
+    }
+
+
+# -- workload ---------------------------------------------------------------
+
+
+N_TYPES = 24  # big enough that grid residency dominates a solver's weight
+# one-shot (churned) catalogs are BIGGER than hot ones: a tenant mutating
+# its catalog every submission is typically growing it, and the heavier
+# synced-only grid is what lifts HBM pressure into the guard's shed band
+# while a probationer is resident — WITHOUT crossing the 0.9 low-water
+# trigger — so the drill exercises the whole ladder, not just defer
+N_TYPES_ONE_SHOT = 48
+
+
+def _variant_catalog(variant: int):
+    """Catalog content for one variant id. Prices are perturbed — od by
+    `variant % 9973`, spot by `variant // 9973` steps — so every variant
+    id maps to a distinct content hash (the LRU identity) while shapes
+    stay identical within each class (hot vs one-shot), keeping compile
+    caches warm and grid builds cheap."""
+    from karpenter_tpu.models.instancetype import Catalog, make_instance_type
+
+    od = round(0.20 + (variant % 9973) * 1e-4, 6)
+    spot = round(0.07 + (variant // 9973) * 1e-4, 6)
+    n = N_TYPES_ONE_SHOT if variant >= ONE_SHOT_BASE else N_TYPES
+    return Catalog(types=[
+        make_instance_type(f"m{i}.large", cpu=4 * (1 + i % 4),
+                           memory=f"{16 * (1 + i % 4)}Gi",
+                           od_price=round(od + 0.01 * i, 6),
+                           spot_price=round(spot + 0.01 * i, 6))
+        for i in range(n)])
+
+
+def _provisioners():
+    from karpenter_tpu.apis import wellknown as wk
+    from karpenter_tpu.apis.provisioner import Provisioner
+    from karpenter_tpu.models.requirements import OP_IN, Requirements
+
+    prov = Provisioner(name="default", requirements=Requirements.of(
+        (wk.LABEL_CAPACITY_TYPE, OP_IN, ["spot", "on-demand"])))
+    prov.set_defaults()
+    return [prov]
+
+
+def calibrate_solver_bytes() -> int:
+    """Measure the SOLVED residency weight of one variant — static grid
+    plus one bucket rung of delta tensors — by running a Sync + Solve
+    through an in-process SolverService and reading the HBM ledger.
+    Residency is a deterministic function of catalog/pod shapes
+    (identical across variants AND across the parent/replica process
+    boundary on the same platform), so the parent can size the replicas'
+    cap without booting a calibration subprocess."""
+    from karpenter_tpu.models.pod import make_pod
+    from karpenter_tpu.solver import buckets, wire
+    from karpenter_tpu.solver.service import SolverService, pb, hbm_key
+
+    svc = SolverService()
+    provs = _provisioners()
+    wire_cat = wire.catalog_to_wire(_variant_catalog(0))
+    svc.Sync(pb.SyncRequest(
+        catalog=wire_cat,
+        provisioners=[wire.provisioner_to_wire(p) for p in provs]), None)
+    pods = [make_pod(f"churn-calib-p{j}", cpu="1", memory="2Gi")
+            for j in range(PODS_PER_SOLVE)]
+    svc.Solve(pb.SolveRequest(
+        catalog_hash=wire.catalog_hash(wire_cat),
+        provisioner_hash=wire.provisioners_hash(provs),
+        pods=[wire.pod_to_wire(p) for p in pods]), None)
+    nbytes = int(buckets.HBM.resident_bytes())
+    with svc._lock:
+        keys = list(svc._cache) + list(svc._probation)
+    for key in keys:
+        buckets.HBM.release(hbm_key(key))
+    if nbytes <= 0:
+        raise RuntimeError("HBM calibration tracked 0 bytes: the grid "
+                           "build no longer files device puts under "
+                           "hbm_scope — the cap audit would be vacuous")
+    return nbytes
+
+
+def classify_outcome(exc) -> "tuple[str, Optional[str]]":
+    """Map a wire error back to (outcome, shed_reason): the frontend
+    aborts FleetShed as DEADLINE_EXCEEDED with the shed message in the
+    status details, so the client can attribute every shed to its
+    SHED_REASONS row without a side channel."""
+    msg = str(exc)
+    if "browned out" in msg:
+        return "shed", "overload-brownout"
+    if "overload pressure" in msg:
+        return "shed", "overload-pressure"
+    if "backlog exceeded the bound" in msg:
+        return "shed", "overload-queue-overflow"
+    if "shedding at admission" in msg or "gave up waiting" in msg:
+        return "shed", "deadline"
+    return "error", None
+
+
+# -- the drill --------------------------------------------------------------
+
+
+def _set_env(key: str, value: "Optional[str]"):
+    """Apply one env edit (None deletes); returns a restore thunk."""
+    prev = os.environ.get(key)
+    if value is None:
+        os.environ.pop(key, None)
+    else:
+        os.environ[key] = value
+
+    def restore():
+        if prev is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = prev
+    return restore
+
+
+def _log_tail(path: str, n: int = 20) -> str:
+    try:
+        with open(path, errors="replace") as f:
+            return "".join(f.readlines()[-n:])
+    except OSError as e:
+        return f"<no log: {e}>"
+
+
+def _run_window(cfg: DrillConfig, label: str, overload_on: bool,
+                cap_bytes: int, items, log_dir: str) -> dict:
+    """Boot a fresh fleet, run the FULL schedule through it, scrape, and
+    tear down. The overload gate and the HBM cap ride the environment —
+    replicas inherit the parent's os.environ at spawn — restored before
+    returning so windows cannot contaminate each other."""
+    from karpenter_tpu.fleet.replica import (
+        GrpcReplicaTransport, spawn_replica, wait_for_registrations)
+    from karpenter_tpu.introspect.fleetview import HttpReplica
+    from karpenter_tpu.overload.state import FLAG_ENV
+    from karpenter_tpu.solver import solver_pb2 as pb
+    from karpenter_tpu.solver import wire
+    from karpenter_tpu.solver.buckets import HBM_CAPACITY_ENV
+    from karpenter_tpu.models.pod import make_pod
+
+    names = _replica_names(cfg)
+    rendezvous = tempfile.mkdtemp(prefix=f"churn-{label}-", dir=log_dir)
+    restores = [
+        _set_env(FLAG_ENV, None if overload_on else "0"),
+        _set_env(HBM_CAPACITY_ENV, str(cap_bytes)),
+    ]
+    procs: "dict[str, object]" = {}
+    transports: "dict[str, GrpcReplicaTransport]" = {}
+    stop_scrape = threading.Event()
+    failed = True
+    try:
+        for name in names:
+            procs[name] = spawn_replica(
+                name, rendezvous, max_wave=cfg.max_wave,
+                tick_interval_s=cfg.tick_interval_s,
+                starvation_bound=cfg.starvation_bound)
+        regs = wait_for_registrations(rendezvous, names,
+                                      timeout_s=cfg.boot_timeout_s)
+        debug: "dict[str, HttpReplica]" = {}
+        for name in names:
+            transports[name] = GrpcReplicaTransport(name, regs[name]["grpc"])
+            debug[name] = HttpReplica(name, regs[name]["debug"])
+
+        provs = _provisioners()
+        prov_hash = wire.provisioners_hash(provs)
+        catalogs: "dict[int, object]" = {}
+        hashes: "dict[int, int]" = {}
+
+        def catalog_of(variant: int):
+            cat = catalogs.get(variant)
+            if cat is None:
+                cat = catalogs[variant] = _variant_catalog(variant)
+                hashes[variant] = wire.catalog_hash(wire.catalog_to_wire(cat))
+            return cat
+
+        seq = itertools.count()
+
+        def build_request(tid: str, variant: int):
+            i = next(seq)
+            pods = [make_pod(f"{tid}-q{i}-p{j}", cpu="1", memory="2Gi")
+                    for j in range(PODS_PER_SOLVE)]
+            catalog_of(variant)
+            return pb.SolveRequest(
+                catalog_hash=hashes[variant], provisioner_hash=prov_hash,
+                pods=[wire.pod_to_wire(p) for p in pods])
+
+        # -- warm: head catalog + batch rungs on every replica ----------
+        for idx, name in enumerate(names):
+            head = idx * cfg.hot_variants
+            transports[name].sync(catalog_of(head), provs)
+            transports[name](f"warm-{name}",
+                             build_request(f"warm-{name}", head),
+                             cfg.solve_timeout_s * 4)
+            for k in cfg.warmup_rungs:
+                burst = [threading.Thread(
+                    target=transports[name],
+                    args=(f"warm-{name}-{k}-{j}",
+                          build_request(f"warm-{name}-{k}-{j}", head),
+                          cfg.solve_timeout_s * 4))
+                    for j in range(k)]
+                for t in burst:
+                    t.start()
+                for t in burst:
+                    t.join()
+
+        # -- scraper: resident-vs-cap samples every cycle ----------------
+        samples: "list[dict]" = []
+        samples_lock = threading.Lock()
+
+        def scraper():
+            while not stop_scrape.is_set():
+                for name in names:
+                    try:
+                        snap = debug[name].statusz()
+                    except Exception as e:  # noqa: BLE001 — audited below
+                        rec = {"replica": name, "error": str(e)}
+                    else:
+                        hbm = snap.get("hbm") or {}
+                        fleet = (snap.get("fleet") or {}).get(
+                            "frontends") or [{}]
+                        rec = {"replica": name,
+                               "resident_bytes":
+                                   hbm.get("resident_bytes_total"),
+                               "capacity_bytes": hbm.get("capacity_bytes"),
+                               "pressure": hbm.get("pressure"),
+                               "queued": fleet[0].get("queued")}
+                    with samples_lock:
+                        samples.append(rec)
+                stop_scrape.wait(cfg.scrape_interval_s)
+
+        # -- traffic: the full fixed schedule through the fairness queue --
+        outcomes: "list[Optional[dict]]" = [None] * len(items)
+        cursor = itertools.count()
+        # a one-shot Sync→Solve pair holds this per-replica gate so a
+        # concurrent one-shot cannot recycle the probation slot between
+        # the Sync and the Solve it serves (hot traffic stays concurrent)
+        oneshot_gate = {name: threading.Lock() for name in names}
+
+        def solve_with_resync(tr, tid: str, variant: int) -> dict:
+            t0 = time.perf_counter()
+            for attempt in range(cfg.sync_retries + 1):
+                try:
+                    tr(tid, build_request(tid, variant), cfg.solve_timeout_s)
+                    return {"tenant": tid, "outcome": "served",
+                            "ms": (time.perf_counter() - t0) * 1e3}
+                except Exception as e:  # noqa: BLE001 — classified below
+                    msg = str(e)
+                    if ("re-Sync required" in msg
+                            and attempt < cfg.sync_retries):
+                        # the target solver was evicted between our Sync
+                        # and the queue drain: re-Sync (a repeat sighting
+                        # — it earns residency) and retry
+                        tr.sync(catalog_of(variant), provs)
+                        continue
+                    outcome, reason = classify_outcome(e)
+                    rec = {"tenant": tid, "outcome": outcome,
+                           "ms": (time.perf_counter() - t0) * 1e3}
+                    if reason is not None:
+                        rec["reason"] = reason
+                    else:
+                        rec["error"] = f"{type(e).__name__}: {e}"
+                    return rec
+            raise AssertionError("unreachable")
+
+        def worker():
+            while True:
+                i = next(cursor)
+                if i >= len(items):
+                    return
+                tid, variant, kind = items[i]
+                name = names[_replica_of(cfg, tid)]
+                tr = transports[name]
+                try:
+                    if kind == "one":
+                        # churn: push the one-shot catalog, then serve the
+                        # tenant from its replica's resident head — the
+                        # Sync exercises the admission filter, the Solve
+                        # exercises fairness under the pressure it causes
+                        with oneshot_gate[name]:
+                            tr.sync(catalog_of(variant), provs)
+                        solve_v = _replica_of(cfg, tid) * cfg.hot_variants
+                    else:
+                        tr.sync(catalog_of(variant), provs)
+                        solve_v = variant
+                    outcomes[i] = {**solve_with_resync(tr, tid, solve_v),
+                                   "variant": variant, "kind": kind}
+                except Exception as e:  # noqa: BLE001 — audited as outcome
+                    outcomes[i] = {"tenant": tid, "variant": variant,
+                                   "kind": kind, "outcome": "error",
+                                   "error": f"{type(e).__name__}: {e}"}
+
+        scrape_thread = threading.Thread(target=scraper, daemon=True)
+        workers = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(cfg.workers)]
+        t0 = time.perf_counter()
+        scrape_thread.start()
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        wall = time.perf_counter() - t0
+
+        # -- drain, then the final (retained-state) scrape ----------------
+        deadline = time.monotonic() + cfg.drain_timeout_s
+        while time.monotonic() < deadline:
+            queued = 0
+            for name in names:
+                snap = debug[name].statusz()
+                fronts = (snap.get("fleet") or {}).get("frontends") or []
+                queued += sum(int(f.get("queued") or 0) for f in fronts)
+            if queued == 0:
+                break
+            time.sleep(0.1)
+        stop_scrape.set()
+        scrape_thread.join(timeout=5.0)
+
+        finals: "dict[str, dict]" = {}
+        for name in names:
+            snap = debug[name].statusz()
+            fronts = (snap.get("fleet") or {}).get("frontends") or []
+            ours = next((f for f in fronts if f.get("name") == name),
+                        fronts[0] if fronts else {})
+            over = snap.get("overload") or {}
+            orow = next((f for f in (over.get("frontends") or [])
+                         if f.get("name") == name), {})
+            finals[name] = {
+                "hbm": snap.get("hbm") or {},
+                "fairness": {"starvation_bound":
+                             ours.get("starvation_bound"),
+                             "queued": ours.get("queued"),
+                             "tenants": ours.get("tenants") or {}},
+                "overload_enabled": over.get("enabled"),
+                "overload_counters": over.get("counters") or {},
+                "guard": orow.get("guard") or {},
+                "guard_evidence": orow.get("evidence") or {},
+                "eviction": orow.get("eviction") or {},
+            }
+
+        served = [o for o in outcomes if o and o["outcome"] == "served"]
+        result = {
+            "label": label,
+            "overload_on": overload_on,
+            "realized": sum(1 for o in outcomes if o is not None),
+            "served": len(served),
+            "sheds": sum(1 for o in outcomes
+                         if o and o["outcome"] == "shed"),
+            "errors": sum(1 for o in outcomes
+                          if o and o["outcome"] == "error"),
+            "error_head": [o["error"] for o in outcomes
+                           if o and o.get("error")][:5],
+            "wall_s": round(wall, 3),
+            "solves_per_sec": (round(len(served) / wall, 3)
+                               if wall > 0 else 0.0),
+            "pids": {n: regs[n]["pid"] for n in names},
+            "outcomes": [o for o in outcomes if o is not None],
+            "samples": samples,
+            "finals": finals,
+        }
+        failed = False
+        return result
+    finally:
+        stop_scrape.set()
+        for proc in procs.values():
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=10.0)
+            except Exception:  # noqa: BLE001 — escalate, then move on
+                proc.kill()
+        for tr in transports.values():
+            tr.close()
+        for restore in reversed(restores):
+            restore()
+        if failed:
+            for name in procs:
+                tail = _log_tail(os.path.join(rendezvous, f"{name}.log"))
+                print(f"--- {name} [{label}] log tail ({rendezvous}) ---\n"
+                      f"{tail}", file=sys.stderr)
+
+
+def _window_eviction_totals(window: dict) -> dict:
+    installs = thrash = evictions = 0
+    for rec in window["finals"].values():
+        ev = rec.get("eviction") or {}
+        installs += int(ev.get("installs") or 0)
+        thrash += int(ev.get("thrash_events") or 0)
+        evictions += int(ev.get("evictions") or 0)
+    ratio = (thrash / installs) if installs else 0.0
+    return {"installs": installs, "evictions": evictions,
+            "thrash_events": thrash, "thrash_ratio": round(ratio, 4)}
+
+
+def _scraped_shed_tenants(window: dict) -> "dict[str, dict]":
+    """tenant -> {total, reasons{reason: count}} summed across replicas,
+    schedule tenants only (warm traffic audited separately)."""
+    out: "dict[str, dict]" = {}
+    for rec in window["finals"].values():
+        for tid, st in (rec["fairness"]["tenants"] or {}).items():
+            if not tid.startswith("tenant-"):
+                continue
+            total = int(st.get("shed_admission") or 0) + \
+                int(st.get("shed_queue") or 0)
+            if total == 0:
+                continue
+            row = out.setdefault(tid, {"total": 0, "reasons": {}})
+            row["total"] += total
+            for per in (st.get("shed_reasons") or {}).values():
+                for reason, n in per.items():
+                    row["reasons"][reason] = \
+                        row["reasons"].get(reason, 0) + int(n)
+    return out
+
+
+def audit(cfg: DrillConfig, plan: dict, items, per_solver: int,
+          cap_bytes: int, off: dict, on: dict):
+    """Every acceptance criterion, from scrape evidence + client
+    outcomes; returns (criteria, violations, evidence)."""
+    from karpenter_tpu.chaos import invariants as inv
+    from karpenter_tpu.explain.reasons import SHED_REASONS
+    from karpenter_tpu.overload.guard import OverloadGuard
+
+    violations: "list[inv.Violation]" = []
+    counts = collections.Counter(tid for tid, _, _ in items)
+
+    # real subprocesses, full schedule realized in BOTH windows
+    pids = set(off["pids"].values()) | set(on["pids"].values())
+    real = (len(pids) == 2 * cfg.replicas and os.getpid() not in pids)
+    realized = (off["realized"] == len(items)
+                and on["realized"] == len(items))
+
+    # resident bytes vs the cap: retained state (final scrape) strictly
+    # under the cap; mid-run samples under cap + in-flight-build slack
+    allowance = int(INFLIGHT_ALLOWANCE_SOLVERS * per_solver)
+    max_sample, over_samples, n_samples = 0, 0, 0
+    for window in (off, on):
+        for s in window["samples"]:
+            r = s.get("resident_bytes")
+            if r is None:
+                continue
+            n_samples += 1
+            max_sample = max(max_sample, int(r))
+            if r > cap_bytes + allowance:
+                over_samples += 1
+    max_final = max(int((rec["hbm"].get("resident_bytes_total") or 0))
+                    for w in (off, on) for rec in w["finals"].values())
+    resident_capped = (n_samples > 0 and over_samples == 0
+                       and max_final <= cap_bytes)
+    if not resident_capped:
+        violations.append(inv.Violation(
+            "churn-resident-over-cap",
+            f"{over_samples}/{n_samples} scrape samples over "
+            f"cap+allowance ({cap_bytes}+{allowance}); max sample "
+            f"{max_sample}, max retained {max_final}"))
+
+    # the A/B: admission filter ON must divide the thrash ratio
+    ev_off, ev_on = (_window_eviction_totals(w) for w in (off, on))
+    thrash_halved = (
+        ev_off["thrash_events"] > 0
+        and ev_on["thrash_ratio"] * cfg.thrash_improvement
+        <= ev_off["thrash_ratio"])
+    if not thrash_halved:
+        violations.append(inv.Violation(
+            "churn-thrash-not-halved",
+            f"off ratio {ev_off['thrash_ratio']} "
+            f"({ev_off['thrash_events']}/{ev_off['installs']}) vs on "
+            f"{ev_on['thrash_ratio']} ({ev_on['thrash_events']}/"
+            f"{ev_on['installs']}); need >= {cfg.thrash_improvement}x cut"))
+
+    # fairness: no tenant past the starvation bound, either window
+    fair_v: "list[inv.Violation]" = []
+    for window in (off, on):
+        for name, rec in window["finals"].items():
+            fair_v += inv.check_fairness_never_starves(rec["fairness"])
+    violations += fair_v
+
+    # every non-served outcome is a shed citing the vocabulary, and the
+    # scraped per-tenant ledgers reconcile with the client's count
+    outcome_v = inv.check_completes_or_sheds(
+        off["outcomes"] + on["outcomes"])
+    violations += outcome_v
+    shed_map_on = _scraped_shed_tenants(on)
+    scraped_total = sum(row["total"] for row in shed_map_on.values())
+    bad_reasons = sorted(
+        {r for row in shed_map_on.values() for r in row["reasons"]}
+        - set(SHED_REASONS))
+    sheds_cited = (not outcome_v and not bad_reasons
+                   and scraped_total == on["sheds"])
+    if bad_reasons or scraped_total != on["sheds"]:
+        violations.append(inv.Violation(
+            "churn-shed-attribution",
+            f"scraped sheds {scraped_total} vs client {on['sheds']}; "
+            f"off-vocabulary reasons {bad_reasons}"))
+
+    # fairness contract under pressure: within-weight tenants (exactly
+    # one appearance — they can never be over their weighted share at
+    # decide time) are served and never shed; every overload-* shed
+    # lands on a multi-appearance tenant
+    within = {tid for tid, c in counts.items() if c == 1}
+    starved = sorted(
+        tid for tid in within
+        if not all(o["outcome"] == "served"
+                   for o in on["outcomes"] if o["tenant"] == tid))
+    shed_within = sorted(tid for tid in shed_map_on if tid in within)
+    misattributed = sorted(
+        tid for tid, row in shed_map_on.items()
+        if counts.get(tid, 0) < 2
+        and any(r.startswith("overload-") for r in row["reasons"]))
+    within_ok = not starved and not shed_within
+    absorbed_ok = not misattributed
+    if not within_ok:
+        violations.append(inv.Violation(
+            "churn-within-weight-starved",
+            f"within-weight tenants shed or unserved: "
+            f"{(starved + shed_within)[:5]}"))
+    if not absorbed_ok:
+        violations.append(inv.Violation(
+            "churn-shed-misattributed",
+            f"overload sheds on single-appearance tenants: "
+            f"{misattributed[:5]}"))
+
+    # brownout recovery: every downward guard transition steps exactly
+    # one rung and only fires below the hysteresis mark
+    enter, hyst = OverloadGuard.ENTER, OverloadGuard.HYSTERESIS
+    mono_v = []
+    for name, rec in on["finals"].items():
+        for t in (rec["guard_evidence"].get("transitions") or []):
+            frm, to = int(t["from"]), int(t["to"])
+            if to < frm and (frm - to != 1
+                             or t["pressure"] >= enter[frm] - hyst):
+                mono_v.append(f"{name}: {t}")
+    if mono_v:
+        violations.append(inv.Violation(
+            "churn-brownout-not-monotone",
+            f"non-monotone or early down transitions: {mono_v[:3]}"))
+
+    # strict noop: the OFF window's overload plane must be inert
+    off_sheds = sum(
+        int(st.get("shed_admission") or 0) + int(st.get("shed_queue") or 0)
+        for rec in off["finals"].values()
+        for st in (rec["fairness"]["tenants"] or {}).values())
+    off_counters = {k: v for rec in off["finals"].values()
+                    for k, v in (rec["overload_counters"] or {}).items()
+                    if v}
+    off_inert = (off["sheds"] == 0 and off_sheds == 0
+                 and not any(rec["overload_enabled"]
+                             for rec in off["finals"].values())
+                 and not off_counters)
+    if not off_inert:
+        violations.append(inv.Violation(
+            "churn-off-window-not-inert",
+            f"disabled window shed {off['sheds']}/{off_sheds} "
+            f"(client/scraped) or counted activity {off_counters}"))
+
+    criteria = {
+        "replicas_are_real_subprocesses": real,
+        "schedule_fully_realized": realized,
+        "resident_bytes_capped": resident_capped,
+        "thrash_halved_by_admission_filter": thrash_halved,
+        "fairness_never_starves": not fair_v,
+        "sheds_cite_reason_vocabulary": sheds_cited,
+        "within_weight_tenants_never_shed": within_ok,
+        "overload_sheds_absorbed_by_over_rate_tenants": absorbed_ok,
+        "brownout_recovery_monotone": not mono_v,
+        "off_window_inert": off_inert,
+        "invariants_hold": not violations,
+    }
+    if cfg.require_sheds:
+        criteria["overload_sheds_observed"] = on["sheds"] > 0
+        if on["sheds"] == 0:
+            violations.append(inv.Violation(
+                "churn-no-sheds",
+                "the ON window never shed: the attribution audits were "
+                "vacuous at this scale"))
+            criteria["invariants_hold"] = False
+    evidence = {
+        "eviction_off": ev_off,
+        "eviction_on": ev_on,
+        "resident": {"cap_bytes": cap_bytes, "per_solver_bytes": per_solver,
+                     "inflight_allowance_bytes": allowance,
+                     "max_sample_bytes": max_sample,
+                     "max_retained_bytes": max_final,
+                     "samples": n_samples},
+        "shed_tenants_on": shed_map_on,
+        "within_weight_tenants": len(within),
+    }
+    return criteria, violations, evidence
+
+
+def run_drill(cfg: DrillConfig, out_dir: "Optional[str]" = None) -> dict:
+    plan = build_replay_plan(cfg)
+    items = build_items(cfg)
+    per_solver = calibrate_solver_bytes()
+    cap_bytes = int(per_solver * cfg.cap_solvers)
+    log_root = tempfile.mkdtemp(prefix="churn-drill-")
+    try:
+        off = _run_window(cfg, "off", False, cap_bytes, items, log_root)
+        on = _run_window(cfg, "on", True, cap_bytes, items, log_root)
+    except Exception:
+        raise
+    else:
+        shutil.rmtree(log_root, ignore_errors=True)
+    criteria, violations, evidence = audit(
+        cfg, plan, items, per_solver, cap_bytes, off, on)
+
+    def window_summary(w: dict) -> dict:
+        shed_reasons = collections.Counter(
+            o["reason"] for o in w["outcomes"]
+            if o["outcome"] == "shed")
+        return {k: w[k] for k in ("label", "overload_on", "realized",
+                                  "served", "sheds", "errors",
+                                  "error_head", "wall_s",
+                                  "solves_per_sec")} | {
+            "shed_reasons": dict(shed_reasons),
+            "eviction": _window_eviction_totals(w),
+            "guard": {n: rec["guard"] for n, rec in w["finals"].items()},
+            "guard_transitions": {
+                n: (rec["guard_evidence"].get("transitions") or [])
+                for n, rec in w["finals"].items()},
+        }
+
+    artifact = {
+        "tool": "karpenter-tpu-churn-drill",
+        "schema": 1,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "config": asdict(cfg),
+        "replay": plan,
+        "calibration": {"per_solver_bytes": per_solver,
+                        "cap_bytes": cap_bytes,
+                        "cap_solvers": cfg.cap_solvers},
+        "windows": {"off": window_summary(off), "on": window_summary(on)},
+        "audit": evidence,
+        "violations": [v.as_dict() for v in violations],
+        "criteria": criteria,
+        "passed": all(criteria.values()),
+    }
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        suffix = "" if cfg.name == "full" else f"_{cfg.name}"
+        path = os.path.join(out_dir, f"churn_drill{suffix}.json")
+        with open(path, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True, default=str)
+            f.write("\n")
+        artifact["artifact_path"] = path
+    return artifact
+
+
+# -- presubmit perf gate ----------------------------------------------------
+
+
+def gate_probe() -> dict:
+    """Tier-1-sized thrash probe for hack/check_perf_regress: ONE
+    in-process SolverService under a cap fitting ~1/4 of an 8-variant hot
+    set, driven with the drill's churn mix (admission filter on). The
+    gate trends the thrash ratio so filter rot — one-shots creeping back
+    into the main LRU — fails presubmit like any perf regression."""
+    from karpenter_tpu import overload
+    from karpenter_tpu.solver import buckets, wire
+    from karpenter_tpu.solver.buckets import HBM_CAPACITY_ENV
+    from karpenter_tpu.solver.service import SolverService, pb, hbm_key
+
+    provs = _provisioners()
+    wire_provs = [wire.provisioner_to_wire(p) for p in provs]
+
+    def sync(svc, variant: int):
+        svc.Sync(pb.SyncRequest(
+            catalog=wire.catalog_to_wire(_variant_catalog(variant)),
+            provisioners=wire_provs), None)
+
+    prev_enabled = overload.set_enabled(True)
+    svc = SolverService()
+    restore_cap = None
+    try:
+        sync(svc, 0)  # calibration install (also the probe's head)
+        per_solver = max(1, int(buckets.HBM.resident_bytes()))
+        restore_cap = _set_env(HBM_CAPACITY_ENV, str(int(per_solver * 2.5)))
+        rng = random.Random(0)
+        hot_cum = _zipf_cum(8, HOT_SKEW)
+        one_shot = itertools.count(ONE_SHOT_BASE)
+        for _ in range(60):
+            if rng.random() < 0.55:
+                sync(svc, next(one_shot))
+            else:
+                sync(svc, _zipf_idx(hot_cum, rng.random()))
+        stats = svc.eviction_stats()
+        return {"thrash_ratio": stats["thrash_ratio"],
+                "installs": stats["installs"],
+                "thrash_events": stats["thrash_events"]}
+    finally:
+        if restore_cap is not None:
+            restore_cap()
+        overload.set_enabled(prev_enabled)
+        with svc._lock:
+            keys = list(svc._cache) + list(svc._probation)
+        for key in keys:
+            buckets.HBM.release(hbm_key(key))
+
+
+def _ledger_records(artifact: dict) -> None:
+    """Record the drill's trend metrics through the SAME extractor the
+    ledger's backfill uses, against the repo-relative artifact path — a
+    later `backfill()` dedupes against what the live run wrote."""
+    from benchmarks import ledger
+
+    path = artifact.get("artifact_path")
+    if not path:
+        return
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rel = os.path.relpath(path, root)
+    for (metric, value, unit, backend, degraded,
+         workload, ts) in ledger._churn_entries(artifact):
+        ledger.append(ledger.make_entry(
+            metric, value, unit, source="benchmarks.churn_drill",
+            backend=backend, degraded=degraded, workload=workload,
+            artifact=rel, recorded_at=ts))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--small", action="store_true",
+                    help="tier-1-sized config (2 replicas, 32 tenants)")
+    ap.add_argument("--out-dir", default=None)
+    args = ap.parse_args(argv)
+    cfg = SMALL if args.small else FULL
+    out_dir = args.out_dir or os.environ.get(
+        "KARPENTER_TPU_DRILL_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+            "benchmarks", "results", "churn"))
+    artifact = run_drill(cfg, out_dir)
+    _ledger_records(artifact)
+    print(json.dumps({"passed": artifact["passed"],
+                      "criteria": artifact["criteria"],
+                      "thrash_off":
+                          artifact["audit"]["eviction_off"]["thrash_ratio"],
+                      "thrash_on":
+                          artifact["audit"]["eviction_on"]["thrash_ratio"],
+                      "sheds_on": artifact["windows"]["on"]["sheds"],
+                      "violations": artifact["violations"][:10],
+                      "artifact": artifact.get("artifact_path")},
+                     indent=2))
+    return 0 if artifact["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

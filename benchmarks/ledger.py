@@ -441,6 +441,35 @@ def _spot_entries(doc: dict):
             yield (metric, key[field], unit, "cpu", degraded, wl, None)
 
 
+def _churn_entries(doc: dict):
+    """benchmarks/churn_drill.py artifacts (full + _small): the A/B
+    eviction-thrash ratios (filter on vs off over the SAME schedule),
+    the ON window's shed volume, and each window's sustained solve rate.
+    Degraded whenever the drill failed a criterion."""
+    if doc.get("tool") != "karpenter-tpu-churn-drill":
+        return
+    cfg = doc.get("config") or {}
+    audit = doc.get("audit") or {}
+    windows = doc.get("windows") or {}
+    degraded = not doc.get("passed", False)
+    ts = doc.get("captured_at")
+    wl = {"name": "churn_drill", "config": cfg.get("name"),
+          "replicas": cfg.get("replicas"), "tenants": cfg.get("tenants"),
+          "seed": cfg.get("seed")}
+    for side in ("on", "off"):
+        ev = audit.get(f"eviction_{side}") or {}
+        if isinstance(ev.get("thrash_ratio"), (int, float)):
+            yield (f"churn_thrash_ratio_{side}", ev["thrash_ratio"],
+                   "ratio", "cpu", degraded, wl, ts)
+        w = windows.get(side) or {}
+        if isinstance(w.get("solves_per_sec"), (int, float)):
+            yield (f"churn_solves_per_sec_{side}", w["solves_per_sec"],
+                   "solves/s", "cpu", degraded, wl, ts)
+    sheds = (windows.get("on") or {}).get("sheds")
+    if isinstance(sheds, (int, float)):
+        yield ("churn_sheds_on", sheds, "count", "cpu", degraded, wl, ts)
+
+
 _BACKFILL_SOURCES = (
     ("BENCH_r0*.json", "bench.py", _bench_round_entries),
     ("benchmarks/results/bench_*.json", "benchmarks.record",
@@ -454,6 +483,8 @@ _BACKFILL_SOURCES = (
      _fleet_entries),
     ("benchmarks/results/fleet/fleet_drill*.json", "benchmarks.fleet_drill",
      _fleet_drill_entries),
+    ("benchmarks/results/churn/churn_drill*.json", "benchmarks.churn_drill",
+     _churn_entries),
     ("benchmarks/results/soak/soak_*.json", "bench.py --soak",
      _soak_entries),
     ("benchmarks/results/incremental/incremental_*.json", "bench.py --soak",

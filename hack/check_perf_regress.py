@@ -93,6 +93,18 @@ def _bench_incremental_share() -> float:
     return float(gate_probe()["encode_share"])
 
 
+def _bench_churn_thrash() -> float:
+    """Overload-plane probe (benchmarks/churn_drill.gate_probe): 60 churn
+    syncs (zipf hot set + 55% one-shot hashes) through an in-process
+    SolverService under an HBM cap, admission filter ON; the gate trends
+    the thrash ratio (re-installs of recently evicted keys per install)
+    so a regression in the anti-thrash eviction plane — filter earn
+    logic, low-water hysteresis, probation handling — fails presubmit."""
+    from benchmarks.churn_drill import gate_probe
+
+    return float(gate_probe()["thrash_ratio"])
+
+
 def _bench_critical_serialize() -> float:
     """Critical-path probe (benchmarks/critical_drill.gate_probe): a
     warmed 400-pod Solve through the in-process service; the gate trends
@@ -119,6 +131,9 @@ GATES = (
     ("critical_serialize_share",
      {"name": "critical_gate", "pods": 400}, "cpu", "share",
      "lower", _bench_critical_serialize),
+    ("churn_eviction_thrash_ratio",
+     {"name": "churn_gate", "syncs": 60}, "cpu", "ratio",
+     "lower", _bench_churn_thrash),
 )
 
 

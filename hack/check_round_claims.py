@@ -46,7 +46,7 @@ ARTIFACT_PATTERNS = [
     re.compile(r"benchmarks/[\w./*-]+"),
     re.compile(r"\b(?:tpu|bench|trace_summary|linkprobe|chaos_seed"
                r"|chaos_burst|chaos_crash|chaos_storm|failover|fleet"
-               r"|bundle_|explain|incremental|soak|critical"
+               r"|bundle_|explain|incremental|soak|critical|churn"
                r"|spotstorm|spot_)"
                r"[\w*-]*\.json(?:\.gz)?"),
     re.compile(r"[\w*-]+\.trace\.json(?:\.gz)?"),

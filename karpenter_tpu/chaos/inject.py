@@ -30,9 +30,21 @@ from ..solver.client import SolverUnavailable
 from ..utils import errors as cloud_errors
 from . import plan as planmod
 from .plan import (KIND_CLOUD_5XX, KIND_CLOUD_ICE, KIND_CLOUD_TIMEOUT,
-                   KIND_CLOCK_SKEW, KIND_KUBE_REQ_DISCONNECT,
-                   KIND_KUBE_RESP_DISCONNECT, KIND_KUBE_WATCH_RESET,
-                   KIND_SOLVER_CRASH, KIND_SPOT_BURST, FaultPlan)
+                   KIND_CLOCK_SKEW, KIND_HOST_MEM_PRESSURE, KIND_KUBE_429,
+                   KIND_KUBE_REQ_DISCONNECT, KIND_KUBE_RESP_DISCONNECT,
+                   KIND_KUBE_WATCH_RESET, KIND_SOLVER_CRASH,
+                   KIND_SPOT_BURST, KIND_WATCH_FLOOD, FaultPlan)
+
+
+# what a chaos 429 tells the client to wait (seconds; virtual under the
+# chaos FakeClock — use_virtual_sleep steps the clock instead of blocking)
+KUBE_429_RETRY_AFTER_S = 0.05
+
+# what host-memory-pressure pins the simulated RSS at: far above any
+# plausible KARPENTER_TPU_RSS_SOFT_CAP_BYTES, so an armed overload guard
+# reads pressure 1.0 while guards without a cap (every legacy scenario)
+# read the same number and stay quiet
+MEM_PRESSURE_RSS_BYTES = 32 << 30
 
 
 def shrink_batcher_windows(op) -> None:
@@ -106,6 +118,9 @@ class ChaosInjector:
         self.consolidation_actions: "list[dict]" = []
         # ICE pools currently injected -> cycle index at which they expire
         self._ice_expiry: "dict[tuple[str, str, str], int]" = {}
+        # host-memory-pressure fault: cycle index at which the simulated
+        # RSS clears again (None = not armed)
+        self._mem_expiry: "int | None" = None
         self._cycle_rng = planmod.ChaosRng(
             (plan.seed << 8) ^ plan.scenario).fork("cycle-choices")
 
@@ -150,7 +165,9 @@ class ChaosInjector:
         self._wrap_cloud_api(cloud.create_fleet_api, "cloud.create_fleet")
         self._wrap_cloud_api(cloud.describe_instances_api, "cloud.describe")
         self._wrap_cloud_api(cloud.terminate_instances_api, "cloud.terminate")
-        self._wrap_kube_writes(op.kube)
+        hub = getattr(op, "resilience", None)
+        self._wrap_kube_writes(
+            op.kube, policy=hub.policy("kube") if hub is not None else None)
         self._hook_consolidation_ledger(op)
         self.tune_operator(op)
 
@@ -199,7 +216,7 @@ class ChaosInjector:
 
         mocked_fn.default_fn = wrapped
 
-    def _wrap_kube_writes(self, kube) -> None:
+    def _wrap_kube_writes(self, kube, policy=None) -> None:
         """Emulate the httpkube transport's failure phases against the
         in-process store: request-phase means the write never applied;
         response-phase means it DID apply and only the ack was lost — the
@@ -215,10 +232,22 @@ class ChaosInjector:
         for method in ("create", "update", "delete", "bind_pod"):
             orig = getattr(kube, method)
 
-            def wrapped(*args, _orig=orig, _method=method, **kwargs):
+            def wrapped(*args, _orig=orig, _method=method, _policy=policy,
+                        **kwargs):
                 if _method != "bind_pod" and args and args[0] in skip_kinds:
                     return _orig(*args, **kwargs)
                 fault = self.maybe("kube.write")
+                if fault is not None and fault.kind == KIND_KUBE_429:
+                    # apiserver throttle: the write is REFUSED (never
+                    # applied) and the server's Retry-After is honored
+                    # through the kube edge's RetryPolicy — the same
+                    # clamped sleep the real httpkube transport takes
+                    # (virtual time under the chaos FakeClock)
+                    if _policy is not None:
+                        _policy.sleep_retry_after(KUBE_429_RETRY_AFTER_S)
+                    raise ApiError(
+                        429, f"chaos: {_method} throttled by the apiserver",
+                        retry_after=KUBE_429_RETRY_AFTER_S)
                 if fault is not None and fault.kind == KIND_KUBE_REQ_DISCONNECT:
                     raise ApiError(
                         0, f"chaos: connection lost before {_method} was sent")
@@ -318,6 +347,11 @@ class ChaosInjector:
             if cycle >= expires:
                 cloud.insufficient_capacity_pools.discard(pool)
                 del self._ice_expiry[pool]
+        if self._mem_expiry is not None and cycle >= self._mem_expiry:
+            from .. import overload
+
+            overload.set_simulated_rss(None)
+            self._mem_expiry = None
         for site in sorted(planmod.CYCLE_SITES):
             fault = self.maybe(site)
             if fault is None:
@@ -330,6 +364,13 @@ class ChaosInjector:
                 op.clock.step(fault.param)
             elif fault.kind == KIND_KUBE_WATCH_RESET:
                 self._inject_watch_reset(op)
+            elif fault.kind == KIND_HOST_MEM_PRESSURE:
+                self._inject_mem_pressure(cycle, fault)
+            elif fault.kind == KIND_WATCH_FLOOD:
+                # a flood is N resets back to back: the relist echo storm,
+                # amplified — every watcher absorbs param× the object churn
+                for _ in range(int(fault.param)):
+                    self._inject_watch_reset(op)
             applied.append(fault.kind)
         return applied
 
@@ -361,6 +402,17 @@ class ChaosInjector:
                 "source": "cloud.spot",
                 "detail-type": "Spot Instance Interruption Warning",
                 "detail": {"instance-id": iid}}))
+
+    def _inject_mem_pressure(self, cycle: int, fault) -> None:
+        """Pin the overload plane's simulated host RSS at the cap for
+        `param` cycles. The simulation hook is deliberately plane-global
+        (guards read it whether or not the plane is enabled) — the strict
+        noop audit needs the DISABLED plane to see identical inputs and
+        still do nothing."""
+        from .. import overload
+
+        overload.set_simulated_rss(MEM_PRESSURE_RSS_BYTES)
+        self._mem_expiry = cycle + int(fault.param)
 
     def _inject_watch_reset(self, op) -> None:
         """A dropped watch stream forces a relist, and the relist replays

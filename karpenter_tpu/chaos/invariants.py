@@ -621,6 +621,38 @@ def check_spot_noop(spot) -> "list[Violation]":
     return out
 
 
+def check_overload_noop(overload) -> "list[Violation]":
+    """overload-strict-noop: the overload/backpressure plane is graduated
+    and OPTIONAL — with KARPENTER_TPU_OVERLOAD=0 every guard observation
+    returns accept, the admission filter admits everything straight to
+    the main LRU, low-water eviction never runs, and the simulated-RSS
+    hook counts nothing. The runner drives a disabled probe window (guard
+    observations under synthetic pressure + admission offers + decide
+    calls) and hands us before/after activity counters
+    (karpenter_tpu.overload.activity()); ANY growth means a producer
+    ignored the switch and backpressure leaked into the disabled path."""
+    if not overload or overload.get("enabled", True):
+        return []  # not part of this drill, or plane was left on
+    out: "list[Violation]" = []
+    before = overload.get("before") or {}
+    after = overload.get("after") or {}
+    for key in sorted(set(before) | set(after)):
+        grew = after.get(key, 0) - before.get(key, 0)
+        if grew > 0:
+            out.append(Violation(
+                "overload-strict-noop",
+                f"overload plane disabled but {key} grew by {grew} "
+                f"({before.get(key, 0)} -> {after.get(key, 0)})"))
+    decisions = overload.get("decisions") or []
+    wrong = [d for d in decisions if d != "accept"]
+    if wrong:
+        out.append(Violation(
+            "overload-strict-noop",
+            f"overload plane disabled but {len(wrong)} probe decision(s) "
+            f"were not 'accept': {sorted(set(wrong))}"))
+    return out
+
+
 def check_spot_cost_never_raised(ledger: "list[dict]") -> "list[Violation]":
     """spot-cost-never-raised: every proactive rebalance replacement must
     cost (sticker price) no more than the at-risk node it relieves —
@@ -881,7 +913,7 @@ def check_all(op, cloud, token_launches=None,
               resilience=None, profiling=None,
               explain=None, membership=None,
               incremental=None, critical=None,
-              spot=None) -> "list[Violation]":
+              spot=None, overload=None) -> "list[Violation]":
     out = []
     out += check_token_ledger(token_launches or {})
     out += check_bijection(op, cloud)
@@ -908,4 +940,9 @@ def check_all(op, cloud, token_launches=None,
     # scenario (two-window evidence, same shape as the critical plane) —
     # see chaos/runner.py
     out += check_spot_noop((spot or {}).get("noop"))
+    # the overload plane runs the same two-window probe shape: window A
+    # disabled under synthetic pressure (counters must freeze, decisions
+    # must all be accept), window B enabled (counters must move) — see
+    # chaos/runner.py
+    out += check_overload_noop((overload or {}).get("noop"))
     return out

@@ -33,6 +33,11 @@ KIND_SPOT_BURST = "spot-burst"            # interruption warnings for running sp
 KIND_CLOCK_SKEW = "clock-skew"            # fake clock jumps forward
 # process layer
 KIND_CRASH = "crash"                      # process dies at a named crashpoint
+# overload layer (ISSUE 20): resource-pressure faults the overload plane
+# must absorb — and must NOT react to while disabled (strict noop)
+KIND_HOST_MEM_PRESSURE = "host-memory-pressure"  # simulated RSS pins at the cap
+KIND_WATCH_FLOOD = "watch-event-flood"           # repeated watch resets, one cycle
+KIND_KUBE_429 = "kube-429-throttle"              # write throttled w/ Retry-After
 
 LAYER_OF_KIND = {
     KIND_CLOUD_5XX: "cloud",
@@ -46,6 +51,9 @@ LAYER_OF_KIND = {
     KIND_SPOT_BURST: "environment",
     KIND_CLOCK_SKEW: "environment",
     KIND_CRASH: "process",
+    KIND_HOST_MEM_PRESSURE: "environment",
+    KIND_WATCH_FLOOD: "kube",
+    KIND_KUBE_429: "kube",
 }
 
 # -- sites -------------------------------------------------------------------
@@ -56,7 +64,8 @@ CALL_SITES = {
     "cloud.create_fleet": (KIND_CLOUD_5XX, KIND_CLOUD_TIMEOUT),
     "cloud.describe": (KIND_CLOUD_5XX, KIND_CLOUD_TIMEOUT),
     "cloud.terminate": (KIND_CLOUD_5XX,),
-    "kube.write": (KIND_KUBE_REQ_DISCONNECT, KIND_KUBE_RESP_DISCONNECT),
+    "kube.write": (KIND_KUBE_REQ_DISCONNECT, KIND_KUBE_RESP_DISCONNECT,
+                   KIND_KUBE_429),
     "solver.solve": (KIND_SOLVER_CRASH,),
     # armed only when the scenario runs over the wire (runner wire=True)
     "wire.create_fleet": (KIND_WIRE_5XX_POST_DISPATCH,),
@@ -67,6 +76,8 @@ CYCLE_SITES = {
     "cycle.spot": (KIND_SPOT_BURST,),
     "cycle.clock": (KIND_CLOCK_SKEW,),
     "cycle.watch": (KIND_KUBE_WATCH_RESET,),
+    "cycle.mem": (KIND_HOST_MEM_PRESSURE,),
+    "cycle.watchflood": (KIND_WATCH_FLOOD,),
 }
 
 
@@ -188,6 +199,10 @@ class FaultPlan:
                     param = float(r.randint(1, 3))     # instances interrupted
                 elif kind == KIND_CLOUD_ICE:
                     param = float(r.randint(2, 5))     # cycles the pool is ICE
+                elif kind == KIND_HOST_MEM_PRESSURE:
+                    param = float(r.randint(2, 4))     # cycles RSS stays pinned
+                elif kind == KIND_WATCH_FLOOD:
+                    param = float(r.randint(2, 5))     # resets injected at once
                 else:
                     param = 0.0
                 per[idx] = FaultSpec(site, idx, kind, param)

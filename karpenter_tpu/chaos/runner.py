@@ -371,6 +371,14 @@ class ChaosRunner:
             for pool in list(injector._ice_expiry):
                 cloud.insufficient_capacity_pools.discard(pool)
             injector._ice_expiry.clear()
+            # a still-armed host-memory-pressure fault is weather too: its
+            # expiry cycle may lie past CHAOS_CYCLES, and a leaked
+            # simulated RSS would poison the overload probe windows below
+            # (and every later scenario in this process)
+            from .. import overload as overload_plane
+            if injector._mem_expiry is not None:
+                overload_plane.set_simulated_rss(None)
+                injector._mem_expiry = None
             settle_cycles = 0
             for _ in range(self.SETTLE_DEADLINE):
                 settle_cycles += 1
@@ -517,6 +525,70 @@ class ChaosRunner:
                                     - spot_off_before[k]
                                     for k in spot_off_before}},
             }
+            # overload plane: the same two-window probe shape. A chaos
+            # scenario never runs a fleet frontend, so the backpressure
+            # surface needs a dedicated probe: a fresh guard spiked to
+            # brownout by simulated host pressure, then recovered; a
+            # fresh admission filter offered a repeat-sighting catalog
+            # hash. The enabled window proves the producers count; the
+            # disabled window drives the IDENTICAL surface and any
+            # counter growth — or any decide() verdict other than
+            # "accept" — is an overload-strict-noop violation. The
+            # churn drill is the complement where the plane runs hot.
+
+            def _overload_probe():
+                guard = overload_plane.OverloadGuard(
+                    clock=op.clock, rss_soft_cap=1 << 30)
+                admission = overload_plane.AdmissionFilter()
+                decisions = []
+                try:
+                    overload_plane.set_simulated_rss(2 << 30)  # 2x the cap
+                    guard.observe(backlog=1.0, deadline=0.8)
+                    decisions.append(guard.decide(over_rate=True))
+                    overload_plane.set_simulated_rss(0)
+                    guard.observe()  # pressure gone -> one-step recovery
+                    decisions.append(guard.decide(over_rate=False))
+                    admission.offer("probe-hash-a")
+                    admission.offer("probe-hash-a")  # second sighting earns
+                    admission.offer("probe-hash-b")
+                finally:
+                    overload_plane.set_simulated_rss(None)
+                return decisions
+
+            ov_prev = overload_plane.set_enabled(True)
+            ov_on_before = overload_plane.activity()
+            _overload_probe()
+            _overload_probe()
+            ov_on_after = overload_plane.activity()
+            overload_plane.set_enabled(False)
+            ov_off_before = overload_plane.activity()
+            ov_off_decisions = _overload_probe() + _overload_probe()
+            ov_off_after = overload_plane.activity()
+            overload_plane.set_enabled(ov_prev)
+            overload_evidence = {
+                "enabled": {"enabled": True,
+                            "before": ov_on_before,
+                            "after": ov_on_after},
+                "noop": {"enabled": False,
+                         "before": ov_off_before,
+                         "after": ov_off_after,
+                         "decisions": ov_off_decisions},
+            }
+            # probe guards/filters are constructed fresh each call, so
+            # every enabled-window delta is a pure function of the probe
+            # (unlike spot's sticky ladder) — the stored dict carries
+            # them all
+            overload_stored = {
+                "enabled": {"enabled": True,
+                            "deltas": {k: ov_on_after[k]
+                                       - ov_on_before[k]
+                                       for k in ov_on_before}},
+                "noop": {"enabled": False,
+                         "deltas": {k: ov_off_after[k]
+                                    - ov_off_before[k]
+                                    for k in ov_off_before},
+                         "decisions": ov_off_decisions},
+            }
             expl_after = explain.activity()
             explain_evidence = {
                 "enabled": False,
@@ -568,7 +640,8 @@ class ChaosRunner:
                 membership=membership_evidence,
                 incremental=incremental_evidence,
                 critical=critical_evidence,
-                spot=spot_evidence)
+                spot=spot_evidence,
+                overload=overload_evidence)
             if not self._quiescent(op):
                 violations = [invariants.Violation(
                     "quiescence",
@@ -596,6 +669,10 @@ class ChaosRunner:
             explain.set_enabled(expl_prev)
             fleet_membership.set_enabled(mem_prev)
             incremental.set_enabled(inc_prev)
+            # never let a simulated RSS escape this scenario, even on the
+            # exception path (the settle-phase clear may not have run)
+            from .. import overload as _overload
+            _overload.set_simulated_rss(None)
             op.stop()
 
         fired_kinds = sorted(injector.fired_kinds())
@@ -619,6 +696,7 @@ class ChaosRunner:
             "incremental": incremental_stored,
             "critical": critical_stored,
             "spot": spot_stored,
+            "overload": overload_stored,
             "violations": [v.as_dict() for v in violations],
             "passed": not violations,
         }

@@ -53,9 +53,27 @@ _API = {
 
 
 class ApiError(RuntimeError):
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str,
+                 retry_after: "Optional[float]" = None):
         super().__init__(f"HTTP {code}: {message}")
         self.code = code
+        # server-directed backoff (429 Retry-After header, seconds); the
+        # client has already honored it through the retry policy's clamped
+        # sleep by the time this propagates — the attribute lets callers
+        # see what was asked
+        self.retry_after = retry_after
+
+
+def _retry_after_seconds(raw: "Optional[str]") -> "Optional[float]":
+    """Parse a Retry-After header's delta-seconds form (the HTTP-date
+    form is ignored — an apiserver throttle always sends seconds)."""
+    if raw is None:
+        return None
+    try:
+        seconds = float(raw.strip())
+    except (ValueError, AttributeError):
+        return None
+    return seconds if seconds >= 0 else None
 
 
 def load_kubeconfig(path: str) -> "tuple[str, Optional[str], object]":
@@ -225,6 +243,15 @@ class HttpKubeStore:
             if e.code == 409:
                 self.requests_total.inc(method=method, outcome="conflict")
                 raise Conflict(msg)
+            if e.code == 429:
+                # throttled is its own outcome (not lumped with 5xx): the
+                # server is ALIVE and pacing us — honor its Retry-After
+                # through the policy's clamped, FakeClock-injectable sleep
+                self.requests_total.inc(method=method, outcome="throttled")
+                ra = _retry_after_seconds(e.headers.get("Retry-After"))
+                if ra is not None and self._policy is not None:
+                    self._policy.sleep_retry_after(ra)
+                raise ApiError(e.code, msg, retry_after=ra)
             self.requests_total.inc(method=method, outcome=f"http_{e.code}")
             raise ApiError(e.code, msg)
         except urllib.error.URLError as e:
@@ -387,6 +414,17 @@ class HttpKubeStore:
                 if '"Fenced"' in text:
                     raise Fenced(text)
                 raise Conflict(text)
+            if resp.status == 429:
+                # see _request: throttled is a pacing signal from a LIVE
+                # server, classified apart from 5xx and honored via the
+                # policy's clamped Retry-After sleep
+                self.requests_total.inc(method=method, outcome="throttled")
+                ra = _retry_after_seconds(resp.getheader("Retry-After"))
+                if ra is not None and pol is not None:
+                    pol.sleep_retry_after(ra)
+                raise ApiError(resp.status,
+                               payload.decode(errors="replace")[:300],
+                               retry_after=ra)
             if resp.status >= 400:
                 self.requests_total.inc(method=method,
                                         outcome=f"http_{resp.status}")

@@ -55,10 +55,14 @@ CLAUSES = (
 # Fleet shed causes (fleet/frontend.py and fleet/failover.py note_shed
 # call sites cite these literally; the storm drill asserts every
 # admission/queue shed in the artifact carries one, the partition drill
-# asserts the quarantine shed does).
+# asserts the quarantine shed does, and the churn drill asserts the
+# overload plane's sheds cite the overload-* rows).
 SHED_REASONS = (
     "deadline",
     "poison-quarantine",
+    "overload-pressure",
+    "overload-queue-overflow",
+    "overload-brownout",
 )
 
 # Node drain causes (controllers/interruption cites the reactive one per

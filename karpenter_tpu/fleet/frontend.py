@@ -51,8 +51,10 @@ import weakref
 from collections import OrderedDict, deque
 from typing import Callable, Optional, Sequence
 
+from .. import overload
 from ..explain import note_shed
 from ..models.pod import group_pods
+from ..resilience.degrade import DegradeLadder
 from ..tracing import TRACER
 from ..utils.clock import Clock
 from . import metrics as fm
@@ -94,7 +96,7 @@ class _Ticket:
     __slots__ = ("tenant_id", "pods", "existing", "daemon_overhead", "key",
                  "plan", "deadline_ms", "admitted_tick", "admitted_at",
                  "served_tick", "latency_s", "result", "error", "_event",
-                 "seq", "trace_ctx")
+                 "seq", "trace_ctx", "deferred")
 
     def __init__(self, tenant_id, pods, existing, daemon_overhead, key,
                  plan, deadline_ms, admitted_tick, admitted_at, seq,
@@ -114,6 +116,9 @@ class _Ticket:
         self.error = None
         self._event = threading.Event()
         self.seq = seq
+        # overload "defer" verdict: the ticket keeps its fair-share slots
+        # but is excluded from the spare-capacity backlog drain
+        self.deferred = False
         # the caller's SpanContext when it sent one over the wire: the
         # queue-wait span joins ITS trace, so a federated trace shows the
         # wait inside this replica's lane, not as an orphan trace
@@ -143,7 +148,7 @@ class _Ticket:
 
 class _TenantState:
     __slots__ = ("key", "weight", "submitted", "served", "shed_admission",
-                 "shed_queue", "errors", "max_wait_ticks")
+                 "shed_queue", "errors", "max_wait_ticks", "reasons")
 
     def __init__(self, key, weight: int):
         self.key = key
@@ -154,13 +159,26 @@ class _TenantState:
         self.shed_queue = 0
         self.errors = 0
         self.max_wait_ticks = 0
+        # where -> reason -> count, updated in lockstep with the totals
+        # above so shed_attribution() sums reconcile against them
+        self.reasons: "dict[str, dict[str, int]]" = {}
+
+    def record_shed(self, where: str, reason: str) -> None:
+        if where == "admission":
+            self.shed_admission += 1
+        else:
+            self.shed_queue += 1
+        per = self.reasons.setdefault(where, {})
+        per[reason] = per.get(reason, 0) + 1
 
     def as_dict(self) -> dict:
         return {"weight": self.weight, "submitted": self.submitted,
                 "served": self.served,
                 "shed_admission": self.shed_admission,
                 "shed_queue": self.shed_queue, "errors": self.errors,
-                "max_wait_ticks": self.max_wait_ticks}
+                "max_wait_ticks": self.max_wait_ticks,
+                "shed_reasons": {w: dict(rs)
+                                 for w, rs in sorted(self.reasons.items())}}
 
 
 class FleetFrontend:
@@ -202,6 +220,17 @@ class FleetFrontend:
         self.ticks_run = 0
         self.mega_solves = 0
         self._depth_labels: "set[str]" = set()
+        # overload-control plane (strict noop while KARPENTER_TPU_OVERLOAD
+        # is falsy): the guard recomputes pressure per submission and its
+        # brownout level rides a resilience DegradeLadder; the backlog
+        # bound caps any one tenant's queue depth (oldest-drop overflow).
+        # probe_interval short: brownout should re-probe within a few
+        # ticks of pressure clearing, not the kube-chain's two minutes.
+        self.tenant_backlog_max = overload.tenant_backlog_max_default()
+        self.guard = overload.OverloadGuard(
+            clock=self.clock,
+            ladder=DegradeLadder("overload", ("normal", "brownout"),
+                                 clock=self.clock, probe_interval_s=1.0))
         _ACTIVE.add(self)
 
     # -- tenant registration ---------------------------------------------------
@@ -278,7 +307,7 @@ class FleetFrontend:
             # answer would arrive after the caller's cycle gave up on it
             min_budget = self.tick_interval_s * 1000.0 + SHED_MIN_BUDGET_MS
             if ticket.deadline_ms and ticket.deadline_ms < min_budget:
-                st.shed_admission += 1
+                st.record_shed("admission", "deadline")
                 fm.SHED.inc(tenant=tlabel, where="admission")
                 fm.TENANT_SHED.inc(tenant=tlabel, where="admission",
                                    reason="deadline")
@@ -290,9 +319,75 @@ class FleetFrontend:
                     f"next {self.tick_interval_s * 1000:.0f}ms tick; "
                     f"shedding at admission"))
                 return ticket
+            # overload plane (strict noop while disabled: observe returns
+            # 0 and decide returns "accept" without touching a counter).
+            # backlog input: total queue depth vs the fairness plane's
+            # drain capacity (starvation_bound ticks of full waves);
+            # deadline input: how close this budget sits to the shed floor
+            queued_total = sum(len(q) for per in self._queues.values()
+                               for q in per.values())
+            capacity = float(self.starvation_bound * self.max_wave)
+            deadline_input = (min_budget / float(ticket.deadline_ms)
+                              if ticket.deadline_ms else 0.0)
+            level = self.guard.observe(
+                backlog=queued_total / capacity if capacity else 0.0,
+                deadline=deadline_input)
+            if level > 0:
+                # only tenants over their weighted share absorb pressure:
+                # the fairness contract is the one thing overload never buys
+                tenant_queued = sum(len(per.get(tenant_id, ()))
+                                    for per in self._queues.values())
+                verdict = self.guard.decide(
+                    over_rate=tenant_queued >= st.weight)
+                if verdict == "brownout":
+                    st.record_shed("admission", "overload-brownout")
+                    fm.SHED.inc(tenant=tlabel, where="admission")
+                    fm.TENANT_SHED.inc(tenant=tlabel, where="admission",
+                                       reason="overload-brownout")
+                    note_shed(tenant_id, "admission", "overload-brownout",
+                              ts=self.clock.now())
+                    ticket._resolve(error=FleetShed(
+                        "admission",
+                        f"replica browned out (pressure "
+                        f"{self.guard.pressure():.2f}) and tenant "
+                        f"{tenant_id!r} is over its weighted share"))
+                    return ticket
+                if verdict == "shed":
+                    st.record_shed("admission", "overload-pressure")
+                    fm.SHED.inc(tenant=tlabel, where="admission")
+                    fm.TENANT_SHED.inc(tenant=tlabel, where="admission",
+                                       reason="overload-pressure")
+                    note_shed(tenant_id, "admission", "overload-pressure",
+                              ts=self.clock.now())
+                    ticket._resolve(error=FleetShed(
+                        "admission",
+                        f"overload pressure {self.guard.pressure():.2f} "
+                        f"and tenant {tenant_id!r} is over its weighted "
+                        f"share; shedding at admission"))
+                    return ticket
+                if verdict == "defer":
+                    ticket.deferred = True
             bucket = (st.key, plan)
             per_tenant = self._queues.setdefault(bucket, OrderedDict())
-            per_tenant.setdefault(tenant_id, deque()).append(ticket)
+            q = per_tenant.setdefault(tenant_id, deque())
+            q.append(ticket)
+            if overload.enabled() and len(q) > self.tenant_backlog_max:
+                # bounded per-tenant backlog, deterministic oldest-drop:
+                # the aged ticket has the least budget left, so it is the
+                # one a bounded queue sheds
+                oldest = q.popleft()
+                st.record_shed("queue", "overload-queue-overflow")
+                fm.SHED.inc(tenant=tlabel, where="queue")
+                fm.TENANT_SHED.inc(tenant=tlabel, where="queue",
+                                   reason="overload-queue-overflow")
+                note_shed(tenant_id, "queue", "overload-queue-overflow",
+                          ts=self.clock.now())
+                overload.note_queue_overflow()
+                oldest._resolve(error=FleetShed(
+                    "queue",
+                    f"tenant backlog exceeded the bound "
+                    f"{self.tenant_backlog_max}; dropping the oldest "
+                    f"queued ticket"))
             self._observe_depths_locked()
         return ticket
 
@@ -360,7 +455,7 @@ class FleetFrontend:
                     remaining = t.deadline_ms - (now - t.admitted_at) * 1000.0
                     if remaining < SHED_MIN_BUDGET_MS:
                         st = self._tenants[tenant_id]
-                        st.shed_queue += 1
+                        st.record_shed("queue", "deadline")
                         tlabel = fm.tenant_peek(tenant_id)
                         fm.SHED.inc(tenant=tlabel, where="queue")
                         fm.TENANT_SHED.inc(tenant=tlabel, where="queue",
@@ -410,10 +505,18 @@ class FleetFrontend:
                 budget -= take
             self._rr[bucket] = self._rr.get(bucket, 0) + max(1, granted)
         # spare capacity drains backlog: oldest admission first, across
-        # every tenant (a hot tenant may fill this, never the fair pass)
+        # every tenant (a hot tenant may fill this, never the fair pass).
+        # Overload-deferred tickets sit the spare pass out until their age
+        # nears the starvation bound — "defer" requeues WITHIN the bound:
+        # fair-share slots still drain the tenant, spare yields to fresher
+        # within-weight traffic, and the wait-bound contract still holds
+        # (aged tickets sort oldest-first, so they reclaim spare first)
         if budget > 0:
+            spare_age = max(0, self.starvation_bound - 1)
             backlog = sorted(
-                (t for q in per_tenant.values() for t in q),
+                (t for q in per_tenant.values() for t in q
+                 if not t.deferred
+                 or self._tick - t.admitted_tick >= spare_age),
                 key=lambda t: (t.admitted_tick, t.seq))
             for t in backlog[:budget]:
                 per_tenant[t.tenant_id].remove(t)
@@ -428,15 +531,18 @@ class FleetFrontend:
         vmapped dispatch per padded shape, one device->host read for all
         tenants (solver/core.py)."""
         svc = self.service
-        with svc._lock:
-            entry = svc._cache.get(key)
-            if entry is not None:
-                svc._cache.move_to_end(key)
+        # checkout pins the resident entry: a concurrent Sync's eviction
+        # pass (capacity, HBM pressure, or low-water) can never release
+        # this solver's device grid while the mega-solve is in flight
+        entry = svc.checkout(key)
         if entry is None:
             raise TenantNotSynced(
                 f"catalog hash={key[0]:x} not synced; re-Sync required")
-        solver, _seqnum = entry
-        return solver.solve_many(problems)
+        try:
+            solver, _seqnum = entry
+            return solver.solve_many(problems)
+        finally:
+            svc.checkin(key)
 
     def _dispatch(self, key, plan, batch: "list[_Ticket]") -> int:
         fm.BATCH_OCCUPANCY.observe(len(batch) / self.max_wave)
@@ -570,6 +676,11 @@ class FleetFrontend:
                 "tenants": {tid: st.as_dict()
                             for tid, st in self._tenants.items()},
                 "tenant_telemetry": fm.TENANT_GUARD.snapshot(),
+                "overload": {
+                    "enabled": overload.enabled(),
+                    "tenant_backlog_max": self.tenant_backlog_max,
+                    "guard": self.guard.snapshot(),
+                },
             }
 
     def evidence(self) -> dict:
@@ -577,26 +688,25 @@ class FleetFrontend:
         check_fairness_never_starves): per-tenant ledger + the bound."""
         s = self.stats()
         return {"starvation_bound": self.starvation_bound,
-                "queued": s["queued"], "tenants": s["tenants"]}
+                "queued": s["queued"], "tenants": s["tenants"],
+                "overload": self.guard.evidence()}
 
     def shed_attribution(self) -> dict:
         """Per-tenant shed attribution (tenant -> where -> reason -> count)
-        for the chaos storm artifact. Built from the frontend's own exact
-        ledgers — NOT the guarded metric families — so every tenant is
-        named even past the top-K, and the sums reconcile against totals
-        (the shed-attribution-sums-match-totals invariant). The only shed
-        reason today is a deadline that could not survive the queue."""
+        for the chaos storm and churn artifacts. Built from the frontend's
+        own exact ledgers — NOT the guarded metric families — so every
+        tenant is named even past the top-K, and the sums reconcile
+        against totals (the shed-attribution-sums-match-totals invariant).
+        Reasons are SHED_REASONS rows: "deadline" plus the overload
+        plane's "overload-pressure" / "overload-queue-overflow" /
+        "overload-brownout"."""
         with self._lock:
             out: "dict[str, dict]" = {}
             for tid, st in sorted(self._tenants.items()):
-                if not (st.shed_admission or st.shed_queue):
-                    continue
-                entry: "dict[str, dict]" = {}
-                if st.shed_admission:
-                    entry["admission"] = {"deadline": st.shed_admission}
-                if st.shed_queue:
-                    entry["queue"] = {"deadline": st.shed_queue}
-                out[tid] = entry
+                entry = {where: dict(rs)
+                         for where, rs in sorted(st.reasons.items()) if rs}
+                if entry:
+                    out[tid] = entry
             return out
 
 
@@ -634,8 +744,12 @@ class FleetService:
         tenant = request.tenant_id or DEFAULT_TENANT
         key = (request.catalog_hash, request.provisioner_hash)
         svc = self.service
-        with svc._lock:
-            entry = svc._cache.get(key)
+        # checkout is probation-aware (a tenant whose content the
+        # admission filter is still holding on probation is synced too);
+        # only the seqnum is needed here, so check right back in
+        entry = svc.checkout(key)
+        if entry is not None:
+            svc.checkin(key)
         if entry is None:
             context.abort(
                 grpc.StatusCode.FAILED_PRECONDITION,

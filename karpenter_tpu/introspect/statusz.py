@@ -23,12 +23,13 @@ from ..metrics import REGISTRY, Gauge, Histogram
 
 log = logging.getLogger("karpenter.statusz")
 
-# 12: added "spot" (forecaster rung + per-pool rates, risk-objective
-# counters, rebalance pending/limiter/ledger) and caches.pricing gained
-# the per-rung staleness fragment
-# (11: "critical"; 10: "incremental"; 9: "pid" + "serving";
-# 8: "decisions"; 7: "profiling"; 6: "hbm"; 5: "slo")
-SCHEMA_VERSION = 12
+# 13: added "overload" (backpressure gate state + activity counters,
+# per-frontend guard snapshots, per-service resident/thrash eviction
+# ledger)
+# (12: "spot" + caches.pricing per-rung staleness; 11: "critical";
+# 10: "incremental"; 9: "pid" + "serving"; 8: "decisions";
+# 7: "profiling"; 6: "hbm"; 5: "slo")
+SCHEMA_VERSION = 13
 
 # hard caps so a pathological operator can't make statusz unbounded
 MAX_EVENTS = 50
@@ -223,6 +224,31 @@ def _spot_section(op) -> dict:
     return out
 
 
+def _overload_section() -> dict:
+    # the overload/backpressure plane: gate state, monotone activity
+    # counters (guard observations/verdicts, admission-filter offers,
+    # low-water passes), plus each live frontend's guard snapshot and
+    # its solver service's resident/thrash eviction ledger — the numbers
+    # the churn drill's resident-bytes and thrash-ratio audits scrape
+    from .. import overload
+    from ..fleet.frontend import active_frontends
+
+    out = {"enabled": overload.enabled(),
+           "counters": overload.activity(),
+           "frontends": []}
+    for f in active_frontends():
+        # evidence carries the full transition ledger (bounded: hysteresis
+        # caps flapping) — the churn drill audits brownout monotonicity
+        # from a scrape, so the ledger must cross the process boundary
+        row = {"name": f.name, "guard": f.guard.snapshot(),
+               "evidence": f.guard.evidence()}
+        svc = getattr(f, "service", None)
+        if svc is not None and hasattr(svc, "eviction_stats"):
+            row["eviction"] = svc.eviction_stats()
+        out["frontends"].append(row)
+    return out
+
+
 def _serving_section(op) -> "dict | None":
     """The ACTUAL bound listener ports (serving.py `ServingPlane.bound`):
     with port-0 ephemeral binds this is the only place the resolved
@@ -263,6 +289,7 @@ def snapshot(op) -> dict:
         "profiling": _fenced(_profiling_section),
         "critical": _fenced(_critical_section),
         "spot": _fenced(lambda: _spot_section(op)),
+        "overload": _fenced(_overload_section),
         "decisions": _fenced(_decisions_section),
         "metrics": _fenced(_metrics_section),
     }

@@ -122,6 +122,18 @@ class TenantTracker:
     def tracked(self) -> "dict[str, float]":
         return dict(self._counts)
 
+    def lower_bound(self, key: str) -> float:
+        """count - error: observations PROVABLY attributable to `key`.
+        The space-saving displacement above hands a newcomer the victim's
+        floor as its starting count, so under a flood of distinct keys
+        the raw count of a brand-new key can read arbitrarily high; the
+        inherited floor is also recorded as its error, so this difference
+        stays 1 for a first sighting no matter how saturated the sketch
+        is (the admission filter's earn test depends on exactly that)."""
+        if key not in self._counts:
+            return 0.0
+        return self._counts[key] - self._errors.get(key, 0.0)
+
     def __contains__(self, key: str) -> bool:
         return key in self._counts
 

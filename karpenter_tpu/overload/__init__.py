@@ -1,0 +1,58 @@
+"""Overload-control plane: graduated backpressure + anti-thrash eviction.
+
+Three cooperating pieces (ISSUE 20):
+
+* :class:`OverloadGuard` (guard.py) — one bounded [0,1] pressure signal
+  from frontend backlog vs the fairness contract, remaining cycle
+  deadline budget, HBM ledger pressure vs the capacity cap, and host
+  RSS vs a soft cap; driving a graduated ladder
+  accept -> defer -> shed -> brownout with spike-up/monotone-down
+  hysteresis. Brownout rides the existing resilience DegradeLadder.
+  Every shed the frontend takes on a guard verdict is a DecisionRecord
+  citing an ``overload-*`` SHED_REASONS entry.
+* :class:`AdmissionFilter` (eviction.py) — frequency-gated admission
+  for the solver service's content-hash resident LRU (the space-saving
+  sketch from metrics/cardinality.py): a one-shot catalog hash must
+  earn residency before it may evict a warm solver, and HBM-pressure
+  eviction drains to a low-water mark in one pass instead of
+  per-request.
+* ``karpenter_overload_*`` metric families (metrics.py) and a statusz
+  section; chaos fault kinds host-memory-pressure / watch-event-flood /
+  kube-429-throttle exercise the plane deterministically.
+
+Strict-noop contract: with ``KARPENTER_TPU_OVERLOAD=0`` nothing here
+runs and no counter in :func:`activity` moves (chaos invariant
+``overload-strict-noop``); frontend admission decisions are identical
+to a build without the plane.
+"""
+from __future__ import annotations
+
+from .eviction import AdmissionFilter, DEFAULT_SKETCH_K, EARN_COUNT
+from .guard import (DEFAULT_TENANT_BACKLOG_MAX, OverloadGuard,
+                    RSS_SOFT_CAP_ENV, TENANT_BACKLOG_MAX_ENV,
+                    host_rss_bytes, note_queue_overflow,
+                    rss_soft_cap_default, set_simulated_rss,
+                    tenant_backlog_max_default)
+from .state import FLAG_ENV, disabled, enabled, set_enabled
+
+from . import eviction as _eviction_mod
+from . import guard as _guard_mod
+
+__all__ = [
+    "AdmissionFilter", "DEFAULT_SKETCH_K", "DEFAULT_TENANT_BACKLOG_MAX",
+    "EARN_COUNT", "FLAG_ENV", "OverloadGuard", "RSS_SOFT_CAP_ENV",
+    "TENANT_BACKLOG_MAX_ENV", "activity", "disabled", "enabled",
+    "host_rss_bytes", "note_queue_overflow", "rss_soft_cap_default",
+    "set_enabled", "set_simulated_rss", "tenant_backlog_max_default",
+]
+
+
+def activity() -> "dict[str, int]":
+    """Flat monotone counters for the chaos strict-noop diff: every
+    number here must stay frozen while the plane is disabled (guard
+    observations/verdicts/transitions, admission-filter offers, the
+    low-water eviction passes)."""
+    out: "dict[str, int]" = {}
+    out.update(_guard_mod.counters())
+    out.update(_eviction_mod.counters())
+    return out

@@ -1,0 +1,115 @@
+"""Anti-thrash admission filter for the resident-solver LRU.
+
+Under catalog churn the content-hash LRU's failure mode is an eviction
+storm: a stream of one-shot catalog hashes (a tenant mutating its
+catalog every submission) each lands in the cache, evicts a warm solver
+some OTHER tenant is about to reuse, and is itself evicted one request
+later — the cache does maximal work to retain nothing. The classic fix
+is frequency-based admission (TinyLFU's shape): a newcomer must prove
+it is not one-shot before it may displace a warm entry.
+
+:class:`AdmissionFilter` reuses the space-saving sketch the cardinality
+guard already ships (`metrics/cardinality.py` TenantTracker) as that
+frequency estimate: every solver-key offer lands in a small sketch, and
+a key has "earned" residency once its estimated count reaches
+``EARN_COUNT``. The solver service consults it ONLY when the cache is
+full and eviction would be forced — an unearned key is still served
+(the solve itself is never refused here; backpressure is the guard's
+job), it just runs un-cached instead of evicting a warm solver.
+
+Strict-noop contract: the service consults the filter only while the
+plane is enabled; :meth:`offer` itself also checks, so a disabled plane
+moves no sketch state and no counter in :func:`counters`.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from . import metrics as om
+from . import state
+from ..metrics.cardinality import TenantTracker
+
+# estimated observations before a key may displace a warm resident
+# (2 = "seen again since first sight": one-shot hashes never qualify)
+EARN_COUNT = 2
+
+# sketch width: frequency estimation over recent solver keys, NOT a
+# tenant table — 4x the service LRU capacity is enough to tell one-shot
+# traffic from the hot set without tracking the whole churn stream
+DEFAULT_SKETCH_K = 16
+
+_counters_lock = threading.Lock()
+_counters = {
+    "filter_offers": 0,
+    "filter_earned": 0,
+    "filter_probation": 0,
+    "lowwater_passes": 0,
+    "lowwater_evictions": 0,
+}
+
+
+def _count(key: str, amount: int = 1) -> None:
+    with _counters_lock:
+        _counters[key] += amount
+
+
+def counters() -> "dict[str, int]":
+    with _counters_lock:
+        return dict(_counters)
+
+
+def note_lowwater(evicted: int) -> None:
+    """One pressure low-water eviction pass freed `evicted` residents
+    (service.py cites this so the pass is visible in activity())."""
+    _count("lowwater_passes")
+    if evicted:
+        _count("lowwater_evictions", evicted)
+        om.EVICTIONS.inc(evicted, cause="pressure-low-water")
+
+
+class AdmissionFilter:
+    """Frequency-gated admission for a full LRU (module docstring)."""
+
+    def __init__(self, k: "Optional[int]" = None,
+                 earn_count: int = EARN_COUNT):
+        self._lock = threading.Lock()
+        self._sketch = TenantTracker(DEFAULT_SKETCH_K if k is None else k)
+        self.earn_count = earn_count
+
+    def offer(self, key: str) -> bool:
+        """One observation of solver key `key` (the hbm_key string).
+        Returns True when the key has earned the right to displace a
+        warm resident; False keeps it on probation (serve uncached)."""
+        if not state.enabled():
+            return True  # disabled: behave exactly like the plain LRU
+        with self._lock:
+            self._sketch.offer(key)
+            # earn on the sketch's LOWER bound (count - error), never the
+            # raw count: space-saving displacement hands a newcomer the
+            # evicted slot's floor, so once one-shot traffic saturates
+            # the sketch every fresh hash would inherit count >= 2 and
+            # "earn" instantly — the exact flood this filter exists to
+            # keep out of the cache
+            earned = self._sketch.lower_bound(key) >= self.earn_count
+        _count("filter_offers")
+        if earned:
+            _count("filter_earned")
+            om.ADMISSION.inc(verdict="earned")
+        else:
+            _count("filter_probation")
+            om.ADMISSION.inc(verdict="probation")
+        return earned
+
+    def seen(self, key: str) -> float:
+        """Estimated observation count (upper bound; test surface)."""
+        with self._lock:
+            return self._sketch.tracked().get(key, 0.0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"k": self._sketch.k,
+                    "earn_count": self.earn_count,
+                    "offers": self._sketch.offers,
+                    "sketch_evictions": self._sketch.evictions,
+                    "tracked": len(self._sketch.tracked())}

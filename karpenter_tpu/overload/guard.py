@@ -1,0 +1,337 @@
+"""OverloadGuard: graduated backpressure from bounded pressure inputs.
+
+The fleet frontend already *measures* everything that matters under
+sustained overload — queue depth vs the fairness contract, per-request
+deadline budget, the HBM ledger's resident-bytes pressure — but nothing
+*acts* on those signals until a request has already burned queue time or
+forced a thrashing eviction. The guard folds them (plus host RSS vs a
+new soft cap) into one bounded pressure signal and drives a graduated
+ladder::
+
+    accept -> defer -> shed -> brownout
+
+Each input is clamped to [0, 1] and the pressure is their max — a
+replica one byte from its HBM cap is overloaded no matter how short its
+queue is. Levels rise as soon as pressure crosses an entry threshold
+and fall ONE level at a time, only after pressure drops below
+``threshold - HYSTERESIS`` — the ladder can spike up but recovers
+monotonically, so it can never flap across a boundary (the churn
+drill's brownout audit and tests/test_overload.py enforce exactly
+that edge behavior on FakeClock).
+
+Fairness contract under pressure: the guard only defers/sheds tenants
+whose CURRENT backlog already exceeds their registered weight
+(``decide(over_rate=True)``); a within-weight tenant is accepted at
+every level, so the storm drill's fairness-never-starves invariant
+holds while over-rate tenants absorb all sheds.
+
+Brownout drives the existing resilience DegradeLadder (chain
+``overload``, rungs ``normal -> brownout``) so the rung is observable
+in the same ``karpenter_resilience_degrade_rung`` gauge every other
+fallback chain uses, with the ladder's own single-step probe recovery.
+
+Strict-noop contract: every public method checks :func:`state.enabled`
+first; disabled, ``observe`` reports level 0, ``decide`` returns
+``accept``, and no counter in :func:`counters` moves.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+from . import metrics as om
+from . import state
+from ..utils.clock import Clock
+
+log = logging.getLogger("karpenter.overload.guard")
+
+# -- env knobs (crossover-knob validation idiom: solver/buckets.py) -----------
+
+RSS_SOFT_CAP_ENV = "KARPENTER_TPU_RSS_SOFT_CAP_BYTES"
+
+TENANT_BACKLOG_MAX_ENV = "KARPENTER_TPU_TENANT_BACKLOG_MAX"
+DEFAULT_TENANT_BACKLOG_MAX = 64
+
+
+def rss_soft_cap_default() -> "Optional[int]":
+    """The host-RSS soft cap in bytes, validated: unset or garbage means
+    the RSS input is disarmed (contributes 0 pressure) — same contract
+    as the HBM capacity knob (buckets.hbm_capacity_default)."""
+    raw = os.environ.get(RSS_SOFT_CAP_ENV)
+    if raw is None or raw.strip() == "":
+        return None
+    try:
+        cap = int(raw)
+    except ValueError:
+        log.warning("%s=%r is not an integer; RSS pressure disarmed",
+                    RSS_SOFT_CAP_ENV, raw)
+        return None
+    if cap <= 0:
+        log.warning("%s=%d is <= 0; RSS pressure disarmed",
+                    RSS_SOFT_CAP_ENV, cap)
+        return None
+    return cap
+
+
+def tenant_backlog_max_default() -> int:
+    """The per-tenant frontend backlog bound, validated: a garbage value
+    warns and falls back, < 1 clamps to 1 (a zero-depth queue could
+    never admit anything)."""
+    raw = os.environ.get(TENANT_BACKLOG_MAX_ENV)
+    if raw is None:
+        return DEFAULT_TENANT_BACKLOG_MAX
+    try:
+        bound = int(raw)
+    except ValueError:
+        log.warning("%s=%r is not an integer; falling back to %d",
+                    TENANT_BACKLOG_MAX_ENV, raw,
+                    DEFAULT_TENANT_BACKLOG_MAX)
+        return DEFAULT_TENANT_BACKLOG_MAX
+    if bound < 1:
+        log.warning("%s=%d is < 1; clamping to 1",
+                    TENANT_BACKLOG_MAX_ENV, bound)
+        return 1
+    return bound
+
+
+# -- host RSS (real or chaos-simulated) ---------------------------------------
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+_sim_lock = threading.Lock()
+_simulated_rss: "Optional[int]" = None
+
+
+def set_simulated_rss(nbytes: "Optional[int]") -> None:
+    """Chaos hook (fault kind ``host-memory-pressure``): override what
+    :func:`host_rss_bytes` reports until cleared with None. Deterministic
+    where real RSS is not — the drill and tests use it exclusively."""
+    global _simulated_rss
+    with _sim_lock:
+        _simulated_rss = None if nbytes is None else int(nbytes)
+    if state.enabled():
+        _count("rss_simulated_sets")
+
+
+def host_rss_bytes() -> int:
+    """Current resident set size: the chaos-simulated value when one is
+    armed, else /proc/self/statm (0 where unreadable — RSS pressure is
+    advisory, never load-bearing)."""
+    with _sim_lock:
+        if _simulated_rss is not None:
+            return _simulated_rss
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE_SIZE
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+# -- plane-gated monotone counters (overload.activity()) ----------------------
+
+_counters_lock = threading.Lock()
+_counters = {
+    "guard_observations": 0,
+    "guard_transitions_up": 0,
+    "guard_transitions_down": 0,
+    "guard_accepts": 0,
+    "guard_defers": 0,
+    "guard_sheds": 0,
+    "guard_brownout_sheds": 0,
+    "rss_simulated_sets": 0,
+    "queue_overflow_sheds": 0,
+}
+
+
+def _count(key: str, amount: int = 1) -> None:
+    with _counters_lock:
+        _counters[key] += amount
+
+
+def counters() -> "dict[str, int]":
+    with _counters_lock:
+        return dict(_counters)
+
+
+def note_queue_overflow(n: int = 1) -> None:
+    """The frontend's per-tenant backlog bound dropped `n` oldest queued
+    tickets (callers gate on :func:`state.enabled`; counted here so the
+    overflow sheds show up in overload.activity())."""
+    _count("queue_overflow_sheds", n)
+
+
+class OverloadGuard:
+    """Per-replica graduated backpressure (module docstring)."""
+
+    LEVELS = ("accept", "defer", "shed", "brownout")
+    # entry thresholds per level (index aligned with LEVELS; accept is
+    # the floor). Pressure >= ENTER[i] raises the level to i.
+    ENTER = (0.0, 0.50, 0.75, 0.90)
+    # a level is left (one step down) only once pressure has dropped
+    # below its OWN entry threshold minus this margin — spike up,
+    # recover monotonically, never flap on a boundary
+    HYSTERESIS = 0.15
+
+    def __init__(self, clock: "Optional[Clock]" = None, ladder=None,
+                 rss_soft_cap: "Optional[int]" = None):
+        self.clock = clock or Clock()
+        self.rss_soft_cap = (rss_soft_cap if rss_soft_cap is not None
+                             else rss_soft_cap_default())
+        self._lock = threading.Lock()
+        self._level = 0
+        self._pressure = 0.0
+        self._inputs: "dict[str, float]" = {}
+        self.transitions: "list[dict]" = []
+        # brownout rides the existing resilience DegradeLadder so the
+        # rung shows up in the same gauge as every other fallback chain;
+        # callers may inject their own (the frontend wires the hub's)
+        self.ladder = ladder
+
+    # -- the pressure signal ---------------------------------------------------
+
+    @staticmethod
+    def _clamp(x: "Optional[float]") -> float:
+        if x is None:
+            return 0.0
+        return 0.0 if x < 0.0 else (1.0 if x > 1.0 else float(x))
+
+    def _hbm_input(self) -> float:
+        # lazy import: the guard must stay importable without the solver
+        # stack (same reason statusz's hbm section imports lazily)
+        try:
+            from ..solver.buckets import HBM
+            return self._clamp(HBM.pressure())
+        except Exception:  # noqa: BLE001 — advisory input, never raises
+            return 0.0
+
+    def _rss_input(self) -> float:
+        if self.rss_soft_cap is None:
+            return 0.0
+        return self._clamp(host_rss_bytes() / self.rss_soft_cap)
+
+    def observe(self, *, backlog: float = 0.0,
+                deadline: float = 0.0) -> int:
+        """Recompute pressure from the caller's bounded inputs (backlog:
+        queued / fairness capacity; deadline: consumed share of the cycle
+        budget) plus the live HBM ledger and host RSS. Returns the
+        (possibly transitioned) ladder level index."""
+        if not state.enabled():
+            return 0
+        inputs = {
+            "backlog": self._clamp(backlog),
+            "deadline": self._clamp(deadline),
+            "hbm": self._hbm_input(),
+            "rss": self._rss_input(),
+        }
+        pressure = max(inputs.values())
+        with self._lock:
+            self._inputs = inputs
+            self._pressure = pressure
+            level = self._level
+            # rise: straight to the highest level whose threshold the
+            # pressure meets (a spike to 0.95 must brown out NOW, not
+            # three observes from now)
+            target = max(i for i, t in enumerate(self.ENTER)
+                         if pressure >= t)
+            if target > level:
+                self._move(level, target, pressure)
+            elif level > 0 and pressure < self.ENTER[level] - self.HYSTERESIS:
+                # fall: one step per observe — monotone recovery
+                self._move(level, level - 1, pressure)
+            level = self._level
+        _count("guard_observations")
+        for name, v in inputs.items():
+            om.PRESSURE.set(v, input=name)
+        om.PRESSURE.set(pressure, input="overall")
+        om.LEVEL.set(level)
+        self._drive_ladder(level)
+        return level
+
+    def _move(self, frm: int, to: int, pressure: float) -> None:
+        """Callers hold self._lock."""
+        self._level = to
+        self.transitions.append({
+            "ts": round(self.clock.now(), 3), "from": frm, "to": to,
+            "pressure": round(pressure, 4)})
+        _count("guard_transitions_up" if to > frm
+               else "guard_transitions_down")
+        om.TRANSITIONS.inc(direction="up" if to > frm else "down")
+
+    def _drive_ladder(self, level: int) -> None:
+        """Keep the DegradeLadder's rung in lockstep with brownout
+        through its OWN protocol: fail the current rung while browned
+        out (a due probe fails too — staying down is correct), succeed
+        the start rung otherwise (a due probe's success is what climbs
+        back to rung 0, single-step, exactly like every other chain)."""
+        ladder = self.ladder
+        if ladder is None:
+            return
+        rung = ladder.start_rung()
+        if level >= 3:
+            ladder.record_failure(rung)
+        else:
+            ladder.record_success(rung)
+
+    # -- per-submission decisions ----------------------------------------------
+
+    def decide(self, *, over_rate: bool) -> str:
+        """The verdict for ONE submission at the current level: "accept",
+        "defer" (requeue within the starvation bound), "shed", or
+        "brownout" (shed, attributed to the brownout). Within-weight
+        tenants (over_rate=False) are accepted at EVERY level — the
+        fairness contract is the one thing pressure never buys."""
+        if not state.enabled():
+            return "accept"
+        with self._lock:
+            level = self._level
+        if not over_rate or level == 0:
+            _count("guard_accepts")
+            om.DECISIONS.inc(decision="accept")
+            return "accept"
+        if level == 1:
+            _count("guard_defers")
+            om.DECISIONS.inc(decision="defer")
+            return "defer"
+        if level == 2:
+            _count("guard_sheds")
+            om.DECISIONS.inc(decision="shed")
+            return "shed"
+        _count("guard_brownout_sheds")
+        om.DECISIONS.inc(decision="brownout")
+        return "brownout"
+
+    # -- observability ---------------------------------------------------------
+
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    def level_name(self) -> str:
+        with self._lock:
+            return self.LEVELS[self._level]
+
+    def pressure(self) -> float:
+        with self._lock:
+            return self._pressure
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"level": self._level,
+                    "level_name": self.LEVELS[self._level],
+                    "pressure": round(self._pressure, 4),
+                    "inputs": {k: round(v, 4)
+                               for k, v in sorted(self._inputs.items())},
+                    "rss_soft_cap_bytes": self.rss_soft_cap,
+                    "transitions": len(self.transitions)}
+
+    def evidence(self) -> dict:
+        """The drill-auditable transition ledger (brownout monotone
+        hysteresis: every down-move steps exactly one level)."""
+        with self._lock:
+            return {"levels": list(self.LEVELS),
+                    "enter": list(self.ENTER),
+                    "hysteresis": self.HYSTERESIS,
+                    "final_level": self._level,
+                    "transitions": [dict(t) for t in self.transitions]}

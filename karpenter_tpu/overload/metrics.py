@@ -1,0 +1,54 @@
+"""Metric families for the overload-control plane.
+
+All ``karpenter_overload_*`` families live here (fleet/metrics.py
+idiom: module-level registration against the process registry so the
+docs generator's boot-and-walk sees them). None carries a tenant label
+— per-tenant shed attribution already flows through the guarded
+``karpenter_fleet_tenant_shed_total`` family with the new ``overload-*``
+reasons, so this module adds no cardinality surface.
+
+Strict-noop note: these families are written ONLY from code paths gated
+on :func:`..overload.enabled` — with the plane disabled they are as
+frozen as the :func:`..overload.activity` counters the chaos invariant
+diffs.
+"""
+from __future__ import annotations
+
+from ..metrics import NAMESPACE, REGISTRY
+
+PRESSURE = REGISTRY.gauge(
+    f"{NAMESPACE}_overload_pressure",
+    "Bounded [0,1] overload pressure per input (backlog/deadline/hbm/rss) "
+    "plus the max as input=\"overall\".", ("input",))
+
+LEVEL = REGISTRY.gauge(
+    f"{NAMESPACE}_overload_level",
+    "Current backpressure ladder level (0=accept 1=defer 2=shed "
+    "3=brownout).")
+
+DECISIONS = REGISTRY.counter(
+    f"{NAMESPACE}_overload_decisions_total",
+    "Per-submission guard verdicts (accept/defer/shed/brownout).",
+    ("decision",))
+
+TRANSITIONS = REGISTRY.counter(
+    f"{NAMESPACE}_overload_transitions_total",
+    "Ladder level transitions by direction (up moves may skip levels; "
+    "down moves are always single-step).", ("direction",))
+
+ADMISSION = REGISTRY.counter(
+    f"{NAMESPACE}_overload_admission_offers_total",
+    "Resident-LRU admission-filter verdicts: \"earned\" keys may evict a "
+    "warm solver, \"probation\" keys may only fill free capacity.",
+    ("verdict",))
+
+EVICTIONS = REGISTRY.counter(
+    f"{NAMESPACE}_overload_evictions_total",
+    "Plane-governed resident-solver evictions by cause (capacity / "
+    "the pressure low-water pass).", ("cause",))
+
+THRASH_RATIO = REGISTRY.gauge(
+    f"{NAMESPACE}_overload_eviction_thrash_ratio",
+    "Share of resident-solver installs that re-installed a recently "
+    "evicted key (the eviction-storm signature; measured always-on at "
+    "the service, published here while the plane is enabled).")

@@ -1,0 +1,53 @@
+"""Global on/off switch for the overload-control plane.
+
+The overload plane is advisory-never-load-bearing (same contract as the
+profiling/explain/membership/incremental/spot planes): every producer —
+the pressure guard, the admission frequency filter, the low-water
+eviction pass, the backlog bound — checks :func:`enabled` before doing
+ANY work, so disabling the plane is a strict no-op (zero counters, no
+deferred or shed tickets, the resident-solver LRU behaves exactly like
+the plain pre-plane eviction loop). The chaos drill enforces exactly
+that invariant (``overload-strict-noop``) with two-window evidence:
+activity counters frozen while disabled AND the frontend's admission
+decisions identical to the baseline.
+
+Default is ON (the guard is cheap: a handful of bounded ratios per
+submission); ``KARPENTER_TPU_OVERLOAD=0`` (or ``false``/``off``/``no``)
+disables it at process start, and :func:`set_enabled` /
+:func:`disabled` flip it at runtime (chaos drills, the churn drill's
+admission-filter A/B window).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+FLAG_ENV = "KARPENTER_TPU_OVERLOAD"
+_FALSY = ("0", "false", "off", "no")
+
+_lock = threading.Lock()
+_enabled = os.environ.get(FLAG_ENV, "1").strip().lower() not in _FALSY
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip the plane; returns the previous state (restore token)."""
+    global _enabled
+    with _lock:
+        prev = _enabled
+        _enabled = bool(on)
+        return prev
+
+
+@contextlib.contextmanager
+def disabled():
+    """Scoped hard-off: A/B baselines and the chaos strict-noop drill."""
+    prev = set_enabled(False)
+    try:
+        yield
+    finally:
+        set_enabled(prev)

@@ -150,6 +150,21 @@ class RetryPolicy:
         self._sleep(delay)
         return delay
 
+    def sleep_retry_after(self, seconds: float) -> float:
+        """Honor a server-directed backoff (HTTP 429 Retry-After): sleep
+        what the server asked, clamped to the policy cap — a throttling
+        apiserver gets to slow this client down, never to wedge it. The
+        directed delay flows through the same injectable sleep and the
+        same sleeps_total ledger as jittered backoff (FakeClock-testable),
+        and resets the decorrelated-jitter state so a subsequent backoff
+        does not compound on top of the server's figure."""
+        delay = min(self.cap, max(0.0, float(seconds)))
+        with self._lock:
+            self.sleeps_total += delay
+            self._prev = self.base
+        self._sleep(delay)
+        return delay
+
     def note_success(self) -> None:
         self.budget.refill()
         with self._lock:

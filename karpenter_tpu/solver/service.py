@@ -25,6 +25,9 @@ import grpc
 from ..apis.provisioner import Provisioner
 from ..models.instancetype import Catalog
 from ..tracing import TRACER
+from .. import overload
+from ..overload import eviction as overload_eviction
+from ..overload import metrics as overload_metrics
 from .core import SolveResult, TPUSolver
 from . import buckets
 from . import solver_pb2 as pb
@@ -57,6 +60,18 @@ WARMUP_LIMIT = 8
 # blind to one giant catalog crowding out three small ones. Disarmed (no-op)
 # when no capacity is declared, which is the CPU-host default.
 HBM_PRESSURE_EVICT = 0.9
+
+# With the overload plane enabled, a pressure eviction pass drains to this
+# fraction in ONE pass instead of evicting exactly back under the trigger:
+# per-request single evictions under sustained churn are the eviction-storm
+# signature (evict one, next Sync re-triggers, repeat) — hysteresis between
+# trigger and low-water makes pressure passes rare instead of constant.
+HBM_LOW_WATER = 0.7
+
+# Sliding window (in installs) over which a re-install of a recently
+# evicted key counts as a thrash event. Always-on measurement: the churn
+# drill's A/B needs the OFF window to report its thrash honestly too.
+THRASH_WINDOW = 32
 
 
 def hbm_key(key: "tuple[int, int]") -> str:
@@ -115,12 +130,35 @@ class SolverService:
 
     LRU_CAPACITY = 4
 
+    # probation side-car width: at most this many unearned newcomers hold
+    # HBM at once — a churn stream of one-shot catalogs recycles this slot
+    # among themselves and never touches the warm residents
+    PROBATION_CAPACITY = 1
+
     def __init__(self, trace_dir: "Optional[str]" = None,
                  trace_every: int = 100,
                  crossover_cells: "Optional[int]" = None):
         self._lock = threading.Lock()
         # (cat_hash, prov_hash) -> (TPUSolver, seqnum); insertion order = LRU
         self._cache: "OrderedDict[tuple[int, int], tuple[TPUSolver, int]]" = \
+            OrderedDict()
+        # in-flight pin refcounts: a pinned entry can NEVER be evicted, so
+        # a concurrent Sync's eviction pass cannot release a solver mid
+        # solve_many (checkout/checkin). Unconditional correctness — not
+        # gated on the overload plane.
+        self._pins: "dict[tuple[int, int], int]" = {}
+        # probation side-car (overload plane only): an unearned newcomer
+        # lands here instead of displacing a warm resident; it is promoted
+        # into the main LRU once the admission filter sees it again
+        self._probation: "OrderedDict[tuple[int, int], tuple[TPUSolver, int]]" = \
+            OrderedDict()
+        self._admission = overload.AdmissionFilter()
+        # always-on eviction-thrash accounting (see THRASH_WINDOW):
+        # recently evicted key -> install-seq at eviction time
+        self._installs = 0
+        self._evictions = 0
+        self._thrash_events = 0
+        self._recent_evicted: "OrderedDict[tuple[int, int], int]" = \
             OrderedDict()
         # single-vs-sharded crossover shared by every solver's router
         # (None = env/default); tests force 0 to shard everything
@@ -157,6 +195,85 @@ class SolverService:
         """Most-recently-used catalog hash (observability/tests)."""
         with self._lock:
             return self._mru()[2]
+
+    # -- residency: pins, admission, eviction accounting ---------------------------
+
+    def checkout(self, key: "tuple[int, int]") \
+            -> "Optional[tuple[TPUSolver, int]]":
+        """Pin + fetch the resident (solver, seqnum) for `key` (main LRU
+        or the probation slot); None when not synced. While the pin is
+        held no eviction pass — capacity, HBM pressure, or low-water —
+        can release this solver, so a dispatch can never race an
+        eviction. Callers MUST pair with checkin()."""
+        with self._lock:
+            entry = self._cache.get(key)
+            if entry is not None:
+                self._cache.move_to_end(key)
+            else:
+                entry = self._probation.get(key)
+            if entry is None:
+                return None
+            self._pins[key] = self._pins.get(key, 0) + 1
+            return entry
+
+    def checkin(self, key: "tuple[int, int]") -> None:
+        """Release one checkout() pin."""
+        with self._lock:
+            n = self._pins.get(key, 0) - 1
+            if n > 0:
+                self._pins[key] = n
+            else:
+                self._pins.pop(key, None)
+
+    def _note_install_locked(self, key: "tuple[int, int]") -> None:
+        self._installs += 1
+        if key in self._recent_evicted:
+            self._thrash_events += 1
+            del self._recent_evicted[key]
+        while self._recent_evicted:
+            oldest, seq = next(iter(self._recent_evicted.items()))
+            if self._installs - seq > THRASH_WINDOW:
+                del self._recent_evicted[oldest]
+            else:
+                break
+
+    def _note_eviction_locked(self, key: "tuple[int, int]") -> None:
+        self._evictions += 1
+        self._recent_evicted.pop(key, None)
+        self._recent_evicted[key] = self._installs
+
+    def _evict_one_locked(self, stores, *,
+                          protect: "Optional[tuple[int, int]]" = None) \
+            -> "Optional[tuple[int, int]]":
+        """Evict the first UNPINNED entry (LRU order, probation before the
+        main cache when both are offered) other than `protect`; releases
+        its HBM ledger rows. None when every candidate is pinned — the
+        count/pressure bound then yields to correctness and the caller
+        stops evicting."""
+        for store in stores:
+            for k in store:
+                if k == protect or self._pins.get(k, 0) > 0:
+                    continue
+                del store[k]
+                buckets.HBM.release(hbm_key(k))
+                self._note_eviction_locked(k)
+                return k
+        return None
+
+    def eviction_stats(self) -> dict:
+        """Always-on thrash accounting (statusz + churn drill A/B): a
+        thrash event is an install of a key evicted within the last
+        THRASH_WINDOW installs — the work-to-retain-nothing signature."""
+        with self._lock:
+            installs, evictions = self._installs, self._evictions
+            thrash = self._thrash_events
+            resident, probation = len(self._cache), len(self._probation)
+            pinned = sum(1 for n in self._pins.values() if n > 0)
+        ratio = (thrash / installs) if installs else 0.0
+        return {"installs": installs, "evictions": evictions,
+                "thrash_events": thrash, "thrash_ratio": round(ratio, 4),
+                "window": THRASH_WINDOW, "resident": resident,
+                "probation": probation, "pinned": pinned}
 
     def _device_context(self):
         """The process-lifetime mesh context (parallel/sharded
@@ -235,11 +352,33 @@ class SolverService:
         ctx = self._device_context()
         with self._lock:
             hit = self._cache.get(key)
+            in_probation = False
             if hit is not None:
                 # idempotent re-Sync: keep the device-resident grid
                 self._cache.move_to_end(key)
                 self._cache[key] = (hit[0], request.catalog.seqnum)
+            else:
+                hit = self._probation.get(key)
+                in_probation = hit is not None
         if hit is not None:
+            if in_probation:
+                # a repeat sighting of a probationer: offer it to the
+                # admission filter again — earning promotes the EXISTING
+                # device-resident solver into the main LRU (no rebuild)
+                earned = self._admission.offer(hbm_key(key))
+                with self._lock:
+                    entry = self._probation.pop(key, None)
+                    if entry is not None and earned:
+                        self._cache[key] = (entry[0], request.catalog.seqnum)
+                        self._cache.move_to_end(key)
+                        while len(self._cache) > self.LRU_CAPACITY:
+                            if self._evict_one_locked((self._cache,),
+                                                      protect=key) is None:
+                                break
+                    elif entry is not None:
+                        self._probation[key] = (entry[0],
+                                                request.catalog.seqnum)
+                        self._probation.move_to_end(key)
             # re-Sync still warms: the client may ship fresh hints and the
             # shape history may have grown since the solver was installed
             warmed = self._warm(hit[0], request)
@@ -265,26 +404,88 @@ class SolverService:
         # build + device-put the option grid OUTSIDE the lock so Health stays
         # responsive during catalog churn, then swap atomically; the hbm
         # scope files the grid's device puts under this solver's ledger key
+        plane_on = overload.enabled()
+        to_probation = False
+        if plane_on:
+            with self._lock:
+                full = len(self._cache) >= self.LRU_CAPACITY
+            # a residency cap that fits fewer solvers than LRU_CAPACITY
+            # means the COUNT never fills — crowding shows up as ledger
+            # pressure instead, and above the low-water mark one more
+            # resident forces a drain just as surely as a full LRU does
+            pressure = buckets.HBM.pressure()
+            crowded = pressure is not None and pressure >= HBM_LOW_WATER
+            if full or crowded:
+                # installing would evict a warm resident: a newcomer must
+                # have EARNED that (one-shot catalog hashes stay on
+                # probation and recycle one slot instead)
+                to_probation = not self._admission.offer(hbm_key(key))
         with buckets.hbm_scope(hbm_key(key)):
             solver.grid()
         with self._lock:
-            self._cache[key] = (solver, catalog.seqnum)
-            self._cache.move_to_end(key)
-            while len(self._cache) > self.LRU_CAPACITY:
-                evicted_key, _ = self._cache.popitem(last=False)
-                buckets.HBM.release(hbm_key(evicted_key))
-                log.info("evicted solver for catalog hash=%x", evicted_key[0])
+            if to_probation:
+                while len(self._probation) >= self.PROBATION_CAPACITY:
+                    if self._evict_one_locked((self._probation,),
+                                              protect=key) is None:
+                        break
+                self._probation[key] = (solver, catalog.seqnum)
+                self._note_install_locked(key)
+            else:
+                if not plane_on and self._probation:
+                    # plane toggled off with probationers resident: drain
+                    # them — disabled must behave like the plain LRU
+                    while self._probation:
+                        if self._evict_one_locked((self._probation,),
+                                                  protect=key) is None:
+                            break
+                self._cache[key] = (solver, catalog.seqnum)
+                self._cache.move_to_end(key)
+                self._note_install_locked(key)
+                while len(self._cache) > self.LRU_CAPACITY:
+                    evicted_key = self._evict_one_locked((self._cache,),
+                                                         protect=key)
+                    if evicted_key is None:
+                        break  # all pinned: bound yields to correctness
+                    log.info("evicted solver for catalog hash=%x",
+                             evicted_key[0])
+                    if plane_on:
+                        overload_metrics.EVICTIONS.inc(cause="capacity")
             # HBM pressure pass: residency, not count, is what actually
             # overflows a device — keep at least the entry just installed
             pressure = buckets.HBM.pressure()
-            while (pressure is not None and pressure > HBM_PRESSURE_EVICT
-                   and len(self._cache) > 1):
-                evicted_key, _ = self._cache.popitem(last=False)
-                freed = buckets.HBM.release(hbm_key(evicted_key))
-                log.info("HBM pressure %.2f: evicted solver for catalog "
-                         "hash=%x (freed %d bytes)",
-                         pressure, evicted_key[0], int(freed))
-                pressure = buckets.HBM.pressure()
+            if plane_on:
+                # low-water drain: one pass down to HBM_LOW_WATER — the
+                # hysteresis band between trigger and mark keeps pressure
+                # passes rare under churn instead of one-per-request
+                evicted_n = 0
+                if pressure is not None and pressure > HBM_PRESSURE_EVICT:
+                    while (pressure is not None
+                           and pressure > HBM_LOW_WATER
+                           and len(self._cache) + len(self._probation) > 1):
+                        evicted_key = self._evict_one_locked(
+                            (self._probation, self._cache), protect=key)
+                        if evicted_key is None:
+                            break
+                        evicted_n += 1
+                        log.info("HBM pressure %.2f: evicted solver for "
+                                 "catalog hash=%x (low-water drain)",
+                                 pressure, evicted_key[0])
+                        pressure = buckets.HBM.pressure()
+                    overload_eviction.note_lowwater(evicted_n)
+            else:
+                while (pressure is not None
+                       and pressure > HBM_PRESSURE_EVICT
+                       and len(self._cache) > 1):
+                    evicted_key = self._evict_one_locked((self._cache,),
+                                                         protect=key)
+                    if evicted_key is None:
+                        break  # all pinned: bound yields to correctness
+                    log.info("HBM pressure %.2f: evicted solver for "
+                             "catalog hash=%x", pressure, evicted_key[0])
+                    pressure = buckets.HBM.pressure()
+        if plane_on:
+            overload_metrics.THRASH_RATIO.set(
+                self.eviction_stats()["thrash_ratio"])
         warmed = self._warm(solver, request)
         log.info("synced catalog seqnum=%d hash=%x (%d types, %d "
                  "provisioners, %d buckets warmed)",
@@ -324,15 +525,24 @@ class SolverService:
     def _solve_traced(self, request: pb.SolveRequest, context,
                       span) -> pb.SolveResponse:
         key = (request.catalog_hash, request.provisioner_hash)
-        with self._lock:
-            entry = self._cache.get(key)
-            if entry is not None:
-                self._cache.move_to_end(key)
+        # checkout pins the entry for the whole dispatch: a concurrent
+        # Sync's eviction pass (capacity, pressure, or low-water) can
+        # never release this solver's device grid mid-solve
+        entry = self.checkout(key)
         if entry is None:
             context.abort(
                 grpc.StatusCode.FAILED_PRECONDITION,
                 f"catalog hash={request.catalog_hash:x} not synced; "
                 f"re-Sync required")
+        try:
+            return self._solve_pinned(request, context, key, entry, span)
+        finally:
+            self.checkin(key)
+
+    def _solve_pinned(self, request: pb.SolveRequest, context,
+                      key: "tuple[int, int]",
+                      entry: "tuple[TPUSolver, int]",
+                      span) -> pb.SolveResponse:
         if request.deadline_ms and request.deadline_ms < SHED_MIN_BUDGET_MS:
             context.abort(
                 grpc.StatusCode.DEADLINE_EXCEEDED,
@@ -438,53 +648,57 @@ class SolverService:
                 context=wire.trace_context_from_wire(request.trace_context),
                 nodes=len(request.nodes)) as span:
             key = (request.catalog_hash, request.provisioner_hash)
-            with self._lock:
-                entry = self._cache.get(key)
-                if entry is not None:
-                    self._cache.move_to_end(key)
+            # checkout pins the entry for the candidate search — the same
+            # eviction-vs-dispatch race Solve closes (see _solve_traced)
+            entry = self.checkout(key)
             if entry is None:
                 context.abort(
                     grpc.StatusCode.FAILED_PRECONDITION,
                     f"catalog hash={request.catalog_hash:x} not synced; "
                     f"re-Sync required")
-            if request.deadline_ms \
-                    and request.deadline_ms < SHED_MIN_BUDGET_MS:
-                context.abort(
-                    grpc.StatusCode.DEADLINE_EXCEEDED,
-                    f"{request.deadline_ms}ms of cycle budget remaining; "
-                    f"shedding consolidation")
-            solver, _seqnum = entry
-            cluster = ClusterState()
-            eligible_names: "set[str]" = set()
-            for msg in request.nodes:
-                node, node_eligible = wire.consolidation_node_from_wire(msg)
-                cluster.add_node(node)
-                if node_eligible:
-                    eligible_names.add(node.name)
-            overhead = list(request.daemon_overhead) or None
-            # big clusters shard their candidate lanes over the persistent
-            # lane mesh (data parallelism); small ones stay single-chip —
-            # the same crossover doctrine as the solve router
-            ctx = self._device_context()
-            lane_mesh = (ctx.lane_mesh if ctx is not None
-                         and len(request.nodes) >= CONSOLIDATE_LANE_MESH_MIN
-                         else None)
-            t0 = time.perf_counter()
-            action = run_consolidation(
-                cluster, solver.catalog, solver.provisioners,
-                daemon_overhead=overhead, now=request.now,
-                grid=solver.grid(),  # the Sync'd device-resident grid — no rebuild
-                mesh=lane_mesh,
-                multi_node=request.multi_node,
-                # -1 = unset sentinel -> server default; 0 legitimately
-                # DISABLES the pair search (proto3 zero-value trap)
-                max_pair_candidates=(MAX_PAIR_CANDIDATES
-                                     if request.max_pair_candidates < 0
-                                     else request.max_pair_candidates),
-                candidate_filter=lambda n: n.name in eligible_names)
-            ms = (time.perf_counter() - t0) * 1000
-            span.set_attributes(found=action is not None, consolidate_ms=ms)
-            return wire.action_to_response(action, ms)
+            try:
+                if request.deadline_ms \
+                        and request.deadline_ms < SHED_MIN_BUDGET_MS:
+                    context.abort(
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                        f"{request.deadline_ms}ms of cycle budget "
+                        f"remaining; shedding consolidation")
+                solver, _seqnum = entry
+                cluster = ClusterState()
+                eligible_names: "set[str]" = set()
+                for msg in request.nodes:
+                    node, node_eligible = \
+                        wire.consolidation_node_from_wire(msg)
+                    cluster.add_node(node)
+                    if node_eligible:
+                        eligible_names.add(node.name)
+                overhead = list(request.daemon_overhead) or None
+                # big clusters shard their candidate lanes over the
+                # persistent lane mesh (data parallelism); small ones stay
+                # single-chip — same crossover doctrine as the solve router
+                ctx = self._device_context()
+                lane_mesh = (ctx.lane_mesh if ctx is not None
+                             and len(request.nodes) >= CONSOLIDATE_LANE_MESH_MIN
+                             else None)
+                t0 = time.perf_counter()
+                action = run_consolidation(
+                    cluster, solver.catalog, solver.provisioners,
+                    daemon_overhead=overhead, now=request.now,
+                    grid=solver.grid(),  # Sync'd device-resident — no rebuild
+                    mesh=lane_mesh,
+                    multi_node=request.multi_node,
+                    # -1 = unset sentinel -> server default; 0 legitimately
+                    # DISABLES the pair search (proto3 zero-value trap)
+                    max_pair_candidates=(MAX_PAIR_CANDIDATES
+                                         if request.max_pair_candidates < 0
+                                         else request.max_pair_candidates),
+                    candidate_filter=lambda n: n.name in eligible_names)
+                ms = (time.perf_counter() - t0) * 1000
+                span.set_attributes(found=action is not None,
+                                    consolidate_ms=ms)
+                return wire.action_to_response(action, ms)
+            finally:
+                self.checkin(key)
 
     def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
         import jax

@@ -426,7 +426,7 @@ class TestCriticalzEndpoint:
         code, body = _get(ports["metrics"], "/debug/statusz")
         assert code == 200
         doc = json.loads(body)
-        assert doc["schema"] == 12
+        assert doc["schema"] == 13
         sect = doc["critical"]
         assert sect["enabled"] is True
         assert sect["lanes"] == list(critical.LANES)

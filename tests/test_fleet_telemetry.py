@@ -263,17 +263,23 @@ class TestHbmLedger:
 
 
 class TestHbmServicePressure:
-    def test_sync_under_pressure_evicts_down_to_one(self, monkeypatch):
-        """With a 1-byte declared capacity every resident grid is over
-        the 0.9 pressure line: the second Sync must evict the first
-        solver (count cap alone would have kept both) and release its
-        ledger bytes."""
-        from karpenter_tpu.solver import wire
-        from karpenter_tpu.solver.service import (SolverService, hbm_key,
-                                                  pb)
+    @pytest.fixture(autouse=True)
+    def _clean_ledger(self):
+        """The HBM ledger is process-global and earlier tests may leak
+        resident entries; with this class's 1-byte capacity any residue
+        reads as crowding and flips the admission path. Start empty."""
+        for key in list(buckets.HBM.snapshot()["solvers"]):
+            buckets.HBM.release(key)
+        yield
 
-        monkeypatch.setenv(buckets.HBM_CAPACITY_ENV, "1")
-        svc = SolverService()
+    @staticmethod
+    def _two_syncs(svc):
+        """First Sync installs one solver; second Sync ships a moved-price
+        catalog (new content hash) under a 1-byte declared capacity, so
+        every resident grid is over the 0.9 pressure line."""
+        from karpenter_tpu.solver import wire
+        from karpenter_tpu.solver.service import pb
+
         cat = small_catalog()
         provs = [default_provisioner()]
         req = pb.SyncRequest(catalog=wire.catalog_to_wire(cat),
@@ -281,7 +287,6 @@ class TestHbmServicePressure:
                                            for p in provs])
         svc.Sync(req, None)
         (key1,) = list(svc._cache)
-        assert buckets.HBM.resident_bytes(hbm_key(key1)) > 0
         moved = dataclasses.replace(cat, types=[
             dataclasses.replace(t, offerings=type(t.offerings)(tuple(
                 dataclasses.replace(o, price=o.price * 2)
@@ -291,11 +296,42 @@ class TestHbmServicePressure:
                               provisioners=[wire.provisioner_to_wire(p)
                                             for p in provs])
         svc.Sync(req2, None)
-        assert len(svc._cache) == 1
-        (key2,) = list(svc._cache)
+        return key1
+
+    def test_sync_under_pressure_evicts_down_to_one(self, monkeypatch):
+        """Overload plane ON (the default): the unearned newcomer lands on
+        probation and the low-water drain evicts the warm resident, so
+        exactly ONE solver stays device-resident (count cap alone would
+        have kept both) and the evicted ledger bytes are released."""
+        from karpenter_tpu.solver.service import SolverService, hbm_key
+
+        monkeypatch.setenv(buckets.HBM_CAPACITY_ENV, "1")
+        svc = SolverService()
+        key1 = self._two_syncs(svc)
+        assert len(svc._cache) + len(svc._probation) == 1
+        (key2,) = list(svc._probation)
         assert key2 != key1
         # the evicted solver's ledger entries were released (gauges step
         # to zero, entries drop)
+        assert buckets.HBM.resident_bytes(hbm_key(key1)) == 0.0
+        assert buckets.HBM.resident_bytes(hbm_key(key2)) > 0
+        buckets.HBM.release(hbm_key(key2))  # leave no residue behind
+
+    def test_sync_under_pressure_disabled_keeps_newcomer(self, monkeypatch):
+        """Plane disabled is a strict no-op: the pre-plane eviction loop —
+        newcomer straight into the LRU, pressure pass keeps the entry
+        just installed, old resident evicted and released."""
+        from karpenter_tpu.overload import state as overload
+        from karpenter_tpu.solver.service import SolverService, hbm_key
+
+        monkeypatch.setenv(buckets.HBM_CAPACITY_ENV, "1")
+        svc = SolverService()
+        with overload.disabled():
+            key1 = self._two_syncs(svc)
+        assert len(svc._cache) == 1
+        assert not svc._probation
+        (key2,) = list(svc._cache)
+        assert key2 != key1
         assert buckets.HBM.resident_bytes(hbm_key(key1)) == 0.0
         assert buckets.HBM.resident_bytes(hbm_key(key2)) > 0
         buckets.HBM.release(hbm_key(key2))  # leave no residue behind

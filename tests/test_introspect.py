@@ -176,7 +176,7 @@ class TestStatusz:
     TOP_KEYS = {"tool", "schema", "version", "ts", "pid", "serving",
                 "cluster", "controllers", "queues", "caches", "events",
                 "resilience", "recovery", "fleet", "slo", "hbm",
-                "profiling", "critical", "spot", "decisions",
+                "profiling", "critical", "spot", "overload", "decisions",
                 "incremental", "metrics"}
     CLUSTER_KEYS = {"nodes", "nodes_by_provisioner",
                     "nodes_marked_for_deletion", "machines", "pods",
@@ -190,7 +190,7 @@ class TestStatusz:
         # key-set changes are schema changes and must bump SCHEMA_VERSION
         assert set(snap) == self.TOP_KEYS
         assert snap["tool"] == "karpenter_tpu.statusz"
-        assert snap["schema"] == 12
+        assert snap["schema"] == 13
         assert set(snap["slo"]) == {"windows", "burn_threshold", "slos"}
         assert {"solvers", "resident_bytes_total", "capacity_bytes",
                 "pressure"} <= set(snap["hbm"])

@@ -489,7 +489,8 @@ class TestRegressGate:
                         "--inject", "baseline_config_ms=99",
                         "--inject", "profile_unaccounted_share=0.9",
                         "--inject", "incremental_steady_encode_share=0.99",
-                        "--inject", "critical_serialize_share=0.99"])
+                        "--inject", "critical_serialize_share=0.99",
+                        "--inject", "churn_eviction_thrash_ratio=0.9"])
         out = capsys.readouterr().out
         assert rc == 0, out
-        assert out.count("SEED") == 5
+        assert out.count("SEED") == 6
